@@ -1,0 +1,19 @@
+"""areal_tpu — a TPU-native asynchronous RL training framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of the reference
+AReaL system (see /root/reference): fully asynchronous RL for large reasoning
+LLMs with interruptible generation, bounded staleness, decoupled PPO, and
+GSPMD-sharded training over TPU meshes.
+
+Design notes (vs the reference, cited as reference file:line):
+- One GSPMD trainer engine replaces FSDP/Megatron/Archon
+  (reference areal/engine/*): a single jax mesh ``(data, fsdp, seq, model,
+  expert)`` plus sharding rules covers DP/TP/SP/EP; XLA inserts collectives.
+- A JAX inference server replaces SGLang/vLLM, speaking the same small HTTP
+  protocol (generate/pause/continue/update-weights) the client layer needs.
+- The pure-python control plane (staleness manager, dispatcher, workflow
+  executor, allocation DSL, stats tracker) keeps the reference's behavior but
+  uses numpy/jax pytrees as the data container.
+"""
+
+__version__ = "0.1.0"

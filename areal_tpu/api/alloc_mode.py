@@ -1,0 +1,325 @@
+"""Allocation-mode DSL: one string ties device topology to backends.
+
+Behavioral parity with reference areal/api/alloc_mode.py:333-427,548-592
+(there implemented with a lark grammar; here a dependency-free recursive
+descent parser). Accepted strings, e.g.:
+
+- ``d4t2p2``                      — pure parallel spec (train only)
+- ``sglang:d4t2+fsdp:d8``         — disaggregated generation + training
+- ``sglang[r]:d2+fsdp[a]:d4|fsdp[c]:d4``  — roles; ``|`` (colocation) binds
+  tighter than ``+`` (disaggregation)
+- ``vllm:d2t2+megatron:(attn:d4t2|ffn:d2e4)``  — MoE hybrid spec
+
+Grammar::
+
+    expr      := group ('+' group)*
+    group     := alloc ('|' alloc)*
+    alloc     := ident role? ':' pspec | pspec
+    role      := '[' ident ']'
+    pspec     := plain | '(' 'attn' ':' plain '|' 'ffn' ':' plain ')'
+    plain     := (dim number)+      dim in {d,t,p,c,e} or 'et'
+
+TPU mapping: generation backends (sglang/vllm/jax) all resolve to the JAX
+inference server; train backends (fsdp/megatron/archon/gspmd) all resolve to
+the single GSPMD engine — the parallel spec selects mesh axis sizes
+(dp→data, t→model, c→seq, e→expert; p→pipeline stages, usually 1 on TPU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from enum import Enum
+
+GEN_BACKENDS = {"sglang", "vllm", "jax", "jax_server"}
+TRAIN_BACKENDS = {"fsdp", "megatron", "archon", "gspmd", "jax_train"}
+
+_DIM_ALIASES = {
+    "d": "dp",
+    "t": "tp",
+    "p": "pp",
+    "c": "cp",
+    "e": "ep",
+    "et": "etp",
+}
+
+
+class AllocationType(Enum):
+    DECOUPLED = "decoupled"  # gen + train on disjoint devices
+    COLOCATE = "colocate"  # gen | train sharing devices
+    TRAIN_ONLY = "train_only"
+    GEN_ONLY = "gen_only"
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelStrategy:
+    """5-D parallel strategy (reference alloc_mode.py:30-245).
+
+    On TPU these become mesh axis sizes: dp→``data``, tp→``model``,
+    cp→``seq``, ep→``expert``; pp maps to GSPMD stage sharding (rarely needed).
+    """
+
+    dp: int = 1
+    tp: int = 1
+    pp: int = 1
+    cp: int = 1
+    ep: int = 1
+    etp: int = 1
+
+    def __post_init__(self):
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(f"parallel degree {f.name}={v!r} must be an int >= 1")
+
+    @property
+    def world_size(self) -> int:
+        return self.dp * self.tp * self.pp * self.cp
+
+    # Aliases matching reference naming
+    @property
+    def dp_size(self) -> int:
+        return self.dp
+
+    @property
+    def tp_size(self) -> int:
+        return self.tp
+
+    @property
+    def pp_size(self) -> int:
+        return self.pp
+
+    @property
+    def cp_size(self) -> int:
+        return self.cp
+
+    @property
+    def ep_size(self) -> int:
+        return self.ep
+
+    def __str__(self) -> str:
+        parts = [f"d{self.dp}"]
+        for letter, attr in (("t", "tp"), ("p", "pp"), ("c", "cp"), ("e", "ep")):
+            v = getattr(self, attr)
+            if v != 1:
+                parts.append(f"{letter}{v}")
+        return "".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class HybridParallelStrategy:
+    """MoE hybrid: separate attention vs FFN(expert) sharding."""
+
+    attn: ParallelStrategy
+    ffn: ParallelStrategy
+
+    def __post_init__(self):
+        # the ffn spec reuses the attn devices: ep borrows dp degrees, so the
+        # ffn world including ep must equal the attn world
+        ffn_ws = self.ffn.dp * self.ffn.tp * self.ffn.pp * self.ffn.cp * self.ffn.ep
+        if ffn_ws != self.attn.world_size:
+            raise ValueError(
+                f"hybrid MoE spec mismatch: attn world {self.attn.world_size} != "
+                f"ffn world {ffn_ws} (dp*tp*pp*cp*ep)"
+            )
+
+    @property
+    def world_size(self) -> int:
+        return self.attn.world_size
+
+
+@dataclasses.dataclass
+class ModelAllocation:
+    backend: str | None
+    name: str  # role: "" (default), "r"(ollout), "a"(ctor), "c"(ritic), ...
+    parallel: ParallelStrategy | HybridParallelStrategy
+
+    @property
+    def is_gen(self) -> bool:
+        return self.backend in GEN_BACKENDS
+
+    @property
+    def is_train(self) -> bool:
+        return self.backend is None or self.backend in TRAIN_BACKENDS
+
+    @property
+    def world_size(self) -> int:
+        return self.parallel.world_size
+
+
+class _Parser:
+    def __init__(self, s: str):
+        self.s = s.replace(" ", "")
+        self.i = 0
+
+    def error(self, msg: str):
+        raise ValueError(f"allocation mode parse error at {self.i} in {self.s!r}: {msg}")
+
+    def peek(self) -> str:
+        return self.s[self.i] if self.i < len(self.s) else ""
+
+    def eat(self, ch: str):
+        if self.peek() != ch:
+            self.error(f"expected {ch!r}")
+        self.i += 1
+
+    def ident(self) -> str:
+        m = re.match(r"[A-Za-z_][A-Za-z0-9_]*", self.s[self.i :])
+        if not m:
+            self.error("expected identifier")
+        self.i += len(m.group())
+        return m.group()
+
+    def plain_pspec(self) -> ParallelStrategy:
+        dims: dict[str, int] = {}
+        matched = False
+        while True:
+            m = re.match(r"(et|[dtpce])(\d+)", self.s[self.i :])
+            if not m:
+                break
+            matched = True
+            key = _DIM_ALIASES[m.group(1)]
+            if key in dims:
+                self.error(f"duplicate dim {m.group(1)!r}")
+            dims[key] = int(m.group(2))
+            self.i += len(m.group())
+        if not matched:
+            self.error("expected parallel spec like d4t2")
+        return ParallelStrategy(**dims)
+
+    def pspec(self) -> ParallelStrategy | HybridParallelStrategy:
+        if self.peek() == "(":
+            self.eat("(")
+            specs: dict[str, ParallelStrategy] = {}
+            while True:
+                part = self.ident()
+                if part not in ("attn", "ffn"):
+                    self.error("hybrid spec parts must be 'attn' or 'ffn'")
+                self.eat(":")
+                specs[part] = self.plain_pspec()
+                if self.peek() == "|":
+                    self.eat("|")
+                    continue
+                break
+            self.eat(")")
+            if set(specs) != {"attn", "ffn"}:
+                self.error("hybrid spec needs both attn and ffn")
+            return HybridParallelStrategy(attn=specs["attn"], ffn=specs["ffn"])
+        return self.plain_pspec()
+
+    def alloc(self) -> ModelAllocation:
+        save = self.i
+        # try backend[role]:pspec
+        m = re.match(r"[A-Za-z_][A-Za-z0-9_]*", self.s[self.i :])
+        if m and (
+            self.s[self.i + len(m.group()) : self.i + len(m.group()) + 1] in (":", "[")
+        ):
+            backend = self.ident()
+            name = ""
+            if self.peek() == "[":
+                self.eat("[")
+                name = self.ident()
+                self.eat("]")
+            self.eat(":")
+            if backend not in GEN_BACKENDS | TRAIN_BACKENDS:
+                self.error(f"unknown backend {backend!r}")
+            return ModelAllocation(backend=backend, name=name, parallel=self.pspec())
+        self.i = save
+        return ModelAllocation(backend=None, name="", parallel=self.plain_pspec())
+
+    def group(self) -> list[ModelAllocation]:
+        allocs = [self.alloc()]
+        while self.peek() == "|":
+            self.eat("|")
+            allocs.append(self.alloc())
+        return allocs
+
+    def expr(self) -> list[list[ModelAllocation]]:
+        groups = [self.group()]
+        while self.peek() == "+":
+            self.eat("+")
+            groups.append(self.group())
+        if self.i != len(self.s):
+            self.error("trailing input")
+        return groups
+
+
+@dataclasses.dataclass
+class AllocationMode:
+    type_: AllocationType
+    groups: list[list[ModelAllocation]]
+
+    @classmethod
+    def from_str(cls, s: str) -> "AllocationMode":
+        groups = _Parser(s).expr()
+        gen = [a for g in groups for a in g if a.is_gen]
+        train = [a for g in groups for a in g if not a.is_gen]
+        if gen and train:
+            colocated = any(
+                any(a.is_gen for a in g) and any(not a.is_gen for a in g)
+                for g in groups
+            )
+            t = AllocationType.COLOCATE if colocated else AllocationType.DECOUPLED
+        elif gen:
+            t = AllocationType.GEN_ONLY
+        else:
+            t = AllocationType.TRAIN_ONLY
+        return cls(type_=t, groups=groups)
+
+    @property
+    def allocations(self) -> list[ModelAllocation]:
+        return [a for g in self.groups for a in g]
+
+    def _find(self, pred) -> ModelAllocation | None:
+        for a in self.allocations:
+            if pred(a):
+                return a
+        return None
+
+    @property
+    def gen(self) -> ParallelStrategy | None:
+        a = self._find(lambda a: a.is_gen)
+        return a.parallel if a else None
+
+    @property
+    def train(self) -> ParallelStrategy | HybridParallelStrategy | None:
+        a = self._find(lambda a: not a.is_gen and a.name in ("", "a", "actor"))
+        if a is None:
+            a = self._find(lambda a: not a.is_gen)
+        return a.parallel if a else None
+
+    @property
+    def critic(self) -> ParallelStrategy | None:
+        a = self._find(lambda a: not a.is_gen and a.name in ("c", "critic"))
+        return a.parallel if a else None
+
+    @property
+    def gen_backend(self) -> str | None:
+        a = self._find(lambda a: a.is_gen)
+        return a.backend if a else None
+
+    @property
+    def train_backend(self) -> str | None:
+        a = self._find(lambda a: not a.is_gen)
+        return (a.backend or "gspmd") if a else None
+
+    @property
+    def gen_world_size(self) -> int:
+        return sum(a.world_size for a in self.allocations if a.is_gen)
+
+    @property
+    def train_world_size(self) -> int:
+        # colocated allocations share devices: count per group max of train allocs
+        total = 0
+        for g in self.groups:
+            train_ws = [a.world_size for a in g if not a.is_gen]
+            if train_ws:
+                total += max(train_ws)
+        return total
+
+    @property
+    def world_size(self) -> int:
+        total = 0
+        for g in self.groups:
+            total += max(a.world_size for a in g)
+        return total

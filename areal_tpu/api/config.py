@@ -1,0 +1,443 @@
+"""Experiment configuration tree + YAML/CLI loader.
+
+Behavioral parity with reference areal/api/cli_args.py (2,240 LoC of nested
+dataclasses loaded via omegaconf). Here: plain dataclasses + a small
+recursive loader (`load_expr_config`) supporting ``--config file.yaml`` and
+dotted ``key=value`` overrides, no external deps.
+
+Field names mirror the reference so its YAML configs carry over with minimal
+edits; backend-specific sections (fsdp/megatron/sglang/vllm) are replaced by
+``engine`` (GSPMD mesh axes) and ``server`` (JAX inference server).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import typing
+from dataclasses import dataclass, field
+from typing import Any
+
+import yaml
+
+from areal_tpu.api.io_struct import GenerationHyperparameters  # noqa: F401
+from areal_tpu.utils.data import MicroBatchSpec  # noqa: F401
+
+
+@dataclass
+class NormConfig:
+    """Advantage/reward normalization (reference cli_args.py adv_norm)."""
+
+    mean_level: str = "batch"  # none|batch|group
+    std_level: str = "batch"
+    group_size: int = 1
+    eps: float = 1e-5
+
+
+@dataclass
+class OptimizerConfig:
+    type: str = "adamw"
+    lr: float = 2e-5
+    weight_decay: float = 0.05
+    beta1: float = 0.9
+    beta2: float = 0.95
+    eps: float = 1e-8
+    lr_scheduler_type: str = "constant"  # constant|linear|cosine
+    warmup_steps_proportion: float = 0.001
+    min_lr_ratio: float = 0.0
+    gradient_clipping: float = 1.0
+    offload_optimizer_state: bool = False
+
+
+@dataclass
+class MeshConfig:
+    """GSPMD device-mesh axis sizes — the TPU replacement for the reference's
+    per-backend parallel dims. Product must divide the process's device count;
+    -1 on ``data`` means "all remaining devices"."""
+
+    data: int = -1
+    fsdp: int = 1
+    seq: int = 1
+    model: int = 1
+    expert: int = 1
+
+
+@dataclass
+class TrainEngineConfig:
+    experiment_name: str = ""
+    trial_name: str = ""
+    path: str = ""  # HF model path (config + safetensors)
+    init_from_scratch: bool = False
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"  # master/optimizer dtype
+    attn_impl: str = "pallas"  # pallas|xla
+    gradient_checkpointing: bool = True
+    mb_spec: MicroBatchSpec = field(default_factory=MicroBatchSpec)
+    pad_to_maximum: bool = False
+    bucket_step: int = 512  # token-count bucketing to bound XLA recompiles
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    weight_update_mode: str = "disk"  # disk|mem
+
+
+@dataclass
+class PPOActorConfig(TrainEngineConfig):
+    """All PPO-family algorithm switches (reference cli_args.py PPOActorConfig).
+
+    The loss zoo dispatch lives in trainer/ppo.py; every published variant
+    (GRPO/DAPO/Dr.GRPO/LitePPO/RLOO/REINFORCE/GSPO/SAPO/M2PO) is a preset over
+    these fields, same as the reference's YAML-only variants.
+    """
+
+    group_size: int = 1
+    ppo_n_minibatches: int = 4
+    # clipping
+    eps_clip: float = 0.2
+    eps_clip_higher: float | None = None  # DAPO asymmetric upper clip
+    c_clip: float | None = None  # dual-clip PPO
+    # rewards/advantages
+    reward_scaling: float = 1.0
+    reward_bias: float = 0.0
+    reward_clip: float = 20.0
+    group_reward_norm: bool = False
+    adv_norm: NormConfig | None = field(default_factory=NormConfig)
+    gamma: float = 1.0
+    lam: float = 1.0
+    # KL regularization
+    kl_ctl: float = 0.0
+    kl_estimator: str = "k1"  # k1|k2|k3
+    # overlong penalty (DAPO)
+    overlong_reward_penalty: bool = False
+    overlong_tokens: int = 0
+    overlong_penalty_factor: float = 0.0
+    mask_too_long_tokens: bool = False
+    # decoupled PPO / staleness correction
+    recompute_logprob: bool = True
+    use_decoupled_loss: bool = True
+    behav_imp_weight_cap: float | None = None
+    behav_imp_weight_mode: str = "clip"  # clip|mask
+    # proximal logprob approximation (reference docs/en/algorithms/prox_approx.md)
+    prox_logp_mode: str = "recompute"  # recompute|loglinear|metrics
+    # importance-sampling level
+    imp_ratio_level: str = "token"  # token|sequence (GSPO)
+    # SAPO soft gates
+    use_sapo_loss: bool = False
+    sapo_tau_pos: float = 1.0
+    sapo_tau_neg: float = 1.05
+    # M2PO second-moment masking
+    use_m2po_loss: bool = False
+    m2po_tau: float = 0.04
+    # entropy & misc
+    entropy_coeff: float = 0.0
+    temperature: float = 1.0
+    log_agent_stats: bool = False
+    dynamic_sampling: bool = False  # DAPO filter: drop zero-variance groups
+
+
+@dataclass
+class PPOCriticConfig(TrainEngineConfig):
+    ppo_n_minibatches: int = 4
+    eps_clip: float = 0.5
+    mask_no_eos_with_zero: bool = False
+
+
+@dataclass
+class InferenceEngineConfig:
+    """Client-side rollout controls incl. staleness knobs (reference
+    cli_args.py:1591-1612)."""
+
+    experiment_name: str = ""
+    trial_name: str = ""
+    max_concurrent_rollouts: int | None = None
+    queue_size: int | None = None
+    consumer_batch_size: int = 1
+    max_head_offpolicyness: int = 0  # staleness bound η
+    enable_rollout_tracing: bool = False
+    check_trajectory_format: bool = True
+    schedule_policy: str = "round_robin"
+    request_timeout: float = 3600.0
+    request_retries: int = 3
+    pause_grace_period: float = 0.0
+    setup_timeout: float = 120.0
+    dump_trajectories: bool = False
+    dump_dir: str | None = None
+
+
+@dataclass
+class ServerConfig:
+    """JAX inference server (replaces reference sglang/vllm sections)."""
+
+    model_path: str = ""
+    dtype: str = "bfloat16"
+    max_batch_size: int = 32
+    max_seq_len: int = 32768
+    page_size: int = 128  # KV page granularity (paged attention)
+    hbm_utilization: float = 0.85
+    decode_steps_per_call: int = 16  # tokens decoded per jitted scan call
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    port: int = 0  # 0 = pick a free port
+    host: str = "0.0.0.0"
+    enable_prefix_caching: bool = True
+
+
+@dataclass
+class SaverConfig:
+    experiment_name: str = ""
+    trial_name: str = ""
+    fileroot: str = "/tmp/areal_tpu/experiments"
+    freq_epochs: int | None = None
+    freq_steps: int | None = None
+    freq_secs: float | None = None
+
+
+@dataclass
+class EvaluatorConfig(SaverConfig):
+    pass
+
+
+@dataclass
+class RecoverConfig(SaverConfig):
+    mode: str = "disabled"  # disabled|off|on|auto
+    retries: int = 3
+
+
+@dataclass
+class WandBConfig:
+    mode: str = "disabled"
+    project: str | None = None
+    name: str | None = None
+    group: str | None = None
+
+
+@dataclass
+class TensorBoardConfig:
+    path: str | None = None
+
+
+@dataclass
+class StatsLoggerConfig:
+    experiment_name: str = ""
+    trial_name: str = ""
+    fileroot: str = "/tmp/areal_tpu/experiments"
+    wandb: WandBConfig = field(default_factory=WandBConfig)
+    tensorboard: TensorBoardConfig = field(default_factory=TensorBoardConfig)
+
+
+@dataclass
+class NameResolveConfig:
+    type: str = "memory"  # memory|nfs
+    nfs_record_root: str = "/tmp/areal_tpu/name_resolve"
+
+
+@dataclass
+class ClusterSpecConfig:
+    name_resolve: NameResolveConfig = field(default_factory=NameResolveConfig)
+    cluster_name: str = "local"
+    fileroot: str = "/tmp/areal_tpu/experiments"
+    n_nodes: int = 1
+    n_accelerators_per_node: int = 8
+
+
+@dataclass
+class SchedulerConfig:
+    type: str = "local"  # local|ray|slurm
+    startup_timeout: float = 300.0
+
+
+@dataclass
+class LauncherConfig:
+    inference_server_cpus_per_gpu: int = 4
+    inference_server_mem_per_gpu: int = 32768
+    trainer_cpus_per_gpu: int = 4
+    trainer_mem_per_gpu: int = 32768
+
+
+@dataclass
+class PerfTracerConfig:
+    enabled: bool = False
+    output_dir: str | None = None
+    save_freq_steps: int = 10
+
+
+@dataclass
+class DatasetConfig:
+    path: str = ""
+    type: str = ""
+    batch_size: int = 1
+    shuffle: bool = True
+    max_length: int | None = None
+    drop_last: bool = True
+
+
+@dataclass
+class BaseExperimentConfig:
+    experiment_name: str = "test-exp"
+    trial_name: str = "test-trial"
+    cluster: ClusterSpecConfig = field(default_factory=ClusterSpecConfig)
+    allocation_mode: str = ""
+    seed: int = 1
+    total_train_epochs: int = 1
+    total_train_steps: int | None = None
+    total_train_n_seqs: int | None = None
+    tokenizer_path: str = ""
+    weight_update_mode: str = "disk"
+    train_dataset: DatasetConfig = field(default_factory=DatasetConfig)
+    valid_dataset: DatasetConfig | None = None
+    saver: SaverConfig = field(default_factory=SaverConfig)
+    checkpointer: SaverConfig = field(default_factory=SaverConfig)
+    evaluator: EvaluatorConfig = field(default_factory=EvaluatorConfig)
+    recover: RecoverConfig = field(default_factory=RecoverConfig)
+    stats_logger: StatsLoggerConfig = field(default_factory=StatsLoggerConfig)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    launcher: LauncherConfig = field(default_factory=LauncherConfig)
+    perf_tracer: PerfTracerConfig = field(default_factory=PerfTracerConfig)
+
+
+@dataclass
+class SFTConfig(BaseExperimentConfig):
+    model: TrainEngineConfig = field(default_factory=TrainEngineConfig)
+
+
+@dataclass
+class RWConfig(BaseExperimentConfig):
+    model: TrainEngineConfig = field(default_factory=TrainEngineConfig)
+
+
+@dataclass
+class PPOConfig(BaseExperimentConfig):
+    async_training: bool = True
+    gconfig: GenerationHyperparameters = field(
+        default_factory=GenerationHyperparameters
+    )
+    rollout: InferenceEngineConfig = field(default_factory=InferenceEngineConfig)
+    server: ServerConfig = field(default_factory=ServerConfig)
+    actor: PPOActorConfig = field(default_factory=PPOActorConfig)
+    critic: PPOCriticConfig | None = None
+    ref: TrainEngineConfig | None = None
+
+
+@dataclass
+class GRPOConfig(PPOConfig):
+    pass
+
+
+# ----------------------------------------------------------------------------
+# Loader: YAML + dotted key=value overrides -> nested dataclasses
+# ----------------------------------------------------------------------------
+
+
+def _is_dataclass_type(t) -> bool:
+    return isinstance(t, type) and dataclasses.is_dataclass(t)
+
+
+def _resolve_optional(t):
+    import types as _types
+
+    origin = typing.get_origin(t)
+    if origin is typing.Union or origin is _types.UnionType:
+        args = [a for a in typing.get_args(t) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return t
+
+
+def from_dict(cls, d: dict[str, Any] | None):
+    """Recursively build dataclass ``cls`` from a plain dict."""
+    if d is None:
+        return cls()
+    if not dataclasses.is_dataclass(cls):
+        return d
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    valid = {f.name for f in dataclasses.fields(cls)}
+    for key, val in d.items():
+        if key not in valid:
+            raise ValueError(f"unknown config key {key!r} for {cls.__name__}")
+        ft = _resolve_optional(hints[key])
+        if _is_dataclass_type(ft) and isinstance(val, dict):
+            kwargs[key] = from_dict(ft, val)
+        elif ft is float and isinstance(val, (str, int)) and not isinstance(val, bool):
+            # YAML 1.1 parses "1e-6" as a string; coerce by annotation
+            kwargs[key] = float(val)
+        elif ft is int and isinstance(val, str):
+            kwargs[key] = int(val)
+        elif ft is str and isinstance(val, bool):
+            # YAML 1.1 parses on/off/yes/no as booleans; recover the
+            # documented string values for str-typed fields (recover.mode)
+            kwargs[key] = "on" if val else "off"
+        else:
+            kwargs[key] = val
+    return cls(**kwargs)
+
+
+def to_dict(obj) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: to_dict(getattr(obj, f.name)) for f in dataclasses.fields(obj)}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    return obj
+
+
+def _parse_scalar(s: str) -> Any:
+    try:
+        val = yaml.safe_load(s)
+    except yaml.YAMLError:
+        return s
+    if isinstance(val, str):
+        # YAML 1.1 misses "3e-4"-style floats
+        try:
+            return float(val)
+        except ValueError:
+            return val
+    return val
+
+
+def apply_override(cfg, dotted_key: str, value: str) -> None:
+    parts = dotted_key.split(".")
+    obj = cfg
+    for p in parts[:-1]:
+        child = getattr(obj, p)
+        if child is None:
+            # instantiate Optional[dataclass] sections on demand
+            hints = typing.get_type_hints(type(obj))
+            ft = _resolve_optional(hints[p])
+            if _is_dataclass_type(ft):
+                child = ft()
+                setattr(obj, p, child)
+        obj = child
+    leaf = parts[-1]
+    if not hasattr(obj, leaf):
+        raise ValueError(f"unknown config key {dotted_key!r}")
+    hints = typing.get_type_hints(type(obj))
+    ft = _resolve_optional(hints.get(leaf, str))
+    if ft is str:
+        # keep the raw string: yaml would turn "on"/"off"/"yes" into bools
+        setattr(obj, leaf, value)
+        return
+    parsed = _parse_scalar(value)
+    if ft is float and isinstance(parsed, (str, int)) and not isinstance(parsed, bool):
+        parsed = float(parsed)
+    setattr(obj, leaf, parsed)
+
+
+def load_expr_config(argv: list[str], cls):
+    """Parse ``--config cfg.yaml`` plus ``a.b.c=value`` overrides.
+
+    Returns (config, config_file_path). Parity: reference api/cli_args.py
+    ``load_expr_config`` (there via omegaconf)."""
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", type=str, default=None)
+    args, overrides = parser.parse_known_args(argv)
+    data = {}
+    if args.config:
+        with open(args.config) as f:
+            data = yaml.safe_load(f) or {}
+    cfg = from_dict(cls, data)
+    for ov in overrides:
+        if "=" not in ov:
+            raise ValueError(f"override must be key=value, got {ov!r}")
+        k, v = ov.split("=", 1)
+        apply_override(cfg, k, v)
+    return cfg, args.config

@@ -1,0 +1,174 @@
+"""Request/response and metadata structs exchanged across the system.
+
+Behavioral parity with reference areal/api/io_struct.py:25-376, with torch
+tensors replaced by plain lists / numpy arrays (host-side control plane stays
+framework-free; jax arrays only live inside engines).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+import uuid
+from typing import Any
+
+
+@dataclasses.dataclass
+class GenerationHyperparameters:
+    """Sampling controls (reference api/cli_args.py:100-240)."""
+
+    n_samples: int = 1
+    max_new_tokens: int = 16384
+    min_new_tokens: int = 0
+    max_tokens: int | None = None  # total budget incl. prompt; None = unlimited
+    greedy: bool = False
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = -1
+    stop_token_ids: list[int] = dataclasses.field(default_factory=list)
+    stop: list[str] = dataclasses.field(default_factory=list)
+    frequency_penalty: float = 0.0
+
+    def new(self, **kwargs) -> "GenerationHyperparameters":
+        return dataclasses.replace(self, **kwargs)
+
+
+class StopReason(str, enum.Enum):
+    STOP = "stop"  # EOS / stop token
+    LENGTH = "length"  # max_new_tokens reached
+    ABORT = "abort"  # interrupted (weight update in flight) — resumable
+    TOOL_CALLS = "tool_calls"
+
+
+@dataclasses.dataclass
+class ModelRequest:
+    """One generation request (reference io_struct.py ModelRequest)."""
+
+    input_ids: list[int] = dataclasses.field(default_factory=list)
+    gconfig: GenerationHyperparameters = dataclasses.field(
+        default_factory=GenerationHyperparameters
+    )
+    rid: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
+    metadata: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # vision
+    image_data: list[Any] | None = None
+
+
+@dataclasses.dataclass
+class ModelResponse:
+    """Generation result with per-token bookkeeping.
+
+    ``output_versions[i]`` is the policy version that produced output token i —
+    the key input to decoupled-PPO staleness correction (reference
+    io_struct.py + remote_inf_engine.py:819-825).
+    """
+
+    input_tokens: list[int] = dataclasses.field(default_factory=list)
+    output_tokens: list[int] = dataclasses.field(default_factory=list)
+    output_logprobs: list[float] = dataclasses.field(default_factory=list)
+    output_versions: list[int] = dataclasses.field(default_factory=list)
+    stop_reason: str = StopReason.STOP.value
+    latency: float = 0.0
+    ttft: float = 0.0
+    rid: str = ""
+    metadata: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def input_len(self) -> int:
+        return len(self.input_tokens)
+
+    @property
+    def output_len(self) -> int:
+        return len(self.output_tokens)
+
+
+@dataclasses.dataclass
+class WeightUpdateMeta:
+    """How trainer weights reach inference servers (reference io_struct.py).
+
+    type:
+    - "disk": trainer saves HF-format safetensors; servers reload from path.
+    - "mem": host-staged device-to-device transfer over DCN — the TPU-native
+      replacement for the reference's cross-job NCCL broadcast group
+      (reference fsdp_engine.py:1047-1137). Weights stream as named bucketed
+      chunks through a shared in-memory store / sidecar socket.
+    """
+
+    type: str = "disk"
+    path: str | None = None
+    with_version: bool = True
+    alloc_mode: Any | None = None
+    chunked_mem_mb: int = 128
+
+    @classmethod
+    def new_disk_update(cls, path: str) -> "WeightUpdateMeta":
+        return cls(type="disk", path=path)
+
+
+@dataclasses.dataclass
+class SaveLoadMeta:
+    path: str
+    weight_format: str = "hf"  # "hf" (safetensors export) | "orbax" (sharded)
+    with_optim: bool = False
+    tokenizer: Any | None = None
+    base_model_path: str | None = None
+
+
+@dataclasses.dataclass
+class FinetuneSpec:
+    total_train_epochs: int
+    dataset_size: int
+    train_batch_size: int
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return max(1, self.dataset_size // self.train_batch_size)
+
+    @property
+    def total_train_steps(self) -> int:
+        return self.total_train_epochs * self.steps_per_epoch
+
+
+@dataclasses.dataclass
+class StepInfo:
+    epoch: int = 0
+    epoch_step: int = 0
+    global_step: int = 0
+    steps_per_epoch: int = 0
+
+    def next(self) -> "StepInfo":
+        ep, es = self.epoch, self.epoch_step + 1
+        if self.steps_per_epoch and es >= self.steps_per_epoch:
+            ep, es = ep + 1, 0
+        return StepInfo(
+            epoch=ep,
+            epoch_step=es,
+            global_step=self.global_step + 1,
+            steps_per_epoch=self.steps_per_epoch,
+        )
+
+
+@dataclasses.dataclass
+class RolloutStat:
+    submitted: int = 0
+    accepted: int = 0
+    running: int = 0
+    rejected: int = 0
+
+
+@dataclasses.dataclass
+class ParamSpec:
+    name: str
+    shape: tuple[int, ...]
+    dtype: str
+
+
+@dataclasses.dataclass
+class TimedResult:
+    """Payload + timing wrapper from the async task runner (reference
+    infra/async_task_runner.py TimedResult)."""
+
+    data: Any
+    task_id: str
+    create_time: float = dataclasses.field(default_factory=time.monotonic)

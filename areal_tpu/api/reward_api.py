@@ -1,0 +1,66 @@
+"""Reward function contract + async wrapper.
+
+Parity: reference areal/api/reward_api.py:16-200. Sync reward fns run in an
+executor so they never block the rollout event loop; the process-pool path
+recovers from broken pools (e.g. a reward fn segfaulting) by rebuilding.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import os
+from concurrent.futures import BrokenExecutor, ProcessPoolExecutor, ThreadPoolExecutor
+from typing import Callable, Protocol
+
+
+class RewardFn(Protocol):
+    def __call__(
+        self,
+        prompt: str,
+        completions: str,
+        prompt_ids: list[int],
+        completion_ids: list[int],
+        **kwargs,
+    ) -> float: ...
+
+
+class AsyncRewardWrapper:
+    """Run a synchronous reward function without blocking the event loop.
+
+    ``use_process_pool=True`` matches the reference's ProcessPoolExecutor
+    (needed for GIL-heavy verifiers like math_verify); the default thread
+    pool avoids fork-after-jax-init hazards for cheap string-match rewards.
+    """
+
+    def __init__(
+        self,
+        reward_fn: Callable,
+        use_process_pool: bool = False,
+        max_workers: int | None = None,
+    ):
+        self._fn = reward_fn
+        self._use_process_pool = use_process_pool
+        self._max_workers = max_workers or min(8, (os.cpu_count() or 2))
+        self._pool = None
+
+    def _get_pool(self):
+        if self._pool is None:
+            cls = ProcessPoolExecutor if self._use_process_pool else ThreadPoolExecutor
+            self._pool = cls(max_workers=self._max_workers)
+        return self._pool
+
+    async def __call__(self, *args, **kwargs) -> float:
+        loop = asyncio.get_running_loop()
+        call = functools.partial(self._fn, *args, **kwargs)
+        try:
+            return float(await loop.run_in_executor(self._get_pool(), call))
+        except BrokenExecutor:
+            # pool died (e.g. worker segfault): rebuild once and retry
+            self._pool = None
+            return float(await loop.run_in_executor(self._get_pool(), call))
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None

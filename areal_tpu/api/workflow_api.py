@@ -1,0 +1,36 @@
+"""Rollout workflow contract (parity: reference areal/api/workflow_api.py:12-113)."""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Union
+
+from areal_tpu.utils.data import TensorDict
+
+
+class RolloutWorkflow(abc.ABC):
+    """One episode of data collection.
+
+    ``arun_episode`` returns a trajectory dict (keys like input_ids /
+    loss_mask / logprobs / versions / rewards as 1D-per-token or scalar
+    numpy arrays — see utils/data.pad_sequences_to_tensors) or a *list* of
+    such dicts (grouped sampling), or None to signal rejection.
+    """
+
+    @abc.abstractmethod
+    async def arun_episode(self, engine, data: dict) -> TensorDict | list[TensorDict] | None: ...
+
+
+# "WorkflowLike": an instance, or an import path string resolved at use site.
+WorkflowLike = Union[RolloutWorkflow, str]
+
+
+def resolve_workflow(workflow: WorkflowLike, **kwargs) -> RolloutWorkflow:
+    if isinstance(workflow, RolloutWorkflow):
+        return workflow
+    if isinstance(workflow, str):
+        from areal_tpu.utils.dynamic_import import import_from_string
+
+        cls = import_from_string(workflow)
+        return cls(**kwargs)
+    raise TypeError(f"cannot resolve workflow from {workflow!r}")

@@ -1,0 +1,1 @@
+from areal_tpu.utils.logging import getLogger  # noqa: F401

@@ -1,0 +1,305 @@
+"""Host-side batch containers: padded dicts <-> packed 1D, microbatching.
+
+Behavioral parity with reference areal/utils/data.py (pack_tensor_dict
+:273-324, split_padded_tensor_dict_into_mb_list :477-598, MicroBatchList
+:386-476, Normalization :1154-1373) — re-designed for TPU:
+
+- containers are dict[str, np.ndarray] on host; jax arrays only appear at the
+  engine boundary.
+- packed batches carry ``cu_seqlens`` (int32, [B+1]) like the reference's
+  flash-attn convention, and a static ``pad_to_multiple_of`` hook so compiled
+  XLA shapes come from a small bucket set (recompile avoidance — SURVEY §7.3.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Iterator, Sequence
+
+import numpy as np
+
+from areal_tpu.utils import datapack
+
+TensorDict = dict[str, Any]
+
+# Keys that are per-sequence scalars (not per-token) in trajectory dicts.
+_NON_TOKEN_KEYS = ("rewards", "task_ids", "begin_of_trajectory", "seq_no_eos_mask")
+
+
+def is_per_token(key: str) -> bool:
+    return key not in _NON_TOKEN_KEYS
+
+
+def pad_sequences_to_tensors(
+    trajs: Sequence[TensorDict], pad_value: float | int = 0
+) -> TensorDict:
+    """Stack ragged per-sequence dicts into a padded batch with attention_mask.
+
+    Each traj maps key -> 1D array (per-token) or scalar (per-sequence).
+    """
+    assert len(trajs) > 0
+    lens = [int(np.asarray(t["input_ids"]).shape[0]) for t in trajs]
+    max_len = max(lens)
+    out: TensorDict = {}
+    for key in trajs[0]:
+        vals = [np.asarray(t[key]) for t in trajs]
+        if vals[0].ndim == 0:
+            out[key] = np.stack(vals)
+            continue
+        padded = []
+        for v in vals:
+            pad_width = [(0, max_len - v.shape[0])] + [(0, 0)] * (v.ndim - 1)
+            padded.append(np.pad(v, pad_width, constant_values=pad_value))
+        out[key] = np.stack(padded)
+    mask = np.zeros((len(trajs), max_len), dtype=np.bool_)
+    for i, l in enumerate(lens):
+        mask[i, :l] = True
+    out["attention_mask"] = mask
+    return out
+
+
+def concat_padded_tensor_dicts(dicts: Sequence[TensorDict]) -> TensorDict:
+    """Concatenate padded batches along batch dim, re-padding to the max len."""
+    assert len(dicts) > 0
+    max_len = max(d["attention_mask"].shape[1] for d in dicts)
+    out: TensorDict = {}
+    for key in dicts[0]:
+        vals = []
+        for d in dicts:
+            v = np.asarray(d[key])
+            own_len = d["attention_mask"].shape[1]
+            # per-token arrays share the dict's padded length; re-pad those
+            if v.ndim >= 2 and v.shape[1] == own_len and own_len != max_len:
+                pad_width = [(0, 0), (0, max_len - v.shape[1])] + [(0, 0)] * (
+                    v.ndim - 2
+                )
+                v = np.pad(v, pad_width)
+            vals.append(v)
+        out[key] = np.concatenate(vals, axis=0)
+    return out
+
+
+def batch_size(data: TensorDict) -> int:
+    return int(np.asarray(data["attention_mask"]).shape[0])
+
+
+def seqlens_of(data: TensorDict) -> np.ndarray:
+    return np.asarray(data["attention_mask"]).sum(axis=1).astype(np.int32)
+
+
+def gather_batch(data: TensorDict, indices: Sequence[int]) -> TensorDict:
+    idx = np.asarray(list(indices), dtype=np.int64)
+    return {k: np.asarray(v)[idx] for k, v in data.items()}
+
+
+def split_batch(data: TensorDict, groups: Sequence[Sequence[int]]) -> list[TensorDict]:
+    return [gather_batch(data, g) for g in groups]
+
+
+def pack_tensor_dict(data: TensorDict, pad_to_multiple_of: int | None = None) -> TensorDict:
+    """Padded [B, L] batch -> packed 1D [T] batch with cu_seqlens.
+
+    Parity: reference utils/data.py pack_tensor_dict:273-324. Per-sequence
+    scalar keys are kept with shape [B]. If ``pad_to_multiple_of`` is given, a
+    trailing dummy region (attention_mask False) pads T up so XLA sees bucketed
+    shapes; ``cu_seqlens`` then has a final padding segment only implied by
+    ``pad_length``.
+    """
+    mask = np.asarray(data["attention_mask"]).astype(bool)
+    B, L = mask.shape
+    lens = mask.sum(axis=1).astype(np.int32)
+    cu = np.zeros(B + 1, dtype=np.int32)
+    np.cumsum(lens, out=cu[1:])
+    total = int(cu[-1])
+    pad = 0
+    if pad_to_multiple_of:
+        pad = (-total) % pad_to_multiple_of
+    out: TensorDict = {}
+    for key, v in data.items():
+        v = np.asarray(v)
+        if key == "attention_mask":
+            continue
+        if v.ndim >= 2 and v.shape[:2] == (B, L):
+            flat = v[mask]
+            if pad:
+                pad_width = [(0, pad)] + [(0, 0)] * (flat.ndim - 1)
+                flat = np.pad(flat, pad_width)
+            out[key] = flat
+        else:
+            out[key] = v
+    out["cu_seqlens"] = cu
+    out["max_seqlen"] = int(lens.max()) if B else 0
+    out["pad_length"] = pad
+    return out
+
+
+def unpack_sequence(packed: np.ndarray, cu_seqlens: np.ndarray) -> list[np.ndarray]:
+    return [
+        np.asarray(packed)[int(cu_seqlens[i]) : int(cu_seqlens[i + 1])]
+        for i in range(len(cu_seqlens) - 1)
+    ]
+
+
+def unpack_tensor_dict(data: TensorDict) -> list[TensorDict]:
+    """Packed batch -> list of per-sequence dicts (inverse of pack on trajs)."""
+    cu = np.asarray(data["cu_seqlens"])
+    B = len(cu) - 1
+    total = int(cu[-1])
+    out: list[TensorDict] = [{} for _ in range(B)]
+    for key, v in data.items():
+        if key in ("cu_seqlens", "max_seqlen", "pad_length"):
+            continue
+        v = np.asarray(v)
+        # known per-sequence keys win even when B == total (all length-1 seqs)
+        per_seq_known = not is_per_token(key) and v.ndim >= 1 and v.shape[0] == B
+        if not per_seq_known and v.ndim >= 1 and v.shape[0] in (
+            total,
+            total + int(data.get("pad_length", 0)),
+        ):
+            for i, seq in enumerate(unpack_sequence(v, cu)):
+                out[i][key] = seq
+        elif v.ndim >= 1 and v.shape[0] == B:
+            for i in range(B):
+                out[i][key] = v[i]
+    return out
+
+
+@dataclasses.dataclass
+class MicroBatchSpec:
+    """Parity: reference api/cli_args.py MicroBatchSpec."""
+
+    n_mbs: int = 1
+    max_tokens_per_mb: int | None = None
+    granularity: int = 1
+
+
+@dataclasses.dataclass
+class MicroBatchList:
+    mbs: list[TensorDict]
+    group_indices: list[list[int]]
+    padded_to: list[int]
+
+    def __len__(self) -> int:
+        return len(self.mbs)
+
+    def __iter__(self):
+        return iter(self.mbs)
+
+
+def round_up_to_bucket(n: int, bucket_step: int = 512) -> int:
+    """Round a token count up to a power-of-two-ish bucket to bound the number
+    of distinct XLA compilations (TPU-specific; no reference counterpart)."""
+    if n <= bucket_step:
+        return bucket_step
+    # buckets: step * 2^k and step * 3 * 2^k (dense enough, few compiles)
+    k = math.ceil(math.log2(n / bucket_step))
+    cands = [bucket_step * (2**k), bucket_step * 3 * (2 ** max(0, k - 2))]
+    cands = [c for c in cands if c >= n]
+    return min(cands) if cands else bucket_step * (2**k)
+
+
+def split_padded_tensor_dict_into_mb_list(
+    data: TensorDict,
+    mb_spec: MicroBatchSpec,
+    same_groups_as: list[list[int]] | None = None,
+) -> MicroBatchList:
+    """FFD-balance sequences into microbatches by token count.
+
+    Parity: reference utils/data.py:477-598. ``granularity`` keeps adjacent
+    sequences together (e.g. chosen/rejected pairs for reward modeling).
+    ``same_groups_as`` forces an externally-synced allocation (the reference
+    all-reduces FFD solutions across DP — here the caller passes the agreed
+    grouping, see engine.prepare_mb_list).
+    """
+    lens = seqlens_of(data)
+    B = len(lens)
+    g = mb_spec.granularity
+    assert B % g == 0, (B, g)
+    unit_sizes = [int(lens[i * g : (i + 1) * g].sum()) for i in range(B // g)]
+    if same_groups_as is not None:
+        unit_groups = same_groups_as
+    elif mb_spec.max_tokens_per_mb:
+        unit_groups = datapack.ffd_allocate(
+            unit_sizes, mb_spec.max_tokens_per_mb, min_groups=mb_spec.n_mbs
+        )
+    else:
+        unit_groups = datapack.balanced_greedy_partition(unit_sizes, mb_spec.n_mbs)
+    unit_groups = [grp for grp in unit_groups if grp]
+    if same_groups_as is None and len(unit_groups) < mb_spec.n_mbs <= B // g:
+        # FFD packed tighter than the requested minimum mb count (needed for
+        # e.g. fixed gradient-accumulation length across DP): rebalance.
+        unit_groups = [
+            grp
+            for grp in datapack.balanced_greedy_partition(unit_sizes, mb_spec.n_mbs)
+            if grp
+        ]
+    groups = [[u * g + j for u in grp for j in range(g)] for grp in unit_groups]
+    groups = [grp for grp in groups if grp] or [list(range(B))]
+    mbs = split_batch(data, groups)
+    return MicroBatchList(mbs=mbs, group_indices=groups, padded_to=[0] * len(mbs))
+
+
+def cycle_dataloader(loader) -> Iterator:
+    """Infinite generator over a (re-iterable) dataloader.
+
+    Parity: reference utils/data.py cycle_dataloader (used by prepare_batch's
+    cached generator, workflow_executor.py:1290-1313).
+    """
+    while True:
+        yield from loader
+
+
+class Normalization:
+    """Mean/std normalization over masked values, batch- or group-wise.
+
+    Parity: reference utils/data.py Normalization:1154-1373. ``group_size``
+    normalizes within consecutive groups (GRPO group-normalized advantages).
+    """
+
+    def __init__(
+        self,
+        mean_level: str | None = "batch",  # none|batch|group
+        std_level: str | None = "batch",
+        group_size: int = 1,
+        eps: float = 1e-5,
+    ):
+        self.mean_level = mean_level or "none"
+        self.std_level = std_level or "none"
+        self.group_size = group_size
+        self.eps = eps
+
+    def __call__(self, x: np.ndarray, mask: np.ndarray | None = None) -> np.ndarray:
+        x = np.asarray(x, dtype=np.float64)
+        if mask is None:
+            mask = np.ones_like(x, dtype=bool)
+        mask = np.asarray(mask, dtype=bool)
+
+        def _masked_mean(xs, ms):
+            cnt = ms.sum()
+            return (xs * ms).sum() / cnt if cnt else 0.0
+
+        def _group_slices():
+            B = x.shape[0]
+            assert B % self.group_size == 0, (B, self.group_size)
+            return [slice(s, s + self.group_size) for s in range(0, B, self.group_size)]
+
+        # 1. the center is selected by mean_level; std is computed around that
+        #    same center (mean_level=none -> RMS around 0), matching reference
+        #    semantics so e.g. Dr.GRPO's no-mean variants stay sane.
+        center = np.zeros_like(x)
+        if self.mean_level == "group":
+            for sl in _group_slices():
+                center[sl] = _masked_mean(x[sl], mask[sl])
+        elif self.mean_level == "batch":
+            center[:] = _masked_mean(x, mask)
+
+        denom = np.ones_like(x)
+        sq = (x - center) ** 2
+        if self.std_level == "group":
+            for sl in _group_slices():
+                denom[sl] = math.sqrt(_masked_mean(sq[sl], mask[sl])) + self.eps
+        elif self.std_level == "batch":
+            denom[:] = math.sqrt(_masked_mean(sq, mask)) + self.eps
+
+        return (((x - center) / denom) * mask).astype(np.float32)

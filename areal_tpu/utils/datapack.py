@@ -1,0 +1,113 @@
+"""Balanced partitioning / bin-packing used for microbatching and DP routing.
+
+Behavioral parity with reference areal/utils/datapack.py (ffd_allocate at
+:187-210, balanced_greedy_partition at :211+, min_abs_diff_partition /
+partition_balanced). All functions operate on integer "sizes" (sequence
+lengths / token counts) and return *index* groups so callers can gather the
+underlying data.
+
+TPU note: FFD bins are ragged; callers that feed XLA pad each bin up to a
+bucketed capacity so compiled shapes stay static (see utils/data.py).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Sequence
+
+
+def ffd_allocate(
+    sizes: Sequence[int],
+    capacity: int,
+    min_groups: int = 1,
+) -> list[list[int]]:
+    """First-fit-decreasing bin packing.
+
+    Packs items into the smallest number of bins (>= ``min_groups``) such that
+    each bin's total size is <= ``capacity``. Items larger than ``capacity``
+    get a dedicated bin. Returns a list of index lists sorted by each bin's
+    first item index for determinism.
+    """
+    order = sorted(range(len(sizes)), key=lambda i: (-sizes[i], i))
+    bins: list[list[int]] = [[] for _ in range(min_groups)]
+    loads = [0] * min_groups
+    for i in order:
+        sz = sizes[i]
+        placed = False
+        for b in range(len(bins)):
+            if loads[b] + sz <= capacity or not bins[b]:
+                bins[b].append(i)
+                loads[b] += sz
+                placed = True
+                break
+        if not placed:
+            bins.append([i])
+            loads.append(sz)
+    bins = [sorted(b) for b in bins if b or len(bins) <= min_groups]
+    # Keep empty bins only to honor min_groups.
+    while len(bins) < min_groups:
+        bins.append([])
+    return sorted(bins, key=lambda b: (b[0] if b else len(sizes)))
+
+
+def balanced_greedy_partition(sizes: Sequence[int], k: int) -> list[list[int]]:
+    """Greedy longest-processing-time partition into exactly ``k`` groups.
+
+    Sort descending, always assign to the least-loaded group. Returns k index
+    lists (some possibly empty if len(sizes) < k), each sorted ascending.
+    """
+    assert k >= 1
+    heap = [(0, g) for g in range(k)]
+    heapq.heapify(heap)
+    groups: list[list[int]] = [[] for _ in range(k)]
+    for i in sorted(range(len(sizes)), key=lambda i: (-sizes[i], i)):
+        load, g = heapq.heappop(heap)
+        groups[g].append(i)
+        heapq.heappush(heap, (load + sizes[i], g))
+    return [sorted(g) for g in groups]
+
+
+def min_abs_diff_partition(sizes: Sequence[int], k: int) -> list[tuple[int, int]]:
+    """Partition a sequence into ``k`` *contiguous* spans minimizing the
+    maximum span sum (classic linear-partition DP). Returns [start, end)
+    pairs covering the sequence in order.
+
+    Mirrors reference areal/utils/datapack.py ``min_abs_diff_partition``'s
+    role: contiguous seqlen-balanced splits for DP dispatch.
+    """
+    n = len(sizes)
+    assert 1 <= k
+    if n == 0:
+        return [(0, 0)] * k
+    if k >= n:
+        spans = [(i, i + 1) for i in range(n)]
+        spans += [(n, n)] * (k - n)
+        return spans
+    prefix = [0] * (n + 1)
+    for i, s in enumerate(sizes):
+        prefix[i + 1] = prefix[i] + s
+
+    # dp[j][i] = minimal max-sum splitting first i items into j parts
+    INF = float("inf")
+    dp = [[INF] * (n + 1) for _ in range(k + 1)]
+    cut = [[0] * (n + 1) for _ in range(k + 1)]
+    dp[0][0] = 0
+    for j in range(1, k + 1):
+        for i in range(j, n + 1):
+            for p in range(j - 1, i):
+                cand = max(dp[j - 1][p], prefix[i] - prefix[p])
+                if cand < dp[j][i]:
+                    dp[j][i] = cand
+                    cut[j][i] = p
+    spans: list[tuple[int, int]] = []
+    i = n
+    for j in range(k, 0, -1):
+        p = cut[j][i]
+        spans.append((p, i))
+        i = p
+    return spans[::-1]
+
+
+def partition_balanced(sizes: Sequence[int], k: int) -> list[list[int]]:
+    """Contiguous balanced partition returned as index groups."""
+    return [list(range(s, e)) for s, e in min_abs_diff_partition(sizes, k)]

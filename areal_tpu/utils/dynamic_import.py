@@ -1,0 +1,27 @@
+"""Import-from-string, used for workflows/reward fns/engine classes.
+
+Parity: reference areal/utils/dynamic_import.py.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+
+def import_from_string(path: str):
+    """``"pkg.module.Attr"`` -> the attribute. Raises ImportError with a
+    helpful message on failure."""
+    if ":" in path:
+        module_name, attr = path.split(":", 1)
+    else:
+        module_name, _, attr = path.rpartition(".")
+    if not module_name:
+        raise ImportError(f"not a dotted import path: {path!r}")
+    module = importlib.import_module(module_name)
+    try:
+        obj = module
+        for part in attr.split("."):
+            obj = getattr(obj, part)
+        return obj
+    except AttributeError:
+        raise ImportError(f"module {module_name!r} has no attribute {attr!r}")

@@ -1,0 +1,63 @@
+"""Logging helpers (behavioral parity with reference areal/utils/logging.py).
+
+Colored console logging with per-module loggers and optional file logging.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s.%(msecs)03d %(name)s %(levelname)s: %(message)s"
+_DATE_FORMAT = "%Y%m%d-%H:%M:%S"
+
+_LEVEL_COLORS = {
+    logging.DEBUG: "\033[36m",
+    logging.INFO: "\033[32m",
+    logging.WARNING: "\033[33m",
+    logging.ERROR: "\033[31m",
+    logging.CRITICAL: "\033[41m",
+}
+_RESET = "\033[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        msg = super().format(record)
+        if sys.stderr.isatty():
+            color = _LEVEL_COLORS.get(record.levelno, "")
+            return f"{color}{msg}{_RESET}" if color else msg
+        return msg
+
+
+_configured = False
+
+
+def _configure_root() -> None:
+    global _configured
+    if _configured:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(_ColorFormatter(fmt=_FORMAT, datefmt=_DATE_FORMAT))
+    root = logging.getLogger("areal_tpu")
+    root.addHandler(handler)
+    root.setLevel(os.environ.get("AREAL_TPU_LOG_LEVEL", "INFO").upper())
+    root.propagate = False
+    _configured = True
+
+
+def getLogger(name: str | None = None) -> logging.Logger:
+    _configure_root()
+    if not name:
+        return logging.getLogger("areal_tpu")
+    return logging.getLogger(f"areal_tpu.{name}")
+
+
+def setup_file_logging(path: str) -> None:
+    """Additionally log everything to ``path`` (created with parents)."""
+    _configure_root()
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    handler = logging.FileHandler(path)
+    handler.setFormatter(logging.Formatter(fmt=_FORMAT, datefmt=_DATE_FORMAT))
+    logging.getLogger("areal_tpu").addHandler(handler)

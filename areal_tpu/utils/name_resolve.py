@@ -1,0 +1,270 @@
+"""KV service discovery with TTL and watch (parity: reference
+areal/utils/name_resolve.py:182,282,410,1209).
+
+Backends: in-process memory (tests, single host) and filesystem tree (NFS —
+the multi-host path on TPU pods, where every host mounts shared storage).
+etcd is intentionally not implemented (no etcd3 client in the image); the
+filesystem backend covers the same contract.
+
+TTL semantics: an entry added with ``keepalive_ttl`` expires (reads treat it
+as missing) unless refreshed; ``KeepaliveThread`` re-adds it periodically,
+mirroring the reference's keepalive threads, so entries of crashed processes
+drop out of discovery.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from abc import ABC, abstractmethod
+
+
+class NameEntryExistsError(RuntimeError):
+    pass
+
+
+class NameEntryNotFoundError(RuntimeError):
+    pass
+
+
+class NameResolveRepo(ABC):
+    @abstractmethod
+    def add(self, name: str, value: str, replace: bool = False, keepalive_ttl: float | None = None) -> None: ...
+
+    @abstractmethod
+    def get(self, name: str) -> str: ...
+
+    @abstractmethod
+    def get_subtree(self, name_root: str) -> list[str]: ...
+
+    @abstractmethod
+    def find_subtree(self, name_root: str) -> list[str]: ...
+
+    @abstractmethod
+    def delete(self, name: str) -> None: ...
+
+    @abstractmethod
+    def clear_subtree(self, name_root: str) -> None: ...
+
+    def wait(self, name: str, timeout: float | None = None, poll_frequency: float = 0.5) -> str:
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        while True:
+            try:
+                return self.get(name)
+            except NameEntryNotFoundError:
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise TimeoutError(f"timeout waiting for name {name!r}")
+                time.sleep(poll_frequency)
+
+    def keepalive(self, name: str, value: str, ttl: float) -> "KeepaliveThread":
+        """Register ``name`` with a TTL and keep refreshing it until stopped."""
+        self.add(name, value, replace=True, keepalive_ttl=ttl)
+        return KeepaliveThread(self, name, value, ttl)
+
+    def reset(self) -> None:
+        pass
+
+
+class KeepaliveThread:
+    def __init__(self, repo: NameResolveRepo, name: str, value: str, ttl: float):
+        self._repo = repo
+        self._name = name
+        self._value = value
+        self._ttl = ttl
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        period = max(0.1, self._ttl / 3)
+        while not self._stop.wait(period):
+            try:
+                self._repo.add(
+                    self._name, self._value, replace=True, keepalive_ttl=self._ttl
+                )
+            except Exception:
+                pass
+
+    def stop(self, delete_entry: bool = True):
+        self._stop.set()
+        self._thread.join(timeout=2)
+        if delete_entry:
+            try:
+                self._repo.delete(self._name)
+            except NameEntryNotFoundError:
+                pass
+
+
+class MemoryNameResolveRepo(NameResolveRepo):
+    def __init__(self):
+        self._lock = threading.RLock()
+        # name -> (value, expires_at | None)
+        self._store: dict[str, tuple[str, float | None]] = {}
+
+    def _alive(self, name: str) -> bool:
+        entry = self._store.get(name)
+        if entry is None:
+            return False
+        _, exp = entry
+        if exp is not None and time.monotonic() > exp:
+            del self._store[name]
+            return False
+        return True
+
+    def add(self, name, value, replace=False, keepalive_ttl=None):
+        with self._lock:
+            if self._alive(name) and not replace:
+                raise NameEntryExistsError(name)
+            exp = time.monotonic() + keepalive_ttl if keepalive_ttl else None
+            self._store[name] = (str(value), exp)
+
+    def get(self, name):
+        with self._lock:
+            if not self._alive(name):
+                raise NameEntryNotFoundError(name)
+            return self._store[name][0]
+
+    def find_subtree(self, name_root):
+        with self._lock:
+            prefix = name_root.rstrip("/") + "/"
+            return sorted(
+                k
+                for k in list(self._store)
+                if (k == name_root or k.startswith(prefix)) and self._alive(k)
+            )
+
+    def get_subtree(self, name_root):
+        with self._lock:
+            return [self._store[k][0] for k in self.find_subtree(name_root)]
+
+    def delete(self, name):
+        with self._lock:
+            if not self._alive(name):
+                raise NameEntryNotFoundError(name)
+            del self._store[name]
+
+    def clear_subtree(self, name_root):
+        with self._lock:
+            for k in self.find_subtree(name_root):
+                self._store.pop(k, None)
+
+    def reset(self):
+        with self._lock:
+            self._store.clear()
+
+
+class NfsNameResolveRepo(NameResolveRepo):
+    """File-tree backend: one JSON file per key under ``root``."""
+
+    def __init__(self, root: str = "/tmp/areal_tpu/name_resolve"):
+        self._root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self._root, name.strip("/"), "ENTRY.json")
+
+    def _read(self, name: str) -> str:
+        p = self._path(name)
+        try:
+            with open(p) as f:
+                entry = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            raise NameEntryNotFoundError(name)
+        ttl = entry.get("ttl")
+        if ttl is not None and time.time() > entry["ts"] + ttl:
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
+            raise NameEntryNotFoundError(name)
+        return entry["value"]
+
+    def add(self, name, value, replace=False, keepalive_ttl=None):
+        p = self._path(name)
+        if not replace:
+            try:
+                self._read(name)
+                raise NameEntryExistsError(name)
+            except NameEntryNotFoundError:
+                pass
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + f".tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"value": str(value), "ts": time.time(), "ttl": keepalive_ttl}, f
+            )
+        os.replace(tmp, p)
+
+    def get(self, name):
+        return self._read(name)
+
+    def find_subtree(self, name_root):
+        base = os.path.join(self._root, name_root.strip("/"))
+        names = []
+        if os.path.isdir(base):
+            for dirpath, _, files in os.walk(base):
+                if "ENTRY.json" in files:
+                    rel = os.path.relpath(dirpath, self._root)
+                    try:
+                        self._read(rel)
+                    except NameEntryNotFoundError:
+                        continue
+                    names.append(rel)
+        return sorted(names)
+
+    def get_subtree(self, name_root):
+        vals = []
+        for n in self.find_subtree(name_root):
+            try:
+                vals.append(self.get(n))
+            except NameEntryNotFoundError:
+                # entry expired/deleted between listing and read
+                continue
+        return vals
+
+    def delete(self, name):
+        p = self._path(name)
+        if not os.path.exists(p):
+            raise NameEntryNotFoundError(name)
+        os.remove(p)
+
+    def clear_subtree(self, name_root):
+        base = os.path.join(self._root, name_root.strip("/"))
+        if os.path.isdir(base):
+            shutil.rmtree(base, ignore_errors=True)
+
+
+DEFAULT_REPO: NameResolveRepo = MemoryNameResolveRepo()
+
+
+def make_repo(type_: str = "memory", **kwargs) -> NameResolveRepo:
+    if type_ == "memory":
+        return MemoryNameResolveRepo()
+    if type_ in ("nfs", "file"):
+        return NfsNameResolveRepo(**kwargs)
+    raise ValueError(f"unknown name_resolve backend {type_!r}")
+
+
+def reconfigure(type_: str = "memory", **kwargs) -> NameResolveRepo:
+    global DEFAULT_REPO
+    DEFAULT_REPO = make_repo(type_, **kwargs)
+    return DEFAULT_REPO
+
+
+# Conventional key layout (parity with reference names.py)
+def rollout_server_key(experiment: str, trial: str, server_idx: int | str = "") -> str:
+    base = f"{experiment}/{trial}/rollout_servers"
+    return f"{base}/{server_idx}" if server_idx != "" else base
+
+
+add = lambda *a, **k: DEFAULT_REPO.add(*a, **k)  # noqa: E731
+get = lambda *a, **k: DEFAULT_REPO.get(*a, **k)  # noqa: E731
+get_subtree = lambda *a, **k: DEFAULT_REPO.get_subtree(*a, **k)  # noqa: E731
+find_subtree = lambda *a, **k: DEFAULT_REPO.find_subtree(*a, **k)  # noqa: E731
+delete = lambda *a, **k: DEFAULT_REPO.delete(*a, **k)  # noqa: E731
+clear_subtree = lambda *a, **k: DEFAULT_REPO.clear_subtree(*a, **k)  # noqa: E731
+wait = lambda *a, **k: DEFAULT_REPO.wait(*a, **k)  # noqa: E731
+keepalive = lambda *a, **k: DEFAULT_REPO.keepalive(*a, **k)  # noqa: E731

@@ -1,0 +1,40 @@
+"""Host/port discovery utilities (parity: reference areal/utils/network.py)."""
+
+from __future__ import annotations
+
+import socket
+from contextlib import closing
+
+
+def find_free_ports(count: int = 1, low: int = 1024, high: int = 65535) -> list[int]:
+    ports: list[int] = []
+    socks = []
+    try:
+        for _ in range(count):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+            socks.append(s)
+            ports.append(port)
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+def find_free_port() -> int:
+    return find_free_ports(1)[0]
+
+
+def gethostip() -> str:
+    with closing(socket.socket(socket.AF_INET, socket.SOCK_DGRAM)) as s:
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        except OSError:
+            return "127.0.0.1"
+
+
+def gethostname() -> str:
+    return socket.gethostname()

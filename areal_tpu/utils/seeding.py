@@ -1,0 +1,42 @@
+"""Deterministic seeding per role (parity: reference areal/utils/seeding.py).
+
+On TPU/JAX, randomness is explicit via ``jax.random`` keys; this module seeds
+python/numpy for host-side code and derives a stable per-role jax PRNG key.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+
+import numpy as np
+
+_BASE_SEED: int | None = None
+_ROLE: str = ""
+
+
+def set_random_seed(seed: int, role: str = "") -> None:
+    global _BASE_SEED, _ROLE
+    _BASE_SEED = seed
+    _ROLE = role
+    mixed = _mix(seed, role)
+    random.seed(mixed)
+    np.random.seed(mixed % (2**32))
+
+
+def _mix(seed: int, role: str) -> int:
+    h = hashlib.sha256(f"{seed}-{role}".encode()).digest()
+    return int.from_bytes(h[:8], "little")
+
+
+def get_seed() -> int:
+    if _BASE_SEED is None:
+        raise RuntimeError("set_random_seed() has not been called")
+    return _BASE_SEED
+
+
+def jax_key(stream: str = "default"):
+    """Derive a stable jax PRNG key for a named stream from the global seed."""
+    import jax
+
+    return jax.random.PRNGKey(_mix(get_seed(), f"{_ROLE}/{stream}") % (2**31))

@@ -1,0 +1,51 @@
+"""Frequency-gated triggers for saver/evaluator (parity: reference
+api/cli_args.py _Timer:1700-1724 + utils/timeutil.py EpochStepTimeFreqCtl).
+
+Each of the epoch/step/seconds triggers keeps an *independent* baseline, so a
+frequent time trigger cannot postpone a step-based one (reference keeps three
+separate FrequencyControl instances for the same reason)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+
+@dataclasses.dataclass
+class FrequencyControl:
+    """Fires when any of epoch/step/seconds frequency is crossed."""
+
+    freq_epoch: int | None = None
+    freq_step: int | None = None
+    freq_sec: float | None = None
+
+    def __post_init__(self):
+        self._last_time = time.monotonic()
+        self._last_epoch = 0
+        self._last_step = 0
+
+    def check(self, epochs: int = 0, steps: int = 0) -> bool:
+        fired = False
+        now = time.monotonic()
+        if self.freq_epoch and epochs - self._last_epoch >= self.freq_epoch:
+            fired = True
+            self._last_epoch = epochs
+        if self.freq_step and steps - self._last_step >= self.freq_step:
+            fired = True
+            self._last_step = steps
+        if self.freq_sec and now - self._last_time >= self.freq_sec:
+            fired = True
+            self._last_time = now
+        return fired
+
+    def state_dict(self) -> dict:
+        return {
+            "last_time_delta": time.monotonic() - self._last_time,
+            "last_epoch": self._last_epoch,
+            "last_step": self._last_step,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self._last_time = time.monotonic() - state["last_time_delta"]
+        self._last_epoch = state["last_epoch"]
+        self._last_step = state["last_step"]

@@ -1,0 +1,86 @@
+"""Allocation-mode DSL tests (parity: reference tests/test_allocation_mode.py)."""
+
+import pytest
+
+from areal_tpu.api.alloc_mode import (
+    AllocationMode,
+    AllocationType,
+    HybridParallelStrategy,
+    ParallelStrategy,
+)
+
+
+def test_pure_parallel_spec():
+    am = AllocationMode.from_str("d4t2p2")
+    assert am.type_ == AllocationType.TRAIN_ONLY
+    assert am.train == ParallelStrategy(dp=4, tp=2, pp=2)
+    assert am.train.world_size == 16
+    assert am.gen is None
+
+
+def test_disaggregated():
+    am = AllocationMode.from_str("sglang:d4t2+fsdp:d8")
+    assert am.type_ == AllocationType.DECOUPLED
+    assert am.gen == ParallelStrategy(dp=4, tp=2)
+    assert am.train == ParallelStrategy(dp=8)
+    assert am.gen_world_size == 8
+    assert am.train_world_size == 8
+    assert am.world_size == 16
+    assert am.gen_backend == "sglang"
+
+
+def test_colocation_binds_tighter_than_disaggregation():
+    am = AllocationMode.from_str("sglang[r]:d2+fsdp[a]:d4|fsdp[c]:d4")
+    assert am.type_ == AllocationType.DECOUPLED
+    assert len(am.groups) == 2
+    assert len(am.groups[1]) == 2  # actor|critic colocated
+    assert am.train == ParallelStrategy(dp=4)
+    assert am.critic == ParallelStrategy(dp=4)
+    # colocated allocs share devices
+    assert am.world_size == 2 + 4
+
+
+def test_gen_train_colocated():
+    am = AllocationMode.from_str("sglang:d4|fsdp:d4")
+    assert am.type_ == AllocationType.COLOCATE
+    assert am.world_size == 4
+
+
+def test_moe_hybrid():
+    am = AllocationMode.from_str("vllm:d2t2+megatron:(attn:d4t2|ffn:d2e4)")
+    train = am.train
+    assert isinstance(train, HybridParallelStrategy)
+    assert train.attn == ParallelStrategy(dp=4, tp=2)
+    assert train.ffn == ParallelStrategy(dp=2, ep=4)
+
+
+def test_etp_dim():
+    am = AllocationMode.from_str("d2et4e2")
+    assert am.train.etp == 4
+    assert am.train.ep == 2
+
+
+def test_cp_dim():
+    am = AllocationMode.from_str("fsdp:d2c4")
+    assert am.train.cp == 4
+    assert am.train.world_size == 8
+
+
+@pytest.mark.parametrize(
+    "bad", ["", "x4", "d4+", "foo:d4", "sglang:", "d4d2", "(attn:d2)", "d4 |"]
+)
+def test_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        AllocationMode.from_str(bad)
+
+
+def test_gen_only():
+    am = AllocationMode.from_str("sglang:d2t4")
+    assert am.type_ == AllocationType.GEN_ONLY
+    assert am.gen_world_size == 8
+    assert am.train is None
+
+
+def test_moe_hybrid_world_mismatch_rejected():
+    with pytest.raises(ValueError):
+        AllocationMode.from_str("megatron:(attn:d4t2|ffn:d2e2)")

@@ -1,0 +1,103 @@
+import dataclasses
+
+import pytest
+
+from areal_tpu.api.config import (
+    GRPOConfig,
+    PPOActorConfig,
+    SFTConfig,
+    from_dict,
+    load_expr_config,
+    to_dict,
+)
+
+
+def test_defaults_roundtrip():
+    cfg = GRPOConfig()
+    d = to_dict(cfg)
+    cfg2 = from_dict(GRPOConfig, d)
+    assert cfg2 == cfg
+
+
+def test_yaml_loading(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text(
+        """
+experiment_name: e1
+actor:
+  lr_is_not_a_field_here: null
+"""
+    )
+    with pytest.raises(ValueError):
+        load_expr_config(["--config", str(p)], GRPOConfig)
+
+
+def test_yaml_and_overrides(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text(
+        """
+experiment_name: e1
+trial_name: t1
+actor:
+  group_size: 8
+  optimizer:
+    lr: 1.0e-6
+"""
+    )
+    cfg, _ = load_expr_config(
+        [
+            "--config",
+            str(p),
+            "actor.eps_clip=0.3",
+            "gconfig.max_new_tokens=128",
+            "actor.optimizer.lr_scheduler_type=cosine",
+        ],
+        GRPOConfig,
+    )
+    assert cfg.experiment_name == "e1"
+    assert cfg.actor.group_size == 8
+    assert cfg.actor.optimizer.lr == 1e-6
+    assert cfg.actor.eps_clip == 0.3
+    assert cfg.gconfig.max_new_tokens == 128
+    assert cfg.actor.optimizer.lr_scheduler_type == "cosine"
+
+
+def test_override_instantiates_optional_section():
+    cfg, _ = load_expr_config(["critic.eps_clip=0.7"], GRPOConfig)
+    assert cfg.critic is not None
+    assert cfg.critic.eps_clip == 0.7
+
+
+def test_override_unknown_key_raises():
+    with pytest.raises(ValueError):
+        load_expr_config(["actor.not_a_field=1"], GRPOConfig)
+
+
+def test_sft_config():
+    cfg, _ = load_expr_config(["model.optimizer.lr=3e-4"], SFTConfig)
+    assert cfg.model.optimizer.lr == 3e-4
+
+
+def test_actor_config_has_algorithm_switches():
+    fields = {f.name for f in dataclasses.fields(PPOActorConfig)}
+    for expected in (
+        "eps_clip_higher",
+        "c_clip",
+        "use_decoupled_loss",
+        "behav_imp_weight_cap",
+        "use_sapo_loss",
+        "use_m2po_loss",
+        "imp_ratio_level",
+        "dynamic_sampling",
+        "overlong_reward_penalty",
+    ):
+        assert expected in fields
+
+
+def test_recover_mode_on_stays_string(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text("recover:\n  mode: on\n")
+    cfg, _ = load_expr_config(["--config", str(p)], GRPOConfig)
+    assert cfg.recover.mode == "on"
+    cfg2, _ = load_expr_config(["recover.mode=off"], GRPOConfig)
+    assert cfg2.recover.mode == "off"

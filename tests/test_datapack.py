@@ -1,0 +1,58 @@
+"""Partitioning tests (parity: reference tests/test_datapack.py)."""
+
+import numpy as np
+
+from areal_tpu.utils.datapack import (
+    balanced_greedy_partition,
+    ffd_allocate,
+    min_abs_diff_partition,
+    partition_balanced,
+)
+
+
+def test_ffd_respects_capacity():
+    sizes = [300, 200, 500, 100, 400, 250]
+    bins = ffd_allocate(sizes, capacity=600)
+    seen = sorted(i for b in bins for i in b)
+    assert seen == list(range(len(sizes)))
+    for b in bins:
+        if len(b) > 1:
+            assert sum(sizes[i] for i in b) <= 600
+
+
+def test_ffd_oversize_item_gets_own_bin():
+    bins = ffd_allocate([700, 100], capacity=600)
+    assert [sizes for sizes in map(len, bins)].count(1) == 2
+
+
+def test_ffd_min_groups():
+    bins = ffd_allocate([10, 10], capacity=1000, min_groups=4)
+    assert len(bins) == 4
+
+
+def test_balanced_greedy_partition_covers_all():
+    rng = np.random.default_rng(0)
+    sizes = rng.integers(1, 1000, size=50).tolist()
+    groups = balanced_greedy_partition(sizes, 4)
+    assert sorted(i for g in groups for i in g) == list(range(50))
+    loads = [sum(sizes[i] for i in g) for g in groups]
+    assert max(loads) - min(loads) <= max(sizes)
+
+
+def test_min_abs_diff_partition_contiguous():
+    sizes = [1, 1, 1, 1, 100]
+    spans = min_abs_diff_partition(sizes, 2)
+    assert spans == [(0, 4), (4, 5)]
+    # coverage + contiguity
+    assert spans[0][1] == spans[1][0]
+
+
+def test_min_abs_diff_partition_more_parts_than_items():
+    spans = min_abs_diff_partition([5, 5], 4)
+    assert len(spans) == 4
+    assert spans[0] == (0, 1) and spans[1] == (1, 2)
+
+
+def test_partition_balanced_indices():
+    groups = partition_balanced([10, 10, 10, 10], 2)
+    assert groups == [[0, 1], [2, 3]]

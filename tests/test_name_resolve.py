@@ -1,0 +1,71 @@
+import pytest
+
+from areal_tpu.utils.name_resolve import (
+    MemoryNameResolveRepo,
+    NameEntryExistsError,
+    NameEntryNotFoundError,
+    NfsNameResolveRepo,
+)
+
+
+@pytest.fixture(params=["memory", "nfs"])
+def repo(request, tmp_path):
+    if request.param == "memory":
+        return MemoryNameResolveRepo()
+    return NfsNameResolveRepo(root=str(tmp_path / "nr"))
+
+
+def test_add_get_delete(repo):
+    repo.add("a/b", "v1")
+    assert repo.get("a/b") == "v1"
+    with pytest.raises(NameEntryExistsError):
+        repo.add("a/b", "v2")
+    repo.add("a/b", "v2", replace=True)
+    assert repo.get("a/b") == "v2"
+    repo.delete("a/b")
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("a/b")
+
+
+def test_subtree(repo):
+    repo.add("exp/t/rollout_servers/0", "addr0")
+    repo.add("exp/t/rollout_servers/1", "addr1")
+    assert repo.get_subtree("exp/t/rollout_servers") == ["addr0", "addr1"]
+    repo.clear_subtree("exp/t")
+    assert repo.get_subtree("exp/t/rollout_servers") == []
+
+
+def test_wait_timeout(repo):
+    with pytest.raises(TimeoutError):
+        repo.wait("missing", timeout=0.2, poll_frequency=0.05)
+
+
+def test_ttl_expiry(repo):
+    repo.add("svc/0", "addr", keepalive_ttl=0.2)
+    assert repo.get("svc/0") == "addr"
+    import time
+
+    time.sleep(0.35)
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("svc/0")
+    assert repo.find_subtree("svc") == []
+
+
+def test_keepalive_refreshes(repo):
+    import time
+
+    ka = repo.keepalive("svc/1", "addr", ttl=0.3)
+    time.sleep(0.8)
+    assert repo.get("svc/1") == "addr"  # still alive thanks to refresh
+    ka.stop()
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("svc/1")
+
+
+def test_wait_zero_timeout_fails_fast(repo):
+    import time
+
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        repo.wait("missing", timeout=0)
+    assert time.monotonic() - t0 < 0.5

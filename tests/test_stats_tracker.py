@@ -1,0 +1,70 @@
+import numpy as np
+import pytest
+
+from areal_tpu.utils.stats_tracker import ReduceType, StatsTracker
+
+
+def test_masked_avg_min_max():
+    t = StatsTracker()
+    mask = np.array([True, True, False])
+    t.denominator(valid=mask)
+    t.stat(denominator="valid", x=np.array([1.0, 3.0, 100.0]))
+    out = t.export()
+    assert out["x/avg"] == pytest.approx(2.0)
+    assert out["x/min"] == 1.0
+    assert out["x/max"] == 3.0
+    assert out["valid"] == 2.0
+
+
+def test_scoped_keys():
+    t = StatsTracker()
+    with t.scope("actor"):
+        with t.scope("ppo"):
+            t.scalar(loss=0.5)
+    out = t.export()
+    assert out["actor/ppo/loss"] == 0.5
+
+
+def test_sum_reduce():
+    t = StatsTracker()
+    t.denominator(n=np.array([True, True]))
+    t.stat(denominator="n", reduce_type=ReduceType.SUM, tokens=np.array([3.0, 4.0]))
+    assert t.export()["tokens"] == 7.0
+
+
+def test_export_resets():
+    t = StatsTracker()
+    t.scalar(a=1.0)
+    assert t.export()["a"] == 1.0
+    assert "a" not in t.export()
+
+
+def test_multiple_records_accumulate():
+    t = StatsTracker()
+    for v in (1.0, 2.0, 3.0):
+        t.denominator(m=np.array([True]))
+        t.stat(denominator="m", x=np.array([v]))
+    assert t.export()["x/avg"] == pytest.approx(2.0)
+
+
+def test_unknown_denominator_raises():
+    t = StatsTracker()
+    with pytest.raises(ValueError):
+        t.stat(denominator="nope", x=np.array([1.0]))
+
+
+def test_single_min_reduce_exported():
+    t = StatsTracker()
+    t.denominator(m=np.array([True, True]))
+    t.stat(denominator="m", reduce_type=ReduceType.MIN, x=np.array([1.0, 5.0]))
+    assert t.export()["x"] == 1.0
+
+
+def test_reduce_type_not_overwritten_by_default_call():
+    t = StatsTracker()
+    t.denominator(m=np.array([True]))
+    t.stat(denominator="m", reduce_type=ReduceType.SUM, loss=np.array([2.0]))
+    t.denominator(m=np.array([True]))
+    t.stat(denominator="m", loss=np.array([3.0]))
+    out = t.export()
+    assert out["loss"] == 5.0  # stays SUM, single unsuffixed key
