@@ -117,7 +117,8 @@ class PPOActorConfig(TrainEngineConfig):
     recompute_logprob: bool = True
     use_decoupled_loss: bool = True
     behav_imp_weight_cap: float | None = None
-    behav_imp_weight_mode: str = "clip"  # clip|mask
+    # token|sequence × mask|truncate, or disabled (reference cli_args naming)
+    behave_imp_weight_mode: str = "token_mask"
     # proximal logprob approximation (reference docs/en/algorithms/prox_approx.md)
     prox_logp_mode: str = "recompute"  # recompute|loglinear|metrics
     # importance-sampling level

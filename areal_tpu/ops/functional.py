@@ -1,0 +1,393 @@
+"""PPO-family loss math, jax-native.
+
+Behavioral parity with reference areal/utils/functional/functional.py
+(ppo_actor_loss_fn :213-317, sapo_loss_fn :318-396, critic :406-473,
+masked_normalization :10-49), areal/trainer/ppo/actor.py (GAE :199-215, M2PO
+:684-774) and areal/utils/data.py KLEstimator (:1374-1432) — re-derived for
+XLA: static shapes, `lax.scan` for the GAE recursion, sort/cumsum instead of
+boolean fancy-indexing for M2PO, everything differentiable-under-jit.
+
+Shape convention: padded [B, L] batches. ``loss_mask`` here is the *shifted*
+mask (reference rolls by -1 before these kernels: position t scores token
+t+1). All masks are float or bool arrays of the data shape.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# normalization / KL
+# ---------------------------------------------------------------------------
+
+
+def masked_normalization(
+    x: jax.Array,
+    mask: jax.Array | None = None,
+    axis=None,
+    unbiased: bool = False,
+    eps: float = 1e-5,
+) -> jax.Array:
+    """Whiten ``x`` over ``axis`` (default: all) counting only masked entries.
+
+    Under pjit the arrays are globally sharded, so the reference's explicit
+    all-reduce disappears: XLA inserts the collective for the global sum.
+    """
+    x = x.astype(jnp.float32)
+    if axis is None:
+        axis = tuple(range(x.ndim))
+    if mask is None:
+        factor = jnp.array(1.0)
+        for d in axis if isinstance(axis, tuple) else (axis,):
+            factor = factor * x.shape[d]
+        xm = x
+    else:
+        mask = mask.astype(jnp.float32)
+        xm = x * mask
+        factor = mask.sum(axis=axis, keepdims=True)
+    x_sum = xm.sum(axis=axis, keepdims=True)
+    x_sum_sq = jnp.square(xm).sum(axis=axis, keepdims=True)
+    mean = x_sum / factor
+    var = x_sum_sq / factor - jnp.square(mean)
+    if unbiased:
+        var = var * factor / jnp.maximum(factor - 1, 1)
+    return (x - mean) / (jnp.sqrt(jnp.maximum(var, 0.0)) + eps)
+
+
+def approx_kl(
+    log_probs: jax.Array,
+    log_probs_base: jax.Array,
+    estimator: str = "k1",
+    apply_clamp: bool = True,
+) -> jax.Array:
+    """Schulman's k1/k2/k3 KL estimators (reference KLEstimator)."""
+    log_ratio = log_probs.astype(jnp.float32) - log_probs_base.astype(jnp.float32)
+    if estimator == "k1":
+        kl = log_ratio
+    elif estimator == "k2":
+        kl = 0.5 * jnp.square(log_ratio)
+    elif estimator == "k3":
+        kl = jnp.expm1(-log_ratio) + log_ratio
+    else:
+        raise ValueError(f"invalid KL estimator {estimator!r} (k1|k2|k3)")
+    if apply_clamp:
+        kl = jnp.clip(kl, -10.0, 10.0)
+    return kl
+
+
+# ---------------------------------------------------------------------------
+# GAE
+# ---------------------------------------------------------------------------
+
+
+def gae(
+    rewards: jax.Array,  # [B, L]
+    values: jax.Array,  # [B, L]
+    loss_mask: jax.Array,  # [B, L] shifted mask, float
+    seq_no_eos_mask: jax.Array,  # [B] True if sequence hit the length cap
+    gamma: float = 1.0,
+    lam: float = 1.0,
+) -> jax.Array:
+    """Masked generalized advantage estimation over a padded batch.
+
+    Port of the reference recursion (trainer/ppo/actor.py:199-215) as a
+    reverse `lax.scan` over time: padding positions propagate state through
+    unchanged, matching the reference's mask arithmetic exactly.
+    """
+    B, L = rewards.shape
+    loss_mask = loss_mask.astype(jnp.float32)
+    nextvalues0 = values[:, L - 1] * seq_no_eos_mask.astype(values.dtype)
+
+    def step(carry, t):
+        nextvalues, lastgaelam = carry
+        delta = rewards[:, t] + gamma * nextvalues - values[:, t]
+        newgaelam = delta + gamma * lam * lastgaelam
+        m = loss_mask[:, t]
+        nextvalues = nextvalues * (1 - m) + values[:, t] * m
+        lastgaelam = lastgaelam * (1 - m) + newgaelam * m
+        return (nextvalues, lastgaelam), lastgaelam
+
+    ts = jnp.arange(L - 2, -1, -1)
+    (_, _), advs_rev = jax.lax.scan(
+        step, (nextvalues0, jnp.zeros((B,), jnp.float32)), ts
+    )
+    # advs_rev[k] is the advantage at t = L-2-k; final position gets 0
+    advantages = jnp.concatenate(
+        [advs_rev[::-1].T, jnp.zeros((B, 1), jnp.float32)], axis=1
+    )
+    return advantages
+
+
+# ---------------------------------------------------------------------------
+# sequence-level (GSPO) helpers
+# ---------------------------------------------------------------------------
+
+
+def _sequence_level_ratio_and_adv(
+    log_ratio: jax.Array,  # [B, L]
+    advantages: jax.Array,  # [B, L]
+    loss_mask: jax.Array,  # [B, L] bool
+) -> tuple[jax.Array, jax.Array]:
+    """GSPO: per-sequence geometric-mean ratio + mean advantage, broadcast
+    back to tokens (reference functional.py:49-142, padded branch)."""
+    lm = loss_mask.astype(jnp.float32)
+    counts = jnp.maximum(lm.sum(axis=1, keepdims=True), 1.0)
+    mean_log_ratio = (log_ratio * lm).sum(axis=1, keepdims=True) / counts
+    ratio = jnp.exp(mean_log_ratio) * lm
+    adv = (advantages * lm).sum(axis=1, keepdims=True) / counts
+    adv = adv * lm
+    return ratio, jnp.broadcast_to(adv, advantages.shape) * lm
+
+
+def compute_behave_imp_weight(
+    proximal_logprobs: jax.Array,
+    old_logprobs: jax.Array,
+    loss_mask: jax.Array,
+    mode: str = "token_mask",
+    cap: float | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Decoupled-PPO behavior importance weight π_prox/π_behave with cap.
+
+    Modes: token|sequence × truncate|mask (reference functional.py:145-215).
+    Returns (weight, approx_kl, behave_mask).
+    """
+    lm = loss_mask.astype(bool)
+    behave_kl = proximal_logprobs - old_logprobs
+    if "sequence" in mode:
+        w, _ = _sequence_level_ratio_and_adv(behave_kl, jnp.zeros_like(behave_kl), lm)
+    else:
+        w = jnp.exp(behave_kl)
+    if cap is not None:
+        if "truncate" in mode:
+            w = jnp.clip(w, 0.0, cap)
+        else:  # mask
+            w = jnp.where(w > cap, 0.0, w)
+    w = jnp.where(lm, w, 0.0)
+    behave_mask = (w > 0) & lm
+    behave_kl = jnp.where(behave_mask, behave_kl, 0.0)
+    return w, behave_kl, behave_mask
+
+
+# ---------------------------------------------------------------------------
+# actor losses
+# ---------------------------------------------------------------------------
+
+
+def ppo_actor_loss_fn(
+    logprobs: jax.Array,  # π_θ  [B, L]
+    proximal_logprobs: jax.Array,  # π_prox
+    old_logprobs: jax.Array,  # π_behave
+    advantages: jax.Array,
+    loss_mask: jax.Array,
+    eps_clip: float = 0.2,
+    eps_clip_higher: float | None = None,
+    c_clip: float | None = None,
+    behave_imp_weight_cap: float | None = None,
+    importance_sampling_level: str = "token",
+    behave_imp_weight_mode: str = "token_mask",
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """PPO-clip policy loss with decoupled behavior correction.
+
+    Covers PPO/GRPO (token level), GSPO (sequence level), DAPO's asymmetric
+    upper clip (eps_clip_higher), dual-clip (c_clip), and decoupled-PPO
+    (behave weight) in one kernel — reference functional.py:213-317.
+    """
+    lm = loss_mask.astype(bool)
+    denom = jnp.maximum(lm.sum(), 1)
+    advantages = jax.lax.stop_gradient(advantages)
+    # proximal/old logprobs are *data* from earlier forward passes (the
+    # reference computes them under no_grad); enforce that so callers passing
+    # live traced arrays don't silently get zero gradients
+    proximal_logprobs = jax.lax.stop_gradient(proximal_logprobs)
+    old_logprobs = jax.lax.stop_gradient(old_logprobs)
+
+    if importance_sampling_level == "sequence":
+        log_ratio = logprobs - proximal_logprobs
+        ratio, advantages = _sequence_level_ratio_and_adv(log_ratio, advantages, lm)
+    elif importance_sampling_level == "token":
+        ratio = jnp.where(lm, jnp.exp(logprobs - proximal_logprobs), 0.0)
+    else:
+        raise ValueError(
+            f"invalid importance_sampling_level {importance_sampling_level!r}"
+        )
+
+    hi = eps_clip if eps_clip_higher is None else eps_clip_higher
+    clipped_ratio = jnp.clip(ratio, 1.0 - eps_clip, 1.0 + hi)
+    pg_loss1 = -advantages * ratio
+    pg_loss2 = -advantages * clipped_ratio
+    clip_mask = jax.lax.stop_gradient(pg_loss1) < jax.lax.stop_gradient(pg_loss2)
+    pg_loss = jnp.maximum(pg_loss1, pg_loss2)
+    if c_clip is not None:
+        assert c_clip > 1.0, c_clip
+        pg_loss3 = jnp.sign(advantages) * c_clip * advantages
+        dual_clip_mask = jax.lax.stop_gradient(pg_loss3) < jax.lax.stop_gradient(
+            pg_loss
+        )
+        pg_loss = jnp.minimum(pg_loss, pg_loss3)
+    else:
+        dual_clip_mask = jnp.zeros_like(clip_mask)
+
+    stat: dict[str, jax.Array] = {}
+    if behave_imp_weight_mode != "disabled":
+        w, behave_kl, behave_mask = compute_behave_imp_weight(
+            proximal_logprobs,
+            old_logprobs,
+            lm,
+            mode=behave_imp_weight_mode,
+            cap=behave_imp_weight_cap,
+        )
+        pg_loss = pg_loss * jax.lax.stop_gradient(w)
+        stat.update(
+            behave_approx_kl=jax.lax.stop_gradient(behave_kl),
+            behave_imp_weight=jax.lax.stop_gradient(w),
+            behave_mask=behave_mask,
+        )
+
+    logging_loss = jax.lax.stop_gradient(pg_loss)
+    loss = jnp.where(lm, pg_loss, 0.0).sum() / denom
+    stat.update(
+        loss=logging_loss,
+        importance_weight=jax.lax.stop_gradient(ratio),
+        approx_kl=jax.lax.stop_gradient(logprobs - proximal_logprobs),
+        clip_mask=clip_mask & lm,
+        dual_clip_mask=dual_clip_mask & lm,
+    )
+    return loss, stat
+
+
+def sapo_loss_fn(
+    logprobs: jax.Array,
+    old_logprobs: jax.Array,
+    advantages: jax.Array,
+    loss_mask: jax.Array,
+    tau_pos: float = 1.0,
+    tau_neg: float = 1.05,
+    importance_sampling_level: str = "token",
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """SAPO: asymmetric sigmoid gates replacing hard clipping
+    (reference functional.py:318-396). Requires non-decoupled mode."""
+    if tau_pos <= 0 or tau_neg <= 0:
+        raise ValueError("SAPO temperatures must be positive")
+    lm = loss_mask.astype(bool)
+    denom = jnp.maximum(lm.sum(), 1)
+    advantages = jax.lax.stop_gradient(advantages)
+    old_logprobs = jax.lax.stop_gradient(old_logprobs)
+    log_ratio = logprobs - old_logprobs
+
+    if importance_sampling_level == "sequence":
+        ratio, advantages = _sequence_level_ratio_and_adv(log_ratio, advantages, lm)
+    elif importance_sampling_level == "token":
+        ratio = jnp.exp(log_ratio)
+    else:
+        raise ValueError(
+            f"invalid importance_sampling_level {importance_sampling_level!r}"
+        )
+
+    gate_pos = jax.nn.sigmoid(tau_pos * (ratio - 1.0)) * (4.0 / tau_pos)
+    gate_neg = jax.nn.sigmoid(tau_neg * (ratio - 1.0)) * (4.0 / tau_neg)
+    soft_gate = jnp.where(advantages > 0, gate_pos, gate_neg)
+
+    pg_loss = -soft_gate * advantages
+    loss = jnp.where(lm, pg_loss, 0.0).sum() / denom
+    stat = dict(
+        loss=jax.lax.stop_gradient(pg_loss),
+        importance_weight=jax.lax.stop_gradient(ratio),
+        approx_kl=jax.lax.stop_gradient(log_ratio),
+        clip_mask=jnp.zeros_like(lm),
+        dual_clip_mask=jnp.zeros_like(lm),
+        sapo_soft_gate=jax.lax.stop_gradient(soft_gate),
+    )
+    return loss, stat
+
+
+def ppo_critic_loss_fn(
+    value: jax.Array,
+    old_value: jax.Array,
+    target_value: jax.Array,
+    loss_mask: jax.Array,
+    value_eps_clip: float = 0.5,
+    loss_fn_type: str = "mse",
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Clipped value loss (reference functional.py:406-473)."""
+    if loss_fn_type == "mse":
+        err = lambda v: 0.5 * jnp.square(v - target_value)  # noqa: E731
+    elif loss_fn_type == "huber":
+        delta = 10.0
+
+        def err(v):
+            d = jnp.abs(v - target_value)
+            return jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+
+    else:
+        raise NotImplementedError(loss_fn_type)
+
+    loss_orig = err(value)
+    value_clipped = old_value + jnp.clip(
+        value - old_value, -value_eps_clip, value_eps_clip
+    )
+    loss_clip = err(value_clipped)
+    value_loss = jnp.maximum(loss_orig, loss_clip)
+    lm = loss_mask.astype(bool)
+    clip_mask = (jax.lax.stop_gradient(loss_clip) > jax.lax.stop_gradient(loss_orig)) & lm
+    loss = jnp.where(lm, value_loss, 0.0).sum() / jnp.maximum(lm.sum(), 1)
+    return loss, dict(loss=jax.lax.stop_gradient(value_loss), clip_mask=clip_mask)
+
+
+# ---------------------------------------------------------------------------
+# M2PO second-moment masking
+# ---------------------------------------------------------------------------
+
+
+def m2po_loss_mask(
+    old_logp: jax.Array,
+    prox_logp: jax.Array,
+    loss_mask: jax.Array,
+    m2_threshold: float,
+) -> jax.Array:
+    """Drop highest-(logp delta)² tokens until the mean second moment of the
+    survivors is below threshold (reference trainer/ppo/actor.py:684-774),
+    re-derived with sort/cumsum so shapes stay static under jit."""
+    lm = loss_mask.astype(bool).reshape(-1)
+    m2 = jnp.square(old_logp - prox_logp).reshape(-1)
+    n = lm.size
+    n_valid = lm.sum()
+
+    # invalid tokens sort to the end (m2 >= 0 for valid ones)
+    key = jnp.where(lm, m2, -1.0)
+    order = jnp.argsort(-key)  # descending; invalid last
+    sorted_m2 = key[order]
+
+    idx = jnp.arange(n)
+    valid_sorted = idx < n_valid
+    vals = jnp.where(valid_sorted, sorted_m2, 0.0)
+    total = vals.sum()
+    prefix = jnp.cumsum(vals) - vals  # sum of entries before i
+    suffix = total - prefix
+    counts = jnp.maximum(n_valid - idx, 1)
+    avg_suffix = suffix / counts
+    below = valid_sorted & (avg_suffix < m2_threshold)
+    num_to_mask = jnp.where(below.any(), jnp.argmax(below), jnp.maximum(n_valid - 1, 0))
+
+    keep_sorted = (idx >= num_to_mask) & valid_sorted
+    keep = jnp.zeros((n,), bool).at[order].set(keep_sorted)
+    return (keep & lm).reshape(loss_mask.shape)
+
+
+# ---------------------------------------------------------------------------
+# reward shaping
+# ---------------------------------------------------------------------------
+
+
+def reward_overlong_penalty(
+    rewards: jax.Array,  # [B]
+    response_lengths: jax.Array,  # [B]
+    overlong_tokens: int,
+    overlong_penalty_factor: float,
+    max_response_length: int,
+) -> jax.Array:
+    """DAPO soft length penalty (reference functional.py:474+, after VERL)."""
+    expected = max_response_length - overlong_tokens
+    exceed = response_lengths.astype(jnp.float32) - expected
+    penalty = jnp.minimum(-exceed / overlong_tokens * overlong_penalty_factor, 0.0)
+    return rewards + penalty

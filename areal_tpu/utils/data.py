@@ -228,12 +228,18 @@ def split_padded_tensor_dict_into_mb_list(
     unit_groups = [grp for grp in unit_groups if grp]
     if same_groups_as is None and len(unit_groups) < mb_spec.n_mbs <= B // g:
         # FFD packed tighter than the requested minimum mb count (needed for
-        # e.g. fixed gradient-accumulation length across DP): rebalance.
-        unit_groups = [
+        # e.g. fixed gradient-accumulation length across DP): rebalance,
+        # unless doing so would break the per-mb token capacity.
+        rebalanced = [
             grp
             for grp in datapack.balanced_greedy_partition(unit_sizes, mb_spec.n_mbs)
             if grp
         ]
+        cap = mb_spec.max_tokens_per_mb
+        if cap is None or all(
+            sum(unit_sizes[u] for u in grp) <= cap for grp in rebalanced
+        ):
+            unit_groups = rebalanced
     groups = [[u * g + j for u in grp for j in range(g)] for grp in unit_groups]
     groups = [grp for grp in groups if grp] or [list(range(B))]
     mbs = split_batch(data, groups)

@@ -24,10 +24,17 @@ def ffd_allocate(
     """First-fit-decreasing bin packing.
 
     Packs items into the smallest number of bins (>= ``min_groups``) such that
-    each bin's total size is <= ``capacity``. Items larger than ``capacity``
-    get a dedicated bin. Returns a list of index lists sorted by each bin's
-    first item index for determinism.
+    each bin's total size is <= ``capacity``. Raises if any single item
+    exceeds ``capacity`` (fail fast at packing time, like the reference,
+    rather than blowing the downstream memory budget). Returns a list of
+    index lists sorted by each bin's first item index for determinism.
     """
+    for i, sz in enumerate(sizes):
+        if sz > capacity:
+            raise ValueError(
+                f"item {i} has size {sz} > microbatch capacity {capacity}; "
+                "raise max_tokens_per_mb or truncate the sequence"
+            )
     order = sorted(range(len(sizes)), key=lambda i: (-sizes[i], i))
     bins: list[list[int]] = [[] for _ in range(min_groups)]
     loads = [0] * min_groups

@@ -201,29 +201,25 @@ class NfsNameResolveRepo(NameResolveRepo):
     def get(self, name):
         return self._read(name)
 
-    def find_subtree(self, name_root):
+    def _walk(self, name_root) -> list[tuple[str, str]]:
+        """Single-read listing: (name, value) for each live entry."""
         base = os.path.join(self._root, name_root.strip("/"))
-        names = []
+        entries = []
         if os.path.isdir(base):
             for dirpath, _, files in os.walk(base):
                 if "ENTRY.json" in files:
                     rel = os.path.relpath(dirpath, self._root)
                     try:
-                        self._read(rel)
+                        entries.append((rel, self._read(rel)))
                     except NameEntryNotFoundError:
                         continue
-                    names.append(rel)
-        return sorted(names)
+        return sorted(entries)
+
+    def find_subtree(self, name_root):
+        return [n for n, _ in self._walk(name_root)]
 
     def get_subtree(self, name_root):
-        vals = []
-        for n in self.find_subtree(name_root):
-            try:
-                vals.append(self.get(n))
-            except NameEntryNotFoundError:
-                # entry expired/deleted between listing and read
-                continue
-        return vals
+        return [v for _, v in self._walk(name_root)]
 
     def delete(self, name):
         p = self._path(name)

@@ -20,9 +20,11 @@ def test_ffd_respects_capacity():
             assert sum(sizes[i] for i in b) <= 600
 
 
-def test_ffd_oversize_item_gets_own_bin():
-    bins = ffd_allocate([700, 100], capacity=600)
-    assert [sizes for sizes in map(len, bins)].count(1) == 2
+def test_ffd_oversize_item_raises():
+    import pytest
+
+    with pytest.raises(ValueError):
+        ffd_allocate([700, 100], capacity=600)
 
 
 def test_ffd_min_groups():

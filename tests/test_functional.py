@@ -1,0 +1,224 @@
+"""Loss-zoo math tests vs independent numpy re-derivations
+(parity: reference tests/test_functional.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.ops.functional import (
+    approx_kl,
+    compute_behave_imp_weight,
+    gae,
+    m2po_loss_mask,
+    masked_normalization,
+    ppo_actor_loss_fn,
+    ppo_critic_loss_fn,
+    reward_overlong_penalty,
+    sapo_loss_fn,
+)
+
+
+def _np_gae(rewards, values, loss_mask, seq_no_eos_mask, gamma, lam):
+    """Direct numpy port of the reference python loop (actor.py:199-215)."""
+    B, L = rewards.shape
+    advantages_reversed = [np.zeros(B, dtype=np.float32)]
+    lastgaelam = np.zeros(B, dtype=np.float32)
+    nextvalues = values[:, L - 1] * seq_no_eos_mask
+    for t in reversed(range(L - 1)):
+        delta = rewards[:, t] + gamma * nextvalues - values[:, t]
+        newgaelam = delta + gamma * lam * lastgaelam
+        m = loss_mask[:, t]
+        nextvalues = nextvalues * (1 - m) + values[:, t] * m
+        lastgaelam = lastgaelam * (1 - m) + newgaelam * m
+        advantages_reversed.append(lastgaelam.copy())
+    return np.stack(advantages_reversed[::-1], axis=1)
+
+
+def test_gae_matches_reference_loop():
+    rng = np.random.default_rng(0)
+    B, L = 4, 12
+    rewards = rng.normal(size=(B, L)).astype(np.float32)
+    values = rng.normal(size=(B, L)).astype(np.float32)
+    lens = rng.integers(3, L, size=B)
+    loss_mask = (np.arange(L)[None, :] < lens[:, None]).astype(np.float32)
+    seq_no_eos = rng.random(B) > 0.5
+    for gamma, lam in [(1.0, 1.0), (0.99, 0.95)]:
+        ref = _np_gae(rewards, values, loss_mask, seq_no_eos, gamma, lam)
+        out = gae(
+            jnp.array(rewards),
+            jnp.array(values),
+            jnp.array(loss_mask),
+            jnp.array(seq_no_eos),
+            gamma,
+            lam,
+        )
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_masked_normalization_whitens():
+    rng = np.random.default_rng(1)
+    x = rng.normal(5.0, 3.0, size=(4, 8)).astype(np.float32)
+    mask = rng.random((4, 8)) > 0.3
+    out = np.asarray(masked_normalization(jnp.array(x), jnp.array(mask)))
+    vals = out[mask]
+    assert abs(vals.mean()) < 1e-3
+    assert vals.std() == pytest.approx(1.0, abs=2e-3)
+
+
+def test_approx_kl_estimators():
+    lp = jnp.array([0.0, -1.0])
+    base = jnp.array([-0.5, -0.5])
+    k1 = np.asarray(approx_kl(lp, base, "k1"))
+    np.testing.assert_allclose(k1, [0.5, -0.5])
+    k2 = np.asarray(approx_kl(lp, base, "k2"))
+    np.testing.assert_allclose(k2, [0.125, 0.125])
+    k3 = np.asarray(approx_kl(lp, base, "k3"))
+    # k3 = exp(-lr) - 1 + lr, always >= 0
+    assert (k3 >= 0).all()
+    with pytest.raises(ValueError):
+        approx_kl(lp, base, "k9")
+
+
+def _setup_loss_inputs(seed=0, B=3, L=6):
+    rng = np.random.default_rng(seed)
+    logprobs = jnp.array(rng.normal(-1.0, 0.3, size=(B, L)).astype(np.float32))
+    prox = jnp.array(rng.normal(-1.0, 0.3, size=(B, L)).astype(np.float32))
+    old = jnp.array(rng.normal(-1.0, 0.3, size=(B, L)).astype(np.float32))
+    adv = jnp.array(rng.normal(size=(B, L)).astype(np.float32))
+    mask = jnp.array(rng.random((B, L)) > 0.2)
+    return logprobs, prox, old, adv, mask
+
+
+def test_ppo_loss_onpolicy_equals_vanilla_pg_at_ratio_one():
+    # when logprobs == proximal == old, ratio==1 → loss = -mean(adv over mask)
+    logprobs, _, _, adv, mask = _setup_loss_inputs()
+    loss, stat = ppo_actor_loss_fn(
+        logprobs, logprobs, logprobs, adv, mask, eps_clip=0.2
+    )
+    expected = -(np.asarray(adv) * np.asarray(mask)).sum() / np.asarray(mask).sum()
+    assert float(loss) == pytest.approx(expected, rel=1e-5)
+    assert not bool(np.asarray(stat["clip_mask"]).any())
+
+
+def test_ppo_loss_clip_activates():
+    logprobs, prox, old, adv, mask = _setup_loss_inputs()
+    big = logprobs + 2.0  # huge ratio vs prox
+    loss, stat = ppo_actor_loss_fn(
+        big, logprobs, logprobs, adv, mask, eps_clip=0.2
+    )
+    # pessimistic max(pg1, pg2) selects the clipped branch where adv > 0 and
+    # the ratio (~e^2) exceeds the 1.2 upper clip
+    cm = np.asarray(stat["clip_mask"])
+    pos_adv = (np.asarray(adv) > 0) & np.asarray(mask)
+    assert (cm & pos_adv).sum() > 0
+    assert (cm & ~pos_adv).sum() == 0
+
+
+def test_ppo_loss_gradient_flows():
+    logprobs, prox, old, adv, mask = _setup_loss_inputs()
+
+    def f(lp):
+        return ppo_actor_loss_fn(lp, prox, old, adv, mask)[0]
+
+    g = jax.grad(f)(logprobs)
+    assert np.isfinite(np.asarray(g)).all()
+    # masked-out positions get no gradient
+    assert np.abs(np.asarray(g)[~np.asarray(mask)]).max() == 0
+
+
+def test_decoupled_behave_weight_mask_mode():
+    _, prox, old, _, mask = _setup_loss_inputs()
+    w, kl, bm = compute_behave_imp_weight(prox, old, mask, "token_mask", cap=1.5)
+    w = np.asarray(w)
+    assert (w <= 1.5).all()
+    assert (w[~np.asarray(mask)] == 0).all()
+    wt, _, _ = compute_behave_imp_weight(prox, old, mask, "token_truncate", cap=1.5)
+    assert np.asarray(wt).max() == pytest.approx(
+        min(1.5, float(np.exp((prox - old))[mask].max())), rel=1e-5
+    )
+
+
+def test_gspo_sequence_level_ratio():
+    logprobs, prox, old, adv, mask = _setup_loss_inputs()
+    loss, stat = ppo_actor_loss_fn(
+        logprobs, prox, old, adv, mask,
+        importance_sampling_level="sequence",
+        behave_imp_weight_mode="disabled",
+    )
+    iw = np.asarray(stat["importance_weight"])
+    m = np.asarray(mask)
+    # within each sequence, all valid tokens share the same (geometric-mean) ratio
+    for b in range(iw.shape[0]):
+        vals = iw[b][m[b]]
+        assert vals.std() < 1e-5
+
+
+def test_sapo_loss_matches_manual():
+    logprobs, _, old, adv, mask = _setup_loss_inputs()
+    loss, stat = sapo_loss_fn(logprobs, old, adv, mask, tau_pos=1.0, tau_neg=2.0)
+    ratio = np.exp(np.asarray(logprobs) - np.asarray(old))
+    gate_pos = 4.0 * (1 / (1 + np.exp(-(ratio - 1))))
+    gate_neg = (4.0 / 2.0) * (1 / (1 + np.exp(-2 * (ratio - 1))))
+    a = np.asarray(adv)
+    gate = np.where(a > 0, gate_pos, gate_neg)
+    expected = (-(gate * a) * np.asarray(mask)).sum() / np.asarray(mask).sum()
+    assert float(loss) == pytest.approx(expected, rel=1e-4)
+    with pytest.raises(ValueError):
+        sapo_loss_fn(logprobs, old, adv, mask, tau_pos=-1.0)
+
+
+def test_critic_loss_clipping():
+    rng = np.random.default_rng(3)
+    v = jnp.array(rng.normal(size=(2, 5)).astype(np.float32))
+    old = v + jnp.array(rng.normal(scale=2.0, size=(2, 5)).astype(np.float32))
+    tgt = jnp.array(rng.normal(size=(2, 5)).astype(np.float32))
+    mask = jnp.ones((2, 5), bool)
+    loss, stat = ppo_critic_loss_fn(v, old, tgt, mask, value_eps_clip=0.2)
+    # pessimistic: loss >= unclipped mse
+    mse = float((0.5 * np.square(np.asarray(v) - np.asarray(tgt))).mean())
+    assert float(loss) >= mse - 1e-6
+
+
+def test_m2po_mask_reduces_mean_m2():
+    rng = np.random.default_rng(4)
+    old = jnp.array(rng.normal(size=(2, 16)).astype(np.float32))
+    prox = old + jnp.array(rng.normal(scale=0.5, size=(2, 16)).astype(np.float32))
+    mask = jnp.array(rng.random((2, 16)) > 0.2)
+    thr = 0.04
+    new_mask = m2po_loss_mask(old, prox, mask, thr)
+    nm = np.asarray(new_mask)
+    assert nm.sum() > 0
+    assert (nm <= np.asarray(mask)).all()  # only removes tokens
+    m2 = np.square(np.asarray(old) - np.asarray(prox))
+    assert m2[nm].mean() < thr or nm.sum() == 1
+
+
+def test_m2po_mask_noop_when_below_threshold():
+    old = jnp.zeros((1, 8))
+    prox = jnp.zeros((1, 8))
+    mask = jnp.ones((1, 8), bool)
+    new_mask = m2po_loss_mask(old, prox, mask, 0.04)
+    assert np.asarray(new_mask).all()
+
+
+def test_overlong_penalty():
+    rewards = jnp.array([1.0, 1.0, 1.0])
+    lengths = jnp.array([100, 450, 500])
+    out = np.asarray(
+        reward_overlong_penalty(rewards, lengths, 100, 1.0, 500)
+    )
+    assert out[0] == 1.0  # under expected length: no penalty
+    assert out[1] == pytest.approx(1.0 - 50 / 100)
+    assert out[2] == pytest.approx(0.0)
+
+
+def test_losses_jit_compile():
+    logprobs, prox, old, adv, mask = _setup_loss_inputs()
+    jloss = jax.jit(
+        lambda lp: ppo_actor_loss_fn(lp, prox, old, adv, mask)[0]
+    )
+    assert np.isfinite(float(jloss(logprobs)))
+    jm2 = jax.jit(lambda: m2po_loss_mask(old, prox, mask, 0.04))
+    assert np.asarray(jm2()).dtype == bool
