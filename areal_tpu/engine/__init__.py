@@ -1,0 +1,1 @@
+from areal_tpu.engine.train_engine import JaxTrainEngine  # noqa: F401

@@ -1,0 +1,8 @@
+from areal_tpu.models.qwen import (  # noqa: F401
+    ModelConfig,
+    init_params,
+    forward,
+    compute_logits,
+    chunked_logprobs_entropy,
+    param_partition_specs,
+)

@@ -1,0 +1,137 @@
+"""HF checkpoint interop: safetensors <-> stacked-layer JAX params.
+
+Plays the role of the reference's HF load/save paths
+(areal/engine/fsdp_engine.py:289-341 memory-efficient load,
+:1164-1204 safetensors export; areal/models/mcore/hf_{load,save}.py bridges)
+— re-designed for JAX: tensors are read lazily per-name from the safetensors
+index, stacked across layers on host, and device_put with the target sharding
+so each chip only materializes its shard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from safetensors import safe_open
+from safetensors.numpy import save_file
+
+from areal_tpu.models.qwen import ModelConfig, _layer_shapes, hf_name_map
+
+
+def _open_shards(path: str) -> dict[str, str]:
+    """HF tensor name -> safetensors file path (handles sharded checkpoints)."""
+    index_path = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            index = json.load(f)
+        return {k: os.path.join(path, v) for k, v in index["weight_map"].items()}
+    single = os.path.join(path, "model.safetensors")
+    with safe_open(single, framework="numpy") as f:
+        return {k: single for k in f.keys()}
+
+
+def load_params_from_hf(
+    path: str,
+    cfg: ModelConfig | None = None,
+    dtype: Any = None,
+    put: Callable[[str, np.ndarray], jax.Array] | None = None,
+) -> tuple[dict, ModelConfig]:
+    """Load an HF Qwen2/Qwen3 checkpoint directory into our param pytree.
+
+    ``put(param_path, host_array) -> device_array`` lets the engine place each
+    stacked tensor with its target sharding (sharded device_put); default is a
+    plain jnp.asarray.
+    """
+    cfg = cfg or ModelConfig.from_hf_path(path)
+    dtype = dtype or cfg.jax_dtype
+    shards = _open_shards(path)
+    name_map = hf_name_map(cfg)
+    handles: dict[str, Any] = {}
+
+    def read(hf_name: str) -> np.ndarray:
+        file = shards[hf_name]
+        if file not in handles:
+            handles[file] = safe_open(file, framework="numpy")
+        t = handles[file].get_tensor(hf_name)
+        if t.dtype == np.dtype("uint16"):  # numpy lacks bf16; reinterpret
+            t = t.view(np.uint16)
+        return t
+
+    def to_np(hf_name: str, transpose: bool) -> np.ndarray:
+        t = read(hf_name)
+        if t.dtype == np.uint16:
+            t = jnp.asarray(t).view(jnp.bfloat16)
+            t = np.asarray(t.astype(jnp.float32))
+        if transpose:
+            t = np.ascontiguousarray(t.T)
+        return t
+
+    put = put or (lambda p, a: jnp.asarray(a, dtype=dtype))
+
+    layers: dict[str, Any] = {}
+    for name in _layer_shapes(cfg):
+        per_layer = [
+            to_np(*name_map[f"layers/{i}/{name}"]) for i in range(cfg.num_layers)
+        ]
+        layers[name] = put(f"layers/{name}", np.stack(per_layer))
+    params = {
+        "embed": put("embed", to_np(*name_map["embed"])),
+        "layers": layers,
+        "final_norm": put("final_norm", to_np(*name_map["final_norm"])),
+    }
+    if not cfg.tie_word_embeddings:
+        if "lm_head.weight" in shards:
+            params["lm_head"] = put("lm_head", to_np(*name_map["lm_head"]))
+        else:  # some exports tie silently
+            params["lm_head"] = put("lm_head", to_np("model.embed_tokens.weight", False))
+    return params, cfg
+
+
+def save_params_to_hf(
+    params: dict,
+    cfg: ModelConfig,
+    path: str,
+    base_model_path: str | None = None,
+) -> None:
+    """Export params as an HF-layout safetensors file (+config/tokenizer files
+    copied from ``base_model_path``) — the disk weight-update format
+    (reference fsdp_engine.py:1139-1204)."""
+    os.makedirs(path, exist_ok=True)
+    name_map = hf_name_map(cfg)
+    flat: dict[str, np.ndarray] = {}
+
+    def host(x) -> np.ndarray:
+        x = jax.device_get(x)
+        if x.dtype == jnp.bfloat16:
+            x = np.asarray(x.astype(jnp.float32), dtype=np.float32)
+        return np.asarray(x)
+
+    for our_path, (hf_name, transpose) in name_map.items():
+        parts = our_path.split("/")
+        if parts[0] == "layers":
+            t = host(params["layers"][parts[2]][int(parts[1])])
+        else:
+            t = host(params[parts[0]])
+        flat[hf_name] = np.ascontiguousarray(t.T) if transpose else t
+    save_file(flat, os.path.join(path, "model.safetensors"))
+
+    src = base_model_path
+    if src:
+        for fname in (
+            "config.json",
+            "tokenizer.json",
+            "tokenizer_config.json",
+            "generation_config.json",
+            "vocab.json",
+            "merges.txt",
+            "special_tokens_map.json",
+        ):
+            sp = os.path.join(src, fname)
+            if os.path.exists(sp):
+                with open(sp, "rb") as fi, open(os.path.join(path, fname), "wb") as fo:
+                    fo.write(fi.read())

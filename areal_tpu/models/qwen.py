@@ -1,0 +1,427 @@
+"""Qwen2/Qwen2.5/Qwen3 decoder — a ground-up TPU-native implementation.
+
+Replaces the reference's HF-runtime models and Archon's native torch Qwen
+(reference areal/experimental/models/archon/qwen3/model/model.py) with a pure
+functional JAX model designed for GSPMD:
+
+- params are a plain pytree with **stacked layers** (leading ``n_layers`` dim)
+  so the decoder body is one ``lax.scan`` — fast compiles, uniform shardings.
+- sequence packing is first-class: a microbatch is a ``[G, L]`` grid of packed
+  rows; ``segment_ids`` (0 = padding) drive both the attention mask and the
+  loss mask. This replaces the reference's flash-attn varlen cu_seqlens path
+  (areal/utils/data.py:273-324) with the TPU-idiomatic equivalent.
+- sharding is expressed as `PartitionSpec` trees over mesh axes
+  ``(data, seq, model, expert)`` — XLA inserts the collectives (TP all-reduce,
+  Ulysses all-to-all between seq- and head-sharded layouts), replacing the
+  reference's DTensor TP plan (areal/engine/fsdp_utils/parallel.py:217-365)
+  and Ulysses monkey-patches (areal/models/fsdp/ulysses.py).
+- logprob/entropy are computed **chunked over tokens** so the ``[T, vocab]``
+  logits never fully materialize (the reference's vocab-parallel logprob role,
+  areal/utils/functional/vocab_parallel.py).
+
+Covers Qwen2 (attention bias, no qk-norm) and Qwen3 (qk-norm, no bias) via
+config flags, with GQA and optional tied embeddings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+# mesh axes over which the microbatch rows (G dim) shard
+BATCH_AXES = ("data", "fsdp")
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 151936
+    hidden_size: int = 896
+    intermediate_size: int = 4864
+    num_layers: int = 24
+    num_heads: int = 14
+    num_kv_heads: int = 2
+    head_dim: int | None = None  # default hidden_size // num_heads
+    rope_theta: float = 1_000_000.0
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = True
+    qk_norm: bool = False  # Qwen3
+    attention_bias: bool = True  # Qwen2 has q/k/v bias
+    dtype: str = "bfloat16"
+    remat: bool = True
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim_
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim_
+
+    @property
+    def jax_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @classmethod
+    def from_hf_dict(cls, d: dict[str, Any]) -> "ModelConfig":
+        """Build from an HF ``config.json`` dict (qwen2 / qwen3 model types)."""
+        mt = d.get("model_type", "qwen2")
+        return cls(
+            vocab_size=d["vocab_size"],
+            hidden_size=d["hidden_size"],
+            intermediate_size=d["intermediate_size"],
+            num_layers=d["num_hidden_layers"],
+            num_heads=d["num_attention_heads"],
+            num_kv_heads=d.get("num_key_value_heads", d["num_attention_heads"]),
+            head_dim=d.get("head_dim"),
+            rope_theta=d.get("rope_theta", 1e6),
+            rms_norm_eps=d.get("rms_norm_eps", 1e-6),
+            tie_word_embeddings=d.get("tie_word_embeddings", False),
+            qk_norm=(mt == "qwen3"),
+            attention_bias=d.get("attention_bias", mt == "qwen2"),
+        )
+
+    @classmethod
+    def from_hf_path(cls, path: str) -> "ModelConfig":
+        with open(os.path.join(path, "config.json")) as f:
+            return cls.from_hf_dict(json.load(f))
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+
+def _layer_shapes(cfg: ModelConfig) -> dict[str, tuple[int, ...]]:
+    D, Q, KV, F, hd = (
+        cfg.hidden_size,
+        cfg.q_dim,
+        cfg.kv_dim,
+        cfg.intermediate_size,
+        cfg.head_dim_,
+    )
+    shapes = {
+        "wq": (D, Q),
+        "wk": (D, KV),
+        "wv": (D, KV),
+        "wo": (Q, D),
+        "w_gate": (D, F),
+        "w_up": (D, F),
+        "w_down": (F, D),
+        "input_norm": (D,),
+        "post_attn_norm": (D,),
+    }
+    if cfg.attention_bias:
+        shapes.update(bq=(Q,), bk=(KV,), bv=(KV,))
+    if cfg.qk_norm:
+        shapes.update(q_norm=(hd,), k_norm=(hd,))
+    return shapes
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig, dtype=None) -> dict:
+    """Random init (truncated-normal 0.02), stacked-layer layout."""
+    dtype = dtype or cfg.jax_dtype
+    n = cfg.num_layers
+    keys = iter(jax.random.split(rng, 64))
+
+    def dense(key, shape):
+        return (0.02 * jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)).astype(dtype)
+
+    layers = {}
+    for name, shape in _layer_shapes(cfg).items():
+        full = (n, *shape)
+        if name.endswith("norm"):
+            layers[name] = jnp.ones(full, dtype)
+        elif name.startswith("b"):
+            layers[name] = jnp.zeros(full, dtype)
+        else:
+            layers[name] = dense(next(keys), full)
+    params = {
+        "embed": dense(next(keys), (cfg.vocab_size, cfg.hidden_size)),
+        "layers": layers,
+        "final_norm": jnp.ones((cfg.hidden_size,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = dense(next(keys), (cfg.vocab_size, cfg.hidden_size))
+    return params
+
+
+def param_partition_specs(cfg: ModelConfig, fsdp_axis: str | None = "fsdp") -> dict:
+    """PartitionSpec tree matching ``init_params`` structure.
+
+    TP ("model" axis) shards head/ffn/vocab dims — the same plan as the
+    reference's DTensor colwise/rowwise parallel
+    (areal/engine/fsdp_utils/parallel.py:217-365). ZeRO-3-style FSDP shards the
+    complementary dim over ``fsdp_axis`` (reference FSDP2 fully_shard role).
+    """
+    f = fsdp_axis
+    layer_specs = {
+        "wq": P(None, f, "model"),
+        "wk": P(None, f, "model"),
+        "wv": P(None, f, "model"),
+        "wo": P(None, "model", f),
+        "w_gate": P(None, f, "model"),
+        "w_up": P(None, f, "model"),
+        "w_down": P(None, "model", f),
+        "input_norm": P(None, None),
+        "post_attn_norm": P(None, None),
+    }
+    if cfg.attention_bias:
+        layer_specs.update(bq=P(None, "model"), bk=P(None, "model"), bv=P(None, "model"))
+    if cfg.qk_norm:
+        layer_specs.update(q_norm=P(None, None), k_norm=P(None, None))
+    specs = {
+        "embed": P("model", f),
+        "layers": layer_specs,
+        "final_norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P("model", f)
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Neox-style rotary embedding. x: [..., L, n_heads, head_dim]."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freq  # [..., L, half]
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def _attention_mask(segment_ids: jax.Array) -> jax.Array:
+    """[G, L] segment ids (0 = pad) -> [G, 1, L, L] bool mask.
+
+    Causality is by *row position* (packed rows concatenate sequences, each
+    with its own restarting rope positions), matching the reference's varlen
+    flash-attn semantics.
+    """
+    L = segment_ids.shape[-1]
+    idx = jnp.arange(L)
+    causal = idx[:, None] >= idx[None, :]
+    same_seg = segment_ids[:, :, None] == segment_ids[:, None, :]
+    not_pad = (segment_ids != 0)[:, :, None]
+    return (causal[None] & same_seg & not_pad)[:, None]
+
+
+def _sdpa(q, k, v, mask, head_dim: int):
+    """Plain XLA attention: einsum + fp32 softmax. q,k,v: [G, L, H, hd].
+
+    XLA fuses and tiles this onto the MXU; a Pallas flash kernel can override
+    it via areal_tpu.ops.attention (see ops/attention.py).
+    """
+    scale = head_dim**-0.5
+    logits = jnp.einsum("gqhd,gkhd->ghqk", q, k).astype(jnp.float32) * scale
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("ghqk,gkhd->gqhd", probs, v)
+
+
+def _decoder_layer(cfg: ModelConfig, x, layer, mask, positions):
+    """One transformer block. x: [G, L, D]."""
+    G, L, D = x.shape
+    H, KH, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+
+    h = _rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+    q = h @ layer["wq"]
+    k = h @ layer["wk"]
+    v = h @ layer["wv"]
+    if cfg.attention_bias:
+        q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+    q = q.reshape(G, L, H, hd)
+    k = k.reshape(G, L, KH, hd)
+    v = v.reshape(G, L, KH, hd)
+    if cfg.qk_norm:
+        q = _rms_norm(q, layer["q_norm"], cfg.rms_norm_eps)
+        k = _rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    if KH != H:
+        k = jnp.repeat(k, H // KH, axis=2)
+        v = jnp.repeat(v, H // KH, axis=2)
+    # head-sharded region: XLA inserts the seq<->head all-to-all here when a
+    # "seq" axis is active (Ulysses SP, reference models/fsdp/ulysses.py)
+    q = _shard(q, P(BATCH_AXES, None, "model", None))
+    k = _shard(k, P(BATCH_AXES, None, "model", None))
+    v = _shard(v, P(BATCH_AXES, None, "model", None))
+    attn = _sdpa(q, k, v, mask, hd)
+    attn = attn.reshape(G, L, H * hd)
+    x = x + _shard(attn @ layer["wo"], P(BATCH_AXES, "seq", None))
+
+    h = _rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
+    ff = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
+    x = x + _shard(ff @ layer["w_down"], P(BATCH_AXES, "seq", None))
+    return x
+
+
+def _shard(x: jax.Array, spec: P) -> jax.Array:
+    """Sharding constraint that is a no-op outside a mesh context."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x
+
+
+def forward(
+    params: dict,
+    cfg: ModelConfig,
+    input_ids: jax.Array,  # [G, L] int32
+    segment_ids: jax.Array,  # [G, L] int32, 0 = padding
+    positions: jax.Array,  # [G, L] int32, restart per segment
+) -> jax.Array:
+    """Decoder body -> final hidden states [G, L, D]."""
+    x = jnp.take(params["embed"], input_ids, axis=0).astype(cfg.jax_dtype)
+    x = _shard(x, P(BATCH_AXES, "seq", None))
+    mask = _attention_mask(segment_ids)
+
+    layer_fn = partial(_decoder_layer, cfg)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(
+            layer_fn, policy=jax.checkpoint_policies.nothing_saveable
+        )
+
+    def body(x, layer):
+        return layer_fn(x, layer, mask, positions), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+
+
+def _lm_head_weight(params: dict) -> jax.Array:
+    return params.get("lm_head", params["embed"])  # [V, D]
+
+
+def compute_logits(params: dict, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
+    """[..., D] -> [..., V] logits in fp32 (small decodes only — for training
+    use chunked_logprobs_entropy)."""
+    w = _lm_head_weight(params)
+    return jnp.einsum("...d,vd->...v", hidden.astype(jnp.float32), w.astype(jnp.float32))
+
+
+def chunked_logprobs_entropy(
+    params: dict,
+    cfg: ModelConfig,
+    hidden: jax.Array,  # [G, L, D]
+    labels: jax.Array,  # [G, L] int32 (next-token ids)
+    chunk_size: int = 1024,
+    temperature: float = 1.0,
+) -> tuple[jax.Array, jax.Array]:
+    """log p(label) and entropy per position, without materializing [T, V].
+
+    Tokens are processed in chunks under ``lax.map`` + remat: each chunk
+    computes its logits, logsumexp, label logprob and entropy, then the logits
+    are discarded (recomputed in backward). This is the TPU replacement for
+    the reference's vocab-parallel logprob path
+    (areal/utils/functional/vocab_parallel.py) — with a "model"-sharded vocab
+    dim, XLA additionally distributes each chunk's reduction.
+    """
+    G, L, D = hidden.shape
+    w = _lm_head_weight(params)
+    T = G * L
+    pad = (-T) % chunk_size
+    flat_h = hidden.reshape(T, D)
+    flat_y = labels.reshape(T)
+    if pad:
+        flat_h = jnp.pad(flat_h, ((0, pad), (0, 0)))
+        flat_y = jnp.pad(flat_y, (0, pad))
+    n_chunks = (T + pad) // chunk_size
+    flat_h = flat_h.reshape(n_chunks, chunk_size, D)
+    flat_y = flat_y.reshape(n_chunks, chunk_size)
+
+    @jax.checkpoint
+    def one_chunk(args):
+        h, y = args
+        logits = jnp.einsum("td,vd->tv", h, w).astype(jnp.float32)
+        if temperature != 1.0:
+            logits = logits / temperature
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        label_logit = jnp.take_along_axis(logits, y[:, None], axis=-1)[:, 0]
+        probs = jax.nn.softmax(logits, axis=-1)
+        ent = lse - jnp.sum(probs * logits, axis=-1)
+        return label_logit - lse, ent
+
+    logp, ent = jax.lax.map(one_chunk, (flat_h, flat_y))
+    logp = logp.reshape(-1)[:T].reshape(G, L)
+    ent = ent.reshape(-1)[:T].reshape(G, L)
+    return logp, ent
+
+
+# ---------------------------------------------------------------------------
+# HF name mapping (for the safetensors loader/saver, models/hf.py)
+# ---------------------------------------------------------------------------
+
+# our layer param -> (HF suffix, needs_transpose)
+_HF_LAYER_MAP = {
+    "wq": ("self_attn.q_proj.weight", True),
+    "wk": ("self_attn.k_proj.weight", True),
+    "wv": ("self_attn.v_proj.weight", True),
+    "wo": ("self_attn.o_proj.weight", True),
+    "bq": ("self_attn.q_proj.bias", False),
+    "bk": ("self_attn.k_proj.bias", False),
+    "bv": ("self_attn.v_proj.bias", False),
+    "q_norm": ("self_attn.q_norm.weight", False),
+    "k_norm": ("self_attn.k_norm.weight", False),
+    "w_gate": ("mlp.gate_proj.weight", True),
+    "w_up": ("mlp.up_proj.weight", True),
+    "w_down": ("mlp.down_proj.weight", True),
+    "input_norm": ("input_layernorm.weight", False),
+    "post_attn_norm": ("post_attention_layernorm.weight", False),
+}
+
+
+def hf_name_map(cfg: ModelConfig) -> dict[str, tuple[str, bool]]:
+    """Flat map: our param path ("layers/3/wq" or "embed") -> (HF name, transpose)."""
+    out: dict[str, tuple[str, bool]] = {
+        "embed": ("model.embed_tokens.weight", False),
+        "final_norm": ("model.norm.weight", False),
+    }
+    if not cfg.tie_word_embeddings:
+        out["lm_head"] = ("lm_head.weight", False)
+    for name in _layer_shapes(cfg):
+        hf_suffix, transpose = _HF_LAYER_MAP[name]
+        for i in range(cfg.num_layers):
+            out[f"layers/{i}/{name}"] = (f"model.layers.{i}.{hf_suffix}", transpose)
+    return out
+
+
+def make_causal_inputs(
+    input_ids: np.ndarray, segment_ids: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """labels + label validity mask for next-token prediction on packed rows.
+
+    Position t predicts token t+1 *within the same segment*; the last token of
+    each segment (and padding) is masked out.
+    """
+    labels = np.roll(input_ids, -1, axis=-1)
+    next_seg = np.roll(segment_ids, -1, axis=-1)
+    next_seg[..., -1] = 0
+    valid = (segment_ids != 0) & (segment_ids == next_seg)
+    return labels, valid

@@ -1,0 +1,139 @@
+"""Grid packing: ragged sequences -> fixed-shape [G, L] packed rows.
+
+The TPU-native replacement for the reference's 1D varlen packing
+(areal/utils/data.py pack_tensor_dict:273-324 + FFD microbatching :477-598):
+sequences are first-fit-decreasing binned into rows of a *bucketed* capacity
+L so XLA sees a small set of static shapes (SURVEY §7.3.4), with
+``segment_ids`` (1-based, 0 = padding) and per-segment restarting positions
+driving attention masking inside the model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+from areal_tpu.utils import datapack
+from areal_tpu.utils.data import TensorDict, is_per_token, round_up_to_bucket, seqlens_of
+
+
+@dataclasses.dataclass
+class Grid:
+    """One packed microbatch with fixed [G, L] shape.
+
+    ``data`` holds per-token keys as [G, L] arrays plus per-sequence keys as
+    [n_seqs] arrays; ``row_of_seq``/``col_of_seq`` locate each original
+    sequence; ``seq_index`` maps local sequence order -> index in the source
+    batch (for reassembling forward outputs in input order).
+    """
+
+    data: TensorDict
+    n_rows: int
+    row_len: int
+    seq_index: list[int]
+    row_of_seq: list[int]
+    col_of_seq: list[int]
+    seq_lens: list[int]
+
+    @property
+    def segment_ids(self) -> np.ndarray:
+        return self.data["segment_ids"]
+
+    def scatter_per_token(self, key: str, grid_values: np.ndarray) -> list[np.ndarray]:
+        """[G, L] model output -> list of per-sequence 1D arrays, input order."""
+        out: list[np.ndarray | None] = [None] * len(self.seq_index)
+        for local, (r, c, n, src) in enumerate(
+            zip(self.row_of_seq, self.col_of_seq, self.seq_lens, self.seq_index)
+        ):
+            out[local] = np.asarray(grid_values[r, c : c + n])
+        return out  # type: ignore[return-value]
+
+
+def pack_grid(
+    data: TensorDict,
+    row_len: int | None = None,
+    n_rows: int | None = None,
+    bucket_step: int = 512,
+    pad_rows_to: int = 1,
+) -> Grid:
+    """Pack a padded [B, Lpad] batch into a [G, L] grid.
+
+    Rows are FFD bins of capacity ``row_len`` (default: bucketed max seqlen).
+    ``pad_rows_to`` rounds G up (e.g. to the data-parallel degree so the grid
+    shards evenly over the mesh "data" axis).
+    """
+    lens = seqlens_of(data)
+    B = len(lens)
+    if row_len is None:
+        row_len = round_up_to_bucket(int(lens.max()), bucket_step)
+    assert int(lens.max()) <= row_len, (int(lens.max()), row_len)
+
+    groups = datapack.ffd_allocate([int(x) for x in lens], row_len, min_groups=1)
+    G = len(groups)
+    if n_rows is not None:
+        assert n_rows >= G, (n_rows, G)
+        G = n_rows
+    G = -(-G // pad_rows_to) * pad_rows_to
+
+    mask = np.asarray(data["attention_mask"]).astype(bool)
+    per_token_keys = [
+        k
+        for k, v in data.items()
+        if k != "attention_mask"
+        and is_per_token(k)
+        and np.asarray(v).ndim >= 2
+        and np.asarray(v).shape[:2] == mask.shape
+    ]
+    per_seq_keys = [
+        k for k, v in data.items() if k not in per_token_keys and k != "attention_mask"
+    ]
+
+    out: TensorDict = {}
+    for k in per_token_keys:
+        v = np.asarray(data[k])
+        out[k] = np.zeros((G, row_len, *v.shape[2:]), dtype=v.dtype)
+    segment_ids = np.zeros((G, row_len), dtype=np.int32)
+    positions = np.zeros((G, row_len), dtype=np.int32)
+
+    seq_index: list[int] = []
+    row_of_seq: list[int] = []
+    col_of_seq: list[int] = []
+    seq_lens: list[int] = []
+    for r, grp in enumerate(groups):
+        col = 0
+        for j, b in enumerate(grp):
+            n = int(lens[b])
+            for k in per_token_keys:
+                out[k][r, col : col + n] = np.asarray(data[k])[b][mask[b]]
+            segment_ids[r, col : col + n] = j + 1
+            positions[r, col : col + n] = np.arange(n)
+            seq_index.append(b)
+            row_of_seq.append(r)
+            col_of_seq.append(col)
+            seq_lens.append(n)
+            col += n
+
+    out["segment_ids"] = segment_ids
+    out["positions"] = positions
+    order = np.argsort(seq_index, kind="stable")
+    for k in per_seq_keys:
+        v = np.asarray(data[k])
+        # reorder to local (pack) order so out[k][i] belongs to local seq i
+        out[k] = v[[seq_index[i] for i in range(len(seq_index))]] if v.shape[:1] == (B,) else v
+    del order
+    return Grid(
+        data=out,
+        n_rows=G,
+        row_len=row_len,
+        seq_index=seq_index,
+        row_of_seq=row_of_seq,
+        col_of_seq=col_of_seq,
+        seq_lens=seq_lens,
+    )
+
+
+def grid_total_tokens(lens: Sequence[int], row_len: int) -> int:
+    groups = datapack.ffd_allocate([int(x) for x in lens], row_len, min_groups=1)
+    return len(groups) * row_len

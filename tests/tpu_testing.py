@@ -1,0 +1,55 @@
+"""Shared test helpers (reference tests/utils.py + areal/utils/testing_utils.py)."""
+
+import numpy as np
+
+from areal_tpu.models import qwen
+from areal_tpu.utils.data import pad_sequences_to_tensors
+
+TINY_QWEN2 = qwen.ModelConfig(
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    dtype="float32",
+    tie_word_embeddings=True,
+    attention_bias=True,
+    rope_theta=10000.0,
+)
+
+TINY_QWEN3 = qwen.ModelConfig(
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    head_dim=16,
+    dtype="float32",
+    tie_word_embeddings=False,
+    attention_bias=False,
+    qk_norm=True,
+    rope_theta=10000.0,
+)
+
+
+def random_batch(
+    n_seqs=8, min_len=5, max_len=60, vocab=256, seed=0, with_rl_keys=False
+):
+    rng = np.random.default_rng(seed)
+    trajs = []
+    for _ in range(n_seqs):
+        n = int(rng.integers(min_len, max_len))
+        t = {
+            "input_ids": rng.integers(0, vocab, n).astype(np.int32),
+            "loss_mask": np.concatenate(
+                [np.zeros(n // 2, np.float32), np.ones(n - n // 2, np.float32)]
+            ),
+        }
+        if with_rl_keys:
+            t["logprobs"] = rng.normal(-1.5, 0.3, n).astype(np.float32)
+            t["versions"] = np.zeros(n, np.int32)
+            t["rewards"] = np.float32(rng.uniform(0, 1))
+        trajs.append(t)
+    return pad_sequences_to_tensors(trajs)
