@@ -91,22 +91,41 @@ def test_eval_batch(engine):
 
 
 def test_microbatching_invariance():
-    """Gradient accumulation over small microbatches must match one big batch
-    (the packed-loss weight protocol)."""
-    eng_a = _engine(lr=1e-2)
-    eng_b = _engine(lr=1e-2)
-    # sync initial params (deep copy — the optimizer step donates buffers)
-    eng_b.params = jax.tree.map(jnp.copy, eng_a.params)
-    eng_b.opt_state = jax.tree.map(jnp.copy, eng_a.opt_state)
+    """Accumulated gradients and total loss over small microbatches must match
+    a single big batch (the packed-loss weight protocol — reference
+    engine/core/train_engine.py loss-weight all-reduce). Post-optimizer params
+    are NOT compared: AdamW's first step is sign-like and amplifies fp32
+    noise chaotically."""
+    eng = _engine(lr=1e-2)
     batch = random_batch(n_seqs=8, seed=6)
-    eng_a.config.mb_spec = MicroBatchSpec(max_tokens_per_mb=100_000)
-    eng_b.config.mb_spec = MicroBatchSpec(max_tokens_per_mb=256)
-    sa = eng_a.train_batch(batch, sft_loss, weight_fn)
-    sb = eng_b.train_batch(batch, sft_loss, weight_fn)
-    assert sb["n_microbatches"] > sa["n_microbatches"]
-    la = eng_a.forward_batch(batch)
-    lb = eng_b.forward_batch(batch)
-    np.testing.assert_allclose(la, lb, rtol=5e-3, atol=5e-3)
+
+    def grads_for(max_tok):
+        eng.config.mb_spec = MicroBatchSpec(max_tokens_per_mb=max_tok)
+        grids = eng._make_grids(batch)
+        ws = [weight_fn(g.data) for g in grids]
+        tot = sum(ws)
+        acc, loss_sum = None, 0.0
+        with jax.set_mesh(eng.mesh):
+            for g, w in zip(grids, ws):
+                b = eng._grid_to_device(g)
+                gfn = eng._get_grad_fn(sft_loss, b["segment_ids"].shape)
+                gr, loss, _ = gfn(eng.params, b, jnp.float32(w / tot))
+                loss_sum += float(loss)
+                gr = jax.tree.map(jnp.copy, gr)
+                acc = gr if acc is None else jax.tree.map(jnp.add, acc, gr)
+        return len(grids), loss_sum, acc
+
+    n_a, loss_a, ga = grads_for(100_000)
+    n_b, loss_b, gb = grads_for(256)
+    assert n_b > n_a
+    np.testing.assert_allclose(loss_a, loss_b, rtol=1e-4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4
+        ),
+        ga,
+        gb,
+    )
 
 
 def test_version_bookkeeping(engine):
