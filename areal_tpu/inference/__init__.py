@@ -1,0 +1,1 @@
+from areal_tpu.inference.decode_engine import DecodeEngine  # noqa: F401

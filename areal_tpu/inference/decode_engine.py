@@ -1,0 +1,526 @@
+"""TPU-native generation engine: continuous batching + interruptible decode.
+
+Replaces the external SGLang/vLLM servers the reference depends on
+(areal/engine/sglang_remote.py, vllm_remote.py + infra/launcher/*_server.py)
+with a JAX decode engine built for the async-RL protocol (SURVEY §7.1):
+
+- **slot-based continuous batching**: S fixed decode slots over a static
+  [n_layers, S, T, KH, hd] KV cache; requests admit into free slots via a
+  bucketed prefill, then all slots step together in a jitted multi-token
+  ``lax.scan`` decode chunk (``decode_steps_per_call``) — static shapes
+  everywhere, a handful of compiled programs total.
+- **interruptible generation** (the reference's crown jewel,
+  remote_inf_engine.py:771-867 + §3.4 pause protocol): ``pause()`` completes
+  all in-flight requests with ``stop_reason="abort"`` and their partial
+  tokens; the client loops, re-submitting accumulated prompts after
+  ``continue_generation``. Weight swaps happen between chunks, so aborts cost
+  at most one chunk of latency.
+- **per-token policy versions**: every emitted token is stamped with the
+  weight version that produced it — the input to decoupled-PPO staleness
+  correction (reference io_struct.py output_versions).
+
+The engine is transport-free; inference/server.py wraps it in aiohttp HTTP
+speaking the reference's small protocol (/generate, /pause_generation, ...).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from areal_tpu.api.config import ServerConfig
+from areal_tpu.api.io_struct import ModelRequest, ModelResponse, StopReason
+from areal_tpu.models import qwen
+from areal_tpu.models.hf import load_params_from_hf
+from areal_tpu.parallel import mesh as mesh_lib
+from areal_tpu.utils import logging as alog
+from areal_tpu.utils.data import round_up_to_bucket
+
+logger = alog.getLogger("decode_engine")
+
+_MAX_STOP = 8  # stop-token-id slots per request (padded with -1)
+
+
+@dataclass
+class _Task:
+    req: ModelRequest
+    callback: Callable[[ModelResponse], None]
+    submit_time: float = field(default_factory=time.monotonic)
+    slot: int = -1
+    prompt_len: int = 0
+    out_tokens: list[int] = field(default_factory=list)
+    out_logprobs: list[float] = field(default_factory=list)
+    out_versions: list[int] = field(default_factory=list)
+    first_token_time: float | None = None
+
+
+def _sample_step(logits, rng, temp, greedy, top_k: int, top_p: float):
+    """One sampling step. logits [S, V] fp32; temp/greedy per-slot arrays;
+    top_k/top_p are static (compiled per distinct value)."""
+    V = logits.shape[-1]
+    masked = logits
+    if top_k > 0 and top_k < V:
+        kth = jax.lax.top_k(masked, top_k)[0][:, -1:]
+        masked = jnp.where(masked < kth, -1e30, masked)
+    if 0.0 < top_p < 1.0:
+        sorted_logits = jnp.sort(masked, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens until cumulative prob exceeds top_p (always keep first)
+        keep = cum - probs < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+        masked = jnp.where(masked < cutoff, -1e30, masked)
+    safe_t = jnp.maximum(temp, 1e-6)[:, None]
+    scaled = masked / safe_t
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    arg = jnp.argmax(logits, axis=-1)
+    next_ids = jnp.where(greedy, arg, sampled).astype(jnp.int32)
+    logp_dist = jax.nn.log_softmax(scaled, axis=-1)
+    logp = jnp.take_along_axis(logp_dist, next_ids[:, None], axis=-1)[:, 0]
+    return next_ids, logp
+
+
+class DecodeEngine:
+    """Continuous-batching generation over one model replica."""
+
+    def __init__(
+        self,
+        config: ServerConfig,
+        params: dict | None = None,
+        model_cfg: qwen.ModelConfig | None = None,
+        mesh=None,
+    ):
+        self.config = config
+        self.params = params
+        self.model_cfg = model_cfg
+        self.mesh = mesh
+        self._version = 0
+        self._paused = threading.Event()  # set = paused
+        self._shutdown = threading.Event()
+        self._queue: queue.Queue[_Task] = queue.Queue()
+        self._pending_weight_update: tuple[str, Any, int] | None = None
+        self._weight_lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._fn_cache: dict[tuple, Callable] = {}
+        self._wakeup = threading.Event()
+        # static sampling knobs compiled into the chunk (per-engine; per-slot
+        # temperature/greedy still vary)
+        self._top_k = -1
+        self._top_p = 1.0
+        self.stats = {"generated_tokens": 0, "completed": 0, "aborted": 0, "chunks": 0}
+
+    # -- lifecycle --------------------------------------------------------
+    def initialize(self) -> None:
+        cfg = self.config
+        if self.mesh is None:
+            self.mesh = mesh_lib.make_mesh(cfg.mesh)
+        if self.params is None:
+            assert cfg.model_path, "ServerConfig.model_path required"
+            self.model_cfg = qwen.ModelConfig.from_hf_path(cfg.model_path)
+            self.model_cfg = qwen.ModelConfig(
+                **{**self.model_cfg.__dict__, "dtype": cfg.dtype, "remat": False}
+            )
+            self.param_shardings = mesh_lib.param_sharding(
+                self.mesh, qwen.param_partition_specs(self.model_cfg)
+            )
+
+            def put(path, arr):
+                parts = path.split("/")
+                shard = (
+                    self.param_shardings["layers"][parts[1]]
+                    if parts[0] == "layers"
+                    else self.param_shardings[parts[0]]
+                )
+                return jax.device_put(
+                    jnp.asarray(arr, dtype=self.model_cfg.jax_dtype), shard
+                )
+
+            self.params, _ = load_params_from_hf(
+                cfg.model_path, self.model_cfg, put=put
+            )
+        else:
+            assert self.model_cfg is not None
+            self.param_shardings = mesh_lib.param_sharding(
+                self.mesh, qwen.param_partition_specs(self.model_cfg)
+            )
+
+        S, T = cfg.max_batch_size, cfg.max_seq_len
+        tp = self.mesh.shape["model"]
+        kv_spec = (
+            qwen.kv_cache_specs()
+            if self.model_cfg.num_kv_heads % max(tp, 1) == 0
+            else {"k": P(), "v": P()}
+        )
+        with jax.set_mesh(self.mesh):
+            self.cache = jax.jit(
+                lambda: qwen.init_kv_cache(self.model_cfg, S, T),
+                out_shardings={
+                    k: NamedSharding(self.mesh, s) for k, s in kv_spec.items()
+                },
+            )()
+        # per-slot host state
+        self._slot_task: list[_Task | None] = [None] * S
+        self._state = {
+            "ids": np.zeros(S, np.int32),
+            "pos": np.zeros(S, np.int32),
+            "active": np.zeros(S, bool),
+            "remaining": np.zeros(S, np.int32),
+            "temp": np.ones(S, np.float32),
+            "greedy": np.zeros(S, bool),
+            "stop_ids": np.full((S, _MAX_STOP), -1, np.int32),
+        }
+        self._rng = jax.random.PRNGKey(int(time.time_ns()) % (2**31))
+        logger.info(
+            f"decode engine ready: {S} slots × {T} ctx, mesh {dict(self.mesh.shape)}"
+        )
+
+    def start(self) -> None:
+        assert self._thread is None
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        self._wakeup.set()
+        if self._thread:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    # -- request API (any thread) ----------------------------------------
+    def submit(self, req: ModelRequest, callback: Callable[[ModelResponse], None]):
+        self._queue.put(_Task(req=req, callback=callback))
+        self._wakeup.set()
+
+    def generate_sync(self, req: ModelRequest, timeout: float = 600.0) -> ModelResponse:
+        done = threading.Event()
+        box: list[ModelResponse] = []
+
+        def cb(resp):
+            box.append(resp)
+            done.set()
+
+        self.submit(req, cb)
+        if not done.wait(timeout):
+            raise TimeoutError(f"generation timed out after {timeout}s")
+        return box[0]
+
+    # -- pause / weights (the §3.4 protocol) ------------------------------
+    def pause_generation(self) -> None:
+        """Abort all in-flight requests (they complete with stop_reason
+        "abort") and stop admitting until continue_generation."""
+        self._paused.set()
+        self._wakeup.set()
+
+    def continue_generation(self) -> None:
+        self._paused.clear()
+        self._wakeup.set()
+
+    @property
+    def is_paused(self) -> bool:
+        return self._paused.is_set()
+
+    def update_weights_from_disk(self, path: str, version: int | None = None) -> None:
+        with self._weight_lock:
+            self._pending_weight_update = ("disk", path, version)
+        self._wakeup.set()
+        # wait for the decode loop to apply it (or apply inline if not running)
+        if self._thread is None:
+            self._apply_weight_update()
+        else:
+            while True:
+                with self._weight_lock:
+                    if self._pending_weight_update is None:
+                        return
+                time.sleep(0.01)
+
+    def update_weights_from_params(self, params: dict, version: int | None = None) -> None:
+        """Colocated/mem-path update: resharded device arrays or host arrays."""
+        with self._weight_lock:
+            self._pending_weight_update = ("params", params, version)
+        self._wakeup.set()
+        if self._thread is None:
+            self._apply_weight_update()
+        else:
+            while True:
+                with self._weight_lock:
+                    if self._pending_weight_update is None:
+                        return
+                time.sleep(0.01)
+
+    def _apply_weight_update(self) -> None:
+        with self._weight_lock:
+            upd = self._pending_weight_update
+            if upd is None:
+                return
+            kind, payload, version = upd
+            t0 = time.monotonic()
+            if kind == "disk":
+
+                def put(path, arr):
+                    parts = path.split("/")
+                    shard = (
+                        self.param_shardings["layers"][parts[1]]
+                        if parts[0] == "layers"
+                        else self.param_shardings[parts[0]]
+                    )
+                    return jax.device_put(
+                        jnp.asarray(arr, dtype=self.model_cfg.jax_dtype), shard
+                    )
+
+                self.params, _ = load_params_from_hf(payload, self.model_cfg, put=put)
+            else:
+                tgt = jax.tree.map(
+                    lambda x, s: jax.device_put(
+                        jnp.asarray(x, dtype=self.model_cfg.jax_dtype), s
+                    ),
+                    payload,
+                    self.param_shardings,
+                )
+                self.params = tgt
+            if version is not None:
+                self._version = version
+            self._pending_weight_update = None
+            logger.info(
+                f"weights updated ({kind}) to v{self._version} in "
+                f"{time.monotonic()-t0:.2f}s"
+            )
+
+    def set_version(self, v: int) -> None:
+        self._version = v
+
+    def get_version(self) -> int:
+        return self._version
+
+    # -- jitted kernels ---------------------------------------------------
+    def _prefill_fn(self, bucket: int):
+        key = ("prefill", bucket)
+        if key not in self._fn_cache:
+            mcfg = self.model_cfg
+
+            def prefill(params, cache, ids, plen, slot):
+                positions = jnp.arange(bucket, dtype=jnp.int32)[None]
+                _, ks, vs = qwen.forward_prefill(params, mcfg, ids, positions)
+                # write rows [0, plen-1): the last prompt token is fed as the
+                # first decode-chunk input instead
+                row = jnp.arange(bucket)
+                keep = (row < plen - 1)[None, :, None, None]
+                for name, new in (("k", ks), ("v", vs)):
+                    cur = jax.lax.dynamic_slice(
+                        cache[name],
+                        (0, slot, 0, 0, 0),
+                        (
+                            mcfg.num_layers,
+                            1,
+                            bucket,
+                            mcfg.num_kv_heads,
+                            mcfg.head_dim_,
+                        ),
+                    )
+                    merged = jnp.where(
+                        keep, new.astype(cur.dtype)[:, None][:, 0], cur[:, 0]
+                    )
+                    cache[name] = jax.lax.dynamic_update_slice(
+                        cache[name], merged[:, None], (0, slot, 0, 0, 0)
+                    )
+                return cache
+
+            self._fn_cache[key] = jax.jit(
+                prefill, static_argnames=(), donate_argnames=("cache",)
+            )
+        return self._fn_cache[key]
+
+    def _chunk_fn(self, n_steps: int, top_k: int, top_p: float):
+        key = ("chunk", n_steps, top_k, top_p)
+        if key not in self._fn_cache:
+            mcfg = self.model_cfg
+            T = self.config.max_seq_len
+
+            def chunk(params, cache, state, rng):
+                def step(carry, _):
+                    ids, pos, active, remaining, cache, rng = carry
+                    hidden, cache = qwen.forward_decode(
+                        params, mcfg, ids, pos, cache, pos
+                    )
+                    logits = qwen.compute_logits(params, mcfg, hidden)
+                    rng, sub = jax.random.split(rng)
+                    next_ids, logp = _sample_step(
+                        logits, sub, state["temp"], state["greedy"], top_k, top_p
+                    )
+                    emitted = active
+                    hit_stop = jnp.any(
+                        next_ids[:, None] == state["stop_ids"], axis=-1
+                    )
+                    new_pos = pos + 1
+                    remaining = remaining - active.astype(jnp.int32)
+                    still = (
+                        active
+                        & ~hit_stop
+                        & (remaining > 0)
+                        & (new_pos < T - 1)
+                    )
+                    ids = jnp.where(active, next_ids, ids)
+                    pos = jnp.where(active, new_pos, pos)
+                    return (ids, pos, still, remaining, cache, rng), (
+                        next_ids,
+                        logp,
+                        emitted,
+                    )
+
+                carry = (
+                    state["ids"],
+                    state["pos"],
+                    state["active"],
+                    state["remaining"],
+                    cache,
+                    rng,
+                )
+                (ids, pos, active, remaining, cache, rng), (toks, logps, emit) = (
+                    jax.lax.scan(step, carry, None, length=n_steps)
+                )
+                out_state = dict(state)
+                out_state.update(ids=ids, pos=pos, active=active, remaining=remaining)
+                return cache, out_state, rng, toks, logps, emit
+
+            self._fn_cache[key] = jax.jit(chunk, donate_argnames=("cache",))
+        return self._fn_cache[key]
+
+    # -- decode loop ------------------------------------------------------
+    def _free_slots(self) -> list[int]:
+        return [i for i, t in enumerate(self._slot_task) if t is None]
+
+    def _admit(self, task: _Task, slot: int) -> None:
+        req = task.req
+        g = req.gconfig
+        ids = list(req.input_ids)
+        P_len = len(ids)
+        T = self.config.max_seq_len
+        if P_len >= T - 2:
+            self._finish(task, StopReason.LENGTH.value)
+            return
+        bucket = min(T, round_up_to_bucket(P_len, 256))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :P_len] = ids
+        with jax.set_mesh(self.mesh):
+            self.cache = self._prefill_fn(bucket)(
+                self.params,
+                self.cache,
+                jnp.asarray(padded),
+                jnp.int32(P_len),
+                jnp.int32(slot),
+            )
+        task.slot = slot
+        task.prompt_len = P_len
+        self._slot_task[slot] = task
+        st = self._state
+        st["ids"][slot] = ids[-1]
+        st["pos"][slot] = P_len - 1
+        st["active"][slot] = True
+        budget = g.max_new_tokens
+        if g.max_tokens is not None:
+            budget = min(budget, g.max_tokens - P_len)
+        st["remaining"][slot] = max(1, min(budget, T - 1 - P_len))
+        st["temp"][slot] = 0.0 if g.greedy else g.temperature
+        st["greedy"][slot] = bool(g.greedy or g.temperature == 0.0)
+        stops = (list(g.stop_token_ids) + [-1] * _MAX_STOP)[:_MAX_STOP]
+        st["stop_ids"][slot] = stops
+        if g.top_k > 0:
+            self._top_k = g.top_k
+        if g.top_p < 1.0:
+            self._top_p = g.top_p
+
+    def _finish(self, task: _Task, reason: str) -> None:
+        if task.slot >= 0:
+            self._slot_task[task.slot] = None
+            self._state["active"][task.slot] = False
+        resp = ModelResponse(
+            input_tokens=list(task.req.input_ids),
+            output_tokens=task.out_tokens,
+            output_logprobs=task.out_logprobs,
+            output_versions=task.out_versions,
+            stop_reason=reason,
+            latency=time.monotonic() - task.submit_time,
+            ttft=(task.first_token_time or time.monotonic()) - task.submit_time,
+            rid=task.req.rid,
+            metadata=dict(task.req.metadata),
+        )
+        if reason == StopReason.ABORT.value:
+            self.stats["aborted"] += 1
+        else:
+            self.stats["completed"] += 1
+        try:
+            task.callback(resp)
+        except Exception:
+            logger.exception("generation callback failed")
+
+    def _abort_all(self) -> None:
+        for slot, task in enumerate(self._slot_task):
+            if task is not None:
+                self._finish(task, StopReason.ABORT.value)
+
+    def _loop(self) -> None:
+        cfg = self.config
+        while not self._shutdown.is_set():
+            self._apply_weight_update()
+            if self._paused.is_set():
+                self._abort_all()
+                self._wakeup.wait(timeout=0.05)
+                self._wakeup.clear()
+                continue
+            # admit pending requests into free slots
+            free = self._free_slots()
+            while free and not self._paused.is_set():
+                try:
+                    task = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                self._admit(task, free.pop(0))
+            if not any(t is not None for t in self._slot_task):
+                self._wakeup.wait(timeout=0.05)
+                self._wakeup.clear()
+                continue
+            # one decode chunk for all active slots
+            n_steps = cfg.decode_steps_per_call
+            st = self._state
+            chunk = self._chunk_fn(n_steps, self._top_k, self._top_p)
+            with jax.set_mesh(self.mesh):
+                dev_state = {k: jnp.asarray(v) for k, v in st.items()}
+                self.cache, out_state, self._rng, toks, logps, emit = chunk(
+                    self.params, self.cache, dev_state, self._rng
+                )
+                toks = np.asarray(toks)
+                logps = np.asarray(logps)
+                emit = np.asarray(emit)
+                for k in ("ids", "pos", "active", "remaining"):
+                    st[k] = np.array(out_state[k])  # writable host copy
+            self.stats["chunks"] += 1
+            version = self._version
+            now = time.monotonic()
+            for slot, task in enumerate(self._slot_task):
+                if task is None:
+                    continue
+                emitted = emit[:, slot]
+                n_emit = int(emitted.sum())
+                if n_emit:
+                    if task.first_token_time is None:
+                        task.first_token_time = now
+                    task.out_tokens.extend(int(t) for t in toks[emitted, slot])
+                    task.out_logprobs.extend(float(x) for x in logps[emitted, slot])
+                    task.out_versions.extend([version] * n_emit)
+                    self.stats["generated_tokens"] += n_emit
+                if not st["active"][slot]:
+                    last = task.out_tokens[-1] if task.out_tokens else -1
+                    if last in task.req.gconfig.stop_token_ids:
+                        reason = StopReason.STOP.value
+                    else:
+                        reason = StopReason.LENGTH.value
+                    self._finish(task, reason)
+        self._abort_all()

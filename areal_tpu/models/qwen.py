@@ -425,3 +425,128 @@ def make_causal_inputs(
     next_seg[..., -1] = 0
     valid = (segment_ids != 0) & (segment_ids == next_seg)
     return labels, valid
+
+
+# ---------------------------------------------------------------------------
+# incremental decoding (inference server path)
+# ---------------------------------------------------------------------------
+
+
+def init_kv_cache(cfg: ModelConfig, n_slots: int, max_len: int, dtype=None) -> dict:
+    """Slot-based KV cache: k/v are [n_layers, S, T, KH, hd]."""
+    dtype = dtype or cfg.jax_dtype
+    shape = (cfg.num_layers, n_slots, max_len, cfg.num_kv_heads, cfg.head_dim_)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def kv_cache_specs() -> dict:
+    """PartitionSpecs for the cache (kv heads on the model axis when they
+    divide; callers fall back to replicated otherwise)."""
+    return {
+        "k": P(None, None, None, "model", None),
+        "v": P(None, None, None, "model", None),
+    }
+
+
+def forward_prefill(
+    params: dict,
+    cfg: ModelConfig,
+    input_ids: jax.Array,  # [1, P]
+    positions: jax.Array,  # [1, P]
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Prompt pass for one request: returns (hidden [1, P, D], k, v) where
+    k/v are [n_layers, P, KH, hd] (post-rope, pre-GQA-repeat) for cache fill."""
+    seg = jnp.ones_like(input_ids)
+    x = jnp.take(params["embed"], input_ids, axis=0).astype(cfg.jax_dtype)
+    mask = _attention_mask(seg)
+    H, KH, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+
+    def body(x, layer):
+        G, L, D = x.shape
+        h = _rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+        q = h @ layer["wq"]
+        k = h @ layer["wk"]
+        v = h @ layer["wv"]
+        if cfg.attention_bias:
+            q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+        q = q.reshape(G, L, H, hd)
+        k = k.reshape(G, L, KH, hd)
+        v = v.reshape(G, L, KH, hd)
+        if cfg.qk_norm:
+            q = _rms_norm(q, layer["q_norm"], cfg.rms_norm_eps)
+            k = _rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        k_cache, v_cache = k, v
+        if KH != H:
+            k = jnp.repeat(k, H // KH, axis=2)
+            v = jnp.repeat(v, H // KH, axis=2)
+        attn = _sdpa(q, k, v, mask, hd).reshape(G, L, H * hd)
+        x = x + attn @ layer["wo"]
+        h = _rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
+        ff = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
+        x = x + ff @ layer["w_down"]
+        return x, (k_cache[0], v_cache[0])
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    hidden = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return hidden, ks, vs
+
+
+def forward_decode(
+    params: dict,
+    cfg: ModelConfig,
+    ids: jax.Array,  # [S] current tokens
+    positions: jax.Array,  # [S] rope positions of these tokens
+    cache: dict,  # k/v [n_layers, S, T, KH, hd]
+    cache_lens: jax.Array,  # [S] number of valid cache rows (incl. this token's slot)
+) -> tuple[jax.Array, dict]:
+    """One incremental step for all S slots -> (hidden [S, D], updated cache).
+
+    The current token's k/v is written at row ``cache_lens`` per slot;
+    attention spans rows [0, cache_lens].
+    """
+    S = ids.shape[0]
+    T = cache["k"].shape[2]
+    H, KH, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    x = jnp.take(params["embed"], ids, axis=0).astype(cfg.jax_dtype)  # [S, D]
+    pos1 = positions[:, None]  # [S, 1]
+    slot_idx = jnp.arange(S)
+    valid = jnp.arange(T)[None, :] <= cache_lens[:, None]  # [S, T]
+
+    def body(x, scanned):
+        layer, k_cache, v_cache = scanned
+        h = _rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+        q = h @ layer["wq"]
+        k = h @ layer["wk"]
+        v = h @ layer["wv"]
+        if cfg.attention_bias:
+            q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+        q = q.reshape(S, 1, H, hd)
+        k = k.reshape(S, 1, KH, hd)
+        v = v.reshape(S, 1, KH, hd)
+        if cfg.qk_norm:
+            q = _rms_norm(q, layer["q_norm"], cfg.rms_norm_eps)
+            k = _rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
+        q = _rope(q, pos1, cfg.rope_theta)[:, 0]  # [S, H, hd]
+        k = _rope(k, pos1, cfg.rope_theta)[:, 0]  # [S, KH, hd]
+        v = v[:, 0]
+        k_cache = k_cache.at[slot_idx, cache_lens].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[slot_idx, cache_lens].set(v.astype(v_cache.dtype))
+        kk, vv = k_cache, v_cache
+        if KH != H:
+            kk = jnp.repeat(kk, H // KH, axis=2)
+            vv = jnp.repeat(vv, H // KH, axis=2)
+        logits = jnp.einsum("shd,sthd->sht", q, kk).astype(jnp.float32) * hd**-0.5
+        logits = jnp.where(valid[:, None, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(vv.dtype)
+        attn = jnp.einsum("sht,sthd->shd", probs, vv).reshape(S, H * hd)
+        x = x + attn @ layer["wo"]
+        h = _rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
+        ff = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
+        x = x + ff @ layer["w_down"]
+        return x, (k_cache, v_cache)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    hidden = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return hidden, {"k": ks, "v": vs}

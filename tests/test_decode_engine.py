@@ -1,0 +1,198 @@
+"""DecodeEngine correctness: incremental KV decode == full forward; abort /
+pause / weight-update protocol (replaces reference test_inference_engines.py)."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import MeshConfig, ServerConfig
+from areal_tpu.api.io_struct import (
+    GenerationHyperparameters,
+    ModelRequest,
+    StopReason,
+)
+from areal_tpu.inference.decode_engine import DecodeEngine
+from areal_tpu.models import qwen
+
+from tpu_testing import TINY_QWEN2
+
+
+def _make_engine(n_slots=4, max_len=256, steps=8, mesh=None):
+    mesh = mesh or MeshConfig(data=-1, fsdp=1, seq=1, model=2)
+    cfg = ServerConfig(
+        max_batch_size=n_slots,
+        max_seq_len=max_len,
+        decode_steps_per_call=steps,
+        mesh=mesh,
+    )
+    params = qwen.init_params(jax.random.PRNGKey(0), TINY_QWEN2)
+    eng = DecodeEngine(cfg, params=params, model_cfg=TINY_QWEN2)
+    eng.initialize()
+    return eng
+
+
+def _naive_greedy(params, cfg, prompt, n_new):
+    ids = list(prompt)
+    for _ in range(n_new):
+        a = np.asarray(ids, np.int32)[None]
+        seg = np.ones_like(a)
+        pos = np.arange(len(ids), dtype=np.int32)[None]
+        h = qwen.forward(params, cfg, a, seg, pos)
+        logits = qwen.compute_logits(params, cfg, h)
+        ids.append(int(np.argmax(np.asarray(logits)[0, -1])))
+    return ids[len(prompt):]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = _make_engine()
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def test_greedy_matches_full_forward(engine):
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 256, 12).tolist()
+    want = _naive_greedy(engine.params, engine.model_cfg, prompt, 16)
+    req = ModelRequest(
+        input_ids=prompt,
+        gconfig=GenerationHyperparameters(max_new_tokens=16, greedy=True),
+    )
+    resp = engine.generate_sync(req, timeout=120)
+    assert resp.stop_reason == StopReason.LENGTH.value
+    assert resp.output_tokens == want, (resp.output_tokens, want)
+    assert len(resp.output_logprobs) == 16
+    assert len(resp.output_versions) == 16
+    assert all(v == 0 for v in resp.output_versions)
+
+
+def test_concurrent_greedy_matches(engine):
+    """Several slots decoding together must not interfere."""
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, 256, int(rng.integers(4, 20))).tolist() for _ in range(4)]
+    wants = [_naive_greedy(engine.params, engine.model_cfg, p, 10) for p in prompts]
+    results = {}
+    lock = threading.Lock()
+    done = threading.Event()
+
+    def cb_for(i):
+        def cb(resp):
+            with lock:
+                results[i] = resp
+                if len(results) == len(prompts):
+                    done.set()
+
+        return cb
+
+    for i, p in enumerate(prompts):
+        engine.submit(
+            ModelRequest(
+                input_ids=p,
+                gconfig=GenerationHyperparameters(max_new_tokens=10, greedy=True),
+            ),
+            cb_for(i),
+        )
+    assert done.wait(120)
+    for i, want in enumerate(wants):
+        assert results[i].output_tokens == want, i
+
+
+def test_stop_token(engine):
+    """Generation halts at a stop token and includes it in the output."""
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, 256, 8).tolist()
+    free_run = engine.generate_sync(
+        ModelRequest(
+            input_ids=prompt,
+            gconfig=GenerationHyperparameters(max_new_tokens=24, greedy=True),
+        ),
+        timeout=120,
+    )
+    # pick the 5th generated token as the "eos"
+    eos = free_run.output_tokens[4]
+    first_idx = free_run.output_tokens.index(eos)
+    resp = engine.generate_sync(
+        ModelRequest(
+            input_ids=prompt,
+            gconfig=GenerationHyperparameters(
+                max_new_tokens=24, greedy=True, stop_token_ids=[eos]
+            ),
+        ),
+        timeout=120,
+    )
+    assert resp.stop_reason == StopReason.STOP.value
+    assert resp.output_tokens == free_run.output_tokens[: first_idx + 1]
+
+
+def test_pause_aborts_and_resume(engine):
+    """pause_generation() completes in-flight requests with stop_reason=abort;
+    after continue_generation() new requests run (the §3.4 protocol)."""
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 256, 8).tolist()
+    box = []
+    ev = threading.Event()
+    engine.submit(
+        ModelRequest(
+            input_ids=prompt,
+            gconfig=GenerationHyperparameters(max_new_tokens=2048, greedy=True),
+        ),
+        lambda r: (box.append(r), ev.set()),
+    )
+    time.sleep(0.3)  # let some chunks run
+    engine.pause_generation()
+    assert ev.wait(60), "pause must complete the in-flight request"
+    resp = box[0]
+    assert resp.stop_reason == StopReason.ABORT.value
+    engine.continue_generation()
+    # resume: resubmit with accumulated tokens (what the client loop does)
+    resumed = engine.generate_sync(
+        ModelRequest(
+            input_ids=prompt + resp.output_tokens,
+            gconfig=GenerationHyperparameters(max_new_tokens=8, greedy=True),
+        ),
+        timeout=120,
+    )
+    want = _naive_greedy(
+        engine.params, engine.model_cfg, prompt, len(resp.output_tokens) + 8
+    )
+    assert resp.output_tokens + resumed.output_tokens == want
+
+
+def test_weight_update_bumps_version(engine):
+    new_params = jax.tree.map(lambda x: x * 1.01, engine.params)
+    engine.update_weights_from_params(
+        jax.tree.map(np.asarray, new_params), version=3
+    )
+    assert engine.get_version() == 3
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(0, 256, 6).tolist()
+    resp = engine.generate_sync(
+        ModelRequest(
+            input_ids=prompt,
+            gconfig=GenerationHyperparameters(max_new_tokens=4, greedy=True),
+        ),
+        timeout=120,
+    )
+    assert all(v == 3 for v in resp.output_versions)
+    engine.set_version(0)
+
+
+def test_temperature_sampling_varies(engine):
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, 256, 6).tolist()
+    outs = set()
+    for _ in range(4):
+        resp = engine.generate_sync(
+            ModelRequest(
+                input_ids=prompt,
+                gconfig=GenerationHyperparameters(max_new_tokens=12, temperature=5.0),
+            ),
+            timeout=120,
+        )
+        outs.add(tuple(resp.output_tokens))
+    assert len(outs) > 1, "high-temperature sampling should vary"
