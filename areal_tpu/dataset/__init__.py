@@ -1,0 +1,64 @@
+"""Dataset registry (parity: reference areal/dataset/__init__.py:11-18).
+
+``get_custom_dataset(name, ...)`` returns a list-like of dict rows with
+"messages" (chat) or "prompt" plus task-specific fields (e.g. "answer").
+Loaders read local HF-datasets paths (this image has zero egress, so remote
+download is not attempted; pass ``path`` to a local copy).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+def register_dataset(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_custom_dataset(name: str, split: str = "train", **kwargs) -> Any:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown dataset {name!r}; registered: {list(_REGISTRY)}")
+    return _REGISTRY[name](split=split, **kwargs)
+
+
+@register_dataset("gsm8k")
+def _gsm8k(split: str = "train", path: str | None = None, **kwargs):
+    """Rows: {"messages": [...], "answer": str} (reference dataset/gsm8k.py)."""
+    import datasets
+
+    assert path, "gsm8k requires a local dataset path (zero-egress image)"
+    ds = datasets.load_dataset(path=path, split=split)
+
+    def to_row(x):
+        return {
+            "messages": [{"role": "user", "content": x["question"]}],
+            "answer": x["answer"],
+        }
+
+    return [to_row(x) for x in ds]
+
+
+@register_dataset("synthetic_arith")
+def _synthetic_arith(split: str = "train", n: int = 512, seed: int = 0, **kwargs):
+    """Self-contained arithmetic task for e2e learning tests without any
+    external data: 'a+b=?' with reward on the exact sum (plays the role of
+    the reference's GSM8K e2e harness, tests/grpo/test_grpo.py)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed + (0 if split == "train" else 10_000))
+    rows = []
+    for _ in range(n):
+        a, b = int(rng.integers(0, 50)), int(rng.integers(0, 50))
+        rows.append(
+            {
+                "prompt": f"Compute: {a}+{b}= ",
+                "answer": f"#### {a+b}",
+            }
+        )
+    return rows

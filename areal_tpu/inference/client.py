@@ -1,0 +1,303 @@
+"""Remote inference client: interruptible generation over an HTTP fleet.
+
+Behavioral parity with reference areal/infra/remote_inf_engine.py (1,413 LoC)
++ engine/sglang_remote.py: implements the InferenceEngine contract against
+N inference-server addresses. The heart is the **interruptible agenerate
+loop** (reference :703-867): on ``stop_reason == "abort"`` (server paused for
+a weight update) it waits out the pause and re-submits with the accumulated
+tokens, preserving per-token policy versions across the interruption; the
+rid→server affinity cache keeps resumed requests on the same server for KV
+reuse (reference :753-763).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Callable
+
+import aiohttp
+import numpy as np
+
+from areal_tpu.api.config import InferenceEngineConfig
+from areal_tpu.api.engine_api import InferenceEngine
+from areal_tpu.api.io_struct import ModelRequest, ModelResponse, StopReason, WeightUpdateMeta
+from areal_tpu.infra.workflow_executor import WorkflowExecutor
+from areal_tpu.utils import logging as alog, name_resolve
+from areal_tpu.utils.data import TensorDict
+
+logger = alog.getLogger("remote_inf")
+
+
+class RemoteJaxEngine(InferenceEngine):
+    """Client handle to a fleet of areal_tpu.inference.server instances."""
+
+    def __init__(self, config: InferenceEngineConfig, addresses: list[str] | None = None):
+        self.config = config
+        self.addresses = list(addresses or [])
+        self._version = 0
+        self._rr = 0  # round-robin cursor
+        self._rid_affinity: dict[str, str] = {}
+        self.executor = WorkflowExecutor(config, engine=self)
+        self._paused = False
+
+    # -- discovery / lifecycle -------------------------------------------
+    def initialize(self, addresses: list[str] | None = None, timeout: float | None = None) -> None:
+        if addresses:
+            self.addresses = list(addresses)
+        if not self.addresses:
+            # name_resolve discovery (reference remote_inf_engine.py:379-454)
+            key = name_resolve.rollout_server_key(
+                self.config.experiment_name, self.config.trial_name
+            )
+            deadline = time.monotonic() + (timeout or self.config.setup_timeout)
+            while not self.addresses and time.monotonic() < deadline:
+                self.addresses = name_resolve.get_subtree(key)
+                if not self.addresses:
+                    time.sleep(0.5)
+        assert self.addresses, "no inference server addresses"
+        self._wait_healthy(timeout or self.config.setup_timeout)
+        self.executor.initialize()
+
+    def _wait_healthy(self, timeout: float) -> None:
+        import urllib.request
+
+        deadline = time.monotonic() + timeout
+        for addr in self.addresses:
+            while True:
+                try:
+                    with urllib.request.urlopen(f"http://{addr}/health", timeout=2) as r:
+                        if r.status == 200:
+                            break
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(f"server {addr} not healthy")
+                    time.sleep(0.5)
+
+    def destroy(self) -> None:
+        self.executor.destroy()
+
+    # -- server choice ----------------------------------------------------
+    def choose_server(self, rid: str | None = None) -> str:
+        if rid and rid in self._rid_affinity:
+            return self._rid_affinity[rid]
+        if self.config.schedule_policy == "random":
+            addr = random.choice(self.addresses)
+        else:  # round_robin
+            addr = self.addresses[self._rr % len(self.addresses)]
+            self._rr += 1
+        if rid:
+            self._rid_affinity[rid] = addr
+        return addr
+
+    # -- generation -------------------------------------------------------
+    async def agenerate(self, req: ModelRequest) -> ModelResponse:
+        """Interruptible generation loop (reference :771-867)."""
+        addr = self.choose_server(req.rid)
+        g = req.gconfig
+        accumulated: list[int] = []
+        logprobs: list[float] = []
+        versions: list[int] = []
+        remaining = g.max_new_tokens
+        start = time.monotonic()
+        ttft = None
+        stop_reason = StopReason.ABORT.value
+        attempt_input = list(req.input_ids)
+
+        while True:
+            payload = {
+                "input_ids": attempt_input,
+                "rid": req.rid,
+                "sampling_params": {
+                    "max_new_tokens": remaining,
+                    "greedy": g.greedy,
+                    "temperature": g.temperature,
+                    "top_p": g.top_p,
+                    "top_k": g.top_k,
+                    "stop_token_ids": g.stop_token_ids,
+                    "max_tokens": g.max_tokens,
+                },
+            }
+            data = await self._post_json(addr, "/generate", payload)
+            toks = data["output_tokens"]
+            accumulated.extend(toks)
+            logprobs.extend(data["output_logprobs"])
+            versions.extend(data["output_versions"])
+            if ttft is None and toks:
+                ttft = time.monotonic() - start
+            stop_reason = data["stop_reason"]
+            remaining -= len(toks)
+            if stop_reason != StopReason.ABORT.value or remaining <= 0:
+                if remaining <= 0 and stop_reason == StopReason.ABORT.value:
+                    stop_reason = StopReason.LENGTH.value
+                break
+            # server paused for a weight update: wait, then resume with the
+            # accumulated sequence (KV re-prefilled server-side)
+            await self._await_unpaused(addr)
+            attempt_input = list(req.input_ids) + accumulated
+
+        self._rid_affinity.pop(req.rid, None)
+        return ModelResponse(
+            input_tokens=list(req.input_ids),
+            output_tokens=accumulated,
+            output_logprobs=logprobs,
+            output_versions=versions,
+            stop_reason=stop_reason,
+            latency=time.monotonic() - start,
+            ttft=ttft or (time.monotonic() - start),
+            rid=req.rid,
+            metadata=dict(req.metadata),
+        )
+
+    async def _await_unpaused(self, addr: str) -> None:
+        while True:
+            try:
+                d = await self._get_json(addr, "/metrics")
+                if not d.get("paused"):
+                    return
+            except Exception:  # noqa: BLE001 — server mid-restart
+                pass
+            await asyncio.sleep(0.1)
+
+    async def _post_json(self, addr: str, path: str, payload: dict) -> dict:
+        last_exc = None
+        for attempt in range(self.config.request_retries):
+            try:
+                timeout = aiohttp.ClientTimeout(total=self.config.request_timeout)
+                async with aiohttp.ClientSession(timeout=timeout) as sess:
+                    async with sess.post(f"http://{addr}{path}", json=payload) as r:
+                        r.raise_for_status()
+                        return await r.json()
+            except Exception as e:  # noqa: BLE001
+                last_exc = e
+                await asyncio.sleep(0.2 * 2**attempt)
+        raise RuntimeError(f"POST {addr}{path} failed after retries") from last_exc
+
+    async def _get_json(self, addr: str, path: str) -> dict:
+        timeout = aiohttp.ClientTimeout(total=30)
+        async with aiohttp.ClientSession(timeout=timeout) as sess:
+            async with sess.get(f"http://{addr}{path}") as r:
+                r.raise_for_status()
+                return await r.json()
+
+    def _post_all(self, path: str, payload: dict) -> list[dict]:
+        """Synchronous fan-out to every server (weight updates, pause)."""
+        import concurrent.futures
+        import json
+        import urllib.request
+
+        def call(addr):
+            req = urllib.request.Request(
+                f"http://{addr}{path}",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=self.config.request_timeout) as r:
+                return json.loads(r.read())
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+            return list(pool.map(call, self.addresses))
+
+    # -- rollout submission (delegated to the executor) -------------------
+    def submit(self, data: dict, workflow=None, should_accept_fn=None) -> str:
+        return self.executor.submit(data, workflow, should_accept_fn)
+
+    def wait(self, count: int, timeout: float | None = None) -> TensorDict:
+        return self.executor.wait(count, timeout)
+
+    def wait_for_task(self, task_id: str, timeout: float | None = None):
+        return self.executor.wait_for_task(task_id, timeout)
+
+    def rollout_batch(self, data, workflow=None, should_accept_fn=None) -> TensorDict:
+        return self.executor.rollout_batch(data, workflow, should_accept_fn)
+
+    def prepare_batch(self, dataloader, workflow=None, should_accept_fn=None) -> TensorDict:
+        return self.executor.prepare_batch(dataloader, workflow, should_accept_fn)
+
+    def pause(self) -> None:
+        self._paused = True
+        self.executor.pause()
+
+    def resume(self) -> None:
+        self._paused = False
+        self.executor.resume()
+
+    # -- server-side generation pause (weight-update window) --------------
+    def pause_generation(self) -> None:
+        self._post_all("/pause_generation", {})
+
+    def continue_generation(self) -> None:
+        self._post_all("/continue_generation", {})
+
+    # -- weights + versioning --------------------------------------------
+    def update_weights(self, meta: WeightUpdateMeta, params: dict | None = None) -> None:
+        """§3.4 protocol: pause servers, push weights, resume."""
+        version = self._version + 1 if meta.with_version else self._version
+        self.pause_generation()
+        try:
+            if meta.type == "disk":
+                assert meta.path
+                self._post_all(
+                    "/update_weights_from_disk", {"path": meta.path, "version": version}
+                )
+            elif meta.type == "mem":
+                assert params is not None
+                self._update_weights_mem(params, version)
+            else:
+                raise NotImplementedError(meta.type)
+        finally:
+            self.continue_generation()
+        self._version = version
+
+    def _update_weights_mem(self, params: dict, version: int) -> None:
+        import io
+        import urllib.request
+
+        from areal_tpu.inference.server import flatten_params
+
+        flat = flatten_params(jax_tree_to_host(params))
+        buf = io.BytesIO()
+        np.savez(buf, __version__=np.int64(version), **flat)
+        body = buf.getvalue()
+        for addr in self.addresses:
+            req = urllib.request.Request(
+                f"http://{addr}/update_weights_from_tensors",
+                data=body,
+                headers={"Content-Type": "application/octet-stream"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=self.config.request_timeout) as r:
+                r.read()
+
+    def set_version(self, version: int) -> None:
+        self._version = version
+        try:
+            self._post_all("/set_version", {"version": version})
+        except Exception:  # noqa: BLE001 — servers may be mid-update
+            logger.warning("set_version fan-out failed", exc_info=True)
+
+    def get_version(self) -> int:
+        return self._version
+
+    def get_capacity(self) -> int:
+        return self.executor.staleness.get_capacity()
+
+    def export_stats(self) -> dict[str, float]:
+        return self.executor.export_stats()
+
+
+def jax_tree_to_host(params: dict) -> dict:
+    import jax
+
+    def host(x):
+        x = jax.device_get(x)
+        arr = np.asarray(x)
+        if arr.dtype.name == "bfloat16":
+            import jax.numpy as jnp
+
+            arr = np.asarray(jax.device_get(jnp.asarray(x).astype(jnp.float32)))
+        return arr
+
+    return jax.tree.map(host, params)

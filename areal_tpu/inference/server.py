@@ -1,0 +1,264 @@
+"""HTTP generation server wrapping DecodeEngine.
+
+Speaks the same small protocol the reference's client layer needs from
+SGLang/vLLM (SURVEY §7.1; reference engine/sglang_remote.py:34-436 builds
+these requests): /generate, /pause_generation, /continue_generation,
+/update_weights_from_disk, /update_weights_from_distributed (mem path),
+/health, /release_memory_occupation, /resume_memory_occupation. aiohttp
+replaces fastapi/uvicorn (not in the image).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import numpy as np
+from aiohttp import web
+
+from areal_tpu.api.config import ServerConfig
+from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+from areal_tpu.inference.decode_engine import DecodeEngine
+from areal_tpu.utils import logging as alog, network
+from areal_tpu.utils import name_resolve
+
+logger = alog.getLogger("inference_server")
+
+
+def _req_from_json(d: dict) -> ModelRequest:
+    g = d.get("sampling_params", {})
+    gconfig = GenerationHyperparameters(
+        max_new_tokens=g.get("max_new_tokens", 128),
+        greedy=bool(g.get("greedy", False)),
+        temperature=g.get("temperature", 1.0),
+        top_p=g.get("top_p", 1.0),
+        top_k=g.get("top_k", -1),
+        stop_token_ids=g.get("stop_token_ids", []),
+        max_tokens=g.get("max_tokens"),
+    )
+    return ModelRequest(
+        input_ids=d["input_ids"], gconfig=gconfig, rid=d.get("rid", ""), metadata=d.get("metadata", {})
+    )
+
+
+class InferenceServer:
+    """One HTTP endpoint over one DecodeEngine replica."""
+
+    def __init__(self, config: ServerConfig, engine: DecodeEngine | None = None):
+        self.config = config
+        self.engine = engine or DecodeEngine(config)
+        self._runner: web.AppRunner | None = None
+        self.port = config.port or network.find_free_port()
+        self.host = config.host
+
+    @property
+    def address(self) -> str:
+        ip = "127.0.0.1" if self.host in ("0.0.0.0", "") else self.host
+        return f"{ip}:{self.port}"
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=1 << 30)
+        app.add_routes(
+            [
+                web.get("/health", self.h_health),
+                web.get("/metrics", self.h_metrics),
+                web.post("/generate", self.h_generate),
+                web.post("/pause_generation", self.h_pause),
+                web.post("/continue_generation", self.h_continue),
+                web.post("/update_weights_from_disk", self.h_update_disk),
+                web.post("/update_weights_from_tensors", self.h_update_tensors),
+                web.post("/set_version", self.h_set_version),
+                web.post("/release_memory_occupation", self.h_noop),
+                web.post("/resume_memory_occupation", self.h_noop),
+                web.post("/abort_request", self.h_noop),
+            ]
+        )
+        return app
+
+    # -- handlers ---------------------------------------------------------
+    async def h_health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "ok", "version": self.engine.get_version()}
+        )
+
+    async def h_metrics(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {**self.engine.stats, "paused": self.engine.is_paused}
+        )
+
+    async def h_generate(self, request: web.Request) -> web.Response:
+        d = await request.json()
+        req = _req_from_json(d)
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def cb(resp):
+            loop.call_soon_threadsafe(
+                lambda: fut.done() or fut.set_result(resp)
+            )
+
+        self.engine.submit(req, cb)
+        resp = await fut
+        return web.json_response(
+            {
+                "output_tokens": resp.output_tokens,
+                "output_logprobs": resp.output_logprobs,
+                "output_versions": resp.output_versions,
+                "stop_reason": resp.stop_reason,
+                "latency": resp.latency,
+                "ttft": resp.ttft,
+                "rid": resp.rid,
+            }
+        )
+
+    async def h_pause(self, request: web.Request) -> web.Response:
+        self.engine.pause_generation()
+        return web.json_response({"status": "ok"})
+
+    async def h_continue(self, request: web.Request) -> web.Response:
+        self.engine.continue_generation()
+        return web.json_response({"status": "ok"})
+
+    async def h_update_disk(self, request: web.Request) -> web.Response:
+        d = await request.json()
+        path, version = d["path"], d.get("version")
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.update_weights_from_disk, path, version
+        )
+        return web.json_response({"status": "ok", "version": self.engine.get_version()})
+
+    async def h_update_tensors(self, request: web.Request) -> web.Response:
+        """mem-path weight update: raw npz body (name -> array)."""
+        body = await request.read()
+        import io
+
+        loaded = np.load(io.BytesIO(body), allow_pickle=False)
+        version = None
+        flat = {}
+        for k in loaded.files:
+            if k == "__version__":
+                version = int(loaded[k])
+            else:
+                flat[k] = loaded[k]
+        params = _unflatten(flat)
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.update_weights_from_params, params, version
+        )
+        return web.json_response({"status": "ok", "version": self.engine.get_version()})
+
+    async def h_set_version(self, request: web.Request) -> web.Response:
+        d = await request.json()
+        self.engine.set_version(int(d["version"]))
+        return web.json_response({"status": "ok"})
+
+    async def h_noop(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    # -- lifecycle --------------------------------------------------------
+    async def astart(self) -> None:
+        if self.engine.params is None:
+            self.engine.initialize()
+        self.engine.start()
+        self._runner = web.AppRunner(self.build_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        logger.info(f"inference server on {self.address}")
+
+    async def astop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+        self.engine.stop()
+
+    def run_forever(self) -> None:
+        loop = asyncio.new_event_loop()
+        loop.run_until_complete(self.astart())
+        try:
+            loop.run_forever()
+        finally:
+            loop.run_until_complete(self.astop())
+
+
+def _unflatten(flat: dict) -> dict:
+    out: dict = {}
+    for k, v in flat.items():
+        parts = k.split("/")
+        d = out
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return out
+
+
+def flatten_params(params: dict, prefix="") -> dict:
+    flat = {}
+    for k, v in params.items():
+        key = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            flat.update(flatten_params(v, key))
+        else:
+            flat[key] = np.asarray(v)
+    return flat
+
+
+class ServerThread:
+    """In-process server for tests and single-host colocated runs."""
+
+    def __init__(self, config: ServerConfig, engine: DecodeEngine | None = None):
+        self.server = InferenceServer(config, engine)
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    @property
+    def engine(self) -> DecodeEngine:
+        return self.server.engine
+
+    def start(self) -> None:
+        started = threading.Event()
+
+        def run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            self._loop.run_until_complete(self.server.astart())
+            started.set()
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        if not started.wait(300):
+            raise TimeoutError("inference server failed to start")
+
+    def stop(self) -> None:
+        if self._loop:
+            asyncio.run_coroutine_threadsafe(self.server.astop(), self._loop).result(30)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread:
+            self._thread.join(timeout=30)
+
+
+def main(argv=None) -> None:
+    """CLI: python -m areal_tpu.inference.server --config x.yaml key=val ...
+
+    Registers its address in name_resolve like the reference's server
+    wrappers (infra/launcher/sglang_server.py:86-253)."""
+    import argparse
+
+    from areal_tpu.api.config import load_expr_config
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--name", default="", help="name_resolve key to register")
+    args, rest = p.parse_known_args(argv)
+    cfg, _ = load_expr_config(rest, ServerConfig)
+    server = InferenceServer(cfg)
+    if args.name:
+        name_resolve.add(args.name, server.address, keepalive_ttl=None)
+    server.run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from areal_tpu.infra.staleness_manager import StalenessManager  # noqa: F401
+from areal_tpu.infra.async_task_runner import AsyncTaskRunner  # noqa: F401
+from areal_tpu.infra.workflow_executor import WorkflowExecutor  # noqa: F401

@@ -1,0 +1,76 @@
+"""Staleness-bounded rollout admission control.
+
+Behavioral parity with reference areal/infra/staleness_manager.py:18-162: the
+capacity formula (:97-111) bounds how many rollouts may run concurrently so
+no accepted trajectory is more than ``max_staleness`` versions behind the
+policy that will train on it:
+
+    capacity = min(max_concurrent - running,
+                   (max_staleness + version + 1) * consumer_bs
+                     - (accepted + running))
+
+``version`` comes from a VersionProvider protocol (the inference engine).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Protocol
+
+from areal_tpu.api.io_struct import RolloutStat
+
+
+class VersionProvider(Protocol):
+    def get_version(self) -> int: ...
+
+
+class StalenessManager:
+    def __init__(
+        self,
+        version_provider: VersionProvider,
+        max_concurrent_rollouts: int,
+        consumer_batch_size: int,
+        max_staleness: int = 0,
+    ):
+        self._vp = version_provider
+        self.max_concurrent_rollouts = max_concurrent_rollouts
+        self.consumer_batch_size = consumer_batch_size
+        self.max_staleness = max_staleness
+        self._lock = threading.Lock()
+        self.stat = RolloutStat()
+
+    def get_capacity(self) -> int:
+        with self._lock:
+            version = self._vp.get_version()
+            concurrency_cap = self.max_concurrent_rollouts - self.stat.running
+            staleness_cap = (
+                (self.max_staleness + version + 1) * self.consumer_batch_size
+                - self.stat.accepted
+                - self.stat.running
+            )
+            return min(concurrency_cap, staleness_cap)
+
+    # -- accounting (called by the dispatcher) ----------------------------
+    def on_submit(self, n: int = 1) -> None:
+        with self._lock:
+            self.stat.submitted += n
+            self.stat.running += n
+
+    def on_accept(self, n: int = 1) -> None:
+        with self._lock:
+            self.stat.running -= n
+            self.stat.accepted += n
+
+    def on_reject(self, n: int = 1) -> None:
+        with self._lock:
+            self.stat.running -= n
+            self.stat.rejected += n
+
+    def export_stats(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "submitted": self.stat.submitted,
+                "running": self.stat.running,
+                "accepted": self.stat.accepted,
+                "rejected": self.stat.rejected,
+            }

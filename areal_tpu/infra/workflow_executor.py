@@ -1,0 +1,261 @@
+"""Rollout dispatcher: staleness-gated task submission + result collection.
+
+Behavioral parity with the reference's BatchTaskDispatcher + WorkflowExecutor
+(areal/infra/workflow_executor.py:253-721, 735-1356), re-threaded for this
+codebase: one background dispatcher thread moves queued inputs into the
+AsyncTaskRunner whenever the StalenessManager grants capacity, and drains
+completed trajectories through format validation + accept/reject accounting
+into a results buffer. ``prepare_batch`` keeps the pipeline full from an
+infinite dataloader cycle (reference :1290-1313) — the core of async RL.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import uuid
+from typing import Any, Callable
+
+import numpy as np
+
+from areal_tpu.api.config import InferenceEngineConfig
+from areal_tpu.api.workflow_api import RolloutWorkflow, resolve_workflow
+from areal_tpu.infra.async_task_runner import AsyncTaskRunner
+from areal_tpu.infra.staleness_manager import StalenessManager
+from areal_tpu.utils import logging as alog
+from areal_tpu.utils.data import TensorDict, concat_padded_tensor_dicts, cycle_dataloader
+from areal_tpu.utils import stats_tracker
+
+logger = alog.getLogger("workflow_executor")
+
+
+def check_trajectory_format(traj: TensorDict) -> None:
+    """Guard user workflow output (reference workflow_executor.py:42-221)."""
+    if not isinstance(traj, dict) or not traj:
+        raise ValueError(f"trajectory must be a non-empty dict, got {type(traj)}")
+    if "input_ids" not in traj or "attention_mask" not in traj:
+        raise ValueError(
+            f"trajectory must contain input_ids and attention_mask, got {list(traj)}"
+        )
+    B, L = np.asarray(traj["attention_mask"]).shape
+    for k, v in traj.items():
+        v = np.asarray(v)
+        if v.ndim == 0:
+            raise ValueError(f"trajectory values must be batched arrays; {k} is scalar")
+        if v.shape[0] != B:
+            raise ValueError(f"{k} batch dim {v.shape[0]} != {B}")
+
+
+class _TaskRecord:
+    __slots__ = ("task_id", "data", "result", "accepted")
+
+    def __init__(self, task_id: str, data: Any):
+        self.task_id = task_id
+        self.data = data
+        self.result: TensorDict | None = None
+        self.accepted: bool | None = None
+
+
+class WorkflowExecutor:
+    """Client-side rollout pipeline bound to one InferenceEngine."""
+
+    def __init__(
+        self,
+        config: InferenceEngineConfig,
+        engine,  # InferenceEngine (provides agenerate + get_version)
+    ):
+        self.config = config
+        self.engine = engine
+        max_conc = config.max_concurrent_rollouts or config.consumer_batch_size
+        self.staleness = StalenessManager(
+            version_provider=engine,
+            max_concurrent_rollouts=max_conc,
+            consumer_batch_size=config.consumer_batch_size,
+            max_staleness=config.max_head_offpolicyness,
+        )
+        self.runner = AsyncTaskRunner(max_concurrency=max_conc)
+        self._input: queue.Queue[tuple[_TaskRecord, RolloutWorkflow, Callable | None]] = (
+            queue.Queue()
+        )
+        self._results: list[TensorDict] = []
+        self._done_tasks: dict[str, _TaskRecord] = {}
+        self._cv = threading.Condition()
+        self._paused = threading.Event()
+        self._shutdown = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._thread_exc: BaseException | None = None
+        self._data_gen = None  # cached cycle_dataloader for prepare_batch
+
+    # -- lifecycle --------------------------------------------------------
+    def initialize(self) -> None:
+        self.runner.start()
+        self._thread = threading.Thread(target=self._dispatch_loop, daemon=True)
+        self._thread.start()
+
+    def destroy(self) -> None:
+        self._shutdown.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        self.runner.stop()
+
+    # -- pause/resume (submission side; reference engine pause semantics) --
+    def pause(self) -> None:
+        self._paused.set()
+
+    def resume(self) -> None:
+        self._paused.clear()
+
+    # -- dispatch loop ----------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        try:
+            while not self._shutdown.is_set():
+                progressed = False
+                # move queued inputs into the runner while capacity allows
+                while not self._paused.is_set():
+                    if self.staleness.get_capacity() <= 0:
+                        break
+                    try:
+                        rec, workflow, accept_fn = self._input.get_nowait()
+                    except queue.Empty:
+                        break
+                    self.staleness.on_submit()
+                    self._launch(rec, workflow, accept_fn)
+                    progressed = True
+                # drain completed tasks
+                res = self.runner.poll_result(timeout=0.02)
+                while res is not None:
+                    progressed = True
+                    self._on_result(res.task_id, res.data)
+                    res = self.runner.poll_result()
+                if not progressed:
+                    time.sleep(0.005)
+        except BaseException as e:  # noqa: BLE001 — fail fast to callers
+            logger.exception("dispatcher thread failed")
+            self._thread_exc = e
+            with self._cv:
+                self._cv.notify_all()
+
+    def _launch(self, rec: _TaskRecord, workflow: RolloutWorkflow, accept_fn) -> None:
+        async def run():
+            traj = await workflow.arun_episode(self.engine, rec.data)
+            return (traj, accept_fn)
+
+        self.runner.submit(run, task_id=rec.task_id)
+
+    def _on_result(self, task_id: str, payload) -> None:
+        traj, accept_fn = payload
+        rec = self._done_tasks.get(task_id)
+        if isinstance(traj, list):  # grouped per-sequence dicts -> padded batch
+            from areal_tpu.utils.data import pad_sequences_to_tensors
+
+            traj = pad_sequences_to_tensors(traj) if traj else None
+        accepted = traj is not None
+        if accepted and self.config.check_trajectory_format:
+            check_trajectory_format(traj)
+        if accepted and accept_fn is not None:
+            accepted = bool(accept_fn(traj))
+        if accepted:
+            self.staleness.on_accept()
+            stats_tracker.get().scalar(rollout_accepted=1.0)
+        else:
+            self.staleness.on_reject()
+            stats_tracker.get().scalar(rollout_rejected=1.0)
+        with self._cv:
+            if rec is not None:
+                rec.result = traj if accepted else None
+                rec.accepted = accepted
+            if accepted:
+                self._results.append(traj)
+            self._cv.notify_all()
+
+    def _check_health(self) -> None:
+        if self._thread_exc is not None:
+            raise RuntimeError("rollout dispatcher failed") from self._thread_exc
+
+    # -- public API (InferenceEngine rollout surface) ---------------------
+    def submit(
+        self,
+        data: dict,
+        workflow: Any = None,
+        should_accept_fn: Callable | None = None,
+    ) -> str:
+        workflow = resolve_workflow(workflow)
+        rec = _TaskRecord(uuid.uuid4().hex, data)
+        self._done_tasks[rec.task_id] = rec
+        self._input.put((rec, workflow, should_accept_fn))
+        return rec.task_id
+
+    def wait(self, count: int, timeout: float | None = None) -> TensorDict:
+        """Block until ``count`` accepted trajectories, then pop and merge."""
+        deadline = time.monotonic() + (timeout or self.config.request_timeout)
+        with self._cv:
+            while len(self._results) < count:
+                self._check_health()
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"waited for {count} trajectories, got {len(self._results)}"
+                    )
+                self._cv.wait(timeout=min(remaining, 0.2))
+            out, self._results = (
+                self._results[:count],
+                self._results[count:],
+            )
+        return concat_padded_tensor_dicts(out)
+
+    def wait_for_task(self, task_id: str, timeout: float | None = None):
+        deadline = time.monotonic() + (timeout or self.config.request_timeout)
+        rec = self._done_tasks[task_id]
+        with self._cv:
+            while rec.accepted is None:
+                self._check_health()
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"task {task_id} not done")
+                self._cv.wait(timeout=min(remaining, 0.2))
+        self._done_tasks.pop(task_id, None)
+        if rec.result is not None:
+            with self._cv:
+                try:
+                    self._results.remove(rec.result)
+                except ValueError:
+                    pass
+        return rec.result
+
+    def rollout_batch(
+        self, data: list[dict], workflow=None, should_accept_fn=None
+    ) -> TensorDict:
+        for d in data:
+            self.submit(d, workflow, should_accept_fn)
+        return self.wait(len(data))
+
+    def prepare_batch(
+        self, dataloader, workflow=None, should_accept_fn=None
+    ) -> TensorDict:
+        """Async-RL batch source: keep the submission pipeline full (bounded
+        by staleness capacity) and return once consumer_batch_size
+        trajectories are ready (reference workflow_executor.py:1256-1313)."""
+        if self._data_gen is None:
+            self._data_gen = cycle_dataloader(dataloader)
+        bs = self.config.consumer_batch_size
+        workflow = resolve_workflow(workflow)
+        while True:
+            self._check_health()
+            # top up submissions while there is capacity and queue space
+            while (
+                self.staleness.get_capacity() > 0
+                and self._input.qsize() == 0
+                and not self._paused.is_set()
+            ):
+                item = next(self._data_gen)
+                for d in item if isinstance(item, list) else [item]:
+                    self.submit(d, workflow, should_accept_fn)
+            with self._cv:
+                if len(self._results) >= bs:
+                    out, self._results = self._results[:bs], self._results[bs:]
+                    return concat_padded_tensor_dicts(out)
+            time.sleep(0.01)
+
+    def export_stats(self) -> dict[str, float]:
+        return {f"rollout/{k}": float(v) for k, v in self.staleness.export_stats().items()}

@@ -1,0 +1,1 @@
+from areal_tpu.reward.gsm8k import gsm8k_reward_fn  # noqa: F401

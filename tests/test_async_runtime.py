@@ -1,0 +1,261 @@
+"""Staleness manager / async runner / workflow executor unit tests
+(parity: reference tests/test_staleness_manager.py, test_async_task_runner.py)."""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import InferenceEngineConfig
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.infra.async_task_runner import AsyncTaskRunner, TaskFailed
+from areal_tpu.infra.staleness_manager import StalenessManager
+from areal_tpu.infra.workflow_executor import WorkflowExecutor, check_trajectory_format
+
+
+class MockVersionProvider:
+    def __init__(self, v=0):
+        self.v = v
+
+    def get_version(self):
+        return self.v
+
+
+class TestStalenessManager:
+    def test_capacity_formula(self):
+        vp = MockVersionProvider(0)
+        m = StalenessManager(vp, max_concurrent_rollouts=8, consumer_batch_size=4, max_staleness=0)
+        # version 0, nothing running: min(8, (0+0+1)*4 - 0) = 4
+        assert m.get_capacity() == 4
+        m.on_submit(4)
+        assert m.get_capacity() == 0
+        m.on_accept(4)
+        # accepted 4 fills the version-0 budget
+        assert m.get_capacity() == 0
+        vp.v = 1
+        assert m.get_capacity() == 4
+
+    def test_staleness_window(self):
+        vp = MockVersionProvider(0)
+        m = StalenessManager(vp, max_concurrent_rollouts=100, consumer_batch_size=2, max_staleness=3)
+        assert m.get_capacity() == (3 + 0 + 1) * 2
+        m.on_submit(5)
+        assert m.get_capacity() == 8 - 5
+
+    def test_concurrency_cap(self):
+        m = StalenessManager(MockVersionProvider(10), 3, 1, max_staleness=0)
+        assert m.get_capacity() == 3
+
+    def test_reject_returns_capacity(self):
+        vp = MockVersionProvider(0)
+        m = StalenessManager(vp, 8, 4, 0)
+        m.on_submit(4)
+        m.on_reject(4)
+        assert m.get_capacity() == 4
+        assert m.export_stats()["rejected"] == 4
+
+
+class TestAsyncTaskRunner:
+    def test_submit_and_poll(self):
+        r = AsyncTaskRunner()
+        r.start()
+        try:
+            async def work():
+                await asyncio.sleep(0.01)
+                return 42
+
+            tid = r.submit(work)
+            deadline = time.monotonic() + 5
+            res = None
+            while res is None and time.monotonic() < deadline:
+                res = r.poll_result(timeout=0.1)
+            assert res is not None and res.data == 42 and res.task_id == tid
+        finally:
+            r.stop()
+
+    def test_failure_propagates(self):
+        r = AsyncTaskRunner()
+        r.start()
+        try:
+            async def boom():
+                raise ValueError("nope")
+
+            r.submit(boom)
+            with pytest.raises(TaskFailed):
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline:
+                    if r.poll_result(timeout=0.1) is not None:
+                        break
+        finally:
+            r.stop()
+
+    def test_pause_blocks_new_tasks(self):
+        r = AsyncTaskRunner()
+        r.start()
+        try:
+            r.pause()
+            hits = []
+
+            async def work():
+                hits.append(1)
+                return 1
+
+            r.submit(work)
+            time.sleep(0.2)
+            assert not hits
+            r.resume()
+            deadline = time.monotonic() + 5
+            while not hits and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert hits
+        finally:
+            r.stop()
+
+
+def test_check_trajectory_format():
+    ok = {
+        "input_ids": np.zeros((2, 5), np.int32),
+        "attention_mask": np.ones((2, 5), bool),
+    }
+    check_trajectory_format(ok)
+    with pytest.raises(ValueError):
+        check_trajectory_format({})
+    with pytest.raises(ValueError):
+        check_trajectory_format({"input_ids": np.zeros((2, 5))})
+    with pytest.raises(ValueError):
+        check_trajectory_format(
+            {"input_ids": np.zeros((2, 5)), "attention_mask": np.ones((3, 5))}
+        )
+
+
+class FakeGenEngine:
+    """InferenceEngine stub: echoes a few tokens after a tiny delay."""
+
+    def __init__(self):
+        self.version = 0
+        self.calls = 0
+
+    def get_version(self):
+        return self.version
+
+    async def agenerate(self, req):
+        from areal_tpu.api.io_struct import ModelResponse
+
+        self.calls += 1
+        await asyncio.sleep(0.01)
+        return ModelResponse(
+            input_tokens=list(req.input_ids),
+            output_tokens=[1, 2, 3],
+            output_logprobs=[-0.1] * 3,
+            output_versions=[self.version] * 3,
+            stop_reason="stop",
+            rid=req.rid,
+        )
+
+
+class EchoWorkflow(RolloutWorkflow):
+    async def arun_episode(self, engine, data):
+        from areal_tpu.api.io_struct import ModelRequest
+
+        resp = await engine.agenerate(ModelRequest(input_ids=data["prompt_ids"]))
+        n = len(resp.input_tokens) + len(resp.output_tokens)
+        return [
+            {
+                "input_ids": np.asarray(resp.input_tokens + resp.output_tokens, np.int32),
+                "loss_mask": np.asarray(
+                    [0.0] * len(resp.input_tokens) + [1.0] * len(resp.output_tokens),
+                    np.float32,
+                ),
+                "rewards": np.float32(1.0),
+            }
+        ]
+
+
+class TestWorkflowExecutor:
+    def _make(self, max_conc=4, bs=2, staleness=100):
+        cfg = InferenceEngineConfig(
+            max_concurrent_rollouts=max_conc,
+            consumer_batch_size=bs,
+            max_head_offpolicyness=staleness,
+        )
+        eng = FakeGenEngine()
+        ex = WorkflowExecutor(cfg, eng)
+        ex.initialize()
+        return ex, eng
+
+    def test_rollout_batch(self):
+        ex, eng = self._make()
+        try:
+            batch = ex.rollout_batch(
+                [{"prompt_ids": [5, 6]} for _ in range(4)], workflow=EchoWorkflow()
+            )
+            assert batch["input_ids"].shape[0] == 4
+            assert batch["attention_mask"].sum() == 4 * 5
+        finally:
+            ex.destroy()
+
+    def test_submit_wait_for_task(self):
+        ex, _ = self._make()
+        try:
+            tid = ex.submit({"prompt_ids": [1]}, workflow=EchoWorkflow())
+            traj = ex.wait_for_task(tid, timeout=10)
+            assert traj is not None and traj["input_ids"].shape[0] == 1
+        finally:
+            ex.destroy()
+
+    def test_should_accept_fn(self):
+        ex, _ = self._make()
+        try:
+            for i in range(4):
+                ex.submit(
+                    {"prompt_ids": [i]},
+                    workflow=EchoWorkflow(),
+                    should_accept_fn=lambda t: False,
+                )
+            time.sleep(1.0)
+            assert ex.staleness.export_stats()["rejected"] == 4
+            with pytest.raises(TimeoutError):
+                ex.wait(1, timeout=0.5)
+        finally:
+            ex.destroy()
+
+    def test_staleness_gates_submission(self):
+        """With staleness 0 and version pinned at 0, only consumer_batch_size
+        rollouts may be admitted."""
+        ex, eng = self._make(max_conc=100, bs=2, staleness=0)
+        try:
+            for i in range(10):
+                ex.submit({"prompt_ids": [i]}, workflow=EchoWorkflow())
+            time.sleep(1.0)
+            st = ex.staleness.export_stats()
+            assert st["accepted"] == 2, st
+            eng.version = 1
+            time.sleep(1.0)
+            st = ex.staleness.export_stats()
+            assert st["accepted"] == 4, st
+        finally:
+            ex.destroy()
+
+    def test_prepare_batch_cycles_dataloader(self):
+        ex, eng = self._make(max_conc=4, bs=4, staleness=100)
+        try:
+            loader = [{"prompt_ids": [i]} for i in range(2)]  # shorter than bs
+            batch = ex.prepare_batch(loader, workflow=EchoWorkflow())
+            assert batch["input_ids"].shape[0] == 4
+        finally:
+            ex.destroy()
+
+    def test_pause_resume(self):
+        ex, eng = self._make()
+        try:
+            ex.pause()
+            ex.submit({"prompt_ids": [1]}, workflow=EchoWorkflow())
+            time.sleep(0.5)
+            assert eng.calls == 0
+            ex.resume()
+            ex.wait(1, timeout=10)
+            assert eng.calls == 1
+        finally:
+            ex.destroy()
