@@ -75,6 +75,7 @@ class TrainEngineConfig:
     mb_spec: MicroBatchSpec = field(default_factory=MicroBatchSpec)
     pad_to_maximum: bool = False
     bucket_step: int = 512  # token-count bucketing to bound XLA recompiles
+    logprob_chunk_size: int = 1024  # vocab-logit chunking (memory ceiling)
     optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     lora_rank: int = 0

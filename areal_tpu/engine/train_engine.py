@@ -298,6 +298,7 @@ class JaxTrainEngine(TrainEngine):
                 mcfg,
                 hidden,
                 batch["labels"],
+                chunk_size=self.config.logprob_chunk_size,
                 temperature=getattr(self.config, "temperature", 1.0),
             )
             outputs["logprobs"] = logp
@@ -341,6 +342,28 @@ class JaxTrainEngine(TrainEngine):
             )
         return self._fn_cache[key]
 
+    def _get_fused_step_fn(self, loss_fn: Callable, shape: tuple):
+        """Single-microbatch fast path: grad + optimizer apply in ONE jit with
+        donated params/opt_state — XLA frees each grad buffer as soon as its
+        param update consumes it, cutting peak HBM vs the accumulate path."""
+        key = ("fused", shape, id(loss_fn))
+        if key not in self._fn_cache:
+
+            def step(params, opt_state, batch, scale):
+                def lf(p):
+                    outputs = self._outputs_fn(p, batch)
+                    loss, stats = loss_fn(outputs, batch)
+                    return loss * scale, stats
+
+                (loss, stats), grads = jax.value_and_grad(lf, has_aux=True)(params)
+                gnorm = optax.global_norm(grads)
+                updates, opt_state = self._tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, gnorm, loss, stats
+
+            self._fn_cache[key] = jax.jit(step, donate_argnums=(0, 1))
+        return self._fn_cache[key]
+
     def _get_apply_fn(self):
         key = ("apply",)
         if key not in self._fn_cache:
@@ -370,6 +393,20 @@ class JaxTrainEngine(TrainEngine):
         grads = None
         agg: dict[str, float] = {}
         accum = self._get_accum_fn()
+        if len(grids) == 1:
+            with jax.set_mesh(self.mesh):
+                batch = self._grid_to_device(grids[0])
+                step_before = self._opt_step_count()
+                fn = self._get_fused_step_fn(loss_fn, batch["segment_ids"].shape)
+                self.params, self.opt_state, gnorm, loss, stats = fn(
+                    self.params, self.opt_state, batch, jnp.float32(weights[0] / total_w)
+                )
+            agg = {k: float(v) for k, v in {**stats, "loss": loss}.items()}
+            agg["grad_norm"] = float(gnorm)
+            agg["lr"] = float(self._lr_schedule(step_before))
+            agg["n_microbatches"] = 1.0
+            agg["train_batch_secs"] = time.monotonic() - t0
+            return agg
         with jax.set_mesh(self.mesh):
             for g, w in zip(grids, weights):
                 batch = self._grid_to_device(g)

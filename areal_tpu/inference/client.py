@@ -29,6 +29,22 @@ from areal_tpu.utils.data import TensorDict
 
 logger = alog.getLogger("remote_inf")
 
+# one ClientSession per event loop (connection pooling; reference
+# workflow_context.py:60-233 get_aiohttp_session)
+_SESSIONS: dict[int, aiohttp.ClientSession] = {}
+
+
+def _get_session(timeout_s: float) -> aiohttp.ClientSession:
+    loop = asyncio.get_running_loop()
+    sess = _SESSIONS.get(id(loop))
+    if sess is None or sess.closed:
+        sess = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=timeout_s),
+            connector=aiohttp.TCPConnector(limit=512, ttl_dns_cache=300),
+        )
+        _SESSIONS[id(loop)] = sess
+    return sess
+
 
 class RemoteJaxEngine(InferenceEngine):
     """Client handle to a fleet of areal_tpu.inference.server instances."""
@@ -164,22 +180,20 @@ class RemoteJaxEngine(InferenceEngine):
         last_exc = None
         for attempt in range(self.config.request_retries):
             try:
-                timeout = aiohttp.ClientTimeout(total=self.config.request_timeout)
-                async with aiohttp.ClientSession(timeout=timeout) as sess:
-                    async with sess.post(f"http://{addr}{path}", json=payload) as r:
-                        r.raise_for_status()
-                        return await r.json()
+                sess = _get_session(self.config.request_timeout)
+                async with sess.post(f"http://{addr}{path}", json=payload) as r:
+                    r.raise_for_status()
+                    return await r.json()
             except Exception as e:  # noqa: BLE001
                 last_exc = e
                 await asyncio.sleep(0.2 * 2**attempt)
         raise RuntimeError(f"POST {addr}{path} failed after retries") from last_exc
 
     async def _get_json(self, addr: str, path: str) -> dict:
-        timeout = aiohttp.ClientTimeout(total=30)
-        async with aiohttp.ClientSession(timeout=timeout) as sess:
-            async with sess.get(f"http://{addr}{path}") as r:
-                r.raise_for_status()
-                return await r.json()
+        sess = _get_session(self.config.request_timeout)
+        async with sess.get(f"http://{addr}{path}") as r:
+            r.raise_for_status()
+            return await r.json()
 
     def _post_all(self, path: str, payload: dict) -> list[dict]:
         """Synchronous fan-out to every server (weight updates, pause)."""
@@ -257,11 +271,14 @@ class RemoteJaxEngine(InferenceEngine):
 
         from areal_tpu.inference.server import flatten_params
 
+        import concurrent.futures
+
         flat = flatten_params(jax_tree_to_host(params))
         buf = io.BytesIO()
         np.savez(buf, __version__=np.int64(version), **flat)
         body = buf.getvalue()
-        for addr in self.addresses:
+
+        def push(addr):
             req = urllib.request.Request(
                 f"http://{addr}/update_weights_from_tensors",
                 data=body,
@@ -270,6 +287,10 @@ class RemoteJaxEngine(InferenceEngine):
             )
             with urllib.request.urlopen(req, timeout=self.config.request_timeout) as r:
                 r.read()
+
+        # fan out: the pause window must not scale with fleet size
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+            list(pool.map(push, self.addresses))
 
     def set_version(self, version: int) -> None:
         self._version = version

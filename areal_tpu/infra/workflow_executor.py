@@ -15,6 +15,7 @@ import queue
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Any, Callable
 
 import numpy as np
@@ -78,8 +79,11 @@ class WorkflowExecutor:
         self._input: queue.Queue[tuple[_TaskRecord, RolloutWorkflow, Callable | None]] = (
             queue.Queue()
         )
-        self._results: list[TensorDict] = []
+        self._results: list[tuple[str, TensorDict]] = []  # (task_id, traj)
         self._done_tasks: dict[str, _TaskRecord] = {}
+        # rejected tasks nobody awaits leave tombstones; bound their count
+        self._reject_order: deque[str] = deque()
+        self._max_reject_records = 65536
         self._cv = threading.Condition()
         self._paused = threading.Event()
         self._shutdown = threading.Event()
@@ -165,8 +169,13 @@ class WorkflowExecutor:
             if rec is not None:
                 rec.result = traj if accepted else None
                 rec.accepted = accepted
+                rec.data = None  # release the input payload
             if accepted:
-                self._results.append(traj)
+                self._results.append((task_id, traj))
+            elif rec is not None:
+                self._reject_order.append(task_id)
+                while len(self._reject_order) > self._max_reject_records:
+                    self._done_tasks.pop(self._reject_order.popleft(), None)
             self._cv.notify_all()
 
     def _check_health(self) -> None:
@@ -202,7 +211,9 @@ class WorkflowExecutor:
                 self._results[:count],
                 self._results[count:],
             )
-        return concat_padded_tensor_dicts(out)
+            for tid, _ in out:
+                self._done_tasks.pop(tid, None)
+        return concat_padded_tensor_dicts([t for _, t in out])
 
     def wait_for_task(self, task_id: str, timeout: float | None = None):
         deadline = time.monotonic() + (timeout or self.config.request_timeout)
@@ -214,13 +225,11 @@ class WorkflowExecutor:
                 if remaining <= 0:
                     raise TimeoutError(f"task {task_id} not done")
                 self._cv.wait(timeout=min(remaining, 0.2))
-        self._done_tasks.pop(task_id, None)
-        if rec.result is not None:
-            with self._cv:
-                try:
-                    self._results.remove(rec.result)
-                except ValueError:
-                    pass
+        with self._cv:
+            self._done_tasks.pop(task_id, None)
+            # drop this task's trajectory from the shared results buffer so it
+            # is not consumed a second time by wait()/prepare_batch
+            self._results = [(tid, t) for tid, t in self._results if tid != task_id]
         return rec.result
 
     def rollout_batch(
@@ -254,7 +263,9 @@ class WorkflowExecutor:
             with self._cv:
                 if len(self._results) >= bs:
                     out, self._results = self._results[:bs], self._results[bs:]
-                    return concat_padded_tensor_dicts(out)
+                    for tid, _ in out:
+                        self._done_tasks.pop(tid, None)
+                    return concat_padded_tensor_dicts([t for _, t in out])
             time.sleep(0.01)
 
     def export_stats(self) -> dict[str, float]:
