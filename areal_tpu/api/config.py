@@ -114,6 +114,7 @@ class PPOActorConfig(TrainEngineConfig):
     overlong_tokens: int = 0
     overlong_penalty_factor: float = 0.0
     mask_too_long_tokens: bool = False
+    mask_no_eos_with_zero: bool = False  # zero task reward for truncated seqs
     # decoupled PPO / staleness correction
     recompute_logprob: bool = True
     use_decoupled_loss: bool = True

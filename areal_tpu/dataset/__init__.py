@@ -55,9 +55,13 @@ def _synthetic_arith(split: str = "train", n: int = 512, seed: int = 0, **kwargs
     rows = []
     for _ in range(n):
         a, b = int(rng.integers(0, 50)), int(rng.integers(0, 50))
+        prompt = f"Compute: {a}+{b}= "
         rows.append(
             {
-                "prompt": f"Compute: {a}+{b}= ",
+                "prompt": prompt,
+                # tokenizer-free char-level ids so the zero-asset smoke path
+                # (from-scratch model, no HF tokenizer) can run end-to-end
+                "prompt_ids": [ord(c) % 256 for c in prompt],
                 "answer": f"#### {a+b}",
             }
         )

@@ -57,6 +57,7 @@ _GRID_KEYS = (
     "advantages",
     "old_logprobs",
     "prox_logprobs",
+    "prox_alpha",
     "ref_logprobs",
     "logprobs",
     "versions",
@@ -65,6 +66,9 @@ _GRID_KEYS = (
     "old_values",
     "labels",
     "label_valid",
+    "rw_pair_id",
+    "rw_sign",
+    "rw_last_mask",
 )
 
 
@@ -94,9 +98,11 @@ class JaxTrainEngine(TrainEngine):
         config: TrainEngineConfig,
         value_head: bool = False,
         model_config: qwen.ModelConfig | None = None,
+        need_optimizer: bool = True,
     ):
         self.config = config
         self.value_head = value_head
+        self.need_optimizer = need_optimizer  # False for frozen ref models
         self._model_config = model_config
         self._version = 0
         self._version_lock = threading.Lock()
@@ -164,6 +170,8 @@ class JaxTrainEngine(TrainEngine):
                 self.param_shardings["value_head"],
             )
 
+        if not self.need_optimizer:
+            return
         total_steps = ft_spec.total_train_steps if ft_spec else 10_000
         ocfg = cfg.optimizer
         self._lr_schedule = make_lr_schedule(ocfg, total_steps)

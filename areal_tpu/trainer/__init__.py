@@ -1,0 +1,5 @@
+from areal_tpu.trainer.ppo import PPOActor, PPOCritic, grpo_loss_fn
+from areal_tpu.trainer.rl_trainer import PPOTrainer
+from areal_tpu.trainer.sft_trainer import SFTTrainer
+
+__all__ = ["PPOActor", "PPOCritic", "grpo_loss_fn", "PPOTrainer", "SFTTrainer"]

@@ -1,0 +1,392 @@
+"""PPO-family algorithm layer: advantages, loss dispatch, update loop.
+
+Behavioral parity with reference areal/trainer/ppo/actor.py (PPOActor:35-345,
+grpo_loss_fn:357-520, prox approximation:520-683) and critic.py, re-plumbed
+for this framework's alignment convention:
+
+- Host-side data is **token-aligned** ([b, t] refers to token t; rollout
+  logprobs, forward_batch outputs, values). ``compute_advantages`` converts
+  per-token training keys to **label alignment** via roll(-1) exactly like
+  the reference (actor.py:165-168, 236), because the train engine's model
+  outputs logprobs/entropy at label positions.
+- The proximal-logp log-linear approximation (docs/en/algorithms/prox_approx
+  .md) is reformulated: the interpolation factor alpha depends only on
+  per-token versions + the (host-known) current version, so it is computed
+  host-side into a ``prox_alpha`` array — the in-jit loss then computes
+  ``prox = old + alpha·(logp_theta − old)`` with no per-version recompiles.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.config import MicroBatchSpec, PPOActorConfig, PPOCriticConfig
+from areal_tpu.api.engine_api import TrainEngine
+from areal_tpu.ops import functional as F
+from areal_tpu.utils import logging as alog, stats_tracker
+from areal_tpu.utils.data import (
+    Normalization,
+    TensorDict,
+    roll_to_label_alignment as _roll_back,
+    split_padded_tensor_dict_into_mb_list,
+)
+
+logger = alog.getLogger("ppo")
+
+
+def grpo_loss_fn(outputs: dict, b: dict, cfg: PPOActorConfig):
+    """Packed-grid policy loss (jit-side). ``outputs`` has label-aligned
+    logprobs/entropy; ``b`` carries label-aligned per-token data prepared by
+    compute_advantages. Mirrors reference grpo_loss_fn dispatch (actor.py
+    :357-520): M2PO mask -> SAPO or PPO-clip/decoupled -> scalar stats."""
+    logprobs = outputs["logprobs"]
+    entropy = jax.lax.stop_gradient(outputs["entropy"])
+    lm = (b["loss_mask"] > 0) & b["label_valid"]
+    old_logp = b["old_logprobs"]
+
+    # resolve proximal logprobs
+    if "prox_logprobs" in b:
+        prox_logp = b["prox_logprobs"]
+    elif "prox_alpha" in b:  # loglinear approximation, no extra fwd pass
+        prox_logp = old_logp + b["prox_alpha"] * (
+            jax.lax.stop_gradient(logprobs) - old_logp
+        )
+    else:
+        prox_logp = old_logp
+
+    if cfg.use_m2po_loss:
+        lm = F.m2po_loss_mask(old_logp, prox_logp, lm, cfg.m2po_tau)
+
+    if cfg.use_sapo_loss:
+        loss, stat = F.sapo_loss_fn(
+            logprobs=logprobs,
+            old_logprobs=old_logp,
+            advantages=b["advantages"],
+            loss_mask=lm,
+            tau_pos=cfg.sapo_tau_pos,
+            tau_neg=cfg.sapo_tau_neg,
+            importance_sampling_level=cfg.imp_ratio_level,
+        )
+    else:
+        loss, stat = F.ppo_actor_loss_fn(
+            logprobs=logprobs,
+            proximal_logprobs=prox_logp,
+            old_logprobs=old_logp,
+            advantages=b["advantages"],
+            loss_mask=lm,
+            eps_clip=cfg.eps_clip,
+            eps_clip_higher=cfg.eps_clip_higher,
+            c_clip=cfg.c_clip,
+            behave_imp_weight_cap=cfg.behav_imp_weight_cap,
+            importance_sampling_level=cfg.imp_ratio_level,
+            behave_imp_weight_mode=(
+                cfg.behave_imp_weight_mode if cfg.use_decoupled_loss else "disabled"
+            ),
+        )
+
+    if cfg.entropy_coeff:
+        ent_for_loss = outputs["entropy"]
+        lmf = lm.astype(jnp.float32)
+        loss = loss - cfg.entropy_coeff * (ent_for_loss * lmf).sum() / jnp.maximum(
+            lmf.sum(), 1.0
+        )
+
+    # reduce per-token stat grids to scalars (reference pushes these through
+    # stats_tracker with denominators; here the engine aggregates floats)
+    lmf = lm.astype(jnp.float32)
+    denom = jnp.maximum(lmf.sum(), 1.0)
+
+    def tok_mean(x, mask=None):
+        m = lmf if mask is None else mask.astype(jnp.float32)
+        return (x * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+    stats = {
+        "actor_loss": tok_mean(stat["loss"]),
+        "importance_weight": tok_mean(stat["importance_weight"]),
+        "approx_kl": tok_mean(stat["approx_kl"]),
+        "entropy": tok_mean(entropy),
+        "new_logp": tok_mean(jax.lax.stop_gradient(logprobs)),
+        "old_logp": tok_mean(old_logp),
+        "clip_ratio": (stat["clip_mask"].astype(jnp.float32)).sum() / denom,
+        "dual_clip_ratio": (stat["dual_clip_mask"].astype(jnp.float32)).sum() / denom,
+        "n_valid_tokens": lmf.sum(),
+    }
+    if "behave_imp_weight" in stat:
+        stats["behave_imp_weight"] = tok_mean(
+            stat["behave_imp_weight"], stat["behave_mask"]
+        )
+        stats["behave_approx_kl"] = tok_mean(
+            stat["behave_approx_kl"], stat["behave_mask"]
+        )
+        stats["unclipped_behave_ratio"] = (
+            stat["behave_mask"].astype(jnp.float32).sum() / denom
+        )
+    if "sapo_soft_gate" in stat:
+        stats["sapo_soft_gate"] = tok_mean(stat["sapo_soft_gate"])
+    return loss, stats
+
+
+class PPOActor:
+    """Algorithm logic over a TrainEngine (reference trainer/ppo/actor.py)."""
+
+    def __init__(self, config: PPOActorConfig, engine: TrainEngine):
+        self.config = config
+        self.engine = engine
+        self.reward_norm = (
+            Normalization(
+                mean_level=config.adv_norm.mean_level if config.adv_norm else "batch",
+                std_level="batch",
+                group_size=config.group_size,
+            )
+            if config.group_reward_norm
+            else None
+        )
+        self.adv_norm = (
+            Normalization(
+                mean_level=config.adv_norm.mean_level,
+                std_level=config.adv_norm.std_level,
+                group_size=config.adv_norm.group_size or config.group_size,
+            )
+            if config.adv_norm
+            else None
+        )
+        # one loss closure for the engine's jit cache (id-stable across steps)
+        cfg = config
+        self._loss_fn = lambda outputs, b: grpo_loss_fn(outputs, b, cfg)
+
+    # -- engine delegation -------------------------------------------------
+    def __getattr__(self, name):
+        return getattr(self.engine, name)
+
+    def compute_logp(self, data: TensorDict) -> np.ndarray:
+        """Token-aligned logprobs of ``input_ids`` under the current policy."""
+        return self.engine.forward_batch(data, output_key="logprobs")
+
+    def should_compute_prox_logp(self) -> bool:
+        c = self.config
+        if c.use_decoupled_loss:
+            return c.prox_logp_mode in ("recompute", "metrics")
+        return c.recompute_logprob
+
+    # -- advantages --------------------------------------------------------
+    def compute_advantages(self, data: TensorDict) -> TensorDict:
+        """Reward shaping + KL-regularized rewards + masked GAE + adv norm
+        (reference actor.py:128-235). Host-side numpy; converts per-token
+        keys to label alignment at the end."""
+        cfg = self.config
+        data = dict(data)
+        attn = np.asarray(data["attention_mask"], bool)
+        B, L = attn.shape
+        loss_mask_tok = np.asarray(data["loss_mask"], np.float32) * attn
+
+        # 1. sequence rewards: overlong penalty -> bias/scale/clip -> norm
+        reward_score = np.asarray(data["rewards"], np.float32).reshape(B)
+        if cfg.overlong_reward_penalty:
+            resp_lens = loss_mask_tok.sum(-1)
+            reward_score = np.asarray(
+                F.reward_overlong_penalty(
+                    jnp.asarray(reward_score),
+                    jnp.asarray(resp_lens),
+                    overlong_tokens=cfg.overlong_tokens,
+                    overlong_penalty_factor=cfg.overlong_penalty_factor,
+                    max_response_length=cfg.overlong_tokens + int(resp_lens.max()),
+                )
+            )
+        reward_score = (reward_score + cfg.reward_bias) * cfg.reward_scaling
+        reward_score = np.clip(reward_score, -cfg.reward_clip, cfg.reward_clip)
+        if self.reward_norm is not None:
+            reward_score = self.reward_norm(reward_score)
+
+        # 2. label-align the mask and logprobs (reference roll(-1))
+        loss_mask = _roll_back(loss_mask_tok)
+        if cfg.mask_too_long_tokens and "seq_no_eos_mask" in data:
+            loss_mask[np.asarray(data["seq_no_eos_mask"], bool)] = 0.0
+
+        prox_tok = data.pop("prox_logp", None)
+        if not cfg.use_decoupled_loss and cfg.recompute_logprob:
+            if prox_tok is None:
+                raise ValueError("recompute_logprob=True but prox_logp missing")
+            old_logp = _roll_back(np.asarray(prox_tok, np.float32))
+            prox = old_logp
+        else:
+            old_logp = _roll_back(np.asarray(data["logprobs"], np.float32))
+            prox = _roll_back(np.asarray(prox_tok, np.float32)) if prox_tok is not None else None
+
+        ref_tok = data.pop("ref_logp", None)
+        ref_logp = (
+            _roll_back(np.asarray(ref_tok, np.float32))
+            if ref_tok is not None
+            else np.zeros_like(old_logp)
+        )
+        old_logp = old_logp * loss_mask
+        ref_logp = ref_logp * loss_mask
+
+        # 3. KL-regularized token rewards; task reward lands on the last
+        #    generated label position (reference :180-197)
+        seqlens = attn.sum(-1).astype(np.int64)
+        if "seq_no_eos_mask" in data:
+            seq_no_eos = np.asarray(data["seq_no_eos_mask"], bool).reshape(B)
+        else:
+            seq_no_eos = seqlens == L
+        kl = np.asarray(
+            F.approx_kl(jnp.asarray(old_logp), jnp.asarray(ref_logp), cfg.kl_estimator)
+        )
+        rewards = -cfg.kl_ctl * kl
+        kl_rewards = rewards.copy()
+        bidx = np.arange(B)
+        rewards[bidx, seqlens - 1] = 0.0
+        last_label = np.clip(seqlens - 2, 0, None)
+        if cfg.mask_no_eos_with_zero:
+            rewards[bidx, last_label] += np.where(seq_no_eos, 0.0, reward_score)
+        else:
+            rewards[bidx, last_label] += reward_score
+
+        # 4. masked GAE (values are token-aligned; zeros for pure GRPO)
+        values = np.asarray(
+            data.get("values", np.zeros_like(rewards)), np.float32
+        ).reshape(B, L)
+        advantages = np.zeros((B, L), np.float32)
+        nextvalues = values[:, L - 1] * seq_no_eos
+        lastgaelam = np.zeros(B, np.float32)
+        for t in range(L - 2, -1, -1):
+            delta = rewards[:, t] + cfg.gamma * nextvalues - values[:, t]
+            newgaelam = delta + cfg.gamma * cfg.lam * lastgaelam
+            m = loss_mask[:, t]
+            nextvalues = nextvalues * (1 - m) + values[:, t] * m
+            lastgaelam = lastgaelam * (1 - m) + newgaelam * m
+            advantages[:, t] = lastgaelam
+        data["returns"] = advantages + values
+
+        if self.adv_norm is not None:
+            advantages = self.adv_norm(advantages, loss_mask > 0)
+
+        # 5. store label-aligned training keys
+        data["advantages"] = advantages.astype(np.float32)
+        data["kl_rewards"] = kl_rewards
+        data["tot_rewards"] = rewards
+        data["loss_mask"] = loss_mask
+        data["old_logprobs"] = old_logp
+        if prox is not None:
+            data["prox_logprobs"] = prox * loss_mask
+        elif cfg.use_decoupled_loss and cfg.prox_logp_mode == "loglinear":
+            data["prox_alpha"] = self._prox_alpha(data, loss_mask)
+        data.pop("logprobs", None)
+        return data
+
+    def _prox_alpha(self, data: TensorDict, loss_mask: np.ndarray) -> np.ndarray:
+        """Per-token interpolation factor for the log-linear proximal
+        approximation (reference actor.py:520-600): alpha = clip((v_prox −
+        v_behave)/(v_theta − v_behave), 0, 1), generated tokens only."""
+        versions = _roll_back(np.asarray(data["versions"], np.int64))
+        v_theta = float(self.engine.get_version())
+        v_prox = v_theta - 1.0
+        v_behave = versions.astype(np.float32)
+        diff = v_theta - v_behave
+        generated = versions >= 0
+        alpha = np.where(generated & (diff > 0), (v_prox - v_behave) / np.maximum(diff, 1e-9), 0.0)
+        return (np.clip(alpha, 0.0, 1.0) * loss_mask).astype(np.float32)
+
+    # -- update ------------------------------------------------------------
+    def ppo_update(self, data: TensorDict) -> list[dict[str, float]]:
+        cfg = self.config
+        data = dict(data)
+        reward_score = np.asarray(data.get("rewards", np.zeros(1)), np.float32)
+        attn = np.asarray(data["attention_mask"], bool)
+        seqlens = attn.sum(-1)
+        lm = np.asarray(data["loss_mask"], np.float32)
+        with stats_tracker.scope("ppo_actor"):
+            tr = stats_tracker.get()
+            tr.scalar(
+                task_reward=float(reward_score.mean()),
+                correct_ratio=float((reward_score > 0).mean()),
+                seq_len=float(seqlens.mean()),
+                prompt_len=float((attn.sum(-1) - lm.sum(-1)).mean()),
+                no_eos_ratio=float(
+                    np.asarray(data.get("seq_no_eos_mask", np.zeros(1))).mean()
+                ),
+                advantages=float(
+                    (np.asarray(data["advantages"]) * lm).sum() / max(lm.sum(), 1)
+                ),
+                final_reward=float(np.asarray(data["tot_rewards"]).sum(-1).mean()),
+            )
+
+        for key in ("rewards", "tot_rewards", "kl_rewards", "returns"):
+            data.pop(key, None)
+        mb_list = split_padded_tensor_dict_into_mb_list(
+            data, MicroBatchSpec(n_mbs=cfg.ppo_n_minibatches)
+        )
+        all_stats = []
+        for mb in mb_list.mbs:
+            train_stat = self.engine.train_batch(
+                mb,
+                loss_fn=self._loss_fn,
+                loss_weight_fn=lambda x: float(
+                    (np.asarray(x["loss_mask"]) > 0).sum()
+                ),
+            )
+            with stats_tracker.scope("ppo_actor"):
+                stats_tracker.get().scalar(**train_stat)
+            all_stats.append(train_stat)
+        return all_stats
+
+
+def critic_loss_fn(outputs: dict, b: dict, cfg: PPOCriticConfig):
+    lm = (b["loss_mask"] > 0) & b["label_valid"]
+    loss, stat = F.ppo_critic_loss_fn(
+        value=outputs["values"],
+        old_value=b["old_values"],
+        target_value=b["target_values"],
+        loss_mask=lm,
+        value_eps_clip=cfg.eps_clip,
+    )
+    lmf = lm.astype(jnp.float32)
+    denom = jnp.maximum(lmf.sum(), 1.0)
+    return loss, {
+        "critic_loss": (stat["loss"] * lmf).sum() / denom,
+        "value_clip_ratio": stat["clip_mask"].astype(jnp.float32).sum() / denom,
+    }
+
+
+class PPOCritic:
+    """Value-function trainer (reference trainer/ppo/critic.py)."""
+
+    def __init__(self, config: PPOCriticConfig, engine: TrainEngine):
+        self.config = config
+        self.engine = engine
+        cfg = config
+        self._loss_fn = lambda outputs, b: critic_loss_fn(outputs, b, cfg)
+
+    def __getattr__(self, name):
+        return getattr(self.engine, name)
+
+    def compute_values(self, data: TensorDict) -> np.ndarray:
+        """Token-aligned values: out[b, t] = V(prefix incl. token t)."""
+        return self.engine.forward_batch(data, output_key="values")
+
+    def ppo_update(self, data: TensorDict) -> list[dict[str, float]]:
+        data = dict(data)
+        # label-aligned targets: value at position t predicts return from t
+        data["old_values"] = np.asarray(data.pop("values"), np.float32)
+        data["target_values"] = np.asarray(data.pop("returns"), np.float32)
+        for key in ("rewards", "tot_rewards", "kl_rewards", "versions"):
+            data.pop(key, None)
+        mb_list = split_padded_tensor_dict_into_mb_list(
+            data, MicroBatchSpec(n_mbs=self.config.ppo_n_minibatches)
+        )
+        all_stats = []
+        for mb in mb_list.mbs:
+            train_stat = self.engine.train_batch(
+                mb,
+                loss_fn=self._loss_fn,
+                loss_weight_fn=lambda x: float(
+                    (np.asarray(x["loss_mask"]) > 0).sum()
+                ),
+            )
+            with stats_tracker.scope("ppo_critic"):
+                stats_tracker.get().scalar(**train_stat)
+            all_stats.append(train_stat)
+        return all_stats

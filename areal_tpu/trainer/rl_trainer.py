@@ -1,0 +1,252 @@
+"""PPOTrainer: the user-facing RL training facade + step loop.
+
+Behavioral parity with reference areal/trainer/rl_trainer.py (86-498): build
+actor/critic/ref engines and the rollout client, then per global step run
+    prepare_batch -> [values] -> [recompute logp] -> [ref logp]
+    -> compute_advantages -> ppo_update (+critic)
+    -> pause rollout -> update_weights -> set_version -> save -> recover-ckpt
+    -> eval -> log -> resume
+Async-vs-sync is one knob: ``config.rollout.max_head_offpolicyness`` (0 =
+synchronous; the staleness manager then admits exactly one batch per
+version — reference blog AReaL_v0_3 η semantics).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from areal_tpu.api.config import PPOConfig
+from areal_tpu.api.io_struct import StepInfo, WeightUpdateMeta
+from areal_tpu.engine.train_engine import JaxTrainEngine
+from areal_tpu.trainer.ppo import PPOActor, PPOCritic
+from areal_tpu.utils import logging as alog, stats_tracker
+from areal_tpu.utils.data import StatefulDataLoader
+from areal_tpu.utils.recover import RecoverHandler
+from areal_tpu.utils.saver import Evaluator, Saver
+from areal_tpu.utils.stats_logger import StatsLogger
+
+logger = alog.getLogger("rl_trainer")
+
+
+class PPOTrainer:
+    def __init__(
+        self,
+        config: PPOConfig,
+        train_dataset,
+        valid_dataset=None,
+        rollout=None,
+        eval_rollout=None,
+        tokenizer=None,
+        actor_engine=None,
+        critic_engine=None,
+        ref_engine=None,
+    ):
+        self.config = config
+        self.tokenizer = tokenizer
+
+        self.train_dataloader = StatefulDataLoader(
+            train_dataset,
+            batch_size=config.train_dataset.batch_size,
+            shuffle=config.train_dataset.shuffle,
+            seed=config.seed,
+            drop_last=config.train_dataset.drop_last,
+        )
+        self.valid_dataset = valid_dataset
+        from areal_tpu.api.io_struct import FinetuneSpec
+
+        self.ft_spec = FinetuneSpec(
+            total_train_epochs=config.total_train_epochs,
+            dataset_size=len(train_dataset),
+            train_batch_size=config.train_dataset.batch_size,
+        )
+
+        # engines (injectable for tests / custom backends)
+        config.actor.temperature = config.gconfig.temperature
+        self.actor_engine = actor_engine or JaxTrainEngine(config.actor)
+        if getattr(self.actor_engine, "params", 1) is None or actor_engine is None:
+            self.actor_engine.initialize(self.ft_spec)
+        self.actor = PPOActor(config.actor, self.actor_engine)
+
+        self.critic = None
+        if config.critic is not None:
+            eng = critic_engine or JaxTrainEngine(config.critic, value_head=True)
+            if critic_engine is None:
+                eng.initialize(self.ft_spec)
+            self.critic = PPOCritic(config.critic, eng)
+
+        self.ref = None
+        if config.ref is not None:
+            eng = ref_engine or JaxTrainEngine(config.ref, need_optimizer=False)
+            if ref_engine is None:
+                eng.initialize(self.ft_spec)
+            self.ref = PPOActor(config.actor, eng)
+
+        # rollout client
+        if rollout is None:
+            from areal_tpu.inference.client import RemoteJaxEngine
+
+            addrs = os.environ.get("AREAL_TPU_SERVER_ADDRS", "")
+            rollout = RemoteJaxEngine(
+                config.rollout, addresses=[a for a in addrs.split(",") if a]
+            )
+            rollout.initialize()
+        self.rollout = rollout
+        self.eval_rollout = eval_rollout
+
+        # weight update channel
+        mode = config.weight_update_mode or config.actor.weight_update_mode
+        update_dir = os.path.join(
+            config.cluster.fileroot,
+            config.experiment_name,
+            config.trial_name,
+            "update_weights",
+        )
+        self.weight_update_meta = WeightUpdateMeta(
+            type=mode, path=update_dir, with_version=True
+        )
+        self.actor_engine.connect_engine(self.rollout, self.weight_update_meta)
+
+        # aux subsystems
+        for c in (
+            config.saver,
+            config.checkpointer,
+            config.evaluator,
+            config.recover,
+            config.stats_logger,
+        ):
+            c.experiment_name = c.experiment_name or config.experiment_name
+            c.trial_name = c.trial_name or config.trial_name
+            if hasattr(c, "fileroot"):
+                c.fileroot = c.fileroot or config.cluster.fileroot
+        self.saver = Saver(config.saver, self.ft_spec)
+        self.evaluator = Evaluator(config.evaluator, self.ft_spec)
+        self.recover_handler = RecoverHandler(config.recover, self.ft_spec)
+        self.stats_logger = StatsLogger(config.stats_logger, self.ft_spec)
+        self.recover_info = self.recover_handler.load(
+            self.actor_engine,
+            saver=self.saver,
+            evaluator=self.evaluator,
+            dataloader=self.train_dataloader,
+            inference_engine=self.rollout,
+            weight_update_meta=self.weight_update_meta,
+        )
+
+    # -- step loop --------------------------------------------------------
+    def train(
+        self,
+        workflow: Any = None,
+        eval_workflow: Any = None,
+        dynamic_filter_fn: Callable | None = None,
+    ) -> None:
+        config = self.config
+        start_step = (
+            self.recover_info.last_step_info.next().global_step
+            if self.recover_info is not None
+            else 0
+        )
+        steps_per_epoch = len(self.train_dataloader)
+        max_steps = config.total_train_epochs * steps_per_epoch
+        if config.total_train_steps is not None:
+            max_steps = min(max_steps, config.total_train_steps)
+
+        for global_step in range(start_step, max_steps):
+            epoch = global_step // steps_per_epoch
+            step = global_step % steps_per_epoch
+            t_step = time.monotonic()
+
+            with stats_tracker.record_timing("rollout"):
+                batch = self.rollout.prepare_batch(
+                    self.train_dataloader,
+                    workflow=workflow,
+                    should_accept_fn=dynamic_filter_fn,
+                )
+
+            if self.critic is not None:
+                with stats_tracker.record_timing("critic_values"):
+                    batch["values"] = self.critic.compute_values(batch)
+
+            if self.actor.should_compute_prox_logp():
+                with stats_tracker.record_timing("recompute_logp"):
+                    batch["prox_logp"] = self.actor.compute_logp(batch)
+
+            if self.ref is not None:
+                with stats_tracker.record_timing("ref_logp"):
+                    batch["ref_logp"] = self.ref.compute_logp(batch)
+
+            with stats_tracker.record_timing("compute_advantages"):
+                adv_batch = self.actor.compute_advantages(batch)
+
+            with stats_tracker.record_timing("train_step"):
+                self.actor.ppo_update(adv_batch)
+            if self.critic is not None:
+                with stats_tracker.record_timing("critic_train_step"):
+                    self.critic.ppo_update(adv_batch)
+
+            # §3.4 protocol: stop submissions, push weights, advance version
+            self.rollout.pause()
+            with stats_tracker.record_timing("update_weights"):
+                new_version = global_step + 1
+                self.actor_engine.update_weights(self.weight_update_meta)
+                self.actor_engine.set_version(new_version)
+                if self.critic is not None:
+                    self.critic.engine.set_version(new_version)
+                self.rollout.set_version(new_version)
+                if self.eval_rollout is not None:
+                    self.eval_rollout.set_version(new_version)
+
+            with stats_tracker.record_timing("save"):
+                self.saver.maybe_save(
+                    self.actor_engine, epoch, step, global_step, self.tokenizer
+                )
+                self.recover_handler.dump(
+                    self.actor_engine,
+                    StepInfo(
+                        epoch=epoch,
+                        epoch_step=step,
+                        global_step=global_step,
+                        steps_per_epoch=steps_per_epoch,
+                    ),
+                    saver=self.saver,
+                    evaluator=self.evaluator,
+                    dataloader=self.train_dataloader,
+                    tokenizer=self.tokenizer,
+                )
+
+            # resume BEFORE eval: the default eval client is the training
+            # rollout client, whose dispatcher skips submissions while paused
+            # (a dedicated eval_rollout keeps the reference's order anyway)
+            self.rollout.resume()
+            with stats_tracker.record_timing("eval"):
+                self._maybe_evaluate(eval_workflow or workflow, epoch, global_step)
+
+            stats = stats_tracker.export_all()
+            stats.update(self.rollout.export_stats())
+            stats["step_secs"] = time.monotonic() - t_step
+            stats["version"] = float(new_version)
+            self.stats_logger.commit(epoch, step, global_step, stats)
+
+    def _maybe_evaluate(self, eval_workflow, epoch: int, global_step: int) -> None:
+        if self.valid_dataset is None or eval_workflow is None:
+            return
+
+        def run_eval():
+            client = self.eval_rollout or self.rollout
+            batch = client.rollout_batch(
+                list(self.valid_dataset), workflow=eval_workflow
+            )
+            rewards = np.asarray(batch["rewards"], np.float32)
+            with stats_tracker.scope("eval"):
+                stats_tracker.get().scalar(
+                    reward=float(rewards.mean()),
+                    n_seqs=float(rewards.shape[0]),
+                )
+
+        self.evaluator.maybe_evaluate(epoch, global_step, run_eval)
+
+    def close(self) -> None:
+        self.stats_logger.close()
+        self.rollout.destroy()

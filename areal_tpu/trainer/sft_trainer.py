@@ -1,0 +1,272 @@
+"""SFT + reward-model trainers (reference areal/trainer/sft_trainer.py:1-410,
+sft/lm_engine.py:1-96, rw/rw_engine.py:1-79).
+
+- ``lm_loss_fn``: packed cross-entropy over loss-masked labels (label-aligned
+  inside the grid, so the host pre-rolls loss_mask like the PPO path).
+- ``rw_loss_fn``: Bradley-Terry pairwise loss. Sequences arrive interleaved
+  (chosen, rejected); the score is the value head at each sequence's last
+  token. Pair grouping survives grid packing via per-token ``rw_pair_id``
+  arrays + an in-jit ``segment_sum`` (static segment count = grid size), the
+  shape-static TPU replacement for the reference's python pair indexing.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.config import MicroBatchSpec, SFTConfig
+from areal_tpu.api.io_struct import FinetuneSpec, StepInfo
+from areal_tpu.engine.train_engine import JaxTrainEngine
+from areal_tpu.utils import logging as alog, stats_tracker
+from areal_tpu.utils.data import (
+    StatefulDataLoader,
+    pad_sequences_to_tensors,
+    roll_to_label_alignment as _roll_back,
+    split_padded_tensor_dict_into_mb_list,
+)
+from areal_tpu.utils.recover import RecoverHandler
+from areal_tpu.utils.saver import Evaluator, Saver
+from areal_tpu.utils.stats_logger import StatsLogger
+
+logger = alog.getLogger("sft")
+
+
+def lm_loss_fn(outputs: dict, b: dict):
+    """Per-token NLL over masked labels (reference lm_engine.py train_lm)."""
+    lm = (b["label_valid"] & (b["loss_mask"] > 0)).astype(jnp.float32)
+    denom = jnp.maximum(lm.sum(), 1.0)
+    nll = -(outputs["logprobs"] * lm).sum() / denom
+    return nll, {
+        "ppl_loss": jax.lax.stop_gradient(nll),
+        "n_valid_tokens": lm.sum(),
+    }
+
+
+def rw_loss_fn(outputs: dict, b: dict):
+    """Bradley-Terry: -log σ(score_chosen − score_rejected)."""
+    values = outputs["values"]  # [G, L]
+    G, L = values.shape
+    flat = (values * b["rw_last_mask"] * b["rw_sign"]).reshape(-1)
+    pair_id = b["rw_pair_id"].reshape(-1).astype(jnp.int32)
+    n_seg = G * L
+    diff = jax.ops.segment_sum(flat, pair_id, num_segments=n_seg)
+    # a full pair contributes exactly 2 last-token markers
+    marks = jax.ops.segment_sum(
+        b["rw_last_mask"].reshape(-1), pair_id, num_segments=n_seg
+    )
+    valid = (marks >= 2.0).astype(jnp.float32)
+    n_pairs = jnp.maximum(valid.sum(), 1.0)
+    loss = -(jax.nn.log_sigmoid(diff) * valid).sum() / n_pairs
+    acc = ((diff > 0).astype(jnp.float32) * valid).sum() / n_pairs
+    return loss, {"rw_loss": jax.lax.stop_gradient(loss), "rw_acc": acc}
+
+
+class LMEngine:
+    """SFT update logic over a TrainEngine (reference sft/lm_engine.py)."""
+
+    def __init__(self, engine, mb_spec: MicroBatchSpec | None = None):
+        self.engine = engine
+        self.mb_spec = mb_spec or MicroBatchSpec()
+
+    def train_lm(self, data) -> dict[str, float]:
+        data = dict(data)
+        data["loss_mask"] = _roll_back(
+            np.asarray(data["loss_mask"], np.float32)
+            * np.asarray(data["attention_mask"], np.float32)
+        )
+        stats = self.engine.train_batch(
+            data,
+            loss_fn=lm_loss_fn,
+            loss_weight_fn=lambda x: float((np.asarray(x["loss_mask"]) > 0).sum()),
+        )
+        return stats
+
+    def eval_lm(self, data) -> dict[str, float]:
+        data = dict(data)
+        data["loss_mask"] = _roll_back(
+            np.asarray(data["loss_mask"], np.float32)
+            * np.asarray(data["attention_mask"], np.float32)
+        )
+        return self.engine.eval_batch(
+            data,
+            loss_fn=lm_loss_fn,
+            loss_weight_fn=lambda x: float((np.asarray(x["loss_mask"]) > 0).sum()),
+        )
+
+
+class RWEngine:
+    """Bradley-Terry reward-model updates (reference rw/rw_engine.py). The
+    engine must be built with ``value_head=True``; batches interleave
+    (chosen, rejected) rows."""
+
+    def __init__(self, engine, mb_spec: MicroBatchSpec | None = None):
+        import dataclasses
+
+        self.engine = engine
+        # never mutate a caller-shared spec; pairs must stay together
+        self.mb_spec = dataclasses.replace(
+            mb_spec or MicroBatchSpec(), granularity=2
+        )
+
+    def _prep(self, mb) -> dict:
+        mb = dict(mb)
+        attn = np.asarray(mb["attention_mask"], bool)
+        B, L = attn.shape
+        assert B % 2 == 0, "RW batches interleave chosen/rejected pairs"
+        seqlens = attn.sum(-1)
+        pair_id = np.broadcast_to((np.arange(B) // 2)[:, None], (B, L)).astype(np.int32)
+        sign = np.broadcast_to(
+            np.where(np.arange(B) % 2 == 0, 1.0, -1.0)[:, None], (B, L)
+        ).astype(np.float32)
+        last = np.zeros((B, L), np.float32)
+        last[np.arange(B), seqlens - 1] = 1.0
+        mb["rw_pair_id"] = pair_id * attn
+        mb["rw_sign"] = sign * attn
+        mb["rw_last_mask"] = last
+        return mb
+
+    def train_rw(self, data) -> list[dict[str, float]]:
+        mb_list = split_padded_tensor_dict_into_mb_list(dict(data), self.mb_spec)
+        out = []
+        for mb in mb_list.mbs:
+            stats = self.engine.train_batch(
+                self._prep(mb),
+                loss_fn=rw_loss_fn,
+                loss_weight_fn=lambda x: float(len(np.asarray(x["rw_sign"]))) or 1.0,
+            )
+            out.append(stats)
+        return out
+
+
+class SFTTrainer:
+    """Supervised fine-tuning loop (reference trainer/sft_trainer.py). Dataset
+    rows are pre-tokenized dicts {"input_ids": [...], "loss_mask": [...]}."""
+
+    def __init__(
+        self,
+        config: SFTConfig,
+        train_dataset,
+        valid_dataset=None,
+        tokenizer=None,
+        engine=None,
+    ):
+        self.config = config
+        self.tokenizer = tokenizer
+        self.train_dataloader = StatefulDataLoader(
+            train_dataset,
+            batch_size=config.train_dataset.batch_size,
+            shuffle=config.train_dataset.shuffle,
+            seed=config.seed,
+            drop_last=config.train_dataset.drop_last,
+        )
+        self.valid_dataset = valid_dataset
+        self.ft_spec = FinetuneSpec(
+            total_train_epochs=config.total_train_epochs,
+            dataset_size=len(train_dataset),
+            train_batch_size=config.train_dataset.batch_size,
+        )
+        self.engine = engine or JaxTrainEngine(config.model)
+        if engine is None:
+            self.engine.initialize(self.ft_spec)
+        self.lm = LMEngine(self.engine, config.model.mb_spec)
+
+        for c in (config.saver, config.checkpointer, config.evaluator, config.recover, config.stats_logger):
+            c.experiment_name = c.experiment_name or config.experiment_name
+            c.trial_name = c.trial_name or config.trial_name
+            if hasattr(c, "fileroot"):
+                c.fileroot = c.fileroot or config.cluster.fileroot
+        self.saver = Saver(config.saver, self.ft_spec)
+        self.evaluator = Evaluator(config.evaluator, self.ft_spec)
+        self.recover_handler = RecoverHandler(config.recover, self.ft_spec)
+        self.stats_logger = StatsLogger(config.stats_logger, self.ft_spec)
+        self.recover_info = self.recover_handler.load(
+            self.engine,
+            saver=self.saver,
+            evaluator=self.evaluator,
+            dataloader=self.train_dataloader,
+        )
+
+    def train(self) -> list[float]:
+        config = self.config
+        start_step = (
+            self.recover_info.last_step_info.next().global_step
+            if self.recover_info is not None
+            else 0
+        )
+        steps_per_epoch = len(self.train_dataloader)
+        max_steps = config.total_train_epochs * steps_per_epoch
+        if config.total_train_steps is not None:
+            max_steps = min(max_steps, config.total_train_steps)
+
+        from areal_tpu.utils.data import cycle_dataloader
+
+        gen = cycle_dataloader(self.train_dataloader)
+        losses = []
+        for global_step in range(start_step, max_steps):
+            epoch = global_step // steps_per_epoch
+            step = global_step % steps_per_epoch
+            t0 = time.monotonic()
+            rows = next(gen)
+            batch = pad_sequences_to_tensors(
+                [
+                    {
+                        "input_ids": np.asarray(r["input_ids"], np.int32),
+                        "loss_mask": np.asarray(r["loss_mask"], np.float32),
+                    }
+                    for r in rows
+                ]
+            )
+            stats = self.lm.train_lm(batch)
+            self.engine.set_version(global_step + 1)
+            losses.append(stats["ppl_loss"])
+
+            self.saver.maybe_save(self.engine, epoch, step, global_step, self.tokenizer)
+            self.recover_handler.dump(
+                self.engine,
+                StepInfo(
+                    epoch=epoch,
+                    epoch_step=step,
+                    global_step=global_step,
+                    steps_per_epoch=steps_per_epoch,
+                ),
+                saver=self.saver,
+                evaluator=self.evaluator,
+                dataloader=self.train_dataloader,
+                tokenizer=self.tokenizer,
+            )
+            if self.valid_dataset is not None:
+                self.evaluator.maybe_evaluate(epoch, global_step, self._run_eval)
+            stats["step_secs"] = time.monotonic() - t0
+            stats.update(stats_tracker.export_all())
+            self.stats_logger.commit(epoch, step, global_step, stats)
+        return losses
+
+    def _run_eval(self) -> None:
+        bs = self.config.train_dataset.batch_size
+        eval_dl = StatefulDataLoader(
+            self.valid_dataset, batch_size=bs, shuffle=False, drop_last=False
+        )
+        loss_sum = tok_sum = 0.0
+        for rows in eval_dl:
+            batch = pad_sequences_to_tensors(
+                [
+                    {
+                        "input_ids": np.asarray(r["input_ids"], np.int32),
+                        "loss_mask": np.asarray(r["loss_mask"], np.float32),
+                    }
+                    for r in rows
+                ]
+            )
+            stats = self.lm.eval_lm(batch)
+            n = stats.get("n_valid_tokens", 1.0)
+            loss_sum += stats["ppl_loss"] * n
+            tok_sum += n
+        with stats_tracker.scope("eval"):
+            stats_tracker.get().scalar(ppl_loss=loss_sum / max(tok_sum, 1.0))
+
+    def close(self) -> None:
+        self.stats_logger.close()

@@ -246,6 +246,74 @@ def split_padded_tensor_dict_into_mb_list(
     return MicroBatchList(mbs=mbs, group_indices=groups, padded_to=[0] * len(mbs))
 
 
+def roll_to_label_alignment(x: np.ndarray) -> np.ndarray:
+    """Token alignment -> label alignment: out[:, t] = x[:, t+1] (wrap like
+    torch.roll; wrapped entries are masked by the rolled loss mask).
+    Parity: the reference's roll(shifts=-1) in trainer/ppo/actor.py:165."""
+    return np.roll(np.asarray(x), shift=-1, axis=-1)
+
+
+class StatefulDataLoader:
+    """Batched iteration over a list-like dataset of dict rows, with a
+    resumable position (reference uses torchdata's StatefulDataLoader;
+    recover.py restores the epoch position from state_dict)."""
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int = 1,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self._epoch = 0
+        self._batch_in_epoch = 0
+        if drop_last and len(dataset) < batch_size:
+            raise ValueError(
+                f"dataset of {len(dataset)} rows cannot fill one batch of "
+                f"{batch_size} with drop_last=True"
+            )
+
+    def __len__(self) -> int:
+        n = len(self.dataset) // self.batch_size
+        if not self.drop_last and len(self.dataset) % self.batch_size:
+            n += 1
+        return n
+
+    def _order(self) -> np.ndarray:
+        idx = np.arange(len(self.dataset))
+        if self.shuffle:
+            np.random.default_rng(self.seed + self._epoch).shuffle(idx)
+        return idx
+
+    def __iter__(self):
+        while True:  # one pass; epoch counter persists across iters
+            order = self._order()
+            n_batches = len(self)
+            start_batch = self._batch_in_epoch
+            for b in range(start_batch, n_batches):
+                sel = order[b * self.batch_size : (b + 1) * self.batch_size]
+                if self.drop_last and len(sel) < self.batch_size:
+                    break
+                self._batch_in_epoch = b + 1
+                yield [self.dataset[int(i)] for i in sel]
+            self._epoch += 1
+            self._batch_in_epoch = 0
+            return
+
+    def state_dict(self) -> dict:
+        return {"epoch": self._epoch, "batch_in_epoch": self._batch_in_epoch}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._epoch = state.get("epoch", 0)
+        self._batch_in_epoch = state.get("batch_in_epoch", 0)
+
+
 def cycle_dataloader(loader) -> Iterator:
     """Infinite generator over a (re-iterable) dataloader.
 

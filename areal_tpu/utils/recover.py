@@ -1,0 +1,146 @@
+"""Checkpoint-based recovery (reference areal/utils/recover.py:30-382).
+
+``RecoverInfo`` snapshots everything the step loop needs to resume:
+last StepInfo, saver/evaluator timer states, and the dataloader position.
+Recovery is checkpoint-based, not in-place elastic — the supervisor (launcher
+or driver) relaunches the trial and ``RecoverHandler.load`` restores engine
+state from the latest recover checkpoint, then re-syncs inference weights.
+
+Mode policy (reference :326-382):
+- "disabled"/"off": never dump, never load.
+- "on": always try to load at startup (error if absent ⇒ fresh start).
+- "auto": load if a recover checkpoint exists, else fresh start.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pickle
+from typing import Any
+
+from areal_tpu.api.config import RecoverConfig
+from areal_tpu.api.io_struct import SaveLoadMeta, StepInfo
+from areal_tpu.utils import logging as alog
+from areal_tpu.utils.saver import Saver
+
+logger = alog.getLogger("recover")
+
+
+@dataclasses.dataclass
+class RecoverInfo:
+    last_step_info: StepInfo
+    saver_state: dict = dataclasses.field(default_factory=dict)
+    evaluator_state: dict = dataclasses.field(default_factory=dict)
+    dataloader_state: dict = dataclasses.field(default_factory=dict)
+    extra: dict = dataclasses.field(default_factory=dict)
+
+
+class RecoverHandler:
+    def __init__(self, config: RecoverConfig, ft_spec=None):
+        self.config = config
+        self.ft_spec = ft_spec
+        self.saver = Saver(config, ft_spec, for_recover=True)
+
+    # -- paths -------------------------------------------------------------
+    def _root(self) -> str:
+        return self.saver.save_root()
+
+    def _info_path(self) -> str:
+        return os.path.join(self._root(), "recover_info.pkl")
+
+    def _latest_path(self) -> str:
+        return os.path.join(self._root(), "latest")
+
+    # -- dump --------------------------------------------------------------
+    def dump(
+        self,
+        engine,
+        step_info: StepInfo,
+        saver=None,
+        evaluator=None,
+        dataloader=None,
+        stats_logger=None,
+        tokenizer=None,
+    ) -> str | None:
+        if self.config.mode in ("disabled", "off"):
+            return None
+        if not self.saver.freq_ctl.check(
+            epochs=step_info.epoch, steps=step_info.global_step + 1
+        ):
+            return None
+        path = self.saver.save(
+            engine,
+            step_info.epoch,
+            step_info.epoch_step,
+            step_info.global_step,
+            tokenizer,
+        )
+        info = RecoverInfo(
+            last_step_info=step_info,
+            saver_state=saver.state_dict() if saver else {},
+            evaluator_state=evaluator.state_dict() if evaluator else {},
+            dataloader_state=(
+                dataloader.state_dict()
+                if dataloader is not None and hasattr(dataloader, "state_dict")
+                else {}
+            ),
+        )
+        os.makedirs(self._root(), exist_ok=True)
+        with open(self._info_path(), "wb") as f:
+            pickle.dump(info, f)
+        with open(self._latest_path(), "w") as f:
+            f.write(path)
+        logger.info(f"recover checkpoint dumped at step {step_info.global_step}")
+        return path
+
+    # -- load --------------------------------------------------------------
+    def should_load(self) -> bool:
+        mode = self.config.mode
+        if mode in ("disabled", "off"):
+            return False
+        exists = os.path.exists(self._info_path()) and os.path.exists(
+            self._latest_path()
+        )
+        if mode == "on" and not exists:
+            logger.warning("recover mode 'on' but no checkpoint found; fresh start")
+        return exists
+
+    def load(
+        self,
+        engine,
+        saver=None,
+        evaluator=None,
+        dataloader=None,
+        inference_engine=None,
+        weight_update_meta=None,
+    ) -> RecoverInfo | None:
+        if not self.should_load():
+            return None
+        with open(self._info_path(), "rb") as f:
+            info: RecoverInfo = pickle.load(f)
+        with open(self._latest_path()) as f:
+            ckpt_path = f.read().strip()
+        engine.load(SaveLoadMeta(path=ckpt_path, weight_format="orbax", with_optim=True))
+        engine.set_version(info.last_step_info.global_step + 1)
+        if saver is not None and info.saver_state:
+            saver.load_state_dict(info.saver_state)
+        if evaluator is not None and info.evaluator_state:
+            evaluator.load_state_dict(info.evaluator_state)
+        if (
+            dataloader is not None
+            and info.dataloader_state
+            and hasattr(dataloader, "load_state_dict")
+        ):
+            dataloader.load_state_dict(info.dataloader_state)
+        # re-sync inference fleet to the restored weights (reference
+        # rl_trainer.py:260-268 re-runs the weight update after recovery)
+        if inference_engine is not None and weight_update_meta is not None:
+            engine.update_weights(weight_update_meta)
+            inference_engine.set_version(engine.get_version())
+        logger.info(
+            f"recovered from {ckpt_path} at step "
+            f"{info.last_step_info.global_step}"
+        )
+        return info

@@ -1,0 +1,91 @@
+"""Timer-gated checkpoint saver + evaluator (reference areal/utils/saver.py
+:1-185, evaluator.py:1-35). Orbax handles async staging TPU-side — ``save``
+can return before bytes hit disk; ``wait_for_staging`` blocks before params
+mutate (reference async_checkpoint.py role)."""
+
+from __future__ import annotations
+
+import os
+
+from areal_tpu.api.config import EvaluatorConfig, SaverConfig
+from areal_tpu.api.io_struct import SaveLoadMeta
+from areal_tpu.utils import logging as alog
+from areal_tpu.utils.timeutil import FrequencyControl
+
+logger = alog.getLogger("saver")
+
+
+class Saver:
+    def __init__(self, config: SaverConfig, ft_spec, for_recover: bool = False):
+        self.config = config
+        self.ft_spec = ft_spec
+        self.for_recover = for_recover
+        self.freq_ctl = FrequencyControl(
+            freq_epoch=config.freq_epochs,
+            freq_step=config.freq_steps,
+            freq_sec=config.freq_secs,
+        )
+
+    def save_root(self) -> str:
+        sub = "recover" if self.for_recover else "checkpoints"
+        return os.path.join(
+            self.config.fileroot,
+            self.config.experiment_name or "exp",
+            self.config.trial_name or "trial",
+            sub,
+        )
+
+    def maybe_save(
+        self, engine, epoch: int, step: int, global_step: int, tokenizer=None
+    ) -> str | None:
+        """Save when a frequency trigger fires; returns the path if saved."""
+        if not self.freq_ctl.check(epochs=epoch, steps=global_step + 1):
+            return None
+        return self.save(engine, epoch, step, global_step, tokenizer)
+
+    def save(
+        self, engine, epoch: int, step: int, global_step: int, tokenizer=None
+    ) -> str:
+        name = f"epoch{epoch}epochstep{step}globalstep{global_step}"
+        path = os.path.join(self.save_root(), name)
+        os.makedirs(path, exist_ok=True)
+        meta = SaveLoadMeta(
+            path=path,
+            weight_format="orbax" if self.for_recover else "hf",
+            with_optim=self.for_recover,
+            tokenizer=tokenizer,
+        )
+        engine.save(meta)
+        logger.info(f"saved {'recover ' if self.for_recover else ''}ckpt to {path}")
+        return path
+
+    def state_dict(self) -> dict:
+        return self.freq_ctl.state_dict()
+
+    def load_state_dict(self, state: dict) -> None:
+        self.freq_ctl.load_state_dict(state)
+
+
+class Evaluator:
+    """Frequency-gated evaluation trigger (reference utils/evaluator.py)."""
+
+    def __init__(self, config: EvaluatorConfig, ft_spec):
+        self.config = config
+        self.ft_spec = ft_spec
+        self.freq_ctl = FrequencyControl(
+            freq_epoch=config.freq_epochs,
+            freq_step=config.freq_steps,
+            freq_sec=config.freq_secs,
+        )
+
+    def maybe_evaluate(self, epoch: int, global_step: int, evaluate_fn) -> bool:
+        if not self.freq_ctl.check(epochs=epoch, steps=global_step + 1):
+            return False
+        evaluate_fn()
+        return True
+
+    def state_dict(self) -> dict:
+        return self.freq_ctl.state_dict()
+
+    def load_state_dict(self, state: dict) -> None:
+        self.freq_ctl.load_state_dict(state)

@@ -1,0 +1,82 @@
+"""Rank-0 metric sink: console table + TensorBoard + optional wandb
+(reference areal/utils/stats_logger.py:34-160). wandb/swanlab are gated on
+import availability — absent in the TPU image, the logger degrades to
+console+tensorboard without error."""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from areal_tpu.api.config import StatsLoggerConfig
+from areal_tpu.api.io_struct import StepInfo
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("stats")
+
+
+class StatsLogger:
+    def __init__(self, config: StatsLoggerConfig, ft_spec=None):
+        self.config = config
+        self.ft_spec = ft_spec
+        self._tb = None
+        self._wandb = None
+        self._init_backends()
+
+    def _log_dir(self) -> str:
+        return os.path.join(
+            self.config.fileroot,
+            self.config.experiment_name or "exp",
+            self.config.trial_name or "trial",
+            "logs",
+        )
+
+    def _init_backends(self) -> None:
+        if self.config.tensorboard and self.config.tensorboard.path is not None:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                path = self.config.tensorboard.path or self._log_dir()
+                os.makedirs(path, exist_ok=True)
+                self._tb = SummaryWriter(log_dir=path)
+            except Exception:  # noqa: BLE001 — optional backend
+                logger.warning("tensorboard unavailable; console only")
+        if self.config.wandb and self.config.wandb.mode != "disabled":
+            try:
+                import wandb
+
+                wandb.init(
+                    mode=self.config.wandb.mode,
+                    project=self.config.wandb.project or self.config.experiment_name,
+                    name=self.config.wandb.name or self.config.trial_name,
+                    dir=self._log_dir(),
+                )
+                self._wandb = wandb
+            except Exception:  # noqa: BLE001
+                logger.warning("wandb unavailable")
+
+    def commit(
+        self, epoch: int, step: int, global_step: int, data: dict[str, Any]
+    ) -> None:
+        flat = {k: float(v) for k, v in sorted(data.items())}
+        info = StepInfo(epoch=epoch, epoch_step=step, global_step=global_step)
+        lines = [
+            f"Epoch {info.epoch + 1} step {info.epoch_step + 1} "
+            f"(global step {info.global_step + 1})"
+        ]
+        width = max((len(k) for k in flat), default=10)
+        for k, v in flat.items():
+            lines.append(f"  {k:<{width}} {v:.6g}")
+        logger.info("\n".join(lines))
+        if self._tb is not None:
+            for k, v in flat.items():
+                self._tb.add_scalar(k, v, global_step)
+            self._tb.flush()
+        if self._wandb is not None:
+            self._wandb.log(flat, step=global_step)
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()
+        if self._wandb is not None:
+            self._wandb.finish()

@@ -17,6 +17,7 @@ controller RPC instead of a gloo group).
 from __future__ import annotations
 
 import threading
+import time
 from collections import defaultdict
 from contextlib import contextmanager
 from enum import Enum
@@ -155,6 +156,28 @@ denominator = DEFAULT_TRACKER.denominator
 stat = DEFAULT_TRACKER.stat
 scalar = DEFAULT_TRACKER.scalar
 export = DEFAULT_TRACKER.export
+
+@contextmanager
+def record_timing(name: str):
+    """Record a wall-clock scope as ``timing/<name>`` seconds (reference
+    stats_tracker.record_timing used throughout rl_trainer.py)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        DEFAULT_TRACKER.scalar(**{f"timing/{name}": time.perf_counter() - t0})
+
+
+def export_all(reset: bool = True) -> dict[str, float]:
+    """Export the default tracker plus every named tracker, name-prefixed."""
+    out = DEFAULT_TRACKER.export(reset=reset)
+    with _NAMED_LOCK:
+        named = list(_NAMED.items())
+    for name, tr in named:
+        for k, v in tr.export(reset=reset).items():
+            out[f"{name}/{k}"] = v
+    return out
+
 
 _NAMED: dict[str, StatsTracker] = {}
 _NAMED_LOCK = threading.Lock()

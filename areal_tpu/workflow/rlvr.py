@@ -69,7 +69,11 @@ class RLVRWorkflow(RolloutWorkflow):
                 completion_str,
                 prompt_ids,
                 resp.output_tokens,
-                **{k: v for k, v in data.items() if k not in ("prompt_ids", "messages")},
+                **{
+                    k: v
+                    for k, v in data.items()
+                    if k not in ("prompt_ids", "messages", "prompt")
+                },
             )
             p, o = len(prompt_ids), len(resp.output_tokens)
             seq = np.asarray(prompt_ids + resp.output_tokens, np.int32)

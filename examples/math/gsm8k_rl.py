@@ -1,0 +1,133 @@
+"""GSM8K GRPO training entry (parity: reference examples/math/gsm8k_rl.py).
+
+Two deployment shapes:
+- **fleet mode**: inference servers already running (launched via
+  ``python -m areal_tpu.inference.server --config ...`` or a scheduler);
+  their addresses arrive through ``AREAL_TPU_SERVER_ADDRS`` or name_resolve.
+- **single-host mode** (default when no addresses are found): spin an
+  in-process DecodeEngine+ServerThread sharing this host's TPU chips —
+  rollout and training time-share the mesh, weight updates are zero-copy
+  ("mem" mode).
+
+Usage:
+    python examples/math/gsm8k_rl.py --config examples/math/gsm8k_grpo.yaml \
+        [train_dataset.path=/data/gsm8k] [key=value ...]
+"""
+
+import os
+import sys
+
+import numpy as np
+
+from areal_tpu.api.config import GRPOConfig, load_expr_config
+from areal_tpu.dataset import get_custom_dataset
+from areal_tpu.inference.client import RemoteJaxEngine
+from areal_tpu.reward.gsm8k import gsm8k_reward_fn
+from areal_tpu.trainer import PPOTrainer
+from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+
+def load_tokenizer(path: str):
+    if not path:
+        return None  # prompt_ids-style datasets need no tokenizer
+    try:
+        from transformers import AutoTokenizer
+
+        return AutoTokenizer.from_pretrained(path)
+    except Exception as e:  # noqa: BLE001 — e.g. weights-only smoke model dir
+        print(f"warning: no tokenizer at {path} ({e}); continuing without one")
+        return None
+
+
+def maybe_start_local_server(config: GRPOConfig, trainer_params=None, model_cfg=None):
+    """Single-host mode: in-process server on this host's chips."""
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.inference.server import ServerThread
+
+    scfg = config.server
+    scfg.model_path = scfg.model_path or config.actor.path
+    engine = DecodeEngine(scfg, params=trainer_params, model_cfg=model_cfg)
+    engine.initialize()
+    server = ServerThread(scfg, engine)
+    server.start()
+    return server
+
+
+def reward_for(dataset_type: str):
+    if dataset_type == "synthetic_arith":
+        from areal_tpu.reward.synthetic import arith_char_reward_fn
+
+        return arith_char_reward_fn
+    return gsm8k_reward_fn
+
+
+def main(argv):
+    config, _ = load_expr_config(argv, GRPOConfig)
+    tokenizer = load_tokenizer(config.tokenizer_path or config.actor.path)
+
+    ds_type = config.train_dataset.type or "gsm8k"
+    train_dataset = get_custom_dataset(
+        ds_type, split="train", path=config.train_dataset.path
+    )
+    valid_dataset = None
+    if config.valid_dataset is not None:
+        valid_dataset = get_custom_dataset(
+            config.valid_dataset.type or ds_type,
+            split="test",
+            path=config.valid_dataset.path,
+        )
+
+    server = None
+    actor_engine = None
+    addrs = [a for a in os.environ.get("AREAL_TPU_SERVER_ADDRS", "").split(",") if a]
+    if not addrs:
+        # single-host: build the trainer engine first so the server shares
+        # its weights (no double HF load, zero-copy mem updates)
+        import jax
+
+        from areal_tpu.api.io_struct import FinetuneSpec
+        from areal_tpu.engine.train_engine import JaxTrainEngine
+
+        config.weight_update_mode = "mem"
+        config.actor.temperature = config.gconfig.temperature
+        actor_engine = JaxTrainEngine(config.actor)
+        actor_engine.initialize(
+            FinetuneSpec(
+                total_train_epochs=config.total_train_epochs,
+                dataset_size=len(train_dataset),
+                train_batch_size=config.train_dataset.batch_size,
+            )
+        )
+        server = maybe_start_local_server(
+            config,
+            trainer_params=jax.tree.map(np.asarray, actor_engine.params),
+            model_cfg=actor_engine.model_cfg,
+        )
+        addrs = [server.address]
+    rollout = RemoteJaxEngine(config.rollout, addresses=addrs)
+    rollout.initialize()
+
+    reward_fn = reward_for(ds_type)
+    workflow = RLVRWorkflow(reward_fn, config.gconfig, tokenizer=tokenizer)
+    eval_workflow = RLVRWorkflow(
+        reward_fn, config.gconfig.new(temperature=0.6), tokenizer=tokenizer
+    )
+
+    trainer = PPOTrainer(
+        config,
+        train_dataset,
+        valid_dataset=valid_dataset,
+        rollout=rollout,
+        tokenizer=tokenizer,
+        actor_engine=actor_engine,
+    )
+    try:
+        trainer.train(workflow=workflow, eval_workflow=eval_workflow)
+    finally:
+        trainer.close()
+        if server is not None:
+            server.stop()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,159 @@
+"""PPO algorithm layer + trainer loop tests (reference tests/test_functional
+.py advantage parts, tests/grpo/test_grpo.py role at unit scale)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import (
+    DatasetConfig,
+    MeshConfig,
+    NormConfig,
+    OptimizerConfig,
+    PPOActorConfig,
+    PPOConfig,
+    RecoverConfig,
+    SaverConfig,
+    StatsLoggerConfig,
+)
+from areal_tpu.api.io_struct import FinetuneSpec
+from areal_tpu.engine.train_engine import JaxTrainEngine
+from areal_tpu.trainer.ppo import PPOActor
+
+from tpu_testing import TINY_QWEN2, random_batch
+
+
+def _actor_cfg(**kw):
+    base = dict(
+        init_from_scratch=True,
+        dtype="float32",
+        param_dtype="float32",
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        optimizer=OptimizerConfig(lr=5e-3, lr_scheduler_type="constant"),
+        bucket_step=64,
+        group_size=1,
+        ppo_n_minibatches=1,
+        adv_norm=None,
+        kl_ctl=0.0,
+        use_decoupled_loss=False,
+        recompute_logprob=False,
+    )
+    base.update(kw)
+    return PPOActorConfig(**base)
+
+
+def _rl_batch(n=4, seed=0, L=24, reward=1.0):
+    """Token-aligned rollout-style batch: prompt 4 tokens, rest response."""
+    rng = np.random.default_rng(seed)
+    B = n
+    ids = rng.integers(1, 250, (B, L)).astype(np.int32)
+    attn = np.ones((B, L), bool)
+    lm = np.zeros((B, L), np.float32)
+    lm[:, 4:] = 1.0
+    return {
+        "input_ids": ids,
+        "attention_mask": attn,
+        "loss_mask": lm,
+        "logprobs": rng.normal(-1.5, 0.2, (B, L)).astype(np.float32),
+        "versions": np.zeros((B, L), np.int32),
+        "rewards": np.full((B,), reward, np.float32),
+        "seq_no_eos_mask": np.zeros((B,), bool),
+    }
+
+
+@pytest.fixture(scope="module")
+def actor():
+    cfg = _actor_cfg()
+    eng = JaxTrainEngine(cfg, model_config=TINY_QWEN2)
+    eng.initialize(FinetuneSpec(1, 64, 4))
+    return PPOActor(cfg, eng)
+
+
+def test_advantages_grpo_semantics(actor):
+    """kl_ctl=0, values=0, gamma=lam=1: every response label position gets
+    advantage == reward_score (cumulative future reward)."""
+    batch = _rl_batch(reward=2.0)
+    out = actor.compute_advantages(batch)
+    adv = out["advantages"]
+    lm = out["loss_mask"]
+    np.testing.assert_allclose(adv[lm > 0], 2.0, atol=1e-5)
+    # label-aligned mask: position t masks token t+1
+    assert lm[0, 3] == 1.0 and lm[0, 2] == 0.0
+    # rolled logprobs are behavior logprobs of labels
+    assert "old_logprobs" in out and "advantages" in out
+
+
+def test_advantages_kl_reward(actor):
+    """kl_ctl>0 subtracts k1 KL from token rewards."""
+    cfg = _actor_cfg(kl_ctl=0.1)
+    a2 = PPOActor(cfg, actor.engine)
+    batch = _rl_batch(reward=0.0)
+    batch["ref_logp"] = batch["logprobs"] - 0.5  # old - ref = +0.5 everywhere
+    out = a2.compute_advantages(batch)
+    # kl reward = -0.1 * 0.5 at masked positions
+    kl_r = out["kl_rewards"]
+    lm = out["loss_mask"]
+    np.testing.assert_allclose(kl_r[lm > 0], -0.05, atol=1e-5)
+
+
+def test_ppo_update_learns(actor):
+    """Positive advantages on response tokens must raise their logprobs."""
+    batch = _rl_batch(reward=1.0, seed=3)
+    lp0 = actor.compute_logp(batch)
+    adv = actor.compute_advantages(dict(batch))
+    for _ in range(5):
+        actor.ppo_update(dict(adv))
+    lp1 = actor.compute_logp(batch)
+    lm_tok = np.asarray(batch["loss_mask"]) > 0
+    assert (lp1[lm_tok] - lp0[lm_tok]).mean() > 0.05
+
+
+def test_decoupled_loss_with_prox_recompute():
+    cfg = _actor_cfg(
+        use_decoupled_loss=True,
+        prox_logp_mode="recompute",
+        behav_imp_weight_cap=5.0,
+    )
+    eng = JaxTrainEngine(cfg, model_config=TINY_QWEN2)
+    eng.initialize(FinetuneSpec(1, 64, 4))
+    actor = PPOActor(cfg, eng)
+    assert actor.should_compute_prox_logp()
+    batch = _rl_batch(seed=5)
+    batch["prox_logp"] = actor.compute_logp(batch)
+    adv = actor.compute_advantages(batch)
+    stats = actor.ppo_update(adv)
+    assert np.isfinite(stats[0]["loss"])
+    assert "behave_imp_weight" in stats[0]
+
+
+def test_loglinear_prox_alpha():
+    cfg = _actor_cfg(use_decoupled_loss=True, prox_logp_mode="loglinear")
+    eng = JaxTrainEngine(cfg, model_config=TINY_QWEN2)
+    eng.initialize(FinetuneSpec(1, 64, 4))
+    eng.set_version(4)
+    actor = PPOActor(cfg, eng)
+    assert not actor.should_compute_prox_logp()
+    batch = _rl_batch(seed=6)
+    batch["versions"] = np.full_like(batch["versions"], 2)  # behave v=2, θ=4
+    adv = actor.compute_advantages(batch)
+    # alpha = (v_prox - v_behave)/(v_theta - v_behave) = (3-2)/(4-2) = 0.5
+    lm = adv["loss_mask"] > 0
+    np.testing.assert_allclose(adv["prox_alpha"][lm], 0.5, atol=1e-6)
+    stats = actor.ppo_update(adv)
+    assert np.isfinite(stats[0]["loss"])
+
+
+def test_gspo_and_sapo_run(actor):
+    for kw in (
+        dict(imp_ratio_level="sequence"),
+        dict(use_sapo_loss=True, use_decoupled_loss=False),
+        dict(use_m2po_loss=True, m2po_tau=0.5),
+        dict(c_clip=3.0),
+        dict(eps_clip_higher=0.3),
+    ):
+        cfg = _actor_cfg(**kw)
+        a = PPOActor(cfg, actor.engine)
+        adv = a.compute_advantages(_rl_batch(seed=7))
+        stats = a.ppo_update(adv)
+        assert np.isfinite(stats[0]["loss"]), kw

@@ -1,0 +1,109 @@
+"""Checkpoint/recover tests (reference tests/test_recover.py role): orbax
+round-trip with optimizer state, RecoverHandler dump/load policy, dataloader
+position restore."""
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import (
+    MeshConfig,
+    MicroBatchSpec,
+    OptimizerConfig,
+    RecoverConfig,
+    SaverConfig,
+    TrainEngineConfig,
+)
+from areal_tpu.api.io_struct import FinetuneSpec, SaveLoadMeta, StepInfo
+from areal_tpu.engine.train_engine import JaxTrainEngine
+from areal_tpu.utils.data import StatefulDataLoader
+from areal_tpu.utils.recover import RecoverHandler
+from areal_tpu.utils.saver import Saver
+
+from tpu_testing import TINY_QWEN2, random_batch
+
+
+def _engine():
+    cfg = TrainEngineConfig(
+        init_from_scratch=True,
+        dtype="float32",
+        param_dtype="float32",
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        optimizer=OptimizerConfig(lr=1e-3, lr_scheduler_type="constant"),
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=4096),
+        bucket_step=64,
+    )
+    eng = JaxTrainEngine(cfg, model_config=TINY_QWEN2)
+    eng.initialize(FinetuneSpec(1, 64, 8))
+    return eng
+
+
+def _loss(outputs, b):
+    import jax
+    import jax.numpy as jnp
+
+    lm = (b["label_valid"] & (b["loss_mask"] > 0)).astype(jnp.float32)
+    loss = -(outputs["logprobs"] * lm).sum() / jnp.maximum(lm.sum(), 1)
+    return loss, {}
+
+
+def _wf(d):
+    return float((np.asarray(d["loss_mask"]) > 0).sum())
+
+
+def test_orbax_roundtrip_with_optimizer(tmp_path):
+    import jax
+
+    eng = _engine()
+    batch = random_batch(seed=1)
+    eng.train_batch(batch, _loss, _wf)
+    eng.save(SaveLoadMeta(path=str(tmp_path / "ck"), weight_format="orbax", with_optim=True))
+    ref_params = jax.tree.map(np.asarray, eng.params)
+
+    eng2 = _engine()
+    eng2.load(SaveLoadMeta(path=str(tmp_path / "ck"), weight_format="orbax", with_optim=True))
+    jax.tree.map(
+        np.testing.assert_array_equal,
+        ref_params,
+        jax.tree.map(np.asarray, eng2.params),
+    )
+    # next step must be identical (optimizer state restored)
+    s1 = eng.train_batch(batch, _loss, _wf)
+    s2 = eng2.train_batch(batch, _loss, _wf)
+    assert abs(s1["loss"] - s2["loss"]) < 1e-5
+
+
+def test_recover_handler_policy(tmp_path):
+    cfg = RecoverConfig(
+        mode="auto",
+        freq_steps=1,
+        fileroot=str(tmp_path),
+        experiment_name="rc",
+        trial_name="t",
+    )
+    h = RecoverHandler(cfg)
+    assert not h.should_load()  # nothing dumped yet
+
+    eng = _engine()
+    eng.set_version(3)
+    dl = StatefulDataLoader(list(range(40)), batch_size=4)
+    it = iter(dl)
+    next(it), next(it)
+    saver = Saver(SaverConfig(freq_steps=5, fileroot=str(tmp_path)), None)
+    step = StepInfo(epoch=0, epoch_step=2, global_step=2, steps_per_epoch=10)
+    assert h.dump(eng, step, saver=saver, dataloader=dl) is not None
+    assert h.should_load()
+
+    eng2 = _engine()
+    dl2 = StatefulDataLoader(list(range(40)), batch_size=4)
+    info = h.load(eng2, dataloader=dl2)
+    assert info.last_step_info.global_step == 2
+    assert info.last_step_info.next().global_step == 3
+    assert eng2.get_version() == 3
+    assert dl2.state_dict() == dl.state_dict()
+
+    # disabled mode never dumps/loads
+    h2 = RecoverHandler(
+        RecoverConfig(mode="disabled", freq_steps=1, fileroot=str(tmp_path / "x"))
+    )
+    assert h2.dump(eng, step) is None
+    assert not h2.should_load()

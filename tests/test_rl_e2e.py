@@ -1,0 +1,153 @@
+"""End-to-end async RL: DecodeEngine server + RemoteJaxEngine + PPOTrainer.
+
+The tiny from-scratch policy must learn a verifiable preference (emit token
+TARGET first) through the full stack — rollout over HTTP, staleness-gated
+async pipeline, GRPO advantages, mem-mode weight updates back to the server.
+This is the unit-scale version of the reference's GSM8K GRPO learning test
+(tests/grpo/test_grpo.py, reward > 0.6 bar)."""
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import (
+    DatasetConfig,
+    EvaluatorConfig,
+    InferenceEngineConfig,
+    MeshConfig,
+    MicroBatchSpec,
+    NormConfig,
+    OptimizerConfig,
+    PPOActorConfig,
+    PPOConfig,
+    RecoverConfig,
+    SaverConfig,
+    ServerConfig,
+    StatsLoggerConfig,
+)
+from areal_tpu.api.io_struct import FinetuneSpec, GenerationHyperparameters
+from areal_tpu.engine.train_engine import JaxTrainEngine
+from areal_tpu.inference.client import RemoteJaxEngine
+from areal_tpu.inference.decode_engine import DecodeEngine
+from areal_tpu.inference.server import ServerThread
+from areal_tpu.trainer.rl_trainer import PPOTrainer
+from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+from tpu_testing import TINY_QWEN2
+
+TARGET = 7
+GROUP = 4
+
+
+def reward_fn(prompt, completions, prompt_ids, completion_ids, **kw):
+    return 1.0 if TARGET in completion_ids else 0.0
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    import jax
+
+    root = str(tmp_path_factory.mktemp("rl_e2e"))
+    actor_cfg = PPOActorConfig(
+        init_from_scratch=True,
+        dtype="float32",
+        param_dtype="float32",
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        optimizer=OptimizerConfig(lr=2e-2, lr_scheduler_type="constant"),
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=100_000),
+        bucket_step=64,
+        group_size=GROUP,
+        ppo_n_minibatches=1,
+        adv_norm=NormConfig(mean_level="group", std_level="group", group_size=GROUP),
+        kl_ctl=0.0,
+        use_decoupled_loss=True,
+        prox_logp_mode="recompute",
+        eps_clip=0.4,
+        temperature=1.0,
+    )
+    engine = JaxTrainEngine(actor_cfg, model_config=TINY_QWEN2)
+    engine.initialize(FinetuneSpec(1, 32, 8))
+
+    scfg = ServerConfig(
+        max_batch_size=8,
+        max_seq_len=64,
+        decode_steps_per_call=4,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+    )
+    dec = DecodeEngine(
+        scfg,
+        params=jax.tree.map(np.asarray, engine.params),
+        model_cfg=TINY_QWEN2,
+    )
+    dec.initialize()
+    server = ServerThread(scfg, dec)
+    server.start()
+
+    rollout = RemoteJaxEngine(
+        InferenceEngineConfig(
+            max_concurrent_rollouts=8,
+            consumer_batch_size=4,
+            max_head_offpolicyness=2,
+            request_timeout=300,
+        ),
+        addresses=[server.address],
+    )
+    rollout.initialize()
+
+    cfg = PPOConfig(
+        experiment_name="e2e",
+        trial_name="t0",
+        total_train_epochs=12,
+        weight_update_mode="mem",
+        gconfig=GenerationHyperparameters(
+            n_samples=GROUP, max_new_tokens=4, temperature=1.0
+        ),
+        train_dataset=DatasetConfig(batch_size=4, shuffle=True),
+        actor=actor_cfg,
+        saver=SaverConfig(fileroot=root),
+        checkpointer=SaverConfig(fileroot=root),
+        evaluator=EvaluatorConfig(fileroot=root),
+        recover=RecoverConfig(mode="disabled", fileroot=root),
+        stats_logger=StatsLoggerConfig(fileroot=root),
+    )
+    cfg.cluster.fileroot = root
+    rng = np.random.default_rng(0)
+    dataset = [
+        {"prompt_ids": rng.integers(20, 200, 4).tolist()} for _ in range(32)
+    ]
+    trainer = PPOTrainer(cfg, dataset, rollout=rollout, actor_engine=engine)
+    yield trainer, server, dataset
+    server.stop()
+
+
+def _first_token_hit_rate(trainer, dataset, n=16):
+    """Direct agenerate probe — bypasses the staleness-gated dispatcher so
+    the probe does not consume the training pipeline's capacity budget."""
+    import asyncio
+
+    from areal_tpu.api.io_struct import ModelRequest
+
+    async def probe():
+        reqs = [
+            ModelRequest(
+                input_ids=row["prompt_ids"],
+                gconfig=GenerationHyperparameters(n_samples=1, max_new_tokens=4),
+            )
+            for row in dataset[:n]
+        ]
+        resps = await asyncio.gather(*[trainer.rollout.agenerate(r) for r in reqs])
+        return float(np.mean([TARGET in r.output_tokens for r in resps]))
+
+    return asyncio.run(probe())
+
+
+def test_rl_learns_target_token(stack):
+    trainer, server, dataset = stack
+    wf = RLVRWorkflow(reward_fn, trainer.config.gconfig)
+    before = _first_token_hit_rate(trainer, dataset)
+    trainer.train(workflow=wf)
+    after = _first_token_hit_rate(trainer, dataset)
+    # from-scratch vocab-256 model: chance ~1/256; trained should be >0.5
+    assert after > max(0.5, before + 0.3), (before, after)
+    # versions advanced through the full stack
+    assert trainer.actor_engine.get_version() > 0
+    assert server.engine.get_version() == trainer.actor_engine.get_version()
