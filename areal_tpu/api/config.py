@@ -262,6 +262,7 @@ class PerfTracerConfig:
     enabled: bool = False
     output_dir: str | None = None
     save_freq_steps: int = 10
+    max_events: int = 200_000  # in-memory ring bound (oldest dropped)
 
 
 @dataclass

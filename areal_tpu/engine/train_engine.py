@@ -131,6 +131,7 @@ class JaxTrainEngine(TrainEngine):
                 **mcfg.__dict__,
                 "dtype": cfg.dtype,
                 "remat": cfg.gradient_checkpointing,
+                "attn_impl": cfg.attn_impl,
             }
         )
         self.model_cfg = mcfg

@@ -142,6 +142,10 @@ class WorkflowExecutor:
 
     def _launch(self, rec: _TaskRecord, workflow: RolloutWorkflow, accept_fn) -> None:
         async def run():
+            from areal_tpu.utils import perf_tracer
+
+            perf_tracer.set_task_context(task_id=rec.task_id)
+            perf_tracer.get_session_tracer().start_session(rec.task_id)
             traj = await workflow.arun_episode(self.engine, rec.data)
             return (traj, accept_fn)
 
@@ -165,6 +169,11 @@ class WorkflowExecutor:
         else:
             self.staleness.on_reject()
             stats_tracker.get().scalar(rollout_rejected=1.0)
+        from areal_tpu.utils import perf_tracer
+
+        perf_tracer.get_session_tracer().finalize(
+            task_id, "accepted" if accepted else "rejected"
+        )
         with self._cv:
             if rec is not None:
                 rec.result = traj if accepted else None

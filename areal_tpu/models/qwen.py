@@ -56,6 +56,10 @@ class ModelConfig:
     attention_bias: bool = True  # Qwen2 has q/k/v bias
     dtype: str = "bfloat16"
     remat: bool = True
+    # training attention: "xla" (masked sdpa, Ulysses via GSPMD a2a),
+    # "ring" (shard_map ring attention over the mesh "seq" axis),
+    # "pallas" (fused flash kernel; falls back to xla off-TPU)
+    attn_impl: str = "xla"
 
     @property
     def head_dim_(self) -> int:
@@ -233,16 +237,10 @@ def _attention_mask(segment_ids: jax.Array) -> jax.Array:
 
 
 def _sdpa(q, k, v, mask, head_dim: int):
-    """Plain XLA attention: einsum + fp32 softmax. q,k,v: [G, L, H, hd].
+    """XLA attention — single source of truth in ops/attention.py."""
+    from areal_tpu.ops.attention import sdpa_xla
 
-    XLA fuses and tiles this onto the MXU; a Pallas flash kernel can override
-    it via areal_tpu.ops.attention (see ops/attention.py).
-    """
-    scale = head_dim**-0.5
-    logits = jnp.einsum("gqhd,gkhd->ghqk", q, k).astype(jnp.float32) * scale
-    logits = jnp.where(mask, logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-    return jnp.einsum("ghqk,gkhd->gqhd", probs, v)
+    return sdpa_xla(q, k, v, mask, head_dim)
 
 
 def _decoder_layer(cfg: ModelConfig, x, layer, mask, positions):
@@ -267,12 +265,36 @@ def _decoder_layer(cfg: ModelConfig, x, layer, mask, positions):
     if KH != H:
         k = jnp.repeat(k, H // KH, axis=2)
         v = jnp.repeat(v, H // KH, axis=2)
-    # head-sharded region: XLA inserts the seq<->head all-to-all here when a
-    # "seq" axis is active (Ulysses SP, reference models/fsdp/ulysses.py)
-    q = _shard(q, P(BATCH_AXES, None, "model", None))
-    k = _shard(k, P(BATCH_AXES, None, "model", None))
-    v = _shard(v, P(BATCH_AXES, None, "model", None))
-    attn = _sdpa(q, k, v, mask, hd)
+    from areal_tpu.ops.attention import resolve_impl
+
+    impl = resolve_impl(cfg.attn_impl, L, hd)
+    if impl == "ring":
+        # context parallelism: q/k/v stay seq-sharded; K/V rotate the ring
+        # (parallel/ring_attention.py). mask here is (segment_ids, col_index).
+        from areal_tpu.parallel.ring_attention import ring_attention
+
+        seg, col = mask
+        q = _shard(q, P(BATCH_AXES, "seq", "model", None))
+        k = _shard(k, P(BATCH_AXES, "seq", "model", None))
+        v = _shard(v, P(BATCH_AXES, "seq", "model", None))
+        attn = ring_attention(q, k, v, seg, col)
+    else:
+        # Ulysses region (reference models/fsdp/ulysses.py:44-202): outside
+        # attention, activations are seq-sharded; inside, heads are sharded
+        # over model×seq and the sequence is whole. GSPMD lowers the
+        # [L/sp, H] -> [L, H/sp] reshard to the head<->seq all-to-all — the
+        # a2a moves 1/sp of the activation vs. a full all-gather. kv heads
+        # were already replicated to H above (the GQA sp>kv_heads case,
+        # ulyssess_patch.py:43-47).
+        q = _shard(q, P(BATCH_AXES, None, ("model", "seq"), None))
+        k = _shard(k, P(BATCH_AXES, None, ("model", "seq"), None))
+        v = _shard(v, P(BATCH_AXES, None, ("model", "seq"), None))
+        if impl == "pallas":
+            from areal_tpu.ops.attention import flash_train
+
+            attn = flash_train(q, k, v, mask)  # mask is segment_ids here
+        else:
+            attn = _sdpa(q, k, v, mask, hd)
     attn = attn.reshape(G, L, H * hd)
     x = x + _shard(attn @ layer["wo"], P(BATCH_AXES, "seq", None))
 
@@ -300,7 +322,18 @@ def forward(
     """Decoder body -> final hidden states [G, L, D]."""
     x = jnp.take(params["embed"], input_ids, axis=0).astype(cfg.jax_dtype)
     x = _shard(x, P(BATCH_AXES, "seq", None))
-    mask = _attention_mask(segment_ids)
+    from areal_tpu.ops.attention import resolve_impl
+
+    impl = resolve_impl(cfg.attn_impl, segment_ids.shape[-1], cfg.head_dim_)
+    if impl == "ring":
+        # ring attention masks from per-token metadata, not an [L, L] matrix
+        L = segment_ids.shape[-1]
+        col = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), segment_ids.shape)
+        mask = (segment_ids, col)
+    elif impl == "pallas":
+        mask = segment_ids  # flash kernel masks from segment ids alone
+    else:
+        mask = _attention_mask(segment_ids)
 
     layer_fn = partial(_decoder_layer, cfg)
     if cfg.remat:

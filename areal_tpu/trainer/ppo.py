@@ -136,10 +136,12 @@ class PPOActor:
     def __init__(self, config: PPOActorConfig, engine: TrainEngine):
         self.config = config
         self.engine = engine
+        # group_reward_norm: normalize the scalar task reward within each
+        # GRPO sample group (reference group_reward_norm semantics)
         self.reward_norm = (
             Normalization(
-                mean_level=config.adv_norm.mean_level if config.adv_norm else "batch",
-                std_level="batch",
+                mean_level="group",
+                std_level="group",
                 group_size=config.group_size,
             )
             if config.group_reward_norm

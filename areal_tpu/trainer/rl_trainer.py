@@ -23,7 +23,8 @@ from areal_tpu.api.config import PPOConfig
 from areal_tpu.api.io_struct import StepInfo, WeightUpdateMeta
 from areal_tpu.engine.train_engine import JaxTrainEngine
 from areal_tpu.trainer.ppo import PPOActor, PPOCritic
-from areal_tpu.utils import logging as alog, stats_tracker
+from areal_tpu.utils import logging as alog, perf_tracer, stats_tracker
+from areal_tpu.utils.perf_tracer import Category
 from areal_tpu.utils.data import StatefulDataLoader
 from areal_tpu.utils.recover import RecoverHandler
 from areal_tpu.utils.saver import Evaluator, Saver
@@ -95,6 +96,23 @@ class PPOTrainer:
             )
             rollout.initialize()
         self.rollout = rollout
+        # eval must NOT share the training executor: its results buffer
+        # interleaves with async training trajectories (the reference builds
+        # a separate eval_rollout client for the same reason)
+        if eval_rollout is None and valid_dataset is not None:
+            import dataclasses as _dc
+
+            from areal_tpu.inference.client import RemoteJaxEngine
+
+            eval_cfg = _dc.replace(
+                config.rollout,
+                max_head_offpolicyness=10_000_000,  # eval is version-agnostic
+                max_concurrent_rollouts=config.rollout.max_concurrent_rollouts,
+            )
+            eval_rollout = RemoteJaxEngine(
+                eval_cfg, addresses=list(self.rollout.addresses)
+            )
+            eval_rollout.initialize()
         self.eval_rollout = eval_rollout
 
         # weight update channel
@@ -122,6 +140,7 @@ class PPOTrainer:
             c.trial_name = c.trial_name or config.trial_name
             if hasattr(c, "fileroot"):
                 c.fileroot = c.fileroot or config.cluster.fileroot
+        perf_tracer.configure(config.perf_tracer, rank=0, role="trainer")
         self.saver = Saver(config.saver, self.ft_spec)
         self.evaluator = Evaluator(config.evaluator, self.ft_spec)
         self.recover_handler = RecoverHandler(config.recover, self.ft_spec)
@@ -158,7 +177,9 @@ class PPOTrainer:
             step = global_step % steps_per_epoch
             t_step = time.monotonic()
 
-            with stats_tracker.record_timing("rollout"):
+            with stats_tracker.record_timing("rollout"), perf_tracer.trace_scope(
+                "train.rollout", Category.COMPUTE, {"global_step": global_step}
+            ):
                 batch = self.rollout.prepare_batch(
                     self.train_dataloader,
                     workflow=workflow,
@@ -166,21 +187,31 @@ class PPOTrainer:
                 )
 
             if self.critic is not None:
-                with stats_tracker.record_timing("critic_values"):
+                with stats_tracker.record_timing("critic_values"), perf_tracer.trace_scope(
+                    "train.compute_values", Category.COMPUTE
+                ):
                     batch["values"] = self.critic.compute_values(batch)
 
             if self.actor.should_compute_prox_logp():
-                with stats_tracker.record_timing("recompute_logp"):
+                with stats_tracker.record_timing("recompute_logp"), perf_tracer.trace_scope(
+                    "train.recompute_logp", Category.COMPUTE
+                ):
                     batch["prox_logp"] = self.actor.compute_logp(batch)
 
             if self.ref is not None:
-                with stats_tracker.record_timing("ref_logp"):
+                with stats_tracker.record_timing("ref_logp"), perf_tracer.trace_scope(
+                    "train.ref_logp", Category.COMPUTE
+                ):
                     batch["ref_logp"] = self.ref.compute_logp(batch)
 
-            with stats_tracker.record_timing("compute_advantages"):
+            with stats_tracker.record_timing("compute_advantages"), perf_tracer.trace_scope(
+                "train.compute_advantages", Category.COMPUTE
+            ):
                 adv_batch = self.actor.compute_advantages(batch)
 
-            with stats_tracker.record_timing("train_step"):
+            with stats_tracker.record_timing("train_step"), perf_tracer.trace_scope(
+                "train.ppo_update", Category.COMPUTE
+            ):
                 self.actor.ppo_update(adv_batch)
             if self.critic is not None:
                 with stats_tracker.record_timing("critic_train_step"):
@@ -188,7 +219,9 @@ class PPOTrainer:
 
             # §3.4 protocol: stop submissions, push weights, advance version
             self.rollout.pause()
-            with stats_tracker.record_timing("update_weights"):
+            with stats_tracker.record_timing("update_weights"), perf_tracer.trace_scope(
+                "train.update_weights", Category.COMM
+            ):
                 new_version = global_step + 1
                 self.actor_engine.update_weights(self.weight_update_meta)
                 self.actor_engine.set_version(new_version)
@@ -198,7 +231,9 @@ class PPOTrainer:
                 if self.eval_rollout is not None:
                     self.eval_rollout.set_version(new_version)
 
-            with stats_tracker.record_timing("save"):
+            with stats_tracker.record_timing("save"), perf_tracer.trace_scope(
+                "train.save", Category.IO
+            ):
                 self.saver.maybe_save(
                     self.actor_engine, epoch, step, global_step, self.tokenizer
                 )
@@ -228,13 +263,16 @@ class PPOTrainer:
             stats["step_secs"] = time.monotonic() - t_step
             stats["version"] = float(new_version)
             self.stats_logger.commit(epoch, step, global_step, stats)
+            perf_tracer.save(step=global_step)
 
     def _maybe_evaluate(self, eval_workflow, epoch: int, global_step: int) -> None:
         if self.valid_dataset is None or eval_workflow is None:
             return
 
         def run_eval():
-            client = self.eval_rollout or self.rollout
+            client = self.eval_rollout
+            if client is None:
+                return
             batch = client.rollout_batch(
                 list(self.valid_dataset), workflow=eval_workflow
             )

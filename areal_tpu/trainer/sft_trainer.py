@@ -111,6 +111,15 @@ class RWEngine:
         self.mb_spec = dataclasses.replace(
             mb_spec or MicroBatchSpec(), granularity=2
         )
+        # pair integrity is guaranteed by OUR granularity-2 split; the
+        # engine's internal token-budget FFD re-split is pair-blind and
+        # could strand a pair's halves in different grids (marks>=2 gate
+        # would then silently drop them) — disable it
+        self.engine.config.mb_spec = MicroBatchSpec(max_tokens_per_mb=None)
+        if self.mb_spec.max_tokens_per_mb is None:
+            self.mb_spec = dataclasses.replace(
+                self.mb_spec, max_tokens_per_mb=32768
+            )
 
     def _prep(self, mb) -> dict:
         mb = dict(mb)

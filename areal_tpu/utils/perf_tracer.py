@@ -1,0 +1,343 @@
+"""Chrome-trace performance tracer + per-rollout session tracer.
+
+Plays the role of reference areal/utils/perf_tracer.py (2,123 LoC): emits
+catapult JSON ("traceEvents") viewable in chrome://tracing or Perfetto, plus
+a JSONL of rollout-session lifecycles. Cross-async propagation uses
+ContextVars, so events recorded inside workflow coroutines attach to the
+right task/session (reference :28-38).
+
+Surface:
+    configure(cfg, rank=..., role=...)      process-level setup
+    trace_scope(name, category=..., args=)  sync context manager
+    atrace_scope(name, ...)                 async context manager
+    instant(name, ...)                      point event
+    counter(name, **values)                 counter track
+    trace_perf(name, category=...)          decorator
+    save(step=..., force=...)               periodic/final flush
+    SessionTracer / trace_session("phase")  rollout lifecycle records
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import functools
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any
+
+from areal_tpu.api.config import PerfTracerConfig
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("perf_tracer")
+
+
+class Category(str, Enum):
+    COMPUTE = "compute"
+    COMM = "comm"
+    IO = "io"
+    SCHEDULER = "scheduler"
+    INSTR = "instr"
+
+
+_task_id_var: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "areal_tpu_trace_task", default=None
+)
+_session_id_var: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "areal_tpu_trace_session", default=None
+)
+
+
+def set_task_context(task_id: str | None = None, session_id: str | None = None):
+    if task_id is not None:
+        _task_id_var.set(task_id)
+    if session_id is not None:
+        _session_id_var.set(session_id)
+
+
+class PerfTracer:
+    """Catapult JSON event collector for one process."""
+
+    def __init__(self, config: PerfTracerConfig, rank: int = 0, role: str | None = None):
+        self.config = config
+        self.enabled = config.enabled
+        self.rank = rank
+        self.role = role
+        self._events: list[dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+        self._last_save_step = -1
+
+    # -- event emission ----------------------------------------------------
+    def _ts_us(self) -> float:
+        return time.perf_counter_ns() / 1e3
+
+    def _base(self, name: str, ph: str, category) -> dict[str, Any]:
+        cat = category.value if isinstance(category, Category) else (category or "instr")
+        return {
+            "name": name,
+            "ph": ph,
+            "pid": self._pid,
+            "tid": threading.get_ident() % 2**31,
+            "ts": self._ts_us(),
+            "cat": cat,
+        }
+
+    def _push(self, ev: dict[str, Any]) -> None:
+        with self._lock:
+            self._events.append(ev)
+            # bound memory on long runs: keep the newest max_events
+            cap = getattr(self.config, "max_events", 200_000)
+            if cap and len(self._events) > cap:
+                del self._events[: len(self._events) - cap]
+
+    @contextlib.contextmanager
+    def trace_scope(self, name: str, category=Category.COMPUTE, args: dict | None = None):
+        if not self.enabled:
+            yield
+            return
+        ev = self._base(name, "X", category)
+        if args or _task_id_var.get():
+            ev["args"] = {**(args or {})}
+            if _task_id_var.get():
+                ev["args"]["task_id"] = _task_id_var.get()
+        t0 = self._ts_us()
+        try:
+            yield
+        finally:
+            ev["ts"] = t0
+            ev["dur"] = self._ts_us() - t0
+            self._push(ev)
+
+    @contextlib.asynccontextmanager
+    async def atrace_scope(self, name: str, category=Category.COMPUTE, args: dict | None = None):
+        with self.trace_scope(name, category, args):
+            yield
+
+    def instant(self, name: str, category=Category.INSTR, args: dict | None = None) -> None:
+        if not self.enabled:
+            return
+        ev = self._base(name, "i", category)
+        ev["s"] = "t"
+        if args:
+            ev["args"] = args
+        self._push(ev)
+
+    def counter(self, name: str, **values: float) -> None:
+        if not self.enabled:
+            return
+        ev = self._base(name, "C", Category.INSTR)
+        ev["args"] = values
+        self._push(ev)
+
+    # -- persistence -------------------------------------------------------
+    def _path(self) -> str:
+        out = self.config.output_dir or "/tmp/areal_tpu/traces"
+        os.makedirs(out, exist_ok=True)
+        role = f"{self.role}_" if self.role else ""
+        return os.path.join(out, f"trace_{role}rank{self.rank}.json")
+
+    def save(self, step: int | None = None, force: bool = False) -> None:
+        if not self.enabled:
+            return
+        if not force and step is not None:
+            if step - self._last_save_step < max(1, self.config.save_freq_steps):
+                return
+            self._last_save_step = step
+        with self._lock:
+            events = list(self._events)
+        with open(self._path(), "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+@dataclass
+class SessionRecord:
+    """Lifecycle of one rollout episode (reference SessionTracer :920-1125)."""
+
+    session_id: str
+    start_ts: float = field(default_factory=time.time)
+    phases: list[dict[str, Any]] = field(default_factory=list)
+    status: str | None = None  # accepted | rejected
+    end_ts: float | None = None
+
+
+class SessionTracer:
+    def __init__(self, output_dir: str | None = None, enabled: bool = True):
+        self.enabled = enabled
+        self.output_dir = output_dir or "/tmp/areal_tpu/traces"
+        self._records: dict[str, SessionRecord] = {}
+        self._lock = threading.Lock()
+
+    def start_session(self, session_id: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._records[session_id] = SessionRecord(session_id)
+        _session_id_var.set(session_id)
+
+    @contextlib.contextmanager
+    def phase(self, name: str, session_id: str | None = None):
+        sid = session_id or _session_id_var.get()
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            if self.enabled and sid is not None:
+                with self._lock:
+                    rec = self._records.get(sid)
+                    if rec is not None:
+                        rec.phases.append(
+                            {"name": name, "start": t0, "dur": time.time() - t0}
+                        )
+
+    def finalize(self, session_id: str, status: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            rec = self._records.pop(session_id, None)
+        if rec is None:
+            return
+        rec.status = status
+        rec.end_ts = time.time()
+        os.makedirs(self.output_dir, exist_ok=True)
+        path = os.path.join(self.output_dir, "sessions.jsonl")
+        with open(path, "a") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "session_id": rec.session_id,
+                        "start": rec.start_ts,
+                        "end": rec.end_ts,
+                        "status": rec.status,
+                        "phases": rec.phases,
+                    }
+                )
+                + "\n"
+            )
+
+
+# ---------------------------------------------------------------------------
+# module-level default tracer (reference module functions :1858-1940)
+# ---------------------------------------------------------------------------
+
+_TRACER = PerfTracer(PerfTracerConfig(enabled=False))
+_SESSIONS = SessionTracer(enabled=False)
+
+
+def configure(config: PerfTracerConfig, rank: int = 0, role: str | None = None) -> None:
+    global _TRACER, _SESSIONS
+    _TRACER = PerfTracer(config, rank=rank, role=role)
+    _SESSIONS = SessionTracer(config.output_dir, enabled=config.enabled)
+
+
+def get_tracer() -> PerfTracer:
+    return _TRACER
+
+
+def get_session_tracer() -> SessionTracer:
+    return _SESSIONS
+
+
+def trace_scope(name: str, category=Category.COMPUTE, args: dict | None = None):
+    return _TRACER.trace_scope(name, category, args)
+
+
+def atrace_scope(name: str, category=Category.COMPUTE, args: dict | None = None):
+    return _TRACER.atrace_scope(name, category, args)
+
+
+def instant(name: str, category=Category.INSTR, args: dict | None = None) -> None:
+    _TRACER.instant(name, category, args)
+
+
+def counter(name: str, **values: float) -> None:
+    _TRACER.counter(name, **values)
+
+
+def save(step: int | None = None, force: bool = False) -> None:
+    _TRACER.save(step=step, force=force)
+
+
+def trace_perf(name: str, category=Category.COMPUTE):
+    """Decorator tracing every call of a function (sync or async)."""
+
+    def deco(fn):
+        if _is_coroutine_fn(fn):
+
+            @functools.wraps(fn)
+            async def awrapper(*a, **kw):
+                with _TRACER.trace_scope(name, category):
+                    return await fn(*a, **kw)
+
+            return awrapper
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with _TRACER.trace_scope(name, category):
+                return fn(*a, **kw)
+
+        return wrapper
+
+    return deco
+
+
+def trace_session(phase_name: str):
+    """Decorator recording a session phase (reference @trace_session use in
+    workflow/rlvr.py:77,124)."""
+
+    def deco(fn):
+        if _is_coroutine_fn(fn):
+
+            @functools.wraps(fn)
+            async def awrapper(*a, **kw):
+                with _SESSIONS.phase(phase_name):
+                    return await fn(*a, **kw)
+
+            return awrapper
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with _SESSIONS.phase(phase_name):
+                return fn(*a, **kw)
+
+        return wrapper
+
+    return deco
+
+
+def _is_coroutine_fn(fn) -> bool:
+    import asyncio
+
+    return asyncio.iscoroutinefunction(fn)
+
+
+def merge_traces(paths: list[str], out_path: str) -> None:
+    """Merge per-rank trace files into one (reference
+    tools/perf_trace_converter.py role). pids are remapped per source file so
+    ranks appear as separate process tracks."""
+    merged: list[dict[str, Any]] = []
+    for i, p in enumerate(paths):
+        with open(p) as f:
+            data = json.load(f)
+        for ev in data.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = i
+            merged.append(ev)
+        merged.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": i,
+                "args": {"name": os.path.basename(p)},
+            }
+        )
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)

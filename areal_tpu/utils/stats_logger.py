@@ -32,6 +32,7 @@ class StatsLogger:
         )
 
     def _init_backends(self) -> None:
+        # tensorboard.path semantics: None = disabled, "" = default log dir
         if self.config.tensorboard and self.config.tensorboard.path is not None:
             try:
                 from torch.utils.tensorboard import SummaryWriter

@@ -50,11 +50,14 @@ class RLVRWorkflow(RolloutWorkflow):
         self.enable_thinking = enable_thinking
 
     async def arun_episode(self, engine, data: dict):
+        from areal_tpu.utils import perf_tracer
+
         prompt_ids = prompt_ids_of(data, self.tokenizer, self.enable_thinking)
         n = self.gconfig.n_samples
         gcfg = self.gconfig.new(n_samples=1)
         reqs = [ModelRequest(input_ids=prompt_ids, gconfig=gcfg) for _ in range(n)]
-        resps = await asyncio.gather(*[engine.agenerate(r) for r in reqs])
+        with perf_tracer.get_session_tracer().phase("generate"):
+            resps = await asyncio.gather(*[engine.agenerate(r) for r in reqs])
 
         results = []
         for resp in resps:
@@ -64,17 +67,18 @@ class RLVRWorkflow(RolloutWorkflow):
             prompt_str = (
                 self.tokenizer.decode(prompt_ids) if self.tokenizer else ""
             )
-            reward = await self.reward_fn(
-                prompt_str,
-                completion_str,
-                prompt_ids,
-                resp.output_tokens,
-                **{
-                    k: v
-                    for k, v in data.items()
-                    if k not in ("prompt_ids", "messages", "prompt")
-                },
-            )
+            with perf_tracer.get_session_tracer().phase("reward"):
+                reward = await self.reward_fn(
+                    prompt_str,
+                    completion_str,
+                    prompt_ids,
+                    resp.output_tokens,
+                    **{
+                        k: v
+                        for k, v in data.items()
+                        if k not in ("prompt_ids", "messages", "prompt")
+                    },
+                )
             p, o = len(prompt_ids), len(resp.output_tokens)
             seq = np.asarray(prompt_ids + resp.output_tokens, np.int32)
             results.append(
