@@ -1,5 +1,5 @@
 """Round benchmark: RL-pipeline tokens/sec/chip on a Qwen2.5-1.5B-dimension
-model, run on the real TPU chip. Prints ONE JSON line.
+model, run on the real TPU chip. Prints ONE JSON line on stdout, always.
 
 Metric definition. An RL step is rollout (decode) + train on the same tokens,
 time-shared on one chip, so the pipeline rate is the series combination
@@ -17,18 +17,29 @@ the same tokens this gives a per-chip pipeline rate of ≈4.3e3 tokens/s/chip.
 We use 4300 as the H800 per-chip baseline; one TPU v5e (~197 bf16 TFLOPs) vs
 an H800 (~990) makes vs_baseline < 1 expected on this hardware — the honest
 comparison is per-chip-second of the same pipeline.
+
+Robustness architecture (round-2 fix for the rc=124 silent timeout). The
+parent process never imports jax. Each phase (decode, train) runs in its own
+subprocess with a hard deadline, SIGKILLed as a process group on overrun so a
+wedged TPU client can't outlive us; phases emit stderr heartbeats and a final
+``BENCH_PHASE {json}`` stdout line; the decode phase reports a measured
+partial rate if it times out mid-stream. Whatever happens, the parent prints
+exactly one JSON line.
 """
 
 import json
 import os
+import signal
+import subprocess
 import sys
+import threading
 import time
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
-
-import numpy as np
-
 BASELINE_TOK_S_PER_CHIP = 4300.0
+PHASE_DEADLINE_S = {"probe": 240.0, "decode": 660.0, "train": 660.0}
+# in-phase budget for the decode wait loop (< the external deadline so the
+# partial-result path can fire before the parent SIGKILLs us)
+DECODE_WAIT_S = 480.0
 
 # Qwen2.5-1.5B dimensions (config.json of Qwen/Qwen2.5-1.5B)
 MODEL_KW = dict(
@@ -50,24 +61,65 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def bench_decode(model_cfg) -> float:
+def _emit_phase(payload: dict) -> None:
+    print("BENCH_PHASE " + json.dumps(payload), flush=True)
+
+
+def _start_heartbeat(phase: str):
+    """Background thread: proves liveness to the driver's capture every 20s."""
+    stop = threading.Event()
+    t0 = time.monotonic()
+
+    def run():
+        while not stop.wait(20.0):
+            log(f"[{phase}] heartbeat t={time.monotonic() - t0:.0f}s")
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    return stop
+
+
+# --------------------------------------------------------------------------
+# Phase bodies (run in child processes; these import jax)
+# --------------------------------------------------------------------------
+
+
+def phase_probe():
+    """Fast TPU backend sanity check: import jax, list devices, tiny matmul."""
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    y = (x @ x).block_until_ready()
+    del y
+    _emit_phase(
+        {
+            "phase": "probe",
+            "platform": jax.default_backend(),
+            "n_devices": len(devs),
+        }
+    )
+
+
+def phase_decode():
     """Generated tokens/sec: 48 concurrent slots, 128-token prompts, 256 new
     tokens each, continuous batching."""
+    import numpy as np
     import jax
-    import threading
 
     from areal_tpu.api.config import MeshConfig, ServerConfig
     from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
     from areal_tpu.inference.decode_engine import DecodeEngine
     from areal_tpu.models import qwen
 
+    model_cfg = qwen.ModelConfig(**MODEL_KW)
     cfg = ServerConfig(
         max_batch_size=48,
         max_seq_len=512,
         decode_steps_per_call=32,
         mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
     )
-    params = None
     t0 = time.monotonic()
     params = jax.jit(lambda k: qwen.init_params(k, model_cfg))(jax.random.PRNGKey(0))
     jax.block_until_ready(params)
@@ -80,18 +132,20 @@ def bench_decode(model_cfg) -> float:
     n_req, new_tokens = 96, 256
     done = threading.Event()
     results = []
+    lock = threading.Lock()
 
     def cb(resp):
-        results.append(resp)
-        if len(results) == n_req:
-            done.set()
+        with lock:
+            results.append(resp)
+            if len(results) == n_req:
+                done.set()
 
     # warmup: compile prefill + decode chunk
     warm = ModelRequest(
         input_ids=rng.integers(0, 1000, 128).tolist(),
         gconfig=GenerationHyperparameters(max_new_tokens=32, greedy=True),
     )
-    eng.generate_sync(warm, timeout=900)
+    eng.generate_sync(warm, timeout=PHASE_DEADLINE_S["decode"] - 120.0)
     log("[decode] warmup done")
 
     t0 = time.monotonic()
@@ -103,18 +157,35 @@ def bench_decode(model_cfg) -> float:
             ),
         )
         eng.submit(req, cb)
-    assert done.wait(timeout=1800), f"decode bench stalled: {len(results)}/{n_req}"
+    complete = done.wait(timeout=DECODE_WAIT_S)
     dt = time.monotonic() - t0
-    gen_tokens = sum(len(r.output_tokens) for r in results)
-    eng.stop()
-    del eng, params
-    return gen_tokens / dt
+    with lock:
+        gen_tokens = sum(len(r.output_tokens) for r in results)
+        n_done = len(results)
+    if gen_tokens == 0:
+        raise RuntimeError(f"decode bench produced nothing in {dt:.0f}s")
+    if not complete:
+        log(f"[decode] PARTIAL: {n_done}/{n_req} finished in {dt:.0f}s")
+    tok_s = gen_tokens / dt
+    _emit_phase(
+        {
+            "phase": "decode",
+            "tok_s": tok_s,
+            "partial": not complete,
+            "requests_done": n_done,
+        }
+    )
+    # best-effort teardown; the parent will SIGKILL stragglers anyway
+    try:
+        eng.stop()
+    except Exception:
+        pass
 
 
-def bench_train(model_cfg) -> float:
+def phase_train():
     """Trained tokens/sec: packed GRPO train_batch (fwd+bwd+AdamW), bf16
     master params, remat on."""
-    import jax
+    import numpy as np
     import jax.numpy as jnp
 
     from areal_tpu.api.config import (
@@ -125,9 +196,11 @@ def bench_train(model_cfg) -> float:
     )
     from areal_tpu.api.io_struct import FinetuneSpec
     from areal_tpu.engine.train_engine import JaxTrainEngine
+    from areal_tpu.models import qwen
     from areal_tpu.ops import functional as F
     from areal_tpu.utils.data import pad_sequences_to_tensors
 
+    model_cfg = qwen.ModelConfig(**MODEL_KW)
     cfg = TrainEngineConfig(
         init_from_scratch=True,
         dtype="bfloat16",
@@ -185,28 +258,126 @@ def bench_train(model_cfg) -> float:
     for _ in range(n_steps):
         eng.train_batch(batch, grpo_loss, weight_fn)
     dt = time.monotonic() - t0
-    eng.destroy()
-    return n_tokens * n_steps / dt
-
-
-def main():
-    from areal_tpu.models import qwen
-
-    model_cfg = qwen.ModelConfig(**MODEL_KW)
-    n_chips = 1
+    _emit_phase({"phase": "train", "tok_s": n_tokens * n_steps / dt})
     try:
-        import jax
-
-        n_chips = max(1, len(jax.devices()))
+        eng.destroy()
     except Exception:
         pass
 
-    gen_tok_s = bench_decode(model_cfg)
-    log(f"[decode] {gen_tok_s:.1f} tok/s")
-    train_tok_s = bench_train(model_cfg)
-    log(f"[train] {train_tok_s:.1f} tok/s")
 
-    pipeline = 1.0 / (1.0 / gen_tok_s + 1.0 / train_tok_s) / n_chips
+PHASES = {"probe": phase_probe, "decode": phase_decode, "train": phase_train}
+
+
+def _run_phase_child(name: str) -> int:
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    hb = _start_heartbeat(name)
+    try:
+        PHASES[name]()
+        return 0
+    except Exception as e:  # noqa: BLE001 — report, don't die silently
+        log(f"[{name}] FAILED: {type(e).__name__}: {e}")
+        _emit_phase({"phase": name, "error": f"{type(e).__name__}: {e}"})
+        return 1
+    finally:
+        hb.set()
+
+
+# --------------------------------------------------------------------------
+# Parent orchestration (never imports jax)
+# --------------------------------------------------------------------------
+
+
+def _spawn_phase(name: str) -> dict:
+    """Run one phase in a subprocess under a hard deadline. Returns the
+    BENCH_PHASE payload, or {"phase": name, "error": ...}."""
+    deadline = PHASE_DEADLINE_S[name]
+    log(f"[parent] starting phase {name} (deadline {deadline:.0f}s)")
+    proc = subprocess.Popen(
+        [sys.executable, "-u", os.path.abspath(__file__), "--phase", name],
+        stdout=subprocess.PIPE,
+        stderr=sys.stderr,
+        text=True,
+        start_new_session=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    payload = {"phase": name, "error": f"no BENCH_PHASE line (deadline {deadline}s)"}
+    timer_fired = threading.Event()
+
+    def killer():
+        timer_fired.set()
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    timer = threading.Timer(deadline, killer)
+    timer.start()
+    try:
+        for line in proc.stdout:
+            if line.startswith("BENCH_PHASE "):
+                try:
+                    payload = json.loads(line[len("BENCH_PHASE "):])
+                except json.JSONDecodeError as e:
+                    payload = {"phase": name, "error": f"bad phase json: {e}"}
+        proc.wait()
+    finally:
+        timer.cancel()
+        if proc.poll() is None:
+            killer()
+            proc.wait()
+    if timer_fired.is_set() and "error" in payload:
+        payload["error"] = f"phase killed at deadline {deadline:.0f}s"
+    log(f"[parent] phase {name} -> {payload}")
+    return payload
+
+
+def main():
+    hb = _start_heartbeat("parent")
+    errors = {}
+    gen_tok_s = train_tok_s = None
+    n_chips = 1
+    try:
+        probe = _spawn_phase("probe")
+        if "error" in probe:
+            # one retry: a previous aborted run can leave the TPU client
+            # wedged; a fresh process occasionally recovers after teardown
+            log("[parent] probe failed; retrying once")
+            time.sleep(10)
+            probe = _spawn_phase("probe")
+        if "error" in probe:
+            errors["probe"] = probe["error"]
+        else:
+            n_chips = max(1, int(probe.get("n_devices", 1)))
+
+        if "probe" not in errors:
+            d = _spawn_phase("decode")
+            if "error" in d:
+                errors["decode"] = d["error"]
+            else:
+                gen_tok_s = float(d["tok_s"])
+                if d.get("partial"):
+                    errors["decode_partial"] = f"only {d.get('requests_done')} reqs"
+            t = _spawn_phase("train")
+            if "error" in t:
+                errors["train"] = t["error"]
+            else:
+                train_tok_s = float(t["tok_s"])
+    except Exception as e:  # noqa: BLE001 — the JSON line must still print
+        errors["parent"] = f"{type(e).__name__}: {e}"
+    finally:
+        hb.set()
+
+    detail = {
+        "gen_tok_s": round(gen_tok_s, 1) if gen_tok_s else None,
+        "train_tok_s": round(train_tok_s, 1) if train_tok_s else None,
+        "chips": n_chips,
+    }
+    if errors:
+        detail["errors"] = errors
+    if gen_tok_s and train_tok_s:
+        pipeline = 1.0 / (1.0 / gen_tok_s + 1.0 / train_tok_s) / n_chips
+    else:
+        pipeline = 0.0
     print(
         json.dumps(
             {
@@ -214,15 +385,14 @@ def main():
                 "value": round(pipeline, 1),
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(pipeline / BASELINE_TOK_S_PER_CHIP, 3),
-                "detail": {
-                    "gen_tok_s": round(gen_tok_s, 1),
-                    "train_tok_s": round(train_tok_s, 1),
-                    "chips": n_chips,
-                },
+                "detail": detail,
             }
-        )
+        ),
+        flush=True,
     )
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
+        sys.exit(_run_phase_child(sys.argv[2]))
     main()
