@@ -113,6 +113,9 @@ class PPOActorConfig(TrainEngineConfig):
     overlong_reward_penalty: bool = False
     overlong_tokens: int = 0
     overlong_penalty_factor: float = 0.0
+    # the generation cap the penalty anchors to (reference uses the fixed
+    # gconfig.max_new_tokens, NOT batch statistics); 0 = penalty disabled
+    max_response_length: int = 0
     mask_too_long_tokens: bool = False
     mask_no_eos_with_zero: bool = False  # zero task reward for truncated seqs
     # decoupled PPO / staleness correction
@@ -166,6 +169,14 @@ class InferenceEngineConfig:
     setup_timeout: float = 120.0
     dump_trajectories: bool = False
     dump_dir: str | None = None
+    # dynamic batch mode (reference workflow_executor dynamic_bs /
+    # active_submit_and_wait): prepare_batch returns once the accepted
+    # trajectories reach this many tokens instead of a fixed count. None =
+    # fixed consumer_batch_size.
+    dynamic_bs_max_tokens: int | None = None
+    # streamed weight-update bucket size (reference weight_chunked_mem_mb):
+    # larger buckets amortise HTTP overhead, smaller ones overlap better
+    weight_chunk_mb: int = 128
 
 
 @dataclass
@@ -183,6 +194,13 @@ class ServerConfig:
     port: int = 0  # 0 = pick a free port
     host: str = "0.0.0.0"
     enable_prefix_caching: bool = True
+    # keep aborted requests' KV parked in their slots across weight updates so
+    # the client's abort->resubmit loop resumes with zero re-prefill. The
+    # retained KV was computed under the previous policy — the same staleness
+    # decoupled PPO already corrects via per-token versions. Set False to
+    # recompute KV under the new weights on every resume (reference re-prefill
+    # behavior).
+    kv_reuse_across_updates: bool = True
 
 
 @dataclass
@@ -251,6 +269,7 @@ class SchedulerConfig:
 
 @dataclass
 class LauncherConfig:
+    n_servers: int = 1  # inference-server array size (alloc-mode gen dN)
     inference_server_cpus_per_gpu: int = 4
     inference_server_mem_per_gpu: int = 32768
     trainer_cpus_per_gpu: int = 4

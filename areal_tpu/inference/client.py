@@ -29,21 +29,35 @@ from areal_tpu.utils.data import TensorDict
 
 logger = alog.getLogger("remote_inf")
 
-# one ClientSession per event loop (connection pooling; reference
-# workflow_context.py:60-233 get_aiohttp_session)
-_SESSIONS: dict[int, aiohttp.ClientSession] = {}
+# one ClientSession per (event loop, timeout), keyed by a weakref so a
+# GC'd loop can't alias a new one (reference workflow_context.py:60-233
+# get_aiohttp_session; ADVICE r1: id(loop) keys were reusable after GC and
+# the first caller's timeout was frozen for everyone)
+import weakref
+
+_SESSIONS: "weakref.WeakKeyDictionary[asyncio.AbstractEventLoop, dict[float, aiohttp.ClientSession]]" = (
+    weakref.WeakKeyDictionary()
+)
 
 
 def _get_session(timeout_s: float) -> aiohttp.ClientSession:
     loop = asyncio.get_running_loop()
-    sess = _SESSIONS.get(id(loop))
+    per_loop = _SESSIONS.setdefault(loop, {})
+    sess = per_loop.get(timeout_s)
     if sess is None or sess.closed:
         sess = aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=timeout_s),
             connector=aiohttp.TCPConnector(limit=512, ttl_dns_cache=300),
         )
-        _SESSIONS[id(loop)] = sess
+        per_loop[timeout_s] = sess
     return sess
+
+
+async def _close_sessions() -> None:
+    loop = asyncio.get_running_loop()
+    for sess in _SESSIONS.pop(loop, {}).values():
+        if not sess.closed:
+            await sess.close()
 
 
 class RemoteJaxEngine(InferenceEngine):
@@ -57,6 +71,7 @@ class RemoteJaxEngine(InferenceEngine):
         self._rid_affinity: dict[str, str] = {}
         self.executor = WorkflowExecutor(config, engine=self)
         self._paused = False
+        self.last_pause_secs = 0.0  # last weight-update availability gap
 
     # -- discovery / lifecycle -------------------------------------------
     def initialize(self, addresses: list[str] | None = None, timeout: float | None = None) -> None:
@@ -92,6 +107,12 @@ class RemoteJaxEngine(InferenceEngine):
                     time.sleep(0.5)
 
     def destroy(self) -> None:
+        try:
+            loop = self.executor.runner._loop
+            if loop is not None and loop.is_running():
+                asyncio.run_coroutine_threadsafe(_close_sessions(), loop).result(5)
+        except Exception:  # noqa: BLE001 — runner may already be down
+            pass
         self.executor.destroy()
 
     # -- server choice ----------------------------------------------------
@@ -247,8 +268,24 @@ class RemoteJaxEngine(InferenceEngine):
 
     # -- weights + versioning --------------------------------------------
     def update_weights(self, meta: WeightUpdateMeta, params: dict | None = None) -> None:
-        """§3.4 protocol: pause servers, push weights, resume."""
+        """§3.4 protocol: pause servers, push weights, resume.
+
+        The pause window (pause_generation -> continue_generation) is the
+        availability cost of an update; it is measured and exported as
+        ``update_weights_pause_secs`` (reference target: <3 s at scale,
+        blog/AReaL_v0_2.md:79-83)."""
         version = self._version + 1 if meta.with_version else self._version
+        enc_pool = first = None
+        if meta.type == "mem":
+            # encode bucket 0 (device->host + bf16 cast) BEFORE pausing so
+            # the window starts with bytes ready to ship
+            assert params is not None
+            import concurrent.futures
+
+            plan = self._plan_weight_buckets(params)
+            enc_pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+            first = enc_pool.submit(self._encode_bucket, plan[0])
+        t0 = time.monotonic()
         self.pause_generation()
         try:
             if meta.type == "disk":
@@ -257,40 +294,94 @@ class RemoteJaxEngine(InferenceEngine):
                     "/update_weights_from_disk", {"path": meta.path, "version": version}
                 )
             elif meta.type == "mem":
-                assert params is not None
-                self._update_weights_mem(params, version)
+                self._stream_weight_buckets(plan, version, enc_pool, first)
             else:
                 raise NotImplementedError(meta.type)
         finally:
             self.continue_generation()
+            if enc_pool is not None:
+                enc_pool.shutdown(wait=False)
+        self.last_pause_secs = time.monotonic() - t0
+        logger.info(
+            f"weight update v{version} pause window {self.last_pause_secs:.2f}s"
+        )
         self._version = version
 
-    def _update_weights_mem(self, params: dict, version: int) -> None:
-        import io
-        import urllib.request
+    def _plan_weight_buckets(self, params: dict) -> list[list[tuple[str, object]]]:
+        """Greedy-pack flattened leaves into ~weight_chunk_mb buckets."""
+        flat: list[tuple[str, object]] = []
 
-        from areal_tpu.inference.server import flatten_params
+        def walk(tree, prefix=""):
+            for k, v in tree.items():
+                key = f"{prefix}/{k}" if prefix else str(k)
+                if isinstance(v, dict):
+                    walk(v, key)
+                else:
+                    flat.append((key, v))
 
+        walk(params)
+        limit = max(1, self.config.weight_chunk_mb) * (1 << 20)
+        buckets: list[list[tuple[str, object]]] = [[]]
+        size = 0
+        for key, v in flat:
+            nbytes = int(np.prod(v.shape)) * 2 if hasattr(v, "shape") else 8
+            if size and size + nbytes > limit:
+                buckets.append([])
+                size = 0
+            buckets[-1].append((key, v))
+            size += nbytes
+        return buckets
+
+    @staticmethod
+    def _encode_bucket(bucket: list[tuple[str, object]]) -> bytes:
+        """Host-transfer + bf16-cast + wire-encode one bucket."""
+        import ml_dtypes
+
+        from areal_tpu.inference.server import encode_weight_bucket
+
+        entries = []
+        for name, v in bucket:
+            arr = np.asarray(jax_leaf_to_host(v))
+            if arr.dtype.kind == "f" and arr.dtype != np.dtype(ml_dtypes.bfloat16):
+                arr = arr.astype(ml_dtypes.bfloat16)
+            entries.append((name, arr))
+        return encode_weight_bucket(entries)
+
+    def _stream_weight_buckets(self, buckets, version: int, enc_pool, first) -> None:
+        """Pipelined upload: encode bucket i+1 (device->host + bf16 cast)
+        while bucket i is in flight to every server; servers device_put each
+        bucket on arrival, so transport/serialisation/H2D all overlap.
+        ``first`` is bucket 0's encode future, started before the pause."""
         import concurrent.futures
 
-        flat = flatten_params(jax_tree_to_host(params))
-        buf = io.BytesIO()
-        np.savez(buf, __version__=np.int64(version), **flat)
-        body = buf.getvalue()
+        self._post_all("/update_weights_begin", {})
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as net_pool:
+            nxt = first
+            for i in range(len(buckets)):
+                body = nxt.result()
+                if i + 1 < len(buckets):
+                    nxt = enc_pool.submit(self._encode_bucket, buckets[i + 1])
+                list(
+                    net_pool.map(
+                        lambda addr: self._post_bytes(
+                            addr, "/update_weights_bucket", body
+                        ),
+                        self.addresses,
+                    )
+                )
+        self._post_all("/update_weights_commit", {"version": version})
 
-        def push(addr):
-            req = urllib.request.Request(
-                f"http://{addr}/update_weights_from_tensors",
-                data=body,
-                headers={"Content-Type": "application/octet-stream"},
-                method="POST",
-            )
-            with urllib.request.urlopen(req, timeout=self.config.request_timeout) as r:
-                r.read()
+    def _post_bytes(self, addr: str, path: str, body: bytes) -> None:
+        import urllib.request
 
-        # fan out: the pause window must not scale with fleet size
-        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
-            list(pool.map(push, self.addresses))
+        req = urllib.request.Request(
+            f"http://{addr}{path}",
+            data=body,
+            headers={"Content-Type": "application/octet-stream"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.config.request_timeout) as r:
+            r.read()
 
     def set_version(self, version: int) -> None:
         self._version = version
@@ -306,19 +397,21 @@ class RemoteJaxEngine(InferenceEngine):
         return self.executor.staleness.get_capacity()
 
     def export_stats(self) -> dict[str, float]:
-        return self.executor.export_stats()
+        stats = self.executor.export_stats()
+        stats["update_weights_pause_secs"] = self.last_pause_secs
+        return stats
+
+
+def jax_leaf_to_host(x):
+    """Device array -> host numpy (bf16 preserved via ml_dtypes)."""
+    if isinstance(x, np.ndarray):
+        return x
+    import jax
+
+    return np.asarray(jax.device_get(x))
 
 
 def jax_tree_to_host(params: dict) -> dict:
     import jax
 
-    def host(x):
-        x = jax.device_get(x)
-        arr = np.asarray(x)
-        if arr.dtype.name == "bfloat16":
-            import jax.numpy as jnp
-
-            arr = np.asarray(jax.device_get(jnp.asarray(x).astype(jnp.float32)))
-        return arr
-
-    return jax.tree.map(host, params)
+    return jax.tree.map(jax_leaf_to_host, params)

@@ -68,9 +68,12 @@ class InferenceServer:
                 web.post("/continue_generation", self.h_continue),
                 web.post("/update_weights_from_disk", self.h_update_disk),
                 web.post("/update_weights_from_tensors", self.h_update_tensors),
+                web.post("/update_weights_begin", self.h_update_begin),
+                web.post("/update_weights_bucket", self.h_update_bucket),
+                web.post("/update_weights_commit", self.h_update_commit),
                 web.post("/set_version", self.h_set_version),
-                web.post("/release_memory_occupation", self.h_noop),
-                web.post("/resume_memory_occupation", self.h_noop),
+                web.post("/release_memory_occupation", self.h_release_memory),
+                web.post("/resume_memory_occupation", self.h_resume_memory),
                 web.post("/abort_request", self.h_noop),
             ]
         )
@@ -147,9 +150,45 @@ class InferenceServer:
         )
         return web.json_response({"status": "ok", "version": self.engine.get_version()})
 
+    async def h_update_begin(self, request: web.Request) -> web.Response:
+        self.engine.begin_staged_update()
+        return web.json_response({"status": "ok"})
+
+    async def h_update_bucket(self, request: web.Request) -> web.Response:
+        """One bucket of bf16 tensors: 8-byte LE header length + json header
+        {entries: [{name, dtype, shape}]} + concatenated raw buffers.
+        device_put happens here, overlapping the next bucket's transport."""
+        body = await request.read()
+        flat = decode_weight_bucket(body)
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.stage_weight_bucket, flat
+        )
+        return web.json_response({"status": "ok"})
+
+    async def h_update_commit(self, request: web.Request) -> web.Response:
+        d = await request.json()
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.commit_staged_weights, d.get("version")
+        )
+        return web.json_response({"status": "ok", "version": self.engine.get_version()})
+
     async def h_set_version(self, request: web.Request) -> web.Response:
         d = await request.json()
         self.engine.set_version(int(d["version"]))
+        return web.json_response({"status": "ok"})
+
+    async def h_release_memory(self, request: web.Request) -> web.Response:
+        """Colocated-mode HBM handoff (pause first if not already paused)."""
+        loop = asyncio.get_running_loop()
+        if not self.engine.is_paused:
+            self.engine.pause_generation()
+        await loop.run_in_executor(None, self.engine.release_memory)
+        return web.json_response({"status": "ok"})
+
+    async def h_resume_memory(self, request: web.Request) -> web.Response:
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.resume_memory
+        )
         return web.json_response({"status": "ok"})
 
     async def h_noop(self, request: web.Request) -> web.Response:
@@ -157,7 +196,9 @@ class InferenceServer:
 
     # -- lifecycle --------------------------------------------------------
     async def astart(self) -> None:
-        if self.engine.params is None:
+        if not getattr(self.engine, "initialized", False):
+            # initialize() builds slot state + KV cache even when params
+            # were injected by the caller
             self.engine.initialize()
         self.engine.start()
         self._runner = web.AppRunner(self.build_app())
@@ -178,6 +219,47 @@ class InferenceServer:
             loop.run_forever()
         finally:
             loop.run_until_complete(self.astop())
+
+
+def encode_weight_bucket(entries: list[tuple[str, np.ndarray]]) -> bytes:
+    """Wire format for streamed weight buckets: 8-byte LE header length, a
+    json header [{name, dtype, shape}], then the raw array bytes in order.
+    bf16 arrays travel as raw bf16 (half the fp32 npz bytes of round 1)."""
+    import struct
+
+    header = []
+    bufs = []
+    for name, arr in entries:
+        arr = np.ascontiguousarray(arr)
+        header.append(
+            {"name": name, "dtype": arr.dtype.name, "shape": list(arr.shape)}
+        )
+        bufs.append(arr.tobytes())
+    hjson = json.dumps(header).encode()
+    return struct.pack("<Q", len(hjson)) + hjson + b"".join(bufs)
+
+
+def decode_weight_bucket(body: bytes) -> dict:
+    import struct
+
+    import ml_dtypes
+
+    (hlen,) = struct.unpack_from("<Q", body, 0)
+    header = json.loads(body[8 : 8 + hlen].decode())
+    flat = {}
+    off = 8 + hlen
+    for ent in header:
+        dtype = np.dtype(
+            ml_dtypes.bfloat16 if ent["dtype"] == "bfloat16" else ent["dtype"]
+        )
+        n = int(np.prod(ent["shape"])) if ent["shape"] else 1
+        nbytes = n * dtype.itemsize
+        flat[ent["name"]] = np.frombuffer(
+            body, dtype=dtype, count=n, offset=off
+        ).reshape(ent["shape"])
+        off += nbytes
+    assert off == len(body), f"bucket size mismatch: {off} != {len(body)}"
+    return flat
 
 
 def _unflatten(flat: dict) -> dict:

@@ -1,0 +1,71 @@
+"""Host offload/onload for colocated generation+training (task: free one
+chip's HBM while the other engine runs).
+
+Reference role: torch_memory_saver pause/resume (fsdp_engine.py:691-722,
+server /release_memory_occupation). TPU-native mechanism: transfer arrays to
+the host memory space via ``jax.device_put`` with a ``pinned_host`` memory
+kind — the sharding layout is preserved so onload is a pure H2D copy, no
+resharding. Backends without memory-kind support (CPU tests) fall back to
+plain host numpy copies.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("offload")
+
+
+def _supports_memory_kind() -> bool:
+    try:
+        dev = jax.devices()[0]
+        return "pinned_host" in {m.kind for m in dev.addressable_memories()}
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def offload_tree(tree):
+    """Move a pytree of device arrays to host memory. Returns (host_tree,
+    mode) where mode is 'pinned_host' or 'numpy' (fallback)."""
+    if tree is None:
+        return None, "none"
+    if _supports_memory_kind():
+        def to_host(x):
+            if not isinstance(x, jax.Array):
+                return x
+            s = x.sharding.with_memory_kind("pinned_host")
+            return jax.device_put(x, s)
+
+        out = jax.tree.map(to_host, tree)
+        jax.block_until_ready(out)
+        return out, "pinned_host"
+    # fallback: host numpy (frees device buffers once old refs drop)
+    out = jax.tree.map(
+        lambda x: np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) else x,
+        tree,
+    )
+    return out, "numpy"
+
+
+def onload_tree(host_tree, shardings, mode: str):
+    """Move an offloaded pytree back onto device with target shardings.
+    ``shardings`` is a matching pytree of jax.sharding.Sharding (or None to
+    reuse each array's own device sharding in pinned_host mode)."""
+    if host_tree is None:
+        return None
+    if mode == "pinned_host" and shardings is None:
+        def back(x):
+            if not isinstance(x, jax.Array):
+                return x
+            return jax.device_put(x, x.sharding.with_memory_kind("device"))
+
+        out = jax.tree.map(back, host_tree)
+    else:
+        out = jax.tree.map(
+            lambda x, s: jax.device_put(x, s), host_tree, shardings
+        )
+    jax.block_until_ready(out)
+    return out

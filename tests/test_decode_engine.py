@@ -182,6 +182,106 @@ def test_weight_update_bumps_version(engine):
     engine.set_version(0)
 
 
+def test_per_slot_sampling_isolation(engine):
+    """A concurrent request with top_p/top_k filtering must not change a
+    greedy request's output (round-1 bug: engine-global top_k/top_p were
+    compiled into the chunk for ALL slots)."""
+    rng = np.random.default_rng(6)
+    prompt = rng.integers(0, 256, 10).tolist()
+    want = _naive_greedy(engine.params, engine.model_cfg, prompt, 12)
+
+    results = {}
+    done = threading.Event()
+    lock = threading.Lock()
+
+    def cb_for(name):
+        def cb(resp):
+            with lock:
+                results[name] = resp
+                if len(results) == 2:
+                    done.set()
+
+        return cb
+
+    engine.submit(
+        ModelRequest(
+            input_ids=prompt,
+            gconfig=GenerationHyperparameters(max_new_tokens=12, greedy=True),
+        ),
+        cb_for("greedy"),
+    )
+    engine.submit(
+        ModelRequest(
+            input_ids=rng.integers(0, 256, 10).tolist(),
+            gconfig=GenerationHyperparameters(
+                max_new_tokens=12, temperature=2.0, top_p=0.7, top_k=5
+            ),
+        ),
+        cb_for("filtered"),
+    )
+    assert done.wait(120)
+    assert results["greedy"].output_tokens == want
+    assert len(results["filtered"].output_tokens) == 12
+
+
+def test_kv_resume_after_abort(engine):
+    """Same-rid resubmission after pause resumes from the parked slot KV
+    (zero re-prefill) and continues the greedy trajectory exactly."""
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, 256, 8).tolist()
+    box = []
+    ev = threading.Event()
+    engine.submit(
+        ModelRequest(
+            input_ids=prompt,
+            rid="resume-me",
+            gconfig=GenerationHyperparameters(max_new_tokens=2048, greedy=True),
+        ),
+        lambda r: (box.append(r), ev.set()),
+    )
+    time.sleep(0.3)
+    engine.pause_generation()
+    assert ev.wait(60)
+    resp = box[0]
+    assert resp.stop_reason == StopReason.ABORT.value
+    engine.continue_generation()
+    resumes_before = engine.stats["kv_resumes"]
+    resumed = engine.generate_sync(
+        ModelRequest(
+            input_ids=prompt + resp.output_tokens,
+            rid="resume-me",
+            gconfig=GenerationHyperparameters(max_new_tokens=8, greedy=True),
+        ),
+        timeout=120,
+    )
+    assert engine.stats["kv_resumes"] == resumes_before + 1
+    want = _naive_greedy(
+        engine.params, engine.model_cfg, prompt, len(resp.output_tokens) + 8
+    )
+    assert resp.output_tokens + resumed.output_tokens == want
+
+
+def test_release_resume_memory(engine):
+    """Colocated-mode HBM handoff: release drops params+KV, resume restores
+    and generation still matches the full forward."""
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(0, 256, 8).tolist()
+    want = _naive_greedy(engine.params, engine.model_cfg, prompt, 6)
+    engine.pause_generation()
+    engine.release_memory()
+    assert engine.cache is None
+    engine.resume_memory()
+    engine.continue_generation()
+    resp = engine.generate_sync(
+        ModelRequest(
+            input_ids=prompt,
+            gconfig=GenerationHyperparameters(max_new_tokens=6, greedy=True),
+        ),
+        timeout=120,
+    )
+    assert resp.output_tokens == want
+
+
 def test_temperature_sampling_varies(engine):
     rng = np.random.default_rng(5)
     prompt = rng.integers(0, 256, 6).tolist()
