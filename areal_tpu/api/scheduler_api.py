@@ -1,0 +1,92 @@
+"""Scheduler contract for single-controller mode.
+
+Mirrors reference areal/api/scheduler_api.py:11-307: a Scheduler creates
+*workers* (OS processes / Ray actors / cluster jobs), each running an RPC
+server (areal_tpu/infra/rpc/rpc_server.py) that hosts engines; the
+controller drives them via (async_)call_engine. TPU translation: a worker
+owns a whole host's chips (one JAX process per host), so `replicas` counts
+hosts, not GPU ranks.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class Job:
+    """One worker array request (reference scheduler_api.py Job)."""
+
+    replicas: int = 1
+    role: str = "worker"
+    # resource hints (advisory for local; real for cluster schedulers)
+    cpus: int = 1
+    mem_gb: int = 4
+    tpus: int = 0
+    # colocate with an existing role's workers (share hosts/devices)
+    colocate_with: str | None = None
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Worker:
+    """Handle to a live worker (reference scheduler_api.py Worker)."""
+
+    id: str
+    role: str
+    ip: str
+    ports: list[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def address(self) -> str:
+        return f"{self.ip}:{self.ports[0]}"
+
+
+class Scheduler(abc.ABC):
+    """Create/destroy worker arrays and call engines hosted on them."""
+
+    @abc.abstractmethod
+    def create_workers(self, job: Job) -> list[Worker]:
+        """Spawn `job.replicas` workers, wait until their RPC servers are
+        healthy, and return handles."""
+
+    @abc.abstractmethod
+    def get_workers(self, role: str) -> list[Worker]:
+        """Live workers of a role."""
+
+    @abc.abstractmethod
+    def delete_workers(self, role: str | None = None) -> None:
+        """Tear down workers (all roles if None)."""
+
+    @abc.abstractmethod
+    def set_worker_env(self, role: str, env: dict[str, str]) -> None:
+        """Extra env for future workers of this role."""
+
+    @abc.abstractmethod
+    def create_engine(
+        self, worker: Worker, engine_path: str, *args: Any, **kwargs: Any
+    ) -> None:
+        """Dynamically import `engine_path` on the worker and construct it
+        (reference rpc_server.py:508-613)."""
+
+    @abc.abstractmethod
+    def call_engine(
+        self, worker: Worker, method: str, *args: Any, **kwargs: Any
+    ) -> Any:
+        """Blocking engine method call on one worker."""
+
+    def call_all(self, workers: list[Worker], method: str, *args, **kwargs) -> list[Any]:
+        """Fan a call out to several workers, collecting results in order.
+        Default implementation is threaded; schedulers may override."""
+        import concurrent.futures
+
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, len(workers))
+        ) as pool:
+            futs = [
+                pool.submit(self.call_engine, w, method, *args, **kwargs)
+                for w in workers
+            ]
+            return [f.result() for f in futs]

@@ -37,7 +37,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from areal_tpu.api.config import OptimizerConfig, TrainEngineConfig
+from areal_tpu.api.config import MicroBatchSpec, OptimizerConfig, TrainEngineConfig
 from areal_tpu.api.engine_api import InferenceEngine, TrainEngine
 from areal_tpu.api.io_struct import FinetuneSpec, SaveLoadMeta, WeightUpdateMeta
 from areal_tpu.models import qwen
@@ -99,8 +99,12 @@ class JaxTrainEngine(TrainEngine):
         value_head: bool = False,
         model_config: qwen.ModelConfig | None = None,
         need_optimizer: bool = True,
+        distributed: dict | None = None,
     ):
         self.config = config
+        # {"coordinator_address", "num_processes", "process_id"} — supplied
+        # by TrainController for multi-host worker meshes
+        self._distributed_kwargs = distributed
         self.value_head = value_head
         self.need_optimizer = need_optimizer  # False for frozen ref models
         self._model_config = model_config
@@ -121,6 +125,21 @@ class JaxTrainEngine(TrainEngine):
     def initialize(self, ft_spec: FinetuneSpec | None = None, **kwargs) -> None:
         cfg = self.config
         self.ft_spec = ft_spec
+        dist = kwargs.get("distributed") or self._distributed_kwargs
+        if dist and int(dist.get("num_processes", 1)) > 1:
+            # multi-host mesh: every worker process joins the same XLA world
+            # before any device enumeration (reference role: torch
+            # dist.init_process_group, fsdp_engine.py:208; here the
+            # collectives ride ICI/DCN chosen by XLA)
+            jax.distributed.initialize(
+                coordinator_address=dist["coordinator_address"],
+                num_processes=int(dist["num_processes"]),
+                process_id=int(dist["process_id"]),
+            )
+            logger.info(
+                f"jax.distributed up: process {dist['process_id']}/"
+                f"{dist['num_processes']} @ {dist['coordinator_address']}"
+            )
         self.mesh = kwargs.get("mesh") or mesh_lib.make_mesh(cfg.mesh)
         mcfg = self._model_config
         if mcfg is None:
@@ -214,9 +233,50 @@ class JaxTrainEngine(TrainEngine):
         return jax.tree_util.tree_map_with_path(assign, state_shapes)
 
     def destroy(self) -> None:
+        self.wait_for_save()
         self.params = None
         self.opt_state = None
         self._fn_cache.clear()
+
+    # -- offload / onload -------------------------------------------------
+    # Colocated gen+train time-shares one chip's HBM: the trainer offloads
+    # params+optimizer state during rollout and onloads before the update
+    # (reference torch_memory_saver role, fsdp_engine.py:691-722).
+    def offload(self) -> None:
+        from areal_tpu.utils.offload import offload_tree
+
+        if self.params is None or getattr(self, "_offload_mode", None):
+            return
+        t0 = time.monotonic()
+        self._offload_shardings = jax.tree.map(
+            lambda x: x.sharding if isinstance(x, jax.Array) else None,
+            (self.params, self.opt_state),
+        )
+        self.params, mode_p = offload_tree(self.params)
+        self.opt_state, mode_o = offload_tree(self.opt_state)
+        self._offload_mode = (mode_p, mode_o)
+        logger.info(
+            f"offloaded params+opt ({mode_p}) in {time.monotonic()-t0:.2f}s"
+        )
+
+    def onload(self) -> None:
+        from areal_tpu.utils.offload import onload_tree
+
+        mode = getattr(self, "_offload_mode", None)
+        if not mode:
+            return
+        t0 = time.monotonic()
+        sp, so = self._offload_shardings
+        with jax.set_mesh(self.mesh):
+            self.params = onload_tree(
+                self.params, None if mode[0] == "pinned_host" else sp, mode[0]
+            )
+            self.opt_state = onload_tree(
+                self.opt_state, None if mode[1] == "pinned_host" else so, mode[1]
+            )
+        self._offload_mode = None
+        self._offload_shardings = None
+        logger.info(f"onloaded params+opt in {time.monotonic()-t0:.2f}s")
 
     # -- versioning -------------------------------------------------------
     def set_version(self, version: int) -> None:
@@ -231,14 +291,17 @@ class JaxTrainEngine(TrainEngine):
     def _dp(self) -> int:
         return self.mesh.shape["data"] * self.mesh.shape["fsdp"]
 
-    def _make_grids(self, input_: TensorDict) -> list[Grid]:
+    def _make_grids(
+        self, input_: TensorDict, mb_spec: MicroBatchSpec | None = None
+    ) -> list[Grid]:
         """Padded batch -> list of microbatch grids (FFD rows, bucketed L,
-        G padded to the DP degree)."""
+        G padded to the DP degree). ``mb_spec`` overrides the engine config
+        for this call only (e.g. RWEngine's pair-preserving split)."""
         cfg = self.config
         lens = seqlens_of(input_)
         row_len = round_up_to_bucket(int(lens.max()), cfg.bucket_step)
         grid = pack_grid(input_, row_len=row_len, pad_rows_to=1)
-        max_tok = cfg.mb_spec.max_tokens_per_mb
+        max_tok = (mb_spec or cfg.mb_spec).max_tokens_per_mb
         dp = self._dp()
         rows_per_mb = grid.n_rows
         if max_tok:
@@ -293,10 +356,21 @@ class JaxTrainEngine(TrainEngine):
             else x,
             params,
         )
-        hidden = qwen.forward(
-            cparams, mcfg, batch["input_ids"], batch["segment_ids"], batch["positions"]
+        moe = mcfg.num_experts > 0
+        fwd = qwen.forward(
+            cparams,
+            mcfg,
+            batch["input_ids"],
+            batch["segment_ids"],
+            batch["positions"],
+            with_aux=moe,
         )
+        hidden, moe_aux = fwd if moe else (fwd, None)
         outputs: dict[str, jax.Array] = {}
+        if moe_aux is not None:
+            # router load-balance aux: loss fns add
+            # cfg.router_aux_coef * outputs["moe_aux"]
+            outputs["moe_aux"] = moe_aux
         if self.value_head:
             outputs["values"] = jnp.einsum(
                 "gld,d->gl", hidden.astype(jnp.float32), cparams["value_head"].astype(jnp.float32)
@@ -392,10 +466,11 @@ class JaxTrainEngine(TrainEngine):
         input_: TensorDict,
         loss_fn: Callable,
         loss_weight_fn: Callable[[TensorDict], float],
+        mb_spec: MicroBatchSpec | None = None,
     ) -> dict[str, float]:
         assert self.params is not None, "engine not initialized"
         t0 = time.monotonic()
-        grids = self._make_grids(input_)
+        grids = self._make_grids(input_, mb_spec=mb_spec)
         weights = [float(loss_weight_fn(g.data)) for g in grids]
         total_w = sum(weights) or 1.0
 
@@ -436,6 +511,28 @@ class JaxTrainEngine(TrainEngine):
         agg["n_microbatches"] = float(len(grids))
         agg["train_batch_secs"] = time.monotonic() - t0
         return agg
+
+    # -- RPC-friendly dispatch (single-controller mode) -------------------
+    # Closures don't cross the RPC boundary; the controller ships loss /
+    # weight functions as import-path strings resolved worker-side
+    # (reference pattern: rpc_server.py create_engine dynamic import).
+    def train_batch_serialized(
+        self, input_: TensorDict, loss_fn: str, loss_weight_fn: str, **kw
+    ) -> dict[str, float]:
+        from areal_tpu.utils.dynamic_import import import_from_string
+
+        return self.train_batch(
+            input_, import_from_string(loss_fn), import_from_string(loss_weight_fn), **kw
+        )
+
+    def eval_batch_serialized(
+        self, input_: TensorDict, loss_fn: str, loss_weight_fn: str, **kw
+    ) -> dict[str, float]:
+        from areal_tpu.utils.dynamic_import import import_from_string
+
+        return self.eval_batch(
+            input_, import_from_string(loss_fn), import_from_string(loss_weight_fn), **kw
+        )
 
     def _opt_step_count(self) -> int:
         for path, leaf in jax.tree_util.tree_flatten_with_path(self.opt_state)[0]:
@@ -507,13 +604,23 @@ class JaxTrainEngine(TrainEngine):
     ) -> None:
         self._inference_engine = engine
         self._weight_update_meta = meta
+        # multi-host worlds route rollout pulls through the coordinator:
+        # process 0 consumes from the fleet, DCN-broadcasts, every process
+        # takes a seqlen-balanced shard (reference dist_rollout.py:22-272)
+        from areal_tpu.infra.dist_rollout import DistRolloutCoordinator
+
+        self._rollout_coord = DistRolloutCoordinator(engine, mesh=self.mesh)
 
     def prepare_batch(self, *args, **kwargs) -> TensorDict:
         assert self._inference_engine is not None
+        if jax.process_count() > 1:
+            return self._rollout_coord.prepare_batch(*args, **kwargs)
         return self._inference_engine.prepare_batch(*args, **kwargs)
 
     def rollout_batch(self, *args, **kwargs) -> TensorDict:
         assert self._inference_engine is not None
+        if jax.process_count() > 1:
+            return self._rollout_coord.rollout_batch(*args, **kwargs)
         return self._inference_engine.rollout_batch(*args, **kwargs)
 
     # -- weights ----------------------------------------------------------
@@ -552,17 +659,37 @@ class JaxTrainEngine(TrainEngine):
                 base_model_path=meta.base_model_path or self.config.path,
             )
         elif meta.weight_format == "orbax":
-            import orbax.checkpoint as ocp
-
+            # async save (reference utils/async_checkpoint.py:27-208 role):
+            # orbax stages device arrays then writes in the background; the
+            # next train_batch blocks on wait_for_save() before mutating
+            # params (reference saver.py:176 maybe_wait_for_staging)
+            ckptr = self._get_async_checkpointer()
+            ckptr.wait_until_finished()  # one in-flight save at a time
             ckpt = {"params": self.params}
             if meta.with_optim:
                 ckpt["opt_state"] = self.opt_state
-            with ocp.StandardCheckpointer() as ckptr:
-                ckptr.save(os.path.join(meta.path, "state"), ckpt, force=True)
+            ckptr.save(os.path.join(meta.path, "state"), ckpt, force=True)
         else:
             raise NotImplementedError(meta.weight_format)
 
+    def _get_async_checkpointer(self):
+        import orbax.checkpoint as ocp
+
+        if getattr(self, "_async_ckptr", None) is None:
+            self._async_ckptr = ocp.AsyncCheckpointer(
+                ocp.StandardCheckpointHandler()
+            )
+        return self._async_ckptr
+
+    def wait_for_save(self) -> None:
+        """Block until any in-flight async checkpoint finished staging+write
+        (must run before params/opt_state mutate)."""
+        ckptr = getattr(self, "_async_ckptr", None)
+        if ckptr is not None:
+            ckptr.wait_until_finished()
+
     def load(self, meta: SaveLoadMeta) -> None:
+        self.wait_for_save()
         if meta.weight_format == "hf":
             pdtype = jnp.dtype(self.config.param_dtype)
 

@@ -1,0 +1,4 @@
+from areal_tpu.infra.controller.train_controller import TrainController  # noqa: F401
+from areal_tpu.infra.controller.rollout_controller import (  # noqa: F401
+    RolloutController,
+)

@@ -1,0 +1,166 @@
+"""RolloutController: controller-side InferenceEngine over rollout workers.
+
+Reference: areal/infra/controller/rollout_controller.py:67-1107. Each rollout
+worker hosts a RemoteJaxEngine (the HTTP client + WorkflowExecutor stack) and
+talks to the shared inference-server fleet; the controller fans submissions
+round-robin, splits rollout batches, and aggregates stats. Workflows cross
+the RPC boundary as import-path strings (api/workflow_api.py WorkflowLike).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Any
+
+import numpy as np
+
+from areal_tpu.api.scheduler_api import Job, Scheduler, Worker
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("rollout_controller")
+
+
+class RolloutController:
+    def __init__(
+        self,
+        scheduler: Scheduler,
+        engine_path: str = "areal_tpu.inference.client.RemoteJaxEngine",
+        role: str = "rollout",
+        replicas: int = 1,
+        worker_env: dict[str, str] | None = None,
+    ):
+        self.scheduler = scheduler
+        self.engine_path = engine_path
+        self.role = role
+        self.replicas = replicas
+        self.worker_env = dict(worker_env or {})
+        self.workers: list[Worker] = []
+        self._rr = 0
+        self._task_worker: dict[str, Worker] = {}
+        self._version = 0
+        self._data_iter = None
+
+    # -- lifecycle --------------------------------------------------------
+    def initialize(self, config, addresses: list[str] | None = None) -> None:
+        job = Job(replicas=self.replicas, role=self.role, env=self.worker_env)
+        self.workers = self.scheduler.create_workers(job)
+        for w in self.workers:
+            self.scheduler.create_engine(w, self.engine_path, config)
+        self.scheduler.call_all(self.workers, "initialize", addresses)
+
+    def destroy(self) -> None:
+        try:
+            self.scheduler.call_all(self.workers, "destroy")
+        except Exception:  # noqa: BLE001
+            logger.warning("destroy fan-out failed", exc_info=True)
+        self.scheduler.delete_workers(self.role)
+        self.workers = []
+
+    # -- submission -------------------------------------------------------
+    def _next_worker(self) -> Worker:
+        w = self.workers[self._rr % len(self.workers)]
+        self._rr += 1
+        return w
+
+    def submit(self, data: dict, workflow: str | None = None, **kw) -> str:
+        w = self._next_worker()
+        task_id = self.scheduler.call_engine(w, "submit", data, workflow, **kw)
+        self._task_worker[str(task_id)] = w
+        return str(task_id)
+
+    def wait_for_task(self, task_id: str, timeout: float | None = None):
+        w = self._task_worker.pop(task_id, None)
+        assert w is not None, f"unknown task {task_id}"
+        return self.scheduler.call_engine(w, "wait_for_task", task_id, timeout)
+
+    def rollout_batch(self, data: list[dict], workflow: str | None = None, **kw):
+        """Split items across workers; each runs its share through its own
+        executor; concatenate the padded results."""
+        n = min(len(self.workers), len(data)) or 1
+        chunks = [list(data[i::n]) for i in range(n)]
+        with concurrent.futures.ThreadPoolExecutor(n) as pool:
+            futs = [
+                pool.submit(
+                    self.scheduler.call_engine,
+                    w,
+                    "rollout_batch",
+                    chunk,
+                    workflow,
+                    **kw,
+                )
+                for w, chunk in zip(self.workers, chunks)
+                if chunk
+            ]
+            results = [f.result() for f in futs]
+        return _concat_padded(results)
+
+    def prepare_batch(self, dataloader, workflow: str | None = None, batch_size: int | None = None, **kw):
+        """Controller-side dataloader; workers do the async generation. Each
+        call pulls the next `batch_size` items and fans them out (the
+        intra-batch pipelining lives in the workers' executors)."""
+        if self._data_iter is None:
+            from areal_tpu.utils.data import cycle_dataloader
+
+            self._data_iter = cycle_dataloader(dataloader)
+        bs = batch_size or getattr(dataloader, "batch_size", None) or 1
+        items = []
+        while len(items) < bs:
+            batch = next(self._data_iter)
+            items.extend(batch if isinstance(batch, list) else [batch])
+        return self.rollout_batch(items[:bs], workflow, **kw)
+
+    # -- fleet control ----------------------------------------------------
+    def pause(self) -> None:
+        self.scheduler.call_all(self.workers, "pause")
+
+    def resume(self) -> None:
+        self.scheduler.call_all(self.workers, "resume")
+
+    def pause_generation(self) -> None:
+        # only worker 0 touches the servers: the fleet is shared
+        self.scheduler.call_engine(self.workers[0], "pause_generation")
+
+    def continue_generation(self) -> None:
+        self.scheduler.call_engine(self.workers[0], "continue_generation")
+
+    def update_weights(self, meta, params: dict | None = None) -> None:
+        self.scheduler.call_engine(self.workers[0], "update_weights", meta, params)
+        for w in self.workers[1:]:
+            self.scheduler.call_engine(w, "set_version", self.get_version() + 1)
+
+    def set_version(self, version: int) -> None:
+        self._version = version
+        self.scheduler.call_all(self.workers, "set_version", version)
+
+    def get_version(self) -> int:
+        return self._version
+
+    def get_capacity(self) -> int:
+        return int(sum(self.scheduler.call_all(self.workers, "get_capacity")))
+
+    def export_stats(self) -> dict[str, float]:
+        merged: dict[str, float] = {}
+        for s in self.scheduler.call_all(self.workers, "export_stats"):
+            for k, v in s.items():
+                merged[k] = merged.get(k, 0.0) + float(v) / len(self.workers)
+        return merged
+
+
+def _concat_padded(results: list[Any]) -> dict:
+    """Concatenate padded tensor dicts with differing L by right-padding."""
+    results = [dict(r) for r in results if r]
+    assert results, "no rollout results"
+    keys = results[0].keys()
+    out = {}
+    for k in keys:
+        arrs = [np.asarray(r[k]) for r in results]
+        if arrs[0].ndim >= 2:
+            L = max(a.shape[1] for a in arrs)
+            arrs = [
+                np.pad(a, ((0, 0), (0, L - a.shape[1])) + ((0, 0),) * (a.ndim - 2))
+                if a.shape[1] < L
+                else a
+                for a in arrs
+            ]
+        out[k] = np.concatenate(arrs, axis=0)
+    return out

@@ -1,0 +1,196 @@
+"""TrainController: the single-controller façade over remote train engines.
+
+Reference: areal/infra/controller/train_controller.py:29-587. The controller
+process creates `replicas` workers via a Scheduler, instantiates the engine
+class on each by import path, and fans method calls out, splitting batches
+along the batch dim across data-parallel heads and merging results.
+
+TPU translation of the worker topology: a *worker* is one JAX process that
+owns a whole host's chips (not one per-GPU rank). Multi-host GSPMD meshes
+are formed by the workers themselves calling ``jax.distributed.initialize``
+with worker 0 as coordinator — the controller only distributes the
+coordinator address and the (num_processes, process_id) pair; the actual
+collectives meet inside XLA, not in this file (SURVEY §5.8).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from areal_tpu.api.scheduler_api import Job, Scheduler, Worker
+from areal_tpu.utils import logging as alog, network
+
+logger = alog.getLogger("train_controller")
+
+
+class TrainController:
+    """Implements the TrainEngine call surface over RPC workers."""
+
+    def __init__(
+        self,
+        scheduler: Scheduler,
+        engine_path: str,
+        role: str = "train",
+        replicas: int = 1,
+        tpus_per_worker: int = 0,
+        worker_env: dict[str, str] | None = None,
+    ):
+        self.scheduler = scheduler
+        self.engine_path = engine_path
+        self.role = role
+        self.replicas = replicas
+        self.tpus_per_worker = tpus_per_worker
+        self.worker_env = dict(worker_env or {})
+        self.workers: list[Worker] = []
+        self._version = 0
+
+    # -- lifecycle --------------------------------------------------------
+    def initialize(self, *engine_args: Any, ft_spec=None, **engine_kwargs: Any) -> None:
+        """Create workers, build engines, initialize them in lockstep
+        (reference train_controller.py:103-177)."""
+        job = Job(
+            replicas=self.replicas,
+            role=self.role,
+            tpus=self.tpus_per_worker,
+            env=self.worker_env,
+        )
+        self.workers = self.scheduler.create_workers(job)
+        if self.replicas > 1:
+            # multi-host mesh: worker 0 is the jax.distributed coordinator
+            coord = f"{self.workers[0].ip}:{network.find_free_port()}"
+            dist_base = {
+                "coordinator_address": coord,
+                "num_processes": self.replicas,
+            }
+        for pid, w in enumerate(self.workers):
+            kwargs = dict(engine_kwargs)
+            if self.replicas > 1:
+                kwargs["distributed"] = {**dist_base, "process_id": pid}
+            self.scheduler.create_engine(w, self.engine_path, *engine_args, **kwargs)
+        # initialize concurrently — multi-host mesh formation blocks until
+        # every process joins
+        self.scheduler.call_all(self.workers, "initialize", ft_spec)
+
+    def destroy(self) -> None:
+        try:
+            self.scheduler.call_all(self.workers, "destroy")
+        except Exception:  # noqa: BLE001 — workers may already be gone
+            logger.warning("destroy fan-out failed", exc_info=True)
+        self.scheduler.delete_workers(self.role)
+        self.workers = []
+
+    # -- dispatch helpers -------------------------------------------------
+    def _dp_heads(self) -> list[Worker]:
+        """Workers that receive data shards. With one JAX process per host
+        every worker is a DP head (contrast: reference must skip TP/PP
+        ranks, train_controller.py:239)."""
+        return self.workers
+
+    @staticmethod
+    def _split_batch(batch: dict, n: int) -> list[dict]:
+        """Split along the batch dim, balancing by sequence length."""
+        from areal_tpu.utils.datapack import balanced_greedy_partition
+
+        lens = None
+        for key in ("attention_mask", "loss_mask", "input_ids"):
+            if key in batch:
+                arr = np.asarray(batch[key])
+                lens = (
+                    (arr != 0).sum(-1)
+                    if arr.ndim > 1
+                    else np.ones(len(arr), np.int64)
+                )
+                break
+        assert lens is not None, "batch has no splittable key"
+        parts = balanced_greedy_partition(list(map(int, lens)), n)
+        out = []
+        for idx in parts:
+            idx = sorted(idx)
+            out.append({k: np.asarray(v)[idx] for k, v in batch.items()})
+        return out
+
+    @staticmethod
+    def _merge_stats(stats: list[dict[str, float]]) -> dict[str, float]:
+        merged: dict[str, float] = {}
+        for s in stats:
+            for k, v in s.items():
+                merged[k] = merged.get(k, 0.0) + float(v) / len(stats)
+        return merged
+
+    # -- TrainEngine surface ---------------------------------------------
+    def call_all(self, method: str, *args, **kwargs) -> list[Any]:
+        return self.scheduler.call_all(self.workers, method, *args, **kwargs)
+
+    def train_batch(self, batch: dict, loss_fn: str, loss_weight_fn: str, **kw):
+        """loss_fn / loss_weight_fn are import-path strings resolved on the
+        workers (closures don't cross RPC; reference passes engine-level
+        methods for the same reason)."""
+        heads = self._dp_heads()
+        shards = self._split_batch(batch, len(heads))
+        import concurrent.futures
+
+        with concurrent.futures.ThreadPoolExecutor(len(heads)) as pool:
+            futs = [
+                pool.submit(
+                    self.scheduler.call_engine,
+                    w,
+                    "train_batch_serialized",
+                    shard,
+                    loss_fn,
+                    loss_weight_fn,
+                    **kw,
+                )
+                for w, shard in zip(heads, shards)
+            ]
+            stats = [f.result() for f in futs]
+        return self._merge_stats(stats)
+
+    def forward_batch(self, batch: dict, **kw) -> np.ndarray:
+        heads = self._dp_heads()
+        shards = self._split_batch(batch, len(heads))
+        import concurrent.futures
+
+        with concurrent.futures.ThreadPoolExecutor(len(heads)) as pool:
+            futs = [
+                pool.submit(self.scheduler.call_engine, w, "forward_batch", s, **kw)
+                for w, s in zip(heads, shards)
+            ]
+            outs = [np.asarray(f.result()) for f in futs]
+        L = max(o.shape[1] for o in outs)
+        outs = [
+            np.pad(o, ((0, 0), (0, L - o.shape[1]))) if o.shape[1] < L else o
+            for o in outs
+        ]
+        return np.concatenate(outs, axis=0)
+
+    def eval_batch(self, batch: dict, loss_fn: str, loss_weight_fn: str, **kw):
+        heads = self._dp_heads()
+        shards = self._split_batch(batch, len(heads))
+        stats = [
+            self.scheduler.call_engine(
+                w, "eval_batch_serialized", s, loss_fn, loss_weight_fn, **kw
+            )
+            for w, s in zip(heads, shards)
+        ]
+        return self._merge_stats(stats)
+
+    def update_weights(self, meta) -> None:
+        self.call_all("update_weights", meta)
+
+    def set_version(self, version: int) -> None:
+        self._version = version
+        self.call_all("set_version", version)
+
+    def get_version(self) -> int:
+        return self._version
+
+    def save(self, meta) -> None:
+        self.call_all("save", meta)
+
+    def load(self, meta) -> None:
+        self.call_all("load", meta)
+
+    def export_stats(self) -> dict[str, float]:
+        return self._merge_stats(self.call_all("export_stats"))

@@ -1,0 +1,124 @@
+"""DistRolloutCoordinator: rollout data distribution for multi-host meshes.
+
+Reference: areal/infra/dist_rollout.py:22-272 — DP-head ranks pull
+trajectories from the inference fleet, repartition them seqlen-balanced
+across the DP group, and broadcast into the context/model-parallel group.
+
+TPU translation (SURVEY §5.8): one JAX process per host; inside a host GSPMD
+handles every parallel dim, so the reference's "broadcast to non-head model-
+parallel ranks" vanishes. What remains across *hosts*:
+
+1. process 0 pulls the global batch from the rollout fleet (one consumer —
+   the fleet's staleness accounting sees exactly one consumer_batch_size),
+2. the padded batch is broadcast host-to-all over the jax.distributed world
+   (``multihost_utils.broadcast_one_to_all`` rides DCN),
+3. every process takes its own seqlen-balanced shard
+   (``balanced_greedy_partition`` — same balancing as the reference's
+   redistribute_trajectories).
+
+Single-process worlds skip (2) entirely.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from areal_tpu.utils import logging as alog
+from areal_tpu.utils.datapack import balanced_greedy_partition
+
+logger = alog.getLogger("dist_rollout")
+
+
+def redistribute(batch: dict, n_parts: int) -> list[dict]:
+    """Seqlen-balanced repartition of a padded batch into n_parts shards
+    (reference redistribute_trajectories, dist_rollout.py:51)."""
+    attn = np.asarray(batch["attention_mask"])
+    lens = attn.sum(-1).astype(np.int64)
+    parts = balanced_greedy_partition(list(map(int, lens)), n_parts)
+    out = []
+    for idx in parts:
+        idx = sorted(idx)
+        out.append({k: np.asarray(v)[idx] for k, v in batch.items()})
+    return out
+
+
+class DistRolloutCoordinator:
+    """Bridges an InferenceEngine client into a (possibly multi-host)
+    training world."""
+
+    def __init__(self, inference_engine, mesh=None):
+        self.engine = inference_engine
+        self.mesh = mesh
+
+    # -- world topology ---------------------------------------------------
+    @staticmethod
+    def _world() -> tuple[int, int]:
+        import jax
+
+        return jax.process_index(), jax.process_count()
+
+    _MAX_DIMS = 8
+
+    def _exchange(self, batch: dict | None) -> dict:
+        """Host 0's batch -> every process's balanced shard.
+
+        ``broadcast_one_to_all`` needs identical shapes on every process, so
+        each variable-size payload is preceded by a fixed-size header
+        broadcast: (1) total header bytes, (2) a json header with keys +
+        shapes + dtypes, (3) one broadcast per array with the now-agreed
+        shape."""
+        pid, n = self._world()
+        if n == 1:
+            assert batch is not None
+            return batch
+        import json
+
+        from jax.experimental import multihost_utils
+
+        if pid == 0:
+            header = {
+                k: {
+                    "shape": list(np.asarray(v).shape),
+                    "dtype": np.asarray(v).dtype.name,
+                }
+                for k, v in batch.items()
+            }
+            hbytes = np.frombuffer(json.dumps(header).encode(), np.uint8)
+            hlen = np.asarray([len(hbytes)], np.int64)
+        else:
+            hbytes = None
+            hlen = np.zeros(1, np.int64)
+        hlen = int(np.asarray(multihost_utils.broadcast_one_to_all(hlen))[0])
+        if pid != 0:
+            hbytes = np.zeros(hlen, np.uint8)
+        hbytes = np.asarray(multihost_utils.broadcast_one_to_all(hbytes))
+        header = json.loads(bytes(hbytes).decode())
+        out = {}
+        for k in sorted(header):
+            shape = tuple(header[k]["shape"])
+            dtype = np.dtype(header[k]["dtype"])
+            send_dtype = np.float32 if dtype.name == "bfloat16" else dtype
+            if pid == 0:
+                send = np.asarray(batch[k]).astype(send_dtype)
+            else:
+                send = np.zeros(shape, send_dtype)
+            out[k] = np.asarray(multihost_utils.broadcast_one_to_all(send))
+        shards = redistribute(out, n)
+        return shards[pid]
+
+    # -- InferenceEngine-facing API --------------------------------------
+    def prepare_batch(self, dataloader, workflow=None, **kw) -> dict:
+        pid, n = self._world()
+        batch = None
+        if pid == 0:
+            batch = dict(self.engine.prepare_batch(dataloader, workflow, **kw))
+        return self._exchange(batch)
+
+    def rollout_batch(self, data: list[dict], workflow=None, **kw) -> dict:
+        pid, n = self._world()
+        batch = None
+        if pid == 0:
+            batch = dict(self.engine.rollout_batch(data, workflow, **kw))
+        return self._exchange(batch)
+
+

@@ -1,0 +1,1 @@
+from areal_tpu.infra.launcher.local import LocalLauncher  # noqa: F401

@@ -1,0 +1,196 @@
+"""LocalLauncher: SPMD-mode process orchestration on one host.
+
+Reference: areal/infra/launcher/local.py:84-431. The launcher (1) spawns the
+inference-server array, (2) waits for their addresses to appear in
+name_resolve, (3) runs the trainer entrypoint with AREAL_LLM_SERVER_ADDRS
+set, and (4) supervises: on trainer failure it relaunches the whole trial
+with run_id+1 up to ``recover_retries`` when recover mode is on/auto
+(reference :399-425 — the launcher IS the failure-recovery supervisor;
+checkpoint restore happens inside the relaunched trainer via RecoverHandler).
+
+TPU process topology: the trainer is ONE process per host (jax owns all
+local chips); `torchrun --nproc-per-node N` has no equivalent here.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from areal_tpu.utils import logging as alog, name_resolve
+
+logger = alog.getLogger("local_launcher")
+
+SERVER_ADDRS_ENV = "AREAL_LLM_SERVER_ADDRS"
+RUN_ID_ENV = "AREAL_RUN_ID"
+
+_TPU_GATE_VARS = (
+    "PALLAS_AXON_POOL_IPS",
+    "PALLAS_AXON_REMOTE_COMPILE",
+    "AXON_LOOPBACK_RELAY",
+    "AXON_POOL_SVC_OVERRIDE",
+)
+
+
+def _scrub_tpu(env: dict) -> dict:
+    env = dict(env)
+    env["JAX_PLATFORMS"] = "cpu"
+    for var in _TPU_GATE_VARS:
+        env.pop(var, None)
+    return env
+
+
+class LocalLauncher:
+    def __init__(
+        self,
+        experiment_name: str,
+        trial_name: str,
+        n_servers: int = 1,
+        server_args: list[str] | None = None,
+        server_on_tpu: bool = True,
+        trainer_on_tpu: bool = False,
+        log_dir: str = "/tmp/areal_tpu/launcher",
+        recover_mode: str = "off",  # off | on | auto (reference recover modes)
+        recover_retries: int = 1,
+        server_start_timeout: float = 300.0,
+    ):
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self.n_servers = n_servers
+        self.server_args = list(server_args or [])
+        self.server_on_tpu = server_on_tpu
+        self.trainer_on_tpu = trainer_on_tpu
+        self.log_dir = log_dir
+        self.recover_mode = recover_mode
+        self.recover_retries = recover_retries
+        self.server_start_timeout = server_start_timeout
+        self._server_procs: list[subprocess.Popen] = []
+        os.makedirs(log_dir, exist_ok=True)
+        # cross-process discovery: pin the file-backed name_resolve tree and
+        # export it so every child resolves against the same root
+        os.environ.setdefault("AREAL_NAME_RESOLVE", "file")
+        os.environ.setdefault(
+            "AREAL_NAME_RESOLVE_ROOT", os.path.join(log_dir, "name_resolve")
+        )
+        name_resolve.reconfigure(
+            "file", root=os.environ["AREAL_NAME_RESOLVE_ROOT"]
+        )
+
+    # -- inference fleet --------------------------------------------------
+    @property
+    def _ns_key(self) -> str:
+        return name_resolve.rollout_server_key(
+            self.experiment_name, self.trial_name
+        )
+
+    def start_servers(self) -> list[str]:
+        """Spawn the server array; wait for name_resolve registration."""
+        for i in range(self.n_servers):
+            env = dict(os.environ)
+            if not self.server_on_tpu:
+                env = _scrub_tpu(env)
+            log_path = os.path.join(self.log_dir, f"server-{i}.log")
+            logf = open(log_path, "ab")
+            proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-u",
+                    "-m",
+                    "areal_tpu.inference.server",
+                    "--name",
+                    f"{self._ns_key}/{i}",
+                    *self.server_args,
+                ],
+                env=env,
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+            logf.close()
+            self._server_procs.append(proc)
+        deadline = time.monotonic() + self.server_start_timeout
+        while True:
+            addrs = name_resolve.get_subtree(self._ns_key)
+            if len(addrs) >= self.n_servers:
+                logger.info(f"servers up: {addrs}")
+                return addrs
+            for i, p in enumerate(self._server_procs):
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        f"server {i} died rc={p.returncode}; see "
+                        f"{self.log_dir}/server-{i}.log"
+                    )
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"servers not registered after {self.server_start_timeout}s"
+                )
+            time.sleep(0.5)
+
+    def stop_servers(self) -> None:
+        for p in self._server_procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        for p in self._server_procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        self._server_procs = []
+        try:
+            name_resolve.clear_subtree(self._ns_key)
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- trainer + supervision -------------------------------------------
+    def run_trainer(self, trainer_cmd: list[str], extra_env: dict | None = None) -> int:
+        """Run the trainer under restart supervision. Returns the final rc."""
+        addrs = name_resolve.get_subtree(self._ns_key)
+        attempt = 0
+        while True:
+            env = dict(os.environ)
+            if not self.trainer_on_tpu:
+                env = _scrub_tpu(env)
+            env[SERVER_ADDRS_ENV] = ",".join(addrs)
+            env[RUN_ID_ENV] = str(attempt)
+            env.update(extra_env or {})
+            log_path = os.path.join(self.log_dir, f"trainer-run{attempt}.log")
+            logger.info(f"launching trainer (run_id={attempt}) -> {log_path}")
+            with open(log_path, "ab") as logf:
+                proc = subprocess.Popen(
+                    trainer_cmd,
+                    env=env,
+                    stdout=logf,
+                    stderr=subprocess.STDOUT,
+                    start_new_session=True,
+                )
+                rc = proc.wait()
+            if rc == 0:
+                return 0
+            if (
+                self.recover_mode in ("on", "auto")
+                and attempt < self.recover_retries
+            ):
+                attempt += 1
+                logger.warning(
+                    f"trainer failed rc={rc}; relaunching run_id={attempt} "
+                    f"(reference launcher/local.py:399-425 semantics)"
+                )
+                continue
+            return rc
+
+    def launch(self, trainer_cmd: list[str], extra_env: dict | None = None) -> int:
+        """Full trial: servers + supervised trainer, teardown on exit."""
+        try:
+            self.start_servers()
+            return self.run_trainer(trainer_cmd, extra_env)
+        finally:
+            self.stop_servers()

@@ -1,0 +1,4 @@
+from areal_tpu.infra.rpc.serialization import (  # noqa: F401
+    decode_value,
+    encode_value,
+)

@@ -1,0 +1,47 @@
+"""Minimal engine for RPC/scheduler integration tests and smoke checks.
+
+Mirrors the reference's mock-engine test pattern (tests/test_train_controller
+.py MockTrainEngine) but lives in the package so worker subprocesses can
+import it by path.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+class EchoEngine:
+    def __init__(self, tag: str = "echo", **kwargs):
+        self.tag = tag
+        self.kwargs = kwargs
+        self.version = 0
+        self.initialized = False
+
+    def initialize(self, ft_spec=None, **kw) -> None:
+        self.initialized = True
+
+    def destroy(self) -> None:
+        self.initialized = False
+
+    def pid(self) -> int:
+        return os.getpid()
+
+    def echo(self, *args, **kwargs):
+        return {"tag": self.tag, "args": list(args), "kwargs": kwargs}
+
+    def double(self, arr: np.ndarray) -> np.ndarray:
+        return np.asarray(arr) * 2
+
+    def set_version(self, v: int) -> None:
+        self.version = v
+
+    def get_version(self) -> int:
+        return self.version
+
+    def boom(self) -> None:
+        raise ValueError("boom")
+
+    def env(self, key: str) -> str | None:
+        return os.environ.get(key)

@@ -1,0 +1,209 @@
+"""Per-worker RPC server hosting engines (single-controller mode).
+
+Reference: areal/infra/rpc/rpc_server.py (1,055 LoC). One aiohttp server per
+worker process; a dedicated *engine thread* serializes all engine calls
+(reference :77-128 — engines are not thread-safe and JAX computations must
+not interleave arbitrarily), endpoints:
+
+- GET  /health                           liveness + hosted engine names
+- POST /configure       {env}            set env vars before engine creation
+- POST /create_engine   {name, path, args, kwargs}   dynamic import + init
+- POST /call            {name, method, args, kwargs} engine method call
+- POST /shard/put       {key, data}      batch-shard store (RTensor backend)
+- GET  /shard/get?key=                   fetch a stored shard
+- POST /shard/clear     {}               drop all shards
+- POST /kill            {}               graceful exit
+
+Values cross the wire via rpc.serialization (numpy b64; dataclasses by
+import path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import importlib
+import os
+import queue
+import threading
+import traceback
+from typing import Any
+
+from aiohttp import web
+
+from areal_tpu.infra.rpc.serialization import decode_value, encode_value
+from areal_tpu.utils import logging as alog, network
+
+logger = alog.getLogger("rpc_server")
+
+
+class _EngineThread:
+    """Runs every engine call on one dedicated thread, in submission order
+    (reference rpc_server.py:77-128)."""
+
+    def __init__(self) -> None:
+        self._q: queue.Queue = queue.Queue()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, fut, loop = item
+            try:
+                res = fn()
+                loop.call_soon_threadsafe(fut.set_result, res)
+            except BaseException as e:  # noqa: BLE001 — ship to caller
+                tb = traceback.format_exc()
+                loop.call_soon_threadsafe(
+                    fut.set_exception, RuntimeError(f"{e}\n{tb}")
+                )
+
+    async def call(self, fn) -> Any:
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._q.put((fn, fut, loop))
+        return await fut
+
+    def stop(self) -> None:
+        self._q.put(None)
+
+
+class RpcWorkerServer:
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self.host = host
+        self.port = port or network.find_free_port()
+        self.engines: dict[str, Any] = {}
+        self.shards: dict[str, Any] = {}
+        self._engine_thread = _EngineThread()
+        self._runner: web.AppRunner | None = None
+        self._stop_event = asyncio.Event()
+
+    @property
+    def address(self) -> str:
+        ip = "127.0.0.1" if self.host in ("0.0.0.0", "") else self.host
+        return f"{ip}:{self.port}"
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=4 << 30)
+        app.add_routes(
+            [
+                web.get("/health", self.h_health),
+                web.post("/configure", self.h_configure),
+                web.post("/create_engine", self.h_create_engine),
+                web.post("/call", self.h_call),
+                web.post("/shard/put", self.h_shard_put),
+                web.get("/shard/get", self.h_shard_get),
+                web.post("/shard/clear", self.h_shard_clear),
+                web.post("/kill", self.h_kill),
+            ]
+        )
+        return app
+
+    async def h_health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "ok", "engines": sorted(self.engines), "pid": os.getpid()}
+        )
+
+    async def h_configure(self, request: web.Request) -> web.Response:
+        d = await request.json()
+        for k, v in d.get("env", {}).items():
+            os.environ[str(k)] = str(v)
+        return web.json_response({"status": "ok"})
+
+    async def h_create_engine(self, request: web.Request) -> web.Response:
+        d = await request.json()
+        name = d["name"]
+        path = d["path"]
+        args = [decode_value(a) for a in d.get("args", [])]
+        kwargs = {k: decode_value(v) for k, v in d.get("kwargs", {}).items()}
+        mod, _, cls_name = path.rpartition(".")
+        try:
+            cls = getattr(importlib.import_module(mod), cls_name)
+            engine = await self._engine_thread.call(lambda: cls(*args, **kwargs))
+        except Exception as e:  # noqa: BLE001
+            return web.json_response(
+                {"status": "error", "error": f"{e}\n{traceback.format_exc()}"},
+                status=500,
+            )
+        self.engines[name] = engine
+        logger.info(f"created engine {name} = {path}")
+        return web.json_response({"status": "ok"})
+
+    async def h_call(self, request: web.Request) -> web.Response:
+        d = await request.json()
+        name, method = d["name"], d["method"]
+        if name not in self.engines:
+            return web.json_response(
+                {"status": "error", "error": f"no engine {name!r}"}, status=404
+            )
+        engine = self.engines[name]
+        args = [decode_value(a) for a in d.get("args", [])]
+        kwargs = {k: decode_value(v) for k, v in d.get("kwargs", {}).items()}
+        try:
+            fn = getattr(engine, method)
+            result = await self._engine_thread.call(lambda: fn(*args, **kwargs))
+        except Exception as e:  # noqa: BLE001
+            return web.json_response(
+                {"status": "error", "error": str(e)}, status=500
+            )
+        return web.json_response({"status": "ok", "result": encode_value(result)})
+
+    async def h_shard_put(self, request: web.Request) -> web.Response:
+        d = await request.json()
+        self.shards[d["key"]] = d["data"]  # stored encoded; fetched verbatim
+        return web.json_response({"status": "ok"})
+
+    async def h_shard_get(self, request: web.Request) -> web.Response:
+        key = request.query.get("key", "")
+        if key not in self.shards:
+            return web.json_response(
+                {"status": "error", "error": f"no shard {key!r}"}, status=404
+            )
+        return web.json_response({"status": "ok", "data": self.shards[key]})
+
+    async def h_shard_clear(self, request: web.Request) -> web.Response:
+        self.shards.clear()
+        return web.json_response({"status": "ok"})
+
+    async def h_kill(self, request: web.Request) -> web.Response:
+        self._stop_event.set()
+        return web.json_response({"status": "ok"})
+
+    async def astart(self) -> None:
+        self._runner = web.AppRunner(self.build_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        logger.info(f"rpc worker server on {self.address}")
+
+    async def astop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+        self._engine_thread.stop()
+
+    async def arun(self) -> None:
+        await self.astart()
+        await self._stop_event.wait()
+        await self.astop()
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--name", default="", help="name_resolve key to register")
+    args = p.parse_args(argv)
+    server = RpcWorkerServer(host=args.host, port=args.port)
+    if args.name:
+        from areal_tpu.utils import name_resolve
+
+        name_resolve.add(args.name, server.address, keepalive_ttl=None)
+    asyncio.run(server.arun())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""JSON-safe RPC serialization for the single-controller runtime.
+
+Plays the role of reference infra/rpc/serialization.py:38-538 (tensors ->
+base64 + dtype/shape, recursive dataclass encoding with import-path
+metadata) with numpy instead of torch containers — JAX arrays cross the RPC
+boundary as host numpy; device placement is the receiving engine's business.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import importlib
+from typing import Any
+
+import numpy as np
+
+_KIND = "__areal_kind__"
+
+
+def _import_from_path(path: str):
+    mod, _, name = path.rpartition(".")
+    return getattr(importlib.import_module(mod), name)
+
+
+def encode_value(v: Any) -> Any:
+    """Recursively encode a python value into JSON-compatible structures."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, (bytes, bytearray)):
+        return {_KIND: "bytes", "b64": base64.b64encode(bytes(v)).decode()}
+    if isinstance(v, np.ndarray):
+        arr = np.ascontiguousarray(v)
+        return {
+            _KIND: "ndarray",
+            "dtype": arr.dtype.name,
+            "shape": list(arr.shape),
+            "b64": base64.b64encode(arr.tobytes()).decode(),
+        }
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        cls = type(v)
+        return {
+            _KIND: "dataclass",
+            "cls": f"{cls.__module__}.{cls.__qualname__}",
+            "fields": {
+                f.name: encode_value(getattr(v, f.name))
+                for f in dataclasses.fields(v)
+            },
+        }
+    if isinstance(v, dict):
+        return {str(k): encode_value(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        out = [encode_value(x) for x in v]
+        return {_KIND: "tuple", "items": out} if isinstance(v, tuple) else out
+    # jax arrays and other array-likes -> numpy
+    if hasattr(v, "__array__"):
+        return encode_value(np.asarray(v))
+    raise TypeError(f"cannot RPC-encode {type(v)!r}")
+
+
+def decode_value(v: Any) -> Any:
+    if isinstance(v, dict):
+        kind = v.get(_KIND)
+        if kind == "bytes":
+            return base64.b64decode(v["b64"])
+        if kind == "ndarray":
+            import ml_dtypes
+
+            name = v["dtype"]
+            dtype = np.dtype(
+                ml_dtypes.bfloat16 if name == "bfloat16" else name
+            )
+            buf = base64.b64decode(v["b64"])
+            return np.frombuffer(buf, dtype=dtype).reshape(v["shape"]).copy()
+        if kind == "dataclass":
+            cls = _import_from_path(v["cls"])
+            fields = {k: decode_value(x) for k, x in v["fields"].items()}
+            return cls(**fields)
+        if kind == "tuple":
+            return tuple(decode_value(x) for x in v["items"])
+        return {k: decode_value(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [decode_value(x) for x in v]
+    return v

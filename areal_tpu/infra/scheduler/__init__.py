@@ -1,0 +1,1 @@
+from areal_tpu.infra.scheduler.local import LocalScheduler  # noqa: F401

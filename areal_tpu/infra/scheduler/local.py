@@ -1,0 +1,231 @@
+"""LocalScheduler: worker subprocesses on this host.
+
+Reference: areal/infra/scheduler/local.py:82-1533 (subprocess spawn, port
+allocation, colocation, readiness polling, health checks, log-tail capture
+on failure). TPU differences: device allocation is per-host, not per-GPU —
+a worker either owns the host's TPU chips (`Job.tpus > 0`) or is pinned to
+CPU (`JAX_PLATFORMS=cpu`) so auxiliary workers can never wedge the chip
+(the round-1 bench hang was exactly a second process touching the TPU
+tunnel).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+
+from areal_tpu.api.scheduler_api import Job, Scheduler, Worker
+from areal_tpu.infra.rpc.serialization import decode_value, encode_value
+from areal_tpu.utils import logging as alog, network
+
+logger = alog.getLogger("local_scheduler")
+
+
+@dataclass
+class _Proc:
+    worker: Worker
+    proc: subprocess.Popen
+    log_path: str
+    job: Job = field(default=None)  # type: ignore[assignment]
+
+
+def _http_json(
+    url: str, payload: dict | None = None, timeout: float = 3600.0
+) -> dict:
+    if payload is None:
+        req = urllib.request.Request(url)
+    else:
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        # rpc_server ships structured errors in non-2xx JSON bodies
+        body = e.read()
+        try:
+            return json.loads(body)
+        except Exception:  # noqa: BLE001
+            raise e from None
+
+
+class LocalScheduler(Scheduler):
+    def __init__(
+        self,
+        log_dir: str = "/tmp/areal_tpu/scheduler",
+        start_timeout: float = 120.0,
+        tpu_exclusive: bool = True,
+    ):
+        self.log_dir = log_dir
+        self.start_timeout = start_timeout
+        self.tpu_exclusive = tpu_exclusive
+        self._procs: dict[str, list[_Proc]] = {}  # role -> procs
+        self._role_env: dict[str, dict[str, str]] = {}
+        self._tpu_owner: str | None = None
+        os.makedirs(log_dir, exist_ok=True)
+
+    # -- worker lifecycle -------------------------------------------------
+    def create_workers(self, job: Job) -> list[Worker]:
+        assert job.role not in self._procs, f"role {job.role} exists"
+        if job.tpus > 0:
+            if self.tpu_exclusive and self._tpu_owner is not None:
+                if job.colocate_with != self._tpu_owner:
+                    raise RuntimeError(
+                        f"TPU already owned by role {self._tpu_owner!r}; "
+                        f"colocate_with it or use tpus=0"
+                    )
+            self._tpu_owner = self._tpu_owner or job.role
+        procs: list[_Proc] = []
+        for i in range(job.replicas):
+            port = network.find_free_port()
+            wid = f"{job.role}-{i}"
+            env = dict(os.environ)
+            env.update(self._role_env.get(job.role, {}))
+            env.update(job.env)
+            if job.tpus <= 0:
+                # CPU-pin auxiliary workers: scrub the TPU-tunnel gate vars
+                # (see __graft_entry__.py round-2 fix) and force cpu jax
+                env["JAX_PLATFORMS"] = "cpu"
+                for var in (
+                    "PALLAS_AXON_POOL_IPS",
+                    "PALLAS_AXON_REMOTE_COMPILE",
+                    "AXON_LOOPBACK_RELAY",
+                    "AXON_POOL_SVC_OVERRIDE",
+                ):
+                    env.pop(var, None)
+            log_path = os.path.join(self.log_dir, f"{wid}.log")
+            logf = open(log_path, "ab")
+            proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-u",
+                    "-m",
+                    "areal_tpu.infra.rpc.rpc_server",
+                    "--port",
+                    str(port),
+                ],
+                env=env,
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+                cwd=os.getcwd(),
+            )
+            logf.close()
+            worker = Worker(id=wid, role=job.role, ip="127.0.0.1", ports=[port])
+            procs.append(_Proc(worker=worker, proc=proc, log_path=log_path, job=job))
+        self._procs[job.role] = procs
+        try:
+            self._wait_healthy(procs)
+        except Exception:
+            self.delete_workers(job.role)
+            raise
+        return [p.worker for p in procs]
+
+    def _wait_healthy(self, procs: list[_Proc]) -> None:
+        deadline = time.monotonic() + self.start_timeout
+        for p in procs:
+            while True:
+                if p.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"worker {p.worker.id} died rc={p.proc.returncode}:\n"
+                        + self._log_tail(p)
+                    )
+                try:
+                    d = _http_json(
+                        f"http://{p.worker.address}/health", timeout=2
+                    )
+                    if d.get("status") == "ok":
+                        break
+                except Exception:  # noqa: BLE001 — still booting
+                    pass
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"worker {p.worker.id} not healthy after "
+                        f"{self.start_timeout}s:\n" + self._log_tail(p)
+                    )
+                time.sleep(0.2)
+
+    def _log_tail(self, p: _Proc, n: int = 30) -> str:
+        try:
+            with open(p.log_path, "rb") as f:
+                return b"\n".join(f.read().splitlines()[-n:]).decode(
+                    errors="replace"
+                )
+        except OSError:
+            return "<no log>"
+
+    def get_workers(self, role: str) -> list[Worker]:
+        return [p.worker for p in self._procs.get(role, [])]
+
+    def check_health(self, role: str) -> None:
+        """Raise if any worker of the role died (reference liveness poll,
+        scheduler/local.py:903-919)."""
+        for p in self._procs.get(role, []):
+            if p.proc.poll() is not None:
+                raise RuntimeError(
+                    f"worker {p.worker.id} died rc={p.proc.returncode}:\n"
+                    + self._log_tail(p)
+                )
+
+    def delete_workers(self, role: str | None = None) -> None:
+        roles = [role] if role else list(self._procs)
+        for r in roles:
+            for p in self._procs.pop(r, []):
+                if p.proc.poll() is None:
+                    try:
+                        _http_json(
+                            f"http://{p.worker.address}/kill", {}, timeout=2
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
+                    try:
+                        p.proc.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        try:
+                            os.killpg(os.getpgid(p.proc.pid), signal.SIGKILL)
+                        except (ProcessLookupError, PermissionError):
+                            pass
+                        p.proc.wait(timeout=5)
+            if r == self._tpu_owner:
+                self._tpu_owner = None
+
+    def set_worker_env(self, role: str, env: dict[str, str]) -> None:
+        self._role_env.setdefault(role, {}).update(env)
+
+    # -- engine RPC -------------------------------------------------------
+    def create_engine(self, worker: Worker, engine_path: str, *args, **kwargs) -> None:
+        d = _http_json(
+            f"http://{worker.address}/create_engine",
+            {
+                "name": "engine",
+                "path": engine_path,
+                "args": [encode_value(a) for a in args],
+                "kwargs": {k: encode_value(v) for k, v in kwargs.items()},
+            },
+        )
+        assert d["status"] == "ok", d
+
+    def call_engine(self, worker: Worker, method: str, *args, **kwargs):
+        d = _http_json(
+            f"http://{worker.address}/call",
+            {
+                "name": "engine",
+                "method": method,
+                "args": [encode_value(a) for a in args],
+                "kwargs": {k: encode_value(v) for k, v in kwargs.items()},
+            },
+        )
+        if d["status"] != "ok":
+            raise RuntimeError(f"{worker.id}.{method}: {d.get('error')}")
+        return decode_value(d["result"])

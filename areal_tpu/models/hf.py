@@ -92,6 +92,31 @@ def load_params_from_hf(
     return params, cfg
 
 
+def write_hf_config(cfg: "ModelConfig", path: str) -> None:
+    """Inverse of ModelConfig.from_hf_dict: write a loadable config.json so
+    a saved checkpoint dir is self-contained (launcher/server subprocess
+    tests; scratch-trained exports)."""
+    import json
+
+    d = {
+        "model_type": "qwen3" if cfg.qk_norm else "qwen2",
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "head_dim": cfg.head_dim,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "tie_word_embeddings": cfg.tie_word_embeddings,
+        "attention_bias": cfg.attention_bias,
+    }
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(d, f, indent=2)
+
+
 def save_params_to_hf(
     params: dict,
     cfg: ModelConfig,
@@ -120,6 +145,10 @@ def save_params_to_hf(
         flat[hf_name] = np.ascontiguousarray(t.T) if transpose else t
     save_file(flat, os.path.join(path, "model.safetensors"))
 
+    if base_model_path is None and not os.path.exists(
+        os.path.join(path, "config.json")
+    ):
+        write_hf_config(cfg, path)
     src = base_model_path
     if src:
         for fname in (

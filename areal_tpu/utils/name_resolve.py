@@ -233,7 +233,18 @@ class NfsNameResolveRepo(NameResolveRepo):
             shutil.rmtree(base, ignore_errors=True)
 
 
-DEFAULT_REPO: NameResolveRepo = MemoryNameResolveRepo()
+def _repo_from_env() -> "NameResolveRepo":
+    """Cross-process discovery needs a shared backend: launchers/schedulers
+    export AREAL_NAME_RESOLVE(=file)+AREAL_NAME_RESOLVE_ROOT so every child
+    process resolves against the same tree (reference NameResolveConfig)."""
+    kind = os.environ.get("AREAL_NAME_RESOLVE", "memory")
+    if kind in ("nfs", "file"):
+        root = os.environ.get("AREAL_NAME_RESOLVE_ROOT")
+        return NfsNameResolveRepo(**({"root": root} if root else {}))
+    return MemoryNameResolveRepo()
+
+
+DEFAULT_REPO: NameResolveRepo = _repo_from_env()
 
 
 def make_repo(type_: str = "memory", **kwargs) -> NameResolveRepo:

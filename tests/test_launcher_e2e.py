@@ -1,0 +1,117 @@
+"""2-process e2e: LocalLauncher spawns a real inference-server subprocess;
+this process acts as the trainer side over HTTP (VERDICT r1 next-round #3).
+Also covers launcher restart supervision (run_id+1 relaunch semantics,
+reference infra/launcher/local.py:399-425)."""
+
+import asyncio
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import InferenceEngineConfig
+from areal_tpu.api.io_struct import (
+    GenerationHyperparameters,
+    ModelRequest,
+    WeightUpdateMeta,
+)
+from areal_tpu.models import qwen
+from areal_tpu.models.hf import save_params_to_hf
+from areal_tpu.utils import name_resolve
+
+from tpu_testing import TINY_QWEN2
+
+
+@pytest.fixture()
+def tiny_hf_dir(tmp_path):
+    params = qwen.init_params(jax.random.PRNGKey(0), TINY_QWEN2)
+    path = str(tmp_path / "hf")
+    save_params_to_hf(params, TINY_QWEN2, path)
+    return path, params
+
+
+@pytest.fixture()
+def launcher(tmp_path):
+    from areal_tpu.infra.launcher import LocalLauncher
+
+    # pin the shared file-backed name_resolve under tmp so parallel test
+    # runs can't collide
+    os.environ["AREAL_NAME_RESOLVE"] = "file"
+    os.environ["AREAL_NAME_RESOLVE_ROOT"] = str(tmp_path / "ns")
+    lau = LocalLauncher(
+        experiment_name="e2e",
+        trial_name="t0",
+        n_servers=1,
+        server_on_tpu=False,
+        log_dir=str(tmp_path / "launcher"),
+        recover_mode="on",
+        recover_retries=1,
+    )
+    yield lau
+    lau.stop_servers()
+    for var in ("AREAL_NAME_RESOLVE", "AREAL_NAME_RESOLVE_ROOT"):
+        os.environ.pop(var, None)
+    name_resolve.reconfigure("memory")
+
+
+@pytest.mark.slow
+def test_launcher_two_process_pipeline(launcher, tiny_hf_dir, tmp_path):
+    hf_path, params = tiny_hf_dir
+    launcher.server_args = [
+        f"model_path={hf_path}",
+        "dtype=float32",
+        "max_batch_size=4",
+        "max_seq_len=128",
+        "decode_steps_per_call=4",
+        "mesh.data=-1",
+        "mesh.model=1",
+    ]
+    addrs = launcher.start_servers()
+    assert len(addrs) == 1
+
+    from areal_tpu.inference.client import RemoteJaxEngine
+
+    client = RemoteJaxEngine(
+        InferenceEngineConfig(experiment_name="e2e", trial_name="t0"),
+        addresses=addrs,
+    )
+    client._wait_healthy(60)
+
+    rng = np.random.default_rng(0)
+    req = ModelRequest(
+        input_ids=rng.integers(0, 256, 8).tolist(),
+        gconfig=GenerationHyperparameters(max_new_tokens=8, greedy=True),
+    )
+    resp = asyncio.run(client.agenerate(req))
+    assert len(resp.output_tokens) == 8
+    assert all(v == 0 for v in resp.output_versions)
+
+    # trainer-side weight push over HTTP (streamed bf16 buckets) + version
+    new_params = jax.tree.map(lambda x: np.asarray(x) * 1.01, params)
+    client.update_weights(WeightUpdateMeta(type="mem"), params=new_params)
+    assert client.last_pause_secs > 0
+    resp2 = asyncio.run(client.agenerate(req))
+    assert all(v == 1 for v in resp2.output_versions)
+
+    launcher.stop_servers()
+
+
+@pytest.mark.slow
+def test_launcher_restart_supervision(launcher):
+    """run_id 0 fails, supervisor relaunches with run_id 1 which succeeds."""
+    rc = launcher.run_trainer(
+        [
+            sys.executable,
+            "-c",
+            "import os, sys; sys.exit(0 if int(os.environ['AREAL_RUN_ID']) >= 1 else 1)",
+        ]
+    )
+    assert rc == 0
+    # with recovery off, the first failure is final
+    launcher.recover_mode = "off"
+    rc = launcher.run_trainer(
+        [sys.executable, "-c", "import sys; sys.exit(3)"]
+    )
+    assert rc == 3

@@ -128,6 +128,21 @@ def test_microbatching_invariance():
     )
 
 
+def test_offload_onload_roundtrip(engine):
+    """offload frees device params; onload restores and training continues
+    with identical numerics (colocated gen+train handoff)."""
+    batch = random_batch(seed=7)
+    before = engine.forward_batch(batch)
+    engine.offload()
+    assert engine._offload_mode is not None
+    engine.onload()
+    assert engine._offload_mode is None
+    after = engine.forward_batch(batch)
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-5)
+    stats = engine.train_batch(batch, sft_loss, weight_fn)
+    assert np.isfinite(stats["loss"])
+
+
 def test_version_bookkeeping(engine):
     engine.set_version(7)
     assert engine.get_version() == 7
