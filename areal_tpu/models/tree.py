@@ -1,0 +1,167 @@
+"""Tree training phase 1: trie packing + ancestor-mask attention (XLA path).
+
+Reference: areal/models/tree_attn/ — tree.py (trie builder, 895 LoC),
+functional.py (packed masks), triton_kernel.py (block-sparse kernel,
+up-to-10x FLOP reduction claim, docs/en/reference/tree_training.md:19-21).
+
+Design (TPU-first):
+- Sequences sharing prefixes (GRPO groups, agentic branches) are merged
+  into a trie; each unique token is ONE node, computed once.
+- Attention is masked by the ancestor relation: node i attends node j iff
+  j is on i's root path (incl. itself). Phase 1 materialises the [N, N]
+  ancestor mask and runs the model's masked-XLA attention; the Pallas
+  block-sparse kernel with packed 64-bit ancestor bitmasks is the phase-2
+  upgrade (reference triton_kernel.py:25-54).
+- Loss lives on EDGES: node j's next-token logprob is read from its
+  parent's logits (log p(token_j | ancestors)). A branching node simply has
+  several children, each contributing its own edge. Summing each node's
+  per-sequence loss weights (`agg` below) makes tree training *exactly*
+  equivalent to padded-batch training — shared nodes have identical logp,
+  so the aggregated gradient matches token-by-token.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class TreePack:
+    """Packed trie over a batch of token sequences."""
+
+    tokens: np.ndarray  # [N] int32 node tokens, topological (parent < child)
+    parent: np.ndarray  # [N] int32 parent index; -1 for roots
+    depth: np.ndarray  # [N] int32 rope position (= path length - 1)
+    # per input sequence: node index of each of its tokens, in order
+    seq_nodes: list[np.ndarray]
+    n_sequences: int
+
+    @property
+    def n_nodes(self) -> int:
+        return int(len(self.tokens))
+
+    def ancestor_mask(self) -> np.ndarray:
+        """[N, N] bool: mask[i, j] = j is i's ancestor or i itself."""
+        N = self.n_nodes
+        mask = np.zeros((N, N), dtype=bool)
+        for i in range(N):
+            p = self.parent[i]
+            if p >= 0:
+                mask[i] = mask[p]
+            mask[i, i] = True
+        return mask
+
+    def aggregate(self, per_seq: list[np.ndarray], reduce: str = "sum") -> np.ndarray:
+        """Scatter per-sequence per-token values onto nodes.
+
+        ``sum`` preserves exact gradient equivalence with padded-batch
+        training (each sequence's contribution lands on its shared node);
+        ``mean`` divides by the traversal count; ``any`` is for masks."""
+        out = np.zeros(self.n_nodes, np.float64)
+        count = np.zeros(self.n_nodes, np.int64)
+        for nodes, vals in zip(self.seq_nodes, per_seq):
+            vals = np.asarray(vals, np.float64)
+            assert len(nodes) == len(vals), (len(nodes), len(vals))
+            np.add.at(out, nodes, vals)
+            np.add.at(count, nodes, 1)
+        if reduce == "mean":
+            out = out / np.maximum(count, 1)
+        elif reduce == "any":
+            out = (out > 0).astype(np.float64)
+        elif reduce != "sum":
+            raise ValueError(reduce)
+        return out.astype(np.float32)
+
+    def traversal_count(self) -> np.ndarray:
+        """[N] number of sequences passing through each node."""
+        count = np.zeros(self.n_nodes, np.int64)
+        for nodes in self.seq_nodes:
+            np.add.at(count, nodes, 1)
+        return count
+
+    def scatter_to_sequences(self, node_vals: np.ndarray) -> list[np.ndarray]:
+        """Gather node-level values back into per-sequence token order."""
+        node_vals = np.asarray(node_vals)
+        return [node_vals[nodes] for nodes in self.seq_nodes]
+
+
+def build_tree(sequences: list[list[int] | np.ndarray]) -> TreePack:
+    """Merge token sequences into a trie (one node per unique prefix+token).
+
+    Node order is insertion order, which guarantees parent-before-child —
+    the topological property ancestor_mask() and incremental algorithms
+    rely on."""
+    assert sequences, "need at least one sequence"
+    tokens: list[int] = []
+    parent: list[int] = []
+    depth: list[int] = []
+    # children[(parent_idx, token)] -> node_idx; parent -1 keyed as root
+    children: dict[tuple[int, int], int] = {}
+    seq_nodes: list[np.ndarray] = []
+    for seq in sequences:
+        seq = [int(t) for t in np.asarray(seq).reshape(-1)]
+        assert seq, "empty sequence"
+        cur = -1
+        path = []
+        for tok in seq:
+            key = (cur, tok)
+            nxt = children.get(key)
+            if nxt is None:
+                nxt = len(tokens)
+                children[key] = nxt
+                tokens.append(tok)
+                parent.append(cur)
+                depth.append(0 if cur < 0 else depth[cur] + 1)
+            cur = nxt
+            path.append(cur)
+        seq_nodes.append(np.asarray(path, np.int32))
+    return TreePack(
+        tokens=np.asarray(tokens, np.int32),
+        parent=np.asarray(parent, np.int32),
+        depth=np.asarray(depth, np.int32),
+        seq_nodes=seq_nodes,
+        n_sequences=len(sequences),
+    )
+
+
+def edge_logprob_index(pack: TreePack) -> tuple[np.ndarray, np.ndarray]:
+    """For every non-root node j: (parent[j], tokens[j]) — gather the model's
+    logits at parent[j] row, token[j] column to get log p(node | ancestors).
+    Returns (gather_rows [M], gather_tokens [M]) with M = #non-root nodes,
+    aligned with non_root_nodes()."""
+    non_root = np.flatnonzero(pack.parent >= 0)
+    return pack.parent[non_root].astype(np.int32), pack.tokens[non_root]
+
+
+def non_root_nodes(pack: TreePack) -> np.ndarray:
+    return np.flatnonzero(pack.parent >= 0).astype(np.int32)
+
+
+def tree_forward_logprobs(params, cfg, pack: TreePack):
+    """Packed-tree forward: one token per unique node, ancestor-mask
+    attention, edge-gathered logprobs.
+
+    Returns ``node_logp`` [N] float32 where node_logp[j] =
+    log p(token_j | ancestors) for non-root j, 0 for roots. FLOPs scale
+    with unique nodes, not total tokens — the tree-training win."""
+    import jax.numpy as jnp
+
+    from areal_tpu.models import qwen
+
+    ids = jnp.asarray(pack.tokens)[None]  # [1, N]
+    positions = jnp.asarray(pack.depth)[None]
+    mask = jnp.asarray(pack.ancestor_mask())[None, None]  # [1, 1, N, N]
+    hidden = qwen.forward(
+        params, cfg, ids, jnp.ones_like(ids), positions, attn_mask=mask
+    )
+    logits = qwen.compute_logits(params, cfg, hidden)[0]  # [N, V]
+    import jax
+
+    logp_all = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    rows, toks = edge_logprob_index(pack)
+    edge_logp = logp_all[jnp.asarray(rows), jnp.asarray(toks)]
+    node_logp = jnp.zeros(pack.n_nodes, jnp.float32)
+    node_logp = node_logp.at[jnp.asarray(non_root_nodes(pack))].set(edge_logp)
+    return node_logp
