@@ -1,0 +1,82 @@
+"""Mixture-of-Experts FFN with expert parallelism (qwen3-moe family).
+
+Reference: archon MoE stack — router (experimental/models/archon/moe/
+router.py), grouped experts (grouped_experts.py), token-dispatch Triton
+kernels (kernels.py:1-228), ExpertParallel (expert_parallel.py:1-512).
+
+TPU-first design: capacity-based *dense dispatch* (the mesh-transformer /
+GSPMD-native formulation) instead of ragged token shuffles — one-hot
+dispatch/combine tensors turn routing into einsums that XLA partitions over
+the mesh ``expert`` axis, inserting the token all-to-all automatically
+(SURVEY §2.4 EP: "ragged all-to-all dispatch (Pallas or lax) — here lax/
+GSPMD"). Tokens over an expert's capacity are dropped (standard capacity
+semantics); the residual stream carries them unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _shard(x, spec):
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x
+
+
+def moe_ffn(h: jax.Array, layer: dict, cfg) -> tuple[jax.Array, jax.Array]:
+    """MoE feed-forward. h: [G, L, D] (post-attn-norm hidden states).
+
+    Returns (out [G, L, D], aux_loss scalar). aux is the switch-style load
+    balance loss E * sum_e(frac_e * mean_prob_e); callers weight it with
+    cfg.router_aux_coef."""
+    from areal_tpu.models.qwen import BATCH_AXES
+
+    G, L, D = h.shape
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    C = int(max(K, round(cfg.capacity_factor * K * L / E)))
+    C = min(C, L)
+
+    # --- routing (fp32 for numerics) ---
+    router_logits = (h.astype(jnp.float32) @ layer["w_router"].astype(jnp.float32))
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [G, L, E]
+    top_p, top_e = jax.lax.top_k(probs, K)  # [G, L, K]
+    if cfg.norm_topk_prob:
+        top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+
+    # --- capacity assignment ---
+    # one-hot expert choice per (token, k): [G, L, K, E]
+    onehot = jax.nn.one_hot(top_e, E, dtype=jnp.float32)
+    # position of each (token, k) in its expert's buffer: cumsum over the
+    # flattened (L, K) order so primary choices of earlier tokens win slots
+    flat = onehot.reshape(G, L * K, E)
+    pos = jnp.cumsum(flat, axis=1) - flat  # [G, L*K, E] slot index if chosen
+    pos = (pos * flat).sum(-1).reshape(G, L, K).astype(jnp.int32)  # [G, L, K]
+    within = pos < C
+    gate = top_p * within  # dropped tokens contribute nothing
+
+    # dispatch [G, L, E, C] — combine one-hot expert and one-hot slot
+    slot_oh = jax.nn.one_hot(pos, C, dtype=h.dtype)  # [G, L, K, C]
+    disp = jnp.einsum("glke,glkc->glec", onehot.astype(h.dtype), slot_oh)
+    comb = jnp.einsum(
+        "glke,glkc,glk->glec", onehot.astype(h.dtype), slot_oh, gate.astype(h.dtype)
+    )
+
+    # --- expert computation (EP over the mesh "expert" axis) ---
+    xs = jnp.einsum("glec,gld->gecd", disp, h)
+    xs = _shard(xs, P(BATCH_AXES, "expert", None, None))
+    g1 = jnp.einsum("gecd,edf->gecf", xs, layer["we_gate"])
+    u1 = jnp.einsum("gecd,edf->gecf", xs, layer["we_up"])
+    y = jnp.einsum("gecf,efd->gecd", jax.nn.silu(g1) * u1, layer["we_down"])
+    y = _shard(y, P(BATCH_AXES, "expert", None, None))
+    out = jnp.einsum("glec,gecd->gld", comb, y)
+    out = _shard(out, P(BATCH_AXES, "seq", None))
+
+    # --- load-balance aux (switch-transformer form) ---
+    frac_tokens = onehot.reshape(G, L * K, E).mean(axis=(0, 1))  # routed frac
+    mean_prob = probs.mean(axis=(0, 1))
+    aux = (frac_tokens * mean_prob).sum() * E
+    return out.astype(h.dtype), aux.astype(jnp.float32)
