@@ -323,3 +323,62 @@ class AllocationMode:
         for g in self.groups:
             total += max(a.world_size for a in g)
         return total
+
+
+def _mesh_config_of(ps: "ParallelStrategy | HybridParallelStrategy"):
+    """ParallelStrategy -> MeshConfig (dp→fsdp: ZeRO sharding is the TPU
+    default for DP; tp→model, cp→seq, ep→expert). Hybrid MoE specs shard
+    attention by the attn spec and experts by the ffn spec's ep degree."""
+    from areal_tpu.api.config import MeshConfig
+
+    if isinstance(ps, HybridParallelStrategy):
+        return MeshConfig(
+            data=1, fsdp=ps.attn.dp, seq=ps.attn.cp, model=ps.attn.tp, expert=ps.ffn.ep
+        )
+    return MeshConfig(data=1, fsdp=ps.dp, seq=ps.cp, model=ps.tp, expert=ps.ep)
+
+
+def apply_allocation_mode(config) -> "AllocationMode | None":
+    """Make ``config.allocation_mode`` the live topology knob (reference
+    alloc_mode.py:333 via rl_trainer.py:91): parse the DSL string and write
+    the resulting axis sizes into the per-engine MeshConfigs, the inference
+    server mesh, and the launcher's server count. No-op when the string is
+    empty (engines then use their hand-set MeshConfig). Explicit non-default
+    MeshConfigs win over the DSL — so examples can still override one engine.
+
+    Works on any experiment config shaped like PPOConfig/SFTConfig: fields
+    are discovered by name (actor/critic/ref/model, server, launcher).
+    """
+    s = getattr(config, "allocation_mode", "") or ""
+    if not s:
+        return None
+    from areal_tpu.api.config import MeshConfig
+
+    mode = AllocationMode.from_str(s)
+    default = MeshConfig()
+
+    def _apply(engine_cfg, ps):
+        if engine_cfg is None or ps is None:
+            return
+        if getattr(engine_cfg, "mesh", None) in (None, default):
+            engine_cfg.mesh = _mesh_config_of(ps)
+
+    train_ps = mode.train
+    for name in ("actor", "ref", "model"):
+        _apply(getattr(config, name, None), train_ps)
+    _apply(getattr(config, "critic", None), mode.critic or train_ps)
+
+    gen_ps = mode.gen
+    server_cfg = getattr(config, "server", None)
+    if gen_ps is not None and server_cfg is not None:
+        if isinstance(gen_ps, HybridParallelStrategy):
+            gen_ps = gen_ps.attn
+        # one server process per gen DP replica; each owns a tp×cp chip slice
+        if getattr(server_cfg, "mesh", None) == default:
+            server_cfg.mesh = MeshConfig(
+                data=1, fsdp=1, seq=gen_ps.cp, model=gen_ps.tp, expert=gen_ps.ep
+            )
+        launcher = getattr(config, "launcher", None)
+        if launcher is not None:
+            launcher.n_servers = gen_ps.dp
+    return mode

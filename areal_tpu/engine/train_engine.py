@@ -348,7 +348,7 @@ class JaxTrainEngine(TrainEngine):
         return dev
 
     # -- jitted kernels ---------------------------------------------------
-    def _outputs_fn(self, params, batch):
+    def _outputs_fn(self, params, batch, no_grad: bool = False):
         mcfg = self.model_cfg
         cparams = jax.tree.map(
             lambda x: x.astype(mcfg.jax_dtype)
@@ -364,6 +364,7 @@ class JaxTrainEngine(TrainEngine):
             batch["segment_ids"],
             batch["positions"],
             with_aux=moe,
+            no_grad=no_grad,
         )
         hidden, moe_aux = fwd if moe else (fwd, None)
         outputs: dict[str, jax.Array] = {}
@@ -409,7 +410,7 @@ class JaxTrainEngine(TrainEngine):
         if key not in self._fn_cache:
 
             def compute(params, batch):
-                outputs = self._outputs_fn(params, batch)
+                outputs = self._outputs_fn(params, batch, no_grad=True)
                 if post_hook is not None:
                     outputs = post_hook(outputs, batch)
                 return outputs
@@ -558,7 +559,7 @@ class JaxTrainEngine(TrainEngine):
                 if key not in self._fn_cache:
 
                     def compute(params, batch):
-                        outputs = self._outputs_fn(params, batch)
+                        outputs = self._outputs_fn(params, batch, no_grad=True)
                         return loss_fn(outputs, batch)
 
                     self._fn_cache[key] = jax.jit(compute)

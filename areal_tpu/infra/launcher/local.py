@@ -79,6 +79,25 @@ class LocalLauncher:
             "file", root=os.environ["AREAL_NAME_RESOLVE_ROOT"]
         )
 
+    @classmethod
+    def from_config(cls, config, **overrides) -> "LocalLauncher":
+        """Build from an experiment config: ``config.allocation_mode`` (when
+        set) sizes the server array (one server per gen DP replica) and the
+        engine meshes; recover policy comes from ``config.recover``."""
+        from areal_tpu.api.alloc_mode import apply_allocation_mode
+
+        apply_allocation_mode(config)
+        kw = dict(
+            experiment_name=config.experiment_name,
+            trial_name=config.trial_name,
+            n_servers=config.launcher.n_servers,
+            recover_mode=getattr(config.recover, "mode", "off"),
+            recover_retries=getattr(config.recover, "retries", 1),
+            server_start_timeout=config.scheduler.startup_timeout,
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
     # -- inference fleet --------------------------------------------------
     @property
     def _ns_key(self) -> str:

@@ -336,6 +336,12 @@ def _decoder_layer(cfg: ModelConfig, x, layer, mask, positions, impl=None):
             from areal_tpu.ops.attention import flash_train
 
             attn = flash_train(q, k, v, mask)  # mask is segment_ids here
+        elif impl == "pallas_fwd":
+            # leaner forward-only kernel (no VJP residuals) for the no-grad
+            # hot paths: logprob recompute, ref/prox forward, eval
+            from areal_tpu.ops.attention import flash_fwd_pallas
+
+            attn = flash_fwd_pallas(q, k, v, mask)  # mask is segment_ids
         else:
             attn = _sdpa(q, k, v, mask, hd)
     attn = attn.reshape(G, L, H * hd)
@@ -368,6 +374,7 @@ def forward(
     positions: jax.Array,  # [G, L] int32, restart per segment
     attn_mask: jax.Array | None = None,  # [G, 1, L, L] override (tree training)
     with_aux: bool = False,  # also return the summed MoE router aux loss
+    no_grad: bool = False,  # forward-only: use the leaner fwd flash kernel
 ) -> jax.Array:
     """Decoder body -> final hidden states [G, L, D] (+ aux when asked)."""
     x = jnp.take(params["embed"], input_ids, axis=0).astype(cfg.jax_dtype)
@@ -387,7 +394,9 @@ def forward(
             col = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), segment_ids.shape)
             mask = (segment_ids, col)
         elif impl == "pallas":
-            mask = segment_ids  # flash kernel masks from segment ids alone
+            if no_grad:
+                impl = "pallas_fwd"
+            mask = segment_ids  # flash kernels mask from segment ids alone
         else:
             mask = _attention_mask(segment_ids)
 

@@ -188,6 +188,14 @@ class PPOActor:
         # 1. sequence rewards: overlong penalty -> bias/scale/clip -> norm
         reward_score = np.asarray(data["rewards"], np.float32).reshape(B)
         if cfg.overlong_reward_penalty:
+            # anchor to the fixed generation cap (reference actor.py uses
+            # gconfig.max_new_tokens); a batch-derived cap would make the
+            # penalty a silent no-op (ADVICE r1)
+            if cfg.max_response_length <= 0:
+                raise ValueError(
+                    "overlong_reward_penalty=True requires "
+                    "max_response_length > 0 (set it to the generation cap)"
+                )
             resp_lens = loss_mask_tok.sum(-1)
             reward_score = np.asarray(
                 F.reward_overlong_penalty(
@@ -195,7 +203,7 @@ class PPOActor:
                     jnp.asarray(resp_lens),
                     overlong_tokens=cfg.overlong_tokens,
                     overlong_penalty_factor=cfg.overlong_penalty_factor,
-                    max_response_length=cfg.overlong_tokens + int(resp_lens.max()),
+                    max_response_length=cfg.max_response_length,
                 )
             )
         reward_score = (reward_score + cfg.reward_bias) * cfg.reward_scaling

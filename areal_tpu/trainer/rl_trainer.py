@@ -49,6 +49,12 @@ class PPOTrainer:
         self.config = config
         self.tokenizer = tokenizer
 
+        # allocation-mode DSL is the single topology knob (reference
+        # rl_trainer.py:91): resolve it into engine/server MeshConfigs first
+        from areal_tpu.api.alloc_mode import apply_allocation_mode
+
+        self.allocation_mode = apply_allocation_mode(config)
+
         self.train_dataloader = StatefulDataLoader(
             train_dataset,
             batch_size=config.train_dataset.batch_size,

@@ -114,8 +114,9 @@ class RWEngine:
         # pair integrity is guaranteed by OUR granularity-2 split; the
         # engine's internal token-budget FFD re-split is pair-blind and
         # could strand a pair's halves in different grids (marks>=2 gate
-        # would then silently drop them) — disable it
-        self.engine.config.mb_spec = MicroBatchSpec(max_tokens_per_mb=None)
+        # would then silently drop them) — disable it per-call (never
+        # mutate the caller's shared engine config; ADVICE r1)
+        self._engine_mb_spec = MicroBatchSpec(max_tokens_per_mb=None)
         if self.mb_spec.max_tokens_per_mb is None:
             self.mb_spec = dataclasses.replace(
                 self.mb_spec, max_tokens_per_mb=32768
@@ -146,6 +147,7 @@ class RWEngine:
                 self._prep(mb),
                 loss_fn=rw_loss_fn,
                 loss_weight_fn=lambda x: float(len(np.asarray(x["rw_sign"]))) or 1.0,
+                mb_spec=self._engine_mb_spec,
             )
             out.append(stats)
         return out
@@ -165,6 +167,9 @@ class SFTTrainer:
     ):
         self.config = config
         self.tokenizer = tokenizer
+        from areal_tpu.api.alloc_mode import apply_allocation_mode
+
+        apply_allocation_mode(config)
         self.train_dataloader = StatefulDataLoader(
             train_dataset,
             batch_size=config.train_dataset.batch_size,

@@ -84,3 +84,57 @@ def test_gen_only():
 def test_moe_hybrid_world_mismatch_rejected():
     with pytest.raises(ValueError):
         AllocationMode.from_str("megatron:(attn:d4t2|ffn:d2e2)")
+
+
+# -- live wiring: apply_allocation_mode ------------------------------------
+
+
+def test_apply_allocation_mode_ppo():
+    from areal_tpu.api.alloc_mode import apply_allocation_mode
+    from areal_tpu.api.config import MeshConfig, PPOConfig
+
+    cfg = PPOConfig(allocation_mode="jax:d2t2+gspmd:d4c2t1")
+    mode = apply_allocation_mode(cfg)
+    assert mode is not None
+    assert cfg.actor.mesh == MeshConfig(data=1, fsdp=4, seq=2, model=1, expert=1)
+    assert cfg.server.mesh == MeshConfig(data=1, fsdp=1, seq=1, model=2, expert=1)
+    assert cfg.launcher.n_servers == 2
+
+
+def test_apply_allocation_mode_explicit_mesh_wins():
+    from areal_tpu.api.alloc_mode import apply_allocation_mode
+    from areal_tpu.api.config import MeshConfig, PPOConfig
+
+    cfg = PPOConfig(allocation_mode="gspmd:d8")
+    cfg.actor.mesh = MeshConfig(data=2, fsdp=4)
+    apply_allocation_mode(cfg)
+    assert cfg.actor.mesh == MeshConfig(data=2, fsdp=4)  # not overwritten
+
+
+def test_apply_allocation_mode_noop_when_empty():
+    from areal_tpu.api.alloc_mode import apply_allocation_mode
+    from areal_tpu.api.config import MeshConfig, PPOConfig
+
+    cfg = PPOConfig()
+    assert apply_allocation_mode(cfg) is None
+    assert cfg.actor.mesh == MeshConfig()
+
+
+def test_apply_allocation_mode_critic_role():
+    from areal_tpu.api.alloc_mode import apply_allocation_mode
+    from areal_tpu.api.config import MeshConfig, PPOConfig, PPOCriticConfig
+
+    cfg = PPOConfig(allocation_mode="gspmd[a]:d4|gspmd[c]:d2t2")
+    cfg.critic = PPOCriticConfig()
+    apply_allocation_mode(cfg)
+    assert cfg.actor.mesh == MeshConfig(data=1, fsdp=4)
+    assert cfg.critic.mesh == MeshConfig(data=1, fsdp=2, model=2, seq=1, expert=1)
+
+
+def test_apply_allocation_mode_moe_hybrid():
+    from areal_tpu.api.alloc_mode import apply_allocation_mode
+    from areal_tpu.api.config import MeshConfig, PPOConfig
+
+    cfg = PPOConfig(allocation_mode="gspmd:(attn:d4t2|ffn:d2e4)")
+    apply_allocation_mode(cfg)
+    assert cfg.actor.mesh == MeshConfig(data=1, fsdp=4, model=2, seq=1, expert=4)
