@@ -218,7 +218,12 @@ class DecodeEngine:
                     k: NamedSharding(self.mesh, s) for k, s in kv_spec.items()
                 },
             )()
-        # per-slot host state
+        # host mirror of per-slot state. The authoritative decode state lives
+        # ON DEVICE (self._dev_state): the loop never round-trips it through
+        # the host — one packed upload per admission event, one packed
+        # download per chunk. (Round-1 uploaded 9 arrays and downloaded 7
+        # per chunk; over a high-latency host<->TPU link each transfer is an
+        # RPC, and that overhead tripled per-token cost.)
         self._slot_task: list[_Task | None] = [None] * S
         self._state = {
             "ids": np.zeros(S, np.int32),
@@ -231,6 +236,8 @@ class DecodeEngine:
             "top_p": np.ones(S, np.float32),
             "stop_ids": np.full((S, _MAX_STOP), -1, np.int32),
         }
+        with jax.set_mesh(self.mesh):
+            self._dev_state = {k: jnp.asarray(v) for k, v in self._state.items()}
         self._rng = jax.random.PRNGKey(int(time.time_ns()) % (2**31))
         self.initialized = True
         logger.info(
@@ -516,6 +523,15 @@ class DecodeEngine:
         return self._fn_cache[key]
 
     def _chunk_fn(self, n_steps: int, window: int, capped: bool):
+        """n_steps of decode for all slots in one jitted call.
+
+        Returns (cache, state, rng, packed) where ``packed`` is ONE int32
+        array [2*n_steps + 3, S] — token rows, logprob-bit rows (fp32
+        bitcast), then emit_count / final-active / final-pos rows — so the
+        host pays a single device->host transfer per chunk. Emission is
+        monotone within a chunk (a stopped slot never re-activates; admits
+        happen between chunks), so per-slot counts fully describe the
+        emit mask."""
         key = ("chunk", n_steps, window, capped)
         if key not in self._fn_cache:
             mcfg = self.model_cfg
@@ -563,9 +579,52 @@ class DecodeEngine:
                 )
                 out_state = dict(state)
                 out_state.update(ids=ids, pos=pos, active=active, remaining=remaining)
-                return cache, out_state, rng, toks, logps, emit
+                packed = jnp.concatenate(
+                    [
+                        toks.astype(jnp.int32),  # [n_steps, S]
+                        jax.lax.bitcast_convert_type(
+                            logps.astype(jnp.float32), jnp.int32
+                        ),  # [n_steps, S]
+                        emit.sum(0, dtype=jnp.int32)[None],  # emit_count [1, S]
+                        active.astype(jnp.int32)[None],  # [1, S]
+                        pos.astype(jnp.int32)[None],  # [1, S]
+                    ],
+                    axis=0,
+                )
+                return cache, out_state, rng, packed
 
-            self._fn_cache[key] = jax.jit(chunk, donate_argnames=("cache",))
+            self._fn_cache[key] = jax.jit(
+                chunk, donate_argnames=("cache", "state")
+            )
+        return self._fn_cache[key]
+
+    def _update_fn(self, n: int):
+        """Jitted slot-state scatter: one packed fp32 [n, 9+_MAX_STOP] upload
+        (columns: slot, ids, pos, active, remaining, top_k, greedy, temp,
+        top_p, stop_ids...) applied on device. All values fit fp32 exactly
+        (token ids < 2^24). Padded rows repeat row 0 (idempotent scatter)."""
+        key = ("upd", n)
+        if key not in self._fn_cache:
+
+            def apply(state, upd):
+                sl = upd[:, 0].astype(jnp.int32)
+                state = dict(state)
+                state["ids"] = state["ids"].at[sl].set(upd[:, 1].astype(jnp.int32))
+                state["pos"] = state["pos"].at[sl].set(upd[:, 2].astype(jnp.int32))
+                state["active"] = state["active"].at[sl].set(upd[:, 3] > 0)
+                state["remaining"] = (
+                    state["remaining"].at[sl].set(upd[:, 4].astype(jnp.int32))
+                )
+                state["top_k"] = state["top_k"].at[sl].set(upd[:, 5].astype(jnp.int32))
+                state["greedy"] = state["greedy"].at[sl].set(upd[:, 6] > 0)
+                state["temp"] = state["temp"].at[sl].set(upd[:, 7])
+                state["top_p"] = state["top_p"].at[sl].set(upd[:, 8])
+                state["stop_ids"] = (
+                    state["stop_ids"].at[sl].set(upd[:, 9 : 9 + _MAX_STOP].astype(jnp.int32))
+                )
+                return state
+
+            self._fn_cache[key] = jax.jit(apply, donate_argnames=("state",))
         return self._fn_cache[key]
 
     # -- decode loop ------------------------------------------------------
@@ -588,11 +647,45 @@ class DecodeEngine:
         rid = min(self._parked, key=lambda r: self._parked[r].park_time)
         return self._parked.pop(rid).slot
 
-    def _set_slot_sampling(self, task: _Task, slot: int) -> None:
-        g = task.req.gconfig
+    def _pack_row(
+        self,
+        slot: int,
+        last_id: int,
+        pos: int,
+        active: bool,
+        remaining: int,
+        top_k: int = -1,
+        greedy: bool = False,
+        temp: float = 1.0,
+        top_p: float = 1.0,
+        stops: list[int] | None = None,
+    ) -> np.ndarray:
+        """The ONE place that knows the packed scatter-row column order (must
+        match ``_update_fn``): update the host mirror and build the fp32 row."""
+        stops = (list(stops or []) + [-1] * _MAX_STOP)[:_MAX_STOP]
         st = self._state
-        st["temp"][slot] = 0.0 if g.greedy else g.temperature
-        st["greedy"][slot] = bool(g.greedy or g.temperature == 0.0)
+        st["ids"][slot] = last_id
+        st["pos"][slot] = pos
+        st["active"][slot] = active
+        st["remaining"][slot] = remaining
+        st["temp"][slot] = temp
+        st["greedy"][slot] = greedy
+        st["top_k"][slot] = top_k
+        st["top_p"][slot] = top_p
+        st["stop_ids"][slot] = stops
+        return np.asarray(
+            [slot, last_id, pos, active, remaining, top_k, greedy, temp, top_p, *stops],
+            np.float32,
+        )
+
+    def _slot_update_row(
+        self, task: _Task, slot: int, last_id: int, pos: int, remaining: int
+    ) -> np.ndarray:
+        """Admit ``task`` into ``slot``: derive per-slot sampling state from
+        the request and pack the device scatter row."""
+        g = task.req.gconfig
+        temp = 0.0 if g.greedy else g.temperature
+        greedy = bool(g.greedy or g.temperature == 0.0)
         top_k = g.top_k if g.top_k and g.top_k > 0 else -1
         if top_k > _TOPK_CAP:
             # the candidate set is statically capped; top_k beyond it (or a
@@ -603,10 +696,18 @@ class DecodeEngine:
                 f"{_TOPK_CAP}; clamping (rid={task.req.rid})"
             )
             top_k = _TOPK_CAP
-        st["top_k"][slot] = top_k
-        st["top_p"][slot] = g.top_p if g.top_p else 1.0
-        stops = (list(g.stop_token_ids) + [-1] * _MAX_STOP)[:_MAX_STOP]
-        st["stop_ids"][slot] = stops
+        return self._pack_row(
+            slot,
+            last_id,
+            pos,
+            True,
+            remaining,
+            top_k=top_k,
+            greedy=greedy,
+            temp=temp,
+            top_p=g.top_p if g.top_p else 1.0,
+            stops=g.stop_token_ids,
+        )
 
     def _budget(self, task: _Task, prompt_len: int) -> int:
         g = task.req.gconfig
@@ -616,38 +717,38 @@ class DecodeEngine:
             budget = min(budget, g.max_tokens - prompt_len)
         return max(1, min(budget, T - 1 - prompt_len))
 
-    def _try_resume(self, task: _Task) -> bool:
+    def _try_resume(self, task: _Task) -> np.ndarray | None:
         """rid-affinity KV reuse: if this rid's previous abort left its slot
         cache intact and the resubmitted ids are exactly prompt+emitted,
-        restore decode state with zero prefill."""
+        restore decode state with zero prefill. Returns the slot-update row."""
         rid = task.req.rid
         if not rid or rid not in self._parked:
-            return False
+            return None
         p = self._parked[rid]
         ids = list(task.req.input_ids)
         if ids != p.full_ids:
             # rid reused with different content — drop the stale parking
             del self._parked[rid]
-            return False
+            return None
         del self._parked[rid]
         slot = p.slot
         P_len = len(ids)
         task.slot = slot
         task.prompt_len = P_len
         self._slot_task[slot] = task
-        st = self._state
-        st["ids"][slot] = ids[-1]
-        st["pos"][slot] = p.pos
-        st["active"][slot] = True
-        st["remaining"][slot] = self._budget(task, P_len)
-        self._set_slot_sampling(task, slot)
+        row = self._slot_update_row(
+            task, slot, ids[-1], p.pos, self._budget(task, P_len)
+        )
         self.stats["kv_resumes"] += 1
-        return True
+        return row
 
-    def _admit_pending(self) -> None:
+    def _admit_pending(self) -> list[np.ndarray]:
         """Admit backlog + queue into slots: resume parked rids in place,
-        then group fresh prompts by length bucket and batch-prefill."""
+        then group fresh prompts by length bucket and batch-prefill. Returns
+        the packed slot-update rows to scatter on device (the prefill cache
+        writes are already enqueued)."""
         T = self.config.max_seq_len
+        rows: list[np.ndarray] = []
         to_prefill: list[tuple[_Task, int]] = []  # (task, slot)
         free = self._free_slots()
         while not self._paused.is_set():
@@ -662,7 +763,9 @@ class DecodeEngine:
             if P_len >= T - 2 or P_len == 0:
                 self._finish(task, StopReason.LENGTH.value)
                 continue
-            if self._try_resume(task):
+            row = self._try_resume(task)
+            if row is not None:
+                rows.append(row)
                 continue
             if not free:
                 evicted = self._evict_oldest_parked()
@@ -681,10 +784,13 @@ class DecodeEngine:
             i = 0
             while i < len(group):
                 A = next(a for a in _PREFILL_SIZES if a <= len(group) - i)
-                self._prefill_group(group[i : i + A], bucket)
+                rows.extend(self._prefill_group(group[i : i + A], bucket))
                 i += A
+        return rows
 
-    def _prefill_group(self, group: list[tuple[_Task, int]], bucket: int) -> None:
+    def _prefill_group(
+        self, group: list[tuple[_Task, int]], bucket: int
+    ) -> list[np.ndarray]:
         A = len(group)
         ids_np = np.zeros((A, bucket), np.int32)
         plens = np.zeros(A, np.int32)
@@ -702,19 +808,40 @@ class DecodeEngine:
                 jnp.asarray(plens),
                 jnp.asarray(slots),
             )
-        st = self._state
+        rows = []
         for j, (task, slot) in enumerate(group):
             P_len = int(plens[j])
             task.slot = slot
             task.prompt_len = P_len
             self._slot_task[slot] = task
-            st["ids"][slot] = int(ids_np[j, P_len - 1])
-            st["pos"][slot] = P_len - 1
-            st["active"][slot] = True
-            st["remaining"][slot] = self._budget(task, P_len)
-            self._set_slot_sampling(task, slot)
+            rows.append(
+                self._slot_update_row(
+                    task,
+                    slot,
+                    int(ids_np[j, P_len - 1]),
+                    P_len - 1,
+                    self._budget(task, P_len),
+                )
+            )
         self.stats["prefills"] += A
         self.stats["prefill_batches"] += 1
+        return rows
+
+    def _apply_slot_updates(self, rows: list[np.ndarray]) -> None:
+        """Scatter admission rows into the device state: one upload, one
+        jitted execute. Row count is bucketed (padding repeats row 0, an
+        idempotent scatter) to bound compile variants."""
+        if not rows:
+            return
+        n = 1
+        while n < len(rows):
+            n *= 2
+        n = min(n, self.config.max_batch_size)
+        upd = np.stack(rows + [rows[0]] * (n - len(rows)))
+        with jax.set_mesh(self.mesh):
+            self._dev_state = self._update_fn(n)(
+                self._dev_state, jnp.asarray(upd)
+            )
 
     def _finish(self, task: _Task, reason: str) -> None:
         if task.slot >= 0:
@@ -742,6 +869,7 @@ class DecodeEngine:
 
     def _abort_all(self) -> None:
         st = self._state
+        deact: list[int] = []
         for slot, task in enumerate(self._slot_task):
             if task is not None:
                 rid = task.req.rid
@@ -753,14 +881,103 @@ class DecodeEngine:
                         full_ids=list(task.req.input_ids) + list(task.out_tokens),
                         pos=int(st["pos"][slot]),
                     )
+                if st["active"][slot]:
+                    deact.append(slot)
                 self._finish(task, StopReason.ABORT.value)
+        # the device state is authoritative between uploads: deactivate the
+        # aborted slots there too, or the next dispatched chunk would keep
+        # decoding into parked/released caches
+        if deact and self.cache is not None:
+            rows = [
+                self._pack_row(slot, 0, int(st["pos"][slot]), False, 0)
+                for slot in deact
+            ]
+            self._apply_slot_updates(rows)
 
-    def _loop(self) -> None:
+    def _dispatch_chunk(self) -> dict | None:
+        """Enqueue one decode chunk against the device-resident state and
+        return a pending record; the packed emissions are downloaded later
+        (next iteration) so the chunk's compute overlaps host processing of
+        the previous chunk — over a high-latency link the download RTT is
+        fully hidden behind device compute."""
         cfg = self.config
         T = cfg.max_seq_len
+        st = self._state
+        active = st["active"]
+        if not active.any():
+            return None
+        n_steps = cfg.decode_steps_per_call
+        # host pos can be one in-flight chunk stale -> widen by 2 chunks
+        max_pos = int(st["pos"][active].max())
+        window = min(T, round_up_to_bucket(max_pos + 1 + 2 * n_steps, _WINDOW_STEP))
+        capped = bool(((st["top_k"] > 0) | (st["top_p"] < 1.0))[active].any())
+        chunk = self._chunk_fn(n_steps, window, capped)
+        with jax.set_mesh(self.mesh):
+            self.cache, self._dev_state, self._rng, packed = chunk(
+                self.params, self.cache, self._dev_state, self._rng
+            )
+        return {
+            "packed": packed,
+            "n_steps": n_steps,
+            "version": self._version,
+            "was_active": active.copy(),
+            # task identity per slot at dispatch: a slot can turn over
+            # between dispatch and drain (its task finished in an earlier
+            # drain, a new task admitted) — results then belong to the OLD
+            # task, and the new one must not be touched
+            "tasks": list(self._slot_task),
+        }
+
+    def _drain(self, pending: dict | None) -> None:
+        """Download one chunk's packed emissions (a single transfer) and
+        credit tokens / finish tasks. Slots admitted after the chunk was
+        dispatched are excluded via the was_active snapshot."""
+        if pending is None:
+            return
+        packed = np.asarray(pending["packed"])  # the one device->host pull
+        n_steps = pending["n_steps"]
+        version = pending["version"]
+        was_active = pending["was_active"]
+        toks = packed[:n_steps]
+        logps = packed[n_steps : 2 * n_steps].view(np.float32)
+        emit_count = packed[2 * n_steps]
+        active = packed[2 * n_steps + 1].astype(bool)
+        pos = packed[2 * n_steps + 2]
+        st = self._state
+        now = time.monotonic()
+        for slot, task in enumerate(pending["tasks"]):
+            if task is None or not was_active[slot]:
+                continue
+            if task is not self._slot_task[slot]:
+                continue  # slot turned over since dispatch; nothing to credit
+            c = int(emit_count[slot])
+            if c:
+                if task.first_token_time is None:
+                    task.first_token_time = now
+                task.out_tokens.extend(int(t) for t in toks[:c, slot])
+                task.out_logprobs.extend(float(x) for x in logps[:c, slot])
+                task.out_versions.extend([version] * c)
+                self.stats["generated_tokens"] += c
+            st["pos"][slot] = int(pos[slot])
+            st["ids"][slot] = int(toks[c - 1, slot]) if c else st["ids"][slot]
+            st["remaining"][slot] -= c
+            st["active"][slot] = bool(active[slot])
+            if not active[slot]:
+                last = task.out_tokens[-1] if task.out_tokens else -1
+                if last in task.req.gconfig.stop_token_ids:
+                    reason = StopReason.STOP.value
+                else:
+                    reason = StopReason.LENGTH.value
+                self._finish(task, reason)
+        self.stats["chunks"] += 1
+
+    def _loop(self) -> None:
+        pending: dict | None = None
         while not self._shutdown.is_set():
             self._apply_weight_update()
             if self._paused.is_set():
+                self._drain(pending)
+                pending = None
                 self._abort_all()
                 # release_memory waits on this: no chunk is in flight and
                 # _abort_all (incl. KV parking) has completed
@@ -768,53 +985,24 @@ class DecodeEngine:
                 self._wakeup.wait(timeout=0.05)
                 self._wakeup.clear()
                 continue
-            self._admit_pending()
-            if not any(t is not None for t in self._slot_task):
+            if self.cache is None:
+                # memory released and not yet resumed: nothing to run on
                 self._wakeup.wait(timeout=0.05)
                 self._wakeup.clear()
                 continue
-            # one decode chunk for all active slots; the attention window is
-            # bucketed to the live max fill so short contexts don't pay
-            # full-T cache reads (one compiled chunk per window bucket)
-            n_steps = cfg.decode_steps_per_call
-            st = self._state
-            active = st["active"]
-            max_pos = int(st["pos"][active].max()) if active.any() else 0
-            window = min(T, round_up_to_bucket(max_pos + 1 + n_steps, _WINDOW_STEP))
-            capped = bool(
-                ((st["top_k"] > 0) | (st["top_p"] < 1.0))[active].any()
-            )
-            chunk = self._chunk_fn(n_steps, window, capped)
-            with jax.set_mesh(self.mesh):
-                dev_state = {k: jnp.asarray(v) for k, v in st.items()}
-                self.cache, out_state, self._rng, toks, logps, emit = chunk(
-                    self.params, self.cache, dev_state, self._rng
-                )
-                toks = np.asarray(toks)
-                logps = np.asarray(logps)
-                emit = np.asarray(emit)
-                for k in ("ids", "pos", "active", "remaining"):
-                    st[k] = np.array(out_state[k])  # writable host copy
-            self.stats["chunks"] += 1
-            version = self._version
-            now = time.monotonic()
-            for slot, task in enumerate(self._slot_task):
-                if task is None:
-                    continue
-                emitted = emit[:, slot]
-                n_emit = int(emitted.sum())
-                if n_emit:
-                    if task.first_token_time is None:
-                        task.first_token_time = now
-                    task.out_tokens.extend(int(t) for t in toks[emitted, slot])
-                    task.out_logprobs.extend(float(x) for x in logps[emitted, slot])
-                    task.out_versions.extend([version] * n_emit)
-                    self.stats["generated_tokens"] += n_emit
-                if not st["active"][slot]:
-                    last = task.out_tokens[-1] if task.out_tokens else -1
-                    if last in task.req.gconfig.stop_token_ids:
-                        reason = StopReason.STOP.value
-                    else:
-                        reason = StopReason.LENGTH.value
-                    self._finish(task, reason)
+            # admissions enqueue prefills + ONE packed state scatter; the
+            # in-flight chunk (if any) ordered before them touches only
+            # previously-active slots, so there is no dataflow hazard
+            rows = self._admit_pending()
+            self._apply_slot_updates(rows)
+            # speculatively dispatch the next chunk, then pay the previous
+            # chunk's download while this one computes
+            dispatched = self._dispatch_chunk()
+            self._drain(pending)
+            pending = dispatched
+            if pending is None:
+                if not any(t is not None for t in self._slot_task):
+                    self._wakeup.wait(timeout=0.05)
+                    self._wakeup.clear()
+        self._drain(pending)
         self._abort_all()

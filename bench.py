@@ -103,8 +103,10 @@ def phase_probe():
 
 
 def phase_decode():
-    """Generated tokens/sec: 48 concurrent slots, 128-token prompts, 256 new
-    tokens each, continuous batching."""
+    """Generated tokens/sec: 128 concurrent slots, 128-token prompts, 256 new
+    tokens each, continuous batching. 128 slots is the measured throughput
+    knee on v5e at 1.5B (48→5.0k, 96→6.6k, 128→7.2k, 256→6.4k tok/s raw
+    chunk compute); the pipelined loop hides host RTT behind device time."""
     import numpy as np
     import jax
 
@@ -115,7 +117,7 @@ def phase_decode():
 
     model_cfg = qwen.ModelConfig(**MODEL_KW)
     cfg = ServerConfig(
-        max_batch_size=48,
+        max_batch_size=128,
         max_seq_len=512,
         decode_steps_per_call=32,
         mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
@@ -129,7 +131,7 @@ def phase_decode():
     eng.start()
 
     rng = np.random.default_rng(0)
-    n_req, new_tokens = 96, 256
+    n_req, new_tokens = 256, 256
     done = threading.Event()
     results = []
     lock = threading.Lock()
