@@ -327,15 +327,26 @@ class AllocationMode:
 
 def _mesh_config_of(ps: "ParallelStrategy | HybridParallelStrategy"):
     """ParallelStrategy -> MeshConfig (dp→fsdp: ZeRO sharding is the TPU
-    default for DP; tp→model, cp→seq, ep→expert). Hybrid MoE specs shard
-    attention by the attn spec and experts by the ffn spec's ep degree."""
+    default for DP; tp→model, cp→seq, ep→expert).
+
+    Expert parallelism reuses the data-parallel devices (reference
+    fsdp_utils/parallel.py:84-121 — "EP borrows dp degrees"; world_size
+    excludes ep for the same reason), so the expert axis is carved OUT of
+    the dp degree: fsdp = dp / ep, keeping the mesh axis product equal to
+    the allocation's world size. Hybrid specs take attention layout from
+    the attn half and ep from the ffn half."""
     from areal_tpu.api.config import MeshConfig
 
     if isinstance(ps, HybridParallelStrategy):
-        return MeshConfig(
-            data=1, fsdp=ps.attn.dp, seq=ps.attn.cp, model=ps.attn.tp, expert=ps.ffn.ep
+        dp, cp, tp, ep = ps.attn.dp, ps.attn.cp, ps.attn.tp, ps.ffn.ep
+    else:
+        dp, cp, tp, ep = ps.dp, ps.cp, ps.tp, ps.ep
+    if dp % ep != 0:
+        raise ValueError(
+            f"ep={ep} must divide dp={dp} "
+            "(expert parallelism borrows data-parallel degrees)"
         )
-    return MeshConfig(data=1, fsdp=ps.dp, seq=ps.cp, model=ps.tp, expert=ps.ep)
+    return MeshConfig(data=1, fsdp=dp // ep, seq=cp, model=tp, expert=ep)
 
 
 def apply_allocation_mode(config) -> "AllocationMode | None":
@@ -371,14 +382,13 @@ def apply_allocation_mode(config) -> "AllocationMode | None":
     gen_ps = mode.gen
     server_cfg = getattr(config, "server", None)
     if gen_ps is not None and server_cfg is not None:
-        if isinstance(gen_ps, HybridParallelStrategy):
-            gen_ps = gen_ps.attn
-        # one server process per gen DP replica; each owns a tp×cp chip slice
+        # the gen layout is the train mapping with the replica axis peeled
+        # off: one server per fsdp slice, each owning a cp×tp×ep chip slice
+        gen_mesh = _mesh_config_of(gen_ps)
+        n_servers = gen_mesh.fsdp
         if getattr(server_cfg, "mesh", None) == default:
-            server_cfg.mesh = MeshConfig(
-                data=1, fsdp=1, seq=gen_ps.cp, model=gen_ps.tp, expert=gen_ps.ep
-            )
+            server_cfg.mesh = dataclasses.replace(gen_mesh, fsdp=1)
         launcher = getattr(config, "launcher", None)
         if launcher is not None:
-            launcher.n_servers = gen_ps.dp
+            launcher.n_servers = n_servers
     return mode

@@ -1,0 +1,5 @@
+from areal_tpu.openai.cache import InteractionCache
+from areal_tpu.openai.client import ArealOpenAI
+from areal_tpu.openai.types import ChatCompletion, Interaction
+
+__all__ = ["ArealOpenAI", "ChatCompletion", "Interaction", "InteractionCache"]

@@ -1,0 +1,111 @@
+"""Interaction cache: insertion-ordered store with conversation-tree linking.
+
+Behavioral parity with reference experimental/openai/cache.py: on insert, the
+new interaction's parent is the cached interaction whose (messages + output
+messages) list is the longest strict prefix of the new input messages;
+rewards propagate backwards with a per-turn discount; export returns either
+every interaction ('individual') or only conversation-tree leaves ('concat').
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from areal_tpu.openai.types import Interaction
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("openai_cache")
+
+
+def _is_prefix(a: list[dict], b: list[dict]) -> bool:
+    return len(a) <= len(b) and b[: len(a)] == a
+
+
+class InteractionCache(OrderedDict):
+    """id -> Interaction, insertion-ordered."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._lock = threading.Lock()
+        self._discount_applied = False
+
+    @property
+    def last_interaction_id(self) -> str:
+        return next(reversed(self))
+
+    def __setitem__(self, key: str, value: Interaction) -> None:
+        # longest-prefix parent resolution (reference cache.py __setitem__)
+        best = None
+        for cand in self.values():
+            if cand.output_messages is None:
+                continue  # still in flight; cannot be a parent
+            cand_data = cand.messages + cand.output_messages
+            if _is_prefix(cand_data, value.messages):
+                if best is None or len(cand_data) > len(
+                    best.messages + best.output_messages
+                ):
+                    best = cand
+        value.parent = best
+        super().__setitem__(key, value)
+
+    def set_reward(self, interaction_id: str, reward: float) -> None:
+        with self._lock:
+            self[interaction_id].reward = reward
+
+    def set_last_reward(self, reward: float) -> None:
+        self.set_reward(self.last_interaction_id, reward)
+
+    @property
+    def total_reward(self) -> float:
+        return sum(i.reward or 0.0 for i in self.values())
+
+    def apply_reward_discount(self, turn_discount: float = 1.0) -> dict:
+        """Backward-propagate rewards in reverse insertion order:
+        reward[i] = reward[i+1]*discount + own_reward[i]."""
+        if self._discount_applied:
+            raise RuntimeError("apply_reward_discount should only be called once")
+        self._discount_applied = True
+        current = 0.0
+        items = list(self.values())
+        if items and items[-1].reward is None:
+            logger.warning(
+                "most recent interaction has no reward; discounting from 0"
+            )
+        for inter in reversed(items):
+            current = current * turn_discount + (inter.reward or 0.0)
+            inter.reward = current
+        return dict(self)
+
+    def export_interactions(
+        self, style: str = "individual", turn_discount: float | None = None
+    ) -> dict:
+        """'individual': every complete interaction. 'concat': only
+        conversation-tree leaves (each leaf's tensor dict concatenates its
+        ancestor chain — requires chat_template_type == 'concat')."""
+        if turn_discount is not None and not self._discount_applied:
+            self.apply_reward_discount(turn_discount)
+        complete = {}
+        for id_, inter in self.items():
+            if inter.output_messages is None or inter.model_response is None:
+                logger.warning(f"skipping incomplete interaction {id_}")
+                continue
+            complete[id_] = inter
+        if style == "individual":
+            return complete
+        if style == "concat":
+            for inter in complete.values():
+                if inter.chat_template_type != "concat":
+                    raise ValueError(
+                        "concat export requires chat_template_type='concat' "
+                        "(hf templates may add/remove tokens between turns)"
+                    )
+            has_children = {
+                id(inter.parent) for inter in complete.values() if inter.parent
+            }
+            return {
+                id_: inter
+                for id_, inter in complete.items()
+                if id(inter) not in has_children
+            }
+        raise ValueError(f"unknown export style {style!r}")

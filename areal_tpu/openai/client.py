@@ -1,0 +1,329 @@
+"""ArealOpenAI: an OpenAI-compatible async client over the inference engine.
+
+The reference wraps the `openai` SDK's AsyncOpenAI and swaps its transport
+for the RL inference engine (experimental/openai/client.py:1035-1133) so any
+SDK-based agent trains by replacing the client object / base_url. This build
+provides the same call surface (`client.chat.completions.create(...)`)
+self-contained: the engine is any object with ``async agenerate(ModelRequest)
+-> ModelResponse`` (the remote client, a controller, or the in-process
+decode engine wrapper), and every completion is recorded as an
+``Interaction`` carrying token ids, logprobs, and per-token policy versions
+for training export.
+
+Reward flow (reference client.py:1088-1129): the agent (or workflow) calls
+``set_reward(id, r)`` / ``set_last_reward(r)``, optionally
+``apply_reward_discount(gamma)``, then ``export_interactions(style)`` and
+``to_tensor_dict()`` feed the trainer.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any
+
+from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+from areal_tpu.openai.cache import InteractionCache
+from areal_tpu.openai.tool_call_parser import process_tool_calls
+from areal_tpu.openai.types import (
+    ChatCompletion,
+    ChatCompletionChoice,
+    ChatMessage,
+    Interaction,
+    Usage,
+)
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("openai_client")
+
+_UNSUPPORTED_WARNED: set[str] = set()
+_DEFAULT_MAX_NEW_TOKENS = 512
+
+
+def _warn_once(param: str) -> None:
+    if param not in _UNSUPPORTED_WARNED:
+        _UNSUPPORTED_WARNED.add(param)
+        logger.warning(f"ignoring unsupported OpenAI parameter {param!r}")
+
+
+def concat_prompt_token_ids_with_parent(
+    remaining_messages: list[dict],
+    parent: Interaction | None,
+    tokenizer,
+    tools: list[dict] | None = None,
+) -> list[int]:
+    """concat chat-template mode: the child's prompt is the parent's exact
+    token record (prompt + generated) plus only the *new* messages tokenized
+    — guaranteeing the shared prefix is token-identical across turns so the
+    conversation tree concatenates losslessly (reference client.py:144-212)."""
+    suffix = tokenizer.apply_chat_template(
+        remaining_messages,
+        tools=tools,
+        add_generation_prompt=True,
+        tokenize=True,
+    )
+    if parent is None or parent.model_response is None:
+        return list(suffix)
+    resp = parent.model_response
+    return list(resp.input_tokens) + list(resp.output_tokens) + list(suffix)
+
+
+def _truncate_at_stop_strings(resp, tokenizer, stop_list: list[str]):
+    """Token-aligned stop-string handling. The decode engine stops on token
+    ids only (strings can split across tokens); the client enforces string
+    stops post-hoc: cut the output at the first token whose cumulative
+    decode contains a stop string, keeping tokens/logprobs/versions aligned
+    for training export. Returns (resp, hit: bool)."""
+    import dataclasses
+
+    if not stop_list or not resp.output_tokens:
+        return resp, False
+    text = tokenizer.decode(resp.output_tokens)
+    hits = [(text.find(s), s) for s in stop_list if text.find(s) != -1]
+    if not hits:
+        return resp, False
+    first_idx = min(h[0] for h in hits)
+    toks = list(resp.output_tokens)
+    k = len(toks)
+    for n in range(1, len(toks) + 1):
+        prefix = tokenizer.decode(toks[:n])
+        if any(s in prefix for _, s in hits):
+            k = n
+            break
+    resp = dataclasses.replace(
+        resp,
+        output_tokens=toks[:k],
+        output_logprobs=list(resp.output_logprobs)[:k],
+        output_versions=list(resp.output_versions)[:k],
+        stop_reason="stop",
+    )
+    resp.metadata = {**resp.metadata, "stop_text_index": first_idx}
+    return resp, True
+
+
+class AsyncChatCompletions:
+    def __init__(self, owner: "ArealOpenAI"):
+        self._o = owner
+
+    async def create(
+        self,
+        *,
+        messages: list[dict],
+        tools: list[dict] | None = None,
+        tool_choice: str | None = None,
+        temperature: float | None = None,
+        top_p: float | None = None,
+        max_tokens: int | None = None,
+        max_completion_tokens: int | None = None,
+        max_total_tokens: int | None = None,
+        stop: str | list[str] | None = None,
+        frequency_penalty: float | None = None,
+        n: int | None = None,
+        store: bool = True,
+        metadata: dict | None = None,
+        stream: bool = False,
+        extra_body: dict | None = None,
+        **unsupported: Any,
+    ) -> ChatCompletion:
+        o = self._o
+        if stream:
+            raise NotImplementedError("streaming responses are not supported yet")
+        if n not in (None, 1):
+            raise NotImplementedError("n != 1 is not supported")
+        for k in unsupported:
+            _warn_once(k)
+        if max_tokens is not None and max_completion_tokens is not None:
+            raise ValueError(
+                "max_tokens is deprecated; set max_completion_tokens (per-turn) "
+                "or max_total_tokens (budget incl. prompt), not both"
+            )
+        messages = [dict(m) for m in messages]
+        if not messages:
+            raise ValueError("messages cannot be empty")
+
+        interaction = Interaction(
+            messages=[dict(m) for m in messages],
+            chat_template_type=o.chat_template_type,
+        )
+        # prompt tokens
+        if o.chat_template_type == "concat":
+            # parent resolution needs the cache's prefix logic; stage the
+            # interaction first so __setitem__ links it, then tokenize only
+            # the remaining messages
+            completion_id = ChatCompletion().id
+            if store:
+                o._cache[completion_id] = interaction
+            parent = interaction.parent
+            parent_len = (
+                len(parent.messages + (parent.output_messages or []))
+                if parent is not None
+                else 0
+            )
+            prompt_ids = concat_prompt_token_ids_with_parent(
+                messages[parent_len:], parent, o.tokenizer, tools
+            )
+        else:
+            completion_id = ChatCompletion().id
+            if store:
+                o._cache[completion_id] = interaction
+            prompt_ids = list(
+                o.tokenizer.apply_chat_template(
+                    messages,
+                    tools=tools,
+                    add_generation_prompt=True,
+                    tokenize=True,
+                    **(extra_body or {}).get("chat_template_kwargs", {}),
+                )
+            )
+
+        # token budget resolution (reference client.py:420-480)
+        total = max_total_tokens
+        if o.engine_max_tokens is not None:
+            total = (
+                o.engine_max_tokens if total is None else min(total, o.engine_max_tokens)
+            )
+        max_new = None
+        if total is not None:
+            max_new = total - len(prompt_ids)
+            if max_new <= 0:
+                if store:
+                    o._cache.pop(completion_id, None)
+                raise ValueError(
+                    f"prompt length {len(prompt_ids)} exceeds the total token "
+                    f"budget {total}"
+                )
+        per_turn = max_completion_tokens if max_completion_tokens is not None else max_tokens
+        if per_turn is not None:
+            max_new = per_turn if max_new is None else min(max_new, per_turn)
+        if max_new is None:
+            max_new = _DEFAULT_MAX_NEW_TOKENS
+            logger.warning(
+                f"no token limit given; defaulting max_new_tokens={max_new}"
+            )
+
+        temp = 1.0 if temperature is None else temperature
+        if frequency_penalty:
+            # accepted on GenerationHyperparameters but the TPU sampler does
+            # not implement it yet — warn instead of silently ignoring
+            _warn_once("frequency_penalty")
+        stop_list = [stop] if isinstance(stop, str) else list(stop or [])
+        stop_ids = sorted(
+            {
+                tid
+                for tid in (
+                    getattr(o.tokenizer, "eos_token_id", None),
+                    getattr(o.tokenizer, "pad_token_id", None),
+                )
+                if tid is not None
+            }
+        )
+        gconfig = GenerationHyperparameters(
+            n_samples=1,
+            temperature=temp,
+            greedy=temp == 0,
+            top_p=1.0 if top_p is None else top_p,
+            max_new_tokens=max_new,
+            stop=stop_list,
+            stop_token_ids=stop_ids,
+            frequency_penalty=frequency_penalty or 0.0,
+        )
+        req = ModelRequest(
+            input_ids=prompt_ids,
+            gconfig=gconfig,
+            rid=uuid.uuid4().hex,
+            metadata=dict(metadata or {}),
+        )
+        resp = await o.engine.agenerate(req)
+        resp, stop_hit = _truncate_at_stop_strings(resp, o.tokenizer, stop_list)
+
+        out_ids = list(resp.output_tokens)
+        if out_ids and out_ids[-1] in stop_ids:
+            out_ids = out_ids[:-1]  # decode without the stop token
+        output_text = o.tokenizer.decode(out_ids)
+        if stop_hit:
+            # text ends before the stop string itself (OpenAI semantics)
+            cut = resp.metadata.get("stop_text_index")
+            if cut is not None:
+                output_text = output_text[:cut]
+        tool_calls = None
+        finish_reason = resp.stop_reason
+        if tools and tool_choice != "none":
+            tool_calls, output_text, finish_reason = process_tool_calls(
+                output_text,
+                tools,
+                o.tool_call_parser,
+                o.reasoning_parser,
+                finish_reason,
+            )
+        message = ChatMessage(
+            role="assistant", content=output_text, tool_calls=tool_calls
+        )
+        completion = ChatCompletion(
+            id=completion_id,
+            model=o.model_name,
+            choices=[
+                ChatCompletionChoice(
+                    index=0, message=message, finish_reason=finish_reason
+                )
+            ],
+            usage=Usage(
+                prompt_tokens=resp.input_len, completion_tokens=resp.output_len
+            ),
+        )
+        if store:
+            interaction.completion = completion
+            interaction.model_response = resp
+            interaction.output_messages = [message.to_dict()]
+        return completion
+
+
+class _Chat:
+    def __init__(self, owner: "ArealOpenAI"):
+        self.completions = AsyncChatCompletions(owner)
+
+
+class ArealOpenAI:
+    """Drop-in replacement for an AsyncOpenAI client bound to the RL engine."""
+
+    def __init__(
+        self,
+        engine,
+        tokenizer,
+        tool_call_parser: str = "qwen",
+        reasoning_parser: str = "qwen3",
+        engine_max_tokens: int | None = None,
+        chat_template_type: str = "hf",
+        model_name: str = "areal-tpu",
+    ):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.tool_call_parser = tool_call_parser
+        self.reasoning_parser = reasoning_parser
+        self.engine_max_tokens = engine_max_tokens
+        self.chat_template_type = chat_template_type
+        self.model_name = model_name
+        self._cache = InteractionCache()
+        self.chat = _Chat(self)
+
+    # -- reward / export surface (reference client.py:1084-1163) ----------
+    def get_interaction(self, id: str) -> Interaction | None:
+        return self._cache.get(id)
+
+    def set_reward(self, id: str, reward: float) -> None:
+        if id not in self._cache:
+            raise KeyError(f"interaction {id} not found")
+        self._cache.set_reward(id, reward)
+
+    def set_last_reward(self, reward: float) -> None:
+        if not self._cache:
+            raise RuntimeError("no interaction to set reward for")
+        self._cache.set_last_reward(reward)
+
+    @property
+    def total_reward(self) -> float:
+        return self._cache.total_reward
+
+    def apply_reward_discount(self, turn_discount: float = 1.0) -> dict:
+        return self._cache.apply_reward_discount(turn_discount)
+
+    def export_interactions(self, style: str = "individual") -> dict:
+        return self._cache.export_interactions(style)

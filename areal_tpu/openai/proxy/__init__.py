@@ -1,0 +1,4 @@
+from areal_tpu.openai.proxy.gateway import GatewayState, create_gateway_app
+from areal_tpu.openai.proxy.rollout_server import ProxyState, create_proxy_app
+
+__all__ = ["ProxyState", "create_proxy_app", "GatewayState", "create_gateway_app"]

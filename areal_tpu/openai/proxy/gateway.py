@@ -1,0 +1,134 @@
+"""Proxy gateway: one external OpenAI-compatible URL over many proxies.
+
+The reference gateway (experimental/openai/proxy/proxy_gateway.py) is what
+makes "replace base_url and train" work at fleet scale: external agent code
+talks to a single address; the gateway starts sessions on the least-loaded
+backend proxy worker and routes each request by its bearer session key to
+the proxy that owns the session. Same protocol here on aiohttp.
+
+    POST /rl/start_session (admin)  -> {session_id, api_key, base_url}
+    POST /v1/chat/completions, /rl/set_reward, /rl/end_session (session key)
+         -> forwarded verbatim to the owning proxy
+    GET  /health
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import aiohttp
+from aiohttp import web
+
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("proxy_gateway")
+
+FORWARDED_PATHS = ("/v1/chat/completions", "/rl/set_reward", "/rl/end_session")
+
+
+@dataclasses.dataclass
+class SessionRoute:
+    backend: str  # base url of the owning proxy
+    session_id: str
+
+
+class GatewayState:
+    def __init__(self, backends: list[str], admin_api_key: str):
+        assert backends, "gateway needs at least one backend proxy"
+        self.backends = list(backends)
+        self.admin_api_key = admin_api_key
+        self.routes: dict[str, SessionRoute] = {}  # api_key -> route
+        self.load: dict[str, int] = {b: 0 for b in self.backends}
+
+    def pick_backend(self) -> str:
+        return min(self.backends, key=lambda b: self.load.get(b, 0))
+
+
+def _bearer(request: web.Request) -> str:
+    auth = request.headers.get("Authorization", "")
+    if auth.startswith("Bearer "):
+        return auth[len("Bearer ") :]
+    return request.headers.get("X-API-Key", "")
+
+
+def create_gateway_app(state: GatewayState) -> web.Application:
+    app = web.Application(client_max_size=512 * 1024 * 1024)
+    app["state"] = state
+
+    async def _client(app_: web.Application) -> aiohttp.ClientSession:
+        return app_["http"]
+
+    async def on_startup(app_):
+        app_["http"] = aiohttp.ClientSession()
+
+    async def on_cleanup(app_):
+        await app_["http"].close()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+
+    async def health(_):
+        return web.json_response(
+            {"status": "ok", "backends": state.backends, "sessions": len(state.routes)}
+        )
+
+    async def start_session(request: web.Request):
+        if _bearer(request) != state.admin_api_key:
+            raise web.HTTPForbidden(text="admin API key required")
+        body = await request.json()
+        backend = state.pick_backend()
+        http = await _client(request.app)
+        async with http.post(
+            f"{backend}/rl/start_session",
+            json=body,
+            headers={"Authorization": f"Bearer {state.admin_api_key}"},
+        ) as r:
+            payload = await r.json(content_type=None)
+            if r.status != 200:
+                return web.json_response(payload, status=r.status)
+        api_key = payload["api_key"]
+        state.routes[api_key] = SessionRoute(
+            backend=backend, session_id=payload["session_id"]
+        )
+        state.load[backend] = state.load.get(backend, 0) + 1
+        payload["base_url"] = backend
+        return web.json_response(payload)
+
+    async def forward(request: web.Request):
+        key = _bearer(request)
+        route = state.routes.get(key)
+        if route is None:
+            raise web.HTTPGone(text="unknown session key")
+        http = await _client(request.app)
+        body = await request.read()
+        async with http.post(
+            f"{route.backend}{request.path}",
+            data=body,
+            headers={
+                "Authorization": f"Bearer {key}",
+                "Content-Type": request.headers.get(
+                    "Content-Type", "application/json"
+                ),
+            },
+        ) as r:
+            text = await r.text()
+            # route + load bookkeeping: release on end_session, and also
+            # when the proxy reports the session gone (agent crashed and the
+            # proxy expired it) — otherwise routes grow without bound and
+            # phantom load skews pick_backend
+            if (request.path == "/rl/end_session" and r.status == 200) or (
+                r.status == 410
+            ):
+                state.routes.pop(key, None)
+                state.load[route.backend] = max(
+                    0, state.load.get(route.backend, 1) - 1
+                )
+            return web.Response(
+                text=text, status=r.status, content_type="application/json"
+            )
+
+    app.router.add_get("/health", health)
+    app.router.add_post("/rl/start_session", start_session)
+    for path in FORWARDED_PATHS:
+        app.router.add_post(path, forward)
+    return app

@@ -1,0 +1,307 @@
+"""Per-worker OpenAI-compatible proxy rollout server.
+
+The reference runs one FastAPI proxy per rollout worker
+(experimental/openai/proxy/proxy_rollout_server.py): an external agent —
+any OpenAI-SDK program — points its base_url here with a session API key,
+every `/v1/chat/completions` call is served by the RL inference engine and
+recorded, rewards are posted back, and the trainer pulls the recorded
+token/logprob/version trajectories. This build speaks the same protocol on
+aiohttp (fastapi/uvicorn are not in the TPU image) over the ArealOpenAI
+client.
+
+Session lifecycle (admin key = the RL system, session key = one episode):
+    POST /rl/start_session   (admin)   {task_id, api_key?} -> {session_id, api_key}
+    POST /v1/chat/completions (session) OpenAI request body -> completion JSON
+    POST /rl/set_reward      (session) {interaction_id?, reward}
+    POST /rl/end_session     (session) -> {interaction_count}
+    POST /export_trajectories (admin)  {session_id, style, discount?} -> tensors
+    POST /grant_capacity     (admin)   frees one capacity unit
+    GET  /health
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import secrets
+import time
+from typing import Any
+
+import numpy as np
+from aiohttp import web
+
+from areal_tpu.openai.client import ArealOpenAI
+from areal_tpu.openai.types import Interaction
+from areal_tpu.utils import logging as alog, name_resolve
+from areal_tpu.utils.network import find_free_port
+
+logger = alog.getLogger("proxy_rollout_server")
+
+SESSION_TIMEOUT_S = 3600.0
+
+
+@dataclasses.dataclass
+class ProxySession:
+    session_id: str
+    client: ArealOpenAI
+    created: float = dataclasses.field(default_factory=time.time)
+    last_access: float = dataclasses.field(default_factory=time.time)
+    finished: asyncio.Event = dataclasses.field(default_factory=asyncio.Event)
+
+    def touch(self) -> None:
+        self.last_access = time.time()
+
+    @property
+    def is_stale(self) -> bool:
+        # finished-but-never-exported sessions also expire — they hold a
+        # capacity unit, and only export or staleness releases it
+        return time.time() - self.last_access > SESSION_TIMEOUT_S
+
+
+def serialize_interactions(interactions: dict[str, Interaction]) -> dict:
+    """JSON-transportable form of exported interactions: tensor dict rows as
+    lists plus the message record (reference rpc-side serialization role)."""
+    out = {}
+    for id_, inter in interactions.items():
+        t = inter.to_tensor_dict()
+        out[id_] = {
+            "tensors": {k: np.asarray(v).tolist() for k, v in t.items()},
+            "messages": inter.messages,
+            "output_messages": inter.output_messages,
+            "reward": inter.reward,
+        }
+    return out
+
+
+class ProxyState:
+    def __init__(
+        self,
+        engine,
+        tokenizer,
+        admin_api_key: str,
+        capacity: int = 128,
+        chat_template_type: str = "hf",
+        engine_max_tokens: int | None = None,
+        tool_call_parser: str = "qwen",
+    ):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.admin_api_key = admin_api_key
+        self.capacity = capacity
+        self.chat_template_type = chat_template_type
+        self.engine_max_tokens = engine_max_tokens
+        self.tool_call_parser = tool_call_parser
+        self.sessions: dict[str, ProxySession] = {}
+        self.key_to_session: dict[str, str] = {}
+        self.session_to_key: dict[str, str] = {}
+        self._last_cleanup = 0.0
+
+    def new_client(self) -> ArealOpenAI:
+        return ArealOpenAI(
+            self.engine,
+            self.tokenizer,
+            chat_template_type=self.chat_template_type,
+            engine_max_tokens=self.engine_max_tokens,
+            tool_call_parser=self.tool_call_parser,
+        )
+
+    def drop_session(self, session_id: str) -> None:
+        """The ONE place a session (and its capacity unit) is released."""
+        sess = self.sessions.pop(session_id, None)
+        if sess is not None:
+            self.capacity += 1
+            # unblock any export waiting on a session that will never finish
+            sess.finished.set()
+        key = self.session_to_key.pop(session_id, None)
+        if key is not None:
+            self.key_to_session.pop(key, None)
+
+    def cleanup_stale(self) -> None:
+        now = time.time()
+        if now - self._last_cleanup < 60:
+            return
+        self._last_cleanup = now
+        for sid in [s.session_id for s in self.sessions.values() if s.is_stale]:
+            logger.warning(f"removing stale session {sid}")
+            self.drop_session(sid)
+
+
+def _bearer(request: web.Request) -> str:
+    auth = request.headers.get("Authorization", "")
+    if auth.startswith("Bearer "):
+        return auth[len("Bearer ") :]
+    return request.headers.get("X-API-Key", "")
+
+
+def create_proxy_app(state: ProxyState) -> web.Application:
+    app = web.Application(client_max_size=512 * 1024 * 1024)
+    app["state"] = state
+
+    def require_admin(request: web.Request) -> None:
+        if _bearer(request) != state.admin_api_key:
+            raise web.HTTPForbidden(text="admin API key required")
+
+    def require_session(request: web.Request) -> ProxySession:
+        key = _bearer(request)
+        sid = state.key_to_session.get(key)
+        if sid is None or sid not in state.sessions:
+            raise web.HTTPGone(text="unknown or expired session key")
+        sess = state.sessions[sid]
+        sess.touch()
+        return sess
+
+    async def health(_):
+        return web.json_response(
+            {
+                "status": "ok",
+                "sessions": len(state.sessions),
+                "capacity": state.capacity,
+            }
+        )
+
+    async def start_session(request: web.Request):
+        require_admin(request)
+        body = await request.json()
+        state.cleanup_stale()
+        if state.capacity <= 0:
+            raise web.HTTPTooManyRequests(text="no session capacity available")
+        task_id = body.get("task_id", "task")
+        idx = 0
+        while (session_id := f"{task_id}-{idx}") in state.sessions:
+            idx += 1
+        api_key = body.get("api_key")
+        if api_key:
+            if api_key == state.admin_api_key:
+                raise web.HTTPBadRequest(text="cannot reuse the admin key")
+            prev_sid = state.key_to_session.get(api_key)
+            if prev_sid is not None:
+                prev = state.sessions.get(prev_sid)
+                if prev is not None and not prev.finished.is_set():
+                    raise web.HTTPConflict(
+                        text=f"key already bound to active session {prev_sid}"
+                    )
+                state.drop_session(prev_sid)
+        else:
+            api_key = secrets.token_urlsafe(32)
+            while api_key in state.key_to_session or api_key == state.admin_api_key:
+                api_key = secrets.token_urlsafe(32)
+        state.capacity -= 1
+        state.sessions[session_id] = ProxySession(
+            session_id=session_id, client=state.new_client()
+        )
+        state.key_to_session[api_key] = session_id
+        state.session_to_key[session_id] = api_key
+        return web.json_response({"session_id": session_id, "api_key": api_key})
+
+    async def chat_completions(request: web.Request):
+        sess = require_session(request)
+        body = await request.json()
+        body.pop("model", None)
+        try:
+            completion = await sess.client.chat.completions.create(**body)
+        except (ValueError, NotImplementedError) as e:
+            raise web.HTTPBadRequest(text=str(e))
+        return web.json_response(completion.to_dict())
+
+    async def set_reward(request: web.Request):
+        sess = require_session(request)
+        body = await request.json()
+        interaction_id = body.get("interaction_id")
+        reward = float(body["reward"])
+        try:
+            if interaction_id is None:
+                sess.client.set_last_reward(reward)
+            else:
+                sess.client.set_reward(interaction_id, reward)
+        except (KeyError, RuntimeError) as e:
+            raise web.HTTPBadRequest(text=str(e))
+        return web.json_response({"message": "success"})
+
+    async def end_session(request: web.Request):
+        sess = require_session(request)
+        n = len(sess.client._cache)
+        sess.finished.set()
+        return web.json_response({"message": "success", "interaction_count": n})
+
+    async def export_trajectories(request: web.Request):
+        require_admin(request)
+        body = await request.json()
+        session_id = body["session_id"]
+        sess = state.sessions.get(session_id)
+        if sess is None:
+            raise web.HTTPNotFound(text=f"session {session_id} not found")
+        # bounded wait: a crashed agent never calls end_session; drop_session
+        # also sets the event so stale cleanup can't strand this coroutine
+        timeout = float(body.get("timeout", SESSION_TIMEOUT_S))
+        try:
+            await asyncio.wait_for(sess.finished.wait(), timeout=timeout)
+        except asyncio.TimeoutError:
+            raise web.HTTPRequestTimeout(
+                text=f"session {session_id} did not finish within {timeout}s"
+            )
+        if session_id not in state.sessions:
+            raise web.HTTPGone(text=f"session {session_id} expired before export")
+        discount = body.get("discount")
+        style = body.get("style", "individual")
+        interactions = sess.client._cache.export_interactions(
+            style=style, turn_discount=discount
+        )
+        state.drop_session(session_id)
+        return web.json_response(
+            {"interactions": serialize_interactions(interactions)}
+        )
+
+    async def grant_capacity(request: web.Request):
+        require_admin(request)
+        state.capacity += 1
+        return web.json_response({"capacity": state.capacity})
+
+    app.router.add_get("/health", health)
+    app.router.add_post("/rl/start_session", start_session)
+    app.router.add_post("/rl/end_session", end_session)
+    app.router.add_post("/rl/set_reward", set_reward)
+    app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/export_trajectories", export_trajectories)
+    app.router.add_post("/grant_capacity", grant_capacity)
+    return app
+
+
+def main(argv: list[str] | None = None) -> None:
+    """Standalone proxy worker (reference proxy_rollout_server.py main):
+    builds the remote inference client from server addresses published in
+    name_resolve / env and serves the proxy, registering its own address."""
+    from transformers import AutoTokenizer
+
+    from areal_tpu.api.config import InferenceEngineConfig
+    from areal_tpu.inference.client import RemoteJaxEngine
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--tokenizer", required=True)
+    p.add_argument("--admin-key", required=True)
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--capacity", type=int, default=128)
+    p.add_argument("--name", default="", help="name_resolve registration key")
+    p.add_argument("--chat-template-type", default="hf")
+    args = p.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.tokenizer)
+    engine = RemoteJaxEngine(InferenceEngineConfig())
+    engine.initialize()
+    state = ProxyState(
+        engine,
+        tokenizer,
+        admin_api_key=args.admin_key,
+        capacity=args.capacity,
+    )
+    app = create_proxy_app(state)
+    port = args.port or find_free_port()
+    if args.name:
+        from areal_tpu.utils.network import gethostip
+
+        name_resolve.add(args.name, f"http://{gethostip()}:{port}")
+    web.run_app(app, port=port)
+
+
+if __name__ == "__main__":
+    main()

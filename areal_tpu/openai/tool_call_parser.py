@@ -1,0 +1,91 @@
+"""Tool-call extraction from generated text.
+
+The reference delegates to sglang's FunctionCallParser/ReasoningParser
+(experimental/openai/tool_call_parser.py) — external GPU-serving machinery.
+This build implements the two formats the supported model families emit,
+dependency-free:
+
+- ``qwen`` (hermes-style): ``<tool_call>\\n{"name": ..., "arguments": {...}}
+  \\n</tool_call>`` blocks after the content.
+- reasoning: a leading ``<think>...</think>`` block is split off and
+  re-attached to the content untouched (``qwen3`` semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import uuid
+
+from areal_tpu.openai.types import FunctionCall, ToolCall
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("tool_call_parser")
+
+_TOOL_CALL_RE = re.compile(r"<tool_call>\s*(.*?)\s*</tool_call>", re.DOTALL)
+
+
+def split_reasoning(
+    text: str, start: str = "<think>", end: str = "</think>"
+) -> tuple[str, str]:
+    """-> (reasoning_with_tags, normal_text). Truncated reasoning (no end
+    tag) consumes the whole remainder, matching sglang's parser."""
+    if start not in text:
+        return "", text
+    body = text.replace(start, "", 1)
+    if end not in body:
+        return start + body, ""
+    reasoning, normal = body.split(end, 1)
+    return start + reasoning + end, normal
+
+
+def process_tool_calls(
+    text: str,
+    tools: list[dict] | None,
+    tool_call_parser: str,
+    reasoning_parser: str,
+    finish_reason: str,
+) -> tuple[list[ToolCall] | None, str, str]:
+    """-> (tool_calls | None, output_text, finish_reason). When calls are
+    found and generation stopped normally, finish_reason becomes
+    'tool_calls' (reference tool_call_parser.py process_tool_calls)."""
+    if tool_call_parser not in ("qwen", "hermes"):
+        raise ValueError(f"unsupported tool_call_parser {tool_call_parser!r}")
+    reasoning, content = split_reasoning(text)
+    known = {
+        t["function"]["name"] for t in (tools or []) if t.get("type") == "function"
+    }
+    calls: list[ToolCall] = []
+    kept = content
+    if "<tool_call>" in content:
+        parsed_spans = []
+        for m in _TOOL_CALL_RE.finditer(content):
+            try:
+                obj = json.loads(m.group(1))
+                name = obj["name"]
+                if known and name not in known:
+                    logger.warning(f"tool call to unknown tool {name!r} ignored")
+                    continue
+                args = obj.get("arguments", {})
+                calls.append(
+                    ToolCall(
+                        id=f"call_{uuid.uuid4().hex[:24]}",
+                        function=FunctionCall(
+                            name=name,
+                            arguments=args
+                            if isinstance(args, str)
+                            else json.dumps(args),
+                        ),
+                    )
+                )
+                parsed_spans.append(m.span())
+            except (json.JSONDecodeError, KeyError, TypeError) as e:
+                logger.warning(f"unparseable tool call ignored: {e}")
+        for s, e in reversed(parsed_spans):
+            kept = kept[:s] + kept[e:]
+        kept = kept.rstrip()
+    if calls:
+        if finish_reason == "stop":
+            finish_reason = "tool_calls"
+        return calls, reasoning + kept, finish_reason
+    return None, text, finish_reason

@@ -1,0 +1,172 @@
+"""OpenAI-compatible wire types + the trainable Interaction record.
+
+The reference layers its agentic RL on the `openai` SDK's pydantic models
+(areal/experimental/openai/types.py). That SDK is a GPU-stack convenience,
+not a capability: this build defines the same wire shapes as plain
+dataclasses (serializable to the exact JSON an OpenAI-SDK agent expects from
+`/v1/chat/completions`) and keeps the trainable record — token ids, logprobs,
+per-token policy versions, reward, parent link — in numpy, the input format
+of the GSPMD trainer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import Any
+
+import numpy as np
+
+from areal_tpu.api.io_struct import ModelResponse
+
+
+def _new_id(prefix: str) -> str:
+    return f"{prefix}-{uuid.uuid4().hex[:29]}"
+
+
+@dataclasses.dataclass
+class FunctionCall:
+    name: str
+    arguments: str  # JSON string, matching the OpenAI schema
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "arguments": self.arguments}
+
+
+@dataclasses.dataclass
+class ToolCall:
+    id: str
+    function: FunctionCall
+    type: str = "function"
+
+    def to_dict(self) -> dict:
+        return {"id": self.id, "type": self.type, "function": self.function.to_dict()}
+
+
+@dataclasses.dataclass
+class ChatMessage:
+    role: str = "assistant"
+    content: str | None = None
+    tool_calls: list[ToolCall] | None = None
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {"role": self.role, "content": self.content}
+        if self.tool_calls:
+            d["tool_calls"] = [t.to_dict() for t in self.tool_calls]
+        return d
+
+
+@dataclasses.dataclass
+class ChatCompletionChoice:
+    index: int
+    message: ChatMessage
+    finish_reason: str = "stop"
+
+    def to_dict(self) -> dict:
+        return {
+            "index": self.index,
+            "message": self.message.to_dict(),
+            "finish_reason": self.finish_reason,
+            "logprobs": None,
+        }
+
+
+@dataclasses.dataclass
+class Usage:
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "prompt_tokens": self.prompt_tokens,
+            "completion_tokens": self.completion_tokens,
+            "total_tokens": self.prompt_tokens + self.completion_tokens,
+        }
+
+
+@dataclasses.dataclass
+class ChatCompletion:
+    """The `/v1/chat/completions` response object (non-streaming)."""
+
+    id: str = dataclasses.field(default_factory=lambda: _new_id("chatcmpl"))
+    created: int = dataclasses.field(default_factory=lambda: int(time.time()))
+    model: str = "areal-tpu"
+    choices: list[ChatCompletionChoice] = dataclasses.field(default_factory=list)
+    usage: Usage = dataclasses.field(default_factory=Usage)
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "object": "chat.completion",
+            "created": self.created,
+            "model": self.model,
+            "choices": [c.to_dict() for c in self.choices],
+            "usage": self.usage.to_dict(),
+        }
+
+
+@dataclasses.dataclass
+class Interaction:
+    """One completion with its trainable record (reference
+    types.py InteractionWithTokenLogpReward).
+
+    ``messages`` is the request's input message list; ``output_messages`` the
+    assistant turn(s) produced. Parent links form the conversation tree when
+    message lists are strict prefixes of one another (multi-turn agents that
+    append to the same history)."""
+
+    completion: ChatCompletion | None = None
+    model_response: ModelResponse | None = None
+    reward: float | None = None
+    parent: "Interaction | None" = None
+    messages: list[dict] = dataclasses.field(default_factory=list)
+    output_messages: list[dict] | None = None
+    chat_template_type: str = "hf"
+    _tensors: dict[str, np.ndarray] | None = None
+
+    @property
+    def interaction_id(self) -> str | None:
+        return self.completion.id if self.completion is not None else None
+
+    def to_tensor_dict(self) -> dict[str, np.ndarray]:
+        """Flatten to the trainer's padded-dict row: input_ids, loss_mask
+        (1 on generated tokens), logprobs, versions (-1 on prompt),
+        attention_mask, rewards. In concat mode a child prepends its parent's
+        record so the shared prefix keeps the parent's logprobs/versions and
+        only the new prompt suffix is masked (reference types.py
+        to_tensor_dict)."""
+        if self._tensors is not None:
+            return self._tensors
+        resp = self.model_response
+        assert resp is not None, "interaction has no model response"
+        seq = list(resp.input_tokens) + list(resp.output_tokens)
+        if self.chat_template_type == "concat" and self.parent is not None:
+            p = self.parent.to_tensor_dict()
+            p_logp = p["logprobs"][0].tolist()
+            p_mask = p["loss_mask"][0].tolist()
+            p_vers = p["versions"][0].tolist()
+            p_len = len(p_logp)
+            if resp.input_len >= p_len:
+                gap = resp.input_len - p_len
+                logprobs = p_logp + [0.0] * gap + list(resp.output_logprobs)
+                loss_mask = p_mask + [0] * gap + [1] * resp.output_len
+                versions = p_vers + [-1] * gap + list(resp.output_versions)
+            else:  # malformed tree: mask the whole prompt
+                logprobs = [0.0] * resp.input_len + list(resp.output_logprobs)
+                loss_mask = [0] * resp.input_len + [1] * resp.output_len
+                versions = [-1] * resp.input_len + list(resp.output_versions)
+        else:
+            logprobs = [0.0] * resp.input_len + list(resp.output_logprobs)
+            loss_mask = [0] * resp.input_len + [1] * resp.output_len
+            versions = [-1] * resp.input_len + list(resp.output_versions)
+        reward = self.reward if self.reward is not None else 0.0
+        self._tensors = {
+            "input_ids": np.asarray([seq], np.int64),
+            "loss_mask": np.asarray([loss_mask], np.int64),
+            "logprobs": np.asarray([logprobs], np.float32),
+            "versions": np.asarray([versions], np.int64),
+            "attention_mask": np.ones((1, len(seq)), np.int64),
+            "rewards": np.asarray([float(reward)], np.float32),
+        }
+        return self._tensors

@@ -1,0 +1,73 @@
+"""Bring-your-own-agent workflow: run any async agent against an
+OpenAI-compatible client and train on its recorded interactions.
+
+Reference shape: experimental/openai/proxy/workflow.py + the SDK example
+agents under workflow/openai*/ — the user supplies ``agent_fn(client, data)``
+that drives ``client.chat.completions.create`` (tools, multi-turn, anything)
+and optionally returns a final reward; every completion is recorded with
+token ids/logprobs/versions, rewards are discounted across turns, and the
+exported interactions become per-sequence training rows.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.openai.client import ArealOpenAI
+from areal_tpu.utils import stats_tracker
+
+
+class OpenAIAgentWorkflow(RolloutWorkflow):
+    """arun_episode: fresh client -> agent_fn -> reward -> tensor rows."""
+
+    def __init__(
+        self,
+        agent_fn: Callable,  # async (client, data) -> float | None
+        tokenizer: Any,
+        export_style: str = "individual",
+        turn_discount: float = 1.0,
+        chat_template_type: str = "hf",
+        engine_max_tokens: int | None = None,
+    ):
+        self.agent_fn = agent_fn
+        self.tokenizer = tokenizer
+        self.export_style = export_style
+        self.turn_discount = turn_discount
+        self.chat_template_type = chat_template_type
+        self.engine_max_tokens = engine_max_tokens
+
+    async def arun_episode(self, engine, data: dict):
+        client = ArealOpenAI(
+            engine,
+            self.tokenizer,
+            chat_template_type=self.chat_template_type,
+            engine_max_tokens=self.engine_max_tokens,
+        )
+        reward = await self.agent_fn(client, data)
+        if reward is not None:
+            client.set_last_reward(float(reward))
+        interactions = client._cache.export_interactions(
+            style=self.export_style, turn_discount=self.turn_discount
+        )
+        if not interactions:
+            return None
+        rows = []
+        for inter in interactions.values():
+            t = inter.to_tensor_dict()
+            rows.append(
+                {
+                    "input_ids": t["input_ids"][0].astype(np.int32),
+                    "loss_mask": t["loss_mask"][0].astype(np.float32),
+                    "logprobs": t["logprobs"][0].astype(np.float32),
+                    "versions": t["versions"][0].astype(np.int32),
+                    "rewards": np.float32(t["rewards"][0]),
+                }
+            )
+            stats_tracker.get().scalar(
+                reward=float(t["rewards"][0]),
+                gen_tokens=float(t["loss_mask"][0].sum()),
+            )
+        return rows

@@ -132,9 +132,39 @@ def test_apply_allocation_mode_critic_role():
 
 
 def test_apply_allocation_mode_moe_hybrid():
-    from areal_tpu.api.alloc_mode import apply_allocation_mode
+    from areal_tpu.api.alloc_mode import AllocationMode, apply_allocation_mode
     from areal_tpu.api.config import MeshConfig, PPOConfig
 
     cfg = PPOConfig(allocation_mode="gspmd:(attn:d4t2|ffn:d2e4)")
     apply_allocation_mode(cfg)
-    assert cfg.actor.mesh == MeshConfig(data=1, fsdp=4, model=2, seq=1, expert=4)
+    # ep borrows dp degrees: mesh axis product stays == world size (8)
+    assert cfg.actor.mesh == MeshConfig(data=1, fsdp=1, model=2, seq=1, expert=4)
+    world = AllocationMode.from_str("gspmd:(attn:d4t2|ffn:d2e4)").world_size
+    m = cfg.actor.mesh
+    assert m.data * m.fsdp * m.seq * m.model * m.expert == world == 8
+
+
+def test_apply_allocation_mode_moe_hybrid_gen():
+    from areal_tpu.api.alloc_mode import apply_allocation_mode
+    from areal_tpu.api.config import MeshConfig, PPOConfig
+
+    cfg = PPOConfig(allocation_mode="jax:(attn:d4t2|ffn:d2e4)+gspmd:d8")
+    apply_allocation_mode(cfg)
+    # server keeps the ffn spec's expert sharding; one server per dp/ep slice
+    assert cfg.server.mesh == MeshConfig(data=1, fsdp=1, seq=1, model=2, expert=4)
+    assert cfg.launcher.n_servers == 1
+
+
+def test_apply_allocation_mode_plain_ep_borrows_dp():
+    from areal_tpu.api.alloc_mode import apply_allocation_mode
+    from areal_tpu.api.config import MeshConfig, PPOConfig
+
+    cfg = PPOConfig(allocation_mode="jax:d4e2+gspmd:d4e2")
+    apply_allocation_mode(cfg)
+    # world is 4 (ep borrows dp): axis product must stay 4
+    assert cfg.actor.mesh == MeshConfig(data=1, fsdp=2, seq=1, model=1, expert=2)
+    assert cfg.launcher.n_servers == 2
+    assert cfg.server.mesh == MeshConfig(data=1, fsdp=1, seq=1, model=1, expert=2)
+
+    with __import__("pytest").raises(ValueError):
+        apply_allocation_mode(PPOConfig(allocation_mode="gspmd:d3e2"))

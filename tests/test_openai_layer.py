@@ -1,0 +1,291 @@
+"""Agentic/OpenAI-compatible layer tests: client capture, conversation tree,
+tool parsing, reward discounting, tensor export, proxy server + gateway over
+real HTTP (reference tests/experimental/openai/ behavioral coverage)."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.io_struct import ModelRequest, ModelResponse
+from areal_tpu.openai.client import ArealOpenAI
+from areal_tpu.openai.tool_call_parser import process_tool_calls, split_reasoning
+from areal_tpu.workflow.openai_agent import OpenAIAgentWorkflow
+
+
+class FakeTokenizer:
+    """Deterministic toy tokenizer: one token per character code."""
+
+    eos_token_id = 0
+    pad_token_id = 0
+
+    def apply_chat_template(
+        self, messages, tools=None, add_generation_prompt=True, tokenize=True, **kw
+    ):
+        text = "".join(f"<{m['role']}>{m.get('content') or ''}" for m in messages)
+        if tools:
+            text = f"[tools:{len(tools)}]" + text
+        if add_generation_prompt:
+            text += "<assistant>"
+        return [ord(c) % 250 + 1 for c in text]
+
+    def encode(self, text):
+        return [ord(c) % 250 + 1 for c in text]
+
+    def decode(self, ids):
+        return "".join(chr(96 + (i % 26)) for i in ids)
+
+
+class EchoEngine:
+    """agenerate returns a fixed number of tokens with logprobs/versions."""
+
+    def __init__(self, n_out=5, version=3, text_tokens=None):
+        self.n_out = n_out
+        self.version = version
+        self.requests: list[ModelRequest] = []
+        self.text_tokens = text_tokens
+
+    async def agenerate(self, req: ModelRequest) -> ModelResponse:
+        self.requests.append(req)
+        out = self.text_tokens or list(range(1, self.n_out + 1))
+        return ModelResponse(
+            input_tokens=list(req.input_ids),
+            output_tokens=list(out),
+            output_logprobs=[-0.5] * len(out),
+            output_versions=[self.version] * len(out),
+            stop_reason="stop",
+            rid=req.rid,
+        )
+
+
+def _run(coro):
+    return asyncio.get_event_loop().run_until_complete(coro)
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def test_client_capture_and_export(loop):
+    eng = EchoEngine()
+    client = ArealOpenAI(eng, FakeTokenizer())
+    comp = loop.run_until_complete(
+        client.chat.completions.create(
+            messages=[{"role": "user", "content": "hi"}], max_completion_tokens=16
+        )
+    )
+    assert comp.choices[0].message.role == "assistant"
+    assert comp.usage.completion_tokens == 5
+    client.set_reward(comp.id, 0.75)
+    exported = client.export_interactions("individual")
+    assert comp.id in exported
+    t = exported[comp.id].to_tensor_dict()
+    prompt_len = len(eng.requests[0].input_ids)
+    assert t["input_ids"].shape == (1, prompt_len + 5)
+    assert t["loss_mask"][0, :prompt_len].sum() == 0
+    assert t["loss_mask"][0, prompt_len:].sum() == 5
+    assert (t["versions"][0, prompt_len:] == 3).all()
+    assert t["rewards"][0] == pytest.approx(0.75)
+
+
+def test_client_token_budget(loop):
+    eng = EchoEngine()
+    client = ArealOpenAI(eng, FakeTokenizer(), engine_max_tokens=32)
+    loop.run_until_complete(
+        client.chat.completions.create(
+            messages=[{"role": "user", "content": "hi"}],
+            max_completion_tokens=1000,
+        )
+    )
+    g = eng.requests[-1].gconfig
+    assert g.max_new_tokens == 32 - len(eng.requests[-1].input_ids)
+    with pytest.raises(ValueError):
+        loop.run_until_complete(
+            client.chat.completions.create(
+                messages=[{"role": "user", "content": "x" * 100}],
+                max_total_tokens=10,
+            )
+        )
+
+
+def test_conversation_tree_and_discount(loop):
+    eng = EchoEngine()
+    client = ArealOpenAI(eng, FakeTokenizer(), chat_template_type="concat")
+    msgs = [{"role": "user", "content": "q1"}]
+    c1 = loop.run_until_complete(
+        client.chat.completions.create(messages=msgs, max_completion_tokens=8)
+    )
+    msgs2 = (
+        msgs
+        + [c1.choices[0].message.to_dict()]
+        + [{"role": "user", "content": "q2"}]
+    )
+    c2 = loop.run_until_complete(
+        client.chat.completions.create(messages=msgs2, max_completion_tokens=8)
+    )
+    i2 = client.get_interaction(c2.id)
+    assert i2.parent is client.get_interaction(c1.id)
+    # concat mode: the child's prompt embeds the parent's exact token record
+    parent_resp = client.get_interaction(c1.id).model_response
+    child_prompt = eng.requests[-1].input_ids
+    assert (
+        child_prompt[: parent_resp.input_len + parent_resp.output_len]
+        == parent_resp.input_tokens + parent_resp.output_tokens
+    )
+    client.set_last_reward(1.0)
+    client.apply_reward_discount(0.5)
+    assert client.get_interaction(c2.id).reward == pytest.approx(1.0)
+    assert client.get_interaction(c1.id).reward == pytest.approx(0.5)
+    # concat export returns only leaves; leaf tensors cover the whole chain
+    leaves = client.export_interactions("concat")
+    assert list(leaves) == [c2.id]
+    t = leaves[c2.id].to_tensor_dict()
+    assert t["input_ids"].shape[1] == len(child_prompt) + 5
+    # parent's generated tokens keep loss_mask=1 inside the concat row
+    p0 = parent_resp.input_len
+    assert t["loss_mask"][0, p0 : p0 + parent_resp.output_len].sum() == 5
+
+
+def test_tool_call_parsing():
+    text = 'hello<tool_call>\n{"name": "search", "arguments": {"q": "tpu"}}\n</tool_call>'
+    tools = [{"type": "function", "function": {"name": "search"}}]
+    calls, out, reason = process_tool_calls(text, tools, "qwen", "qwen3", "stop")
+    assert len(calls) == 1
+    assert calls[0].function.name == "search"
+    assert json.loads(calls[0].function.arguments) == {"q": "tpu"}
+    assert reason == "tool_calls"
+    assert "<tool_call>" not in out
+    # unknown tool / malformed JSON -> ignored, no crash
+    calls2, _, r2 = process_tool_calls(
+        '<tool_call>{"name": "nope"}</tool_call>', tools, "qwen", "qwen3", "stop"
+    )
+    assert calls2 is None and r2 == "stop"
+    think = "<think>reasoning</think>answer"
+    r, n = split_reasoning(think)
+    assert r == "<think>reasoning</think>" and n == "answer"
+
+
+def test_stop_string_truncation(loop):
+    tok = FakeTokenizer()
+    # output tokens decode to "abcde"; stop at "cd" -> keep "ab"
+    eng = EchoEngine(text_tokens=[1 + 96 - 96 + 0] * 0 or [97 - 96, 98 - 96, 99 - 96, 100 - 96, 101 - 96])
+    client = ArealOpenAI(eng, tok)
+    comp = loop.run_until_complete(
+        client.chat.completions.create(
+            messages=[{"role": "user", "content": "hi"}],
+            max_completion_tokens=16,
+            stop="cd",
+        )
+    )
+    assert comp.choices[0].message.content == "ab"
+    inter = client.get_interaction(comp.id)
+    # tokens/logprobs stay aligned after truncation
+    n = len(inter.model_response.output_tokens)
+    # "cd" completes at the 4th token ("abcd"); tokens/logprobs stay aligned
+    assert n == len(inter.model_response.output_logprobs) == 4
+    assert comp.choices[0].finish_reason == "stop"
+
+
+def test_agent_workflow(loop):
+    async def agent(client, data):
+        c1 = await client.chat.completions.create(
+            messages=[{"role": "user", "content": data["q"]}],
+            max_completion_tokens=8,
+        )
+        assert c1.choices[0].message.content
+        return 0.9
+
+    wf = OpenAIAgentWorkflow(agent, FakeTokenizer())
+    rows = loop.run_until_complete(wf.arun_episode(EchoEngine(), {"q": "2+2?"}))
+    assert len(rows) == 1
+    assert rows[0]["rewards"] == pytest.approx(0.9)
+    assert rows[0]["loss_mask"].sum() == 5
+
+
+# -- proxy + gateway over real HTTP ----------------------------------------
+
+
+async def _proxy_gateway_flow():
+    from aiohttp import ClientSession
+    from aiohttp.test_utils import TestServer
+
+    from areal_tpu.openai.proxy.gateway import GatewayState, create_gateway_app
+    from areal_tpu.openai.proxy.rollout_server import ProxyState, create_proxy_app
+
+    eng = EchoEngine()
+    state = ProxyState(eng, FakeTokenizer(), admin_api_key="admin-key", capacity=2)
+    proxy = TestServer(create_proxy_app(state))
+    await proxy.start_server()
+    proxy_url = f"http://127.0.0.1:{proxy.port}"
+
+    gw_state = GatewayState([proxy_url], admin_api_key="admin-key")
+    gateway = TestServer(create_gateway_app(gw_state))
+    await gateway.start_server()
+    gw_url = f"http://127.0.0.1:{gateway.port}"
+
+    admin = {"Authorization": "Bearer admin-key"}
+    async with ClientSession() as http:
+        # session via the gateway (what the RL system does)
+        async with http.post(
+            f"{gw_url}/rl/start_session", json={"task_id": "t1"}, headers=admin
+        ) as r:
+            assert r.status == 200
+            sess = await r.json()
+        key = sess["api_key"]
+        user = {"Authorization": f"Bearer {key}"}
+
+        # the agent speaks plain OpenAI protocol through the gateway
+        async with http.post(
+            f"{gw_url}/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_completion_tokens": 8,
+                "model": "whatever",
+            },
+            headers=user,
+        ) as r:
+            assert r.status == 200
+            comp = await r.json()
+        assert comp["object"] == "chat.completion"
+        assert comp["choices"][0]["message"]["role"] == "assistant"
+
+        async with http.post(
+            f"{gw_url}/rl/set_reward", json={"reward": 0.5}, headers=user
+        ) as r:
+            assert r.status == 200
+        async with http.post(f"{gw_url}/rl/end_session", json={}, headers=user) as r:
+            assert r.status == 200
+            assert (await r.json())["interaction_count"] == 1
+
+        # trainer pulls trajectories straight from the proxy
+        async with http.post(
+            f"{proxy_url}/export_trajectories",
+            json={"session_id": sess["session_id"], "style": "individual"},
+            headers=admin,
+        ) as r:
+            assert r.status == 200
+            data = await r.json()
+        (inter,) = data["interactions"].values()
+        assert inter["reward"] == pytest.approx(0.5)
+        t = inter["tensors"]
+        assert np.asarray(t["loss_mask"]).sum() == 5
+        assert len(t["input_ids"][0]) == len(t["logprobs"][0])
+
+        # capacity freed after export; bad keys rejected
+        assert state.capacity == 2
+        async with http.post(
+            f"{gw_url}/v1/chat/completions", json={}, headers=user
+        ) as r:
+            assert r.status in (410, 400)  # gateway may still route; proxy 410s
+
+    await gateway.close()
+    await proxy.close()
+
+
+def test_proxy_gateway_http(loop):
+    loop.run_until_complete(_proxy_gateway_flow())
