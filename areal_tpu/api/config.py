@@ -78,8 +78,11 @@ class TrainEngineConfig:
     logprob_chunk_size: int = 1024  # vocab-logit chunking (memory ceiling)
     optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
-    lora_rank: int = 0
+    lora_rank: int = 0  # 0 = full fine-tuning (reference fsdp LoRA/PEFT role)
     lora_alpha: float = 16.0
+    lora_targets: list[str] = field(
+        default_factory=lambda: ["wq", "wk", "wv", "wo"]
+    )
     weight_update_mode: str = "disk"  # disk|mem
 
 

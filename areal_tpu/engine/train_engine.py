@@ -113,6 +113,7 @@ class JaxTrainEngine(TrainEngine):
         self.mesh = None
         self.params = None
         self.opt_state = None
+        self._param_labels = None  # "train"/"freeze" tree when LoRA is on
         self.model_cfg: qwen.ModelConfig | None = None
         self._tx = None
         self._fn_cache: dict[tuple, Callable] = {}
@@ -151,6 +152,9 @@ class JaxTrainEngine(TrainEngine):
                 "dtype": cfg.dtype,
                 "remat": cfg.gradient_checkpointing,
                 "attn_impl": cfg.attn_impl,
+                "lora_rank": cfg.lora_rank,
+                "lora_alpha": cfg.lora_alpha,
+                "lora_targets": tuple(cfg.lora_targets),
             }
         )
         self.model_cfg = mcfg
@@ -184,6 +188,9 @@ class JaxTrainEngine(TrainEngine):
 
             self.params, _ = load_params_from_hf(cfg.path, mcfg, dtype=pdtype, put=put)
             logger.info(f"loaded HF weights from {cfg.path} in {time.monotonic()-t0:.1f}s")
+            # fresh adapters over the loaded base (reference
+            # fsdp_engine.py:833-860 get_peft_model role)
+            self._add_lora_adapters(seed=kwargs.get("seed", 0))
         if self.value_head:
             self.params["value_head"] = jax.device_put(
                 jnp.zeros((mcfg.hidden_size,), pdtype),
@@ -195,7 +202,7 @@ class JaxTrainEngine(TrainEngine):
         total_steps = ft_spec.total_train_steps if ft_spec else 10_000
         ocfg = cfg.optimizer
         self._lr_schedule = make_lr_schedule(ocfg, total_steps)
-        self._tx = optax.chain(
+        inner = optax.chain(
             optax.clip_by_global_norm(ocfg.gradient_clipping),
             optax.adamw(
                 self._lr_schedule,
@@ -205,12 +212,60 @@ class JaxTrainEngine(TrainEngine):
                 weight_decay=ocfg.weight_decay,
             ),
         )
+        if mcfg.lora_rank > 0:
+            # freeze the base: only adapter (+value head) leaves train. The
+            # frozen branch never READS its grads (set_to_zero), and the
+            # grad-norm is masked below, so inside the fused jit XLA's DCE
+            # prunes the base dW matmuls from the backward — the LoRA FLOP
+            # saving falls out of dead-code elimination, no custom VJP.
+            self._param_labels = jax.tree_util.tree_map_with_path(
+                lambda p, _: "train"
+                if "_lora_" in jax.tree_util.keystr(p)
+                or jax.tree_util.keystr(p).endswith("['value_head']")
+                else "freeze",
+                self.params,
+            )
+            self._tx = optax.multi_transform(
+                {"train": inner, "freeze": optax.set_to_zero()},
+                self._param_labels,
+            )
+        else:
+            self._param_labels = None
+            self._tx = inner
         state_shapes = jax.eval_shape(self._tx.init, self.params)
         self.opt_state_shardings = self._opt_state_shardings(state_shapes)
         with jax.set_mesh(self.mesh):
             self.opt_state = jax.jit(
                 self._tx.init, out_shardings=self.opt_state_shardings
             )(self.params)
+
+    def _add_lora_adapters(self, seed: int = 0) -> None:
+        """Insert freshly-initialized adapter leaves into an adapter-less
+        param tree (HF checkpoints never carry them — they are merged away
+        on export)."""
+        mcfg = self.model_cfg
+        if mcfg.lora_rank <= 0:
+            return
+        pdtype = jnp.dtype(self.config.param_dtype)
+        lora_shardings = mesh_lib.param_sharding(
+            self.mesh, qwen.lora_partition_specs(mcfg)
+        )
+        with jax.set_mesh(self.mesh):
+            lora = jax.jit(
+                lambda key: qwen.init_lora_params(key, mcfg, dtype=pdtype),
+                out_shardings=lora_shardings,
+            )(jax.random.PRNGKey(seed))
+        self.params["layers"].update(lora)
+
+    def _grad_norm(self, grads):
+        """Global norm over TRAINABLE grads only — reading frozen grads here
+        would keep their backward computation alive under LoRA."""
+        if self._param_labels is None:
+            return optax.global_norm(grads)
+        labels = jax.tree.leaves(self._param_labels)
+        return optax.global_norm(
+            [g for g, l in zip(jax.tree.leaves(grads), labels) if l == "train"]
+        )
 
     def _opt_state_shardings(self, state_shapes):
         """Match mu/nu subtrees to param shardings by path suffix; scalars and
@@ -440,7 +495,7 @@ class JaxTrainEngine(TrainEngine):
                     return loss * scale, stats
 
                 (loss, stats), grads = jax.value_and_grad(lf, has_aux=True)(params)
-                gnorm = optax.global_norm(grads)
+                gnorm = self._grad_norm(grads)
                 updates, opt_state = self._tx.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
                 return params, opt_state, gnorm, loss, stats
@@ -453,7 +508,7 @@ class JaxTrainEngine(TrainEngine):
         if key not in self._fn_cache:
 
             def apply(params, opt_state, grads):
-                gnorm = optax.global_norm(grads)
+                gnorm = self._grad_norm(grads)
                 updates, opt_state = self._tx.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
                 return params, opt_state, gnorm
@@ -634,12 +689,16 @@ class JaxTrainEngine(TrainEngine):
         inference/client.py)."""
         meta = meta or self._weight_update_meta
         assert meta is not None, "no WeightUpdateMeta configured"
+        # inference serves the merged tree — LoRA deltas fold into the base
+        # (the reference instead ships a PEFT config to SGLang; on TPU the
+        # merged weights ARE the serving format)
+        export = self._export_params()
         if meta.type == "disk":
             path = meta.path
             if meta.with_version:
                 path = os.path.join(path, f"v{self.get_version()}")
             save_params_to_hf(
-                self.params, self.model_cfg, path, base_model_path=self.config.path
+                export, self.model_cfg, path, base_model_path=self.config.path
             )
             if self._inference_engine is not None:
                 import dataclasses as _dc
@@ -647,14 +706,20 @@ class JaxTrainEngine(TrainEngine):
                 self._inference_engine.update_weights(_dc.replace(meta, path=path))
         elif meta.type == "mem":
             assert self._inference_engine is not None
-            self._inference_engine.update_weights(meta, params=self.params)
+            self._inference_engine.update_weights(meta, params=export)
         else:
             raise NotImplementedError(meta.type)
+
+    def _export_params(self) -> dict:
+        if self.model_cfg is not None and self.model_cfg.lora_rank > 0:
+            with jax.set_mesh(self.mesh):
+                return qwen.merge_lora(self.params, self.model_cfg)
+        return self.params
 
     def save(self, meta: SaveLoadMeta) -> None:
         if meta.weight_format == "hf":
             save_params_to_hf(
-                self.params,
+                self._export_params(),
                 self.model_cfg,
                 meta.path,
                 base_model_path=meta.base_model_path or self.config.path,
@@ -707,6 +772,9 @@ class JaxTrainEngine(TrainEngine):
             self.params, _ = load_params_from_hf(
                 meta.path, self.model_cfg, dtype=pdtype, put=put
             )
+            # HF checkpoints are merged trees: restore fresh adapter leaves
+            # so params stay congruent with _param_labels/_tx (LoRA mode)
+            self._add_lora_adapters()
             if vh is not None:
                 self.params["value_head"] = vh
         elif meta.weight_format == "orbax":

@@ -67,6 +67,12 @@ class ModelConfig:
     moe_intermediate_size: int | None = None
     capacity_factor: float = 1.25
     norm_topk_prob: bool = True
+    # LoRA (reference fsdp_engine.py:833-860 PEFT wrapper). rank 0 = off.
+    # Adapters live as extra stacked-layer leaves ("wq_lora_a"/"wq_lora_b");
+    # the base stays frozen and exports merge the deltas back in.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: tuple = ("wq", "wk", "wv", "wo")
     router_aux_coef: float = 0.0  # load-balance aux loss weight
 
     @property
@@ -149,6 +155,81 @@ def _layer_shapes(cfg: ModelConfig) -> dict[str, tuple[int, ...]]:
     return shapes
 
 
+def _lora_shapes(cfg: ModelConfig) -> dict[str, tuple[int, ...]]:
+    """a: [in, r], b: [r, out] per target projection, from the base shapes."""
+    base = _layer_shapes(cfg)
+    r = cfg.lora_rank
+    out = {}
+    for t in cfg.lora_targets:
+        if t not in base or len(base[t]) != 2:
+            raise ValueError(f"LoRA target {t!r} is not a 2-D layer projection")
+        d_in, d_out = base[t]
+        out[f"{t}_lora_a"] = (d_in, r)
+        out[f"{t}_lora_b"] = (r, d_out)
+    return out
+
+
+def init_lora_params(rng: jax.Array, cfg: ModelConfig, dtype=None) -> dict:
+    """Stacked-layer LoRA leaves. Standard init: A ~ N(0, 0.02), B = 0 so the
+    adapted model starts exactly at the base model."""
+    assert cfg.lora_rank > 0
+    dtype = dtype or cfg.jax_dtype
+    n = cfg.num_layers
+    keys = iter(jax.random.split(rng, 2 * len(cfg.lora_targets) + 1))
+    out = {}
+    for name, shape in _lora_shapes(cfg).items():
+        full = (n, *shape)
+        if name.endswith("_a"):
+            out[name] = (
+                0.02 * jax.random.truncated_normal(next(keys), -2, 2, full, jnp.float32)
+            ).astype(dtype)
+        else:
+            out[name] = jnp.zeros(full, dtype)
+    return out
+
+
+def lora_partition_specs(cfg: ModelConfig, fsdp_axis: str | None = "fsdp") -> dict:
+    """a keeps the base weight's input-dim sharding, b its output-dim
+    sharding; the tiny rank dim is replicated."""
+    base = param_partition_specs(
+        ModelConfig(**{**cfg.__dict__, "lora_rank": 0}), fsdp_axis
+    )["layers"]
+    out = {}
+    for t in cfg.lora_targets:
+        spec = base[t]  # P(None, in_shard, out_shard)
+        out[f"{t}_lora_a"] = P(None, spec[1], None)
+        out[f"{t}_lora_b"] = P(None, None, spec[2])
+    return out
+
+
+def merge_lora(params: dict, cfg: ModelConfig) -> dict:
+    """W' = W + (alpha/r)·A@B per target; drops the adapter leaves. Used for
+    HF export and weight updates to inference (the reference ships the PEFT
+    config to SGLang instead; on TPU the merged tree IS the serving format)."""
+    if cfg.lora_rank <= 0:
+        return params
+    scale = cfg.lora_alpha / cfg.lora_rank
+    layers = dict(params["layers"])
+    for t in cfg.lora_targets:
+        a = layers.pop(f"{t}_lora_a")
+        b = layers.pop(f"{t}_lora_b")
+        delta = jnp.einsum("nir,nro->nio", a.astype(jnp.float32), b.astype(jnp.float32))
+        layers[t] = (layers[t].astype(jnp.float32) + scale * delta).astype(
+            layers[t].dtype
+        )
+    return {**params, "layers": layers}
+
+
+def _proj(cfg: ModelConfig, layer: dict, name: str, x: jax.Array) -> jax.Array:
+    """x @ W with the LoRA delta when this layer carries adapters."""
+    out = x @ layer[name]
+    a = layer.get(f"{name}_lora_a")
+    if a is not None:
+        scale = cfg.lora_alpha / cfg.lora_rank
+        out = out + ((x @ a) @ layer[f"{name}_lora_b"]) * scale
+    return out
+
+
 def init_params(rng: jax.Array, cfg: ModelConfig, dtype=None) -> dict:
     """Random init (truncated-normal 0.02), stacked-layer layout."""
     dtype = dtype or cfg.jax_dtype
@@ -167,6 +248,8 @@ def init_params(rng: jax.Array, cfg: ModelConfig, dtype=None) -> dict:
             layers[name] = jnp.zeros(full, dtype)
         else:
             layers[name] = dense(next(keys), full)
+    if cfg.lora_rank > 0:
+        layers.update(init_lora_params(next(keys), cfg, dtype))
     params = {
         "embed": dense(next(keys), (cfg.vocab_size, cfg.hidden_size)),
         "layers": layers,
@@ -213,6 +296,8 @@ def param_partition_specs(cfg: ModelConfig, fsdp_axis: str | None = "fsdp") -> d
         layer_specs.update(bq=P(None, "model"), bk=P(None, "model"), bv=P(None, "model"))
     if cfg.qk_norm:
         layer_specs.update(q_norm=P(None, None), k_norm=P(None, None))
+    if cfg.lora_rank > 0:
+        layer_specs.update(lora_partition_specs(cfg, fsdp_axis))
     specs = {
         "embed": P("model", f),
         "layers": layer_specs,
@@ -291,9 +376,9 @@ def _decoder_layer(cfg: ModelConfig, x, layer, mask, positions, impl=None):
     H, KH, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
 
     h = _rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
-    q = h @ layer["wq"]
-    k = h @ layer["wk"]
-    v = h @ layer["wv"]
+    q = _proj(cfg, layer, "wq", h)
+    k = _proj(cfg, layer, "wk", h)
+    v = _proj(cfg, layer, "wv", h)
     if cfg.attention_bias:
         q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
     q = q.reshape(G, L, H, hd)
@@ -345,7 +430,7 @@ def _decoder_layer(cfg: ModelConfig, x, layer, mask, positions, impl=None):
         else:
             attn = _sdpa(q, k, v, mask, hd)
     attn = attn.reshape(G, L, H * hd)
-    x = x + _shard(attn @ layer["wo"], P(BATCH_AXES, "seq", None))
+    x = x + _shard(_proj(cfg, layer, "wo", attn), P(BATCH_AXES, "seq", None))
 
     h = _rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
     if cfg.num_experts > 0:
@@ -353,8 +438,8 @@ def _decoder_layer(cfg: ModelConfig, x, layer, mask, positions, impl=None):
 
         ff_out, aux = moe_ffn(h, layer, cfg)
         return x + ff_out, aux
-    ff = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
-    x = x + _shard(ff @ layer["w_down"], P(BATCH_AXES, "seq", None))
+    ff = jax.nn.silu(_proj(cfg, layer, "w_gate", h)) * _proj(cfg, layer, "w_up", h)
+    x = x + _shard(_proj(cfg, layer, "w_down", ff), P(BATCH_AXES, "seq", None))
     return x, jnp.float32(0.0)
 
 

@@ -140,18 +140,19 @@ class AsyncChatCompletions:
         if not messages:
             raise ValueError("messages cannot be empty")
 
+        from areal_tpu.openai.types import _new_id
+
         interaction = Interaction(
             messages=[dict(m) for m in messages],
             chat_template_type=o.chat_template_type,
         )
+        completion_id = _new_id("chatcmpl")
+        # parent resolution needs the cache's prefix logic; stage the
+        # interaction first so __setitem__ links it
+        if store:
+            o._cache[completion_id] = interaction
         # prompt tokens
         if o.chat_template_type == "concat":
-            # parent resolution needs the cache's prefix logic; stage the
-            # interaction first so __setitem__ links it, then tokenize only
-            # the remaining messages
-            completion_id = ChatCompletion().id
-            if store:
-                o._cache[completion_id] = interaction
             parent = interaction.parent
             parent_len = (
                 len(parent.messages + (parent.output_messages or []))
@@ -162,9 +163,6 @@ class AsyncChatCompletions:
                 messages[parent_len:], parent, o.tokenizer, tools
             )
         else:
-            completion_id = ChatCompletion().id
-            if store:
-                o._cache[completion_id] = interaction
             prompt_ids = list(
                 o.tokenizer.apply_chat_template(
                     messages,
@@ -232,7 +230,14 @@ class AsyncChatCompletions:
             rid=uuid.uuid4().hex,
             metadata=dict(metadata or {}),
         )
-        resp = await o.engine.agenerate(req)
+        try:
+            resp = await o.engine.agenerate(req)
+        except BaseException:
+            # never strand a half-built interaction in the cache (it would
+            # pollute parent resolution and spam "incomplete" export warnings)
+            if store:
+                o._cache.pop(completion_id, None)
+            raise
         resp, stop_hit = _truncate_at_stop_strings(resp, o.tokenizer, stop_list)
 
         out_ids = list(resp.output_tokens)

@@ -1,0 +1,13 @@
+"""Shared helpers for the proxy server + gateway."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+
+def bearer_token(request: web.Request) -> str:
+    """Bearer token from Authorization (or X-API-Key fallback)."""
+    auth = request.headers.get("Authorization", "")
+    if auth.startswith("Bearer "):
+        return auth[len("Bearer ") :]
+    return request.headers.get("X-API-Key", "")

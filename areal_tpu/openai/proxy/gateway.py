@@ -15,21 +15,25 @@ the proxy that owns the session. Same protocol here on aiohttp.
 from __future__ import annotations
 
 import dataclasses
+import time
 
 import aiohttp
 from aiohttp import web
 
+from areal_tpu.openai.proxy.common import bearer_token as _bearer
 from areal_tpu.utils import logging as alog
 
 logger = alog.getLogger("proxy_gateway")
 
 FORWARDED_PATHS = ("/v1/chat/completions", "/rl/set_reward", "/rl/end_session")
+ROUTE_TIMEOUT_S = 3600.0  # matches the proxy's session timeout
 
 
 @dataclasses.dataclass
 class SessionRoute:
     backend: str  # base url of the owning proxy
     session_id: str
+    created: float = dataclasses.field(default_factory=time.time)
 
 
 class GatewayState:
@@ -39,16 +43,29 @@ class GatewayState:
         self.admin_api_key = admin_api_key
         self.routes: dict[str, SessionRoute] = {}  # api_key -> route
         self.load: dict[str, int] = {b: 0 for b in self.backends}
+        self._last_sweep = 0.0
 
     def pick_backend(self) -> str:
         return min(self.backends, key=lambda b: self.load.get(b, 0))
 
+    def drop_route(self, api_key: str) -> None:
+        route = self.routes.pop(api_key, None)
+        if route is not None:
+            self.load[route.backend] = max(0, self.load.get(route.backend, 1) - 1)
 
-def _bearer(request: web.Request) -> str:
-    auth = request.headers.get("Authorization", "")
-    if auth.startswith("Bearer "):
-        return auth[len("Bearer ") :]
-    return request.headers.get("X-API-Key", "")
+    def sweep_stale_routes(self) -> None:
+        """Crashed agents never send another request, so forward()-side
+        cleanup can't fire for them; expire routes on the proxy's timeout
+        (keeps routes bounded and load honest on a long-lived gateway)."""
+        now = time.time()
+        if now - self._last_sweep < 60:
+            return
+        self._last_sweep = now
+        for key in [
+            k for k, r in self.routes.items() if now - r.created > ROUTE_TIMEOUT_S
+        ]:
+            logger.warning("expiring stale gateway route")
+            self.drop_route(key)
 
 
 def create_gateway_app(state: GatewayState) -> web.Application:
@@ -75,6 +92,7 @@ def create_gateway_app(state: GatewayState) -> web.Application:
     async def start_session(request: web.Request):
         if _bearer(request) != state.admin_api_key:
             raise web.HTTPForbidden(text="admin API key required")
+        state.sweep_stale_routes()
         body = await request.json()
         backend = state.pick_backend()
         http = await _client(request.app)
@@ -91,7 +109,9 @@ def create_gateway_app(state: GatewayState) -> web.Application:
             backend=backend, session_id=payload["session_id"]
         )
         state.load[backend] = state.load.get(backend, 0) + 1
-        payload["base_url"] = backend
+        # the agent must keep talking THROUGH the gateway — backends are
+        # internal addresses and bypassing them breaks route bookkeeping
+        payload["base_url"] = f"http://{request.headers.get('Host', request.host)}"
         return web.json_response(payload)
 
     async def forward(request: web.Request):
@@ -114,15 +134,12 @@ def create_gateway_app(state: GatewayState) -> web.Application:
             text = await r.text()
             # route + load bookkeeping: release on end_session, and also
             # when the proxy reports the session gone (agent crashed and the
-            # proxy expired it) — otherwise routes grow without bound and
-            # phantom load skews pick_backend
+            # proxy expired it); sweep_stale_routes covers agents that stop
+            # talking entirely
             if (request.path == "/rl/end_session" and r.status == 200) or (
                 r.status == 410
             ):
-                state.routes.pop(key, None)
-                state.load[route.backend] = max(
-                    0, state.load.get(route.backend, 1) - 1
-                )
+                state.drop_route(key)
             return web.Response(
                 text=text, status=r.status, content_type="application/json"
             )

@@ -127,11 +127,7 @@ class ProxyState:
             self.drop_session(sid)
 
 
-def _bearer(request: web.Request) -> str:
-    auth = request.headers.get("Authorization", "")
-    if auth.startswith("Bearer "):
-        return auth[len("Bearer ") :]
-    return request.headers.get("X-API-Key", "")
+from areal_tpu.openai.proxy.common import bearer_token as _bearer  # noqa: E402
 
 
 def create_proxy_app(state: ProxyState) -> web.Application:
@@ -244,9 +240,12 @@ def create_proxy_app(state: ProxyState) -> web.Application:
             raise web.HTTPGone(text=f"session {session_id} expired before export")
         discount = body.get("discount")
         style = body.get("style", "individual")
-        interactions = sess.client._cache.export_interactions(
-            style=style, turn_discount=discount
-        )
+        try:
+            interactions = sess.client._cache.export_interactions(
+                style=style, turn_discount=discount
+            )
+        except (ValueError, RuntimeError) as e:
+            raise web.HTTPBadRequest(text=str(e))
         state.drop_session(session_id)
         return web.json_response(
             {"interactions": serialize_interactions(interactions)}

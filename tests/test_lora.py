@@ -1,0 +1,153 @@
+"""LoRA/PEFT tests (reference fsdp_engine.py:833-860 role): adapters train,
+the base stays frozen bit-for-bit, merged export folds the deltas in, and
+the adapted model starts exactly at the base model (B=0 init)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from areal_tpu.api.config import (
+    MeshConfig,
+    MicroBatchSpec,
+    OptimizerConfig,
+    TrainEngineConfig,
+)
+from areal_tpu.api.io_struct import FinetuneSpec
+from areal_tpu.engine.train_engine import JaxTrainEngine
+from areal_tpu.models import qwen
+
+MODEL_KW = dict(
+    vocab_size=128,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    dtype="float32",
+)
+
+
+def _engine(lora_rank=4, targets=("wq", "wk", "wv", "wo")):
+    cfg = TrainEngineConfig(
+        init_from_scratch=True,
+        dtype="float32",
+        param_dtype="float32",
+        gradient_checkpointing=False,
+        mesh=MeshConfig(data=1, fsdp=4, seq=1, model=2, expert=1),
+        optimizer=OptimizerConfig(lr=1e-2, lr_scheduler_type="constant"),
+        mb_spec=MicroBatchSpec(),
+        lora_rank=lora_rank,
+        lora_targets=list(targets),
+    )
+    mc = qwen.ModelConfig(
+        **{**MODEL_KW, "lora_rank": lora_rank, "lora_targets": tuple(targets)}
+    )
+    eng = JaxTrainEngine(cfg, model_config=mc)
+    eng.initialize(FinetuneSpec(1, 100, 4))
+    return eng
+
+
+def _batch(rng, B=4, L=16):
+    return {
+        "input_ids": rng.integers(1, 128, (B, L)).astype(np.int32),
+        "attention_mask": np.ones((B, L), np.int64),
+        "loss_mask": np.ones((B, L), np.float32),
+    }
+
+
+def _lm_loss(outputs, b):
+    lm = (b["label_valid"] & (b["loss_mask"] > 0)).astype(jnp.float32)
+    denom = jnp.maximum(lm.sum(), 1.0)
+    return -(outputs["logprobs"] * lm).sum() / denom, {}
+
+
+def _wf(d):
+    return float((np.asarray(d["loss_mask"]) > 0).sum()) or 1.0
+
+
+def test_lora_b_zero_init_matches_base():
+    """With B=0, the adapted forward equals the base forward exactly."""
+    mc_base = qwen.ModelConfig(**MODEL_KW)
+    mc_lora = qwen.ModelConfig(**{**MODEL_KW, "lora_rank": 4})
+    params = qwen.init_params(jax.random.PRNGKey(0), mc_lora)
+    base_params = {
+        **params,
+        "layers": {
+            k: v for k, v in params["layers"].items() if "_lora_" not in k
+        },
+    }
+    ids = jnp.ones((1, 8), jnp.int32)
+    seg = jnp.ones((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8), (1, 8)).astype(jnp.int32)
+    h_lora = qwen.forward(params, mc_lora, ids, seg, pos)
+    h_base = qwen.forward(base_params, mc_base, ids, seg, pos)
+    np.testing.assert_allclose(np.asarray(h_lora), np.asarray(h_base), atol=1e-6)
+
+
+def test_lora_trains_adapters_only():
+    eng = _engine()
+    rng = np.random.default_rng(0)
+    batch = _batch(rng)
+    before = jax.tree.map(np.asarray, eng.params)
+    s1 = eng.train_batch(batch, _lm_loss, _wf)  # warmup step: lr ramps from 0
+    s2 = eng.train_batch(batch, _lm_loss, _wf)
+    s3 = eng.train_batch(batch, _lm_loss, _wf)
+    after = jax.tree.map(np.asarray, eng.params)
+    assert s3["loss"] < s2["loss"], (s2["loss"], s3["loss"])
+    assert s1["grad_norm"] > 0
+    changed, frozen_ok = [], []
+    for k in before["layers"]:
+        same = np.array_equal(before["layers"][k], after["layers"][k])
+        if "_lora_" in k:
+            changed.append((k, not same))
+        else:
+            frozen_ok.append((k, same))
+    assert all(ok for _, ok in frozen_ok), [k for k, ok in frozen_ok if not ok]
+    assert any(ch for _, ch in changed), "no adapter moved"
+    assert np.array_equal(before["embed"], after["embed"])
+
+
+def test_lora_merge_matches_adapted_forward():
+    eng = _engine()
+    rng = np.random.default_rng(1)
+    batch = _batch(rng)
+    eng.train_batch(batch, _lm_loss, _wf)  # warmup step (lr=0)
+    eng.train_batch(batch, _lm_loss, _wf)  # adapters actually move
+    mc = eng.model_cfg
+    merged = qwen.merge_lora(eng.params, mc)
+    assert not any("_lora_" in k for k in merged["layers"])
+    mc_base = qwen.ModelConfig(**{**mc.__dict__, "lora_rank": 0})
+    ids = jnp.asarray(rng.integers(1, 128, (2, 8)), jnp.int32)
+    seg = jnp.ones((2, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8), (2, 8)).astype(jnp.int32)
+    with jax.set_mesh(eng.mesh):
+        h_adapted = qwen.forward(eng.params, mc, ids, seg, pos)
+        h_merged = qwen.forward(merged, mc_base, ids, seg, pos)
+    np.testing.assert_allclose(
+        np.asarray(h_adapted), np.asarray(h_merged), atol=2e-5
+    )
+
+
+def test_lora_ffn_targets():
+    eng = _engine(targets=("w_gate", "w_up", "w_down"))
+    rng = np.random.default_rng(2)
+    before = jax.tree.map(np.asarray, eng.params)
+    batch = _batch(rng)
+    eng.train_batch(batch, _lm_loss, _wf)  # warmup step (lr=0)
+    eng.train_batch(batch, _lm_loss, _wf)
+    after = jax.tree.map(np.asarray, eng.params)
+    assert not np.array_equal(
+        before["layers"]["w_gate_lora_b"], after["layers"]["w_gate_lora_b"]
+    )
+    assert np.array_equal(before["layers"]["w_gate"], after["layers"]["w_gate"])
+
+
+def test_lora_invalid_target_rejected():
+    with pytest.raises(ValueError):
+        qwen.init_lora_params(
+            jax.random.PRNGKey(0),
+            qwen.ModelConfig(
+                **{**MODEL_KW, "lora_rank": 2, "lora_targets": ("input_norm",)}
+            ),
+        )
