@@ -32,6 +32,7 @@ class NormConfig:
     std_level: str = "batch"
     group_size: int = 1
     eps: float = 1e-5
+    mean_leave1out: bool = False  # RLOO leave-one-out baseline
 
 
 @dataclass

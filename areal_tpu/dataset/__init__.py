@@ -66,3 +66,98 @@ def _synthetic_arith(split: str = "train", n: int = 512, seed: int = 0, **kwargs
             }
         )
     return rows
+
+
+@register_dataset("math")
+def _math(split: str = "train", path: str | None = None, **kwargs):
+    """Competition-math rows: {"messages", "answer"} with boxed answers
+    (reference geometry3k/math_verify pipeline shape)."""
+    import datasets
+
+    assert path, "math requires a local dataset path (zero-egress image)"
+    ds = datasets.load_dataset(path=path, split=split)
+
+    def to_row(x):
+        q = x.get("problem") or x.get("question")
+        return {
+            "messages": [{"role": "user", "content": q}],
+            "answer": x.get("answer") or x.get("solution", ""),
+        }
+
+    return [to_row(x) for x in ds]
+
+
+@register_dataset("hh_rlhf")
+def _hh_rlhf(
+    split: str = "train",
+    path: str | None = None,
+    tokenizer=None,
+    max_length: int | None = None,
+    **kwargs,
+):
+    """Pairwise preference rows for reward modeling:
+    {"chosen_ids", "rejected_ids"} (reference dataset/hhrlhf.py)."""
+    import datasets
+
+    assert path, "hh_rlhf requires a local dataset path (zero-egress image)"
+    assert tokenizer is not None, "hh_rlhf requires a tokenizer"
+    ds = datasets.load_dataset(path=path, split=split)
+    eos = tokenizer.eos_token or ""
+    rows = []
+    for x in ds:
+        chosen = tokenizer.encode(x["chosen"] + eos)
+        rejected = tokenizer.encode(x["rejected"] + eos)
+        if max_length is not None and (
+            len(chosen) > max_length or len(rejected) > max_length
+        ):
+            continue
+        rows.append({"chosen_ids": chosen, "rejected_ids": rejected})
+    return rows
+
+
+@register_dataset("clevr_count_70k")
+def _clevr_count(split: str = "train", path: str | None = None, **kwargs):
+    """Vision counting rows: {"messages", "images", "answer"} — the
+    message content carries an image placeholder; VisionRLVRWorkflow ships
+    the pixel data (reference dataset/clevr_count_70k.py)."""
+    import datasets
+
+    assert path, "clevr_count_70k requires a local dataset path"
+    ds = datasets.load_dataset(path=path, split=split)
+
+    def to_row(x):
+        msgs = x.get("messages") or [
+            {
+                "role": "user",
+                "content": x.get("problem", "How many objects are there? "
+                "Answer within brackets, e.g. [3]."),
+            }
+        ]
+        return {
+            "messages": msgs,
+            "images": x.get("images") or x.get("image"),
+            "answer": str(x.get("answer", "")).strip(),
+        }
+
+    return [to_row(x) for x in ds]
+
+
+@register_dataset("torl_data")
+def _torl(split: str = "train", path: str | None = None, **kwargs):
+    """Tool-integrated reasoning rows (reference dataset/torl_data.py):
+    math questions intended for code-interpreter agents; same row schema as
+    "math" so RLVR and agentic workflows can consume them unchanged."""
+    import datasets
+
+    assert path, "torl_data requires a local dataset path"
+    ds = datasets.load_dataset(path=path, split=split)
+
+    def to_row(x):
+        return {
+            "messages": [
+                {"role": "user", "content": x.get("question") or x.get("problem")}
+            ],
+            "answer": str(x.get("answer", "")),
+        }
+
+    return [to_row(x) for x in ds]

@@ -79,7 +79,10 @@ class WorkflowExecutor:
         self._input: queue.Queue[tuple[_TaskRecord, RolloutWorkflow, Callable | None]] = (
             queue.Queue()
         )
-        self._results: list[tuple[str, TensorDict]] = []  # (task_id, traj)
+        # (task_id, traj, n_real_tokens) — the count is cached at append
+        # time so the dynamic-batch poll loop doesn't re-reduce every
+        # pending mask on each iteration
+        self._results: list[tuple[str, TensorDict, int]] = []
         self._done_tasks: dict[str, _TaskRecord] = {}
         # rejected tasks nobody awaits leave tombstones; bound their count
         self._reject_order: deque[str] = deque()
@@ -90,6 +93,8 @@ class WorkflowExecutor:
         self._thread: threading.Thread | None = None
         self._thread_exc: BaseException | None = None
         self._data_gen = None  # cached cycle_dataloader for prepare_batch
+        # optional: attach a tokenizer to get decoded text in trajectory dumps
+        self.tokenizer = None
 
     # -- lifecycle --------------------------------------------------------
     def initialize(self) -> None:
@@ -166,6 +171,11 @@ class WorkflowExecutor:
         if accepted:
             self.staleness.on_accept()
             stats_tracker.get().scalar(rollout_accepted=1.0)
+            if self.config.dump_trajectories:
+                try:
+                    self._dump_trajectory(traj, task_id)
+                except Exception:  # noqa: BLE001 — dumping must never kill rollout
+                    logger.exception("trajectory dump failed")
         else:
             self.staleness.on_reject()
             stats_tracker.get().scalar(rollout_rejected=1.0)
@@ -180,7 +190,9 @@ class WorkflowExecutor:
                 rec.accepted = accepted
                 rec.data = None  # release the input payload
             if accepted:
-                self._results.append((task_id, traj))
+                self._results.append(
+                    (task_id, traj, int(np.asarray(traj["attention_mask"]).sum()))
+                )
             elif rec is not None:
                 self._reject_order.append(task_id)
                 while len(self._reject_order) > self._max_reject_records:
@@ -190,6 +202,72 @@ class WorkflowExecutor:
     def _check_health(self) -> None:
         if self._thread_exc is not None:
             raise RuntimeError("rollout dispatcher failed") from self._thread_exc
+
+    # -- trajectory dumping (reference workflow_executor.py:823-910) -------
+    def _dump_dir(self) -> str:
+        if self.config.dump_dir:
+            return self.config.dump_dir
+        import os
+
+        return os.path.join(
+            "/tmp/areal_tpu/experiments",
+            self.config.experiment_name or "exp",
+            self.config.trial_name or "trial",
+            "generated",
+        )
+
+    def _dump_trajectory(self, traj: TensorDict, task_id: str) -> None:
+        """One JSONL record per sequence, under {dump_dir}/{tail_version}/:
+        seqlen/prompt_len/version span/reward plus decoded text when a
+        tokenizer is attached (token ids otherwise)."""
+        import json
+        import os
+
+        input_ids = np.asarray(traj["input_ids"])
+        attn = np.asarray(traj["attention_mask"])
+        loss_mask = np.asarray(traj.get("loss_mask", np.ones_like(attn)))
+        rewards = np.asarray(traj.get("rewards", np.zeros(len(input_ids))))
+        if "versions" in traj:
+            versions = np.asarray(traj["versions"])
+            vmask = versions >= 0
+            head_v = int(versions[vmask].min()) if vmask.any() else -1
+            tail_v = int(versions[vmask].max()) if vmask.any() else -1
+        else:
+            head_v = tail_v = int(self.engine.get_version())
+        version_dir = os.path.join(self._dump_dir(), str(tail_v))
+        os.makedirs(version_dir, exist_ok=True)
+        path = os.path.join(version_dir, f"{task_id}.jsonl")
+        with open(path, "a") as f:
+            for i in range(len(input_ids)):
+                seqlen = int(attn[i].sum())
+                if seqlen == 0:
+                    continue
+                ids = input_ids[i, :seqlen].tolist()
+                mask = loss_mask[i, :seqlen].tolist()
+                if not mask or mask[-1] != 1:
+                    continue  # no completion tokens
+                # only the LEADING 0-run is the prompt — multi-turn masks
+                # interleave 0-runs (injected user/tool turns) with 1-runs,
+                # so seqlen - sum(mask) would misattribute text
+                prompt_end = next(
+                    (j for j, m in enumerate(mask) if m == 1), seqlen
+                )
+                rec = {
+                    "task_id": task_id,
+                    "sample_idx": i,
+                    "seqlen": seqlen,
+                    "prompt_len": prompt_end,
+                    "head_version": head_v,
+                    "tail_version": tail_v,
+                    "reward": float(np.ravel(rewards)[i]),
+                }
+                if self.tokenizer is not None:
+                    rec["prompt"] = self.tokenizer.decode(ids[:prompt_end])
+                    rec["completion"] = self.tokenizer.decode(ids[prompt_end:])
+                else:
+                    rec["prompt_ids"] = ids[:prompt_end]
+                    rec["completion_ids"] = ids[prompt_end:]
+                f.write(json.dumps(rec) + "\n")
 
     # -- public API (InferenceEngine rollout surface) ---------------------
     def submit(
@@ -220,9 +298,9 @@ class WorkflowExecutor:
                 self._results[:count],
                 self._results[count:],
             )
-            for tid, _ in out:
+            for tid, _, _ in out:
                 self._done_tasks.pop(tid, None)
-        return concat_padded_tensor_dicts([t for _, t in out])
+        return concat_padded_tensor_dicts([t for _, t, _ in out])
 
     def wait_for_task(self, task_id: str, timeout: float | None = None):
         deadline = time.monotonic() + (timeout or self.config.request_timeout)
@@ -238,7 +316,7 @@ class WorkflowExecutor:
             self._done_tasks.pop(task_id, None)
             # drop this task's trajectory from the shared results buffer so it
             # is not consumed a second time by wait()/prepare_batch
-            self._results = [(tid, t) for tid, t in self._results if tid != task_id]
+            self._results = [r for r in self._results if r[0] != task_id]
         return rec.result
 
     def rollout_batch(
@@ -257,6 +335,12 @@ class WorkflowExecutor:
         if self._data_gen is None:
             self._data_gen = cycle_dataloader(dataloader)
         bs = self.config.consumer_batch_size
+        # dynamic batch mode (reference active_submit_and_wait dynamic_bs,
+        # workflow_executor.py:623): instead of a fixed trajectory count,
+        # return as soon as the accepted set reaches a token budget — batch
+        # sizes then track response length, keeping step compute stable for
+        # long-CoT workloads
+        tok_budget = self.config.dynamic_bs_max_tokens
         workflow = resolve_workflow(workflow)
         while True:
             self._check_health()
@@ -270,11 +354,24 @@ class WorkflowExecutor:
                 for d in item if isinstance(item, list) else [item]:
                     self.submit(d, workflow, should_accept_fn)
             with self._cv:
-                if len(self._results) >= bs:
+                if tok_budget is not None and self._results:
+                    n_take, total = 0, 0
+                    for _, _, ntok in self._results:
+                        total += ntok
+                        n_take += 1
+                        if total >= tok_budget:
+                            break
+                    if total >= tok_budget or n_take >= bs:
+                        out = self._results[:n_take]
+                        self._results = self._results[n_take:]
+                        for tid, _, _ in out:
+                            self._done_tasks.pop(tid, None)
+                        return concat_padded_tensor_dicts([t for _, t, _ in out])
+                elif len(self._results) >= bs:
                     out, self._results = self._results[:bs], self._results[bs:]
-                    for tid, _ in out:
+                    for tid, _, _ in out:
                         self._done_tasks.pop(tid, None)
-                    return concat_padded_tensor_dicts([t for _, t in out])
+                    return concat_padded_tensor_dicts([t for _, t, _ in out])
             time.sleep(0.01)
 
     def export_stats(self) -> dict[str, float]:

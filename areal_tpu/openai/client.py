@@ -148,30 +148,36 @@ class AsyncChatCompletions:
         )
         completion_id = _new_id("chatcmpl")
         # parent resolution needs the cache's prefix logic; stage the
-        # interaction first so __setitem__ links it
+        # interaction first so __setitem__ links it — and evict it on ANY
+        # failure before the completion lands (tokenizer errors included),
+        # or retries strand half-built entries in the cache
         if store:
             o._cache[completion_id] = interaction
-        # prompt tokens
-        if o.chat_template_type == "concat":
-            parent = interaction.parent
-            parent_len = (
-                len(parent.messages + (parent.output_messages or []))
-                if parent is not None
-                else 0
-            )
-            prompt_ids = concat_prompt_token_ids_with_parent(
-                messages[parent_len:], parent, o.tokenizer, tools
-            )
-        else:
-            prompt_ids = list(
-                o.tokenizer.apply_chat_template(
-                    messages,
-                    tools=tools,
-                    add_generation_prompt=True,
-                    tokenize=True,
-                    **(extra_body or {}).get("chat_template_kwargs", {}),
+        try:
+            if o.chat_template_type == "concat":
+                parent = interaction.parent
+                parent_len = (
+                    len(parent.messages + (parent.output_messages or []))
+                    if parent is not None
+                    else 0
                 )
-            )
+                prompt_ids = concat_prompt_token_ids_with_parent(
+                    messages[parent_len:], parent, o.tokenizer, tools
+                )
+            else:
+                prompt_ids = list(
+                    o.tokenizer.apply_chat_template(
+                        messages,
+                        tools=tools,
+                        add_generation_prompt=True,
+                        tokenize=True,
+                        **(extra_body or {}).get("chat_template_kwargs", {}),
+                    )
+                )
+        except BaseException:
+            if store:
+                o._cache.pop(completion_id, None)
+            raise
 
         # token budget resolution (reference client.py:420-480)
         total = max_total_tokens

@@ -33,7 +33,7 @@ ROUTE_TIMEOUT_S = 3600.0  # matches the proxy's session timeout
 class SessionRoute:
     backend: str  # base url of the owning proxy
     session_id: str
-    created: float = dataclasses.field(default_factory=time.time)
+    last_activity: float = dataclasses.field(default_factory=time.time)
 
 
 class GatewayState:
@@ -55,14 +55,17 @@ class GatewayState:
 
     def sweep_stale_routes(self) -> None:
         """Crashed agents never send another request, so forward()-side
-        cleanup can't fire for them; expire routes on the proxy's timeout
-        (keeps routes bounded and load honest on a long-lived gateway)."""
+        cleanup can't fire for them; expire routes on IDLE time (matching
+        the proxy's last-access semantics — an active long episode must
+        never lose its route mid-rollout)."""
         now = time.time()
         if now - self._last_sweep < 60:
             return
         self._last_sweep = now
         for key in [
-            k for k, r in self.routes.items() if now - r.created > ROUTE_TIMEOUT_S
+            k
+            for k, r in self.routes.items()
+            if now - r.last_activity > ROUTE_TIMEOUT_S
         ]:
             logger.warning("expiring stale gateway route")
             self.drop_route(key)
@@ -119,6 +122,7 @@ def create_gateway_app(state: GatewayState) -> web.Application:
         route = state.routes.get(key)
         if route is None:
             raise web.HTTPGone(text="unknown session key")
+        route.last_activity = time.time()
         http = await _client(request.app)
         body = await request.read()
         async with http.post(

@@ -1,0 +1,88 @@
+"""Merge per-rank/per-role perf-tracer files into one Chrome trace.
+
+Reference: areal/tools/perf_trace_converter.py — collects the rank-qualified
+catapult JSON files the PerfTracer writes, remaps pid/tid so ranks render as
+separate process rows sorted (role, rank), and emits a single
+``traceEvents`` JSON loadable in chrome://tracing / Perfetto.
+
+Usage:  python -m areal_tpu.tools.perf_trace_converter TRACE_DIR [-o out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+from pathlib import Path
+
+_FNAME_RE = re.compile(r"(?P<role>[A-Za-z_]+)?-?r(?P<rank>\d+)")
+
+
+def _load_events(path: Path) -> list[dict]:
+    text = path.read_text()
+    try:
+        payload = json.loads(text)
+        if isinstance(payload, dict):
+            return payload.get("traceEvents", [])
+        return payload
+    except json.JSONDecodeError:
+        # JSONL: one event per line
+        events = []
+        for line in text.splitlines():
+            line = line.strip().rstrip(",")
+            if line:
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        return events
+
+
+def _rank_role_of(path: Path) -> tuple[int, str]:
+    m = _FNAME_RE.search(path.stem)
+    if m:
+        return int(m.group("rank")), m.group("role") or "rank"
+    return 0, path.stem
+
+
+def convert(trace_dir: str | Path, output: str | Path | None = None) -> Path:
+    trace_dir = Path(trace_dir)
+    files = sorted(
+        p
+        for p in trace_dir.glob("**/*")
+        if p.suffix in (".json", ".jsonl") and p.is_file()
+    )
+    if not files:
+        raise FileNotFoundError(f"no trace files under {trace_dir}")
+    merged: list[dict] = []
+    for pid, path in enumerate(sorted(files, key=_rank_role_of)):
+        rank, role = _rank_role_of(path)
+        merged.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": f"{role} r{rank}"},
+            }
+        )
+        for ev in _load_events(path):
+            ev = dict(ev)
+            ev["pid"] = pid
+            merged.append(ev)
+    output = Path(output) if output else trace_dir / "merged_trace.json"
+    output.write_text(json.dumps({"traceEvents": merged}))
+    return output
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("trace_dir")
+    p.add_argument("-o", "--output", default=None)
+    args = p.parse_args(argv)
+    out = convert(args.trace_dir, args.output)
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

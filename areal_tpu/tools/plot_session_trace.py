@@ -1,0 +1,52 @@
+"""Summarize session-tracer lifecycles: per-phase latency percentiles and an
+accept/reject breakdown (reference areal/tools/plot_session_trace.py role,
+text output instead of matplotlib — the TPU image is headless).
+
+Usage: python -m areal_tpu.tools.plot_session_trace SESSIONS.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from collections import defaultdict
+from pathlib import Path
+
+
+def summarize(path: str | Path) -> dict:
+    phases: dict[str, list[float]] = defaultdict(list)
+    status: dict[str, int] = defaultdict(int)
+    total: list[float] = []
+    for line in Path(path).read_text().splitlines():
+        if not line.strip():
+            continue
+        rec = json.loads(line)
+        status[rec.get("status", "unknown")] += 1
+        if rec.get("start") is not None and rec.get("end") is not None:
+            total.append(rec["end"] - rec["start"])
+        for ph in rec.get("phases", []):
+            if ph.get("start") is not None and ph.get("end") is not None:
+                phases[ph["name"]].append(ph["end"] - ph["start"])
+    def pct(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else 0.0
+    return {
+        "sessions": dict(status),
+        "total_s": {"p50": pct(total, 0.5), "p90": pct(total, 0.9), "p99": pct(total, 0.99)},
+        "phases": {
+            name: {"n": len(xs), "p50": pct(xs, 0.5), "p90": pct(xs, 0.9)}
+            for name, xs in sorted(phases.items())
+        },
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("sessions_file")
+    args = p.parse_args(argv)
+    print(json.dumps(summarize(args.sessions_file), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

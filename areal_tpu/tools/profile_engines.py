@@ -1,0 +1,196 @@
+"""Per-engine microbenchmarks (reference areal/tools/profile_engines.py /
+profile_fsdp.py role): time train_batch / forward_batch / decode chunks on
+the current backend for a synthetic model, print one JSON report.
+
+Usage:
+  python -m areal_tpu.tools.profile_engines --mode train --hidden 1536 \
+      --layers 28 --seqs 6 --len 2048
+  python -m areal_tpu.tools.profile_engines --mode decode --slots 128
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def profile_train(args) -> dict:
+    import numpy as np
+    import jax.numpy as jnp
+
+    from areal_tpu.api.config import (
+        MeshConfig,
+        MicroBatchSpec,
+        OptimizerConfig,
+        TrainEngineConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.train_engine import JaxTrainEngine
+    from areal_tpu.models import qwen
+    from areal_tpu.ops import functional as F
+    from areal_tpu.utils.data import pad_sequences_to_tensors
+
+    mc = qwen.ModelConfig(
+        vocab_size=args.vocab,
+        hidden_size=args.hidden,
+        intermediate_size=args.hidden * 6 if args.inter is None else args.inter,
+        num_layers=args.layers,
+        num_heads=args.heads,
+        num_kv_heads=args.kv_heads,
+        head_dim=128,
+        dtype="bfloat16",
+    )
+    cfg = TrainEngineConfig(
+        init_from_scratch=True,
+        dtype="bfloat16",
+        param_dtype="bfloat16",
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        optimizer=OptimizerConfig(lr=1e-5, lr_scheduler_type="constant"),
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=1_000_000),
+        logprob_chunk_size=1024,
+    )
+    eng = JaxTrainEngine(cfg, model_config=mc)
+    eng.initialize(FinetuneSpec(1, 1000, 8))
+    rng = np.random.default_rng(0)
+    trajs = []
+    for _ in range(args.seqs):
+        n = args.len
+        trajs.append(
+            {
+                "input_ids": rng.integers(0, args.vocab, n).astype(np.int32),
+                "loss_mask": np.ones(n, np.float32),
+                "old_logprobs": rng.normal(-1.5, 0.1, n).astype(np.float32),
+                "advantages": rng.normal(0, 1, n).astype(np.float32),
+            }
+        )
+    batch = pad_sequences_to_tensors(trajs)
+    n_tokens = int(np.asarray(batch["attention_mask"]).sum())
+
+    def loss(outputs, b):
+        lm = (b["label_valid"] & (b["loss_mask"] > 0)).astype(jnp.float32)
+        l, _ = F.ppo_actor_loss_fn(
+            logprobs=outputs["logprobs"],
+            proximal_logprobs=b["old_logprobs"],
+            old_logprobs=b["old_logprobs"],
+            advantages=b["advantages"],
+            loss_mask=lm,
+        )
+        return l, {}
+
+    wf = lambda d: float((np.asarray(d["loss_mask"]) > 0).sum())  # noqa: E731
+    t0 = time.monotonic()
+    eng.train_batch(batch, loss, wf)
+    compile_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    for _ in range(args.steps):
+        stats = eng.train_batch(batch, loss, wf)
+    dt = (time.monotonic() - t0) / args.steps
+    n_params = sum(x.size for x in __import__("jax").tree.leaves(eng.params))
+    mfu = n_tokens * 6 * n_params / dt / 197e12
+    return {
+        "mode": "train",
+        "tokens_per_step": n_tokens,
+        "compile_s": round(compile_s, 1),
+        "step_ms": round(dt * 1e3, 1),
+        "tok_s": round(n_tokens / dt, 1),
+        "mfu_v5e": round(mfu, 3),
+        "loss": stats["loss"],
+    }
+
+
+def profile_decode(args) -> dict:
+    import numpy as np
+    import jax
+
+    from areal_tpu.api.config import MeshConfig, ServerConfig
+    from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.models import qwen
+
+    mc = qwen.ModelConfig(
+        vocab_size=args.vocab,
+        hidden_size=args.hidden,
+        intermediate_size=args.hidden * 6 if args.inter is None else args.inter,
+        num_layers=args.layers,
+        num_heads=args.heads,
+        num_kv_heads=args.kv_heads,
+        head_dim=128,
+        dtype="bfloat16",
+    )
+    cfg = ServerConfig(
+        max_batch_size=args.slots,
+        max_seq_len=args.ctx,
+        decode_steps_per_call=32,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+    )
+    params = jax.jit(lambda k: qwen.init_params(k, mc))(jax.random.PRNGKey(0))
+    eng = DecodeEngine(cfg, params=params, model_cfg=mc)
+    eng.initialize()
+    eng.start()
+    rng = np.random.default_rng(0)
+    import threading
+
+    n_req = args.slots * 2
+    done = threading.Event()
+    results = []
+
+    def cb(r):
+        results.append(r)
+        if len(results) == n_req:
+            done.set()
+
+    eng.generate_sync(
+        ModelRequest(
+            input_ids=rng.integers(0, 1000, 128).tolist(),
+            gconfig=GenerationHyperparameters(max_new_tokens=16, greedy=True),
+        ),
+        timeout=600,
+    )
+    t0 = time.monotonic()
+    for _ in range(n_req):
+        eng.submit(
+            ModelRequest(
+                input_ids=rng.integers(0, 1000, 128).tolist(),
+                gconfig=GenerationHyperparameters(
+                    max_new_tokens=args.new_tokens, temperature=1.0
+                ),
+            ),
+            cb,
+        )
+    done.wait(timeout=900)
+    dt = time.monotonic() - t0
+    toks = sum(len(r.output_tokens) for r in results)
+    eng.stop()
+    return {
+        "mode": "decode",
+        "slots": args.slots,
+        "requests": len(results),
+        "tok_s": round(toks / dt, 1),
+        "stats": {k: int(v) for k, v in eng.stats.items()},
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--mode", choices=("train", "decode"), default="train")
+    p.add_argument("--hidden", type=int, default=1536)
+    p.add_argument("--inter", type=int, default=None)
+    p.add_argument("--layers", type=int, default=28)
+    p.add_argument("--heads", type=int, default=12)
+    p.add_argument("--kv-heads", type=int, default=2)
+    p.add_argument("--vocab", type=int, default=151936)
+    p.add_argument("--seqs", type=int, default=6)
+    p.add_argument("--len", type=int, default=2048)
+    p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--slots", type=int, default=128)
+    p.add_argument("--ctx", type=int, default=512)
+    p.add_argument("--new-tokens", type=int, default=256)
+    args = p.parse_args(argv)
+    report = profile_train(args) if args.mode == "train" else profile_decode(args)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,80 @@
+"""Installation validator (reference areal/tools/validate_installation.py):
+checks imports, device availability, a tiny jit, and the HTTP stack; prints
+a PASS/FAIL table and exits nonzero on failure.
+
+Usage: python -m areal_tpu.tools.validate_installation [--tpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _check(name, fn, results):
+    try:
+        detail = fn() or ""
+        results.append((name, True, str(detail)))
+    except Exception as e:  # noqa: BLE001
+        results.append((name, False, f"{type(e).__name__}: {e}"))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--tpu", action="store_true", help="require a TPU backend")
+    args = p.parse_args(argv)
+    results: list[tuple[str, bool, str]] = []
+
+    def imports():
+        import aiohttp  # noqa: F401
+        import flax  # noqa: F401
+        import optax  # noqa: F401
+        import orbax.checkpoint  # noqa: F401
+        import transformers  # noqa: F401
+
+        import areal_tpu  # noqa: F401
+
+        return "core deps + areal_tpu"
+
+    _check("imports", imports, results)
+
+    def devices():
+        import jax
+
+        devs = jax.devices()
+        if args.tpu and devs[0].platform != "tpu":
+            raise RuntimeError(f"expected tpu, got {devs[0].platform}")
+        return f"{len(devs)}x {devs[0].platform}"
+
+    _check("devices", devices, results)
+
+    def tiny_jit():
+        import jax
+        import jax.numpy as jnp
+
+        y = jax.jit(lambda x: (x @ x).sum())(jnp.ones((128, 128), jnp.bfloat16))
+        return f"jit ok ({float(y):.0f})"
+
+    _check("jit", tiny_jit, results)
+
+    def engine_contract():
+        from areal_tpu.api.engine_api import InferenceEngine, TrainEngine
+        from areal_tpu.engine.train_engine import JaxTrainEngine
+        from areal_tpu.inference.client import RemoteJaxEngine
+
+        assert issubclass(JaxTrainEngine, TrainEngine)
+        assert issubclass(RemoteJaxEngine, InferenceEngine)
+        return "contracts wired"
+
+    _check("contracts", engine_contract, results)
+
+    width = max(len(n) for n, _, _ in results)
+    ok = True
+    for name, passed, detail in results:
+        ok &= passed
+        print(f"{name:<{width}}  {'PASS' if passed else 'FAIL'}  {detail}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -152,6 +152,7 @@ class PPOActor:
                 mean_level=config.adv_norm.mean_level,
                 std_level=config.adv_norm.std_level,
                 group_size=config.adv_norm.group_size or config.group_size,
+                mean_leave1out=config.adv_norm.mean_leave1out,
             )
             if config.adv_norm
             else None

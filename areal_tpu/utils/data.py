@@ -337,11 +337,13 @@ class Normalization:
         std_level: str | None = "batch",
         group_size: int = 1,
         eps: float = 1e-5,
+        mean_leave1out: bool = False,  # RLOO: center = mean of the OTHERS
     ):
         self.mean_level = mean_level or "none"
         self.std_level = std_level or "none"
         self.group_size = group_size
         self.eps = eps
+        self.mean_leave1out = mean_leave1out
 
     def __call__(self, x: np.ndarray, mask: np.ndarray | None = None) -> np.ndarray:
         x = np.asarray(x, dtype=np.float64)
@@ -362,7 +364,16 @@ class Normalization:
         #    same center (mean_level=none -> RMS around 0), matching reference
         #    semantics so e.g. Dr.GRPO's no-mean variants stay sane.
         center = np.zeros_like(x)
-        if self.mean_level == "group":
+        if self.mean_level == "group" and self.mean_leave1out:
+            # RLOO baseline (reference Normalization mean_leave1out): each
+            # sample's center is the mean of its group EXCLUDING itself
+            for sl in _group_slices():
+                xs, ms = x[sl], mask[sl]
+                tot, cnt = (xs * ms).sum(), ms.sum()
+                for j in range(xs.shape[0]):
+                    c = cnt - ms[j].sum()
+                    center[sl][j] = ((tot - (xs[j] * ms[j]).sum()) / c) if c else 0.0
+        elif self.mean_level == "group":
             for sl in _group_slices():
                 center[sl] = _masked_mean(x[sl], mask[sl])
         elif self.mean_level == "batch":
