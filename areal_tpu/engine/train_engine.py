@@ -69,6 +69,7 @@ _GRID_KEYS = (
     "rw_pair_id",
     "rw_sign",
     "rw_last_mask",
+    "image_embeds",
 )
 
 
@@ -191,6 +192,7 @@ class JaxTrainEngine(TrainEngine):
             # fresh adapters over the loaded base (reference
             # fsdp_engine.py:833-860 get_peft_model role)
             self._add_lora_adapters(seed=kwargs.get("seed", 0))
+            self._ensure_vision_tower(seed=kwargs.get("seed", 0))
         if self.value_head:
             self.params["value_head"] = jax.device_put(
                 jnp.zeros((mcfg.hidden_size,), pdtype),
@@ -212,18 +214,29 @@ class JaxTrainEngine(TrainEngine):
                 weight_decay=ocfg.weight_decay,
             ),
         )
-        if mcfg.lora_rank > 0:
-            # freeze the base: only adapter (+value head) leaves train. The
-            # frozen branch never READS its grads (set_to_zero), and the
+        if mcfg.lora_rank > 0 or mcfg.vision is not None:
+            # freeze branches never READ their grads (set_to_zero) and the
             # grad-norm is masked below, so inside the fused jit XLA's DCE
-            # prunes the base dW matmuls from the backward — the LoRA FLOP
-            # saving falls out of dead-code elimination, no custom VJP.
+            # prunes their dW matmuls from the backward.
+            # - LoRA: only adapter (+value head) leaves train
+            # - VLM: the vision tower is frozen BY DESIGN (embeds are
+            #   precomputed outside the loss — its grads are structurally
+            #   zero, and plain AdamW's decoupled weight decay would still
+            #   shrink it every step; models/vision.py design note)
+            def label(p, _):
+                ks = jax.tree_util.keystr(p)
+                if ks.startswith("['vision']"):
+                    return "freeze"
+                if mcfg.lora_rank > 0:
+                    return (
+                        "train"
+                        if "_lora_" in ks or ks.endswith("['value_head']")
+                        else "freeze"
+                    )
+                return "train"
+
             self._param_labels = jax.tree_util.tree_map_with_path(
-                lambda p, _: "train"
-                if "_lora_" in jax.tree_util.keystr(p)
-                or jax.tree_util.keystr(p).endswith("['value_head']")
-                else "freeze",
-                self.params,
+                label, self.params
             )
             self._tx = optax.multi_transform(
                 {"train": inner, "freeze": optax.set_to_zero()},
@@ -256,6 +269,28 @@ class JaxTrainEngine(TrainEngine):
                 out_shardings=lora_shardings,
             )(jax.random.PRNGKey(seed))
         self.params["layers"].update(lora)
+
+    def _ensure_vision_tower(self, seed: int = 0) -> None:
+        """VLM: guarantee a ``vision`` subtree exists after any param-tree
+        replacement. HF checkpoint name mapping for the tower is not
+        implemented yet, so missing towers initialize from scratch
+        (documented limitation, models/vision.py)."""
+        mcfg = self.model_cfg
+        if mcfg.vision is None or "vision" in self.params:
+            return
+        logger.warning(
+            "VLM: vision tower weights initialize from scratch "
+            "(HF tower import pending)"
+        )
+        from areal_tpu.models.vision import init_vision_params, vision_partition_specs
+
+        pdtype = jnp.dtype(self.config.param_dtype)
+        vshard = mesh_lib.param_sharding(self.mesh, vision_partition_specs())
+        with jax.set_mesh(self.mesh):
+            self.params["vision"] = jax.jit(
+                lambda k: init_vision_params(k, mcfg.vision, dtype=pdtype),
+                out_shardings=vshard,
+            )(jax.random.PRNGKey(seed))
 
     def _grad_norm(self, grads):
         """Global norm over TRAINABLE grads only — reading frozen grads here
@@ -346,6 +381,73 @@ class JaxTrainEngine(TrainEngine):
     def _dp(self) -> int:
         return self.mesh.shape["data"] * self.mesh.shape["fsdp"]
 
+    def _attach_image_embeds(self, input_: TensorDict) -> TensorDict:
+        """VLM data boundary: run the (frozen) vision tower once over the
+        batch's pixel patches and materialize a per-token [B, L, D]
+        ``image_embeds`` key aligned to <|image_pad|> positions — packed
+        grids then never carry pixel data (models/vision.py design note)."""
+        if "pixel_values" not in input_:
+            return input_
+        mcfg = self.model_cfg
+        assert mcfg.vision is not None and mcfg.image_token_id >= 0, (
+            "batch has pixel_values but the model is not a VLM"
+        )
+        from areal_tpu.models import vision as vis
+
+        input_ = dict(input_)
+        pv = np.asarray(input_.pop("pixel_values"), np.float32)  # [B, P, pd]
+        B, P_raw, pd = pv.shape
+        counts = np.asarray(
+            input_.pop("pixel_counts", np.full(B, P_raw)), np.int32
+        )
+        ids = np.asarray(input_["input_ids"])
+        # one PPO step calls forward_batch (logprob recompute) and
+        # train_batch on the SAME batch; memoize the tower output so the
+        # frozen ViT truly runs once per batch
+        memo_key = (
+            hash(pv.tobytes()),
+            hash(counts.tobytes()),
+            hash(ids.tobytes()),
+            self.get_version(),
+        )
+        cached = getattr(self, "_image_embed_memo", None)
+        if cached is not None and cached[0] == memo_key:
+            input_["image_embeds"] = cached[1]
+            return input_
+        merge2 = mcfg.vision.spatial_merge**2
+        # bucket the padded patch count so image-size variation doesn't
+        # recompile the tower per batch
+        Ppad = -(-round_up_to_bucket(P_raw, 256) // merge2) * merge2
+        if Ppad != P_raw:
+            pv = np.pad(pv, ((0, 0), (0, Ppad - P_raw), (0, 0)))
+        key = ("vision", Ppad)
+        if key not in self._fn_cache:
+            vcfg = mcfg.vision
+
+            def run(vparams, pixels, cnts):
+                def one(px, c):
+                    mask = jnp.arange(px.shape[0]) < c
+                    return vis.vision_forward(vparams, vcfg, px, mask)
+
+                return jax.vmap(one)(pixels, cnts)
+
+            self._fn_cache[key] = jax.jit(run)
+        with jax.set_mesh(self.mesh):
+            out = np.asarray(
+                self._fn_cache[key](
+                    self.params["vision"], jnp.asarray(pv), jnp.asarray(counts)
+                ),
+                np.float32,
+            )  # [B, Ppad/merge2, D]
+        embeds = np.zeros((B, ids.shape[1], mcfg.hidden_size), np.float32)
+        for b in range(B):
+            pos = np.where(ids[b] == mcfg.image_token_id)[0]
+            n = min(len(pos), int(counts[b]) // merge2)
+            embeds[b, pos[:n]] = out[b, :n]
+        input_["image_embeds"] = embeds
+        self._image_embed_memo = (memo_key, embeds)
+        return input_
+
     def _make_grids(
         self, input_: TensorDict, mb_spec: MicroBatchSpec | None = None
     ) -> list[Grid]:
@@ -353,6 +455,7 @@ class JaxTrainEngine(TrainEngine):
         G padded to the DP degree). ``mb_spec`` overrides the engine config
         for this call only (e.g. RWEngine's pair-preserving split)."""
         cfg = self.config
+        input_ = self._attach_image_embeds(input_)
         lens = seqlens_of(input_)
         row_len = round_up_to_bucket(int(lens.max()), cfg.bucket_step)
         grid = pack_grid(input_, row_len=row_len, pad_rows_to=1)
@@ -420,6 +523,7 @@ class JaxTrainEngine(TrainEngine):
             batch["positions"],
             with_aux=moe,
             no_grad=no_grad,
+            image_embeds=batch.get("image_embeds"),
         )
         hidden, moe_aux = fwd if moe else (fwd, None)
         outputs: dict[str, jax.Array] = {}
@@ -772,9 +876,11 @@ class JaxTrainEngine(TrainEngine):
             self.params, _ = load_params_from_hf(
                 meta.path, self.model_cfg, dtype=pdtype, put=put
             )
-            # HF checkpoints are merged trees: restore fresh adapter leaves
-            # so params stay congruent with _param_labels/_tx (LoRA mode)
+            # HF checkpoints are merged trees without adapters or the vision
+            # tower: restore those subtrees so params stay congruent with
+            # _param_labels/_tx
             self._add_lora_adapters()
+            self._ensure_vision_tower()
             if vh is not None:
                 self.params["value_head"] = vh
         elif meta.weight_format == "orbax":

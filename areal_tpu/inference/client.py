@@ -142,10 +142,20 @@ class RemoteJaxEngine(InferenceEngine):
         stop_reason = StopReason.ABORT.value
         attempt_input = list(req.input_ids)
 
+        image_b64 = None
+        if req.image_data is not None:
+            import base64 as b64
+            import io
+
+            buf = io.BytesIO()
+            np.save(buf, np.asarray(req.image_data, np.float32))
+            image_b64 = b64.b64encode(buf.getvalue()).decode()
+
         while True:
             payload = {
                 "input_ids": attempt_input,
                 "rid": req.rid,
+                "image_data": image_b64,
                 "sampling_params": {
                     "max_new_tokens": remaining,
                     "greedy": g.greedy,

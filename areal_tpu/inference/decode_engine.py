@@ -198,6 +198,27 @@ class DecodeEngine:
             self.params, _ = load_params_from_hf(
                 cfg.model_path, self.model_cfg, put=put
             )
+            if self.model_cfg.vision is not None and "vision" not in self.params:
+                # HF tower name mapping pending (models/vision.py); serve a
+                # from-scratch tower rather than KeyError on the first image
+                logger.warning(
+                    "VLM serving: vision tower initializes from scratch"
+                )
+                from areal_tpu.models.vision import (
+                    init_vision_params,
+                    vision_partition_specs,
+                )
+
+                vshard = mesh_lib.param_sharding(
+                    self.mesh, vision_partition_specs()
+                )
+                with jax.set_mesh(self.mesh):
+                    self.params["vision"] = jax.jit(
+                        lambda k: init_vision_params(
+                            k, self.model_cfg.vision, dtype=self.model_cfg.jax_dtype
+                        ),
+                        out_shardings=vshard,
+                    )(jax.random.PRNGKey(0))
         else:
             assert self.model_cfg is not None
             self.param_shardings = mesh_lib.param_sharding(
@@ -492,16 +513,18 @@ class DecodeEngine:
         return self._version
 
     # -- jitted kernels ---------------------------------------------------
-    def _prefill_fn(self, n_prompts: int, bucket: int):
+    def _prefill_fn(self, n_prompts: int, bucket: int, with_images: bool = False):
         """Batched prefill: A prompts (padded to ``bucket``) in one forward,
         KV scattered into the A target slots. Amortises the full-parameter
         read across admits; no gather/merge — rows at/after each prompt's
-        last token are overwritten by decode before they become readable."""
-        key = ("prefill", n_prompts, bucket)
+        last token are overwritten by decode before they become readable.
+        ``with_images`` adds a positioned [A, bucket, D] vision-embed input
+        (VLM serving; embeds computed by _image_embeds_for at admission)."""
+        key = ("prefill", n_prompts, bucket, with_images)
         if key not in self._fn_cache:
             mcfg = self.model_cfg
 
-            def prefill(params, cache, ids, plens, slots):
+            def prefill(params, cache, ids, plens, slots, img=None):
                 # ids [A, bucket], plens [A], slots [A]
                 positions = jnp.broadcast_to(
                     jnp.arange(bucket, dtype=jnp.int32)[None], ids.shape
@@ -509,7 +532,9 @@ class DecodeEngine:
                 seg = (
                     jnp.arange(bucket, dtype=jnp.int32)[None] < plens[:, None]
                 ).astype(jnp.int32)
-                _, ks, vs = qwen.forward_prefill(params, mcfg, ids, positions, seg)
+                _, ks, vs = qwen.forward_prefill(
+                    params, mcfg, ids, positions, seg, image_embeds=img
+                )
                 # ks/vs: [n_layers, A, bucket, KH, hd]
                 for name, new in (("k", ks), ("v", vs)):
                     cache[name] = (
@@ -521,6 +546,48 @@ class DecodeEngine:
 
             self._fn_cache[key] = jax.jit(prefill, donate_argnames=("cache",))
         return self._fn_cache[key]
+
+    def _image_embeds_for(self, group: list[tuple[_Task, int]], ids_np, bucket: int):
+        """VLM admission: run the vision tower over each request's pixel
+        patches (ModelRequest.image_data: [P_i, patch_dim]) and position the
+        merged embeddings at the prompt's image-token slots. Returns
+        [A, bucket, D] fp32 or None when the group carries no images."""
+        mcfg = self.model_cfg
+        if mcfg.vision is None or not any(
+            t.req.image_data is not None for t, _ in group
+        ):
+            return None
+        from areal_tpu.models import vision as vis
+
+        merge2 = mcfg.vision.spatial_merge**2
+        emb = np.zeros((len(group), bucket, mcfg.hidden_size), np.float32)
+        for j, (task, _) in enumerate(group):
+            if task.req.image_data is None:
+                continue
+            px = np.asarray(task.req.image_data, np.float32)  # [P, pd]
+            P = px.shape[0]
+            # bucket the padded patch count: distinct image sizes must not
+            # each compile a fresh ViT (the mask handles the padding)
+            Ppad = -(-round_up_to_bucket(P, 256) // merge2) * merge2
+            key = ("vision", Ppad)
+            if key not in self._fn_cache:
+                vcfg = mcfg.vision
+                self._fn_cache[key] = jax.jit(
+                    lambda vp, x, m: vis.vision_forward(vp, vcfg, x, m)
+                )
+            px_pad = np.pad(px, ((0, Ppad - P), (0, 0)))
+            mask = np.arange(Ppad) < P
+            with jax.set_mesh(self.mesh):
+                out = np.asarray(
+                    self._fn_cache[key](
+                        self.params["vision"], jnp.asarray(px_pad), jnp.asarray(mask)
+                    ),
+                    np.float32,
+                )
+            pos = np.where(ids_np[j] == mcfg.image_token_id)[0]
+            n = min(len(pos), P // merge2)
+            emb[j, pos[:n]] = out[:n]
+        return emb
 
     def _chunk_fn(self, n_steps: int, window: int, capped: bool):
         """n_steps of decode for all slots in one jitted call.
@@ -800,14 +867,25 @@ class DecodeEngine:
             ids_np[j, : len(ids)] = ids
             plens[j] = len(ids)
             slots[j] = slot
+        img = self._image_embeds_for(group, ids_np, bucket)
         with jax.set_mesh(self.mesh):
-            self.cache = self._prefill_fn(A, bucket)(
-                self.params,
-                self.cache,
-                jnp.asarray(ids_np),
-                jnp.asarray(plens),
-                jnp.asarray(slots),
-            )
+            if img is None:
+                self.cache = self._prefill_fn(A, bucket)(
+                    self.params,
+                    self.cache,
+                    jnp.asarray(ids_np),
+                    jnp.asarray(plens),
+                    jnp.asarray(slots),
+                )
+            else:
+                self.cache = self._prefill_fn(A, bucket, with_images=True)(
+                    self.params,
+                    self.cache,
+                    jnp.asarray(ids_np),
+                    jnp.asarray(plens),
+                    jnp.asarray(slots),
+                    jnp.asarray(img),
+                )
         rows = []
         for j, (task, slot) in enumerate(group):
             P_len = int(plens[j])

@@ -37,8 +37,21 @@ def _req_from_json(d: dict) -> ModelRequest:
         stop_token_ids=g.get("stop_token_ids", []),
         max_tokens=g.get("max_tokens"),
     )
+    image_data = None
+    if d.get("image_data"):
+        # base64 fp32 patch array [P, patch_dim] (VLM serving; the reference
+        # ships base64 images to SGLang — here the processor runs client-side
+        # and the wire carries extracted patches)
+        import base64 as b64
+        import io
+
+        image_data = np.load(io.BytesIO(b64.b64decode(d["image_data"])))
     return ModelRequest(
-        input_ids=d["input_ids"], gconfig=gconfig, rid=d.get("rid", ""), metadata=d.get("metadata", {})
+        input_ids=d["input_ids"],
+        gconfig=gconfig,
+        rid=d.get("rid", ""),
+        metadata=d.get("metadata", {}),
+        image_data=image_data,
     )
 
 

@@ -73,6 +73,11 @@ class ModelConfig:
     lora_rank: int = 0
     lora_alpha: float = 16.0
     lora_targets: tuple = ("wq", "wk", "wv", "wo")
+    # VLM (reference VLM path fsdp_utils/parallel.py:217-365): when set, the
+    # params tree carries a "vision" subtree (models/vision.py tower) and
+    # forward() scatters image embeddings into <|image_pad|> positions
+    image_token_id: int = -1
+    vision: Any = None  # vision.VisionConfig | None
     router_aux_coef: float = 0.0  # load-balance aux loss weight
 
     @property
@@ -93,21 +98,47 @@ class ModelConfig:
 
     @classmethod
     def from_hf_dict(cls, d: dict[str, Any]) -> "ModelConfig":
-        """Build from an HF ``config.json`` dict (qwen2 / qwen3 model types)."""
+        """Build from an HF ``config.json`` dict (qwen2 / qwen3 model types,
+        plus qwen2-vl-style VLMs whose text fields may nest under
+        ``text_config``)."""
         mt = d.get("model_type", "qwen2")
+        td = {**d, **d.get("text_config", {})}
+        vision = None
+        image_token_id = d.get("image_token_id", -1)
+        if "vision_config" in d:
+            from areal_tpu.models.vision import VisionConfig
+
+            vd = d["vision_config"]
+            patch = vd.get("patch_size", 14)
+            vision = VisionConfig(
+                patch_dim=vd.get("in_channels", 3)
+                * vd.get("temporal_patch_size", 2)
+                * patch
+                * patch,
+                hidden_size=vd.get("embed_dim", vd.get("hidden_size", 1280)),
+                intermediate_size=vd.get(
+                    "intermediate_size", 4 * vd.get("embed_dim", 1280)
+                ),
+                num_layers=vd.get("depth", vd.get("num_hidden_layers", 32)),
+                num_heads=vd.get("num_heads", vd.get("num_attention_heads", 16)),
+                out_hidden_size=td["hidden_size"],
+                spatial_merge=vd.get("spatial_merge_size", 2),
+            )
         return cls(
-            vocab_size=d["vocab_size"],
-            hidden_size=d["hidden_size"],
-            intermediate_size=d["intermediate_size"],
-            num_layers=d["num_hidden_layers"],
-            num_heads=d["num_attention_heads"],
-            num_kv_heads=d.get("num_key_value_heads", d["num_attention_heads"]),
-            head_dim=d.get("head_dim"),
-            rope_theta=d.get("rope_theta", 1e6),
-            rms_norm_eps=d.get("rms_norm_eps", 1e-6),
-            tie_word_embeddings=d.get("tie_word_embeddings", False),
-            qk_norm=(mt == "qwen3"),
-            attention_bias=d.get("attention_bias", mt == "qwen2"),
+            vocab_size=td["vocab_size"],
+            hidden_size=td["hidden_size"],
+            intermediate_size=td["intermediate_size"],
+            num_layers=td["num_hidden_layers"],
+            num_heads=td["num_attention_heads"],
+            num_kv_heads=td.get("num_key_value_heads", td["num_attention_heads"]),
+            head_dim=td.get("head_dim"),
+            rope_theta=td.get("rope_theta", 1e6),
+            rms_norm_eps=td.get("rms_norm_eps", 1e-6),
+            tie_word_embeddings=td.get("tie_word_embeddings", False),
+            qk_norm=(mt.startswith("qwen3")),
+            attention_bias=td.get("attention_bias", mt.startswith("qwen2")),
+            image_token_id=image_token_id,
+            vision=vision,
         )
 
     @classmethod
@@ -257,6 +288,10 @@ def init_params(rng: jax.Array, cfg: ModelConfig, dtype=None) -> dict:
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = dense(next(keys), (cfg.vocab_size, cfg.hidden_size))
+    if cfg.vision is not None:
+        from areal_tpu.models.vision import init_vision_params
+
+        params["vision"] = init_vision_params(next(keys), cfg.vision, dtype)
     return params
 
 
@@ -305,6 +340,10 @@ def param_partition_specs(cfg: ModelConfig, fsdp_axis: str | None = "fsdp") -> d
     }
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P("model", f)
+    if cfg.vision is not None:
+        from areal_tpu.models.vision import vision_partition_specs
+
+        specs["vision"] = vision_partition_specs()
     return specs
 
 
@@ -460,9 +499,15 @@ def forward(
     attn_mask: jax.Array | None = None,  # [G, 1, L, L] override (tree training)
     with_aux: bool = False,  # also return the summed MoE router aux loss
     no_grad: bool = False,  # forward-only: use the leaner fwd flash kernel
+    image_embeds: jax.Array | None = None,  # [G, L, D] precomputed vision embeds
 ) -> jax.Array:
     """Decoder body -> final hidden states [G, L, D] (+ aux when asked)."""
     x = jnp.take(params["embed"], input_ids, axis=0).astype(cfg.jax_dtype)
+    if image_embeds is not None and cfg.image_token_id >= 0:
+        # VLM: <|image_pad|> positions take the vision tower's output
+        # (precomputed and positioned by the caller; models/vision.py)
+        img_pos = (input_ids == cfg.image_token_id)[..., None]
+        x = jnp.where(img_pos, image_embeds.astype(cfg.jax_dtype), x)
     x = _shard(x, P(BATCH_AXES, "seq", None))
     from areal_tpu.ops.attention import resolve_impl
 
@@ -641,6 +686,7 @@ def forward_prefill(
     input_ids: jax.Array,  # [A, P]
     positions: jax.Array,  # [A, P]
     seg: jax.Array | None = None,  # [A, P] 1=valid 0=pad; default all-valid
+    image_embeds: jax.Array | None = None,  # [A, P, D] VLM vision embeds
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Batched prompt pass: returns (hidden [A, P, D], k, v) where k/v are
     [n_layers, A, P, KH, hd] (post-rope, pre-GQA-repeat) for cache fill.
@@ -652,6 +698,9 @@ def forward_prefill(
     if seg is None:
         seg = jnp.ones_like(input_ids)
     x = jnp.take(params["embed"], input_ids, axis=0).astype(cfg.jax_dtype)
+    if image_embeds is not None and cfg.image_token_id >= 0:
+        img_pos = (input_ids == cfg.image_token_id)[..., None]
+        x = jnp.where(img_pos, image_embeds.astype(cfg.jax_dtype), x)
     mask = _attention_mask(seg)
     H, KH, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
 

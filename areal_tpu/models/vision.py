@@ -1,0 +1,145 @@
+"""Vision tower for VLM training/serving (reference VLM role:
+fsdp_utils/parallel.py:217-365 VLM special-casing + workflow/vision_rlvr.py).
+
+A compact Qwen2-VL-shaped ViT, TPU-first: pixel patches arrive pre-extracted
+by the HF processor as a flat [N_patches, patch_dim] array (patch_dim =
+channels·temporal·patch²), pass through pre-norm transformer blocks (full
+attention — MXU-friendly dense [N, N]), and a spatial merger MLP folds
+``merge**2`` neighboring patches into one LLM-space embedding. The LLM
+scatters those embeddings into its <|image_pad|> token positions
+(qwen.forward image_embeds path).
+
+Design choice (documented limitation): during RL the tower is FROZEN and
+embeddings are precomputed once per batch at the data boundary — the packed
+[G, L] training grids never carry pixel data, only the [*, D_llm] embed
+vectors as a per-token key. Reference VLM RL typically freezes the ViT too;
+tower finetuning would move the tower call inside the loss closure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    patch_dim: int = 1176  # 3 ch * 2 temporal * 14 * 14 (Qwen2-VL)
+    hidden_size: int = 1280
+    intermediate_size: int = 5120
+    num_layers: int = 32
+    num_heads: int = 16
+    out_hidden_size: int = 1536  # LLM hidden
+    spatial_merge: int = 2  # merge^2 patches -> 1 LLM token
+    rms_norm_eps: float = 1e-6
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def merge_dim(self) -> int:
+        return self.hidden_size * self.spatial_merge**2
+
+
+def init_vision_params(rng: jax.Array, cfg: VisionConfig, dtype=jnp.float32) -> dict:
+    keys = iter(jax.random.split(rng, 8))
+
+    def dense(key, shape):
+        return (
+            0.02 * jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+        ).astype(dtype)
+
+    n = cfg.num_layers
+    D, F, H = cfg.hidden_size, cfg.intermediate_size, cfg.num_heads
+    layers = {
+        "norm1": jnp.ones((n, D), dtype),
+        "norm2": jnp.ones((n, D), dtype),
+        "wqkv": dense(next(keys), (n, D, 3 * D)),
+        "bqkv": jnp.zeros((n, 3 * D), dtype),
+        "wo": dense(next(keys), (n, D, D)),
+        "w_fc1": dense(next(keys), (n, D, F)),
+        "b_fc1": jnp.zeros((n, F), dtype),
+        "w_fc2": dense(next(keys), (n, F, D)),
+        "b_fc2": jnp.zeros((n, D), dtype),
+    }
+    return {
+        "patch_embed": dense(next(keys), (cfg.patch_dim, D)),
+        "layers": layers,
+        "merger_norm": jnp.ones((D,), dtype),
+        "merger_fc1": dense(next(keys), (cfg.merge_dim, cfg.merge_dim)),
+        "merger_fc2": dense(next(keys), (cfg.merge_dim, cfg.out_hidden_size)),
+    }
+
+
+def vision_partition_specs() -> dict:
+    """FSDP-shard the big projections; small norms replicated."""
+    f = "fsdp"
+    return {
+        "patch_embed": P(f, None),
+        "layers": {
+            "norm1": P(None, None),
+            "norm2": P(None, None),
+            "wqkv": P(None, f, "model"),
+            "bqkv": P(None, "model"),
+            "wo": P(None, "model", f),
+            "w_fc1": P(None, f, "model"),
+            "b_fc1": P(None, "model"),
+            "w_fc2": P(None, "model", f),
+            "b_fc2": P(None, None),
+        },
+        "merger_norm": P(None),
+        "merger_fc1": P(f, None),
+        "merger_fc2": P(None, f),
+    }
+
+
+def _ln(x, w, eps):
+    m = x.mean(-1, keepdims=True)
+    v = ((x - m) ** 2).mean(-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + eps) * w
+
+
+def vision_forward(
+    params: dict,
+    cfg: VisionConfig,
+    pixel_values: jax.Array,  # [N_patches, patch_dim] (N divisible by merge^2)
+    patch_mask: jax.Array | None = None,  # [N_patches] bool; False = padding
+) -> jax.Array:
+    """-> [N_patches / merge^2, out_hidden] image embeddings."""
+    N = pixel_values.shape[0]
+    D, H, hd = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    assert N % cfg.spatial_merge**2 == 0, (N, cfg.spatial_merge)
+    x = pixel_values.astype(params["patch_embed"].dtype) @ params["patch_embed"]
+
+    if patch_mask is None:
+        attn_ok = None
+    else:
+        attn_ok = patch_mask[None, :] & patch_mask[:, None]  # [N, N]
+
+    def block(x, layer):
+        h = _ln(x, layer["norm1"], cfg.rms_norm_eps)
+        qkv = h @ layer["wqkv"] + layer["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(N, H, hd)
+        k = k.reshape(N, H, hd)
+        v = v.reshape(N, H, hd)
+        logits = jnp.einsum("qhd,khd->hqk", q, k).astype(jnp.float32) * hd**-0.5
+        if attn_ok is not None:
+            logits = jnp.where(attn_ok[None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        attn = jnp.einsum("hqk,khd->qhd", probs, v).reshape(N, D)
+        x = x + attn @ layer["wo"]
+        h = _ln(x, layer["norm2"], cfg.rms_norm_eps)
+        h = jax.nn.gelu(h @ layer["w_fc1"] + layer["b_fc1"])
+        x = x + h @ layer["w_fc2"] + layer["b_fc2"]
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["layers"])
+    x = _ln(x, params["merger_norm"], cfg.rms_norm_eps)
+    x = x.reshape(N // cfg.spatial_merge**2, cfg.merge_dim)
+    x = jax.nn.gelu(x @ params["merger_fc1"])
+    return x @ params["merger_fc2"]  # [N/merge^2, out_hidden]

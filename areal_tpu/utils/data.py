@@ -23,8 +23,16 @@ from areal_tpu.utils import datapack
 
 TensorDict = dict[str, Any]
 
-# Keys that are per-sequence scalars (not per-token) in trajectory dicts.
-_NON_TOKEN_KEYS = ("rewards", "task_ids", "begin_of_trajectory", "seq_no_eos_mask")
+# Keys that are per-sequence (not per-token) in trajectory dicts: scalars,
+# plus ragged per-sequence arrays with their OWN length axis (vision patches)
+_NON_TOKEN_KEYS = (
+    "rewards",
+    "task_ids",
+    "begin_of_trajectory",
+    "seq_no_eos_mask",
+    "pixel_values",
+    "pixel_counts",
+)
 
 
 def is_per_token(key: str) -> bool:
@@ -47,9 +55,12 @@ def pad_sequences_to_tensors(
         if vals[0].ndim == 0:
             out[key] = np.stack(vals)
             continue
+        # ragged per-sequence arrays (vision patches) pad to their OWN max
+        # length, not the token length
+        tgt = max_len if is_per_token(key) else max(v.shape[0] for v in vals)
         padded = []
         for v in vals:
-            pad_width = [(0, max_len - v.shape[0])] + [(0, 0)] * (v.ndim - 1)
+            pad_width = [(0, tgt - v.shape[0])] + [(0, 0)] * (v.ndim - 1)
             padded.append(np.pad(v, pad_width, constant_values=pad_value))
         out[key] = np.stack(padded)
     mask = np.zeros((len(trajs), max_len), dtype=np.bool_)
@@ -66,11 +77,26 @@ def concat_padded_tensor_dicts(dicts: Sequence[TensorDict]) -> TensorDict:
     out: TensorDict = {}
     for key in dicts[0]:
         vals = []
+        ragged_max = None
+        if not is_per_token(key) and np.asarray(dicts[0][key]).ndim >= 2:
+            ragged_max = max(np.asarray(d[key]).shape[1] for d in dicts)
         for d in dicts:
             v = np.asarray(d[key])
             own_len = d["attention_mask"].shape[1]
-            # per-token arrays share the dict's padded length; re-pad those
-            if v.ndim >= 2 and v.shape[1] == own_len and own_len != max_len:
+            if ragged_max is not None and v.shape[1] != ragged_max:
+                # ragged per-sequence arrays (vision patches) align to their
+                # own max, independent of the token length
+                pad_width = [(0, 0), (0, ragged_max - v.shape[1])] + [(0, 0)] * (
+                    v.ndim - 2
+                )
+                v = np.pad(v, pad_width)
+            elif (
+                is_per_token(key)
+                and v.ndim >= 2
+                and v.shape[1] == own_len
+                and own_len != max_len
+            ):
+                # per-token arrays share the dict's padded length; re-pad
                 pad_width = [(0, 0), (0, max_len - v.shape[1])] + [(0, 0)] * (
                     v.ndim - 2
                 )

@@ -1,0 +1,133 @@
+"""Vision RLVR workflow: image + prompt -> generate -> verifiable reward.
+
+Behavioral parity with reference areal/workflow/vision_rlvr.py:26-162: the HF
+processor turns the dataset row's images+messages into prompt token ids
+(containing <|image_pad|> runs) and pixel patches; generation carries the
+patches to the server (the JAX decode engine runs the vision tower at
+prefill — models/vision.py — where the reference relies on a VLM-enabled
+SGLang); the emitted trajectory keeps ``pixel_values`` so the trainer
+recomputes multimodal logprobs.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable
+
+import numpy as np
+
+from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+from areal_tpu.api.reward_api import AsyncRewardWrapper
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.utils import stats_tracker
+
+
+class VisionRLVRWorkflow(RolloutWorkflow):
+    def __init__(
+        self,
+        reward_fn: Callable,
+        gconfig: GenerationHyperparameters,
+        tokenizer: Any,
+        processor: Any,
+        enable_thinking: bool = False,
+        use_process_pool_reward: bool = False,
+    ):
+        self.reward_fn = AsyncRewardWrapper(
+            reward_fn, use_process_pool=use_process_pool_reward
+        )
+        self.gconfig = gconfig
+        self.tokenizer = tokenizer
+        self.processor = processor
+        self.enable_thinking = enable_thinking
+
+    def _process(self, data: dict) -> tuple[list[int], np.ndarray]:
+        """-> (prompt token ids incl. image pads, pixel patches [P, pd]).
+
+        HF multimodal processors take rendered TEXT with vision placeholders
+        (the chat template inserts <|vision_start|><|image_pad|>... runs) —
+        not raw message dicts; render first when the processor can."""
+        messages = data["messages"]
+        if hasattr(self.processor, "apply_chat_template"):
+            text = self.processor.apply_chat_template(
+                messages, add_generation_prompt=True, tokenize=False
+            )
+        else:
+            text = messages
+        out = self.processor(
+            images=data["images"],
+            text=text,
+            padding=False,
+            return_tensors="np",
+        )
+        input_ids = np.asarray(out["input_ids"]).reshape(-1).tolist()
+        pixel_values = np.asarray(out["pixel_values"], np.float32)
+        if pixel_values.ndim == 3:  # [1, P, pd]
+            pixel_values = pixel_values[0]
+        return input_ids, pixel_values
+
+    async def _one_sample(self, engine, prompt_ids, pixel_values, data):
+        from areal_tpu.utils import perf_tracer
+
+        req = ModelRequest(
+            rid=uuid.uuid4().hex,
+            input_ids=prompt_ids,
+            image_data=pixel_values,
+            gconfig=self.gconfig.new(n_samples=1),
+        )
+        with perf_tracer.get_session_tracer().phase("generate"):
+            resp = await engine.agenerate(req)
+        prompt_str = self.tokenizer.decode(prompt_ids)
+        completion_str = self.tokenizer.decode(resp.output_tokens)
+        with perf_tracer.get_session_tracer().phase("reward"):
+            reward = await self.reward_fn(
+                prompt_str,
+                completion_str,
+                prompt_ids,
+                resp.output_tokens,
+                **{
+                    k: v
+                    for k, v in data.items()
+                    if k not in ("messages", "images", "prompt")
+                },
+            )
+        p, o = len(prompt_ids), len(resp.output_tokens)
+        stats_tracker.get().scalar(reward=float(reward), gen_tokens=float(o))
+        return {
+            "input_ids": np.asarray(prompt_ids + resp.output_tokens, np.int32),
+            "loss_mask": np.concatenate(
+                [np.zeros(p, np.float32), np.ones(o, np.float32)]
+            ),
+            "logprobs": np.concatenate(
+                [
+                    np.zeros(p, np.float32),
+                    np.asarray(resp.output_logprobs, np.float32),
+                ]
+            ),
+            "versions": np.concatenate(
+                [
+                    np.full(p, -1, np.int32),
+                    np.asarray(resp.output_versions, np.int32),
+                ]
+            ),
+            "rewards": np.float32(reward),
+            # trainer-side multimodality: _attach_image_embeds consumes
+            # these (reference multi_modal_input)
+            "pixel_values": pixel_values,
+            "pixel_counts": np.int32(pixel_values.shape[0]),
+            "seq_no_eos_mask": np.bool_(resp.stop_reason == "length"),
+        }
+
+    async def arun_episode(self, engine, data: dict):
+        import asyncio
+
+        prompt_ids, pixel_values = self._process(data)
+        # GRPO group: n_samples completions of the same prompt (same fan-out
+        # as RLVRWorkflow; group_reward_norm depends on it)
+        return list(
+            await asyncio.gather(
+                *[
+                    self._one_sample(engine, prompt_ids, pixel_values, data)
+                    for _ in range(self.gconfig.n_samples)
+                ]
+            )
+        )

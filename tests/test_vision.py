@@ -1,0 +1,231 @@
+"""VLM path tests: vision tower, multimodal forward, trainer integration,
+decode-engine image prefill, ragged pixel batching (reference
+workflow/vision_rlvr.py + VLM handling role)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from areal_tpu.models import qwen
+from areal_tpu.models.vision import (
+    VisionConfig,
+    init_vision_params,
+    vision_forward,
+)
+
+VCFG = VisionConfig(
+    patch_dim=48,
+    hidden_size=32,
+    intermediate_size=64,
+    num_layers=2,
+    num_heads=4,
+    out_hidden_size=64,
+    spatial_merge=2,
+)
+
+MODEL_KW = dict(
+    vocab_size=128,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    dtype="float32",
+    image_token_id=9,
+    vision=VCFG,
+)
+
+
+def test_tower_shapes_and_mask():
+    params = init_vision_params(jax.random.PRNGKey(0), VCFG)
+    px = jax.random.normal(jax.random.PRNGKey(1), (16, 48))
+    out = vision_forward(params, VCFG, px)
+    assert out.shape == (4, 64)  # 16 patches / merge^2 -> 4 embeds
+    # masked (padding) patches must not change the valid embeddings
+    px_pad = jnp.concatenate([px, jnp.full((8, 48), 123.0)])
+    mask = jnp.arange(24) < 16
+    out_pad = vision_forward(params, VCFG, px_pad, mask)
+    np.testing.assert_allclose(
+        np.asarray(out_pad[:4]), np.asarray(out), atol=1e-5
+    )
+
+
+def test_forward_image_scatter():
+    mc = qwen.ModelConfig(**MODEL_KW)
+    params = qwen.init_params(jax.random.PRNGKey(0), mc)
+    ids = jnp.asarray([[1, 9, 9, 2, 3, 4, 5, 6]], jnp.int32)
+    seg = jnp.ones_like(ids)
+    pos = jnp.broadcast_to(jnp.arange(8), (1, 8)).astype(jnp.int32)
+    emb = jnp.zeros((1, 8, 64), jnp.float32)
+    h0 = qwen.forward(params, mc, ids, seg, pos, image_embeds=emb)
+    emb2 = emb.at[0, 1].set(1.0).at[0, 2].set(-1.0)
+    h1 = qwen.forward(params, mc, ids, seg, pos, image_embeds=emb2)
+    # image positions and everything after must differ; position 0 must not
+    assert not np.allclose(np.asarray(h0[0, 1]), np.asarray(h1[0, 1]))
+    np.testing.assert_allclose(np.asarray(h0[0, 0]), np.asarray(h1[0, 0]), atol=1e-6)
+
+
+def _vlm_engine():
+    from areal_tpu.api.config import (
+        MeshConfig,
+        MicroBatchSpec,
+        OptimizerConfig,
+        TrainEngineConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.train_engine import JaxTrainEngine
+
+    cfg = TrainEngineConfig(
+        init_from_scratch=True,
+        dtype="float32",
+        param_dtype="float32",
+        gradient_checkpointing=False,
+        mesh=MeshConfig(data=1, fsdp=4, seq=1, model=2, expert=1),
+        optimizer=OptimizerConfig(lr=5e-3, lr_scheduler_type="constant"),
+        mb_spec=MicroBatchSpec(),
+    )
+    eng = JaxTrainEngine(cfg, model_config=qwen.ModelConfig(**MODEL_KW))
+    eng.initialize(FinetuneSpec(1, 64, 4))
+    return eng
+
+
+def test_vlm_train_batch():
+    eng = _vlm_engine()
+    rng = np.random.default_rng(0)
+    B, L, P = 4, 16, 8  # P patches -> P/4 = 2 image tokens per row
+    ids = rng.integers(10, 128, (B, L)).astype(np.int32)
+    ids[:, 2:4] = 9  # image pad tokens
+    batch = {
+        "input_ids": ids,
+        "attention_mask": np.ones((B, L), np.int64),
+        "loss_mask": np.ones((B, L), np.float32),
+        "pixel_values": rng.normal(0, 1, (B, P, 48)).astype(np.float32),
+        "pixel_counts": np.full(B, P, np.int32),
+    }
+
+    def loss(outputs, b):
+        lm = (b["label_valid"] & (b["loss_mask"] > 0)).astype(jnp.float32)
+        return -(outputs["logprobs"] * lm).sum() / jnp.maximum(lm.sum(), 1), {}
+
+    wf = lambda d: float(len(np.asarray(d["input_ids"]))) or 1.0  # noqa: E731
+    s1 = eng.train_batch(dict(batch), loss, wf)
+    s2 = eng.train_batch(dict(batch), loss, wf)
+    s3 = eng.train_batch(dict(batch), loss, wf)
+    assert s3["loss"] < s2["loss"]
+    # changing the image changes the logprobs (the embeds actually matter)
+    lp1 = eng.forward_batch(dict(batch))
+    batch2 = dict(batch)
+    batch2["pixel_values"] = batch["pixel_values"] + 3.0
+    lp2 = eng.forward_batch(batch2)
+    assert not np.allclose(lp1, lp2)
+
+
+def test_decode_engine_image_prefill(monkeypatch):
+    from areal_tpu.api.config import MeshConfig, ServerConfig
+    from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+    from areal_tpu.inference.decode_engine import DecodeEngine
+
+    mc = qwen.ModelConfig(**MODEL_KW)
+    params = qwen.init_params(jax.random.PRNGKey(0), mc)
+    scfg = ServerConfig(
+        max_batch_size=4,
+        max_seq_len=64,
+        decode_steps_per_call=4,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+    )
+    eng = DecodeEngine(scfg, params=params, model_cfg=mc)
+    eng.initialize()
+
+    # spy on the host-side embed builder (a random-init model's greedy
+    # output is saturated, so end-to-end token comparison is blind;
+    # numerical propagation itself is covered by test_forward_image_scatter)
+    captured = []
+    real_embeds = DecodeEngine._image_embeds_for
+
+    def spy(self, group, ids_np, bucket):
+        emb = real_embeds(self, group, ids_np, bucket)
+        captured.append(None if emb is None else np.asarray(emb))
+        return emb
+
+    monkeypatch.setattr(DecodeEngine, "_image_embeds_for", spy)
+    eng.start()
+    try:
+        rng = np.random.default_rng(0)
+        px = rng.normal(0, 1, (8, 48)).astype(np.float32)
+        ids = [1, 9, 9, 2, 3]
+        g = GenerationHyperparameters(max_new_tokens=6, greedy=True)
+        r1 = eng.generate_sync(
+            ModelRequest(input_ids=ids, gconfig=g, image_data=px), timeout=300
+        )
+        assert len(r1.output_tokens) == 6
+        (emb,) = captured
+        assert emb is not None
+        # 8 patches / merge^2 -> 2 embeddings at the two image-pad positions
+        assert np.abs(emb[0, 1]).max() > 0 and np.abs(emb[0, 2]).max() > 0
+        assert np.abs(emb[0, 0]).max() == 0 and np.abs(emb[0, 3:]).max() == 0
+        # a plain text request prefises without embeds
+        captured.clear()
+        eng.generate_sync(
+            ModelRequest(input_ids=[1, 2, 3], gconfig=g), timeout=300
+        )
+        assert captured == [None]
+    finally:
+        eng.stop()
+
+
+def test_ragged_pixel_batching():
+    from areal_tpu.utils.data import (
+        concat_padded_tensor_dicts,
+        pad_sequences_to_tensors,
+    )
+
+    t1 = {
+        "input_ids": np.arange(5),
+        "pixel_values": np.ones((8, 48), np.float32),
+        "pixel_counts": np.int32(8),
+        "rewards": np.float32(1.0),
+    }
+    t2 = {
+        "input_ids": np.arange(9),
+        "pixel_values": np.ones((4, 48), np.float32),
+        "pixel_counts": np.int32(4),
+        "rewards": np.float32(0.0),
+    }
+    b = pad_sequences_to_tensors([t1, t2])
+    assert b["pixel_values"].shape == (2, 8, 48)
+    assert b["input_ids"].shape == (2, 9)
+    b2 = pad_sequences_to_tensors([dict(t1, pixel_values=np.ones((12, 48), np.float32), pixel_counts=np.int32(12))])
+    merged = concat_padded_tensor_dicts([b, b2])
+    assert merged["pixel_values"].shape == (3, 12, 48)
+    assert merged["input_ids"].shape == (3, 9)
+
+
+def test_vlm_hf_config_parsing(tmp_path):
+    import json
+
+    cfg = {
+        "model_type": "qwen2_vl",
+        "vocab_size": 1000,
+        "hidden_size": 64,
+        "intermediate_size": 128,
+        "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "image_token_id": 151655,
+        "vision_config": {
+            "embed_dim": 32,
+            "depth": 2,
+            "num_heads": 4,
+            "patch_size": 14,
+            "spatial_merge_size": 2,
+            "in_channels": 3,
+            "temporal_patch_size": 2,
+        },
+    }
+    (tmp_path / "config.json").write_text(json.dumps(cfg))
+    mc = qwen.ModelConfig.from_hf_path(str(tmp_path))
+    assert mc.image_token_id == 151655
+    assert mc.vision is not None
+    assert mc.vision.patch_dim == 3 * 2 * 14 * 14
+    assert mc.vision.out_hidden_size == 64
