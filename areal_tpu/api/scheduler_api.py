@@ -64,18 +64,47 @@ class Scheduler(abc.ABC):
     def set_worker_env(self, role: str, env: dict[str, str]) -> None:
         """Extra env for future workers of this role."""
 
-    @abc.abstractmethod
+    # engine RPC: every scheduler places the SAME RpcWorkerServer, so these
+    # concrete defaults ride its HTTP surface regardless of how the worker
+    # was placed (subprocess / Ray actor / sbatch task)
     def create_engine(
         self, worker: Worker, engine_path: str, *args: Any, **kwargs: Any
     ) -> None:
         """Dynamically import `engine_path` on the worker and construct it
         (reference rpc_server.py:508-613)."""
+        from areal_tpu.infra.rpc.serialization import encode_value
+        from areal_tpu.utils.network import http_json as _http_json
 
-    @abc.abstractmethod
+        d = _http_json(
+            f"http://{worker.address}/create_engine",
+            {
+                "name": "engine",
+                "path": engine_path,
+                "args": [encode_value(a) for a in args],
+                "kwargs": {k: encode_value(v) for k, v in kwargs.items()},
+            },
+        )
+        assert d["status"] == "ok", d
+
     def call_engine(
         self, worker: Worker, method: str, *args: Any, **kwargs: Any
     ) -> Any:
         """Blocking engine method call on one worker."""
+        from areal_tpu.infra.rpc.serialization import decode_value, encode_value
+        from areal_tpu.utils.network import http_json as _http_json
+
+        d = _http_json(
+            f"http://{worker.address}/call",
+            {
+                "name": "engine",
+                "method": method,
+                "args": [encode_value(a) for a in args],
+                "kwargs": {k: encode_value(v) for k, v in kwargs.items()},
+            },
+        )
+        if d["status"] != "ok":
+            raise RuntimeError(f"{worker.id}.{method}: {d.get('error')}")
+        return decode_value(d["result"])
 
     def call_all(self, workers: list[Worker], method: str, *args, **kwargs) -> list[Any]:
         """Fan a call out to several workers, collecting results in order.

@@ -442,7 +442,17 @@ class JaxTrainEngine(TrainEngine):
         embeds = np.zeros((B, ids.shape[1], mcfg.hidden_size), np.float32)
         for b in range(B):
             pos = np.where(ids[b] == mcfg.image_token_id)[0]
-            n = min(len(pos), int(counts[b]) // merge2)
+            n_emb = int(counts[b]) // merge2
+            if len(pos) != n_emb:
+                # silent truncation here means training on corrupted inputs
+                # (wrong spatial_merge, processor/tokenizer skew, truncated
+                # image-pad runs) — make the misconfiguration loud
+                logger.warning(
+                    f"VLM mismatch row {b}: {len(pos)} image-pad tokens vs "
+                    f"{n_emb} merged patch embeddings; extra positions keep "
+                    "the pad-token text embedding"
+                )
+            n = min(len(pos), n_emb)
             embeds[b, pos[:n]] = out[b, :n]
         input_["image_embeds"] = embeds
         self._image_embed_memo = (memo_key, embeds)

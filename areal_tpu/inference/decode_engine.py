@@ -585,6 +585,11 @@ class DecodeEngine:
                     np.float32,
                 )
             pos = np.where(ids_np[j] == mcfg.image_token_id)[0]
+            if len(pos) != P // merge2:
+                logger.warning(
+                    f"VLM mismatch rid={task.req.rid}: {len(pos)} image-pad "
+                    f"tokens vs {P // merge2} merged patch embeddings"
+                )
             n = min(len(pos), P // merge2)
             emb[j, pos[:n]] = out[:n]
         return emb

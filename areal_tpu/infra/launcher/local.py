@@ -111,6 +111,9 @@ class LocalLauncher:
             env = dict(os.environ)
             if not self.server_on_tpu:
                 env = _scrub_tpu(env)
+            from areal_tpu.utils.network import ensure_pkg_on_pythonpath
+
+            ensure_pkg_on_pythonpath(env)
             log_path = os.path.join(self.log_dir, f"server-{i}.log")
             logf = open(log_path, "ab")
             proc = subprocess.Popen(

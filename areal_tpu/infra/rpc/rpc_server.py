@@ -95,6 +95,7 @@ class RpcWorkerServer:
                 web.post("/call", self.h_call),
                 web.post("/shard/put", self.h_shard_put),
                 web.get("/shard/get", self.h_shard_get),
+                web.post("/shard/delete", self.h_shard_delete),
                 web.post("/shard/clear", self.h_shard_clear),
                 web.post("/kill", self.h_kill),
             ]
@@ -163,6 +164,11 @@ class RpcWorkerServer:
             )
         return web.json_response({"status": "ok", "data": self.shards[key]})
 
+    async def h_shard_delete(self, request: web.Request) -> web.Response:
+        d = await request.json()
+        self.shards.pop(d["key"], None)
+        return web.json_response({"status": "ok"})
+
     async def h_shard_clear(self, request: web.Request) -> web.Response:
         self.shards.clear()
         return web.json_response({"status": "ok"})
@@ -201,7 +207,14 @@ def main(argv=None) -> None:
     if args.name:
         from areal_tpu.utils import name_resolve
 
-        name_resolve.add(args.name, server.address, keepalive_ttl=None)
+        # register a REACHABLE address: 0.0.0.0 must become this node's
+        # real IP or multi-node controllers would dial themselves
+        ip = (
+            network.gethostip()
+            if args.host in ("0.0.0.0", "")
+            else args.host
+        )
+        name_resolve.add(args.name, f"{ip}:{server.port}", keepalive_ttl=None)
     asyncio.run(server.arun())
 
 

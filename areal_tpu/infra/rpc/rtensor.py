@@ -1,0 +1,149 @@
+"""RTensor: remote handles for sharded rollout batches.
+
+Reference: areal/infra/rpc/rtensor.py:20-701. In single-controller mode the
+controller must dispatch batch slices to DP-head workers without hauling
+every tensor through its own process: trajectories stay ON the workers'
+shard stores (rpc_server /shard/*), and only lightweight handles — shard
+key, sequence lengths, owning address — travel through RPC. Consumers fetch
+shards directly from the owning worker, and a seqlen-balanced repartition
+maps producer shards onto consumer workers (reference balanced repartition
+via datapack)."""
+
+from __future__ import annotations
+
+import dataclasses
+import uuid
+from typing import Any
+
+import numpy as np
+
+from areal_tpu.infra.rpc.serialization import decode_value, encode_value
+from areal_tpu.utils import logging as alog, network
+from areal_tpu.utils.data import TensorDict, concat_padded_tensor_dicts, seqlens_of
+from areal_tpu.utils.datapack import balanced_greedy_partition
+
+logger = alog.getLogger("rtensor")
+
+
+_http_json = network.http_json
+
+
+@dataclasses.dataclass
+class TensorShardInfo:
+    """One stored shard: where it lives and how big it is."""
+
+    key: str
+    node_addr: str  # host:port of the owning rpc worker
+    size: int  # number of sequences
+    seqlens: list[int]
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TensorShardInfo":
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class RTensor:
+    """Handle to a batch scattered across worker shard stores."""
+
+    shards: list[TensorShardInfo] = dataclasses.field(default_factory=list)
+
+    # -- store/fetch ------------------------------------------------------
+    @classmethod
+    def store(
+        cls, batch: TensorDict, node_addr: str, key: str | None = None
+    ) -> "RTensor":
+        """Put one padded batch into ``node_addr``'s shard store."""
+        key = key or f"rt-{uuid.uuid4().hex}"
+        lens = [int(x) for x in seqlens_of(batch)]
+        _http_json(
+            f"http://{node_addr}/shard/put",
+            {"key": key, "data": encode_value(dict(batch))},
+        )
+        return cls(
+            shards=[
+                TensorShardInfo(
+                    key=key, node_addr=node_addr, size=len(lens), seqlens=lens
+                )
+            ]
+        )
+
+    @staticmethod
+    def _fetch_shard(info: TensorShardInfo) -> TensorDict:
+        d = _http_json(f"http://{info.node_addr}/shard/get?key={info.key}")
+        assert d["status"] == "ok", d
+        return decode_value(d["data"])
+
+    def fetch(self) -> TensorDict:
+        """Gather every shard into one padded batch (controller-side)."""
+        assert self.shards, "empty RTensor"
+        return concat_padded_tensor_dicts(
+            [self._fetch_shard(s) for s in self.shards]
+        )
+
+    def delete(self) -> None:
+        """Drop ONLY this handle's shards (other batches may share the
+        worker's store — /shard/clear would wipe them too)."""
+        for s in self.shards:
+            try:
+                _http_json(f"http://{s.node_addr}/shard/delete", {"key": s.key})
+            except Exception:  # noqa: BLE001 — worker may be gone
+                logger.warning(f"shard delete failed on {s.node_addr}")
+
+    # -- metadata ---------------------------------------------------------
+    @property
+    def size(self) -> int:
+        return sum(s.size for s in self.shards)
+
+    @property
+    def seqlens(self) -> list[int]:
+        return [n for s in self.shards for n in s.seqlens]
+
+    def to_dict(self) -> dict:
+        return {"shards": [s.to_dict() for s in self.shards]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RTensor":
+        return cls(shards=[TensorShardInfo.from_dict(s) for s in d["shards"]])
+
+    # -- repartition ------------------------------------------------------
+    def repartition(self, n_consumers: int) -> list["RTensor"]:
+        """Split the handle into ``n_consumers`` seqlen-balanced sub-handles
+        WITHOUT moving data: each consumer fetches whole shards (reference
+        rtensor repartition; token balance via balanced_greedy_partition).
+        Sub-batch granularity is the shard, so producers should store one
+        shard per trajectory batch for best balance."""
+        assert self.shards
+        weights = [sum(s.seqlens) for s in self.shards]
+        if len(self.shards) < n_consumers:
+            # fewer shards than consumers: split the largest shards by
+            # fetching and re-storing is the producers' job; here we assign
+            # round-robin so every consumer gets at most one shard
+            groups = [[i] for i in range(len(self.shards))]
+            groups += [[] for _ in range(n_consumers - len(groups))]
+        else:
+            groups = balanced_greedy_partition(weights, n_consumers)
+        return [
+            RTensor(shards=[self.shards[i] for i in grp]) for grp in groups
+        ]
+
+
+def scatter_batch(
+    batch: TensorDict, node_addrs: list[str], key_prefix: str | None = None
+) -> RTensor:
+    """Controller-side scatter: seqlen-balance ``batch`` rows across worker
+    shard stores and return the combined handle."""
+    lens = [int(x) for x in seqlens_of(batch)]
+    groups = balanced_greedy_partition(lens, len(node_addrs))
+    prefix = key_prefix or f"rt-{uuid.uuid4().hex[:12]}"
+    shards: list[TensorShardInfo] = []
+    for rank, (addr, rows) in enumerate(zip(node_addrs, groups)):
+        if not rows:
+            continue
+        sub = {k: np.asarray(v)[rows] for k, v in batch.items()}
+        handle = RTensor.store(sub, addr, key=f"{prefix}-{rank}")
+        shards.extend(handle.shards)
+    return RTensor(shards=shards)

@@ -22,7 +22,6 @@ import urllib.request
 from dataclasses import dataclass, field
 
 from areal_tpu.api.scheduler_api import Job, Scheduler, Worker
-from areal_tpu.infra.rpc.serialization import decode_value, encode_value
 from areal_tpu.utils import logging as alog, network
 
 logger = alog.getLogger("local_scheduler")
@@ -36,28 +35,8 @@ class _Proc:
     job: Job = field(default=None)  # type: ignore[assignment]
 
 
-def _http_json(
-    url: str, payload: dict | None = None, timeout: float = 3600.0
-) -> dict:
-    if payload is None:
-        req = urllib.request.Request(url)
-    else:
-        req = urllib.request.Request(
-            url,
-            data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
-            method="POST",
-        )
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as r:
-            return json.loads(r.read())
-    except urllib.error.HTTPError as e:
-        # rpc_server ships structured errors in non-2xx JSON bodies
-        body = e.read()
-        try:
-            return json.loads(body)
-        except Exception:  # noqa: BLE001
-            raise e from None
+# control-plane JSON RPC (shared helper; rpc_server ships structured errors)
+_http_json = network.http_json
 
 
 class LocalScheduler(Scheduler):
@@ -93,6 +72,7 @@ class LocalScheduler(Scheduler):
             env = dict(os.environ)
             env.update(self._role_env.get(job.role, {}))
             env.update(job.env)
+            network.ensure_pkg_on_pythonpath(env)
             if job.tpus <= 0:
                 # CPU-pin auxiliary workers: scrub the TPU-tunnel gate vars
                 # (see __graft_entry__.py round-2 fix) and force cpu jax
@@ -203,29 +183,3 @@ class LocalScheduler(Scheduler):
     def set_worker_env(self, role: str, env: dict[str, str]) -> None:
         self._role_env.setdefault(role, {}).update(env)
 
-    # -- engine RPC -------------------------------------------------------
-    def create_engine(self, worker: Worker, engine_path: str, *args, **kwargs) -> None:
-        d = _http_json(
-            f"http://{worker.address}/create_engine",
-            {
-                "name": "engine",
-                "path": engine_path,
-                "args": [encode_value(a) for a in args],
-                "kwargs": {k: encode_value(v) for k, v in kwargs.items()},
-            },
-        )
-        assert d["status"] == "ok", d
-
-    def call_engine(self, worker: Worker, method: str, *args, **kwargs):
-        d = _http_json(
-            f"http://{worker.address}/call",
-            {
-                "name": "engine",
-                "method": method,
-                "args": [encode_value(a) for a in args],
-                "kwargs": {k: encode_value(v) for k, v in kwargs.items()},
-            },
-        )
-        if d["status"] != "ok":
-            raise RuntimeError(f"{worker.id}.{method}: {d.get('error')}")
-        return decode_value(d["result"])

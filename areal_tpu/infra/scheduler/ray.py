@@ -1,0 +1,115 @@
+"""RayScheduler: the Scheduler contract over Ray actors.
+
+Reference: areal/infra/scheduler/ray.py:55-762 (placement groups with
+PACK/colocation strategies, actor fork support). TPU shape: each worker is a
+Ray actor that runs the same RpcWorkerServer the LocalScheduler spawns as a
+subprocess — the engine-RPC surface is identical, so controllers don't know
+which scheduler placed them. Ray is optional in the image; importing this
+module without ray raises only when the scheduler is constructed.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from areal_tpu.api.scheduler_api import Job, Scheduler, Worker
+from areal_tpu.infra.scheduler.local import _http_json
+
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("ray_scheduler")
+
+class _RayRpcWorker:
+    """Actor body: runs the standard RpcWorkerServer on its node. Defined as
+    a plain class (ray symbols only appear inside methods, so the module
+    imports fine without ray); wrapped with ray.remote at scheduler init."""
+
+    def __init__(self, port: int = 0):
+        from areal_tpu.infra.rpc.rpc_server import RpcWorkerServer
+
+        self.server = RpcWorkerServer(port=port)
+
+    async def start(self) -> str:
+        await self.server.astart()
+        import ray.util
+
+        ip = ray.util.get_node_ip_address()
+        return f"{ip}:{self.server.port}"
+
+    async def stop(self) -> None:
+        await self.server.astop()
+
+
+class RayScheduler(Scheduler):
+    def __init__(self, start_timeout: float = 300.0, ray_init_kwargs: dict | None = None):
+        try:
+            import ray  # noqa: F401
+        except ImportError as e:  # pragma: no cover - ray not in TPU image
+            raise RuntimeError(
+                "RayScheduler requires the `ray` package (not in the base "
+                "TPU image); use LocalScheduler or SlurmScheduler"
+            ) from e
+        import ray
+
+        self._ray = ray
+        if not ray.is_initialized():
+            ray.init(**(ray_init_kwargs or {}))
+        self.start_timeout = start_timeout
+        self._actors: dict[str, list[tuple[Worker, Any]]] = {}
+        self._role_env: dict[str, dict[str, str]] = {}
+        self._worker_cls = ray.remote(_RayRpcWorker)
+
+    def create_workers(self, job: Job) -> list[Worker]:
+        assert job.role not in self._actors, f"role {job.role} exists"
+        ray = self._ray
+        env = dict(self._role_env.get(job.role, {}))
+        env.update(job.env)
+        opts: dict[str, Any] = {
+            "num_cpus": max(1, job.cpus),
+            "runtime_env": {"env_vars": {k: str(v) for k, v in env.items()}},
+        }
+        if job.tpus > 0:
+            opts["resources"] = {"TPU": job.tpus}
+        entries: list[tuple[Worker, Any]] = []
+        handles = []
+        for i in range(job.replicas):
+            actor = self._worker_cls.options(
+                name=f"{job.role}-{i}", **opts
+            ).remote()
+            handles.append((i, actor, actor.start.remote()))
+        for i, actor, ref in handles:
+            addr = ray.get(ref, timeout=self.start_timeout)
+            ip, port = addr.rsplit(":", 1)
+            worker = Worker(
+                id=f"{job.role}-{i}", role=job.role, ip=ip, ports=[int(port)]
+            )
+            entries.append((worker, actor))
+        self._actors[job.role] = entries
+        return [w for w, _ in entries]
+
+    def get_workers(self, role: str) -> list[Worker]:
+        return [w for w, _ in self._actors.get(role, [])]
+
+    def check_health(self, role: str) -> None:
+        deadline = time.monotonic() + 5.0
+        for worker, _ in self._actors.get(role, []):
+            try:
+                d = _http_json(f"http://{worker.address}/health", timeout=max(1.0, deadline - time.monotonic()))
+                assert d.get("status") == "ok"
+            except Exception as e:  # noqa: BLE001
+                raise RuntimeError(f"worker {worker.id} unhealthy: {e}") from e
+
+    def delete_workers(self, role: str | None = None) -> None:
+        roles = [role] if role else list(self._actors)
+        for r in roles:
+            for worker, actor in self._actors.pop(r, []):
+                try:
+                    self._ray.get(actor.stop.remote(), timeout=10)
+                except Exception:  # noqa: BLE001
+                    pass
+                self._ray.kill(actor, no_restart=True)
+
+    def set_worker_env(self, role: str, env: dict[str, str]) -> None:
+        self._role_env.setdefault(role, {}).update(env)
+

@@ -1,0 +1,172 @@
+"""SlurmScheduler: the Scheduler contract over sbatch job arrays.
+
+Reference: areal/infra/scheduler/slurm.py:67-1634 (generated sbatch scripts,
+squeue state polling, worker network discovery via name_resolve, colocation
+node mapping). TPU shape: each array task runs the standard RpcWorkerServer
+and registers ``{ns_prefix}/{role}/{task_id} -> ip:port`` in the file/NFS
+name_resolve tree (shared filesystem is a Slurm given); the controller polls
+that tree instead of parsing node lists. Engine RPC then rides the same HTTP
+surface as every other scheduler. Requires the ``sbatch``/``squeue``/
+``scancel`` binaries — construction fails fast without them.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import time
+import uuid
+
+from areal_tpu.api.scheduler_api import Job, Scheduler, Worker
+from areal_tpu.infra.scheduler.local import _http_json
+
+from areal_tpu.utils import logging as alog, name_resolve
+
+logger = alog.getLogger("slurm_scheduler")
+
+_SBATCH_TEMPLATE = """#!/bin/bash
+#SBATCH --job-name={job_name}
+#SBATCH --array=0-{max_task}
+#SBATCH --ntasks=1
+#SBATCH --cpus-per-task={cpus}
+#SBATCH --mem={mem_gb}G
+#SBATCH --output={log_dir}/{role}-%a.log
+{extra_directives}
+export AREAL_NAME_RESOLVE=file
+export AREAL_NAME_RESOLVE_ROOT={ns_root}
+{env_exports}
+exec python -m areal_tpu.infra.rpc.rpc_server \\
+    --name {ns_prefix}/{role}/$SLURM_ARRAY_TASK_ID
+"""
+
+_FINISHED_STATES = {"COMPLETED", "FAILED", "CANCELLED", "TIMEOUT", "NODE_FAIL", "OUT_OF_MEMORY"}
+
+
+class SlurmScheduler(Scheduler):
+    def __init__(
+        self,
+        log_dir: str = "/tmp/areal_tpu/slurm",
+        ns_root: str | None = None,
+        start_timeout: float = 600.0,
+        tpu_directive: str = "",  # site-specific, e.g. "#SBATCH --gres=tpu:4"
+    ):
+        for binary in ("sbatch", "squeue", "scancel"):
+            if shutil.which(binary) is None:
+                raise RuntimeError(
+                    f"SlurmScheduler requires {binary!r} on PATH; use "
+                    "LocalScheduler on a single host"
+                )
+        self.log_dir = log_dir
+        self.ns_root = ns_root or os.path.join(log_dir, "name_resolve")
+        self.start_timeout = start_timeout
+        self.tpu_directive = tpu_directive
+        self.ns_prefix = f"slurm-{uuid.uuid4().hex[:8]}"
+        self._jobs: dict[str, tuple[str, list[Worker]]] = {}  # role -> (jobid, workers)
+        self._role_env: dict[str, dict[str, str]] = {}
+        os.makedirs(log_dir, exist_ok=True)
+        name_resolve.reconfigure("file", root=self.ns_root)
+
+    def _render_script(self, job: Job) -> str:
+        env = dict(self._role_env.get(job.role, {}))
+        env.update(job.env)
+        extra = self.tpu_directive if job.tpus > 0 else ""
+        return _SBATCH_TEMPLATE.format(
+            job_name=f"areal-{job.role}",
+            max_task=job.replicas - 1,
+            cpus=max(1, job.cpus),
+            mem_gb=max(1, job.mem_gb),
+            log_dir=self.log_dir,
+            role=job.role,
+            extra_directives=extra,
+            ns_root=self.ns_root,
+            ns_prefix=self.ns_prefix,
+            env_exports="\n".join(
+                f"export {k}={v!s}" for k, v in sorted(env.items())
+            ),
+        )
+
+    def create_workers(self, job: Job) -> list[Worker]:
+        assert job.role not in self._jobs, f"role {job.role} exists"
+        script = os.path.join(self.log_dir, f"{job.role}.sbatch")
+        with open(script, "w") as f:
+            f.write(self._render_script(job))
+        out = subprocess.run(
+            ["sbatch", "--parsable", script],
+            capture_output=True,
+            text=True,
+            check=True,
+        )
+        job_id = out.stdout.strip().split(";")[0]
+        logger.info(f"submitted {job.role} as slurm job {job_id}")
+        prefix = f"{self.ns_prefix}/{job.role}"
+        deadline = time.monotonic() + self.start_timeout
+        workers: list[Worker] = []
+        while True:
+            addrs = name_resolve.get_subtree(prefix)
+            if len(addrs) >= job.replicas:
+                break
+            state = self._job_state(job_id)
+            if state in _FINISHED_STATES:
+                raise RuntimeError(
+                    f"slurm job {job_id} ({job.role}) reached state {state} "
+                    f"before all workers registered ({len(addrs)}/{job.replicas})"
+                )
+            if time.monotonic() > deadline:
+                subprocess.run(["scancel", job_id], check=False)
+                name_resolve.clear_subtree(prefix)  # drop partial entries
+                raise TimeoutError(
+                    f"slurm workers for {job.role} not registered after "
+                    f"{self.start_timeout}s ({len(addrs)}/{job.replicas})"
+                )
+            time.sleep(2.0)
+        for i, addr in enumerate(sorted(addrs)):
+            ip, port = addr.rsplit(":", 1)
+            workers.append(
+                Worker(id=f"{job.role}-{i}", role=job.role, ip=ip, ports=[int(port)])
+            )
+        self._jobs[job.role] = (job_id, workers)
+        return workers
+
+    def _job_state(self, job_id: str) -> str:
+        out = subprocess.run(
+            ["squeue", "-j", job_id, "-h", "-o", "%T"],
+            capture_output=True,
+            text=True,
+            check=False,
+        )
+        states = {s.strip() for s in out.stdout.splitlines() if s.strip()}
+        if not states:
+            return "COMPLETED"  # gone from the queue
+        return sorted(states)[0]
+
+    def get_workers(self, role: str) -> list[Worker]:
+        return self._jobs.get(role, ("", []))[1]
+
+    def check_health(self, role: str) -> None:
+        job_id, workers = self._jobs.get(role, ("", []))
+        if not job_id:
+            return
+        state = self._job_state(job_id)
+        if state in _FINISHED_STATES:
+            raise RuntimeError(f"slurm job {job_id} ({role}) is {state}")
+        for w in workers:
+            try:
+                d = _http_json(f"http://{w.address}/health", timeout=5)
+                assert d.get("status") == "ok"
+            except Exception as e:  # noqa: BLE001
+                raise RuntimeError(f"worker {w.id} unhealthy: {e}") from e
+
+    def delete_workers(self, role: str | None = None) -> None:
+        roles = [role] if role else list(self._jobs)
+        for r in roles:
+            job_id, _ = self._jobs.pop(r, ("", []))
+            if job_id:
+                subprocess.run(["scancel", job_id], check=False)
+            # registrations never expire (keepalive_ttl=None) — clear them,
+            # or a re-created role would instantly "discover" dead workers
+            name_resolve.clear_subtree(f"{self.ns_prefix}/{r}")
+
+    def set_worker_env(self, role: str, env: dict[str, str]) -> None:
+        self._role_env.setdefault(role, {}).update(env)
+

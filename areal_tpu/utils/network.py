@@ -38,3 +38,47 @@ def gethostip() -> str:
 
 def gethostname() -> str:
     return socket.gethostname()
+
+
+def http_json(url: str, payload=None, timeout: float = 3600.0) -> dict:
+    """Tiny dependency-free JSON-over-HTTP helper (control-plane RPC).
+    GET when payload is None, POST otherwise; non-2xx responses with JSON
+    bodies are returned as dicts (rpc_server ships structured errors)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    if payload is None:
+        req = urllib.request.Request(url)
+    else:
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            return json.loads(body)
+        except Exception:  # noqa: BLE001
+            raise e from None
+
+
+def ensure_pkg_on_pythonpath(env: dict) -> dict:
+    """Child processes must import areal_tpu regardless of the caller's cwd
+    (the package may run from a source tree, not an installed wheel)."""
+    import os
+
+    import areal_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(areal_tpu.__file__))
+    env["PYTHONPATH"] = (
+        pkg_root + os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH")
+        else pkg_root
+    )
+    return env

@@ -1,0 +1,98 @@
+"""Scale-out layer: RTensor handles over real rpc-worker shard stores,
+scheduler engine-RPC defaults, slurm script rendering, worker liveness
+(reference rtensor.py:20-701, scheduler/slurm.py, scheduler health polls)."""
+
+import shutil
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.scheduler_api import Job
+from areal_tpu.infra.rpc.rtensor import RTensor, scatter_batch
+from areal_tpu.infra.scheduler.local import LocalScheduler
+
+
+@pytest.fixture(scope="module")
+def workers():
+    sched = LocalScheduler(start_timeout=60)
+    ws = sched.create_workers(Job(role="store", replicas=2, tpus=0))
+    yield sched, ws
+    sched.delete_workers()
+
+
+def _batch(lens):
+    L = max(lens)
+    B = len(lens)
+    mask = np.zeros((B, L), np.int64)
+    for i, n in enumerate(lens):
+        mask[i, :n] = 1
+    return {
+        "input_ids": np.arange(B * L).reshape(B, L).astype(np.int32),
+        "attention_mask": mask,
+        "rewards": np.arange(B, dtype=np.float32),
+    }
+
+
+def test_rtensor_store_fetch_roundtrip(workers):
+    _, ws = workers
+    batch = _batch([4, 7, 3])
+    rt = RTensor.store(batch, ws[0].address)
+    assert rt.size == 3 and rt.seqlens == [4, 7, 3]
+    # handles serialize for RPC transport
+    rt2 = RTensor.from_dict(rt.to_dict())
+    out = rt2.fetch()
+    np.testing.assert_array_equal(out["input_ids"], batch["input_ids"])
+    np.testing.assert_array_equal(out["rewards"], batch["rewards"])
+
+
+def test_rtensor_scatter_and_repartition(workers):
+    _, ws = workers
+    batch = _batch([8, 2, 6, 4])
+    rt = scatter_batch(batch, [w.address for w in ws])
+    assert rt.size == 4
+    assert len(rt.shards) == 2  # one shard per worker
+    # token-balanced: |(8+2) - (6+4)| == 0 for these lengths
+    loads = sorted(sum(s.seqlens) for s in rt.shards)
+    assert loads == [10, 10]
+    parts = rt.repartition(2)
+    assert sum(p.size for p in parts) == 4
+    merged = RTensor(shards=[s for p in parts for s in p.shards]).fetch()
+    assert set(np.asarray(merged["rewards"]).tolist()) == {0.0, 1.0, 2.0, 3.0}
+
+
+def test_scheduler_engine_rpc_defaults(workers):
+    sched, ws = workers
+    # create_engine/call_engine now live on the ABC: drive them through the
+    # same worker the RTensor tests used
+    sched.create_engine(ws[1], "areal_tpu.infra.rpc.echo_engine.EchoEngine")
+    out = sched.call_engine(ws[1], "double", np.arange(3))
+    np.testing.assert_array_equal(out, np.arange(3) * 2)
+    sched.check_health("store")  # liveness poll passes while alive
+
+
+def test_slurm_script_rendering(tmp_path):
+    if shutil.which("sbatch") is None:
+        # env-gated constructor: verify the fail-fast, then render via an
+        # uninitialized instance (template is a pure function of Job)
+        from areal_tpu.infra.scheduler.slurm import SlurmScheduler
+
+        with pytest.raises(RuntimeError, match="sbatch"):
+            SlurmScheduler(log_dir=str(tmp_path))
+        sched = SlurmScheduler.__new__(SlurmScheduler)
+        sched.log_dir = str(tmp_path)
+        sched.ns_root = str(tmp_path / "ns")
+        sched.ns_prefix = "slurm-test"
+        sched.tpu_directive = "#SBATCH --gres=tpu:4"
+        sched._role_env = {"trainer": {"A": "1"}}
+        script = sched._render_script(
+            Job(role="trainer", replicas=4, cpus=8, mem_gb=32, tpus=4, env={"B": "2"})
+        )
+        assert "#SBATCH --array=0-3" in script
+        assert "#SBATCH --cpus-per-task=8" in script
+        assert "--gres=tpu:4" in script
+        assert "export A=1" in script and "export B=2" in script
+        assert "slurm-test/trainer/$SLURM_ARRAY_TASK_ID" in script
+
+
+def test_ray_scheduler_gated():
+    pytest.importorskip("ray", reason="ray not in the TPU image")
