@@ -214,7 +214,11 @@ def main(argv=None) -> None:
             if args.host in ("0.0.0.0", "")
             else args.host
         )
-        name_resolve.add(args.name, f"{ip}:{server.port}", keepalive_ttl=None)
+        # replace=True: a restarted/requeued worker (slurm NODE_FAIL requeue)
+        # must overwrite its stale registration, not crash on it
+        name_resolve.add(
+            args.name, f"{ip}:{server.port}", replace=True, keepalive_ttl=None
+        )
     asyncio.run(server.arun())
 
 

@@ -59,10 +59,11 @@ class RTensor:
         """Put one padded batch into ``node_addr``'s shard store."""
         key = key or f"rt-{uuid.uuid4().hex}"
         lens = [int(x) for x in seqlens_of(batch)]
-        _http_json(
+        d = _http_json(
             f"http://{node_addr}/shard/put",
             {"key": key, "data": encode_value(dict(batch))},
         )
+        assert d.get("status") == "ok", f"shard put failed on {node_addr}: {d}"
         return cls(
             shards=[
                 TensorShardInfo(
@@ -77,12 +78,27 @@ class RTensor:
         assert d["status"] == "ok", d
         return decode_value(d["data"])
 
+    @property
+    def is_empty(self) -> bool:
+        return not self.shards
+
     def fetch(self) -> TensorDict:
-        """Gather every shard into one padded batch (controller-side)."""
-        assert self.shards, "empty RTensor"
-        return concat_padded_tensor_dicts(
-            [self._fetch_shard(s) for s in self.shards]
-        )
+        """Gather every shard into one padded batch, fetching from the
+        owning workers concurrently (one HTTP round-trip wall-clock)."""
+        if not self.shards:
+            raise ValueError(
+                "RTensor has no shards — repartition() had fewer producer "
+                "shards than consumers; check handle.is_empty before fetch()"
+            )
+        if len(self.shards) == 1:
+            return self._fetch_shard(self.shards[0])
+        import concurrent.futures
+
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=len(self.shards)
+        ) as pool:
+            parts = list(pool.map(self._fetch_shard, self.shards))
+        return concat_padded_tensor_dicts(parts)
 
     def delete(self) -> None:
         """Drop ONLY this handle's shards (other batches may share the

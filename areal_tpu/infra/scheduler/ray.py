@@ -14,7 +14,7 @@ import time
 from typing import Any
 
 from areal_tpu.api.scheduler_api import Job, Scheduler, Worker
-from areal_tpu.infra.scheduler.local import _http_json
+from areal_tpu.utils.network import http_json as _http_json
 
 from areal_tpu.utils import logging as alog
 

@@ -13,13 +13,14 @@ surface as every other scheduler. Requires the ``sbatch``/``squeue``/
 from __future__ import annotations
 
 import os
+import shlex
 import shutil
 import subprocess
 import time
 import uuid
 
 from areal_tpu.api.scheduler_api import Job, Scheduler, Worker
-from areal_tpu.infra.scheduler.local import _http_json
+from areal_tpu.utils.network import http_json as _http_json
 
 from areal_tpu.utils import logging as alog, name_resolve
 
@@ -82,7 +83,8 @@ class SlurmScheduler(Scheduler):
             ns_root=self.ns_root,
             ns_prefix=self.ns_prefix,
             env_exports="\n".join(
-                f"export {k}={v!s}" for k, v in sorted(env.items())
+                f"export {k}={shlex.quote(str(v))}"
+                for k, v in sorted(env.items())
             ),
         )
 
@@ -135,6 +137,11 @@ class SlurmScheduler(Scheduler):
             text=True,
             check=False,
         )
+        if out.returncode != 0:
+            # transient slurmctld outage must not read as COMPLETED (which
+            # would abort a healthy run); report unknown and let callers poll
+            logger.warning(f"squeue failed rc={out.returncode}: {out.stderr.strip()}")
+            return "UNKNOWN"
         states = {s.strip() for s in out.stdout.splitlines() if s.strip()}
         if not states:
             return "COMPLETED"  # gone from the queue

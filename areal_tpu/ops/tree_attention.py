@@ -1,0 +1,234 @@
+"""Tree attention phase 2: Pallas block-sparse ancestor-bitmask kernel.
+
+Reference: areal/models/tree_attn/triton_kernel.py (1,037 LoC) — the
+reference's main custom kernel. Packed trie nodes attend only their root
+path; the mask is shipped as PACKED BITS (32 nodes per uint32 word, vs the
+reference's 64-bit words — TPU lanes are 32-bit) and expanded in-register
+inside the kernel, and whole [BQ, BK] tiles with no ancestor relation are
+skipped via a host-computed block map — attention FLOPs and mask memory
+scale with the trie's structure instead of N².
+
+Because the trie is built parent-before-child (models/tree.py build_tree),
+ancestors satisfy j <= i: everything above the block diagonal is skipped
+for free, and deep-branching tries skip most sub-diagonal tiles too.
+
+Forward-only (the no-grad hot paths: tree logprob recompute / scoring);
+training uses the dense-mask XLA path (models/tree.py phase 1). Off-TPU the
+kernel runs in Pallas interpret mode so CPU tests exercise the real code.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK = 128  # q/k tile edge
+WORD = 32  # mask bits per uint32
+
+
+def pack_ancestor_bits(
+    parent: np.ndarray, n_pad: int | None = None, block: int = BLOCK
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side: parent pointers -> (mask_words [Npad, Npad/32] uint32,
+    block_any [nB, nB] int32).
+
+    mask_words[i] has bit j set iff j is an ancestor of i (or i itself);
+    block_any[bi, bj] = 1 iff ANY (i, j) pair in that tile is set — the
+    kernel skips tiles where it is 0."""
+    N = len(parent)
+    n_pad = n_pad or -(-N // block) * block
+    assert n_pad % block == 0 and n_pad >= N
+    W = n_pad // WORD
+    words = np.zeros((n_pad, W), np.uint32)
+    for i in range(N):
+        p = int(parent[i])
+        if p >= 0:
+            words[i] = words[p]
+        words[i, i // WORD] |= np.uint32(1) << np.uint32(i % WORD)
+    nB = n_pad // block
+    block_any = np.zeros((nB, nB), np.int32)
+    wpb = block // WORD  # words per block column
+    for bi in range(nB):
+        rows = words[bi * block : (bi + 1) * block]
+        for bj in range(nB):
+            if rows[:, bj * wpb : (bj + 1) * wpb].any():
+                block_any[bi, bj] = 1
+    return words, block_any
+
+
+def _tree_attn_kernel(
+    block_any_ref,  # [1, 1] int32 — this tile's skip predicate
+    q_ref,  # [1, BQ, d]
+    k_ref,  # [1, BK, d]
+    v_ref,  # [1, BK, d]
+    words_ref,  # [BQ, BK // WORD] uint32 — this tile's mask words
+    o_ref,  # [1, BQ, d]
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    scale: float,
+    block: int,
+):
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -1e30)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(block_any_ref[0, 0] > 0)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        logits = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )  # [BQ, BK]
+        # expand packed bits -> [BQ, BK] bool: word w, bit b -> column w*32+b.
+        # Formulated without 3-D reshapes (layout-hostile in Mosaic): each
+        # word broadcasts across its 32 columns, then a per-column logical
+        # shift selects the bit.
+        words = words_ref[...].astype(jnp.int32)  # [BQ, BK//WORD]
+        expanded = jnp.concatenate(
+            [
+                jnp.broadcast_to(words[:, i : i + 1], (block, WORD))
+                for i in range(block // WORD)
+            ],
+            axis=1,
+        )  # [BQ, BK]
+        col_bit = (
+            jax.lax.broadcasted_iota(jnp.int32, (block, block), 1) % WORD
+        )
+        mask = (jax.lax.shift_right_logical(expanded, col_bit) & 1) > 0
+        logits = jnp.where(mask, logits, -1e30)
+
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_blk = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(logits - m_new)
+        p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == pl.num_programs(2) - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def tree_attention(
+    q: jax.Array,  # [N, H, d] (N padded to BLOCK)
+    k: jax.Array,
+    v: jax.Array,
+    mask_words: jax.Array,  # [N, N // 32] uint32
+    block_any: jax.Array,  # [nB, nB] int32
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Block-sparse ancestor-masked attention -> [N, H, d]."""
+    N, H, d = q.shape
+    assert N % BLOCK == 0, (N, BLOCK)
+    nB = N // BLOCK
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    qt, kt, vt = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
+    kernel = functools.partial(
+        _tree_attn_kernel, scale=d**-0.5, block=BLOCK
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(H, nB, nB),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda h, iq, ik: (iq, ik)),
+            pl.BlockSpec((1, BLOCK, d), lambda h, iq, ik: (h, iq, 0)),
+            pl.BlockSpec((1, BLOCK, d), lambda h, iq, ik: (h, ik, 0)),
+            pl.BlockSpec((1, BLOCK, d), lambda h, iq, ik: (h, ik, 0)),
+            pl.BlockSpec(
+                (BLOCK, BLOCK // WORD), lambda h, iq, ik: (iq, ik)
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK, d), lambda h, iq, ik: (h, iq, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK, 128), jnp.float32),
+            pltpu.VMEM((BLOCK, 128), jnp.float32),
+            pltpu.VMEM((BLOCK, d), jnp.float32),
+        ],
+        out_shape=jax.ShapeDtypeStruct((H, N, d), q.dtype),
+        interpret=interpret,
+    )(block_any, qt, kt, vt, mask_words)
+    return jnp.transpose(out, (1, 0, 2))
+
+
+def tree_forward_logprobs_pallas(params, cfg, pack):
+    """Phase-2 tree scoring: the packed-trie forward with the block-sparse
+    kernel in every layer (no-grad path; training uses the dense phase-1
+    path). Returns node_logp [N] like tree.tree_forward_logprobs."""
+    from areal_tpu.models import qwen
+    from areal_tpu.models.tree import edge_logprob_index, non_root_nodes
+
+    N = pack.n_nodes
+    n_pad = -(-N // BLOCK) * BLOCK
+    words_np, block_any_np = pack_ancestor_bits(pack.parent, n_pad)
+    ids = np.zeros(n_pad, np.int32)
+    ids[:N] = pack.tokens
+    pos = np.zeros(n_pad, np.int32)
+    pos[:N] = pack.depth
+
+    mcfg = cfg
+    H, KH, hd = mcfg.num_heads, mcfg.num_kv_heads, mcfg.head_dim_
+    x = jnp.take(params["embed"], jnp.asarray(ids), axis=0).astype(mcfg.jax_dtype)
+    words = jnp.asarray(words_np)
+    block_any = jnp.asarray(block_any_np)
+    positions = jnp.asarray(pos)[None]
+
+    def layer_fn(x, layer):
+        h = qwen._rms_norm(x, layer["input_norm"], mcfg.rms_norm_eps)
+        q = qwen._proj(mcfg, layer, "wq", h)
+        k = qwen._proj(mcfg, layer, "wk", h)
+        v = qwen._proj(mcfg, layer, "wv", h)
+        if mcfg.attention_bias:
+            q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+        q = q.reshape(n_pad, H, hd)
+        k = k.reshape(n_pad, KH, hd)
+        v = v.reshape(n_pad, KH, hd)
+        if mcfg.qk_norm:
+            q = qwen._rms_norm(q, layer["q_norm"], mcfg.rms_norm_eps)
+            k = qwen._rms_norm(k, layer["k_norm"], mcfg.rms_norm_eps)
+        q = qwen._rope(q[None], positions, mcfg.rope_theta)[0]
+        k = qwen._rope(k[None], positions, mcfg.rope_theta)[0]
+        if KH != H:
+            k = jnp.repeat(k, H // KH, axis=1)
+            v = jnp.repeat(v, H // KH, axis=1)
+        attn = tree_attention(q, k, v, words, block_any)
+        x = x + attn.reshape(n_pad, H * hd) @ layer["wo"]
+        h = qwen._rms_norm(x, layer["post_attn_norm"], mcfg.rms_norm_eps)
+        ff = jax.nn.silu(qwen._proj(mcfg, layer, "w_gate", h)) * qwen._proj(
+            mcfg, layer, "w_up", h
+        )
+        return x + qwen._proj(mcfg, layer, "w_down", ff), None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    hidden = qwen._rms_norm(x, params["final_norm"], mcfg.rms_norm_eps)
+    logits = qwen.compute_logits(params, mcfg, hidden[None])[0]
+    logp_all = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    rows, toks = edge_logprob_index(pack)
+    edge_logp = logp_all[jnp.asarray(rows), jnp.asarray(toks)]
+    node_logp = jnp.zeros(N, jnp.float32)
+    return node_logp.at[jnp.asarray(non_root_nodes(pack))].set(edge_logp)

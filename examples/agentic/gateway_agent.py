@@ -1,0 +1,28 @@
+"""Example: train ANY external agent by pointing it at the proxy gateway
+(reference experimental/openai "replace base_url and train", examples/openclaw).
+
+The RL system starts sessions through the gateway admin API; the agent is an
+unmodified OpenAI-SDK program whose base_url/api_key come from the session.
+Sketch (aiohttp used here since the openai package is not in the TPU image —
+any OpenAI SDK works identically against these endpoints):
+
+    # RL side -------------------------------------------------------------
+    async with http.post(f"{GATEWAY}/rl/start_session",
+                         json={"task_id": "math-001"},
+                         headers={"Authorization": f"Bearer {ADMIN_KEY}"}) as r:
+        sess = await r.json()       # {session_id, api_key, base_url}
+
+    # agent side (unmodified agent code) ----------------------------------
+    # client = AsyncOpenAI(base_url=sess["base_url"] + "/v1",
+    #                      api_key=sess["api_key"])
+    # ... agent runs, gateway records every completion ...
+
+    # RL side: reward + export --------------------------------------------
+    await http.post(f"{GATEWAY}/rl/set_reward", json={"reward": 1.0},
+                    headers={"Authorization": f"Bearer {sess['api_key']}"})
+    await http.post(f"{GATEWAY}/rl/end_session", json={},
+                    headers={"Authorization": f"Bearer {sess['api_key']}"})
+    traj = await http.post(f"{PROXY}/export_trajectories",
+                           json={"session_id": sess["session_id"]},
+                           headers={"Authorization": f"Bearer {ADMIN_KEY}"})
+"""

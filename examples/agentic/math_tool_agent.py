@@ -1,0 +1,72 @@
+"""Example agent: multi-turn math solver with a calculator tool, trained via
+OpenAIAgentWorkflow (reference workflow/openai* SDK example agents).
+
+The agent function below is ordinary OpenAI-SDK-style code: it sees ONLY an
+OpenAI-compatible client. Run it through the rollout pipeline with:
+
+    workflow = OpenAIAgentWorkflow(math_tool_agent, tokenizer)
+    trainer.train(workflow=workflow)
+"""
+
+from __future__ import annotations
+
+import json
+
+CALC_TOOL = {
+    "type": "function",
+    "function": {
+        "name": "calculator",
+        "description": "Evaluate a basic arithmetic expression.",
+        "parameters": {
+            "type": "object",
+            "properties": {"expression": {"type": "string"}},
+            "required": ["expression"],
+        },
+    },
+}
+
+
+def _calculator(expression: str) -> str:
+    try:
+        allowed = set("0123456789+-*/(). ")
+        if not set(expression) <= allowed:
+            return "error: unsupported characters"
+        return str(eval(expression, {"__builtins__": {}}))  # noqa: S307
+    except Exception as e:  # noqa: BLE001
+        return f"error: {e}"
+
+
+async def math_tool_agent(client, data: dict) -> float | None:
+    """Up to 4 turns: model may call the calculator; reward = exact answer
+    match. Returns the final reward (assigned to the last completion; use
+    client.apply_reward_discount upstream for per-turn credit)."""
+    messages = [
+        {
+            "role": "system",
+            "content": "Solve the problem. Use the calculator tool for "
+            "arithmetic. End with 'Answer: <number>'.",
+        },
+        {"role": "user", "content": data["question"]},
+    ]
+    final_text = ""
+    for _ in range(4):
+        completion = await client.chat.completions.create(
+            messages=messages,
+            tools=[CALC_TOOL],
+            max_completion_tokens=256,
+            temperature=1.0,
+        )
+        msg = completion.choices[0].message
+        messages.append(msg.to_dict())
+        if not msg.tool_calls:
+            final_text = msg.content or ""
+            break
+        for call in msg.tool_calls:
+            args = json.loads(call.function.arguments)
+            result = _calculator(args.get("expression", ""))
+            messages.append(
+                {"role": "tool", "tool_call_id": call.id, "content": result}
+            )
+    expected = str(data.get("answer", "")).strip()
+    got = final_text.rsplit("Answer:", 1)[-1].strip().rstrip(".")
+    return 1.0 if expected and got == expected else 0.0

@@ -10,6 +10,17 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# scrub the TPU-tunnel gate vars BEFORE importing jax: the axon sitecustomize
+# registers its PJRT plugin in every process when these are set, and that
+# registration can wedge `import jax` while another process holds the tunnel
+# (verify skill gotcha); also keeps test subprocesses off the tunnel
+for _var in (
+    "PALLAS_AXON_POOL_IPS",
+    "PALLAS_AXON_REMOTE_COMPILE",
+    "AXON_LOOPBACK_RELAY",
+    "AXON_POOL_SVC_OVERRIDE",
+):
+    os.environ.pop(_var, None)
 
 import jax  # noqa: E402
 

@@ -289,3 +289,52 @@ async def _proxy_gateway_flow():
 
 def test_proxy_gateway_http(loop):
     loop.run_until_complete(_proxy_gateway_flow())
+
+
+def test_math_tool_agent_example(loop):
+    """The shipped example agent drives tool calls end-to-end against a
+    scripted engine (SDK-example-agent coverage, reference workflow/openai*)."""
+    import importlib.util
+    import sys
+
+    spec = importlib.util.spec_from_file_location(
+        "math_tool_agent_example", "examples/agentic/math_tool_agent.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    class ToolTok(FakeTokenizer):
+        """First turn decodes to a calculator call; second to the answer."""
+
+        def __init__(self):
+            self.turn = 0
+
+        def decode(self, ids):
+            if len(ids) == 2:
+                return (
+                    '<tool_call>\n{"name": "calculator", '
+                    '"arguments": {"expression": "6*7"}}\n</tool_call>'
+                )
+            return "Answer: 42"
+
+    class ScriptedEngine(EchoEngine):
+        async def agenerate(self, req):
+            self.requests.append(req)
+            out = [1, 2] if len(self.requests) == 1 else [3, 4, 5]
+            return ModelResponse(
+                input_tokens=list(req.input_ids),
+                output_tokens=out,
+                output_logprobs=[-0.1] * len(out),
+                output_versions=[0] * len(out),
+                stop_reason="stop",
+                rid=req.rid,
+            )
+
+    from areal_tpu.workflow.openai_agent import OpenAIAgentWorkflow
+
+    wf = OpenAIAgentWorkflow(mod.math_tool_agent, ToolTok())
+    rows = loop.run_until_complete(
+        wf.arun_episode(ScriptedEngine(), {"question": "6*7?", "answer": "42"})
+    )
+    assert len(rows) == 2  # both turns recorded
+    assert rows[-1]["rewards"] == pytest.approx(1.0)

@@ -71,27 +71,36 @@ def test_scheduler_engine_rpc_defaults(workers):
 
 
 def test_slurm_script_rendering(tmp_path):
-    if shutil.which("sbatch") is None:
-        # env-gated constructor: verify the fail-fast, then render via an
-        # uninitialized instance (template is a pure function of Job)
-        from areal_tpu.infra.scheduler.slurm import SlurmScheduler
+    from areal_tpu.infra.scheduler.slurm import SlurmScheduler
 
+    if shutil.which("sbatch") is None:
+        # env-gated constructor: verify the fail-fast
         with pytest.raises(RuntimeError, match="sbatch"):
             SlurmScheduler(log_dir=str(tmp_path))
-        sched = SlurmScheduler.__new__(SlurmScheduler)
-        sched.log_dir = str(tmp_path)
-        sched.ns_root = str(tmp_path / "ns")
-        sched.ns_prefix = "slurm-test"
-        sched.tpu_directive = "#SBATCH --gres=tpu:4"
-        sched._role_env = {"trainer": {"A": "1"}}
-        script = sched._render_script(
-            Job(role="trainer", replicas=4, cpus=8, mem_gb=32, tpus=4, env={"B": "2"})
+    # template rendering is a pure function of Job — test it regardless of
+    # whether slurm binaries exist on this host
+    sched = SlurmScheduler.__new__(SlurmScheduler)
+    sched.log_dir = str(tmp_path)
+    sched.ns_root = str(tmp_path / "ns")
+    sched.ns_prefix = "slurm-test"
+    sched.tpu_directive = "#SBATCH --gres=tpu:4"
+    sched._role_env = {"trainer": {"A": "1"}}
+    script = sched._render_script(
+        Job(
+            role="trainer",
+            replicas=4,
+            cpus=8,
+            mem_gb=32,
+            tpus=4,
+            env={"B": "2", "XLA_FLAGS": "--a=1 --b=2"},
         )
-        assert "#SBATCH --array=0-3" in script
-        assert "#SBATCH --cpus-per-task=8" in script
-        assert "--gres=tpu:4" in script
-        assert "export A=1" in script and "export B=2" in script
-        assert "slurm-test/trainer/$SLURM_ARRAY_TASK_ID" in script
+    )
+    assert "#SBATCH --array=0-3" in script
+    assert "#SBATCH --cpus-per-task=8" in script
+    assert "--gres=tpu:4" in script
+    assert "export A=1" in script and "export B=2" in script
+    assert "export XLA_FLAGS='--a=1 --b=2'" in script  # metachars quoted
+    assert "slurm-test/trainer/$SLURM_ARRAY_TASK_ID" in script
 
 
 def test_ray_scheduler_gated():
