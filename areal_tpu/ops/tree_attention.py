@@ -219,6 +219,8 @@ def tree_forward_logprobs_pallas(params, cfg, pack):
         attn = tree_attention(q, k, v, words, block_any)
         x = x + attn.reshape(n_pad, H * hd) @ layer["wo"]
         h = qwen._rms_norm(x, layer["post_attn_norm"], mcfg.rms_norm_eps)
+        if mcfg.num_experts > 0:
+            return x + qwen._ffn(mcfg, h, layer), None  # MoE dispatch
         ff = jax.nn.silu(qwen._proj(mcfg, layer, "w_gate", h)) * qwen._proj(
             mcfg, layer, "w_up", h
         )

@@ -27,9 +27,14 @@ CALC_TOOL = {
 
 
 def _calculator(expression: str) -> str:
+    """Arithmetic only — model output is adversarial during RL, so beyond
+    the charset check we must also reject '**' (a power tower like 9**9**9
+    would hang/OOM the rollout worker) and cap expression length."""
     try:
         allowed = set("0123456789+-*/(). ")
-        if not set(expression) <= allowed:
+        if len(expression) > 200:
+            return "error: expression too long"
+        if not set(expression) <= allowed or "**" in expression:
             return "error: unsupported characters"
         return str(eval(expression, {"__builtins__": {}}))  # noqa: S307
     except Exception as e:  # noqa: BLE001
