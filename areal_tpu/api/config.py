@@ -205,6 +205,10 @@ class ServerConfig:
     # recompute KV under the new weights on every resume (reference re-prefill
     # behavior).
     kv_reuse_across_updates: bool = True
+    # compile-warm every jitted serving variant (prefill sizes x prompt
+    # buckets, decode-chunk windows, slot-scatter sizes) at startup so no
+    # compile stall lands mid-serving (SGLang's warmup-at-launch role)
+    precompile: bool = False
 
 
 @dataclass

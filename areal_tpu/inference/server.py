@@ -213,6 +213,10 @@ class InferenceServer:
             # initialize() builds slot state + KV cache even when params
             # were injected by the caller
             self.engine.initialize()
+        if getattr(self.engine, "config", None) is not None and getattr(
+            self.engine.config, "precompile", False
+        ):
+            self.engine.precompile()
         self.engine.start()
         self._runner = web.AppRunner(self.build_app())
         await self._runner.setup()

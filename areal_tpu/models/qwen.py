@@ -767,8 +767,14 @@ def forward_decode(
     slot_idx = jnp.arange(S)
     valid = jnp.arange(W)[None, :] <= cache_lens[:, None]  # [S, W]
 
-    def body(x, scanned):
-        layer, k_cache, v_cache = scanned
+    def body(carry, scanned):
+        # the FULL [n_layers, S, T, KH, hd] cache rides the carry and takes a
+        # per-row in-place scatter. Round-2 profiling: passing per-layer cache
+        # slices through scan xs/ys made every step rewrite whole [S, T, KH,
+        # hd] layer slices into the stacked ys buffer (~2x the chunk's ideal
+        # HBM traffic); carry + scatter writes only the S new rows.
+        x, k_all, v_all = carry
+        layer, li = scanned
         h = _rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
         q = h @ layer["wq"]
         k = h @ layer["wk"]
@@ -784,10 +790,10 @@ def forward_decode(
         q = _rope(q, pos1, cfg.rope_theta)[:, 0]  # [S, H, hd]
         k = _rope(k, pos1, cfg.rope_theta)[:, 0]  # [S, KH, hd]
         v = v[:, 0]
-        k_cache = k_cache.at[slot_idx, cache_lens].set(k.astype(k_cache.dtype))
-        v_cache = v_cache.at[slot_idx, cache_lens].set(v.astype(v_cache.dtype))
-        kk = k_cache[:, :W]  # [S, W, KH, hd] — static slice
-        vv = v_cache[:, :W]
+        k_all = k_all.at[li, slot_idx, cache_lens].set(k.astype(k_all.dtype))
+        v_all = v_all.at[li, slot_idx, cache_lens].set(v.astype(v_all.dtype))
+        kk = jax.lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)[:, :W]
+        vv = jax.lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)[:, :W]
         qg = q.reshape(S, KH, G, hd)
         logits = (
             jnp.einsum("skgd,stkd->skgt", qg, kk).astype(jnp.float32) * hd**-0.5
@@ -798,8 +804,13 @@ def forward_decode(
         x = x + attn @ layer["wo"]
         h = _rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
         x = x + _ffn(cfg, h, layer)
-        return x, (k_cache, v_cache)
+        return (x, k_all, v_all), None
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    n_layers = cfg.num_layers
+    (x, ks, vs), _ = jax.lax.scan(
+        body,
+        (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
+    )
     hidden = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     return hidden, {"k": ks, "v": vs}

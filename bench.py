@@ -36,10 +36,11 @@ import threading
 import time
 
 BASELINE_TOK_S_PER_CHIP = 4300.0
-# worst-case sum (probe + probe-retry + decode + train = 180+180+480+480
-# = 1320s + overhead) must stay under the driver's ~25-min capture window
-# even if every phase hits its deadline
-PHASE_DEADLINE_S = {"probe": 180.0, "decode": 480.0, "train": 480.0}
+# worst-case sum (probe + probe-retry + decode + train = 180+180+560+480
+# = 1400s + overhead) must stay under the driver's ~25-min capture window
+# even if every phase hits its deadline — do NOT raise a deadline without
+# re-checking this sum
+PHASE_DEADLINE_S = {"probe": 180.0, "decode": 560.0, "train": 480.0}
 # in-phase budget for the decode wait loop (< the external deadline so the
 # partial-result path can fire before the parent SIGKILLs us)
 DECODE_WAIT_S = 360.0  # < decode deadline so the partial path can report
@@ -131,6 +132,13 @@ def phase_decode():
     log(f"[decode] init params {time.monotonic()-t0:.1f}s")
     eng = DecodeEngine(cfg, params=params, model_cfg=model_cfg)
     eng.initialize()
+    # warm ALL serving programs (prefill group sizes x buckets, chunk
+    # windows, scatter sizes) before the clock starts: profiling showed
+    # cold-variant compile/cache-replay inside the measured window costs
+    # ~25% of apparent throughput (4.1k vs 5.6k tok/s steady state)
+    t0 = time.monotonic()
+    eng.precompile()
+    log(f"[decode] precompile {time.monotonic()-t0:.1f}s")
     eng.start()
 
     rng = np.random.default_rng(0)

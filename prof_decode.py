@@ -1,0 +1,110 @@
+"""Profile the decode engine on the real chip: wave timing + steady state."""
+import time, threading
+import numpy as np
+import jax
+
+from areal_tpu.api.config import MeshConfig, ServerConfig
+from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+from areal_tpu.inference import decode_engine as DE
+from areal_tpu.inference.decode_engine import DecodeEngine
+from areal_tpu.models import qwen
+
+MODEL_KW = dict(
+    vocab_size=151936, hidden_size=1536, intermediate_size=8960,
+    num_layers=28, num_heads=12, num_kv_heads=2, head_dim=128,
+    rope_theta=1_000_000.0, dtype="bfloat16", tie_word_embeddings=True,
+    attention_bias=True,
+)
+
+model_cfg = qwen.ModelConfig(**MODEL_KW)
+cfg = ServerConfig(
+    max_batch_size=128, max_seq_len=512, decode_steps_per_call=32,
+    mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+)
+t0 = time.monotonic()
+params = jax.jit(lambda k: qwen.init_params(k, model_cfg))(jax.random.PRNGKey(0))
+jax.block_until_ready(params)
+print(f"init params {time.monotonic()-t0:.1f}s", flush=True)
+eng = DecodeEngine(cfg, params=params, model_cfg=model_cfg)
+eng.initialize()
+t0 = time.monotonic()
+eng.precompile()
+print(f"precompile {time.monotonic()-t0:.1f}s", flush=True)
+
+# monkeypatch timing instrumentation
+times = {"prefill": 0.0, "prefill_n": 0, "dispatch": 0.0, "drain": 0.0,
+         "admit": 0.0, "scatter": 0.0, "chunks": 0}
+orig_prefill = eng._prefill_group
+orig_dispatch = eng._dispatch_chunk
+orig_drain = eng._drain
+orig_admit = eng._admit_pending
+orig_scatter = eng._apply_slot_updates
+
+def prefill(*a, **k):
+    t = time.monotonic(); r = orig_prefill(*a, **k)
+    times["prefill"] += time.monotonic() - t; times["prefill_n"] += 1
+    return r
+
+def dispatch():
+    t = time.monotonic(); r = orig_dispatch()
+    times["dispatch"] += time.monotonic() - t
+    if r is not None: times["chunks"] += 1
+    return r
+
+def drain(p):
+    t = time.monotonic(); r = orig_drain(p)
+    times["drain"] += time.monotonic() - t
+    return r
+
+def admit():
+    t = time.monotonic(); r = orig_admit()
+    times["admit"] += time.monotonic() - t
+    return r
+
+def scatter(rows):
+    t = time.monotonic(); r = orig_scatter(rows)
+    times["scatter"] += time.monotonic() - t
+    return r
+
+eng._prefill_group = prefill
+eng._dispatch_chunk = dispatch
+eng._drain = drain
+eng._admit_pending = admit
+eng._apply_slot_updates = scatter
+eng.start()
+
+rng = np.random.default_rng(0)
+
+def run_trial(n_req, new_tokens, label):
+    done = threading.Event(); results = []; lock = threading.Lock()
+    for k in times: times[k] = 0 if isinstance(times[k], int) else 0.0
+    def cb(resp):
+        with lock:
+            results.append(resp)
+            if len(results) == n_req: done.set()
+    t0 = time.monotonic()
+    for _ in range(n_req):
+        req = ModelRequest(
+            input_ids=rng.integers(0, 1000, 128).tolist(),
+            gconfig=GenerationHyperparameters(max_new_tokens=new_tokens, temperature=1.0),
+        )
+        eng.submit(req, cb)
+    ok = done.wait(timeout=420)
+    dt = time.monotonic() - t0
+    gen = sum(len(r.output_tokens) for r in results)
+    admit_only = times["admit"] - times["prefill"]
+    print(f"[{label}] ok={ok} gen={gen} dt={dt:.2f}s tok_s={gen/dt:.0f} | "
+          f"prefill={times['prefill']:.2f}s({times['prefill_n']}) "
+          f"admit-other={admit_only:.2f}s scatter={times['scatter']:.2f}s "
+          f"dispatch={times['dispatch']:.2f}s drain={times['drain']:.2f}s "
+          f"chunks={times['chunks']}", flush=True)
+
+warm = ModelRequest(input_ids=rng.integers(0, 1000, 128).tolist(),
+                    gconfig=GenerationHyperparameters(max_new_tokens=32, temperature=1.0))
+eng.generate_sync(warm, timeout=300)
+print("warmup done", flush=True)
+
+run_trial(256, 256, "trial1-cold")
+run_trial(256, 256, "trial2-warm")
+run_trial(256, 256, "trial3-warm")
+eng.stop()
