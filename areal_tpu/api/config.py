@@ -193,6 +193,11 @@ class ServerConfig:
     max_seq_len: int = 32768
     page_size: int = 128  # KV page granularity (paged attention)
     hbm_utilization: float = 0.85
+    # KV page-pool HBM budget in GiB. None = dense-equivalent pool
+    # (max_batch_size x max_seq_len tokens) — fine for short contexts and
+    # tests; long-context serving MUST set a budget so pages are a shared
+    # pool smaller than S*T (the whole point of paging: KV ∝ used tokens)
+    kv_hbm_gb: float | None = None
     decode_steps_per_call: int = 16  # tokens decoded per jitted scan call
     mesh: MeshConfig = field(default_factory=MeshConfig)
     port: int = 0  # 0 = pick a free port

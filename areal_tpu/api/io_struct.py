@@ -51,8 +51,10 @@ class ModelRequest:
     )
     rid: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
     metadata: dict[str, Any] = dataclasses.field(default_factory=dict)
-    # vision
+    # vision: pre-extracted pixel patches [P, patch_dim] and the images'
+    # (t, h, w) patch-grid shapes [n_images, 3] (drives the tower's 2-D rope)
     image_data: list[Any] | None = None
+    image_grid_thw: list[Any] | None = None
 
 
 @dataclasses.dataclass

@@ -179,12 +179,7 @@ class JaxTrainEngine(TrainEngine):
             t0 = time.monotonic()
 
             def put(path, arr):
-                parts = path.split("/")
-                shard = (
-                    self.param_shardings["layers"][parts[1]]
-                    if parts[0] == "layers"
-                    else self.param_shardings[parts[0]]
-                )
+                shard = mesh_lib.shard_for_path(self.param_shardings, path)
                 return jax.device_put(jnp.asarray(arr, dtype=pdtype), shard)
 
             self.params, _ = load_params_from_hf(cfg.path, mcfg, dtype=pdtype, put=put)
@@ -400,6 +395,15 @@ class JaxTrainEngine(TrainEngine):
         counts = np.asarray(
             input_.pop("pixel_counts", np.full(B, P_raw)), np.int32
         )
+        if "pixel_pos_ids" not in input_:
+            logger.warning(
+                "VLM batch has pixel_values but no pixel_pos_ids; vision "
+                "rope positions default to (0,0) per patch (real Qwen2-VL "
+                "weights will mis-embed)"
+            )
+        pos_ids = np.asarray(
+            input_.pop("pixel_pos_ids", np.zeros((B, P_raw, 2))), np.int32
+        )
         ids = np.asarray(input_["input_ids"])
         # one PPO step calls forward_batch (logprob recompute) and
         # train_batch on the SAME batch; memoize the tower output so the
@@ -420,22 +424,26 @@ class JaxTrainEngine(TrainEngine):
         Ppad = -(-round_up_to_bucket(P_raw, 256) // merge2) * merge2
         if Ppad != P_raw:
             pv = np.pad(pv, ((0, 0), (0, Ppad - P_raw), (0, 0)))
+            pos_ids = np.pad(pos_ids, ((0, 0), (0, Ppad - P_raw), (0, 0)))
         key = ("vision", Ppad)
         if key not in self._fn_cache:
             vcfg = mcfg.vision
 
-            def run(vparams, pixels, cnts):
-                def one(px, c):
+            def run(vparams, pixels, cnts, pids):
+                def one(px, c, pid):
                     mask = jnp.arange(px.shape[0]) < c
-                    return vis.vision_forward(vparams, vcfg, px, mask)
+                    return vis.vision_forward(vparams, vcfg, px, mask, pid)
 
-                return jax.vmap(one)(pixels, cnts)
+                return jax.vmap(one)(pixels, cnts, pids)
 
             self._fn_cache[key] = jax.jit(run)
         with jax.set_mesh(self.mesh):
             out = np.asarray(
                 self._fn_cache[key](
-                    self.params["vision"], jnp.asarray(pv), jnp.asarray(counts)
+                    self.params["vision"],
+                    jnp.asarray(pv),
+                    jnp.asarray(counts),
+                    jnp.asarray(pos_ids),
                 ),
                 np.float32,
             )  # [B, Ppad/merge2, D]
@@ -874,12 +882,7 @@ class JaxTrainEngine(TrainEngine):
             pdtype = jnp.dtype(self.config.param_dtype)
 
             def put(path, arr):
-                parts = path.split("/")
-                shard = (
-                    self.param_shardings["layers"][parts[1]]
-                    if parts[0] == "layers"
-                    else self.param_shardings[parts[0]]
-                )
+                shard = mesh_lib.shard_for_path(self.param_shardings, path)
                 return jax.device_put(jnp.asarray(arr, dtype=pdtype), shard)
 
             vh = self.params.get("value_head") if self.value_head else None

@@ -150,12 +150,18 @@ class RemoteJaxEngine(InferenceEngine):
             buf = io.BytesIO()
             np.save(buf, np.asarray(req.image_data, np.float32))
             image_b64 = b64.b64encode(buf.getvalue()).decode()
+        grid_thw = (
+            np.asarray(req.image_grid_thw).tolist()
+            if req.image_grid_thw is not None
+            else None
+        )
 
         while True:
             payload = {
                 "input_ids": attempt_input,
                 "rid": req.rid,
                 "image_data": image_b64,
+                "image_grid_thw": grid_thw,
                 "sampling_params": {
                     "max_new_tokens": remaining,
                     "greedy": g.greedy,

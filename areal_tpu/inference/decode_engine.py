@@ -4,11 +4,18 @@ Replaces the external SGLang/vLLM servers the reference depends on
 (areal/engine/sglang_remote.py, vllm_remote.py + infra/launcher/*_server.py)
 with a JAX decode engine built for the async-RL protocol (SURVEY §7.1):
 
-- **slot-based continuous batching**: S fixed decode slots over a static
-  [n_layers, S, T, KH, hd] KV cache; requests admit into free slots via a
-  bucketed prefill, then all slots step together in a jitted multi-token
-  ``lax.scan`` decode chunk (``decode_steps_per_call``) — static shapes
-  everywhere, a handful of compiled programs total.
+- **slot-based continuous batching over a paged KV cache**: S decode slots
+  draw fixed-size KV pages from a shared pool (inference/paged_kv.py) via
+  host-side block tables — KV HBM ∝ used tokens, so 4K-32K contexts fit at
+  real concurrency. Requests admit into free slots via a bucketed prefill
+  (KV scattered into their pages), then all slots step together in a jitted
+  multi-token ``lax.scan`` decode chunk (``decode_steps_per_call``) running
+  the Pallas paged-attention kernel — static shapes everywhere, a bounded
+  set of compiled programs (windows bucketed in pages).
+- **GRPO prefix sharing by page aliasing**: a group's identical prompts
+  prefill once; duplicates share the full prompt pages (refcount++) and
+  copy only the final partial page. Pool exhaustion evicts parked KV, then
+  preempts the highest-budget slots (abort + client retry).
 - **interruptible generation** (the reference's crown jewel,
   remote_inf_engine.py:771-867 + §3.4 pause protocol): ``pause()`` completes
   all in-flight requests with ``stop_reason="abort"`` and their partial
@@ -68,16 +75,18 @@ class _Task:
 
 @dataclass
 class _Parked:
-    """KV retained in a slot across abort/resume (rid affinity).
+    """KV retained across abort/resume (rid affinity).
 
     The client's interruptible-generation loop resubmits ``prompt + emitted``
     with the same rid after continue_generation (client.py agenerate loop;
-    reference intent remote_inf_engine.py:753-763). If the slot's cache is
-    intact we restore decode state directly — zero re-prefill."""
+    reference intent remote_inf_engine.py:753-763). If the slot's pages are
+    intact we restore decode state directly — zero re-prefill. The parked
+    entry owns the slot's KV pages until resume or eviction."""
 
     slot: int
     full_ids: list[int]  # prompt + emitted; cache holds all but the last
     pos: int  # decode position of the pending (last) token
+    pages: list[int] = field(default_factory=list)  # owned KV pages
     park_time: float = field(default_factory=time.monotonic)
 
 
@@ -185,12 +194,7 @@ class DecodeEngine:
             )
 
             def put(path, arr):
-                parts = path.split("/")
-                shard = (
-                    self.param_shardings["layers"][parts[1]]
-                    if parts[0] == "layers"
-                    else self.param_shardings[parts[0]]
-                )
+                shard = mesh_lib.shard_for_path(self.param_shardings, path)
                 return jax.device_put(
                     jnp.asarray(arr, dtype=self.model_cfg.jax_dtype), shard
                 )
@@ -226,19 +230,7 @@ class DecodeEngine:
             )
 
         S, T = cfg.max_batch_size, cfg.max_seq_len
-        tp = self.mesh.shape["model"]
-        kv_spec = (
-            qwen.kv_cache_specs()
-            if self.model_cfg.num_kv_heads % max(tp, 1) == 0
-            else {"k": P(), "v": P()}
-        )
-        with jax.set_mesh(self.mesh):
-            self.cache = jax.jit(
-                lambda: qwen.init_kv_cache(self.model_cfg, S, T),
-                out_shardings={
-                    k: NamedSharding(self.mesh, s) for k, s in kv_spec.items()
-                },
-            )()
+        self._init_paged_cache()
         # host mirror of per-slot state. The authoritative decode state lives
         # ON DEVICE (self._dev_state): the loop never round-trips it through
         # the host — one packed upload per admission event, one packed
@@ -260,69 +252,187 @@ class DecodeEngine:
         with jax.set_mesh(self.mesh):
             self._dev_state = {k: jnp.asarray(v) for k, v in self._state.items()}
         self._rng = jax.random.PRNGKey(int(time.time_ns()) % (2**31))
+        # precompile() warms via AOT lower().compile(); the serving path
+        # replays those programs through the persistent compile cache, so
+        # make sure one is configured. TPU only: CPU AOT cache entries are
+        # machine-feature-specific and a remote-compile service can poison
+        # them for this host (observed SIGILL-class cpu_aot_loader errors).
+        if (
+            jax.config.jax_compilation_cache_dir is None
+            and jax.default_backend() == "tpu"
+        ):
+            jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
         self.initialized = True
         logger.info(
-            f"decode engine ready: {S} slots × {T} ctx, mesh {dict(self.mesh.shape)}"
+            f"decode engine ready: {S} slots × {T} ctx, "
+            f"{self.pool.n_pages} KV pages × {cfg.page_size} tokens, "
+            f"mesh {dict(self.mesh.shape)}"
         )
 
+    def _init_paged_cache(self) -> None:
+        """Create the paged KV pool (inference/paged_kv.py): page arrays on
+        device, allocator + block tables on host. Pool size comes from
+        ``kv_hbm_gb`` when set (long-context serving: KV HBM ∝ used tokens),
+        else a dense-equivalent S×T tokens (short contexts, tests)."""
+        from areal_tpu.inference import paged_kv
+
+        cfg = self.config
+        mcfg = self.model_cfg
+        S, T, psz = cfg.max_batch_size, cfg.max_seq_len, cfg.page_size
+        self._maxp = -(-T // psz)  # pages per sequence (ceil)
+        if cfg.kv_hbm_gb is not None:
+            n_pages = paged_kv.n_pages_for_budget(
+                int(cfg.kv_hbm_gb * (1 << 30)),
+                mcfg.num_layers,
+                mcfg.num_kv_heads,
+                psz,
+                mcfg.head_dim_,
+                jnp.dtype(mcfg.jax_dtype).itemsize,
+            )
+        else:
+            n_pages = S * self._maxp + 1  # +1: trash page 0
+        self.pool = paged_kv.PagePool(n_pages)
+        tp = self.mesh.shape["model"]
+        kv_spec = (
+            paged_kv.paged_cache_specs()
+            if mcfg.num_kv_heads % max(tp, 1) == 0
+            else {"k": P(), "v": P()}
+        )
+        # the Pallas paged kernel runs single-device; under TP the engine
+        # falls back to the gather+einsum path which GSPMD shards over the
+        # KV-head axis like the dense engine did
+        self._use_kernel = (
+            jax.devices()[0].platform == "tpu"
+            and int(np.prod(list(self.mesh.shape.values()))) == 1
+        )
+        with jax.set_mesh(self.mesh):
+            self.cache = jax.jit(
+                lambda: paged_kv.init_paged_cache(mcfg, n_pages, psz),
+                out_shardings={
+                    k: NamedSharding(self.mesh, s) for k, s in kv_spec.items()
+                },
+            )()
+        self._slot_pages: list[list[int]] = [[] for _ in range(S)]
+        self._pt_host = np.zeros((S, self._maxp), np.int32)
+
+    # prompt buckets above this warm only if on the round_up_to_bucket
+    # 2^k/3*2^k series — the exact-reachable set at T=32K would otherwise be
+    # every 256-multiple (512 prefill programs; a ~10x startup blowup).
+    # Buckets outside the warmed set still work; they compile on first hit.
+    _WARM_DENSE_CAP = 4096
+
+    def _reachable_prompt_buckets(self) -> list[int]:
+        """Values ``min(T, round_up_to_bucket(plen, 256))`` the admission
+        path can produce (round-2 warmed linear multiples instead — compiling
+        unreachable programs while missing the 3*2^k series and the T-cap;
+        ADVICE r02 #1), dense up to ``_WARM_DENSE_CAP`` then the sparse
+        series tail only."""
+        T = self.config.max_seq_len
+        exact = {
+            min(T, round_up_to_bucket(n, 256))
+            for n in range(1, max(2, min(T - 1, self._WARM_DENSE_CAP)))
+        }
+        b = self._WARM_DENSE_CAP
+        while b < T:
+            exact.add(min(T, round_up_to_bucket(b + 1, 256)))
+            b *= 2
+        exact.add(min(T, round_up_to_bucket(max(1, T - 2), 256)))
+        return sorted(exact)
+
+    def _reachable_chunk_wps(self) -> list[int]:
+        """Window page counts ``_dispatch_chunk`` can request — exact up to
+        ``_WARM_DENSE_CAP`` rows, then the sparse bucket-series tail."""
+        cfg = self.config
+        T, psz = cfg.max_seq_len, cfg.page_size
+        n_steps = cfg.decode_steps_per_call
+
+        def wp_of(max_pos: int) -> int:
+            window = min(
+                T, round_up_to_bucket(max_pos + 1 + 2 * n_steps, _WINDOW_STEP)
+            )
+            return min(self._maxp, -(-window // psz))
+
+        wps = {wp_of(p) for p in range(min(T, self._WARM_DENSE_CAP))}
+        b = self._WARM_DENSE_CAP
+        while b < T:
+            wps.add(wp_of(b))
+            b *= 2
+        wps.add(wp_of(T - 1))
+        return sorted(wps)
+
+    def _reachable_scatter_sizes(self) -> list[int]:
+        """Exact set of bucketed row counts ``_apply_slot_updates`` uses:
+        powers of two up to S, plus S itself when S is not a power of two."""
+        S = self.config.max_batch_size
+        sizes = set()
+        n = 1
+        while n < S:
+            sizes.add(n)
+            n *= 2
+        sizes.add(S)
+        return sorted(sizes)
+
     def precompile(self, prompt_buckets: list[int] | None = None) -> None:
-        """Compile-warm every jitted variant the serving loop can reach:
-        batched-prefill programs (``_PREFILL_SIZES`` group sizes x prompt
-        length buckets), the slot-scatter sizes, and every decode-chunk
-        (window, capped) combination.
+        """AOT compile-warm every jitted variant the serving loop can reach:
+        batched-prefill programs (``_PREFILL_SIZES`` group sizes x reachable
+        prompt buckets), the slot-scatter sizes, page-copy sizes, and every
+        reachable decode-chunk (window-pages, capped) combination.
 
         A compile stall mid-serving blocks ALL slots for tens of seconds;
-        profiling showed cold prefill variants alone cost ~25% of measured
-        decode throughput on the first request waves. Servers call this at
-        startup (``ServerConfig.precompile``) — the role SGLang's warmup
-        phase plays for the reference's launchers. Text-only variants are
-        warmed; VLM image-prefill programs compile on first use.
+        round-2 profiling showed cold prefill variants alone cost ~25% of
+        measured decode throughput on the first request waves. Servers call
+        this at startup (``ServerConfig.precompile``) — the role SGLang's
+        warmup phase plays for the reference's launchers.
 
-        ``prompt_buckets`` defaults to every 256-multiple up to
-        min(max_seq_len, 2048) plus powers of two beyond — admission buckets
-        outside the warmed set still work, they just compile on first hit.
+        Warm sets are derived from ``round_up_to_bucket`` itself, and
+        warming uses ``jit(f).lower(...).compile()`` — compile cost only, no
+        device execution (ADVICE r02 #1/#2). The runtime path re-traces on
+        first hit and replays from the in-process/persistent compile cache.
         """
         assert self.initialized, "initialize() first"
         cfg = self.config
-        T, S = cfg.max_seq_len, cfg.max_batch_size
-        if prompt_buckets is None:
-            prompt_buckets = list(range(256, min(T, 2048) + 1, 256))
-            b = 4096
-            while b <= T:
-                prompt_buckets.append(b)
-                b *= 2
-        prompt_buckets = sorted({min(T, max(256, int(b))) for b in prompt_buckets})
         t0 = time.monotonic()
         n_prog = 0
+
+        def sds(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        params_s = jax.tree.map(sds, self.params)
+        cache_s = jax.tree.map(sds, self.cache)
+        state_s = jax.tree.map(sds, self._dev_state)
+        rng_s = sds(self._rng)
+        psz = cfg.page_size
         with jax.set_mesh(self.mesh):
+            if prompt_buckets is None:
+                prompt_buckets = self._reachable_prompt_buckets()
             for bucket in prompt_buckets:
                 for A in _PREFILL_SIZES:
-                    self.cache = self._prefill_fn(A, bucket)(
-                        self.params,
-                        self.cache,
-                        jnp.zeros((A, bucket), jnp.int32),
-                        jnp.ones(A, jnp.int32),
-                        jnp.arange(A, dtype=jnp.int32),
-                    )
+                    self._prefill_fn(A, bucket).lower(
+                        params_s,
+                        cache_s,
+                        jax.ShapeDtypeStruct((A, bucket), jnp.int32),
+                        jax.ShapeDtypeStruct((A,), jnp.int32),
+                        jax.ShapeDtypeStruct((A * -(-bucket // psz),), jnp.int32),
+                    ).compile()
                     n_prog += 1
-            n = 1
-            while n <= S:
-                upd = np.stack([self._pack_row(0, 0, 0, False, 0)] * n)
-                self._dev_state = self._update_fn(n)(
-                    self._dev_state, jnp.asarray(upd)
-                )
+            upd_row = 9 + _MAX_STOP  # _pack_row column count
+            for n in self._reachable_scatter_sizes():
+                self._update_fn(n).lower(
+                    state_s, jax.ShapeDtypeStruct((n, upd_row), jnp.float32)
+                ).compile()
                 n_prog += 1
-                n *= 2
-            for window in range(_WINDOW_STEP, T + 1, _WINDOW_STEP):
+            for wp in self._reachable_chunk_wps():
                 for capped in (False, True):
-                    chunk = self._chunk_fn(
-                        cfg.decode_steps_per_call, window, capped
-                    )
-                    self.cache, self._dev_state, self._rng, _ = chunk(
-                        self.params, self.cache, self._dev_state, self._rng
-                    )
+                    self._chunk_fn(cfg.decode_steps_per_call, wp, capped).lower(
+                        params_s,
+                        cache_s,
+                        jax.ShapeDtypeStruct(
+                            (cfg.max_batch_size, wp), jnp.int32
+                        ),
+                        state_s,
+                        rng_s,
+                    ).compile()
                     n_prog += 1
-            jax.block_until_ready(self._dev_state)
         logger.info(
             f"precompiled {n_prog} serving programs in "
             f"{time.monotonic() - t0:.1f}s"
@@ -418,12 +528,7 @@ class DecodeEngine:
         sharding immediately (async dispatch)."""
         staged = {}
         for name, arr in flat.items():
-            parts = name.split("/")
-            shard = (
-                self.param_shardings["layers"][parts[1]]
-                if parts[0] == "layers"
-                else self.param_shardings[parts[0]]
-            )
+            shard = mesh_lib.shard_for_path(self.param_shardings, name)
             staged[name] = jax.device_put(
                 jnp.asarray(arr, dtype=self.model_cfg.jax_dtype), shard
             )
@@ -469,12 +574,7 @@ class DecodeEngine:
             elif kind == "disk":
 
                 def put(path, arr):
-                    parts = path.split("/")
-                    shard = (
-                        self.param_shardings["layers"][parts[1]]
-                        if parts[0] == "layers"
-                        else self.param_shardings[parts[0]]
-                    )
+                    shard = mesh_lib.shard_for_path(self.param_shardings, path)
                     return jax.device_put(
                         jnp.asarray(arr, dtype=self.model_cfg.jax_dtype), shard
                     )
@@ -492,7 +592,8 @@ class DecodeEngine:
             if version is not None:
                 self._version = version
             if not self.config.kv_reuse_across_updates:
-                self._parked.clear()
+                while self._evict_oldest_parked() is not None:
+                    pass
             self._pending_weight_update = None
             logger.info(
                 f"weights updated ({kind}) to v{self._version} in "
@@ -519,8 +620,9 @@ class DecodeEngine:
         t0 = time.monotonic()
         self.params, mode = offload_tree(self.params)
         self._offload_mode = mode
-        self.cache = None  # slab is zeros-recreatable; parked KV is lost
-        self._parked.clear()
+        self.cache = None  # pages are zeros-recreatable; parked KV is lost
+        while self._evict_oldest_parked() is not None:
+            pass
         logger.info(f"released memory ({mode}) in {time.monotonic()-t0:.2f}s")
 
     def resume_memory(self) -> None:
@@ -536,12 +638,7 @@ class DecodeEngine:
             else:
                 # rebuild target shardings from the param spec map
                 def shard_of(path):
-                    parts = path.split("/")
-                    return (
-                        self.param_shardings["layers"][parts[1]]
-                        if parts[0] == "layers"
-                        else self.param_shardings[parts[0]]
-                    )
+                    return mesh_lib.shard_for_path(self.param_shardings, path)
 
                 flat = dict(_iter_tree_paths(self.params))
                 shardings_flat = {p: shard_of(p) for p in flat}
@@ -553,19 +650,7 @@ class DecodeEngine:
                         d = d.setdefault(k, {})
                     d[ks[-1]] = s
                 self.params = onload_tree(self.params, tree_shardings, mode)
-            S, T = self.config.max_batch_size, self.config.max_seq_len
-            tp = self.mesh.shape["model"]
-            kv_spec = (
-                qwen.kv_cache_specs()
-                if self.model_cfg.num_kv_heads % max(tp, 1) == 0
-                else {"k": P(), "v": P()}
-            )
-            self.cache = jax.jit(
-                lambda: qwen.init_kv_cache(self.model_cfg, S, T),
-                out_shardings={
-                    k: NamedSharding(self.mesh, s) for k, s in kv_spec.items()
-                },
-            )()
+        self._init_paged_cache()  # fresh pool; all requests were aborted
         self._offload_mode = None
         logger.info(f"resumed memory in {time.monotonic()-t0:.2f}s")
 
@@ -586,9 +671,11 @@ class DecodeEngine:
         key = ("prefill", n_prompts, bucket, with_images)
         if key not in self._fn_cache:
             mcfg = self.model_cfg
+            psz = self.config.page_size
+            from areal_tpu.inference import paged_kv
 
-            def prefill(params, cache, ids, plens, slots, img=None):
-                # ids [A, bucket], plens [A], slots [A]
+            def prefill(params, cache, ids, plens, flat_pages, img=None):
+                # ids [A, bucket], plens [A], flat_pages [A * bucket/psz]
                 positions = jnp.broadcast_to(
                     jnp.arange(bucket, dtype=jnp.int32)[None], ids.shape
                 )
@@ -598,14 +685,8 @@ class DecodeEngine:
                 _, ks, vs = qwen.forward_prefill(
                     params, mcfg, ids, positions, seg, image_embeds=img
                 )
-                # ks/vs: [n_layers, A, bucket, KH, hd]
-                for name, new in (("k", ks), ("v", vs)):
-                    cache[name] = (
-                        cache[name]
-                        .at[:, slots, :bucket]
-                        .set(new.astype(cache[name].dtype))
-                    )
-                return cache
+                # ks/vs: [n_layers, A, bucket, KH, hd] -> page scatter
+                return paged_kv.scatter_prefill(cache, ks, vs, flat_pages, psz)
 
             self._fn_cache[key] = jax.jit(prefill, donate_argnames=("cache",))
         return self._fn_cache[key]
@@ -629,6 +710,18 @@ class DecodeEngine:
                 continue
             px = np.asarray(task.req.image_data, np.float32)  # [P, pd]
             P = px.shape[0]
+            if task.req.image_grid_thw is not None:
+                pos = vis.grid_pos_ids(
+                    task.req.image_grid_thw, mcfg.vision.spatial_merge
+                )
+            else:
+                # all-zero rope positions lose all spatial structure — real
+                # Qwen2-VL weights will produce garbage embeddings
+                logger.warning(
+                    f"rid={task.req.rid}: image_data without image_grid_thw; "
+                    "vision rope positions default to (0,0) per patch"
+                )
+                pos = np.zeros((P, 2), np.int32)
             # bucket the padded patch count: distinct image sizes must not
             # each compile a fresh ViT (the mask handles the padding)
             Ppad = -(-round_up_to_bucket(P, 256) // merge2) * merge2
@@ -636,14 +729,18 @@ class DecodeEngine:
             if key not in self._fn_cache:
                 vcfg = mcfg.vision
                 self._fn_cache[key] = jax.jit(
-                    lambda vp, x, m: vis.vision_forward(vp, vcfg, x, m)
+                    lambda vp, x, m, p: vis.vision_forward(vp, vcfg, x, m, p)
                 )
             px_pad = np.pad(px, ((0, Ppad - P), (0, 0)))
+            pos_pad = np.pad(pos, ((0, Ppad - P), (0, 0)))
             mask = np.arange(Ppad) < P
             with jax.set_mesh(self.mesh):
                 out = np.asarray(
                     self._fn_cache[key](
-                        self.params["vision"], jnp.asarray(px_pad), jnp.asarray(mask)
+                        self.params["vision"],
+                        jnp.asarray(px_pad),
+                        jnp.asarray(mask),
+                        jnp.asarray(pos_pad),
                     ),
                     np.float32,
                 )
@@ -657,8 +754,9 @@ class DecodeEngine:
             emb[j, pos[:n]] = out[:n]
         return emb
 
-    def _chunk_fn(self, n_steps: int, window: int, capped: bool):
-        """n_steps of decode for all slots in one jitted call.
+    def _chunk_fn(self, n_steps: int, wp: int, capped: bool):
+        """n_steps of decode for all slots in one jitted call, attending over
+        each slot's first ``wp`` KV pages (the window, bucketed in pages).
 
         Returns (cache, state, rng, packed) where ``packed`` is ONE int32
         array [2*n_steps + 3, S] — token rows, logprob-bit rows (fp32
@@ -667,16 +765,25 @@ class DecodeEngine:
         monotone within a chunk (a stopped slot never re-activates; admits
         happen between chunks), so per-slot counts fully describe the
         emit mask."""
-        key = ("chunk", n_steps, window, capped)
+        key = ("chunk", n_steps, wp, capped)
         if key not in self._fn_cache:
             mcfg = self.model_cfg
             T = self.config.max_seq_len
+            psz = self.config.page_size
+            use_kernel = self._use_kernel
 
-            def chunk(params, cache, state, rng):
+            def chunk(params, cache, page_table, state, rng):
                 def step(carry, _):
                     ids, pos, active, remaining, cache, rng = carry
-                    hidden, cache = qwen.forward_decode(
-                        params, mcfg, ids, pos, cache, pos, window=window
+                    hidden, cache = qwen.forward_decode_paged(
+                        params,
+                        mcfg,
+                        ids,
+                        pos,
+                        cache,
+                        page_table,
+                        page_size=psz,
+                        use_kernel=use_kernel,
                     )
                     logits = qwen.compute_logits(params, mcfg, hidden)
                     rng, sub = jax.random.split(rng)
@@ -775,12 +882,16 @@ class DecodeEngine:
         ]
 
     def _evict_oldest_parked(self) -> int | None:
-        """Free the least-recently-parked slot (its KV is lost; a resume for
-        that rid falls back to prefill)."""
+        """Free the least-recently-parked slot and its KV pages (a resume
+        for that rid falls back to prefill)."""
         if not self._parked:
             return None
         rid = min(self._parked, key=lambda r: self._parked[r].park_time)
-        return self._parked.pop(rid).slot
+        p = self._parked.pop(rid)
+        self.pool.free(p.pages)
+        self._slot_pages[p.slot] = []
+        self._pt_host[p.slot] = 0
+        return p.slot
 
     def _pack_row(
         self,
@@ -871,6 +982,11 @@ class DecodeEngine:
         task.slot = slot
         task.prompt_len = P_len
         self._slot_task[slot] = task
+        # restore page ownership + block-table row (zeroed at park time so
+        # in-flight chunks couldn't write into retained pages)
+        self._slot_pages[slot] = p.pages
+        self._pt_host[slot] = 0
+        self._pt_host[slot, : len(p.pages)] = p.pages
         row = self._slot_update_row(
             task, slot, ids[-1], p.pos, self._budget(task, P_len)
         )
@@ -948,95 +1064,134 @@ class DecodeEngine:
     def _admit_duplicates(
         self, pairs: list[tuple[_Task, int, int]]
     ) -> list[np.ndarray]:
-        """Shared-prefix admission: copy the primary slot's freshly-written
-        KV rows into each duplicate slot on device (a few MB vs a full
-        forward), then activate the duplicates like normal admits."""
-        T = self.config.max_seq_len
+        """Shared-prefix admission by **page aliasing**: duplicates share the
+        primary's full prompt pages (refcount++, zero copies) and take a
+        private copy of only the page the decode head writes into (the page
+        holding row ``plen-1``). This is the GRPO-group radix-cache
+        equivalent (reference leans on SGLang's radix cache,
+        remote_inf_engine.py:753-763) at page granularity."""
+        psz = self.config.page_size
         rows: list[np.ndarray] = []
-        dst = np.asarray([p[1] for p in pairs], np.int32)
-        src = np.asarray([p[2] for p in pairs], np.int32)
-        bucket = min(
-            T,
-            round_up_to_bucket(
-                max(len(t.req.input_ids) for t, _, _ in pairs), 256
-            ),
-        )
-        n = 1
-        while n < len(pairs):
-            n *= 2
-        n = min(n, self.config.max_batch_size)
-        pad = n - len(pairs)
-        dst = np.concatenate([dst, np.repeat(dst[:1], pad)])
-        src = np.concatenate([src, np.repeat(src[:1], pad)])
-        key = ("kvcopy", n, bucket)
-        if key not in self._fn_cache:
-
-            def copy(cache, dst_idx, src_idx):
-                for name in ("k", "v"):
-                    cache[name] = (
-                        cache[name]
-                        .at[:, dst_idx, :bucket]
-                        .set(cache[name][:, src_idx, :bucket])
-                    )
-                return cache
-
-            self._fn_cache[key] = jax.jit(copy, donate_argnames=("cache",))
-        with jax.set_mesh(self.mesh):
-            self.cache = self._fn_cache[key](
-                self.cache, jnp.asarray(dst), jnp.asarray(src)
-            )
-        for task, slot, _src in pairs:
+        copy_dst: list[int] = []
+        copy_src: list[int] = []
+        for task, slot, src_slot in pairs:
             ids = list(task.req.input_ids)
+            plen = len(ids)
+            prim = self._slot_pages[src_slot]
+            n_shared = (plen - 1) // psz  # pages decode will never write
+            if len(prim) <= n_shared:
+                # primary wasn't admitted (pool pressure backlogged it in
+                # _prefill_group) — this duplicate has nothing to alias;
+                # retry it as a fresh admission next round
+                self._backlog.append(task)
+                continue
+            priv = self.pool.alloc(1)
+            if priv is None:
+                self._evict_oldest_parked()
+                priv = self.pool.alloc(1)
+            if priv is None:
+                self._backlog.append(task)
+                continue
+            shared = prim[:n_shared]
+            self.pool.ref(shared)
+            pages = list(shared) + priv
+            copy_dst.append(priv[0])
+            copy_src.append(prim[n_shared])
+            self._slot_pages[slot] = pages
+            self._pt_host[slot] = 0
+            self._pt_host[slot, : len(pages)] = pages
             task.slot = slot
-            task.prompt_len = len(ids)
+            task.prompt_len = plen
             self._slot_task[slot] = task
             rows.append(
                 self._slot_update_row(
-                    task,
-                    slot,
-                    ids[-1],
-                    len(ids) - 1,
-                    self._budget(task, len(ids)),
+                    task, slot, ids[-1], plen - 1, self._budget(task, plen)
                 )
             )
+        if copy_dst:
+            from areal_tpu.inference import paged_kv
+
+            n = 1
+            while n < len(copy_dst):
+                n *= 2
+            pad = n - len(copy_dst)
+            dst = np.asarray(copy_dst + copy_dst[:1] * pad, np.int32)
+            src = np.asarray(copy_src + copy_src[:1] * pad, np.int32)
+            key = ("pagecopy", n)
+            if key not in self._fn_cache:
+                self._fn_cache[key] = jax.jit(
+                    paged_kv.copy_pages, donate_argnames=("cache",)
+                )
+            with jax.set_mesh(self.mesh):
+                self.cache = self._fn_cache[key](
+                    self.cache, jnp.asarray(dst), jnp.asarray(src)
+                )
         self.stats["prefix_shared"] = self.stats.get("prefix_shared", 0) + len(
-            pairs
+            copy_dst
         )
         return rows
 
     def _prefill_group(
         self, group: list[tuple[_Task, int]], bucket: int
     ) -> list[np.ndarray]:
-        A = len(group)
+        psz = self.config.page_size
+        npg = -(-bucket // psz)  # ceil: tiny max_seq_len can make bucket < psz
+        admitted: list[tuple[_Task, int]] = []
+        page_rows: list[np.ndarray] = []
+        for task, slot in group:
+            plen = len(task.req.input_ids)
+            need = -(-plen // psz)
+            pages = self.pool.alloc(need)
+            while pages is None and self._evict_oldest_parked() is not None:
+                pages = self.pool.alloc(need)
+            if pages is None:
+                self._backlog.append(task)  # pool pressure: retry later
+                continue
+            self._slot_pages[slot] = pages
+            self._pt_host[slot] = 0
+            self._pt_host[slot, :need] = pages
+            row = np.zeros(npg, np.int32)  # 0 = trash page for padded rows
+            row[:need] = pages
+            page_rows.append(row)
+            admitted.append((task, slot))
+        if not admitted:
+            return []
+        A = len(admitted)
+        flat_pages = np.stack(page_rows)
         ids_np = np.zeros((A, bucket), np.int32)
         plens = np.zeros(A, np.int32)
-        slots = np.zeros(A, np.int32)
-        for j, (task, slot) in enumerate(group):
+        for j, (task, _slot) in enumerate(admitted):
             ids = list(task.req.input_ids)
             ids_np[j, : len(ids)] = ids
             plens[j] = len(ids)
-            slots[j] = slot
-        img = self._image_embeds_for(group, ids_np, bucket)
+        img = self._image_embeds_for(admitted, ids_np, bucket)
+        # prefill group sizes are compiled variants; re-bucket A after any
+        # allocation drops by padding rows (trash-page scatter, plen 1)
+        sizes = [a for a in _PREFILL_SIZES if a >= A]
+        A_pad = min(sizes) if sizes else A
+        if A_pad > A:
+            ids_np = np.pad(ids_np, ((0, A_pad - A), (0, 0)))
+            ids_np[A:, 0] = 1
+            plens = np.pad(plens, (0, A_pad - A), constant_values=1)
+            flat_pages = np.pad(flat_pages, ((0, A_pad - A), (0, 0)))
+            if img is not None:
+                img = np.pad(img, ((0, A_pad - A), (0, 0), (0, 0)))
         with jax.set_mesh(self.mesh):
+            args = [
+                self.params,
+                self.cache,
+                jnp.asarray(ids_np),
+                jnp.asarray(plens),
+                jnp.asarray(flat_pages.reshape(-1)),
+            ]
             if img is None:
-                self.cache = self._prefill_fn(A, bucket)(
-                    self.params,
-                    self.cache,
-                    jnp.asarray(ids_np),
-                    jnp.asarray(plens),
-                    jnp.asarray(slots),
-                )
+                self.cache = self._prefill_fn(A_pad, bucket)(*args)
             else:
-                self.cache = self._prefill_fn(A, bucket, with_images=True)(
-                    self.params,
-                    self.cache,
-                    jnp.asarray(ids_np),
-                    jnp.asarray(plens),
-                    jnp.asarray(slots),
-                    jnp.asarray(img),
+                self.cache = self._prefill_fn(A_pad, bucket, with_images=True)(
+                    *args, jnp.asarray(img)
                 )
         rows = []
-        for j, (task, slot) in enumerate(group):
+        for j, (task, slot) in enumerate(admitted):
             P_len = int(plens[j])
             task.slot = slot
             task.prompt_len = P_len
@@ -1074,6 +1229,14 @@ class DecodeEngine:
         if task.slot >= 0:
             self._slot_task[task.slot] = None
             self._state["active"][task.slot] = False
+            # release KV pages (a parked rid already transferred ownership
+            # to its _Parked entry, leaving this list empty). Zeroing the
+            # block-table row makes any in-flight chunk's stale write for
+            # this slot land in the trash page / a freed page that the next
+            # owner's prefill fully rewrites before reading.
+            self.pool.free(self._slot_pages[task.slot])
+            self._slot_pages[task.slot] = []
+            self._pt_host[task.slot] = 0
         resp = ModelResponse(
             input_tokens=list(task.req.input_ids),
             output_tokens=task.out_tokens,
@@ -1102,12 +1265,17 @@ class DecodeEngine:
                 rid = task.req.rid
                 if rid and st["active"][slot]:
                     # retain KV for rid-affinity resume (client resubmits
-                    # prompt+emitted after continue_generation)
+                    # prompt+emitted after continue_generation); page
+                    # ownership moves to the parked entry so _finish below
+                    # doesn't free them
                     self._parked[rid] = _Parked(
                         slot=slot,
                         full_ids=list(task.req.input_ids) + list(task.out_tokens),
                         pos=int(st["pos"][slot]),
+                        pages=self._slot_pages[slot],
                     )
+                    self._slot_pages[slot] = []
+                    self._pt_host[slot] = 0
                 if st["active"][slot]:
                     deact.append(slot)
                 self._finish(task, StopReason.ABORT.value)
@@ -1121,6 +1289,83 @@ class DecodeEngine:
             ]
             self._apply_slot_updates(rows)
 
+    def _ensure_pages(self) -> None:
+        """Allocation-ahead: every active slot gets pages covering
+        ``pos + 2*n_steps`` writes (host pos can be one in-flight chunk
+        stale). On pool exhaustion, evict parked KV first, then preempt the
+        active slots with the most remaining budget (they abort with their
+        partial tokens; the client's retry loop re-submits them — the same
+        backpressure role SGLang's RETRACT_DECODE preemption plays)."""
+        st = self._state
+        psz = self.config.page_size
+        n_steps = self.config.decode_steps_per_call
+        deact_rows: list[np.ndarray] = []
+        for slot in np.nonzero(st["active"])[0]:
+            if not st["active"][slot]:  # preempted by an earlier iteration
+                continue
+            need = min(
+                self._maxp, -(-(int(st["pos"][slot]) + 2 * n_steps + 1) // psz)
+            )
+            pages = self._slot_pages[slot]
+            while len(pages) < need:
+                got = self.pool.alloc(need - len(pages))
+                if got is None and self._evict_oldest_parked() is not None:
+                    continue
+                if got is None:
+                    victim = self._preempt_victim()
+                    if victim is None or victim == slot:
+                        # cannot free enough. If the pages this slot already
+                        # holds cover further decoding, freeze its budget to
+                        # that coverage (it then deactivates inside a chunk
+                        # and _drain finishes it by length); if they don't,
+                        # freezing would deactivate it with no chunk ever
+                        # crediting it — abort it properly instead.
+                        covered = len(pages) * psz - 1 - int(st["pos"][slot])
+                        if covered <= 0:
+                            deact_rows.append(self._preempt(int(slot)))
+                            break
+                        st["remaining"][slot] = min(
+                            int(st["remaining"][slot]), covered
+                        )
+                        deact_rows.append(
+                            self._pack_row(
+                                int(slot),
+                                int(st["ids"][slot]),
+                                int(st["pos"][slot]),
+                                True,
+                                int(st["remaining"][slot]),
+                            )
+                        )
+                        break
+                    deact_rows.append(self._preempt(victim))
+                    continue
+                self._pt_host[slot, len(pages) : len(pages) + len(got)] = got
+                pages.extend(got)
+        if deact_rows:
+            self._apply_slot_updates(deact_rows)
+
+    def _preempt_victim(self) -> int | None:
+        """Active slot with the most remaining generation budget (frees the
+        most future page demand per abort)."""
+        st = self._state
+        best, best_rem = None, -1
+        for slot, task in enumerate(self._slot_task):
+            if task is None or not st["active"][slot]:
+                continue
+            if int(st["remaining"][slot]) > best_rem:
+                best, best_rem = slot, int(st["remaining"][slot])
+        return best
+
+    def _preempt(self, slot: int) -> np.ndarray:
+        """Abort one active slot to reclaim its pages (no parking — the
+        point is to free memory). Returns the deactivation scatter row."""
+        task = self._slot_task[slot]
+        st = self._state
+        row = self._pack_row(slot, 0, int(st["pos"][slot]), False, 0)
+        self._finish(task, StopReason.ABORT.value)
+        self.stats["preempted"] = self.stats.get("preempted", 0) + 1
+        return row
+
     def _dispatch_chunk(self) -> dict | None:
         """Enqueue one decode chunk against the device-resident state and
         return a pending record; the packed emissions are downloaded later
@@ -1129,19 +1374,26 @@ class DecodeEngine:
         fully hidden behind device compute."""
         cfg = self.config
         T = cfg.max_seq_len
+        psz = cfg.page_size
         st = self._state
         active = st["active"]
+        if not active.any():
+            return None
+        self._ensure_pages()
+        active = st["active"]  # _ensure_pages may preempt
         if not active.any():
             return None
         n_steps = cfg.decode_steps_per_call
         # host pos can be one in-flight chunk stale -> widen by 2 chunks
         max_pos = int(st["pos"][active].max())
         window = min(T, round_up_to_bucket(max_pos + 1 + 2 * n_steps, _WINDOW_STEP))
+        wp = min(self._maxp, -(-window // psz))
         capped = bool(((st["top_k"] > 0) | (st["top_p"] < 1.0))[active].any())
-        chunk = self._chunk_fn(n_steps, window, capped)
+        chunk = self._chunk_fn(n_steps, wp, capped)
         with jax.set_mesh(self.mesh):
+            pt = jnp.asarray(self._pt_host[:, :wp])
             self.cache, self._dev_state, self._rng, packed = chunk(
-                self.params, self.cache, self._dev_state, self._rng
+                self.params, self.cache, pt, self._dev_state, self._rng
             )
         return {
             "packed": packed,

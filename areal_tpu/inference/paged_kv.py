@@ -1,0 +1,197 @@
+"""Paged KV cache for the decode engine: block tables + page pool.
+
+The round-2 engine kept a dense per-slot slab ``[n_layers, S, T, KH, hd]`` —
+O(S·T) HBM regardless of use, which caps serving at short contexts (a 1.5B
+model at S=128, T=32K would need ~118 GB; VERDICT r02 "What's missing" #1).
+This module replaces it with the design SURVEY §7.1 names ("paged KV cache
+(Pallas), continuous batching, prefix cache") and the role SGLang's
+paged/radix allocator plays for the reference
+(reference blog/AReaL_v0_3.md:266 trains 27K-token generations on it):
+
+- **PagePool** (host): refcounted free-list allocator over a fixed pool of
+  ``n_pages`` pages of ``page_size`` tokens. Page 0 is reserved as a trash
+  page — padded prefill rows scatter there harmlessly.
+- **device cache**: ``k``/``v`` are ``[n_layers, KH, n_pages, page_size, hd]``
+  (the layout jax's TPU paged-attention kernel expects per layer). KV memory
+  is proportional to *used* tokens, not slots × max_len.
+- **page aliasing** replaces the dense engine's KV row copy for GRPO
+  prefix sharing: duplicate prompts share full prompt pages (refcount++)
+  and copy only the final partial page (copy-on-write boundary: decode
+  writes land at ``pos >= prompt_len``, so shared full pages are immutable).
+
+Attention over pages:
+- TPU: ``jax.experimental.pallas.ops.tpu.paged_attention`` (flash-style
+  kernel reading only each sequence's pages).
+- elsewhere (CPU tests / TP fallback): gather the window's pages and run the
+  same grouped masked einsum the dense engine used — identical numerics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PagePool:
+    """Host-side refcounted page allocator.
+
+    Page 0 is reserved (trash page for padded scatter targets); ``alloc``
+    never returns it. Not thread-safe — the decode loop is the only caller.
+    """
+
+    def __init__(self, n_pages: int):
+        assert n_pages >= 2, "pool needs at least one allocatable page"
+        self.n_pages = n_pages
+        self._free: list[int] = list(range(n_pages - 1, 0, -1))  # pop() -> 1 first
+        self._rc = np.zeros(n_pages, np.int32)
+        self._rc[0] = 1  # trash page: permanently held
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    @property
+    def used(self) -> int:
+        return self.n_pages - 1 - len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """Allocate n pages (rc=1 each) or None if the pool can't cover it."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._rc[pages] = 1
+        return pages
+
+    def ref(self, pages: list[int]) -> None:
+        """Increment refcounts (page aliasing for shared prefixes)."""
+        for p in pages:
+            assert self._rc[p] > 0, f"ref of unallocated page {p}"
+            self._rc[p] += 1
+
+    def free(self, pages: list[int]) -> None:
+        """Decrement refcounts; pages reaching zero return to the free list."""
+        for p in pages:
+            if p == 0:
+                continue
+            assert self._rc[p] > 0, f"double free of page {p}"
+            self._rc[p] -= 1
+            if self._rc[p] == 0:
+                self._free.append(p)
+
+
+def n_pages_for_budget(
+    budget_bytes: int, n_layers: int, num_kv_heads: int, page_size: int,
+    head_dim: int, itemsize: int,
+) -> int:
+    """Pages fitting a KV HBM budget (k+v across all layers per page)."""
+    page_bytes = 2 * n_layers * num_kv_heads * page_size * head_dim * itemsize
+    return max(2, budget_bytes // page_bytes)
+
+
+def init_paged_cache(
+    cfg, n_pages: int, page_size: int, dtype=None
+) -> dict:
+    """k/v page pools: [n_layers, KH, n_pages, page_size, hd]."""
+    dtype = dtype or cfg.jax_dtype
+    shape = (cfg.num_layers, cfg.num_kv_heads, n_pages, page_size, cfg.head_dim_)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def paged_cache_specs():
+    """PartitionSpecs: KV heads shard over the TP axis when they divide."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "k": P(None, "model", None, None, None),
+        "v": P(None, "model", None, None, None),
+    }
+
+
+def scatter_prefill(cache: dict, ks: jax.Array, vs: jax.Array, flat_pages: jax.Array, page_size: int) -> dict:
+    """Write a batched prefill's KV into pages.
+
+    ks/vs: [n_layers, A, bucket, KH, hd] from qwen.forward_prefill;
+    flat_pages: [A * ceil(bucket/page_size)] int32 page ids row-major per
+    prompt (padded positions -> trash page 0; duplicate trash writes are
+    benign). A bucket shorter than one page (tiny max_seq_len) pads up.
+    """
+    L, A, bucket, KH, hd = ks.shape
+    if bucket % page_size:
+        pad = page_size - bucket % page_size
+        ks = jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+        vs = jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+        bucket += pad
+    npg = bucket // page_size
+    for name, new in (("k", ks), ("v", vs)):
+        # [L, A, bucket, KH, hd] -> [L, KH, A*npg, page_size, hd]
+        r = jnp.transpose(new, (0, 3, 1, 2, 4)).reshape(
+            L, KH, A * npg, page_size, hd
+        )
+        cache[name] = cache[name].at[:, :, flat_pages].set(
+            r.astype(cache[name].dtype)
+        )
+    return cache
+
+
+def copy_pages(cache: dict, dst: jax.Array, src: jax.Array) -> dict:
+    """Copy page contents src[i] -> dst[i] (partial-page duplication for
+    prefix sharing; a few pages, all layers at once)."""
+    for name in ("k", "v"):
+        cache[name] = cache[name].at[:, :, dst].set(cache[name][:, :, src])
+    return cache
+
+
+def paged_attention_xla(
+    q: jax.Array,  # [S, H, hd]
+    k_pages: jax.Array,  # [KH, N, psz, hd] (one layer)
+    v_pages: jax.Array,
+    lengths: jax.Array,  # [S] int32 valid rows per slot
+    page_table: jax.Array,  # [S, wp] int32 (window's pages)
+) -> jax.Array:
+    """Reference/CPU path: gather the window's pages, grouped masked einsum —
+    numerically identical to the dense engine's attention."""
+    S, H, hd = q.shape
+    KH, _, psz, _ = k_pages.shape
+    G = H // KH
+    wp = page_table.shape[1]
+    W = wp * psz
+    # [KH, S, wp, psz, hd] -> [S, W, KH, hd]
+    kk = jnp.transpose(k_pages[:, page_table], (1, 2, 3, 0, 4)).reshape(
+        S, W, KH, hd
+    )
+    vv = jnp.transpose(v_pages[:, page_table], (1, 2, 3, 0, 4)).reshape(
+        S, W, KH, hd
+    )
+    qg = q.reshape(S, KH, G, hd)
+    logits = jnp.einsum("skgd,stkd->skgt", qg, kk).astype(jnp.float32) * hd**-0.5
+    valid = jnp.arange(W)[None, :] < lengths[:, None]
+    logits = jnp.where(valid[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(vv.dtype)
+    return jnp.einsum("skgt,stkd->skgd", probs, vv).reshape(S, H, hd)
+
+
+def paged_attention_tpu(
+    q: jax.Array,  # [S, H, hd]
+    k_pages: jax.Array,  # [KH, N, psz, hd]
+    v_pages: jax.Array,
+    lengths: jax.Array,  # [S] int32
+    page_table: jax.Array,  # [S, wp] int32
+    pages_per_compute_block: int = 4,
+) -> jax.Array:
+    """jax's Pallas TPU paged-attention kernel (grouped-query flash over the
+    page table; reads only each sequence's pages)."""
+    from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention
+
+    wp = page_table.shape[1]
+    ppcb = pages_per_compute_block
+    while wp % ppcb:
+        ppcb //= 2
+    return paged_attention(
+        q,
+        k_pages,
+        v_pages,
+        lengths,
+        page_table,
+        pages_per_compute_block=max(1, ppcb),
+    )

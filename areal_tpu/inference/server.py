@@ -52,6 +52,7 @@ def _req_from_json(d: dict) -> ModelRequest:
         rid=d.get("rid", ""),
         metadata=d.get("metadata", {}),
         image_data=image_data,
+        image_grid_thw=d.get("image_grid_thw"),
     )
 
 

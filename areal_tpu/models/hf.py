@@ -89,7 +89,40 @@ def load_params_from_hf(
             params["lm_head"] = put("lm_head", to_np(*name_map["lm_head"]))
         else:  # some exports tie silently
             params["lm_head"] = put("lm_head", to_np("model.embed_tokens.weight", False))
+    if cfg.vision is not None and "visual.patch_embed.proj.weight" in shards:
+        params["vision"] = _load_vision_params(cfg.vision, shards, to_np, put)
     return params, cfg
+
+
+def _load_vision_params(vcfg, shards, to_np, put) -> dict:
+    """Load a Qwen2-VL ``visual.*`` tower (the reference gets this from HF's
+    from_pretrained, fsdp_engine.py:289-341; here the name map lives in
+    models/vision.py next to the module structure it mirrors)."""
+    from areal_tpu.models.vision import hf_vision_name_map
+
+    name_map = hf_vision_name_map(vcfg)
+
+    def read(path: str) -> np.ndarray:
+        hf_name, transpose = name_map[path]
+        if hf_name == "visual.patch_embed.proj.weight":
+            # Conv3d kernel [D, C, T, p, p] == a [D, patch_dim] matmul
+            t = to_np(hf_name, False)
+            t = t.reshape(t.shape[0], -1).T
+            return np.ascontiguousarray(t)
+        return to_np(hf_name, transpose)
+
+    layers = {}
+    layer_names = {p.split("/")[2] for p in name_map if p.startswith("layers/")}
+    for name in layer_names:
+        stacked = np.stack(
+            [read(f"layers/{i}/{name}") for i in range(vcfg.num_layers)]
+        )
+        layers[name] = put(f"vision/layers/{name}", stacked)
+    out = {"layers": layers}
+    for path in name_map:
+        if not path.startswith("layers/"):
+            out[path] = put(f"vision/{path}", read(path))
+    return out
 
 
 def write_hf_config(cfg: "ModelConfig", path: str) -> None:

@@ -333,13 +333,19 @@ def param_partition_specs(cfg: ModelConfig, fsdp_axis: str | None = "fsdp") -> d
         layer_specs.update(q_norm=P(None, None), k_norm=P(None, None))
     if cfg.lora_rank > 0:
         layer_specs.update(lora_partition_specs(cfg, fsdp_axis))
+    # vocab-sharded over (fsdp, model), D replicated: the distributed lookup
+    # in _embed_lookup (zero-3 all_gather over fsdp + masked psum over
+    # model) and the vocab-parallel logprob reduction both key off this
+    # layout; sharding D instead made XLA replicate the whole table per
+    # step (MULTICHIP_r02 involuntary-remat warning)
+    vocab_spec = P((f, "model") if f else "model", None)
     specs = {
-        "embed": P("model", f),
+        "embed": vocab_spec,
         "layers": layer_specs,
         "final_norm": P(None),
     }
     if not cfg.tie_word_embeddings:
-        specs["lm_head"] = P("model", f)
+        specs["lm_head"] = vocab_spec
     if cfg.vision is not None:
         from areal_tpu.models.vision import vision_partition_specs
 
@@ -350,6 +356,78 @@ def param_partition_specs(cfg: ModelConfig, fsdp_axis: str | None = "fsdp") -> d
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
+
+
+def _embed_lookup(
+    embed: jax.Array, ids: jax.Array, dtype, batch_sharded: bool = True
+) -> jax.Array:
+    """Vocab-parallel embedding lookup.
+
+    ``embed`` is vocab-sharded over ("fsdp", "model") — see
+    ``param_partition_specs``. A plain ``jnp.take`` from a sharded table
+    makes XLA SPMD replicate the whole [V, D] table on every step
+    ("Involuntary full rematerialization", MULTICHIP_r02 — a step-time cliff
+    at 151k x D). Instead we express the distributed lookup explicitly:
+
+    - zero-3 leg: ``all_gather`` the local rows over "fsdp" (the same
+      per-use param gather FSDP does for every other weight),
+    - TP leg: masked local take + ``psum`` over "model" (each rank resolves
+      only the ids in its vocab shard; out-of-shard rows contribute zeros).
+
+    Batch dims of ``ids`` stay sharded over ("data","fsdp")/"seq" throughout
+    — no replication anywhere. Falls back to ``jnp.take`` when no mesh is
+    active (single-chip serving, CPU tests)."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        axes = dict(mesh.shape) if mesh is not None else {}
+    except Exception:  # noqa: BLE001 — no mesh context
+        axes = {}
+    f_sz, m_sz = axes.get("fsdp", 1), axes.get("model", 1)
+    if f_sz * m_sz == 1 or embed.shape[0] % (f_sz * m_sz):
+        return jnp.take(embed, ids, axis=0).astype(dtype)
+    vloc = embed.shape[0] // (f_sz * m_sz)
+
+    def local_grid(emb, ids_l):
+        # ids vary over (data, fsdp, seq): zero-3 leg first — all_gather the
+        # fsdp vocab blocks so each rank holds the rows of its "model" index
+        # (global row (b*m_sz + m_idx)*vloc + r sits at gathered row
+        # b*vloc + r; vocab order is fsdp-major, model-minor) — then masked
+        # local take + psum over "model" only.
+        emb = jax.lax.all_gather(emb, "fsdp", axis=0, tiled=True)
+        m_idx = jax.lax.axis_index("model")
+        blk = ids_l // vloc
+        ok = (blk % m_sz) == m_idx
+        pos = (blk // m_sz) * vloc + ids_l % vloc
+        rows = jnp.take(emb, jnp.clip(pos, 0, emb.shape[0] - 1), axis=0)
+        rows = jnp.where(ok[..., None], rows, 0).astype(dtype)
+        return jax.lax.psum(rows, "model")
+
+    def local_flat(emb, ids_l):
+        # ids replicated (decode steps / serving prefill, where the engine
+        # replicates work across spare mesh axes): no gather needed — each
+        # rank resolves ids inside its own (fsdp x model) vocab block and
+        # one psum over both axes assembles the rows (replicated output).
+        f_idx = jax.lax.axis_index("fsdp")
+        m_idx = jax.lax.axis_index("model")
+        mine = f_idx * m_sz + m_idx
+        blk = ids_l // vloc
+        ok = blk == mine
+        rows = jnp.take(emb, jnp.clip(ids_l % vloc, 0, vloc - 1), axis=0)
+        rows = jnp.where(ok[..., None], rows, 0).astype(dtype)
+        return jax.lax.psum(rows, ("fsdp", "model"))
+
+    if batch_sharded and ids.ndim == 2:  # [G, L] training grids
+        return jax.shard_map(
+            local_grid,
+            in_specs=(P(("fsdp", "model"), None), P(BATCH_AXES, "seq")),
+            out_specs=P(BATCH_AXES, "seq", None),
+        )(embed, ids)
+    reps = (None,) * ids.ndim
+    return jax.shard_map(  # replicated ids: decode steps, serving prefill
+        local_flat,
+        in_specs=(P(("fsdp", "model"), None), P(*reps)),
+        out_specs=P(*reps, None),
+    )(embed, ids)
 
 
 def _rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
@@ -502,7 +580,7 @@ def forward(
     image_embeds: jax.Array | None = None,  # [G, L, D] precomputed vision embeds
 ) -> jax.Array:
     """Decoder body -> final hidden states [G, L, D] (+ aux when asked)."""
-    x = jnp.take(params["embed"], input_ids, axis=0).astype(cfg.jax_dtype)
+    x = _embed_lookup(params["embed"], input_ids, cfg.jax_dtype)
     if image_embeds is not None and cfg.image_token_id >= 0:
         # VLM: <|image_pad|> positions take the vision tower's output
         # (precomputed and positioned by the caller; models/vision.py)
@@ -697,7 +775,9 @@ def forward_prefill(
     """
     if seg is None:
         seg = jnp.ones_like(input_ids)
-    x = jnp.take(params["embed"], input_ids, axis=0).astype(cfg.jax_dtype)
+    # serving prefill runs replicated over any spare mesh axes (the decode
+    # engine's data axis absorbs leftover devices) — ids are not sharded
+    x = _embed_lookup(params["embed"], input_ids, cfg.jax_dtype, batch_sharded=False)
     if image_embeds is not None and cfg.image_token_id >= 0:
         img_pos = (input_ids == cfg.image_token_id)[..., None]
         x = jnp.where(img_pos, image_embeds.astype(cfg.jax_dtype), x)
@@ -735,6 +815,86 @@ def forward_prefill(
     return hidden, ks, vs
 
 
+def forward_decode_paged(
+    params: dict,
+    cfg: ModelConfig,
+    ids: jax.Array,  # [S] current tokens
+    positions: jax.Array,  # [S] rope positions of these tokens
+    cache: dict,  # k/v [n_layers, KH, n_pages, page_size, hd]
+    page_table: jax.Array,  # [S, wp] int32 page ids covering the window
+    *,
+    page_size: int,
+    use_kernel: bool = True,
+) -> tuple[jax.Array, dict]:
+    """One incremental step for all S slots over the *paged* KV cache.
+
+    The current token's k/v lands at page ``table[s, pos//psz]`` row
+    ``pos % psz``; attention reads each slot's pages via the TPU
+    paged-attention kernel (inference/paged_kv.py), or a gather + grouped
+    einsum off-TPU. This is the serving design SURVEY §7.1 specifies in
+    place of the reference's SGLang paged/radix attention
+    (reference blog/AReaL_v0_3.md:266): KV HBM ∝ used tokens, so 4K–32K
+    contexts fit at real concurrency (VERDICT r02 missing #1).
+    """
+    from areal_tpu.inference import paged_kv
+
+    S = ids.shape[0]
+    H, KH, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    x = _embed_lookup(params["embed"], ids, cfg.jax_dtype)  # [S, D]
+    pos1 = positions[:, None]
+    lengths = (positions + 1).astype(jnp.int32)
+    slot = jnp.arange(S)
+    write_page = page_table[slot, positions // page_size]  # [S]
+    write_off = positions % page_size  # [S]
+
+    def body(carry, scanned):
+        x, k_all, v_all = carry
+        layer, li = scanned
+        h = _rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+        q = h @ layer["wq"]
+        k = h @ layer["wk"]
+        v = h @ layer["wv"]
+        if cfg.attention_bias:
+            q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+        q = q.reshape(S, 1, H, hd)
+        k = k.reshape(S, 1, KH, hd)
+        v = v.reshape(S, 1, KH, hd)
+        if cfg.qk_norm:
+            q = _rms_norm(q, layer["q_norm"], cfg.rms_norm_eps)
+            k = _rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
+        q = _rope(q, pos1, cfg.rope_theta)[:, 0]  # [S, H, hd]
+        k = _rope(k, pos1, cfg.rope_theta)[:, 0]  # [S, KH, hd]
+        v = v[:, 0]
+        # write the step's rows into (li, :, page[s], offset[s]). The traced
+        # ``li`` makes all three advanced indices broadcast together and the
+        # slice dim (KH) stay behind them -> value layout [S, KH, hd].
+        k_all = k_all.at[li, :, write_page, write_off].set(k.astype(k_all.dtype))
+        v_all = v_all.at[li, :, write_page, write_off].set(v.astype(v_all.dtype))
+        kp = jax.lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)
+        vp = jax.lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)
+        if use_kernel:
+            attn = paged_kv.paged_attention_tpu(
+                q, kp, vp, lengths, page_table
+            )
+        else:
+            attn = paged_kv.paged_attention_xla(
+                q, kp, vp, lengths, page_table
+            )
+        attn = attn.reshape(S, H * hd).astype(x.dtype)
+        x = x + attn @ layer["wo"]
+        h = _rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + _ffn(cfg, h, layer)
+        return (x, k_all, v_all), None
+
+    (x, ks, vs), _ = jax.lax.scan(
+        body,
+        (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+    )
+    hidden = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return hidden, {"k": ks, "v": vs}
+
+
 def forward_decode(
     params: dict,
     cfg: ModelConfig,
@@ -762,7 +922,7 @@ def forward_decode(
     W = T if window is None else min(window, T)
     H, KH, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
     G = H // KH
-    x = jnp.take(params["embed"], ids, axis=0).astype(cfg.jax_dtype)  # [S, D]
+    x = _embed_lookup(params["embed"], ids, cfg.jax_dtype)  # [S, D]
     pos1 = positions[:, None]  # [S, 1]
     slot_idx = jnp.arange(S)
     valid = jnp.arange(W)[None, :] <= cache_lens[:, None]  # [S, W]

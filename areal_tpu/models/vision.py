@@ -1,13 +1,21 @@
 """Vision tower for VLM training/serving (reference VLM role:
 fsdp_utils/parallel.py:217-365 VLM special-casing + workflow/vision_rlvr.py).
 
-A compact Qwen2-VL-shaped ViT, TPU-first: pixel patches arrive pre-extracted
-by the HF processor as a flat [N_patches, patch_dim] array (patch_dim =
-channels·temporal·patch²), pass through pre-norm transformer blocks (full
-attention — MXU-friendly dense [N, N]), and a spatial merger MLP folds
-``merge**2`` neighboring patches into one LLM-space embedding. The LLM
-scatters those embeddings into its <|image_pad|> token positions
+A Qwen2-VL-compatible ViT, TPU-first: pixel patches arrive pre-extracted by
+the HF processor as a flat [N_patches, patch_dim] array (patch_dim =
+channels·temporal·patch², the Conv3d kernel flattened to a matmul), pass
+through pre-norm transformer blocks (full attention — MXU-friendly dense
+[N, N]) with Qwen2-VL's 2-D rotary position embedding (half the rotary dim
+rotates by the patch's grid row, half by its column), and a spatial merger
+MLP folds ``merge**2`` neighboring patches into one LLM-space embedding.
+The LLM scatters those embeddings into its <|image_pad|> token positions
 (qwen.forward image_embeds path).
+
+Structure matches HF's ``Qwen2VisionTransformerPretrainedModel`` exactly
+(LayerNorm with bias, biased qkv/proj/fc projections, quick-GELU blocks,
+exact-GELU merger) so real ``visual.*`` checkpoints load and reproduce HF
+outputs — see ``hf_vision_name_map`` and
+tests/test_vision.py::test_hf_vision_parity.
 
 Design choice (documented limitation): during RL the tower is FROZEN and
 embeddings are precomputed once per batch at the data boundary — the packed
@@ -22,6 +30,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 
@@ -35,6 +44,7 @@ class VisionConfig:
     out_hidden_size: int = 1536  # LLM hidden
     spatial_merge: int = 2  # merge^2 patches -> 1 LLM token
     rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
 
     @property
     def head_dim(self) -> int:
@@ -54,13 +64,16 @@ def init_vision_params(rng: jax.Array, cfg: VisionConfig, dtype=jnp.float32) -> 
         ).astype(dtype)
 
     n = cfg.num_layers
-    D, F, H = cfg.hidden_size, cfg.intermediate_size, cfg.num_heads
+    D, F = cfg.hidden_size, cfg.intermediate_size
     layers = {
         "norm1": jnp.ones((n, D), dtype),
+        "norm1_b": jnp.zeros((n, D), dtype),
         "norm2": jnp.ones((n, D), dtype),
+        "norm2_b": jnp.zeros((n, D), dtype),
         "wqkv": dense(next(keys), (n, D, 3 * D)),
         "bqkv": jnp.zeros((n, 3 * D), dtype),
         "wo": dense(next(keys), (n, D, D)),
+        "bo": jnp.zeros((n, D), dtype),
         "w_fc1": dense(next(keys), (n, D, F)),
         "b_fc1": jnp.zeros((n, F), dtype),
         "w_fc2": dense(next(keys), (n, F, D)),
@@ -70,37 +83,88 @@ def init_vision_params(rng: jax.Array, cfg: VisionConfig, dtype=jnp.float32) -> 
         "patch_embed": dense(next(keys), (cfg.patch_dim, D)),
         "layers": layers,
         "merger_norm": jnp.ones((D,), dtype),
+        "merger_norm_b": jnp.zeros((D,), dtype),
         "merger_fc1": dense(next(keys), (cfg.merge_dim, cfg.merge_dim)),
+        "merger_b1": jnp.zeros((cfg.merge_dim,), dtype),
         "merger_fc2": dense(next(keys), (cfg.merge_dim, cfg.out_hidden_size)),
+        "merger_b2": jnp.zeros((cfg.out_hidden_size,), dtype),
     }
 
 
 def vision_partition_specs() -> dict:
-    """FSDP-shard the big projections; small norms replicated."""
+    """FSDP-shard the big projections; small norms/biases replicated."""
     f = "fsdp"
     return {
         "patch_embed": P(f, None),
         "layers": {
             "norm1": P(None, None),
+            "norm1_b": P(None, None),
             "norm2": P(None, None),
+            "norm2_b": P(None, None),
             "wqkv": P(None, f, "model"),
             "bqkv": P(None, "model"),
             "wo": P(None, "model", f),
+            "bo": P(None, None),
             "w_fc1": P(None, f, "model"),
             "b_fc1": P(None, "model"),
             "w_fc2": P(None, "model", f),
             "b_fc2": P(None, None),
         },
         "merger_norm": P(None),
+        "merger_norm_b": P(None),
         "merger_fc1": P(f, None),
+        "merger_b1": P(None),
         "merger_fc2": P(None, f),
+        "merger_b2": P(None),
     }
 
 
-def _ln(x, w, eps):
-    m = x.mean(-1, keepdims=True)
-    v = ((x - m) ** 2).mean(-1, keepdims=True)
-    return (x - m) * jax.lax.rsqrt(v + eps) * w
+def grid_pos_ids(grid_thw, merge: int) -> np.ndarray:
+    """Per-patch (row, col) grid positions for Qwen2-VL's 2-D rope.
+
+    ``grid_thw``: [n_images, 3] (t, h, w). The HF processor flattens patches
+    in **merge-block-major** order — (h/m, w/m, m, m) — so position ids are
+    emitted in the same order (HF rot_pos_emb). Returns [N_patches, 2]."""
+    chunks = []
+    for t, h, w in np.asarray(grid_thw, np.int64):
+        hh = np.arange(h, dtype=np.int32)[:, None].repeat(w, 1)
+        ww = np.arange(w, dtype=np.int32)[None, :].repeat(h, 0)
+        blk = lambda a: (
+            a.reshape(h // merge, merge, w // merge, merge)
+            .transpose(0, 2, 1, 3)
+            .reshape(-1)
+        )
+        pos = np.stack([blk(hh), blk(ww)], axis=-1)  # [h*w, 2]
+        chunks.append(np.tile(pos, (int(t), 1)))
+    return np.concatenate(chunks, axis=0)
+
+
+def _ln(x, w, b, eps):
+    x32 = x.astype(jnp.float32)
+    m = x32.mean(-1, keepdims=True)
+    v = ((x32 - m) ** 2).mean(-1, keepdims=True)
+    return (((x32 - m) * jax.lax.rsqrt(v + eps)).astype(x.dtype)) * w + b
+
+
+def _rope_2d(x: jax.Array, pos_ids: jax.Array, theta: float) -> jax.Array:
+    """Qwen2-VL vision rope: x [N, H, hd]; pos_ids [N, 2] (row, col).
+    Angles: row-driven for the first hd/4 freqs, col-driven for the next
+    hd/4, then duplicated — applied rotate-half style over hd/2."""
+    hd = x.shape[-1]
+    quarter = hd // 4
+    inv = theta ** (-jnp.arange(0, quarter, dtype=jnp.float32) / quarter)
+    ang_h = pos_ids[:, 0:1].astype(jnp.float32) * inv[None]  # [N, hd/4]
+    ang_w = pos_ids[:, 1:2].astype(jnp.float32) * inv[None]
+    ang = jnp.concatenate([ang_h, ang_w], axis=-1)  # [N, hd/2]
+    cos = jnp.cos(ang)[:, None, :]  # broadcast over heads
+    sin = jnp.sin(ang)[:, None, :]
+    x1, x2 = x[..., : hd // 2], x[..., hd // 2 :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
 
 
 def vision_forward(
@@ -108,12 +172,15 @@ def vision_forward(
     cfg: VisionConfig,
     pixel_values: jax.Array,  # [N_patches, patch_dim] (N divisible by merge^2)
     patch_mask: jax.Array | None = None,  # [N_patches] bool; False = padding
+    pos_ids: jax.Array | None = None,  # [N_patches, 2] grid (row, col)
 ) -> jax.Array:
     """-> [N_patches / merge^2, out_hidden] image embeddings."""
     N = pixel_values.shape[0]
     D, H, hd = cfg.hidden_size, cfg.num_heads, cfg.head_dim
     assert N % cfg.spatial_merge**2 == 0, (N, cfg.spatial_merge)
     x = pixel_values.astype(params["patch_embed"].dtype) @ params["patch_embed"]
+    if pos_ids is None:
+        pos_ids = jnp.zeros((N, 2), jnp.int32)
 
     if patch_mask is None:
         attn_ok = None
@@ -121,25 +188,65 @@ def vision_forward(
         attn_ok = patch_mask[None, :] & patch_mask[:, None]  # [N, N]
 
     def block(x, layer):
-        h = _ln(x, layer["norm1"], cfg.rms_norm_eps)
+        h = _ln(x, layer["norm1"], layer["norm1_b"], cfg.rms_norm_eps)
         qkv = h @ layer["wqkv"] + layer["bqkv"]
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(N, H, hd)
-        k = k.reshape(N, H, hd)
+        q = _rope_2d(q.reshape(N, H, hd), pos_ids, cfg.rope_theta)
+        k = _rope_2d(k.reshape(N, H, hd), pos_ids, cfg.rope_theta)
         v = v.reshape(N, H, hd)
         logits = jnp.einsum("qhd,khd->hqk", q, k).astype(jnp.float32) * hd**-0.5
         if attn_ok is not None:
             logits = jnp.where(attn_ok[None], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
         attn = jnp.einsum("hqk,khd->qhd", probs, v).reshape(N, D)
-        x = x + attn @ layer["wo"]
-        h = _ln(x, layer["norm2"], cfg.rms_norm_eps)
-        h = jax.nn.gelu(h @ layer["w_fc1"] + layer["b_fc1"])
+        x = x + attn @ layer["wo"] + layer["bo"]
+        h = _ln(x, layer["norm2"], layer["norm2_b"], cfg.rms_norm_eps)
+        h = _quick_gelu(h @ layer["w_fc1"] + layer["b_fc1"])
         x = x + h @ layer["w_fc2"] + layer["b_fc2"]
         return x, None
 
     x, _ = jax.lax.scan(block, x, params["layers"])
-    x = _ln(x, params["merger_norm"], cfg.rms_norm_eps)
+    x = _ln(x, params["merger_norm"], params["merger_norm_b"], cfg.rms_norm_eps)
     x = x.reshape(N // cfg.spatial_merge**2, cfg.merge_dim)
-    x = jax.nn.gelu(x @ params["merger_fc1"])
-    return x @ params["merger_fc2"]  # [N/merge^2, out_hidden]
+    x = jax.nn.gelu(x @ params["merger_fc1"] + params["merger_b1"], approximate=False)
+    return x @ params["merger_fc2"] + params["merger_b2"]
+
+
+# ---------------------------------------------------------------------------
+# HF name mapping (visual.* of Qwen2-VL checkpoints)
+# ---------------------------------------------------------------------------
+
+# our layer param -> (HF suffix under visual.blocks.{i}., transpose)
+_HF_VISION_LAYER_MAP = {
+    "norm1": ("norm1.weight", False),
+    "norm1_b": ("norm1.bias", False),
+    "norm2": ("norm2.weight", False),
+    "norm2_b": ("norm2.bias", False),
+    "wqkv": ("attn.qkv.weight", True),
+    "bqkv": ("attn.qkv.bias", False),
+    "wo": ("attn.proj.weight", True),
+    "bo": ("attn.proj.bias", False),
+    "w_fc1": ("mlp.fc1.weight", True),
+    "b_fc1": ("mlp.fc1.bias", False),
+    "w_fc2": ("mlp.fc2.weight", True),
+    "b_fc2": ("mlp.fc2.bias", False),
+}
+
+
+def hf_vision_name_map(cfg: VisionConfig) -> dict[str, tuple[str, bool]]:
+    """Flat map: vision param path -> (HF name, transpose). The Conv3d
+    patch_embed kernel [D, C, T, P, P] is handled specially by the loader
+    (flatten to [D, patch_dim] then transpose)."""
+    out: dict[str, tuple[str, bool]] = {
+        "patch_embed": ("visual.patch_embed.proj.weight", True),
+        "merger_norm": ("visual.merger.ln_q.weight", False),
+        "merger_norm_b": ("visual.merger.ln_q.bias", False),
+        "merger_fc1": ("visual.merger.mlp.0.weight", True),
+        "merger_b1": ("visual.merger.mlp.0.bias", False),
+        "merger_fc2": ("visual.merger.mlp.2.weight", True),
+        "merger_b2": ("visual.merger.mlp.2.bias", False),
+    }
+    for name, (suffix, transpose) in _HF_VISION_LAYER_MAP.items():
+        for i in range(cfg.num_layers):
+            out[f"layers/{i}/{name}"] = (f"visual.blocks.{i}.{suffix}", transpose)
+    return out

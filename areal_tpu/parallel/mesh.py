@@ -83,3 +83,12 @@ def param_sharding(mesh: Mesh, spec_tree):
         spec_tree,
         is_leaf=lambda x: isinstance(x, P),
     )
+
+
+def shard_for_path(shardings: dict, path: str):
+    """Walk a PartitionSpec/NamedSharding tree by a flat "a/b/c" param path
+    (works for the stacked-layer text tree AND the nested vision tree)."""
+    node = shardings
+    for seg in path.split("/"):
+        node = node[seg]
+    return node

@@ -32,6 +32,7 @@ _NON_TOKEN_KEYS = (
     "seq_no_eos_mask",
     "pixel_values",
     "pixel_counts",
+    "pixel_pos_ids",
 )
 
 

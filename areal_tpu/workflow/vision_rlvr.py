@@ -63,15 +63,19 @@ class VisionRLVRWorkflow(RolloutWorkflow):
         pixel_values = np.asarray(out["pixel_values"], np.float32)
         if pixel_values.ndim == 3:  # [1, P, pd]
             pixel_values = pixel_values[0]
-        return input_ids, pixel_values
+        grid_thw = out.get("image_grid_thw")
+        if grid_thw is not None:
+            grid_thw = np.asarray(grid_thw).reshape(-1, 3)
+        return input_ids, pixel_values, grid_thw
 
-    async def _one_sample(self, engine, prompt_ids, pixel_values, data):
+    async def _one_sample(self, engine, prompt_ids, pixel_values, grid_thw, data):
         from areal_tpu.utils import perf_tracer
 
         req = ModelRequest(
             rid=uuid.uuid4().hex,
             input_ids=prompt_ids,
             image_data=pixel_values,
+            image_grid_thw=grid_thw,
             gconfig=self.gconfig.new(n_samples=1),
         )
         with perf_tracer.get_session_tracer().phase("generate"):
@@ -114,19 +118,34 @@ class VisionRLVRWorkflow(RolloutWorkflow):
             # these (reference multi_modal_input)
             "pixel_values": pixel_values,
             "pixel_counts": np.int32(pixel_values.shape[0]),
+            # per-patch grid (row, col) for the tower's 2-D rope — ragged
+            # like pixel_values, so batching machinery treats them alike
+            "pixel_pos_ids": self._pos_ids(pixel_values, grid_thw),
             "seq_no_eos_mask": np.bool_(resp.stop_reason == "length"),
         }
+
+    def _pos_ids(self, pixel_values, grid_thw) -> np.ndarray:
+        if grid_thw is None:
+            return np.zeros((pixel_values.shape[0], 2), np.int32)
+        from areal_tpu.models.vision import grid_pos_ids
+
+        merge = getattr(
+            getattr(self.processor, "image_processor", None), "merge_size", 2
+        )
+        return grid_pos_ids(grid_thw, merge)
 
     async def arun_episode(self, engine, data: dict):
         import asyncio
 
-        prompt_ids, pixel_values = self._process(data)
+        prompt_ids, pixel_values, grid_thw = self._process(data)
         # GRPO group: n_samples completions of the same prompt (same fan-out
         # as RLVRWorkflow; group_reward_norm depends on it)
         return list(
             await asyncio.gather(
                 *[
-                    self._one_sample(engine, prompt_ids, pixel_values, data)
+                    self._one_sample(
+                        engine, prompt_ids, pixel_values, grid_thw, data
+                    )
                     for _ in range(self.gconfig.n_samples)
                 ]
             )

@@ -201,6 +201,74 @@ def test_ragged_pixel_batching():
     assert merged["input_ids"].shape == (3, 9)
 
 
+def test_hf_vision_parity():
+    """Our tower must reproduce HF's Qwen2VisionTransformerPretrainedModel
+    bit-for-bit-ish from the same weights (the real-checkpoint load path;
+    reference gets this via HF from_pretrained, fsdp_engine.py:289-341)."""
+    torch = pytest.importorskip("torch")
+    tr = pytest.importorskip("transformers")
+    from transformers.models.qwen2_vl.configuration_qwen2_vl import (
+        Qwen2VLVisionConfig,
+    )
+    from transformers.models.qwen2_vl.modeling_qwen2_vl import (
+        Qwen2VisionTransformerPretrainedModel,
+    )
+
+    from areal_tpu.models.hf import _load_vision_params
+    from areal_tpu.models.vision import grid_pos_ids
+
+    hf_cfg = Qwen2VLVisionConfig(
+        depth=2,
+        embed_dim=64,
+        num_heads=4,
+        mlp_ratio=2,
+        in_channels=3,
+        patch_size=4,
+        temporal_patch_size=2,
+        spatial_merge_size=2,
+        hidden_size=32,
+    )
+    hf_cfg._attn_implementation = "eager"
+    torch.manual_seed(0)
+    hf_model = Qwen2VisionTransformerPretrainedModel(hf_cfg).eval().float()
+    sd = {f"visual.{k}": v.detach().numpy() for k, v in hf_model.state_dict().items()}
+
+    vcfg = VisionConfig(
+        patch_dim=3 * 2 * 4 * 4,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        out_hidden_size=32,
+        spatial_merge=2,
+    )
+
+    def to_np(name, transpose):
+        t = np.asarray(sd[name], np.float32)
+        if transpose:
+            t = np.ascontiguousarray(t.T)
+        return t
+
+    params = _load_vision_params(
+        vcfg, sd, to_np, lambda p, a: jnp.asarray(a, jnp.float32)
+    )
+
+    grid = np.array([[1, 4, 8]], np.int64)
+    N = 32
+    rng = np.random.default_rng(0)
+    px = rng.normal(0, 1, (N, vcfg.patch_dim)).astype(np.float32)
+    with torch.no_grad():
+        ref = hf_model(
+            torch.from_numpy(px), grid_thw=torch.from_numpy(grid)
+        ).numpy()
+    pos = grid_pos_ids(grid, vcfg.spatial_merge)
+    ours = np.asarray(
+        vision_forward(params, vcfg, jnp.asarray(px), None, jnp.asarray(pos))
+    )
+    assert ours.shape == ref.shape
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
 def test_vlm_hf_config_parsing(tmp_path):
     import json
 
