@@ -421,6 +421,27 @@ class DecodeEngine:
                     state_s, jax.ShapeDtypeStruct((n, upd_row), jnp.float32)
                 ).compile()
                 n_prog += 1
+            # GRPO prefix-sharing page copies (dup counts pad to powers of
+            # two up to next_pow2(S-1)) — a cold compile here would stall
+            # all slots on the first identical-prompt group
+            from areal_tpu.inference import paged_kv
+
+            n = 1
+            while True:
+                key = ("pagecopy", n)
+                if key not in self._fn_cache:
+                    self._fn_cache[key] = jax.jit(
+                        paged_kv.copy_pages, donate_argnames=("cache",)
+                    )
+                self._fn_cache[key].lower(
+                    cache_s,
+                    jax.ShapeDtypeStruct((n,), jnp.int32),
+                    jax.ShapeDtypeStruct((n,), jnp.int32),
+                ).compile()
+                n_prog += 1
+                if n >= max(1, cfg.max_batch_size - 1):
+                    break
+                n *= 2
             for wp in self._reachable_chunk_wps():
                 for capped in (False, True):
                     self._chunk_fn(cfg.decode_steps_per_call, wp, capped).lower(
@@ -974,7 +995,10 @@ class DecodeEngine:
         ids = list(task.req.input_ids)
         if ids != p.full_ids:
             # rid reused with different content — drop the stale parking
+            # and release its pages (the slot's own list was emptied at
+            # park time, so nothing else frees them)
             del self._parked[rid]
+            self.pool.free(p.pages)
             return None
         del self._parked[rid]
         slot = p.slot
@@ -1300,6 +1324,7 @@ class DecodeEngine:
         psz = self.config.page_size
         n_steps = self.config.decode_steps_per_call
         deact_rows: list[np.ndarray] = []
+        clamp_rows: list[tuple[int, int]] = []  # (slot, remaining cap)
         for slot in np.nonzero(st["active"])[0]:
             if not st["active"][slot]:  # preempted by an earlier iteration
                 continue
@@ -1315,27 +1340,25 @@ class DecodeEngine:
                     victim = self._preempt_victim()
                     if victim is None or victim == slot:
                         # cannot free enough. If the pages this slot already
-                        # holds cover further decoding, freeze its budget to
-                        # that coverage (it then deactivates inside a chunk
-                        # and _drain finishes it by length); if they don't,
-                        # freezing would deactivate it with no chunk ever
-                        # crediting it — abort it properly instead.
-                        covered = len(pages) * psz - 1 - int(st["pos"][slot])
+                        # holds cover further decoding EVEN IF the device is
+                        # a full in-flight chunk ahead of the host view,
+                        # clamp its remaining budget to that coverage via a
+                        # remaining-only scatter (a full _pack_row would
+                        # rewind device pos/ids by up to n_steps — the
+                        # device state is authoritative); it then finishes
+                        # by length inside a chunk. Otherwise abort it.
+                        covered = (
+                            len(pages) * psz
+                            - 1
+                            - (int(st["pos"][slot]) + n_steps)
+                        )
                         if covered <= 0:
                             deact_rows.append(self._preempt(int(slot)))
                             break
                         st["remaining"][slot] = min(
                             int(st["remaining"][slot]), covered
                         )
-                        deact_rows.append(
-                            self._pack_row(
-                                int(slot),
-                                int(st["ids"][slot]),
-                                int(st["pos"][slot]),
-                                True,
-                                int(st["remaining"][slot]),
-                            )
-                        )
+                        clamp_rows.append((int(slot), covered))
                         break
                     deact_rows.append(self._preempt(victim))
                     continue
@@ -1343,6 +1366,36 @@ class DecodeEngine:
                 pages.extend(got)
         if deact_rows:
             self._apply_slot_updates(deact_rows)
+        if clamp_rows:
+            self._apply_remaining_clamp(clamp_rows)
+
+    def _apply_remaining_clamp(self, rows: list[tuple[int, int]]) -> None:
+        """Scatter remaining := min(remaining, cap) for the given slots,
+        touching nothing else (pos/ids stay device-authoritative). Padded
+        rows repeat row 0 (idempotent: min with the same cap)."""
+        n = 1
+        while n < len(rows):
+            n *= 2
+        upd = np.asarray(rows + [rows[0]] * (n - len(rows)), np.int32)
+        key = ("clamp", n)
+        if key not in self._fn_cache:
+
+            def clamp(state, upd):
+                sl = upd[:, 0]
+                cap = upd[:, 1]
+                state = dict(state)
+                new_rem = jnp.minimum(state["remaining"][sl], cap)
+                state["remaining"] = state["remaining"].at[sl].set(new_rem)
+                state["active"] = (
+                    state["active"].at[sl].set(state["active"][sl] & (new_rem > 0))
+                )
+                return state
+
+            self._fn_cache[key] = jax.jit(clamp, donate_argnames=("state",))
+        with jax.set_mesh(self.mesh):
+            self._dev_state = self._fn_cache[key](
+                self._dev_state, jnp.asarray(upd)
+            )
 
     def _preempt_victim(self) -> int | None:
         """Active slot with the most remaining generation budget (frees the

@@ -737,25 +737,10 @@ def make_causal_inputs(
     return labels, valid
 
 
+
 # ---------------------------------------------------------------------------
 # incremental decoding (inference server path)
 # ---------------------------------------------------------------------------
-
-
-def init_kv_cache(cfg: ModelConfig, n_slots: int, max_len: int, dtype=None) -> dict:
-    """Slot-based KV cache: k/v are [n_layers, S, T, KH, hd]."""
-    dtype = dtype or cfg.jax_dtype
-    shape = (cfg.num_layers, n_slots, max_len, cfg.num_kv_heads, cfg.head_dim_)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
-
-
-def kv_cache_specs() -> dict:
-    """PartitionSpecs for the cache (kv heads on the model axis when they
-    divide; callers fall back to replicated otherwise)."""
-    return {
-        "k": P(None, None, None, "model", None),
-        "v": P(None, None, None, "model", None),
-    }
 
 
 def forward_prefill(
@@ -895,82 +880,3 @@ def forward_decode_paged(
     return hidden, {"k": ks, "v": vs}
 
 
-def forward_decode(
-    params: dict,
-    cfg: ModelConfig,
-    ids: jax.Array,  # [S] current tokens
-    positions: jax.Array,  # [S] rope positions of these tokens
-    cache: dict,  # k/v [n_layers, S, T, KH, hd]
-    cache_lens: jax.Array,  # [S] number of valid cache rows (incl. this token's slot)
-    window: int | None = None,  # static attention span (<= T); None = full T
-) -> tuple[jax.Array, dict]:
-    """One incremental step for all S slots -> (hidden [S, D], updated cache).
-
-    The current token's k/v is written at row ``cache_lens`` per slot;
-    attention spans rows [0, cache_lens].
-
-    TPU HBM-bandwidth design (VERDICT round-1 "What's weak" #2): the cache
-    stays at KH kv-heads and attention is a *grouped* einsum — q reshaped to
-    [S, KH, H/KH, hd] contracts directly against the [S, t, KH, hd] cache.
-    The round-1 ``jnp.repeat`` to H heads multiplied cache read traffic by
-    H/KH (6x at Qwen2.5-1.5B). ``window`` statically bounds the attention
-    span so short fills don't pay full-T reads; the engine compiles one chunk
-    per window bucket and always writes into the full cache before slicing.
-    """
-    S = ids.shape[0]
-    T = cache["k"].shape[2]
-    W = T if window is None else min(window, T)
-    H, KH, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
-    G = H // KH
-    x = _embed_lookup(params["embed"], ids, cfg.jax_dtype)  # [S, D]
-    pos1 = positions[:, None]  # [S, 1]
-    slot_idx = jnp.arange(S)
-    valid = jnp.arange(W)[None, :] <= cache_lens[:, None]  # [S, W]
-
-    def body(carry, scanned):
-        # the FULL [n_layers, S, T, KH, hd] cache rides the carry and takes a
-        # per-row in-place scatter. Round-2 profiling: passing per-layer cache
-        # slices through scan xs/ys made every step rewrite whole [S, T, KH,
-        # hd] layer slices into the stacked ys buffer (~2x the chunk's ideal
-        # HBM traffic); carry + scatter writes only the S new rows.
-        x, k_all, v_all = carry
-        layer, li = scanned
-        h = _rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
-        q = h @ layer["wq"]
-        k = h @ layer["wk"]
-        v = h @ layer["wv"]
-        if cfg.attention_bias:
-            q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
-        q = q.reshape(S, 1, H, hd)
-        k = k.reshape(S, 1, KH, hd)
-        v = v.reshape(S, 1, KH, hd)
-        if cfg.qk_norm:
-            q = _rms_norm(q, layer["q_norm"], cfg.rms_norm_eps)
-            k = _rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
-        q = _rope(q, pos1, cfg.rope_theta)[:, 0]  # [S, H, hd]
-        k = _rope(k, pos1, cfg.rope_theta)[:, 0]  # [S, KH, hd]
-        v = v[:, 0]
-        k_all = k_all.at[li, slot_idx, cache_lens].set(k.astype(k_all.dtype))
-        v_all = v_all.at[li, slot_idx, cache_lens].set(v.astype(v_all.dtype))
-        kk = jax.lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)[:, :W]
-        vv = jax.lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)[:, :W]
-        qg = q.reshape(S, KH, G, hd)
-        logits = (
-            jnp.einsum("skgd,stkd->skgt", qg, kk).astype(jnp.float32) * hd**-0.5
-        )
-        logits = jnp.where(valid[:, None, None, :], logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(vv.dtype)
-        attn = jnp.einsum("skgt,stkd->skgd", probs, vv).reshape(S, H * hd)
-        x = x + attn @ layer["wo"]
-        h = _rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
-        x = x + _ffn(cfg, h, layer)
-        return (x, k_all, v_all), None
-
-    n_layers = cfg.num_layers
-    (x, ks, vs), _ = jax.lax.scan(
-        body,
-        (x, cache["k"], cache["v"]),
-        (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
-    )
-    hidden = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    return hidden, {"k": ks, "v": vs}

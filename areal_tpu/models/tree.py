@@ -139,6 +139,21 @@ def non_root_nodes(pack: TreePack) -> np.ndarray:
     return np.flatnonzero(pack.parent >= 0).astype(np.int32)
 
 
+def tree_train_logprobs(params, cfg, pack: "TreePack", impl: str = "sparse"):
+    """Training-grade tree logprobs: node_logp [N] differentiable w.r.t.
+    params. ``impl="sparse"`` runs the block-sparse Pallas kernel (fwd+bwd,
+    ops/tree_attention.py — the role of the reference's Triton kernel,
+    models/tree_attn/triton_kernel.py); ``"dense"`` is the phase-1 masked
+    XLA path (reference eager fallback). Gradients agree between the two
+    (tests/test_tree_training.py::test_tree_training_grad_parity)."""
+    if impl == "sparse":
+        from areal_tpu.ops.tree_attention import tree_forward_logprobs_pallas
+
+        return tree_forward_logprobs_pallas(params, cfg, pack)
+    assert impl == "dense", impl
+    return tree_forward_logprobs(params, cfg, pack)
+
+
 def tree_forward_logprobs(params, cfg, pack: TreePack):
     """Packed-tree forward: one token per unique node, ancestor-mask
     attention, edge-gathered logprobs.

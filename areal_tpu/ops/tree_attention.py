@@ -12,9 +12,14 @@ Because the trie is built parent-before-child (models/tree.py build_tree),
 ancestors satisfy j <= i: everything above the block diagonal is skipped
 for free, and deep-branching tries skip most sub-diagonal tiles too.
 
-Forward-only (the no-grad hot paths: tree logprob recompute / scoring);
-training uses the dense-mask XLA path (models/tree.py phase 1). Off-TPU the
-kernel runs in Pallas interpret mode so CPU tests exercise the real code.
+Differentiable: ``tree_attention`` carries a custom VJP whose backward is
+two more block-sparse kernels (dQ; dK/dV) sharing the same packed-bit mask
+expansion and block skip map — so tree *training* pays structure-sparse
+FLOPs too, matching the reference Triton kernel's fwd+bwd
+(areal/models/tree_attn/triton_kernel.py). The forward kernel additionally
+emits per-row logsumexp as the softmax residual (recompute-style backward,
+no [N, N] probability materialization). Off-TPU the kernels run in Pallas
+interpret mode so CPU tests exercise the real code.
 """
 
 from __future__ import annotations
@@ -69,6 +74,7 @@ def _tree_attn_kernel(
     v_ref,  # [1, BK, d]
     words_ref,  # [BQ, BK // WORD] uint32 — this tile's mask words
     o_ref,  # [1, BQ, d]
+    lse_ref,  # [1, BQ] fp32 — per-row logsumexp (backward residual)
     m_scr,
     l_scr,
     acc_scr,
@@ -132,27 +138,32 @@ def _tree_attn_kernel(
     def _finalize():
         l = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        # per-row softmax residual for the backward
+        lse_ref[...] = (m_scr[:, :1] + jnp.log(l)).reshape(1, block)
 
 
-def tree_attention(
-    q: jax.Array,  # [N, H, d] (N padded to BLOCK)
-    k: jax.Array,
-    v: jax.Array,
-    mask_words: jax.Array,  # [N, N // 32] uint32
-    block_any: jax.Array,  # [nB, nB] int32
-    interpret: bool | None = None,
-) -> jax.Array:
-    """Block-sparse ancestor-masked attention -> [N, H, d]."""
+def _expand_mask(words_ref, block: int):
+    """Packed uint32 words -> [BQ, BK] bool, in-register (no 3-D reshapes —
+    layout-hostile in Mosaic): each word broadcasts across its 32 columns,
+    then a per-column logical shift selects the bit."""
+    words = words_ref[...].astype(jnp.int32)  # [BQ, BK//WORD]
+    expanded = jnp.concatenate(
+        [
+            jnp.broadcast_to(words[:, i : i + 1], (block, WORD))
+            for i in range(block // WORD)
+        ],
+        axis=1,
+    )  # [BQ, BK]
+    col_bit = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1) % WORD
+    return (jax.lax.shift_right_logical(expanded, col_bit) & 1) > 0
+
+
+def _fwd_pallas(q, k, v, mask_words, block_any, interpret):
     N, H, d = q.shape
-    assert N % BLOCK == 0, (N, BLOCK)
     nB = N // BLOCK
-    if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
     qt, kt, vt = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
-    kernel = functools.partial(
-        _tree_attn_kernel, scale=d**-0.5, block=BLOCK
-    )
-    out = pl.pallas_call(
+    kernel = functools.partial(_tree_attn_kernel, scale=d**-0.5, block=BLOCK)
+    out, lse = pl.pallas_call(
         kernel,
         grid=(H, nB, nB),
         in_specs=[
@@ -164,22 +175,243 @@ def tree_attention(
                 (BLOCK, BLOCK // WORD), lambda h, iq, ik: (iq, ik)
             ),
         ],
-        out_specs=pl.BlockSpec((1, BLOCK, d), lambda h, iq, ik: (h, iq, 0)),
+        out_specs=[
+            pl.BlockSpec((1, BLOCK, d), lambda h, iq, ik: (h, iq, 0)),
+            pl.BlockSpec((1, BLOCK), lambda h, iq, ik: (h, iq)),
+        ],
         scratch_shapes=[
             pltpu.VMEM((BLOCK, 128), jnp.float32),
             pltpu.VMEM((BLOCK, 128), jnp.float32),
             pltpu.VMEM((BLOCK, d), jnp.float32),
         ],
-        out_shape=jax.ShapeDtypeStruct((H, N, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((H, N, d), q.dtype),
+            jax.ShapeDtypeStruct((H, N), jnp.float32),
+        ],
         interpret=interpret,
     )(block_any, qt, kt, vt, mask_words)
-    return jnp.transpose(out, (1, 0, 2))
+    return jnp.transpose(out, (1, 0, 2)), lse
 
 
-def tree_forward_logprobs_pallas(params, cfg, pack):
-    """Phase-2 tree scoring: the packed-trie forward with the block-sparse
-    kernel in every layer (no-grad path; training uses the dense phase-1
-    path). Returns node_logp [N] like tree.tree_forward_logprobs."""
+def _tree_bwd_dq_kernel(
+    block_any_ref,  # [1, 1]
+    q_ref,  # [1, BQ, d]
+    k_ref,  # [1, BK, d]
+    v_ref,  # [1, BK, d]
+    do_ref,  # [1, BQ, d]
+    lse_ref,  # [1, BQ]
+    delta_ref,  # [1, BQ]
+    words_ref,  # [BQ, BK//WORD]
+    dq_ref,  # [1, BQ, d]
+    dq_scr,  # VMEM [BQ, d] fp32
+    *,
+    scale: float,
+    block: int,
+):
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when(block_any_ref[0, 0] > 0)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        mask = _expand_mask(words_ref, block)
+        logits = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )
+        p = jnp.where(mask, jnp.exp(logits - lse_ref[0].reshape(block, 1)), 0.0)
+        dp = jax.lax.dot_general(  # [BQ, BK] = dO @ V^T
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0].reshape(block, 1))
+        dq_scr[...] += (
+            jax.lax.dot_general(
+                ds.astype(k.dtype),
+                k,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+
+    @pl.when(ik == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _tree_bwd_dkv_kernel(
+    block_any_ref,  # [1, 1] — note index map transposes to (iq, jk)
+    q_ref,  # [1, BQ, d]
+    k_ref,  # [1, BK, d]
+    v_ref,  # [1, BK, d]
+    do_ref,  # [1, BQ, d]
+    lse_ref,  # [1, BQ]
+    delta_ref,  # [1, BQ]
+    words_ref,  # [BQ, BK//WORD]
+    dk_ref,  # [1, BK, d]
+    dv_ref,  # [1, BK, d]
+    dk_scr,  # VMEM [BK, d] fp32
+    dv_scr,  # VMEM [BK, d] fp32
+    *,
+    scale: float,
+    block: int,
+):
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(block_any_ref[0, 0] > 0)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        mask = _expand_mask(words_ref, block)  # [BQ, BK]
+        logits = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )
+        p = jnp.where(mask, jnp.exp(logits - lse_ref[0].reshape(block, 1)), 0.0)
+        # dV[BK, d] = P^T @ dO — contract the query dim, no transpose needed
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype),
+            do,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0].reshape(block, 1))
+        dk_scr[...] += (
+            jax.lax.dot_general(
+                ds.astype(q.dtype),
+                q,
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+
+    @pl.when(iq == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def tree_attention(
+    q: jax.Array,  # [N, H, d] (N padded to BLOCK)
+    k: jax.Array,
+    v: jax.Array,
+    mask_words: jax.Array,  # [N, N // 32] uint32
+    block_any: jax.Array,  # [nB, nB] int32
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Block-sparse ancestor-masked attention -> [N, H, d]. Differentiable
+    in q/k/v (custom VJP over the sparse backward kernels)."""
+    out, _ = _fwd_pallas(q, k, v, mask_words, block_any, _interp(interpret))
+    return out
+
+
+def _interp(interpret):
+    if interpret is None:
+        return jax.devices()[0].platform != "tpu"
+    return interpret
+
+
+def _tree_attn_fwd(q, k, v, mask_words, block_any, interpret):
+    out, lse = _fwd_pallas(q, k, v, mask_words, block_any, _interp(interpret))
+    return out, (q, k, v, out, lse, mask_words, block_any)
+
+
+def _tree_attn_bwd(interpret, res, dout):
+    q, k, v, out, lse, mask_words, block_any = res
+    interpret = _interp(interpret)
+    N, H, d = q.shape
+    nB = N // BLOCK
+    scale = d**-0.5
+    # delta[h, i] = sum_d dO * O — the softmax-backward row correction
+    delta = jnp.einsum("nhd,nhd->hn", dout.astype(jnp.float32), out.astype(jnp.float32))
+    qt, kt, vt, dot = (
+        jnp.transpose(x, (1, 0, 2)) for x in (q, k, v, dout)
+    )
+    common_in = [
+        pl.BlockSpec((1, 1), lambda h, iq, ik: (iq, ik)),
+        pl.BlockSpec((1, BLOCK, d), lambda h, iq, ik: (h, iq, 0)),
+        pl.BlockSpec((1, BLOCK, d), lambda h, iq, ik: (h, ik, 0)),
+        pl.BlockSpec((1, BLOCK, d), lambda h, iq, ik: (h, ik, 0)),
+        pl.BlockSpec((1, BLOCK, d), lambda h, iq, ik: (h, iq, 0)),
+        pl.BlockSpec((1, BLOCK), lambda h, iq, ik: (h, iq)),
+        pl.BlockSpec((1, BLOCK), lambda h, iq, ik: (h, iq)),
+        pl.BlockSpec((BLOCK, BLOCK // WORD), lambda h, iq, ik: (iq, ik)),
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_tree_bwd_dq_kernel, scale=scale, block=BLOCK),
+        grid=(H, nB, nB),  # (head, q tile, reduce over k tiles)
+        in_specs=common_in,
+        out_specs=pl.BlockSpec((1, BLOCK, d), lambda h, iq, ik: (h, iq, 0)),
+        scratch_shapes=[pltpu.VMEM((BLOCK, d), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((H, N, d), q.dtype),
+        interpret=interpret,
+    )(block_any, qt, kt, vt, dot, lse, delta, mask_words)
+    # dK/dV: outer loop over k tiles, reduce over q tiles — the index maps
+    # swap (iq, ik) roles relative to the grid axes
+    dkv_in = [
+        pl.BlockSpec((1, 1), lambda h, jk, iq: (iq, jk)),
+        pl.BlockSpec((1, BLOCK, d), lambda h, jk, iq: (h, iq, 0)),
+        pl.BlockSpec((1, BLOCK, d), lambda h, jk, iq: (h, jk, 0)),
+        pl.BlockSpec((1, BLOCK, d), lambda h, jk, iq: (h, jk, 0)),
+        pl.BlockSpec((1, BLOCK, d), lambda h, jk, iq: (h, iq, 0)),
+        pl.BlockSpec((1, BLOCK), lambda h, jk, iq: (h, iq)),
+        pl.BlockSpec((1, BLOCK), lambda h, jk, iq: (h, iq)),
+        pl.BlockSpec((BLOCK, BLOCK // WORD), lambda h, jk, iq: (iq, jk)),
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_tree_bwd_dkv_kernel, scale=scale, block=BLOCK),
+        grid=(H, nB, nB),
+        in_specs=dkv_in,
+        out_specs=[
+            pl.BlockSpec((1, BLOCK, d), lambda h, jk, iq: (h, jk, 0)),
+            pl.BlockSpec((1, BLOCK, d), lambda h, jk, iq: (h, jk, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK, d), jnp.float32),
+            pltpu.VMEM((BLOCK, d), jnp.float32),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((H, N, d), k.dtype),
+            jax.ShapeDtypeStruct((H, N, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(block_any, qt, kt, vt, dot, lse, delta, mask_words)
+    t = lambda x: jnp.transpose(x, (1, 0, 2))
+    return t(dq), t(dk), t(dv), None, None
+
+
+tree_attention.defvjp(_tree_attn_fwd, _tree_attn_bwd)
+
+
+def tree_forward_logprobs_pallas(params, cfg, pack, remat: bool | None = None):
+    """Packed-trie forward with the block-sparse kernel in every layer.
+    Fully differentiable (tree_attention carries a custom VJP), so this is
+    BOTH the phase-2 scoring path and the sparse *training* path
+    (models/tree.py tree_train_logprobs dispatches here). ``remat``
+    checkpoints each layer like the main model (defaults to cfg.remat).
+    Returns node_logp [N] like tree.tree_forward_logprobs."""
     from areal_tpu.models import qwen
     from areal_tpu.models.tree import edge_logprob_index, non_root_nodes
 
@@ -226,6 +458,12 @@ def tree_forward_logprobs_pallas(params, cfg, pack):
         )
         return x + qwen._proj(mcfg, layer, "w_down", ff), None
 
+    if remat is None:
+        remat = cfg.remat
+    if remat:
+        layer_fn = jax.checkpoint(
+            layer_fn, policy=jax.checkpoint_policies.nothing_saveable
+        )
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])
     hidden = qwen._rms_norm(x, params["final_norm"], mcfg.rms_norm_eps)
     logits = qwen.compute_logits(params, mcfg, hidden[None])[0]

@@ -176,3 +176,43 @@ def test_tree_forward_pallas_matches_dense():
     dense = np.asarray(tree_forward_logprobs(params, cfg, pack))
     sparse = np.asarray(tree_forward_logprobs_pallas(params, cfg, pack))
     np.testing.assert_allclose(sparse, dense, atol=3e-4, rtol=3e-3)
+
+
+def test_tree_training_grad_parity():
+    """Sparse-kernel tree training == dense-mask tree training, in gradients
+    (VERDICT r03 item: the reference's Triton kernel trains through the
+    sparse path, models/tree_attn/triton_kernel.py fwd+bwd)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.models import qwen
+    from areal_tpu.models.tree import build_tree, tree_train_logprobs
+    from areal_tpu.ops.tree_attention import pack_ancestor_bits
+
+    rng = np.random.default_rng(1)
+    # >128 nodes with deep shared prefixes -> multiple tiles, some skippable
+    base = list(rng.integers(1, 200, 90))
+    seqs = [base[:60] + list(rng.integers(1, 200, 80)) for _ in range(3)]
+    seqs += [base + list(rng.integers(1, 200, 40)) for _ in range(2)]
+    pack = build_tree(seqs)
+    assert pack.n_nodes > 128
+    _, block_any = pack_ancestor_bits(pack.parent)
+    assert block_any.mean() < 1.0, "expected at least one skippable tile"
+
+    params = qwen.init_params(jax.random.PRNGKey(0), TINY_QWEN2)
+    # per-node weights make the loss sensitive to every edge logprob
+    wts = jnp.asarray(rng.normal(0, 1, pack.n_nodes), jnp.float32)
+
+    def loss(params, impl):
+        return (tree_train_logprobs(params, TINY_QWEN2, pack, impl) * wts).sum()
+
+    ls, gs = jax.value_and_grad(lambda p: loss(p, "sparse"))(params)
+    ld, gd = jax.value_and_grad(lambda p: loss(p, "dense"))(params)
+    np.testing.assert_allclose(float(ls), float(ld), rtol=1e-4)
+    flat_s = jax.tree.leaves(gs)
+    flat_d = jax.tree.leaves(gd)
+    for a, b in zip(flat_s, flat_d):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4
+        )
