@@ -422,8 +422,9 @@ class DecodeEngine:
                 ).compile()
                 n_prog += 1
             # GRPO prefix-sharing page copies (dup counts pad to powers of
-            # two up to next_pow2(S-1)) — a cold compile here would stall
-            # all slots on the first identical-prompt group
+            # two up to next_pow2(S-1)) and the pool-pressure remaining
+            # clamp — a cold compile on either would stall all slots
+            # mid-serving
             from areal_tpu.inference import paged_kv
 
             n = 1
@@ -442,6 +443,11 @@ class DecodeEngine:
                 if n >= max(1, cfg.max_batch_size - 1):
                     break
                 n *= 2
+            for n in self._reachable_scatter_sizes():
+                self._clamp_fn(n).lower(
+                    state_s, jax.ShapeDtypeStruct((n, 2), jnp.int32)
+                ).compile()
+                n_prog += 1
             for wp in self._reachable_chunk_wps():
                 for capped in (False, True):
                     self._chunk_fn(cfg.decode_steps_per_call, wp, capped).lower(
@@ -1369,14 +1375,10 @@ class DecodeEngine:
         if clamp_rows:
             self._apply_remaining_clamp(clamp_rows)
 
-    def _apply_remaining_clamp(self, rows: list[tuple[int, int]]) -> None:
-        """Scatter remaining := min(remaining, cap) for the given slots,
-        touching nothing else (pos/ids stay device-authoritative). Padded
-        rows repeat row 0 (idempotent: min with the same cap)."""
-        n = 1
-        while n < len(rows):
-            n *= 2
-        upd = np.asarray(rows + [rows[0]] * (n - len(rows)), np.int32)
+    def _clamp_fn(self, n: int):
+        """Jitted remaining-only scatter: remaining := min(remaining, cap)
+        for n (slot, cap) rows, touching nothing else (pos/ids stay
+        device-authoritative)."""
         key = ("clamp", n)
         if key not in self._fn_cache:
 
@@ -1392,8 +1394,16 @@ class DecodeEngine:
                 return state
 
             self._fn_cache[key] = jax.jit(clamp, donate_argnames=("state",))
+        return self._fn_cache[key]
+
+    def _apply_remaining_clamp(self, rows: list[tuple[int, int]]) -> None:
+        """Padded rows repeat row 0 (idempotent: min with the same cap)."""
+        n = 1
+        while n < len(rows):
+            n *= 2
+        upd = np.asarray(rows + [rows[0]] * (n - len(rows)), np.int32)
         with jax.set_mesh(self.mesh):
-            self._dev_state = self._fn_cache[key](
+            self._dev_state = self._clamp_fn(n)(
                 self._dev_state, jnp.asarray(upd)
             )
 

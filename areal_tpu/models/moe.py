@@ -4,13 +4,19 @@ Reference: archon MoE stack — router (experimental/models/archon/moe/
 router.py), grouped experts (grouped_experts.py), token-dispatch Triton
 kernels (kernels.py:1-228), ExpertParallel (expert_parallel.py:1-512).
 
-TPU-first design: capacity-based *dense dispatch* (the mesh-transformer /
-GSPMD-native formulation) instead of ragged token shuffles — one-hot
-dispatch/combine tensors turn routing into einsums that XLA partitions over
-the mesh ``expert`` axis, inserting the token all-to-all automatically
-(SURVEY §2.4 EP: "ragged all-to-all dispatch (Pallas or lax) — here lax/
-GSPMD"). Tokens over an expert's capacity are dropped (standard capacity
-semantics); the residual stream carries them unchanged.
+Two dispatch strategies, selected by ``cfg.moe_dropless``:
+
+- **dropless (default)**: sort-based grouped dispatch. Per EP shard, the
+  (token, k) assignments targeting local experts are stably sorted by
+  expert id and fed through ``megablox.gmm`` — jax's Pallas grouped-matmul
+  TPU kernel — so every routed token is computed (no capacity drop; the
+  reference's Triton token-shuffle kernels play this role,
+  archon/moe/kernels.py:1-228). Combine is a segment scatter-add weighted
+  by the router gates + psum over the mesh ``expert`` axis.
+- **capacity**: dense one-hot dispatch/combine einsums (mesh-transformer /
+  GSPMD formulation); tokens over an expert's ``capacity_factor`` buffer
+  are dropped, the residual stream carries them unchanged. Cheaper mask
+  bookkeeping, but wrong for training parity when routing is imbalanced.
 """
 
 from __future__ import annotations
@@ -32,7 +38,13 @@ def moe_ffn(h: jax.Array, layer: dict, cfg) -> tuple[jax.Array, jax.Array]:
 
     Returns (out [G, L, D], aux_loss scalar). aux is the switch-style load
     balance loss E * sum_e(frac_e * mean_prob_e); callers weight it with
-    cfg.router_aux_coef."""
+    cfg.router_aux_coef. Dispatch strategy per ``cfg.moe_dropless``."""
+    if getattr(cfg, "moe_dropless", False):
+        return moe_ffn_dropless(h, layer, cfg)
+    return _moe_ffn_capacity(h, layer, cfg)
+
+
+def _moe_ffn_capacity(h: jax.Array, layer: dict, cfg) -> tuple[jax.Array, jax.Array]:
     from areal_tpu.models.qwen import BATCH_AXES
 
     G, L, D = h.shape
@@ -80,3 +92,120 @@ def moe_ffn(h: jax.Array, layer: dict, cfg) -> tuple[jax.Array, jax.Array]:
     mean_prob = probs.mean(axis=(0, 1))
     aux = (frac_tokens * mean_prob).sum() * E
     return out.astype(h.dtype), aux.astype(jnp.float32)
+
+
+def _router(h32, w_router, K: int, norm_topk: bool):
+    """fp32 routing: -> (probs [T, E], top_p [T, K], top_e [T, K])."""
+    logits = h32 @ w_router.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_e = jax.lax.top_k(probs, K)
+    if norm_topk:
+        top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+    return probs, top_p, top_e
+
+
+def moe_ffn_dropless(h: jax.Array, layer: dict, cfg) -> tuple[jax.Array, jax.Array]:
+    """Sort-based dropless MoE dispatch over the mesh ``expert`` axis.
+
+    Inside a shard_map block (token shard x expert shard), the (token, k)
+    assignments hitting this shard's experts are stably sorted by local
+    expert id, run through grouped matmuls (``megablox.gmm`` — interpret
+    mode off-TPU, so CPU tests exercise the same code), and scattered back
+    with their gates; a psum over "expert" assembles each token's K expert
+    outputs. Every assignment is computed — token conservation is exact
+    (tests/test_moe.py::test_dropless_token_conservation).
+
+    Expert weights enter the block gathered over (fsdp, model) — the
+    zero-3 per-use gather shard_map's in_specs perform; TP *within* expert
+    FFNs is not sharded on this path (EP takes the expert axis; meshes
+    that want both should use the capacity path)."""
+    from jax.experimental.pallas.ops.tpu.megablox import gmm
+    from areal_tpu.models.qwen import BATCH_AXES
+
+    G, L, D = h.shape
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        axes = dict(mesh.shape) if mesh is not None else {}
+    except Exception:  # noqa: BLE001
+        axes = {}
+    e_sz = axes.get("expert", 1)
+    in_mesh = bool(axes) and E % max(e_sz, 1) == 0
+    interpret = jax.devices()[0].platform != "tpu"
+    tile = (16, 128, 128) if interpret else (128, 128, 128)
+
+    def block(h_blk, wr, wg, wu, wd):
+        # h_blk [G_, L_, D]; wg/wu [E_loc, D, F]; wd [E_loc, F, D]
+        G_, L_, _ = h_blk.shape
+        E_loc = wg.shape[0]
+        T = G_ * L_
+        x = h_blk.reshape(T, D)
+        probs, top_p, top_e = _router(
+            x.astype(jnp.float32), wr, K, cfg.norm_topk_prob
+        )
+        e0 = jax.lax.axis_index("expert") * E_loc if in_mesh else 0
+        ek = top_e.reshape(T * K)
+        gk = top_p.reshape(T * K)
+        tok = jnp.arange(T * K, dtype=jnp.int32) // K
+        local = (ek >= e0) & (ek < e0 + E_loc)
+        key = jnp.where(local, ek - e0, E_loc)  # non-local sorts last
+        order = jnp.argsort(key, stable=True)
+        sizes = jnp.bincount(key, length=E_loc + 1).astype(jnp.int32)
+        # non-local rows sort past sum(group_sizes): gmm never computes
+        # them (per-shard FLOPs stay ~1/e_sz of the fleet's). Their output
+        # AND vjp-cotangent rows are uninitialized, so (a) they gather from
+        # / scatter to a phantom zero token row T, keeping garbage out of
+        # real tokens in both directions, and (b) every gmm output is
+        # masked so garbage can't ride the elementwise ops into the
+        # accumulated gradients.
+        group_sizes = sizes[:E_loc]
+        n_local = group_sizes.sum()
+        computed = jnp.arange(T * K) < n_local
+        s_tok = jnp.where(computed, tok[order], T)  # phantom row for tail
+        x_ext = jnp.concatenate([x, jnp.zeros((1, D), x.dtype)])
+        xs = x_ext[s_tok]  # [T*K, D] grouped by local expert
+        cm = computed[:, None]
+        g1 = jnp.where(cm, gmm(xs, wg, group_sizes, tiling=tile, interpret=interpret), 0)
+        u1 = jnp.where(cm, gmm(xs, wu, group_sizes, tiling=tile, interpret=interpret), 0)
+        y = (jax.nn.silu(g1) * u1).astype(x.dtype)
+        yd = jnp.where(cm, gmm(y, wd, group_sizes, tiling=tile, interpret=interpret), 0)
+        gates = (gk * local)[order].astype(jnp.float32)
+        contrib = yd.astype(jnp.float32) * gates[:, None]
+        out = (
+            jnp.zeros((T + 1, D), jnp.float32).at[s_tok].add(contrib)[:T]
+        )
+        if in_mesh:
+            out = jax.lax.psum(out, "expert")
+        # switch-style aux from the (replicated-over-expert) global routing
+        onehot = jax.nn.one_hot(top_e, E, dtype=jnp.float32)
+        frac = onehot.reshape(T * K, E).mean(0)
+        mean_prob = probs.mean(0)
+        aux = (frac * mean_prob).sum() * E
+        if in_mesh:
+            aux = jax.lax.pmean(aux, ("data", "fsdp", "seq"))
+        return out.reshape(G_, L_, D).astype(h_blk.dtype), aux
+
+    if not in_mesh:
+        return block(
+            h,
+            layer["w_router"],
+            layer["we_gate"],
+            layer["we_up"],
+            layer["we_down"],
+        )
+    out, aux = jax.shard_map(
+        block,
+        in_specs=(
+            P(BATCH_AXES, "seq", None),
+            P(None, None),
+            P("expert", None, None),
+            P("expert", None, None),
+            P("expert", None, None),
+        ),
+        out_specs=(P(BATCH_AXES, "seq", None), P()),
+        # gmm's inner pallas_call carries no vma annotations; the variance
+        # checker can't see through it — the psum/pmean above implement the
+        # replication the out_specs promise
+        check_vma=False,
+    )(h, layer["w_router"], layer["we_gate"], layer["we_up"], layer["we_down"])
+    return out, aux.astype(jnp.float32)

@@ -67,6 +67,9 @@ class ModelConfig:
     moe_intermediate_size: int | None = None
     capacity_factor: float = 1.25
     norm_topk_prob: bool = True
+    # sort-based grouped dispatch (megablox gmm) computing EVERY routed
+    # token; False = capacity-bounded einsum dispatch (drops overflow)
+    moe_dropless: bool = True
     # LoRA (reference fsdp_engine.py:833-860 PEFT wrapper). rank 0 = off.
     # Adapters live as extra stacked-layer leaves ("wq_lora_a"/"wq_lora_b");
     # the base stays frozen and exports merge the deltas back in.

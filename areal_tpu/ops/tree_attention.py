@@ -160,6 +160,7 @@ def _expand_mask(words_ref, block: int):
 
 def _fwd_pallas(q, k, v, mask_words, block_any, interpret):
     N, H, d = q.shape
+    assert N % BLOCK == 0, (N, BLOCK)  # unpadded input would silently truncate
     nB = N // BLOCK
     qt, kt, vt = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
     kernel = functools.partial(_tree_attn_kernel, scale=d**-0.5, block=BLOCK)
@@ -342,6 +343,7 @@ def _tree_attn_bwd(interpret, res, dout):
     q, k, v, out, lse, mask_words, block_any = res
     interpret = _interp(interpret)
     N, H, d = q.shape
+    assert N % BLOCK == 0, (N, BLOCK)
     nB = N // BLOCK
     scale = d**-0.5
     # delta[h, i] = sum_d dO * O — the softmax-backward row correction
