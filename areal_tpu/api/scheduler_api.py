@@ -64,6 +64,23 @@ class Scheduler(abc.ABC):
     def set_worker_env(self, role: str, env: dict[str, str]) -> None:
         """Extra env for future workers of this role."""
 
+    def fork_workers(
+        self,
+        role: str,
+        target_role: str,
+        command: str | None = None,
+        args: list[str] | None = None,
+    ) -> list[Worker]:
+        """Fork one new worker per existing worker of ``target_role``,
+        colocated on the same host (reference scheduler_api.py:128-161 —
+        used by RolloutController to start per-worker OpenAI proxy servers).
+
+        ``command`` is a python module path run as ``python -m command``
+        (default: the RPC worker server); ``args`` are its argv, with the
+        literal ``"{port}"`` substituted by the worker's allocated port.
+        Forked workers are auxiliary: they never take TPU ownership."""
+        raise NotImplementedError(type(self).__name__)
+
     # engine RPC: every scheduler places the SAME RpcWorkerServer, so these
     # concrete defaults ride its HTTP surface regardless of how the worker
     # was placed (subprocess / Ray actor / sbatch task)

@@ -39,22 +39,134 @@ class RolloutController:
         self._task_worker: dict[str, Worker] = {}
         self._version = 0
         self._data_iter = None
+        self._server_addresses: list[str] = []
+        self.proxy_workers: list[Worker] = []
+        self._admin_key = ""
+        self._gateway_thread = None
+        self._gateway_loop = None
+        self.gateway_url: str | None = None
 
     # -- lifecycle --------------------------------------------------------
     def initialize(self, config, addresses: list[str] | None = None) -> None:
         job = Job(replicas=self.replicas, role=self.role, env=self.worker_env)
         self.workers = self.scheduler.create_workers(job)
+        self._server_addresses = list(addresses or [])
         for w in self.workers:
             self.scheduler.create_engine(w, self.engine_path, config)
         self.scheduler.call_all(self.workers, "initialize", addresses)
 
     def destroy(self) -> None:
+        self.stop_gateway()
+        if self.proxy_workers:
+            self.scheduler.delete_workers(self._proxy_role)
+            self.proxy_workers = []
         try:
             self.scheduler.call_all(self.workers, "destroy")
         except Exception:  # noqa: BLE001
             logger.warning("destroy fan-out failed", exc_info=True)
         self.scheduler.delete_workers(self.role)
         self.workers = []
+
+    # -- agentic layer: per-worker proxies + one gateway -------------------
+    # Reference: rollout_controller.py:335-516 forks colocated proxy
+    # workers (scheduler fork contract) and starts the gateway that gives
+    # external OpenAI-SDK agents a single base_url.
+    @property
+    def _proxy_role(self) -> str:
+        return f"{self.role}-proxy"
+
+    def start_proxy(
+        self,
+        tokenizer_path: str,
+        admin_key: str,
+        capacity: int = 128,
+        engine_path: str = "",
+    ) -> list[str]:
+        """Fork one OpenAI-compatible proxy server per rollout worker
+        (colocated, CPU-pinned) wired to the same inference fleet. Returns
+        the proxy base URLs."""
+        assert self.workers, "initialize() first"
+        assert not self.proxy_workers, "proxy already started"
+        args = [
+            "--tokenizer",
+            tokenizer_path,
+            "--admin-key",
+            admin_key,
+            "--capacity",
+            str(capacity),
+            "--port",
+            "{port}",
+        ]
+        if engine_path:
+            args += ["--engine-path", engine_path]
+        elif self._server_addresses:
+            args += ["--servers", ",".join(self._server_addresses)]
+        self.proxy_workers = self.scheduler.fork_workers(
+            role=self._proxy_role,
+            target_role=self.role,
+            command="areal_tpu.openai.proxy.rollout_server",
+            args=args,
+        )
+        self._admin_key = admin_key
+        addrs = [f"http://{w.address}" for w in self.proxy_workers]
+        logger.info(f"proxy workers up: {addrs}")
+        return addrs
+
+    def get_proxy_addr(self, rank: int) -> str:
+        assert self.proxy_workers, "start_proxy() first"
+        return f"http://{self.proxy_workers[rank].address}"
+
+    def start_gateway(self, port: int = 0) -> str:
+        """Serve the gateway (openai/proxy/gateway.py) from the controller
+        process on a daemon thread: ONE external base_url over all proxy
+        workers. Returns the gateway URL."""
+        import asyncio
+        import threading
+
+        from aiohttp import web as aioweb
+
+        from areal_tpu.openai.proxy.gateway import GatewayState, create_gateway_app
+        from areal_tpu.utils.network import find_free_port
+
+        assert self.proxy_workers, "start_proxy() first"
+        assert self._gateway_thread is None, "gateway already running"
+        port = port or find_free_port()
+        backends = [f"http://{w.address}" for w in self.proxy_workers]
+        state = GatewayState(backends, admin_api_key=self._admin_key)
+        started = threading.Event()
+
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            runner = aioweb.AppRunner(create_gateway_app(state))
+            loop.run_until_complete(runner.setup())
+            site = aioweb.TCPSite(runner, "0.0.0.0", port)
+            loop.run_until_complete(site.start())
+            self._gateway_loop = loop
+            started.set()
+            loop.run_forever()
+            loop.run_until_complete(runner.cleanup())
+
+        self._gateway_thread = threading.Thread(target=run, daemon=True)
+        self._gateway_thread.start()
+        if not started.wait(timeout=30):
+            self._gateway_thread = None
+            raise RuntimeError(f"gateway failed to bind port {port}")
+        from areal_tpu.utils.network import gethostip
+
+        # externally reachable URL — off-host agents are the whole point
+        self.gateway_url = f"http://{gethostip()}:{port}"
+        logger.info(f"gateway up at {self.gateway_url} over {backends}")
+        return self.gateway_url
+
+    def stop_gateway(self) -> None:
+        if self._gateway_thread is not None:
+            if self._gateway_loop is not None:
+                self._gateway_loop.call_soon_threadsafe(self._gateway_loop.stop)
+            self._gateway_thread.join(timeout=10)
+            self._gateway_thread = None
+            self._gateway_loop = None
+            self.gateway_url = None
 
     # -- submission -------------------------------------------------------
     def _next_worker(self) -> Worker:

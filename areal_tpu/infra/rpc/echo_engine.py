@@ -45,3 +45,63 @@ class EchoEngine:
 
     def env(self, key: str) -> str | None:
         return os.environ.get(key)
+
+
+class FakeInferenceEngine:
+    """Importable inference stub with ``agenerate`` (deterministic token
+    stream) so subprocess proxy/gateway tests don't need a real model
+    server (same role as the reference's mock engines in its proxy tests)."""
+
+    def __init__(self, n_tokens: int = 4, **kwargs):
+        self.n_tokens = n_tokens
+        self.version = 0
+
+    def initialize(self, *a, **kw) -> None:
+        pass
+
+    def destroy(self) -> None:
+        pass
+
+    async def agenerate(self, req):
+        from areal_tpu.api.io_struct import ModelResponse
+
+        n = min(self.n_tokens, req.gconfig.max_new_tokens)
+        toks = [(sum(req.input_ids) + i) % 97 + 1 for i in range(n)]
+        return ModelResponse(
+            input_tokens=list(req.input_ids),
+            output_tokens=toks,
+            output_logprobs=[-0.5] * n,
+            output_versions=[self.version] * n,
+            stop_reason="stop",
+            rid=req.rid,
+        )
+
+    def set_version(self, v: int) -> None:
+        self.version = v
+
+    def get_version(self) -> int:
+        return self.version
+
+
+class CharTokenizer:
+    """Deterministic toy tokenizer (one token per character) importable by
+    subprocess fixtures (proxy main --tokenizer import:...)."""
+
+    eos_token_id = 0
+    pad_token_id = 0
+
+    def apply_chat_template(
+        self, messages, tools=None, add_generation_prompt=True, tokenize=True, **kw
+    ):
+        text = "".join(f"<{m['role']}>{m.get('content') or ''}" for m in messages)
+        if tools:
+            text = f"[tools:{len(tools)}]" + text
+        if add_generation_prompt:
+            text += "<assistant>"
+        return [ord(c) % 250 + 1 for c in text]
+
+    def encode(self, text):
+        return [ord(c) % 250 + 1 for c in text]
+
+    def decode(self, ids):
+        return "".join(chr(96 + (i % 26)) for i in ids)

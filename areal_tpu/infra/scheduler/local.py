@@ -67,43 +67,17 @@ class LocalScheduler(Scheduler):
             self._tpu_owner = self._tpu_owner or job.role
         procs: list[_Proc] = []
         for i in range(job.replicas):
-            port = network.find_free_port()
-            wid = f"{job.role}-{i}"
-            env = dict(os.environ)
-            env.update(self._role_env.get(job.role, {}))
-            env.update(job.env)
-            network.ensure_pkg_on_pythonpath(env)
-            if job.tpus <= 0:
-                # CPU-pin auxiliary workers: scrub the TPU-tunnel gate vars
-                # (see __graft_entry__.py round-2 fix) and force cpu jax
-                env["JAX_PLATFORMS"] = "cpu"
-                for var in (
-                    "PALLAS_AXON_POOL_IPS",
-                    "PALLAS_AXON_REMOTE_COMPILE",
-                    "AXON_LOOPBACK_RELAY",
-                    "AXON_POOL_SVC_OVERRIDE",
-                ):
-                    env.pop(var, None)
-            log_path = os.path.join(self.log_dir, f"{wid}.log")
-            logf = open(log_path, "ab")
-            proc = subprocess.Popen(
-                [
-                    sys.executable,
-                    "-u",
-                    "-m",
-                    "areal_tpu.infra.rpc.rpc_server",
-                    "--port",
-                    str(port),
-                ],
-                env=env,
-                stdout=logf,
-                stderr=subprocess.STDOUT,
-                start_new_session=True,
-                cwd=os.getcwd(),
+            procs.append(
+                self._spawn(
+                    role=job.role,
+                    index=i,
+                    module="areal_tpu.infra.rpc.rpc_server",
+                    argv=["--port", "{port}"],
+                    extra_env=job.env,
+                    pin_cpu=job.tpus <= 0,
+                    job=job,
+                )
             )
-            logf.close()
-            worker = Worker(id=wid, role=job.role, ip="127.0.0.1", ports=[port])
-            procs.append(_Proc(worker=worker, proc=proc, log_path=log_path, job=job))
         self._procs[job.role] = procs
         try:
             self._wait_healthy(procs)
@@ -111,6 +85,56 @@ class LocalScheduler(Scheduler):
             self.delete_workers(job.role)
             raise
         return [p.worker for p in procs]
+
+    def _spawn(
+        self,
+        role: str,
+        index: int,
+        module: str,
+        argv: list[str],
+        extra_env: dict[str, str] | None = None,
+        pin_cpu: bool = True,
+        job: Job | None = None,
+        ip: str = "127.0.0.1",
+    ) -> _Proc:
+        """One worker subprocess: env assembly (role env + CPU pinning with
+        the TPU-tunnel gate-var scrub — the round-2 __graft_entry__ fix),
+        ``python -m module`` with "{port}" substituted, log redirection.
+        Shared by create_workers and fork_workers so the scrub list and
+        spawn mechanics live in exactly one place."""
+        port = network.find_free_port()
+        wid = f"{role}-{index}"
+        env = dict(os.environ)
+        env.update(self._role_env.get(role, {}))
+        env.update(extra_env or {})
+        network.ensure_pkg_on_pythonpath(env)
+        if pin_cpu:
+            env["JAX_PLATFORMS"] = "cpu"
+            for var in (
+                "PALLAS_AXON_POOL_IPS",
+                "PALLAS_AXON_REMOTE_COMPILE",
+                "AXON_LOOPBACK_RELAY",
+                "AXON_POOL_SVC_OVERRIDE",
+            ):
+                env.pop(var, None)
+        log_path = os.path.join(self.log_dir, f"{wid}.log")
+        with open(log_path, "ab") as logf:
+            proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-u",
+                    "-m",
+                    module,
+                    *[a.replace("{port}", str(port)) for a in argv],
+                ],
+                env=env,
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+                cwd=os.getcwd(),
+            )
+        worker = Worker(id=wid, role=role, ip=ip, ports=[port])
+        return _Proc(worker=worker, proc=proc, log_path=log_path, job=job)
 
     def _wait_healthy(self, procs: list[_Proc]) -> None:
         deadline = time.monotonic() + self.start_timeout
@@ -182,4 +206,39 @@ class LocalScheduler(Scheduler):
 
     def set_worker_env(self, role: str, env: dict[str, str]) -> None:
         self._role_env.setdefault(role, {}).update(env)
+
+    def fork_workers(
+        self,
+        role: str,
+        target_role: str,
+        command: str | None = None,
+        args: list[str] | None = None,
+    ) -> list[Worker]:
+        """One colocated auxiliary process per ``target_role`` worker (on a
+        single host: same machine, CPU-pinned, fresh port). The forked
+        module owns its own protocol; health is polled on GET /health."""
+        assert role not in self._procs, f"role {role} exists"
+        targets = self._procs.get(target_role)
+        assert targets, f"no workers of role {target_role!r} to fork from"
+        module = command or "areal_tpu.infra.rpc.rpc_server"
+        procs: list[_Proc] = []
+        for i, tgt in enumerate(targets):
+            procs.append(
+                self._spawn(
+                    role=role,
+                    index=i,
+                    module=module,
+                    argv=list(args or ["--port", "{port}"]),
+                    pin_cpu=True,  # auxiliary: never touch the TPU
+                    job=tgt.job,
+                    ip=tgt.worker.ip,
+                )
+            )
+        self._procs[role] = procs
+        try:
+            self._wait_healthy(procs)
+        except Exception:
+            self.delete_workers(role)
+            raise
+        return [p.worker for p in procs]
 

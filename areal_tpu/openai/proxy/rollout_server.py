@@ -256,7 +256,16 @@ def create_proxy_app(state: ProxyState) -> web.Application:
         state.capacity += 1
         return web.json_response({"capacity": state.capacity})
 
+    async def kill(request: web.Request):
+        """Scheduler teardown hook (the LocalScheduler POSTs /kill before
+        escalating to SIGKILL) — acknowledge, then exit."""
+        import os
+
+        asyncio.get_event_loop().call_later(0.1, os._exit, 0)
+        return web.json_response({"status": "ok"})
+
     app.router.add_get("/health", health)
+    app.router.add_post("/kill", kill)
     app.router.add_post("/rl/start_session", start_session)
     app.router.add_post("/rl/end_session", end_session)
     app.router.add_post("/rl/set_reward", set_reward)
@@ -282,11 +291,37 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--capacity", type=int, default=128)
     p.add_argument("--name", default="", help="name_resolve registration key")
     p.add_argument("--chat-template-type", default="hf")
+    p.add_argument(
+        "--servers",
+        default="",
+        help="comma-separated inference server addresses (else name_resolve)",
+    )
+    p.add_argument(
+        "--engine-path",
+        default="",
+        help="import path of an alternative engine class (tests)",
+    )
     args = p.parse_args(argv)
 
-    tokenizer = AutoTokenizer.from_pretrained(args.tokenizer)
-    engine = RemoteJaxEngine(InferenceEngineConfig())
-    engine.initialize()
+    if args.tokenizer.startswith("import:"):
+        import importlib
+
+        mod, cls = args.tokenizer[len("import:") :].rsplit(".", 1)
+        tokenizer = getattr(importlib.import_module(mod), cls)()
+    else:
+        tokenizer = AutoTokenizer.from_pretrained(args.tokenizer)
+    if args.engine_path:
+        import importlib
+
+        mod, cls = args.engine_path.rsplit(".", 1)
+        engine = getattr(importlib.import_module(mod), cls)()
+        if hasattr(engine, "initialize"):
+            engine.initialize()
+    else:
+        engine = RemoteJaxEngine(InferenceEngineConfig())
+        engine.initialize(
+            addresses=[a for a in args.servers.split(",") if a] or None
+        )
     state = ProxyState(
         engine,
         tokenizer,
