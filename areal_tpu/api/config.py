@@ -198,6 +198,10 @@ class ServerConfig:
     # tests; long-context serving MUST set a budget so pages are a shared
     # pool smaller than S*T (the whole point of paging: KV ∝ used tokens)
     kv_hbm_gb: float | None = None
+    # attention-window bucket granularity (rows). Each reachable window is
+    # a compiled decode-chunk variant; long-context configs should coarsen
+    # this (e.g. 1024) to bound compile count
+    attn_window_step: int = 512
     decode_steps_per_call: int = 16  # tokens decoded per jitted scan call
     mesh: MeshConfig = field(default_factory=MeshConfig)
     port: int = 0  # 0 = pick a free port

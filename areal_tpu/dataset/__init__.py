@@ -161,3 +161,53 @@ def _torl(split: str = "train", path: str | None = None, **kwargs):
         }
 
     return [to_row(x) for x in ds]
+
+
+@register_dataset("geometry3k")
+def _geometry3k(split: str = "train", path: str | None = None, **kwargs):
+    """Geometry VQA rows: {"messages", "images", "answer"} (reference
+    dataset/geometry3k.py — image + "problem" + boxed "answer"). Images pass
+    through as-is; VisionRLVRWorkflow's HF processor handles RGB conversion
+    and patch extraction."""
+    import datasets
+
+    assert path, "geometry3k requires a local dataset path (zero-egress image)"
+    ds = datasets.load_dataset(path=path, split=split)
+
+    def to_row(x):
+        problem = x.get("problem") or x.get("question") or ""
+        return {
+            "messages": [
+                {
+                    "role": "user",
+                    "content": problem
+                    + "\nAnswer with the final result in \\boxed{}.",
+                }
+            ],
+            "images": x.get("images") or x.get("image"),
+            "answer": str(x.get("answer", "")).strip(),
+        }
+
+    return [to_row(x) for x in ds]
+
+
+@register_dataset("virl39k")
+def _virl39k(split: str = "train", path: str | None = None, **kwargs):
+    """ViRL39K multimodal reasoning rows (reference dataset/virl39k.py):
+    category-tagged image questions; same {"messages", "images", "answer"}
+    schema as the other vision datasets."""
+    import datasets
+
+    assert path, "virl39k requires a local dataset path (zero-egress image)"
+    ds = datasets.load_dataset(path=path, split=split)
+
+    def to_row(x):
+        q = x.get("question") or x.get("problem") or ""
+        return {
+            "messages": [{"role": "user", "content": q}],
+            "images": x.get("images") or x.get("image"),
+            "answer": str(x.get("answer", "")).strip(),
+            "category": x.get("category", ""),
+        }
+
+    return [to_row(x) for x in ds]

@@ -56,7 +56,6 @@ logger = alog.getLogger("decode_engine")
 
 _MAX_STOP = 8  # stop-token-id slots per request (padded with -1)
 _TOPK_CAP = 1024  # static candidate-set size for per-slot top-k/top-p
-_WINDOW_STEP = 512  # attention-window bucket granularity
 _PREFILL_SIZES = (8, 4, 2, 1)  # batched-prefill group sizes (compile variants)
 
 
@@ -348,7 +347,10 @@ class DecodeEngine:
 
         def wp_of(max_pos: int) -> int:
             window = min(
-                T, round_up_to_bucket(max_pos + 1 + 2 * n_steps, _WINDOW_STEP)
+                T,
+                round_up_to_bucket(
+                    max_pos + 1 + 2 * n_steps, cfg.attn_window_step
+                ),
             )
             return min(self._maxp, -(-window // psz))
 
@@ -1449,7 +1451,12 @@ class DecodeEngine:
         n_steps = cfg.decode_steps_per_call
         # host pos can be one in-flight chunk stale -> widen by 2 chunks
         max_pos = int(st["pos"][active].max())
-        window = min(T, round_up_to_bucket(max_pos + 1 + 2 * n_steps, _WINDOW_STEP))
+        window = min(
+            T,
+            round_up_to_bucket(
+                max_pos + 1 + 2 * n_steps, cfg.attn_window_step
+            ),
+        )
         wp = min(self._maxp, -(-window // psz))
         capped = bool(((st["top_k"] > 0) | (st["top_p"] < 1.0))[active].any())
         chunk = self._chunk_fn(n_steps, wp, capped)

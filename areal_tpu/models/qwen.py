@@ -419,12 +419,18 @@ def _embed_lookup(
         rows = jnp.where(ok[..., None], rows, 0).astype(dtype)
         return jax.lax.psum(rows, ("fsdp", "model"))
 
-    if batch_sharded and ids.ndim == 2:  # [G, L] training grids
-        return jax.shard_map(
-            local_grid,
-            in_specs=(P(("fsdp", "model"), None), P(BATCH_AXES, "seq")),
-            out_specs=P(BATCH_AXES, "seq", None),
-        )(embed, ids)
+    if batch_sharded and ids.ndim == 2:
+        # [G, L] training grids — engine-built grids pad G to the DP degree
+        # and bucket L; ad-hoc forward() calls (tests, tiny probes) may
+        # not divide, and then take the replicated variant below
+        d_sz = axes.get("data", 1) * f_sz
+        s_sz = axes.get("seq", 1)
+        if ids.shape[0] % d_sz == 0 and ids.shape[1] % s_sz == 0:
+            return jax.shard_map(
+                local_grid,
+                in_specs=(P(("fsdp", "model"), None), P(BATCH_AXES, "seq")),
+                out_specs=P(BATCH_AXES, "seq", None),
+            )(embed, ids)
     reps = (None,) * ids.ndim
     return jax.shard_map(  # replicated ids: decode steps, serving prefill
         local_flat,

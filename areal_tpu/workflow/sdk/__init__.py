@@ -1,0 +1,11 @@
+"""Third-party-SDK agent integrations over the proxy gateway.
+
+Parity with the reference's SDK workflow packages
+(areal/workflow/{langchain,openai_agent,anthropic}/): an unmodified agent
+written against a vendor SDK trains by pointing its base_url at the
+gateway (infra/controller/rollout_controller.py start_gateway) with a
+session API key. Each module import-gates on its SDK — the TPU image ships
+neither langchain nor the openai package, so these are exercised where the
+SDK exists; the gateway protocol itself is e2e-tested SDK-free in
+tests/test_scale_out.py.
+"""

@@ -1,0 +1,67 @@
+"""LangChain math agent over the gateway (reference
+workflow/langchain/math_agent.py role).
+
+The ChatOpenAI client points at the gateway with a session API key; the
+agent's tool calls and completions are recorded by the RL proxies exactly
+like any other OpenAI-compatible traffic.
+"""
+
+from __future__ import annotations
+
+try:
+    from langchain_openai import ChatOpenAI
+    from langchain_core.tools import tool
+except ImportError as e:  # pragma: no cover - SDK not in the TPU image
+    raise ImportError(
+        "langchain + langchain-openai are required for this integration "
+        "(pip install langchain langchain-openai); the gateway protocol "
+        "itself has no SDK dependency — see examples/agentic/gateway_agent.py"
+    ) from e
+
+
+@tool
+def add(a: float, b: float) -> float:
+    """Add two numbers."""
+    return a + b
+
+
+@tool
+def multiply(a: float, b: float) -> float:
+    """Multiply two numbers."""
+    return a * b
+
+
+@tool
+def divide(a: float, b: float) -> float:
+    """Divide a by b."""
+    if b == 0:
+        raise ValueError("division by zero")
+    return a / b
+
+
+TOOLS = [add, multiply, divide]
+
+
+def build_llm(base_url: str, api_key: str, model: str = "default") -> ChatOpenAI:
+    """An LLM whose every call is served + recorded by the RL fleet."""
+    return ChatOpenAI(base_url=f"{base_url}/v1", api_key=api_key, model=model)
+
+
+async def run_math_agent(
+    base_url: str, api_key: str, question: str, max_turns: int = 6
+) -> str:
+    """Minimal tool-loop agent built on the LangChain message/tool types."""
+    llm = build_llm(base_url, api_key).bind_tools(TOOLS)
+    from langchain_core.messages import HumanMessage, ToolMessage
+
+    by_name = {t.name: t for t in TOOLS}
+    messages = [HumanMessage(content=question)]
+    for _ in range(max_turns):
+        ai = await llm.ainvoke(messages)
+        messages.append(ai)
+        if not ai.tool_calls:
+            return ai.content
+        for tc in ai.tool_calls:
+            out = by_name[tc["name"]].invoke(tc["args"])
+            messages.append(ToolMessage(content=str(out), tool_call_id=tc["id"]))
+    return str(messages[-1].content)

@@ -1,0 +1,90 @@
+"""OpenAI-SDK math agent over the gateway (reference
+workflow/openai_agent/math_agent.py role).
+
+Usage (RL side starts the session; the agent is plain SDK code):
+
+    from areal_tpu.workflow.sdk.openai_sdk_agent import run_math_agent
+    answer = await run_math_agent(
+        base_url=session["base_url"],   # the gateway
+        api_key=session["api_key"],     # session bearer key
+        question="What is 12*(3+4)?",
+    )
+
+Every chat completion the agent makes is served by the RL inference fleet
+and recorded by the owning proxy; the trainer exports the interaction tree
+afterwards (openai/proxy/rollout_server.py /export_trajectories).
+"""
+
+from __future__ import annotations
+
+import json
+
+try:
+    from openai import AsyncOpenAI
+except ImportError as e:  # pragma: no cover - SDK not in the TPU image
+    raise ImportError(
+        "the `openai` package is required for this integration "
+        "(pip install openai); the gateway protocol itself has no SDK "
+        "dependency — see examples/agentic/gateway_agent.py"
+    ) from e
+
+CALC_TOOL = {
+    "type": "function",
+    "function": {
+        "name": "calc",
+        "description": "Evaluate a basic arithmetic expression.",
+        "parameters": {
+            "type": "object",
+            "properties": {"expression": {"type": "string"}},
+            "required": ["expression"],
+        },
+    },
+}
+
+
+def _calc(expression: str) -> str:
+    allowed = set("0123456789+-*/(). ")
+    if not set(expression) <= allowed or "**" in expression:
+        return "error: unsupported characters"
+    try:
+        return str(eval(expression, {"__builtins__": {}}, {}))  # noqa: S307
+    except Exception as e:  # noqa: BLE001
+        return f"error: {e}"
+
+
+async def run_math_agent(
+    base_url: str,
+    api_key: str,
+    question: str,
+    model: str = "default",
+    max_turns: int = 6,
+) -> str:
+    """Tool-loop math agent: the SDK talks to the gateway like any OpenAI
+    endpoint; returns the final assistant message content."""
+    client = AsyncOpenAI(base_url=f"{base_url}/v1", api_key=api_key)
+    messages = [
+        {
+            "role": "system",
+            "content": "Solve the math problem. Use the calc tool for "
+            "arithmetic. End with the final numeric answer.",
+        },
+        {"role": "user", "content": question},
+    ]
+    for _ in range(max_turns):
+        resp = await client.chat.completions.create(
+            model=model, messages=messages, tools=[CALC_TOOL]
+        )
+        msg = resp.choices[0].message
+        messages.append(msg.model_dump(exclude_none=True))
+        if not msg.tool_calls:
+            return msg.content or ""
+        for tc in msg.tool_calls:
+            args = json.loads(tc.function.arguments or "{}")
+            messages.append(
+                {
+                    "role": "tool",
+                    "tool_call_id": tc.id,
+                    "content": _calc(args.get("expression", "")),
+                }
+            )
+    return messages[-1].get("content", "")

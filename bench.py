@@ -36,14 +36,28 @@ import threading
 import time
 
 BASELINE_TOK_S_PER_CHIP = 4300.0
-# worst-case sum (probe + probe-retry + decode + train = 180+180+560+480
-# = 1400s + overhead) must stay under the driver's ~25-min capture window
-# even if every phase hits its deadline — do NOT raise a deadline without
-# re-checking this sum
-PHASE_DEADLINE_S = {"probe": 180.0, "decode": 560.0, "train": 480.0}
-# in-phase budget for the decode wait loop (< the external deadline so the
+# worst-case sum (probe + probe-retry + all phases) must stay under the
+# driver's ~25-min capture window even if every phase hits its deadline —
+# the startup assert below enforces it (ADVICE r02 #3)
+PHASE_DEADLINE_S = {
+    "probe": 120.0,
+    "decode": 420.0,
+    "longctx": 360.0,
+    "train": 360.0,
+}
+_CAPTURE_WINDOW_S = 1500.0
+_OVERHEAD_ALLOWANCE_S = 90.0  # probe retry sleep, process spawn, parent work
+assert (
+    sum(PHASE_DEADLINE_S.values())
+    + PHASE_DEADLINE_S["probe"]  # one retry
+    + _OVERHEAD_ALLOWANCE_S
+    <= _CAPTURE_WINDOW_S
+), "phase deadlines no longer fit the driver capture window"
+# in-phase budget for the decode wait loops (< the external deadline so the
 # partial-result path can fire before the parent SIGKILLs us)
-DECODE_WAIT_S = 360.0  # < decode deadline so the partial path can report
+DECODE_WAIT_S = 280.0
+LONGCTX_WAIT_S = 180.0
+_PHASE_START = time.monotonic()  # reset per child in _run_phase_child
 
 # Qwen2.5-1.5B dimensions (config.json of Qwen/Qwen2.5-1.5B)
 MODEL_KW = dict(
@@ -180,6 +194,8 @@ def phase_decode():
     if not complete:
         log(f"[decode] PARTIAL: {n_done}/{n_req} finished in {dt:.0f}s")
     tok_s = gen_tokens / dt
+    # emit the throughput result NOW: if the weight-update segment below
+    # stalls into the phase deadline, the parent keeps this line
     _emit_phase(
         {
             "phase": "decode",
@@ -188,7 +204,135 @@ def phase_decode():
             "requests_done": n_done,
         }
     )
+
+    # weight-update latency: pause -> staged bf16 bucket stream -> pointer
+    # -swap commit -> resume. The reference bar is the <3 s transfer story
+    # (blog/AReaL_v0_2.md:79-83); here the "transfer" is host-staged
+    # device_put of every bucket plus the commit swap.
+    import jax as _jax
+
+    from areal_tpu.inference.server import flatten_params
+
+    host_params = _jax.tree.map(lambda x: np.asarray(x), params)
+    flat = flatten_params(host_params)
+    t0 = time.monotonic()
+    eng.pause_generation()
+    eng.begin_staged_update()
+    bucket, size, budget = {}, 0, 100 * (1 << 20)  # 100 MB buckets
+    for name, arr in flat.items():
+        bucket[name] = arr
+        size += arr.nbytes
+        if size >= budget:
+            eng.stage_weight_bucket(bucket)
+            bucket, size = {}, 0
+    if bucket:
+        eng.stage_weight_bucket(bucket)
+    eng.commit_staged_weights(version=1)
+    eng.continue_generation()
+    wu_secs = time.monotonic() - t0
+    log(f"[decode] weight update (staged stream) {wu_secs:.2f}s")
+
+    _emit_phase(
+        {
+            "phase": "decode",
+            "tok_s": tok_s,
+            "partial": not complete,
+            "requests_done": n_done,
+            "weight_update_secs": round(wu_secs, 3),
+        }
+    )
     # best-effort teardown; the parent will SIGKILL stragglers anyway
+    try:
+        eng.stop()
+    except Exception:
+        pass
+
+
+def phase_longctx():
+    """Long-context serving (VERDICT r02 missing #1 / weak #2): 64 slots at
+    4K max context over a BUDGETED page pool smaller than S*T — KV fits
+    because memory tracks used tokens. 512-token prompts, up to 3.5K new
+    tokens each; reports generated tokens/sec over a fixed measurement
+    window (the requests intentionally outlast it)."""
+    import numpy as np
+    import jax
+
+    from areal_tpu.api.config import MeshConfig, ServerConfig
+    from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.models import qwen
+
+    model_cfg = qwen.ModelConfig(**MODEL_KW)
+    cfg = ServerConfig(
+        max_batch_size=64,
+        max_seq_len=4096,
+        decode_steps_per_call=32,
+        page_size=128,
+        kv_hbm_gb=6.0,  # << dense equivalent (64*4096 tokens ~ 7.5 GB)
+        attn_window_step=1024,  # 4 window buckets -> few chunk compiles
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+    )
+    t0 = time.monotonic()
+    params = jax.jit(lambda k: qwen.init_params(k, model_cfg))(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    log(f"[longctx] init params {time.monotonic()-t0:.1f}s")
+    eng = DecodeEngine(cfg, params=params, model_cfg=model_cfg)
+    eng.initialize()
+    t0 = time.monotonic()
+    eng.precompile(prompt_buckets=[512])  # the one bucket this phase admits
+    log(f"[longctx] precompile {time.monotonic()-t0:.1f}s")
+    eng.start()
+
+    rng = np.random.default_rng(0)
+    warm = ModelRequest(
+        input_ids=rng.integers(0, 1000, 512).tolist(),
+        gconfig=GenerationHyperparameters(max_new_tokens=32, greedy=True),
+    )
+    phase_t0 = time.monotonic()
+    eng.generate_sync(warm, timeout=120.0)
+    log("[longctx] warmup done")
+
+    # 2x oversubscription keeps the slots full for the whole window
+    n_req, done = 128, []
+    for _ in range(n_req):
+        eng.submit(
+            ModelRequest(
+                input_ids=rng.integers(0, 1000, 512).tolist(),
+                gconfig=GenerationHyperparameters(
+                    max_new_tokens=3584, temperature=1.0
+                ),
+            ),
+            lambda resp: done.append(1),
+        )
+    t0 = time.monotonic()
+    # fit the window inside whatever deadline budget is left (the parent
+    # SIGKILLs at the phase deadline; keep 40s margin for emit+teardown)
+    elapsed = time.monotonic() - _PHASE_START
+    window_s = max(30.0, min(LONGCTX_WAIT_S, PHASE_DEADLINE_S["longctx"] - elapsed - 40.0))
+    log(f"[longctx] measurement window {window_s:.0f}s")
+    start_tokens = eng.stats["generated_tokens"]
+    while time.monotonic() - t0 < window_s and len(done) < n_req:
+        time.sleep(5.0)
+        log(
+            f"[longctx] t={time.monotonic()-t0:.0f}s "
+            f"gen={eng.stats['generated_tokens'] - start_tokens} "
+            f"done={len(done)} pages={eng.pool.used}/{eng.pool.n_pages}"
+        )
+    gen = eng.stats["generated_tokens"] - start_tokens
+    dt = time.monotonic() - t0
+    if gen == 0:
+        raise RuntimeError(f"longctx produced nothing in {dt:.0f}s")
+    max_pos = int(eng._state["pos"].max())
+    _emit_phase(
+        {
+            "phase": "longctx",
+            "tok_s": gen / dt,
+            "max_context_reached": max_pos,
+            "kv_pages_used": eng.pool.used,
+            "kv_pages_total": eng.pool.n_pages,
+            "preempted": eng.stats.get("preempted", 0),
+        }
+    )
     try:
         eng.stop()
     except Exception:
@@ -290,10 +434,17 @@ def phase_train():
         pass
 
 
-PHASES = {"probe": phase_probe, "decode": phase_decode, "train": phase_train}
+PHASES = {
+    "probe": phase_probe,
+    "decode": phase_decode,
+    "longctx": phase_longctx,
+    "train": phase_train,
+}
 
 
 def _run_phase_child(name: str) -> int:
+    global _PHASE_START
+    _PHASE_START = time.monotonic()
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
     hb = _start_heartbeat(name)
     try:
@@ -359,7 +510,7 @@ def _spawn_phase(name: str) -> dict:
 def main():
     hb = _start_heartbeat("parent")
     errors = {}
-    gen_tok_s = train_tok_s = None
+    gen_tok_s = train_tok_s = weight_update_secs = longctx = None
     n_chips = 1
     try:
         probe = _spawn_phase("probe")
@@ -380,8 +531,19 @@ def main():
                 errors["decode"] = d["error"]
             else:
                 gen_tok_s = float(d["tok_s"])
+                weight_update_secs = d.get("weight_update_secs")
                 if d.get("partial"):
                     errors["decode_partial"] = f"only {d.get('requests_done')} reqs"
+            lc = _spawn_phase("longctx")
+            if "error" in lc:
+                errors["longctx"] = lc["error"]
+            else:
+                longctx = {
+                    "tok_s": round(float(lc["tok_s"]), 1),
+                    "max_context_reached": lc.get("max_context_reached"),
+                    "kv_pages_used": lc.get("kv_pages_used"),
+                    "kv_pages_total": lc.get("kv_pages_total"),
+                }
             t = _spawn_phase("train")
             if "error" in t:
                 errors["train"] = t["error"]
@@ -395,6 +557,8 @@ def main():
     detail = {
         "gen_tok_s": round(gen_tok_s, 1) if gen_tok_s else None,
         "train_tok_s": round(train_tok_s, 1) if train_tok_s else None,
+        "weight_update_secs": weight_update_secs,
+        "longctx": longctx,
         "chips": n_chips,
     }
     if errors:

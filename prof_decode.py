@@ -5,7 +5,6 @@ import jax
 
 from areal_tpu.api.config import MeshConfig, ServerConfig
 from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
-from areal_tpu.inference import decode_engine as DE
 from areal_tpu.inference.decode_engine import DecodeEngine
 from areal_tpu.models import qwen
 

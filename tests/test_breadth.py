@@ -159,5 +159,57 @@ def test_math_verify_reward():
 def test_dataset_registry_names():
     from areal_tpu.dataset import _REGISTRY
 
-    for name in ("gsm8k", "math", "hh_rlhf", "clevr_count_70k", "torl_data"):
+    for name in (
+        "gsm8k",
+        "math",
+        "hh_rlhf",
+        "clevr_count_70k",
+        "torl_data",
+        "geometry3k",
+        "virl39k",
+    ):
         assert name in _REGISTRY, name
+
+
+def test_vision_dataset_row_schema(tmp_path):
+    """geometry3k/virl39k loaders produce the {"messages", "images",
+    "answer"} rows VisionRLVRWorkflow consumes, from a local dataset dir."""
+    import datasets
+
+    import json as _json
+
+    path = str(tmp_path / "geo")
+    import os
+
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "train.jsonl"), "w") as f:
+        for row in (
+            {"problem": "find x", "image": [[0.0]], "answer": "42"},
+            {"problem": "find y", "image": [[1.0]], "answer": "7"},
+        ):
+            f.write(_json.dumps(row) + "\n")
+    from areal_tpu.dataset import get_custom_dataset
+
+    rows = get_custom_dataset("geometry3k", split="train", path=path)
+    assert rows[0]["answer"] == "42"
+    assert rows[0]["messages"][0]["role"] == "user"
+    assert "boxed" in rows[0]["messages"][0]["content"]
+
+
+def test_sdk_integrations_import_gated():
+    """SDK agent modules exist and fail loudly (with install guidance) when
+    their SDK is absent — or import cleanly when present."""
+    import importlib
+
+    import pytest
+
+    for mod, pkg in (
+        ("areal_tpu.workflow.sdk.openai_sdk_agent", "openai"),
+        ("areal_tpu.workflow.sdk.langchain_math_agent", "langchain_openai"),
+    ):
+        try:
+            importlib.import_module(pkg)
+            importlib.import_module(mod)  # SDK present: must import clean
+        except ImportError:
+            with pytest.raises(ImportError, match="pip install"):
+                importlib.import_module(mod)
