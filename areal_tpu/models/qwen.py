@@ -431,6 +431,17 @@ def _embed_lookup(
                 in_specs=(P(("fsdp", "model"), None), P(BATCH_AXES, "seq")),
                 out_specs=P(BATCH_AXES, "seq", None),
             )(embed, ids)
+        # a non-dividing TRAINING grid means the caller skipped the engine's
+        # G/L padding — the replicated fallback below works but replicates
+        # ids + [G, L, D] output on every rank (the very cliff this function
+        # exists to avoid); make that loud
+        import warnings
+
+        warnings.warn(
+            f"_embed_lookup: grid {ids.shape} not divisible by mesh "
+            f"(dp={d_sz}, seq={s_sz}); taking the replicated fallback",
+            stacklevel=2,
+        )
     reps = (None,) * ids.ndim
     return jax.shard_map(  # replicated ids: decode steps, serving prefill
         local_flat,

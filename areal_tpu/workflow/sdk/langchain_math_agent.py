@@ -62,6 +62,11 @@ async def run_math_agent(
         if not ai.tool_calls:
             return ai.content
         for tc in ai.tool_calls:
-            out = by_name[tc["name"]].invoke(tc["args"])
+            tool = by_name.get(tc["name"])
+            try:
+                out = tool.invoke(tc["args"]) if tool else f"error: unknown tool {tc['name']}"
+            except Exception as e:  # noqa: BLE001 — feed back, don't crash
+                out = f"error: {e}"
             messages.append(ToolMessage(content=str(out), tool_call_id=tc["id"]))
-    return str(messages[-1].content)
+    # exhausted without a final assistant answer
+    return ""

@@ -79,12 +79,19 @@ async def run_math_agent(
         if not msg.tool_calls:
             return msg.content or ""
         for tc in msg.tool_calls:
-            args = json.loads(tc.function.arguments or "{}")
+            # early-training policies emit malformed calls; feed errors back
+            # as tool output instead of crashing the rollout
+            if tc.function.name != "calc":
+                content = f"error: unknown tool {tc.function.name}"
+            else:
+                try:
+                    args = json.loads(tc.function.arguments or "{}")
+                    content = _calc(args.get("expression", ""))
+                except (json.JSONDecodeError, AttributeError, TypeError) as e:
+                    content = f"error: bad arguments ({e})"
             messages.append(
-                {
-                    "role": "tool",
-                    "tool_call_id": tc.id,
-                    "content": _calc(args.get("expression", "")),
-                }
+                {"role": "tool", "tool_call_id": tc.id, "content": content}
             )
-    return messages[-1].get("content", "")
+    # turn budget exhausted without a final answer: do NOT surface the last
+    # tool output (the reward would score text the policy never produced)
+    return ""
