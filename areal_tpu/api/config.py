@@ -73,6 +73,9 @@ class TrainEngineConfig:
     param_dtype: str = "float32"  # master/optimizer dtype
     attn_impl: str = "pallas"  # pallas|xla
     gradient_checkpointing: bool = True
+    # jax.checkpoint policy when gradient_checkpointing is on:
+    # nothing | dots_nobatch | everything (models/qwen.py remat_policy)
+    remat_policy: str = "nothing"
     mb_spec: MicroBatchSpec = field(default_factory=MicroBatchSpec)
     pad_to_maximum: bool = False
     bucket_step: int = 512  # token-count bucketing to bound XLA recompiles

@@ -152,6 +152,7 @@ class JaxTrainEngine(TrainEngine):
                 **mcfg.__dict__,
                 "dtype": cfg.dtype,
                 "remat": cfg.gradient_checkpointing,
+                "remat_policy": cfg.remat_policy,
                 "attn_impl": cfg.attn_impl,
                 "lora_rank": cfg.lora_rank,
                 "lora_alpha": cfg.lora_alpha,

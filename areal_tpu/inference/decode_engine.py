@@ -98,6 +98,31 @@ def _iter_tree_paths(tree: dict, prefix: str = ""):
             yield key, v
 
 
+def _inverse_cdf_sample(scaled, rng):
+    """Exact categorical sampling with ONE uniform per row.
+
+    ``jax.random.categorical`` materializes gumbel noise for every vocab
+    entry — [S, 152k] of threefry bits per decode step, measured ~9 ms of
+    an 11 ms step at S=128 on v5e (the whole decode bottleneck). The
+    inverse-CDF draw needs only [S] uniforms: idx = first position where
+    cumsum(softmax) > u. Returns (ids [S], logp [S]) with logp the exact
+    log-softmax of the drawn token."""
+    lse = jax.scipy.special.logsumexp(scaled, axis=-1, keepdims=True)
+    probs = jnp.exp(scaled - lse)  # [S, V]
+    cum = jnp.cumsum(probs, axis=-1)
+    u = jax.random.uniform(rng, (scaled.shape[0], 1), jnp.float32)
+    # count of cdf entries <= u == index of the first bucket exceeding u.
+    # Scale u by the realized total (1 - fp32 cumsum undershoot) so the
+    # undershoot mass is spread proportionally instead of all landing on
+    # the last vocab id; the min() is then a pure OOB guard.
+    ids = jnp.sum((cum <= u * cum[:, -1:]).astype(jnp.int32), axis=-1)
+    ids = jnp.minimum(ids, scaled.shape[-1] - 1)
+    logp = (
+        jnp.take_along_axis(scaled, ids[:, None], axis=-1) - lse
+    )[:, 0]
+    return ids, logp, lse
+
+
 def _sample_step(logits, rng, state, capped: bool):
     """One sampling step. logits [S, V] fp32; all sampling knobs are
     *per-slot arrays* in ``state`` (temp, greedy, top_k, top_p) so one
@@ -111,8 +136,7 @@ def _sample_step(logits, rng, state, capped: bool):
     safe_t = jnp.maximum(temp, 1e-6)[:, None]
     scaled = logits / safe_t
     rng_full, rng_cap = jax.random.split(rng)
-    sampled = jax.random.categorical(rng_full, scaled, axis=-1)
-    logp_dist = jax.nn.log_softmax(scaled, axis=-1)
+    sampled, samp_logp, lse = _inverse_cdf_sample(scaled, rng_full)
     use_cap = None
     if capped:
         K = min(V, _TOPK_CAP)
@@ -133,7 +157,10 @@ def _sample_step(logits, rng, state, capped: bool):
         sampled = jnp.where(use_cap, cap_ids, sampled)
     arg = jnp.argmax(logits, axis=-1)
     next_ids = jnp.where(greedy, arg, sampled).astype(jnp.int32)
-    logp = jnp.take_along_axis(logp_dist, next_ids[:, None], axis=-1)[:, 0]
+    greedy_logp = (
+        jnp.take_along_axis(scaled, arg[:, None], axis=-1) - lse
+    )[:, 0]
+    logp = jnp.where(greedy, greedy_logp, samp_logp)
     if capped:
         logp = jnp.where(use_cap & ~greedy, cap_logp, logp)
     return next_ids, logp

@@ -56,6 +56,10 @@ class ModelConfig:
     attention_bias: bool = True  # Qwen2 has q/k/v bias
     dtype: str = "bfloat16"
     remat: bool = True
+    # checkpoint policy under remat: "nothing" (recompute all — min HBM),
+    # "dots_nobatch" (save non-batch matmul outputs — fewer recomputed
+    # FLOPs when HBM allows), "everything" (no recompute)
+    remat_policy: str = "nothing"
     # training attention: "xla" (masked sdpa, Ulysses via GSPMD a2a),
     # "ring" (shard_map ring attention over the mesh "seq" axis),
     # "pallas" (fused flash kernel; falls back to xla off-TPU)
@@ -630,9 +634,16 @@ def forward(
 
     layer_fn = partial(_decoder_layer, cfg, impl=impl)
     if cfg.remat:
-        layer_fn = jax.checkpoint(
-            layer_fn, policy=jax.checkpoint_policies.nothing_saveable
-        )
+        policies = {
+            "nothing": jax.checkpoint_policies.nothing_saveable,
+            "dots_nobatch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "everything": jax.checkpoint_policies.everything_saveable,
+        }
+        if cfg.remat_policy not in policies:
+            raise ValueError(
+                f"remat_policy={cfg.remat_policy!r}; valid: {sorted(policies)}"
+            )
+        layer_fn = jax.checkpoint(layer_fn, policy=policies[cfg.remat_policy])
 
     def body(x, layer):
         x, aux = layer_fn(x, layer, mask, positions)
@@ -651,9 +662,17 @@ def _lm_head_weight(params: dict) -> jax.Array:
 
 def compute_logits(params: dict, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
     """[..., D] -> [..., V] logits in fp32 (small decodes only — for training
-    use chunked_logprobs_entropy)."""
+    use chunked_logprobs_entropy). The matmul runs in the weight dtype with
+    fp32 ACCUMULATION — casting the [V, D] table to fp32 first would either
+    materialize a second full-size copy per step or push the matmul off the
+    bf16 MXU path (decode-step hot path)."""
     w = _lm_head_weight(params)
-    return jnp.einsum("...d,vd->...v", hidden.astype(jnp.float32), w.astype(jnp.float32))
+    return jax.lax.dot_general(
+        hidden.astype(w.dtype),
+        w,
+        (((hidden.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
 
 
 def chunked_logprobs_entropy(

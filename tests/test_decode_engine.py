@@ -333,3 +333,22 @@ def test_grpo_prefix_sharing():
         assert tuple(ref.output_tokens) == outs[0]
     finally:
         eng2.stop()
+
+
+def test_inverse_cdf_sampler_distribution():
+    """The one-uniform-per-row sampler draws from the exact softmax
+    distribution and reports exact logprobs (it replaced per-vocab gumbel
+    noise, which was ~80% of the decode step at S=128 x V=152k)."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.inference.decode_engine import _inverse_cdf_sample
+
+    n = 4000
+    logits = jnp.asarray([[2.0, 0.0, 1.0, -1.0, 0.5]] * n, jnp.float32)
+    want = np.asarray(jax.nn.softmax(logits[0]))
+    ids, logp, _ = jax.jit(_inverse_cdf_sample)(logits, jax.random.PRNGKey(0))
+    ids_np, logp_np = np.asarray(ids), np.asarray(logp)
+    np.testing.assert_allclose(logp_np, np.log(want[ids_np]), rtol=1e-5)
+    freq = np.bincount(ids_np, minlength=5) / n
+    np.testing.assert_allclose(freq, want, atol=0.03)
