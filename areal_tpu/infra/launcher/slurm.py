@@ -1,0 +1,276 @@
+"""SlurmLauncher: SPMD-mode trial orchestration over sbatch.
+
+Reference: areal/infra/launcher/slurm.py:49-684 — the cluster-tier launcher:
+(1) submit the inference-server array as one sbatch job, (2) wait for the
+servers to register their addresses, (3) submit the trainer job with
+``AREAL_LLM_SERVER_ADDRS``/``AREAL_RUN_ID`` exported, (4) supervise: when
+the trainer job fails and recover mode allows, resubmit with run_id+1 (the
+relaunched trainer restores from the recover checkpoint via RecoverHandler,
+utils/recover.py). Same contract as LocalLauncher so ``from_config`` call
+sites swap tiers with one class name.
+
+Slurm specifics: discovery rides the file name_resolve backend on a SHARED
+filesystem (set ``ns_root`` to a path all nodes mount — the standard slurm
+cluster shape); per-site TPU resources are injected via ``tpu_directive``
+(e.g. ``#SBATCH --gres=tpu:4``). Binaries ``sbatch``/``squeue``/``scancel``
+must be on PATH.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import subprocess
+import time
+
+from areal_tpu.utils import logging as alog, name_resolve
+
+logger = alog.getLogger("slurm_launcher")
+
+SERVER_ADDRS_ENV = "AREAL_LLM_SERVER_ADDRS"
+RUN_ID_ENV = "AREAL_RUN_ID"
+
+_FINISHED = {"COMPLETED", "FAILED", "CANCELLED", "TIMEOUT", "NODE_FAIL",
+             "PREEMPTED", "OUT_OF_MEMORY", "UNKNOWN"}
+_FAILED = _FINISHED - {"COMPLETED"}
+
+_SERVER_TEMPLATE = """#!/bin/bash
+#SBATCH --job-name=areal-{exp}-{trial}-srv
+#SBATCH --array=0-{max_task}
+#SBATCH --cpus-per-task={cpus}
+#SBATCH --mem={mem_gb}G
+#SBATCH --output={log_dir}/server-%a.log
+{extra_directives}
+export AREAL_NAME_RESOLVE=file
+export AREAL_NAME_RESOLVE_ROOT={ns_root}
+{env_exports}
+exec python -u -m areal_tpu.inference.server \\
+    --name {ns_key}/$SLURM_ARRAY_TASK_ID {server_args}
+"""
+
+_TRAINER_TEMPLATE = """#!/bin/bash
+#SBATCH --job-name=areal-{exp}-{trial}-train-r{run_id}
+#SBATCH --cpus-per-task={cpus}
+#SBATCH --mem={mem_gb}G
+#SBATCH --output={log_dir}/trainer-run{run_id}.log
+{extra_directives}
+export AREAL_NAME_RESOLVE=file
+export AREAL_NAME_RESOLVE_ROOT={ns_root}
+export {addrs_env}={addrs}
+export {run_id_env}={run_id}
+{env_exports}
+exec {trainer_cmd}
+"""
+
+
+class SlurmLauncher:
+    def __init__(
+        self,
+        experiment_name: str,
+        trial_name: str,
+        n_servers: int = 1,
+        server_args: list[str] | None = None,
+        log_dir: str = "/tmp/areal_tpu/slurm_launcher",
+        ns_root: str | None = None,
+        recover_mode: str = "off",  # off | on | auto
+        recover_retries: int = 1,
+        server_start_timeout: float = 600.0,
+        server_cpus: int = 8,
+        server_mem_gb: int = 32,
+        trainer_cpus: int = 16,
+        trainer_mem_gb: int = 64,
+        tpu_directive: str = "",  # site resource line, e.g. --gres=tpu:4
+        poll_interval: float = 5.0,
+    ):
+        for binary in ("sbatch", "squeue", "scancel"):
+            if shutil.which(binary) is None:
+                raise RuntimeError(
+                    f"SlurmLauncher requires {binary!r} on PATH; use "
+                    "LocalLauncher on a single host"
+                )
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self.n_servers = n_servers
+        self.server_args = list(server_args or [])
+        self.log_dir = log_dir
+        self.ns_root = ns_root or os.path.join(log_dir, "name_resolve")
+        self.recover_mode = recover_mode
+        self.recover_retries = recover_retries
+        self.server_start_timeout = server_start_timeout
+        self.server_cpus = server_cpus
+        self.server_mem_gb = server_mem_gb
+        self.trainer_cpus = trainer_cpus
+        self.trainer_mem_gb = trainer_mem_gb
+        self.tpu_directive = tpu_directive
+        self.poll_interval = poll_interval
+        self._server_job: str | None = None
+        os.makedirs(log_dir, exist_ok=True)
+        name_resolve.reconfigure("file", root=self.ns_root)
+
+    @classmethod
+    def from_config(cls, config, **overrides) -> "SlurmLauncher":
+        from areal_tpu.api.alloc_mode import apply_allocation_mode
+
+        apply_allocation_mode(config)
+        kw = dict(
+            experiment_name=config.experiment_name,
+            trial_name=config.trial_name,
+            n_servers=config.launcher.n_servers,
+            recover_mode=getattr(config.recover, "mode", "off"),
+            recover_retries=getattr(config.recover, "retries", 1),
+            server_start_timeout=config.scheduler.startup_timeout,
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
+    # -- script rendering (separate for testability) ----------------------
+    @property
+    def _ns_key(self) -> str:
+        return name_resolve.rollout_server_key(
+            self.experiment_name, self.trial_name
+        )
+
+    def render_server_script(self, extra_env: dict | None = None) -> str:
+        return _SERVER_TEMPLATE.format(
+            exp=self.experiment_name,
+            trial=self.trial_name,
+            max_task=self.n_servers - 1,
+            cpus=self.server_cpus,
+            mem_gb=self.server_mem_gb,
+            log_dir=self.log_dir,
+            extra_directives=self.tpu_directive,
+            ns_root=self.ns_root,
+            ns_key=self._ns_key,
+            env_exports=_exports(extra_env),
+            server_args=" ".join(shlex.quote(a) for a in self.server_args),
+        )
+
+    def render_trainer_script(
+        self, trainer_cmd: list[str], run_id: int, addrs: list[str],
+        extra_env: dict | None = None,
+    ) -> str:
+        return _TRAINER_TEMPLATE.format(
+            exp=self.experiment_name,
+            trial=self.trial_name,
+            run_id=run_id,
+            cpus=self.trainer_cpus,
+            mem_gb=self.trainer_mem_gb,
+            log_dir=self.log_dir,
+            extra_directives=self.tpu_directive,
+            ns_root=self.ns_root,
+            addrs_env=SERVER_ADDRS_ENV,
+            addrs=",".join(addrs),
+            run_id_env=RUN_ID_ENV,
+            env_exports=_exports(extra_env),
+            trainer_cmd=" ".join(shlex.quote(a) for a in trainer_cmd),
+        )
+
+    # -- slurm plumbing ---------------------------------------------------
+    def _submit(self, script_text: str, tag: str) -> str:
+        path = os.path.join(self.log_dir, f"{tag}.sbatch")
+        with open(path, "w") as f:
+            f.write(script_text)
+        out = subprocess.run(
+            ["sbatch", "--parsable", path],
+            capture_output=True,
+            text=True,
+            check=True,
+        )
+        job_id = out.stdout.strip().split(";")[0]
+        logger.info(f"submitted {tag} as slurm job {job_id}")
+        return job_id
+
+    def _state(self, job_id: str) -> str:
+        out = subprocess.run(
+            ["squeue", "-j", job_id, "-h", "-o", "%T"],
+            capture_output=True,
+            text=True,
+        )
+        if out.returncode != 0:
+            logger.warning(f"squeue failed: {out.stderr.strip()}")
+            return "UNKNOWN"
+        states = [s for s in out.stdout.split() if s]
+        if not states:
+            # job left the queue: squeue forgets finished jobs — treat as
+            # completed; run_trainer double-checks via the rc file
+            return "COMPLETED"
+        return states[0]
+
+    # -- lifecycle --------------------------------------------------------
+    def start_servers(self, extra_env: dict | None = None) -> list[str]:
+        assert self._server_job is None, "servers already started"
+        self._server_job = self._submit(
+            self.render_server_script(extra_env), "servers"
+        )
+        deadline = time.monotonic() + self.server_start_timeout
+        while True:
+            addrs = name_resolve.get_subtree(self._ns_key)
+            if len(addrs) >= self.n_servers:
+                logger.info(f"servers up: {addrs}")
+                return sorted(addrs)
+            state = self._state(self._server_job)
+            if state in _FAILED:
+                raise RuntimeError(
+                    f"server array job {self._server_job} state={state} "
+                    f"({len(addrs)}/{self.n_servers} registered)"
+                )
+            if time.monotonic() > deadline:
+                self.stop_servers()
+                raise TimeoutError(
+                    f"servers not registered after {self.server_start_timeout}s"
+                )
+            time.sleep(self.poll_interval)
+
+    def stop_servers(self) -> None:
+        if self._server_job is not None:
+            subprocess.run(["scancel", self._server_job], check=False)
+            self._server_job = None
+        try:
+            name_resolve.clear_subtree(self._ns_key)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def run_trainer(
+        self, trainer_cmd: list[str], extra_env: dict | None = None
+    ) -> int:
+        """Submit the trainer job and supervise to completion; resubmit with
+        run_id+1 on failure when recover mode allows (the reference
+        launcher's recovery loop, launcher/slurm.py run supervision)."""
+        addrs = sorted(name_resolve.get_subtree(self._ns_key))
+        attempt = 0
+        while True:
+            job_id = self._submit(
+                self.render_trainer_script(
+                    trainer_cmd, attempt, addrs, extra_env
+                ),
+                f"trainer-run{attempt}",
+            )
+            state = self._wait_finished(job_id)
+            if state == "COMPLETED":
+                return 0
+            if (
+                self.recover_mode in ("on", "auto")
+                and attempt < self.recover_retries
+            ):
+                attempt += 1
+                logger.warning(
+                    f"trainer job {job_id} state={state}; resubmitting "
+                    f"run_id={attempt}"
+                )
+                continue
+            logger.error(f"trainer job {job_id} final state={state}")
+            return 1
+
+    def _wait_finished(self, job_id: str) -> str:
+        while True:
+            state = self._state(job_id)
+            if state in _FINISHED:
+                return state
+            time.sleep(self.poll_interval)
+
+
+def _exports(env: dict | None) -> str:
+    return "\n".join(
+        f"export {k}={shlex.quote(str(v))}" for k, v in sorted((env or {}).items())
+    )

@@ -1,0 +1,219 @@
+"""Round-3 perf experiments on the real chip. Run phases individually:
+
+    python prof_r3.py decode   # chunk-step component timing + sweeps
+    python prof_r3.py train    # remat policies x attention impls x lengths
+
+All timing uses host scalar pulls (np.asarray) — jax.block_until_ready does
+NOT synchronize on the axon backend (see .claude/skills/verify/SKILL.md).
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.models import qwen
+
+MODEL_KW = dict(
+    vocab_size=151936, hidden_size=1536, intermediate_size=8960,
+    num_layers=28, num_heads=12, num_kv_heads=2, head_dim=128,
+    rope_theta=1_000_000.0, dtype="bfloat16", tie_word_embeddings=True,
+    attention_bias=True,
+)
+
+
+def sync(x):
+    return np.asarray(jax.tree.leaves(x)[0]).ravel()[0]
+
+
+def timeit(label, fn, *args, n=4):
+    out = fn(*args)
+    sync(out)
+    ts = []
+    for _ in range(n):
+        t0 = time.monotonic()
+        out = fn(*args)
+        sync(out)
+        ts.append(time.monotonic() - t0)
+    t = min(ts)
+    print(f"{label:52s} {t*1e3:9.2f} ms", flush=True)
+    return t
+
+
+def phase_decode():
+    from areal_tpu.api.config import MeshConfig, ServerConfig
+    from areal_tpu.inference.decode_engine import DecodeEngine, _sample_step
+    from areal_tpu.inference import paged_kv
+
+    cfg = qwen.ModelConfig(**MODEL_KW)
+    S, T, NS = 128, 512, 32
+    psz = 128
+    params = jax.jit(lambda k: qwen.init_params(k, cfg))(jax.random.PRNGKey(0))
+    sync(params)
+    n_pages = S * (T // psz) + 1
+    cache = jax.jit(lambda: paged_kv.init_paged_cache(cfg, n_pages, psz))()
+    sync(cache)
+    pt_host = np.zeros((S, T // psz), np.int32)
+    pt_host[:] = 1 + np.arange(S * (T // psz)).reshape(S, T // psz)
+    pt = jnp.asarray(pt_host)
+    ids = jnp.ones((S,), jnp.int32)
+    pos = jnp.full((S,), 256, jnp.int32)
+    state = {
+        "temp": jnp.ones(S, jnp.float32),
+        "greedy": jnp.zeros(S, bool),
+        "top_k": jnp.full(S, -1, jnp.int32),
+        "top_p": jnp.ones(S, jnp.float32),
+    }
+    rng = jax.random.PRNGKey(0)
+    print("== decode components (per chunk of 32 steps / per step) ==")
+
+    def mk_chunk(with_logits, with_sample, use_kernel=True):
+        def chunk(params, cache, pt, ids, pos, rng):
+            def step(carry, _):
+                ids, pos, cache, rng = carry
+                hid, cache = qwen.forward_decode_paged(
+                    params, cfg, ids, pos, cache, pt,
+                    page_size=psz, use_kernel=use_kernel,
+                )
+                if with_logits:
+                    logits = qwen.compute_logits(params, cfg, hid)
+                    if with_sample:
+                        rng, sub = jax.random.split(rng)
+                        nids, logp = _sample_step(logits, sub, state, False)
+                        return (nids, pos + 1, cache, rng), logp.sum()
+                    return (
+                        jnp.argmax(logits, -1).astype(jnp.int32),
+                        pos + 1,
+                        cache,
+                        rng,
+                    ), logits[0, 0]
+                return (ids, pos + 1, cache, rng), hid.sum()
+            (ids, pos, cache, rng), aux = jax.lax.scan(
+                step, (ids, pos, cache, rng), None, length=NS
+            )
+            return aux.sum()
+        return jax.jit(chunk)
+
+    t_full = timeit("A full chunk (fwd+logits+sample)", mk_chunk(True, True),
+                    params, cache, pt, ids, pos, rng) / NS
+    t_nl = timeit("B fwd+logits+argmax (no sampling)", mk_chunk(True, False),
+                  params, cache, pt, ids, pos, rng) / NS
+    t_f = timeit("C fwd only", mk_chunk(False, False),
+                 params, cache, pt, ids, pos, rng) / NS
+    t_x = timeit("D fwd only, XLA attn fallback", mk_chunk(False, False, False),
+                 params, cache, pt, ids, pos, rng) / NS
+    print(f"per-step: full={t_full*1e3:.2f} sample={1e3*(t_full-t_nl):.2f} "
+          f"logits={1e3*(t_nl-t_f):.2f} fwd={t_f*1e3:.2f} "
+          f"(xla-attn fwd {t_x*1e3:.2f}) -> {S/t_full:.0f} tok/s raw",
+          flush=True)
+
+    # engine end-to-end at a few slot counts
+    from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+    import threading
+    for S2, nsteps in ((128, 32), (128, 64), (256, 32)):
+        scfg = ServerConfig(
+            max_batch_size=S2, max_seq_len=T, decode_steps_per_call=nsteps,
+            mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        )
+        eng = DecodeEngine(scfg, params=params, model_cfg=cfg)
+        eng.initialize()
+        eng.precompile(prompt_buckets=[256])
+        eng.start()
+        rngg = np.random.default_rng(0)
+        done = threading.Event()
+        res = []
+        lock = threading.Lock()
+        n_req = 2 * S2
+        def cb(r):
+            with lock:
+                res.append(r)
+                if len(res) == n_req:
+                    done.set()
+        eng.generate_sync(ModelRequest(
+            input_ids=rngg.integers(0, 1000, 128).tolist(),
+            gconfig=GenerationHyperparameters(max_new_tokens=16, temperature=1.0)),
+            timeout=200)
+        t0 = time.monotonic()
+        for _ in range(n_req):
+            eng.submit(ModelRequest(
+                input_ids=rngg.integers(0, 1000, 128).tolist(),
+                gconfig=GenerationHyperparameters(max_new_tokens=256, temperature=1.0)), cb)
+        ok = done.wait(150)
+        dt = time.monotonic() - t0
+        with lock:
+            gen = sum(len(r.output_tokens) for r in res)
+        print(f"engine S={S2} nsteps={nsteps}: {gen/dt:8.0f} tok/s "
+              f"(ok={ok})", flush=True)
+        eng.stop()
+        del eng
+
+
+def phase_train():
+    from areal_tpu.api.config import (
+        MeshConfig, MicroBatchSpec, OptimizerConfig, TrainEngineConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.train_engine import JaxTrainEngine
+    from areal_tpu.ops import functional as F
+    from areal_tpu.utils.data import pad_sequences_to_tensors
+
+    rng = np.random.default_rng(0)
+    print("== train sweeps ==", flush=True)
+    for label, rows, lo, hi, policy, attn in (
+        ("L2048 nothing xla", 6, 1500, 2048, "nothing", "xla"),
+        ("L2048 dots_nobatch xla", 6, 1500, 2048, "dots_nobatch", "xla"),
+        ("L2048 nothing pallas", 6, 1500, 2048, "nothing", "pallas"),
+        ("L4096 nothing pallas", 3, 3500, 4096, "nothing", "pallas"),
+        ("L4096 nothing xla", 3, 3500, 4096, "nothing", "xla"),
+        ("L4096 dots_nobatch pallas", 3, 3500, 4096, "dots_nobatch", "pallas"),
+    ):
+        cfg = TrainEngineConfig(
+            init_from_scratch=True, dtype="bfloat16", param_dtype="bfloat16",
+            gradient_checkpointing=True, remat_policy=policy, attn_impl=attn,
+            mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+            optimizer=OptimizerConfig(lr=1e-5, lr_scheduler_type="constant"),
+            mb_spec=MicroBatchSpec(max_tokens_per_mb=100_000),
+            bucket_step=512, logprob_chunk_size=256,
+        )
+        mcfg = qwen.ModelConfig(**MODEL_KW)
+        try:
+            eng = JaxTrainEngine(cfg, model_config=mcfg)
+            eng.initialize(FinetuneSpec(1, 1000, 8))
+            trajs = []
+            for _ in range(rows):
+                n = int(rng.integers(lo, hi))
+                trajs.append({
+                    "input_ids": rng.integers(0, 32000, n).astype(np.int32),
+                    "loss_mask": np.concatenate(
+                        [np.zeros(128, np.float32), np.ones(n - 128, np.float32)]),
+                    "old_logprobs": rng.normal(-1.5, 0.1, n).astype(np.float32),
+                    "advantages": rng.normal(0, 1, n).astype(np.float32),
+                })
+            batch = pad_sequences_to_tensors(trajs)
+            n_tokens = int(np.asarray(batch["attention_mask"]).sum())
+
+            def grpo_loss(outputs, b):
+                lm = (b["label_valid"] & (b["loss_mask"] > 0)).astype(jnp.float32)
+                loss, _ = F.ppo_actor_loss_fn(
+                    logprobs=outputs["logprobs"],
+                    proximal_logprobs=b["old_logprobs"],
+                    old_logprobs=b["old_logprobs"],
+                    advantages=b["advantages"], loss_mask=lm)
+                return loss, {}
+
+            wf = lambda d: float((np.asarray(d["loss_mask"]) > 0).sum())
+            eng.train_batch(batch, grpo_loss, wf)  # compile
+            t0 = time.monotonic()
+            for _ in range(3):
+                eng.train_batch(batch, grpo_loss, wf)
+            dt = time.monotonic() - t0
+            print(f"{label:28s} {n_tokens*3/dt:8.0f} tok/s", flush=True)
+            eng.destroy()
+            del eng
+        except Exception as e:  # noqa: BLE001
+            print(f"{label:28s} FAILED {type(e).__name__}: {e}", flush=True)
+
+
+if __name__ == "__main__":
+    {"decode": phase_decode, "train": phase_train}[sys.argv[1]]()

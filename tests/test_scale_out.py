@@ -2,6 +2,7 @@
 scheduler engine-RPC defaults, slurm script rendering, worker liveness
 (reference rtensor.py:20-701, scheduler/slurm.py, scheduler health polls)."""
 
+import os
 import shutil
 
 import numpy as np
@@ -184,3 +185,76 @@ def test_controller_started_proxy_gateway_agent_flow():
         ctl.destroy()
         sched.delete_workers()
     assert ctl.gateway_url is None and not ctl.proxy_workers
+
+
+def test_slurm_launcher_supervision(tmp_path, monkeypatch):
+    """SlurmLauncher renders sbatch scripts and supervises the trainer with
+    run_id+1 resubmission on failure (reference launcher/slurm.py recovery
+    loop) — exercised against stub sbatch/squeue/scancel binaries."""
+    import stat
+
+    from areal_tpu.utils import name_resolve
+
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    state_dir = tmp_path / "state"
+    state_dir.mkdir()
+
+    def stub(name, body):
+        p = bindir / name
+        p.write_text("#!/bin/bash\n" + body)
+        p.chmod(p.stat().st_mode | stat.S_IEXEC)
+
+    # sbatch: assign incrementing ids, remember script path per id
+    stub(
+        "sbatch",
+        f"""n=$(cat {state_dir}/next 2>/dev/null || echo 1)
+echo $((n+1)) > {state_dir}/next
+cp "$2" {state_dir}/script-$n
+echo $n
+""",
+    )
+    # squeue: report state from a per-job file (default RUNNING)
+    stub(
+        "squeue",
+        f"""cat {state_dir}/state-$2 2>/dev/null || echo RUNNING
+""",
+    )
+    stub("scancel", "exit 0\n")
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+
+    from areal_tpu.infra.launcher.slurm import SlurmLauncher
+
+    lau = SlurmLauncher(
+        "exp",
+        "t0",
+        n_servers=2,
+        server_args=["model_path=/m"],
+        log_dir=str(tmp_path / "logs"),
+        ns_root=str(tmp_path / "ns"),
+        recover_mode="auto",
+        recover_retries=1,
+        server_start_timeout=20.0,
+        poll_interval=0.1,
+    )
+    # pretend the server array came up: register both addresses
+    name_resolve.add(f"{lau._ns_key}/0", "10.0.0.1:9000")
+    name_resolve.add(f"{lau._ns_key}/1", "10.0.0.2:9000")
+    (state_dir / "state-1").write_text("RUNNING\n")
+    addrs = lau.start_servers()
+    assert addrs == ["10.0.0.1:9000", "10.0.0.2:9000"]
+    srv_script = (state_dir / "script-1").read_text()
+    assert "--array=0-1" in srv_script and "model_path=/m" in srv_script
+
+    # trainer: first submission FAILS -> resubmitted with run_id 1 -> OK
+    (state_dir / "state-2").write_text("FAILED\n")
+    (state_dir / "state-3").write_text("COMPLETED\n")
+    rc = lau.run_trainer(["python", "train.py", "--config", "c.yaml"])
+    assert rc == 0
+    run0 = (state_dir / "script-2").read_text()
+    run1 = (state_dir / "script-3").read_text()
+    assert "export AREAL_RUN_ID=0" in run0
+    assert "export AREAL_RUN_ID=1" in run1
+    assert "10.0.0.1:9000,10.0.0.2:9000" in run0
+    assert "python train.py --config c.yaml" in run1
+    lau.stop_servers()
