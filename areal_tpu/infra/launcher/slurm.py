@@ -20,20 +20,15 @@ from __future__ import annotations
 
 import os
 import shlex
-import shutil
-import subprocess
 import time
 
+from areal_tpu.infra import slurm_tools as st
 from areal_tpu.utils import logging as alog, name_resolve
 
 logger = alog.getLogger("slurm_launcher")
 
 SERVER_ADDRS_ENV = "AREAL_LLM_SERVER_ADDRS"
 RUN_ID_ENV = "AREAL_RUN_ID"
-
-_FINISHED = {"COMPLETED", "FAILED", "CANCELLED", "TIMEOUT", "NODE_FAIL",
-             "PREEMPTED", "OUT_OF_MEMORY", "UNKNOWN"}
-_FAILED = _FINISHED - {"COMPLETED"}
 
 _SERVER_TEMPLATE = """#!/bin/bash
 #SBATCH --job-name=areal-{exp}-{trial}-srv
@@ -60,7 +55,10 @@ export AREAL_NAME_RESOLVE_ROOT={ns_root}
 export {addrs_env}={addrs}
 export {run_id_env}={run_id}
 {env_exports}
-exec {trainer_cmd}
+{trainer_cmd}
+rc=$?
+echo $rc > {log_dir}/trainer-run{run_id}.rc
+exit $rc
 """
 
 
@@ -83,12 +81,7 @@ class SlurmLauncher:
         tpu_directive: str = "",  # site resource line, e.g. --gres=tpu:4
         poll_interval: float = 5.0,
     ):
-        for binary in ("sbatch", "squeue", "scancel"):
-            if shutil.which(binary) is None:
-                raise RuntimeError(
-                    f"SlurmLauncher requires {binary!r} on PATH; use "
-                    "LocalLauncher on a single host"
-                )
+        st.require_binaries("SlurmLauncher")
         self.experiment_name = experiment_name
         self.trial_name = trial_name
         self.n_servers = n_servers
@@ -166,36 +159,12 @@ class SlurmLauncher:
             trainer_cmd=" ".join(shlex.quote(a) for a in trainer_cmd),
         )
 
-    # -- slurm plumbing ---------------------------------------------------
+    # -- slurm plumbing (shared with SlurmScheduler: infra/slurm_tools) ---
     def _submit(self, script_text: str, tag: str) -> str:
         path = os.path.join(self.log_dir, f"{tag}.sbatch")
         with open(path, "w") as f:
             f.write(script_text)
-        out = subprocess.run(
-            ["sbatch", "--parsable", path],
-            capture_output=True,
-            text=True,
-            check=True,
-        )
-        job_id = out.stdout.strip().split(";")[0]
-        logger.info(f"submitted {tag} as slurm job {job_id}")
-        return job_id
-
-    def _state(self, job_id: str) -> str:
-        out = subprocess.run(
-            ["squeue", "-j", job_id, "-h", "-o", "%T"],
-            capture_output=True,
-            text=True,
-        )
-        if out.returncode != 0:
-            logger.warning(f"squeue failed: {out.stderr.strip()}")
-            return "UNKNOWN"
-        states = [s for s in out.stdout.split() if s]
-        if not states:
-            # job left the queue: squeue forgets finished jobs — treat as
-            # completed; run_trainer double-checks via the rc file
-            return "COMPLETED"
-        return states[0]
+        return st.submit(path)
 
     # -- lifecycle --------------------------------------------------------
     def start_servers(self, extra_env: dict | None = None) -> list[str]:
@@ -209,8 +178,8 @@ class SlurmLauncher:
             if len(addrs) >= self.n_servers:
                 logger.info(f"servers up: {addrs}")
                 return sorted(addrs)
-            state = self._state(self._server_job)
-            if state in _FAILED:
+            state = st.job_state(self._server_job)
+            if state in st.FAILED_STATES:
                 raise RuntimeError(
                     f"server array job {self._server_job} state={state} "
                     f"({len(addrs)}/{self.n_servers} registered)"
@@ -224,7 +193,7 @@ class SlurmLauncher:
 
     def stop_servers(self) -> None:
         if self._server_job is not None:
-            subprocess.run(["scancel", self._server_job], check=False)
+            st.cancel(self._server_job)
             self._server_job = None
         try:
             name_resolve.clear_subtree(self._ns_key)
@@ -246,7 +215,7 @@ class SlurmLauncher:
                 ),
                 f"trainer-run{attempt}",
             )
-            state = self._wait_finished(job_id)
+            state = self._wait_finished(job_id, attempt)
             if state == "COMPLETED":
                 return 0
             if (
@@ -262,15 +231,39 @@ class SlurmLauncher:
             logger.error(f"trainer job {job_id} final state={state}")
             return 1
 
-    def _wait_finished(self, job_id: str) -> str:
+    def _wait_finished(self, job_id: str, run_id: int) -> str:
+        """Poll to a terminal verdict. squeue blips (UNKNOWN) are transient
+        and only abort after a long consecutive streak; a job that left the
+        queue (GONE) is judged by the rc file the trainer script wrote —
+        squeue forgets finished jobs, so queue absence alone proves
+        nothing about success."""
+        unknown_streak = 0
         while True:
-            state = self._state(job_id)
-            if state in _FINISHED:
+            state = st.job_state(job_id)
+            if state == st.UNKNOWN:
+                unknown_streak += 1
+                if unknown_streak * self.poll_interval > 300.0:
+                    raise RuntimeError(
+                        f"squeue unreachable for 300s while supervising "
+                        f"job {job_id}"
+                    )
+                time.sleep(self.poll_interval)
+                continue
+            unknown_streak = 0
+            if state == st.GONE:
+                rc_path = os.path.join(
+                    self.log_dir, f"trainer-run{run_id}.rc"
+                )
+                try:
+                    with open(rc_path) as f:
+                        rc = int(f.read().strip() or "1")
+                except (OSError, ValueError):
+                    rc = 1  # crashed before writing the rc file
+                return "COMPLETED" if rc == 0 else "FAILED"
+            if state in st.FINISHED_STATES:
                 return state
             time.sleep(self.poll_interval)
 
 
 def _exports(env: dict | None) -> str:
-    return "\n".join(
-        f"export {k}={shlex.quote(str(v))}" for k, v in sorted((env or {}).items())
-    )
+    return st.render_exports(env)

@@ -20,6 +20,7 @@ import time
 import uuid
 
 from areal_tpu.api.scheduler_api import Job, Scheduler, Worker
+from areal_tpu.infra import slurm_tools as st
 from areal_tpu.utils.network import http_json as _http_json
 
 from areal_tpu.utils import logging as alog, name_resolve
@@ -41,7 +42,7 @@ exec python -m areal_tpu.infra.rpc.rpc_server \\
     --name {ns_prefix}/{role}/$SLURM_ARRAY_TASK_ID
 """
 
-_FINISHED_STATES = {"COMPLETED", "FAILED", "CANCELLED", "TIMEOUT", "NODE_FAIL", "OUT_OF_MEMORY"}
+_FINISHED_STATES = st.FINISHED_STATES | {st.GONE}
 
 
 class SlurmScheduler(Scheduler):
@@ -52,12 +53,7 @@ class SlurmScheduler(Scheduler):
         start_timeout: float = 600.0,
         tpu_directive: str = "",  # site-specific, e.g. "#SBATCH --gres=tpu:4"
     ):
-        for binary in ("sbatch", "squeue", "scancel"):
-            if shutil.which(binary) is None:
-                raise RuntimeError(
-                    f"SlurmScheduler requires {binary!r} on PATH; use "
-                    "LocalScheduler on a single host"
-                )
+        st.require_binaries("SlurmScheduler")
         self.log_dir = log_dir
         self.ns_root = ns_root or os.path.join(log_dir, "name_resolve")
         self.start_timeout = start_timeout
@@ -93,14 +89,7 @@ class SlurmScheduler(Scheduler):
         script = os.path.join(self.log_dir, f"{job.role}.sbatch")
         with open(script, "w") as f:
             f.write(self._render_script(job))
-        out = subprocess.run(
-            ["sbatch", "--parsable", script],
-            capture_output=True,
-            text=True,
-            check=True,
-        )
-        job_id = out.stdout.strip().split(";")[0]
-        logger.info(f"submitted {job.role} as slurm job {job_id}")
+        job_id = st.submit(script)
         prefix = f"{self.ns_prefix}/{job.role}"
         deadline = time.monotonic() + self.start_timeout
         workers: list[Worker] = []
@@ -131,21 +120,10 @@ class SlurmScheduler(Scheduler):
         return workers
 
     def _job_state(self, job_id: str) -> str:
-        out = subprocess.run(
-            ["squeue", "-j", job_id, "-h", "-o", "%T"],
-            capture_output=True,
-            text=True,
-            check=False,
-        )
-        if out.returncode != 0:
-            # transient slurmctld outage must not read as COMPLETED (which
-            # would abort a healthy run); report unknown and let callers poll
-            logger.warning(f"squeue failed rc={out.returncode}: {out.stderr.strip()}")
-            return "UNKNOWN"
-        states = {s.strip() for s in out.stdout.splitlines() if s.strip()}
-        if not states:
-            return "COMPLETED"  # gone from the queue
-        return sorted(states)[0]
+        # shared poll semantics (infra/slurm_tools): failures aggregate
+        # across array tasks; UNKNOWN = transient squeue outage (callers
+        # keep polling); GONE = left the queue
+        return st.job_state(job_id)
 
     def get_workers(self, role: str) -> list[Worker]:
         return self._jobs.get(role, ("", []))[1]
@@ -169,7 +147,7 @@ class SlurmScheduler(Scheduler):
         for r in roles:
             job_id, _ = self._jobs.pop(r, ("", []))
             if job_id:
-                subprocess.run(["scancel", job_id], check=False)
+                st.cancel(job_id)
             # registrations never expire (keepalive_ttl=None) — clear them,
             # or a re-created role would instantly "discover" dead workers
             name_resolve.clear_subtree(f"{self.ns_prefix}/{r}")

@@ -1,0 +1,90 @@
+"""Shared sbatch/squeue plumbing for the Slurm scheduler and launcher.
+
+One home for the submit/poll/state conventions so the two slurm clients
+(infra/scheduler/slurm.py worker arrays, infra/launcher/slurm.py trial
+supervision) cannot drift: squeue failures are TRANSIENT (``UNKNOWN`` is
+never a terminal state by itself), array-job states aggregate across tasks
+with failures winning, and a job absent from the queue reports ``GONE`` —
+callers decide what absence means (the launcher reads the rc file its
+trainer script writes; registration/timeouts gate the server array).
+"""
+
+from __future__ import annotations
+
+import shlex
+import shutil
+import subprocess
+
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("slurm_tools")
+
+# states squeue can report that mean the job is over
+FINISHED_STATES = {
+    "COMPLETED",
+    "FAILED",
+    "CANCELLED",
+    "TIMEOUT",
+    "NODE_FAIL",
+    "PREEMPTED",
+    "OUT_OF_MEMORY",
+}
+FAILED_STATES = FINISHED_STATES - {"COMPLETED"}
+GONE = "GONE"  # job no longer in the queue (aged out / finished)
+UNKNOWN = "UNKNOWN"  # squeue itself failed — transient, retry
+
+
+def require_binaries(who: str) -> None:
+    for binary in ("sbatch", "squeue", "scancel"):
+        if shutil.which(binary) is None:
+            raise RuntimeError(
+                f"{who} requires {binary!r} on PATH; use the Local tier "
+                "on a single host"
+            )
+
+
+def submit(script_path: str) -> str:
+    """sbatch --parsable -> job id."""
+    out = subprocess.run(
+        ["sbatch", "--parsable", script_path],
+        capture_output=True,
+        text=True,
+        check=True,
+    )
+    job_id = out.stdout.strip().split(";")[0]
+    logger.info(f"submitted {script_path} as slurm job {job_id}")
+    return job_id
+
+
+def job_state(job_id: str) -> str:
+    """Aggregate state of a (possibly array) job: any failed task makes the
+    job FAILED; else running/pending wins; absent -> GONE; squeue error ->
+    UNKNOWN (transient — never treat as terminal on its own)."""
+    out = subprocess.run(
+        ["squeue", "-j", job_id, "-h", "-o", "%T"],
+        capture_output=True,
+        text=True,
+    )
+    if out.returncode != 0:
+        logger.warning(f"squeue failed rc={out.returncode}: {out.stderr.strip()}")
+        return UNKNOWN
+    states = set(out.stdout.split())
+    if not states:
+        return GONE
+    for s in sorted(states):
+        if s in FAILED_STATES:
+            return s
+    if "COMPLETED" in states and len(states) == 1:
+        return "COMPLETED"
+    return sorted(states - {"COMPLETED"})[0]  # RUNNING/PENDING/...
+
+
+def cancel(job_id: str) -> None:
+    subprocess.run(["scancel", job_id], check=False)
+
+
+def render_exports(env: dict | None) -> str:
+    return "\n".join(
+        f"export {k}={shlex.quote(str(v))}"
+        for k, v in sorted((env or {}).items())
+    )
