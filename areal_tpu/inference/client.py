@@ -252,6 +252,11 @@ class RemoteJaxEngine(InferenceEngine):
             return list(pool.map(call, self.addresses))
 
     # -- rollout submission (delegated to the executor) -------------------
+    def set_completion_callback(self, url: str, worker_id: str = "") -> None:
+        """Push task completions to the controller (fleet-scale wait path;
+        reference rollout_controller.py per-worker callback servers)."""
+        self.executor.set_completion_callback(url, worker_id)
+
     def submit(self, data: dict, workflow=None, should_accept_fn=None) -> str:
         return self.executor.submit(data, workflow, should_accept_fn)
 

@@ -10,6 +10,7 @@ the RPC boundary as import-path strings (api/workflow_api.py WorkflowLike).
 from __future__ import annotations
 
 import concurrent.futures
+import time
 from typing import Any
 
 import numpy as np
@@ -45,6 +46,15 @@ class RolloutController:
         self._gateway_thread = None
         self._gateway_loop = None
         self.gateway_url: str | None = None
+        import threading as _threading
+
+        self._cb_cv = _threading.Condition()
+        self._cb_done: set[str] = set()
+        from collections import deque as _deque
+
+        self._cb_order: "_deque[str]" = _deque()  # bound for never-awaited ids
+        self._cb_thread = None
+        self._cb_server = None
 
     # -- lifecycle --------------------------------------------------------
     def initialize(self, config, addresses: list[str] | None = None) -> None:
@@ -56,6 +66,7 @@ class RolloutController:
         self.scheduler.call_all(self.workers, "initialize", addresses)
 
     def destroy(self) -> None:
+        self.disable_completion_callbacks()
         self.stop_gateway()
         if self.proxy_workers:
             self.scheduler.delete_workers(self._proxy_role)
@@ -180,10 +191,107 @@ class RolloutController:
         self._task_worker[str(task_id)] = w
         return str(task_id)
 
+    # how long wait_for_task listens for a push before falling back to the
+    # (always-correct) blocking RPC — pushes are a latency/traffic
+    # optimization, never load-bearing
+    _CB_PUSH_GRACE_S = 10.0
+
     def wait_for_task(self, task_id: str, timeout: float | None = None):
         w = self._task_worker.pop(task_id, None)
         assert w is not None, f"unknown task {task_id}"
-        return self.scheduler.call_engine(w, "wait_for_task", task_id, timeout)
+        deadline = time.monotonic() + (
+            timeout if timeout is not None else 3600.0
+        )
+        if self._cb_thread is not None:
+            # hybrid push/poll: wait briefly for the worker's completion
+            # POST (the common fleet-scale case — then the RPC below
+            # returns instantly); a lost/late/forged push costs nothing
+            # because the blocking RPC is issued either way
+            grace = min(self._CB_PUSH_GRACE_S, max(0.0, deadline - time.monotonic()))
+            with self._cb_cv:
+                end = time.monotonic() + grace
+                while task_id not in self._cb_done:
+                    rem = end - time.monotonic()
+                    if rem <= 0:
+                        break
+                    self._cb_cv.wait(timeout=rem)
+                self._cb_done.discard(task_id)
+        remaining = max(1.0, deadline - time.monotonic())
+        return self.scheduler.call_engine(w, "wait_for_task", task_id, remaining)
+
+    def enable_completion_callbacks(self, port: int = 0) -> str:
+        """Start the controller's completion listener and point every
+        rollout worker's executor at it (reference per-worker completion
+        callback servers, rollout_controller.py:530-646). wait_for_task
+        then blocks on pushes instead of holding an RPC per task."""
+        import json as _json
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from areal_tpu.utils.network import find_free_port, gethostip
+
+        assert self.workers, "initialize() first"
+        assert self._cb_thread is None, "callbacks already enabled"
+        port = port or find_free_port()
+        ctl = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 — http.server API
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    payload = _json.loads(self.rfile.read(n) or b"{}")
+                except _json.JSONDecodeError:
+                    payload = {}
+                tid = str(payload.get("task_id", ""))
+                if tid:
+                    with ctl._cb_cv:
+                        ctl._cb_done.add(tid)
+                        ctl._cb_order.append(tid)
+                        # tasks consumed via rollout_batch/prepare_batch
+                        # never pass through wait_for_task; bound the set
+                        while len(ctl._cb_order) > 65536:
+                            ctl._cb_done.discard(ctl._cb_order.popleft())
+                        ctl._cb_cv.notify_all()
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._cb_server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self._cb_thread = threading.Thread(
+            target=self._cb_server.serve_forever, daemon=True
+        )
+        self._cb_thread.start()
+        url = f"http://{gethostip()}:{port}/task_done"
+        try:
+            for w in self.workers:
+                self.scheduler.call_engine(
+                    w, "set_completion_callback", url, w.id
+                )
+        except Exception:
+            self.disable_completion_callbacks()
+            raise
+        logger.info(f"completion callbacks -> {url}")
+        return url
+
+    def disable_completion_callbacks(self) -> None:
+        if self._cb_thread is not None:
+            for w in self.workers:
+                try:
+                    self.scheduler.call_engine(
+                        w, "set_completion_callback", "", w.id
+                    )
+                except Exception:  # noqa: BLE001 — worker may be gone
+                    pass
+            self._cb_server.shutdown()
+            self._cb_thread.join(timeout=10)
+            self._cb_thread = None
+            self._cb_server = None
+            with self._cb_cv:
+                self._cb_done.clear()
+                self._cb_order.clear()
 
     def rollout_batch(self, data: list[dict], workflow: str | None = None, **kw):
         """Split items across workers; each runs its share through its own

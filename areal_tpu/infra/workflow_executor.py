@@ -11,6 +11,7 @@ infinite dataloader cycle (reference :1290-1313) — the core of async RL.
 
 from __future__ import annotations
 
+import json
 import queue
 import threading
 import time
@@ -198,6 +199,54 @@ class WorkflowExecutor:
                 while len(self._reject_order) > self._max_reject_records:
                     self._done_tasks.pop(self._reject_order.popleft(), None)
             self._cv.notify_all()
+        self._notify_completion(task_id, accepted)
+
+    # -- completion push (fleet-scale wait: reference rollout_controller
+    # per-worker completion callbacks, rollout_controller.py:530-646) ------
+    def set_completion_callback(self, url: str, worker_id: str = "") -> None:
+        """POST {task_id, accepted, worker_id} to ``url`` as each task
+        finishes, from a dedicated notifier thread (never the workflow
+        loop). The controller uses this to wait on pushes instead of
+        polling every task over RPC."""
+        import urllib.request
+
+        if getattr(self, "_notify_q", None) is None:
+            self._notify_q: queue.Queue = queue.Queue()
+
+            def pump():
+                while True:
+                    item = self._notify_q.get()
+                    if item is None:
+                        return
+                    u, payload = item
+                    try:
+                        req = urllib.request.Request(
+                            u,
+                            data=json.dumps(payload).encode(),
+                            headers={"Content-Type": "application/json"},
+                        )
+                        urllib.request.urlopen(req, timeout=10).read()
+                    except Exception as e:  # noqa: BLE001 — the poll path
+                        # still works; pushes are a latency optimization
+                        logger.warning(f"completion callback failed: {e}")
+
+            threading.Thread(target=pump, daemon=True).start()
+        self._callback_url = url
+        self._callback_worker_id = worker_id
+
+    def _notify_completion(self, task_id: str, accepted: bool) -> None:
+        url = getattr(self, "_callback_url", None)
+        if url:
+            self._notify_q.put(
+                (
+                    url,
+                    {
+                        "task_id": task_id,
+                        "accepted": bool(accepted),
+                        "worker_id": getattr(self, "_callback_worker_id", ""),
+                    },
+                )
+            )
 
     def _check_health(self) -> None:
         if self._thread_exc is not None:

@@ -171,9 +171,31 @@ class FakeRolloutEngine:
     def destroy(self):
         pass
 
+    def set_completion_callback(self, url, worker_id=""):
+        self.cb = (url, worker_id)
+
+    def _push(self, task_id):
+        import json as _json
+        import urllib.request
+
+        url, wid = self.cb
+        req = urllib.request.Request(
+            url,
+            data=_json.dumps(
+                {"task_id": task_id, "accepted": True, "worker_id": wid}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=10).read()
+
     def submit(self, data, workflow=None, **kw):
         self.submitted.append(data)
-        return f"task-{len(self.submitted)}"
+        tid = f"task-{len(self.submitted)}"
+        if getattr(self, "cb", None):
+            import threading as _t
+
+            _t.Timer(0.05, self._push, args=(tid,)).start()
+        return tid
 
     def wait_for_task(self, task_id, timeout=None):
         return {"input_ids": np.ones((1, 4), np.int64), "task": task_id}
@@ -209,6 +231,12 @@ def test_rollout_controller_dispatch():
     tid = rc.submit({"q": 1})
     res = rc.wait_for_task(tid)
     assert res["task"] == tid
+
+    # push mode: completions arrive via the controller's callback listener
+    rc.enable_completion_callbacks()
+    tid2 = rc.submit({"q": 2})
+    res2 = rc.wait_for_task(tid2, timeout=30)
+    assert res2["task"] == tid2
 
     out = rc.rollout_batch([{"q": i} for i in range(5)])
     assert len(out["input_ids"]) == 5

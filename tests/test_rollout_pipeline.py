@@ -133,3 +133,49 @@ def test_prepare_batch_async_pipeline(client):
     b2 = client.prepare_batch(loader, workflow=wf)
     assert b1["input_ids"].shape[0] == 2
     assert b2["input_ids"].shape[0] == 2
+
+
+def test_completion_callback_push(client):
+    """Executor completion pushes: a registered callback URL receives
+    {task_id, accepted, worker_id} for each finished task (the controller's
+    fleet-scale wait path; reference per-worker callback servers,
+    rollout_controller.py:530-646)."""
+    import json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    got = []
+    ev = threading.Event()
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            got.append(json.loads(self.rfile.read(n)))
+            ev.set()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}/task_done"
+        client.set_completion_callback(url, worker_id="w-7")
+        wf = RLVRWorkflow(
+            lambda *a, **k: 1.0,
+            GenerationHyperparameters(max_new_tokens=4, greedy=True),
+            tokenizer=None,
+        )
+        tid = client.submit({"prompt_ids": [5, 6, 7]}, wf)
+        res = client.wait_for_task(tid, timeout=120)
+        assert res is not None
+        assert ev.wait(30), "no completion push received"
+        assert got[0]["task_id"] == tid
+        assert got[0]["accepted"] is True
+        assert got[0]["worker_id"] == "w-7"
+    finally:
+        client.executor._callback_url = None
+        srv.shutdown()
