@@ -257,8 +257,10 @@ class RemoteJaxEngine(InferenceEngine):
         reference rollout_controller.py per-worker callback servers)."""
         self.executor.set_completion_callback(url, worker_id)
 
-    def submit(self, data: dict, workflow=None, should_accept_fn=None) -> str:
-        return self.executor.submit(data, workflow, should_accept_fn)
+    def submit(
+        self, data: dict, workflow=None, should_accept_fn=None, is_eval=False
+    ) -> str:
+        return self.executor.submit(data, workflow, should_accept_fn, is_eval=is_eval)
 
     def wait(self, count: int, timeout: float | None = None) -> TensorDict:
         return self.executor.wait(count, timeout)
@@ -266,8 +268,12 @@ class RemoteJaxEngine(InferenceEngine):
     def wait_for_task(self, task_id: str, timeout: float | None = None):
         return self.executor.wait_for_task(task_id, timeout)
 
-    def rollout_batch(self, data, workflow=None, should_accept_fn=None) -> TensorDict:
-        return self.executor.rollout_batch(data, workflow, should_accept_fn)
+    def rollout_batch(
+        self, data, workflow=None, should_accept_fn=None, is_eval=False
+    ) -> TensorDict:
+        return self.executor.rollout_batch(
+            data, workflow, should_accept_fn, is_eval=is_eval
+        )
 
     def prepare_batch(self, dataloader, workflow=None, should_accept_fn=None) -> TensorDict:
         return self.executor.prepare_batch(dataloader, workflow, should_accept_fn)

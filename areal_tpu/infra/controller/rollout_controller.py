@@ -197,17 +197,16 @@ class RolloutController:
     _CB_PUSH_GRACE_S = 10.0
 
     def wait_for_task(self, task_id: str, timeout: float | None = None):
-        w = self._task_worker.pop(task_id, None)
+        w = self._task_worker.get(task_id)
         assert w is not None, f"unknown task {task_id}"
-        deadline = time.monotonic() + (
-            timeout if timeout is not None else 3600.0
-        )
         if self._cb_thread is not None:
             # hybrid push/poll: wait briefly for the worker's completion
             # POST (the common fleet-scale case — then the RPC below
             # returns instantly); a lost/late/forged push costs nothing
             # because the blocking RPC is issued either way
-            grace = min(self._CB_PUSH_GRACE_S, max(0.0, deadline - time.monotonic()))
+            grace = self._CB_PUSH_GRACE_S
+            if timeout is not None:
+                grace = min(grace, timeout)
             with self._cb_cv:
                 end = time.monotonic() + grace
                 while task_id not in self._cb_done:
@@ -216,8 +215,12 @@ class RolloutController:
                         break
                     self._cb_cv.wait(timeout=rem)
                 self._cb_done.discard(task_id)
-        remaining = max(1.0, deadline - time.monotonic())
-        return self.scheduler.call_engine(w, "wait_for_task", task_id, remaining)
+        # None passes through (the worker applies its configured timeout);
+        # the mapping is only dropped on success so a timed-out wait can
+        # be retried
+        result = self.scheduler.call_engine(w, "wait_for_task", task_id, timeout)
+        self._task_worker.pop(task_id, None)
+        return result
 
     def enable_completion_callbacks(self, port: int = 0) -> str:
         """Start the controller's completion listener and point every
@@ -286,6 +289,7 @@ class RolloutController:
                 except Exception:  # noqa: BLE001 — worker may be gone
                     pass
             self._cb_server.shutdown()
+            self._cb_server.server_close()
             self._cb_thread.join(timeout=10)
             self._cb_thread = None
             self._cb_server = None

@@ -1,0 +1,48 @@
+"""Per-task workflow execution context (reference infra/workflow_context.py).
+
+A frozen dataclass in a ContextVar — asyncio-task-local, so the hundreds of
+interleaved rollout coroutines on the runner loop each see their own
+context. The executor sets it as it launches each episode; workflows and
+rewards read it via ``get()``; stats recorded inside an eval task
+automatically land under the ``eval-rollout/`` scope (``stat_scope`` +
+the stats_tracker prefix hook), keeping eval rollouts out of training
+curves without a separate client. The reference module also owns shared
+HTTP client pooling; here that lives with the client/session machinery
+(inference/client.py, infra/async_task_runner.py).
+"""
+
+from __future__ import annotations
+
+from contextvars import ContextVar
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class WorkflowContext:
+    is_eval: bool = False
+    task_id: str | None = None
+
+
+_current: ContextVar[WorkflowContext] = ContextVar(
+    "areal_workflow_context", default=WorkflowContext()
+)
+
+
+def set(ctx: WorkflowContext) -> None:  # noqa: A001 — reference API name
+    _current.set(ctx)
+
+
+def get() -> WorkflowContext:
+    return _current.get()
+
+
+def stat_scope() -> str:
+    """Stats scope for the current task: eval rollouts are quarantined."""
+    return "eval-rollout" if get().is_eval else ""
+
+
+# install the stats-scope hook: stats recorded inside an eval task prepend
+# "eval-rollout/" (utils/stats_tracker stays free of infra imports)
+from areal_tpu.utils import stats_tracker as _stats_tracker  # noqa: E402
+
+_stats_tracker.register_prefix_hook(stat_scope)

@@ -17,6 +17,7 @@ import threading
 import time
 import uuid
 from collections import deque
+from contextlib import nullcontext as _nullcontext
 from typing import Any, Callable
 
 import numpy as np
@@ -50,13 +51,14 @@ def check_trajectory_format(traj: TensorDict) -> None:
 
 
 class _TaskRecord:
-    __slots__ = ("task_id", "data", "result", "accepted")
+    __slots__ = ("task_id", "data", "result", "accepted", "is_eval")
 
-    def __init__(self, task_id: str, data: Any):
+    def __init__(self, task_id: str, data: Any, is_eval: bool = False):
         self.task_id = task_id
         self.data = data
         self.result: TensorDict | None = None
         self.accepted: bool | None = None
+        self.is_eval = is_eval
 
 
 class WorkflowExecutor:
@@ -80,10 +82,16 @@ class WorkflowExecutor:
         self._input: queue.Queue[tuple[_TaskRecord, RolloutWorkflow, Callable | None]] = (
             queue.Queue()
         )
+        # eval tasks skip staleness gating/accounting entirely (they are
+        # off-policy-neutral; reference workflow_context is_eval semantics)
+        self._input_eval: queue.Queue[
+            tuple[_TaskRecord, RolloutWorkflow, Callable | None]
+        ] = queue.Queue()
         # (task_id, traj, n_real_tokens) — the count is cached at append
         # time so the dynamic-batch poll loop doesn't re-reduce every
         # pending mask on each iteration
         self._results: list[tuple[str, TensorDict, int]] = []
+        self._eval_results: list[tuple[str, TensorDict, int]] = []
         self._done_tasks: dict[str, _TaskRecord] = {}
         # rejected tasks nobody awaits leave tombstones; bound their count
         self._reject_order: deque[str] = deque()
@@ -105,6 +113,8 @@ class WorkflowExecutor:
 
     def destroy(self) -> None:
         self._shutdown.set()
+        if getattr(self, "_notify_q", None) is not None:
+            self._notify_q.put(None)  # stop the callback pump thread
         if self._thread:
             self._thread.join(timeout=10)
         self.runner.stop()
@@ -121,7 +131,15 @@ class WorkflowExecutor:
         try:
             while not self._shutdown.is_set():
                 progressed = False
-                # move queued inputs into the runner while capacity allows
+                # eval tasks launch unconditionally (no staleness budget)
+                while not self._paused.is_set():
+                    try:
+                        rec, workflow, accept_fn = self._input_eval.get_nowait()
+                    except queue.Empty:
+                        break
+                    self._launch(rec, workflow, accept_fn)
+                    progressed = True
+                # move queued train inputs into the runner while capacity allows
                 while not self._paused.is_set():
                     if self.staleness.get_capacity() <= 0:
                         break
@@ -148,8 +166,16 @@ class WorkflowExecutor:
 
     def _launch(self, rec: _TaskRecord, workflow: RolloutWorkflow, accept_fn) -> None:
         async def run():
+            from areal_tpu.infra import workflow_context
             from areal_tpu.utils import perf_tracer
 
+            # asyncio-task-local execution context: workflows/rewards read
+            # it via workflow_context.get(); eval tasks' stats auto-scope
+            workflow_context.set(
+                workflow_context.WorkflowContext(
+                    is_eval=rec.is_eval, task_id=rec.task_id
+                )
+            )
             perf_tracer.set_task_context(task_id=rec.task_id)
             perf_tracer.get_session_tracer().start_session(rec.task_id)
             traj = await workflow.arun_episode(self.engine, rec.data)
@@ -165,21 +191,33 @@ class WorkflowExecutor:
 
             traj = pad_sequences_to_tensors(traj) if traj else None
         accepted = traj is not None
+        is_eval = rec.is_eval if rec is not None else False
         if accepted and self.config.check_trajectory_format:
             check_trajectory_format(traj)
         if accepted and accept_fn is not None:
             accepted = bool(accept_fn(traj))
+        # this runs on the dispatcher thread where the task ContextVar is
+        # not set — scope the counters explicitly so eval accounting stays
+        # out of training curves
+        tracker = stats_tracker.get()
+        counter_cm = (
+            tracker.scope("eval-rollout") if is_eval else _nullcontext()
+        )
         if accepted:
-            self.staleness.on_accept()
-            stats_tracker.get().scalar(rollout_accepted=1.0)
+            if not is_eval:
+                self.staleness.on_accept()
+            with counter_cm:
+                tracker.scalar(rollout_accepted=1.0)
             if self.config.dump_trajectories:
                 try:
                     self._dump_trajectory(traj, task_id)
                 except Exception:  # noqa: BLE001 — dumping must never kill rollout
                     logger.exception("trajectory dump failed")
         else:
-            self.staleness.on_reject()
-            stats_tracker.get().scalar(rollout_rejected=1.0)
+            if not is_eval:
+                self.staleness.on_reject()
+            with counter_cm:
+                tracker.scalar(rollout_rejected=1.0)
         from areal_tpu.utils import perf_tracer
 
         perf_tracer.get_session_tracer().finalize(
@@ -191,7 +229,8 @@ class WorkflowExecutor:
                 rec.accepted = accepted
                 rec.data = None  # release the input payload
             if accepted:
-                self._results.append(
+                bucket = self._eval_results if is_eval else self._results
+                bucket.append(
                     (task_id, traj, int(np.asarray(traj["attention_mask"]).sum()))
                 )
             elif rec is not None:
@@ -210,6 +249,9 @@ class WorkflowExecutor:
         polling every task over RPC."""
         import urllib.request
 
+        if not url:
+            self._callback_url = None
+            return
         if getattr(self, "_notify_q", None) is None:
             self._notify_q: queue.Queue = queue.Queue()
 
@@ -324,29 +366,38 @@ class WorkflowExecutor:
         data: dict,
         workflow: Any = None,
         should_accept_fn: Callable | None = None,
+        is_eval: bool = False,
     ) -> str:
         workflow = resolve_workflow(workflow)
-        rec = _TaskRecord(uuid.uuid4().hex, data)
+        rec = _TaskRecord(uuid.uuid4().hex, data, is_eval=is_eval)
         self._done_tasks[rec.task_id] = rec
-        self._input.put((rec, workflow, should_accept_fn))
+        (self._input_eval if is_eval else self._input).put(
+            (rec, workflow, should_accept_fn)
+        )
         return rec.task_id
 
-    def wait(self, count: int, timeout: float | None = None) -> TensorDict:
-        """Block until ``count`` accepted trajectories, then pop and merge."""
+    def wait(
+        self, count: int, timeout: float | None = None, is_eval: bool = False
+    ) -> TensorDict:
+        """Block until ``count`` accepted trajectories, then pop and merge.
+        Train and eval results live in SEPARATE buffers — interleaved eval
+        can never leak eval samples into a training batch."""
         deadline = time.monotonic() + (timeout or self.config.request_timeout)
         with self._cv:
-            while len(self._results) < count:
+            bucket = lambda: self._eval_results if is_eval else self._results
+            while len(bucket()) < count:
                 self._check_health()
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise TimeoutError(
-                        f"waited for {count} trajectories, got {len(self._results)}"
+                        f"waited for {count} trajectories, got {len(bucket())}"
                     )
                 self._cv.wait(timeout=min(remaining, 0.2))
-            out, self._results = (
-                self._results[:count],
-                self._results[count:],
-            )
+            out = bucket()[:count]
+            if is_eval:
+                self._eval_results = self._eval_results[count:]
+            else:
+                self._results = self._results[count:]
             for tid, _, _ in out:
                 self._done_tasks.pop(tid, None)
         return concat_padded_tensor_dicts([t for _, t, _ in out])
@@ -363,17 +414,21 @@ class WorkflowExecutor:
                 self._cv.wait(timeout=min(remaining, 0.2))
         with self._cv:
             self._done_tasks.pop(task_id, None)
-            # drop this task's trajectory from the shared results buffer so it
-            # is not consumed a second time by wait()/prepare_batch
+            # drop this task's trajectory from the results buffers so it is
+            # not consumed a second time by wait()/prepare_batch
             self._results = [r for r in self._results if r[0] != task_id]
+            self._eval_results = [
+                r for r in self._eval_results if r[0] != task_id
+            ]
         return rec.result
 
     def rollout_batch(
-        self, data: list[dict], workflow=None, should_accept_fn=None
+        self, data: list[dict], workflow=None, should_accept_fn=None,
+        is_eval: bool = False,
     ) -> TensorDict:
         for d in data:
-            self.submit(d, workflow, should_accept_fn)
-        return self.wait(len(data))
+            self.submit(d, workflow, should_accept_fn, is_eval=is_eval)
+        return self.wait(len(data), is_eval=is_eval)
 
     def prepare_batch(
         self, dataloader, workflow=None, should_accept_fn=None

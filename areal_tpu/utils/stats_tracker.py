@@ -33,6 +33,15 @@ class ReduceType(Enum):
     SCALAR = "scalar"
 
 
+_PREFIX_HOOK = None  # () -> str; see infra/workflow_context
+
+
+def register_prefix_hook(fn) -> None:
+    """Install the task-context scope hook (one slot; latest wins)."""
+    global _PREFIX_HOOK
+    _PREFIX_HOOK = fn
+
+
 class StatsTracker:
     def __init__(self):
         self._lock = threading.RLock()
@@ -48,11 +57,23 @@ class StatsTracker:
 
     # -- scoping ----------------------------------------------------------
     def _prefix(self) -> str:
-        return getattr(self._scope, "prefix", "")
+        prefix = getattr(self._scope, "prefix", "")
+        # optional context hook (installed by infra/workflow_context at its
+        # import — keeps this utils module layering-free): prepends e.g.
+        # "eval-rollout/" for stats recorded inside an eval rollout task
+        hook = _PREFIX_HOOK
+        if hook is not None:
+            ctx_scope = hook()
+            if ctx_scope:
+                return f"{ctx_scope}/{prefix}"
+        return prefix
 
     @contextmanager
     def scope(self, name: str):
-        old = self._prefix()
+        # save/restore the RAW thread-local prefix: going through _prefix()
+        # would bake a context-derived scope into the thread-local and
+        # double-prefix (and permanently misroute) later keys
+        old = getattr(self._scope, "prefix", "")
         self._scope.prefix = f"{old}{name}/"
         try:
             yield self

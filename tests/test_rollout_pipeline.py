@@ -179,3 +179,24 @@ def test_completion_callback_push(client):
     finally:
         client.executor._callback_url = None
         srv.shutdown()
+
+
+def test_eval_rollouts_scope_stats(client):
+    """WorkflowContext (reference infra/workflow_context.py): stats recorded
+    inside an is_eval task land under the eval-rollout/ scope — eval
+    rollouts stay out of training curves, interleaved on the same client."""
+    from areal_tpu.utils import stats_tracker
+
+    stats_tracker.get().export(reset=True)  # clean slate
+    wf = RLVRWorkflow(
+        lambda *a, **k: 1.0,
+        GenerationHyperparameters(max_new_tokens=4, greedy=True),
+        tokenizer=None,
+    )
+    t_train = client.submit({"prompt_ids": [11, 12, 13]}, wf)
+    t_eval = client.submit({"prompt_ids": [14, 15, 16]}, wf, is_eval=True)
+    assert client.wait_for_task(t_train, timeout=120) is not None
+    assert client.wait_for_task(t_eval, timeout=120) is not None
+    stats = stats_tracker.get().export(reset=True)
+    assert any(k == "reward" or k.endswith("/reward") and not k.startswith("eval-rollout/") for k in stats), stats
+    assert any(k.startswith("eval-rollout/") and "reward" in k for k in stats), stats
