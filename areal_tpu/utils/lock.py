@@ -1,0 +1,145 @@
+"""Distributed mutual exclusion (reference utils/lock.py:8-100 role).
+
+The reference mutexes over the torch TCPStore (atomic counter + owner
+token). This framework has no always-on store process; its cross-process
+fabric is the name_resolve file tree — on one host a local directory, on
+the slurm tier a shared filesystem every node mounts. The lock therefore
+rides the same substrate: ``O_CREAT|O_EXCL`` file creation is the atomic
+primitive (POSIX guarantees it locally; NFSv3+ guarantees it for exclusive
+create), the file body is the owner token, and a TTL lets waiters steal a
+lease whose holder crashed without releasing (the reference's TCPStore
+loses all state when the trainer dies — here the failure mode is an
+orphaned file, so expiry is explicit).
+
+Typical guarded sections: rank-0-only checkpoint directory mutations,
+recover-info rewrites, shared dataset cache fills.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+import uuid
+
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("lock")
+
+
+def _default_root() -> str:
+    base = os.environ.get("AREAL_NAME_RESOLVE_ROOT", "/tmp/areal_tpu")
+    return os.path.join(base, "locks")
+
+
+class DistributedLock:
+    """File-lease mutex. Not reentrant. Safe across processes and (on a
+    shared filesystem) across hosts."""
+
+    def __init__(
+        self,
+        name: str,
+        root: str | None = None,
+        backoff: float = 0.05,
+        ttl: float | None = 300.0,  # None = leases never expire
+    ):
+        self.root = root or _default_root()
+        os.makedirs(self.root, exist_ok=True)
+        self.path = os.path.join(self.root, f"{name}.lock")
+        self.backoff = backoff
+        self.ttl = ttl
+        self.token: str | None = None
+
+    # -- core -------------------------------------------------------------
+    def acquire(self, timeout: float | None = None) -> bool:
+        assert self.token is None, "lock is not reentrant"
+        start = time.perf_counter()
+        sleep = self.backoff
+        token = f"{os.uname().nodename}:{os.getpid()}:{uuid.uuid4().hex}"
+        while True:
+            if self._try_create(token):
+                self.token = token
+                return True
+            self._maybe_steal_stale()
+            if timeout is not None and time.perf_counter() - start > timeout:
+                return False
+            time.sleep(sleep * (1.0 + 0.25 * random.random()))
+            sleep = min(sleep * 1.5, 0.5)
+
+    def release(self) -> None:
+        if self.token is None:
+            raise RuntimeError("lock not held by this process")
+        token, self.token = self.token, None
+        owner = self._read_owner()
+        if owner != token:
+            # our lease was stolen after expiring (ttl overrun) — whether
+            # the stealer still holds it or already finished, the guarded
+            # section's exclusivity was violated; surface that always
+            raise RuntimeError(
+                "lock lease was lost (ttl overrun and stolen); owner is "
+                f"now {owner!r}"
+            )
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    # -- internals --------------------------------------------------------
+    def _try_create(self, token: str) -> bool:
+        try:
+            fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+        except FileExistsError:
+            return False
+        with os.fdopen(fd, "w") as f:
+            f.write(token)
+        return True
+
+    def _read_owner(self) -> str | None:
+        try:
+            with open(self.path) as f:
+                return f.read().strip()
+        except OSError:
+            return None
+
+    def _maybe_steal_stale(self) -> None:
+        """Break a lease whose holder died without releasing: unlink once
+        the file is older than the TTL. Token-verified immediately before
+        the unlink, so a fresh lease created after our staleness
+        observation (old holder released, new holder acquired) is not
+        destroyed — the residual read-to-unlink window is microseconds and
+        only reachable after a holder already violated the TTL contract
+        (holders must finish or ``refresh()`` within ttl)."""
+        if self.ttl is None:
+            return
+        stale_owner = self._read_owner()
+        if stale_owner is None:
+            return  # released meanwhile
+        try:
+            age = time.time() - os.stat(self.path).st_mtime
+        except OSError:
+            return
+        if age <= self.ttl:
+            return
+        if self._read_owner() != stale_owner:
+            return  # lease turned over while we were deciding
+        logger.warning(
+            f"breaking stale lock {self.path} (age {age:.0f}s > "
+            f"ttl {self.ttl:.0f}s, owner {stale_owner!r})"
+        )
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def refresh(self) -> None:
+        """Long-running holders bump the lease mtime to keep it."""
+        assert self.token is not None
+        os.utime(self.path, None)
+
+    def __enter__(self) -> "DistributedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.release()
+        return False
