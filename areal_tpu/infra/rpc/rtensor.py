@@ -7,11 +7,23 @@ shard stores (rpc_server /shard/*), and only lightweight handles — shard
 key, sequence lengths, owning address — travel through RPC. Consumers fetch
 shards directly from the owning worker, and a seqlen-balanced repartition
 maps producer shards onto consumer workers (reference balanced repartition
-via datapack)."""
+via datapack).
+
+Storage backends (reference has HTTP + a Ray object-store tier,
+rtensor.py:13,137): selected per shard by the ``node_addr`` scheme —
+- ``host:port``  — the worker's HTTP shard store (cross-host default);
+- ``mem://<ns>`` — a process-local object store. Colocated mode (trainer +
+  rollout controller in one process — the common single-host TPU topology)
+  gets zero-copy handles with the exact same RTensor API instead of
+  round-tripping tensors through localhost HTTP; this is the TPU analogue
+  of the reference's same-node Ray object-store fast path.
+Handles stay plain strings either way, so they serialize through RPC
+unchanged and a single RTensor may mix backends."""
 
 from __future__ import annotations
 
 import dataclasses
+import threading
 import uuid
 from typing import Any
 
@@ -26,6 +38,63 @@ logger = alog.getLogger("rtensor")
 
 
 _http_json = network.http_json
+
+
+class _MemObjectStore:
+    """Process-local shard store: ``mem://<namespace>`` addresses resolve
+    here. Values are stored by reference (zero-copy within the process)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: dict[tuple[str, str], TensorDict] = {}
+
+    def put(self, ns: str, key: str, batch: TensorDict) -> None:
+        with self._lock:
+            self._data[(ns, key)] = batch
+
+    def get(self, ns: str, key: str) -> TensorDict:
+        with self._lock:
+            try:
+                return self._data[(ns, key)]
+            except KeyError:
+                raise KeyError(f"mem://{ns} has no shard {key!r}")
+
+    def delete(self, ns: str, key: str) -> None:
+        with self._lock:
+            self._data.pop((ns, key), None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+
+
+MEM_STORE = _MemObjectStore()
+
+
+def _store_put(node_addr: str, key: str, batch: TensorDict) -> None:
+    if node_addr.startswith("mem://"):
+        MEM_STORE.put(node_addr[6:], key, dict(batch))
+        return
+    d = _http_json(
+        f"http://{node_addr}/shard/put",
+        {"key": key, "data": encode_value(dict(batch))},
+    )
+    assert d.get("status") == "ok", f"shard put failed on {node_addr}: {d}"
+
+
+def _store_get(node_addr: str, key: str) -> TensorDict:
+    if node_addr.startswith("mem://"):
+        return MEM_STORE.get(node_addr[6:], key)
+    d = _http_json(f"http://{node_addr}/shard/get?key={key}")
+    assert d["status"] == "ok", d
+    return decode_value(d["data"])
+
+
+def _store_delete(node_addr: str, key: str) -> None:
+    if node_addr.startswith("mem://"):
+        MEM_STORE.delete(node_addr[6:], key)
+        return
+    _http_json(f"http://{node_addr}/shard/delete", {"key": key})
 
 
 @dataclasses.dataclass
@@ -59,11 +128,7 @@ class RTensor:
         """Put one padded batch into ``node_addr``'s shard store."""
         key = key or f"rt-{uuid.uuid4().hex}"
         lens = [int(x) for x in seqlens_of(batch)]
-        d = _http_json(
-            f"http://{node_addr}/shard/put",
-            {"key": key, "data": encode_value(dict(batch))},
-        )
-        assert d.get("status") == "ok", f"shard put failed on {node_addr}: {d}"
+        _store_put(node_addr, key, batch)
         return cls(
             shards=[
                 TensorShardInfo(
@@ -74,9 +139,7 @@ class RTensor:
 
     @staticmethod
     def _fetch_shard(info: TensorShardInfo) -> TensorDict:
-        d = _http_json(f"http://{info.node_addr}/shard/get?key={info.key}")
-        assert d["status"] == "ok", d
-        return decode_value(d["data"])
+        return _store_get(info.node_addr, info.key)
 
     @property
     def is_empty(self) -> bool:
@@ -105,7 +168,7 @@ class RTensor:
         worker's store — /shard/clear would wipe them too)."""
         for s in self.shards:
             try:
-                _http_json(f"http://{s.node_addr}/shard/delete", {"key": s.key})
+                _store_delete(s.node_addr, s.key)
             except Exception:  # noqa: BLE001 — worker may be gone
                 logger.warning(f"shard delete failed on {s.node_addr}")
 

@@ -1,0 +1,361 @@
+"""In-process fake of the minimal ray API surface areal_tpu uses, so
+RayScheduler and RayLauncher actually EXECUTE in CI without ray installed
+(the slurm tier gets the same treatment via stub sbatch/squeue binaries).
+
+Semantics mirrored from real ray:
+- ``ray.remote(fn).options(**o).remote(*a)`` runs the function in a fresh
+  SUBPROCESS (real ray: a worker process) with ``runtime_env.env_vars``
+  applied — so entry bodies that set os.environ / bind ports / crash behave
+  exactly as they would on a cluster, and ``ray.cancel(force=True)`` is a
+  real SIGKILL.
+- ``ray.remote(cls)`` actors run in a dedicated THREAD with their own asyncio
+  loop (async actor methods work); ``ray.kill`` stops the loop.
+- ``ray.get`` raises GetTimeoutError on timeout and RayTaskError when the
+  task died, matching the exception types areal_tpu catches.
+
+Install with ``install()`` (registers sys.modules['ray'] + submodules);
+``uninstall()`` restores. Tests should use the ``fake_ray`` fixture.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import inspect
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import types
+
+_BOOTSTRAP = r"""
+import os, pickle, sys
+with open(sys.argv[1], "rb") as f:
+    payload = pickle.load(f)
+sys.path[:0] = [p for p in payload["sys_path"] if p not in sys.path]
+import importlib
+module = importlib.import_module(payload["module"])
+fn = module
+for part in payload["qualname"].split("."):
+    fn = getattr(fn, part)
+result = fn(*payload["args"], **payload["kwargs"])
+with open(sys.argv[2] + ".tmp", "wb") as f:
+    pickle.dump(result, f)
+os.replace(sys.argv[2] + ".tmp", sys.argv[2])
+"""
+
+
+class GetTimeoutError(TimeoutError):
+    pass
+
+
+class RayTaskError(RuntimeError):
+    pass
+
+
+class RayActorError(RuntimeError):
+    pass
+
+
+class ObjectRef:
+    """Either a subprocess task handle or a concurrent future."""
+
+    def __init__(self, proc=None, result_path=None, future=None, value=None):
+        self._proc = proc
+        self._result_path = result_path
+        self._future = future
+        self._value = value
+
+    def get(self, timeout=None):
+        if self._future is not None:
+            try:
+                return self._future.result(timeout=timeout)
+            except concurrent.futures.TimeoutError:
+                raise GetTimeoutError("fake-ray: future not ready")
+            except Exception as e:  # noqa: BLE001
+                raise RayTaskError(f"actor call failed: {e!r}") from e
+        if self._proc is not None:
+            try:
+                rc = self._proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                raise GetTimeoutError("fake-ray: task still running")
+            if rc != 0:
+                raise RayTaskError(f"task exited rc={rc}")
+            with open(self._result_path, "rb") as f:
+                return pickle.load(f)
+        return self._value
+
+    def cancel(self, force=False):
+        if self._proc is not None and self._proc.poll() is None:
+            sig = signal.SIGKILL if force else signal.SIGTERM
+            try:
+                os.killpg(os.getpgid(self._proc.pid), sig)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+
+class _RemoteFunction:
+    def __init__(self, fn, opts=None):
+        self._fn = fn
+        self._opts = dict(opts or {})
+
+    def options(self, **kw):
+        merged = dict(self._opts)
+        merged.update(kw)
+        return _RemoteFunction(self._fn, merged)
+
+    def remote(self, *args, **kwargs):
+        env_vars = (
+            self._opts.get("runtime_env", {}).get("env_vars", {})
+            if isinstance(self._opts.get("runtime_env"), dict)
+            else {}
+        )
+        payload = {
+            "module": self._fn.__module__,
+            "qualname": self._fn.__qualname__,
+            "args": args,
+            "kwargs": kwargs,
+            "sys_path": [p for p in sys.path if p],
+        }
+        fd, payload_path = tempfile.mkstemp(prefix="fake_ray_in_")
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(payload, f)
+        result_path = payload_path + ".out"
+        env = dict(os.environ)
+        env.update({k: str(v) for k, v in env_vars.items()})
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _BOOTSTRAP, payload_path, result_path],
+            env=env,
+            start_new_session=True,
+        )
+        _STATE.tasks.append(proc)
+        return ObjectRef(proc=proc, result_path=result_path)
+
+
+class _ActorMethod:
+    def __init__(self, actor, name):
+        self._actor = actor
+        self._name = name
+
+    def remote(self, *args, **kwargs):
+        return self._actor._call(self._name, args, kwargs)
+
+
+class _ActorHandle:
+    """Thread-hosted actor with its own asyncio loop."""
+
+    def __init__(self, cls, args, kwargs, opts):
+        self._cls = cls
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever,
+            daemon=True,
+            name=f"fake-ray-actor-{opts.get('name', cls.__name__)}",
+        )
+        self._thread.start()
+        # instantiate ON the actor thread (real ray constructs in-worker)
+        self._instance = asyncio.run_coroutine_threadsafe(
+            self._construct(args, kwargs), self._loop
+        ).result(timeout=60)
+        _STATE.actors.append(self)
+
+    async def _construct(self, args, kwargs):
+        return self._cls(*args, **kwargs)
+
+    def _call(self, name, args, kwargs):
+        method = getattr(self._instance, name)
+        if inspect.iscoroutinefunction(method):
+            fut = asyncio.run_coroutine_threadsafe(
+                method(*args, **kwargs), self._loop
+            )
+            return ObjectRef(future=fut)
+        fut = concurrent.futures.Future()
+
+        def run():
+            try:
+                fut.set_result(method(*args, **kwargs))
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        self._loop.call_soon_threadsafe(run)
+        return ObjectRef(future=fut)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ActorMethod(self, name)
+
+    def _kill(self):
+        if self._loop.is_running():
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+
+class _RemoteActorClass:
+    def __init__(self, cls, opts=None):
+        self._cls = cls
+        self._opts = dict(opts or {})
+
+    def options(self, **kw):
+        merged = dict(self._opts)
+        merged.update(kw)
+        return _RemoteActorClass(self._cls, merged)
+
+    def remote(self, *args, **kwargs):
+        return _ActorHandle(self._cls, args, kwargs, self._opts)
+
+
+class _PlacementGroup:
+    def __init__(self, bundles, strategy):
+        self.bundles = bundles
+        self.strategy = strategy
+
+    def ready(self):
+        return ObjectRef(value=None)
+
+
+class _State:
+    def __init__(self):
+        self.initialized = False
+        self.tasks: list[subprocess.Popen] = []
+        self.actors: list[_ActorHandle] = []
+
+
+_STATE = _State()
+
+
+# -- module-level ray API ---------------------------------------------------
+
+
+def init(**kwargs):
+    _STATE.initialized = True
+
+
+def is_initialized():
+    return _STATE.initialized
+
+
+def shutdown():
+    for proc in _STATE.tasks:
+        if proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+    for actor in _STATE.actors:
+        actor._kill()
+    _STATE.tasks.clear()
+    _STATE.actors.clear()
+    _STATE.initialized = False
+
+
+def remote(obj=None, **opts):
+    if obj is None:
+
+        def deco(o):
+            return remote(o, **opts)
+
+        return deco
+    if inspect.isclass(obj):
+        return _RemoteActorClass(obj, opts)
+    return _RemoteFunction(obj, opts)
+
+
+def get(ref, timeout=None):
+    if isinstance(ref, (list, tuple)):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for r in ref:
+            left = None if deadline is None else max(0.0, deadline - time.monotonic())
+            out.append(r.get(timeout=left))
+        return out
+    return ref.get(timeout=timeout)
+
+
+def cancel(ref, force=False, recursive=True):
+    ref.cancel(force=force)
+
+
+def kill(actor, no_restart=True):
+    actor._kill()
+
+
+def nodes():
+    return [{"NodeID": "fake-node-0", "Alive": True}]
+
+
+def _make_modules() -> dict[str, types.ModuleType]:
+    ray_mod = types.ModuleType("ray")
+    for name in (
+        "init",
+        "is_initialized",
+        "shutdown",
+        "remote",
+        "get",
+        "cancel",
+        "kill",
+        "nodes",
+        "ObjectRef",
+    ):
+        setattr(ray_mod, name, globals()[name])
+
+    exc_mod = types.ModuleType("ray.exceptions")
+    exc_mod.GetTimeoutError = GetTimeoutError
+    exc_mod.RayTaskError = RayTaskError
+    exc_mod.RayActorError = RayActorError
+
+    util_mod = types.ModuleType("ray.util")
+    util_mod.get_node_ip_address = lambda: "127.0.0.1"
+
+    def placement_group(bundles, strategy="PACK", **kw):
+        return _PlacementGroup(bundles, strategy)
+
+    util_mod.placement_group = placement_group
+
+    strat_mod = types.ModuleType("ray.util.scheduling_strategies")
+
+    class PlacementGroupSchedulingStrategy:
+        def __init__(
+            self,
+            placement_group=None,
+            placement_group_bundle_index=-1,
+            placement_group_capture_child_tasks=False,
+        ):
+            self.placement_group = placement_group
+            self.placement_group_bundle_index = placement_group_bundle_index
+
+    strat_mod.PlacementGroupSchedulingStrategy = PlacementGroupSchedulingStrategy
+    util_mod.scheduling_strategies = strat_mod
+
+    ray_mod.exceptions = exc_mod
+    ray_mod.util = util_mod
+    return {
+        "ray": ray_mod,
+        "ray.exceptions": exc_mod,
+        "ray.util": util_mod,
+        "ray.util.scheduling_strategies": strat_mod,
+    }
+
+
+_SAVED: dict[str, types.ModuleType | None] = {}
+
+
+def install() -> None:
+    mods = _make_modules()
+    for name, mod in mods.items():
+        _SAVED[name] = sys.modules.get(name)
+        sys.modules[name] = mod
+
+
+def uninstall() -> None:
+    shutdown()
+    for name, prev in _SAVED.items():
+        if prev is None:
+            sys.modules.pop(name, None)
+        else:
+            sys.modules[name] = prev
+    _SAVED.clear()
