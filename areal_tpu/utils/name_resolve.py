@@ -1,24 +1,34 @@
 """KV service discovery with TTL and watch (parity: reference
 areal/utils/name_resolve.py:182,282,410,1209).
 
-Backends: in-process memory (tests, single host) and filesystem tree (NFS —
-the multi-host path on TPU pods, where every host mounts shared storage).
-etcd is intentionally not implemented (no etcd3 client in the image); the
-filesystem backend covers the same contract.
+Backends:
+- in-process memory (tests, single host);
+- filesystem tree (NFS — multi-host TPU pods where every host mounts shared
+  storage);
+- etcd v3 over its JSON gRPC-gateway (clusters WITHOUT a shared filesystem).
+  The reference uses the ``etcd3`` python client (name_resolve.py:410-780);
+  this image ships no etcd client, so the backend speaks the gateway's
+  ``/v3/kv/*`` + ``/v3/lease/*`` HTTP endpoints with stdlib urllib — zero
+  new dependencies, works against any etcd >= 3.3.
 
 TTL semantics: an entry added with ``keepalive_ttl`` expires (reads treat it
 as missing) unless refreshed; ``KeepaliveThread`` re-adds it periodically,
 mirroring the reference's keepalive threads, so entries of crashed processes
-drop out of discovery.
+drop out of discovery. On etcd the TTL is a lease (1 s server-side
+granularity — etcd rejects sub-second leases, so TTLs round up).
 """
 
 from __future__ import annotations
 
+import base64
 import json
+import math
 import os
 import shutil
 import threading
 import time
+import urllib.error
+import urllib.request
 from abc import ABC, abstractmethod
 
 
@@ -233,6 +243,194 @@ class NfsNameResolveRepo(NameResolveRepo):
             shutil.rmtree(base, ignore_errors=True)
 
 
+class Etcd3NameResolveRepo(NameResolveRepo):
+    """etcd v3 backend via the JSON gRPC-gateway (no client library).
+
+    Key layout matches the other repos (path-like names). Prefix queries
+    issue two ranges — the exact key and ``name/``-prefixed descendants —
+    so ``get_subtree("exp/t")`` can never match a sibling ``exp/tx`` (the
+    memory/NFS repos have the same boundary semantics).
+
+    TTL entries attach to a fresh lease per add; a keepalive refresh grants
+    a new lease, re-puts, then revokes the old lease (2 RPCs at discovery
+    scale beats tracking gateway keepalive streams)."""
+
+    def __init__(
+        self,
+        addr: str | None = None,
+        user: str | None = None,
+        password: str | None = None,
+        timeout: float = 5.0,
+    ):
+        self._addr = addr or os.environ.get("AREAL_ETCD_ADDR", "127.0.0.1:2379")
+        self._timeout = timeout
+        self._lock = threading.RLock()
+        self._leases: dict[str, int] = {}  # name -> lease id we attached
+        self._auth_token: str | None = None
+        self._user = user or os.environ.get("AREAL_ETCD_USER")
+        self._password = password or os.environ.get("AREAL_ETCD_PASSWORD")
+        if self._user:
+            self._authenticate()
+
+    # -- wire helpers -----------------------------------------------------
+    def _authenticate(self) -> None:
+        resp = self._post(
+            "/v3/auth/authenticate",
+            {"name": self._user, "password": self._password or ""},
+            _raw=True,
+        )
+        self._auth_token = resp.get("token")
+
+    def _post(self, path: str, body: dict, _raw: bool = False) -> dict:
+        def do() -> dict:
+            req = urllib.request.Request(
+                f"http://{self._addr}{path}",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            if self._auth_token and not _raw:
+                req.add_header("Authorization", self._auth_token)
+            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+                return json.loads(r.read() or b"{}")
+
+        try:
+            return do()
+        except urllib.error.HTTPError as e:
+            # etcd simple-token auth expires (default 300 s); re-auth once
+            # and retry so long-lived keepalive threads don't silently drop
+            # their discovery entries on 401
+            if e.code == 401 and self._user and not _raw:
+                self._authenticate()
+                return do()
+            raise
+
+    @staticmethod
+    def _b64(s: str | bytes) -> str:
+        if isinstance(s, str):
+            s = s.encode()
+        return base64.b64encode(s).decode()
+
+    @staticmethod
+    def _unb64(s: str) -> str:
+        return base64.b64decode(s).decode()
+
+    @staticmethod
+    def _prefix_end(prefix: str) -> bytes:
+        """etcd range_end for a prefix scan: increment the last byte
+        (carrying over trailing 0xff, per the etcd client convention)."""
+        b = bytearray(prefix.encode())
+        while b and b[-1] == 0xFF:
+            b.pop()
+        if not b:
+            return b"\x00"  # scan everything
+        b[-1] += 1
+        return bytes(b)
+
+    def _range(self, key: str, prefix: bool = False) -> list[tuple[str, str]]:
+        body: dict = {"key": self._b64(key)}
+        if prefix:
+            body["range_end"] = self._b64(self._prefix_end(key))
+        resp = self._post("/v3/kv/range", body)
+        return [
+            (self._unb64(kv["key"]), self._unb64(kv.get("value", "")))
+            for kv in resp.get("kvs", [])
+        ]
+
+    def _grant(self, ttl: float) -> int:
+        resp = self._post("/v3/lease/grant", {"TTL": max(1, math.ceil(ttl))})
+        return int(resp["ID"])
+
+    def _revoke(self, lease_id: int) -> None:
+        try:
+            self._post("/v3/lease/revoke", {"ID": lease_id})
+        except (urllib.error.URLError, OSError, KeyError):
+            pass  # expired or already gone
+
+    # -- contract ---------------------------------------------------------
+    def add(self, name, value, replace=False, keepalive_ttl=None):
+        name = name.strip("/")
+        with self._lock:
+            body: dict = {"key": self._b64(name), "value": self._b64(str(value))}
+            old_lease = self._leases.pop(name, None)
+            if keepalive_ttl:
+                lease_id = self._grant(keepalive_ttl)
+                body["lease"] = lease_id
+                self._leases[name] = lease_id
+            if replace:
+                self._post("/v3/kv/put", body)
+            else:
+                # ATOMIC create-if-absent via a txn (create_revision == 0):
+                # a client-side check-then-put would race across hosts —
+                # the exact multi-host deployment this backend exists for
+                resp = self._post(
+                    "/v3/kv/txn",
+                    {
+                        "compare": [
+                            {
+                                "key": body["key"],
+                                "target": "CREATE",
+                                "result": "EQUAL",
+                                "create_revision": "0",
+                            }
+                        ],
+                        "success": [{"request_put": body}],
+                    },
+                )
+                if not resp.get("succeeded"):
+                    if keepalive_ttl:
+                        self._revoke(self._leases.pop(name))
+                    if old_lease is not None:
+                        self._leases[name] = old_lease
+                    raise NameEntryExistsError(name)
+            if old_lease is not None:
+                self._revoke(old_lease)
+
+    def get(self, name):
+        name = name.strip("/")
+        kvs = self._range(name)
+        if not kvs:
+            raise NameEntryNotFoundError(name)
+        return kvs[0][1]
+
+    def _walk(self, name_root) -> list[tuple[str, str]]:
+        root = name_root.strip("/")
+        entries = dict(self._range(root))
+        entries.update(self._range(root + "/", prefix=True))
+        return sorted(entries.items())
+
+    def find_subtree(self, name_root):
+        return [k for k, _ in self._walk(name_root)]
+
+    def get_subtree(self, name_root):
+        return [v for _, v in self._walk(name_root)]
+
+    def delete(self, name):
+        name = name.strip("/")
+        resp = self._post("/v3/kv/deleterange", {"key": self._b64(name)})
+        with self._lock:
+            lease = self._leases.pop(name, None)
+        if lease is not None:
+            self._revoke(lease)
+        if int(resp.get("deleted", 0)) == 0:
+            raise NameEntryNotFoundError(name)
+
+    def clear_subtree(self, name_root):
+        root = name_root.strip("/")
+        self._post("/v3/kv/deleterange", {"key": self._b64(root)})
+        self._post(
+            "/v3/kv/deleterange",
+            {
+                "key": self._b64(root + "/"),
+                "range_end": self._b64(self._prefix_end(root + "/")),
+            },
+        )
+        with self._lock:
+            for name in list(self._leases):
+                if name == root or name.startswith(root + "/"):
+                    self._leases.pop(name)
+
+
 def _repo_from_env() -> "NameResolveRepo":
     """Cross-process discovery needs a shared backend: launchers/schedulers
     export AREAL_NAME_RESOLVE(=file)+AREAL_NAME_RESOLVE_ROOT so every child
@@ -241,6 +439,8 @@ def _repo_from_env() -> "NameResolveRepo":
     if kind in ("nfs", "file"):
         root = os.environ.get("AREAL_NAME_RESOLVE_ROOT")
         return NfsNameResolveRepo(**({"root": root} if root else {}))
+    if kind in ("etcd", "etcd3"):
+        return Etcd3NameResolveRepo()
     return MemoryNameResolveRepo()
 
 
@@ -252,6 +452,8 @@ def make_repo(type_: str = "memory", **kwargs) -> NameResolveRepo:
         return MemoryNameResolveRepo()
     if type_ in ("nfs", "file"):
         return NfsNameResolveRepo(**kwargs)
+    if type_ in ("etcd", "etcd3"):
+        return Etcd3NameResolveRepo(**kwargs)
     raise ValueError(f"unknown name_resolve backend {type_!r}")
 
 

@@ -1,0 +1,139 @@
+"""In-process fake of the etcd v3 JSON gRPC-gateway endpoints that
+Etcd3NameResolveRepo speaks (/v3/kv/put, /v3/kv/range, /v3/kv/deleterange,
+/v3/lease/grant, /v3/lease/revoke). Lets the etcd backend EXECUTE in CI —
+the image has neither an etcd server nor a client library.
+
+Fidelity notes: keys/values are base64 like the real gateway; lease TTLs
+expire lazily on access (real etcd expires server-side — indistinguishable
+through this API); range honors ``range_end`` byte-interval semantics.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _Store:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.kv: dict[bytes, tuple[bytes, int | None]] = {}  # key -> (val, lease)
+        self.leases: dict[int, float] = {}  # id -> expires_at
+        self.next_lease = 7000
+
+    def _expire(self):
+        now = time.monotonic()
+        dead = {lid for lid, exp in self.leases.items() if exp <= now}
+        for lid in dead:
+            del self.leases[lid]
+        if dead:
+            self.kv = {
+                k: (v, lid)
+                for k, (v, lid) in self.kv.items()
+                if lid is None or lid not in dead
+            }
+
+    def handle(self, path: str, body: dict) -> dict:
+        with self.lock:
+            self._expire()
+            if path == "/v3/kv/put":
+                key = base64.b64decode(body["key"])
+                val = base64.b64decode(body.get("value", ""))
+                lease = int(body["lease"]) if body.get("lease") else None
+                if lease is not None and lease not in self.leases:
+                    return {"error": "etcdserver: requested lease not found"}
+                self.kv[key] = (val, lease)
+                return {}
+            if path == "/v3/kv/range":
+                key = base64.b64decode(body["key"])
+                if "range_end" in body:
+                    end = base64.b64decode(body["range_end"])
+                    keys = [k for k in self.kv if key <= k < end]
+                else:
+                    keys = [k for k in self.kv if k == key]
+                kvs = [
+                    {
+                        "key": base64.b64encode(k).decode(),
+                        "value": base64.b64encode(self.kv[k][0]).decode(),
+                    }
+                    for k in sorted(keys)
+                ]
+                return {"kvs": kvs, "count": str(len(kvs))}
+            if path == "/v3/kv/deleterange":
+                key = base64.b64decode(body["key"])
+                if "range_end" in body:
+                    end = base64.b64decode(body["range_end"])
+                    keys = [k for k in self.kv if key <= k < end]
+                else:
+                    keys = [k for k in self.kv if k == key]
+                for k in keys:
+                    del self.kv[k]
+                return {"deleted": str(len(keys))}
+            if path == "/v3/kv/txn":
+                # minimal txn support: the single compare shape the client
+                # uses (create_revision == 0 -> atomic create-if-absent)
+                cmp = body.get("compare", [])
+                ok = True
+                for c in cmp:
+                    key = base64.b64decode(c["key"])
+                    if (
+                        c.get("target") == "CREATE"
+                        and c.get("result") == "EQUAL"
+                        and str(c.get("create_revision", "0")) == "0"
+                    ):
+                        ok = ok and key not in self.kv
+                    else:
+                        return {"error": f"unsupported txn compare {c}"}
+                if ok:
+                    for op in body.get("success", []):
+                        put = op.get("request_put") or op.get("requestPut")
+                        if put is None:
+                            return {"error": f"unsupported txn op {op}"}
+                        sub = self.handle("/v3/kv/put", put)
+                        if "error" in sub:
+                            return sub
+                return {"succeeded": ok}
+            if path == "/v3/lease/grant":
+                ttl = float(body["TTL"])
+                lid = self.next_lease
+                self.next_lease += 1
+                self.leases[lid] = time.monotonic() + ttl
+                return {"ID": str(lid), "TTL": str(int(ttl))}
+            if path == "/v3/lease/revoke":
+                lid = int(body["ID"])
+                self.leases.pop(lid, None)
+                self.kv = {
+                    k: (v, l) for k, (v, l) in self.kv.items() if l != lid
+                }
+                return {}
+            return {"error": f"unhandled path {path}"}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: _Store
+
+    def do_POST(self):  # noqa: N802 - stdlib naming
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n) or b"{}")
+        resp = self.store.handle(self.path, body)
+        data = json.dumps(resp).encode()
+        self.send_response(500 if "error" in resp else 200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *args):  # silence per-request stderr noise
+        pass
+
+
+def start_fake_etcd() -> tuple[ThreadingHTTPServer, str]:
+    """Returns (server, "host:port"). Call server.shutdown() when done."""
+    store = _Store()
+    handler = type("BoundHandler", (_Handler,), {"store": store})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"127.0.0.1:{server.server_address[1]}"

@@ -1,6 +1,7 @@
 import pytest
 
 from areal_tpu.utils.name_resolve import (
+    Etcd3NameResolveRepo,
     MemoryNameResolveRepo,
     NameEntryExistsError,
     NameEntryNotFoundError,
@@ -8,11 +9,29 @@ from areal_tpu.utils.name_resolve import (
 )
 
 
-@pytest.fixture(params=["memory", "nfs"])
+@pytest.fixture(params=["memory", "nfs", "etcd"])
 def repo(request, tmp_path):
     if request.param == "memory":
-        return MemoryNameResolveRepo()
-    return NfsNameResolveRepo(root=str(tmp_path / "nr"))
+        yield MemoryNameResolveRepo()
+    elif request.param == "nfs":
+        yield NfsNameResolveRepo(root=str(tmp_path / "nr"))
+    else:
+        # the etcd backend runs against an in-process fake of the etcd v3
+        # JSON gateway (tests/fake_etcd.py) — same contract tests as the
+        # other repos, no etcd server in the image required
+        from fake_etcd import start_fake_etcd
+
+        server, addr = start_fake_etcd()
+        try:
+            yield Etcd3NameResolveRepo(addr=addr)
+        finally:
+            server.shutdown()
+
+
+def _ttl(repo, t: float) -> float:
+    """etcd leases have 1 s server-side granularity; scale sub-second test
+    TTLs up for that backend only."""
+    return max(t, 1.0) if isinstance(repo, Etcd3NameResolveRepo) else t
 
 
 def test_add_get_delete(repo):
@@ -30,9 +49,13 @@ def test_add_get_delete(repo):
 def test_subtree(repo):
     repo.add("exp/t/rollout_servers/0", "addr0")
     repo.add("exp/t/rollout_servers/1", "addr1")
+    # a sibling sharing the string prefix must not leak into the subtree
+    # (etcd prefix ranges are byte intervals; the repo adds the "/" bound)
+    repo.add("exp/tx/rollout_servers/0", "sibling")
     assert repo.get_subtree("exp/t/rollout_servers") == ["addr0", "addr1"]
     repo.clear_subtree("exp/t")
     assert repo.get_subtree("exp/t/rollout_servers") == []
+    assert repo.get("exp/tx/rollout_servers/0") == "sibling"
 
 
 def test_wait_timeout(repo):
@@ -41,11 +64,12 @@ def test_wait_timeout(repo):
 
 
 def test_ttl_expiry(repo):
-    repo.add("svc/0", "addr", keepalive_ttl=0.2)
+    ttl = _ttl(repo, 0.2)
+    repo.add("svc/0", "addr", keepalive_ttl=ttl)
     assert repo.get("svc/0") == "addr"
     import time
 
-    time.sleep(0.35)
+    time.sleep(ttl * 1.75)
     with pytest.raises(NameEntryNotFoundError):
         repo.get("svc/0")
     assert repo.find_subtree("svc") == []
@@ -54,8 +78,9 @@ def test_ttl_expiry(repo):
 def test_keepalive_refreshes(repo):
     import time
 
-    ka = repo.keepalive("svc/1", "addr", ttl=0.3)
-    time.sleep(0.8)
+    ttl = _ttl(repo, 0.3)
+    ka = repo.keepalive("svc/1", "addr", ttl=ttl)
+    time.sleep(ttl * 2.7)
     assert repo.get("svc/1") == "addr"  # still alive thanks to refresh
     ka.stop()
     with pytest.raises(NameEntryNotFoundError):
