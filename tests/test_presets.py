@@ -1,0 +1,173 @@
+"""Algorithm preset library (VERDICT r03 missing #7): every yaml under
+examples/math/ must (a) load through the real config loader, (b) wire the
+algorithm switches the preset's name promises (reference presets at
+examples/math/*.yaml — DAPO/Dr.GRPO/LitePPO/RLOO/GSPO/SAPO/M2PO/lora), and
+(c) drive one full PPO step (compute_advantages + ppo_update) through the
+loss path it selects."""
+
+import dataclasses
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import GRPOConfig, MeshConfig, load_expr_config
+from areal_tpu.api.io_struct import FinetuneSpec
+from areal_tpu.engine.train_engine import JaxTrainEngine
+from areal_tpu.trainer.ppo import PPOActor
+
+from tpu_testing import TINY_QWEN2
+
+PRESET_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "examples",
+    "math",
+)
+PRESETS = sorted(
+    os.path.basename(p) for p in glob.glob(os.path.join(PRESET_DIR, "*.yaml"))
+)
+
+
+def _load(name: str) -> GRPOConfig:
+    cfg, _ = load_expr_config(
+        ["--config", os.path.join(PRESET_DIR, name)], GRPOConfig
+    )
+    return cfg
+
+
+# preset file -> assertions on the loaded config proving the algorithm the
+# file claims is actually the one wired up
+WIRING = {
+    "gsm8k_grpo.yaml": lambda c: (
+        c.actor.use_decoupled_loss
+        and c.actor.group_reward_norm
+        and c.actor.adv_norm.mean_level == "batch"
+    ),
+    "gsm8k_dapo.yaml": lambda c: (
+        c.actor.eps_clip_higher == 0.28
+        and c.actor.overlong_reward_penalty
+        and c.rollout.dynamic_bs_max_tokens == 65536
+    ),
+    "gsm8k_drgrpo.yaml": lambda c: (
+        c.actor.adv_norm.mean_level == "group"
+        and c.actor.adv_norm.std_level == "none"
+    ),
+    "gsm8k_gspo.yaml": lambda c: c.actor.imp_ratio_level == "sequence",
+    "gsm8k_liteppo.yaml": lambda c: (
+        c.actor.adv_norm.mean_level == "group"
+        and c.actor.adv_norm.std_level == "batch"
+    ),
+    "gsm8k_m2po.yaml": lambda c: (
+        c.actor.use_m2po_loss
+        and c.actor.m2po_tau == 0.04
+        and c.actor.eps_clip == 0.0
+    ),
+    "gsm8k_rloo.yaml": lambda c: (
+        c.actor.adv_norm.mean_level == "group"
+        and c.actor.adv_norm.mean_leave1out
+        and c.actor.adv_norm.std_level == "none"
+    ),
+    "gsm8k_sapo.yaml": lambda c: (
+        c.actor.use_sapo_loss
+        and c.actor.sapo_tau_neg == 1.05
+        and not c.actor.use_decoupled_loss
+    ),
+    "gsm8k_reinforce.yaml": lambda c: (
+        not c.actor.group_reward_norm and not c.actor.use_sapo_loss
+    ),
+    "gsm8k_reinforce_baseline.yaml": lambda c: (
+        c.actor.adv_norm.mean_level == "group"
+        and c.actor.adv_norm.std_level == "none"
+    ),
+    "gsm8k_ppo.yaml": lambda c: c.critic is not None,
+    "gsm8k_sync_ppo.yaml": lambda c: (
+        c.rollout.max_head_offpolicyness == 0
+        and not c.actor.use_decoupled_loss
+    ),
+    "gsm8k_grpo_lora.yaml": lambda c: (
+        c.actor.lora_rank == 32 and c.actor.lora_alpha == 16.0
+    ),
+}
+
+
+def test_preset_library_is_complete():
+    """The zoo must cover at least the 8 reference algorithm families."""
+    assert set(WIRING) <= set(PRESETS), set(WIRING) - set(PRESETS)
+    assert len(PRESETS) >= 8
+
+
+@pytest.mark.parametrize("name", PRESETS)
+def test_preset_loads_and_wires(name):
+    cfg = _load(name)
+    assert cfg.experiment_name
+    check = WIRING.get(name)
+    assert check is not None, f"add a WIRING assertion for new preset {name}"
+    assert check(cfg), f"{name} did not wire its algorithm switches"
+
+
+# -- one PPO step through each preset's loss path ---------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    from areal_tpu.api.config import OptimizerConfig
+
+    cfg = dataclasses.replace(
+        _load("gsm8k_grpo.yaml").actor,
+        path="",
+        init_from_scratch=True,
+        dtype="float32",
+        param_dtype="float32",
+        gradient_checkpointing=False,
+        lora_rank=0,
+        bucket_step=64,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        optimizer=OptimizerConfig(lr=5e-3, lr_scheduler_type="constant"),
+    )
+    eng = JaxTrainEngine(cfg, model_config=TINY_QWEN2)
+    eng.initialize(FinetuneSpec(1, 64, 4))
+    yield eng
+    eng.destroy()
+
+
+def _rl_batch(n=4, seed=0, L=24):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, 250, (n, L)).astype(np.int32)
+    lm = np.zeros((n, L), np.float32)
+    lm[:, 4:] = 1.0
+    return {
+        "input_ids": ids,
+        "attention_mask": np.ones((n, L), bool),
+        "loss_mask": lm,
+        "logprobs": rng.normal(-1.5, 0.2, (n, L)).astype(np.float32),
+        "versions": np.zeros((n, L), np.int32),
+        "rewards": rng.normal(0.5, 1.0, (n,)).astype(np.float32),
+        "seq_no_eos_mask": np.zeros((n,), bool),
+    }
+
+
+@pytest.mark.parametrize("name", sorted(WIRING))
+def test_preset_one_ppo_step(name, tiny_engine):
+    """The preset's ACTOR config (algorithm switches untouched, only model/
+    runtime fields tinyified) must drive advantages + one ppo_update to a
+    finite loss — proving the yaml reaches the loss zoo end-to-end."""
+    cfg = dataclasses.replace(
+        _load(name).actor,
+        path="",
+        init_from_scratch=True,
+        dtype="float32",
+        param_dtype="float32",
+        gradient_checkpointing=False,
+        lora_rank=0,  # adapter shape is engine-level; covered by test_lora
+        bucket_step=64,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        group_size=2,
+    )
+    actor = PPOActor(cfg, tiny_engine)
+    batch = _rl_batch(seed=hash(name) % 1000)
+    if actor.should_compute_prox_logp():
+        batch["prox_logp"] = actor.compute_logp(batch)
+    adv = actor.compute_advantages(batch)
+    stats = actor.ppo_update(adv)
+    assert np.isfinite(stats[0]["loss"]), name
