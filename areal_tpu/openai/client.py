@@ -18,8 +18,9 @@ Reward flow (reference client.py:1088-1129): the agent (or workflow) calls
 
 from __future__ import annotations
 
+import asyncio
 import uuid
-from typing import Any
+from typing import Any, AsyncIterator
 
 from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
 from areal_tpu.openai.cache import InteractionCache
@@ -27,7 +28,10 @@ from areal_tpu.openai.tool_call_parser import process_tool_calls
 from areal_tpu.openai.types import (
     ChatCompletion,
     ChatCompletionChoice,
+    ChatCompletionChunk,
+    ChatCompletionChunkChoice,
     ChatMessage,
+    ChoiceDelta,
     Interaction,
     Usage,
 )
@@ -100,6 +104,66 @@ def _truncate_at_stop_strings(resp, tokenizer, stop_list: list[str]):
     return resp, True
 
 
+_STREAM_PIECE_CHARS = 48
+
+
+async def _stream_chunks(
+    completion: ChatCompletion, model: str
+) -> AsyncIterator[ChatCompletionChunk]:
+    """Yield a completed ChatCompletion as OpenAI streaming chunks: per
+    choice a role delta, content pieces, optional tool-call delta, finish
+    marker; then one usage chunk. The decode engine generates in device
+    chunks of ~32 steps, so token-level wire streaming buys RL agents
+    nothing — like the reference (client.py:588-600 simulates streaming
+    over its engines) the stream is synthesized after generation."""
+    for choice in completion.choices:
+        i = choice.index
+        yield ChatCompletionChunk(
+            id=completion.id,
+            model=model,
+            choices=[
+                ChatCompletionChunkChoice(index=i, delta=ChoiceDelta(role="assistant"))
+            ],
+        )
+        text = choice.message.content or ""
+        for k in range(0, len(text), _STREAM_PIECE_CHARS):
+            yield ChatCompletionChunk(
+                id=completion.id,
+                model=model,
+                choices=[
+                    ChatCompletionChunkChoice(
+                        index=i,
+                        delta=ChoiceDelta(content=text[k : k + _STREAM_PIECE_CHARS]),
+                    )
+                ],
+            )
+        if choice.message.tool_calls:
+            yield ChatCompletionChunk(
+                id=completion.id,
+                model=model,
+                choices=[
+                    ChatCompletionChunkChoice(
+                        index=i,
+                        delta=ChoiceDelta(tool_calls=choice.message.tool_calls),
+                    )
+                ],
+            )
+        yield ChatCompletionChunk(
+            id=completion.id,
+            model=model,
+            choices=[
+                ChatCompletionChunkChoice(
+                    index=i,
+                    delta=ChoiceDelta(),
+                    finish_reason=choice.finish_reason,
+                )
+            ],
+        )
+    yield ChatCompletionChunk(
+        id=completion.id, model=model, choices=[], usage=completion.usage
+    )
+
+
 class AsyncChatCompletions:
     def __init__(self, owner: "ArealOpenAI"):
         self._o = owner
@@ -123,12 +187,11 @@ class AsyncChatCompletions:
         stream: bool = False,
         extra_body: dict | None = None,
         **unsupported: Any,
-    ) -> ChatCompletion:
+    ) -> ChatCompletion | AsyncIterator[ChatCompletionChunk]:
         o = self._o
-        if stream:
-            raise NotImplementedError("streaming responses are not supported yet")
-        if n not in (None, 1):
-            raise NotImplementedError("n != 1 is not supported")
+        n_samples = 1 if n is None else int(n)
+        if n_samples < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
         for k in unsupported:
             _warn_once(k)
         if max_tokens is not None and max_completion_tokens is not None:
@@ -142,20 +205,39 @@ class AsyncChatCompletions:
 
         from areal_tpu.openai.types import _new_id
 
-        interaction = Interaction(
-            messages=[dict(m) for m in messages],
-            chat_template_type=o.chat_template_type,
-        )
+        # n>1 (the reference raises NotImplementedError here): each sample
+        # is its own Interaction so the conversation tree follows WHICHEVER
+        # choice the agent continues. Choice 0 keeps the completion id
+        # (set_reward(completion_id) targets it); choice i>0 is addressable
+        # as f"{completion_id}/{i}".
         completion_id = _new_id("chatcmpl")
+        ids = [completion_id] + [
+            f"{completion_id}/{i}" for i in range(1, n_samples)
+        ]
+        interactions = [
+            Interaction(
+                messages=[dict(m) for m in messages],
+                chat_template_type=o.chat_template_type,
+            )
+            for _ in range(n_samples)
+        ]
+
+        def _evict() -> None:
+            for id_ in ids:
+                o._cache.pop(id_, None)
+
         # parent resolution needs the cache's prefix logic; stage the
-        # interaction first so __setitem__ links it — and evict it on ANY
+        # interactions first so __setitem__ links them — and evict on ANY
         # failure before the completion lands (tokenizer errors included),
-        # or retries strand half-built entries in the cache
+        # or retries strand half-built entries in the cache. In-flight
+        # entries are never chosen as parents, so siblings cannot
+        # accidentally parent each other.
         if store:
-            o._cache[completion_id] = interaction
+            for id_, inter in zip(ids, interactions):
+                o._cache[id_] = inter
         try:
             if o.chat_template_type == "concat":
-                parent = interaction.parent
+                parent = interactions[0].parent
                 parent_len = (
                     len(parent.messages + (parent.output_messages or []))
                     if parent is not None
@@ -176,7 +258,7 @@ class AsyncChatCompletions:
                 )
         except BaseException:
             if store:
-                o._cache.pop(completion_id, None)
+                _evict()
             raise
 
         # token budget resolution (reference client.py:420-480)
@@ -190,7 +272,7 @@ class AsyncChatCompletions:
             max_new = total - len(prompt_ids)
             if max_new <= 0:
                 if store:
-                    o._cache.pop(completion_id, None)
+                    _evict()
                 raise ValueError(
                     f"prompt length {len(prompt_ids)} exceeds the total token "
                     f"budget {total}"
@@ -230,60 +312,84 @@ class AsyncChatCompletions:
             stop_token_ids=stop_ids,
             frequency_penalty=frequency_penalty or 0.0,
         )
-        req = ModelRequest(
-            input_ids=prompt_ids,
-            gconfig=gconfig,
-            rid=uuid.uuid4().hex,
-            metadata=dict(metadata or {}),
-        )
-        try:
-            resp = await o.engine.agenerate(req)
-        except BaseException:
-            # never strand a half-built interaction in the cache (it would
-            # pollute parent resolution and spam "incomplete" export warnings)
-            if store:
-                o._cache.pop(completion_id, None)
-            raise
-        resp, stop_hit = _truncate_at_stop_strings(resp, o.tokenizer, stop_list)
-
-        out_ids = list(resp.output_tokens)
-        if out_ids and out_ids[-1] in stop_ids:
-            out_ids = out_ids[:-1]  # decode without the stop token
-        output_text = o.tokenizer.decode(out_ids)
-        if stop_hit:
-            # text ends before the stop string itself (OpenAI semantics)
-            cut = resp.metadata.get("stop_text_index")
-            if cut is not None:
-                output_text = output_text[:cut]
-        tool_calls = None
-        finish_reason = resp.stop_reason
-        if tools and tool_choice != "none":
-            tool_calls, output_text, finish_reason = process_tool_calls(
-                output_text,
-                tools,
-                o.tool_call_parser,
-                o.reasoning_parser,
-                finish_reason,
+        reqs = [
+            ModelRequest(
+                input_ids=list(prompt_ids),
+                gconfig=gconfig,
+                rid=uuid.uuid4().hex,
+                metadata=dict(metadata or {}),
             )
-        message = ChatMessage(
-            role="assistant", content=output_text, tool_calls=tool_calls
-        )
+            for _ in range(n_samples)
+        ]
+        tasks = [asyncio.ensure_future(o.engine.agenerate(r)) for r in reqs]
+        try:
+            resps = list(await asyncio.gather(*tasks))
+        except BaseException:
+            # never strand half-built interactions in the cache (they would
+            # pollute parent resolution and spam "incomplete" export
+            # warnings) — and never leave sibling generations running
+            # orphaned, burning decode capacity with no consumer
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            if store:
+                _evict()
+            raise
+
+        choices = []
+        total_completion_tokens = 0
+        for i, resp in enumerate(resps):
+            resp, stop_hit = _truncate_at_stop_strings(resp, o.tokenizer, stop_list)
+            out_ids = list(resp.output_tokens)
+            if out_ids and out_ids[-1] in stop_ids:
+                out_ids = out_ids[:-1]  # decode without the stop token
+            output_text = o.tokenizer.decode(out_ids)
+            if stop_hit:
+                # text ends before the stop string itself (OpenAI semantics)
+                cut = resp.metadata.get("stop_text_index")
+                if cut is not None:
+                    output_text = output_text[:cut]
+            tool_calls = None
+            finish_reason = resp.stop_reason
+            if tools and tool_choice != "none":
+                tool_calls, output_text, finish_reason = process_tool_calls(
+                    output_text,
+                    tools,
+                    o.tool_call_parser,
+                    o.reasoning_parser,
+                    finish_reason,
+                )
+            message = ChatMessage(
+                role="assistant", content=output_text, tool_calls=tool_calls
+            )
+            choices.append(
+                ChatCompletionChoice(
+                    index=i, message=message, finish_reason=finish_reason
+                )
+            )
+            total_completion_tokens += resp.output_len
+            resps[i] = resp  # keep the truncated record for training export
+
         completion = ChatCompletion(
             id=completion_id,
             model=o.model_name,
-            choices=[
-                ChatCompletionChoice(
-                    index=0, message=message, finish_reason=finish_reason
-                )
-            ],
+            choices=choices,
             usage=Usage(
-                prompt_tokens=resp.input_len, completion_tokens=resp.output_len
+                prompt_tokens=resps[0].input_len,
+                completion_tokens=total_completion_tokens,
             ),
         )
         if store:
-            interaction.completion = completion
-            interaction.model_response = resp
-            interaction.output_messages = [message.to_dict()]
+            for inter, resp, choice in zip(interactions, resps, choices):
+                inter.completion = completion
+                inter.model_response = resp
+                inter.output_messages = [choice.message.to_dict()]
+        if stream:
+            # cache is updated BEFORE the generator is handed out, so the
+            # interaction is recorded even if the consumer never iterates
+            # (reference client.py:543-551 notes LiteLLM adapters emit
+            # pre-chunks before pulling the underlying stream)
+            return _stream_chunks(completion, o.model_name)
         return completion
 
 

@@ -135,6 +135,19 @@ def create_gateway_app(state: GatewayState) -> web.Application:
                 ),
             },
         ) as r:
+            ct = r.headers.get("Content-Type", "")
+            if ct.startswith("text/event-stream"):
+                # SSE passthrough: relay chunks as they arrive so streaming
+                # agents see deltas live instead of one buffered blob
+                out = web.StreamResponse(
+                    status=r.status,
+                    headers={"Content-Type": ct, "Cache-Control": "no-cache"},
+                )
+                await out.prepare(request)
+                async for chunk in r.content.iter_any():
+                    await out.write(chunk)
+                await out.write_eof()
+                return out
             text = await r.text()
             # route + load bookkeeping: release on end_session, and also
             # when the proxy reports the session gone (agent crashed and the

@@ -24,6 +24,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import dataclasses
+import json
 import secrets
 import time
 from typing import Any
@@ -195,10 +196,29 @@ def create_proxy_app(state: ProxyState) -> web.Application:
         body = await request.json()
         body.pop("model", None)
         try:
-            completion = await sess.client.chat.completions.create(**body)
+            result = await sess.client.chat.completions.create(**body)
         except (ValueError, NotImplementedError) as e:
             raise web.HTTPBadRequest(text=str(e))
-        return web.json_response(completion.to_dict())
+        if body.get("stream"):
+            # OpenAI SSE wire format: one `data: {chunk json}` event per
+            # chunk, then `data: [DONE]` — what openai-SDK streaming
+            # clients parse from /v1/chat/completions
+            resp = web.StreamResponse(
+                headers={
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                    "Connection": "keep-alive",
+                }
+            )
+            await resp.prepare(request)
+            async for chunk in result:
+                await resp.write(
+                    b"data: " + json.dumps(chunk.to_dict()).encode() + b"\n\n"
+                )
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+        return web.json_response(result.to_dict())
 
     async def set_reward(request: web.Request):
         sess = require_session(request)

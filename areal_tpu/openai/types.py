@@ -107,6 +107,68 @@ class ChatCompletion:
 
 
 @dataclasses.dataclass
+class ChoiceDelta:
+    """Incremental piece of a streamed assistant message."""
+
+    role: str | None = None
+    content: str | None = None
+    tool_calls: list[ToolCall] | None = None
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {}
+        if self.role is not None:
+            d["role"] = self.role
+        if self.content is not None:
+            d["content"] = self.content
+        if self.tool_calls:
+            d["tool_calls"] = [
+                {**t.to_dict(), "index": i} for i, t in enumerate(self.tool_calls)
+            ]
+        return d
+
+
+@dataclasses.dataclass
+class ChatCompletionChunkChoice:
+    index: int
+    delta: ChoiceDelta
+    finish_reason: str | None = None
+
+    def to_dict(self) -> dict:
+        return {
+            "index": self.index,
+            "delta": self.delta.to_dict(),
+            "finish_reason": self.finish_reason,
+            "logprobs": None,
+        }
+
+
+@dataclasses.dataclass
+class ChatCompletionChunk:
+    """One `/v1/chat/completions` SSE event (``object:
+    "chat.completion.chunk"``) — what OpenAI-SDK streaming agents iterate."""
+
+    id: str = ""
+    created: int = dataclasses.field(default_factory=lambda: int(time.time()))
+    model: str = "areal-tpu"
+    choices: list[ChatCompletionChunkChoice] = dataclasses.field(
+        default_factory=list
+    )
+    usage: Usage | None = None
+
+    def to_dict(self) -> dict:
+        d = {
+            "id": self.id,
+            "object": "chat.completion.chunk",
+            "created": self.created,
+            "model": self.model,
+            "choices": [c.to_dict() for c in self.choices],
+        }
+        if self.usage is not None:
+            d["usage"] = self.usage.to_dict()
+        return d
+
+
+@dataclasses.dataclass
 class Interaction:
     """One completion with its trainable record (reference
     types.py InteractionWithTokenLogpReward).

