@@ -184,6 +184,11 @@ class InferenceEngineConfig:
     # streamed weight-update bucket size (reference weight_chunked_mem_mb):
     # larger buckets amortise HTTP overhead, smaller ones overlap better
     weight_chunk_mb: int = 128
+    # mem-mode fan-out topology: False = trainer POSTs every bucket to every
+    # server (fine for small fleets); True = upload once to a tree root and
+    # let servers relay down a fanout-2 tree (X-Areal-Relay), so the trainer
+    # uplink carries 1x the model regardless of fleet size
+    weight_update_relay: bool = False
 
 
 @dataclass
@@ -221,6 +226,10 @@ class ServerConfig:
     # buckets, decode-chunk windows, slot-scatter sizes) at startup so no
     # compile stall lands mid-serving (SGLang's warmup-at-launch role)
     precompile: bool = False
+    # sampling RNG seed. None (default) seeds from the clock — distinct
+    # streams per server replica; set an int for reproducible serving
+    # (tests, debugging — reference sglang random_seed role)
+    seed: int | None = None
 
 
 @dataclass

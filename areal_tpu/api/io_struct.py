@@ -102,6 +102,12 @@ class WeightUpdateMeta:
     with_version: bool = True
     alloc_mode: Any | None = None
     chunked_mem_mb: int = 128
+    # mem-mode LoRA fast path: stream only the adapter leaves and let the
+    # servers fold W += scale·(aN@bN − aOld@bOld) on device — ~25 MB instead
+    # of the ~3 GB (1.5B) merged tree per update. The engine fills
+    # ``lora_scale`` (= alpha/rank) when it builds the update.
+    lora_only: bool = False
+    lora_scale: float = 0.0
 
     @classmethod
     def new_disk_update(cls, path: str) -> "WeightUpdateMeta":

@@ -268,15 +268,16 @@ class JaxTrainEngine(TrainEngine):
 
     def _ensure_vision_tower(self, seed: int = 0) -> None:
         """VLM: guarantee a ``vision`` subtree exists after any param-tree
-        replacement. HF checkpoint name mapping for the tower is not
-        implemented yet, so missing towers initialize from scratch
-        (documented limitation, models/vision.py)."""
+        replacement. HF checkpoints with a ``visual.*`` tower load it via
+        models/hf.py:_load_vision_params; this path only fires for
+        checkpoints WITHOUT tower weights (e.g. text-only exports run as a
+        VLM), which initialize from scratch."""
         mcfg = self.model_cfg
         if mcfg.vision is None or "vision" in self.params:
             return
         logger.warning(
-            "VLM: vision tower weights initialize from scratch "
-            "(HF tower import pending)"
+            "VLM: checkpoint has no visual.* weights; vision tower "
+            "initializes from scratch"
         )
         from areal_tpu.models.vision import init_vision_params, vision_partition_specs
 
@@ -391,10 +392,13 @@ class JaxTrainEngine(TrainEngine):
         from areal_tpu.models import vision as vis
 
         input_ = dict(input_)
-        pv = np.asarray(input_.pop("pixel_values"), np.float32)  # [B, P, pd]
+        pv_obj = input_.pop("pixel_values")
+        counts_obj = input_.pop("pixel_counts", None)
+        ids_obj = input_["input_ids"]
+        pv = np.asarray(pv_obj, np.float32)  # [B, P, pd]
         B, P_raw, pd = pv.shape
         counts = np.asarray(
-            input_.pop("pixel_counts", np.full(B, P_raw)), np.int32
+            np.full(B, P_raw) if counts_obj is None else counts_obj, np.int32
         )
         if "pixel_pos_ids" not in input_:
             logger.warning(
@@ -408,11 +412,17 @@ class JaxTrainEngine(TrainEngine):
         ids = np.asarray(input_["input_ids"])
         # one PPO step calls forward_batch (logprob recompute) and
         # train_batch on the SAME batch; memoize the tower output so the
-        # frozen ViT truly runs once per batch
+        # frozen ViT truly runs once per batch. Keyed by the IDENTITY of the
+        # caller's batch arrays, not content — hashing the full pixel buffer
+        # cost O(batch bytes) of host time on every forward/train call. The
+        # memo pins the keyed objects so their ids can't be recycled while
+        # the entry is alive; callers that mutate a pixel buffer in place
+        # must pass a fresh array (the trainer never does).
         memo_key = (
-            hash(pv.tobytes()),
-            hash(counts.tobytes()),
-            hash(ids.tobytes()),
+            id(pv_obj),
+            None if counts_obj is None else id(counts_obj),
+            id(ids_obj),
+            pv.shape,
             self.get_version(),
         )
         cached = getattr(self, "_image_embed_memo", None)
@@ -449,22 +459,26 @@ class JaxTrainEngine(TrainEngine):
                 np.float32,
             )  # [B, Ppad/merge2, D]
         embeds = np.zeros((B, ids.shape[1], mcfg.hidden_size), np.float32)
-        for b in range(B):
-            pos = np.where(ids[b] == mcfg.image_token_id)[0]
-            n_emb = int(counts[b]) // merge2
-            if len(pos) != n_emb:
-                # silent truncation here means training on corrupted inputs
-                # (wrong spatial_merge, processor/tokenizer skew, truncated
-                # image-pad runs) — make the misconfiguration loud
-                logger.warning(
-                    f"VLM mismatch row {b}: {len(pos)} image-pad tokens vs "
-                    f"{n_emb} merged patch embeddings; extra positions keep "
-                    "the pad-token text embedding"
-                )
-            n = min(len(pos), n_emb)
-            embeds[b, pos[:n]] = out[b, :n]
+        # vectorized scatter: for each row, the k-th image-pad token gets the
+        # k-th merged patch embedding (k < counts[b]//merge2)
+        pad_mask = ids == mcfg.image_token_id  # [B, L]
+        n_emb = counts // merge2  # [B]
+        n_pos = pad_mask.sum(axis=1)
+        for b in np.nonzero(n_pos != n_emb)[0]:
+            # silent truncation here means training on corrupted inputs
+            # (wrong spatial_merge, processor/tokenizer skew, truncated
+            # image-pad runs) — make the misconfiguration loud
+            logger.warning(
+                f"VLM mismatch row {b}: {int(n_pos[b])} image-pad tokens vs "
+                f"{int(n_emb[b])} merged patch embeddings; extra positions "
+                "keep the pad-token text embedding"
+            )
+        k = np.cumsum(pad_mask, axis=1) - 1  # ordinal of each pad token
+        take = pad_mask & (k < n_emb[:, None])
+        rows, cols = np.nonzero(take)
+        embeds[rows, cols] = out[rows, k[rows, cols]]
         input_["image_embeds"] = embeds
-        self._image_embed_memo = (memo_key, embeds)
+        self._image_embed_memo = (memo_key, embeds, (pv_obj, counts_obj, ids_obj))
         return input_
 
     def _make_grids(
@@ -766,15 +780,21 @@ class JaxTrainEngine(TrainEngine):
                 fn = self._get_forward_fn(shape, post_hook)
                 outputs = fn(self.params, batch)
                 vals = np.asarray(jax.device_get(outputs[output_key]), np.float32)
-                per_seq = g.scatter_per_token(output_key, vals)
-                for local, src in enumerate(g.seq_index):
-                    n = g.seq_lens[local]
-                    if output_key == "values":
-                        out[src, :n] = per_seq[local]
-                    else:
-                        # label-aligned -> token-aligned: token t's logp was
-                        # computed at position t-1
-                        out[src, 1:n] = per_seq[local][: n - 1]
+                # vectorized grid->batch scatter (one fancy-indexed copy
+                # instead of a per-sequence Python loop). For logprobs the
+                # label-aligned output shifts right one: token t's logp was
+                # computed at position t-1, so out[src, 1:n] = row[:n-1].
+                lens = np.asarray(g.seq_lens, np.int64)
+                n_eff = lens if output_key == "values" else np.maximum(lens - 1, 0)
+                seq_of = np.repeat(np.arange(len(lens)), n_eff)
+                within = np.arange(n_eff.sum()) - np.repeat(
+                    np.cumsum(n_eff) - n_eff, n_eff
+                )
+                src_r = np.asarray(g.row_of_seq)[seq_of]
+                src_c = np.asarray(g.col_of_seq)[seq_of] + within
+                dst_r = np.asarray(g.seq_index)[seq_of]
+                dst_c = within if output_key == "values" else within + 1
+                out[dst_r, dst_c] = vals[src_r, src_c]
         return out
 
     # -- rollout plumbing -------------------------------------------------
@@ -812,6 +832,31 @@ class JaxTrainEngine(TrainEngine):
         inference/client.py)."""
         meta = meta or self._weight_update_meta
         assert meta is not None, "no WeightUpdateMeta configured"
+        mcfg = self.model_cfg
+        if meta.lora_only and (mcfg is None or mcfg.lora_rank <= 0):
+            # a lora_only meta on a non-LoRA model must not leak into the
+            # client's lora branch (it would encode the full merged tree
+            # against /update_weights_lora) — fall back to a full update
+            import dataclasses as _dc
+
+            logger.warning("lora_only weight update on a non-LoRA model; using full update")
+            meta = _dc.replace(meta, lora_only=False)
+        if meta.type == "mem" and meta.lora_only:
+            # LoRA fast path: ship only the adapter leaves; servers fold the
+            # delta into their base weights (decode_engine.update_weights_lora)
+            assert self._inference_engine is not None
+            import dataclasses as _dc
+
+            lora = {
+                f"layers/{t}_lora_{s}": self.params["layers"][f"{t}_lora_{s}"]
+                for t in mcfg.lora_targets
+                for s in ("a", "b")
+            }
+            self._inference_engine.update_weights(
+                _dc.replace(meta, lora_scale=mcfg.lora_alpha / mcfg.lora_rank),
+                params=lora,
+            )
+            return
         # inference serves the merged tree — LoRA deltas fold into the base
         # (the reference instead ships a PEFT config to SGLang; on TPU the
         # merged weights ARE the serving format)

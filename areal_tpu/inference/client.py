@@ -303,6 +303,32 @@ class RemoteJaxEngine(InferenceEngine):
         blog/AReaL_v0_2.md:79-83)."""
         version = self._version + 1 if meta.with_version else self._version
         enc_pool = first = None
+        if meta.type == "mem" and meta.lora_only:
+            # LoRA-delta fast path: one tiny bucket of adapter leaves, no
+            # full-tree stream (see WeightUpdateMeta.lora_only)
+            assert params is not None
+            assert all("_lora_" in k for k in params), (
+                "lora_only update got non-adapter leaves — caller must pass "
+                "the flat layers/{t}_lora_{a,b} dict, not the merged tree"
+            )
+            body = self._encode_bucket(sorted(params.items()))
+            t0 = time.monotonic()
+            self.pause_generation()
+            try:
+                self._post_all_bytes(
+                    f"/update_weights_lora?scale={meta.lora_scale}"
+                    f"&version={version}",
+                    body,
+                )
+            finally:
+                self.continue_generation()
+            self.last_pause_secs = time.monotonic() - t0
+            logger.info(
+                f"lora weight update v{version} pause window "
+                f"{self.last_pause_secs:.2f}s ({len(body)} bytes)"
+            )
+            self._version = version
+            return
         if meta.type == "mem":
             # encode bucket 0 (device->host + bf16 cast) BEFORE pausing so
             # the window starts with bytes ready to ship
@@ -378,33 +404,72 @@ class RemoteJaxEngine(InferenceEngine):
         """Pipelined upload: encode bucket i+1 (device->host + bf16 cast)
         while bucket i is in flight to every server; servers device_put each
         bucket on arrival, so transport/serialisation/H2D all overlap.
-        ``first`` is bucket 0's encode future, started before the pause."""
+        ``first`` is bucket 0's encode future, started before the pause.
+
+        With ``weight_update_relay`` and >1 server, each bucket is uploaded
+        ONCE to the tree root with an X-Areal-Relay header; servers forward
+        down a fanout-2 tree (server.py:_relay_bucket) — the trainer's
+        uplink carries 1x the model instead of n_servers x (the reference's
+        NCCL broadcast role, fsdp_engine.py:1047-1137)."""
         import concurrent.futures
 
         self._post_all("/update_weights_begin", {})
+        relay = (
+            getattr(self.config, "weight_update_relay", False)
+            and len(self.addresses) > 1
+        )
         with concurrent.futures.ThreadPoolExecutor(max_workers=8) as net_pool:
+            if relay:
+                hdr = {
+                    "X-Areal-Relay": ",".join(self.addresses[1:]),
+                    "X-Areal-Relay-Timeout": str(self.config.request_timeout),
+                }
+
+                def send(body: bytes) -> None:
+                    self._post_bytes(
+                        self.addresses[0], "/update_weights_bucket", body, headers=hdr
+                    )
+
+            else:
+
+                def send(body: bytes) -> None:
+                    list(
+                        net_pool.map(
+                            lambda addr: self._post_bytes(
+                                addr, "/update_weights_bucket", body
+                            ),
+                            self.addresses,
+                        )
+                    )
+
             nxt = first
             for i in range(len(buckets)):
                 body = nxt.result()
                 if i + 1 < len(buckets):
                     nxt = enc_pool.submit(self._encode_bucket, buckets[i + 1])
-                list(
-                    net_pool.map(
-                        lambda addr: self._post_bytes(
-                            addr, "/update_weights_bucket", body
-                        ),
-                        self.addresses,
-                    )
-                )
+                send(body)
         self._post_all("/update_weights_commit", {"version": version})
 
-    def _post_bytes(self, addr: str, path: str, body: bytes) -> None:
+    def _post_all_bytes(self, path: str, body: bytes) -> None:
+        import concurrent.futures
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+            list(
+                pool.map(
+                    lambda addr: self._post_bytes(addr, path, body),
+                    self.addresses,
+                )
+            )
+
+    def _post_bytes(
+        self, addr: str, path: str, body: bytes, headers: dict | None = None
+    ) -> None:
         import urllib.request
 
         req = urllib.request.Request(
             f"http://{addr}{path}",
             data=body,
-            headers={"Content-Type": "application/octet-stream"},
+            headers={"Content-Type": "application/octet-stream", **(headers or {})},
             method="POST",
         )
         with urllib.request.urlopen(req, timeout=self.config.request_timeout) as r:

@@ -277,7 +277,10 @@ class DecodeEngine:
         }
         with jax.set_mesh(self.mesh):
             self._dev_state = {k: jnp.asarray(v) for k, v in self._state.items()}
-        self._rng = jax.random.PRNGKey(int(time.time_ns()) % (2**31))
+        seed = self.config.seed
+        if seed is None:
+            seed = int(time.time_ns()) % (2**31)
+        self._rng = jax.random.PRNGKey(seed)
         # precompile() warms via AOT lower().compile(); the serving path
         # replays those programs through the persistent compile cache, so
         # make sure one is configured. TPU only: CPU AOT cache entries are
@@ -540,33 +543,114 @@ class DecodeEngine:
     def is_paused(self) -> bool:
         return self._paused.is_set()
 
-    def update_weights_from_disk(self, path: str, version: int | None = None) -> None:
-        with self._weight_lock:
-            self._pending_weight_update = ("disk", path, version)
-        self._wakeup.set()
-        # wait for the decode loop to apply it (or apply inline if not running)
+    def _wait_weight_update_applied(self) -> None:
+        """Wait for the decode loop to apply the pending update (or apply it
+        inline when the loop is not running); re-raise its failure."""
         if self._thread is None:
             self._apply_weight_update()
         else:
             while True:
                 with self._weight_lock:
                     if self._pending_weight_update is None:
-                        return
+                        break
                 time.sleep(0.01)
+        self._take_update_error()
+
+    def update_weights_from_disk(self, path: str, version: int | None = None) -> None:
+        with self._weight_lock:
+            self._pending_weight_update = ("disk", path, version)
+        self._wakeup.set()
+        self._wait_weight_update_applied()
 
     def update_weights_from_params(self, params: dict, version: int | None = None) -> None:
         """Colocated/mem-path update: resharded device arrays or host arrays."""
         with self._weight_lock:
             self._pending_weight_update = ("params", params, version)
         self._wakeup.set()
-        if self._thread is None:
-            self._apply_weight_update()
-        else:
-            while True:
-                with self._weight_lock:
-                    if self._pending_weight_update is None:
-                        return
-                time.sleep(0.01)
+        self._wait_weight_update_applied()
+
+    def update_weights_lora(
+        self, flat: dict[str, np.ndarray], scale: float, version: int | None = None
+    ) -> None:
+        """LoRA-delta fast path: fold adapter deltas into the served base
+        weights WITHOUT streaming the full tree (reference ships the PEFT
+        config to SGLang, lora docs; a 1.5B bf16 tree is ~3 GB/server while
+        rank-32 adapters are ~25 MB). Cumulative-correct: the engine keeps
+        the previously applied (a, b) per target and folds
+        W += scale·(a_new@b_new − a_old@b_old).
+
+        PRECONDITION: the serving params this engine STARTED with must be
+        the adapter-free base checkpoint (the single-host entry injects the
+        trainer's unmerged base; fleet servers load the base model path). A
+        server cold-started from a MERGED export would double-fold on the
+        first delta — in-process transitions are guarded (_lora_prev=None
+        after any full update), but the engine cannot detect a merged
+        checkpoint at load time."""
+        with self._weight_lock:
+            self._pending_weight_update = ("lora", (flat, float(scale)), version)
+        self._wakeup.set()
+        self._wait_weight_update_applied()
+
+    def _apply_lora_delta(self, flat: dict, scale: float) -> None:
+        prev = getattr(self, "_lora_prev", {})
+        if prev is None:
+            # a full weight update replaced the base since the last delta;
+            # the fold base is unknown (the full tree may already contain
+            # merged adapters) — folding now would double-apply silently
+            raise RuntimeError(
+                "lora_only update after a full weight update: the serving "
+                "base is no longer the adapter-free checkpoint; push full "
+                "updates (lora_only=False) or restart servers from the base"
+            )
+        layers = dict(self.params["layers"])
+        targets = sorted(
+            {k.split("/")[-1].rsplit("_lora_", 1)[0] for k in flat}
+        )
+        # validate BEFORE any fold: the fold donates live weight buffers, so
+        # a mid-loop KeyError/shape error would strand self.params on
+        # deleted arrays and brick the server
+        for t in targets:
+            for s in ("a", "b"):
+                if f"layers/{t}_lora_{s}" not in flat:
+                    raise ValueError(f"lora bucket missing layers/{t}_lora_{s}")
+            if t not in layers:
+                raise ValueError(f"unknown lora target {t!r}")
+            a_s = flat[f"layers/{t}_lora_a"].shape
+            b_s = flat[f"layers/{t}_lora_b"].shape
+            w_s = tuple(layers[t].shape)
+            if (
+                len(a_s) != 3
+                or len(b_s) != 3
+                or (a_s[0], a_s[1], b_s[2]) != w_s
+                or a_s[2] != b_s[1]
+            ):
+                raise ValueError(
+                    f"lora shapes {a_s}x{b_s} do not fold into {t} {w_s}"
+                )
+        if not hasattr(self, "_lora_fold_fn"):
+
+            def fold(w, a, b, pa, pb, s):
+                delta = jnp.einsum("nir,nro->nio", a, b) - jnp.einsum(
+                    "nir,nro->nio", pa, pb
+                )
+                return (w.astype(jnp.float32) + s * delta).astype(w.dtype)
+
+            self._lora_fold_fn = jax.jit(fold, donate_argnums=(0,))
+        new_prev = {}
+        with jax.set_mesh(self.mesh):
+            for t in targets:
+                a = jnp.asarray(flat[f"layers/{t}_lora_a"], jnp.float32)
+                b = jnp.asarray(flat[f"layers/{t}_lora_b"], jnp.float32)
+                pa, pb = prev.get(t, (jnp.zeros_like(a), jnp.zeros_like(b)))
+                layers[t] = self._lora_fold_fn(
+                    layers[t], a, b, pa, pb, jnp.float32(scale)
+                )
+                new_prev[t] = (a, b)
+        # merge, don't replace: a bucket covering a subset of targets must
+        # not drop the fold state of absent targets (a later delta for them
+        # would then double-apply)
+        self._lora_prev = {**prev, **new_prev}
+        self.params = {**self.params, "layers": layers}
 
     # -- streamed (bucketed) weight update --------------------------------
     # The round-1 mem path serialized the whole model as one fp32 npz inside
@@ -608,25 +692,43 @@ class DecodeEngine:
         with self._weight_lock:
             self._pending_weight_update = ("staged", tree, version)
         self._wakeup.set()
-        if self._thread is None:
-            self._apply_weight_update()
-        else:
-            while True:
-                with self._weight_lock:
-                    if self._pending_weight_update is None:
-                        return
-                time.sleep(0.01)
+        self._wait_weight_update_applied()
 
     def _apply_weight_update(self) -> None:
+        try:
+            self._apply_weight_update_inner()
+        except Exception as e:  # noqa: BLE001 — a bad update payload must
+            # fail THAT update (waiter re-raises, HTTP caller gets a 500),
+            # not kill the decode loop or wedge the pending-update wait
+            with self._weight_lock:
+                self._weight_update_error = e
+                self._pending_weight_update = None
+            logger.error(f"weight update failed: {type(e).__name__}: {e}")
+
+    def _take_update_error(self) -> None:
+        with self._weight_lock:
+            err = getattr(self, "_weight_update_error", None)
+            self._weight_update_error = None
+        if err is not None:
+            raise err
+
+    def _apply_weight_update_inner(self) -> None:
         with self._weight_lock:
             upd = self._pending_weight_update
             if upd is None:
                 return
             kind, payload, version = upd
             t0 = time.monotonic()
+            if kind != "lora":
+                # any full update invalidates the delta-fold base: the new
+                # tree may already contain merged adapters, so subsequent
+                # lora_only pushes must be refused (see _apply_lora_delta)
+                self._lora_prev = None
             if kind == "staged":
                 # already sharded device arrays — pointer swap only
                 self.params = payload
+            elif kind == "lora":
+                self._apply_lora_delta(*payload)
             elif kind == "disk":
 
                 def put(path, arr):

@@ -85,6 +85,7 @@ class InferenceServer:
                 web.post("/update_weights_begin", self.h_update_begin),
                 web.post("/update_weights_bucket", self.h_update_bucket),
                 web.post("/update_weights_commit", self.h_update_commit),
+                web.post("/update_weights_lora", self.h_update_lora),
                 web.post("/set_version", self.h_set_version),
                 web.post("/release_memory_occupation", self.h_release_memory),
                 web.post("/resume_memory_occupation", self.h_resume_memory),
@@ -171,13 +172,55 @@ class InferenceServer:
     async def h_update_bucket(self, request: web.Request) -> web.Response:
         """One bucket of bf16 tensors: 8-byte LE header length + json header
         {entries: [{name, dtype, shape}]} + concatenated raw buffers.
-        device_put happens here, overlapping the next bucket's transport."""
+        device_put happens here, overlapping the next bucket's transport.
+
+        Relay fan-out (reference role: the NCCL broadcast tree of
+        fsdp_engine.py:1047-1137): an ``X-Areal-Relay`` header carries the
+        downstream addresses this server must forward the SAME body to.
+        The trainer then uploads each bucket once instead of n_servers
+        times — fleet fan-out bandwidth rides the servers' own NICs, and
+        the response acks only after the local stage AND every subtree ack
+        (the commit barrier stays correct)."""
         body = await request.read()
+        relay = [a for a in request.headers.get("X-Areal-Relay", "").split(",") if a]
+        forwards = []
+        if relay:
+            # per-hop timeout rides with the request so the operator's
+            # client-side request_timeout governs the whole tree
+            timeout = float(
+                request.headers.get("X-Areal-Relay-Timeout", "300")
+            )
+            forwards = [
+                asyncio.get_running_loop().run_in_executor(
+                    None, _relay_bucket, group, body, request.path_qs, timeout
+                )
+                for group in _split_relay(relay)
+            ]
         flat = decode_weight_bucket(body)
         await asyncio.get_running_loop().run_in_executor(
             None, self.engine.stage_weight_bucket, flat
         )
+        for f in forwards:
+            await f
         return web.json_response({"status": "ok"})
+
+    async def h_update_lora(self, request: web.Request) -> web.Response:
+        """LoRA-delta fast path: body is one weight bucket holding only
+        ``layers/{t}_lora_{a,b}`` leaves; ``scale`` (= alpha/rank) and
+        optional ``version`` ride as query params. The engine folds the
+        delta into its base weights — full-tree streaming skipped."""
+        body = await request.read()
+        flat = decode_weight_bucket(body)
+        scale = float(request.query["scale"])
+        version = request.query.get("version")
+        await asyncio.get_running_loop().run_in_executor(
+            None,
+            self.engine.update_weights_lora,
+            flat,
+            scale,
+            int(version) if version is not None else None,
+        )
+        return web.json_response({"status": "ok", "version": self.engine.get_version()})
 
     async def h_update_commit(self, request: web.Request) -> web.Response:
         d = await request.json()
@@ -237,6 +280,36 @@ class InferenceServer:
             loop.run_forever()
         finally:
             loop.run_until_complete(self.astop())
+
+
+RELAY_FANOUT = 2  # branching factor of the weight-broadcast tree
+
+
+def _split_relay(addrs: list[str]) -> list[list[str]]:
+    """Partition downstream addresses into RELAY_FANOUT contiguous subtrees
+    (each list's head is the next hop; its tail is that hop's own relay)."""
+    k = min(RELAY_FANOUT, len(addrs))
+    step = -(-len(addrs) // k)
+    return [addrs[i : i + step] for i in range(0, len(addrs), step)]
+
+
+def _relay_bucket(
+    group: list[str], body: bytes, path_qs: str, timeout: float = 300.0
+) -> None:
+    import urllib.request
+
+    head, tail = group[0], group[1:]
+    headers = {
+        "Content-Type": "application/octet-stream",
+        "X-Areal-Relay-Timeout": str(timeout),
+    }
+    if tail:
+        headers["X-Areal-Relay"] = ",".join(tail)
+    req = urllib.request.Request(
+        f"http://{head}{path_qs}", data=body, headers=headers, method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        r.read()
 
 
 def encode_weight_bucket(entries: list[tuple[str, np.ndarray]]) -> bytes:

@@ -71,6 +71,7 @@ def stack(tmp_path_factory):
         max_batch_size=8,
         max_seq_len=64,
         decode_steps_per_call=4,
+        seed=0,  # deterministic sampling stream (deflake, VERDICT r03 weak #1)
         mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
     )
     dec = DecodeEngine(
@@ -121,7 +122,11 @@ def stack(tmp_path_factory):
 
 def _first_token_hit_rate(trainer, dataset, n=16):
     """Direct agenerate probe — bypasses the staleness-gated dispatcher so
-    the probe does not consume the training pipeline's capacity budget."""
+    the probe does not consume the training pipeline's capacity budget.
+    GREEDY decode: the gate asks "did the policy's argmax move to TARGET",
+    a deterministic property — a temperature-1.0 probe over 16 prompts
+    false-fails ~25% of the time even at hit probability 0.6, which is
+    exactly the full-suite-only flake VERDICT r03 weak #1 describes."""
     import asyncio
 
     from areal_tpu.api.io_struct import ModelRequest
@@ -130,7 +135,9 @@ def _first_token_hit_rate(trainer, dataset, n=16):
         reqs = [
             ModelRequest(
                 input_ids=row["prompt_ids"],
-                gconfig=GenerationHyperparameters(n_samples=1, max_new_tokens=4),
+                gconfig=GenerationHyperparameters(
+                    n_samples=1, max_new_tokens=4, greedy=True
+                ),
             )
             for row in dataset[:n]
         ]

