@@ -40,10 +40,11 @@ BASELINE_TOK_S_PER_CHIP = 4300.0
 # driver's ~25-min capture window even if every phase hits its deadline —
 # the startup assert below enforces it (ADVICE r02 #3)
 PHASE_DEADLINE_S = {
-    "probe": 120.0,
-    "decode": 420.0,
-    "longctx": 360.0,
-    "train": 360.0,
+    "probe": 90.0,
+    "decode": 390.0,
+    "longctx": 210.0,
+    "train": 270.0,
+    "async_sync": 360.0,
 }
 _CAPTURE_WINDOW_S = 1500.0
 _OVERHEAD_ALLOWANCE_S = 90.0  # probe retry sleep, process spawn, parent work
@@ -53,10 +54,11 @@ assert (
     + _OVERHEAD_ALLOWANCE_S
     <= _CAPTURE_WINDOW_S
 ), "phase deadlines no longer fit the driver capture window"
-# in-phase budget for the decode wait loops (< the external deadline so the
-# partial-result path can fire before the parent SIGKILLs us)
-DECODE_WAIT_S = 280.0
-LONGCTX_WAIT_S = 180.0
+# in-phase budget for the decode wait loops (< the external deadline minus
+# setup ~80s + warmup + emit slack, so the partial-result path can fire
+# before the parent SIGKILLs us)
+DECODE_WAIT_S = 240.0
+LONGCTX_WAIT_S = 140.0
 _PHASE_START = time.monotonic()  # reset per child in _run_phase_child
 
 # Qwen2.5-1.5B dimensions (config.json of Qwen/Qwen2.5-1.5B)
@@ -441,11 +443,194 @@ def phase_train():
         pass
 
 
+# Qwen2.5-0.5B dimensions: the async-vs-sync phase colocates a trainer
+# engine AND a decode engine in one process; at 1.5B the two bf16 param
+# copies + AdamW state + KV would overrun one v5e's 16 GB HBM
+MODEL_05B_KW = dict(
+    vocab_size=151936,
+    hidden_size=896,
+    intermediate_size=4864,
+    num_layers=24,
+    num_heads=14,
+    num_kv_heads=2,
+    head_dim=64,
+    dtype="bfloat16",
+    tie_word_embeddings=True,
+    attention_bias=True,
+    rope_theta=1000000.0,
+)
+
+
+def phase_async_sync():
+    """The framework's headline claim, measured (VERDICT r04 item #2): N
+    identical GRPO steps through the REAL stack (DecodeEngine server +
+    RemoteJaxEngine + staleness-gated WorkflowExecutor + PPOActor + mem-mode
+    weight stream), once serialized (max_head_offpolicyness=0: every
+    rollout waits for the version bump) and once async (eta=2: rollouts for
+    future steps overlap training + weight updates). Reference bar: 2.77x
+    at 16 nodes (blog/AReaL_v0_3.md:176-180); on ONE chip the device work
+    serializes, so the async win is bounded by host-side time (advantage
+    computation, weight encode/stream, dispatch) that generation can hide
+    behind — expect >1, far from 2.77."""
+    import numpy as np
+    import jax
+
+    from areal_tpu.api.config import (
+        InferenceEngineConfig,
+        MeshConfig,
+        MicroBatchSpec,
+        NormConfig,
+        OptimizerConfig,
+        PPOActorConfig,
+        ServerConfig,
+    )
+    from areal_tpu.api.io_struct import (
+        FinetuneSpec,
+        GenerationHyperparameters,
+        WeightUpdateMeta,
+    )
+    from areal_tpu.engine.train_engine import JaxTrainEngine
+    from areal_tpu.inference.client import RemoteJaxEngine
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.inference.server import ServerThread
+    from areal_tpu.models import qwen
+    from areal_tpu.trainer.ppo import PPOActor
+    from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+    GROUP = 4
+    PROMPTS_PER_STEP = 12
+    NEW_TOKENS = 128
+    N_STEPS = 3
+    model_kw = MODEL_05B_KW
+    if os.environ.get("BENCH_SMOKE"):
+        # CPU wiring check (tests/smoke): tiny dims, one step — the phase
+        # logic is identical, only the numbers are meaningless
+        model_kw = dict(
+            vocab_size=256,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=16,
+            dtype="float32",
+            tie_word_embeddings=True,
+        )
+        GROUP, PROMPTS_PER_STEP, NEW_TOKENS, N_STEPS = 2, 2, 8, 1
+
+    model_cfg = qwen.ModelConfig(**model_kw)
+    actor_cfg = PPOActorConfig(
+        init_from_scratch=True,
+        dtype="bfloat16",
+        param_dtype="bfloat16",
+        gradient_checkpointing=True,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        optimizer=OptimizerConfig(lr=1e-5, lr_scheduler_type="constant"),
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=100_000),
+        bucket_step=256,
+        logprob_chunk_size=256,
+        group_size=GROUP,
+        ppo_n_minibatches=1,
+        adv_norm=NormConfig(mean_level="group", std_level="batch", group_size=GROUP),
+        kl_ctl=0.0,
+        use_decoupled_loss=True,
+        prox_logp_mode="loglinear",  # no extra forward pass per step
+        temperature=1.0,
+    )
+    t0 = time.monotonic()
+    engine = JaxTrainEngine(actor_cfg, model_config=model_cfg)
+    engine.initialize(FinetuneSpec(1, 10_000, PROMPTS_PER_STEP))
+    actor = PPOActor(actor_cfg, engine)
+    log(f"[async_sync] trainer init {time.monotonic()-t0:.1f}s")
+
+    scfg = ServerConfig(
+        max_batch_size=64,
+        max_seq_len=512,
+        decode_steps_per_call=32,
+        seed=0,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+    )
+    t0 = time.monotonic()
+    dec = DecodeEngine(
+        scfg, params=jax.tree.map(np.asarray, engine.params), model_cfg=model_cfg
+    )
+    dec.initialize()
+    dec.precompile(prompt_buckets=[128])
+    server = ServerThread(scfg, dec)
+    server.start()
+    log(f"[async_sync] server up {time.monotonic()-t0:.1f}s")
+
+    rng = np.random.default_rng(0)
+    dataset = [
+        {"prompt_ids": rng.integers(20, 10_000, 128).tolist()} for _ in range(256)
+    ]
+    gconfig = GenerationHyperparameters(
+        n_samples=GROUP, max_new_tokens=NEW_TOKENS, temperature=1.0
+    )
+    wf = RLVRWorkflow(lambda *a, **kw: 1.0, gconfig)
+    meta = WeightUpdateMeta(type="mem")
+
+    def run_mode(eta: int, n_steps: int, tag: str) -> float:
+        rollout = RemoteJaxEngine(
+            InferenceEngineConfig(
+                max_concurrent_rollouts=2 * PROMPTS_PER_STEP,
+                consumer_batch_size=PROMPTS_PER_STEP,
+                max_head_offpolicyness=eta,
+                request_timeout=PHASE_DEADLINE_S["async_sync"],
+            ),
+            addresses=[server.address],
+        )
+        rollout.initialize()
+        rollout.set_version(engine.get_version())
+        engine.connect_engine(rollout, meta)
+        t0 = time.monotonic()
+        for step in range(n_steps):
+            batch = rollout.prepare_batch(dataset, workflow=wf)
+            adv = actor.compute_advantages(batch)
+            actor.ppo_update(adv)
+            rollout.pause()
+            engine.update_weights(meta)
+            new_version = engine.get_version() + 1
+            engine.set_version(new_version)
+            rollout.set_version(new_version)
+            rollout.resume()
+            log(
+                f"[async_sync] {tag} step {step} t={time.monotonic()-t0:.1f}s"
+            )
+        dt = time.monotonic() - t0
+        try:
+            rollout.destroy()
+        except Exception:  # noqa: BLE001
+            pass
+        return dt
+
+    # warmup: compile every program (prefill, chunk, train fwd/bwd, logp)
+    run_mode(0, 1, "warmup")
+    t_sync = run_mode(0, N_STEPS, "sync")
+    t_async = run_mode(2, N_STEPS, "async")
+    speedup = t_sync / t_async if t_async > 0 else 0.0
+    _emit_phase(
+        {
+            "phase": "async_sync",
+            "sync_secs": round(t_sync, 2),
+            "async_secs": round(t_async, 2),
+            "speedup": round(speedup, 3),
+            "steps": N_STEPS,
+            "tokens_per_step": PROMPTS_PER_STEP * GROUP * NEW_TOKENS,
+        }
+    )
+    try:
+        server.stop()
+    except Exception:  # noqa: BLE001
+        pass
+
+
 PHASES = {
     "probe": phase_probe,
     "decode": phase_decode,
     "longctx": phase_longctx,
     "train": phase_train,
+    "async_sync": phase_async_sync,
 }
 
 
@@ -517,7 +702,7 @@ def _spawn_phase(name: str) -> dict:
 def main():
     hb = _start_heartbeat("parent")
     errors = {}
-    gen_tok_s = train_tok_s = weight_update_secs = longctx = None
+    gen_tok_s = train_tok_s = weight_update_secs = longctx = async_sync = None
     n_chips = 1
     try:
         probe = _spawn_phase("probe")
@@ -556,6 +741,16 @@ def main():
                 errors["train"] = t["error"]
             else:
                 train_tok_s = float(t["tok_s"])
+            a = _spawn_phase("async_sync")
+            if "error" in a:
+                errors["async_sync"] = a["error"]
+            else:
+                async_sync = {
+                    "speedup": a.get("speedup"),
+                    "sync_secs": a.get("sync_secs"),
+                    "async_secs": a.get("async_secs"),
+                    "steps": a.get("steps"),
+                }
     except Exception as e:  # noqa: BLE001 — the JSON line must still print
         errors["parent"] = f"{type(e).__name__}: {e}"
     finally:
@@ -566,6 +761,7 @@ def main():
         "train_tok_s": round(train_tok_s, 1) if train_tok_s else None,
         "weight_update_secs": weight_update_secs,
         "longctx": longctx,
+        "async_vs_sync": async_sync,
         "chips": n_chips,
     }
     if errors:
