@@ -85,7 +85,6 @@ def _truncate_at_stop_strings(resp, tokenizer, stop_list: list[str]):
     hits = [(text.find(s), s) for s in stop_list if text.find(s) != -1]
     if not hits:
         return resp, False
-    first_idx = min(h[0] for h in hits)
     toks = list(resp.output_tokens)
     k = len(toks)
     for n in range(1, len(toks) + 1):
@@ -93,6 +92,7 @@ def _truncate_at_stop_strings(resp, tokenizer, stop_list: list[str]):
         if any(s in prefix for _, s in hits):
             k = n
             break
+    first_idx, first_s = min(hits)
     resp = dataclasses.replace(
         resp,
         output_tokens=toks[:k],
@@ -100,7 +100,12 @@ def _truncate_at_stop_strings(resp, tokenizer, stop_list: list[str]):
         output_versions=list(resp.output_versions)[:k],
         stop_reason="stop",
     )
-    resp.metadata = {**resp.metadata, "stop_text_index": first_idx}
+    resp.metadata = {
+        **resp.metadata,
+        "stop_text_index": first_idx,
+        "stop_string": first_s,  # which sequence fired (Anthropic shim
+        # reports it as stop_reason="stop_sequence")
+    }
     return resp, True
 
 
@@ -364,7 +369,10 @@ class AsyncChatCompletions:
             )
             choices.append(
                 ChatCompletionChoice(
-                    index=i, message=message, finish_reason=finish_reason
+                    index=i,
+                    message=message,
+                    finish_reason=finish_reason,
+                    matched_stop=resp.metadata.get("stop_string") if stop_hit else None,
                 )
             )
             total_completion_tokens += resp.output_len

@@ -25,7 +25,12 @@ from areal_tpu.utils import logging as alog
 
 logger = alog.getLogger("proxy_gateway")
 
-FORWARDED_PATHS = ("/v1/chat/completions", "/rl/set_reward", "/rl/end_session")
+FORWARDED_PATHS = (
+    "/v1/chat/completions",
+    "/v1/messages",  # Anthropic Messages API shim (anthropic-SDK agents)
+    "/rl/set_reward",
+    "/rl/end_session",
+)
 ROUTE_TIMEOUT_S = 3600.0  # matches the proxy's session timeout
 
 

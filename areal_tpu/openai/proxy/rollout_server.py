@@ -220,6 +220,219 @@ def create_proxy_app(state: ProxyState) -> web.Application:
             return resp
         return web.json_response(result.to_dict())
 
+    async def anthropic_messages(request: web.Request):
+        """Anthropic Messages API shim (reference workflow/anthropic/
+        math_agent.py points anthropic.AsyncAnthropic at the proxy): the
+        request translates onto the internal OpenAI-shaped client, the
+        response back into an Anthropic ``message`` object — so
+        anthropic-SDK agents train unchanged. Tools map input_schema <->
+        function.parameters; tool_use blocks carry parsed arguments."""
+        sess = require_session(request)
+        body = await request.json()
+        messages = []
+        system = body.get("system")
+        if system:
+            if isinstance(system, list):  # content-block form
+                system = "".join(b.get("text", "") for b in system)
+            messages.append({"role": "system", "content": system})
+        for m in body.get("messages", []):
+            content = m.get("content")
+            if not isinstance(content, list):
+                messages.append({"role": m["role"], "content": content})
+                continue
+            # content-block translation, tool loop included: assistant
+            # tool_use blocks become OpenAI tool_calls, user tool_result
+            # blocks become role="tool" messages — without this every
+            # multi-turn tool loop loses the tool outputs
+            text = "".join(
+                b.get("text", "") for b in content if b.get("type") == "text"
+            )
+            tool_uses = [b for b in content if b.get("type") == "tool_use"]
+            tool_results = [b for b in content if b.get("type") == "tool_result"]
+            if m["role"] == "assistant" and tool_uses:
+                messages.append(
+                    {
+                        "role": "assistant",
+                        "content": text or None,
+                        "tool_calls": [
+                            {
+                                "id": b.get("id", ""),
+                                "type": "function",
+                                "function": {
+                                    "name": b.get("name", ""),
+                                    "arguments": json.dumps(b.get("input", {})),
+                                },
+                            }
+                            for b in tool_uses
+                        ],
+                    }
+                )
+                continue
+            for b in tool_results:
+                rc = b.get("content")
+                if isinstance(rc, list):
+                    rc = "".join(
+                        x.get("text", "") for x in rc if x.get("type") == "text"
+                    )
+                messages.append(
+                    {
+                        "role": "tool",
+                        "tool_call_id": b.get("tool_use_id", ""),
+                        "content": rc if rc is not None else "",
+                    }
+                )
+            if text or not tool_results:
+                messages.append({"role": m["role"], "content": text})
+        tools = [
+            {
+                "type": "function",
+                "function": {
+                    "name": t["name"],
+                    "description": t.get("description", ""),
+                    "parameters": t.get("input_schema", {}),
+                },
+            }
+            for t in body.get("tools", [])
+        ]
+        # stream=False internally is deliberate: the decode engine has no
+        # token-level callback yet, so the internal stream=True generator is
+        # ALSO synthesized after generation completes — consuming it here
+        # would add plumbing with identical latency. Revisit when the engine
+        # exposes per-chunk emission.
+        kw: dict = {
+            "messages": messages,
+            "max_completion_tokens": body.get("max_tokens"),
+            "stream": False,
+        }
+        if tools:
+            kw["tools"] = tools
+        if body.get("temperature") is not None:
+            kw["temperature"] = body["temperature"]
+        if body.get("top_p") is not None:
+            kw["top_p"] = body["top_p"]
+        if body.get("stop_sequences"):
+            kw["stop"] = list(body["stop_sequences"])
+        stream = bool(body.get("stream"))
+        try:
+            completion = await sess.client.chat.completions.create(**kw)
+        except (ValueError, NotImplementedError) as e:
+            raise web.HTTPBadRequest(text=str(e))
+        choice = completion.choices[0]
+        content_blocks: list[dict] = []
+        if choice.message.content:
+            content_blocks.append({"type": "text", "text": choice.message.content})
+        for tc in choice.message.tool_calls or []:
+            try:
+                args = json.loads(tc.function.arguments or "{}")
+            except json.JSONDecodeError:
+                args = {"_raw": tc.function.arguments}
+            content_blocks.append(
+                {
+                    "type": "tool_use",
+                    "id": tc.id,
+                    "name": tc.function.name,
+                    "input": args,
+                }
+            )
+        if choice.matched_stop is not None:
+            # a requested stop_sequence fired — Anthropic agents branch on
+            # this (ReAct loops read which delimiter halted the model)
+            stop_reason = "stop_sequence"
+            stop_sequence = choice.matched_stop
+        else:
+            stop_reason = {
+                "stop": "end_turn",
+                "length": "max_tokens",
+                "tool_calls": "tool_use",
+            }.get(choice.finish_reason, "end_turn")
+            stop_sequence = None
+        msg = {
+            "id": completion.id.replace("chatcmpl", "msg"),
+            "type": "message",
+            "role": "assistant",
+            "model": completion.model,
+            "content": content_blocks,
+            "stop_reason": stop_reason,
+            "stop_sequence": stop_sequence,
+            "usage": {
+                "input_tokens": completion.usage.prompt_tokens,
+                "output_tokens": completion.usage.completion_tokens,
+            },
+        }
+        if not stream:
+            return web.json_response(msg)
+        # Anthropic SSE shape: typed events with `event:` lines
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            }
+        )
+        await resp.prepare(request)
+
+        async def emit(event: str, payload: dict) -> None:
+            await resp.write(
+                f"event: {event}\ndata: {json.dumps(payload)}\n\n".encode()
+            )
+
+        await emit(
+            "message_start",
+            {"type": "message_start", "message": {**msg, "content": []}},
+        )
+        for i, block in enumerate(content_blocks):
+            start = (
+                {"type": "text", "text": ""}
+                if block["type"] == "text"
+                else {**block, "input": {}}
+            )
+            await emit(
+                "content_block_start",
+                {"type": "content_block_start", "index": i, "content_block": start},
+            )
+            if block["type"] == "text":
+                text = block["text"]
+                for k in range(0, len(text), 48):
+                    await emit(
+                        "content_block_delta",
+                        {
+                            "type": "content_block_delta",
+                            "index": i,
+                            "delta": {
+                                "type": "text_delta",
+                                "text": text[k : k + 48],
+                            },
+                        },
+                    )
+            else:
+                await emit(
+                    "content_block_delta",
+                    {
+                        "type": "content_block_delta",
+                        "index": i,
+                        "delta": {
+                            "type": "input_json_delta",
+                            "partial_json": json.dumps(block["input"]),
+                        },
+                    },
+                )
+            await emit(
+                "content_block_stop", {"type": "content_block_stop", "index": i}
+            )
+        await emit(
+            "message_delta",
+            {
+                "type": "message_delta",
+                "delta": {
+                    "stop_reason": stop_reason,
+                    "stop_sequence": stop_sequence,
+                },
+                "usage": {"output_tokens": msg["usage"]["output_tokens"]},
+            },
+        )
+        await emit("message_stop", {"type": "message_stop"})
+        await resp.write_eof()
+        return resp
+
     async def set_reward(request: web.Request):
         sess = require_session(request)
         body = await request.json()
@@ -290,6 +503,7 @@ def create_proxy_app(state: ProxyState) -> web.Application:
     app.router.add_post("/rl/end_session", end_session)
     app.router.add_post("/rl/set_reward", set_reward)
     app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/v1/messages", anthropic_messages)
     app.router.add_post("/export_trajectories", export_trajectories)
     app.router.add_post("/grant_capacity", grant_capacity)
     return app

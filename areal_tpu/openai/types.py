@@ -62,14 +62,21 @@ class ChatCompletionChoice:
     index: int
     message: ChatMessage
     finish_reason: str = "stop"
+    # extension: the stop STRING that fired when finish_reason=="stop" came
+    # from a requested stop sequence (None for natural EOS). The Anthropic
+    # Messages shim needs this to report stop_reason="stop_sequence".
+    matched_stop: str | None = None
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "index": self.index,
             "message": self.message.to_dict(),
             "finish_reason": self.finish_reason,
             "logprobs": None,
         }
+        if self.matched_stop is not None:
+            d["matched_stop"] = self.matched_stop
+        return d
 
 
 @dataclasses.dataclass

@@ -1,11 +1,14 @@
 """Third-party-SDK agent integrations over the proxy gateway.
 
 Parity with the reference's SDK workflow packages
-(areal/workflow/{langchain,openai_agent,anthropic}/): an unmodified agent
-written against a vendor SDK trains by pointing its base_url at the
-gateway (infra/controller/rollout_controller.py start_gateway) with a
-session API key. Each module import-gates on its SDK — the TPU image ships
-neither langchain nor the openai package, so these are exercised where the
-SDK exists; the gateway protocol itself is e2e-tested SDK-free in
-tests/test_scale_out.py.
+(areal/workflow/{langchain,openai_agent,anthropic}/ and
+experimental/camel/): an unmodified agent written against a vendor SDK
+trains by pointing its base_url at the gateway
+(infra/controller/rollout_controller.py start_gateway) with a session API
+key. ``openai_sdk_agent``/``langchain_math_agent``/``camel_model`` speak
+the OpenAI endpoint; ``anthropic_agent`` speaks the proxy's ``/v1/messages``
+Anthropic Messages shim. Each module import-gates on its SDK — the TPU
+image ships none of them, so these are exercised where the SDK exists; both
+wire protocols are e2e-tested SDK-free in tests/test_scale_out.py and
+tests/test_openai_layer.py.
 """

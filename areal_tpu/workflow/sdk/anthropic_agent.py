@@ -1,0 +1,75 @@
+"""Anthropic-SDK math agent over the gateway (reference
+workflow/anthropic/math_agent.py:16-80).
+
+The RL side starts a session on the gateway; the agent is plain
+anthropic-SDK code pointed at it — the proxy's ``/v1/messages`` shim
+(openai/proxy/rollout_server.py) serves the Messages API from the RL
+inference fleet and records every completion for training export. Auth
+rides the SDK's ``x-api-key`` header (the proxy accepts it alongside
+bearer keys).
+
+Usage:
+
+    from areal_tpu.workflow.sdk.anthropic_agent import run_math_agent
+    answer = await run_math_agent(
+        base_url=session["base_url"],   # the gateway
+        api_key=session["api_key"],     # session key
+        question="What is 12*(3+4)?",
+    )
+"""
+
+from __future__ import annotations
+
+try:
+    import anthropic
+except ImportError as e:  # pragma: no cover - SDK not in the TPU image
+    raise ImportError(
+        "the `anthropic` package is required for this integration "
+        "(pip install anthropic); the /v1/messages protocol itself has no "
+        "SDK dependency — POST plain JSON like tests/test_openai_layer.py"
+    ) from e
+
+
+async def run_math_agent(
+    base_url: str,
+    api_key: str,
+    question: str,
+    model: str = "default",
+    max_tokens: int = 512,
+    system: str = "Solve the math problem. End with the final numeric answer.",
+) -> str:
+    """Single-turn Messages-API agent; returns the assistant text."""
+    client = anthropic.AsyncAnthropic(
+        api_key=api_key, base_url=base_url, max_retries=0
+    )
+    response = await client.messages.create(
+        model=model,
+        system=system,
+        messages=[{"role": "user", "content": question}],
+        max_tokens=max_tokens,
+    )
+    return "".join(
+        block.text for block in response.content if block.type == "text"
+    )
+
+
+async def run_math_agent_streaming(
+    base_url: str,
+    api_key: str,
+    question: str,
+    model: str = "default",
+    max_tokens: int = 512,
+) -> str:
+    """Streaming variant: consumes the proxy's Anthropic SSE events."""
+    client = anthropic.AsyncAnthropic(
+        api_key=api_key, base_url=base_url, max_retries=0
+    )
+    parts: list[str] = []
+    async with client.messages.stream(
+        model=model,
+        messages=[{"role": "user", "content": question}],
+        max_tokens=max_tokens,
+    ) as stream:
+        async for text in stream.text_stream:
+            parts.append(text)
+    return "".join(parts)

@@ -1,0 +1,129 @@
+"""CAMEL-AI model backend bound to the RL gateway (reference
+experimental/camel/openai_model.py role).
+
+CAMEL agents pick a ``BaseModelBackend``; this one routes every chat call
+through the gateway's OpenAI-compatible endpoint, so a CAMEL agent society
+trains against the RL inference fleet by swapping its model object — no
+agent-code changes. Token counting uses the HF tokenizer the RL run already
+has (the reference's AReaLTokenCounter shape).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+try:
+    from camel.messages import OpenAIMessage
+    from camel.models.base_model import BaseModelBackend
+    from camel.utils import BaseTokenCounter
+except ImportError as e:  # pragma: no cover - SDK not in the TPU image
+    raise ImportError(
+        "the `camel-ai` package is required for this integration "
+        "(pip install camel-ai); agents without CAMEL can use the plain "
+        "gateway protocol (examples/agentic/gateway_agent.py)"
+    ) from e
+
+try:
+    from openai import AsyncOpenAI, OpenAI
+except ImportError as e:  # pragma: no cover
+    raise ImportError("camel integration also needs the `openai` package") from e
+
+
+class ArealTokenCounter(BaseTokenCounter):
+    """HF-tokenizer-backed counter (reference AReaLTokenCounter,
+    experimental/camel/openai_model.py:41-62)."""
+
+    def __init__(self, tokenizer, tokens_per_message: int = 4):
+        self.tokenizer = tokenizer
+        self.tokens_per_message = tokens_per_message
+
+    def count_tokens_from_messages(self, messages: list[OpenAIMessage]) -> int:
+        n = 3  # assistant reply priming
+        for message in messages:
+            n += self.tokens_per_message
+            for value in message.values():
+                if isinstance(value, list):
+                    for item in value:
+                        if item.get("type") == "text":
+                            n += len(self.tokenizer.encode(str(item["text"])))
+                else:
+                    n += len(self.tokenizer.encode(str(value)))
+        return n
+
+    def encode(self, text: str) -> list[int]:
+        return list(self.tokenizer.encode(text))
+
+    def decode(self, token_ids: list[int]) -> str:
+        return self.tokenizer.decode(token_ids)
+
+
+class ArealModelBackend(BaseModelBackend):
+    """CAMEL backend over the gateway: sync + async chat via the OpenAI
+    protocol; the proxy records trajectories for export."""
+
+    def __init__(
+        self,
+        base_url: str,
+        api_key: str,
+        tokenizer=None,
+        model_type: str = "areal-tpu",
+        model_config_dict: dict[str, Any] | None = None,
+    ):
+        cfg = dict(model_config_dict or {})
+        cfg.setdefault("max_completion_tokens", 512)
+        super().__init__(
+            model_type=model_type,
+            model_config_dict=cfg,
+            api_key=api_key,
+            url=f"{base_url}/v1",
+        )
+        self._sync = OpenAI(base_url=f"{base_url}/v1", api_key=api_key, max_retries=0)
+        self._async = AsyncOpenAI(
+            base_url=f"{base_url}/v1", api_key=api_key, max_retries=0
+        )
+        self._tokenizer = tokenizer
+
+    @property
+    def token_counter(self) -> BaseTokenCounter:
+        if self._tokenizer is None:
+            raise RuntimeError(
+                "pass tokenizer= to ArealModelBackend for token counting"
+            )
+        return ArealTokenCounter(self._tokenizer)
+
+    def _call_kwargs(self, response_format, tools) -> dict[str, Any]:
+        """CAMEL hands (messages, response_format, tools) to the backend —
+        dropping them silently would disable tool use with no error."""
+        kw = dict(self.model_config_dict)
+        if tools:
+            kw["tools"] = tools
+        if response_format is not None:
+            kw["response_format"] = response_format
+        return kw
+
+    def _run(
+        self,
+        messages: list[OpenAIMessage],
+        response_format=None,
+        tools: list[dict] | None = None,
+    ):
+        return self._sync.chat.completions.create(
+            messages=messages,
+            model=str(self.model_type),
+            **self._call_kwargs(response_format, tools),
+        )
+
+    async def _arun(
+        self,
+        messages: list[OpenAIMessage],
+        response_format=None,
+        tools: list[dict] | None = None,
+    ):
+        return await self._async.chat.completions.create(
+            messages=messages,
+            model=str(self.model_type),
+            **self._call_kwargs(response_format, tools),
+        )
+
+    def check_model_config(self) -> None:
+        pass  # gateway accepts standard OpenAI params; unknown ones warn server-side
