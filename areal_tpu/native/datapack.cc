@@ -1,0 +1,127 @@
+// Native data-path kernels for areal_tpu.utils.datapack.
+//
+// The reference's data plane leans on native code (torch dataloaders, fused
+// CUDA ops); here the packing/partitioning hot path — run on EVERY
+// microbatch build (utils/grid.py) and every DP dispatch
+// (infra/dist_rollout.py) — gets the same treatment: exact ports of the
+// Python algorithms, compiled once at first use (native/__init__.py) and
+// bound via ctypes. Semantics MUST match the Python reference functions
+// bit-for-bit (tie-breaking included); tests/test_datapack.py checks the
+// two implementations against each other on random inputs.
+//
+// Build: g++ -O2 -shared -fPIC -o _datapack.so datapack.cc
+
+#include <algorithm>
+#include <cstdint>
+#include <queue>
+#include <vector>
+
+extern "C" {
+
+// First-fit-decreasing bin packing (datapack.py ffd_allocate).
+// group_of[i] receives the CREATION-ORDER bin id of item i; the Python
+// wrapper applies the final normalization (sort bins by first item index,
+// keep empties only up to min_groups). Returns the number of bins, or
+// -(i+1) if item i exceeds capacity.
+int64_t ffd_group_of(const int64_t* sizes, int64_t n, int64_t capacity,
+                     int64_t min_groups, int32_t* group_of) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (sizes[i] > capacity) return -(i + 1);
+  }
+  std::vector<int64_t> order(n);
+  for (int64_t i = 0; i < n; ++i) order[i] = i;
+  std::sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+    if (sizes[a] != sizes[b]) return sizes[a] > sizes[b];
+    return a < b;
+  });
+  std::vector<int64_t> loads;
+  std::vector<char> nonempty;
+  loads.assign(static_cast<size_t>(min_groups), 0);
+  nonempty.assign(static_cast<size_t>(min_groups), 0);
+  for (int64_t oi = 0; oi < n; ++oi) {
+    const int64_t i = order[oi];
+    const int64_t sz = sizes[i];
+    bool placed = false;
+    for (size_t b = 0; b < loads.size(); ++b) {
+      if (loads[b] + sz <= capacity || !nonempty[b]) {
+        group_of[i] = static_cast<int32_t>(b);
+        loads[b] += sz;
+        nonempty[b] = 1;
+        placed = true;
+        break;
+      }
+    }
+    if (!placed) {
+      group_of[i] = static_cast<int32_t>(loads.size());
+      loads.push_back(sz);
+      nonempty.push_back(1);
+    }
+  }
+  return static_cast<int64_t>(loads.size());
+}
+
+// Greedy longest-processing-time partition (datapack.py
+// balanced_greedy_partition): sort desc (ties by index), assign to the
+// least-loaded group (ties by group id) — identical to Python's
+// heapq of (load, g) tuples.
+void lpt_group_of(const int64_t* sizes, int64_t n, int64_t k,
+                  int32_t* group_of) {
+  using Entry = std::pair<int64_t, int64_t>;  // (load, group)
+  std::priority_queue<Entry, std::vector<Entry>, std::greater<Entry>> heap;
+  for (int64_t g = 0; g < k; ++g) heap.emplace(0, g);
+  std::vector<int64_t> order(n);
+  for (int64_t i = 0; i < n; ++i) order[i] = i;
+  std::sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+    if (sizes[a] != sizes[b]) return sizes[a] > sizes[b];
+    return a < b;
+  });
+  for (int64_t oi = 0; oi < n; ++oi) {
+    const int64_t i = order[oi];
+    Entry e = heap.top();
+    heap.pop();
+    group_of[i] = static_cast<int32_t>(e.second);
+    heap.emplace(e.first + sizes[i], e.second);
+  }
+}
+
+// Contiguous minimal-max-sum partition DP (datapack.py
+// min_abs_diff_partition for the k < n case). cuts[0..k] receives the
+// span boundaries (cuts[0]=0, cuts[k]=n). Same recurrence and
+// tie-breaking (first minimal p) as the Python DP.
+void linear_partition_cuts(const int64_t* sizes, int64_t n, int64_t k,
+                           int64_t* cuts) {
+  std::vector<int64_t> prefix(static_cast<size_t>(n + 1), 0);
+  for (int64_t i = 0; i < n; ++i) prefix[i + 1] = prefix[i] + sizes[i];
+  const int64_t INF = INT64_MAX;
+  // dp[j][i], cut[j][i] flattened on (k+1) x (n+1)
+  std::vector<int64_t> dp(static_cast<size_t>((k + 1) * (n + 1)), INF);
+  std::vector<int64_t> cut(static_cast<size_t>((k + 1) * (n + 1)), 0);
+  auto at = [n](int64_t j, int64_t i) { return j * (n + 1) + i; };
+  dp[at(0, 0)] = 0;
+  for (int64_t j = 1; j <= k; ++j) {
+    for (int64_t i = j; i <= n; ++i) {
+      int64_t best = INF, bestp = 0;
+      for (int64_t p = j - 1; p < i; ++p) {
+        const int64_t prev = dp[at(j - 1, p)];
+        if (prev == INF) continue;
+        const int64_t span = prefix[i] - prefix[p];
+        const int64_t cand = prev > span ? prev : span;
+        if (cand < best) {
+          best = cand;
+          bestp = p;
+        }
+      }
+      dp[at(j, i)] = best;
+      cut[at(j, i)] = bestp;
+    }
+  }
+  int64_t i = n;
+  cuts[k] = n;
+  for (int64_t j = k; j >= 1; --j) {
+    const int64_t p = cut[at(j, i)];
+    cuts[j - 1] = p;
+    i = p;
+  }
+}
+
+}  // extern "C"

@@ -12,8 +12,26 @@ bucketed capacity so compiled shapes stay static (see utils/data.py).
 
 from __future__ import annotations
 
+import ctypes
 import heapq
 from typing import Sequence
+
+# items below this stay on the pure-Python path (the ctypes call + array
+# marshalling overhead beats C for tiny inputs)
+_NATIVE_MIN_N = 64
+
+
+def _native():
+    from areal_tpu.native import datapack_lib
+
+    return datapack_lib()
+
+
+def _groups_from_ids(group_of, n_groups: int) -> list[list[int]]:
+    groups: list[list[int]] = [[] for _ in range(n_groups)]
+    for i, g in enumerate(group_of):
+        groups[g].append(i)  # i ascending -> groups come out sorted
+    return groups
 
 
 def ffd_allocate(
@@ -28,7 +46,28 @@ def ffd_allocate(
     exceeds ``capacity`` (fail fast at packing time, like the reference,
     rather than blowing the downstream memory budget). Returns a list of
     index lists sorted by each bin's first item index for determinism.
+
+    Hot path (every microbatch build, utils/grid.py): large inputs run the
+    C++ kernel (native/datapack.cc), an exact port; this Python body is the
+    semantic reference and the fallback.
     """
+    n = len(sizes)
+    lib = _native() if n >= _NATIVE_MIN_N else None
+    if lib is not None:
+        arr = (ctypes.c_int64 * n)(*sizes)
+        out = (ctypes.c_int32 * n)()
+        rc = lib.ffd_group_of(arr, n, capacity, min_groups, out)
+        if rc < 0:
+            i = -int(rc) - 1
+            raise ValueError(
+                f"item {i} has size {sizes[i]} > microbatch capacity "
+                f"{capacity}; raise max_tokens_per_mb or truncate the sequence"
+            )
+        bins = _groups_from_ids(out, int(rc))
+        bins = [b for b in bins if b or len(bins) <= min_groups]
+        while len(bins) < min_groups:
+            bins.append([])
+        return sorted(bins, key=lambda b: (b[0] if b else n))
     for i, sz in enumerate(sizes):
         if sz > capacity:
             raise ValueError(
@@ -64,6 +103,13 @@ def balanced_greedy_partition(sizes: Sequence[int], k: int) -> list[list[int]]:
     lists (some possibly empty if len(sizes) < k), each sorted ascending.
     """
     assert k >= 1
+    n = len(sizes)
+    lib = _native() if n >= _NATIVE_MIN_N else None
+    if lib is not None:
+        arr = (ctypes.c_int64 * n)(*sizes)
+        out = (ctypes.c_int32 * n)()
+        lib.lpt_group_of(arr, n, k, out)
+        return _groups_from_ids(out, k)
     heap = [(0, g) for g in range(k)]
     heapq.heapify(heap)
     groups: list[list[int]] = [[] for _ in range(k)]
@@ -90,6 +136,14 @@ def min_abs_diff_partition(sizes: Sequence[int], k: int) -> list[tuple[int, int]
         spans = [(i, i + 1) for i in range(n)]
         spans += [(n, n)] * (k - n)
         return spans
+    # the O(k*n^2) DP is seconds of Python at rollout-batch n; the C port
+    # (same recurrence + tie-breaking) keeps it in the microseconds
+    lib = _native() if n >= _NATIVE_MIN_N else None
+    if lib is not None:
+        arr = (ctypes.c_int64 * n)(*sizes)
+        cuts = (ctypes.c_int64 * (k + 1))()
+        lib.linear_partition_cuts(arr, n, k, cuts)
+        return [(int(cuts[j]), int(cuts[j + 1])) for j in range(k)]
     prefix = [0] * (n + 1)
     for i, s in enumerate(sizes):
         prefix[i + 1] = prefix[i] + s

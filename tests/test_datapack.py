@@ -58,3 +58,47 @@ def test_min_abs_diff_partition_more_parts_than_items():
 def test_partition_balanced_indices():
     groups = partition_balanced([10, 10, 10, 10], 2)
     assert groups == [[0, 1], [2, 3]]
+
+
+def test_native_kernels_match_python_reference():
+    """The C++ kernels (areal_tpu/native/datapack.cc) are exact ports; on
+    random inputs above the native threshold they must agree with the pure
+    Python bodies bit-for-bit (ordering and tie-breaking included)."""
+    import areal_tpu.native as native
+    import areal_tpu.utils.datapack as dp
+
+    lib = native.datapack_lib()
+    assert lib is not None, "g++ is baked into the image; build must succeed"
+
+    rng = np.random.default_rng(7)
+
+    def python_only(fn, *args):
+        saved = dp._NATIVE_MIN_N
+        dp._NATIVE_MIN_N = 1 << 30  # force the Python path
+        try:
+            return fn(*args)
+        finally:
+            dp._NATIVE_MIN_N = saved
+
+    for trial in range(8):
+        n = int(rng.integers(dp._NATIVE_MIN_N, 400))
+        sizes = rng.integers(1, 1000, size=n).tolist()
+        cap = int(max(sizes) + rng.integers(0, 2000))
+        mg = int(rng.integers(1, 5))
+        assert dp.ffd_allocate(sizes, cap, mg) == python_only(
+            dp.ffd_allocate, sizes, cap, mg
+        ), ("ffd", trial)
+        k = int(rng.integers(1, 9))
+        assert dp.balanced_greedy_partition(sizes, k) == python_only(
+            dp.balanced_greedy_partition, sizes, k
+        ), ("lpt", trial)
+        assert dp.min_abs_diff_partition(sizes, k) == python_only(
+            dp.min_abs_diff_partition, sizes, k
+        ), ("linpart", trial)
+
+    # oversize raises identically through the native path
+    big = [5] * dp._NATIVE_MIN_N + [999]
+    import pytest
+
+    with pytest.raises(ValueError):
+        dp.ffd_allocate(big, capacity=100)
