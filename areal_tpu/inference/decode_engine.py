@@ -1632,8 +1632,10 @@ class DecodeEngine:
             if c:
                 if task.first_token_time is None:
                     task.first_token_time = now
-                task.out_tokens.extend(int(t) for t in toks[:c, slot])
-                task.out_logprobs.extend(float(x) for x in logps[:c, slot])
+                # .tolist() converts in C — a genexpr of int()/float() costs
+                # ~S*n_steps Python calls per chunk on the serving hot loop
+                task.out_tokens.extend(toks[:c, slot].tolist())
+                task.out_logprobs.extend(logps[:c, slot].tolist())
                 task.out_versions.extend([version] * c)
                 self.stats["generated_tokens"] += c
             st["pos"][slot] = int(pos[slot])

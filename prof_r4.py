@@ -1,0 +1,88 @@
+"""Round-4 on-chip experiments (run the moment the TPU tunnel is back):
+
+    python prof_r4.py wu       # weight-update pause windows @1.5B: full
+                               # bucketed stream vs LoRA-delta fast path
+    python prof_r4.py async    # async-vs-sync GRPO speedup knob sweep
+                               # (eta x prompts-per-step), 0.5B colocated
+
+prof_r3.py still covers the decode component split and train sweeps.
+All timing uses host scalar pulls — jax.block_until_ready does NOT
+synchronize on the axon backend (verify skill gotcha).
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def phase_wu():
+    import jax
+
+    from areal_tpu.api.config import InferenceEngineConfig, MeshConfig, ServerConfig
+    from areal_tpu.api.io_struct import WeightUpdateMeta
+    from areal_tpu.inference.client import RemoteJaxEngine
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.inference.server import ServerThread
+    from areal_tpu.models import qwen
+
+    from bench import MODEL_KW  # Qwen2.5-1.5B dims
+
+    cfg = qwen.ModelConfig(**MODEL_KW)
+    params = jax.jit(lambda k: qwen.init_params(k, cfg))(jax.random.PRNGKey(0))
+    params_host = jax.tree.map(np.asarray, params)
+    scfg = ServerConfig(
+        max_batch_size=32,
+        max_seq_len=512,
+        decode_steps_per_call=16,
+        seed=0,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+    )
+    dec = DecodeEngine(scfg, params=params, model_cfg=cfg)
+    dec.initialize()
+    server = ServerThread(scfg, dec)
+    server.start()
+    client = RemoteJaxEngine(
+        InferenceEngineConfig(
+            max_concurrent_rollouts=4, consumer_batch_size=1, request_timeout=600
+        ),
+        addresses=[server.address],
+    )
+    client.initialize()
+    print("== weight-update pause windows @1.5B (3 reps each) ==", flush=True)
+    for rep in range(3):
+        client.update_weights(WeightUpdateMeta(type="mem"), params=params_host)
+        print(f"full mem stream rep{rep}: {client.last_pause_secs:8.3f}s", flush=True)
+    # LoRA-delta: synthesize rank-32 adapters on the 1.5B tree
+    rng = np.random.default_rng(0)
+    lora = {}
+    for t in ("wq", "wk", "wv", "wo"):
+        L, d_in, d_out = np.asarray(params_host["layers"][t]).shape
+        lora[f"layers/{t}_lora_a"] = rng.normal(0, 0.01, (L, d_in, 32)).astype(
+            np.float32
+        )
+        lora[f"layers/{t}_lora_b"] = np.zeros((L, 32, d_out), np.float32)
+    meta = WeightUpdateMeta(type="mem", lora_only=True, lora_scale=0.5)
+    for rep in range(3):
+        client.update_weights(meta, params=lora)
+        print(f"lora delta rep{rep}:      {client.last_pause_secs:8.3f}s", flush=True)
+    nbytes = sum(a.nbytes for a in lora.values())
+    print(f"lora payload {nbytes/1e6:.1f} MB (bf16 wire: {nbytes/2e6:.1f} MB) "
+          f"vs full tree {sum(np.asarray(x).nbytes for x in jax.tree.leaves(params_host))/1e9:.2f} GB",
+          flush=True)
+    client.destroy()
+    server.stop()
+
+
+def phase_async():
+    os.environ.pop("BENCH_SMOKE", None)
+    import bench
+
+    # knob sweep by monkeypatching the phase constants via env would need
+    # refactoring; run the standard phase (eta 0 vs 2) as shipped first
+    bench.phase_async_sync()
+
+
+if __name__ == "__main__":
+    {"wu": phase_wu, "async": phase_async}[sys.argv[1]]()
