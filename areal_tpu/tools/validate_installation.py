@@ -68,6 +68,17 @@ def main(argv=None) -> int:
 
     _check("contracts", engine_contract, results)
 
+    def native_kernels():
+        from areal_tpu.native import datapack_lib
+        from areal_tpu.utils.datapack import ffd_allocate
+
+        lib = datapack_lib()
+        bins = ffd_allocate(list(range(1, 200)), capacity=512)
+        assert sorted(i for b in bins for i in b) == list(range(199))
+        return "C++ datapack" if lib is not None else "python fallback (no g++?)"
+
+    _check("native", native_kernels, results)
+
     width = max(len(n) for n, _, _ in results)
     ok = True
     for name, passed, detail in results:

@@ -1,0 +1,169 @@
+"""Example: an Anthropic-SDK-style agent trained through the gateway's
+``/v1/messages`` Messages API shim (reference workflow/anthropic/
+math_agent.py role; openai/proxy/rollout_server.py implements the shim).
+
+Runnable in-image (no anthropic SDK needed — the wire protocol is plain
+JSON; anthropic.AsyncAnthropic(base_url=gateway, api_key=session_key)
+drives the identical endpoints, see workflow/sdk/anthropic_agent.py):
+
+    python examples/agentic/anthropic_messages_agent.py
+
+Spins a proxy + gateway over a scripted engine, runs one tool-loop episode
+through /v1/messages (tool_use -> local tool -> tool_result -> final
+answer), posts a reward, and exports the recorded trajectory.
+"""
+
+import asyncio
+import json
+
+
+async def main():
+    from aiohttp import ClientSession
+    from aiohttp.test_utils import TestServer
+
+    from areal_tpu.api.io_struct import ModelRequest, ModelResponse
+    from areal_tpu.openai.proxy.gateway import GatewayState, create_gateway_app
+    from areal_tpu.openai.proxy.rollout_server import ProxyState, create_proxy_app
+
+    class CharTokenizer:
+        eos_token_id = 0
+        pad_token_id = 0
+
+        def apply_chat_template(self, messages, tools=None, add_generation_prompt=True, tokenize=True, **kw):
+            text = "".join(
+                f"<{m['role']}>{m.get('content') or ''}" for m in messages
+            )
+            return [ord(c) % 250 + 1 for c in text]
+
+        def encode(self, text):
+            return [ord(c) % 250 + 1 for c in text]
+
+        def decode(self, ids):
+            return "".join(chr(96 + (i % 26)) for i in ids)
+
+    class ScriptedEngine:
+        """Turn 1 emits a qwen-format tool call; turn 2 a final answer.
+        Emitted texts queue on ``self.emitted`` so the proxy-side decode
+        replay below returns exactly what the engine produced (the toy
+        tokenizer cannot round-trip; a real run uses the HF tokenizer)."""
+
+        SCRIPT = [
+            '<tool_call>\n{"name": "calc", "arguments": '
+            '{"expression": "12*(3+4)"}}\n</tool_call>',
+            "the answer is 84",
+        ]
+
+        def __init__(self, tokenizer):
+            self.tok = tokenizer
+            self.turn = 0
+            self.emitted: list[str] = []
+
+        async def agenerate(self, req: ModelRequest) -> ModelResponse:
+            text = self.SCRIPT[min(self.turn, len(self.SCRIPT) - 1)]
+            self.turn += 1
+            self.emitted.append(text)
+            out = self.tok.encode(text)
+            return ModelResponse(
+                input_tokens=list(req.input_ids),
+                output_tokens=out,
+                output_logprobs=[-0.1] * len(out),
+                output_versions=[0] * len(out),
+                stop_reason="stop",
+                rid=req.rid,
+            )
+
+    tok = CharTokenizer()
+    eng = ScriptedEngine(tok)
+    real_decode = tok.decode
+    tok.decode = lambda ids: (
+        eng.emitted.pop(0) if eng.emitted else real_decode(ids)
+    )
+
+    state = ProxyState(eng, tok, admin_api_key="admin", capacity=1)
+    proxy = TestServer(create_proxy_app(state))
+    await proxy.start_server()
+    gw_state = GatewayState([f"http://127.0.0.1:{proxy.port}"], admin_api_key="admin")
+    gateway = TestServer(create_gateway_app(gw_state))
+    await gateway.start_server()
+    gw = f"http://127.0.0.1:{gateway.port}"
+
+    def calc(expression: str) -> str:
+        allowed = set("0123456789+-*/(). ")
+        assert set(expression) <= allowed and "**" not in expression
+        return str(eval(expression, {"__builtins__": {}}, {}))  # noqa: S307
+
+    async with ClientSession() as http:
+        admin = {"Authorization": "Bearer admin"}
+        async with http.post(
+            f"{gw}/rl/start_session", json={"task_id": "math-84"}, headers=admin
+        ) as r:
+            sess = await r.json()
+        hdr = {"x-api-key": sess["api_key"]}  # anthropic-SDK auth style
+
+        messages = [{"role": "user", "content": "What is 12*(3+4)? Use the tool."}]
+        tools = [
+            {
+                "name": "calc",
+                "description": "Evaluate arithmetic.",
+                "input_schema": {
+                    "type": "object",
+                    "properties": {"expression": {"type": "string"}},
+                },
+            }
+        ]
+        for _turn in range(4):
+            async with http.post(
+                f"{gw}/v1/messages",
+                json={
+                    "model": "default",
+                    "messages": messages,
+                    "tools": tools,
+                    "max_tokens": 128,
+                },
+                headers=hdr,
+            ) as r:
+                assert r.status == 200, await r.text()
+                msg = await r.json()
+            messages.append({"role": "assistant", "content": msg["content"]})
+            tool_uses = [b for b in msg["content"] if b["type"] == "tool_use"]
+            if not tool_uses:
+                break
+            results = [
+                {
+                    "type": "tool_result",
+                    "tool_use_id": b["id"],
+                    "content": calc(b["input"]["expression"]),
+                }
+                for b in tool_uses
+            ]
+            messages.append({"role": "user", "content": results})
+
+        final = "".join(
+            b["text"] for b in msg["content"] if b["type"] == "text"
+        )
+        print("agent final answer:", final)
+        reward = 1.0 if "84" in final else 0.0
+        async with http.post(
+            f"{gw}/rl/set_reward", json={"reward": reward}, headers=hdr
+        ):
+            pass
+        async with http.post(f"{gw}/rl/end_session", json={}, headers=hdr):
+            pass
+        async with http.post(
+            f"http://127.0.0.1:{proxy.port}/export_trajectories",
+            json={"session_id": sess["session_id"]},
+            headers=admin,
+        ) as r:
+            traj = await r.json()
+        n = len(traj["interactions"])
+        rewards = [i["reward"] for i in traj["interactions"].values()]
+        print(f"exported {n} interactions, rewards={rewards}")
+        assert reward == 1.0 and n == 2, (reward, n)
+        print("OK")
+
+    await gateway.close()
+    await proxy.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -51,10 +51,9 @@ def phase_wu():
     )
     client.initialize()
     print("== weight-update pause windows @1.5B (3 reps each) ==", flush=True)
-    for rep in range(3):
-        client.update_weights(WeightUpdateMeta(type="mem"), params=params_host)
-        print(f"full mem stream rep{rep}: {client.last_pause_secs:8.3f}s", flush=True)
-    # LoRA-delta: synthesize rank-32 adapters on the 1.5B tree
+    # LoRA reps must run FIRST: any full update invalidates the server's
+    # delta-fold base (decode_engine._apply_weight_update) and subsequent
+    # lora_only pushes are rejected by design
     rng = np.random.default_rng(0)
     lora = {}
     for t in ("wq", "wk", "wv", "wo"):
@@ -67,6 +66,9 @@ def phase_wu():
     for rep in range(3):
         client.update_weights(meta, params=lora)
         print(f"lora delta rep{rep}:      {client.last_pause_secs:8.3f}s", flush=True)
+    for rep in range(3):
+        client.update_weights(WeightUpdateMeta(type="mem"), params=params_host)
+        print(f"full mem stream rep{rep}: {client.last_pause_secs:8.3f}s", flush=True)
     nbytes = sum(a.nbytes for a in lora.values())
     print(f"lora payload {nbytes/1e6:.1f} MB (bf16 wire: {nbytes/2e6:.1f} MB) "
           f"vs full tree {sum(np.asarray(x).nbytes for x in jax.tree.leaves(params_host))/1e9:.2f} GB",
