@@ -406,6 +406,178 @@ class _Chat:
         self.completions = AsyncChatCompletions(owner)
 
 
+class AsyncResponses:
+    """OpenAI Responses API surface (`client.responses.create`), composed
+    onto the chat-completions path so budget/cache/tool/eviction logic is
+    shared (reference AsyncResponsesWithReward,
+    experimental/openai/client.py:694-1030, re-derived: the reference
+    duplicates the whole request pipeline; here Responses IS a translation
+    layer). ``set_reward(response.id)`` works unchanged — the response id
+    is the cached interaction id."""
+
+    def __init__(self, owner: "ArealOpenAI"):
+        self._o = owner
+
+    @staticmethod
+    def _input_to_messages(input) -> list[dict]:
+        """Responses input (str | item list) -> chat messages. Items:
+        role/content (content str, or input_text/output_text block lists),
+        prior function_call items (-> assistant tool_calls), and
+        function_call_output (-> role=tool) for agent tool loops."""
+        if isinstance(input, str):
+            return [{"role": "user", "content": input}]
+        messages: list[dict] = []
+        pending_calls: list[dict] = []
+
+        def flush_calls() -> None:
+            # consecutive function_call items are ONE assistant turn with a
+            # tool_calls list — splitting them would render assistant turns
+            # the model never generated and break concat-mode prefix
+            # matching against the cached parent record
+            if pending_calls:
+                messages.append(
+                    {
+                        "role": "assistant",
+                        "content": None,
+                        "tool_calls": list(pending_calls),
+                    }
+                )
+                pending_calls.clear()
+
+        for item in input:
+            if not isinstance(item, dict):
+                raise ValueError(
+                    f"Responses input items must be dicts, got {type(item).__name__}"
+                )
+            t = item.get("type")
+            if t == "function_call":
+                pending_calls.append(
+                    {
+                        "id": item.get("call_id", item.get("id", "")),
+                        "type": "function",
+                        "function": {
+                            "name": item.get("name", ""),
+                            "arguments": item.get("arguments", "{}"),
+                        },
+                    }
+                )
+                continue
+            flush_calls()
+            if t == "function_call_output":
+                messages.append(
+                    {
+                        "role": "tool",
+                        "tool_call_id": item.get("call_id", ""),
+                        "content": item.get("output", ""),
+                    }
+                )
+                continue
+            if "content" not in item and "role" not in item:
+                raise ValueError(f"unsupported Responses input item: {item!r}")
+            content = item.get("content")
+            if isinstance(content, list):
+                content = "".join(
+                    c.get("text", "")
+                    for c in content
+                    if isinstance(c, dict)
+                    and c.get("type") in ("input_text", "output_text", "text")
+                )
+            messages.append({"role": item.get("role", "user"), "content": content})
+        flush_calls()
+        return messages
+
+    async def create(
+        self,
+        *,
+        input,
+        instructions: str | None = None,
+        max_output_tokens: int | None = None,
+        temperature: float | None = None,
+        top_p: float | None = None,
+        tools: list[dict] | None = None,
+        tool_choice: str | None = None,
+        store: bool = True,
+        metadata: dict | None = None,
+        previous_response_id: str | None = None,
+        **unsupported: Any,
+    ):
+        from areal_tpu.openai.types import OAIResponse, ResponseOutputItem, _new_id
+
+        if previous_response_id is not None:
+            # server-side conversation state: silently ignoring it would
+            # generate WITHOUT the prior context and record a wrong
+            # trajectory — fail loudly (the proxy maps this to HTTP 400);
+            # agents should resend the history as input items instead
+            raise NotImplementedError(
+                "previous_response_id is not supported; resend the prior "
+                "turns as Responses input items"
+            )
+        for k in unsupported:
+            _warn_once(f"responses.{k}")
+        messages: list[dict] = []
+        if instructions:
+            messages.append({"role": "system", "content": instructions})
+        messages += self._input_to_messages(input)
+        chat_tools = None
+        if tools:
+            # Responses flat tool format -> chat function format
+            chat_tools = [
+                {
+                    "type": "function",
+                    "function": {
+                        "name": t.get("name", ""),
+                        "description": t.get("description", ""),
+                        "parameters": t.get("parameters", {}),
+                    },
+                }
+                if "function" not in t
+                else t
+                for t in tools
+            ]
+        completion = await self._o.chat.completions.create(
+            messages=messages,
+            tools=chat_tools,
+            tool_choice=tool_choice,
+            temperature=temperature,
+            top_p=top_p,
+            max_completion_tokens=max_output_tokens,
+            store=store,
+            metadata=metadata,
+        )
+        choice = completion.choices[0]
+        output: list[ResponseOutputItem] = []
+        if choice.message.tool_calls:
+            for tc in choice.message.tool_calls:
+                output.append(
+                    ResponseOutputItem(
+                        type="function_call",
+                        id=_new_id("fc"),
+                        call_id=tc.id,
+                        name=tc.function.name,
+                        arguments=tc.function.arguments,
+                    )
+                )
+        if choice.message.content or not output:
+            output.insert(
+                0,
+                ResponseOutputItem(
+                    type="message",
+                    id=_new_id("msg"),
+                    text=choice.message.content or "",
+                ),
+            )
+        return OAIResponse(
+            id=completion.id,  # the interaction id: set_reward(resp.id) works
+            model=self._o.model_name,
+            instructions=instructions,
+            output=output,
+            usage=completion.usage,
+            status=(
+                "incomplete" if choice.finish_reason == "length" else "completed"
+            ),
+        )
+
+
 class ArealOpenAI:
     """Drop-in replacement for an AsyncOpenAI client bound to the RL engine."""
 
@@ -428,6 +600,7 @@ class ArealOpenAI:
         self.model_name = model_name
         self._cache = InteractionCache()
         self.chat = _Chat(self)
+        self.responses = AsyncResponses(self)
 
     # -- reward / export surface (reference client.py:1084-1163) ----------
     def get_interaction(self, id: str) -> Interaction | None:

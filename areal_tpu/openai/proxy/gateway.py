@@ -27,6 +27,7 @@ logger = alog.getLogger("proxy_gateway")
 
 FORWARDED_PATHS = (
     "/v1/chat/completions",
+    "/v1/responses",  # OpenAI Responses API (openai-agents-SDK agents)
     "/v1/messages",  # Anthropic Messages API shim (anthropic-SDK agents)
     "/rl/set_reward",
     "/rl/end_session",

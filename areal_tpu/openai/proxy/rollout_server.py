@@ -220,6 +220,23 @@ def create_proxy_app(state: ProxyState) -> web.Application:
             return resp
         return web.json_response(result.to_dict())
 
+    async def responses_api(request: web.Request):
+        """OpenAI Responses API (`/v1/responses`) — openai-agents-SDK style
+        agents speak this instead of chat.completions."""
+        sess = require_session(request)
+        body = await request.json()
+        body.pop("model", None)
+        if body.get("stream"):
+            raise web.HTTPBadRequest(
+                text="stream is not supported on /v1/responses yet; "
+                "use /v1/chat/completions for streaming"
+            )
+        try:
+            resp = await sess.client.responses.create(**body)
+        except (ValueError, NotImplementedError, TypeError) as e:
+            raise web.HTTPBadRequest(text=str(e))
+        return web.json_response(resp.to_dict())
+
     async def anthropic_messages(request: web.Request):
         """Anthropic Messages API shim (reference workflow/anthropic/
         math_agent.py points anthropic.AsyncAnthropic at the proxy): the
@@ -503,6 +520,7 @@ def create_proxy_app(state: ProxyState) -> web.Application:
     app.router.add_post("/rl/end_session", end_session)
     app.router.add_post("/rl/set_reward", set_reward)
     app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/v1/responses", responses_api)
     app.router.add_post("/v1/messages", anthropic_messages)
     app.router.add_post("/export_trajectories", export_trajectories)
     app.router.add_post("/grant_capacity", grant_capacity)

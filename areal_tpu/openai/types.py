@@ -114,6 +114,90 @@ class ChatCompletion:
 
 
 @dataclasses.dataclass
+class ResponseOutputItem:
+    """One Responses-API output item: an assistant ``message`` carrying
+    ``output_text`` content, or a flat ``function_call``."""
+
+    type: str  # "message" | "function_call"
+    id: str = ""
+    # message fields
+    role: str = "assistant"
+    text: str | None = None
+    # function_call fields
+    call_id: str = ""
+    name: str = ""
+    arguments: str = ""
+
+    def to_dict(self) -> dict:
+        if self.type == "message":
+            return {
+                "type": "message",
+                "id": self.id,
+                "role": self.role,
+                "status": "completed",
+                "content": [
+                    {
+                        "type": "output_text",
+                        "text": self.text or "",
+                        "annotations": [],
+                    }
+                ],
+            }
+        return {
+            "type": "function_call",
+            "id": self.id,
+            "call_id": self.call_id,
+            "name": self.name,
+            "arguments": self.arguments,
+            "status": "completed",
+        }
+
+
+@dataclasses.dataclass
+class OAIResponse:
+    """The `/v1/responses` response object (OpenAI Responses API; the
+    reference builds these through the openai SDK's pydantic models,
+    experimental/openai/client.py:694-1030)."""
+
+    id: str = dataclasses.field(default_factory=lambda: _new_id("resp"))
+    created_at: float = dataclasses.field(default_factory=lambda: float(int(time.time())))
+    model: str = "areal-tpu"
+    instructions: str | None = None
+    output: list[ResponseOutputItem] = dataclasses.field(default_factory=list)
+    usage: Usage = dataclasses.field(default_factory=Usage)
+    status: str = "completed"
+
+    @property
+    def output_text(self) -> str:
+        """SDK convenience: concatenated text of all message outputs."""
+        return "".join(o.text or "" for o in self.output if o.type == "message")
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "object": "response",
+            "created_at": self.created_at,
+            "status": self.status,
+            "model": self.model,
+            "instructions": self.instructions,
+            "output": [o.to_dict() for o in self.output],
+            "parallel_tool_calls": False,
+            "usage": {
+                "input_tokens": self.usage.prompt_tokens,
+                # the openai-agents SDK aggregates these sub-objects; None
+                # there crashes its usage accounting
+                "input_tokens_details": {"cached_tokens": 0},
+                "output_tokens": self.usage.completion_tokens,
+                "output_tokens_details": {"reasoning_tokens": 0},
+                "total_tokens": self.usage.prompt_tokens
+                + self.usage.completion_tokens,
+            },
+            "error": None,
+            "incomplete_details": None,
+        }
+
+
+@dataclasses.dataclass
 class ChoiceDelta:
     """Incremental piece of a streamed assistant message."""
 

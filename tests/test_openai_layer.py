@@ -583,3 +583,119 @@ async def _anthropic_messages_flow():
 
 def test_anthropic_messages_shim(loop):
     loop.run_until_complete(_anthropic_messages_flow())
+
+
+def test_responses_api(loop):
+    """OpenAI Responses API surface (reference AsyncResponsesWithReward,
+    client.py:694-1030): string + item-list input, instructions, tool
+    loops via function_call / function_call_output items, reward by
+    response id, and the same interaction cache as chat.completions."""
+
+    class ToolEngine(EchoEngine):
+        def __init__(self):
+            super().__init__()
+            self.script = [
+                '<tool_call>\n{"name": "calc", "arguments": {"e": "1+1"}}\n</tool_call>',
+                "two",
+            ]
+            self.texts = []
+
+        async def agenerate(self, req):
+            resp = await super().agenerate(req)
+            self.texts.append(self.script[min(len(self.requests) - 1, 1)])
+            return resp
+
+    eng = ToolEngine()
+    tok = FakeTokenizer()
+    real_decode = tok.decode
+    tok.decode = lambda ids: eng.texts.pop(0) if eng.texts else real_decode(ids)
+    client = ArealOpenAI(eng, tok)
+
+    tools = [
+        {
+            "type": "function",
+            "name": "calc",
+            "description": "adds",
+            "parameters": {"type": "object"},
+        }
+    ]
+    r1 = loop.run_until_complete(
+        client.responses.create(
+            input="what is 1+1?",
+            instructions="use the tool",
+            tools=tools,
+            max_output_tokens=16,
+        )
+    )
+    assert r1.to_dict()["object"] == "response"
+    fcs = [o for o in r1.output if o.type == "function_call"]
+    assert len(fcs) == 1 and fcs[0].name == "calc"
+    # agent executes the tool and feeds the Responses-style items back
+    r2 = loop.run_until_complete(
+        client.responses.create(
+            input=[
+                {"role": "user", "content": "what is 1+1?"},
+                {
+                    "type": "function_call",
+                    "call_id": fcs[0].call_id,
+                    "name": "calc",
+                    "arguments": fcs[0].arguments,
+                },
+                {
+                    "type": "function_call_output",
+                    "call_id": fcs[0].call_id,
+                    "output": "2",
+                },
+            ],
+            max_output_tokens=16,
+        )
+    )
+    assert r2.output_text == "two"
+    assert r2.usage.completion_tokens == 5
+    # the tool output reached the model through the chat template
+    expected = "<user>what is 1+1?<assistant><tool>2<assistant>"
+    assert eng.requests[-1].input_ids == [ord(c) % 250 + 1 for c in expected]
+    # reward by response id rides the shared interaction cache
+    client.set_reward(r2.id, 1.0)
+    inters = client.export_interactions()
+    assert inters[r2.id].reward == 1.0
+    assert len(inters) == 2
+
+
+async def _responses_proxy_flow():
+    from aiohttp import ClientSession
+    from aiohttp.test_utils import TestServer
+
+    from areal_tpu.openai.proxy.gateway import GatewayState, create_gateway_app
+    from areal_tpu.openai.proxy.rollout_server import ProxyState, create_proxy_app
+
+    state = ProxyState(EchoEngine(), FakeTokenizer(), admin_api_key="adm", capacity=1)
+    proxy = TestServer(create_proxy_app(state))
+    await proxy.start_server()
+    gw_state = GatewayState([f"http://127.0.0.1:{proxy.port}"], admin_api_key="adm")
+    gateway = TestServer(create_gateway_app(gw_state))
+    await gateway.start_server()
+    gw = f"http://127.0.0.1:{gateway.port}"
+    async with ClientSession() as http:
+        async with http.post(
+            f"{gw}/rl/start_session",
+            json={"task_id": "r1"},
+            headers={"Authorization": "Bearer adm"},
+        ) as r:
+            sess = await r.json()
+        async with http.post(
+            f"{gw}/v1/responses",
+            json={"model": "x", "input": "hi", "max_output_tokens": 8},
+            headers={"Authorization": f"Bearer {sess['api_key']}"},
+        ) as r:
+            assert r.status == 200, await r.text()
+            d = await r.json()
+    assert d["object"] == "response"
+    assert d["output"][0]["type"] == "message"
+    assert d["output"][0]["content"][0]["type"] == "output_text"
+    await gateway.close()
+    await proxy.close()
+
+
+def test_responses_api_through_gateway(loop):
+    loop.run_until_complete(_responses_proxy_flow())
