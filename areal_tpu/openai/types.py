@@ -193,7 +193,11 @@ class OAIResponse:
                 + self.usage.completion_tokens,
             },
             "error": None,
-            "incomplete_details": None,
+            "incomplete_details": (
+                {"reason": "max_output_tokens"}
+                if self.status == "incomplete"
+                else None
+            ),
         }
 
 
