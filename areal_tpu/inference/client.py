@@ -60,6 +60,14 @@ async def _close_sessions() -> None:
             await sess.close()
 
 
+async def close_loop_sessions() -> None:
+    """Public: close THIS event loop's cached ClientSessions. Scripts that
+    drive ``agenerate`` inside their own ``asyncio.run`` must call this
+    before the loop exits, or its connector leaks ('Unclosed client
+    session' warnings) — destroy() only reaches the executor loop's cache."""
+    await _close_sessions()
+
+
 class RemoteJaxEngine(InferenceEngine):
     """Client handle to a fleet of areal_tpu.inference.server instances."""
 

@@ -229,10 +229,12 @@ class DecodeEngine:
                 cfg.model_path, self.model_cfg, put=put
             )
             if self.model_cfg.vision is not None and "vision" not in self.params:
-                # HF tower name mapping pending (models/vision.py); serve a
-                # from-scratch tower rather than KeyError on the first image
+                # checkpoint shipped no visual.* weights (models/hf.py loads
+                # them when present); serve a from-scratch tower rather than
+                # KeyError on the first image
                 logger.warning(
-                    "VLM serving: vision tower initializes from scratch"
+                    "VLM serving: checkpoint has no visual.* weights; vision "
+                    "tower initializes from scratch"
                 )
                 from areal_tpu.models.vision import (
                     init_vision_params,

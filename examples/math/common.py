@@ -1,0 +1,43 @@
+"""Shared helpers for the math example entries (gsm8k_rl / gsm8k_sft /
+gsm8k_eval) — one copy so tokenizer loading, reward selection, and the
+single-host server spin-up cannot drift between entries."""
+
+from __future__ import annotations
+
+from areal_tpu.reward.gsm8k import gsm8k_reward_fn
+
+
+def load_tokenizer(path: str):
+    """Forgiving tokenizer load: weights-only smoke dirs have no tokenizer
+    files; entries fall back to char-level/prompt_ids rows."""
+    if not path:
+        return None
+    try:
+        from transformers import AutoTokenizer
+
+        return AutoTokenizer.from_pretrained(path)
+    except Exception as e:  # noqa: BLE001
+        print(f"warning: no tokenizer at {path} ({e}); continuing without one")
+        return None
+
+
+def reward_for(dataset_type: str):
+    if dataset_type == "synthetic_arith":
+        from areal_tpu.reward.synthetic import arith_char_reward_fn
+
+        return arith_char_reward_fn
+    return gsm8k_reward_fn
+
+
+def start_local_server(server_cfg, params=None, model_cfg=None):
+    """Single-host mode: in-process DecodeEngine + HTTP server on this
+    host's chips. With ``params`` the server shares the caller's weights
+    (zero-copy mem updates); otherwise it loads ``server_cfg.model_path``."""
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.inference.server import ServerThread
+
+    engine = DecodeEngine(server_cfg, params=params, model_cfg=model_cfg)
+    engine.initialize()
+    server = ServerThread(server_cfg, engine)
+    server.start()
+    return server

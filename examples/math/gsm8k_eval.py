@@ -1,0 +1,117 @@
+"""GSM8K evaluation entry (parity: reference examples/math/gsm8k_eval.py):
+greedy-decode the test split against a serving fleet (or an in-process
+server spun from a checkpoint) and report mean reward / accuracy.
+
+Usage:
+    # against running servers
+    AREAL_TPU_SERVER_ADDRS=10.0.0.1:9000 python examples/math/gsm8k_eval.py \
+        --config examples/math/gsm8k_grpo.yaml valid_dataset.path=/data/gsm8k
+    # single-host: spin a server from the actor checkpoint
+    python examples/math/gsm8k_eval.py --config examples/math/gsm8k_grpo.yaml \
+        actor.path=/ckpt/Qwen2.5-1.5B valid_dataset.path=/data/gsm8k
+"""
+
+import asyncio
+import os
+import sys
+
+import numpy as np
+
+from areal_tpu.api.config import GRPOConfig, load_expr_config
+from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+from areal_tpu.dataset import get_custom_dataset
+from areal_tpu.inference.client import RemoteJaxEngine
+from areal_tpu.workflow.rlvr import prompt_ids_of
+from common import load_tokenizer, reward_for, start_local_server
+
+CONCURRENCY = 64
+
+
+def main(argv):
+    config, _ = load_expr_config(argv, GRPOConfig)
+    tokenizer = load_tokenizer(config.tokenizer_path or config.actor.path)
+
+    ds_cfg = config.valid_dataset or config.train_dataset
+    ds_type = ds_cfg.type or "gsm8k"
+    dataset = get_custom_dataset(
+        ds_type, split="test", path=ds_cfg.path or config.train_dataset.path
+    )
+    reward_fn = reward_for(ds_type)
+
+    server = None
+    addrs = [a for a in os.environ.get("AREAL_TPU_SERVER_ADDRS", "").split(",") if a]
+    if not addrs:
+        scfg = config.server
+        scfg.model_path = scfg.model_path or config.actor.path
+        server = start_local_server(scfg)
+        addrs = [server.address]
+
+    rollout = RemoteJaxEngine(config.rollout, addresses=addrs)
+    rollout.initialize()
+    gcfg = GenerationHyperparameters(
+        n_samples=1,
+        max_new_tokens=config.gconfig.max_new_tokens,
+        greedy=True,
+    )
+
+    async def run() -> list:
+        sem = asyncio.Semaphore(CONCURRENCY)
+
+        async def one(row: dict) -> float:
+            prompt_ids = prompt_ids_of(row, tokenizer, False)
+            async with sem:
+                resp = await rollout.agenerate(
+                    ModelRequest(input_ids=prompt_ids, gconfig=gcfg)
+                )
+            completion = (
+                tokenizer.decode(resp.output_tokens) if tokenizer else ""
+            )
+            prompt = tokenizer.decode(prompt_ids) if tokenizer else ""
+            return float(
+                reward_fn(
+                    prompt,
+                    completion,
+                    prompt_ids,
+                    resp.output_tokens,
+                    **{
+                        k: v
+                        for k, v in row.items()
+                        if k not in ("prompt_ids", "messages", "prompt")
+                    },
+                )
+            )
+
+        # one failed row must not discard 1000 finished scores
+        out = await asyncio.gather(
+            *(one(r) for r in dataset), return_exceptions=True
+        )
+        from areal_tpu.inference.client import close_loop_sessions
+
+        await close_loop_sessions()
+        return out
+
+    try:
+        results = asyncio.run(run())
+    finally:
+        rollout.destroy()
+        if server is not None:
+            server.stop()
+    rewards = np.asarray(
+        [r for r in results if not isinstance(r, BaseException)], np.float64
+    )
+    n_failed = len(results) - len(rewards)
+    if n_failed:
+        first = next(r for r in results if isinstance(r, BaseException))
+        print(f"warning: {n_failed}/{len(results)} rows failed (first: {first!r})")
+    if not len(rewards):
+        print("no rows scored")
+        return 0.0
+    print(
+        f"n={len(rewards)} mean_reward={rewards.mean():.4f} "
+        f"accuracy={(rewards > 0).mean():.4f}"
+    )
+    return float(rewards.mean())
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -22,43 +22,18 @@ import numpy as np
 from areal_tpu.api.config import GRPOConfig, load_expr_config
 from areal_tpu.dataset import get_custom_dataset
 from areal_tpu.inference.client import RemoteJaxEngine
-from areal_tpu.reward.gsm8k import gsm8k_reward_fn
 from areal_tpu.trainer import PPOTrainer
 from areal_tpu.workflow.rlvr import RLVRWorkflow
 
 
-def load_tokenizer(path: str):
-    if not path:
-        return None  # prompt_ids-style datasets need no tokenizer
-    try:
-        from transformers import AutoTokenizer
-
-        return AutoTokenizer.from_pretrained(path)
-    except Exception as e:  # noqa: BLE001 — e.g. weights-only smoke model dir
-        print(f"warning: no tokenizer at {path} ({e}); continuing without one")
-        return None
+from common import load_tokenizer, reward_for, start_local_server
 
 
 def maybe_start_local_server(config: GRPOConfig, trainer_params=None, model_cfg=None):
     """Single-host mode: in-process server on this host's chips."""
-    from areal_tpu.inference.decode_engine import DecodeEngine
-    from areal_tpu.inference.server import ServerThread
-
     scfg = config.server
     scfg.model_path = scfg.model_path or config.actor.path
-    engine = DecodeEngine(scfg, params=trainer_params, model_cfg=model_cfg)
-    engine.initialize()
-    server = ServerThread(scfg, engine)
-    server.start()
-    return server
-
-
-def reward_for(dataset_type: str):
-    if dataset_type == "synthetic_arith":
-        from areal_tpu.reward.synthetic import arith_char_reward_fn
-
-        return arith_char_reward_fn
-    return gsm8k_reward_fn
+    return start_local_server(scfg, params=trainer_params, model_cfg=model_cfg)
 
 
 def main(argv):

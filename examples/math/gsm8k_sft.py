@@ -52,6 +52,10 @@ def tokenize_sft_rows(dataset, tokenizer, max_len: int | None = None) -> list[di
         )
         if tokenizer.eos_token_id is not None:
             answer_ids = answer_ids + [tokenizer.eos_token_id]
+        if max_len is not None and len(prompt_ids) >= max_len:
+            # a row truncated to prompt-only would carry an all-zero
+            # loss_mask: full compute, zero supervised signal — drop it
+            continue
         ids = list(prompt_ids) + list(answer_ids)
         mask = [0.0] * len(prompt_ids) + [1.0] * len(answer_ids)
         if max_len is not None and len(ids) > max_len:
@@ -68,15 +72,9 @@ def tokenize_sft_rows(dataset, tokenizer, max_len: int | None = None) -> list[di
 def main(argv):
     config, _ = load_expr_config(argv, SFTConfig)
 
-    tokenizer = None
-    tok_path = config.tokenizer_path or config.model.path
-    if tok_path:
-        try:
-            from transformers import AutoTokenizer
+    from common import load_tokenizer
 
-            tokenizer = AutoTokenizer.from_pretrained(tok_path)
-        except Exception as e:  # noqa: BLE001 — weights-only smoke model dir
-            print(f"warning: no tokenizer at {tok_path} ({e}); char-level rows")
+    tokenizer = load_tokenizer(config.tokenizer_path or config.model.path)
 
     ds_type = config.train_dataset.type or "gsm8k"
     train_rows = get_custom_dataset(

@@ -155,3 +155,40 @@ def test_gsm8k_sft_main_smoke(tmp_path, monkeypatch):
     assert len(losses) >= 8
     # char-level answers are memorizable: the loss must drop substantially
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_gsm8k_eval_main_smoke(tmp_path, monkeypatch):
+    """The eval entry (examples/math/gsm8k_eval.py) greedy-decodes the test
+    split against an in-process server spun from a checkpoint and reports
+    mean reward (reference examples/math/gsm8k_eval.py role)."""
+    import gsm8k_eval
+
+    hf_dir = str(tmp_path / "hf")
+    params = qwen.init_params(jax.random.PRNGKey(0), TINY_QWEN2)
+    save_params_to_hf(params, TINY_QWEN2, hf_dir)
+    monkeypatch.setenv("AREAL_TPU_SERVER_ADDRS", "")
+    monkeypatch.setattr(gsm8k_eval, "CONCURRENCY", 8)
+    mean = gsm8k_eval.main(
+        [
+            "--config",
+            os.path.join(
+                os.path.dirname(gsm8k_eval.__file__),
+                "..",
+                "smoke",
+                "synthetic_grpo.yaml",
+            ),
+            f"server.model_path={hf_dir}",
+            "server.max_batch_size=8",
+            "server.max_seq_len=64",
+            "server.decode_steps_per_call=4",
+            "server.mesh.data=-1",
+            "server.mesh.model=1",
+            "gconfig.max_new_tokens=8",
+            "tokenizer_path=",
+            "actor.path=",
+            "rollout.max_concurrent_rollouts=8",
+            f"cluster.fileroot={tmp_path}",
+        ]
+    )
+    # untrained model: reward is ~0, but every row was scored
+    assert 0.0 <= mean <= 1.0
