@@ -44,6 +44,21 @@ def _gsm8k(split: str = "train", path: str | None = None, **kwargs):
     return [to_row(x) for x in ds]
 
 
+@register_dataset("synthetic_pref")
+def _synthetic_pref(split: str = "train", n: int = 256, seed: int = 0, **kwargs):
+    """Zero-asset pairwise-preference rows for reward-model smoke runs
+    (examples/alignment): shared random prefix, chosen ends with token 9,
+    rejected with token 3 — a value head must learn the separator."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed + (0 if split == "train" else 10_000))
+    rows = []
+    for _ in range(n):
+        p = rng.integers(1, 250, int(rng.integers(4, 12))).tolist()
+        rows.append({"chosen_ids": p + [9], "rejected_ids": p + [3]})
+    return rows
+
+
 @register_dataset("synthetic_arith")
 def _synthetic_arith(split: str = "train", n: int = 512, seed: int = 0, **kwargs):
     """Self-contained arithmetic task for e2e learning tests without any

@@ -62,7 +62,11 @@ def rw_loss_fn(outputs: dict, b: dict):
     n_pairs = jnp.maximum(valid.sum(), 1.0)
     loss = -(jax.nn.log_sigmoid(diff) * valid).sum() / n_pairs
     acc = ((diff > 0).astype(jnp.float32) * valid).sum() / n_pairs
-    return loss, {"rw_loss": jax.lax.stop_gradient(loss), "rw_acc": acc}
+    return loss, {
+        "rw_loss": jax.lax.stop_gradient(loss),
+        "rw_acc": acc,
+        "n_pairs": n_pairs,  # weight for cross-microbatch aggregation
+    }
 
 
 class LMEngine:
@@ -183,7 +187,9 @@ class SFTTrainer:
             dataset_size=len(train_dataset),
             train_batch_size=config.train_dataset.batch_size,
         )
-        self.engine = engine or JaxTrainEngine(config.model)
+        self.engine = engine or JaxTrainEngine(
+            config.model, value_head=self.value_head
+        )
         if engine is None:
             self.engine.initialize(self.ft_spec)
         self.lm = LMEngine(self.engine, config.model.mb_spec)
@@ -203,6 +209,25 @@ class SFTTrainer:
             evaluator=self.evaluator,
             dataloader=self.train_dataloader,
         )
+
+    # subclass hooks (RWTrainer overrides): collate one dataloader batch
+    # and run one optimizer step, returning the step's stats dict
+    loss_key = "ppl_loss"
+    value_head = False
+
+    def _collate(self, rows) -> dict:
+        return pad_sequences_to_tensors(
+            [
+                {
+                    "input_ids": np.asarray(r["input_ids"], np.int32),
+                    "loss_mask": np.asarray(r["loss_mask"], np.float32),
+                }
+                for r in rows
+            ]
+        )
+
+    def _train_step(self, batch) -> dict:
+        return self.lm.train_lm(batch)
 
     def train(self) -> list[float]:
         config = self.config
@@ -225,18 +250,10 @@ class SFTTrainer:
             step = global_step % steps_per_epoch
             t0 = time.monotonic()
             rows = next(gen)
-            batch = pad_sequences_to_tensors(
-                [
-                    {
-                        "input_ids": np.asarray(r["input_ids"], np.int32),
-                        "loss_mask": np.asarray(r["loss_mask"], np.float32),
-                    }
-                    for r in rows
-                ]
-            )
-            stats = self.lm.train_lm(batch)
+            batch = self._collate(rows)
+            stats = self._train_step(batch)
             self.engine.set_version(global_step + 1)
-            losses.append(stats["ppl_loss"])
+            losses.append(stats[self.loss_key])
 
             self.saver.maybe_save(self.engine, epoch, step, global_step, self.tokenizer)
             self.recover_handler.dump(
@@ -284,3 +301,45 @@ class SFTTrainer:
 
     def close(self) -> None:
         self.stats_logger.close()
+
+
+class RWTrainer(SFTTrainer):
+    """Reward-model training on the full SFTTrainer harness — saver,
+    recover dumps, stats logging all inherited (reference rw training runs
+    through the same trainer scaffolding). Dataset rows are
+    {"chosen_ids", "rejected_ids"}; each step interleaves them so
+    consecutive rows form Bradley-Terry pairs."""
+
+    loss_key = "rw_loss"
+    value_head = True
+
+    def __init__(self, config, train_dataset, valid_dataset=None, **kw):
+        assert valid_dataset is None, "RWTrainer has no eval loop yet"
+        super().__init__(config, train_dataset, **kw)
+        self.rw = RWEngine(self.engine, config.model.mb_spec)
+
+    def _collate(self, rows) -> dict:
+        return pad_sequences_to_tensors(
+            [
+                {
+                    "input_ids": np.asarray(ids, np.int32),
+                    "loss_mask": np.ones(len(ids), np.float32),
+                }
+                for item in rows
+                for ids in (item["chosen_ids"], item["rejected_ids"])
+            ]
+        )
+
+    def _train_step(self, batch) -> dict:
+        stats_list = self.rw.train_rw(batch)
+        # pair-count-weighted aggregate: logging only the last microbatch
+        # would report a fraction of the step's pairs
+        total = sum(float(s.get("n_pairs", 1.0)) for s in stats_list) or 1.0
+        agg: dict[str, float] = {}
+        for s in stats_list:
+            w = float(s.get("n_pairs", 1.0)) / total
+            for k, v in s.items():
+                if isinstance(v, (int, float, np.floating)):
+                    agg[k] = agg.get(k, 0.0) + float(v) * w
+        agg["n_pairs"] = total
+        return agg

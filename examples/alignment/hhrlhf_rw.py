@@ -1,0 +1,44 @@
+"""HH-RLHF reward-model training entry (parity: reference
+examples/alignment/hhrlhf_rw.py): Bradley-Terry pairwise loss over a value
+head; batches interleave (chosen, rejected) rows and pair integrity
+survives microbatching (trainer/sft_trainer.py RWTrainer — the full SFT
+harness: saver, recover dumps, stats logging).
+
+Usage:
+    python examples/alignment/hhrlhf_rw.py \
+        --config examples/alignment/hhrlhf_rw.yaml \
+        model.path=/ckpt/Qwen2.5-1.5B train_dataset.path=/data/hh-rlhf
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "math"))
+
+from areal_tpu.api.config import RWConfig, load_expr_config
+from areal_tpu.dataset import get_custom_dataset
+from areal_tpu.trainer.sft_trainer import RWTrainer
+
+from common import load_tokenizer
+
+
+def main(argv):
+    config, _ = load_expr_config(argv, RWConfig)
+    tokenizer = load_tokenizer(config.tokenizer_path or config.model.path)
+
+    ds_type = config.train_dataset.type or "hh_rlhf"
+    train_rows = get_custom_dataset(
+        ds_type,
+        split="train",
+        path=config.train_dataset.path,
+        tokenizer=tokenizer,
+        max_length=config.train_dataset.max_length,
+    )
+    trainer = RWTrainer(config, train_rows, tokenizer=tokenizer)
+    losses = trainer.train()
+    print(f"final rw_loss={losses[-1]:.4f}" if losses else "no steps run")
+    return losses
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -29,6 +29,36 @@ def reward_for(dataset_type: str):
     return gsm8k_reward_fn
 
 
+def start_single_host_stack(config, dataset_size: int):
+    """Single-host RL bootstrap shared by the RL entries: build the trainer
+    engine first, then an in-process server SHARING its weights (zero-copy
+    "mem" updates). Returns (actor_engine, server)."""
+    import jax
+    import numpy as np
+
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.train_engine import JaxTrainEngine
+
+    config.weight_update_mode = "mem"
+    config.actor.temperature = config.gconfig.temperature
+    actor_engine = JaxTrainEngine(config.actor)
+    actor_engine.initialize(
+        FinetuneSpec(
+            total_train_epochs=config.total_train_epochs,
+            dataset_size=dataset_size,
+            train_batch_size=config.train_dataset.batch_size,
+        )
+    )
+    scfg = config.server
+    scfg.model_path = scfg.model_path or config.actor.path
+    server = start_local_server(
+        scfg,
+        params=jax.tree.map(np.asarray, actor_engine.params),
+        model_cfg=actor_engine.model_cfg,
+    )
+    return actor_engine, server
+
+
 def start_local_server(server_cfg, params=None, model_cfg=None):
     """Single-host mode: in-process DecodeEngine + HTTP server on this
     host's chips. With ``params`` the server shares the caller's weights

@@ -24,8 +24,6 @@ from areal_tpu.inference.client import RemoteJaxEngine
 from areal_tpu.workflow.rlvr import prompt_ids_of
 from common import load_tokenizer, reward_for, start_local_server
 
-CONCURRENCY = 64
-
 
 def main(argv):
     config, _ = load_expr_config(argv, GRPOConfig)
@@ -55,7 +53,8 @@ def main(argv):
     )
 
     async def run() -> list:
-        sem = asyncio.Semaphore(CONCURRENCY)
+        # one knob: the rollout config's concurrency bound governs eval too
+        sem = asyncio.Semaphore(config.rollout.max_concurrent_rollouts or 64)
 
         async def one(row: dict) -> float:
             prompt_ids = prompt_ids_of(row, tokenizer, False)
@@ -105,12 +104,18 @@ def main(argv):
         print(f"warning: {n_failed}/{len(results)} rows failed (first: {first!r})")
     if not len(rewards):
         print("no rows scored")
-        return 0.0
+        return {"n": 0, "mean_reward": 0.0, "accuracy": 0.0, "failed": n_failed}
+    out = {
+        "n": int(len(rewards)),
+        "mean_reward": float(rewards.mean()),
+        "accuracy": float((rewards > 0).mean()),
+        "failed": int(n_failed),
+    }
     print(
-        f"n={len(rewards)} mean_reward={rewards.mean():.4f} "
-        f"accuracy={(rewards > 0).mean():.4f}"
+        f"n={out['n']} mean_reward={out['mean_reward']:.4f} "
+        f"accuracy={out['accuracy']:.4f}"
     )
-    return float(rewards.mean())
+    return out
 
 
 if __name__ == "__main__":

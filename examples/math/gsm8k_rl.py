@@ -17,8 +17,6 @@ Usage:
 import os
 import sys
 
-import numpy as np
-
 from areal_tpu.api.config import GRPOConfig, load_expr_config
 from areal_tpu.dataset import get_custom_dataset
 from areal_tpu.inference.client import RemoteJaxEngine
@@ -26,14 +24,7 @@ from areal_tpu.trainer import PPOTrainer
 from areal_tpu.workflow.rlvr import RLVRWorkflow
 
 
-from common import load_tokenizer, reward_for, start_local_server
-
-
-def maybe_start_local_server(config: GRPOConfig, trainer_params=None, model_cfg=None):
-    """Single-host mode: in-process server on this host's chips."""
-    scfg = config.server
-    scfg.model_path = scfg.model_path or config.actor.path
-    return start_local_server(scfg, params=trainer_params, model_cfg=model_cfg)
+from common import load_tokenizer, reward_for, start_single_host_stack
 
 
 def main(argv):
@@ -58,26 +49,7 @@ def main(argv):
     if not addrs:
         # single-host: build the trainer engine first so the server shares
         # its weights (no double HF load, zero-copy mem updates)
-        import jax
-
-        from areal_tpu.api.io_struct import FinetuneSpec
-        from areal_tpu.engine.train_engine import JaxTrainEngine
-
-        config.weight_update_mode = "mem"
-        config.actor.temperature = config.gconfig.temperature
-        actor_engine = JaxTrainEngine(config.actor)
-        actor_engine.initialize(
-            FinetuneSpec(
-                total_train_epochs=config.total_train_epochs,
-                dataset_size=len(train_dataset),
-                train_batch_size=config.train_dataset.batch_size,
-            )
-        )
-        server = maybe_start_local_server(
-            config,
-            trainer_params=jax.tree.map(np.asarray, actor_engine.params),
-            model_cfg=actor_engine.model_cfg,
-        )
+        actor_engine, server = start_single_host_stack(config, len(train_dataset))
         addrs = [server.address]
     rollout = RemoteJaxEngine(config.rollout, addresses=addrs)
     rollout.initialize()

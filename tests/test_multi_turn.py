@@ -1,0 +1,140 @@
+"""MultiTurnWorkflow behavior (reference workflow/multi_turn.py +
+examples/multi_turn_math): append-only token record across turns, user/
+feedback tokens loss-masked, env-driven retries, per-turn reward
+discounting, and the entry's retry env_fn."""
+
+import asyncio
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.io_struct import (
+    GenerationHyperparameters,
+    ModelRequest,
+    ModelResponse,
+)
+from areal_tpu.workflow.multi_turn import MultiTurnWorkflow
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples", "math"))
+
+
+class ChatTok:
+    """Append-only toy chat template: text round-trips through char ids."""
+
+    eos_token_id = 0
+    pad_token_id = 0
+
+    def apply_chat_template(self, messages, add_generation_prompt=True, tokenize=False):
+        text = "".join(f"<{m['role']}>{m['content']}" for m in messages)
+        if add_generation_prompt:
+            text += "<assistant>"
+        return text
+
+    def encode(self, text, add_special_tokens=False):
+        return [ord(c) for c in text]
+
+    def decode(self, ids):
+        return "".join(chr(i) for i in ids)
+
+
+class ScriptedEngine:
+    """Turn 1 answers '7' (wrong), turn 2 answers '9' (right)."""
+
+    def __init__(self):
+        self.calls = []
+        self.script = ["7", "9"]
+
+    async def agenerate(self, req: ModelRequest) -> ModelResponse:
+        self.calls.append(list(req.input_ids))
+        text = self.script[min(len(self.calls) - 1, len(self.script) - 1)]
+        out = [ord(c) for c in text]
+        return ModelResponse(
+            input_tokens=list(req.input_ids),
+            output_tokens=out,
+            output_logprobs=[-0.25] * len(out),
+            output_versions=[5] * len(out),
+            stop_reason="stop",
+        )
+
+
+def reward_fn(prompt, completion, prompt_ids, completion_ids, **kw):
+    return 1.0 if kw.get("answer", "") in completion else 0.0
+
+
+def test_multi_turn_retry_masking_and_discount():
+    from gsm8k_rl_mt import make_env_fn
+
+    eng = ScriptedEngine()
+    wf = MultiTurnWorkflow(
+        reward_fn,
+        GenerationHyperparameters(n_samples=1, max_new_tokens=4),
+        tokenizer=ChatTok(),
+        max_turns=3,
+        turn_discount=0.5,
+        env_fn=make_env_fn(reward_fn),
+    )
+    rows = asyncio.run(
+        wf.arun_episode(eng, {"messages": [{"role": "user", "content": "q?"}], "answer": "9"})
+    )
+    (row,) = rows
+    # two generation calls: wrong then right; episode ends on correct
+    assert len(eng.calls) == 2
+    # discounted: reward 1.0 * 0.5^(2-1)
+    assert row["rewards"] == pytest.approx(0.5)
+    # loss mask covers exactly the assistant tokens ('7' and '9')
+    ids = row["input_ids"]
+    lm = row["loss_mask"]
+    assert lm.sum() == 2
+    gen_positions = np.nonzero(lm)[0]
+    assert [chr(ids[i]) for i in gen_positions] == ["7", "9"]
+    # context tokens carry version -1, generated carry the engine version
+    assert (row["versions"][lm == 0] == -1).all()
+    assert (row["versions"][lm == 1] == 5).all()
+    # append-only: turn 2's prompt extends turn 1's prompt + emission
+    assert eng.calls[1][: len(eng.calls[0]) + 1] == eng.calls[0] + [ord("7")]
+    # the retry feedback text made it into the second prompt
+    second_ctx = "".join(chr(i) for i in eng.calls[1])
+    assert "incorrect" in second_ctx
+
+
+def test_multi_turn_first_try_success_no_discount():
+    from gsm8k_rl_mt import make_env_fn
+
+    eng = ScriptedEngine()
+    eng.script = ["9"]
+    wf = MultiTurnWorkflow(
+        reward_fn,
+        GenerationHyperparameters(n_samples=1, max_new_tokens=4),
+        tokenizer=ChatTok(),
+        max_turns=3,
+        turn_discount=0.5,
+        env_fn=make_env_fn(reward_fn),
+    )
+    (row,) = asyncio.run(
+        wf.arun_episode(eng, {"messages": [{"role": "user", "content": "q?"}], "answer": "9"})
+    )
+    assert len(eng.calls) == 1
+    assert row["rewards"] == pytest.approx(1.0)  # no discount on turn 1
+
+
+def test_multi_turn_exhausts_turns_on_failure():
+    from gsm8k_rl_mt import make_env_fn
+
+    eng = ScriptedEngine()
+    eng.script = ["7", "8", "6"]
+    wf = MultiTurnWorkflow(
+        reward_fn,
+        GenerationHyperparameters(n_samples=1, max_new_tokens=4),
+        tokenizer=ChatTok(),
+        max_turns=3,
+        turn_discount=0.5,
+        env_fn=make_env_fn(reward_fn),
+    )
+    (row,) = asyncio.run(
+        wf.arun_episode(eng, {"messages": [{"role": "user", "content": "q?"}], "answer": "9"})
+    )
+    assert len(eng.calls) == 3
+    assert row["rewards"] == pytest.approx(0.0)
+    assert row["loss_mask"].sum() == 3  # every assistant token trains
