@@ -44,6 +44,53 @@ def _gsm8k(split: str = "train", path: str | None = None, **kwargs):
     return [to_row(x) for x in ds]
 
 
+@register_dataset("countdown")
+def _countdown(
+    split: str = "train", n: int = 1024, seed: int = 0, n_numbers: int = 4, **kwargs
+):
+    """Countdown numbers game (reference examples/countdown): given numbers
+    that may each be used once and a target, emit <answer>equation</answer>.
+    Puzzles are generated SOLVABLE by construction: the target is computed
+    from a random expression over the numbers. Zero-asset."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed + (0 if split == "train" else 10_000))
+    rows = []
+    while len(rows) < n:
+        nums = [int(rng.integers(1, 50)) for _ in range(n_numbers)]
+        vals = list(nums)
+        rng.shuffle(vals)
+        acc = vals[0]
+        for v in vals[1:]:
+            op = rng.integers(0, 3)
+            if op == 0:
+                acc = acc + v
+            elif op == 1:
+                acc = acc - v
+            else:
+                acc = acc * v
+        target = int(acc)
+        if not (0 < target <= 10_000):
+            continue
+        prompt = (
+            f"Using the numbers {nums}, create an equation that equals "
+            f"{target}. You may use + - * / and parentheses; each number "
+            "must be used exactly once. Show your final equation inside "
+            "<answer></answer> tags."
+        )
+        rows.append(
+            {
+                "messages": [{"role": "user", "content": prompt}],
+                # full-prompt char ids for the tokenizer-free smoke path (a
+                # real tokenizer takes precedence in prompt_ids_of)
+                "prompt_ids": [ord(c) % 256 for c in prompt],
+                "numbers": nums,
+                "target": target,
+            }
+        )
+    return rows
+
+
 @register_dataset("synthetic_pref")
 def _synthetic_pref(split: str = "train", n: int = 256, seed: int = 0, **kwargs):
     """Zero-asset pairwise-preference rows for reward-model smoke runs

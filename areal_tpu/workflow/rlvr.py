@@ -21,7 +21,21 @@ from areal_tpu.utils import stats_tracker
 
 
 def prompt_ids_of(data: dict, tokenizer=None, enable_thinking: bool = False) -> list[int]:
-    """Extract/construct prompt token ids from a dataset row."""
+    """Extract/construct prompt token ids from a dataset row.
+
+    Preference: a REAL tokenizer over pre-baked ``prompt_ids`` — rows that
+    carry both (zero-asset datasets bake char-level ids for tokenizer-free
+    smoke runs) must not feed byte pseudo-ids to a real model, whose vocab
+    they mean nothing in."""
+    if tokenizer is not None and ("messages" in data or "prompt" in data):
+        if "messages" in data:
+            return tokenizer.apply_chat_template(
+                data["messages"],
+                add_generation_prompt=True,
+                tokenize=True,
+                enable_thinking=enable_thinking,
+            )
+        return tokenizer.encode(data["prompt"])
     if "prompt_ids" in data:
         return list(data["prompt_ids"])
     assert tokenizer is not None, "tokenizer required for message/text prompts"

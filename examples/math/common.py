@@ -26,6 +26,10 @@ def reward_for(dataset_type: str):
         from areal_tpu.reward.synthetic import arith_char_reward_fn
 
         return arith_char_reward_fn
+    if dataset_type == "countdown":
+        from areal_tpu.reward.countdown import countdown_reward_fn
+
+        return countdown_reward_fn
     return gsm8k_reward_fn
 
 

@@ -213,3 +213,49 @@ def test_sdk_integrations_import_gated():
         except ImportError:
             with pytest.raises(ImportError, match="pip install"):
                 importlib.import_module(mod)
+
+
+def test_countdown_reward_and_dataset():
+    """Countdown task (reference examples/countdown): generated puzzles are
+    solvable by construction and the reward scores correctness, format
+    credit, and violations."""
+    from areal_tpu.dataset import get_custom_dataset
+    from areal_tpu.reward.countdown import countdown_reward_fn, safe_eval
+
+    rows = get_custom_dataset("countdown", split="train", n=16, seed=3)
+    assert len(rows) == 16
+    for r in rows:
+        assert 0 < r["target"] <= 10_000 and len(r["numbers"]) == 4
+        assert str(r["target"]) in r["messages"][0]["content"]
+
+    nums, target = [2, 3, 5, 10], 25
+    good = "<answer>5*(10-3-2)</answer>"  # each number exactly once
+    assert countdown_reward_fn("", good, [], [], numbers=nums, target=target) == 1.0
+    wrong_val = "<answer>2+3+5+10</answer>"
+    assert countdown_reward_fn("", wrong_val, [], [], numbers=nums, target=target) == 0.1
+    reused = "<answer>5*5</answer>"  # number reuse / missing numbers
+    assert countdown_reward_fn("", reused, [], [], numbers=nums, target=target) == 0.0
+    no_tags = "(2+3)*5"
+    assert countdown_reward_fn("", no_tags, [], [], numbers=nums, target=target) == 0.0
+    evil = "<answer>__import__('os')</answer>"
+    assert countdown_reward_fn("", evil, [], [], numbers=nums, target=target) == 0.0
+    assert safe_eval("2**10") is None  # power disallowed
+
+
+def test_prompt_ids_of_prefers_real_tokenizer():
+    """Rows carrying both messages and baked char-level prompt_ids must use
+    the REAL tokenizer when one exists (byte pseudo-ids mean nothing in a
+    real vocab); tokenizer-free runs fall back to prompt_ids."""
+    from areal_tpu.workflow.rlvr import prompt_ids_of
+
+    class Tok:
+        def apply_chat_template(self, messages, add_generation_prompt=True, tokenize=True, enable_thinking=False):
+            return [42, 43]
+
+        def encode(self, text):
+            return [7] * len(text)
+
+    row = {"messages": [{"role": "user", "content": "hi"}], "prompt_ids": [1, 2, 3]}
+    assert prompt_ids_of(row, Tok()) == [42, 43]
+    assert prompt_ids_of(row, None) == [1, 2, 3]
+    assert prompt_ids_of({"prompt_ids": [5]}, Tok()) == [5]

@@ -24,8 +24,13 @@ PRESET_DIR = os.path.join(
     "examples",
     "math",
 )
+# RL presets only: the SFT config is a different schema (SFTConfig) with
+# its own entry test (tests/test_gsm8k_entry.py::test_gsm8k_sft_main_smoke)
+_NON_RL = {"gsm8k_sft.yaml"}
 PRESETS = sorted(
-    os.path.basename(p) for p in glob.glob(os.path.join(PRESET_DIR, "*.yaml"))
+    os.path.basename(p)
+    for p in glob.glob(os.path.join(PRESET_DIR, "*.yaml"))
+    if os.path.basename(p) not in _NON_RL
 )
 
 
@@ -87,6 +92,11 @@ WIRING = {
     ),
     "gsm8k_grpo_lora.yaml": lambda c: (
         c.actor.lora_rank == 32 and c.actor.lora_alpha == 16.0
+    ),
+    "countdown_grpo.yaml": lambda c: (
+        c.train_dataset.type == "countdown"
+        and c.actor.group_size == 8
+        and c.actor.group_reward_norm
     ),
 }
 
