@@ -21,6 +21,9 @@ def load_tokenizer(path: str):
         return None
 
 
+VISION_DATASETS = ("clevr_count_70k", "geometry3k", "virl39k")
+
+
 def reward_for(dataset_type: str):
     if dataset_type == "synthetic_arith":
         from areal_tpu.reward.synthetic import arith_char_reward_fn
@@ -30,7 +33,49 @@ def reward_for(dataset_type: str):
         from areal_tpu.reward.countdown import countdown_reward_fn
 
         return countdown_reward_fn
+    if dataset_type == "clevr_count_70k":
+        from areal_tpu.reward.clevr_count import clevr_count_reward_fn
+
+        return clevr_count_reward_fn
+    if dataset_type in ("geometry3k", "virl39k"):
+        from areal_tpu.reward.math_verify import math_verify_reward_fn
+
+        return math_verify_reward_fn
     return gsm8k_reward_fn
+
+
+def make_workflow(dataset_type: str, gconfig, tokenizer, processor=None):
+    """RLVR for text tasks; VisionRLVRWorkflow (pixel patches through the
+    request path) for image datasets — the entry stays task-agnostic."""
+    reward_fn = reward_for(dataset_type)
+    if dataset_type in VISION_DATASETS:
+        from areal_tpu.workflow.vision_rlvr import VisionRLVRWorkflow
+
+        if processor is None:  # operator-facing: must survive python -O
+            raise ValueError(
+                f"{dataset_type} needs an image processor (AutoProcessor of "
+                "the VLM checkpoint)"
+            )
+        return VisionRLVRWorkflow(reward_fn, gconfig, tokenizer, processor)
+    from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+    return RLVRWorkflow(reward_fn, gconfig, tokenizer=tokenizer)
+
+
+def load_processor(path: str, dataset_type: str = ""):
+    """AutoProcessor for VLM checkpoints; None for text models. Only loads
+    when the dataset actually needs images (AutoProcessor on a text
+    checkpoint degenerates into a second full tokenizer load)."""
+    if not path or dataset_type not in VISION_DATASETS:
+        return None
+    try:
+        from transformers import AutoProcessor
+
+        return AutoProcessor.from_pretrained(path)
+    except Exception as e:  # noqa: BLE001 — surface the root cause; the
+        # vision workflow will refuse to build without a processor
+        print(f"warning: AutoProcessor load failed at {path}: {e}")
+        return None
 
 
 def start_single_host_stack(config, dataset_size: int):

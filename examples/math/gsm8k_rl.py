@@ -21,10 +21,14 @@ from areal_tpu.api.config import GRPOConfig, load_expr_config
 from areal_tpu.dataset import get_custom_dataset
 from areal_tpu.inference.client import RemoteJaxEngine
 from areal_tpu.trainer import PPOTrainer
-from areal_tpu.workflow.rlvr import RLVRWorkflow
 
 
-from common import load_tokenizer, reward_for, start_single_host_stack
+from common import (
+    load_processor,
+    load_tokenizer,
+    make_workflow,
+    start_single_host_stack,
+)
 
 
 def main(argv):
@@ -54,10 +58,24 @@ def main(argv):
     rollout = RemoteJaxEngine(config.rollout, addresses=addrs)
     rollout.initialize()
 
-    reward_fn = reward_for(ds_type)
-    workflow = RLVRWorkflow(reward_fn, config.gconfig, tokenizer=tokenizer)
-    eval_workflow = RLVRWorkflow(
-        reward_fn, config.gconfig.new(temperature=0.6), tokenizer=tokenizer
+    # image datasets route through VisionRLVRWorkflow (pixel patches ride
+    # the request path); text datasets through RLVR — same entry either way.
+    # The eval split may declare its OWN type; each workflow follows its
+    # dataset's modality.
+    valid_ds_type = (
+        (config.valid_dataset.type or ds_type)
+        if config.valid_dataset is not None
+        else ds_type
+    )
+    proc_path = config.tokenizer_path or config.actor.path
+    workflow = make_workflow(
+        ds_type, config.gconfig, tokenizer, load_processor(proc_path, ds_type)
+    )
+    eval_workflow = make_workflow(
+        valid_ds_type,
+        config.gconfig.new(temperature=0.6),
+        tokenizer,
+        load_processor(proc_path, valid_ds_type),
     )
 
     trainer = PPOTrainer(
