@@ -1,0 +1,240 @@
+"""Tool-integrated reasoning (TIR): the model interleaves reasoning with
+```python ...``` blocks; a SANDBOXED evaluator executes each block and the
+output feeds back as the next turn (reference examples/tir/{tir_workflow,
+tool_manager}.py role, re-derived at an honest scope: an AST-whitelisted
+calculator-grade python subset instead of a containerized interpreter).
+
+Rides MultiTurnWorkflow: ``make_tir_env_fn()`` is an env_fn — code blocks
+get executed, turns without code end the episode with the final answer.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+_CODE_RE = re.compile(r"```(?:python)?\n(.*?)```", re.DOTALL)
+
+# AST node whitelist: arithmetic, assignments, comparisons, bounded for-
+# loops, if/else, and calls to a tiny function allowlist. No attribute
+# access (closes .__class__ ladders), no imports, no while (unbounded), no
+# comprehensions-with-walrus tricks beyond the listed nodes.
+_ALLOWED_NODES = (
+    ast.Module,
+    ast.Expr,
+    ast.Assign,
+    ast.AugAssign,
+    ast.BinOp,
+    ast.UnaryOp,
+    ast.BoolOp,
+    ast.Compare,
+    ast.Constant,
+    ast.Name,
+    ast.Load,
+    ast.Store,
+    ast.Tuple,
+    ast.List,
+    ast.Subscript,
+    ast.Index if hasattr(ast, "Index") else ast.Slice,
+    ast.Slice,
+    ast.Call,
+    ast.keyword,
+    ast.If,
+    ast.For,
+    ast.Break,
+    ast.Continue,
+    ast.Pass,
+    ast.Add,
+    ast.Sub,
+    ast.Mult,
+    ast.Div,
+    ast.FloorDiv,
+    ast.Mod,
+    ast.Pow,
+    ast.USub,
+    ast.UAdd,
+    ast.Not,
+    ast.And,
+    ast.Or,
+    ast.Eq,
+    ast.NotEq,
+    ast.Lt,
+    ast.LtE,
+    ast.Gt,
+    ast.GtE,
+    ast.ListComp,
+    ast.comprehension,
+)
+# single source of truth: the sandbox env IS the call allowlist (print and
+# range get shimmed per execution)
+_SAFE_FNS = {
+    "abs": abs,
+    "min": min,
+    "max": max,
+    "round": round,
+    "len": len,
+    "sum": sum,
+    "int": int,
+    "float": float,
+    "str": str,
+    "sorted": sorted,
+    "enumerate": enumerate,
+}
+_ALLOWED_CALLS = frozenset(_SAFE_FNS) | {"print", "range"}
+_MAX_NODES = 400
+_MAX_LOOP = 100_000  # best-effort iteration budget (range shim); the HARD
+# bound is the subprocess CPU/memory rlimit + wall-clock timeout
+
+
+class ToolError(ValueError):
+    pass
+
+
+def _validate(tree: ast.AST) -> None:
+    n = 0
+    for node in ast.walk(tree):
+        n += 1
+        if n > _MAX_NODES:
+            raise ToolError("program too large")
+        if not isinstance(node, _ALLOWED_NODES):
+            raise ToolError(f"disallowed syntax: {type(node).__name__}")
+        if isinstance(node, ast.Call):
+            if not isinstance(node.func, ast.Name) or node.func.id not in _ALLOWED_CALLS:
+                raise ToolError("only basic math/list builtins may be called")
+        if isinstance(node, ast.Name) and node.id.startswith("__"):
+            raise ToolError("dunder names are not allowed")
+
+
+class _Budget:
+    def __init__(self, limit: int):
+        self.left = limit
+
+    def tick(self, n: int = 1) -> None:
+        self.left -= n
+        if self.left < 0:
+            raise ToolError("iteration budget exceeded")
+
+
+def _execute_validated(code: str, max_output_chars: int = 2000) -> str:
+    """Execute an ALREADY AST-validated block in this process. The AST
+    whitelist closes syntactic escapes; resource abuse (9**9**9,
+    [0]*10**9 loops) is the CALLER's job to bound — run_python_tool wraps
+    this in a subprocess with CPU/memory rlimits and a wall clock."""
+    tree = ast.parse(code)
+    _validate(tree)
+    out: list[str] = []
+    budget = _Budget(_MAX_LOOP)
+
+    def _print(*args, **kw):
+        out.append(" ".join(str(a) for a in args))
+
+    def _range(*args):
+        r = range(*(int(a) for a in args))
+        budget.tick(len(r))
+        return r
+
+    # ONE dict used as globals (no separate locals): pre-3.12 list
+    # comprehensions compile to nested scopes that resolve free names in
+    # GLOBALS — env-as-locals would NameError on `[i * n for i in ...]`
+    g: dict = {"__builtins__": {}, **_SAFE_FNS, "print": _print, "range": _range}
+    last_expr = None
+    try:
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Expr):
+                last_expr = eval(  # noqa: S307 — AST-whitelisted above
+                    compile(ast.Expression(stmt.value), "<tool>", "eval"), g
+                )
+            else:
+                exec(  # noqa: S102 — AST-whitelisted above
+                    compile(ast.Module([stmt], []), "<tool>", "exec"), g
+                )
+    except ToolError as e:
+        return f"error: {e}"
+    except Exception as e:  # noqa: BLE001 — model code may raise anything
+        return f"error: {type(e).__name__}: {e}"
+    if not out and last_expr is not None:
+        out.append(str(last_expr))
+    text = "\n".join(out)
+    return text[:max_output_chars] if text else "(no output)"
+
+
+def _exec_in_child() -> None:
+    """Subprocess entry: code on stdin, result on stdout."""
+    import sys
+
+    sys.stdout.write(_execute_validated(sys.stdin.read()))
+
+
+def run_python_tool(
+    code: str, max_output_chars: int = 2000, timeout_s: float = 5.0
+) -> str:
+    """Execute one sandboxed code block; returns captured print output (or
+    the last expression's value), or an ``error: ...`` string.
+
+    Defense in depth: the AST whitelist (validated HERE, for fast friendly
+    errors) closes syntactic escapes, and execution happens in a CHILD
+    process under CPU/address-space rlimits + a wall-clock timeout — a
+    `9**9**9` or `[0]*10**6`-product loop costs one killed child, never a
+    wedged rollout worker."""
+    import os
+    import subprocess
+    import sys
+
+    try:
+        _validate(ast.parse(code))
+    except SyntaxError as e:
+        return f"error: syntax: {e.msg}"
+    except ToolError as e:
+        return f"error: {e}"
+
+    def limits() -> None:
+        import resource
+
+        cpu = max(1, int(timeout_s))
+        resource.setrlimit(resource.RLIMIT_CPU, (cpu, cpu + 1))
+        resource.setrlimit(resource.RLIMIT_AS, (512 << 20, 512 << 20))
+
+    env = dict(os.environ)
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "from areal_tpu.workflow.tir import _exec_in_child; _exec_in_child()",
+            ],
+            input=code.encode(),
+            capture_output=True,
+            timeout=timeout_s,
+            env=env,
+            preexec_fn=limits,
+        )
+    except subprocess.TimeoutExpired:
+        return "error: execution timed out"
+    if proc.returncode != 0:
+        return "error: execution failed (resource limit or crash)"
+    text = proc.stdout.decode(errors="replace")
+    return text[:max_output_chars] if text else "(no output)"
+
+
+def extract_code(text: str) -> str | None:
+    """Last fenced code block of the assistant turn, if any."""
+    blocks = _CODE_RE.findall(text)
+    return blocks[-1].strip() if blocks else None
+
+
+def make_tir_env_fn():
+    """env_fn for MultiTurnWorkflow: execute the turn's code block and feed
+    the output back; a turn WITHOUT code is the final answer."""
+
+    def env_fn(data, assistant_text: str, turn: int):
+        code = extract_code(assistant_text)
+        if code is None:
+            return None, True
+        result = run_python_tool(code)
+        return f"Execution output:\n{result}", False
+
+    return env_fn

@@ -7,7 +7,11 @@ user/feedback tokens are loss-masked (workflow/multi_turn.py).
 Usage:
     python examples/math/gsm8k_rl_mt.py --config examples/math/gsm8k_grpo.yaml \
         actor.path=/ckpt/Qwen2.5-1.5B train_dataset.path=/data/gsm8k \
-        [mt_max_turns=3] [mt_turn_discount=0.9]
+        [mt_max_turns=3] [mt_turn_discount=0.9] [mt_env=retry|tir]
+
+mt_env=tir swaps the retry environment for the sandboxed python tool
+(workflow/tir.py): code blocks execute, outputs feed back — the reference
+examples/tir tool-integrated-reasoning recipe.
 """
 
 import os
@@ -44,14 +48,18 @@ def make_env_fn(reward_fn):
 
 def main(argv):
     # mt_* knobs are entry-local (not experiment-config fields): strip them
-    # before the config loader sees the overrides
-    max_turns, turn_discount = 3, 0.9
+    # before the config loader sees the overrides. mt_env=retry (wrong
+    # answers get feedback) | tir (code blocks run in the sandboxed python
+    # tool, workflow/tir.py — the reference examples/tir role).
+    max_turns, turn_discount, env_kind = 3, 0.9, "retry"
     rest = []
     for a in argv:
         if a.startswith("mt_max_turns="):
             max_turns = int(a.split("=", 1)[1])
         elif a.startswith("mt_turn_discount="):
             turn_discount = float(a.split("=", 1)[1])
+        elif a.startswith("mt_env="):
+            env_kind = a.split("=", 1)[1]
         else:
             rest.append(a)
     config, _ = load_expr_config(rest, GRPOConfig)
@@ -73,13 +81,21 @@ def main(argv):
     rollout.initialize()
 
     reward_fn = reward_for(ds_type)
+    if env_kind == "tir":
+        from areal_tpu.workflow.tir import make_tir_env_fn
+
+        env_fn = make_tir_env_fn()
+    elif env_kind == "retry":
+        env_fn = make_env_fn(reward_fn)
+    else:
+        raise ValueError(f"mt_env must be 'retry' or 'tir', got {env_kind!r}")
     workflow = MultiTurnWorkflow(
         reward_fn,
         config.gconfig.new(n_samples=1),
         tokenizer=tokenizer,
         max_turns=max_turns,
         turn_discount=turn_discount,
-        env_fn=make_env_fn(reward_fn),
+        env_fn=env_fn,
     )
 
     trainer = PPOTrainer(

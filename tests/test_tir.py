@@ -1,0 +1,138 @@
+"""Tool-integrated reasoning (reference examples/tir role): the sandboxed
+python tool computes, refuses escapes, bounds loops; the env_fn drives a
+code->output->answer episode through MultiTurnWorkflow."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.io_struct import (
+    GenerationHyperparameters,
+    ModelRequest,
+    ModelResponse,
+)
+from areal_tpu.workflow.multi_turn import MultiTurnWorkflow
+from areal_tpu.workflow.tir import extract_code, make_tir_env_fn, run_python_tool
+
+
+def test_tool_computes():
+    assert run_python_tool("print(2 + 3 * 4)") == "14"
+    assert run_python_tool("x = 10\ny = x * x\nprint(y)") == "100"
+    assert run_python_tool("sum(i * i for i in range(4))" ) .startswith("error")  # genexp not whitelisted
+    assert run_python_tool("print(sum([i * i for i in range(4)]))") == "14"
+    # bare final expression returns its value
+    assert run_python_tool("6 * 7") == "42"
+    assert run_python_tool("s = 0\nfor i in range(5):\n    s = s + i\nprint(s)") == "10"
+
+
+def test_tool_refuses_escapes():
+    for evil in (
+        "import os",
+        "__import__('os')",
+        "().__class__",
+        "open('/etc/passwd')",
+        "exec('1')",
+        "getattr(int, 'bit_length')",
+        "while True:\n    pass",
+        "x.__globals__",
+    ):
+        out = run_python_tool(evil)
+        assert out.startswith("error"), (evil, out)
+
+
+def test_tool_bounds_loops():
+    out = run_python_tool("s = 0\nfor i in range(10**9):\n    s = s + 1")
+    assert out.startswith("error")
+    out2 = run_python_tool(
+        "s = 0\nfor i in range(400):\n    for j in range(400):\n        s = s + 1\nprint(s)"
+    )
+    assert out2.startswith("error")  # 160k iterations > budget
+
+
+def test_tool_resource_limits_kill_runaways():
+    """The HARD bound: syntactically-legal resource bombs (huge pow, loops
+    over non-range iterables that bypass the range shim) die at the child's
+    rlimits/wall clock instead of wedging the rollout worker."""
+    out = run_python_tool("x = 9 ** 9 ** 9", timeout_s=2.0)
+    assert out.startswith("error"), out
+    out2 = run_python_tool(
+        "s = 0\nfor i in [0] * 1000000:\n    for j in [0] * 1000000:\n        s = s + 1",
+        timeout_s=2.0,
+    )
+    assert out2.startswith("error"), out2
+
+
+def test_tool_comprehension_sees_outer_names():
+    """Pre-3.12 comprehension scoping: free names in a listcomp body must
+    resolve (env rides globals, not locals)."""
+    assert run_python_tool("n = 4\nprint(sum([i * n for i in range(3)]))") == "12"
+
+
+def test_extract_code():
+    text = "思考...\n```python\nprint(1)\n```\nmore\n```python\nprint(2)\n```"
+    assert extract_code(text) == "print(2)"
+    assert extract_code("no code here") is None
+
+
+class ChatTok:
+    eos_token_id = 0
+    pad_token_id = 0
+
+    def apply_chat_template(self, messages, add_generation_prompt=True, tokenize=False):
+        text = "".join(f"<{m['role']}>{m['content']}" for m in messages)
+        return text + "<assistant>" if add_generation_prompt else text
+
+    def encode(self, text, add_special_tokens=False):
+        return [ord(c) % 1000 for c in text]
+
+    def decode(self, ids):
+        return "".join(chr(i) for i in ids)
+
+
+class CodeAgentEngine:
+    """Turn 1 emits a code block; turn 2 reads the output and answers."""
+
+    def __init__(self):
+        self.calls: list[str] = []
+        self.script = ["```python\nprint(17 * 3)\n```", "the answer is 51"]
+
+    async def agenerate(self, req: ModelRequest) -> ModelResponse:
+        self.calls.append("".join(chr(i) for i in req.input_ids))
+        text = self.script[min(len(self.calls) - 1, 1)]
+        out = [ord(c) % 1000 for c in text]
+        return ModelResponse(
+            input_tokens=list(req.input_ids),
+            output_tokens=out,
+            output_logprobs=[-0.1] * len(out),
+            output_versions=[0] * len(out),
+            stop_reason="stop",
+        )
+
+
+def test_tir_episode_end_to_end():
+    def reward_fn(prompt, completion, prompt_ids, completion_ids, **kw):
+        return 1.0 if kw.get("answer", "") in completion else 0.0
+
+    eng = CodeAgentEngine()
+    wf = MultiTurnWorkflow(
+        reward_fn,
+        GenerationHyperparameters(n_samples=1, max_new_tokens=64),
+        tokenizer=ChatTok(),
+        max_turns=4,
+        turn_discount=1.0,
+        env_fn=make_tir_env_fn(),
+    )
+    (row,) = asyncio.run(
+        wf.arun_episode(
+            eng,
+            {"messages": [{"role": "user", "content": "what is 17*3?"}], "answer": "51"},
+        )
+    )
+    assert len(eng.calls) == 2
+    # the tool's execution output reached the model's second prompt
+    assert "Execution output:" in eng.calls[1] and "51" in eng.calls[1]
+    assert row["rewards"] == pytest.approx(1.0)
+    # tool-output/user tokens are loss-masked; only assistant tokens train
+    n_assistant = len(eng.script[0]) + len(eng.script[1])
+    assert row["loss_mask"].sum() == n_assistant
