@@ -406,7 +406,11 @@ class DecodeEngine:
         sizes.add(S)
         return sorted(sizes)
 
-    def precompile(self, prompt_buckets: list[int] | None = None) -> None:
+    def precompile(
+        self,
+        prompt_buckets: list[int] | None = None,
+        budget_s: float | None = None,
+    ) -> None:
         """AOT compile-warm every jitted variant the serving loop can reach:
         batched-prefill programs (``_PREFILL_SIZES`` group sizes x reachable
         prompt buckets), the slot-scatter sizes, page-copy sizes, and every
@@ -422,11 +426,18 @@ class DecodeEngine:
         warming uses ``jit(f).lower(...).compile()`` — compile cost only, no
         device execution (ADVICE r02 #1/#2). The runtime path re-traces on
         first hit and replays from the in-process/persistent compile cache.
+
+        ``budget_s`` bounds wall-clock: compilation stops (with a log of the
+        skipped count) once the budget is spent. Programs are ordered hot
+        loop first — decode chunks, then scatter/pagecopy/clamp, then
+        prefill variants — so an out-of-budget stop costs admission-wave
+        stalls, never mid-decode stalls. Fresh compiles land in the
+        persistent cache, so a budget-truncated run completes further on the
+        next start.
         """
         assert self.initialized, "initialize() first"
         cfg = self.config
         t0 = time.monotonic()
-        n_prog = 0
 
         def sds(x):
             return jax.ShapeDtypeStruct(x.shape, x.dtype)
@@ -436,33 +447,43 @@ class DecodeEngine:
         state_s = jax.tree.map(sds, self._dev_state)
         rng_s = sds(self._rng)
         psz = cfg.page_size
-        with jax.set_mesh(self.mesh):
-            if prompt_buckets is None:
-                prompt_buckets = self._reachable_prompt_buckets()
-            for bucket in prompt_buckets:
-                for A in _PREFILL_SIZES:
-                    self._prefill_fn(A, bucket).lower(
+        if prompt_buckets is None:
+            prompt_buckets = self._reachable_prompt_buckets()
+        from areal_tpu.inference import paged_kv
+
+        tasks: list[Callable[[], Any]] = []
+        for wp in self._reachable_chunk_wps():
+            for capped in (False, True):
+                tasks.append(
+                    lambda wp=wp, capped=capped: self._chunk_fn(
+                        cfg.decode_steps_per_call, wp, capped
+                    ).lower(
                         params_s,
                         cache_s,
-                        jax.ShapeDtypeStruct((A, bucket), jnp.int32),
-                        jax.ShapeDtypeStruct((A,), jnp.int32),
-                        jax.ShapeDtypeStruct((A * -(-bucket // psz),), jnp.int32),
+                        jax.ShapeDtypeStruct((cfg.max_batch_size, wp), jnp.int32),
+                        state_s,
+                        rng_s,
                     ).compile()
-                    n_prog += 1
-            upd_row = 9 + _MAX_STOP  # _pack_row column count
-            for n in self._reachable_scatter_sizes():
-                self._update_fn(n).lower(
+                )
+        upd_row = 9 + _MAX_STOP  # _pack_row column count
+        for n in self._reachable_scatter_sizes():
+            tasks.append(
+                lambda n=n: self._update_fn(n).lower(
                     state_s, jax.ShapeDtypeStruct((n, upd_row), jnp.float32)
                 ).compile()
-                n_prog += 1
-            # GRPO prefix-sharing page copies (dup counts pad to powers of
-            # two up to next_pow2(S-1)) and the pool-pressure remaining
-            # clamp — a cold compile on either would stall all slots
-            # mid-serving
-            from areal_tpu.inference import paged_kv
+            )
+            tasks.append(
+                lambda n=n: self._clamp_fn(n).lower(
+                    state_s, jax.ShapeDtypeStruct((n, 2), jnp.int32)
+                ).compile()
+            )
+        # GRPO prefix-sharing page copies (dup counts pad to powers of two
+        # up to next_pow2(S-1)) — a cold compile would stall all slots
+        # mid-serving
+        n = 1
+        while True:
 
-            n = 1
-            while True:
+            def warm_pagecopy(n=n):
                 key = ("pagecopy", n)
                 if key not in self._fn_cache:
                     self._fn_cache[key] = jax.jit(
@@ -473,29 +494,37 @@ class DecodeEngine:
                     jax.ShapeDtypeStruct((n,), jnp.int32),
                     jax.ShapeDtypeStruct((n,), jnp.int32),
                 ).compile()
-                n_prog += 1
-                if n >= max(1, cfg.max_batch_size - 1):
-                    break
-                n *= 2
-            for n in self._reachable_scatter_sizes():
-                self._clamp_fn(n).lower(
-                    state_s, jax.ShapeDtypeStruct((n, 2), jnp.int32)
-                ).compile()
-                n_prog += 1
-            for wp in self._reachable_chunk_wps():
-                for capped in (False, True):
-                    self._chunk_fn(cfg.decode_steps_per_call, wp, capped).lower(
+
+            tasks.append(warm_pagecopy)
+            if n >= max(1, cfg.max_batch_size - 1):
+                break
+            n *= 2
+        for bucket in prompt_buckets:
+            for A in _PREFILL_SIZES:
+                tasks.append(
+                    lambda A=A, bucket=bucket: self._prefill_fn(A, bucket).lower(
                         params_s,
                         cache_s,
-                        jax.ShapeDtypeStruct(
-                            (cfg.max_batch_size, wp), jnp.int32
-                        ),
-                        state_s,
-                        rng_s,
+                        jax.ShapeDtypeStruct((A, bucket), jnp.int32),
+                        jax.ShapeDtypeStruct((A,), jnp.int32),
+                        jax.ShapeDtypeStruct((A * -(-bucket // psz),), jnp.int32),
                     ).compile()
-                    n_prog += 1
+                )
+
+        n_prog = 0
+        with jax.set_mesh(self.mesh):
+            for task in tasks:
+                if budget_s is not None and time.monotonic() - t0 > budget_s:
+                    logger.warning(
+                        f"precompile budget {budget_s:.0f}s spent after "
+                        f"{n_prog} programs; {len(tasks) - n_prog} deferred "
+                        "to lazy compile"
+                    )
+                    break
+                task()
+                n_prog += 1
         logger.info(
-            f"precompiled {n_prog} serving programs in "
+            f"precompiled {n_prog}/{len(tasks)} serving programs in "
             f"{time.monotonic() - t0:.1f}s"
         )
 
