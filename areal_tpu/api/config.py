@@ -230,6 +230,14 @@ class ServerConfig:
     # streams per server replica; set an int for reproducible serving
     # (tests, debugging — reference sglang random_seed role)
     seed: int | None = None
+    # serving weight quantization: "none" | "int8" (weight-only, per-output-
+    # channel symmetric; models/qwen.py quantize_params_int8). Decode at
+    # small-model scale is weight-HBM-bound, so int8 roughly halves the
+    # per-step floor. Rollout drift from the quantized behavior policy is
+    # exactly what the decoupled-PPO loss corrects (the logged behavior
+    # logprobs ARE the quantized server's). Reference reaches this through
+    # SGLang/vLLM quantized deployments.
+    quantization: str = "none"
 
 
 @dataclass

@@ -257,6 +257,22 @@ class DecodeEngine:
                 self.mesh, qwen.param_partition_specs(self.model_cfg)
             )
 
+        # the UNQUANTIZED param structure: weight updates arrive as bf16
+        # trees with base names regardless of serving quantization, so
+        # completeness checks and shard lookups use this, not self.params
+        self._base_param_paths = {p for p, _ in _iter_tree_paths(self.params)}
+        if cfg.quantization == "int8":
+            self.params = self._quantize(self.params)
+            # shardings for the SERVED (quantized) structure — offload/onload
+            # walks self.params paths, which carry _q8/_scale names
+            self._serving_shardings = mesh_lib.param_sharding(
+                self.mesh, qwen.quant_partition_specs(self.model_cfg)
+            )
+        elif cfg.quantization not in (None, "", "none"):
+            raise ValueError(f"unknown quantization {cfg.quantization!r}")
+        else:
+            self._serving_shardings = self.param_shardings
+
         S, T = cfg.max_batch_size, cfg.max_seq_len
         self._init_paged_cache()
         # host mirror of per-slot state. The authoritative decode state lives
@@ -299,6 +315,19 @@ class DecodeEngine:
             f"{self.pool.n_pages} KV pages × {cfg.page_size} tokens, "
             f"mesh {dict(self.mesh.shape)}"
         )
+
+    def _quantize(self, params: dict) -> dict:
+        """int8 weight-only transform of a served tree (jitted; sharding
+        propagates from the inputs — q8 is elementwise in W, so GSPMD keeps
+        the base weight's placement). The caller's bf16 tree is NOT donated:
+        colocated callers may still hold references into it. The jitted fn
+        is built once — a per-call jax.jit would retrace inside every
+        weight-update pause window."""
+        fn = getattr(self, "_quantize_jit", None)
+        if fn is None:
+            fn = self._quantize_jit = jax.jit(qwen.quantize_params_int8)
+        with jax.set_mesh(self.mesh):
+            return fn(params)
 
     def _init_paged_cache(self) -> None:
         """Create the paged KV pool (inference/paged_kv.py): page arrays on
@@ -715,8 +744,11 @@ class DecodeEngine:
             self._staged_flat = None
         assert flat, "no staged weights"
         tree = _unflatten(flat)
-        # sanity: staged tree must cover the whole param structure
-        ref_paths = {p for p, _ in _iter_tree_paths(self.params)}
+        # sanity: staged tree must cover the whole param structure. Compare
+        # against the UNQUANTIZED structure — updates always arrive with
+        # base weight names even when the engine serves int8 (a fallback to
+        # self.params here would demand q8 names no updater can supply)
+        ref_paths = self._base_param_paths
         got_paths = {p for p, _ in _iter_tree_paths(tree)}
         missing = ref_paths - got_paths
         assert not missing, f"staged update missing params: {sorted(missing)[:5]}"
@@ -755,10 +787,18 @@ class DecodeEngine:
                 # tree may already contain merged adapters, so subsequent
                 # lora_only pushes must be refused (see _apply_lora_delta)
                 self._lora_prev = None
+            quantized = self.config.quantization == "int8"
             if kind == "staged":
-                # already sharded device arrays — pointer swap only
-                self.params = payload
+                # already sharded device arrays — pointer swap (re-quantize
+                # first when serving int8: one fused device pass)
+                self.params = self._quantize(payload) if quantized else payload
             elif kind == "lora":
+                if quantized:
+                    raise RuntimeError(
+                        "lora_only updates cannot fold into int8-quantized "
+                        "serving weights; push full updates or serve with "
+                        "quantization='none'"
+                    )
                 self._apply_lora_delta(*payload)
             elif kind == "disk":
 
@@ -768,7 +808,8 @@ class DecodeEngine:
                         jnp.asarray(arr, dtype=self.model_cfg.jax_dtype), shard
                     )
 
-                self.params, _ = load_params_from_hf(payload, self.model_cfg, put=put)
+                loaded, _ = load_params_from_hf(payload, self.model_cfg, put=put)
+                self.params = self._quantize(loaded) if quantized else loaded
             else:
                 tgt = jax.tree.map(
                     lambda x, s: jax.device_put(
@@ -777,7 +818,7 @@ class DecodeEngine:
                     payload,
                     self.param_shardings,
                 )
-                self.params = tgt
+                self.params = self._quantize(tgt) if quantized else tgt
             if version is not None:
                 self._version = version
             if not self.config.kv_reuse_across_updates:
@@ -825,9 +866,10 @@ class DecodeEngine:
             if mode == "pinned_host":
                 self.params = onload_tree(self.params, None, mode)
             else:
-                # rebuild target shardings from the param spec map
+                # rebuild target shardings from the SERVED structure's spec
+                # map (carries _q8/_scale names under int8 quantization)
                 def shard_of(path):
-                    return mesh_lib.shard_for_path(self.param_shardings, path)
+                    return mesh_lib.shard_for_path(self._serving_shardings, path)
 
                 flat = dict(_iter_tree_paths(self.params))
                 shardings_flat = {p: shard_of(p) for p in flat}

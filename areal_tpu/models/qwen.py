@@ -259,13 +259,70 @@ def merge_lora(params: dict, cfg: ModelConfig) -> dict:
 
 
 def _proj(cfg: ModelConfig, layer: dict, name: str, x: jax.Array) -> jax.Array:
-    """x @ W with the LoRA delta when this layer carries adapters."""
-    out = x @ layer[name]
+    """x @ W with the LoRA delta when this layer carries adapters.
+
+    When the layer carries an int8-quantized weight (``name_q8`` +
+    ``name_scale``, see ``quantize_params_int8``) the matmul reads the int8
+    table and applies the per-output-channel scale to the PRODUCT — scaling
+    commutes through the contraction, so the dequantized [in, out] matrix is
+    never materialized and HBM streams half the bytes. Serving (decode) is
+    weight-bandwidth-bound, so this is a throughput lever, not just memory.
+    """
+    q8 = layer.get(f"{name}_q8")
+    if q8 is not None:
+        y = x @ q8.astype(x.dtype)
+        out = (y.astype(jnp.float32) * layer[f"{name}_scale"]).astype(x.dtype)
+    else:
+        out = x @ layer[name]
     a = layer.get(f"{name}_lora_a")
     if a is not None:
         scale = cfg.lora_alpha / cfg.lora_rank
         out = out + ((x @ a) @ layer[f"{name}_lora_b"]) * scale
     return out
+
+
+# int8 weight-only serving quantization. The reference reaches serving
+# quantization through SGLang/vLLM deployment options; the TPU-native engine
+# provides it as a first-class transform. Dense projection weights only —
+# embed/lm_head stay bf16 (tied-table gather + fp32-sensitive logits), as do
+# norms/biases (tiny) and MoE experts (megablox gmm path; follow-up).
+QUANT_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_params_int8(params: dict) -> dict:
+    """Per-output-channel symmetric int8 quantization of the dense
+    projection weights: W[..., in, out] -> q8 int8 + scale fp32[..., 1, out],
+    with W ≈ q8 * scale. Jit-friendly (pure jnp); leaves every other weight
+    untouched and drops the bf16 originals."""
+    layers = dict(params["layers"])
+    for name in QUANT_TARGETS:
+        w = layers.get(name)
+        if w is None:
+            continue
+        w32 = w.astype(jnp.float32)
+        s = jnp.max(jnp.abs(w32), axis=-2, keepdims=True) / 127.0
+        s = jnp.maximum(s, 1e-12)
+        layers[f"{name}_q8"] = (
+            jnp.round(w32 / s).clip(-127, 127).astype(jnp.int8)
+        )
+        layers[f"{name}_scale"] = s
+        del layers[name]
+    return {**params, "layers": layers}
+
+
+def quant_partition_specs(cfg: ModelConfig, fsdp_axis: str | None = "fsdp") -> dict:
+    """Partition specs matching ``quantize_params_int8`` output: q8 inherits
+    the base weight's spec; the per-out-channel scale keeps only the output
+    dim's sharding."""
+    specs = param_partition_specs(cfg, fsdp_axis)
+    layers = dict(specs["layers"])
+    for name in QUANT_TARGETS:
+        spec = layers.pop(name, None)
+        if spec is None:
+            continue
+        layers[f"{name}_q8"] = spec
+        layers[f"{name}_scale"] = P(spec[0], None, spec[2])
+    return {**specs, "layers": layers}
 
 
 def init_params(rng: jax.Array, cfg: ModelConfig, dtype=None) -> dict:
@@ -507,7 +564,12 @@ def _ffn(cfg: ModelConfig, h: jax.Array, layer: dict) -> jax.Array:
         h3 = h[:, None] if squeeze else h
         out, _ = moe_ffn(h3, layer, cfg)
         return out[:, 0] if squeeze else out
-    return (jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])) @ layer["w_down"]
+    return _proj(
+        cfg,
+        layer,
+        "w_down",
+        jax.nn.silu(_proj(cfg, layer, "w_gate", h)) * _proj(cfg, layer, "w_up", h),
+    )
 
 
 def _decoder_layer(cfg: ModelConfig, x, layer, mask, positions, impl=None):
@@ -811,9 +873,9 @@ def forward_prefill(
     def body(x, layer):
         G, L, D = x.shape
         h = _rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
-        q = h @ layer["wq"]
-        k = h @ layer["wk"]
-        v = h @ layer["wv"]
+        q = _proj(cfg, layer, "wq", h)
+        k = _proj(cfg, layer, "wk", h)
+        v = _proj(cfg, layer, "wv", h)
         if cfg.attention_bias:
             q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
         q = q.reshape(G, L, H, hd)
@@ -829,7 +891,7 @@ def forward_prefill(
             k = jnp.repeat(k, H // KH, axis=2)
             v = jnp.repeat(v, H // KH, axis=2)
         attn = _sdpa(q, k, v, mask, hd).reshape(G, L, H * hd)
-        x = x + attn @ layer["wo"]
+        x = x + _proj(cfg, layer, "wo", attn)
         h = _rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
         x = x + _ffn(cfg, h, layer)
         return x, (k_cache, v_cache)
@@ -875,9 +937,9 @@ def forward_decode_paged(
         x, k_all, v_all = carry
         layer, li = scanned
         h = _rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
-        q = h @ layer["wq"]
-        k = h @ layer["wk"]
-        v = h @ layer["wv"]
+        q = _proj(cfg, layer, "wq", h)
+        k = _proj(cfg, layer, "wk", h)
+        v = _proj(cfg, layer, "wv", h)
         if cfg.attention_bias:
             q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
         q = q.reshape(S, 1, H, hd)
@@ -905,7 +967,7 @@ def forward_decode_paged(
                 q, kp, vp, lengths, page_table
             )
         attn = attn.reshape(S, H * hd).astype(x.dtype)
-        x = x + attn @ layer["wo"]
+        x = x + _proj(cfg, layer, "wo", attn)
         h = _rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
         x = x + _ffn(cfg, h, layer)
         return (x, k_all, v_all), None

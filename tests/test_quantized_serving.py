@@ -1,0 +1,193 @@
+"""int8 weight-only serving quantization (ServerConfig.quantization).
+
+The reference reaches serving quantization through SGLang/vLLM deployment
+options; the TPU engine provides it natively (models/qwen.py
+quantize_params_int8 + the _proj int8 branch). These tests pin:
+  - numerical closeness of the quantized forward to the bf16/fp32 one
+  - the engine serving end-to-end with int8 weights
+  - full weight updates re-quantizing on apply
+  - lora_only updates being refused (no fold base in int8)
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from areal_tpu.api.config import MeshConfig, ServerConfig
+from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+from areal_tpu.inference.decode_engine import DecodeEngine
+from areal_tpu.models import qwen
+
+MODEL_KW = dict(
+    vocab_size=128,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    head_dim=16,
+    dtype="float32",
+    tie_word_embeddings=True,
+)
+
+
+@pytest.fixture(scope="module")
+def cfg_params():
+    cfg = qwen.ModelConfig(**MODEL_KW)
+    params = qwen.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def test_quantize_structure_and_reconstruction(cfg_params):
+    cfg, params = cfg_params
+    qp = qwen.quantize_params_int8(params)
+    for name in qwen.QUANT_TARGETS:
+        if name not in params["layers"]:
+            continue
+        assert name not in qp["layers"]
+        q8 = qp["layers"][f"{name}_q8"]
+        s = qp["layers"][f"{name}_scale"]
+        assert q8.dtype == jnp.int8
+        w = np.asarray(params["layers"][name], np.float32)
+        recon = np.asarray(q8, np.float32) * np.asarray(s, np.float32)
+        # per-out-channel symmetric: |err| <= scale/2 elementwise
+        assert np.all(np.abs(recon - w) <= np.asarray(s, np.float32) / 2 + 1e-8)
+    # untouched leaves pass through
+    assert "embed" in qp and "final_norm" in qp
+    assert "input_norm" in qp["layers"]
+
+
+def test_quantized_prefill_close(cfg_params):
+    cfg, params = cfg_params
+    qp = qwen.quantize_params_int8(params)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 128)
+    pos = jnp.broadcast_to(jnp.arange(16), (2, 16))
+    h_ref, _, _ = qwen.forward_prefill(params, cfg, ids, pos)
+    h_q, _, _ = qwen.forward_prefill(qp, cfg, ids, pos)
+    ref = np.asarray(qwen.compute_logits(params, cfg, h_ref))
+    got = np.asarray(qwen.compute_logits(qp, cfg, h_q))
+    # int8 weight error is ~0.4% per projection; logits track closely
+    err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-6)
+    assert err < 0.05, f"relative logits error {err:.4f}"
+
+
+def _mk_engine(params, model_cfg, **overrides):
+    scfg = ServerConfig(
+        max_batch_size=4,
+        max_seq_len=64,
+        decode_steps_per_call=4,
+        seed=0,
+        quantization="int8",
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        **overrides,
+    )
+    eng = DecodeEngine(scfg, params=params, model_cfg=model_cfg)
+    eng.initialize()
+    return eng
+
+
+def test_engine_serves_int8(cfg_params):
+    cfg, params = cfg_params
+    eng = _mk_engine(params, cfg)
+    # served tree is quantized
+    assert "wq_q8" in eng.params["layers"]
+    assert "wq" not in eng.params["layers"]
+    eng.start()
+    try:
+        r = eng.generate_sync(
+            ModelRequest(
+                input_ids=list(range(1, 9)),
+                gconfig=GenerationHyperparameters(max_new_tokens=8, greedy=True),
+            ),
+            timeout=120,
+        )
+        assert len(r.output_tokens) == 8
+        # greedy int8 serving matches the fp32 model's greedy decode on a
+        # clean-margin model? Not guaranteed in general — assert only that
+        # generation is deterministic across engines
+        r2 = eng.generate_sync(
+            ModelRequest(
+                input_ids=list(range(1, 9)),
+                gconfig=GenerationHyperparameters(max_new_tokens=8, greedy=True),
+            ),
+            timeout=120,
+        )
+        assert r.output_tokens == r2.output_tokens
+    finally:
+        eng.stop()
+
+
+def test_full_update_requantizes(cfg_params):
+    cfg, params = cfg_params
+    eng = _mk_engine(params, cfg)
+    new_params = qwen.init_params(jax.random.PRNGKey(7), cfg)
+    eng.update_weights_from_params(new_params, version=5)
+    assert eng._version == 5
+    q8 = np.asarray(eng.params["layers"]["wq_q8"], np.float32)
+    s = np.asarray(eng.params["layers"]["wq_scale"], np.float32)
+    w = np.asarray(new_params["layers"]["wq"], np.float32)
+    assert np.all(np.abs(q8 * s - w) <= s / 2 + 1e-8)
+    # staged (streamed) path re-quantizes too
+    from areal_tpu.inference.server import flatten_params
+
+    newer = qwen.init_params(jax.random.PRNGKey(8), cfg)
+    eng.begin_staged_update()
+    eng.stage_weight_bucket(flatten_params(jax.tree.map(np.asarray, newer)))
+    eng.commit_staged_weights(version=6)
+    q8 = np.asarray(eng.params["layers"]["wo_q8"], np.float32)
+    s = np.asarray(eng.params["layers"]["wo_scale"], np.float32)
+    w = np.asarray(newer["layers"]["wo"], np.float32)
+    assert np.all(np.abs(q8 * s - w) <= s / 2 + 1e-8)
+
+
+def test_lora_update_refused_when_quantized(cfg_params):
+    cfg, params = cfg_params
+    eng = _mk_engine(params, cfg)
+    rng = np.random.default_rng(0)
+    lora = {}
+    for t in ("wq",):
+        L, d_in, d_out = 2, 64, 64
+        lora[f"layers/{t}_lora_a"] = rng.normal(0, 0.01, (L, d_in, 4)).astype(
+            np.float32
+        )
+        lora[f"layers/{t}_lora_b"] = np.zeros((L, 4, d_out), np.float32)
+    with pytest.raises(RuntimeError, match="int8"):
+        eng.update_weights_lora(lora, scale=0.5, version=2)
+
+
+def test_offload_onload_roundtrip_int8(cfg_params):
+    """release/resume memory must handle the quantized leaf names
+    (layers/wq_q8) — the spec map for the served structure differs from the
+    base param shardings."""
+    cfg, params = cfg_params
+    eng = _mk_engine(params, cfg)
+    before = np.asarray(eng.params["layers"]["wq_q8"])
+    eng.pause_generation()
+    eng.release_memory()
+    assert eng.cache is None
+    eng.resume_memory()
+    eng.continue_generation()
+    after = np.asarray(eng.params["layers"]["wq_q8"])
+    assert np.array_equal(before, after)
+    eng.start()
+    try:
+        r = eng.generate_sync(
+            ModelRequest(
+                input_ids=list(range(1, 9)),
+                gconfig=GenerationHyperparameters(max_new_tokens=4, greedy=True),
+            ),
+            timeout=120,
+        )
+        assert len(r.output_tokens) == 4
+    finally:
+        eng.stop()
+
+
+def test_quant_partition_specs_structure(cfg_params):
+    cfg, params = cfg_params
+    specs = qwen.quant_partition_specs(cfg)
+    qp = qwen.quantize_params_int8(params)
+    # every quantized layer leaf has a spec (scan-stacked layout)
+    for name in qp["layers"]:
+        assert name in specs["layers"], name
