@@ -141,10 +141,15 @@ def phase_decode():
     from areal_tpu.models import qwen
 
     model_cfg = qwen.ModelConfig(**MODEL_KW)
+    # BENCH_QUANT=int8 serves the policy weight-only-quantized (decode is
+    # weight-HBM-bound; the decoupled-PPO loss corrects the behavior-policy
+    # drift) — measured against the bf16 default before promotion
+    quant = os.environ.get("BENCH_QUANT", "none")
     cfg = ServerConfig(
         max_batch_size=128,
         max_seq_len=512,
         decode_steps_per_call=32,
+        quantization=quant,
         mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
     )
     t0 = time.monotonic()
@@ -320,6 +325,7 @@ def phase_decode():
             "tok_s": tok_s,
             "partial": not complete,
             "requests_done": n_done,
+            "quantization": quant,
             "weight_update_secs": wu.get("wu_colocated_secs"),
             **wu,
         }

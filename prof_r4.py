@@ -86,5 +86,74 @@ def phase_async():
     bench.phase_async_sync()
 
 
+def phase_int8():
+    """bf16 vs int8 serving throughput, same engine config as bench decode
+    (128 slots, 128-tok prompts, 256 new tokens). Run AFTER prof_r3 decode
+    has warmed the bf16 programs."""
+    import threading
+
+    import jax
+
+    from areal_tpu.api.config import MeshConfig, ServerConfig
+    from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.models import qwen
+
+    from bench import MODEL_KW
+
+    cfg = qwen.ModelConfig(**MODEL_KW)
+    params = jax.jit(lambda k: qwen.init_params(k, cfg))(jax.random.PRNGKey(0))
+    np.asarray(jax.tree.leaves(params)[0]).ravel()[0]
+    for quant in ("none", "int8"):
+        scfg = ServerConfig(
+            max_batch_size=128,
+            max_seq_len=512,
+            decode_steps_per_call=32,
+            quantization=quant,
+            seed=0,
+            mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        )
+        eng = DecodeEngine(scfg, params=params, model_cfg=cfg)
+        eng.initialize()
+        t0 = time.monotonic()
+        eng.precompile(prompt_buckets=[128])
+        print(f"[{quant}] precompile {time.monotonic()-t0:.1f}s", flush=True)
+        eng.start()
+        rng = np.random.default_rng(0)
+        eng.generate_sync(
+            ModelRequest(
+                input_ids=rng.integers(0, 1000, 128).tolist(),
+                gconfig=GenerationHyperparameters(max_new_tokens=16, temperature=1.0),
+            ),
+            timeout=200,
+        )
+        n_req, done, res, lock = 256, threading.Event(), [], threading.Lock()
+
+        def cb(r):
+            with lock:
+                res.append(r)
+                if len(res) == n_req:
+                    done.set()
+
+        t0 = time.monotonic()
+        for _ in range(n_req):
+            eng.submit(
+                ModelRequest(
+                    input_ids=rng.integers(0, 1000, 128).tolist(),
+                    gconfig=GenerationHyperparameters(
+                        max_new_tokens=256, temperature=1.0
+                    ),
+                ),
+                cb,
+            )
+        ok = done.wait(200)
+        dt = time.monotonic() - t0
+        with lock:
+            gen = sum(len(r.output_tokens) for r in res)
+        print(f"[{quant}] {gen/dt:8.0f} tok/s (ok={ok})", flush=True)
+        eng.stop()
+        del eng
+
+
 if __name__ == "__main__":
-    {"wu": phase_wu, "async": phase_async}[sys.argv[1]]()
+    {"wu": phase_wu, "async": phase_async, "int8": phase_int8}[sys.argv[1]]()
