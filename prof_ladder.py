@@ -79,14 +79,19 @@ def log(msg):
 
 
 def probe() -> bool:
-    p = subprocess.run(
-        [sys.executable, "-c", PROBE_CODE],
-        cwd=REPO,
-        capture_output=True,
-        text=True,
-        timeout=180,
-    )
-    ok = "PROBE_OK tpu" in p.stdout
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", PROBE_CODE],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=180,
+        )
+        ok = "PROBE_OK tpu" in p.stdout
+    except subprocess.TimeoutExpired:
+        # child wedged in C past its in-child alarm; the run() kill already
+        # happened — report blocked so the ladder stops cleanly
+        ok = False
     log(f"probe: {'OK' if ok else 'blocked'}")
     return ok
 
@@ -140,11 +145,17 @@ def main():
             try:
                 lines = open(f"/tmp/ladder_{name}.log").read().splitlines()
                 for ln in reversed(lines):
-                    if ln.startswith("{") and '"metric"' in ln:
-                        with open(os.path.join(REPO, "BENCH_r04_mid.json"), "w") as f:
-                            f.write(ln + "\n")
-                        log(f"BENCH_r04_mid.json written: {ln[:120]}")
-                        break
+                    if not (ln.startswith("{") and '"metric"' in ln):
+                        continue
+                    try:
+                        payload = json.loads(ln)  # a truncated line must not
+                    except json.JSONDecodeError:  # poison the snapshot
+                        continue
+                    with open(os.path.join(REPO, "BENCH_r04_mid.json"), "w") as f:
+                        json.dump(payload, f)
+                        f.write("\n")
+                    log(f"BENCH_r04_mid.json written: {ln[:120]}")
+                    break
             except OSError as e:
                 log(f"snapshot harvest failed: {e}")
         if not ok and not probe():

@@ -352,3 +352,39 @@ def test_inverse_cdf_sampler_distribution():
     np.testing.assert_allclose(logp_np, np.log(want[ids_np]), rtol=1e-5)
     freq = np.bincount(ids_np, minlength=5) / n
     np.testing.assert_allclose(freq, want, atol=0.03)
+
+
+def test_hierarchical_sampler_two_level_path():
+    """V > 512 engages the two-level CDF decomposition (block pick +
+    in-block pick, crossing block boundaries); the draw must still follow
+    the exact softmax and report exact logprobs."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.inference.decode_engine import (
+        _inverse_cdf_sample,
+        _sample_blocks,
+    )
+
+    V = 1024
+    assert V // _sample_blocks(V) > 1  # two-level path engaged
+    rng = np.random.default_rng(3)
+    base = np.full(V, -4.0, np.float32)
+    # peaks straddling block boundaries (inner=2 at V=1024 -> blocks {2k, 2k+1})
+    peaks = {7: 2.0, 8: 1.5, 511: 1.8, 512: 2.2, 1023: 1.0}
+    for k, v in peaks.items():
+        base[k] = v
+    n = 6000
+    logits = jnp.asarray(np.tile(base, (n, 1)))
+    want = np.asarray(jax.nn.softmax(jnp.asarray(base)))
+    ids, logp, lse = jax.jit(_inverse_cdf_sample)(logits, jax.random.PRNGKey(1))
+    ids_np, logp_np = np.asarray(ids), np.asarray(logp)
+    log_softmax = base - np.asarray(lse)[0, 0]
+    np.testing.assert_allclose(logp_np, log_softmax[ids_np], rtol=1e-4, atol=1e-5)
+    freq = np.bincount(ids_np, minlength=V) / n
+    for k in peaks:
+        assert abs(freq[k] - want[k]) < 0.03, (k, freq[k], want[k])
+    # total mass on non-peak tokens also matches
+    mask = np.ones(V, bool)
+    mask[list(peaks)] = False
+    assert abs(freq[mask].sum() - want[mask].sum()) < 0.03
