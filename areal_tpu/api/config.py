@@ -238,6 +238,11 @@ class ServerConfig:
     # logprobs ARE the quantized server's). Reference reaches this through
     # SGLang/vLLM quantized deployments.
     quantization: str = "none"
+    # KV-cache quantization: "none" | "int8" (per-token-vector scales,
+    # matching the TPU paged-attention kernel's QuantizedTensor support).
+    # KV reads dominate decode HBM traffic at long context; int8 halves
+    # them AND doubles the page pool a kv_hbm_gb budget buys.
+    kv_quantization: str = "none"
 
 
 @dataclass

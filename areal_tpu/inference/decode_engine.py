@@ -372,6 +372,9 @@ class DecodeEngine:
         mcfg = self.model_cfg
         S, T, psz = cfg.max_batch_size, cfg.max_seq_len, cfg.page_size
         self._maxp = -(-T // psz)  # pages per sequence (ceil)
+        kv_quant = cfg.kv_quantization == "int8"
+        if cfg.kv_quantization not in (None, "", "none", "int8"):
+            raise ValueError(f"unknown kv_quantization {cfg.kv_quantization!r}")
         if cfg.kv_hbm_gb is not None:
             n_pages = paged_kv.n_pages_for_budget(
                 int(cfg.kv_hbm_gb * (1 << 30)),
@@ -380,15 +383,16 @@ class DecodeEngine:
                 psz,
                 mcfg.head_dim_,
                 jnp.dtype(mcfg.jax_dtype).itemsize,
+                quant=kv_quant,
             )
         else:
             n_pages = S * self._maxp + 1  # +1: trash page 0
         self.pool = paged_kv.PagePool(n_pages)
         tp = self.mesh.shape["model"]
         kv_spec = (
-            paged_kv.paged_cache_specs()
+            paged_kv.paged_cache_specs(quant=kv_quant)
             if mcfg.num_kv_heads % max(tp, 1) == 0
-            else {"k": P(), "v": P()}
+            else {k: P() for k in paged_kv.paged_cache_specs(quant=kv_quant)}
         )
         # the Pallas paged kernel runs single-device; under TP the engine
         # falls back to the gather+einsum path which GSPMD shards over the
@@ -399,7 +403,7 @@ class DecodeEngine:
         )
         with jax.set_mesh(self.mesh):
             self.cache = jax.jit(
-                lambda: paged_kv.init_paged_cache(mcfg, n_pages, psz),
+                lambda: paged_kv.init_paged_cache(mcfg, n_pages, psz, quant=kv_quant),
                 out_shardings={
                     k: NamedSharding(self.mesh, s) for k, s in kv_spec.items()
                 },

@@ -80,32 +80,67 @@ class PagePool:
                 self._free.append(p)
 
 
+# int8 KV quantization convention — matches the library paged-attention
+# kernel's quantization_utils (scales = max|x| over head_dim, q = rint(
+# x * 127.5 / scale)), so quantized pages feed the TPU kernel directly as
+# QuantizedTensor(weight, scales)
+_MAX_INT8 = 127.5
+
+
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """[..., hd] float -> (int8 [..., hd], f32 scale [..., 1])."""
+    x32 = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(x32), axis=-1, keepdims=True), 1e-12)
+    # clip: rint(127.5) would be 128, which wraps in int8 (a latent bug in
+    # the library's own to_int8)
+    q = jnp.clip(jnp.rint(x32 * (_MAX_INT8 / scale)), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    return (q.astype(jnp.float32) * (scale / _MAX_INT8)).astype(dtype)
+
+
 def n_pages_for_budget(
     budget_bytes: int, n_layers: int, num_kv_heads: int, page_size: int,
-    head_dim: int, itemsize: int,
+    head_dim: int, itemsize: int, quant: bool = False,
 ) -> int:
     """Pages fitting a KV HBM budget (k+v across all layers per page)."""
-    page_bytes = 2 * n_layers * num_kv_heads * page_size * head_dim * itemsize
+    vec_bytes = head_dim * (1 if quant else itemsize) + (4 if quant else 0)
+    page_bytes = 2 * n_layers * num_kv_heads * page_size * vec_bytes
     return max(2, budget_bytes // page_bytes)
 
 
 def init_paged_cache(
-    cfg, n_pages: int, page_size: int, dtype=None
+    cfg, n_pages: int, page_size: int, dtype=None, quant: bool = False
 ) -> dict:
-    """k/v page pools: [n_layers, KH, n_pages, page_size, hd]."""
+    """k/v page pools: [n_layers, KH, n_pages, page_size, hd]. With
+    ``quant`` the pages are int8 plus per-token-vector f32 scales
+    ([..., psz, 1]) — halved KV HBM traffic, the decode bottleneck at long
+    context."""
     dtype = dtype or cfg.jax_dtype
     shape = (cfg.num_layers, cfg.num_kv_heads, n_pages, page_size, cfg.head_dim_)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if not quant:
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    sshape = shape[:-1] + (1,)
+    return {
+        "k": jnp.zeros(shape, jnp.int8),
+        "v": jnp.zeros(shape, jnp.int8),
+        "k_scale": jnp.ones(sshape, jnp.float32),
+        "v_scale": jnp.ones(sshape, jnp.float32),
+    }
 
 
-def paged_cache_specs():
+def paged_cache_specs(quant: bool = False):
     """PartitionSpecs: KV heads shard over the TP axis when they divide."""
     from jax.sharding import PartitionSpec as P
 
-    return {
-        "k": P(None, "model", None, None, None),
-        "v": P(None, "model", None, None, None),
-    }
+    spec = P(None, "model", None, None, None)
+    out = {"k": spec, "v": spec}
+    if quant:
+        out["k_scale"] = spec
+        out["v_scale"] = spec
+    return out
 
 
 def scatter_prefill(cache: dict, ks: jax.Array, vs: jax.Array, flat_pages: jax.Array, page_size: int) -> dict:
@@ -123,21 +158,27 @@ def scatter_prefill(cache: dict, ks: jax.Array, vs: jax.Array, flat_pages: jax.A
         vs = jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
         bucket += pad
     npg = bucket // page_size
+    quant = "k_scale" in cache
     for name, new in (("k", ks), ("v", vs)):
         # [L, A, bucket, KH, hd] -> [L, KH, A*npg, page_size, hd]
         r = jnp.transpose(new, (0, 3, 1, 2, 4)).reshape(
             L, KH, A * npg, page_size, hd
         )
-        cache[name] = cache[name].at[:, :, flat_pages].set(
-            r.astype(cache[name].dtype)
-        )
+        if quant:
+            q, s = quantize_kv(r)
+            cache[name] = cache[name].at[:, :, flat_pages].set(q)
+            cache[f"{name}_scale"] = cache[f"{name}_scale"].at[:, :, flat_pages].set(s)
+        else:
+            cache[name] = cache[name].at[:, :, flat_pages].set(
+                r.astype(cache[name].dtype)
+            )
     return cache
 
 
 def copy_pages(cache: dict, dst: jax.Array, src: jax.Array) -> dict:
     """Copy page contents src[i] -> dst[i] (partial-page duplication for
     prefix sharing; a few pages, all layers at once)."""
-    for name in ("k", "v"):
+    for name in cache:  # k/v (+ k_scale/v_scale under int8 KV)
         cache[name] = cache[name].at[:, :, dst].set(cache[name][:, :, src])
     return cache
 
@@ -148,6 +189,8 @@ def paged_attention_xla(
     v_pages: jax.Array,
     lengths: jax.Array,  # [S] int32 valid rows per slot
     page_table: jax.Array,  # [S, wp] int32 (window's pages)
+    k_scales: jax.Array | None = None,  # [KH, N, psz, 1] (int8 KV)
+    v_scales: jax.Array | None = None,
 ) -> jax.Array:
     """Reference/CPU path: gather the window's pages, grouped masked einsum —
     numerically identical to the dense engine's attention."""
@@ -163,6 +206,15 @@ def paged_attention_xla(
     vv = jnp.transpose(v_pages[:, page_table], (1, 2, 3, 0, 4)).reshape(
         S, W, KH, hd
     )
+    if k_scales is not None:
+        ks_g = jnp.transpose(k_scales[:, page_table], (1, 2, 3, 0, 4)).reshape(
+            S, W, KH, 1
+        )
+        vs_g = jnp.transpose(v_scales[:, page_table], (1, 2, 3, 0, 4)).reshape(
+            S, W, KH, 1
+        )
+        kk = dequantize_kv(kk, ks_g, q.dtype)
+        vv = dequantize_kv(vv, vs_g, q.dtype)
     qg = q.reshape(S, KH, G, hd)
     logits = jnp.einsum("skgd,stkd->skgt", qg, kk).astype(jnp.float32) * hd**-0.5
     valid = jnp.arange(W)[None, :] < lengths[:, None]
@@ -178,15 +230,38 @@ def paged_attention_tpu(
     lengths: jax.Array,  # [S] int32
     page_table: jax.Array,  # [S, wp] int32
     pages_per_compute_block: int = 4,
+    k_scales: jax.Array | None = None,  # [KH, N, psz, 1] (int8 KV)
+    v_scales: jax.Array | None = None,
 ) -> jax.Array:
     """jax's Pallas TPU paged-attention kernel (grouped-query flash over the
-    page table; reads only each sequence's pages)."""
-    from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention
-
+    page table; reads only each sequence's pages). int8 pages go through
+    the NARROW-scales fork (ops/paged_attention_q8.py): the library wrapper
+    would broadcast the [..., 1] scales to head_dim, inverting the
+    halved-HBM premise; the fork keeps them narrow end to end and
+    dequantizes in VMEM."""
     wp = page_table.shape[1]
     ppcb = pages_per_compute_block
     while wp % ppcb:
         ppcb //= 2
+    # the library kernel applies NO 1/sqrt(hd) to the logits — callers
+    # pre-scale q (verified against a dense reference in interpret mode;
+    # the XLA path above scales internally)
+    q = q * (q.shape[-1] ** -0.5)
+    if k_scales is not None:
+        from areal_tpu.ops.paged_attention_q8 import paged_attention_q8
+
+        return paged_attention_q8(
+            q,
+            k_pages,
+            k_scales,
+            v_pages,
+            v_scales,
+            lengths,
+            page_table,
+            pages_per_compute_block=max(1, ppcb),
+        )
+    from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention
+
     return paged_attention(
         q,
         k_pages,

@@ -932,9 +932,10 @@ def forward_decode_paged(
     slot = jnp.arange(S)
     write_page = page_table[slot, positions // page_size]  # [S]
     write_off = positions % page_size  # [S]
+    kv_quant = "k_scale" in cache  # int8 pages + per-vector scales
 
     def body(carry, scanned):
-        x, k_all, v_all = carry
+        x, c = carry
         layer, li = scanned
         h = _rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
         q = _proj(cfg, layer, "wq", h)
@@ -954,30 +955,44 @@ def forward_decode_paged(
         # write the step's rows into (li, :, page[s], offset[s]). The traced
         # ``li`` makes all three advanced indices broadcast together and the
         # slice dim (KH) stay behind them -> value layout [S, KH, hd].
-        k_all = k_all.at[li, :, write_page, write_off].set(k.astype(k_all.dtype))
-        v_all = v_all.at[li, :, write_page, write_off].set(v.astype(v_all.dtype))
-        kp = jax.lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)
-        vp = jax.lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)
+        c = dict(c)
+        if kv_quant:
+            kq, ksc = paged_kv.quantize_kv(k)
+            vq, vsc = paged_kv.quantize_kv(v)
+            writes = (("k", kq), ("k_scale", ksc), ("v", vq), ("v_scale", vsc))
+        else:
+            writes = (("k", k), ("v", v))
+        for name, val in writes:
+            c[name] = c[name].at[li, :, write_page, write_off].set(
+                val.astype(c[name].dtype)
+            )
+        sl = {
+            name: jax.lax.dynamic_index_in_dim(c[name], li, 0, keepdims=False)
+            for name in c
+        }
+        scales = (
+            dict(k_scales=sl["k_scale"], v_scales=sl["v_scale"]) if kv_quant else {}
+        )
         if use_kernel:
             attn = paged_kv.paged_attention_tpu(
-                q, kp, vp, lengths, page_table
+                q, sl["k"], sl["v"], lengths, page_table, **scales
             )
         else:
             attn = paged_kv.paged_attention_xla(
-                q, kp, vp, lengths, page_table
+                q, sl["k"], sl["v"], lengths, page_table, **scales
             )
         attn = attn.reshape(S, H * hd).astype(x.dtype)
         x = x + _proj(cfg, layer, "wo", attn)
         h = _rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
         x = x + _ffn(cfg, h, layer)
-        return (x, k_all, v_all), None
+        return (x, c), None
 
-    (x, ks, vs), _ = jax.lax.scan(
+    (x, out_cache), _ = jax.lax.scan(
         body,
-        (x, cache["k"], cache["v"]),
+        (x, dict(cache)),
         (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
     )
     hidden = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    return hidden, {"k": ks, "v": vs}
+    return hidden, out_cache
 
 

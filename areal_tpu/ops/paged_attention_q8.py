@@ -1,0 +1,143 @@
+"""Paged attention over int8-quantized KV pages with NARROW scales.
+
+jax's library wrapper (jax.experimental.pallas.ops.tpu.paged_attention)
+accepts QuantizedTensor pages but ``jnp.broadcast_to``s the [..., psz, 1]
+scales to full head_dim before the pallas_call — materializing a fp32
+array 2x the size of the bf16 cache per layer and DMA-ing 4 scale bytes
+per 1-byte KV element, which INVERTS the halved-HBM premise of int8 KV.
+The kernel bodies themselves don't need that: the in-VMEM dequant
+(``from_int8: x * h / 127.5``) broadcasts a trailing-1 scale natively.
+
+This module is a minimal fork of ONLY the launch wrapper (Apache-2.0, from
+jax's paged_attention_kernel.py) that:
+  - keeps scales at [num_kv_heads, total_pages, page_size, 1] end to end
+    (HBM operand, VMEM scratch, DMA) — 4/head_dim the traffic
+  - exposes ``interpret=`` so the kernel path is CPU-testable
+  - supports the engine's usage only: megacore_mode=None, inline seq dim
+
+The kernel body and copy descriptor are imported from the library
+unmodified — they are shape-generic over the scales' trailing dim.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.pallas.ops.tpu.paged_attention.paged_attention_kernel import (
+    DEFAULT_MASK_VALUE,
+    paged_flash_attention_kernel_inline_seq_dim,
+)
+
+
+def paged_attention_q8(
+    q: jax.Array,  # [S, H, hd]
+    k_pages: jax.Array,  # int8 [KH, N, psz, hd]
+    k_scales: jax.Array,  # f32 [KH, N, psz, 1]
+    v_pages: jax.Array,
+    v_scales: jax.Array,
+    lengths: jax.Array,  # i32 [S]
+    page_indices: jax.Array,  # i32 [S, pages_per_sequence]
+    *,
+    pages_per_compute_block: int,
+    mask_value: float = DEFAULT_MASK_VALUE,
+    attn_logits_soft_cap: float | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    batch_size, num_q_heads, head_dim = q.shape
+    orig_dtype = q.dtype
+    num_kv_heads, _, page_size, head_dim_k = k_pages.shape
+    _, pages_per_sequence = page_indices.shape
+    if k_pages.shape != v_pages.shape:
+        raise ValueError(f"k/v page shapes differ: {k_pages.shape} {v_pages.shape}")
+    if k_scales.shape != (*k_pages.shape[:-1], 1):
+        raise ValueError(f"narrow scales expected, got {k_scales.shape}")
+    if num_q_heads % num_kv_heads:
+        raise ValueError(f"H={num_q_heads} not divisible by KH={num_kv_heads}")
+    if head_dim_k != head_dim:
+        raise ValueError(f"head_dim mismatch {head_dim} vs {head_dim_k}")
+    if pages_per_sequence % pages_per_compute_block:
+        raise ValueError(
+            f"pages_per_sequence={pages_per_sequence} not divisible by "
+            f"pages_per_compute_block={pages_per_compute_block}"
+        )
+
+    num_groups = num_q_heads // num_kv_heads
+    if num_groups % 8 != 0:
+        # <1x128> layout hint (library comment): reshape q to 4-D
+        q = q.reshape(batch_size, num_q_heads, 1, head_dim)
+        q_block_spec = pl.BlockSpec(
+            (None, num_groups, None, head_dim), lambda core, b, h, *_: (b, h, 0, 0)
+        )
+        q_dtype_for_kernel_launch = jnp.float32
+    else:
+        q_block_spec = pl.BlockSpec(
+            (None, num_groups, head_dim), lambda core, b, h, *_: (b, h, 0)
+        )
+        q_dtype_for_kernel_launch = q.dtype
+
+    grid = (1, batch_size, num_kv_heads)  # megacore_mode=None
+    dimension_semantics = ("parallel", "arbitrary", "arbitrary")
+    in_specs = [
+        q_block_spec,
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+
+    def kv_vmem(dtype, trailing):
+        return pltpu.VMEM(
+            (2, pages_per_compute_block, page_size, trailing), dtype
+        )
+
+    scratch_shapes = (
+        kv_vmem(k_pages.dtype, head_dim),  # k pages buffer
+        kv_vmem(k_scales.dtype, 1),  # k scales buffer (NARROW)
+        kv_vmem(v_pages.dtype, head_dim),
+        kv_vmem(v_scales.dtype, 1),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+    )
+
+    out, _, _ = pl.pallas_call(
+        functools.partial(
+            paged_flash_attention_kernel_inline_seq_dim,
+            pages_per_sequence=pages_per_sequence,
+            batch_size=batch_size,
+            pages_per_compute_block=pages_per_compute_block,
+            mask_value=mask_value,
+            attn_logits_soft_cap=attn_logits_soft_cap,
+            megacore_mode=None,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            in_specs=in_specs,
+            out_specs=[q_block_spec, q_block_spec, q_block_spec],
+            grid=grid,
+            scratch_shapes=scratch_shapes,
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=dimension_semantics
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q_dtype_for_kernel_launch),
+            jax.ShapeDtypeStruct((*q.shape[:-1], 1), jnp.float32),
+            jax.ShapeDtypeStruct((*q.shape[:-1], 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        lengths,
+        page_indices.reshape(-1),
+        jnp.zeros((1,), jnp.int32),  # buffer index
+        jnp.ones((1,), jnp.int32),  # init flag
+        q.astype(q_dtype_for_kernel_launch),
+        k_pages,
+        k_scales,
+        v_pages,
+        v_scales,
+    )
+    return out.reshape(batch_size, num_q_heads, head_dim).astype(orig_dtype)

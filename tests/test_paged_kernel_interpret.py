@@ -1,0 +1,88 @@
+"""Pallas paged-attention kernel parity in interpret mode (CPU).
+
+These caught a real on-chip bug: jax's library kernel applies NO 1/sqrt(hd)
+logit scaling (callers pre-scale q), while the XLA gather path scales
+internally — so the TPU kernel path served over-peaked attention until
+paged_attention_tpu gained the pre-scale. tests_tpu/ re-checks on real
+hardware; this file keeps the parity under CI without a chip.
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from areal_tpu.inference import paged_kv
+
+
+def _setup(S=4, KH=2, G=6, hd=128, psz=16, wp=4, seed=0):
+    rng = np.random.default_rng(seed)
+    H = KH * G
+    N = S * wp + 1
+    q = jnp.asarray(rng.normal(0, 1, (S, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(0, 1, (KH, N, psz, hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1, (KH, N, psz, hd)), jnp.float32)
+    pt = jnp.asarray(1 + np.arange(S * wp).reshape(S, wp), jnp.int32)
+    lengths = jnp.asarray(rng.integers(1, wp * psz + 1, S), jnp.int32)
+    return q, k, v, lengths, pt
+
+
+def test_xla_path_matches_dense_reference():
+    """Ground truth: the XLA path IS scaled dot-product attention."""
+    q, k, v, lengths, pt = _setup(S=1, KH=1, G=8, wp=2)
+    W = 2 * 16
+    lengths = jnp.asarray([W], jnp.int32)
+    kk = np.concatenate([np.asarray(k)[0, p] for p in np.asarray(pt)[0]], axis=0)
+    vv = np.concatenate([np.asarray(v)[0, p] for p in np.asarray(pt)[0]], axis=0)
+    qq = np.asarray(q)[0]
+    probs = np.asarray(
+        jax.nn.softmax(jnp.asarray(qq @ kk.T / np.sqrt(q.shape[-1])), axis=-1)
+    )
+    want = probs @ vv
+    got = np.asarray(paged_kv.paged_attention_xla(q, k, v, lengths, pt))[0]
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_q8_kernel_interpret_matches_xla():
+    """The narrow-scales int8 fork (ops/paged_attention_q8.py) against the
+    gather+dequant XLA path, through the paged_attention_tpu entry point."""
+    import areal_tpu.ops.paged_attention_q8 as q8mod
+
+    q, k, v, lengths, pt = _setup()
+    kq, ks = paged_kv.quantize_kv(k)
+    vq, vs = paged_kv.quantize_kv(v)
+    ref = paged_kv.paged_attention_xla(q, kq, vq, lengths, pt, ks, vs)
+    out = q8mod.paged_attention_q8(
+        q * (q.shape[-1] ** -0.5),
+        kq,
+        ks,
+        vq,
+        vs,
+        lengths,
+        pt,
+        pages_per_compute_block=2,
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
+    )
+
+
+def test_bf16_library_kernel_interpret_matches_xla():
+    """The library kernel through paged_attention_tpu (incl. the q
+    pre-scale) against the XLA path."""
+    import unittest.mock as mock
+
+    import jax.experimental.pallas.ops.tpu.paged_attention.paged_attention_kernel as pk
+
+    q, k, v, lengths, pt = _setup(seed=1)
+    ref = paged_kv.paged_attention_xla(q, k, v, lengths, pt)
+    with mock.patch.object(
+        pk.pl, "pallas_call", functools.partial(pk.pl.pallas_call, interpret=True)
+    ):
+        out = paged_kv.paged_attention_tpu(q, k, v, lengths, pt)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
+    )

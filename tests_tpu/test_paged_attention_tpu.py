@@ -76,3 +76,24 @@ def test_decode_chunk_greedy_parity_kernel_vs_xla():
         logits = jax.jit(lambda p, h: qwen.compute_logits(p, cfg, h))(params, hid)
         outs[use_kernel] = np.asarray(jnp.argmax(logits, -1))
     np.testing.assert_array_equal(outs[True], outs[False])
+
+
+def test_paged_attention_q8_kernel_matches_xla_on_chip():
+    """Narrow-scales int8 kernel fork (ops/paged_attention_q8.py) on real
+    TPU vs the gather+dequant XLA path (CPU-validated in interpret mode by
+    tests/test_paged_kernel_interpret.py)."""
+    q, k, v, lengths, pt = _setup()
+    kq, ks = paged_kv.quantize_kv(k.astype(jnp.float32))
+    vq, vs = paged_kv.quantize_kv(v.astype(jnp.float32))
+    ref = jax.jit(paged_kv.paged_attention_xla)(q, kq, vq, lengths, pt, ks, vs)
+    out = jax.jit(
+        lambda *a: paged_kv.paged_attention_tpu(
+            a[0], a[1], a[2], a[3], a[4], k_scales=a[5], v_scales=a[6]
+        )
+    )(q, kq, vq, lengths, pt, ks, vs)
+    np.testing.assert_allclose(
+        np.asarray(ref, np.float32),
+        np.asarray(out, np.float32),
+        atol=3e-2,
+        rtol=3e-2,
+    )
