@@ -155,14 +155,16 @@ def _inverse_cdf_sample(scaled, rng):
     return ids, logp, lse
 
 
-def _sample_step(logits, rng, state, capped: bool):
+def _sample_step(logits, rng, state, capped: bool, greedy_any: bool = True):
     """One sampling step. logits [S, V] fp32; all sampling knobs are
     *per-slot arrays* in ``state`` (temp, greedy, top_k, top_p) so one
     request's config can never leak into another slot (round-1 correctness
     bug: engine-global top_k/top_p compiled into the chunk).
 
-    ``capped`` is a static flag: when no active slot filters, the top-k
-    candidate machinery is compiled out entirely."""
+    ``capped`` and ``greedy_any`` are static flags: when no active slot
+    filters (resp. decodes greedily), the top-k candidate machinery (resp.
+    the full-vocab argmax pass — a [S, V] fp32 HBM read per step) is
+    compiled out entirely."""
     V = logits.shape[-1]
     temp, greedy = state["temp"], state["greedy"]
     safe_t = jnp.maximum(temp, 1e-6)[:, None]
@@ -187,12 +189,16 @@ def _sample_step(logits, rng, state, capped: bool):
         )[:, 0]
         use_cap = (state["top_k"] > 0) | (state["top_p"] < 1.0)
         sampled = jnp.where(use_cap, cap_ids, sampled)
-    arg = jnp.argmax(logits, axis=-1)
-    next_ids = jnp.where(greedy, arg, sampled).astype(jnp.int32)
-    greedy_logp = (
-        jnp.take_along_axis(scaled, arg[:, None], axis=-1) - lse
-    )[:, 0]
-    logp = jnp.where(greedy, greedy_logp, samp_logp)
+    if greedy_any:
+        arg = jnp.argmax(logits, axis=-1)
+        next_ids = jnp.where(greedy, arg, sampled).astype(jnp.int32)
+        greedy_logp = (
+            jnp.take_along_axis(scaled, arg[:, None], axis=-1) - lse
+        )[:, 0]
+        logp = jnp.where(greedy, greedy_logp, samp_logp)
+    else:
+        next_ids = sampled.astype(jnp.int32)
+        logp = samp_logp
     if capped:
         logp = jnp.where(use_cap & ~greedy, cap_logp, logp)
     return next_ids, logp
@@ -518,10 +524,15 @@ class DecodeEngine:
 
         tasks: list[Callable[[], Any]] = []
         for wp in self._reachable_chunk_wps():
-            for capped in (False, True):
+            for capped, greedy_any in (
+                (False, False),  # the serving steady state (pure sampling)
+                (False, True),
+                (True, False),
+                (True, True),
+            ):
                 tasks.append(
-                    lambda wp=wp, capped=capped: self._chunk_fn(
-                        cfg.decode_steps_per_call, wp, capped
+                    lambda wp=wp, capped=capped, greedy_any=greedy_any: self._chunk_fn(
+                        cfg.decode_steps_per_call, wp, capped, greedy_any
                     ).lower(
                         params_s,
                         cache_s,
@@ -1021,7 +1032,7 @@ class DecodeEngine:
             emb[j, pos[:n]] = out[:n]
         return emb
 
-    def _chunk_fn(self, n_steps: int, wp: int, capped: bool):
+    def _chunk_fn(self, n_steps: int, wp: int, capped: bool, greedy_any: bool = True):
         """n_steps of decode for all slots in one jitted call, attending over
         each slot's first ``wp`` KV pages (the window, bucketed in pages).
 
@@ -1032,7 +1043,7 @@ class DecodeEngine:
         monotone within a chunk (a stopped slot never re-activates; admits
         happen between chunks), so per-slot counts fully describe the
         emit mask."""
-        key = ("chunk", n_steps, wp, capped)
+        key = ("chunk", n_steps, wp, capped, greedy_any)
         if key not in self._fn_cache:
             mcfg = self.model_cfg
             T = self.config.max_seq_len
@@ -1054,7 +1065,9 @@ class DecodeEngine:
                     )
                     logits = qwen.compute_logits(params, mcfg, hidden)
                     rng, sub = jax.random.split(rng)
-                    next_ids, logp = _sample_step(logits, sub, state, capped)
+                    next_ids, logp = _sample_step(
+                        logits, sub, state, capped, greedy_any
+                    )
                     emitted = active
                     hit_stop = jnp.any(
                         next_ids[:, None] == state["stop_ids"], axis=-1
@@ -1697,7 +1710,8 @@ class DecodeEngine:
         )
         wp = min(self._maxp, -(-window // psz))
         capped = bool(((st["top_k"] > 0) | (st["top_p"] < 1.0))[active].any())
-        chunk = self._chunk_fn(n_steps, wp, capped)
+        greedy_any = bool(st["greedy"][active].any())
+        chunk = self._chunk_fn(n_steps, wp, capped, greedy_any)
         with jax.set_mesh(self.mesh):
             pt = jnp.asarray(self._pt_host[:, :wp])
             self.cache, self._dev_state, self._rng, packed = chunk(
