@@ -73,13 +73,13 @@ def test_quantized_prefill_close(cfg_params):
 
 
 def _mk_engine(params, model_cfg, **overrides):
+    overrides.setdefault("mesh", MeshConfig(data=-1, fsdp=1, seq=1, model=1))
     scfg = ServerConfig(
         max_batch_size=4,
         max_seq_len=64,
         decode_steps_per_call=4,
         seed=0,
         quantization="int8",
-        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
         **overrides,
     )
     eng = DecodeEngine(scfg, params=params, model_cfg=model_cfg)
@@ -180,6 +180,33 @@ def test_offload_onload_roundtrip_int8(cfg_params):
             timeout=120,
         )
         assert len(r.output_tokens) == 4
+    finally:
+        eng.stop()
+
+
+def test_tp_sharded_int8_serving(cfg_params):
+    """int8 weights + int8 KV on a model=2 TP mesh (8-dev CPU): the
+    quantized leaves must place under quant_partition_specs and the XLA
+    gather+dequant attention path must run sharded."""
+    cfg, params = cfg_params
+    eng = _mk_engine(
+        params,
+        cfg,
+        kv_quantization="int8",
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=2),
+    )
+    assert eng.cache["k"].dtype == jnp.int8
+    assert "wq_q8" in eng.params["layers"]
+    eng.start()
+    try:
+        r = eng.generate_sync(
+            ModelRequest(
+                input_ids=list(range(1, 9)),
+                gconfig=GenerationHyperparameters(max_new_tokens=8, greedy=True),
+            ),
+            timeout=180,
+        )
+        assert len(r.output_tokens) == 8
     finally:
         eng.stop()
 
