@@ -257,14 +257,8 @@ class DecodeEngine:
                 self.mesh, qwen.param_partition_specs(self.model_cfg)
             )
 
-            def put(path, arr):
-                shard = mesh_lib.shard_for_path(self.param_shardings, path)
-                return jax.device_put(
-                    jnp.asarray(arr, dtype=self.model_cfg.jax_dtype), shard
-                )
-
             self.params, _ = load_params_from_hf(
-                cfg.model_path, self.model_cfg, put=put
+                cfg.model_path, self.model_cfg, put=self._place
             )
             if self.model_cfg.vision is not None and "vision" not in self.params:
                 # checkpoint shipped no visual.* weights (models/hf.py loads
@@ -294,6 +288,17 @@ class DecodeEngine:
             self.param_shardings = mesh_lib.param_sharding(
                 self.mesh, qwen.param_partition_specs(self.model_cfg)
             )
+            # caller-provided params (colocated trainers, tests) arrive with
+            # whatever placement the caller had — often replicated or
+            # single-device. Reshard toward the serving specs; without this
+            # a TP mesh serves fully-replicated weights (no memory saving,
+            # and the quantized leaves inherit the replication)
+            from areal_tpu.inference.server import _unflatten
+
+            with jax.set_mesh(self.mesh):
+                self.params = _unflatten(
+                    {p: self._place(p, a) for p, a in _iter_tree_paths(self.params)}
+                )
 
         # the UNQUANTIZED param structure: weight updates arrive as bf16
         # trees with base names regardless of serving quantization, so
@@ -352,6 +357,16 @@ class DecodeEngine:
             f"decode engine ready: {S} slots × {T} ctx, "
             f"{self.pool.n_pages} KV pages × {cfg.page_size} tokens, "
             f"mesh {dict(self.mesh.shape)}"
+        )
+
+    def _place(self, path: str, arr) -> jax.Array:
+        """THE placement policy for incoming base-named weights: cast to the
+        serving dtype and device_put toward the base param shardings. Used
+        by HF load, caller-provided-params reshard, staged-bucket ingest,
+        and disk updates — keep them identical."""
+        return jax.device_put(
+            jnp.asarray(arr, dtype=self.model_cfg.jax_dtype),
+            mesh_lib.shard_for_path(self.param_shardings, path),
         )
 
     def _quantize(self, params: dict) -> dict:
@@ -773,12 +788,7 @@ class DecodeEngine:
     def stage_weight_bucket(self, flat: dict[str, np.ndarray]) -> None:
         """Stage one bucket: device_put each tensor toward its target
         sharding immediately (async dispatch)."""
-        staged = {}
-        for name, arr in flat.items():
-            shard = mesh_lib.shard_for_path(self.param_shardings, name)
-            staged[name] = jax.device_put(
-                jnp.asarray(arr, dtype=self.model_cfg.jax_dtype), shard
-            )
+        staged = {name: self._place(name, arr) for name, arr in flat.items()}
         with self._weight_lock:
             assert self._staged_flat is not None, "begin_staged_update first"
             self._staged_flat.update(staged)
@@ -848,14 +858,9 @@ class DecodeEngine:
                     )
                 self._apply_lora_delta(*payload)
             elif kind == "disk":
-
-                def put(path, arr):
-                    shard = mesh_lib.shard_for_path(self.param_shardings, path)
-                    return jax.device_put(
-                        jnp.asarray(arr, dtype=self.model_cfg.jax_dtype), shard
-                    )
-
-                loaded, _ = load_params_from_hf(payload, self.model_cfg, put=put)
+                loaded, _ = load_params_from_hf(
+                    payload, self.model_cfg, put=self._place
+                )
                 self.params = self._quantize(loaded) if quantized else loaded
             else:
                 tgt = jax.tree.map(

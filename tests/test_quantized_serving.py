@@ -197,6 +197,18 @@ def test_tp_sharded_int8_serving(cfg_params):
     )
     assert eng.cache["k"].dtype == jnp.int8
     assert "wq_q8" in eng.params["layers"]
+    # the int8 table must actually LAND sharded over the TP axis (sharding
+    # propagates from the bf16 input through the elementwise quantize) —
+    # a replicated regression would still generate fine on CPU
+    def axes(spec):
+        flat = []
+        for part in spec:
+            if part is None:
+                continue
+            flat.extend(part if isinstance(part, tuple) else (part,))
+        return flat
+
+    assert "model" in axes(eng.params["layers"]["wq_q8"].sharding.spec)
     eng.start()
     try:
         r = eng.generate_sync(

@@ -42,11 +42,16 @@ def reward_fn(prompt, completions, prompt_ids, completion_ids, **kw):
     return 1.0 if TARGET in completion_ids else 0.0
 
 
-@pytest.fixture(scope="module")
-def stack(tmp_path_factory):
+@pytest.fixture(scope="module", params=["bf16", "int8"])
+def stack(request, tmp_path_factory):
+    """Parametrized over the serving mode: "int8" serves the rollout policy
+    weight-only-int8-quantized with int8 KV — the learning gate then proves
+    the decoupled-PPO story end to end (behavior logprobs are the quantized
+    server's own; the IS weights absorb the drift)."""
     import jax
 
-    root = str(tmp_path_factory.mktemp("rl_e2e"))
+    quant = request.param
+    root = str(tmp_path_factory.mktemp(f"rl_e2e_{quant}"))
     actor_cfg = PPOActorConfig(
         init_from_scratch=True,
         dtype="float32",
@@ -72,6 +77,8 @@ def stack(tmp_path_factory):
         max_seq_len=64,
         decode_steps_per_call=4,
         seed=0,  # deterministic sampling stream (deflake, VERDICT r03 weak #1)
+        quantization="int8" if quant == "int8" else "none",
+        kv_quantization="int8" if quant == "int8" else "none",
         mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
     )
     dec = DecodeEngine(
