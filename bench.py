@@ -296,8 +296,10 @@ def phase_decode():
             a.size * 2 for a in flat_meta.values()  # bf16 wire bytes
         )
         # probe with ONE leaf sliced to ~the budget: accumulating whole
-        # leaves overshoots badly (embed alone is 467 MB bf16 at 1.5B)
-        budget = 100 * (1 << 20)
+        # leaves overshoots badly (embed alone is 467 MB bf16 at 1.5B).
+        # 48 MB: enough for a stable rate estimate, small enough that a
+        # ~10 MB/s relay day can't eat the phase deadline
+        budget = 48 * (1 << 20)
         name, arr = max(flat_meta.items(), key=lambda kv: kv[1].size)
         per_row = max(1, arr.size // arr.shape[0]) * 2
         rows = max(1, min(arr.shape[0], budget // per_row))

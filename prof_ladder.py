@@ -52,6 +52,15 @@ STEPS = [
         1600,
         "import bench; bench.main()",
     ),
+    (
+        # decode phase rerun with int8 serving: the BENCH_PHASE line in this
+        # step's log vs bench_full's decode line is the promotion decision
+        # for making int8 the default bench config
+        "bench_decode_int8",
+        700,
+        "import os; os.environ['BENCH_QUANT'] = 'int8'\n"
+        "import bench; bench._run_phase_child('decode')",
+    ),
 ]
 
 # the alarm handler must RAISE (not default-terminate): only a normal
