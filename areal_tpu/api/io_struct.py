@@ -108,6 +108,13 @@ class WeightUpdateMeta:
     # ``lora_scale`` (= alpha/rank) when it builds the update.
     lora_only: bool = False
     lora_scale: float = 0.0
+    # mem-mode wire format: "bf16" streams full-precision-ish leaves and
+    # int8-serving servers re-quantize on apply; "q8" pre-quantizes the
+    # dense projection leaves client-side (same per-out-channel transform
+    # the server would run) — half the wire bytes AND no bf16-then-
+    # requantize double rounding. Requires servers running
+    # ServerConfig.quantization="int8".
+    wire_format: str = "bf16"
 
     @classmethod
     def new_disk_update(cls, path: str) -> "WeightUpdateMeta":

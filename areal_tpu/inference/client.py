@@ -343,6 +343,10 @@ class RemoteJaxEngine(InferenceEngine):
             assert params is not None
             import concurrent.futures
 
+            if meta.wire_format == "q8":
+                params = self._quantize_for_wire(params)
+            elif meta.wire_format not in (None, "", "bf16"):
+                raise ValueError(f"unknown wire_format {meta.wire_format!r}")
             plan = self._plan_weight_buckets(params)
             enc_pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
             first = enc_pool.submit(self._encode_bucket, plan[0])
@@ -368,6 +372,17 @@ class RemoteJaxEngine(InferenceEngine):
         )
         self._version = version
 
+    @staticmethod
+    def _quantize_for_wire(params: dict) -> dict:
+        """q8 wire format: pre-quantize the dense projection leaves with the
+        SAME transform an int8-serving server runs (qwen.quantize_dense_int8)
+        — half the wire bytes, and strictly more faithful than bf16-then-
+        server-requantize (no double rounding). The staged tree arrives in
+        served form; non-int8 servers reject it at stage time."""
+        from areal_tpu.models import qwen
+
+        return qwen.quantize_params_int8(params)
+
     def _plan_weight_buckets(self, params: dict) -> list[list[tuple[str, object]]]:
         """Greedy-pack flattened leaves into ~weight_chunk_mb buckets."""
         flat: list[tuple[str, object]] = []
@@ -385,7 +400,20 @@ class RemoteJaxEngine(InferenceEngine):
         buckets: list[list[tuple[str, object]]] = [[]]
         size = 0
         for key, v in flat:
-            nbytes = int(np.prod(v.shape)) * 2 if hasattr(v, "shape") else 8
+            if not hasattr(v, "shape"):
+                nbytes = 8
+            else:
+                # wire bytes: floats travel bf16 (except f32 scale planes),
+                # int8 stays int8
+                kind = getattr(v.dtype, "kind", "f")
+                itemsize = (
+                    4
+                    if key.endswith("_scale")
+                    else 2
+                    if kind == "f"
+                    else v.dtype.itemsize
+                )
+                nbytes = int(np.prod(v.shape)) * itemsize
             if size and size + nbytes > limit:
                 buckets.append([])
                 size = 0
@@ -403,7 +431,11 @@ class RemoteJaxEngine(InferenceEngine):
         entries = []
         for name, v in bucket:
             arr = np.asarray(jax_leaf_to_host(v))
-            if arr.dtype.kind == "f" and arr.dtype != np.dtype(ml_dtypes.bfloat16):
+            if (
+                arr.dtype.kind == "f"
+                and arr.dtype != np.dtype(ml_dtypes.bfloat16)
+                and not name.endswith("_scale")  # q8 scale planes stay f32
+            ):
                 arr = arr.astype(ml_dtypes.bfloat16)
             entries.append((name, arr))
         return encode_weight_bucket(entries)

@@ -360,10 +360,27 @@ class DecodeEngine:
         )
 
     def _place(self, path: str, arr) -> jax.Array:
-        """THE placement policy for incoming base-named weights: cast to the
-        serving dtype and device_put toward the base param shardings. Used
-        by HF load, caller-provided-params reshard, staged-bucket ingest,
-        and disk updates — keep them identical."""
+        """THE placement policy for incoming weights. Base-named leaves cast
+        to the serving dtype toward the base param shardings; served-form
+        quantized leaves (``*_q8``/``*_scale`` from a q8-wire update against
+        an int8 engine) keep their own dtype and take the quantized specs.
+        Used by HF load, caller-provided-params reshard, staged-bucket
+        ingest, and disk updates — keep them identical."""
+        name = path.rsplit("/", 1)[-1]
+        if name.endswith(("_q8", "_scale")):
+            # served-form leaf from a q8-wire update
+            if self.config.quantization != "int8":
+                raise RuntimeError(
+                    "q8-wire weight update against a non-quantized engine; "
+                    "set ServerConfig.quantization='int8' or use "
+                    "wire_format='bf16'"
+                )
+            if not hasattr(self, "_serving_shardings"):
+                raise RuntimeError("q8-wire leaf before engine initialize()")
+            return jax.device_put(
+                jnp.asarray(arr),
+                mesh_lib.shard_for_path(self._serving_shardings, path),
+            )
         return jax.device_put(
             jnp.asarray(arr, dtype=self.model_cfg.jax_dtype),
             mesh_lib.shard_for_path(self.param_shardings, path),
@@ -801,12 +818,15 @@ class DecodeEngine:
             self._staged_flat = None
         assert flat, "no staged weights"
         tree = _unflatten(flat)
-        # sanity: staged tree must cover the whole param structure. Compare
-        # against the UNQUANTIZED structure — updates always arrive with
-        # base weight names even when the engine serves int8 (a fallback to
-        # self.params here would demand q8 names no updater can supply)
-        ref_paths = self._base_param_paths
         got_paths = {p for p, _ in _iter_tree_paths(tree)}
+        served_form = any(p.endswith("_q8") for p in got_paths)
+        # sanity: staged tree must cover the whole param structure — the
+        # UNQUANTIZED one for bf16-wire updates (engine re-quantizes on
+        # apply), or the SERVED (quantized) one for q8-wire updates
+        if served_form:
+            ref_paths = {p for p, _ in _iter_tree_paths(self.params)}
+        else:
+            ref_paths = self._base_param_paths
         missing = ref_paths - got_paths
         assert not missing, f"staged update missing params: {sorted(missing)[:5]}"
         with self._weight_lock:
@@ -846,9 +866,20 @@ class DecodeEngine:
                 self._lora_prev = None
             quantized = self.config.quantization == "int8"
             if kind == "staged":
-                # already sharded device arrays — pointer swap (re-quantize
-                # first when serving int8: one fused device pass)
-                self.params = self._quantize(payload) if quantized else payload
+                # already sharded device arrays — pointer swap. bf16-wire
+                # trees re-quantize in one fused device pass; q8-wire trees
+                # (client pre-quantized, leaves named *_q8/*_scale) are
+                # already in served form
+                already_served = any(
+                    k.endswith("_q8") for k in payload.get("layers", {})
+                )
+                # (a served-form tree can't reach a non-quantized engine:
+                # _place rejects q8-wire leaves at stage time)
+                self.params = (
+                    self._quantize(payload)
+                    if quantized and not already_served
+                    else payload
+                )
             elif kind == "lora":
                 if quantized:
                     raise RuntimeError(

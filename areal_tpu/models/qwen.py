@@ -289,23 +289,28 @@ def _proj(cfg: ModelConfig, layer: dict, name: str, x: jax.Array) -> jax.Array:
 QUANT_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
+def quantize_dense_int8(w: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """W[..., in, out] -> (q8 int8, scale fp32[..., 1, out]) with
+    W ≈ q8 * scale — the ONE transform shared by server-side quantization
+    and the client's q8 weight-update wire format (identical results by
+    construction)."""
+    w32 = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(w32), axis=-2, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-12)
+    return jnp.round(w32 / s).clip(-127, 127).astype(jnp.int8), s
+
+
 def quantize_params_int8(params: dict) -> dict:
     """Per-output-channel symmetric int8 quantization of the dense
-    projection weights: W[..., in, out] -> q8 int8 + scale fp32[..., 1, out],
-    with W ≈ q8 * scale. Jit-friendly (pure jnp); leaves every other weight
-    untouched and drops the bf16 originals."""
+    projection weights via ``quantize_dense_int8``. Jit-friendly (pure
+    jnp); leaves every other weight untouched and drops the bf16
+    originals."""
     layers = dict(params["layers"])
     for name in QUANT_TARGETS:
         w = layers.get(name)
         if w is None:
             continue
-        w32 = w.astype(jnp.float32)
-        s = jnp.max(jnp.abs(w32), axis=-2, keepdims=True) / 127.0
-        s = jnp.maximum(s, 1e-12)
-        layers[f"{name}_q8"] = (
-            jnp.round(w32 / s).clip(-127, 127).astype(jnp.int8)
-        )
-        layers[f"{name}_scale"] = s
+        layers[f"{name}_q8"], layers[f"{name}_scale"] = quantize_dense_int8(w)
         del layers[name]
     return {**params, "layers": layers}
 

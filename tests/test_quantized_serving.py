@@ -223,6 +223,115 @@ def test_tp_sharded_int8_serving(cfg_params):
         eng.stop()
 
 
+@pytest.mark.parametrize("tp", [1, 2])
+def test_q8_wire_update_over_http(cfg_params, tp):
+    """wire_format="q8": the client pre-quantizes dense leaves with the
+    SAME transform the server runs — the served q8 table must match the
+    client-side quantization bit-exactly (no bf16 double rounding), at
+    half the wire bytes. tp=2 covers device_put of client-quantized
+    *_q8/*_scale leaves onto TP-sharded serving specs."""
+    import asyncio
+
+    import jax as _jax
+
+    from areal_tpu.api.config import InferenceEngineConfig
+    from areal_tpu.api.io_struct import WeightUpdateMeta
+    from areal_tpu.inference.client import RemoteJaxEngine
+    from areal_tpu.inference.server import ServerThread
+
+    cfg, params = cfg_params
+    scfg = ServerConfig(
+        max_batch_size=4,
+        max_seq_len=64,
+        decode_steps_per_call=4,
+        seed=0,
+        quantization="int8",
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=tp),
+    )
+    dec = DecodeEngine(scfg, params=params, model_cfg=cfg)
+    dec.initialize()
+    server = ServerThread(scfg, dec)
+    server.start()
+    client = RemoteJaxEngine(
+        InferenceEngineConfig(
+            max_concurrent_rollouts=2, consumer_batch_size=1, request_timeout=120
+        ),
+        addresses=[server.address],
+    )
+    client.initialize()
+    try:
+        new_params = qwen.init_params(_jax.random.PRNGKey(11), cfg)
+        client.update_weights(
+            WeightUpdateMeta(type="mem", wire_format="q8"),
+            params=new_params,
+        )
+        want_q8, want_s = qwen.quantize_dense_int8(new_params["layers"]["wq"])
+        np.testing.assert_array_equal(
+            np.asarray(dec.params["layers"]["wq_q8"]), np.asarray(want_q8)
+        )
+        np.testing.assert_allclose(
+            np.asarray(dec.params["layers"]["wq_scale"]),
+            np.asarray(want_s),
+            rtol=1e-6,
+        )
+        r = asyncio.run(
+            client.agenerate(
+                ModelRequest(
+                    input_ids=list(range(1, 9)),
+                    gconfig=GenerationHyperparameters(
+                        max_new_tokens=4, greedy=True
+                    ),
+                )
+            )
+        )
+        assert len(r.output_tokens) == 4
+    finally:
+        client.destroy()
+        server.stop()
+
+
+def test_q8_wire_rejected_by_bf16_server(cfg_params):
+    """A q8-wire push against a non-quantized server must fail the update,
+    not corrupt the served tree."""
+    import jax as _jax
+
+    from areal_tpu.api.config import InferenceEngineConfig
+    from areal_tpu.api.io_struct import WeightUpdateMeta
+    from areal_tpu.inference.client import RemoteJaxEngine
+    from areal_tpu.inference.server import ServerThread
+
+    cfg, params = cfg_params
+    scfg = ServerConfig(
+        max_batch_size=4,
+        max_seq_len=64,
+        decode_steps_per_call=4,
+        seed=0,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+    )
+    dec = DecodeEngine(scfg, params=params, model_cfg=cfg)
+    dec.initialize()
+    server = ServerThread(scfg, dec)
+    server.start()
+    client = RemoteJaxEngine(
+        InferenceEngineConfig(
+            max_concurrent_rollouts=2, consumer_batch_size=1, request_timeout=60
+        ),
+        addresses=[server.address],
+    )
+    client.initialize()
+    try:
+        new_params = qwen.init_params(_jax.random.PRNGKey(12), cfg)
+        with pytest.raises(Exception):
+            client.update_weights(
+                WeightUpdateMeta(type="mem", wire_format="q8"),
+                params=new_params,
+            )
+        assert "wq" in dec.params["layers"]  # served tree untouched
+    finally:
+        client.destroy()
+        server.stop()
+
+
 def test_quant_partition_specs_structure(cfg_params):
     cfg, params = cfg_params
     specs = qwen.quant_partition_specs(cfg)
