@@ -354,6 +354,9 @@ def phase_longctx():
     from areal_tpu.models import qwen
 
     model_cfg = qwen.ModelConfig(**MODEL_KW)
+    # BENCH_KV_QUANT=int8: int8 KV pages — halves the KV read (the dominant
+    # HBM term at 4K ctx) and doubles the pages the budget buys
+    kv_quant = os.environ.get("BENCH_KV_QUANT", "none")
     cfg = ServerConfig(
         max_batch_size=64,
         max_seq_len=4096,
@@ -361,6 +364,8 @@ def phase_longctx():
         page_size=128,
         kv_hbm_gb=6.0,  # << dense equivalent (64*4096 tokens ~ 7.5 GB)
         attn_window_step=1024,  # 4 window buckets -> few chunk compiles
+        quantization=os.environ.get("BENCH_QUANT", "none"),
+        kv_quantization=kv_quant,
         mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
     )
     t0 = time.monotonic()
@@ -429,6 +434,7 @@ def phase_longctx():
             "max_context_reached": max_pos,
             "kv_pages_used": eng.pool.used,
             "kv_pages_total": eng.pool.n_pages,
+            "kv_quantization": kv_quant,
             "preempted": eng.stats.get("preempted", 0),
         }
     )

@@ -61,6 +61,16 @@ STEPS = [
         "import os; os.environ['BENCH_QUANT'] = 'int8'\n"
         "import bench; bench._run_phase_child('decode')",
     ),
+    (
+        # longctx with int8 KV (+ int8 weights): the KV read dominates at
+        # 4K ctx, so this is where kv_quantization shows
+        "bench_longctx_int8kv",
+        500,
+        "import os\n"
+        "os.environ['BENCH_QUANT'] = 'int8'\n"
+        "os.environ['BENCH_KV_QUANT'] = 'int8'\n"
+        "import bench; bench._run_phase_child('longctx')",
+    ),
 ]
 
 # the alarm handler must RAISE (not default-terminate): only a normal

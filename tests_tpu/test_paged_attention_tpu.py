@@ -97,3 +97,57 @@ def test_paged_attention_q8_kernel_matches_xla_on_chip():
         atol=3e-2,
         rtol=3e-2,
     )
+
+
+def test_int8_weight_serving_greedy_parity_on_chip():
+    """int8 weight-only serving on real TPU: greedy decode through the
+    quantized engine must match the CPU-validated behavior — same argmax
+    stream as the bf16 engine at clean-margin random init."""
+    from areal_tpu.api.config import MeshConfig, ServerConfig
+    from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.models import qwen
+
+    cfg = qwen.ModelConfig(
+        vocab_size=512,
+        hidden_size=256,
+        intermediate_size=512,
+        num_layers=2,
+        num_heads=8,
+        num_kv_heads=2,
+        head_dim=32,
+        dtype="bfloat16",
+    )
+    params = jax.jit(lambda k: qwen.init_params(k, cfg))(jax.random.PRNGKey(0))
+    outs = {}
+    for quant in ("none", "int8"):
+        eng = DecodeEngine(
+            ServerConfig(
+                max_batch_size=2,
+                max_seq_len=64,
+                decode_steps_per_call=4,
+                seed=0,
+                quantization=quant,
+                kv_quantization="int8" if quant == "int8" else "none",
+                mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+            ),
+            params=params,
+            model_cfg=cfg,
+        )
+        eng.initialize()
+        eng.start()
+        try:
+            r = eng.generate_sync(
+                ModelRequest(
+                    input_ids=list(range(1, 9)),
+                    gconfig=GenerationHyperparameters(
+                        max_new_tokens=8, greedy=True
+                    ),
+                ),
+                timeout=300,
+            )
+            outs[quant] = tuple(r.output_tokens)
+        finally:
+            eng.stop()
+        del eng
+    assert outs["none"] == outs["int8"], outs
