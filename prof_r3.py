@@ -160,13 +160,14 @@ def phase_train():
 
     rng = np.random.default_rng(0)
     print("== train sweeps ==", flush=True)
-    for label, rows, lo, hi, policy, attn in (
-        ("L2048 nothing xla", 6, 1500, 2048, "nothing", "xla"),
-        ("L2048 dots_nobatch xla", 6, 1500, 2048, "dots_nobatch", "xla"),
-        ("L2048 nothing pallas", 6, 1500, 2048, "nothing", "pallas"),
-        ("L4096 nothing pallas", 3, 3500, 4096, "nothing", "pallas"),
-        ("L4096 nothing xla", 3, 3500, 4096, "nothing", "xla"),
-        ("L4096 dots_nobatch pallas", 3, 3500, 4096, "dots_nobatch", "pallas"),
+    for label, rows, lo, hi, policy, attn, chunk in (
+        ("L2048 nothing xla", 6, 1500, 2048, "nothing", "xla", 256),
+        ("L2048 nothing xla chunk1024", 6, 1500, 2048, "nothing", "xla", 1024),
+        ("L2048 dots_nobatch xla", 6, 1500, 2048, "dots_nobatch", "xla", 256),
+        ("L2048 nothing pallas", 6, 1500, 2048, "nothing", "pallas", 256),
+        ("L4096 nothing pallas", 3, 3500, 4096, "nothing", "pallas", 256),
+        ("L4096 nothing xla", 3, 3500, 4096, "nothing", "xla", 256),
+        ("L4096 dots_nobatch pallas", 3, 3500, 4096, "dots_nobatch", "pallas", 256),
     ):
         cfg = TrainEngineConfig(
             init_from_scratch=True, dtype="bfloat16", param_dtype="bfloat16",
@@ -174,7 +175,7 @@ def phase_train():
             mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
             optimizer=OptimizerConfig(lr=1e-5, lr_scheduler_type="constant"),
             mb_spec=MicroBatchSpec(max_tokens_per_mb=100_000),
-            bucket_step=512, logprob_chunk_size=256,
+            bucket_step=512, logprob_chunk_size=chunk,
         )
         mcfg = qwen.ModelConfig(**MODEL_KW)
         try:
