@@ -183,6 +183,14 @@ def copy_pages(cache: dict, dst: jax.Array, src: jax.Array) -> dict:
     return cache
 
 
+def choose_ppcb(window_pages: int, default: int = 4) -> int:
+    """Largest pages-per-compute-block <= default dividing the window."""
+    ppcb = default
+    while window_pages % ppcb:
+        ppcb //= 2
+    return max(1, ppcb)
+
+
 def paged_attention_xla(
     q: jax.Array,  # [S, H, hd]
     k_pages: jax.Array,  # [KH, N, psz, hd] (one layer)
@@ -239,17 +247,11 @@ def paged_attention_tpu(
     would broadcast the [..., 1] scales to head_dim, inverting the
     halved-HBM premise; the fork keeps them narrow end to end and
     dequantizes in VMEM."""
-    wp = page_table.shape[1]
-    ppcb = pages_per_compute_block
-    while wp % ppcb:
-        ppcb //= 2
-    # the library kernel applies NO 1/sqrt(hd) to the logits — callers
-    # pre-scale q (verified against a dense reference in interpret mode;
-    # the XLA path above scales internally)
-    q = q * (q.shape[-1] ** -0.5)
+    ppcb = choose_ppcb(page_table.shape[1], pages_per_compute_block)
     if k_scales is not None:
         from areal_tpu.ops.paged_attention_q8 import paged_attention_q8
 
+        # the fork takes RAW q (applies 1/sqrt(hd) internally)
         return paged_attention_q8(
             q,
             k_pages,
@@ -258,15 +260,18 @@ def paged_attention_tpu(
             v_scales,
             lengths,
             page_table,
-            pages_per_compute_block=max(1, ppcb),
+            pages_per_compute_block=ppcb,
         )
     from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention
 
+    # the library kernel applies NO 1/sqrt(hd) to the logits — callers
+    # pre-scale q (verified against a dense reference in interpret mode;
+    # the XLA path above scales internally)
     return paged_attention(
-        q,
+        q * (q.shape[-1] ** -0.5),
         k_pages,
         v_pages,
         lengths,
         page_table,
-        pages_per_compute_block=max(1, ppcb),
+        pages_per_compute_block=ppcb,
     )

@@ -971,18 +971,36 @@ def forward_decode_paged(
             c[name] = c[name].at[li, :, write_page, write_off].set(
                 val.astype(c[name].dtype)
             )
-        sl = {
-            name: jax.lax.dynamic_index_in_dim(c[name], li, 0, keepdims=False)
-            for name in c
-        }
-        scales = (
-            dict(k_scales=sl["k_scale"], v_scales=sl["v_scale"]) if kv_quant else {}
-        )
         if use_kernel:
-            attn = paged_kv.paged_attention_tpu(
-                q, sl["k"], sl["v"], lengths, page_table, **scales
+            # STACKED launch: the kernel slices ref.at[li] internally. A
+            # dynamic_index_in_dim layer slice here would force XLA to
+            # materialize a copy of every layer's pages every step (a
+            # pallas operand must be a real buffer) — measured as
+            # full-cache r/w traffic per decode step (docstring of
+            # ops/paged_attention_q8.py)
+            from areal_tpu.ops.paged_attention_q8 import paged_attention_stacked
+
+            attn = paged_attention_stacked(
+                q,
+                c["k"],
+                c["v"],
+                li,
+                lengths,
+                page_table,
+                pages_per_compute_block=paged_kv.choose_ppcb(page_table.shape[1]),
+                k_scales=c.get("k_scale"),
+                v_scales=c.get("v_scale"),
             )
         else:
+            sl = {
+                name: jax.lax.dynamic_index_in_dim(c[name], li, 0, keepdims=False)
+                for name in c
+            }
+            scales = (
+                dict(k_scales=sl["k_scale"], v_scales=sl["v_scale"])
+                if kv_quant
+                else {}
+            )
             attn = paged_kv.paged_attention_xla(
                 q, sl["k"], sl["v"], lengths, page_table, **scales
             )

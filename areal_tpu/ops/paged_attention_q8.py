@@ -1,4 +1,5 @@
-"""Paged attention over int8-quantized KV pages with NARROW scales.
+"""Paged attention kernels for the decode engine: narrow-scales int8 and
+stacked-cache (all-layers) launch variants.
 
 jax's library wrapper (jax.experimental.pallas.ops.tpu.paged_attention)
 accepts QuantizedTensor pages but ``jnp.broadcast_to``s the [..., psz, 1]
@@ -17,6 +18,16 @@ jax's paged_attention_kernel.py) that:
 
 The kernel body and copy descriptor are imported from the library
 unmodified — they are shape-generic over the scales' trailing dim.
+
+``paged_attention_stacked`` additionally takes the FULL stacked cache
+[n_layers, KH, N, psz, hd] plus a (traced) layer index delivered via
+scalar prefetch, and slices ``ref.at[li]`` INSIDE the kernel. Rationale
+(r04 profiling): the decode chunk scans over layers and fed the kernel a
+``dynamic_index_in_dim`` layer slice — a pallas operand must be a real
+buffer, so XLA materialized a copy of every layer's pages every step:
+full-cache read+write traffic per decode step (~9 ms/step at 1.5B,
+dominating the step). In-kernel slicing DMAs only the pages attention
+actually reads.
 """
 
 from __future__ import annotations
@@ -34,7 +45,7 @@ from jax.experimental.pallas.ops.tpu.paged_attention.paged_attention_kernel impo
 
 
 def paged_attention_q8(
-    q: jax.Array,  # [S, H, hd]
+    q: jax.Array,  # [S, H, hd] — RAW (scaling applied internally)
     k_pages: jax.Array,  # int8 [KH, N, psz, hd]
     k_scales: jax.Array,  # f32 [KH, N, psz, 1]
     v_pages: jax.Array,
@@ -47,13 +58,107 @@ def paged_attention_q8(
     attn_logits_soft_cap: float | None = None,
     interpret: bool = False,
 ) -> jax.Array:
+    """Single-layer int8 entry: delegates to the stacked launcher with a
+    leading layer axis of 1 (one launch path to maintain)."""
+    return paged_attention_stacked(
+        q,
+        k_pages[None],
+        v_pages[None],
+        jnp.int32(0),
+        lengths,
+        page_indices,
+        pages_per_compute_block=pages_per_compute_block,
+        k_scales=k_scales[None],
+        v_scales=v_scales[None],
+        mask_value=mask_value,
+        attn_logits_soft_cap=attn_logits_soft_cap,
+        interpret=interpret,
+    )
+
+
+def _stacked_kernel(
+    lengths_ref,
+    page_indices_ref,
+    buffer_index_ref,
+    init_flag_ref,
+    layer_ref,
+    q_ref,
+    k_hbm,
+    k_scales_hbm,
+    v_hbm,
+    v_scales_hbm,
+    o_ref,
+    m_ref,
+    l_ref,
+    k_vmem,
+    k_scales_vmem,
+    v_vmem,
+    v_scales_vmem,
+    k_sems,
+    v_sems,
+    *,
+    batch_size: int,
+    pages_per_compute_block: int,
+    pages_per_sequence: int,
+    mask_value: float,
+    attn_logits_soft_cap: float | None,
+):
+    li = layer_ref[0]
+    paged_flash_attention_kernel_inline_seq_dim(
+        lengths_ref,
+        page_indices_ref,
+        buffer_index_ref,
+        init_flag_ref,
+        q_ref,
+        k_hbm.at[li],
+        None if k_scales_hbm is None else k_scales_hbm.at[li],
+        v_hbm.at[li],
+        None if v_scales_hbm is None else v_scales_hbm.at[li],
+        o_ref,
+        m_ref,
+        l_ref,
+        k_vmem,
+        k_scales_vmem,
+        v_vmem,
+        v_scales_vmem,
+        k_sems,
+        v_sems,
+        batch_size=batch_size,
+        pages_per_compute_block=pages_per_compute_block,
+        pages_per_sequence=pages_per_sequence,
+        mask_value=mask_value,
+        attn_logits_soft_cap=attn_logits_soft_cap,
+        megacore_mode=None,
+    )
+
+
+def paged_attention_stacked(
+    q: jax.Array,  # [S, H, hd] — RAW (this wrapper applies 1/sqrt(hd))
+    k_pages: jax.Array,  # [n_layers, KH, N, psz, hd] (bf16 or int8)
+    v_pages: jax.Array,
+    layer: jax.Array,  # scalar int32 — which layer's pages to read
+    lengths: jax.Array,  # i32 [S]
+    page_indices: jax.Array,  # i32 [S, pages_per_sequence]
+    *,
+    pages_per_compute_block: int,
+    k_scales: jax.Array | None = None,  # f32 [n_layers, KH, N, psz, 1]
+    v_scales: jax.Array | None = None,
+    mask_value: float = DEFAULT_MASK_VALUE,
+    attn_logits_soft_cap: float | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Paged attention reading layer ``layer`` of the FULL stacked cache —
+    zero layer-slice copies (see module docstring). Scales, when given,
+    stay NARROW ([..., 1]) end to end."""
     batch_size, num_q_heads, head_dim = q.shape
     orig_dtype = q.dtype
-    num_kv_heads, _, page_size, head_dim_k = k_pages.shape
+    q = q * (head_dim**-0.5)  # the kernel applies no logit scaling
+    n_layers, num_kv_heads, _, page_size, head_dim_k = k_pages.shape
     _, pages_per_sequence = page_indices.shape
     if k_pages.shape != v_pages.shape:
         raise ValueError(f"k/v page shapes differ: {k_pages.shape} {v_pages.shape}")
-    if k_scales.shape != (*k_pages.shape[:-1], 1):
+    quant = k_scales is not None
+    if quant and k_scales.shape != (*k_pages.shape[:-1], 1):
         raise ValueError(f"narrow scales expected, got {k_scales.shape}")
     if num_q_heads % num_kv_heads:
         raise ValueError(f"H={num_q_heads} not divisible by KH={num_kv_heads}")
@@ -67,7 +172,6 @@ def paged_attention_q8(
 
     num_groups = num_q_heads // num_kv_heads
     if num_groups % 8 != 0:
-        # <1x128> layout hint (library comment): reshape q to 4-D
         q = q.reshape(batch_size, num_q_heads, 1, head_dim)
         q_block_spec = pl.BlockSpec(
             (None, num_groups, None, head_dim), lambda core, b, h, *_: (b, h, 0, 0)
@@ -79,14 +183,14 @@ def paged_attention_q8(
         )
         q_dtype_for_kernel_launch = q.dtype
 
-    grid = (1, batch_size, num_kv_heads)  # megacore_mode=None
+    grid = (1, batch_size, num_kv_heads)
     dimension_semantics = ("parallel", "arbitrary", "arbitrary")
     in_specs = [
         q_block_spec,
         pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY) if quant else None,
         pl.BlockSpec(memory_space=pl.ANY),
-        pl.BlockSpec(memory_space=pl.ANY),
-        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY) if quant else None,
     ]
 
     def kv_vmem(dtype, trailing):
@@ -95,30 +199,49 @@ def paged_attention_q8(
         )
 
     scratch_shapes = (
-        kv_vmem(k_pages.dtype, head_dim),  # k pages buffer
-        kv_vmem(k_scales.dtype, 1),  # k scales buffer (NARROW)
+        kv_vmem(k_pages.dtype, head_dim),
+        kv_vmem(k_scales.dtype, 1) if quant else None,
         kv_vmem(v_pages.dtype, head_dim),
-        kv_vmem(v_scales.dtype, 1),
+        kv_vmem(v_scales.dtype, 1) if quant else None,
         pltpu.SemaphoreType.DMA((2,)),
         pltpu.SemaphoreType.DMA((2,)),
     )
 
+    operands = [
+        lengths,
+        page_indices.reshape(-1),
+        jnp.zeros((1,), jnp.int32),  # buffer index
+        jnp.ones((1,), jnp.int32),  # init flag
+        jnp.asarray(layer, jnp.int32).reshape(1),  # layer index (prefetched)
+        q.astype(q_dtype_for_kernel_launch),
+        k_pages,
+    ]
+    if quant:
+        operands.append(k_scales)
+    operands.append(v_pages)
+    if quant:
+        operands.append(v_scales)
+    if not quant:
+        # drop the None spec slots to match the operand list
+        in_specs = [s for s in in_specs if s is not None]
+
     out, _, _ = pl.pallas_call(
         functools.partial(
-            paged_flash_attention_kernel_inline_seq_dim,
-            pages_per_sequence=pages_per_sequence,
+            _stacked_kernel if quant else _stacked_kernel_noscale,
             batch_size=batch_size,
             pages_per_compute_block=pages_per_compute_block,
+            pages_per_sequence=pages_per_sequence,
             mask_value=mask_value,
             attn_logits_soft_cap=attn_logits_soft_cap,
-            megacore_mode=None,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=4,
+            num_scalar_prefetch=5,
             in_specs=in_specs,
             out_specs=[q_block_spec, q_block_spec, q_block_spec],
             grid=grid,
-            scratch_shapes=scratch_shapes,
+            scratch_shapes=tuple(s for s in scratch_shapes if s is not None)
+            if not quant
+            else scratch_shapes,
         ),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=dimension_semantics
@@ -129,15 +252,47 @@ def paged_attention_q8(
             jax.ShapeDtypeStruct((*q.shape[:-1], 1), jnp.float32),
         ],
         interpret=interpret,
-    )(
-        lengths,
-        page_indices.reshape(-1),
-        jnp.zeros((1,), jnp.int32),  # buffer index
-        jnp.ones((1,), jnp.int32),  # init flag
-        q.astype(q_dtype_for_kernel_launch),
-        k_pages,
-        k_scales,
-        v_pages,
-        v_scales,
-    )
+    )(*operands)
     return out.reshape(batch_size, num_q_heads, head_dim).astype(orig_dtype)
+
+
+def _stacked_kernel_noscale(
+    lengths_ref,
+    page_indices_ref,
+    buffer_index_ref,
+    init_flag_ref,
+    layer_ref,
+    q_ref,
+    k_hbm,
+    v_hbm,
+    o_ref,
+    m_ref,
+    l_ref,
+    k_vmem,
+    v_vmem,
+    k_sems,
+    v_sems,
+    **kw,
+):
+    _stacked_kernel(
+        lengths_ref,
+        page_indices_ref,
+        buffer_index_ref,
+        init_flag_ref,
+        layer_ref,
+        q_ref,
+        k_hbm,
+        None,
+        v_hbm,
+        None,
+        o_ref,
+        m_ref,
+        l_ref,
+        k_vmem,
+        None,
+        v_vmem,
+        None,
+        k_sems,
+        v_sems,
+        **kw,
+    )

@@ -55,7 +55,7 @@ def test_q8_kernel_interpret_matches_xla():
     vq, vs = paged_kv.quantize_kv(v)
     ref = paged_kv.paged_attention_xla(q, kq, vq, lengths, pt, ks, vs)
     out = q8mod.paged_attention_q8(
-        q * (q.shape[-1] ** -0.5),
+        q,  # RAW: the fork applies 1/sqrt(hd) internally
         kq,
         ks,
         vq,
@@ -64,6 +64,42 @@ def test_q8_kernel_interpret_matches_xla():
         pt,
         pages_per_compute_block=2,
         interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
+    )
+
+
+def test_stacked_kernel_interpret_matches_xla():
+    """paged_attention_stacked (the serving hot path: full stacked cache +
+    in-kernel layer slicing — no per-step layer copies) against the
+    per-layer XLA path, bf16 and int8, multiple layer indices."""
+    from areal_tpu.ops.paged_attention_q8 import paged_attention_stacked
+
+    rng = np.random.default_rng(7)
+    L, S, KH, G, hd, psz, wp = 3, 4, 2, 6, 128, 16, 4
+    H = KH * G
+    N = S * wp + 1
+    q = jnp.asarray(rng.normal(0, 1, (S, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(0, 1, (L, KH, N, psz, hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1, (L, KH, N, psz, hd)), jnp.float32)
+    pt = jnp.asarray(1 + np.arange(S * wp).reshape(S, wp), jnp.int32)
+    lengths = jnp.asarray(rng.integers(1, wp * psz + 1, S), jnp.int32)
+    for li in (0, L - 1):
+        ref = paged_kv.paged_attention_xla(q, k[li], v[li], lengths, pt)
+        out = paged_attention_stacked(
+            q, k, v, jnp.int32(li), lengths, pt,
+            pages_per_compute_block=2, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
+        )
+    kq, ks = paged_kv.quantize_kv(k)
+    vq, vs = paged_kv.quantize_kv(v)
+    ref = paged_kv.paged_attention_xla(q, kq[1], vq[1], lengths, pt, ks[1], vs[1])
+    out = paged_attention_stacked(
+        q, kq, vq, jnp.int32(1), lengths, pt,
+        pages_per_compute_block=2, k_scales=ks, v_scales=vs, interpret=True,
     )
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
