@@ -163,7 +163,13 @@ def phase_decode():
     # cold-variant compile/cache-replay inside the measured window costs
     # ~25% of apparent throughput (4.1k vs 5.6k tok/s steady state)
     t0 = time.monotonic()
-    eng.precompile()
+    # budget-bounded: the greedy x capped chunk variants doubled the warm
+    # set this round; on a cold cache the deadline must still leave room
+    # for warmup + measurement + the wu segment (~180s)
+    elapsed = time.monotonic() - _PHASE_START
+    eng.precompile(
+        budget_s=max(30.0, PHASE_DEADLINE_S["decode"] - elapsed - 180.0)
+    )
     log(f"[decode] precompile {time.monotonic()-t0:.1f}s")
     eng.start()
 
