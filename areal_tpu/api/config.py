@@ -348,6 +348,10 @@ class BaseExperimentConfig:
     total_train_n_seqs: int | None = None
     tokenizer_path: str = ""
     weight_update_mode: str = "disk"
+    # mem-mode stream encoding: "auto" picks q8 when the serving fleet is
+    # int8-quantized (half the wire bytes, bit-identical to server-side
+    # quantization), else bf16; or force "bf16"/"q8" explicitly
+    weight_update_wire: str = "auto"
     train_dataset: DatasetConfig = field(default_factory=DatasetConfig)
     valid_dataset: DatasetConfig | None = None
     saver: SaverConfig = field(default_factory=SaverConfig)

@@ -33,6 +33,28 @@ from areal_tpu.utils.stats_logger import StatsLogger
 logger = alog.getLogger("rl_trainer")
 
 
+def resolve_weight_update_wire(config) -> str:
+    """``weight_update_wire`` policy: "auto" -> "q8" when the serving fleet
+    is int8-quantized (half the wire bytes, bit-identical to server-side
+    quantization), else "bf16". Validates eagerly so a typo fails at
+    trainer init, not at the first mid-training update."""
+    wire = getattr(config, "weight_update_wire", "auto") or "auto"
+    if wire == "auto":
+        server_cfg = getattr(config, "server", None)
+        wire = (
+            "q8"
+            if server_cfg is not None
+            and getattr(server_cfg, "quantization", "none") == "int8"
+            else "bf16"
+        )
+    if wire not in ("bf16", "q8"):
+        raise ValueError(
+            f"weight_update_wire={wire!r}; valid: auto|bf16|q8 "
+            "(int8 is a ServerConfig.quantization value, not a wire format)"
+        )
+    return wire
+
+
 class PPOTrainer:
     def __init__(
         self,
@@ -129,8 +151,9 @@ class PPOTrainer:
             config.trial_name,
             "update_weights",
         )
+        wire = resolve_weight_update_wire(config)
         self.weight_update_meta = WeightUpdateMeta(
-            type=mode, path=update_dir, with_version=True
+            type=mode, path=update_dir, with_version=True, wire_format=wire
         )
         self.actor_engine.connect_engine(self.rollout, self.weight_update_meta)
 

@@ -98,6 +98,12 @@ WIRING = {
         and c.actor.group_size == 8
         and c.actor.group_reward_norm
     ),
+    "gsm8k_grpo_int8serve.yaml": lambda c: (
+        c.server.quantization == "int8"
+        and c.server.kv_quantization == "int8"
+        and c.weight_update_wire == "auto"  # resolves to q8 for int8 fleets
+        and c.actor.use_decoupled_loss  # drift correction is load-bearing
+    ),
 }
 
 
@@ -181,3 +187,22 @@ def test_preset_one_ppo_step(name, tiny_engine):
     adv = actor.compute_advantages(batch)
     stats = actor.ppo_update(adv)
     assert np.isfinite(stats[0]["loss"]), name
+
+
+def test_weight_update_wire_resolution():
+    """auto -> q8 exactly when the serving fleet is int8-quantized; typos
+    fail eagerly with a pointer at the right config field."""
+    import pytest as _pytest
+
+    from areal_tpu.api.config import PPOConfig, ServerConfig
+    from areal_tpu.trainer.rl_trainer import resolve_weight_update_wire
+
+    cfg = PPOConfig()
+    assert resolve_weight_update_wire(cfg) == "bf16"
+    cfg.server = ServerConfig(quantization="int8")
+    assert resolve_weight_update_wire(cfg) == "q8"
+    cfg.weight_update_wire = "bf16"  # explicit beats auto
+    assert resolve_weight_update_wire(cfg) == "bf16"
+    cfg.weight_update_wire = "int8"  # the natural typo
+    with _pytest.raises(ValueError, match="ServerConfig.quantization"):
+        resolve_weight_update_wire(cfg)
