@@ -2,9 +2,14 @@
 
 Implements the reference TrainEngine contract (areal/api/engine_api.py:30-528)
 on a single jax mesh ``(data, fsdp, seq, model, expert)``: DP/ZeRO-3, TP, SP
-and (later) EP are sharding rules, not codepaths — XLA inserts the collectives
+and EP are sharding rules, not codepaths — XLA inserts the collectives
 the reference gets from FSDP2/DTensor/Megatron/NCCL
-(areal/engine/fsdp_engine.py, megatron_engine.py).
+(areal/engine/fsdp_engine.py, megatron_engine.py). Pipeline parallelism is
+deliberately not an engine mode (GSPMD covers the reference's PP use cases
+within a pod, SURVEY §7.1); the GPipe mechanism itself lives in
+``parallel/pipeline.py`` (fill-drain schedule over a stage axis, backward
+via AD through the collectives) for deployments that want stage
+partitioning across DCN-connected slices.
 
 Design notes:
 - A microbatch is a fixed-shape [G, L] grid of FFD-packed rows

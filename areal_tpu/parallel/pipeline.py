@@ -1,0 +1,115 @@
+"""GPipe pipeline parallelism over a mesh axis (SURVEY §2.4 PP row).
+
+The reference implements PP twice (Megatron 1F1B/VPP schedules,
+megatron_engine.py:561-637; Archon torch.distributed.pipelining incl.
+ZBV/DualPipeV, archon_engine.py:16-19). On TPU, GSPMD sharding covers the
+reference's PP use cases *within* a pod — the train engine deliberately
+scales via (data, fsdp, seq, model, expert) sharding rules instead
+(SURVEY §7.1: "XLA SPMD rarely needs PP on TPU"). This module provides
+the mechanism itself for the cases where stage partitioning IS wanted
+(DCN-connected pod slices; models whose layer count dwarfs HBM): a
+functional GPipe fill–drain schedule whose backward comes from jax.grad
+differentiating through the collectives — no hand-written schedule code
+for the bwd pass, XLA overlaps the ppermute with stage compute.
+
+Design (the scaling-book "pipelining" recipe, restated TPU-first):
+- layers live STACKED as [n_layers, ...] leaves (the repo-wide layout);
+  stage s owns the contiguous slice [s*L/S, (s+1)*L/S) — resharding from
+  the GSPMD layout is one device_put of a differently-sharded array.
+- inside shard_map over the ``stage`` axis, every device runs the same
+  fill–drain loop of length n_micro + S - 1: apply my stage's layers to
+  my current microbatch, then ``ppermute`` activations to the next stage
+  while rotating in the next microbatch.
+- the [n_microbatches, ...] input buffer is REPLICATED on every stage
+  (only stage 0 reads it) and the output accumulator likewise lives on
+  every stage (only the last writes it; a final masked psum broadcasts
+  it), so callers see an ordinary [M, ...] -> [M, ...] function. Memory
+  per stage is therefore two full [M, ...] activation buffers — the
+  simple/robust choice at RL-activation sizes; a stage-0-resident
+  variant (rotating buffers) is the optimization for activation-bound
+  regimes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def gpipe(
+    layer_fn: Callable,  # (carry, layer_params) -> carry, applied per layer
+    n_stages: int,
+    n_microbatches: int,
+    axis_name: str = "model",
+):
+    """Build a GPipe-pipelined apply: ``fn(stage_params, x_micro) -> y``.
+
+    ``stage_params``: pytree whose leaves are [layers_per_stage, ...] — the
+    CURRENT stage's slice (callers shard a stacked [n_layers, ...] tree
+    over the pp axis; inside shard_map each device sees its slice).
+    ``x_micro``: [n_microbatches, ...] microbatched activations, all
+    resident on every stage (replicated entry; only stage 0's are read).
+
+    Returns y of the same shape: microbatch m's output after all layers.
+    Must be called INSIDE shard_map with ``axis_name`` mapped; the stacked
+    layer count must divide evenly over the stages (shard_map's P("stage")
+    split enforces the same — asserted eagerly by the caller's in_specs).
+    """
+
+    def apply_stage(params, x):
+        def body(carry, layer):
+            return layer_fn(carry, layer), None
+
+        y, _ = jax.lax.scan(body, x, params)
+        return y
+
+    def fn(stage_params, x_micro):
+        stage = jax.lax.axis_index(axis_name)
+        M = n_microbatches
+        S = n_stages
+        n_steps = M + S - 1
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+        # state: the activation currently flowing through THIS stage, plus
+        # the output accumulator (written by the last stage)
+        cur = jnp.zeros_like(x_micro[0])
+        out = jnp.zeros_like(x_micro)
+
+        def step(t, carry):
+            cur, out = carry
+            # stage 0 injects microbatch t (while t < M), others take the
+            # activation handed to them last step
+            inject = jax.lax.dynamic_index_in_dim(
+                x_micro, jnp.minimum(t, M - 1), 0, keepdims=False
+            )
+            cur = jnp.where(stage == 0, inject, cur)
+            cur = apply_stage(stage_params, cur)
+            # the LAST stage retires microbatch t-(S-1) (valid once t >= S-1)
+            m_idx = t - (S - 1)
+            write = jnp.logical_and(stage == S - 1, m_idx >= 0)
+            out = jax.lax.cond(
+                write,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, cur, jnp.maximum(m_idx, 0), 0
+                ),
+                lambda o: o,
+                out,
+            )
+            # hand my activation to the next stage
+            cur = jax.lax.ppermute(cur, axis_name, fwd_perm)
+            return cur, out
+
+        _, out = jax.lax.fori_loop(0, n_steps, step, (cur, out))
+        # every stage ends with the LAST stage's accumulator only on that
+        # device; psum-broadcast so callers see it replicated (cheap at
+        # [M, ...] activation size; callers usually reduce immediately)
+        out = jax.lax.psum(
+            jnp.where(stage == S - 1, out, jnp.zeros_like(out)), axis_name
+        )
+        return out
+
+    return fn

@@ -52,6 +52,14 @@ def resolve_weight_update_wire(config) -> str:
             f"weight_update_wire={wire!r}; valid: auto|bf16|q8 "
             "(int8 is a ServerConfig.quantization value, not a wire format)"
         )
+    if wire == "q8":
+        server_cfg = getattr(config, "server", None)
+        if getattr(server_cfg, "quantization", "none") != "int8":
+            raise ValueError(
+                "weight_update_wire='q8' requires an int8-serving fleet "
+                "(set server.quantization='int8') — servers reject q8-wire "
+                "leaves otherwise, at the first mid-training update"
+            )
     return wire
 
 
