@@ -106,6 +106,49 @@ def test_stacked_kernel_interpret_matches_xla():
     )
 
 
+@pytest.mark.parametrize("quant", [False, True])
+def test_full_decode_step_composition_interpret(quant, monkeypatch):
+    """forward_decode_paged with use_kernel=True — the scatter-write +
+    in-kernel layer slice composition inside the layers scan — against the
+    XLA path, full model forward, greedy argmax parity. This is the exact
+    program the serving chunk runs on chip."""
+    import functools
+
+    import areal_tpu.ops.paged_attention_q8 as q8mod
+    from areal_tpu.models import qwen
+
+    monkeypatch.setattr(
+        q8mod,
+        "paged_attention_stacked",
+        functools.partial(q8mod.paged_attention_stacked, interpret=True),
+    )
+    cfg = qwen.ModelConfig(
+        vocab_size=256,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=8,
+        num_kv_heads=2,
+        head_dim=16,
+        dtype="float32",
+        tie_word_embeddings=True,
+    )
+    params = qwen.init_params(jax.random.PRNGKey(0), cfg)
+    S, psz, wp = 4, 16, 2
+    cache = paged_kv.init_paged_cache(cfg, S * wp + 1, psz, quant=quant)
+    pt = jnp.asarray(1 + np.arange(S * wp).reshape(S, wp), jnp.int32)
+    ids = jnp.asarray([3, 5, 7, 9], jnp.int32)
+    pos = jnp.asarray([4, 9, 14, 19], jnp.int32)
+    outs = {}
+    for uk in (True, False):
+        hid, _ = qwen.forward_decode_paged(
+            params, cfg, ids, pos, dict(cache), pt, page_size=psz, use_kernel=uk
+        )
+        logits = qwen.compute_logits(params, cfg, hid)
+        outs[uk] = np.asarray(jnp.argmax(logits, -1))
+    np.testing.assert_array_equal(outs[True], outs[False])
+
+
 def test_bf16_library_kernel_interpret_matches_xla():
     """The library kernel through paged_attention_tpu (incl. the q
     pre-scale) against the XLA path."""
