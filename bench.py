@@ -686,16 +686,23 @@ def phase_async_sync():
         rollout.set_version(engine.get_version())
         engine.connect_engine(rollout, meta)
         t0 = time.monotonic()
+        parts = {"batch_wait": 0.0, "train": 0.0, "wu": 0.0}
         for step in range(n_steps):
+            tb = time.monotonic()
             batch = rollout.prepare_batch(dataset, workflow=wf)
+            parts["batch_wait"] += time.monotonic() - tb
+            tb = time.monotonic()
             adv = actor.compute_advantages(batch)
             actor.ppo_update(adv)
+            parts["train"] += time.monotonic() - tb
+            tb = time.monotonic()
             rollout.pause()
             engine.update_weights(meta)
             new_version = engine.get_version() + 1
             engine.set_version(new_version)
             rollout.set_version(new_version)
             rollout.resume()
+            parts["wu"] += time.monotonic() - tb
             log(
                 f"[async_sync] {tag} step {step} t={time.monotonic()-t0:.1f}s"
             )
@@ -704,13 +711,15 @@ def phase_async_sync():
             rollout.destroy()
         except Exception:  # noqa: BLE001
             pass
-        return dt
+        return dt, {k: round(v, 2) for k, v in parts.items()}
 
     # warmup: compile every program (prefill, chunk, train fwd/bwd, logp)
     run_mode(0, 1, "warmup")
-    t_sync = run_mode(0, N_STEPS, "sync")
-    t_async = run_mode(2, N_STEPS, "async")
+    t_sync, parts_sync = run_mode(0, N_STEPS, "sync")
+    t_async, parts_async = run_mode(2, N_STEPS, "async")
     speedup = t_sync / t_async if t_async > 0 else 0.0
+    # the diagnostic: in async mode, batch_wait shrinks (generation for
+    # step N+1 overlapped step N's train+wu); train/wu stay ~constant
     _emit_phase(
         {
             "phase": "async_sync",
@@ -719,6 +728,8 @@ def phase_async_sync():
             "speedup": round(speedup, 3),
             "steps": N_STEPS,
             "tokens_per_step": PROMPTS_PER_STEP * GROUP * NEW_TOKENS,
+            "sync_parts": parts_sync,
+            "async_parts": parts_async,
         }
     )
     try:
