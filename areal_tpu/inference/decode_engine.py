@@ -344,14 +344,11 @@ class DecodeEngine:
         self._rng = jax.random.PRNGKey(seed)
         # precompile() warms via AOT lower().compile(); the serving path
         # replays those programs through the persistent compile cache, so
-        # make sure one is configured. TPU only: CPU AOT cache entries are
-        # machine-feature-specific and a remote-compile service can poison
-        # them for this host (observed SIGILL-class cpu_aot_loader errors).
-        if (
-            jax.config.jax_compilation_cache_dir is None
-            and jax.default_backend() == "tpu"
-        ):
-            jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+        # make sure one is configured (TPU-only gating + the cross-round
+        # repo-local default live in utils/compile_cache.py)
+        from areal_tpu.utils.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache()
         self.initialized = True
         logger.info(
             f"decode engine ready: {S} slots × {T} ctx, "

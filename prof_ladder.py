@@ -12,7 +12,7 @@ Design constraints (learned the hard way this round):
   TPU client leaves the pool lease wedged for every subsequent claim
 - a TPU probe runs between steps; if the tunnel wedges mid-ladder the
   ladder stops instead of queueing more hangs
-- the bench step writes BENCH_r04_mid.json so a later outage cannot zero
+- the bench step writes BENCH_r05_mid.json so a later outage cannot zero
   the round's scoreboard
 """
 
@@ -26,16 +26,49 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 # (name, child budget seconds, code)
+# r05 ordering: the round's must-have (a full valid bench) FIRST — a short
+# lease window must produce BENCH_r05_mid.json + the .bench_cache phase
+# files before anything exploratory runs; on-chip kernel parity SECOND
+# (the r04 1/sqrt(hd) bug proved this class of risk is real); comparisons
+# and component profiles after.
 STEPS = [
+    (
+        "bench_full",
+        1600,
+        "import bench; bench.main()",
+    ),
+    (
+        # on-chip kernel parity: paged attention (bf16 + q8 + stacked),
+        # flash, tree — vs interpret-mode references (VERDICT r04 item #4)
+        "tests_tpu",
+        1500,
+        "import pytest\n"
+        "rc = pytest.main(['tests_tpu', '-x', '-q', '--no-header'])\n"
+        "raise SystemExit(int(rc))",
+    ),
+    (
+        # decode phase rerun with int8 serving: the BENCH_PHASE line in this
+        # step's log vs bench_full's decode line is the promotion decision
+        # for making int8 the default bench config
+        "bench_decode_int8",
+        700,
+        "import os; os.environ['BENCH_QUANT'] = 'int8'\n"
+        "import bench; raise SystemExit(bench._run_phase_child('decode'))",
+    ),
+    (
+        # longctx with int8 KV (+ int8 weights): the KV read dominates at
+        # 4K ctx, so this is where kv_quantization shows
+        "bench_longctx_int8kv",
+        500,
+        "import os\n"
+        "os.environ['BENCH_QUANT'] = 'int8'\n"
+        "os.environ['BENCH_KV_QUANT'] = 'int8'\n"
+        "import bench; raise SystemExit(bench._run_phase_child('longctx'))",
+    ),
     (
         "prof_r3_decode",
         1500,
         "import prof_r3; prof_r3.phase_decode()",
-    ),
-    (
-        "prof_r4_int8",
-        1200,
-        "import prof_r4; prof_r4.phase_int8()",
     ),
     (
         "prof_r4_wu",
@@ -48,28 +81,14 @@ STEPS = [
         "import prof_r3; prof_r3.phase_train()",
     ),
     (
-        "bench_full",
-        1600,
-        "import bench; bench.main()",
-    ),
-    (
-        # decode phase rerun with int8 serving: the BENCH_PHASE line in this
-        # step's log vs bench_full's decode line is the promotion decision
-        # for making int8 the default bench config
-        "bench_decode_int8",
-        700,
-        "import os; os.environ['BENCH_QUANT'] = 'int8'\n"
-        "import bench; bench._run_phase_child('decode')",
-    ),
-    (
-        # longctx with int8 KV (+ int8 weights): the KV read dominates at
-        # 4K ctx, so this is where kv_quantization shows
-        "bench_longctx_int8kv",
-        500,
-        "import os\n"
-        "os.environ['BENCH_QUANT'] = 'int8'\n"
-        "os.environ['BENCH_KV_QUANT'] = 'int8'\n"
-        "import bench; bench._run_phase_child('longctx')",
+        # on-chip RL learning gate through the real stack (server + executor
+        # + PPO). Synthetic task — no pretrained weights exist in this
+        # zero-egress image, so real-GSM8K reward curves stay out of reach;
+        # this validates learning-on-hardware, not benchmark reward.
+        # (NOT via pytest tests/: that conftest forces JAX_PLATFORMS=cpu)
+        "rl_learn_onchip",
+        1200,
+        "import prof_learn; raise SystemExit(prof_learn.main())",
     ),
 ]
 
@@ -77,10 +96,18 @@ STEPS = [
 # interpreter exit runs the PJRT client teardown that releases the remote
 # pool lease — an abrupt signal death wedges it like a SIGKILL does
 _ALARM_PREAMBLE = (
-    "import signal, sys\n"
+    "import signal, sys, os\n"
     "def _die(s, f):\n"
     "    raise SystemExit('ladder alarm: budget exceeded')\n"
     "signal.signal(signal.SIGALRM, _die)\n"
+)
+
+# persistent compile cache shared with bench.py phase children (replays
+# from prior green runs keep cold starts inside the step budgets); the
+# helper gates on backend==tpu so a CPU fallback can't poison the cache
+_CACHE_LINE = (
+    "from areal_tpu.utils.compile_cache import enable_persistent_cache\n"
+    "enable_persistent_cache()\n"
 )
 
 PROBE_CODE = (
@@ -122,6 +149,7 @@ def run_step(name: str, budget: int, code: str) -> bool:
         _ALARM_PREAMBLE
         + f"signal.alarm({budget})\n"
         + "sys.path.insert(0, %r)\n" % REPO
+        + _CACHE_LINE
     ) + code
     log(f"step {name} (budget {budget}s)")
     t0 = time.monotonic()
@@ -150,17 +178,47 @@ def run_step(name: str, budget: int, code: str) -> bool:
     return rc == 0
 
 
+_DONE_PATH = os.path.join(REPO, ".bench_cache", "ladder_done.json")
+
+
+def _load_done() -> dict:
+    try:
+        with open(_DONE_PATH) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _mark_done(name: str) -> None:
+    done = _load_done()
+    done[name] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    os.makedirs(os.path.dirname(_DONE_PATH), exist_ok=True)
+    with open(_DONE_PATH, "w") as f:
+        json.dump(done, f, indent=1)
+
+
 def main():
     start = 0
     if "--from" in sys.argv:
         start = int(sys.argv[sys.argv.index("--from") + 1])
+    # resume support: lease windows are scarce and reruns must not burn one
+    # re-measuring finished steps — completed steps are recorded and
+    # skipped on the next run (override with --force)
+    done = {} if "--force" in sys.argv else _load_done()
     for i, (name, budget, code) in enumerate(STEPS[start:], start):
+        if name in done:
+            log(f"step {name}: already completed {done[name]}, skipping")
+            continue
         if not probe():
             log(f"tunnel blocked before step {i} ({name}); stopping ladder")
             return 1
         ok = run_step(name, budget, code)
         if name == "bench_full":
-            # harvest the one-line JSON into the mid-round snapshot
+            # bench.main() exits 0 even when every phase died (the driver
+            # contract: always print one JSON line) — success for
+            # done-marking purposes means the harvested payload carries a
+            # real LIVE pipeline number, not a cache fallback or 0.0
+            payload = None
             try:
                 lines = open(f"/tmp/ladder_{name}.log").read().splitlines()
                 for ln in reversed(lines):
@@ -170,13 +228,22 @@ def main():
                         payload = json.loads(ln)  # a truncated line must not
                     except json.JSONDecodeError:  # poison the snapshot
                         continue
-                    with open(os.path.join(REPO, "BENCH_r04_mid.json"), "w") as f:
+                    with open(os.path.join(REPO, "BENCH_r05_mid.json"), "w") as f:
                         json.dump(payload, f)
                         f.write("\n")
-                    log(f"BENCH_r04_mid.json written: {ln[:120]}")
+                    log(f"BENCH_r05_mid.json written: {ln[:120]}")
                     break
             except OSError as e:
                 log(f"snapshot harvest failed: {e}")
+            srcs = (payload or {}).get("detail", {}).get("sources", {})
+            ok = (
+                payload is not None
+                and payload.get("value", 0) > 0
+                and srcs.get("decode", "live") == "live"
+                and srcs.get("train", "live") == "live"
+            )
+        if ok:
+            _mark_done(name)
         if not ok and not probe():
             log(f"tunnel died during {name}; stopping ladder")
             return 1
