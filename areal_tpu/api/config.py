@@ -88,6 +88,15 @@ class TrainEngineConfig:
         default_factory=lambda: ["wq", "wk", "wv", "wo"]
     )
     weight_update_mode: str = "disk"  # disk|mem
+    # tree training (reference areal/models/tree_attn/module_*.py +
+    # docs/en/reference/tree_training.md): dedup shared-prefix sequences
+    # (GRPO groups, agentic branches) into a trie and run fwd/bwd over
+    # unique NODES through the block-sparse ancestor-bitmask Pallas kernel;
+    # the loss still runs per-sequence on edge-gathered logprobs, so every
+    # loss-zoo variant is exactly equivalent to padded training
+    tree_training: bool = False
+    tree_node_budget: int = 2048  # max trie nodes per microbatch forward
+    tree_node_bucket: int = 512  # node-axis bucketing (bounds recompiles)
 
 
 @dataclass

@@ -586,13 +586,56 @@ class JaxTrainEngine(TrainEngine):
             outputs["entropy"] = ent
         return outputs
 
-    def _get_grad_fn(self, loss_fn: Callable, shape: tuple):
-        key = ("grad", shape, id(loss_fn))
+    def _tree_outputs_fn(self, params, batch):
+        """Tree-training outputs (reference models/tree_attn/module_fsdp.py
+        :1-185 role): the transformer fwd/bwd runs once per unique trie NODE
+        through the block-sparse ancestor kernel; per-sequence label-aligned
+        logprobs/entropy are then GATHERED from the edges, so the loss zoo
+        sees the same [B, T] contract as the packed path — exact parity,
+        FLOPs scale with unique nodes."""
+        mcfg = self.model_cfg
+        cparams = jax.tree.map(
+            lambda x: x.astype(mcfg.jax_dtype)
+            if isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            params,
+        )
+        from areal_tpu.ops.tree_attention import forest_hidden
+
+        hidden = forest_hidden(
+            cparams,
+            mcfg,
+            batch["node_ids"],
+            batch["node_pos"],
+            batch["mask_words"],
+            batch["block_any"],
+        )
+        # one chunked-vocab pass, EDGE-aligned: row parent(j) scored against
+        # token(j) gives log p(node j | ancestors); the entropy from the
+        # same row is exactly the label-aligned entropy convention
+        edge_hidden = jnp.take(hidden, batch["edge_rows"], axis=0)
+        logp, ent = qwen.chunked_logprobs_entropy(
+            cparams,
+            mcfg,
+            edge_hidden[None],
+            batch["edge_labels"][None],
+            chunk_size=self.config.logprob_chunk_size,
+            temperature=getattr(self.config, "temperature", 1.0),
+        )
+        gather = batch["gather_idx"]  # [B, T] -> edge index of token t+1
+        return {
+            "logprobs": logp[0][gather],
+            "entropy": ent[0][gather],
+        }
+
+    def _get_grad_fn(self, loss_fn: Callable, shape: tuple, kind: str = "packed"):
+        key = ("grad", kind, shape, id(loss_fn))
         if key not in self._fn_cache:
+            ofn = self._outputs_fn if kind == "packed" else self._tree_outputs_fn
 
             def compute(params, batch, scale):
                 def lf(p):
-                    outputs = self._outputs_fn(p, batch)
+                    outputs = ofn(p, batch)
                     loss, stats = loss_fn(outputs, batch)
                     return loss * scale, stats
 
@@ -623,18 +666,22 @@ class JaxTrainEngine(TrainEngine):
             )
         return self._fn_cache[key]
 
-    def _get_fused_step_fn(self, loss_fn: Callable, shape: tuple):
+    def _get_fused_step_fn(
+        self, loss_fn: Callable, shape: tuple, kind: str = "packed"
+    ):
         """Single-microbatch fast path: grad + optimizer apply in ONE jit with
         donated params/opt_state — XLA frees each grad buffer as soon as its
         param update consumes it, cutting peak HBM vs the accumulate path."""
-        key = ("fused", shape, id(loss_fn))
+        key = ("fused", kind, shape, id(loss_fn))
         if key not in self._fn_cache:
+            ofn = self._outputs_fn if kind == "packed" else self._tree_outputs_fn
 
             def step(params, opt_state, batch, scale):
                 def lf(p):
-                    outputs = self._outputs_fn(p, batch)
+                    outputs = ofn(p, batch)
                     loss, stats = loss_fn(outputs, batch)
                     return loss * scale, stats
+
 
                 (loss, stats), grads = jax.value_and_grad(lf, has_aux=True)(params)
                 gnorm = self._grad_norm(grads)
@@ -658,6 +705,165 @@ class JaxTrainEngine(TrainEngine):
             self._fn_cache[key] = jax.jit(apply, donate_argnums=(0, 1))
         return self._fn_cache[key]
 
+    # -- tree training ----------------------------------------------------
+    def _make_tree_batches(
+        self, input_: TensorDict
+    ) -> tuple[list[dict], dict[str, float]]:
+        """Padded [B, T] batch -> host forest microbatches + dedup stats.
+
+        Each microbatch is one fixed-shape forest forward: sequences are
+        chunked under ``tree_node_budget`` unique nodes (GRPO groups kept
+        whole — models/tree.py pack_forest), the trie's ancestor relation
+        packed to bitmask words, and every label-aligned loss key sliced to
+        the chunk's rows. Shapes are bucketed (node axis: tree_node_bucket;
+        time axis: bucket_step) to bound XLA recompiles."""
+        from areal_tpu.models import tree as tree_lib
+        from areal_tpu.ops.tree_attention import BLOCK, pack_ancestor_bits
+
+        cfg = self.config
+        attn = np.asarray(input_["attention_mask"], bool)
+        lens = attn.sum(-1).astype(int)
+        ids = np.asarray(input_["input_ids"])
+        T_orig = ids.shape[1]
+        seqs = [ids[b, : lens[b]] for b in range(len(lens))]
+        packs = tree_lib.pack_forest(
+            seqs, cfg.tree_node_budget, getattr(cfg, "group_size", 1)
+        )
+        batches: list[dict] = []
+        for pack, rows in packs:
+            N = pack.n_nodes
+            n_pad = round_up_to_bucket(N, max(cfg.tree_node_bucket, BLOCK))
+            n_pad = -(-n_pad // BLOCK) * BLOCK
+            words, block_any = pack_ancestor_bits(pack.parent, n_pad)
+            node_ids = np.zeros(n_pad, np.int32)
+            node_ids[:N] = pack.tokens
+            node_pos = np.zeros(n_pad, np.int32)
+            node_pos[:N] = pack.depth
+            # edge j (every non-root node is one edge): score row parent(j)
+            # against token(j); roots clamp to row 0 and are never gathered
+            edge_rows = np.zeros(n_pad, np.int32)
+            edge_rows[:N] = np.maximum(pack.parent, 0)
+            edge_labels = np.zeros(n_pad, np.int32)
+            edge_labels[:N] = pack.tokens
+            Tp = min(
+                T_orig,
+                round_up_to_bucket(
+                    int(max(lens[r] for r in rows)), cfg.bucket_step
+                ),
+            )
+            B = len(rows)
+            # bucket the row axis too: how many groups fit a node budget
+            # shifts step to step, and an unbucketed B would recompile the
+            # full fwd/bwd per distinct pack size. Dummy rows carry
+            # label_valid=False and zeroed loss keys — inert in every loss.
+            B_pad = round_up_to_bucket(B, 8)
+            gather = np.zeros((B_pad, Tp), np.int32)
+            label_valid = np.zeros((B_pad, Tp), bool)
+            for i in range(B):
+                nodes = pack.seq_nodes[i]
+                L = len(nodes)
+                gather[i, : L - 1] = nodes[1:]
+                label_valid[i, : L - 1] = True
+            batch = {
+                "node_ids": node_ids,
+                "node_pos": node_pos,
+                "mask_words": words,
+                "block_any": block_any,
+                "edge_rows": edge_rows,
+                "edge_labels": edge_labels,
+                "gather_idx": gather,
+                "label_valid": label_valid,
+            }
+            for k in _GRID_KEYS:
+                if k in ("labels", "label_valid", "image_embeds"):
+                    continue
+                if k not in input_:
+                    continue
+                v = np.asarray(input_[k])[rows]
+                if v.ndim >= 2 and v.shape[1] == T_orig:
+                    v = v[:, :Tp]
+                if B_pad > B:
+                    pad = np.zeros((B_pad - B, *v.shape[1:]), v.dtype)
+                    v = np.concatenate([v, pad], axis=0)
+                batch[k] = v
+            batches.append(batch)
+        total_tokens = int(lens.sum())
+        total_nodes = sum(p.n_nodes for p, _ in packs)
+        stats = {
+            "tree_tokens": float(total_tokens),
+            "tree_nodes": float(total_nodes),
+            # fwd/bwd FLOPs scale with nodes: this ratio IS the measured
+            # FLOP reduction vs padded training (reference claims up to 10x,
+            # docs/en/reference/tree_training.md:19-21)
+            "tree_dedup_ratio": float(total_tokens) / max(total_nodes, 1),
+        }
+        return batches, stats
+
+    def _tree_batch_to_device(self, batch: dict) -> dict[str, jax.Array]:
+        """Tree microbatches ship replicated: the node axis is one fused
+        kernel sequence (not row-shardable like grids), and params keep
+        their GSPMD shardings regardless."""
+        rep = mesh_lib.replicated(self.mesh)
+        dev = {}
+        for k, v in batch.items():
+            v = np.asarray(v)
+            if v.dtype == np.float64:
+                v = v.astype(np.float32)
+            if v.dtype == np.int64:
+                v = v.astype(np.int32)
+            dev[k] = jax.device_put(v, rep)
+        return dev
+
+    def _train_batch_tree(
+        self,
+        input_: TensorDict,
+        loss_fn: Callable,
+        loss_weight_fn: Callable[[TensorDict], float],
+    ) -> dict[str, float]:
+        t0 = time.monotonic()
+        batches, tstats = self._make_tree_batches(input_)
+        weights = [float(loss_weight_fn(b)) for b in batches]
+        total_w = sum(weights) or 1.0
+        agg: dict[str, float] = {}
+        if len(batches) == 1:
+            with jax.set_mesh(self.mesh):
+                batch = self._tree_batch_to_device(batches[0])
+                shape = batch["node_ids"].shape + batch["gather_idx"].shape
+                step_before = self._opt_step_count()
+                fn = self._get_fused_step_fn(loss_fn, shape, kind="tree")
+                self.params, self.opt_state, gnorm, loss, stats = fn(
+                    self.params,
+                    self.opt_state,
+                    batch,
+                    jnp.float32(weights[0] / total_w),
+                )
+            agg = {k: float(v) for k, v in {**stats, "loss": loss}.items()}
+            agg["n_microbatches"] = 1.0
+        else:
+            grads = None
+            accum = self._get_accum_fn()
+            with jax.set_mesh(self.mesh):
+                for b, w in zip(batches, weights):
+                    batch = self._tree_batch_to_device(b)
+                    shape = batch["node_ids"].shape + batch["gather_idx"].shape
+                    gfn = self._get_grad_fn(loss_fn, shape, kind="tree")
+                    new_grads, loss, stats = gfn(
+                        self.params, batch, jnp.float32(w / total_w)
+                    )
+                    grads = new_grads if grads is None else accum(grads, new_grads)
+                    for k, v in {**stats, "loss": loss}.items():
+                        agg[k] = agg.get(k, 0.0) + float(v) * (w / total_w)
+                step_before = self._opt_step_count()
+                self.params, self.opt_state, gnorm = self._get_apply_fn()(
+                    self.params, self.opt_state, grads
+                )
+            agg["n_microbatches"] = float(len(batches))
+        agg["grad_norm"] = float(gnorm)
+        agg["lr"] = float(self._lr_schedule(step_before))
+        agg.update(tstats)
+        agg["train_batch_secs"] = time.monotonic() - t0
+        return agg
+
     # -- TrainEngine API --------------------------------------------------
     def train_batch(
         self,
@@ -667,6 +873,17 @@ class JaxTrainEngine(TrainEngine):
         mb_spec: MicroBatchSpec | None = None,
     ) -> dict[str, float]:
         assert self.params is not None, "engine not initialized"
+        if getattr(self.config, "tree_training", False):
+            assert not self.value_head, "tree training is a policy-only path"
+            assert "pixel_values" not in input_ and "image_embeds" not in input_, (
+                "tree training does not support vision inputs"
+            )
+            # the forest forward drops the MoE router aux; a loss relying on
+            # outputs["moe_aux"] would silently train without load balance
+            assert self.model_cfg.num_experts == 0, (
+                "tree training does not support MoE models yet"
+            )
+            return self._train_batch_tree(input_, loss_fn, loss_weight_fn)
         t0 = time.monotonic()
         grids = self._make_grids(input_, mb_spec=mb_spec)
         weights = [float(loss_weight_fn(g.data)) for g in grids]

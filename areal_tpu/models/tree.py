@@ -126,6 +126,73 @@ def build_tree(sequences: list[list[int] | np.ndarray]) -> TreePack:
     )
 
 
+def pack_forest(
+    sequences: list[list[int] | np.ndarray],
+    node_budget: int,
+    group_size: int = 1,
+) -> list[tuple[TreePack, list[int]]]:
+    """Chunk a batch of sequences into FORESTS under a fixed node budget.
+
+    The scale half of the reference's trie builder
+    (areal/models/tree_attn/tree.py:1-895: chunked packing of many tries
+    into fixed budgets): sequences are taken in order, ``group_size`` at a
+    time (GRPO groups stay whole — their shared prompt is exactly the
+    dedup win), and merged into one trie per chunk until adding the next
+    group would exceed ``node_budget`` unique nodes. Disjoint tries coexist
+    in one pack (build_tree roots them separately; the ancestor mask keeps
+    them from attending each other), so each pack is ONE fixed-shape
+    forward for the engine.
+
+    Returns ``[(pack, seq_indices), ...]`` covering every input sequence
+    exactly once, order-preserving. A single group larger than the budget
+    gets its own oversized pack (caller pads to its true size) rather than
+    being split — splitting would lose the shared-prefix dedup that makes
+    the group cheap in the first place.
+    """
+    assert sequences, "need at least one sequence"
+    assert node_budget > 0 and group_size > 0
+    groups = [
+        list(range(i, min(i + group_size, len(sequences))))
+        for i in range(0, len(sequences), group_size)
+    ]
+
+    # ONE running trie (same children-keyed insert as build_tree), grown
+    # group by group and rolled back when a group overflows the budget —
+    # O(total tokens) overall, not O(tokens²) per pack
+    children: dict[tuple[int, int], int] = {}
+    n_nodes = 0
+
+    def insert_group(g) -> None:
+        nonlocal n_nodes
+        for i in g:
+            cur = -1
+            for tok in np.asarray(sequences[i]).reshape(-1):
+                key = (cur, int(tok))
+                nxt = children.get(key)
+                if nxt is None:
+                    nxt = n_nodes
+                    children[key] = nxt
+                    n_nodes += 1
+                cur = nxt
+
+    out: list[tuple[TreePack, list[int]]] = []
+    cur_idx: list[int] = []
+    for g in groups:
+        insert_group(g)
+        if cur_idx and n_nodes > node_budget:
+            # overflow: flush the accumulated chunk, restart with this group
+            out.append((build_tree([sequences[i] for i in cur_idx]), cur_idx))
+            children.clear()
+            n_nodes = 0
+            insert_group(g)
+            cur_idx = list(g)
+        else:
+            cur_idx += g
+    if cur_idx:
+        out.append((build_tree([sequences[i] for i in cur_idx]), cur_idx))
+    return out
+
+
 def edge_logprob_index(pack: TreePack) -> tuple[np.ndarray, np.ndarray]:
     """For every non-root node j: (parent[j], tokens[j]) — gather the model's
     logits at parent[j] row, token[j] column to get log p(node | ancestors).

@@ -407,30 +407,30 @@ def _tree_attn_bwd(interpret, res, dout):
 tree_attention.defvjp(_tree_attn_fwd, _tree_attn_bwd)
 
 
-def tree_forward_logprobs_pallas(params, cfg, pack, remat: bool | None = None):
-    """Packed-trie forward with the block-sparse kernel in every layer.
-    Fully differentiable (tree_attention carries a custom VJP), so this is
-    BOTH the phase-2 scoring path and the sparse *training* path
-    (models/tree.py tree_train_logprobs dispatches here). ``remat``
-    checkpoints each layer like the main model (defaults to cfg.remat).
-    Returns node_logp [N] like tree.tree_forward_logprobs."""
-    from areal_tpu.models import qwen
-    from areal_tpu.models.tree import edge_logprob_index, non_root_nodes
+def forest_hidden(
+    params,
+    cfg,
+    ids: jax.Array,  # [Npad] int32 node tokens (padding: 0)
+    positions: jax.Array,  # [Npad] int32 node depths (rope positions)
+    words: jax.Array,  # [Npad, Npad // 32] uint32 ancestor bitmask
+    block_any: jax.Array,  # [nB, nB] int32 tile skip map
+    remat: bool | None = None,
+) -> jax.Array:
+    """Transformer forward over packed trie nodes with the block-sparse
+    kernel in every layer -> final-norm hidden states [Npad, D].
 
-    N = pack.n_nodes
-    n_pad = -(-N // BLOCK) * BLOCK
-    words_np, block_any_np = pack_ancestor_bits(pack.parent, n_pad)
-    ids = np.zeros(n_pad, np.int32)
-    ids[:N] = pack.tokens
-    pos = np.zeros(n_pad, np.int32)
-    pos[:N] = pack.depth
+    Pure jax-array contract (jit-safe): the engine's tree-training path
+    feeds host-built node/mask arrays straight through its grad jit. The
+    ancestor mask isolates disjoint trees, so a whole FOREST (many tries
+    packed into one node axis, models/tree.py pack_forest) runs as one
+    call. Fully differentiable via tree_attention's custom VJP."""
+    from areal_tpu.models import qwen
 
     mcfg = cfg
+    n_pad = ids.shape[0]
     H, KH, hd = mcfg.num_heads, mcfg.num_kv_heads, mcfg.head_dim_
-    x = jnp.take(params["embed"], jnp.asarray(ids), axis=0).astype(mcfg.jax_dtype)
-    words = jnp.asarray(words_np)
-    block_any = jnp.asarray(block_any_np)
-    positions = jnp.asarray(pos)[None]
+    x = jnp.take(params["embed"], ids, axis=0).astype(mcfg.jax_dtype)
+    positions = positions[None]
 
     def layer_fn(x, layer):
         h = qwen._rms_norm(x, layer["input_norm"], mcfg.rms_norm_eps)
@@ -467,8 +467,37 @@ def tree_forward_logprobs_pallas(params, cfg, pack, remat: bool | None = None):
             layer_fn, policy=jax.checkpoint_policies.nothing_saveable
         )
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])
-    hidden = qwen._rms_norm(x, params["final_norm"], mcfg.rms_norm_eps)
-    logits = qwen.compute_logits(params, mcfg, hidden[None])[0]
+    return qwen._rms_norm(x, params["final_norm"], mcfg.rms_norm_eps)
+
+
+def tree_forward_logprobs_pallas(params, cfg, pack, remat: bool | None = None):
+    """Packed-trie forward with the block-sparse kernel in every layer.
+    Fully differentiable (tree_attention carries a custom VJP), so this is
+    BOTH the phase-2 scoring path and the sparse *training* path
+    (models/tree.py tree_train_logprobs dispatches here). ``remat``
+    checkpoints each layer like the main model (defaults to cfg.remat).
+    Returns node_logp [N] like tree.tree_forward_logprobs."""
+    from areal_tpu.models import qwen
+    from areal_tpu.models.tree import edge_logprob_index, non_root_nodes
+
+    N = pack.n_nodes
+    n_pad = -(-N // BLOCK) * BLOCK
+    words_np, block_any_np = pack_ancestor_bits(pack.parent, n_pad)
+    ids = np.zeros(n_pad, np.int32)
+    ids[:N] = pack.tokens
+    pos = np.zeros(n_pad, np.int32)
+    pos[:N] = pack.depth
+
+    hidden = forest_hidden(
+        params,
+        cfg,
+        jnp.asarray(ids),
+        jnp.asarray(pos),
+        jnp.asarray(words_np),
+        jnp.asarray(block_any_np),
+        remat=remat,
+    )
+    logits = qwen.compute_logits(params, cfg, hidden[None])[0]
     logp_all = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     rows, toks = edge_logprob_index(pack)
     edge_logp = logp_all[jnp.asarray(rows), jnp.asarray(toks)]

@@ -145,11 +145,17 @@ def probe() -> bool:
 def run_step(name: str, budget: int, code: str) -> bool:
     # in-child graceful deadline; SIGALRM raises in the main thread and the
     # interpreter exits normally -> PJRT teardown releases the lease
+    # _CACHE_LINE initializes a TPU client (jax.default_backend()), which
+    # CLAIMS the pool lease — bench_full is a phase-SPAWNING parent whose
+    # children must make their own claims (and already enable the cache in
+    # _run_phase_child), so giving the parent the cache line would hold the
+    # lease against its own children for the whole step
+    cache = "" if name == "bench_full" else _CACHE_LINE
     child = (
         _ALARM_PREAMBLE
         + f"signal.alarm({budget})\n"
         + "sys.path.insert(0, %r)\n" % REPO
-        + _CACHE_LINE
+        + cache
     ) + code
     log(f"step {name} (budget {budget}s)")
     t0 = time.monotonic()

@@ -98,6 +98,11 @@ WIRING = {
         and c.actor.group_size == 8
         and c.actor.group_reward_norm
     ),
+    "gsm8k_grpo_tree.yaml": lambda c: (
+        c.actor.tree_training
+        and c.actor.tree_node_budget == 8192
+        and c.actor.group_size == 8  # shared prompts are the dedup win
+    ),
     "gsm8k_grpo_int8serve.yaml": lambda c: (
         c.server.quantization == "int8"
         and c.server.kv_quantization == "int8"

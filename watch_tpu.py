@@ -44,8 +44,8 @@ def main():
             # ladder stopped mid-way (lease re-wedged); wait for the next
             # window and rerun — finished bench phases replay from cache
         time.sleep(PERIOD_S)
-    log("max ladder runs reached; watcher done")
-    return 0
+    log("max ladder runs reached without a complete ladder; watcher done")
+    return 1
 
 
 if __name__ == "__main__":
