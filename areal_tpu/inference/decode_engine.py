@@ -55,6 +55,11 @@ from areal_tpu.utils.data import round_up_to_bucket
 logger = alog.getLogger("decode_engine")
 
 _MAX_STOP = 8  # stop-token-id slots per request (padded with -1)
+# the exact leaf names quantize_params_int8 produces — suffix matching would
+# misroute any future base param that happens to end in _scale (ADVICE r04)
+_SERVED_FORM_LEAVES = frozenset(
+    f"{t}{suf}" for t in qwen.QUANT_TARGETS for suf in ("_q8", "_scale")
+)
 _TOPK_CAP = 1024  # static candidate-set size for per-slot top-k/top-p
 _PREFILL_SIZES = (8, 4, 2, 1)  # batched-prefill group sizes (compile variants)
 
@@ -364,7 +369,7 @@ class DecodeEngine:
         Used by HF load, caller-provided-params reshard, staged-bucket
         ingest, and disk updates — keep them identical."""
         name = path.rsplit("/", 1)[-1]
-        if name.endswith(("_q8", "_scale")):
+        if name in _SERVED_FORM_LEAVES:
             # served-form leaf from a q8-wire update
             if self.config.quantization != "int8":
                 raise RuntimeError(
@@ -816,7 +821,12 @@ class DecodeEngine:
         assert flat, "no staged weights"
         tree = _unflatten(flat)
         got_paths = {p for p, _ in _iter_tree_paths(tree)}
-        served_form = any(p.endswith("_q8") for p in got_paths)
+        # served_form is decided HERE, once, and travels with the payload —
+        # the apply side must not re-derive it (ADVICE r04: two detections
+        # drift apart)
+        served_form = any(
+            p.rsplit("/", 1)[-1] in _SERVED_FORM_LEAVES for p in got_paths
+        )
         # sanity: staged tree must cover the whole param structure — the
         # UNQUANTIZED one for bf16-wire updates (engine re-quantizes on
         # apply), or the SERVED (quantized) one for q8-wire updates
@@ -827,9 +837,16 @@ class DecodeEngine:
         missing = ref_paths - got_paths
         assert not missing, f"staged update missing params: {sorted(missing)[:5]}"
         with self._weight_lock:
-            self._pending_weight_update = ("staged", tree, version)
+            self._pending_weight_update = ("staged", (tree, served_form), version)
         self._wakeup.set()
         self._wait_weight_update_applied()
+
+    def abort_staged_update(self) -> None:
+        """Drop a partially staged update without committing (e.g. a
+        stream-rate probe, or a client that died mid-stream). Safe when
+        nothing is staged."""
+        with self._weight_lock:
+            self._staged_flat = None
 
     def _apply_weight_update(self) -> None:
         try:
@@ -865,17 +882,15 @@ class DecodeEngine:
             if kind == "staged":
                 # already sharded device arrays — pointer swap. bf16-wire
                 # trees re-quantize in one fused device pass; q8-wire trees
-                # (client pre-quantized, leaves named *_q8/*_scale) are
-                # already in served form
-                already_served = any(
-                    k.endswith("_q8") for k in payload.get("layers", {})
-                )
-                # (a served-form tree can't reach a non-quantized engine:
-                # _place rejects q8-wire leaves at stage time)
+                # (client pre-quantized, served_form decided once at commit
+                # time) are already in served form. (A served-form tree
+                # can't reach a non-quantized engine: _place rejects q8-wire
+                # leaves at stage time.)
+                tree, already_served = payload
                 self.params = (
-                    self._quantize(payload)
+                    self._quantize(tree)
                     if quantized and not already_served
-                    else payload
+                    else tree
                 )
             elif kind == "lora":
                 if quantized:

@@ -43,6 +43,44 @@ from jax.experimental.pallas.ops.tpu.paged_attention.paged_attention_kernel impo
     paged_flash_attention_kernel_inline_seq_dim,
 )
 
+# This fork passes positional args into a PRIVATE jax kernel whose signature
+# a jax upgrade can silently reorder/extend — fail loudly at import instead
+# of via subtly wrong kernel arguments (tested against jax 0.9.0; interpret
+# tests only help if they run on the upgraded jax).
+import inspect as _inspect
+
+_EXPECTED_KERNEL_PARAMS = (
+    "lengths_ref",
+    "page_indices_ref",
+    "buffer_index_ref",
+    "init_flag_ref",
+    "q_ref",
+    "k_pages_hbm_ref",
+    "k_scales_pages_hbm_ref",
+    "v_pages_hbm_ref",
+    "v_scales_pages_hbm_ref",
+    "o_ref",
+    "m_ref",
+    "l_ref",
+    "k_vmem_buffer",
+    "k_scales_vmem_buffer",
+    "v_vmem_buffer",
+    "v_scales_vmem_buffer",
+    "k_sems",
+    "v_sems",
+)
+_got = tuple(
+    _inspect.signature(
+        paged_flash_attention_kernel_inline_seq_dim
+    ).parameters
+)[: len(_EXPECTED_KERNEL_PARAMS)]
+if _got != _EXPECTED_KERNEL_PARAMS:
+    raise ImportError(
+        "jax's private paged_flash_attention_kernel_inline_seq_dim signature "
+        f"changed (got {_got}); re-audit areal_tpu/ops/paged_attention_q8.py "
+        "against the new kernel before serving with int8 KV"
+    )
+
 
 def paged_attention_q8(
     q: jax.Array,  # [S, H, hd] — RAW (scaling applied internally)

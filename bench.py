@@ -383,7 +383,7 @@ def phase_decode():
         for arr in eng._staged_flat.values():
             _sync_scalar(arr[(0,) * arr.ndim])
         dt = time.monotonic() - t0
-        eng._staged_flat = None  # drop the partial stage (no commit)
+        eng.abort_staged_update()  # drop the partial stage (no commit)
         wu["wu_stream_mbps"] = round(size / dt / 1e6, 1)
         wu["wu_stream_est_secs"] = round(total_bytes / (size / dt), 1)
         log(
