@@ -483,11 +483,20 @@ class RemoteJaxEngine(InferenceEngine):
                     )
 
             nxt = first
-            for i in range(len(buckets)):
-                body = nxt.result()
-                if i + 1 < len(buckets):
-                    nxt = enc_pool.submit(self._encode_bucket, buckets[i + 1])
-                send(body)
+            try:
+                for i in range(len(buckets)):
+                    body = nxt.result()
+                    if i + 1 < len(buckets):
+                        nxt = enc_pool.submit(self._encode_bucket, buckets[i + 1])
+                    send(body)
+            except Exception:
+                # a failed stream must not leave partial buckets pinning
+                # server HBM until the next begin — best-effort abort
+                try:
+                    self._post_all("/update_weights_abort", {})
+                except Exception:  # noqa: BLE001
+                    pass
+                raise
         self._post_all("/update_weights_commit", {"version": version})
 
     def _post_all_bytes(self, path: str, body: bytes) -> None:

@@ -85,6 +85,7 @@ class InferenceServer:
                 web.post("/update_weights_begin", self.h_update_begin),
                 web.post("/update_weights_bucket", self.h_update_bucket),
                 web.post("/update_weights_commit", self.h_update_commit),
+                web.post("/update_weights_abort", self.h_update_abort),
                 web.post("/update_weights_lora", self.h_update_lora),
                 web.post("/set_version", self.h_set_version),
                 web.post("/release_memory_occupation", self.h_release_memory),
@@ -228,6 +229,13 @@ class InferenceServer:
             None, self.engine.commit_staged_weights, d.get("version")
         )
         return web.json_response({"status": "ok", "version": self.engine.get_version()})
+
+    async def h_update_abort(self, request: web.Request) -> web.Response:
+        """Drop a partially staged update (a trainer that died mid-stream
+        would otherwise leave the staged device arrays pinning HBM until
+        the next begin)."""
+        self.engine.abort_staged_update()
+        return web.json_response({"status": "ok"})
 
     async def h_set_version(self, request: web.Request) -> web.Response:
         d = await request.json()

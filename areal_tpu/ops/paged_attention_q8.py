@@ -68,12 +68,20 @@ _EXPECTED_KERNEL_PARAMS = (
     "v_scales_vmem_buffer",
     "k_sems",
     "v_sems",
+    "batch_size",
+    "pages_per_compute_block",
+    "pages_per_sequence",
+    "mask_value",
+    "attn_logits_soft_cap",
+    "megacore_mode",
 )
+# the FULL tuple, not a prefix: an APPENDED param (defaulted, supplied by
+# jax's own wrapper but not by this fork) must fail here too
 _got = tuple(
     _inspect.signature(
         paged_flash_attention_kernel_inline_seq_dim
     ).parameters
-)[: len(_EXPECTED_KERNEL_PARAMS)]
+)
 if _got != _EXPECTED_KERNEL_PARAMS:
     raise ImportError(
         "jax's private paged_flash_attention_kernel_inline_seq_dim signature "

@@ -1,0 +1,141 @@
+"""SlurmScheduler + SlurmLauncher EXECUTING against the fake-slurm PATH
+shims (VERDICT r04 item #6): worker arrays really spawn, register through
+file name_resolve, serve HTTP health; the launcher supervises real trainer
+subprocesses including the run_id+1 recovery loop and the GONE+rc-file
+verdict protocol. Reference: areal/infra/scheduler/slurm.py,
+areal/infra/launcher/slurm.py."""
+
+import asyncio
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.scheduler_api import Job
+from areal_tpu.utils import name_resolve
+
+from fake_slurm import fake_slurm  # noqa: F401 (fixture)
+
+
+@pytest.fixture()
+def ns_guard():
+    yield
+    for var in ("AREAL_NAME_RESOLVE", "AREAL_NAME_RESOLVE_ROOT"):
+        os.environ.pop(var, None)
+    name_resolve.reconfigure("memory")
+
+
+def test_scheduler_worker_array_lifecycle(fake_slurm, tmp_path, ns_guard):  # noqa: F811
+    from areal_tpu.infra.scheduler.slurm import SlurmScheduler
+
+    sched = SlurmScheduler(
+        log_dir=str(tmp_path / "slurm"), start_timeout=90.0
+    )
+    job = Job(role="echo", replicas=2, cpus=1, mem_gb=1)
+    workers = sched.create_workers(job)
+    assert len(workers) == 2
+    assert all(w.ports for w in workers)
+    sched.check_health("echo")  # squeue state + HTTP /health on each worker
+    # the workers are REAL rpc servers: round-trip an engine-less echo call
+    from areal_tpu.utils.network import http_json
+
+    d = http_json(f"http://{workers[0].address}/health", timeout=10)
+    assert d.get("status") == "ok"
+    sched.delete_workers("echo")
+    # registrations cleared: a re-created role discovers only NEW workers
+    assert name_resolve.get_subtree(f"{sched.ns_prefix}/echo") == []
+
+
+def test_scheduler_fails_fast_when_workers_crash(fake_slurm, tmp_path, ns_guard):  # noqa: F811
+    from areal_tpu.infra.scheduler import slurm as sched_mod
+    from areal_tpu.infra.scheduler.slurm import SlurmScheduler
+
+    sched = SlurmScheduler(log_dir=str(tmp_path / "slurm"), start_timeout=60.0)
+    # make every array task die instantly: point the template at a module
+    # that exits nonzero before registering
+    orig = sched_mod._SBATCH_TEMPLATE
+    sched_mod._SBATCH_TEMPLATE = orig.replace(
+        "areal_tpu.infra.rpc.rpc_server", "nonexistent_module_xyz"
+    )
+    try:
+        with pytest.raises(RuntimeError, match="before all workers registered"):
+            sched.create_workers(Job(role="crash", replicas=2))
+    finally:
+        sched_mod._SBATCH_TEMPLATE = orig
+
+
+@pytest.mark.slow
+def test_launcher_pipeline_and_recovery(fake_slurm, tmp_path, ns_guard):  # noqa: F811
+    """Servers come up via sbatch, a client generates through them, the
+    trainer supervision loop retries with run_id+1 (rc-file verdict: the
+    fake squeue forgets finished jobs, so the GONE path is what's used)."""
+    import jax
+
+    from areal_tpu.api.config import InferenceEngineConfig
+    from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+    from areal_tpu.infra.launcher.slurm import SlurmLauncher
+    from areal_tpu.inference.client import RemoteJaxEngine
+    from areal_tpu.models import qwen
+    from areal_tpu.models.hf import save_params_to_hf
+
+    from tpu_testing import TINY_QWEN2
+
+    params = qwen.init_params(jax.random.PRNGKey(0), TINY_QWEN2)
+    hf_path = str(tmp_path / "hf")
+    save_params_to_hf(params, TINY_QWEN2, hf_path)
+
+    os.environ["AREAL_NAME_RESOLVE"] = "file"
+    os.environ["AREAL_NAME_RESOLVE_ROOT"] = str(tmp_path / "ns")
+    lau = SlurmLauncher(
+        experiment_name="slurm-e2e",
+        trial_name="t0",
+        n_servers=1,
+        server_args=[
+            f"model_path={hf_path}",
+            "dtype=float32",
+            "max_batch_size=4",
+            "max_seq_len=128",
+            "decode_steps_per_call=4",
+            "mesh.data=-1",
+            "mesh.model=1",
+        ],
+        log_dir=str(tmp_path / "launcher"),
+        ns_root=str(tmp_path / "ns"),
+        recover_mode="on",
+        recover_retries=1,
+        server_start_timeout=120.0,
+        poll_interval=0.5,
+    )
+    try:
+        addrs = lau.start_servers()
+        assert len(addrs) == 1
+        client = RemoteJaxEngine(
+            InferenceEngineConfig(experiment_name="slurm-e2e", trial_name="t0"),
+            addresses=addrs,
+        )
+        client._wait_healthy(60)
+        rng = np.random.default_rng(0)
+        resp = asyncio.run(
+            client.agenerate(
+                ModelRequest(
+                    input_ids=rng.integers(0, 256, 8).tolist(),
+                    gconfig=GenerationHyperparameters(max_new_tokens=8, greedy=True),
+                )
+            )
+        )
+        assert len(resp.output_tokens) == 8
+
+        # supervision: run 0 exits 1, the launcher resubmits with run_id 1
+        rc = lau.run_trainer(
+            [
+                sys.executable,
+                "-c",
+                "import os, sys; "
+                "sys.exit(0 if int(os.environ['AREAL_RUN_ID']) >= 1 else 1)",
+            ]
+        )
+        assert rc == 0
+        assert os.path.exists(os.path.join(lau.log_dir, "trainer-run1.rc"))
+    finally:
+        lau.stop_servers()
