@@ -338,15 +338,17 @@ def _mesh_config_of(ps: "ParallelStrategy | HybridParallelStrategy"):
     from areal_tpu.api.config import MeshConfig
 
     if isinstance(ps, HybridParallelStrategy):
-        dp, cp, tp, ep = ps.attn.dp, ps.attn.cp, ps.attn.tp, ps.ffn.ep
+        dp, cp, tp, ep, pp = ps.attn.dp, ps.attn.cp, ps.attn.tp, ps.ffn.ep, ps.attn.pp
     else:
-        dp, cp, tp, ep = ps.dp, ps.cp, ps.tp, ps.ep
+        dp, cp, tp, ep, pp = ps.dp, ps.cp, ps.tp, ps.ep, ps.pp
     if dp % ep != 0:
         raise ValueError(
             f"ep={ep} must divide dp={dp} "
             "(expert parallelism borrows data-parallel degrees)"
         )
-    return MeshConfig(data=1, fsdp=dp // ep, seq=cp, model=tp, expert=ep)
+    return MeshConfig(
+        data=1, fsdp=dp // ep, seq=cp, model=tp, expert=ep, pipe=pp
+    )
 
 
 def apply_allocation_mode(config) -> "AllocationMode | None":
@@ -385,6 +387,12 @@ def apply_allocation_mode(config) -> "AllocationMode | None":
         # the gen layout is the train mapping with the replica axis peeled
         # off: one server per fsdp slice, each owning a cp×tp×ep chip slice
         gen_mesh = _mesh_config_of(gen_ps)
+        if gen_mesh.pipe > 1:
+            raise ValueError(
+                "pipeline parallelism (pN) applies to training only; the "
+                "decode engine serves layer-stacked weights without stage "
+                "partitioning — drop pN from the gen half of allocation_mode"
+            )
         n_servers = gen_mesh.fsdp
         if getattr(server_cfg, "mesh", None) == default:
             server_cfg.mesh = dataclasses.replace(gen_mesh, fsdp=1)

