@@ -33,6 +33,7 @@ class NormConfig:
     group_size: int = 1
     eps: float = 1e-5
     mean_leave1out: bool = False  # RLOO leave-one-out baseline
+    std_unbiased: bool = False  # Bessel (n-1) correction on the std
 
 
 @dataclass
@@ -61,6 +62,11 @@ class MeshConfig:
     seq: int = 1
     model: int = 1
     expert: int = 1
+    # GPipe stage axis (AllocationMode pN). GSPMD sharding covers most PP
+    # use cases on TPU (SURVEY §2.4) — rarely recommended, but mechanism
+    # available: the engine routes the layer stack through
+    # parallel/pipeline.py when pipe > 1
+    pipe: int = 1
 
 
 @dataclass
@@ -281,6 +287,16 @@ class WandBConfig:
     project: str | None = None
     name: str | None = None
     group: str | None = None
+    # passthroughs to wandb.init (reference cli_args.py WandBConfig);
+    # base_url/api_key export to the standard env vars before init
+    wandb_base_url: str = ""
+    wandb_api_key: str = ""
+    entity: str | None = None
+    job_type: str | None = None
+    notes: str | None = None
+    tags: list[str] | None = None
+    config: dict | None = None
+    id_suffix: str = "train"
 
 
 @dataclass
@@ -299,8 +315,9 @@ class StatsLoggerConfig:
 
 @dataclass
 class NameResolveConfig:
-    type: str = "memory"  # memory|nfs
+    type: str = "memory"  # memory|nfs|etcd3
     nfs_record_root: str = "/tmp/areal_tpu/name_resolve"
+    etcd3_addr: str = "localhost:2379"  # v3 JSON gateway host:port
 
 
 @dataclass
@@ -328,11 +345,27 @@ class LauncherConfig:
 
 
 @dataclass
+class SessionTracerConfig:
+    """Per-rollout-session lifecycle tracing (reference cli_args.py
+    SessionTracerConfig): records land in sessions.jsonl next to the perf
+    trace. When None on PerfTracerConfig, session tracing follows the perf
+    tracer's own enabled flag (the pre-knob behavior)."""
+
+    enabled: bool = False
+    flush_threshold: int = 256  # buffer this many finalized records per write
+
+
+@dataclass
 class PerfTracerConfig:
     enabled: bool = False
     output_dir: str | None = None
     save_freq_steps: int = 10
     max_events: int = 200_000  # in-memory ring bound (oldest dropped)
+    # capture a DETAILED device profile (jax.profiler trace, viewable in
+    # TensorBoard/XProf) at exactly these global steps — the reference's
+    # profile_steps knob with torch.profiler swapped for the XLA profiler
+    profile_steps: list[int] | None = None
+    session_tracer: SessionTracerConfig | None = None
 
 
 @dataclass

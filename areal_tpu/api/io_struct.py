@@ -29,6 +29,11 @@ class GenerationHyperparameters:
     stop_token_ids: list[int] = dataclasses.field(default_factory=list)
     stop: list[str] = dataclasses.field(default_factory=list)
     frequency_penalty: float = 0.0
+    # generate to the full token budget even when a stop token appears
+    # (benchmark/profiling runs; reference ignore_eos semantics)
+    ignore_eos: bool = False
+    # detokenization control applied by workflows when rendering completions
+    skip_special_tokens: bool = True
 
     def new(self, **kwargs) -> "GenerationHyperparameters":
         return dataclasses.replace(self, **kwargs)

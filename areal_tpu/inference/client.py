@@ -178,6 +178,7 @@ class RemoteJaxEngine(InferenceEngine):
                     "top_k": g.top_k,
                     "stop_token_ids": g.stop_token_ids,
                     "max_tokens": g.max_tokens,
+                    "ignore_eos": g.ignore_eos,
                 },
             }
             data = await self._post_json(addr, "/generate", payload)

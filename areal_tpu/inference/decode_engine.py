@@ -1280,7 +1280,7 @@ class DecodeEngine:
             greedy=greedy,
             temp=temp,
             top_p=g.top_p if g.top_p else 1.0,
-            stops=g.stop_token_ids,
+            stops=[] if g.ignore_eos else g.stop_token_ids,
         )
 
     def _budget(self, task: _Task, prompt_len: int) -> int:
@@ -1815,7 +1815,8 @@ class DecodeEngine:
             st["active"][slot] = bool(active[slot])
             if not active[slot]:
                 last = task.out_tokens[-1] if task.out_tokens else -1
-                if last in task.req.gconfig.stop_token_ids:
+                g = task.req.gconfig
+                if not g.ignore_eos and last in g.stop_token_ids:
                     reason = StopReason.STOP.value
                 else:
                     reason = StopReason.LENGTH.value

@@ -36,6 +36,7 @@ def _req_from_json(d: dict) -> ModelRequest:
         top_k=g.get("top_k", -1),
         stop_token_ids=g.get("stop_token_ids", []),
         max_tokens=g.get("max_tokens"),
+        ignore_eos=bool(g.get("ignore_eos", False)),
     )
     image_data = None
     if d.get("image_data"):

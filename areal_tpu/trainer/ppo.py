@@ -153,6 +153,7 @@ class PPOActor:
                 std_level=config.adv_norm.std_level,
                 group_size=config.adv_norm.group_size or config.group_size,
                 mean_leave1out=config.adv_norm.mean_leave1out,
+                std_unbiased=config.adv_norm.std_unbiased,
             )
             if config.adv_norm
             else None

@@ -84,6 +84,12 @@ class PPOTrainer:
         from areal_tpu.api.alloc_mode import apply_allocation_mode
 
         self.allocation_mode = apply_allocation_mode(config)
+        if config.cluster.name_resolve.type != "memory":
+            # the discovery backend must be live BEFORE any rollout client
+            # resolves server addresses (reference NameResolveConfig wiring)
+            from areal_tpu.utils import name_resolve
+
+            name_resolve.reconfigure_from_config(config.cluster.name_resolve)
 
         self.train_dataloader = StatefulDataLoader(
             train_dataset,
@@ -213,6 +219,15 @@ class PPOTrainer:
             epoch = global_step // steps_per_epoch
             step = global_step % steps_per_epoch
             t_step = time.monotonic()
+            # detailed device profile at requested steps (perf_tracer
+            # .profile_steps — reference knob; XLA profiler instead of
+            # torch.profiler, traces viewable in TensorBoard/XProf)
+            profiling = bool(
+                config.perf_tracer.profile_steps
+                and global_step in config.perf_tracer.profile_steps
+            )
+            if profiling:
+                perf_tracer.start_device_profile()
 
             with stats_tracker.record_timing("rollout"), perf_tracer.trace_scope(
                 "train.rollout", Category.COMPUTE, {"global_step": global_step}
@@ -300,6 +315,8 @@ class PPOTrainer:
             stats["step_secs"] = time.monotonic() - t_step
             stats["version"] = float(new_version)
             self.stats_logger.commit(epoch, step, global_step, stats)
+            if profiling:
+                perf_tracer.stop_device_profile()
             perf_tracer.save(step=global_step)
 
     def _maybe_evaluate(self, eval_workflow, epoch: int, global_step: int) -> None:

@@ -365,12 +365,14 @@ class Normalization:
         group_size: int = 1,
         eps: float = 1e-5,
         mean_leave1out: bool = False,  # RLOO: center = mean of the OTHERS
+        std_unbiased: bool = False,  # Bessel n/(n-1) correction on the std
     ):
         self.mean_level = mean_level or "none"
         self.std_level = std_level or "none"
         self.group_size = group_size
         self.eps = eps
         self.mean_leave1out = mean_leave1out
+        self.std_unbiased = std_unbiased
 
     def __call__(self, x: np.ndarray, mask: np.ndarray | None = None) -> np.ndarray:
         x = np.asarray(x, dtype=np.float64)
@@ -407,11 +409,19 @@ class Normalization:
             center[:] = _masked_mean(x, mask)
 
         denom = np.ones_like(x)
+        def _masked_var(xs, ms):
+            v = _masked_mean(xs, ms)
+            if self.std_unbiased:
+                n = ms.sum()
+                if n > 1:
+                    v *= n / (n - 1)
+            return v
+
         sq = (x - center) ** 2
         if self.std_level == "group":
             for sl in _group_slices():
-                denom[sl] = math.sqrt(_masked_mean(sq[sl], mask[sl])) + self.eps
+                denom[sl] = math.sqrt(_masked_var(sq[sl], mask[sl])) + self.eps
         elif self.std_level == "batch":
-            denom[:] = math.sqrt(_masked_mean(sq, mask)) + self.eps
+            denom[:] = math.sqrt(_masked_var(sq, mask)) + self.eps
 
         return (((x - center) / denom) * mask).astype(np.float32)

@@ -463,6 +463,18 @@ def reconfigure(type_: str = "memory", **kwargs) -> NameResolveRepo:
     return DEFAULT_REPO
 
 
+def reconfigure_from_config(cfg) -> NameResolveRepo:
+    """Apply a ``NameResolveConfig`` (cluster.name_resolve) to the process
+    default repo — the reference's NameResolveConfig wiring: type selects
+    the backend, nfs_record_root/etcd3_addr parameterize it."""
+    t = cfg.type
+    if t in ("nfs", "file"):
+        return reconfigure("nfs", root=cfg.nfs_record_root)
+    if t in ("etcd", "etcd3"):
+        return reconfigure("etcd3", addr=cfg.etcd3_addr)
+    return reconfigure(t)
+
+
 # Conventional key layout (parity with reference names.py)
 def rollout_server_key(experiment: str, trial: str, server_idx: int | str = "") -> str:
     base = f"{experiment}/{trial}/rollout_servers"

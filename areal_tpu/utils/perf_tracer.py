@@ -170,10 +170,19 @@ class SessionRecord:
 
 
 class SessionTracer:
-    def __init__(self, output_dir: str | None = None, enabled: bool = True):
+    def __init__(
+        self,
+        output_dir: str | None = None,
+        enabled: bool = True,
+        flush_threshold: int = 1,
+    ):
         self.enabled = enabled
         self.output_dir = output_dir or "/tmp/areal_tpu/traces"
+        # finalized records buffer until this many are ready (reference
+        # SessionTracerConfig.flush_threshold); <=0 falls back to 1
+        self.flush_threshold = max(1, flush_threshold)
         self._records: dict[str, SessionRecord] = {}
+        self._done: list[dict] = []
         self._lock = threading.Lock()
 
     def start_session(self, session_id: str) -> None:
@@ -203,25 +212,34 @@ class SessionTracer:
             return
         with self._lock:
             rec = self._records.pop(session_id, None)
-        if rec is None:
+            if rec is None:
+                return
+            rec.status = status
+            rec.end_ts = time.time()
+            self._done.append(
+                {
+                    "session_id": rec.session_id,
+                    "start": rec.start_ts,
+                    "end": rec.end_ts,
+                    "status": rec.status,
+                    "phases": rec.phases,
+                }
+            )
+            ready = len(self._done) >= self.flush_threshold
+        if ready:
+            self.flush()
+
+    def flush(self) -> None:
+        """Write buffered finalized records to sessions.jsonl."""
+        with self._lock:
+            done, self._done = self._done, []
+        if not done:
             return
-        rec.status = status
-        rec.end_ts = time.time()
         os.makedirs(self.output_dir, exist_ok=True)
         path = os.path.join(self.output_dir, "sessions.jsonl")
         with open(path, "a") as f:
-            f.write(
-                json.dumps(
-                    {
-                        "session_id": rec.session_id,
-                        "start": rec.start_ts,
-                        "end": rec.end_ts,
-                        "status": rec.status,
-                        "phases": rec.phases,
-                    }
-                )
-                + "\n"
-            )
+            for d in done:
+                f.write(json.dumps(d) + "\n")
 
 
 # ---------------------------------------------------------------------------
@@ -235,7 +253,34 @@ _SESSIONS = SessionTracer(enabled=False)
 def configure(config: PerfTracerConfig, rank: int = 0, role: str | None = None) -> None:
     global _TRACER, _SESSIONS
     _TRACER = PerfTracer(config, rank=rank, role=role)
-    _SESSIONS = SessionTracer(config.output_dir, enabled=config.enabled)
+    # session tracing follows its own sub-config when given (reference
+    # SessionTracerConfig), else the perf tracer's enabled flag with
+    # per-record writes (the pre-knob behavior)
+    sess = getattr(config, "session_tracer", None)
+    _SESSIONS = SessionTracer(
+        config.output_dir,
+        enabled=sess.enabled if sess is not None else config.enabled,
+        flush_threshold=sess.flush_threshold if sess is not None else 1,
+    )
+
+
+def start_device_profile(output_dir: str | None = None) -> None:
+    """Begin a detailed XLA device profile (jax.profiler trace; view in
+    TensorBoard/XProf). Reference knob: PerfTracerConfig.profile_steps."""
+    import jax
+
+    d = os.path.join(
+        output_dir or _TRACER.config.output_dir or "/tmp/areal_tpu/traces",
+        "xprof",
+    )
+    os.makedirs(d, exist_ok=True)
+    jax.profiler.start_trace(d)
+
+
+def stop_device_profile() -> None:
+    import jax
+
+    jax.profiler.stop_trace()
 
 
 def get_tracer() -> PerfTracer:
@@ -264,6 +309,7 @@ def counter(name: str, **values: float) -> None:
 
 def save(step: int | None = None, force: bool = False) -> None:
     _TRACER.save(step=step, force=force)
+    _SESSIONS.flush()  # buffered session records ride the same cadence
 
 
 def trace_perf(name: str, category=Category.COMPUTE):

@@ -46,10 +46,26 @@ class StatsLogger:
             try:
                 import wandb
 
+                w = self.config.wandb
+                if w.wandb_base_url:
+                    os.environ["WANDB_BASE_URL"] = w.wandb_base_url
+                if w.wandb_api_key:
+                    os.environ["WANDB_API_KEY"] = w.wandb_api_key
+                name = w.name or self.config.trial_name
                 wandb.init(
-                    mode=self.config.wandb.mode,
-                    project=self.config.wandb.project or self.config.experiment_name,
-                    name=self.config.wandb.name or self.config.trial_name,
+                    mode=w.mode,
+                    project=w.project or self.config.experiment_name,
+                    name=name,
+                    group=w.group,
+                    entity=w.entity,
+                    job_type=w.job_type,
+                    notes=w.notes,
+                    tags=w.tags,
+                    config=w.config,
+                    id=f"{name}_{w.id_suffix}" if w.id_suffix else None,
+                    # a fixed id must pair with resume: a recovered trial
+                    # re-inits the same id and should append, not collide
+                    resume="allow" if w.id_suffix else None,
                     dir=self._log_dir(),
                 )
                 self._wandb = wandb

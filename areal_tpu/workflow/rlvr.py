@@ -76,7 +76,12 @@ class RLVRWorkflow(RolloutWorkflow):
         results = []
         for resp in resps:
             completion_str = (
-                self.tokenizer.decode(resp.output_tokens) if self.tokenizer else ""
+                self.tokenizer.decode(
+                    resp.output_tokens,
+                    skip_special_tokens=self.gconfig.skip_special_tokens,
+                )
+                if self.tokenizer
+                else ""
             )
             prompt_str = (
                 self.tokenizer.decode(prompt_ids) if self.tokenizer else ""

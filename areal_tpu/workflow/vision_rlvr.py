@@ -81,7 +81,9 @@ class VisionRLVRWorkflow(RolloutWorkflow):
         with perf_tracer.get_session_tracer().phase("generate"):
             resp = await engine.agenerate(req)
         prompt_str = self.tokenizer.decode(prompt_ids)
-        completion_str = self.tokenizer.decode(resp.output_tokens)
+        completion_str = self.tokenizer.decode(
+            resp.output_tokens, skip_special_tokens=self.gconfig.skip_special_tokens
+        )
         with perf_tracer.get_session_tracer().phase("reward"):
             reward = await self.reward_fn(
                 prompt_str,

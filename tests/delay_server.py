@@ -1,0 +1,135 @@
+"""Standalone inference-server subprocess with a WALL-CLOCK-delay echo
+engine — the disaggregated half of tests/test_async_disagg.py.
+
+Why a delay engine: this CI host has ONE cpu core, so two compute-bound
+processes cannot show real overlap — but a disaggregated fleet's
+generation capacity is independent of the trainer's chips, i.e. from the
+trainer's perspective generation is WALL-CLOCK latency, not local compute.
+The delay engine models exactly that: each request completes
+``token_delay * max_new_tokens`` seconds after submission (all requests in
+parallel, like a fleet with spare capacity), over the REAL HTTP server +
+client + staleness-gated executor stack. The trainer side then runs real
+jax compute, and async (eta>=1) genuinely overlaps the two.
+
+Usage: python delay_server.py <addr_file> <token_delay_s>
+"""
+
+import sys
+import threading
+import time
+
+
+class DelayEchoEngine:
+    """The DecodeEngine surface InferenceServer drives, latency-simulated."""
+
+    def __init__(self, vocab: int = 256, token_delay: float = 0.004):
+        import numpy as np
+
+        self.vocab = vocab
+        self.token_delay = token_delay
+        self._rng = np.random.default_rng(0)
+        self._version = 0
+        self._paused = threading.Event()
+        self.stats = {"generated_tokens": 0, "requests": 0}
+        self._lock = threading.Lock()
+
+    # -- lifecycle (server calls these) -----------------------------------
+    def initialize(self):
+        pass
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    @property
+    def is_paused(self) -> bool:
+        return self._paused.is_set()
+
+    def pause_generation(self):
+        self._paused.set()
+
+    def continue_generation(self):
+        self._paused.clear()
+
+    def get_version(self) -> int:
+        return self._version
+
+    def set_version(self, v: int) -> None:
+        self._version = v
+
+    # -- generation --------------------------------------------------------
+    def submit(self, req, cb) -> None:
+        import numpy as np
+
+        from areal_tpu.api.io_struct import ModelResponse, StopReason
+
+        n = req.gconfig.max_new_tokens
+
+        def run():
+            deadline = time.monotonic() + n * self.token_delay
+            while True:
+                # paused == weight update in flight: generation stalls,
+                # exactly like the real engine's pause gate
+                while self._paused.is_set():
+                    time.sleep(0.002)
+                    deadline += 0.002
+                if time.monotonic() >= deadline:
+                    break
+                time.sleep(0.002)
+            toks = self._rng.integers(1, self.vocab, n).tolist()
+            with self._lock:
+                self.stats["generated_tokens"] += n
+                self.stats["requests"] += 1
+                v = self._version
+            cb(
+                ModelResponse(
+                    input_tokens=list(req.input_ids),
+                    output_tokens=toks,
+                    output_logprobs=[-1.5] * n,
+                    output_versions=[v] * n,
+                    stop_reason=StopReason.LENGTH.value,
+                )
+            )
+
+        threading.Thread(target=run, daemon=True).start()
+
+    # -- weight updates (mem-mode protocol) --------------------------------
+    def update_weights_from_params(self, params, version=None):
+        if version is not None:
+            self._version = version
+
+    def begin_staged_update(self):
+        self._staged = {}
+
+    def stage_weight_bucket(self, flat):
+        self._staged.update(flat)
+
+    def commit_staged_weights(self, version=None):
+        self._staged = None
+        if version is not None:
+            self._version = version
+
+    def abort_staged_update(self):
+        self._staged = None
+
+
+def main():
+    addr_file, delay = sys.argv[1], float(sys.argv[2])
+    from areal_tpu.api.config import ServerConfig
+    from areal_tpu.inference.server import ServerThread
+
+    srv = ServerThread(ServerConfig(max_batch_size=64), DelayEchoEngine(token_delay=delay))
+    srv.start()
+    with open(addr_file + ".tmp", "w") as f:
+        f.write(srv.address)
+    import os
+
+    os.replace(addr_file + ".tmp", addr_file)
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

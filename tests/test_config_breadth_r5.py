@@ -1,0 +1,194 @@
+"""Round-5 config-breadth knobs (VERDICT r04 item #9; docs/config_parity.md):
+each knob added because its feature already existed must actually reach the
+feature."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+def test_session_tracer_flush_threshold(tmp_path):
+    from areal_tpu.api.config import PerfTracerConfig, SessionTracerConfig
+    from areal_tpu.utils import perf_tracer
+
+    perf_tracer.configure(
+        PerfTracerConfig(
+            enabled=False,
+            output_dir=str(tmp_path),
+            session_tracer=SessionTracerConfig(enabled=True, flush_threshold=3),
+        )
+    )
+    st = perf_tracer.get_session_tracer()
+    assert st.enabled and st.flush_threshold == 3
+    path = tmp_path / "sessions.jsonl"
+    for i in range(2):
+        st.start_session(f"s{i}")
+        st.finalize(f"s{i}", "accepted")
+    assert not path.exists()  # buffered below the threshold
+    st.start_session("s2")
+    st.finalize("s2", "rejected")
+    lines = path.read_text().splitlines()
+    assert len(lines) == 3
+    assert json.loads(lines[2])["status"] == "rejected"
+    # module save() flushes stragglers
+    st.start_session("s3")
+    st.finalize("s3", "accepted")
+    perf_tracer.save(force=True)
+    assert len(path.read_text().splitlines()) == 4
+    perf_tracer.configure(PerfTracerConfig(enabled=False))
+
+
+def test_session_tracer_defaults_follow_perf_enabled(tmp_path):
+    from areal_tpu.api.config import PerfTracerConfig
+    from areal_tpu.utils import perf_tracer
+
+    perf_tracer.configure(
+        PerfTracerConfig(enabled=True, output_dir=str(tmp_path))
+    )
+    st = perf_tracer.get_session_tracer()
+    assert st.enabled and st.flush_threshold == 1  # pre-knob behavior
+    perf_tracer.configure(PerfTracerConfig(enabled=False))
+
+
+def test_name_resolve_reconfigure_from_config(tmp_path):
+    from areal_tpu.api.config import NameResolveConfig
+    from areal_tpu.utils import name_resolve
+
+    try:
+        repo = name_resolve.reconfigure_from_config(
+            NameResolveConfig(type="nfs", nfs_record_root=str(tmp_path / "ns"))
+        )
+        repo.add("a/b", "1")
+        assert name_resolve.get("a/b") == "1"
+        assert os.path.isdir(tmp_path / "ns")
+        # etcd3 selection constructs the right backend with the given addr
+        repo = name_resolve.reconfigure_from_config(
+            NameResolveConfig(type="etcd3", etcd3_addr="etcd.example:9999")
+        )
+        assert repo._addr == "etcd.example:9999"
+    finally:
+        name_resolve.reconfigure("memory")
+
+
+def test_norm_std_unbiased():
+    from areal_tpu.utils.data import Normalization
+
+    x = np.asarray([1.0, 2.0, 3.0, 4.0])
+    biased = Normalization(mean_level="batch", std_level="batch", eps=0.0)(x)
+    unbiased = Normalization(
+        mean_level="batch", std_level="batch", eps=0.0, std_unbiased=True
+    )(x)
+    np.testing.assert_allclose(biased, (x - 2.5) / x.std(), rtol=1e-6)
+    np.testing.assert_allclose(unbiased, (x - 2.5) / x.std(ddof=1), rtol=1e-6)
+
+
+def test_profile_steps_capture(tmp_path):
+    """start/stop_device_profile writes an XLA trace dir."""
+    import jax.numpy as jnp
+
+    from areal_tpu.api.config import PerfTracerConfig
+    from areal_tpu.utils import perf_tracer
+
+    perf_tracer.configure(
+        PerfTracerConfig(enabled=True, output_dir=str(tmp_path))
+    )
+    perf_tracer.start_device_profile()
+    (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+    perf_tracer.stop_device_profile()
+    assert (tmp_path / "xprof").is_dir()
+    assert any((tmp_path / "xprof").rglob("*"))
+    perf_tracer.configure(PerfTracerConfig(enabled=False))
+
+
+def test_ignore_eos_generates_to_budget():
+    """A stop token in the stream is ignored under ignore_eos=True."""
+    import jax
+
+    from areal_tpu.api.config import MeshConfig, ServerConfig
+    from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.models import qwen
+
+    cfg = qwen.ModelConfig(
+        vocab_size=64,
+        hidden_size=32,
+        intermediate_size=64,
+        num_layers=1,
+        num_heads=2,
+        num_kv_heads=2,
+        dtype="float32",
+        tie_word_embeddings=True,
+    )
+    eng = DecodeEngine(
+        ServerConfig(
+            max_batch_size=2,
+            max_seq_len=64,
+            decode_steps_per_call=4,
+            seed=0,
+            mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        ),
+        params=qwen.init_params(jax.random.PRNGKey(0), cfg),
+        model_cfg=cfg,
+    )
+    eng.initialize()
+    eng.start()
+    try:
+        prompt = [1, 2, 3]
+        # greedy: both runs produce the same stream; stop at the 1st token's
+        # id in one run proves the stop machinery sees it
+        base = eng.generate_sync(
+            ModelRequest(
+                input_ids=prompt,
+                gconfig=GenerationHyperparameters(max_new_tokens=12, greedy=True),
+            ),
+            timeout=120,
+        )
+        stop_tok = base.output_tokens[2]
+        stopped = eng.generate_sync(
+            ModelRequest(
+                input_ids=prompt,
+                gconfig=GenerationHyperparameters(
+                    max_new_tokens=12, greedy=True, stop_token_ids=[stop_tok]
+                ),
+            ),
+            timeout=120,
+        )
+        ignored = eng.generate_sync(
+            ModelRequest(
+                input_ids=prompt,
+                gconfig=GenerationHyperparameters(
+                    max_new_tokens=12,
+                    greedy=True,
+                    stop_token_ids=[stop_tok],
+                    ignore_eos=True,
+                ),
+            ),
+            timeout=120,
+        )
+        assert len(stopped.output_tokens) < 12
+        assert stopped.stop_reason == "stop"
+        assert len(ignored.output_tokens) == 12
+        assert ignored.stop_reason == "length"
+    finally:
+        eng.stop()
+
+
+def test_wandb_config_fields_load_from_yaml(tmp_path):
+    from areal_tpu.api.config import GRPOConfig, load_expr_config
+
+    y = tmp_path / "c.yaml"
+    y.write_text(
+        "experiment_name: e\ntrial_name: t\n"
+        "stats_logger:\n  wandb:\n    mode: offline\n    entity: team\n"
+        "    tags: [a, b]\n    id_suffix: train\n"
+        "perf_tracer:\n  profile_steps: [3, 7]\n"
+        "cluster:\n  name_resolve:\n    type: etcd3\n"
+        "    etcd3_addr: host:1234\n"
+    )
+    cfg, _ = load_expr_config(["--config", str(y)], GRPOConfig)
+    assert cfg.stats_logger.wandb.entity == "team"
+    assert cfg.stats_logger.wandb.tags == ["a", "b"]
+    assert cfg.perf_tracer.profile_steps == [3, 7]
+    assert cfg.cluster.name_resolve.etcd3_addr == "host:1234"
