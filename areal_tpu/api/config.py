@@ -103,6 +103,11 @@ class TrainEngineConfig:
     tree_training: bool = False
     tree_node_budget: int = 2048  # max trie nodes per microbatch forward
     tree_node_bucket: int = 512  # node-axis bucketing (bounds recompiles)
+    # VLM: train the vision tower jointly with the LM (reference FSDP VLM
+    # path). Default False = frozen tower with embeds precomputed once per
+    # batch outside the loss — the right call for RL recipes and much
+    # cheaper; True runs the tower inside the fwd/bwd jit so its grads flow
+    train_vision_tower: bool = False
 
 
 @dataclass

@@ -55,6 +55,16 @@ from areal_tpu.utils.data import round_up_to_bucket
 
 logger = alog.getLogger("jax_engine")
 
+def _shape_key(batch) -> tuple:
+    """jit-cache shape key: grid shape + pixel shapes when the trainable
+    vision tower rides in the batch (their padded sizes change the traced
+    program)."""
+    s = tuple(batch["segment_ids"].shape)
+    if "pixel_values" in batch:
+        s = s + tuple(batch["pixel_values"].shape)
+    return s
+
+
 # per-token keys that ship to device grids (everything else stays on host)
 _GRID_KEYS = (
     "input_ids",
@@ -231,15 +241,25 @@ class JaxTrainEngine(TrainEngine):
                 weight_decay=ocfg.weight_decay,
             ),
         )
-        if mcfg.lora_rank > 0 or mcfg.vision is not None:
+        train_vit = bool(getattr(cfg, "train_vision_tower", False))
+        if train_vit:
+            assert mcfg.vision is not None, (
+                "train_vision_tower set but the model has no vision tower"
+            )
+            assert mcfg.lora_rank == 0, (
+                "train_vision_tower with LoRA is unsupported: LoRA freezes "
+                "every non-adapter leaf by design"
+            )
+        if mcfg.lora_rank > 0 or (mcfg.vision is not None and not train_vit):
             # freeze branches never READ their grads (set_to_zero) and the
             # grad-norm is masked below, so inside the fused jit XLA's DCE
             # prunes their dW matmuls from the backward.
             # - LoRA: only adapter (+value head) leaves train
-            # - VLM: the vision tower is frozen BY DESIGN (embeds are
+            # - VLM: the vision tower is frozen by DEFAULT (embeds are
             #   precomputed outside the loss — its grads are structurally
             #   zero, and plain AdamW's decoupled weight decay would still
-            #   shrink it every step; models/vision.py design note)
+            #   shrink it every step); config.train_vision_tower runs the
+            #   tower inside the grad jit instead and trains it jointly
             def label(p, _):
                 ks = jax.tree_util.keystr(p)
                 if ks.startswith("['vision']"):
@@ -400,10 +420,15 @@ class JaxTrainEngine(TrainEngine):
         return self.mesh.shape["data"] * self.mesh.shape["fsdp"]
 
     def _attach_image_embeds(self, input_: TensorDict) -> TensorDict:
-        """VLM data boundary: run the (frozen) vision tower once over the
-        batch's pixel patches and materialize a per-token [B, L, D]
-        ``image_embeds`` key aligned to <|image_pad|> positions — packed
-        grids then never carry pixel data (models/vision.py design note)."""
+        """VLM data boundary. Frozen tower (default): run the vision tower
+        once over the batch's pixel patches and materialize a per-token
+        [B, L, D] ``image_embeds`` key aligned to <|image_pad|> positions —
+        packed grids then never carry pixel data (models/vision.py design
+        note). With ``train_vision_tower`` the tower must run INSIDE the
+        grad jit instead, so this keeps the (padded) pixel tensors as
+        per-seq keys plus a per-token ``image_k`` (ordinal of each image-pad
+        token) that the grid packer redistributes with the tokens; the
+        gather map is finalized per grid in _grid_to_device."""
         if "pixel_values" not in input_:
             return input_
         mcfg = self.model_cfg
@@ -431,6 +456,28 @@ class JaxTrainEngine(TrainEngine):
             input_.pop("pixel_pos_ids", np.zeros((B, P_raw, 2))), np.int32
         )
         ids = np.asarray(input_["input_ids"])
+        if getattr(self.config, "train_vision_tower", False):
+            merge2 = mcfg.vision.spatial_merge**2
+            Ppad = vis.pad_patch_bucket(P_raw, merge2)
+            if Ppad != P_raw:
+                pv = np.pad(pv, ((0, 0), (0, Ppad - P_raw), (0, 0)))
+                pos_ids = np.pad(pos_ids, ((0, 0), (0, Ppad - P_raw), (0, 0)))
+            pad_mask = ids == mcfg.image_token_id
+            n_emb = counts // merge2
+            n_pos = pad_mask.sum(axis=1)
+            for b in np.nonzero(n_pos != n_emb)[0]:
+                logger.warning(
+                    f"VLM mismatch row {b}: {int(n_pos[b])} image-pad tokens "
+                    f"vs {int(n_emb[b])} merged patch embeddings"
+                )
+            k = np.cumsum(pad_mask, axis=1) - 1
+            input_["image_k"] = np.where(
+                pad_mask & (k < n_emb[:, None]), k, -1
+            ).astype(np.int32)
+            input_["pixel_values"] = pv
+            input_["pixel_counts"] = counts
+            input_["pixel_pos_ids"] = pos_ids
+            return input_
         # one PPO step calls forward_batch (logprob recompute) and
         # train_batch on the SAME batch; memoize the tower output so the
         # frozen ViT truly runs once per batch. Keyed by the IDENTITY of the
@@ -451,24 +498,16 @@ class JaxTrainEngine(TrainEngine):
             input_["image_embeds"] = cached[1]
             return input_
         merge2 = mcfg.vision.spatial_merge**2
-        # bucket the padded patch count so image-size variation doesn't
-        # recompile the tower per batch
-        Ppad = -(-round_up_to_bucket(P_raw, 256) // merge2) * merge2
+        Ppad = vis.pad_patch_bucket(P_raw, merge2)
         if Ppad != P_raw:
             pv = np.pad(pv, ((0, 0), (0, Ppad - P_raw), (0, 0)))
             pos_ids = np.pad(pos_ids, ((0, 0), (0, Ppad - P_raw), (0, 0)))
         key = ("vision", Ppad)
         if key not in self._fn_cache:
             vcfg = mcfg.vision
-
-            def run(vparams, pixels, cnts, pids):
-                def one(px, c, pid):
-                    mask = jnp.arange(px.shape[0]) < c
-                    return vis.vision_forward(vparams, vcfg, px, mask, pid)
-
-                return jax.vmap(one)(pixels, cnts, pids)
-
-            self._fn_cache[key] = jax.jit(run)
+            self._fn_cache[key] = jax.jit(
+                lambda vp, px, c, pid: vis.vision_forward_batch(vp, vcfg, px, c, pid)
+            )
         with jax.set_mesh(self.mesh):
             out = np.asarray(
                 self._fn_cache[key](
@@ -492,7 +531,7 @@ class JaxTrainEngine(TrainEngine):
             logger.warning(
                 f"VLM mismatch row {b}: {int(n_pos[b])} image-pad tokens vs "
                 f"{int(n_emb[b])} merged patch embeddings; extra positions "
-                "keep the pad-token text embedding"
+                "get zero embeddings (same in the trainable-tower path)"
             )
         k = np.cumsum(pad_mask, axis=1) - 1  # ordinal of each pad token
         take = pad_mask & (k < n_emb[:, None])
@@ -557,6 +596,30 @@ class JaxTrainEngine(TrainEngine):
             if v.dtype == np.int64:
                 v = v.astype(np.int32)
             dev[k] = jax.device_put(v, sharding)
+        if "pixel_values" in grid.data and "image_k" in grid.data:
+            # trainable-tower path: pixel tensors ride to the jit (replicated
+            # — n_seqs is not dp-divisible in general and the tower is small
+            # relative to the LM), and the per-token image_k ordinals become
+            # a flat gather map into the [n_seqs * Pm, D] tower output
+            merge2 = self.model_cfg.vision.spatial_merge**2
+            pv = np.asarray(grid.data["pixel_values"], np.float32)
+            Pm = pv.shape[1] // merge2
+            ik = np.asarray(grid.data["image_k"])
+            slot = np.full_like(ik, -1)
+            for local, (r, c, n) in enumerate(
+                zip(grid.row_of_seq, grid.col_of_seq, grid.seq_lens)
+            ):
+                seg = ik[r, c : c + n]
+                slot[r, c : c + n] = np.where(seg >= 0, local * Pm + seg, -1)
+            rep = mesh_lib.replicated(self.mesh)
+            dev["image_slot"] = jax.device_put(slot, sharding)
+            dev["pixel_values"] = jax.device_put(pv, rep)
+            dev["pixel_counts"] = jax.device_put(
+                np.asarray(grid.data["pixel_counts"], np.int32), rep
+            )
+            dev["pixel_pos_ids"] = jax.device_put(
+                np.asarray(grid.data["pixel_pos_ids"], np.int32), rep
+            )
         return dev
 
     # -- jitted kernels ---------------------------------------------------
@@ -569,6 +632,26 @@ class JaxTrainEngine(TrainEngine):
             params,
         )
         moe = mcfg.num_experts > 0
+        image_embeds = batch.get("image_embeds")
+        if "pixel_values" in batch:
+            # trainable tower (train_vision_tower): the ViT runs INSIDE this
+            # traced fn on cparams["vision"], so the LM loss differentiates
+            # through it; image_slot gathers merged patch embeddings into
+            # the packed grid layout
+            from areal_tpu.models import vision as vis
+
+            emb = vis.vision_forward_batch(
+                cparams["vision"],
+                mcfg.vision,
+                batch["pixel_values"],
+                batch["pixel_counts"],
+                batch["pixel_pos_ids"],
+            )  # [n_seqs, Pm, D]
+            flat = emb.reshape(-1, emb.shape[-1])
+            slot = batch["image_slot"]
+            image_embeds = jnp.where(
+                (slot >= 0)[..., None], flat[jnp.maximum(slot, 0)], 0.0
+            )
         if self.mesh.shape.get("pipe", 1) > 1:
             hidden, moe_aux = self._pp_hidden(cparams, batch), None
         else:
@@ -580,7 +663,7 @@ class JaxTrainEngine(TrainEngine):
                 batch["positions"],
                 with_aux=moe,
                 no_grad=no_grad,
-                image_embeds=batch.get("image_embeds"),
+                image_embeds=image_embeds,
             )
             hidden, moe_aux = fwd if moe else (fwd, None)
         outputs: dict[str, jax.Array] = {}
@@ -984,7 +1067,7 @@ class JaxTrainEngine(TrainEngine):
             with jax.set_mesh(self.mesh):
                 batch = self._grid_to_device(grids[0])
                 step_before = self._opt_step_count()
-                fn = self._get_fused_step_fn(loss_fn, batch["segment_ids"].shape)
+                fn = self._get_fused_step_fn(loss_fn, _shape_key(batch))
                 self.params, self.opt_state, gnorm, loss, stats = fn(
                     self.params, self.opt_state, batch, jnp.float32(weights[0] / total_w)
                 )
@@ -997,7 +1080,7 @@ class JaxTrainEngine(TrainEngine):
         with jax.set_mesh(self.mesh):
             for g, w in zip(grids, weights):
                 batch = self._grid_to_device(g)
-                shape = batch["segment_ids"].shape
+                shape = _shape_key(batch)
                 gfn = self._get_grad_fn(loss_fn, shape)
                 new_grads, loss, stats = gfn(
                     self.params, batch, jnp.float32(w / total_w)
@@ -1056,7 +1139,7 @@ class JaxTrainEngine(TrainEngine):
         with jax.set_mesh(self.mesh):
             for g, w in zip(grids, weights):
                 batch = self._grid_to_device(g)
-                shape = batch["segment_ids"].shape
+                shape = _shape_key(batch)
                 key = ("eval", shape, id(loss_fn))
                 if key not in self._fn_cache:
 
@@ -1086,7 +1169,7 @@ class JaxTrainEngine(TrainEngine):
         with jax.set_mesh(self.mesh):
             for g in grids:
                 batch = self._grid_to_device(g)
-                shape = batch["segment_ids"].shape
+                shape = _shape_key(batch)
                 fn = self._get_forward_fn(shape, post_hook)
                 outputs = fn(self.params, batch)
                 vals = np.asarray(jax.device_get(outputs[output_key]), np.float32)

@@ -17,11 +17,15 @@ exact-GELU merger) so real ``visual.*`` checkpoints load and reproduce HF
 outputs — see ``hf_vision_name_map`` and
 tests/test_vision.py::test_hf_vision_parity.
 
-Design choice (documented limitation): during RL the tower is FROZEN and
-embeddings are precomputed once per batch at the data boundary — the packed
-[G, L] training grids never carry pixel data, only the [*, D_llm] embed
-vectors as a per-token key. Reference VLM RL typically freezes the ViT too;
-tower finetuning would move the tower call inside the loss closure.
+Design choice: by DEFAULT the tower is frozen during RL and embeddings are
+precomputed once per batch at the data boundary — the packed [G, L]
+training grids never carry pixel data, only the [*, D_llm] embed vectors
+as a per-token key (reference VLM RL typically freezes the ViT too, and
+this is much cheaper). ``TrainEngineConfig.train_vision_tower`` lifts the
+boundary: the engine then ships the (padded) pixel tensors with each grid
+and runs the tower INSIDE the grad jit, so the LM loss differentiates
+through it (the reference FSDP VLM path's full-model finetuning;
+tests/test_vision.py::test_train_vision_tower).
 """
 
 from __future__ import annotations
@@ -32,6 +36,28 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
+
+
+def pad_patch_bucket(p_raw: int, merge2: int, bucket: int = 256) -> int:
+    """Padded per-image patch count: bucketed (image-size variation must not
+    recompile the tower per batch) AND divisible by the spatial-merge group.
+    THE one formula both the frozen-precompute and trainable-tower engine
+    paths use — they must agree for embed parity."""
+    from areal_tpu.utils.data import round_up_to_bucket
+
+    return -(-round_up_to_bucket(p_raw, bucket) // merge2) * merge2
+
+
+def vision_forward_batch(vparams, cfg, pixels, counts, pos_ids):
+    """vmapped masked tower forward: [B, Ppad, pd] -> [B, Ppad/merge², D].
+    Shared by the engine's frozen-precompute jit and the trainable-tower
+    path inside the grad jit (parity by construction)."""
+
+    def one(px, c, pid):
+        mask = jnp.arange(px.shape[0]) < c
+        return vision_forward(vparams, cfg, px, mask, pid)
+
+    return jax.vmap(one)(pixels, counts, pos_ids)
 
 
 @dataclasses.dataclass(frozen=True)
