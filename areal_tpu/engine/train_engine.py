@@ -456,24 +456,31 @@ class JaxTrainEngine(TrainEngine):
             input_.pop("pixel_pos_ids", np.zeros((B, P_raw, 2))), np.int32
         )
         ids = np.asarray(input_["input_ids"])
+        # shared alignment pass (both paths): patch-bucket padding, image-pad
+        # ordinals, and the loud mismatch check — extras (k >= n_emb) get
+        # zero embeddings either way
+        merge2 = mcfg.vision.spatial_merge**2
+        Ppad = vis.pad_patch_bucket(P_raw, merge2)
+        if Ppad != P_raw:
+            pv = np.pad(pv, ((0, 0), (0, Ppad - P_raw), (0, 0)))
+            pos_ids = np.pad(pos_ids, ((0, 0), (0, Ppad - P_raw), (0, 0)))
+        pad_mask = ids == mcfg.image_token_id  # [B, L]
+        n_emb = counts // merge2  # [B]
+        n_pos = pad_mask.sum(axis=1)
+        for b in np.nonzero(n_pos != n_emb)[0]:
+            # silent truncation here means training on corrupted inputs
+            # (wrong spatial_merge, processor/tokenizer skew, truncated
+            # image-pad runs) — make the misconfiguration loud
+            logger.warning(
+                f"VLM mismatch row {b}: {int(n_pos[b])} image-pad tokens vs "
+                f"{int(n_emb[b])} merged patch embeddings; extra positions "
+                "get zero embeddings"
+            )
+        k = np.cumsum(pad_mask, axis=1) - 1  # ordinal of each pad token
+        take = pad_mask & (k < n_emb[:, None])
+
         if getattr(self.config, "train_vision_tower", False):
-            merge2 = mcfg.vision.spatial_merge**2
-            Ppad = vis.pad_patch_bucket(P_raw, merge2)
-            if Ppad != P_raw:
-                pv = np.pad(pv, ((0, 0), (0, Ppad - P_raw), (0, 0)))
-                pos_ids = np.pad(pos_ids, ((0, 0), (0, Ppad - P_raw), (0, 0)))
-            pad_mask = ids == mcfg.image_token_id
-            n_emb = counts // merge2
-            n_pos = pad_mask.sum(axis=1)
-            for b in np.nonzero(n_pos != n_emb)[0]:
-                logger.warning(
-                    f"VLM mismatch row {b}: {int(n_pos[b])} image-pad tokens "
-                    f"vs {int(n_emb[b])} merged patch embeddings"
-                )
-            k = np.cumsum(pad_mask, axis=1) - 1
-            input_["image_k"] = np.where(
-                pad_mask & (k < n_emb[:, None]), k, -1
-            ).astype(np.int32)
+            input_["image_k"] = np.where(take, k, -1).astype(np.int32)
             input_["pixel_values"] = pv
             input_["pixel_counts"] = counts
             input_["pixel_pos_ids"] = pos_ids
@@ -497,11 +504,6 @@ class JaxTrainEngine(TrainEngine):
         if cached is not None and cached[0] == memo_key:
             input_["image_embeds"] = cached[1]
             return input_
-        merge2 = mcfg.vision.spatial_merge**2
-        Ppad = vis.pad_patch_bucket(P_raw, merge2)
-        if Ppad != P_raw:
-            pv = np.pad(pv, ((0, 0), (0, Ppad - P_raw), (0, 0)))
-            pos_ids = np.pad(pos_ids, ((0, 0), (0, Ppad - P_raw), (0, 0)))
         key = ("vision", Ppad)
         if key not in self._fn_cache:
             vcfg = mcfg.vision
@@ -521,20 +523,6 @@ class JaxTrainEngine(TrainEngine):
         embeds = np.zeros((B, ids.shape[1], mcfg.hidden_size), np.float32)
         # vectorized scatter: for each row, the k-th image-pad token gets the
         # k-th merged patch embedding (k < counts[b]//merge2)
-        pad_mask = ids == mcfg.image_token_id  # [B, L]
-        n_emb = counts // merge2  # [B]
-        n_pos = pad_mask.sum(axis=1)
-        for b in np.nonzero(n_pos != n_emb)[0]:
-            # silent truncation here means training on corrupted inputs
-            # (wrong spatial_merge, processor/tokenizer skew, truncated
-            # image-pad runs) — make the misconfiguration loud
-            logger.warning(
-                f"VLM mismatch row {b}: {int(n_pos[b])} image-pad tokens vs "
-                f"{int(n_emb[b])} merged patch embeddings; extra positions "
-                "get zero embeddings (same in the trainable-tower path)"
-            )
-        k = np.cumsum(pad_mask, axis=1) - 1  # ordinal of each pad token
-        take = pad_mask & (k < n_emb[:, None])
         rows, cols = np.nonzero(take)
         embeds[rows, cols] = out[rows, k[rows, cols]]
         input_["image_embeds"] = embeds
@@ -603,6 +591,18 @@ class JaxTrainEngine(TrainEngine):
             # a flat gather map into the [n_seqs * Pm, D] tower output
             merge2 = self.model_cfg.vision.spatial_merge**2
             pv = np.asarray(grid.data["pixel_values"], np.float32)
+            counts = np.asarray(grid.data["pixel_counts"], np.int32)
+            pos_ids = np.asarray(grid.data["pixel_pos_ids"], np.int32)
+            # bucket n_seqs too: ragged rollouts vary the per-microbatch
+            # sequence count, and an unbucketed jit operand dim would
+            # recompile the whole train program per count. Padded rows have
+            # count 0 (fully masked tower) and no slot references them.
+            n_pad = round_up_to_bucket(pv.shape[0], 8)
+            if n_pad > pv.shape[0]:
+                extra = n_pad - pv.shape[0]
+                pv = np.pad(pv, ((0, extra), (0, 0), (0, 0)))
+                counts = np.pad(counts, (0, extra))
+                pos_ids = np.pad(pos_ids, ((0, extra), (0, 0), (0, 0)))
             Pm = pv.shape[1] // merge2
             ik = np.asarray(grid.data["image_k"])
             slot = np.full_like(ik, -1)
@@ -614,12 +614,8 @@ class JaxTrainEngine(TrainEngine):
             rep = mesh_lib.replicated(self.mesh)
             dev["image_slot"] = jax.device_put(slot, sharding)
             dev["pixel_values"] = jax.device_put(pv, rep)
-            dev["pixel_counts"] = jax.device_put(
-                np.asarray(grid.data["pixel_counts"], np.int32), rep
-            )
-            dev["pixel_pos_ids"] = jax.device_put(
-                np.asarray(grid.data["pixel_pos_ids"], np.int32), rep
-            )
+            dev["pixel_counts"] = jax.device_put(counts, rep)
+            dev["pixel_pos_ids"] = jax.device_put(pos_ids, rep)
         return dev
 
     # -- jitted kernels ---------------------------------------------------

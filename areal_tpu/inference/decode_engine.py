@@ -1049,8 +1049,11 @@ class DecodeEngine:
                 )
                 pos = np.zeros((P, 2), np.int32)
             # bucket the padded patch count: distinct image sizes must not
-            # each compile a fresh ViT (the mask handles the padding)
-            Ppad = -(-round_up_to_bucket(P, 256) // merge2) * merge2
+            # each compile a fresh ViT (the mask handles the padding); THE
+            # shared formula so serving and training embeds agree
+            from areal_tpu.models.vision import pad_patch_bucket
+
+            Ppad = pad_patch_bucket(P, merge2)
             key = ("vision", Ppad)
             if key not in self._fn_cache:
                 vcfg = mcfg.vision

@@ -81,6 +81,14 @@ STEPS = [
         "import prof_r3; prof_r3.phase_train()",
     ),
     (
+        # tree-vs-packed training at 1.5B on GRPO-shaped shared-prefix
+        # batches: the on-chip FLOP-reduction measurement for the tree
+        # kernel (reference claims up to 10x, tree_training.md:19-21)
+        "prof_r5_tree",
+        1500,
+        "import prof_r5; prof_r5.phase_tree()",
+    ),
+    (
         # on-chip RL learning gate through the real stack (server + executor
         # + PPO). Synthetic task — no pretrained weights exist in this
         # zero-egress image, so real-GSM8K reward curves stay out of reach;

@@ -1,0 +1,158 @@
+"""Round-5 on-chip experiment: tree training vs packed training at 1.5B.
+
+    python prof_r5.py tree
+
+Measures the tree-training FLOP-reduction claim on real hardware
+(reference docs/en/reference/tree_training.md:19-21 — up to 10x on
+heavily-shared batches): the same GRPO-shaped batch (groups sharing a
+512-token prompt) through JaxTrainEngine.train_batch with
+tree_training off vs on, steady-state steps, identical loss math.
+
+Reports packed tok/s, tree tok/s, the measured dedup ratio, and the
+speedup. Timing via host scalar pulls (axon block_until_ready gotcha).
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def phase_tree():
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.api.config import (
+        MeshConfig,
+        MicroBatchSpec,
+        OptimizerConfig,
+        TrainEngineConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.train_engine import JaxTrainEngine
+    from areal_tpu.models import qwen
+    from areal_tpu.ops import functional as F
+    from areal_tpu.utils.data import pad_sequences_to_tensors
+
+    from bench import MODEL_KW  # Qwen2.5-1.5B dims
+
+    import os
+
+    model_kw = MODEL_KW
+    GROUPS, GROUP, PROMPT, RESP = 4, 8, 512, 512
+    budget, bucket, mb_tokens = 8192, 1024, 9000
+    if os.environ.get("PROF_SMOKE"):
+        # CPU wiring check: tiny dims, same code path
+        model_kw = dict(
+            vocab_size=256,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            dtype="float32",
+            tie_word_embeddings=True,
+        )
+        GROUPS, GROUP, PROMPT, RESP = 2, 4, 32, 32
+        budget, bucket, mb_tokens = 512, 128, 100_000
+
+    model_cfg = qwen.ModelConfig(**model_kw)
+    rng = np.random.default_rng(0)
+    trajs = []
+    for _ in range(GROUPS):
+        vocab = model_cfg.vocab_size - 1
+        prompt = rng.integers(1, vocab, PROMPT)
+        for _ in range(GROUP):
+            jit = max(4, RESP // 8)
+            resp = rng.integers(1, vocab, int(rng.integers(RESP - jit, RESP + jit)))
+            ids = np.concatenate([prompt, resp]).astype(np.int32)
+            n = len(ids)
+            trajs.append(
+                {
+                    "input_ids": ids,
+                    "loss_mask": np.concatenate(
+                        [np.zeros(PROMPT, np.float32), np.ones(n - PROMPT, np.float32)]
+                    ),
+                    "old_logprobs": rng.normal(-1.5, 0.1, n).astype(np.float32),
+                    "advantages": rng.normal(0, 1, n).astype(np.float32),
+                }
+            )
+    batch = pad_sequences_to_tensors(trajs)
+    n_tokens = int(np.asarray(batch["attention_mask"]).sum())
+
+    def grpo_loss(outputs, b):
+        lm = (b["label_valid"] & (b["loss_mask"] > 0)).astype(jnp.float32)
+        loss, _ = F.ppo_actor_loss_fn(
+            logprobs=outputs["logprobs"],
+            proximal_logprobs=b["old_logprobs"],
+            old_logprobs=b["old_logprobs"],
+            advantages=b["advantages"],
+            loss_mask=lm,
+        )
+        return loss, {}
+
+    def weight_fn(d):
+        return float((np.asarray(d["loss_mask"]) > 0).sum())
+
+    def make_engine(tree: bool):
+        smoke = bool(__import__("os").environ.get("PROF_SMOKE"))
+        cfg = TrainEngineConfig(
+            init_from_scratch=True,
+            dtype="float32" if smoke else "bfloat16",
+            param_dtype="float32" if smoke else "bfloat16",
+            gradient_checkpointing=not smoke,
+            mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+            optimizer=OptimizerConfig(lr=1e-5, lr_scheduler_type="constant"),
+            mb_spec=MicroBatchSpec(max_tokens_per_mb=mb_tokens),
+            bucket_step=128 if smoke else 512,
+            logprob_chunk_size=256,
+            tree_training=tree,
+            tree_node_budget=budget,
+            tree_node_bucket=bucket,
+        )
+        eng = JaxTrainEngine(cfg, model_config=model_cfg)
+        eng.initialize(FinetuneSpec(1, 1000, 8))
+        return eng
+
+    def measure(tag: str, tree: bool) -> dict:
+        eng = make_engine(tree)
+        t0 = time.monotonic()
+        stats = eng.train_batch(batch, grpo_loss, weight_fn)
+        print(f"[{tag}] first step (compile) {time.monotonic()-t0:.1f}s "
+              f"loss={stats.get('loss'):.5f}", flush=True)
+        n_steps = 3
+        t0 = time.monotonic()
+        for _ in range(n_steps):
+            stats = eng.train_batch(batch, grpo_loss, weight_fn)
+        dt = time.monotonic() - t0
+        out = {
+            "tok_s": n_tokens * n_steps / dt,
+            "loss": float(stats.get("loss")),
+            "dedup": float(stats.get("tree_dedup_ratio", 1.0)),
+            "mbs": stats.get("n_microbatches"),
+        }
+        print(f"[{tag}] {out}", flush=True)
+        eng.destroy()
+        return out
+
+    packed = measure("packed", False)
+    tree = measure("tree", True)
+    print(
+        "TREE_RESULT "
+        + str(
+            {
+                "packed_tok_s": round(packed["tok_s"], 1),
+                "tree_tok_s": round(tree["tok_s"], 1),
+                "speedup": round(tree["tok_s"] / packed["tok_s"], 3),
+                "dedup_ratio": round(tree["dedup"], 3),
+                "loss_delta": abs(tree["loss"] - packed["loss"]),
+                "total_tokens": n_tokens,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    assert len(sys.argv) > 1 and sys.argv[1] == "tree", "usage: prof_r5.py tree"
+    phase_tree()
