@@ -177,6 +177,19 @@ class PPOCriticConfig(TrainEngineConfig):
 
 
 @dataclass
+class OpenAIProxyConfig:
+    """Agentic OpenAI-proxy layer knobs (reference cli_args.py
+    OpenAIProxyConfig): consumed by RolloutController.start_proxy_from_config
+    when forking per-worker proxy servers + the gateway."""
+
+    tool_call_parser: str = "qwen"
+    chat_template_type: str = "hf"  # hf|concat
+    engine_max_tokens: int = 0  # 0 = the serving engine's own limit
+    capacity: int = 128  # concurrent sessions per proxy worker
+    admin_api_key: str = ""  # empty = generate one at start_proxy time
+
+
+@dataclass
 class InferenceEngineConfig:
     """Client-side rollout controls incl. staleness knobs (reference
     cli_args.py:1591-1612)."""
@@ -209,6 +222,11 @@ class InferenceEngineConfig:
     # let servers relay down a fanout-2 tree (X-Areal-Relay), so the trainer
     # uplink carries 1x the model regardless of fleet size
     weight_update_relay: bool = False
+    # agentic proxy layer (reference openai knob): non-None starts the
+    # per-worker OpenAI-compatible proxies + gateway during
+    # RolloutController.initialize (requires tokenizer_path)
+    openai: OpenAIProxyConfig | None = None
+    tokenizer_path: str = ""  # chat templating for the proxy layer
 
 
 @dataclass

@@ -456,6 +456,29 @@ class JaxTrainEngine(TrainEngine):
             input_.pop("pixel_pos_ids", np.zeros((B, P_raw, 2))), np.int32
         )
         ids = np.asarray(input_["input_ids"])
+        trainable = bool(getattr(self.config, "train_vision_tower", False))
+        if not trainable:
+            # one PPO step calls forward_batch (logprob recompute) and
+            # train_batch on the SAME batch; memoize the tower output so the
+            # frozen ViT truly runs once per batch — checked FIRST so a hit
+            # pays none of the padding/alignment host work below. Keyed by
+            # the IDENTITY of the caller's batch arrays, not content —
+            # hashing the full pixel buffer cost O(batch bytes) of host time
+            # on every forward/train call. The memo pins the keyed objects
+            # so their ids can't be recycled while the entry is alive;
+            # callers that mutate a pixel buffer in place must pass a fresh
+            # array (the trainer never does).
+            memo_key = (
+                id(pv_obj),
+                None if counts_obj is None else id(counts_obj),
+                id(ids_obj),
+                pv.shape,
+                self.get_version(),
+            )
+            cached = getattr(self, "_image_embed_memo", None)
+            if cached is not None and cached[0] == memo_key:
+                input_["image_embeds"] = cached[1]
+                return input_
         # shared alignment pass (both paths): patch-bucket padding, image-pad
         # ordinals, and the loud mismatch check — extras (k >= n_emb) get
         # zero embeddings either way
@@ -479,30 +502,11 @@ class JaxTrainEngine(TrainEngine):
         k = np.cumsum(pad_mask, axis=1) - 1  # ordinal of each pad token
         take = pad_mask & (k < n_emb[:, None])
 
-        if getattr(self.config, "train_vision_tower", False):
+        if trainable:
             input_["image_k"] = np.where(take, k, -1).astype(np.int32)
             input_["pixel_values"] = pv
             input_["pixel_counts"] = counts
             input_["pixel_pos_ids"] = pos_ids
-            return input_
-        # one PPO step calls forward_batch (logprob recompute) and
-        # train_batch on the SAME batch; memoize the tower output so the
-        # frozen ViT truly runs once per batch. Keyed by the IDENTITY of the
-        # caller's batch arrays, not content — hashing the full pixel buffer
-        # cost O(batch bytes) of host time on every forward/train call. The
-        # memo pins the keyed objects so their ids can't be recycled while
-        # the entry is alive; callers that mutate a pixel buffer in place
-        # must pass a fresh array (the trainer never does).
-        memo_key = (
-            id(pv_obj),
-            None if counts_obj is None else id(counts_obj),
-            id(ids_obj),
-            pv.shape,
-            self.get_version(),
-        )
-        cached = getattr(self, "_image_embed_memo", None)
-        if cached is not None and cached[0] == memo_key:
-            input_["image_embeds"] = cached[1]
             return input_
         key = ("vision", Ppad)
         if key not in self._fn_cache:

@@ -29,9 +29,13 @@ class RolloutController:
         role: str = "rollout",
         replicas: int = 1,
         worker_env: dict[str, str] | None = None,
+        proxy_engine_path: str = "",
     ):
         self.scheduler = scheduler
         self.engine_path = engine_path
+        # alternative engine import path for config-auto-started proxy
+        # workers ("" = discover real inference servers via name_resolve)
+        self.proxy_engine_path = proxy_engine_path
         self.role = role
         self.replicas = replicas
         self.worker_env = dict(worker_env or {})
@@ -64,6 +68,21 @@ class RolloutController:
         for w in self.workers:
             self.scheduler.create_engine(w, self.engine_path, config)
         self.scheduler.call_all(self.workers, "initialize", addresses)
+        # config-driven agentic layer (reference InferenceEngineConfig
+        # .openai): a non-None openai sub-config starts the per-worker
+        # proxies + gateway as part of bringup; needs a tokenizer path
+        # (experiment-level tokenizer_path)
+        ocfg = getattr(config, "openai", None)
+        tok = getattr(config, "tokenizer_path", "")
+        if ocfg is not None:
+            assert tok, (
+                "InferenceEngineConfig.openai is set but no tokenizer_path "
+                "is configured — the proxy layer needs one to template chats"
+            )
+            self.start_proxy_from_config(
+                ocfg, tokenizer_path=tok, engine_path=self.proxy_engine_path
+            )
+            self.start_gateway()
 
     def destroy(self) -> None:
         self.disable_completion_callbacks()
@@ -92,6 +111,7 @@ class RolloutController:
         admin_key: str,
         capacity: int = 128,
         engine_path: str = "",
+        extra_args: list[str] | None = None,
     ) -> list[str]:
         """Fork one OpenAI-compatible proxy server per rollout worker
         (colocated, CPU-pinned) wired to the same inference fleet. Returns
@@ -107,6 +127,7 @@ class RolloutController:
             str(capacity),
             "--port",
             "{port}",
+            *(extra_args or []),
         ]
         if engine_path:
             args += ["--engine-path", engine_path]
@@ -122,6 +143,32 @@ class RolloutController:
         addrs = [f"http://{w.address}" for w in self.proxy_workers]
         logger.info(f"proxy workers up: {addrs}")
         return addrs
+
+    def start_proxy_from_config(
+        self, cfg, tokenizer_path: str, engine_path: str = ""
+    ) -> list[str]:
+        """Config-driven proxy bringup (reference
+        InferenceEngineConfig.openai -> OpenAIProxyConfig): maps the knobs
+        onto start_proxy and threads parser/template/max-tokens through to
+        each forked proxy worker."""
+        import secrets
+
+        admin_key = cfg.admin_api_key or secrets.token_hex(16)
+        extra = [
+            "--tool-call-parser",
+            cfg.tool_call_parser,
+            "--chat-template-type",
+            cfg.chat_template_type,
+        ]
+        if cfg.engine_max_tokens:
+            extra += ["--engine-max-tokens", str(cfg.engine_max_tokens)]
+        return self.start_proxy(
+            tokenizer_path,
+            admin_key,
+            capacity=cfg.capacity,
+            engine_path=engine_path,
+            extra_args=extra,
+        )
 
     def get_proxy_addr(self, rank: int) -> str:
         assert self.proxy_workers, "start_proxy() first"

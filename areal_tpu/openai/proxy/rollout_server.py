@@ -543,6 +543,8 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--capacity", type=int, default=128)
     p.add_argument("--name", default="", help="name_resolve registration key")
     p.add_argument("--chat-template-type", default="hf")
+    p.add_argument("--tool-call-parser", default="qwen")
+    p.add_argument("--engine-max-tokens", type=int, default=0)
     p.add_argument(
         "--servers",
         default="",
@@ -579,6 +581,9 @@ def main(argv: list[str] | None = None) -> None:
         tokenizer,
         admin_api_key=args.admin_key,
         capacity=args.capacity,
+        chat_template_type=args.chat_template_type,
+        engine_max_tokens=args.engine_max_tokens or None,
+        tool_call_parser=args.tool_call_parser,
     )
     app = create_proxy_app(state)
     port = args.port or find_free_port()

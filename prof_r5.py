@@ -41,7 +41,8 @@ def phase_tree():
     model_kw = MODEL_KW
     GROUPS, GROUP, PROMPT, RESP = 4, 8, 512, 512
     budget, bucket, mb_tokens = 8192, 1024, 9000
-    if os.environ.get("PROF_SMOKE"):
+    smoke = bool(os.environ.get("PROF_SMOKE"))
+    if smoke:
         # CPU wiring check: tiny dims, same code path
         model_kw = dict(
             vocab_size=256,
@@ -95,7 +96,6 @@ def phase_tree():
         return float((np.asarray(d["loss_mask"]) > 0).sum())
 
     def make_engine(tree: bool):
-        smoke = bool(__import__("os").environ.get("PROF_SMOKE"))
         cfg = TrainEngineConfig(
             init_from_scratch=True,
             dtype="float32" if smoke else "bfloat16",

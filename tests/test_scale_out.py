@@ -333,6 +333,52 @@ def test_controller_started_proxy_gateway_agent_flow():
     assert ctl.gateway_url is None and not ctl.proxy_workers
 
 
+def test_proxy_from_config():
+    """Config-driven proxy bringup (reference InferenceEngineConfig.openai):
+    a non-None openai sub-config makes RolloutController.initialize start
+    the proxies + gateway as part of bringup, knobs reaching the forked
+    workers, incl. a generated admin key when none is configured."""
+    import json
+    import urllib.request
+
+    from areal_tpu.api.config import InferenceEngineConfig, OpenAIProxyConfig
+    from areal_tpu.infra.controller.rollout_controller import RolloutController
+    from areal_tpu.infra.scheduler.local import LocalScheduler
+
+    sched = LocalScheduler(start_timeout=90)
+    ctl = RolloutController(
+        sched,
+        engine_path="areal_tpu.infra.rpc.echo_engine.EchoEngine",
+        role="rollout-cfg",
+        replicas=1,
+        proxy_engine_path="areal_tpu.infra.rpc.echo_engine.FakeInferenceEngine",
+    )
+    cfg = InferenceEngineConfig(
+        openai=OpenAIProxyConfig(capacity=7, tool_call_parser="qwen"),
+        tokenizer_path="import:areal_tpu.infra.rpc.echo_engine.CharTokenizer",
+    )
+    try:
+        ctl.initialize(config=cfg)
+        assert len(ctl.proxy_workers) == 1  # auto-started from config
+        assert ctl.gateway_url
+        key = ctl._admin_key
+        assert key and len(key) >= 32  # generated (admin_api_key was empty)
+        req = urllib.request.Request(
+            f"{ctl.gateway_url}/rl/start_session",
+            data=json.dumps({"task_id": "t-0"}).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "Authorization": f"Bearer {key}",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            sess = json.loads(r.read())
+        assert sess["api_key"]
+    finally:
+        ctl.destroy()
+        sched.delete_workers()
+
+
 def test_slurm_launcher_supervision(tmp_path, monkeypatch):
     """SlurmLauncher renders sbatch scripts and supervises the trainer with
     run_id+1 resubmission on failure (reference launcher/slurm.py recovery
