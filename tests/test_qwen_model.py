@@ -38,14 +38,29 @@ def test_forward_runs(cfg):
     assert np.isfinite(np.asarray(logits)).all()
 
 
-@pytest.mark.parametrize("model_type", ["qwen2", "qwen3"])
+@pytest.mark.parametrize("model_type", ["qwen2", "qwen3", "llama"])
 def test_hf_transformers_parity(tmp_path, model_type):
     """Round-trip a tiny random HF model through our loader and compare logits
-    against the torch implementation."""
+    against the torch implementation. Llama rides the same decoder family
+    (RMSNorm + SwiGLU + GQA + rope, bias-free attention, untied head) — the
+    config parser and name map are architecture-generic, so Llama-3-style
+    checkpoints load without a separate model implementation."""
     torch = pytest.importorskip("torch")
     import transformers
 
-    if model_type == "qwen2":
+    if model_type == "llama":
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=128,
+            hidden_size=32,
+            intermediate_size=64,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            tie_word_embeddings=False,
+            rope_theta=500000.0,
+        )
+        model = transformers.LlamaForCausalLM(hf_cfg)
+    elif model_type == "qwen2":
         hf_cfg = transformers.Qwen2Config(
             vocab_size=128,
             hidden_size=32,
