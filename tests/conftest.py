@@ -14,12 +14,15 @@ if "xla_force_host_platform_device_count" not in flags:
 # registers its PJRT plugin in every process when these are set, and that
 # registration can wedge `import jax` while another process holds the tunnel
 # (verify skill gotcha); also keeps test subprocesses off the tunnel
-for _var in (
+# the one authoritative list of TPU-tunnel gate vars (tests that spawn
+# their own subprocesses scrub the child env with this too)
+AXON_GATE_VARS = (
     "PALLAS_AXON_POOL_IPS",
     "PALLAS_AXON_REMOTE_COMPILE",
     "AXON_LOOPBACK_RELAY",
     "AXON_POOL_SVC_OVERRIDE",
-):
+)
+for _var in AXON_GATE_VARS:
     os.environ.pop(_var, None)
 
 import jax  # noqa: E402
