@@ -64,6 +64,48 @@ def test_sft_trainer_loss_decreases(tmp_path):
     assert losses[-1] < losses[0] - 2.0, (losses[0], losses[-1])
 
 
+def test_sft_frozen_loss_curve(tmp_path):
+    """Loss-curve regression pin (reference tests/sft/ref_losses_*.json
+    role): the exact deterministic training trajectory on a fixed seed is
+    frozen — a silent numerics change anywhere in the engine/model stack
+    (the 1/sqrt(hd) class of bug) shifts the curve and fails here. The
+    frozen file regenerates via REGEN_REF_LOSSES=1."""
+    import json
+    import os
+
+    rng = np.random.default_rng(1)
+    rows = []
+    for _ in range(16):
+        p = int(rng.integers(3, 8))
+        ids = np.concatenate([rng.integers(1, 250, p), np.full(6, 42)]).astype(np.int32)
+        lm = np.concatenate([np.zeros(p), np.ones(6)]).astype(np.float32)
+        rows.append({"input_ids": ids.tolist(), "loss_mask": lm.tolist()})
+    cfg = SFTConfig(
+        experiment_name="sft-frozen",
+        trial_name="t0",
+        total_train_epochs=2,
+        model=_engine_cfg(),
+        train_dataset=DatasetConfig(batch_size=8, shuffle=False),
+        saver=SaverConfig(fileroot=str(tmp_path)),
+        checkpointer=SaverConfig(fileroot=str(tmp_path)),
+        recover=RecoverConfig(mode="disabled", fileroot=str(tmp_path)),
+        stats_logger=StatsLoggerConfig(fileroot=str(tmp_path)),
+    )
+    cfg.cluster.fileroot = str(tmp_path)
+    engine = JaxTrainEngine(cfg.model, model_config=TINY_QWEN2)
+    engine.initialize(FinetuneSpec(2, 16, 8))
+    losses = SFTTrainer(cfg, rows, engine=engine).train()
+    ref_path = os.path.join(os.path.dirname(__file__), "ref_losses_sft.json")
+    if os.environ.get("REGEN_REF_LOSSES"):
+        with open(ref_path, "w") as f:
+            json.dump([float(x) for x in losses], f)
+        pytest.skip("reference curve regenerated")
+    with open(ref_path) as f:
+        ref = json.load(f)
+    assert len(losses) == len(ref)
+    np.testing.assert_allclose(losses, ref, rtol=2e-3, atol=2e-3)
+
+
 def test_rw_engine_learns_preference():
     """Chosen sequences end with token 9, rejected with token 3; the value
     head must learn to score chosen higher (Bradley-Terry)."""
