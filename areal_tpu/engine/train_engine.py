@@ -773,14 +773,17 @@ class JaxTrainEngine(TrainEngine):
         )
         from areal_tpu.ops.tree_attention import forest_hidden
 
-        hidden = forest_hidden(
+        moe = mcfg.num_experts > 0
+        fwd = forest_hidden(
             cparams,
             mcfg,
             batch["node_ids"],
             batch["node_pos"],
             batch["mask_words"],
             batch["block_any"],
+            with_aux=moe,
         )
+        hidden, moe_aux = fwd if moe else (fwd, None)
         # one chunked-vocab pass, EDGE-aligned: row parent(j) scored against
         # token(j) gives log p(node j | ancestors); the entropy from the
         # same row is exactly the label-aligned entropy convention
@@ -794,10 +797,16 @@ class JaxTrainEngine(TrainEngine):
             temperature=getattr(self.config, "temperature", 1.0),
         )
         gather = batch["gather_idx"]  # [B, T] -> edge index of token t+1
-        return {
+        outputs = {
             "logprobs": logp[0][gather],
             "entropy": ent[0][gather],
         }
+        if moe_aux is not None:
+            # router load-balance aux over UNIQUE nodes (the packed path's
+            # statistic covers duplicated tokens; same contract, slightly
+            # different and arguably better-behaved estimator)
+            outputs["moe_aux"] = moe_aux
+        return outputs
 
     def _get_grad_fn(self, loss_fn: Callable, shape: tuple, kind: str = "packed"):
         key = ("grad", kind, shape, id(loss_fn))
@@ -1048,11 +1057,6 @@ class JaxTrainEngine(TrainEngine):
             assert not self.value_head, "tree training is a policy-only path"
             assert "pixel_values" not in input_ and "image_embeds" not in input_, (
                 "tree training does not support vision inputs"
-            )
-            # the forest forward drops the MoE router aux; a loss relying on
-            # outputs["moe_aux"] would silently train without load balance
-            assert self.model_cfg.num_experts == 0, (
-                "tree training does not support MoE models yet"
             )
             return self._train_batch_tree(input_, loss_fn, loss_weight_fn)
         t0 = time.monotonic()

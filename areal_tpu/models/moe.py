@@ -130,7 +130,31 @@ def moe_ffn_dropless(h: jax.Array, layer: dict, cfg) -> tuple[jax.Array, jax.Arr
     except Exception:  # noqa: BLE001
         axes = {}
     e_sz = axes.get("expert", 1)
-    in_mesh = bool(axes) and E % max(e_sz, 1) == 0
+    d_sz = max(axes.get("data", 1) * axes.get("fsdp", 1), 1)
+    s_sz = max(axes.get("seq", 1), 1)
+    # shard_map needs every sharded dim divisible by its axes. Routing is
+    # per-token, so an unshardable (G, L) layout (the tree-training
+    # forest's [1, N, D]) can be RESHAPED to a shardable one when the
+    # total token count divides — same math, shards keep their FLOP share
+    orig_GL = None
+    if (
+        bool(axes)
+        and not (G % d_sz == 0 and L % s_sz == 0)
+        and (G * L) % (d_sz * s_sz) == 0
+    ):
+        orig_GL = (G, L)
+        h = h.reshape(d_sz, (G * L) // d_sz, D)
+        G, L = h.shape[0], h.shape[1]
+    in_mesh = (
+        bool(axes)
+        and E % max(e_sz, 1) == 0
+        and G % d_sz == 0
+        and L % s_sz == 0
+    )
+    if bool(axes) and not in_mesh:
+        # truly unshardable: run replicated — every device computes all
+        # tokens. Loud, because on a big mesh this is a real perf cliff.
+        _warn_replicated_once((G, L, d_sz, s_sz, e_sz))
     interpret = jax.devices()[0].platform != "tpu"
     tile = (16, 128, 128) if interpret else (128, 128, 128)
 
@@ -186,26 +210,46 @@ def moe_ffn_dropless(h: jax.Array, layer: dict, cfg) -> tuple[jax.Array, jax.Arr
         return out.reshape(G_, L_, D).astype(h_blk.dtype), aux
 
     if not in_mesh:
-        return block(
+        out, aux = block(
             h,
             layer["w_router"],
             layer["we_gate"],
             layer["we_up"],
             layer["we_down"],
         )
-    out, aux = jax.shard_map(
-        block,
-        in_specs=(
-            P(BATCH_AXES, "seq", None),
-            P(None, None),
-            P("expert", None, None),
-            P("expert", None, None),
-            P("expert", None, None),
-        ),
-        out_specs=(P(BATCH_AXES, "seq", None), P()),
-        # gmm's inner pallas_call carries no vma annotations; the variance
-        # checker can't see through it — the psum/pmean above implement the
-        # replication the out_specs promise
-        check_vma=False,
-    )(h, layer["w_router"], layer["we_gate"], layer["we_up"], layer["we_down"])
+    else:
+        out, aux = jax.shard_map(
+            block,
+            in_specs=(
+                P(BATCH_AXES, "seq", None),
+                P(None, None),
+                P("expert", None, None),
+                P("expert", None, None),
+                P("expert", None, None),
+            ),
+            out_specs=(P(BATCH_AXES, "seq", None), P()),
+            # gmm's inner pallas_call carries no vma annotations; the variance
+            # checker can't see through it — the psum/pmean above implement the
+            # replication the out_specs promise
+            check_vma=False,
+        )(h, layer["w_router"], layer["we_gate"], layer["we_up"], layer["we_down"])
+    if orig_GL is not None:
+        out = out.reshape(*orig_GL, D)
     return out, aux.astype(jnp.float32)
+
+
+_REPLICATED_WARNED: set = set()
+
+
+def _warn_replicated_once(key: tuple) -> None:
+    if key in _REPLICATED_WARNED:
+        return
+    _REPLICATED_WARNED.add(key)
+    from areal_tpu.utils import logging as alog
+
+    alog.getLogger("moe").warning(
+        "moe_ffn token layout (G=%s, L=%s) is not shardable over "
+        "data*fsdp=%s, seq=%s (expert=%s); dispatch runs REPLICATED — every "
+        "device computes every token. Fine for tests/tiny calls, a perf "
+        "cliff on real meshes." % key
+    )

@@ -415,9 +415,13 @@ def forest_hidden(
     words: jax.Array,  # [Npad, Npad // 32] uint32 ancestor bitmask
     block_any: jax.Array,  # [nB, nB] int32 tile skip map
     remat: bool | None = None,
+    with_aux: bool = False,  # also return the summed MoE router aux loss
 ) -> jax.Array:
     """Transformer forward over packed trie nodes with the block-sparse
-    kernel in every layer -> final-norm hidden states [Npad, D].
+    kernel in every layer -> final-norm hidden states [Npad, D]
+    (+ aux when asked; note the load-balance statistic is over UNIQUE
+    nodes, not the packed path's duplicated tokens — document, don't
+    expect bitwise aux parity).
 
     Pure jax-array contract (jit-safe): the engine's tree-training path
     feeds host-built node/mask arrays straight through its grad jit. The
@@ -454,11 +458,14 @@ def forest_hidden(
         x = x + attn.reshape(n_pad, H * hd) @ layer["wo"]
         h = qwen._rms_norm(x, layer["post_attn_norm"], mcfg.rms_norm_eps)
         if mcfg.num_experts > 0:
-            return x + qwen._ffn(mcfg, h, layer), None  # MoE dispatch
+            from areal_tpu.models.moe import moe_ffn
+
+            ff_out, aux = moe_ffn(h[None], layer, mcfg)  # wants [G, L, D]
+            return x + ff_out[0], aux
         ff = jax.nn.silu(qwen._proj(mcfg, layer, "w_gate", h)) * qwen._proj(
             mcfg, layer, "w_up", h
         )
-        return x + qwen._proj(mcfg, layer, "w_down", ff), None
+        return x + qwen._proj(mcfg, layer, "w_down", ff), jnp.float32(0.0)
 
     if remat is None:
         remat = cfg.remat
@@ -466,8 +473,11 @@ def forest_hidden(
         layer_fn = jax.checkpoint(
             layer_fn, policy=jax.checkpoint_policies.nothing_saveable
         )
-    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
-    return qwen._rms_norm(x, params["final_norm"], mcfg.rms_norm_eps)
+    x, aux = jax.lax.scan(layer_fn, x, params["layers"])
+    hidden = qwen._rms_norm(x, params["final_norm"], mcfg.rms_norm_eps)
+    if with_aux:
+        return hidden, aux.sum()
+    return hidden
 
 
 def tree_forward_logprobs_pallas(params, cfg, pack, remat: bool | None = None):
