@@ -179,6 +179,11 @@ class RemoteJaxEngine(InferenceEngine):
                     "stop_token_ids": g.stop_token_ids,
                     "max_tokens": g.max_tokens,
                     "ignore_eos": g.ignore_eos,
+                    # abort-resume aware: tokens already accumulated across
+                    # attempts count toward the minimum
+                    "min_new_tokens": max(
+                        0, g.min_new_tokens - len(accumulated)
+                    ),
                 },
             }
             data = await self._post_json(addr, "/generate", payload)

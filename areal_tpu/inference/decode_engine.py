@@ -339,6 +339,10 @@ class DecodeEngine:
             "greedy": np.zeros(S, bool),
             "top_k": np.full(S, -1, np.int32),
             "top_p": np.ones(S, np.float32),
+            # stop tokens are honored only once remaining - 1 <= min_rem
+            # (the -1 accounts for the token being emitted), i.e. after
+            # gconfig.min_new_tokens tokens have been generated
+            "min_rem": np.zeros(S, np.int32),
             "stop_ids": np.full((S, _MAX_STOP), -1, np.int32),
         }
         with jax.set_mesh(self.mesh):
@@ -575,7 +579,7 @@ class DecodeEngine:
                         rng_s,
                     ).compile()
                 )
-        upd_row = 9 + _MAX_STOP  # _pack_row column count
+        upd_row = 10 + _MAX_STOP  # _pack_row column count
         for n in self._reachable_scatter_sizes():
             tasks.append(
                 lambda n=n: self._update_fn(n).lower(
@@ -1122,7 +1126,7 @@ class DecodeEngine:
                     emitted = active
                     hit_stop = jnp.any(
                         next_ids[:, None] == state["stop_ids"], axis=-1
-                    )
+                    ) & (remaining - 1 <= state["min_rem"])
                     new_pos = pos + 1
                     remaining = remaining - active.astype(jnp.int32)
                     still = (
@@ -1172,9 +1176,9 @@ class DecodeEngine:
         return self._fn_cache[key]
 
     def _update_fn(self, n: int):
-        """Jitted slot-state scatter: one packed fp32 [n, 9+_MAX_STOP] upload
+        """Jitted slot-state scatter: one packed fp32 [n, 10+_MAX_STOP] upload
         (columns: slot, ids, pos, active, remaining, top_k, greedy, temp,
-        top_p, stop_ids...) applied on device. All values fit fp32 exactly
+        top_p, min_rem, stop_ids...) applied on device. All values fit fp32 exactly
         (token ids < 2^24). Padded rows repeat row 0 (idempotent scatter)."""
         key = ("upd", n)
         if key not in self._fn_cache:
@@ -1192,8 +1196,11 @@ class DecodeEngine:
                 state["greedy"] = state["greedy"].at[sl].set(upd[:, 6] > 0)
                 state["temp"] = state["temp"].at[sl].set(upd[:, 7])
                 state["top_p"] = state["top_p"].at[sl].set(upd[:, 8])
+                state["min_rem"] = (
+                    state["min_rem"].at[sl].set(upd[:, 9].astype(jnp.int32))
+                )
                 state["stop_ids"] = (
-                    state["stop_ids"].at[sl].set(upd[:, 9 : 9 + _MAX_STOP].astype(jnp.int32))
+                    state["stop_ids"].at[sl].set(upd[:, 10 : 10 + _MAX_STOP].astype(jnp.int32))
                 )
                 return state
 
@@ -1236,10 +1243,15 @@ class DecodeEngine:
         temp: float = 1.0,
         top_p: float = 1.0,
         stops: list[int] | None = None,
+        min_rem: int | None = None,
     ) -> np.ndarray:
         """The ONE place that knows the packed scatter-row column order (must
-        match ``_update_fn``): update the host mirror and build the fp32 row."""
+        match ``_update_fn``): update the host mirror and build the fp32 row.
+        ``min_rem``: stops fire only once remaining-1 <= min_rem (the
+        min_new_tokens gate); default = remaining, i.e. always allowed."""
         stops = (list(stops or []) + [-1] * _MAX_STOP)[:_MAX_STOP]
+        if min_rem is None:
+            min_rem = remaining
         st = self._state
         st["ids"][slot] = last_id
         st["pos"][slot] = pos
@@ -1249,9 +1261,10 @@ class DecodeEngine:
         st["greedy"][slot] = greedy
         st["top_k"][slot] = top_k
         st["top_p"][slot] = top_p
+        st["min_rem"][slot] = min_rem
         st["stop_ids"][slot] = stops
         return np.asarray(
-            [slot, last_id, pos, active, remaining, top_k, greedy, temp, top_p, *stops],
+            [slot, last_id, pos, active, remaining, top_k, greedy, temp, top_p, min_rem, *stops],
             np.float32,
         )
 
@@ -1284,6 +1297,13 @@ class DecodeEngine:
             temp=temp,
             top_p=g.top_p if g.top_p else 1.0,
             stops=[] if g.ignore_eos else g.stop_token_ids,
+            # min_new_tokens gate, resume-aware: stops unlock after the
+            # request has min_new tokens TOTAL (tokens emitted before an
+            # abort/park count)
+            min_rem=max(
+                0,
+                remaining - max(0, g.min_new_tokens - len(task.out_tokens)),
+            ),
         )
 
     def _budget(self, task: _Task, prompt_len: int) -> int:
@@ -1690,8 +1710,16 @@ class DecodeEngine:
                 sl = upd[:, 0]
                 cap = upd[:, 1]
                 state = dict(state)
-                new_rem = jnp.minimum(state["remaining"][sl], cap)
+                old_rem = state["remaining"][sl]
+                new_rem = jnp.minimum(old_rem, cap)
                 state["remaining"] = state["remaining"].at[sl].set(new_rem)
+                # keep the min_new_tokens gate invariant: "tokens still
+                # needed before stops unlock" (= remaining - min_rem) must
+                # survive the budget clamp, or stops would fire immediately
+                new_min = jnp.maximum(
+                    0, state["min_rem"][sl] - (old_rem - new_rem)
+                )
+                state["min_rem"] = state["min_rem"].at[sl].set(new_min)
                 state["active"] = (
                     state["active"].at[sl].set(state["active"][sl] & (new_rem > 0))
                 )
@@ -1819,7 +1847,11 @@ class DecodeEngine:
             if not active[slot]:
                 last = task.out_tokens[-1] if task.out_tokens else -1
                 g = task.req.gconfig
-                if not g.ignore_eos and last in g.stop_token_ids:
+                if (
+                    not g.ignore_eos
+                    and last in g.stop_token_ids
+                    and len(task.out_tokens) >= g.min_new_tokens
+                ):
                     reason = StopReason.STOP.value
                 else:
                     reason = StopReason.LENGTH.value

@@ -175,6 +175,79 @@ def test_ignore_eos_generates_to_budget():
         eng.stop()
 
 
+def test_min_new_tokens_suppresses_early_stop():
+    """Stops are inert until min_new_tokens have been generated (reference
+    GenerationHyperparameters.min_new_tokens — previously accepted but
+    never consumed)."""
+    import jax
+
+    from areal_tpu.api.config import MeshConfig, ServerConfig
+    from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.models import qwen
+
+    cfg = qwen.ModelConfig(
+        vocab_size=64,
+        hidden_size=32,
+        intermediate_size=64,
+        num_layers=1,
+        num_heads=2,
+        num_kv_heads=2,
+        dtype="float32",
+        tie_word_embeddings=True,
+    )
+    eng = DecodeEngine(
+        ServerConfig(
+            max_batch_size=2,
+            max_seq_len=64,
+            decode_steps_per_call=4,
+            seed=0,
+            mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        ),
+        params=qwen.init_params(jax.random.PRNGKey(0), cfg),
+        model_cfg=cfg,
+    )
+    eng.initialize()
+    eng.start()
+    try:
+        prompt = [1, 2, 3]
+        base = eng.generate_sync(
+            ModelRequest(
+                input_ids=prompt,
+                gconfig=GenerationHyperparameters(max_new_tokens=12, greedy=True),
+            ),
+            timeout=120,
+        )
+        stop_tok = base.output_tokens[2]  # appears at position 3
+        early = eng.generate_sync(
+            ModelRequest(
+                input_ids=prompt,
+                gconfig=GenerationHyperparameters(
+                    max_new_tokens=12, greedy=True, stop_token_ids=[stop_tok]
+                ),
+            ),
+            timeout=120,
+        )
+        gated = eng.generate_sync(
+            ModelRequest(
+                input_ids=prompt,
+                gconfig=GenerationHyperparameters(
+                    max_new_tokens=12,
+                    greedy=True,
+                    stop_token_ids=[stop_tok],
+                    min_new_tokens=8,
+                ),
+            ),
+            timeout=120,
+        )
+        assert len(early.output_tokens) < 8
+        assert len(gated.output_tokens) >= 8
+        # the gated stream is the same greedy stream, just not cut short
+        assert gated.output_tokens[: len(early.output_tokens)] == early.output_tokens
+    finally:
+        eng.stop()
+
+
 def test_wandb_config_fields_load_from_yaml(tmp_path):
     from areal_tpu.api.config import GRPOConfig, load_expr_config
 
