@@ -55,6 +55,16 @@ from areal_tpu.utils.data import round_up_to_bucket
 
 logger = alog.getLogger("jax_engine")
 
+def _np_device_dtype(v: np.ndarray) -> np.ndarray:
+    """Host arrays ship to device in 32-bit: f64/i64 are loader artifacts,
+    never intentional precision."""
+    if v.dtype == np.float64:
+        return v.astype(np.float32)
+    if v.dtype == np.int64:
+        return v.astype(np.int32)
+    return v
+
+
 def _shape_key(batch) -> tuple:
     """jit-cache shape key: grid shape + pixel shapes when the trainable
     vision tower rides in the batch (their padded sizes change the traced
@@ -241,7 +251,7 @@ class JaxTrainEngine(TrainEngine):
                 weight_decay=ocfg.weight_decay,
             ),
         )
-        train_vit = bool(getattr(cfg, "train_vision_tower", False))
+        train_vit = cfg.train_vision_tower
         if train_vit:
             assert mcfg.vision is not None, (
                 "train_vision_tower set but the model has no vision tower"
@@ -456,7 +466,7 @@ class JaxTrainEngine(TrainEngine):
             input_.pop("pixel_pos_ids", np.zeros((B, P_raw, 2))), np.int32
         )
         ids = np.asarray(input_["input_ids"])
-        trainable = bool(getattr(self.config, "train_vision_tower", False))
+        trainable = self.config.train_vision_tower
         if not trainable:
             # one PPO step calls forward_batch (logprob recompute) and
             # train_batch on the SAME batch; memoize the tower output so the
@@ -582,12 +592,7 @@ class JaxTrainEngine(TrainEngine):
         sharding = mesh_lib.batch_sharding(self.mesh)
         dev = {}
         for k, v in batch.items():
-            v = np.asarray(v)
-            if v.dtype == np.float64:
-                v = v.astype(np.float32)
-            if v.dtype == np.int64:
-                v = v.astype(np.int32)
-            dev[k] = jax.device_put(v, sharding)
+            dev[k] = jax.device_put(_np_device_dtype(np.asarray(v)), sharding)
         if "pixel_values" in grid.data and "image_k" in grid.data:
             # trainable-tower path: pixel tensors ride to the jit (replicated
             # — n_seqs is not dp-divisible in general and the tower is small
@@ -984,15 +989,10 @@ class JaxTrainEngine(TrainEngine):
         kernel sequence (not row-shardable like grids), and params keep
         their GSPMD shardings regardless."""
         rep = mesh_lib.replicated(self.mesh)
-        dev = {}
-        for k, v in batch.items():
-            v = np.asarray(v)
-            if v.dtype == np.float64:
-                v = v.astype(np.float32)
-            if v.dtype == np.int64:
-                v = v.astype(np.int32)
-            dev[k] = jax.device_put(v, rep)
-        return dev
+        return {
+            k: jax.device_put(_np_device_dtype(np.asarray(v)), rep)
+            for k, v in batch.items()
+        }
 
     def _train_batch_tree(
         self,
@@ -1053,7 +1053,7 @@ class JaxTrainEngine(TrainEngine):
         mb_spec: MicroBatchSpec | None = None,
     ) -> dict[str, float]:
         assert self.params is not None, "engine not initialized"
-        if getattr(self.config, "tree_training", False):
+        if self.config.tree_training:
             assert not self.value_head, "tree training is a policy-only path"
             assert "pixel_values" not in input_ and "image_embeds" not in input_, (
                 "tree training does not support vision inputs"
