@@ -75,9 +75,22 @@ def load_params_from_hf(
 
     layers: dict[str, Any] = {}
     for name in _layer_shapes(cfg):
-        per_layer = [
-            to_np(*name_map[f"layers/{i}/{name}"]) for i in range(cfg.num_layers)
-        ]
+        if name in ("we_gate", "we_up", "we_down"):
+            # MoE expert leaves: HF ships one tensor per (layer, expert);
+            # stacked [L, E, ...] here
+            per_layer = [
+                np.stack(
+                    [
+                        to_np(*name_map[f"layers/{i}/{name}/{e}"])
+                        for e in range(cfg.num_experts)
+                    ]
+                )
+                for i in range(cfg.num_layers)
+            ]
+        else:
+            per_layer = [
+                to_np(*name_map[f"layers/{i}/{name}"]) for i in range(cfg.num_layers)
+            ]
         layers[name] = put(f"layers/{name}", np.stack(per_layer))
     params = {
         "embed": put("embed", to_np(*name_map["embed"])),
@@ -131,8 +144,13 @@ def write_hf_config(cfg: "ModelConfig", path: str) -> None:
     tests; scratch-trained exports)."""
     import json
 
+    assert cfg.vision is None, (
+        "write_hf_config cannot reconstruct a vision_config — export VLM "
+        "checkpoints with base_model_path pointing at the source model dir"
+    )
+    base = "qwen3" if cfg.qk_norm else "qwen2"
     d = {
-        "model_type": "qwen3" if cfg.qk_norm else "qwen2",
+        "model_type": base + ("_moe" if cfg.num_experts > 0 else ""),
         "vocab_size": cfg.vocab_size,
         "hidden_size": cfg.hidden_size,
         "intermediate_size": cfg.intermediate_size,
@@ -145,6 +163,13 @@ def write_hf_config(cfg: "ModelConfig", path: str) -> None:
         "tie_word_embeddings": cfg.tie_word_embeddings,
         "attention_bias": cfg.attention_bias,
     }
+    if cfg.num_experts > 0:
+        d.update(
+            num_experts=cfg.num_experts,
+            num_experts_per_tok=cfg.num_experts_per_tok,
+            moe_intermediate_size=cfg.moe_intermediate_size,
+            norm_topk_prob=cfg.norm_topk_prob,
+        )
     os.makedirs(path, exist_ok=True)
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump(d, f, indent=2)
@@ -171,14 +196,18 @@ def save_params_to_hf(
 
     for our_path, (hf_name, transpose) in name_map.items():
         parts = our_path.split("/")
-        if parts[0] == "layers":
+        if parts[0] == "layers" and len(parts) == 4:  # layers/<l>/<name>/<e>
+            t = host(params["layers"][parts[2]][int(parts[1]), int(parts[3])])
+        elif parts[0] == "layers":
             t = host(params["layers"][parts[2]][int(parts[1])])
         else:
             t = host(params[parts[0]])
         flat[hf_name] = np.ascontiguousarray(t.T) if transpose else t
     save_file(flat, os.path.join(path, "model.safetensors"))
 
-    if base_model_path is None and not os.path.exists(
+    # "" (a from-scratch engine's config.path) must behave like None: an
+    # export with no config.json is not loadable as an HF artifact
+    if not base_model_path and not os.path.exists(
         os.path.join(path, "config.json")
     ):
         write_hf_config(cfg, path)

@@ -144,6 +144,11 @@ class ModelConfig:
             tie_word_embeddings=td.get("tie_word_embeddings", False),
             qk_norm=(mt.startswith("qwen3")),
             attention_bias=td.get("attention_bias", mt.startswith("qwen2")),
+            # qwen2_moe / qwen3_moe checkpoints (HF key names)
+            num_experts=td.get("num_experts", 0),
+            num_experts_per_tok=td.get("num_experts_per_tok", 2),
+            moe_intermediate_size=td.get("moe_intermediate_size"),
+            norm_topk_prob=td.get("norm_topk_prob", True),
             image_token_id=image_token_id,
             vision=vision,
         )
@@ -814,15 +819,33 @@ _HF_LAYER_MAP = {
 
 
 def hf_name_map(cfg: ModelConfig) -> dict[str, tuple[str, bool]]:
-    """Flat map: our param path ("layers/3/wq" or "embed") -> (HF name, transpose)."""
+    """Flat map: our param path -> (HF name, transpose). Dense leaves map as
+    "layers/<l>/<name>"; MoE expert leaves (stacked [L, E, ...] here, one
+    tensor per (layer, expert) in HF qwen2/3_moe checkpoints) map as
+    "layers/<l>/<name>/<e>"."""
     out: dict[str, tuple[str, bool]] = {
         "embed": ("model.embed_tokens.weight", False),
         "final_norm": ("model.norm.weight", False),
     }
     if not cfg.tie_word_embeddings:
         out["lm_head"] = ("lm_head.weight", False)
+    moe_map = {
+        "w_router": ("mlp.gate.weight", True),
+        "we_gate": ("mlp.experts.{e}.gate_proj.weight", True),
+        "we_up": ("mlp.experts.{e}.up_proj.weight", True),
+        "we_down": ("mlp.experts.{e}.down_proj.weight", True),
+    }
     for name in _layer_shapes(cfg):
-        hf_suffix, transpose = _HF_LAYER_MAP[name]
+        if name in ("we_gate", "we_up", "we_down"):
+            suffix, transpose = moe_map[name]
+            for i in range(cfg.num_layers):
+                for e in range(cfg.num_experts):
+                    out[f"layers/{i}/{name}/{e}"] = (
+                        f"model.layers.{i}.{suffix.format(e=e)}",
+                        transpose,
+                    )
+            continue
+        hf_suffix, transpose = moe_map.get(name) or _HF_LAYER_MAP[name]
         for i in range(cfg.num_layers):
             out[f"layers/{i}/{name}"] = (f"model.layers.{i}.{hf_suffix}", transpose)
     return out

@@ -221,3 +221,36 @@ def test_dropless_ep_sharded_matches_single_device():
     with jax.set_mesh(mesh):
         out, aux = jax.jit(lambda h, l: moe_ffn(h, l, cfg))(h, layer)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_moe_hf_roundtrip(tmp_path):
+    """MoE checkpoints round-trip through the HF layout (qwen2/3_moe: one
+    tensor per (layer, expert) + mlp.gate router; stacked [L, E, ...] here)
+    and the written config.json reconstructs the MoE ModelConfig — so a
+    from-scratch MoE export is a self-contained, loadable artifact."""
+    import jax.numpy as jnp
+
+    from areal_tpu.models.hf import load_params_from_hf, save_params_to_hf
+
+    params = qwen.init_params(jax.random.PRNGKey(0), MOE_CFG)
+    path = str(tmp_path / "hf")
+    save_params_to_hf(params, MOE_CFG, path, base_model_path="")
+    cfg2 = qwen.ModelConfig.from_hf_path(path)
+    assert cfg2.num_experts == MOE_CFG.num_experts
+    assert cfg2.num_experts_per_tok == MOE_CFG.num_experts_per_tok
+    assert cfg2.moe_intermediate_size == MOE_CFG.moe_intermediate_size
+    cfg2 = qwen.ModelConfig(**{**cfg2.__dict__, "dtype": "float32"})
+    loaded, _ = load_params_from_hf(path, cfg2, dtype=jnp.float32)
+    for k in ("w_router", "we_gate", "we_up", "we_down", "wq", "input_norm"):
+        np.testing.assert_allclose(
+            np.asarray(loaded["layers"][k]),
+            np.asarray(params["layers"][k]),
+            rtol=1e-6,
+        )
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(1, 250, (1, 16)).astype(np.int32))
+    seg = jnp.ones((1, 16), jnp.int32)
+    pos = jnp.arange(16, dtype=jnp.int32)[None]
+    h1, _ = qwen.forward(params, MOE_CFG, ids, seg, pos, with_aux=True)
+    h2, _ = qwen.forward(loaded, cfg2, ids, seg, pos, with_aux=True)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), rtol=1e-5, atol=1e-6)
