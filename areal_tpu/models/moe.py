@@ -156,13 +156,19 @@ def moe_ffn_dropless(h: jax.Array, layer: dict, cfg) -> tuple[jax.Array, jax.Arr
         # tokens. Loud, because on a big mesh this is a real perf cliff.
         _warn_replicated_once((G, L, d_sz, s_sz, e_sz))
     interpret = jax.devices()[0].platform != "tpu"
-    tile = (16, 128, 128) if interpret else (128, 128, 128)
+    tile_m0 = 16 if interpret else 128
 
     def block(h_blk, wr, wg, wu, wd):
         # h_blk [G_, L_, D]; wg/wu [E_loc, D, F]; wd [E_loc, F, D]
         G_, L_, _ = h_blk.shape
         E_loc = wg.shape[0]
         T = G_ * L_
+        # gmm requires its m dim (T*K) divisible by the m tile; tiny
+        # per-shard token counts (decode chunks, the forest's replicated
+        # fallback) take a smaller tile instead of failing
+        import math as _math
+
+        tile = (_math.gcd(T * K, tile_m0) or 1, 128, 128)
         x = h_blk.reshape(T, D)
         probs, top_p, top_e = _router(
             x.astype(jnp.float32), wr, K, cfg.norm_topk_prob
