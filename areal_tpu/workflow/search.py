@@ -1,0 +1,68 @@
+"""Search-agent environment: the model interleaves reasoning with
+``<search>query</search>`` calls; a local retriever answers each call and
+the snippets feed back as the next turn (reference examples/search_agent/
+recipe role — their agent queries a retrieval service; this zero-egress
+equivalent retrieves over an in-memory corpus, which is also the shape
+unit tests and offline curricula need).
+
+Rides MultiTurnWorkflow like TIR: ``make_search_env_fn(corpus)`` returns
+an env_fn — turns with a ``<search>`` tag get ranked snippets back, turns
+without one end the episode with the final answer.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+
+_SEARCH_RE = re.compile(r"<search>(.*?)</search>", re.DOTALL)
+
+
+def extract_query(text: str) -> str | None:
+    """Last <search> tag in the turn (the model may reason before it)."""
+    hits = _SEARCH_RE.findall(text)
+    return hits[-1].strip() if hits else None
+
+
+class LocalRetriever:
+    """Tiny keyword retriever: token-overlap scoring over (title, text)
+    documents. Deliberately dependency-free — the recipe's contract is the
+    search TURN LOOP, not retrieval quality; swap in a real service by
+    passing any object with ``search(query, k) -> list[str]``."""
+
+    def __init__(self, docs: list[tuple[str, str]]):
+        self.docs = list(docs)
+        self._toks = [
+            Counter(self._tokenize(f"{t} {b}")) for t, b in self.docs
+        ]
+
+    @staticmethod
+    def _tokenize(s: str) -> list[str]:
+        return re.findall(r"[a-z0-9]+", s.lower())
+
+    def search(self, query: str, k: int = 3) -> list[str]:
+        q = Counter(self._tokenize(query))
+        scored = []
+        for i, bag in enumerate(self._toks):
+            score = sum(min(c, bag[w]) for w, c in q.items())
+            if score > 0:
+                scored.append((score, i))
+        scored.sort(key=lambda si: (-si[0], si[1]))
+        return [
+            f"[{self.docs[i][0]}] {self.docs[i][1]}" for _, i in scored[:k]
+        ]
+
+
+def make_search_env_fn(retriever, k: int = 3, max_chars: int = 2000):
+    """env_fn for MultiTurnWorkflow: answer the turn's <search> query with
+    retrieved snippets; a turn without a query is the final answer."""
+
+    def env_fn(data, assistant_text: str, turn: int):
+        query = extract_query(assistant_text)
+        if query is None:
+            return None, True
+        snippets = retriever.search(query, k=k)
+        body = "\n".join(snippets) if snippets else "(no results)"
+        return f"Search results:\n{body[:max_chars]}", False
+
+    return env_fn

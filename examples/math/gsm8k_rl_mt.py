@@ -50,7 +50,9 @@ def main(argv):
     # mt_* knobs are entry-local (not experiment-config fields): strip them
     # before the config loader sees the overrides. mt_env=retry (wrong
     # answers get feedback) | tir (code blocks run in the sandboxed python
-    # tool, workflow/tir.py — the reference examples/tir role).
+    # tool, workflow/tir.py — the reference examples/tir role) | search
+    # (<search> tags retrieve over a local corpus built from the dataset,
+    # workflow/search.py — the reference examples/search_agent role).
     max_turns, turn_discount, env_kind = 3, 0.9, "retry"
     rest = []
     for a in argv:
@@ -85,10 +87,26 @@ def main(argv):
         from areal_tpu.workflow.tir import make_tir_env_fn
 
         env_fn = make_tir_env_fn()
+    elif env_kind == "search":
+        from areal_tpu.workflow.search import LocalRetriever, make_search_env_fn
+
+        # corpus from the training split itself: each row's question+answer
+        # becomes a document — a zero-egress stand-in for the reference's
+        # retrieval service with the same turn-loop contract
+        docs = []
+        for i, row in enumerate(train_dataset):
+            body = " ".join(
+                str(row.get(k, "")) for k in ("question", "prompt", "answer")
+            ).strip()
+            if body:
+                docs.append((f"doc{i}", body))
+        env_fn = make_search_env_fn(LocalRetriever(docs))
     elif env_kind == "retry":
         env_fn = make_env_fn(reward_fn)
     else:
-        raise ValueError(f"mt_env must be 'retry' or 'tir', got {env_kind!r}")
+        raise ValueError(
+            f"mt_env must be 'retry', 'tir', or 'search', got {env_kind!r}"
+        )
     workflow = MultiTurnWorkflow(
         reward_fn,
         config.gconfig.new(n_samples=1),

@@ -1,0 +1,109 @@
+"""Search-agent environment (reference examples/search_agent recipe role):
+the model's <search> turns get locally retrieved snippets back, the final
+turn answers, feedback tokens are loss-masked, and rewards ride the
+standard multi-turn discounting."""
+
+import asyncio
+
+import numpy as np
+
+from areal_tpu.api.io_struct import (
+    GenerationHyperparameters,
+    ModelRequest,
+    ModelResponse,
+)
+from areal_tpu.workflow.multi_turn import MultiTurnWorkflow
+from areal_tpu.workflow.search import (
+    LocalRetriever,
+    extract_query,
+    make_search_env_fn,
+)
+
+CORPUS = [
+    ("Mount Everest", "Mount Everest is the highest mountain, 8849 meters."),
+    ("K2", "K2 is the second highest mountain at 8611 meters."),
+    ("Mariana Trench", "The Mariana Trench is the deepest ocean trench."),
+]
+
+
+def test_retriever_ranks_by_overlap():
+    r = LocalRetriever(CORPUS)
+    hits = r.search("highest mountain height meters", k=2)
+    assert hits and "Everest" in hits[0]
+    assert r.search("zzz nothing") == []
+
+
+def test_extract_query_takes_last_tag():
+    t = "thinking <search>first</search> more <search>second one</search>"
+    assert extract_query(t) == "second one"
+    assert extract_query("no tags here") is None
+
+
+class ChatTok:
+    eos_token_id = 0
+    pad_token_id = 0
+
+    def apply_chat_template(self, messages, add_generation_prompt=True, tokenize=False):
+        text = "".join(f"<{m['role']}>{m['content']}" for m in messages)
+        if add_generation_prompt:
+            text += "<assistant>"
+        return text
+
+    def encode(self, text, add_special_tokens=False):
+        return [ord(c) for c in text]
+
+    def decode(self, ids):
+        return "".join(chr(i) for i in ids)
+
+
+class SearchingEngine:
+    """Turn 1 issues a search; turn 2 answers from the snippets."""
+
+    def __init__(self):
+        self.calls = []
+
+    async def agenerate(self, req: ModelRequest) -> ModelResponse:
+        self.calls.append(list(req.input_ids))
+        text = (
+            "<search>highest mountain</search>"
+            if len(self.calls) == 1
+            else "8849 meters"
+        )
+        out = [ord(c) for c in text]
+        return ModelResponse(
+            input_tokens=list(req.input_ids),
+            output_tokens=out,
+            output_logprobs=[-0.5] * len(out),
+            output_versions=[1] * len(out),
+            stop_reason="stop",
+        )
+
+
+def test_search_agent_episode():
+    env_fn = make_search_env_fn(LocalRetriever(CORPUS), k=2)
+
+    def reward_fn(prompt, completion, prompt_ids, completion_ids, **kw):
+        return 1.0 if "8849" in completion else 0.0
+
+    eng = SearchingEngine()
+    wf = MultiTurnWorkflow(
+        reward_fn,
+        GenerationHyperparameters(max_new_tokens=64, n_samples=1),
+        tokenizer=ChatTok(),
+        max_turns=3,
+        env_fn=env_fn,
+        turn_discount=0.5,
+    )
+    trajs = asyncio.run(
+        wf.arun_episode(eng, {"messages": [{"role": "user", "content": "How tall is the highest mountain?"}]})
+    )
+    traj = trajs[0]
+    # two model turns happened; the search results were fed back in turn 2
+    assert len(eng.calls) == 2
+    turn2_text = ChatTok().decode(eng.calls[1])
+    assert "Search results:" in turn2_text and "Everest" in turn2_text
+    # correct final answer, one retry turn -> discounted once
+    assert float(np.asarray(traj["rewards"])) == 0.5
+    # feedback (user/search) tokens are loss-masked; model tokens are not
+    lm = np.asarray(traj["loss_mask"], np.float32)
+    assert lm.sum() > 0 and lm.sum() < lm.size
