@@ -149,8 +149,13 @@ def write_hf_config(cfg: "ModelConfig", path: str) -> None:
         "checkpoints with base_model_path pointing at the source model dir"
     )
     base = "qwen3" if cfg.qk_norm else "qwen2"
+    # MoE exports always mark qwen3_moe (qwen2_moe implies shared experts
+    # this family doesn't have); the explicit qk_norm key keeps a
+    # no-qk-norm MoE export round-trippable through from_hf_dict
+    mt = ("qwen3_moe" if cfg.num_experts > 0 else base)
     d = {
-        "model_type": base + ("_moe" if cfg.num_experts > 0 else ""),
+        "model_type": mt,
+        "qk_norm": cfg.qk_norm,
         "vocab_size": cfg.vocab_size,
         "hidden_size": cfg.hidden_size,
         "intermediate_size": cfg.intermediate_size,
@@ -194,14 +199,25 @@ def save_params_to_hf(
             x = np.asarray(x.astype(jnp.float32), dtype=np.float32)
         return np.asarray(x)
 
+    # ONE device_get per stacked leaf, sliced on host — per-(layer, expert)
+    # device slices would multiply transfers on the disk weight-update path
+    host_cache: dict[str, np.ndarray] = {}
+
+    def leaf(name: str) -> np.ndarray:
+        if name not in host_cache:
+            host_cache[name] = host(
+                params["layers"][name] if name in params["layers"] else params[name]
+            )
+        return host_cache[name]
+
     for our_path, (hf_name, transpose) in name_map.items():
         parts = our_path.split("/")
         if parts[0] == "layers" and len(parts) == 4:  # layers/<l>/<name>/<e>
-            t = host(params["layers"][parts[2]][int(parts[1]), int(parts[3])])
+            t = leaf(parts[2])[int(parts[1]), int(parts[3])]
         elif parts[0] == "layers":
-            t = host(params["layers"][parts[2]][int(parts[1])])
+            t = leaf(parts[2])[int(parts[1])]
         else:
-            t = host(params[parts[0]])
+            t = leaf(parts[0])
         flat[hf_name] = np.ascontiguousarray(t.T) if transpose else t
     save_file(flat, os.path.join(path, "model.safetensors"))
 

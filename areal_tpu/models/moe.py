@@ -21,6 +21,8 @@ Two dispatch strategies, selected by ``cfg.moe_dropless``:
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -165,10 +167,13 @@ def moe_ffn_dropless(h: jax.Array, layer: dict, cfg) -> tuple[jax.Array, jax.Arr
         T = G_ * L_
         # gmm requires its m dim (T*K) divisible by the m tile; tiny
         # per-shard token counts (decode chunks, the forest's replicated
-        # fallback) take a smaller tile instead of failing
-        import math as _math
-
-        tile = (_math.gcd(T * K, tile_m0) or 1, 128, 128)
+        # fallback) take a smaller tile instead of failing. LARGE
+        # non-divisible shapes also land here — warn, because a collapsed
+        # m tile on a hot path is a silent perf cliff
+        tm = math.gcd(T * K, tile_m0)
+        if T * K >= tile_m0 and tm < tile_m0:
+            _warn_small_tile_once((T, K, tm, tile_m0))
+        tile = (tm, 128, 128)
         x = h_blk.reshape(T, D)
         probs, top_p, top_e = _router(
             x.astype(jnp.float32), wr, K, cfg.norm_topk_prob
@@ -242,6 +247,22 @@ def moe_ffn_dropless(h: jax.Array, layer: dict, cfg) -> tuple[jax.Array, jax.Arr
     if orig_GL is not None:
         out = out.reshape(*orig_GL, D)
     return out, aux.astype(jnp.float32)
+
+
+_SMALL_TILE_WARNED: set = set()
+
+
+def _warn_small_tile_once(key: tuple) -> None:
+    if key in _SMALL_TILE_WARNED:
+        return
+    _SMALL_TILE_WARNED.add(key)
+    from areal_tpu.utils import logging as alog
+
+    alog.getLogger("moe").warning(
+        "moe gmm m dim T*K=%s*%s is not divisible by the %s tile; running "
+        "with m tile %s — pad the token count to the tile for full "
+        "throughput" % (key[0], key[1], key[3], key[2])
+    )
 
 
 _REPLICATED_WARNED: set = set()

@@ -109,6 +109,13 @@ class ModelConfig:
         plus qwen2-vl-style VLMs whose text fields may nest under
         ``text_config``)."""
         mt = d.get("model_type", "qwen2")
+        if mt == "qwen2_moe":
+            raise ValueError(
+                "qwen2_moe checkpoints use always-active SHARED experts, "
+                "which this model family does not implement — loading one "
+                "would silently drop those weights. Supported MoE family: "
+                "qwen3_moe."
+            )
         td = {**d, **d.get("text_config", {})}
         vision = None
         image_token_id = d.get("image_token_id", -1)
@@ -142,7 +149,9 @@ class ModelConfig:
             rope_theta=td.get("rope_theta", 1e6),
             rms_norm_eps=td.get("rms_norm_eps", 1e-6),
             tie_word_embeddings=td.get("tie_word_embeddings", False),
-            qk_norm=(mt.startswith("qwen3")),
+            # explicit key wins (our own from-scratch exports carry it);
+            # else the qwen3-family heuristic
+            qk_norm=d.get("qk_norm", mt.startswith("qwen3")),
             attention_bias=td.get("attention_bias", mt.startswith("qwen2")),
             # qwen2_moe / qwen3_moe checkpoints (HF key names)
             num_experts=td.get("num_experts", 0),

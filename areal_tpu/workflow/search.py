@@ -40,10 +40,14 @@ class LocalRetriever:
     def _tokenize(s: str) -> list[str]:
         return re.findall(r"[a-z0-9]+", s.lower())
 
-    def search(self, query: str, k: int = 3) -> list[str]:
+    def search(
+        self, query: str, k: int = 3, exclude_substr: str | None = None
+    ) -> list[str]:
         q = Counter(self._tokenize(query))
         scored = []
         for i, bag in enumerate(self._toks):
+            if exclude_substr and exclude_substr in self.docs[i][1]:
+                continue
             score = sum(min(c, bag[w]) for w, c in q.items())
             if score > 0:
                 scored.append((score, i))
@@ -55,13 +59,20 @@ class LocalRetriever:
 
 def make_search_env_fn(retriever, k: int = 3, max_chars: int = 2000):
     """env_fn for MultiTurnWorkflow: answer the turn's <search> query with
-    retrieved snippets; a turn without a query is the final answer."""
+    retrieved snippets; a turn without a query is the final answer.
+
+    When the corpus is built from the TRAINING SPLIT itself (the zero-
+    egress entry does this), the episode's own document must be excluded —
+    otherwise token-overlap ranking hands the model its gold answer and
+    GRPO learns retrieval-copying, not reasoning. Docs containing the
+    episode's own question verbatim are filtered."""
 
     def env_fn(data, assistant_text: str, turn: int):
         query = extract_query(assistant_text)
         if query is None:
             return None, True
-        snippets = retriever.search(query, k=k)
+        own = str(data.get("question") or data.get("prompt") or "") or None
+        snippets = retriever.search(query, k=k, exclude_substr=own)
         body = "\n".join(snippets) if snippets else "(no results)"
         return f"Search results:\n{body[:max_chars]}", False
 

@@ -33,6 +33,24 @@ def test_retriever_ranks_by_overlap():
     assert r.search("zzz nothing") == []
 
 
+def test_retriever_excludes_own_document():
+    """Training-split corpora must not leak the episode's own gold answer
+    back to the model (the retrieval-copying reward hack)."""
+    r = LocalRetriever(CORPUS)
+    hits = r.search(
+        "highest mountain", k=3, exclude_substr="Mount Everest is the highest"
+    )
+    assert hits and all("8849" not in h for h in hits)
+
+    env_fn = make_search_env_fn(r)
+    reply, done = env_fn(
+        {"question": "Mount Everest is the highest"},
+        "<search>highest mountain</search>",
+        0,
+    )
+    assert not done and "8849" not in reply
+
+
 def test_extract_query_takes_last_tag():
     t = "thinking <search>first</search> more <search>second one</search>"
     assert extract_query(t) == "second one"
