@@ -260,6 +260,11 @@ class ServerConfig:
     # recompute KV under the new weights on every resume (reference re-prefill
     # behavior).
     kv_reuse_across_updates: bool = True
+    # allocate the [slots, vocab] repeat-count table and compile the
+    # penalized sampling variants; off (default) keeps the serving memory
+    # and program set untouched and requests asking for a frequency
+    # penalty are warned + ignored
+    enable_frequency_penalty: bool = False
     # compile-warm every jitted serving variant (prefill sizes x prompt
     # buckets, decode-chunk windows, slot-scatter sizes) at startup so no
     # compile stall lands mid-serving (SGLang's warmup-at-launch role)

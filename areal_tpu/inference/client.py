@@ -179,6 +179,7 @@ class RemoteJaxEngine(InferenceEngine):
                     "stop_token_ids": g.stop_token_ids,
                     "max_tokens": g.max_tokens,
                     "ignore_eos": g.ignore_eos,
+                    "frequency_penalty": g.frequency_penalty,
                     # abort-resume aware: tokens already accumulated across
                     # attempts count toward the minimum
                     "min_new_tokens": max(

@@ -91,6 +91,7 @@ class _Parked:
     full_ids: list[int]  # prompt + emitted; cache holds all but the last
     pos: int  # decode position of the pending (last) token
     pages: list[int] = field(default_factory=list)  # owned KV pages
+    n_emitted: int = 0  # completion tokens so far (freq-penalty restore)
     park_time: float = field(default_factory=time.monotonic)
 
 
@@ -343,10 +344,21 @@ class DecodeEngine:
             # (the -1 accounts for the token being emitted), i.e. after
             # gconfig.min_new_tokens tokens have been generated
             "min_rem": np.zeros(S, np.int32),
+            "freq_pen": np.zeros(S, np.float32),
             "stop_ids": np.full((S, _MAX_STOP), -1, np.int32),
         }
+        # per-slot generated-token counts (OpenAI frequency_penalty
+        # semantics) live DEVICE-ONLY — the host never reads them back, so
+        # no [S, V] host mirror. uint16 with saturating updates. Config-
+        # gated so default fleets pay neither the memory nor new variants.
+        self._freq_enabled = bool(cfg.enable_frequency_penalty)
+        self._pending_count_restore: list[tuple[int, np.ndarray]] = []
         with jax.set_mesh(self.mesh):
             self._dev_state = {k: jnp.asarray(v) for k, v in self._state.items()}
+            if self._freq_enabled:
+                self._dev_state["freq_counts"] = jnp.zeros(
+                    (S, self.model_cfg.vocab_size), jnp.uint16
+                )
         seed = self.config.seed
         if seed is None:
             seed = int(time.time_ns()) % (2**31)
@@ -561,6 +573,7 @@ class DecodeEngine:
         from areal_tpu.inference import paged_kv
 
         tasks: list[Callable[[], Any]] = []
+        freq_variants = (False, True) if cfg.enable_frequency_penalty else (False,)
         for wp in self._reachable_chunk_wps():
             for capped, greedy_any in (
                 (False, False),  # the serving steady state (pure sampling)
@@ -568,9 +581,10 @@ class DecodeEngine:
                 (True, False),
                 (True, True),
             ):
+              for freq_any in freq_variants:
                 tasks.append(
-                    lambda wp=wp, capped=capped, greedy_any=greedy_any: self._chunk_fn(
-                        cfg.decode_steps_per_call, wp, capped, greedy_any
+                    lambda wp=wp, capped=capped, greedy_any=greedy_any, freq_any=freq_any: self._chunk_fn(
+                        cfg.decode_steps_per_call, wp, capped, greedy_any, freq_any
                     ).lower(
                         params_s,
                         cache_s,
@@ -579,7 +593,7 @@ class DecodeEngine:
                         rng_s,
                     ).compile()
                 )
-        upd_row = 10 + _MAX_STOP  # _pack_row column count
+        upd_row = 11 + _MAX_STOP  # _pack_row column count
         for n in self._reachable_scatter_sizes():
             tasks.append(
                 lambda n=n: self._update_fn(n).lower(
@@ -1087,7 +1101,14 @@ class DecodeEngine:
             emb[j, pos[:n]] = out[:n]
         return emb
 
-    def _chunk_fn(self, n_steps: int, wp: int, capped: bool, greedy_any: bool = True):
+    def _chunk_fn(
+        self,
+        n_steps: int,
+        wp: int,
+        capped: bool,
+        greedy_any: bool = True,
+        freq_any: bool = False,
+    ):
         """n_steps of decode for all slots in one jitted call, attending over
         each slot's first ``wp`` KV pages (the window, bucketed in pages).
 
@@ -1098,7 +1119,7 @@ class DecodeEngine:
         monotone within a chunk (a stopped slot never re-activates; admits
         happen between chunks), so per-slot counts fully describe the
         emit mask."""
-        key = ("chunk", n_steps, wp, capped, greedy_any)
+        key = ("chunk", n_steps, wp, capped, greedy_any, freq_any)
         if key not in self._fn_cache:
             mcfg = self.model_cfg
             T = self.config.max_seq_len
@@ -1107,7 +1128,7 @@ class DecodeEngine:
 
             def chunk(params, cache, page_table, state, rng):
                 def step(carry, _):
-                    ids, pos, active, remaining, cache, rng = carry
+                    ids, pos, active, remaining, counts, cache, rng = carry
                     hidden, cache = qwen.forward_decode_paged(
                         params,
                         mcfg,
@@ -1119,10 +1140,28 @@ class DecodeEngine:
                         use_kernel=use_kernel,
                     )
                     logits = qwen.compute_logits(params, mcfg, hidden)
+                    if freq_any:
+                        # OpenAI-style frequency penalty on raw logits,
+                        # proportional to this slot's generated-token counts
+                        logits = logits - (
+                            state["freq_pen"][:, None]
+                            * counts.astype(jnp.float32)
+                        )
                     rng, sub = jax.random.split(rng)
                     next_ids, logp = _sample_step(
                         logits, sub, state, capped, greedy_any
                     )
+                    if freq_any:
+                        # saturating (uint16 .add would wrap at 65535 —
+                        # reachable at max_seq_len > 64k, and negative
+                        # penalties actively drive repeats toward it)
+                        sl = jnp.arange(counts.shape[0])
+                        cur = counts[sl, next_ids].astype(jnp.int32)
+                        counts = counts.at[sl, next_ids].set(
+                            jnp.minimum(
+                                cur + active.astype(jnp.int32), 65535
+                            ).astype(counts.dtype)
+                        )
                     emitted = active
                     hit_stop = jnp.any(
                         next_ids[:, None] == state["stop_ids"], axis=-1
@@ -1137,7 +1176,7 @@ class DecodeEngine:
                     )
                     ids = jnp.where(active, next_ids, ids)
                     pos = jnp.where(active, new_pos, pos)
-                    return (ids, pos, still, remaining, cache, rng), (
+                    return (ids, pos, still, remaining, counts, cache, rng), (
                         next_ids,
                         logp,
                         emitted,
@@ -1148,14 +1187,19 @@ class DecodeEngine:
                     state["pos"],
                     state["active"],
                     state["remaining"],
+                    state["freq_counts"] if freq_any else jnp.zeros((), jnp.uint16),
                     cache,
                     rng,
                 )
-                (ids, pos, active, remaining, cache, rng), (toks, logps, emit) = (
-                    jax.lax.scan(step, carry, None, length=n_steps)
-                )
+                (ids, pos, active, remaining, counts, cache, rng), (
+                    toks,
+                    logps,
+                    emit,
+                ) = jax.lax.scan(step, carry, None, length=n_steps)
                 out_state = dict(state)
                 out_state.update(ids=ids, pos=pos, active=active, remaining=remaining)
+                if freq_any:
+                    out_state["freq_counts"] = counts
                 packed = jnp.concatenate(
                     [
                         toks.astype(jnp.int32),  # [n_steps, S]
@@ -1176,9 +1220,9 @@ class DecodeEngine:
         return self._fn_cache[key]
 
     def _update_fn(self, n: int):
-        """Jitted slot-state scatter: one packed fp32 [n, 10+_MAX_STOP] upload
+        """Jitted slot-state scatter: one packed fp32 [n, 11+_MAX_STOP] upload
         (columns: slot, ids, pos, active, remaining, top_k, greedy, temp,
-        top_p, min_rem, stop_ids...) applied on device. All values fit fp32 exactly
+        top_p, min_rem, freq_pen, stop_ids...) applied on device. All values fit fp32 exactly
         (token ids < 2^24). Padded rows repeat row 0 (idempotent scatter)."""
         key = ("upd", n)
         if key not in self._fn_cache:
@@ -1199,8 +1243,12 @@ class DecodeEngine:
                 state["min_rem"] = (
                     state["min_rem"].at[sl].set(upd[:, 9].astype(jnp.int32))
                 )
+                state["freq_pen"] = state["freq_pen"].at[sl].set(upd[:, 10])
+                if "freq_counts" in state:
+                    # (re)admission resets the slot's repeat counts
+                    state["freq_counts"] = state["freq_counts"].at[sl].set(0)
                 state["stop_ids"] = (
-                    state["stop_ids"].at[sl].set(upd[:, 10 : 10 + _MAX_STOP].astype(jnp.int32))
+                    state["stop_ids"].at[sl].set(upd[:, 11 : 11 + _MAX_STOP].astype(jnp.int32))
                 )
                 return state
 
@@ -1244,6 +1292,7 @@ class DecodeEngine:
         top_p: float = 1.0,
         stops: list[int] | None = None,
         min_rem: int | None = None,
+        freq_pen: float = 0.0,
     ) -> np.ndarray:
         """The ONE place that knows the packed scatter-row column order (must
         match ``_update_fn``): update the host mirror and build the fp32 row.
@@ -1262,9 +1311,10 @@ class DecodeEngine:
         st["top_k"][slot] = top_k
         st["top_p"][slot] = top_p
         st["min_rem"][slot] = min_rem
+        st["freq_pen"][slot] = freq_pen
         st["stop_ids"][slot] = stops
         return np.asarray(
-            [slot, last_id, pos, active, remaining, top_k, greedy, temp, top_p, min_rem, *stops],
+            [slot, last_id, pos, active, remaining, top_k, greedy, temp, top_p, min_rem, freq_pen, *stops],
             np.float32,
         )
 
@@ -1304,7 +1354,23 @@ class DecodeEngine:
                 0,
                 remaining - max(0, g.min_new_tokens - len(task.out_tokens)),
             ),
+            freq_pen=self._effective_freq_pen(task),
         )
+
+    def _effective_freq_pen(self, task: _Task) -> float:
+        fp = float(task.req.gconfig.frequency_penalty or 0.0)
+        if fp and not self._freq_enabled:
+            # config-gated: honoring it needs the [S, V] count table +
+            # penalized chunk variants — warn once, serve unpenalized
+            # (pre-knob behavior) rather than failing agent traffic
+            if not getattr(self, "_freq_pen_warned", False):
+                self._freq_pen_warned = True
+                logger.warning(
+                    "frequency_penalty requested but "
+                    "ServerConfig.enable_frequency_penalty is off — ignoring"
+                )
+            return 0.0
+        return fp
 
     def _budget(self, task: _Task, prompt_len: int) -> int:
         g = task.req.gconfig
@@ -1344,6 +1410,17 @@ class DecodeEngine:
         row = self._slot_update_row(
             task, slot, ids[-1], p.pos, self._budget(task, P_len)
         )
+        if self._freq_enabled and self._effective_freq_pen(task) != 0.0 and p.n_emitted:
+            # one logical request across an abort: the COMPLETION tokens
+            # emitted before the park (the tail of full_ids) keep their
+            # repeat counts; the admission scatter zeroes the slot, so the
+            # restore applies right after it
+            emitted = np.asarray(ids[-p.n_emitted :], np.int64)
+            counts = np.zeros(self.model_cfg.vocab_size, np.int64)
+            np.add.at(counts, emitted, 1)
+            self._pending_count_restore.append(
+                (slot, np.minimum(counts, 65535).astype(np.uint16))
+            )
         self.stats["kv_resumes"] += 1
         return row
 
@@ -1578,6 +1655,13 @@ class DecodeEngine:
             self._dev_state = self._update_fn(n)(
                 self._dev_state, jnp.asarray(upd)
             )
+            for slot, counts in self._pending_count_restore:
+                self._dev_state["freq_counts"] = (
+                    self._dev_state["freq_counts"].at[slot].set(
+                        jnp.asarray(counts)
+                    )
+                )
+            self._pending_count_restore.clear()
 
     def _finish(self, task: _Task, reason: str) -> None:
         if task.slot >= 0:
@@ -1627,6 +1711,7 @@ class DecodeEngine:
                         full_ids=list(task.req.input_ids) + list(task.out_tokens),
                         pos=int(st["pos"][slot]),
                         pages=self._slot_pages[slot],
+                        n_emitted=len(task.out_tokens),
                     )
                     self._slot_pages[slot] = []
                     self._pt_host[slot] = 0
@@ -1790,7 +1875,10 @@ class DecodeEngine:
         wp = min(self._maxp, -(-window // psz))
         capped = bool(((st["top_k"] > 0) | (st["top_p"] < 1.0))[active].any())
         greedy_any = bool(st["greedy"][active].any())
-        chunk = self._chunk_fn(n_steps, wp, capped, greedy_any)
+        freq_any = self._freq_enabled and bool(
+            (st["freq_pen"] != 0.0)[active].any()
+        )
+        chunk = self._chunk_fn(n_steps, wp, capped, greedy_any, freq_any)
         with jax.set_mesh(self.mesh):
             pt = jnp.asarray(self._pt_host[:, :wp])
             self.cache, self._dev_state, self._rng, packed = chunk(

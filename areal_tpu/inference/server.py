@@ -37,6 +37,7 @@ def _req_from_json(d: dict) -> ModelRequest:
         stop_token_ids=g.get("stop_token_ids", []),
         max_tokens=g.get("max_tokens"),
         ignore_eos=bool(g.get("ignore_eos", False)),
+        frequency_penalty=float(g.get("frequency_penalty", 0.0)),
         min_new_tokens=int(g.get("min_new_tokens", 0)),
     )
     image_data = None

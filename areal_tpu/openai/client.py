@@ -292,10 +292,9 @@ class AsyncChatCompletions:
             )
 
         temp = 1.0 if temperature is None else temperature
-        if frequency_penalty:
-            # accepted on GenerationHyperparameters but the TPU sampler does
-            # not implement it yet — warn instead of silently ignoring
-            _warn_once("frequency_penalty")
+        # frequency_penalty rides gconfig to the decode engine; fleets
+        # without ServerConfig.enable_frequency_penalty warn server-side
+        # and serve unpenalized
         stop_list = [stop] if isinstance(stop, str) else list(stop or [])
         stop_ids = sorted(
             {
