@@ -344,3 +344,81 @@ def test_frequency_penalty_matches_reference_math():
     assert naive(PEN) != naive(0.0)
     # disabled: warn + serve unpenalized (pre-knob behavior)
     assert served(PEN, enable=False) == naive(0.0)
+
+
+def test_frequency_penalty_survives_abort_resume():
+    """One logical request across a weight-update abort: the resumed half
+    must continue penalizing the tokens emitted BEFORE the abort — the
+    whole stream equals the uninterrupted penalized stream."""
+    import threading
+    import time
+
+    import jax
+
+    from areal_tpu.api.config import MeshConfig, ServerConfig
+    from areal_tpu.api.io_struct import (
+        GenerationHyperparameters,
+        ModelRequest,
+        StopReason,
+    )
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.models import qwen
+
+    cfg = qwen.ModelConfig(
+        vocab_size=64,
+        hidden_size=32,
+        intermediate_size=64,
+        num_layers=1,
+        num_heads=2,
+        num_kv_heads=2,
+        dtype="float32",
+        tie_word_embeddings=True,
+    )
+    eng = DecodeEngine(
+        ServerConfig(
+            max_batch_size=2,
+            max_seq_len=64,
+            decode_steps_per_call=4,
+            seed=0,
+            enable_frequency_penalty=True,
+            mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        ),
+        params=qwen.init_params(jax.random.PRNGKey(0), cfg),
+        model_cfg=cfg,
+    )
+    eng.initialize()
+    eng.start()
+    try:
+        prompt = [1, 2, 3]
+        g = GenerationHyperparameters(
+            max_new_tokens=20, greedy=True, frequency_penalty=5.0
+        )
+        uninterrupted = eng.generate_sync(
+            ModelRequest(input_ids=prompt, gconfig=g), timeout=240
+        ).output_tokens
+
+        box, ev = [], threading.Event()
+        eng.submit(
+            ModelRequest(input_ids=prompt, rid="fp-resume", gconfig=g),
+            lambda r: (box.append(r), ev.set()),
+        )
+        time.sleep(0.25)
+        eng.pause_generation()
+        assert ev.wait(120)
+        first = box[0]
+        assert first.stop_reason == StopReason.ABORT.value
+        assert 0 < len(first.output_tokens) < 20
+        eng.continue_generation()
+        resumes = eng.stats["kv_resumes"]
+        second = eng.generate_sync(
+            ModelRequest(
+                input_ids=prompt + first.output_tokens,
+                rid="fp-resume",
+                gconfig=g.new(max_new_tokens=20 - len(first.output_tokens)),
+            ),
+            timeout=240,
+        )
+        assert eng.stats["kv_resumes"] == resumes + 1
+        assert first.output_tokens + second.output_tokens == uninterrupted
+    finally:
+        eng.stop()
