@@ -211,3 +211,55 @@ def test_weight_update_wire_resolution():
     cfg.weight_update_wire = "int8"  # the natural typo
     with _pytest.raises(ValueError, match="ServerConfig.quantization"):
         resolve_weight_update_wire(cfg)
+
+
+def test_tree_preset_trains_through_tree_kernel():
+    """VERDICT r04 #3 done-bar, literally: the gsm8k_grpo_tree preset's
+    actor config (tinyified runtime fields only) drives ppo_update THROUGH
+    the tree path and reports the node-dedup ratio."""
+    from areal_tpu.api.config import OptimizerConfig
+
+    cfg = dataclasses.replace(
+        _load("gsm8k_grpo_tree.yaml").actor,
+        path="",
+        init_from_scratch=True,
+        dtype="float32",
+        param_dtype="float32",
+        gradient_checkpointing=False,
+        bucket_step=32,
+        tree_node_budget=512,
+        tree_node_bucket=128,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        optimizer=OptimizerConfig(lr=1e-3, lr_scheduler_type="constant"),
+        group_size=4,
+    )
+    assert cfg.tree_training  # the preset's own switch, not test-injected
+    eng = JaxTrainEngine(cfg, model_config=TINY_QWEN2)
+    eng.initialize(FinetuneSpec(1, 64, 4))
+    actor = PPOActor(cfg, eng)
+    rng = np.random.default_rng(11)
+    n, L, P = 8, 28, 12
+    ids = np.zeros((n, L), np.int32)
+    for g in range(2):  # groups share their prompt (the dedup win)
+        prompt = rng.integers(1, 250, P)
+        for j in range(4):
+            ids[g * 4 + j, :P] = prompt
+            ids[g * 4 + j, P:] = rng.integers(1, 250, L - P)
+    lm = np.zeros((n, L), np.float32)
+    lm[:, P:] = 1.0
+    batch = {
+        "input_ids": ids,
+        "attention_mask": np.ones((n, L), bool),
+        "loss_mask": lm,
+        "logprobs": rng.normal(-1.5, 0.2, (n, L)).astype(np.float32),
+        "versions": np.zeros((n, L), np.int32),
+        "rewards": rng.normal(0.5, 1.0, (n,)).astype(np.float32),
+        "seq_no_eos_mask": np.zeros((n,), bool),
+    }
+    if actor.should_compute_prox_logp():
+        batch["prox_logp"] = actor.compute_logp(batch)
+    adv = actor.compute_advantages(batch)
+    stats = actor.ppo_update(adv)
+    assert np.isfinite(stats[0]["loss"])
+    assert stats[0]["tree_dedup_ratio"] > 1.2
+    eng.destroy()
