@@ -384,6 +384,22 @@ class SessionTracerConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """Unified telemetry layer (observability/): metrics exposition,
+    controller-side fleet aggregation, and the obs dashboard cadence."""
+
+    enabled: bool = True
+    # controller-side fleet aggregation: scrape every inference server's
+    # /metrics this often and serve the merged series + /healthz//statusz
+    scrape_interval_s: float = 5.0
+    scrape_timeout_s: float = 2.0
+    scrape_retries: int = 1  # extra attempts per target per round
+    # controller telemetry endpoint port (0 = pick a free port)
+    export_port: int = 0
+    dashboard_refresh_s: float = 2.0  # tools/obs_dashboard.py redraw period
+
+
+@dataclass
 class PerfTracerConfig:
     enabled: bool = False
     output_dir: str | None = None
@@ -432,6 +448,7 @@ class BaseExperimentConfig:
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     launcher: LauncherConfig = field(default_factory=LauncherConfig)
     perf_tracer: PerfTracerConfig = field(default_factory=PerfTracerConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
 
 @dataclass

@@ -106,8 +106,11 @@ class Scheduler(abc.ABC):
     def call_engine(
         self, worker: Worker, method: str, *args: Any, **kwargs: Any
     ) -> Any:
-        """Blocking engine method call on one worker."""
+        """Blocking engine method call on one worker. The caller's trace
+        context (perf_tracer task/session ids) rides the x-areal-trace
+        header so worker-side spans join the controller's timeline."""
         from areal_tpu.infra.rpc.serialization import decode_value, encode_value
+        from areal_tpu.observability import tracecontext
         from areal_tpu.utils.network import http_json as _http_json
 
         d = _http_json(
@@ -118,6 +121,7 @@ class Scheduler(abc.ABC):
                 "args": [encode_value(a) for a in args],
                 "kwargs": {k: encode_value(v) for k, v in kwargs.items()},
             },
+            headers=tracecontext.inject(),
         )
         if d["status"] != "ok":
             raise RuntimeError(f"{worker.id}.{method}: {d.get('error')}")

@@ -24,6 +24,7 @@ from areal_tpu.api.config import InferenceEngineConfig
 from areal_tpu.api.engine_api import InferenceEngine
 from areal_tpu.api.io_struct import ModelRequest, ModelResponse, StopReason, WeightUpdateMeta
 from areal_tpu.infra.workflow_executor import WorkflowExecutor
+from areal_tpu.observability import catalog, tracecontext
 from areal_tpu.utils import logging as alog, name_resolve
 from areal_tpu.utils.data import TensorDict
 
@@ -80,6 +81,7 @@ class RemoteJaxEngine(InferenceEngine):
         self.executor = WorkflowExecutor(config, engine=self)
         self._paused = False
         self.last_pause_secs = 0.0  # last weight-update availability gap
+        self._metrics = catalog.client_metrics()
 
     # -- discovery / lifecycle -------------------------------------------
     def initialize(self, addresses: list[str] | None = None, timeout: float | None = None) -> None:
@@ -222,7 +224,10 @@ class RemoteJaxEngine(InferenceEngine):
         while True:
             try:
                 d = await self._get_json(addr, "/metrics")
-                if not d.get("paused"):
+                # server_paused is the server's authoritative boolean;
+                # "paused" is kept as a fallback for pre-observability
+                # servers (and may be an engine stat on new ones)
+                if not d.get("server_paused", d.get("paused")):
                     return
             except Exception:  # noqa: BLE001 — server mid-restart
                 pass
@@ -230,10 +235,13 @@ class RemoteJaxEngine(InferenceEngine):
 
     async def _post_json(self, addr: str, path: str, payload: dict) -> dict:
         last_exc = None
+        headers = tracecontext.inject()
         for attempt in range(self.config.request_retries):
             try:
                 sess = _get_session(self.config.request_timeout)
-                async with sess.post(f"http://{addr}{path}", json=payload) as r:
+                async with sess.post(
+                    f"http://{addr}{path}", json=payload, headers=headers
+                ) as r:
                     r.raise_for_status()
                     return await r.json()
             except Exception as e:  # noqa: BLE001
@@ -241,11 +249,34 @@ class RemoteJaxEngine(InferenceEngine):
                 await asyncio.sleep(0.2 * 2**attempt)
         raise RuntimeError(f"POST {addr}{path} failed after retries") from last_exc
 
-    async def _get_json(self, addr: str, path: str) -> dict:
-        sess = _get_session(self.config.request_timeout)
-        async with sess.get(f"http://{addr}{path}") as r:
-            r.raise_for_status()
-            return await r.json()
+    # metric scrapes must not inherit the hour-scale generation timeout: a
+    # dead server would park the caller (the pause-wait loop, the fleet
+    # aggregator) for request_timeout seconds per probe
+    _SCRAPE_TIMEOUT_S = 5.0
+
+    async def _get_json(
+        self, addr: str, path: str, timeout: float | None = None
+    ) -> dict:
+        """GET with a short timeout and a single retry with backoff, so one
+        dead server cannot stall a scrape/poll loop."""
+        timeout = timeout or min(
+            self._SCRAPE_TIMEOUT_S, self.config.request_timeout
+        )
+        last_exc: Exception | None = None
+        for attempt in range(2):  # initial try + one retry
+            try:
+                sess = _get_session(timeout)
+                async with sess.get(
+                    f"http://{addr}{path}", headers=tracecontext.inject()
+                ) as r:
+                    r.raise_for_status()
+                    return await r.json()
+            except Exception as e:  # noqa: BLE001
+                last_exc = e
+                if attempt == 0:
+                    self._metrics.scrape_retries.inc()
+                    await asyncio.sleep(0.2)
+        raise RuntimeError(f"GET {addr}{path} failed after retry") from last_exc
 
     def _post_all(self, path: str, payload: dict) -> list[dict]:
         """Synchronous fan-out to every server (weight updates, pause)."""
@@ -338,6 +369,9 @@ class RemoteJaxEngine(InferenceEngine):
             finally:
                 self.continue_generation()
             self.last_pause_secs = time.monotonic() - t0
+            self._metrics.updates.inc()
+            self._metrics.update_bytes.inc(len(body))
+            self._metrics.pause_seconds.observe(self.last_pause_secs)
             logger.info(
                 f"lora weight update v{version} pause window "
                 f"{self.last_pause_secs:.2f}s ({len(body)} bytes)"
@@ -374,6 +408,8 @@ class RemoteJaxEngine(InferenceEngine):
             if enc_pool is not None:
                 enc_pool.shutdown(wait=False)
         self.last_pause_secs = time.monotonic() - t0
+        self._metrics.updates.inc()
+        self._metrics.pause_seconds.observe(self.last_pause_secs)
         logger.info(
             f"weight update v{version} pause window {self.last_pause_secs:.2f}s"
         )
@@ -495,6 +531,7 @@ class RemoteJaxEngine(InferenceEngine):
                     body = nxt.result()
                     if i + 1 < len(buckets):
                         nxt = enc_pool.submit(self._encode_bucket, buckets[i + 1])
+                    self._metrics.update_bytes.inc(len(body))
                     send(body)
             except Exception:
                 # a failed stream must not leave partial buckets pinning

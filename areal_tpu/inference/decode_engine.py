@@ -48,6 +48,7 @@ from areal_tpu.api.config import ServerConfig
 from areal_tpu.api.io_struct import ModelRequest, ModelResponse, StopReason
 from areal_tpu.models import qwen
 from areal_tpu.models.hf import load_params_from_hf
+from areal_tpu.observability import catalog as obs_catalog
 from areal_tpu.parallel import mesh as mesh_lib
 from areal_tpu.utils import logging as alog
 from areal_tpu.utils.data import round_up_to_bucket
@@ -247,6 +248,9 @@ class DecodeEngine:
             "prefills": 0,
             "prefill_batches": 0,
         }
+        # registry counters mirror the hot stats (thread-sharded: the
+        # decode thread increments contention-free; scrapes sum shards)
+        self._obs = obs_catalog.engine_metrics()
 
     # -- lifecycle --------------------------------------------------------
     def initialize(self) -> None:
@@ -1638,6 +1642,7 @@ class DecodeEngine:
             )
         self.stats["prefills"] += A
         self.stats["prefill_batches"] += 1
+        self._obs.prefills.inc(A)
         return rows
 
     def _apply_slot_updates(self, rows: list[np.ndarray]) -> None:
@@ -1688,8 +1693,10 @@ class DecodeEngine:
         )
         if reason == StopReason.ABORT.value:
             self.stats["aborted"] += 1
+            self._obs.aborted.inc()
         else:
             self.stats["completed"] += 1
+            self._obs.completed.inc()
         try:
             task.callback(resp)
         except Exception:
@@ -1928,6 +1935,7 @@ class DecodeEngine:
                 task.out_logprobs.extend(logps[:c, slot].tolist())
                 task.out_versions.extend([version] * c)
                 self.stats["generated_tokens"] += c
+                self._obs.generated_tokens.inc(c)
             st["pos"][slot] = int(pos[slot])
             st["ids"][slot] = int(toks[c - 1, slot]) if c else st["ids"][slot]
             st["remaining"][slot] -= c
@@ -1945,6 +1953,7 @@ class DecodeEngine:
                     reason = StopReason.LENGTH.value
                 self._finish(task, reason)
         self.stats["chunks"] += 1
+        self._obs.chunks.inc()
 
     def _loop(self) -> None:
         pending: dict | None = None

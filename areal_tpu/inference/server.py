@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 
 import numpy as np
 from aiohttp import web
@@ -20,8 +21,10 @@ from aiohttp import web
 from areal_tpu.api.config import ServerConfig
 from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
 from areal_tpu.inference.decode_engine import DecodeEngine
+from areal_tpu.observability import catalog, tracecontext
+from areal_tpu.observability.metrics import get_registry
 from areal_tpu.utils import logging as alog, network
-from areal_tpu.utils import name_resolve
+from areal_tpu.utils import name_resolve, perf_tracer
 
 logger = alog.getLogger("inference_server")
 
@@ -68,6 +71,10 @@ class InferenceServer:
         self._runner: web.AppRunner | None = None
         self.port = config.port or network.find_free_port()
         self.host = config.host
+        self._metrics = catalog.server_metrics()
+        self._engine_obs = catalog.engine_metrics()
+        self._started_at = time.time()
+        self._update_begin_ts: float | None = None
 
     @property
     def address(self) -> str:
@@ -79,6 +86,8 @@ class InferenceServer:
         app.add_routes(
             [
                 web.get("/health", self.h_health),
+                web.get("/healthz", self.h_health),
+                web.get("/statusz", self.h_statusz),
                 web.get("/metrics", self.h_metrics),
                 web.post("/generate", self.h_generate),
                 web.post("/pause_generation", self.h_pause),
@@ -104,12 +113,66 @@ class InferenceServer:
             {"status": "ok", "version": self.engine.get_version()}
         )
 
+    def _refresh_gauges(self) -> None:
+        """Point-in-time engine state -> registry gauges (scrape-driven;
+        the hot decode loop never touches these)."""
+        m = self._metrics
+        m.paused.set(1.0 if self.engine.is_paused else 0.0)
+        q = getattr(self.engine, "_queue", None)
+        backlog = getattr(self.engine, "_backlog", ())
+        m.queue_depth.set(
+            (q.qsize() if q is not None else 0) + len(backlog)
+        )
+        slots = getattr(self.engine, "_slot_task", None)
+        if slots is not None:
+            self._engine_obs.batch_occupancy.set(
+                sum(1 for t in slots if t is not None)
+            )
+
     async def h_metrics(self, request: web.Request) -> web.Response:
+        """Content-negotiated metrics.
+
+        Default (and ``Accept: application/json``) keeps the legacy JSON
+        shape for existing callers (client._await_unpaused and older
+        scrapers); ``Accept: text/plain`` serves the Prometheus text
+        exposition of the process registry.
+        """
+        self._refresh_gauges()
+        accept = request.headers.get("Accept", "")
+        if "text/plain" in accept:
+            return web.Response(
+                text=get_registry().render_prometheus(),
+                content_type="text/plain",
+                charset="utf-8",
+            )
+        # the server's pause state gets its OWN key (server_paused) so an
+        # engine-provided "paused" stat is never clobbered; "paused" keeps
+        # the legacy boolean shape unless the engine claims the name (the
+        # pause-wait client polls server_paused first — client.py)
+        out = dict(self.engine.stats)
+        out["server_paused"] = self.engine.is_paused
+        out.setdefault("paused", self.engine.is_paused)
+        return web.json_response(out)
+
+    async def h_statusz(self, request: web.Request) -> web.Response:
+        """Human/ops summary: identity, uptime, version, live state."""
+        self._refresh_gauges()
         return web.json_response(
-            {**self.engine.stats, "paused": self.engine.is_paused}
+            {
+                "role": "inference_server",
+                "address": self.address,
+                "uptime_secs": time.time() - self._started_at,
+                "version": self.engine.get_version(),
+                "paused": self.engine.is_paused,
+                "stats": dict(self.engine.stats),
+            }
         )
 
     async def h_generate(self, request: web.Request) -> web.Response:
+        # trace context rides x-areal-trace from the rollout client so this
+        # server's spans correlate with the submitting workflow's session
+        tracecontext.extract(request.headers)
+        self._metrics.requests.labels(endpoint="generate").inc()
         d = await request.json()
         req = _req_from_json(d)
         loop = asyncio.get_running_loop()
@@ -120,8 +183,17 @@ class InferenceServer:
                 lambda: fut.done() or fut.set_result(resp)
             )
 
-        self.engine.submit(req, cb)
-        resp = await fut
+        async with perf_tracer.atrace_scope(
+            "server.generate", perf_tracer.Category.COMPUTE, {"rid": req.rid}
+        ):
+            self.engine.submit(req, cb)
+            resp = await fut
+        # only requests that actually emitted a token have a TTFT; aborted
+        # ones report submit->abort time, which would skew the histogram
+        # with pause-wait durations
+        if resp.output_tokens:
+            self._metrics.ttft.observe(resp.ttft)
+        self._metrics.request_latency.observe(resp.latency)
         return web.json_response(
             {
                 "output_tokens": resp.output_tokens,
@@ -135,10 +207,12 @@ class InferenceServer:
         )
 
     async def h_pause(self, request: web.Request) -> web.Response:
+        self._metrics.pauses.inc()
         self.engine.pause_generation()
         return web.json_response({"status": "ok"})
 
     async def h_continue(self, request: web.Request) -> web.Response:
+        self._metrics.resumes.inc()
         self.engine.continue_generation()
         return web.json_response({"status": "ok"})
 
@@ -170,6 +244,7 @@ class InferenceServer:
         return web.json_response({"status": "ok", "version": self.engine.get_version()})
 
     async def h_update_begin(self, request: web.Request) -> web.Response:
+        self._update_begin_ts = time.monotonic()
         self.engine.begin_staged_update()
         return web.json_response({"status": "ok"})
 
@@ -186,6 +261,7 @@ class InferenceServer:
         the response acks only after the local stage AND every subtree ack
         (the commit barrier stays correct)."""
         body = await request.read()
+        self._metrics.update_bucket_bytes.inc(len(body))
         relay = [a for a in request.headers.get("X-Areal-Relay", "").split(",") if a]
         forwards = []
         if relay:
@@ -231,6 +307,11 @@ class InferenceServer:
         await asyncio.get_running_loop().run_in_executor(
             None, self.engine.commit_staged_weights, d.get("version")
         )
+        if self._update_begin_ts is not None:
+            self._metrics.update_stage_seconds.observe(
+                time.monotonic() - self._update_begin_ts
+            )
+            self._update_begin_ts = None
         return web.json_response({"status": "ok", "version": self.engine.get_version()})
 
     async def h_update_abort(self, request: web.Request) -> web.Response:

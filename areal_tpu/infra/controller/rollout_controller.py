@@ -30,8 +30,10 @@ class RolloutController:
         replicas: int = 1,
         worker_env: dict[str, str] | None = None,
         proxy_engine_path: str = "",
+        telemetry=None,  # TelemetryConfig | None: auto-start fleet scraping
     ):
         self.scheduler = scheduler
+        self.telemetry_config = telemetry
         self.engine_path = engine_path
         # alternative engine import path for config-auto-started proxy
         # workers ("" = discover real inference servers via name_resolve)
@@ -59,6 +61,12 @@ class RolloutController:
         self._cb_order: "_deque[str]" = _deque()  # bound for never-awaited ids
         self._cb_thread = None
         self._cb_server = None
+        # fleet telemetry (start_telemetry): scrape loop + HTTP endpoint
+        self._telemetry_thread = None
+        self._telemetry_server = None
+        self._telemetry_stop = None
+        self._aggregator = None
+        self.telemetry_url: str | None = None
 
     # -- lifecycle --------------------------------------------------------
     def initialize(self, config, addresses: list[str] | None = None) -> None:
@@ -72,6 +80,7 @@ class RolloutController:
         # .openai): a non-None openai sub-config starts the per-worker
         # proxies + gateway as part of bringup; needs a tokenizer path
         # (experiment-level tokenizer_path)
+        self._maybe_start_config_telemetry(config)
         ocfg = getattr(config, "openai", None)
         tok = getattr(config, "tokenizer_path", "")
         if ocfg is not None:
@@ -85,6 +94,7 @@ class RolloutController:
             self.start_gateway()
 
     def destroy(self) -> None:
+        self.stop_telemetry()
         self.disable_completion_callbacks()
         self.stop_gateway()
         if self.proxy_workers:
@@ -379,6 +389,197 @@ class RolloutController:
             batch = next(self._data_iter)
             items.extend(batch if isinstance(batch, list) else [batch])
         return self.rollout_batch(items[:bs], workflow, **kw)
+
+    # -- fleet telemetry ---------------------------------------------------
+    # The controller is the natural aggregation point: it already knows the
+    # inference-server fleet. start_telemetry scrapes every server's
+    # /metrics on a fixed cadence, merges the fleet into cluster-level
+    # series (observability.aggregator), and serves /metrics (merged
+    # Prometheus text), /healthz, and /statusz from one endpoint that the
+    # obs dashboard and any external Prometheus can point at.
+    def _maybe_start_config_telemetry(self, config=None) -> None:
+        """Config-driven bringup: a TelemetryConfig passed at construction
+        (BaseExperimentConfig.telemetry) starts the scrape loop + aggregated
+        /metrics//healthz//statusz as part of initialize(). In the
+        discovery path (no explicit addresses) the server fleet is resolved
+        from name_resolve using the engine config's experiment/trial names."""
+        tcfg = self.telemetry_config
+        if tcfg is None or not tcfg.enabled:
+            return
+        targets = list(self._server_addresses)
+        if not targets and config is not None:
+            exp = getattr(config, "experiment_name", "")
+            trial = getattr(config, "trial_name", "")
+            if exp and trial:
+                from areal_tpu.utils import name_resolve
+
+                try:
+                    targets = name_resolve.get_subtree(
+                        name_resolve.rollout_server_key(exp, trial)
+                    )
+                except Exception:  # noqa: BLE001 — backend may be absent
+                    targets = []
+        if not targets:
+            logger.warning(
+                "telemetry enabled but no inference-server addresses known "
+                "(none passed, none discoverable) — fleet scraping not "
+                "started; call start_telemetry(targets=...) manually"
+            )
+            return
+        self.start_telemetry(
+            targets=targets,
+            port=tcfg.export_port,
+            interval=tcfg.scrape_interval_s,
+            timeout=tcfg.scrape_timeout_s,
+            retries=tcfg.scrape_retries,
+        )
+
+    def start_telemetry(
+        self,
+        targets: list[str] | None = None,
+        port: int = 0,
+        interval: float = 5.0,
+        timeout: float = 2.0,
+        retries: int = 1,
+    ) -> str:
+        import json as _json
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from areal_tpu.observability.aggregator import FleetAggregator
+        from areal_tpu.utils.network import find_free_port, gethostip
+
+        assert self._telemetry_thread is None, "telemetry already running"
+        # default target set: the inference servers AND the RPC rollout
+        # workers — the staleness/executor/weight-update-client families
+        # live in the worker processes, whose rpc_server also serves
+        # /metrics. (The direct PPOTrainer topology has no trainer-side
+        # exposition endpoint yet; its families are registry-local there.)
+        targets = list(
+            targets
+            or (self._server_addresses + [w.address for w in self.workers])
+        )
+        port = port or find_free_port()
+        agg = FleetAggregator(targets, timeout=timeout, retries=retries)
+        self._aggregator = agg
+        stop = threading.Event()
+        self._telemetry_stop = stop
+        started_at = time.time()
+        ctl = self
+
+        def scrape_loop():
+            while not stop.is_set():
+                try:
+                    agg.scrape_once()
+                except Exception:  # noqa: BLE001 — keep the loop alive
+                    logger.exception("fleet scrape round failed")
+                stop.wait(interval)
+
+        class Handler(BaseHTTPRequestHandler):
+            def _reply(self, body: bytes, ctype: str, status: int = 200):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                snap = agg.latest()
+                path = self.path.split("?")[0]
+                if path == "/metrics":
+                    from areal_tpu.observability.metrics import get_registry
+
+                    # merged fleet series + the aggregator's own scrape-
+                    # health families (which only exist in this process)
+                    text = (snap.render_prometheus() if snap else "") + (
+                        get_registry().render_prometheus(
+                            name_prefix="areal_fleet_"
+                        )
+                    )
+                    self._reply(text.encode(), "text/plain; charset=utf-8")
+                elif path == "/healthz":
+                    n = len(targets)
+                    if snap is None:
+                        # first scrape round still in flight — not degraded;
+                        # a readiness probe at bringup must not see a 503
+                        self._reply(
+                            _json.dumps(
+                                {
+                                    "status": "initializing",
+                                    "targets_up": 0,
+                                    "targets_total": n,
+                                }
+                            ).encode(),
+                            "application/json",
+                        )
+                        return
+                    healthy = n == 0 or snap.n_up == n
+                    self._reply(
+                        _json.dumps(
+                            {
+                                "status": "ok" if healthy else "degraded",
+                                "targets_up": snap.n_up,
+                                "targets_total": n,
+                            }
+                        ).encode(),
+                        "application/json",
+                        200 if healthy else 503,
+                    )
+                elif path == "/statusz":
+                    self._reply(
+                        _json.dumps(
+                            {
+                                "role": "rollout_controller",
+                                "uptime_secs": time.time() - started_at,
+                                "version": ctl._version,
+                                "n_workers": len(ctl.workers),
+                                "targets": [
+                                    {
+                                        "target": t.target,
+                                        "up": t.up,
+                                        "error": t.error,
+                                        "scraped_at": t.scraped_at,
+                                    }
+                                    for t in (snap.targets if snap else [])
+                                ],
+                                "scraped_at": snap.scraped_at if snap else None,
+                            }
+                        ).encode(),
+                        "application/json",
+                    )
+                else:
+                    self._reply(b"not found", "text/plain", 404)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._telemetry_server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        threading.Thread(
+            target=self._telemetry_server.serve_forever, daemon=True
+        ).start()
+        self._telemetry_thread = threading.Thread(
+            target=scrape_loop, daemon=True
+        )
+        self._telemetry_thread.start()
+        self.telemetry_url = f"http://{gethostip()}:{port}"
+        logger.info(
+            f"fleet telemetry at {self.telemetry_url} over {len(targets)} "
+            "targets"
+        )
+        return self.telemetry_url
+
+    def stop_telemetry(self) -> None:
+        if self._telemetry_thread is not None:
+            self._telemetry_stop.set()
+            self._telemetry_server.shutdown()
+            self._telemetry_server.server_close()
+            self._telemetry_thread.join(timeout=10)
+            self._telemetry_thread = None
+            self._telemetry_server = None
+            self._telemetry_stop = None
+            self._aggregator.close()
+            self._aggregator = None
+            self.telemetry_url = None
 
     # -- fleet control ----------------------------------------------------
     def pause(self) -> None:

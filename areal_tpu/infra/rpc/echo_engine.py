@@ -46,6 +46,32 @@ class EchoEngine:
     def env(self, key: str) -> str | None:
         return os.environ.get(key)
 
+    def trace_context(self) -> dict:
+        """Report the perf-tracer ContextVars as seen on the engine thread
+        (observability tests: x-areal-trace must survive the RPC hop AND
+        the handler->engine-thread handoff)."""
+        from areal_tpu.utils import perf_tracer
+
+        task_id, session_id = perf_tracer.get_task_context()
+        return {"task_id": task_id, "session_id": session_id}
+
+    def traced_work(self, output_dir: str, name: str = "worker.work") -> str:
+        """Record one perf span in THIS process under the propagated trace
+        context and flush the trace file; returns its path. The two-process
+        Perfetto-correlation test merges it with the caller's trace."""
+        from areal_tpu.api.config import PerfTracerConfig
+        from areal_tpu.utils import perf_tracer
+
+        perf_tracer.configure(
+            PerfTracerConfig(enabled=True, output_dir=output_dir),
+            rank=0,
+            role="worker",
+        )
+        with perf_tracer.trace_scope(name):
+            pass
+        perf_tracer.save(force=True)
+        return perf_tracer.get_tracer()._path()
+
 
 class FakeInferenceEngine:
     """Importable inference stub with ``agenerate`` (deterministic token

@@ -21,16 +21,20 @@ import path).
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import importlib
 import os
 import queue
 import threading
+import time
 import traceback
 from typing import Any
 
 from aiohttp import web
 
 from areal_tpu.infra.rpc.serialization import decode_value, encode_value
+from areal_tpu.observability import catalog, tracecontext
+from areal_tpu.observability.metrics import get_registry
 from areal_tpu.utils import logging as alog, network
 
 logger = alog.getLogger("rpc_server")
@@ -63,7 +67,10 @@ class _EngineThread:
     async def call(self, fn) -> Any:
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._q.put((fn, fut, loop))
+        # carry the handler's ContextVars (x-areal-trace task/session ids)
+        # onto the engine thread so engine-side perf spans stay correlated
+        ctx = contextvars.copy_context()
+        self._q.put((lambda: ctx.run(fn), fut, loop))
         return await fut
 
     def stop(self) -> None:
@@ -79,6 +86,7 @@ class RpcWorkerServer:
         self._engine_thread = _EngineThread()
         self._runner: web.AppRunner | None = None
         self._stop_event = asyncio.Event()
+        self._metrics = catalog.rpc_metrics()
 
     @property
     def address(self) -> str:
@@ -90,6 +98,8 @@ class RpcWorkerServer:
         app.add_routes(
             [
                 web.get("/health", self.h_health),
+                web.get("/healthz", self.h_health),
+                web.get("/metrics", self.h_metrics),
                 web.post("/configure", self.h_configure),
                 web.post("/create_engine", self.h_create_engine),
                 web.post("/call", self.h_call),
@@ -132,6 +142,17 @@ class RpcWorkerServer:
         logger.info(f"created engine {name} = {path}")
         return web.json_response({"status": "ok"})
 
+    async def h_metrics(self, request: web.Request) -> web.Response:
+        """Worker-process registry: Prometheus text (default) or JSON."""
+        reg = get_registry()
+        if "application/json" in request.headers.get("Accept", ""):
+            return web.json_response(reg.render_json())
+        return web.Response(
+            text=reg.render_prometheus(),
+            content_type="text/plain",
+            charset="utf-8",
+        )
+
     async def h_call(self, request: web.Request) -> web.Response:
         d = await request.json()
         name, method = d["name"], d["method"]
@@ -139,15 +160,34 @@ class RpcWorkerServer:
             return web.json_response(
                 {"status": "error", "error": f"no engine {name!r}"}, status=404
             )
+        # seat the caller's trace context before the engine runs; the
+        # _EngineThread copies this handler context onto its own thread
+        tracecontext.extract(request.headers)
         engine = self.engines[name]
+        # validate the method BEFORE minting metric labels: label values
+        # come from the wire, and unknown names would otherwise grow the
+        # per-method families without bound
+        fn = getattr(engine, method, None)
+        if not callable(fn):
+            self._metrics.errors.labels(method="_unknown").inc()
+            return web.json_response(
+                {"status": "error", "error": f"no method {method!r}"},
+                status=404,
+            )
         args = [decode_value(a) for a in d.get("args", [])]
         kwargs = {k: decode_value(v) for k, v in d.get("kwargs", {}).items()}
+        self._metrics.requests.labels(method=method).inc()
+        t0 = time.monotonic()
         try:
-            fn = getattr(engine, method)
             result = await self._engine_thread.call(lambda: fn(*args, **kwargs))
         except Exception as e:  # noqa: BLE001
+            self._metrics.errors.labels(method=method).inc()
             return web.json_response(
                 {"status": "error", "error": str(e)}, status=500
+            )
+        finally:
+            self._metrics.latency.labels(method=method).observe(
+                time.monotonic() - t0
             )
         return web.json_response({"status": "ok", "result": encode_value(result)})
 

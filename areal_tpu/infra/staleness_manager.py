@@ -18,6 +18,7 @@ import threading
 from typing import Protocol
 
 from areal_tpu.api.io_struct import RolloutStat
+from areal_tpu.observability import catalog
 
 
 class VersionProvider(Protocol):
@@ -38,6 +39,7 @@ class StalenessManager:
         self.max_staleness = max_staleness
         self._lock = threading.Lock()
         self.stat = RolloutStat()
+        self._metrics = catalog.staleness_metrics()
 
     def get_capacity(self) -> int:
         with self._lock:
@@ -48,23 +50,35 @@ class StalenessManager:
                 - self.stat.accepted
                 - self.stat.running
             )
-            return min(concurrency_cap, staleness_cap)
+            capacity = min(concurrency_cap, staleness_cap)
+            self._metrics.capacity.set(capacity)
+            self._metrics.running.set(self.stat.running)
+            return capacity
 
     # -- accounting (called by the dispatcher) ----------------------------
     def on_submit(self, n: int = 1) -> None:
         with self._lock:
             self.stat.submitted += n
             self.stat.running += n
+        self._metrics.submitted.inc(n)
 
     def on_accept(self, n: int = 1) -> None:
         with self._lock:
             self.stat.running -= n
             self.stat.accepted += n
+        self._metrics.accepted.inc(n)
 
     def on_reject(self, n: int = 1) -> None:
         with self._lock:
             self.stat.running -= n
             self.stat.rejected += n
+        self._metrics.rejected.inc(n)
+
+    def observe_version_lag(self, lag: int) -> None:
+        """Record an accepted trajectory's version lag (current policy
+        version minus the oldest per-token version in the trajectory) —
+        the drifting-version-mix signal the staleness bound exists for."""
+        self._metrics.version_lag.observe(max(0, lag))
 
     def export_stats(self) -> dict[str, int]:
         with self._lock:

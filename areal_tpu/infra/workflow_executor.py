@@ -26,6 +26,7 @@ from areal_tpu.api.config import InferenceEngineConfig
 from areal_tpu.api.workflow_api import RolloutWorkflow, resolve_workflow
 from areal_tpu.infra.async_task_runner import AsyncTaskRunner
 from areal_tpu.infra.staleness_manager import StalenessManager
+from areal_tpu.observability import catalog
 from areal_tpu.utils import logging as alog
 from areal_tpu.utils.data import TensorDict, concat_padded_tensor_dicts, cycle_dataloader
 from areal_tpu.utils import stats_tracker
@@ -51,7 +52,7 @@ def check_trajectory_format(traj: TensorDict) -> None:
 
 
 class _TaskRecord:
-    __slots__ = ("task_id", "data", "result", "accepted", "is_eval")
+    __slots__ = ("task_id", "data", "result", "accepted", "is_eval", "submit_ts")
 
     def __init__(self, task_id: str, data: Any, is_eval: bool = False):
         self.task_id = task_id
@@ -59,6 +60,7 @@ class _TaskRecord:
         self.result: TensorDict | None = None
         self.accepted: bool | None = None
         self.is_eval = is_eval
+        self.submit_ts = time.monotonic()
 
 
 class WorkflowExecutor:
@@ -104,6 +106,8 @@ class WorkflowExecutor:
         self._data_gen = None  # cached cycle_dataloader for prepare_batch
         # optional: attach a tokenizer to get decoded text in trajectory dumps
         self.tokenizer = None
+        self._obs = catalog.executor_metrics()
+        self._inflight = 0  # launched, not yet completed (dispatcher-only)
 
     # -- lifecycle --------------------------------------------------------
     def initialize(self) -> None:
@@ -154,8 +158,15 @@ class WorkflowExecutor:
                 res = self.runner.poll_result(timeout=0.02)
                 while res is not None:
                     progressed = True
+                    self._inflight -= 1
                     self._on_result(res.task_id, res.data)
                     res = self.runner.poll_result()
+                # queue-depth gauges: cheap last-writer-wins sets on every
+                # loop turn so a scrape always sees a fresh picture
+                self._obs.input_depth.set(self._input.qsize())
+                self._obs.eval_depth.set(self._input_eval.qsize())
+                self._obs.inflight.set(self._inflight)
+                self._obs.results_buffered.set(len(self._results))
                 if not progressed:
                     time.sleep(0.005)
         except BaseException as e:  # noqa: BLE001 — fail fast to callers
@@ -165,6 +176,9 @@ class WorkflowExecutor:
                 self._cv.notify_all()
 
     def _launch(self, rec: _TaskRecord, workflow: RolloutWorkflow, accept_fn) -> None:
+        self._obs.dispatch_latency.observe(time.monotonic() - rec.submit_ts)
+        self._inflight += 1
+
         async def run():
             from areal_tpu.infra import workflow_context
             from areal_tpu.utils import perf_tracer
@@ -206,6 +220,14 @@ class WorkflowExecutor:
         if accepted:
             if not is_eval:
                 self.staleness.on_accept()
+                if "versions" in traj:
+                    versions = np.asarray(traj["versions"])
+                    vmask = versions >= 0
+                    if vmask.any():
+                        self.staleness.observe_version_lag(
+                            int(self.engine.get_version())
+                            - int(versions[vmask].min())
+                        )
             with counter_cm:
                 tracker.scalar(rollout_accepted=1.0)
             if self.config.dump_trajectories:

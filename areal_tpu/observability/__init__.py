@@ -1,0 +1,24 @@
+"""Unified telemetry layer: metrics registry, Prometheus exposition,
+cross-RPC trace propagation, and fleet aggregation.
+
+Entry points:
+    metrics.get_registry()      the process-wide Registry
+    catalog.*_metrics()         per-layer metric family handles
+    tracecontext.inject/extract x-areal-trace header propagation
+    aggregator.FleetAggregator  controller-side /metrics fleet merge
+
+See docs/observability.md for the full metric catalog and wire formats.
+"""
+
+from areal_tpu.observability.metrics import (  # noqa: F401
+    Registry,
+    get_registry,
+    parse_prometheus_text,
+)
+from areal_tpu.observability.tracecontext import (  # noqa: F401
+    TRACE_HEADER,
+    apply_trace_header,
+    current_trace_header,
+    extract,
+    inject,
+)

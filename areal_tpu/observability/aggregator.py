@@ -1,0 +1,218 @@
+"""Fleet-level metric aggregation: scrape N ``/metrics`` endpoints, merge.
+
+The rollout controller (or the obs dashboard) points a
+:class:`FleetAggregator` at every inference server; each scrape pulls the
+Prometheus text exposition, parses it, and merges the fleet into
+cluster-level series — counters and histogram buckets sum, gauges sum
+(with per-target values retained for the dashboard's straggler view).
+
+One dead server must never stall the loop: scrapes run with a short
+per-target timeout and a single retry with backoff, and a failed target
+just marks its series stale for the round (``areal_fleet_targets_up``
+drops) while the rest of the fleet merges normally.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+
+from areal_tpu.observability import catalog
+from areal_tpu.observability.metrics import (
+    _escape_label_value,
+    _format_value,
+    parse_prometheus_text,
+    parse_prometheus_types,
+)
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("fleet_aggregator")
+
+Sample = tuple[str, dict[str, str], float]
+
+
+@dataclass
+class TargetScrape:
+    """One target's latest scrape result."""
+
+    target: str
+    up: bool = False
+    error: str = ""
+    scraped_at: float = 0.0
+    samples: list[Sample] = field(default_factory=list)
+    types: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class FleetSnapshot:
+    """One aggregation round over the whole fleet."""
+
+    targets: list[TargetScrape]
+    merged: dict[tuple[str, tuple[tuple[str, str], ...]], float]
+    types: dict[str, str]
+    scraped_at: float
+
+    @property
+    def n_up(self) -> int:
+        return sum(t.up for t in self.targets)
+
+    def value(self, name: str, **labels: str) -> float | None:
+        """Merged value of one series, or None if absent."""
+        return self.merged.get((name, tuple(sorted(labels.items()))))
+
+    def per_target(self, name: str) -> dict[str, float]:
+        """{target: summed value of ``name``} for the straggler view."""
+        out: dict[str, float] = {}
+        for t in self.targets:
+            if not t.up:
+                continue
+            total = None
+            for n, _labels, v in t.samples:
+                if n == name:
+                    total = (total or 0.0) + v
+            if total is not None:
+                out[t.target] = total
+        return out
+
+    def render_prometheus(self) -> str:
+        """Merged fleet series as exposition text (controller /metrics)."""
+        lines: list[str] = []
+        by_name: dict[str, list[tuple[tuple[tuple[str, str], ...], float]]] = {}
+        for (name, labels), v in sorted(self.merged.items()):
+            by_name.setdefault(name, []).append((labels, v))
+        typed: set[str] = set()
+        for name, series in by_name.items():
+            base = _base_metric_name(name)
+            mtype = self.types.get(base)
+            if mtype and base not in typed:
+                # one TYPE line per family even though a histogram's
+                # _bucket/_count/_sum series arrive as separate names
+                typed.add(base)
+                lines.append(f"# TYPE {base} {mtype}")
+            for labels, v in series:
+                lab = (
+                    "{"
+                    + ",".join(
+                        f'{k}="{_escape_label_value(val)}"'
+                        for k, val in labels
+                    )
+                    + "}"
+                    if labels
+                    else ""
+                )
+                lines.append(f"{name}{lab} {_format_value(v)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _base_metric_name(name: str) -> str:
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+def scrape_target(
+    target: str,
+    timeout: float = 2.0,
+    retries: int = 1,
+    backoff: float = 0.2,
+    path: str = "/metrics",
+) -> TargetScrape:
+    """Fetch one target's exposition with timeout + bounded retry."""
+    url = target if target.startswith("http") else f"http://{target}"
+    req = urllib.request.Request(
+        url + path, headers={"Accept": "text/plain"}
+    )
+    result = TargetScrape(target=target)
+    last_err = ""
+    for attempt in range(retries + 1):
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                text = r.read().decode()
+            result.samples = parse_prometheus_text(text)
+            result.types = parse_prometheus_types(text)
+            result.up = True
+            result.scraped_at = time.time()
+            return result
+        except Exception as e:  # noqa: BLE001 — a dead server is data
+            last_err = f"{type(e).__name__}: {e}"
+            if attempt < retries:
+                time.sleep(backoff * 2**attempt)
+    result.error = last_err
+    result.scraped_at = time.time()
+    return result
+
+
+class FleetAggregator:
+    """Scrape a target set and keep the latest merged snapshot."""
+
+    def __init__(
+        self,
+        targets: list[str],
+        timeout: float = 2.0,
+        retries: int = 1,
+    ):
+        self.targets = list(targets)
+        self.timeout = timeout
+        self.retries = retries
+        self._m = catalog.aggregator_metrics()
+        self._m.targets_total.set(len(self.targets))
+        self._lock = threading.Lock()
+        self._latest: FleetSnapshot | None = None
+        # one persistent pool for the aggregator's lifetime — a 5s-interval
+        # scrape loop must not create/join 16 OS threads every round
+        self._pool = None
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def scrape_once(self) -> FleetSnapshot:
+        import concurrent.futures
+
+        if self.targets:
+            if self._pool is None:
+                self._pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=min(16, len(self.targets)),
+                    thread_name_prefix="fleet-scrape",
+                )
+            scrapes = list(
+                self._pool.map(
+                    lambda t: scrape_target(
+                        t, timeout=self.timeout, retries=self.retries
+                    ),
+                    self.targets,
+                )
+            )
+        else:
+            scrapes = []
+        merged: dict[tuple[str, tuple[tuple[str, str], ...]], float] = {}
+        types: dict[str, str] = {}
+        for sc in scrapes:
+            self._m.scrapes.labels(
+                outcome="ok" if sc.up else "error"
+            ).inc()
+            if not sc.up:
+                logger.warning(f"scrape {sc.target} failed: {sc.error}")
+                continue
+            types.update(sc.types)
+            for name, labels, v in sc.samples:
+                key = (name, tuple(sorted(labels.items())))
+                merged[key] = merged.get(key, 0.0) + v
+        snap = FleetSnapshot(
+            targets=scrapes,
+            merged=merged,
+            types=types,
+            scraped_at=time.time(),
+        )
+        self._m.targets_up.set(snap.n_up)
+        with self._lock:
+            self._latest = snap
+        return snap
+
+    def latest(self) -> FleetSnapshot | None:
+        with self._lock:
+            return self._latest

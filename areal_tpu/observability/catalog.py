@@ -1,0 +1,271 @@
+"""The authoritative catalog of every areal_tpu metric family.
+
+Each instrumented layer obtains its handles through one factory here, so
+this module is the single place a metric name/label-set/help text exists.
+``tools/validate_installation.py`` lints the catalog (names match
+``^areal_[a-z0-9_]+$``, help text present) and ``docs/observability.md``
+documents it; keep the three in sync.
+
+Factories are idempotent (the registry dedups by name), so calling them
+from multiple instances is safe and cheap.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+from areal_tpu.observability.metrics import Registry, get_registry
+
+# short-latency buckets for TTFT / dispatch (sub-ms to 10s)
+FAST_BUCKETS = (
+    0.0005,
+    0.001,
+    0.0025,
+    0.005,
+    0.01,
+    0.025,
+    0.05,
+    0.1,
+    0.25,
+    0.5,
+    1.0,
+    2.5,
+    5.0,
+    10.0,
+)
+# version-lag buckets (integer staleness steps)
+LAG_BUCKETS = (0, 1, 2, 3, 4, 6, 8, 12, 16, 32)
+
+
+def staleness_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """StalenessManager: admission-control visibility."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        capacity=r.gauge(
+            "areal_rollout_capacity",
+            "Remaining rollout admission capacity (staleness-bounded).",
+        ),
+        running=r.gauge(
+            "areal_rollout_running", "Rollouts currently in flight."
+        ),
+        submitted=r.counter(
+            "areal_rollout_submitted_total", "Rollout tasks admitted."
+        ),
+        accepted=r.counter(
+            "areal_rollout_accepted_total",
+            "Rollout trajectories accepted into the training buffer.",
+        ),
+        rejected=r.counter(
+            "areal_rollout_rejected_total",
+            "Rollout trajectories rejected (filter or empty result).",
+        ),
+        version_lag=r.histogram(
+            "areal_rollout_version_lag",
+            "Policy-version lag (current - head version) of accepted "
+            "trajectories.",
+            buckets=LAG_BUCKETS,
+        ),
+    )
+
+
+def executor_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """WorkflowExecutor: queue depths + dispatch latency."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        input_depth=r.gauge(
+            "areal_executor_input_queue_depth",
+            "Queued train rollout tasks awaiting staleness capacity.",
+        ),
+        eval_depth=r.gauge(
+            "areal_executor_eval_queue_depth",
+            "Queued eval rollout tasks awaiting dispatch.",
+        ),
+        inflight=r.gauge(
+            "areal_executor_inflight_tasks",
+            "Rollout tasks launched and not yet completed.",
+        ),
+        results_buffered=r.gauge(
+            "areal_executor_results_buffered",
+            "Accepted trajectories buffered awaiting wait()/prepare_batch.",
+        ),
+        # default (latency-wide) buckets: gate waits under exhausted
+        # staleness capacity routinely run tens of seconds to minutes
+        dispatch_latency=r.histogram(
+            "areal_executor_dispatch_latency_seconds",
+            "Time from submit() to task launch (staleness-gate wait).",
+        ),
+    )
+
+
+def engine_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """DecodeEngine: decode-loop throughput counters."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        generated_tokens=r.counter(
+            "areal_decode_generated_tokens_total",
+            "Tokens emitted by the decode loop.",
+        ),
+        completed=r.counter(
+            "areal_decode_completed_total",
+            "Generation requests finished (stop/length).",
+        ),
+        aborted=r.counter(
+            "areal_decode_aborted_total",
+            "Generation requests aborted (weight-update pause/preemption).",
+        ),
+        prefills=r.counter(
+            "areal_decode_prefills_total", "Sequences prefilled."
+        ),
+        chunks=r.counter(
+            "areal_decode_chunks_total", "Jitted decode chunks executed."
+        ),
+        batch_occupancy=r.gauge(
+            "areal_decode_batch_occupancy",
+            "Active decode slots (of ServerConfig.max_batch_size).",
+        ),
+    )
+
+
+def server_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """Inference HTTP server: per-request latency + pause/update windows."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        requests=r.counter(
+            "areal_server_requests_total",
+            "HTTP requests served, by endpoint.",
+            label_names=("endpoint",),
+        ),
+        ttft=r.histogram(
+            "areal_server_ttft_seconds",
+            "Per-request time to first token.",
+            buckets=FAST_BUCKETS,
+        ),
+        request_latency=r.histogram(
+            "areal_server_generate_seconds",
+            "Per-request end-to-end /generate latency.",
+        ),
+        paused=r.gauge(
+            "areal_server_paused",
+            "1 while generation is paused for a weight update, else 0.",
+        ),
+        pauses=r.counter(
+            "areal_server_pause_total", "pause_generation calls."
+        ),
+        resumes=r.counter(
+            "areal_server_resume_total", "continue_generation calls."
+        ),
+        queue_depth=r.gauge(
+            "areal_server_queue_depth",
+            "Engine submission queue + admission backlog depth.",
+        ),
+        update_bucket_bytes=r.counter(
+            "areal_weight_update_bucket_bytes_total",
+            "Streamed weight-bucket bytes received (server side).",
+        ),
+        update_stage_seconds=r.histogram(
+            "areal_weight_update_stage_seconds",
+            "Server-side begin->commit latency of a staged weight update.",
+        ),
+    )
+
+
+def client_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """RemoteJaxEngine: trainer-side weight-update path."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        updates=r.counter(
+            "areal_weight_update_total", "Weight updates pushed to the fleet."
+        ),
+        update_bytes=r.counter(
+            "areal_weight_update_bytes_total",
+            "Encoded weight bytes uploaded (trainer side; 1x per bucket "
+            "regardless of relay fan-out).",
+        ),
+        pause_seconds=r.histogram(
+            "areal_weight_update_pause_seconds",
+            "Fleet availability gap per update (pause->continue window).",
+        ),
+        scrape_retries=r.counter(
+            "areal_client_scrape_retries_total",
+            "Metric-scrape GETs retried after a timeout or error.",
+        ),
+    )
+
+
+def rpc_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """RPC worker server: per-method request/error/latency."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        requests=r.counter(
+            "areal_rpc_requests_total",
+            "Engine RPC calls, by method.",
+            label_names=("method",),
+        ),
+        errors=r.counter(
+            "areal_rpc_errors_total",
+            "Engine RPC calls that raised, by method.",
+            label_names=("method",),
+        ),
+        latency=r.histogram(
+            "areal_rpc_request_seconds",
+            "Engine RPC call latency, by method.",
+            label_names=("method",),
+        ),
+    )
+
+
+def trainer_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """PPOTrainer: step cadence + policy version."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        step_seconds=r.histogram(
+            "areal_train_step_seconds",
+            "Wall-clock seconds per global training step.",
+            buckets=(1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0, 1800.0),
+        ),
+        version=r.gauge(
+            "areal_train_version", "Current policy version (global step + 1)."
+        ),
+        update_seconds=r.histogram(
+            "areal_train_update_weights_seconds",
+            "Trainer-side update_weights duration per step.",
+        ),
+    )
+
+
+def aggregator_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """Fleet aggregator: scrape health."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        scrapes=r.counter(
+            "areal_fleet_scrapes_total",
+            "Scrape attempts, by outcome.",
+            label_names=("outcome",),
+        ),
+        targets_up=r.gauge(
+            "areal_fleet_targets_up", "Scrape targets currently reachable."
+        ),
+        targets_total=r.gauge(
+            "areal_fleet_targets_total", "Scrape targets configured."
+        ),
+    )
+
+
+ALL_FACTORIES = (
+    staleness_metrics,
+    executor_metrics,
+    engine_metrics,
+    server_metrics,
+    client_metrics,
+    rpc_metrics,
+    trainer_metrics,
+    aggregator_metrics,
+)
+
+
+def register_all(reg: Registry | None = None) -> Registry:
+    """Instantiate every catalogued family (lint + docs tooling)."""
+    r = reg or get_registry()
+    for factory in ALL_FACTORIES:
+        factory(r)
+    return r

@@ -1,0 +1,521 @@
+"""Process-local metrics registry with Prometheus text exposition.
+
+The telemetry core of areal_tpu (ISSUE 1 / ROADMAP observability): every
+layer of the async-RL stack — StalenessManager, WorkflowExecutor,
+DecodeEngine, the inference HTTP server, the weight-update path, and the
+RPC plane — reports into one process-wide :class:`Registry` whose contents
+are served by ``GET /metrics`` (Prometheus text format or JSON) and merged
+fleet-wide by :mod:`areal_tpu.observability.aggregator`.
+
+Design notes:
+
+- **Naming convention** is enforced at registration: every metric matches
+  ``^areal_[a-z0-9_]+$`` and must carry non-empty help text (linted again
+  by ``tools/validate_installation.py``).
+- **Lock-free hot path**: counters and histograms shard their state
+  per-thread (one cell per observing thread, created once under a lock,
+  then mutated only by its owner), so ``inc``/``observe`` never contend —
+  the decode loop, the dispatcher thread, and aiohttp handlers each write
+  their own cell and the scrape path sums across shards. Gauges are
+  last-writer-wins single slots (a plain attribute store).
+- **Labels** are fixed per family at registration; ``labels(**kv)``
+  resolves (and caches) one child per label-value tuple.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Any, Iterable, Mapping
+
+_NAME_RE = re.compile(r"^areal_[a-z0-9_]+$")
+
+# default histogram buckets: latency-shaped, seconds (prometheus defaults
+# extended down to 1ms — TTFT at small-model scale sits well under 100ms)
+DEFAULT_BUCKETS = (
+    0.001,
+    0.005,
+    0.01,
+    0.025,
+    0.05,
+    0.1,
+    0.25,
+    0.5,
+    1.0,
+    2.5,
+    5.0,
+    10.0,
+    30.0,
+    60.0,
+    120.0,
+)
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _unescape_label_value(v: str) -> str:
+    """Single left-to-right scan — sequential str.replace would corrupt a
+    literal backslash followed by 'n' ('\\\\n' must become '\\' + 'n', not
+    a newline)."""
+    out: list[str] = []
+    i = 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if nxt in ('"', "\\"):
+                out.append(nxt)
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _format_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels_key(
+    label_names: tuple[str, ...], kv: Mapping[str, str]
+) -> tuple[str, ...]:
+    if set(kv) != set(label_names):
+        raise ValueError(
+            f"labels {sorted(kv)} != declared label names {sorted(label_names)}"
+        )
+    return tuple(str(kv[n]) for n in label_names)
+
+
+class _ThreadShardedValue:
+    """One float accumulator per writing thread.
+
+    ``add`` touches only the calling thread's cell (a one-element list so
+    the reference stays stable), so the hot path takes no lock; ``total``
+    sums a snapshot of all cells. Cell creation (first write from a new
+    thread) is the only locked operation.
+    """
+
+    __slots__ = ("_cells", "_lock", "_local")
+
+    def __init__(self) -> None:
+        self._cells: list[list[float]] = []
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    def _cell(self) -> list[float]:
+        cell = getattr(self._local, "cell", None)
+        if cell is None:
+            cell = [0.0]
+            with self._lock:
+                self._cells.append(cell)
+            self._local.cell = cell
+        return cell
+
+    def add(self, v: float) -> None:
+        self._cell()[0] += v
+
+    def total(self) -> float:
+        with self._lock:
+            cells = list(self._cells)
+        return sum(c[0] for c in cells)
+
+
+class _Child:
+    """Base for one (metric family, label values) time series."""
+
+    def __init__(self, family: "MetricFamily", label_values: tuple[str, ...]):
+        self._family = family
+        self.label_values = label_values
+
+
+class CounterChild(_Child):
+    def __init__(self, family: "MetricFamily", label_values: tuple[str, ...]):
+        super().__init__(family, label_values)
+        self._value = _ThreadShardedValue()
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up")
+        self._value.add(n)
+
+    def get(self) -> float:
+        return self._value.total()
+
+
+class GaugeChild(_Child):
+    def __init__(self, family: "MetricFamily", label_values: tuple[str, ...]):
+        super().__init__(family, label_values)
+        self._value = 0.0
+        self._lock = threading.Lock()  # inc/dec are read-modify-write
+
+    def set(self, v: float) -> None:
+        self._value = float(v)  # single store: last-writer-wins by design
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value -= n
+
+    def get(self) -> float:
+        return self._value
+
+
+class _HistShard:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * n_buckets
+        self.sum = 0.0
+        self.count = 0
+
+
+class HistogramChild(_Child):
+    def __init__(self, family: "MetricFamily", label_values: tuple[str, ...]):
+        super().__init__(family, label_values)
+        self.buckets: tuple[float, ...] = family.buckets
+        self._shards: list[_HistShard] = []
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    def _shard(self) -> _HistShard:
+        sh = getattr(self._local, "shard", None)
+        if sh is None:
+            sh = _HistShard(len(self.buckets))
+            with self._lock:
+                self._shards.append(sh)
+            self._local.shard = sh
+        return sh
+
+    def observe(self, v: float) -> None:
+        sh = self._shard()
+        # non-cumulative per-bucket increments; render() accumulates
+        for i, le in enumerate(self.buckets):
+            if v <= le:
+                sh.counts[i] += 1
+                break
+        sh.sum += v
+        sh.count += 1
+
+    def snapshot(self) -> tuple[list[int], float, int]:
+        """(cumulative bucket counts incl. +Inf, sum, count)."""
+        with self._lock:
+            shards = list(self._shards)
+        counts = [0] * len(self.buckets)
+        total_sum, total_count = 0.0, 0
+        for sh in shards:
+            for i, c in enumerate(sh.counts):
+                counts[i] += c
+            total_sum += sh.sum
+            total_count += sh.count
+        cum, acc = [], 0
+        for c in counts:
+            acc += c
+            cum.append(acc)
+        cum.append(total_count)  # +Inf bucket
+        return cum, total_sum, total_count
+
+
+_CHILD_TYPES = {
+    "counter": CounterChild,
+    "gauge": GaugeChild,
+    "histogram": HistogramChild,
+}
+
+
+class MetricFamily:
+    """One named metric with a fixed label schema and N children."""
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        type: str,
+        label_names: tuple[str, ...] = (),
+        buckets: Iterable[float] | None = None,
+    ):
+        if not _NAME_RE.match(name):
+            raise ValueError(
+                f"metric name {name!r} violates ^areal_[a-z0-9_]+$"
+            )
+        if not help or not help.strip():
+            raise ValueError(f"metric {name!r} must have help text")
+        if type not in _CHILD_TYPES:
+            raise ValueError(f"unknown metric type {type!r}")
+        for ln in label_names:
+            if ln in ("le", "quantile"):
+                raise ValueError(f"reserved label name {ln!r}")
+        self.name = name
+        self.help = help.strip()
+        self.type = type
+        self.label_names = tuple(label_names)
+        self.buckets = tuple(sorted(set(buckets or DEFAULT_BUCKETS)))
+        self._children: dict[tuple[str, ...], _Child] = {}
+        self._lock = threading.Lock()
+        self._default: _Child | None = None
+
+    # -- child resolution --------------------------------------------------
+    def labels(self, **kv: str):
+        key = _labels_key(self.label_names, kv)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(
+                    key, _CHILD_TYPES[self.type](self, key)
+                )
+        return child
+
+    def _default_child(self):
+        if self._default is None:
+            if self.label_names:
+                raise ValueError(
+                    f"metric {self.name!r} has labels {self.label_names}; "
+                    "use .labels(...)"
+                )
+            self._default = self.labels()
+        return self._default
+
+    # -- label-less conveniences ------------------------------------------
+    def inc(self, n: float = 1.0) -> None:
+        self._default_child().inc(n)
+
+    def dec(self, n: float = 1.0) -> None:
+        self._default_child().dec(n)
+
+    def set(self, v: float) -> None:
+        self._default_child().set(v)
+
+    def observe(self, v: float) -> None:
+        self._default_child().observe(v)
+
+    def get(self) -> float:
+        return self._default_child().get()
+
+    @property
+    def cardinality(self) -> int:
+        with self._lock:
+            return len(self._children)
+
+    def children(self) -> list[_Child]:
+        with self._lock:
+            return list(self._children.values())
+
+
+class Registry:
+    """A named set of metric families; one default instance per process."""
+
+    def __init__(self) -> None:
+        self._families: dict[str, MetricFamily] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_register(
+        self,
+        name: str,
+        help: str,
+        type: str,
+        label_names: tuple[str, ...],
+        buckets: Iterable[float] | None = None,
+    ) -> MetricFamily:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.type != type or fam.label_names != tuple(label_names):
+                    raise ValueError(
+                        f"metric {name!r} re-registered with a different "
+                        f"schema: {fam.type}{fam.label_names} vs "
+                        f"{type}{tuple(label_names)}"
+                    )
+                return fam
+            fam = MetricFamily(name, help, type, tuple(label_names), buckets)
+            if not fam.label_names:
+                # materialize the unlabeled series at registration so the
+                # exposition shows an explicit 0 before the first event
+                fam._default_child()
+            self._families[name] = fam
+            return fam
+
+    def counter(
+        self, name: str, help: str, label_names: Iterable[str] = ()
+    ) -> MetricFamily:
+        return self._get_or_register(name, help, "counter", tuple(label_names))
+
+    def gauge(
+        self, name: str, help: str, label_names: Iterable[str] = ()
+    ) -> MetricFamily:
+        return self._get_or_register(name, help, "gauge", tuple(label_names))
+
+    def histogram(
+        self,
+        name: str,
+        help: str,
+        label_names: Iterable[str] = (),
+        buckets: Iterable[float] | None = None,
+    ) -> MetricFamily:
+        return self._get_or_register(
+            name, help, "histogram", tuple(label_names), buckets
+        )
+
+    def families(self) -> list[MetricFamily]:
+        with self._lock:
+            return [self._families[k] for k in sorted(self._families)]
+
+    def clear(self) -> None:
+        """Drop all families (tests only — live handles go stale)."""
+        with self._lock:
+            self._families.clear()
+
+    # -- exposition --------------------------------------------------------
+    def render_prometheus(self, name_prefix: str | None = None) -> str:
+        """Prometheus text exposition format 0.0.4. ``name_prefix``
+        restricts output to families whose name starts with it (the
+        controller appends only its own areal_fleet_* series to the merged
+        fleet exposition this way)."""
+        lines: list[str] = []
+        for fam in self.families():
+            if name_prefix and not fam.name.startswith(name_prefix):
+                continue
+            lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# TYPE {fam.name} {fam.type}")
+            for child in fam.children():
+                base = _render_labels(fam.label_names, child.label_values)
+                if fam.type == "histogram":
+                    cum, total_sum, total_count = child.snapshot()
+                    for le, c in zip(
+                        list(fam.buckets) + [math.inf], cum
+                    ):
+                        le_s = _format_value(le)
+                        lab = _render_labels(
+                            fam.label_names + ("le",),
+                            child.label_values + (le_s,),
+                        )
+                        lines.append(f"{fam.name}_bucket{lab} {c}")
+                    lines.append(
+                        f"{fam.name}_sum{base} {_format_value(total_sum)}"
+                    )
+                    lines.append(f"{fam.name}_count{base} {total_count}")
+                else:
+                    lines.append(
+                        f"{fam.name}{base} {_format_value(child.get())}"
+                    )
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def render_json(self) -> dict[str, Any]:
+        """JSON export: {name: {help, type, samples: [{labels, ...}]}}."""
+        out: dict[str, Any] = {}
+        for fam in self.families():
+            samples = []
+            for child in fam.children():
+                labels = dict(zip(fam.label_names, child.label_values))
+                if fam.type == "histogram":
+                    cum, total_sum, total_count = child.snapshot()
+                    samples.append(
+                        {
+                            "labels": labels,
+                            "buckets": {
+                                _format_value(le): c
+                                for le, c in zip(
+                                    list(fam.buckets) + [math.inf], cum
+                                )
+                            },
+                            "sum": total_sum,
+                            "count": total_count,
+                        }
+                    )
+                else:
+                    samples.append({"labels": labels, "value": child.get()})
+            out[fam.name] = {
+                "help": fam.help,
+                "type": fam.type,
+                "samples": samples,
+            }
+        return out
+
+def _render_labels(names: tuple[str, ...], values: tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(
+        f'{n}="{_escape_label_value(v)}"' for n, v in zip(names, values)
+    )
+    return "{" + inner + "}"
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text parsing (the aggregator's scrape decoder + golden tests)
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    # label block: quoted strings may contain '}' and escaped quotes, so
+    # match either a full quoted value or any non-brace/non-quote char
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r'(?:\{(?P<labels>(?:[^{}"]|"(?:[^"\\]|\\.)*")*)\})?'
+    r"\s+(?P<value>[^ ]+)"
+    r"(?:\s+(?P<timestamp>-?\d+))?\s*$"  # optional ms timestamp (spec 0.0.4)
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus_text(
+    text: str,
+) -> list[tuple[str, dict[str, str], float]]:
+    """Parse exposition text into (name, labels, value) samples.
+
+    HELP/TYPE comments are skipped; histogram series come back as their
+    raw ``_bucket``/``_sum``/``_count`` samples.
+    """
+    samples: list[tuple[str, dict[str, str], float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        labels: dict[str, str] = {}
+        for lm in _LABEL_RE.finditer(m.group("labels") or ""):
+            labels[lm.group(1)] = _unescape_label_value(lm.group(2))
+        raw = m.group("value")
+        if raw == "+Inf":
+            v = math.inf
+        elif raw == "-Inf":
+            v = -math.inf
+        else:
+            v = float(raw)
+        samples.append((m.group("name"), labels, v))
+    return samples
+
+
+def parse_prometheus_types(text: str) -> dict[str, str]:
+    """Extract {metric_name: type} from # TYPE comments."""
+    types: dict[str, str] = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) >= 4:
+                types[parts[2]] = parts[3]
+    return types
+
+
+# ---------------------------------------------------------------------------
+# process-default registry
+# ---------------------------------------------------------------------------
+
+_REGISTRY = Registry()
+
+
+def get_registry() -> Registry:
+    return _REGISTRY

@@ -1,0 +1,102 @@
+"""Cross-process trace-context propagation (``x-areal-trace``).
+
+The perf tracer keeps task/session ids in ContextVars so events recorded
+inside workflow coroutines attach to the right rollout. Those ids die at
+process boundaries — a trainer-side span and the inference-server work it
+caused land in separate traces with nothing to join them on. This module
+rides the ids across RPC and HTTP hops in one header:
+
+    x-areal-trace: task=<task_id>;session=<session_id>
+
+Senders call :func:`inject` on their outbound header dict; receivers call
+:func:`extract` (or :func:`apply_trace_header`) before doing work, which
+re-seats the ContextVars so every span the handler records carries the
+originating task/session id. ``merge_traces`` then produces one Perfetto
+timeline whose spans correlate by ``args.session_id`` across processes.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, MutableMapping
+
+from areal_tpu.utils import perf_tracer
+
+TRACE_HEADER = "x-areal-trace"
+
+
+def format_trace_header(
+    task_id: str | None, session_id: str | None
+) -> str | None:
+    """Encode ids into the wire value; None when there is nothing to send."""
+    parts = []
+    if task_id:
+        parts.append(f"task={task_id}")
+    if session_id:
+        parts.append(f"session={session_id}")
+    return ";".join(parts) if parts else None
+
+
+def parse_trace_header(value: str) -> tuple[str | None, str | None]:
+    """Decode a wire value back into (task_id, session_id).
+
+    Unknown ``k=v`` pairs are ignored (forward compatibility); malformed
+    fragments never raise — a bad header must not fail a request.
+    """
+    task_id = session_id = None
+    for part in (value or "").split(";"):
+        k, _, v = part.strip().partition("=")
+        if not v:
+            continue
+        if k == "task":
+            task_id = v
+        elif k == "session":
+            session_id = v
+    return task_id, session_id
+
+
+def current_trace_header() -> str | None:
+    """The header value for the calling context, or None outside a task."""
+    task_id, session_id = perf_tracer.get_task_context()
+    return format_trace_header(task_id, session_id)
+
+
+def apply_trace_header(value: str | None) -> None:
+    """Seat ids from a received header into this context's ContextVars."""
+    if not value:
+        return
+    task_id, session_id = parse_trace_header(value)
+    if task_id or session_id:
+        perf_tracer.set_task_context(task_id=task_id, session_id=session_id)
+
+
+def inject(headers: MutableMapping[str, str] | None = None) -> dict:
+    """Return ``headers`` (a new dict if None) with the trace header added
+    when the calling context carries one."""
+    out = dict(headers or {})
+    value = current_trace_header()
+    if value:
+        out[TRACE_HEADER] = value
+    return out
+
+
+def extract(headers: Mapping[str, str]) -> tuple[str | None, str | None]:
+    """Read + apply the trace header from inbound request headers (matched
+    case-insensitively; aiohttp lower-cases, urllib title-cases). Returns
+    the (task_id, session_id) it seated, (None, None) when absent.
+
+    The context is seated to EXACTLY what the header carries — a request
+    without the header clears both ids, because requests sharing a
+    keep-alive connection run in the same handler task and would otherwise
+    inherit the previous request's ids.
+    """
+    value = headers.get(TRACE_HEADER)
+    if value is None:
+        for k, v in headers.items():
+            if k.lower() == TRACE_HEADER:
+                value = v
+                break
+    perf_tracer.clear_task_context()
+    if not value:
+        return None, None
+    apply_trace_header(value)
+    return parse_trace_header(value)

@@ -1,0 +1,252 @@
+"""Live terminal dashboard over the areal_tpu telemetry fleet.
+
+Scrapes one or more ``/metrics`` endpoints (inference servers directly, or
+a rollout controller's aggregated endpoint) and renders the async-RL
+vitals: queue depths, staleness admission state, tokens/s, pause state,
+and weight-update latency.
+
+Usage:
+    python -m areal_tpu.tools.obs_dashboard --targets host:port,host:port
+    python -m areal_tpu.tools.obs_dashboard --targets ... --once
+    python -m areal_tpu.tools.obs_dashboard --self-test   # CI smoke mode
+
+``--self-test`` starts a local fake scrape target serving canned
+exposition text, runs one aggregation + render round against it, asserts
+the pipeline end-to-end (scrape -> parse -> merge -> render), and exits
+0/1 — the tier-1 smoke test invokes exactly this.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from areal_tpu.observability.aggregator import FleetAggregator, FleetSnapshot
+
+# (metric, label filter, display name) rows for the vitals table
+_ROWS = (
+    ("areal_rollout_capacity", "staleness capacity"),
+    ("areal_rollout_running", "rollouts running"),
+    ("areal_rollout_accepted_total", "accepted"),
+    ("areal_rollout_rejected_total", "rejected"),
+    ("areal_executor_input_queue_depth", "input queue"),
+    ("areal_executor_eval_queue_depth", "eval queue"),
+    ("areal_executor_inflight_tasks", "in flight"),
+    ("areal_server_queue_depth", "server queue"),
+    ("areal_decode_batch_occupancy", "batch occupancy"),
+    ("areal_server_paused", "paused servers"),
+    ("areal_weight_update_total", "weight updates"),
+)
+
+
+def _merged_value(snap: FleetSnapshot, name: str) -> float | None:
+    """Sum a metric across all its label children in the merged view."""
+    total = None
+    for (n, _labels), v in snap.merged.items():
+        if n == name:
+            total = (total or 0.0) + v
+    return total
+
+
+def _fmt(v: float | None) -> str:
+    if v is None:
+        return "-"
+    if float(v).is_integer():
+        return str(int(v))
+    return f"{v:.2f}"
+
+
+def render_frame(
+    snap: FleetSnapshot, prev: FleetSnapshot | None = None
+) -> str:
+    """One dashboard frame as plain text (also the --once/--self-test
+    output, so it stays pipe- and CI-friendly)."""
+    lines = []
+    up, total = snap.n_up, len(snap.targets)
+    lines.append(
+        f"areal_tpu fleet  |  targets {up}/{total} up  |  "
+        + time.strftime("%H:%M:%S", time.localtime(snap.scraped_at))
+    )
+    lines.append("-" * 64)
+    # tokens/s needs two frames: rate = d(generated)/dt
+    toks = _merged_value(snap, "areal_decode_generated_tokens_total")
+    if prev is not None and toks is not None:
+        prev_toks = _merged_value(
+            prev, "areal_decode_generated_tokens_total"
+        )
+        dt = snap.scraped_at - prev.scraped_at
+        if prev_toks is not None and dt > 0:
+            lines.append(f"{'tokens/s':<24} {(toks - prev_toks) / dt:>12.1f}")
+    elif toks is not None:
+        lines.append(f"{'tokens (total)':<24} {_fmt(toks):>12}")
+    for name, label in _ROWS:
+        v = _merged_value(snap, name)
+        if v is not None:
+            lines.append(f"{label:<24} {_fmt(v):>12}")
+    pause_sum = _merged_value(snap, "areal_weight_update_pause_seconds_sum")
+    pause_cnt = _merged_value(snap, "areal_weight_update_pause_seconds_count")
+    if pause_sum is not None and pause_cnt:
+        lines.append(
+            f"{'update pause (mean s)':<24} {pause_sum / pause_cnt:>12.3f}"
+        )
+    # straggler view: per-target token counters expose a lagging server
+    # that the fleet-merged sums hide
+    per = snap.per_target("areal_decode_generated_tokens_total")
+    if len(per) > 1:
+        lines.append("-" * 64)
+        for target, v in sorted(per.items(), key=lambda kv: kv[1]):
+            lines.append(f"  {target:<22} {_fmt(v):>12} tok")
+    down = [t.target for t in snap.targets if not t.up]
+    if down:
+        lines.append("-" * 64)
+        for t in down:
+            lines.append(f"DOWN  {t}")
+    return "\n".join(lines)
+
+
+def run_dashboard(
+    targets: list[str],
+    refresh: float = 2.0,
+    once: bool = False,
+    timeout: float = 2.0,
+) -> int:
+    agg = FleetAggregator(targets, timeout=timeout)
+    prev = None
+    while True:
+        snap = agg.scrape_once()
+        frame = render_frame(snap, prev)
+        if once:
+            print(frame)
+            return 0 if snap.n_up == len(targets) else 1
+        # clear + home, then the frame (plain ANSI, no curses dependency)
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        prev = snap
+        time.sleep(refresh)
+
+
+# ---------------------------------------------------------------------------
+# --self-test: CI smoke over a fake scrape target
+# ---------------------------------------------------------------------------
+
+_FAKE_EXPOSITION = """\
+# HELP areal_rollout_capacity Remaining rollout admission capacity.
+# TYPE areal_rollout_capacity gauge
+areal_rollout_capacity 7
+# HELP areal_executor_input_queue_depth Queued train rollout tasks.
+# TYPE areal_executor_input_queue_depth gauge
+areal_executor_input_queue_depth 3
+# HELP areal_decode_generated_tokens_total Tokens emitted by the decode loop.
+# TYPE areal_decode_generated_tokens_total counter
+areal_decode_generated_tokens_total 1234
+# HELP areal_server_paused 1 while generation is paused.
+# TYPE areal_server_paused gauge
+areal_server_paused 0
+# HELP areal_weight_update_pause_seconds Availability gap per update.
+# TYPE areal_weight_update_pause_seconds histogram
+areal_weight_update_pause_seconds_bucket{le="1"} 2
+areal_weight_update_pause_seconds_bucket{le="+Inf"} 2
+areal_weight_update_pause_seconds_sum 1.5
+areal_weight_update_pause_seconds_count 2
+"""
+
+
+def self_test() -> int:
+    """End-to-end smoke: fake target -> scrape -> merge -> render."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — http.server API
+            body = _FAKE_EXPOSITION.encode()
+            self.send_response(200 if self.path == "/metrics" else 404)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if self.path == "/metrics":
+                self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    target = f"127.0.0.1:{srv.server_address[1]}"
+    try:
+        # two live targets sharing one backend: merge must sum them, and a
+        # third dead target must not stall or fail the round
+        agg = FleetAggregator(
+            [target, target, "127.0.0.1:1"], timeout=2.0, retries=0
+        )
+        t0 = time.monotonic()
+        snap = agg.scrape_once()
+        elapsed = time.monotonic() - t0
+        frame = render_frame(snap)
+        checks = [
+            (snap.n_up == 2, f"expected 2 targets up, got {snap.n_up}"),
+            (
+                _merged_value(snap, "areal_rollout_capacity") == 14,
+                "gauge merge: capacity should sum to 14",
+            ),
+            (
+                _merged_value(snap, "areal_decode_generated_tokens_total")
+                == 2468,
+                "counter merge: tokens should sum to 2468",
+            ),
+            (
+                elapsed < 10.0,
+                f"dead target stalled the round ({elapsed:.1f}s)",
+            ),
+            ("staleness capacity" in frame, "frame missing capacity row"),
+            ("update pause (mean s)" in frame, "frame missing pause row"),
+            ("DOWN  127.0.0.1:1" in frame, "frame missing down-target row"),
+        ]
+        failed = [msg for ok, msg in checks if not ok]
+        print(frame)
+        print("-" * 64)
+        for ok, msg in checks:
+            print(f"{'PASS' if ok else 'FAIL'}  {msg}")
+        if failed:
+            return 1
+        print("self-test OK")
+        return 0
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--targets",
+        default="",
+        help="comma-separated host:port /metrics endpoints",
+    )
+    p.add_argument(
+        "--refresh", type=float, default=2.0, help="redraw period (s)"
+    )
+    p.add_argument(
+        "--timeout", type=float, default=2.0, help="per-target scrape timeout"
+    )
+    p.add_argument(
+        "--once", action="store_true", help="render one frame and exit"
+    )
+    p.add_argument(
+        "--self-test",
+        action="store_true",
+        help="run against a built-in fake target (CI smoke)",
+    )
+    args = p.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    targets = [t for t in args.targets.split(",") if t]
+    if not targets:
+        p.error("--targets required (or --self-test)")
+    return run_dashboard(
+        targets, refresh=args.refresh, once=args.once, timeout=args.timeout
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -68,6 +68,33 @@ def main(argv=None) -> int:
 
     _check("contracts", engine_contract, results)
 
+    def metrics_lint():
+        """Every catalogued metric family obeys the naming convention
+        (^areal_[a-z0-9_]+$) and carries help text, and the registry's
+        Prometheus rendering round-trips through its own parser."""
+        import re
+
+        from areal_tpu.observability import catalog
+        from areal_tpu.observability.metrics import (
+            Registry,
+            parse_prometheus_text,
+        )
+
+        reg = catalog.register_all(Registry())
+        name_re = re.compile(r"^areal_[a-z0-9_]+$")
+        bad = []
+        for fam in reg.families():
+            if not name_re.match(fam.name):
+                bad.append(f"{fam.name}: bad name")
+            if not fam.help.strip():
+                bad.append(f"{fam.name}: missing help")
+        if bad:
+            raise RuntimeError("; ".join(bad))
+        parse_prometheus_text(reg.render_prometheus())
+        return f"{len(reg.families())} metric families lint-clean"
+
+    _check("metrics", metrics_lint, results)
+
     def native_kernels():
         from areal_tpu.native import datapack_lib
         from areal_tpu.utils.datapack import ffd_allocate

@@ -22,6 +22,7 @@ import numpy as np
 from areal_tpu.api.config import PPOConfig
 from areal_tpu.api.io_struct import StepInfo, WeightUpdateMeta
 from areal_tpu.engine.train_engine import JaxTrainEngine
+from areal_tpu.observability import catalog as obs_catalog
 from areal_tpu.trainer.ppo import PPOActor, PPOCritic
 from areal_tpu.utils import logging as alog, perf_tracer, stats_tracker
 from areal_tpu.utils.perf_tracer import Category
@@ -184,6 +185,7 @@ class PPOTrainer:
             if hasattr(c, "fileroot"):
                 c.fileroot = c.fileroot or config.cluster.fileroot
         perf_tracer.configure(config.perf_tracer, rank=0, role="trainer")
+        self._obs = obs_catalog.trainer_metrics()
         self.saver = Saver(config.saver, self.ft_spec)
         self.evaluator = Evaluator(config.evaluator, self.ft_spec)
         self.recover_handler = RecoverHandler(config.recover, self.ft_spec)
@@ -271,6 +273,7 @@ class PPOTrainer:
 
             # §3.4 protocol: stop submissions, push weights, advance version
             self.rollout.pause()
+            t_update = time.monotonic()
             with stats_tracker.record_timing("update_weights"), perf_tracer.trace_scope(
                 "train.update_weights", Category.COMM
             ):
@@ -282,6 +285,8 @@ class PPOTrainer:
                 self.rollout.set_version(new_version)
                 if self.eval_rollout is not None:
                     self.eval_rollout.set_version(new_version)
+            self._obs.update_seconds.observe(time.monotonic() - t_update)
+            self._obs.version.set(new_version)
 
             with stats_tracker.record_timing("save"), perf_tracer.trace_scope(
                 "train.save", Category.IO
@@ -313,6 +318,7 @@ class PPOTrainer:
             stats = stats_tracker.export_all()
             stats.update(self.rollout.export_stats())
             stats["step_secs"] = time.monotonic() - t_step
+            self._obs.step_seconds.observe(stats["step_secs"])
             stats["version"] = float(new_version)
             self.stats_logger.commit(epoch, step, global_step, stats)
             if profiling:

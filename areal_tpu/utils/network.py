@@ -40,7 +40,9 @@ def gethostname() -> str:
     return socket.gethostname()
 
 
-def http_json(url: str, payload=None, timeout: float = 3600.0) -> dict:
+def http_json(
+    url: str, payload=None, timeout: float = 3600.0, headers: dict | None = None
+) -> dict:
     """Tiny dependency-free JSON-over-HTTP helper (control-plane RPC).
     GET when payload is None, POST otherwise; non-2xx responses with JSON
     bodies are returned as dicts (rpc_server ships structured errors)."""
@@ -49,12 +51,12 @@ def http_json(url: str, payload=None, timeout: float = 3600.0) -> dict:
     import urllib.request
 
     if payload is None:
-        req = urllib.request.Request(url)
+        req = urllib.request.Request(url, headers=dict(headers or {}))
     else:
         req = urllib.request.Request(
             url,
             data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
+            headers={"Content-Type": "application/json", **(headers or {})},
             method="POST",
         )
     try:

@@ -59,6 +59,21 @@ def set_task_context(task_id: str | None = None, session_id: str | None = None):
         _session_id_var.set(session_id)
 
 
+def get_task_context() -> tuple[str | None, str | None]:
+    """(task_id, session_id) of the calling context — the payload that
+    observability.tracecontext rides across RPC/HTTP hops."""
+    return _task_id_var.get(), _session_id_var.get()
+
+
+def clear_task_context() -> None:
+    """Unconditionally reset both ids in the calling context. Inbound
+    request handlers must call this when no trace header arrived: aiohttp
+    serves a keep-alive connection's requests from one task, so stale ids
+    would otherwise leak into later requests' spans."""
+    _task_id_var.set(None)
+    _session_id_var.set(None)
+
+
 class PerfTracer:
     """Catapult JSON event collector for one process."""
 
@@ -101,10 +116,14 @@ class PerfTracer:
             yield
             return
         ev = self._base(name, "X", category)
-        if args or _task_id_var.get():
+        if args or _task_id_var.get() or _session_id_var.get():
             ev["args"] = {**(args or {})}
             if _task_id_var.get():
                 ev["args"]["task_id"] = _task_id_var.get()
+            # session ids are the cross-process join key: merge_traces
+            # output correlates trainer/controller/server spans on them
+            if _session_id_var.get():
+                ev["args"]["session_id"] = _session_id_var.get()
         t0 = self._ts_us()
         try:
             yield

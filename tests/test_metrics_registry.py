@@ -1,0 +1,196 @@
+"""Metrics registry: naming, labels, histograms, exposition, concurrency
+(ISSUE 1 satellite: registry test coverage)."""
+
+import math
+import threading
+
+import pytest
+
+from areal_tpu.observability import catalog
+from areal_tpu.observability.metrics import (
+    Registry,
+    parse_prometheus_text,
+    parse_prometheus_types,
+)
+
+
+def test_name_convention_enforced():
+    reg = Registry()
+    with pytest.raises(ValueError):
+        reg.counter("http_requests_total", "missing areal_ prefix")
+    with pytest.raises(ValueError):
+        reg.counter("areal_Bad_Case", "uppercase")
+    with pytest.raises(ValueError):
+        reg.counter("areal_ok_total", "")  # empty help
+    reg.counter("areal_ok_total", "fine")
+
+
+def test_registration_idempotent_but_schema_checked():
+    reg = Registry()
+    a = reg.counter("areal_x_total", "help", label_names=("k",))
+    b = reg.counter("areal_x_total", "help", label_names=("k",))
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge("areal_x_total", "help")  # type change
+    with pytest.raises(ValueError):
+        reg.counter("areal_x_total", "help", label_names=("other",))
+
+
+def test_label_cardinality_and_isolation():
+    reg = Registry()
+    c = reg.counter("areal_req_total", "requests", label_names=("method",))
+    for i in range(5):
+        c.labels(method=f"m{i}").inc(i + 1)
+    c.labels(method="m0").inc()  # resolves the SAME child
+    assert c.cardinality == 5
+    assert c.labels(method="m0").get() == 2
+    assert c.labels(method="m4").get() == 5
+    # wrong/missing label names are rejected
+    with pytest.raises(ValueError):
+        c.labels(verb="GET")
+    with pytest.raises(ValueError):
+        c.labels()
+    # labeled family has no default child
+    with pytest.raises(ValueError):
+        c.inc()
+
+
+def test_counter_rejects_negative():
+    reg = Registry()
+    c = reg.counter("areal_c_total", "h")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_histogram_bucketing_cumulative():
+    reg = Registry()
+    h = reg.histogram("areal_lat_seconds", "latency", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    cum, total_sum, total_count = h._default_child().snapshot()
+    # cumulative: le=0.1 -> 1, le=1 -> 3, le=10 -> 4, +Inf -> 5
+    assert cum == [1, 3, 4, 5]
+    assert total_count == 5
+    assert abs(total_sum - 56.05) < 1e-9
+    # boundary lands in the bucket (le is inclusive)
+    h.observe(0.1)
+    cum, _, _ = h._default_child().snapshot()
+    assert cum[0] == 2
+
+
+def test_prometheus_text_golden():
+    """Exact exposition text for a small registry (format 0.0.4)."""
+    reg = Registry()
+    c = reg.counter("areal_req_total", "Requests served.", label_names=("ep",))
+    c.labels(ep="generate").inc(3)
+    g = reg.gauge("areal_depth", "Queue depth.")
+    g.set(7)
+    h = reg.histogram("areal_lat_seconds", "Latency.", buckets=(0.5, 2.0))
+    h.observe(0.25)
+    h.observe(1.0)
+    h.observe(9.0)
+    golden = (
+        "# HELP areal_depth Queue depth.\n"
+        "# TYPE areal_depth gauge\n"
+        "areal_depth 7\n"
+        "# HELP areal_lat_seconds Latency.\n"
+        "# TYPE areal_lat_seconds histogram\n"
+        'areal_lat_seconds_bucket{le="0.5"} 1\n'
+        'areal_lat_seconds_bucket{le="2"} 2\n'
+        'areal_lat_seconds_bucket{le="+Inf"} 3\n'
+        "areal_lat_seconds_sum 10.25\n"
+        "areal_lat_seconds_count 3\n"
+        "# HELP areal_req_total Requests served.\n"
+        "# TYPE areal_req_total counter\n"
+        'areal_req_total{ep="generate"} 3\n'
+    )
+    assert reg.render_prometheus() == golden
+    # and the text round-trips through the scrape parser
+    samples = parse_prometheus_text(golden)
+    as_dict = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+    assert as_dict[("areal_depth", ())] == 7
+    assert as_dict[("areal_req_total", (("ep", "generate"),))] == 3
+    assert as_dict[("areal_lat_seconds_bucket", (("le", "+Inf"),))] == 3
+    assert parse_prometheus_types(golden)["areal_lat_seconds"] == "histogram"
+
+
+def test_label_value_escaping_roundtrip():
+    reg = Registry()
+    c = reg.counter("areal_esc_total", "escapes", label_names=("path",))
+    # includes the order-sensitive case: literal backslash followed by 'n'
+    # must round-trip as two characters, not collapse into a newline
+    nasty = 'a"b\\c\nd\\ne'
+    c.labels(path=nasty).inc()
+    samples = parse_prometheus_text(reg.render_prometheus())
+    (name, labels, v) = [s for s in samples if s[0] == "areal_esc_total"][0]
+    assert labels["path"] == nasty
+    assert v == 1
+
+
+def test_json_export_shape():
+    reg = Registry()
+    reg.counter("areal_j_total", "h").inc(2)
+    reg.histogram("areal_jh_seconds", "h", buckets=(1.0,)).observe(0.5)
+    d = reg.render_json()
+    assert d["areal_j_total"]["type"] == "counter"
+    assert d["areal_j_total"]["samples"][0]["value"] == 2
+    hs = d["areal_jh_seconds"]["samples"][0]
+    assert hs["count"] == 1 and hs["buckets"]["1"] == 1
+    assert hs["buckets"]["+Inf"] == 1
+
+
+def test_concurrent_increments_exact():
+    """8 threads x 10k increments: thread-sharded counters lose nothing."""
+    reg = Registry()
+    c = reg.counter("areal_conc_total", "h")
+    h = reg.histogram("areal_conc_seconds", "h", buckets=(0.5,))
+    n_threads, n_iter = 8, 10_000
+    barrier = threading.Barrier(n_threads)
+
+    def worker():
+        barrier.wait()
+        for _ in range(n_iter):
+            c.inc()
+            h.observe(0.25)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.get() == n_threads * n_iter
+    cum, total_sum, total_count = h._default_child().snapshot()
+    assert total_count == n_threads * n_iter
+    assert cum[-1] == n_threads * n_iter
+
+
+def test_catalog_registers_clean():
+    """Every catalogued family obeys the lint (the validate_installation
+    check, importable form)."""
+    reg = catalog.register_all(Registry())
+    assert len(reg.families()) > 20
+    text = reg.render_prometheus()
+    parse_prometheus_text(text)  # must not raise
+    for fam in reg.families():
+        assert fam.name.startswith("areal_")
+        assert fam.help
+
+
+def test_infinity_formatting():
+    assert parse_prometheus_text("areal_x +Inf\n")[0][2] == math.inf
+
+
+def test_parse_accepts_brace_in_label_value():
+    """'}' is legal inside a quoted label value (paths, queries)."""
+    samples = parse_prometheus_text('my_metric{path="a}b{c"} 1\n')
+    assert samples == [("my_metric", {"path": "a}b{c"}, 1.0)]
+
+
+def test_parse_accepts_optional_timestamp():
+    """Exposition format 0.0.4 allows a trailing ms timestamp — scraping a
+    conformant third-party exporter must not mark the target down."""
+    samples = parse_prometheus_text(
+        'some_metric{a="b"} 5 1712345678000\nother_total 2 -1\n'
+    )
+    assert samples[0] == ("some_metric", {"a": "b"}, 5.0)
+    assert samples[1] == ("other_total", {}, 2.0)

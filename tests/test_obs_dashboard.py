@@ -1,0 +1,37 @@
+"""Tier-1 smoke for the obs dashboard (ISSUE 1 satellite: CI invokes the
+--self-test mode against a fake scrape target)."""
+
+from areal_tpu.tools import obs_dashboard
+
+
+def test_dashboard_self_test(capsys):
+    assert obs_dashboard.main(["--self-test"]) == 0
+    out = capsys.readouterr().out
+    assert "self-test OK" in out
+
+
+def test_render_frame_tokens_per_sec():
+    """Two snapshots -> a rate line derived from the counter delta."""
+    from areal_tpu.observability.aggregator import FleetSnapshot
+
+    key = ("areal_decode_generated_tokens_total", ())
+    prev = FleetSnapshot(targets=[], merged={key: 100.0}, types={}, scraped_at=10.0)
+    snap = FleetSnapshot(targets=[], merged={key: 300.0}, types={}, scraped_at=12.0)
+    frame = obs_dashboard.render_frame(snap, prev)
+    assert "tokens/s" in frame
+    assert "100.0" in frame  # (300-100)/2s
+
+
+def test_validate_installation_metrics_lint():
+    """The installation validator's metric lint passes on the catalog."""
+    import io
+    from contextlib import redirect_stdout
+
+    from areal_tpu.tools import validate_installation
+
+    # run just the lint body by invoking main and checking the metrics row
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        validate_installation.main([])
+    rows = [l for l in buf.getvalue().splitlines() if l.startswith("metrics")]
+    assert rows and "PASS" in rows[0], buf.getvalue()

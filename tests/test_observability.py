@@ -1,0 +1,351 @@
+"""Observability endpoints over the real HTTP stack: /metrics content
+negotiation, /healthz, /statusz, trace propagation through /generate, and
+controller-side fleet aggregation (ISSUE 1 tentpole)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from areal_tpu.api.config import PerfTracerConfig, ServerConfig
+from areal_tpu.api.io_struct import ModelResponse
+from areal_tpu.inference.server import ServerThread
+from areal_tpu.infra.controller.rollout_controller import RolloutController
+from areal_tpu.observability.metrics import parse_prometheus_text
+from areal_tpu.utils import perf_tracer
+
+
+class InstantEchoEngine:
+    """Minimal DecodeEngine surface: answers /generate immediately and
+    carries a stats key named 'paused' to pin the clobber fix."""
+
+    def __init__(self):
+        self.initialized = True
+        self._version = 3
+        self._paused = False
+        # 'paused' here is ENGINE data (e.g. a pause count) that the
+        # server's boolean view used to silently overwrite
+        self.stats = {"generated_tokens": 11, "paused": "engine-owned"}
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    @property
+    def is_paused(self):
+        return self._paused
+
+    def pause_generation(self):
+        self._paused = True
+
+    def continue_generation(self):
+        self._paused = False
+
+    def get_version(self):
+        return self._version
+
+    def submit(self, req, cb):
+        task_id, session_id = perf_tracer.get_task_context()
+        cb(
+            ModelResponse(
+                input_tokens=list(req.input_ids),
+                output_tokens=[1, 2],
+                output_logprobs=[-0.1, -0.2],
+                output_versions=[self._version] * 2,
+                stop_reason="stop",
+                latency=0.01,
+                ttft=0.005,
+                rid=req.rid,
+                metadata={
+                    "seen_task": task_id or "",
+                    "seen_session": session_id or "",
+                },
+            )
+        )
+
+
+@pytest.fixture(scope="module")
+def server():
+    st = ServerThread(ServerConfig(host="127.0.0.1"), InstantEchoEngine())
+    st.start()
+    yield st
+    st.stop()
+
+
+def _get(url, headers=None, timeout=10):
+    req = urllib.request.Request(url, headers=dict(headers or {}))
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.headers.get_content_type(), r.read().decode()
+
+
+def test_metrics_json_keeps_legacy_shape_and_engine_key_wins(server):
+    status, ctype, body = _get(f"http://{server.address}/metrics")
+    assert status == 200 and ctype == "application/json"
+    d = json.loads(body)
+    assert d["generated_tokens"] == 11
+    # the engine-provided 'paused' stat is NOT clobbered by the server view
+    assert d["paused"] == "engine-owned"
+    # ...and the server's boolean lives under its own authoritative key
+    # (what the client's pause-wait loop polls)
+    assert d["server_paused"] is False
+
+
+def test_metrics_prometheus_negotiated(server):
+    status, ctype, body = _get(
+        f"http://{server.address}/metrics", headers={"Accept": "text/plain"}
+    )
+    assert status == 200 and ctype == "text/plain"
+    samples = parse_prometheus_text(body)  # must parse cleanly
+    names = {n for n, _, _ in samples}
+    assert "areal_server_paused" in names
+    assert "areal_server_queue_depth" in names
+
+
+def test_healthz_statusz(server):
+    status, _, body = _get(f"http://{server.address}/healthz")
+    assert status == 200 and json.loads(body)["status"] == "ok"
+    status, _, body = _get(f"http://{server.address}/statusz")
+    d = json.loads(body)
+    assert d["role"] == "inference_server"
+    assert d["version"] == 3
+    assert d["uptime_secs"] >= 0
+    assert d["stats"]["generated_tokens"] == 11
+
+
+def test_generate_applies_trace_header_and_observes_latency(server):
+    payload = json.dumps(
+        {"input_ids": [1, 2, 3], "rid": "r1", "sampling_params": {}}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://{server.address}/generate",
+        data=payload,
+        headers={
+            "Content-Type": "application/json",
+            "x-areal-trace": "task=T9;session=S9",
+        },
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        d = json.loads(r.read())
+    assert d["output_tokens"] == [1, 2]
+    # the engine saw the propagated ids (handler seats the ContextVars)
+    # via ModelResponse.metadata — but the wire response drops metadata,
+    # so verify through the request-latency metrics instead
+    _, _, body = _get(
+        f"http://{server.address}/metrics", headers={"Accept": "text/plain"}
+    )
+    samples = {
+        (n, tuple(sorted(l.items()))): v
+        for n, l, v in parse_prometheus_text(body)
+    }
+    assert samples[("areal_server_ttft_seconds_count", ())] >= 1
+    assert samples[("areal_server_generate_seconds_count", ())] >= 1
+    assert (
+        samples[("areal_server_requests_total", (("endpoint", "generate"),))]
+        >= 1
+    )
+
+
+def test_generate_span_carries_propagated_session_id(server, tmp_path):
+    """The server-side 'server.generate' span records the session id that
+    arrived in x-areal-trace — the cross-process Perfetto join key."""
+    perf_tracer.configure(
+        PerfTracerConfig(enabled=True, output_dir=str(tmp_path)),
+        rank=0,
+        role="server",
+    )
+    try:
+        payload = json.dumps(
+            {"input_ids": [4], "rid": "r2", "sampling_params": {}}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://{server.address}/generate",
+            data=payload,
+            headers={
+                "Content-Type": "application/json",
+                "x-areal-trace": "task=TX;session=SX",
+            },
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            r.read()
+        perf_tracer.save(force=True)
+        data = json.load(open(tmp_path / "trace_server_rank0.json"))
+        spans = [
+            e
+            for e in data["traceEvents"]
+            if e["name"] == "server.generate"
+        ]
+        assert spans, "no server.generate span recorded"
+        assert spans[-1]["args"]["session_id"] == "SX"
+        assert spans[-1]["args"]["task_id"] == "TX"
+    finally:
+        perf_tracer.configure(PerfTracerConfig(enabled=False))
+
+
+def test_pause_continue_counters(server):
+    for path in ("/pause_generation", "/continue_generation"):
+        req = urllib.request.Request(
+            f"http://{server.address}{path}", data=b"{}", method="POST"
+        )
+        urllib.request.urlopen(req, timeout=10).read()
+    _, _, body = _get(
+        f"http://{server.address}/metrics", headers={"Accept": "text/plain"}
+    )
+    samples = {n: v for n, l, v in parse_prometheus_text(body) if not l}
+    assert samples["areal_server_pause_total"] >= 1
+    assert samples["areal_server_resume_total"] >= 1
+    assert samples["areal_server_paused"] == 0
+
+
+def test_controller_fleet_aggregation(server):
+    """RolloutController.start_telemetry scrapes the server fleet, merges
+    cluster-level series, and serves /metrics,/healthz,/statusz."""
+    ctl = RolloutController(scheduler=None)
+    ctl._server_addresses = [server.address]
+    url = ctl.start_telemetry(interval=0.2, timeout=5.0, retries=0)
+    try:
+        deadline = time.monotonic() + 30
+        merged = None
+        while time.monotonic() < deadline:
+            snap = ctl._aggregator.latest()
+            if snap is not None and snap.n_up == 1:
+                merged = snap
+                break
+            time.sleep(0.05)
+        assert merged is not None, "aggregator never scraped the server"
+        # the controller endpoint is reachable on localhost regardless of
+        # what gethostip() resolved to
+        port = url.rsplit(":", 1)[1]
+        base = f"http://127.0.0.1:{port}"
+        status, ctype, body = _get(f"{base}/metrics")
+        assert status == 200 and ctype == "text/plain"
+        names = {n for n, _, _ in parse_prometheus_text(body)}
+        assert "areal_server_paused" in names
+        # the aggregator's own scrape-health series ride the same endpoint
+        assert "areal_fleet_targets_up" in names
+        assert "areal_fleet_scrapes_total" in names
+        status, _, body = _get(f"{base}/healthz")
+        assert status == 200 and json.loads(body)["targets_up"] == 1
+        status, _, body = _get(f"{base}/statusz")
+        d = json.loads(body)
+        assert d["role"] == "rollout_controller"
+        assert d["targets"][0]["up"] is True
+    finally:
+        ctl.stop_telemetry()
+
+
+def test_controller_config_driven_telemetry(server):
+    """TelemetryConfig passed at construction starts the scrape loop during
+    initialize() (here via the factored bringup hook) with its knobs."""
+    from areal_tpu.api.config import TelemetryConfig
+
+    ctl = RolloutController(
+        scheduler=None,
+        telemetry=TelemetryConfig(scrape_interval_s=0.2, scrape_timeout_s=5.0),
+    )
+    ctl._server_addresses = [server.address]
+    ctl._maybe_start_config_telemetry()
+    try:
+        assert ctl.telemetry_url is not None
+        assert ctl._aggregator.timeout == 5.0
+    finally:
+        ctl.stop_telemetry()
+    # enabled=False stays off
+    ctl2 = RolloutController(
+        scheduler=None, telemetry=TelemetryConfig(enabled=False)
+    )
+    ctl2._server_addresses = [server.address]
+    ctl2._maybe_start_config_telemetry()
+    assert ctl2.telemetry_url is None
+
+
+def test_controller_config_telemetry_discovers_via_name_resolve(server):
+    """Discovery path: no explicit addresses, fleet resolved from
+    name_resolve using the engine config's experiment/trial names."""
+    from areal_tpu.api.config import InferenceEngineConfig, TelemetryConfig
+    from areal_tpu.utils import name_resolve
+
+    key = name_resolve.rollout_server_key("obs-exp", "obs-trial")
+    name_resolve.add(f"{key}/0", server.address, keepalive_ttl=None)
+    try:
+        ctl = RolloutController(
+            scheduler=None, telemetry=TelemetryConfig(scrape_interval_s=0.2)
+        )
+        cfg = InferenceEngineConfig(
+            experiment_name="obs-exp", trial_name="obs-trial"
+        )
+        ctl._maybe_start_config_telemetry(cfg)
+        try:
+            assert ctl.telemetry_url is not None
+            assert ctl._aggregator.targets == [server.address]
+        finally:
+            ctl.stop_telemetry()
+    finally:
+        name_resolve.clear_subtree(key)
+
+
+def test_telemetry_targets_include_rpc_workers(server):
+    """The default scrape set covers the RPC rollout workers too — the
+    staleness/executor families live in those processes."""
+    from areal_tpu.api.scheduler_api import Worker
+
+    ctl = RolloutController(scheduler=None)
+    ctl._server_addresses = [server.address]
+    ctl.workers = [Worker(id="w0", role="rollout", ip="127.0.0.1", ports=[9])]
+    ctl.start_telemetry(interval=60.0, timeout=1.0, retries=0)
+    try:
+        assert set(ctl._aggregator.targets) == {server.address, "127.0.0.1:9"}
+        # before the first round lands, /healthz says initializing (200)
+        port = ctl.telemetry_url.rsplit(":", 1)[1]
+        if ctl._aggregator.latest() is None:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10
+            ) as r:
+                d = json.loads(r.read())
+            assert r.status == 200 and d["status"] == "initializing"
+    finally:
+        ctl.stop_telemetry()
+
+
+def test_merged_exposition_escapes_label_values():
+    """Scraped label values are re-escaped on the controller's merged
+    /metrics so the output stays parseable."""
+    from areal_tpu.observability.aggregator import FleetSnapshot
+
+    snap = FleetSnapshot(
+        targets=[],
+        merged={("areal_x_total", (("path", 'a"b\\c'),)): 2.0},
+        types={"areal_x_total": "counter"},
+        scraped_at=0.0,
+    )
+    text = snap.render_prometheus()
+    samples = parse_prometheus_text(text)
+    assert samples[0][1]["path"] == 'a"b\\c'
+
+
+def test_controller_healthz_degraded_on_dead_target():
+    ctl = RolloutController(scheduler=None)
+    ctl._server_addresses = ["127.0.0.1:1"]  # nothing listens here
+    url = ctl.start_telemetry(interval=0.2, timeout=1.0, retries=0)
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if ctl._aggregator.latest() is not None:
+                break
+            time.sleep(0.05)
+        port = url.rsplit(":", 1)[1]
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/healthz")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                status, body = r.status, r.read()
+        except urllib.error.HTTPError as e:  # 503 raises in urllib
+            status, body = e.code, e.read()
+        assert status == 503
+        assert json.loads(body)["status"] == "degraded"
+    finally:
+        ctl.stop_telemetry()
