@@ -1,0 +1,37 @@
+"""arealint — AST-based static analysis for areal_tpu invariants.
+
+The async-RL stack is a concurrency machine whose worst bugs never crash:
+a blocking call starving the rollout event loop, a side effect captured
+inside a ``jax.jit`` trace, a config field drifting from its dataclass, a
+metric name drifting from the catalog. These corrupt throughput or
+training signal silently. This package makes those invariants
+machine-checked: a rule engine (`core`), five rule families (`rules/`),
+and a burn-down baseline (`baseline.json`) so the gate is
+zero-new-findings from day one.
+
+Entry points:
+  - CLI: ``python -m areal_tpu.tools.arealint [paths]``
+  - API: :func:`run_analysis`
+"""
+
+from areal_tpu.analysis.core import (
+    Analyzer,
+    AnalysisResult,
+    Finding,
+    ProjectContext,
+    SourceFile,
+    default_baseline_path,
+    default_package_root,
+    run_analysis,
+)
+
+__all__ = [
+    "Analyzer",
+    "AnalysisResult",
+    "Finding",
+    "ProjectContext",
+    "SourceFile",
+    "default_baseline_path",
+    "default_package_root",
+    "run_analysis",
+]

@@ -1,0 +1,565 @@
+"""arealint core: source loading, suppressions, project context, rule engine.
+
+Design:
+  - Every rule family is a class with a ``FAMILY`` prefix (ASY/JAX/THR/
+    CFG/OBS), a ``RULES`` table (id -> one-line title), and a
+    ``check(sf, ctx)`` generator yielding :class:`Finding`.
+  - Findings carry a line number for humans and a line-independent ``key``
+    for the baseline, so baselined findings survive unrelated edits that
+    shift line numbers.
+  - Suppressions are comments: ``# arealint: disable=ASY001 reason`` on
+    the finding line, ``# arealint: disable-next=ASY001 reason`` on the
+    line above, or ``# arealint: disable-file=OBS001 reason`` anywhere for
+    the whole file (``# arealint: skip-file`` excludes the file entirely).
+    ``disable=all`` and family prefixes (``disable=THR``) are accepted.
+    Comments are located with :mod:`tokenize`, so a ``#`` inside a string
+    literal can never suppress anything.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import re
+import tokenize
+from collections import Counter
+from pathlib import Path
+from typing import Iterable, Iterator
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+_SUPPRESS_RE = re.compile(
+    r"arealint:\s*(?P<kind>disable(?:-next|-file)?|skip-file)"
+    r"(?:\s*=\s*(?P<rules>[A-Za-z0-9_,]+))?"
+    r"(?:\s+(?P<reason>\S.*))?"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a specific site."""
+
+    rule: str  # e.g. "ASY001"
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+    severity: str = SEVERITY_ERROR
+    # line-independent identity used for baseline matching:
+    #   rule:path:scope:token  (scope = enclosing def/class qualname,
+    #   token = rule-specific detail such as the callee or attribute name)
+    key: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "severity": self.severity,
+            "key": self.key,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} [{self.severity}] {self.message}"
+
+
+def make_key(rule: str, path: str, scope: str, token: str) -> str:
+    return f"{rule}:{path}:{scope}:{token}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Suppression:
+    rules: frozenset[str]  # rule ids, family prefixes, or {"all"}
+    reason: str
+
+    def covers(self, rule: str) -> bool:
+        if "all" in self.rules:
+            return True
+        if rule in self.rules:
+            return True
+        # family prefix, e.g. disable=THR covers THR001
+        return any(rule.startswith(r) and r.isalpha() for r in self.rules)
+
+
+class SourceFile:
+    """A parsed module plus the comment-derived suppression table."""
+
+    def __init__(self, path: Path, relpath: str, text: str, tree: ast.Module):
+        self.path = path
+        self.relpath = relpath
+        self.text = text
+        self.tree = tree
+        self.suppressions: dict[int, Suppression] = {}
+        self.file_suppression: Suppression | None = None
+        self.skip_file = False
+        self._parents: dict[int, ast.AST] | None = None
+        self._parse_suppressions()
+
+    @classmethod
+    def load(cls, path: Path, repo_root: Path) -> "SourceFile":
+        text = path.read_text(encoding="utf-8", errors="replace")
+        tree = ast.parse(text, filename=str(path))
+        try:
+            rel = path.resolve().relative_to(repo_root.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        return cls(path, rel, text, tree)
+
+    def _parse_suppressions(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            comments = [
+                (t.start[0], t.string) for t in tokens if t.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            comments = []
+        lines = self.text.splitlines()
+        for lineno, comment in comments:
+            m = _SUPPRESS_RE.search(comment)
+            if not m:
+                continue
+            kind = m.group("kind")
+            if kind == "skip-file":
+                self.skip_file = True
+                continue
+            rules = frozenset(
+                r.strip() for r in (m.group("rules") or "all").split(",") if r.strip()
+            )
+            sup = Suppression(rules=rules, reason=(m.group("reason") or "").strip())
+            if kind == "disable-file":
+                prev = self.file_suppression
+                if prev is not None:
+                    sup = Suppression(
+                        rules=prev.rules | sup.rules,
+                        reason=(prev.reason + "; " + sup.reason).strip("; "),
+                    )
+                self.file_suppression = sup
+                continue
+            if kind == "disable-next":
+                # covers the full extent of the statement STARTING on the
+                # next line (a wrapped call anchors findings on its first
+                # physical line, but inner nodes may anchor deeper)
+                targets = self._stmt_extent(lineno + 1, starting=True)
+            else:
+                # trailing comment: covers the whole multi-line statement it
+                # trails — but ONLY when there is code on the comment's own
+                # line; a standalone comment inside a function must not
+                # blanket the enclosing block (use disable-next for that)
+                code = lines[lineno - 1] if lineno <= len(lines) else ""
+                has_code = code.split("#", 1)[0].strip() != ""
+                targets = (
+                    self._stmt_extent(lineno, starting=False)
+                    if has_code
+                    else [lineno]
+                )
+            for target in targets:
+                prev = self.suppressions.get(target)
+                merged = sup
+                if prev is not None:
+                    merged = Suppression(
+                        rules=prev.rules | sup.rules,
+                        reason=(prev.reason + "; " + sup.reason).strip("; "),
+                    )
+                self.suppressions[target] = merged
+
+    def _stmt_extent(self, line: int, starting: bool) -> list[int]:
+        """Lines of the smallest statement containing ``line`` (or, with
+        ``starting=True``, beginning exactly at ``line``). Falls back to
+        ``[line]`` when no statement matches."""
+        best: tuple[int, int] | None = None
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.stmt):
+                continue
+            end = getattr(node, "end_lineno", node.lineno) or node.lineno
+            if starting:
+                if node.lineno != line:
+                    continue
+            elif not (node.lineno <= line <= end):
+                continue
+            if best is None or (end - node.lineno) < (best[1] - best[0]):
+                best = (node.lineno, end)
+        if best is None:
+            return [line]
+        return list(range(best[0], best[1] + 1))
+
+    def suppressed(self, finding: Finding) -> bool:
+        if self.file_suppression is not None and self.file_suppression.covers(
+            finding.rule
+        ):
+            return True
+        sup = self.suppressions.get(finding.line)
+        return sup is not None and sup.covers(finding.rule)
+
+    @property
+    def parents(self) -> dict[int, ast.AST]:
+        """id(node) -> parent node map, built lazily once per file."""
+        if self._parents is None:
+            self._parents = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    self._parents[id(child)] = node
+        return self._parents
+
+    def scope_of(self, node: ast.AST) -> str:
+        """Dotted qualname of the enclosing def/class chain ("<module>" at
+        top level). Used for stable finding keys."""
+        names: list[str] = []
+        cur = self.parents.get(id(node))
+        while cur is not None:
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                names.append(cur.name)
+            cur = self.parents.get(id(cur))
+        return ".".join(reversed(names)) or "<module>"
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """Render a Name/Attribute chain as "a.b.c" (None for anything else)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def config_class_of_annotation(
+    ann: ast.expr | None, names: "set[str] | dict"
+) -> str | None:
+    """The single config-class name an annotation refers to, if exactly one
+    of ``names`` appears in it (handles string annotations and unions like
+    ``X | None`` / ``Optional[X]``). Shared by the context builder and the
+    CFG rule so both sides accept the same annotation shapes."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    hits = {
+        n.id for n in ast.walk(ann) if isinstance(n, ast.Name) and n.id in names
+    }
+    return hits.pop() if len(hits) == 1 else None
+
+
+def default_package_root() -> Path:
+    """The areal_tpu package directory (this file lives in its analysis/)."""
+    return Path(__file__).resolve().parents[1]
+
+
+def default_baseline_path() -> Path:
+    return default_package_root() / "analysis" / "baseline.json"
+
+
+# ---------------------------------------------------------------------------
+# Project context: facts extracted once from the package the rules check
+# against (config dataclass fields, metric catalog names).
+# ---------------------------------------------------------------------------
+
+
+class ProjectContext:
+    def __init__(self, package_root: Path):
+        self.package_root = package_root
+        self.repo_root = package_root.parent
+        # config dataclasses (api/config.py): class -> own+inherited fields
+        self.config_fields: dict[str, set[str]] = {}
+        # class -> field -> config-class name of the field's annotation
+        # (None when the annotation is not another config dataclass)
+        self.config_field_types: dict[str, dict[str, str | None]] = {}
+        # methods/properties defined on config classes (allowed accesses)
+        self.config_methods: dict[str, set[str]] = {}
+        # metric catalog (observability/catalog.py)
+        self.metric_names: set[str] = set()
+        self.metric_prefixes: set[str] = set()
+        self.catalog_relpath = "areal_tpu/observability/catalog.py"
+        self._build_config_registry()
+        self._build_metric_catalog()
+
+    # -- config dataclasses ------------------------------------------------
+    def _build_config_registry(self) -> None:
+        path = self.package_root / "api" / "config.py"
+        if not path.exists():
+            return
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+        own_fields: dict[str, list[tuple[str, ast.expr | None]]] = {}
+        bases: dict[str, list[str]] = {}
+        methods: dict[str, set[str]] = {}
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            is_dc = any(
+                (isinstance(d, ast.Name) and d.id == "dataclass")
+                or (isinstance(d, ast.Attribute) and d.attr == "dataclass")
+                or (
+                    isinstance(d, ast.Call)
+                    and dotted_name(d.func) in ("dataclass", "dataclasses.dataclass")
+                )
+                for d in node.decorator_list
+            )
+            if not is_dc:
+                continue
+            flds: list[tuple[str, ast.expr | None]] = []
+            meths: set[str] = set()
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    flds.append((stmt.target.id, stmt.annotation))
+                elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    meths.add(stmt.name)
+            own_fields[node.name] = flds
+            bases[node.name] = [
+                b.id for b in node.bases if isinstance(b, ast.Name)
+            ]
+            methods[node.name] = meths
+
+        def resolve(cls: str, seen: frozenset[str]) -> list[tuple[str, ast.expr | None]]:
+            if cls not in own_fields or cls in seen:
+                return []
+            out: list[tuple[str, ast.expr | None]] = []
+            for b in bases.get(cls, []):
+                out.extend(resolve(b, seen | {cls}))
+            out.extend(own_fields[cls])
+            return out
+
+        for cls in own_fields:
+            resolved = resolve(cls, frozenset())
+            self.config_fields[cls] = {n for n, _ in resolved}
+            self.config_methods[cls] = set()
+            for b in [cls] + bases.get(cls, []):
+                self.config_methods[cls] |= methods.get(b, set())
+            # field -> nested config class (for attribute-chain resolution)
+            ftypes: dict[str, str | None] = {}
+            for name, ann in resolved:
+                ftypes[name] = config_class_of_annotation(ann, own_fields)
+            self.config_field_types[cls] = ftypes
+
+    # -- metric catalog ----------------------------------------------------
+    def _build_metric_catalog(self) -> None:
+        path = self.package_root / "observability" / "catalog.py"
+        if not path.exists():
+            return
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+        for call in ast.walk(tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if not (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr in ("counter", "gauge", "histogram")
+            ):
+                continue
+            name = const_str(call.args[0]) if call.args else None
+            if name and name.startswith("areal_"):
+                self.metric_names.add(name)
+        self.metric_prefixes = {
+            "_".join(n.split("_")[:2]) for n in self.metric_names
+        }
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: list[Finding]  # non-suppressed, non-baselined
+    baselined: list[Finding]
+    suppressed: list[Finding]
+    stale_baseline: list[dict]  # baseline entries no current finding matches
+    files_checked: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "ok": self.ok,
+            "files_checked": self.files_checked,
+            "findings": [f.to_dict() for f in self.findings],
+            "baselined": len(self.baselined),
+            "suppressed": len(self.suppressed),
+            "stale_baseline": self.stale_baseline,
+        }
+
+
+def iter_python_files(paths: Iterable[Path]) -> Iterator[Path]:
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            # hidden-dir filter applies only BELOW the requested root, so a
+            # repo living under a dotted parent directory still analyzes
+            yield from sorted(
+                f
+                for f in p.rglob("*.py")
+                if not any(
+                    part.startswith(".") for part in f.relative_to(p).parts
+                )
+            )
+        elif p.suffix == ".py":
+            yield p
+
+
+class Analyzer:
+    def __init__(
+        self,
+        rules: Iterable[str] | None = None,
+        package_root: Path | None = None,
+    ):
+        from areal_tpu.analysis.rules import all_checkers
+
+        self.context = ProjectContext(package_root or default_package_root())
+        self.checkers = all_checkers()
+        if rules:
+            wanted = {r.strip() for r in rules if r.strip()}
+            known = {c.FAMILY for c in self.checkers} | {
+                r for c in self.checkers for r in c.RULES
+            }
+            unknown = wanted - known
+            if unknown:
+                # a typo'd rule selection must never silently check nothing
+                raise ValueError(
+                    f"unknown rule(s) {sorted(unknown)}; "
+                    f"known: {sorted(known)}"
+                )
+            self.checkers = [
+                c
+                for c in self.checkers
+                if c.FAMILY in wanted or any(r in wanted for r in c.RULES)
+            ]
+            for c in self.checkers:
+                if c.FAMILY not in wanted:
+                    c.only_rules = {r for r in c.RULES if r in wanted}
+
+    def rule_table(self) -> dict[str, str]:
+        table: dict[str, str] = {}
+        for c in self.checkers:
+            table.update(c.RULES)
+        return dict(sorted(table.items()))
+
+    def run(
+        self,
+        paths: Iterable[Path],
+        baseline: dict | None = None,
+    ) -> AnalysisResult:
+        findings: list[Finding] = []
+        suppressed: list[Finding] = []
+        n_files = 0
+        for path in iter_python_files(paths):
+            n_files += 1
+            try:
+                sf = SourceFile.load(Path(path), self.context.repo_root)
+            except SyntaxError as e:
+                rel = Path(path).as_posix()
+                findings.append(
+                    Finding(
+                        rule="PARSE",
+                        path=rel,
+                        line=e.lineno or 1,
+                        message=f"syntax error: {e.msg}",
+                        key=make_key("PARSE", rel, "<module>", "syntax"),
+                    )
+                )
+                continue
+            if sf.skip_file:
+                continue
+            for checker in self.checkers:
+                for f in checker.check(sf, self.context):
+                    only = getattr(checker, "only_rules", None)
+                    if only and f.rule not in only:
+                        continue
+                    if sf.suppressed(f):
+                        suppressed.append(f)
+                    else:
+                        findings.append(f)
+        findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+        suppressed.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+
+        baselined: list[Finding] = []
+        stale: list[dict] = []
+        if baseline:
+            budget = Counter(
+                e["key"] for e in baseline.get("findings", []) if e.get("key")
+            )
+            fresh: list[Finding] = []
+            for f in findings:
+                if budget.get(f.key, 0) > 0:
+                    budget[f.key] -= 1
+                    baselined.append(f)
+                else:
+                    fresh.append(f)
+            findings = fresh
+            leftover = +budget  # strips zero/negative counts
+            for e in baseline.get("findings", []):
+                if leftover.get(e.get("key", ""), 0) > 0:
+                    leftover[e["key"]] -= 1
+                    stale.append(e)
+        return AnalysisResult(
+            findings=findings,
+            baselined=baselined,
+            suppressed=suppressed,
+            stale_baseline=stale,
+            files_checked=n_files,
+        )
+
+
+def load_baseline(path: Path) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "findings" not in data:
+        raise ValueError(f"malformed baseline file {path}")
+    return data
+
+
+def render_baseline(
+    findings: Iterable[Finding], old: dict | None = None
+) -> dict:
+    """Baseline document for the given findings, carrying over reasons from
+    ``old`` for keys that persist (new entries get an empty reason that a
+    human must fill in — the gate test enforces non-empty reasons)."""
+    reasons: dict[str, str] = {}
+    if old:
+        for e in old.get("findings", []):
+            if e.get("reason"):
+                reasons.setdefault(e.get("key", ""), e["reason"])
+    entries = [
+        {
+            "rule": f.rule,
+            "path": f.path,
+            "key": f.key,
+            "message": f.message,
+            "reason": reasons.get(f.key, ""),
+        }
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+    ]
+    return {"version": 1, "findings": entries}
+
+
+def run_analysis(
+    paths: Iterable[Path],
+    rules: Iterable[str] | None = None,
+    baseline_path: Path | None = None,
+    package_root: Path | None = None,
+) -> AnalysisResult:
+    """One-call API: analyze ``paths`` with the given rule families against
+    the baseline at ``baseline_path`` (pass None to disable baselining)."""
+    analyzer = Analyzer(rules=rules, package_root=package_root)
+    baseline = None
+    if baseline_path is not None and Path(baseline_path).exists():
+        baseline = load_baseline(Path(baseline_path))
+    return analyzer.run(paths, baseline=baseline)

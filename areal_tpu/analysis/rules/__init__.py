@@ -1,0 +1,23 @@
+"""arealint rule families.
+
+Each module exposes one checker class; :func:`all_checkers` returns fresh
+instances in deterministic order.
+"""
+
+from __future__ import annotations
+
+
+def all_checkers() -> list:
+    from areal_tpu.analysis.rules.asy import AsyncSafetyChecker
+    from areal_tpu.analysis.rules.cfg import ConfigDriftChecker
+    from areal_tpu.analysis.rules.jaxpurity import JaxPurityChecker
+    from areal_tpu.analysis.rules.obs import MetricCatalogChecker
+    from areal_tpu.analysis.rules.thr import SharedStateChecker
+
+    return [
+        AsyncSafetyChecker(),
+        JaxPurityChecker(),
+        SharedStateChecker(),
+        ConfigDriftChecker(),
+        MetricCatalogChecker(),
+    ]

@@ -1,0 +1,216 @@
+"""ASY — blocking calls reachable from ``async def`` bodies.
+
+The rollout side runs thousands of concurrent coroutines on ONE event loop
+(infra/async_task_runner.py). A single ``time.sleep`` or synchronous HTTP
+call inside any of them stalls every in-flight generation at once — the
+classic async-RL throughput bug that never raises. Rules:
+
+  ASY001  ``time.sleep`` in an async function (use ``await asyncio.sleep``)
+  ASY002  synchronous I/O (urllib/requests/http.client/socket/subprocess)
+          in an async function (use aiohttp / run_in_executor)
+  ASY003  blocking lock acquisition in an async function: un-awaited
+          ``*.acquire()`` or ``with <lock-like attr>:`` (a threading lock
+          held across the loop blocks every other coroutine)
+  ASY004  call from an async function into a local sync helper that itself
+          blocks (one-hop reachability)
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from areal_tpu.analysis.core import (
+    Finding,
+    ProjectContext,
+    SourceFile,
+    dotted_name,
+    make_key,
+)
+
+# dotted callee -> rule id
+_BLOCKING = {
+    "time.sleep": "ASY001",
+    "urllib.request.urlopen": "ASY002",
+    "socket.create_connection": "ASY002",
+    "os.system": "ASY002",
+    "subprocess.run": "ASY002",
+    "subprocess.call": "ASY002",
+    "subprocess.check_call": "ASY002",
+    "subprocess.check_output": "ASY002",
+    "http.client.HTTPConnection": "ASY002",
+    "http.client.HTTPSConnection": "ASY002",
+}
+_REQUESTS_METHODS = {
+    "get", "post", "put", "delete", "head", "patch", "options", "request",
+}
+_LOCKISH_RE = re.compile(r"(^|_)(lock|cv|cond|mutex|sem)")
+
+
+def _blocking_rule(call: ast.Call) -> tuple[str, str] | None:
+    """(rule_id, token) when ``call`` is a known blocking call."""
+    dotted = dotted_name(call.func)
+    if dotted is None:
+        return None
+    if dotted in _BLOCKING:
+        return _BLOCKING[dotted], dotted
+    parts = dotted.split(".")
+    if parts[0] == "requests" and parts[-1] in _REQUESTS_METHODS:
+        return "ASY002", dotted
+    if parts[-1] == "acquire" and len(parts) > 1:
+        return "ASY003", dotted
+    return None
+
+
+class AsyncSafetyChecker:
+    FAMILY = "ASY"
+    RULES = {
+        "ASY001": "time.sleep inside an async function",
+        "ASY002": "synchronous I/O inside an async function",
+        "ASY003": "blocking lock acquisition inside an async function",
+        "ASY004": "async function calls a local helper that blocks",
+    }
+
+    def check(self, sf: SourceFile, ctx: ProjectContext) -> Iterator[Finding]:
+        tree = sf.tree
+        awaited = {id(n.value) for n in ast.walk(tree) if isinstance(n, ast.Await)}
+
+        # -- pass 1: sync defs (module-level or methods) that block -------
+        # maps "name" and "self.name" call shapes to the first blocking
+        # line inside the helper, for ASY004 one-hop reachability. Only the
+        # helper's OWN body counts: nested defs are separate callables whose
+        # blocking calls must not be attributed to the enclosing function.
+        def own_nodes(fn: ast.FunctionDef):
+            stack = list(fn.body)
+            while stack:
+                n = stack.pop()
+                yield n
+                if not isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    stack.extend(ast.iter_child_nodes(n))
+
+        # blockers are scoped: module-level helpers by bare name, methods by
+        # (class, name) — a blocking `A.flush` must never be attributed to
+        # an unrelated `B.flush` called as `self.flush()` elsewhere
+        module_blockers: dict[str, tuple[str, int]] = {}
+        method_blockers: dict[tuple[str, str], tuple[str, int]] = {}
+
+        def first_block(fn: ast.FunctionDef) -> tuple[str, int] | None:
+            for sub in own_nodes(fn):
+                if isinstance(sub, ast.Call):
+                    hit = _blocking_rule(sub)
+                    if hit and hit[0] in ("ASY001", "ASY002"):
+                        return (hit[1], sub.lineno)
+            return None
+
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                hit = first_block(node)
+                if hit:
+                    module_blockers[node.name] = hit
+            elif isinstance(node, ast.ClassDef):
+                for meth in node.body:
+                    if isinstance(meth, ast.FunctionDef):
+                        hit = first_block(meth)
+                        if hit:
+                            method_blockers[(node.name, meth.name)] = hit
+
+        def enclosing_class(node: ast.AST) -> str | None:
+            cur = sf.parents.get(id(node))
+            while cur is not None:
+                if isinstance(cur, ast.ClassDef):
+                    return cur.name
+                cur = sf.parents.get(id(cur))
+            return None
+
+        # -- pass 2: walk async bodies ------------------------------------
+        def visit(node: ast.AST, in_async: bool) -> Iterator[Finding]:
+            if isinstance(node, ast.AsyncFunctionDef):
+                for child in node.body:
+                    yield from visit(child, True)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.Lambda, ast.ClassDef)):
+                body = node.body if not isinstance(node, ast.Lambda) else [node.body]
+                for child in body:
+                    yield from visit(child, False)
+                return
+            if in_async and isinstance(node, ast.Call) and id(node) not in awaited:
+                hit = _blocking_rule(node)
+                if hit:
+                    rule, token = hit
+                    hint = {
+                        "ASY001": "use `await asyncio.sleep(...)`",
+                        "ASY002": "use aiohttp or `loop.run_in_executor`",
+                        "ASY003": "a threading lock blocks the whole event loop",
+                    }[rule]
+                    yield Finding(
+                        rule=rule,
+                        path=sf.relpath,
+                        line=node.lineno,
+                        message=f"blocking call `{token}` in async context; {hint}",
+                        key=make_key(rule, sf.relpath, sf.scope_of(node), token),
+                    )
+                else:
+                    # one-hop: plain-name call into a module-level helper,
+                    # or self-method call into a method of THIS class
+                    callee = None
+                    blocked = None
+                    if isinstance(node.func, ast.Name):
+                        callee = node.func.id
+                        blocked = module_blockers.get(callee)
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                    ):
+                        callee = node.func.attr
+                        cls = enclosing_class(node)
+                        if cls is not None:
+                            blocked = method_blockers.get((cls, callee))
+                    if blocked is not None:
+                        blocked_by, bline = blocked
+                        yield Finding(
+                            rule="ASY004",
+                            path=sf.relpath,
+                            line=node.lineno,
+                            message=(
+                                f"async context calls `{callee}` which blocks "
+                                f"(`{blocked_by}` at line {bline}); run it in "
+                                "an executor or make it async"
+                            ),
+                            key=make_key(
+                                "ASY004", sf.relpath, sf.scope_of(node), callee
+                            ),
+                        )
+            if in_async and isinstance(node, ast.With):
+                for item in node.items:
+                    ce = item.context_expr
+                    if (
+                        isinstance(ce, ast.Attribute)
+                        and isinstance(ce.value, ast.Name)
+                        and ce.value.id == "self"
+                        and _LOCKISH_RE.search(ce.attr)
+                    ):
+                        yield Finding(
+                            rule="ASY003",
+                            path=sf.relpath,
+                            line=node.lineno,
+                            message=(
+                                f"`with self.{ce.attr}:` in async context "
+                                "blocks the event loop while contended; use "
+                                "an asyncio primitive"
+                            ),
+                            key=make_key(
+                                "ASY003",
+                                sf.relpath,
+                                sf.scope_of(node),
+                                f"with:self.{ce.attr}",
+                            ),
+                        )
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, in_async)
+
+        for top in tree.body:
+            yield from visit(top, False)

@@ -1,0 +1,333 @@
+"""CFG — config-field drift between api/config.py dataclasses and call sites.
+
+The config tree is plain dataclasses with no runtime attribute checking on
+reads: ``cfg.max_concurent_rollouts`` (typo) raises AttributeError only on
+the code path that executes it — in async RL that is often a rarely-taken
+branch deep inside a worker. The rule type-tracks variables annotated or
+constructed as api/config.py dataclasses (including ``self.config = ...``
+captures and nested section chains like ``cfg.saver.freq_steps``) and
+flags accesses that name no declared field. Scopes are walked with proper
+environment chaining: a nested function inherits the enclosing bindings
+minus any name it rebinds, so an inner parameter shadowing ``cfg`` never
+borrows the outer type. Rules:
+
+  CFG001  attribute access on a config dataclass that names no declared field
+  CFG002  constructor keyword that names no declared field
+  CFG003  ``getattr(cfg, "literal", default)`` whose literal names no
+          declared field — the default silently masks drift: a typo in the
+          literal (or a removed field) makes the call ALWAYS take the
+          fallback, with no error on any path
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.analysis.core import (
+    Finding,
+    ProjectContext,
+    SourceFile,
+    config_class_of_annotation,
+    make_key,
+)
+
+_ALLOWED = {
+    "__class__",
+    "__dict__",
+    "__doc__",
+    "__dataclass_fields__",
+    "__module__",
+}
+
+_DEF = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _own_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Nodes of ``fn``'s own body, yielding nested def/lambda/class nodes
+    themselves but not descending into them (separate scopes)."""
+    body = [fn.body] if isinstance(fn, ast.Lambda) else list(fn.body)
+    stack: list[ast.AST] = body
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, _DEF + (ast.Lambda, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _params_of(fn: ast.AST) -> list[ast.arg]:
+    a = fn.args
+    out = list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+    if a.vararg:
+        out.append(a.vararg)
+    if a.kwarg:
+        out.append(a.kwarg)
+    return out
+
+
+def _bound_names(fn: ast.AST) -> set[str]:
+    """Names (re)bound inside ``fn``'s own scope — these shadow the
+    enclosing environment for nested lookups."""
+    names = {p.arg for p in _params_of(fn)}
+    for n in _own_nodes(fn):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                names |= {x.id for x in ast.walk(t) if isinstance(x, ast.Name)}
+        elif isinstance(n, (ast.AnnAssign, ast.AugAssign, ast.NamedExpr)):
+            if isinstance(n.target, ast.Name):
+                names.add(n.target.id)
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            names |= {x.id for x in ast.walk(n.target) if isinstance(x, ast.Name)}
+        elif isinstance(n, _DEF + (ast.ClassDef,)):
+            names.add(n.name)
+    return names
+
+
+class ConfigDriftChecker:
+    FAMILY = "CFG"
+    RULES = {
+        "CFG001": "attribute access names no declared config field",
+        "CFG002": "constructor keyword names no declared config field",
+        "CFG003": "getattr literal names no declared config field",
+    }
+
+    def check(self, sf: SourceFile, ctx: ProjectContext) -> Iterator[Finding]:
+        if not ctx.config_fields:
+            return
+        # the registry source itself defines the classes; analyzing it
+        # against itself only produces noise on the loader helpers
+        if sf.relpath.endswith("api/config.py"):
+            return
+        registry = ctx.config_fields
+        # skip shadowed names: a module defining its own class of the same
+        # name is not talking about the config tree
+        shadowed = {
+            n.name
+            for n in ast.walk(sf.tree)
+            if isinstance(n, ast.ClassDef) and n.name in registry
+        }
+        known_names = set(registry) - shadowed
+
+        def class_of_annotation(ann: ast.expr | None) -> str | None:
+            return config_class_of_annotation(ann, known_names)
+
+        def class_of_call(call: ast.Call) -> str | None:
+            name = None
+            if isinstance(call.func, ast.Name):
+                name = call.func.id
+            elif isinstance(call.func, ast.Attribute):
+                name = call.func.attr
+            return name if name in known_names else None
+
+        # -- per-class: self.<attr> captures of config-typed values --------
+        # (class name, attr) -> config class
+        self_attr_types: dict[tuple[str, str], str] = {}
+        for cls in (n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)):
+            for meth in (n for n in cls.body if isinstance(n, _DEF)):
+                param_types = {
+                    a.arg: class_of_annotation(a.annotation)
+                    for a in _params_of(meth)
+                }
+                for stmt in _own_nodes(meth):
+                    if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    targets = (
+                        stmt.targets
+                        if isinstance(stmt, ast.Assign)
+                        else [stmt.target]
+                    )
+                    value = stmt.value
+                    vtype: str | None = None
+                    if isinstance(stmt, ast.AnnAssign):
+                        vtype = class_of_annotation(stmt.annotation)
+                    if vtype is None and isinstance(value, ast.Name):
+                        vtype = param_types.get(value.id)
+                    if vtype is None and isinstance(value, ast.Call):
+                        vtype = class_of_call(value)
+                    if vtype is None:
+                        continue
+                    for t in targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            key = (cls.name, t.attr)
+                            if self_attr_types.get(key, vtype) != vtype:
+                                self_attr_types[key] = "__conflict__"
+                            else:
+                                self_attr_types[key] = vtype
+
+        def type_of(
+            expr: ast.AST, env: dict[str, str], cls_name: str | None
+        ) -> str | None:
+            if isinstance(expr, ast.Name):
+                return env.get(expr.id)
+            if isinstance(expr, ast.Call):
+                return class_of_call(expr)
+            if isinstance(expr, ast.Attribute):
+                if (
+                    isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"
+                    and cls_name is not None
+                ):
+                    t = self_attr_types.get((cls_name, expr.attr))
+                    if t and t != "__conflict__":
+                        return t
+                base = type_of(expr.value, env, cls_name)
+                if base is None:
+                    return None
+                return ctx.config_field_types.get(base, {}).get(expr.attr)
+            return None
+
+        def check_ctor_kwargs(node: ast.Call) -> Iterator[Finding]:
+            base = class_of_call(node)
+            if base is None:
+                return
+            fields = ctx.config_fields.get(base, set())
+            for kw in node.keywords:
+                if kw.arg is not None and kw.arg not in fields:
+                    yield Finding(
+                        rule="CFG002",
+                        path=sf.relpath,
+                        line=node.lineno,
+                        message=(
+                            f"`{base}(...)` has no field `{kw.arg}`; the "
+                            "constructor will raise TypeError at runtime"
+                        ),
+                        key=make_key(
+                            "CFG002",
+                            sf.relpath,
+                            sf.scope_of(node),
+                            f"{base}.{kw.arg}",
+                        ),
+                    )
+
+        def allowed_attrs(base: str) -> set[str]:
+            return (
+                ctx.config_fields.get(base, set())
+                | ctx.config_methods.get(base, set())
+                | _ALLOWED
+            )
+
+        seen_calls: set[int] = set()
+
+        def check_scope(
+            fn: ast.AST, outer_env: dict[str, str], cls_name: str | None
+        ) -> Iterator[Finding]:
+            """Check one function scope with proper environment chaining,
+            then recurse into nested scopes."""
+            env = {
+                k: v for k, v in outer_env.items() if k not in _bound_names(fn)
+            }
+            for p in _params_of(fn):
+                t = class_of_annotation(p.annotation)
+                if t:
+                    env[p.arg] = t
+            for stmt in _own_nodes(fn):
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    t = class_of_annotation(stmt.annotation)
+                    if t:
+                        env[stmt.target.id] = t
+                elif isinstance(stmt, ast.Assign):
+                    t = None
+                    if isinstance(stmt.value, ast.Call):
+                        t = class_of_call(stmt.value)
+                    elif (
+                        isinstance(stmt.value, ast.Attribute)
+                        and isinstance(stmt.value.value, ast.Name)
+                        and stmt.value.value.id == "self"
+                        and cls_name is not None
+                    ):
+                        cand = self_attr_types.get((cls_name, stmt.value.attr))
+                        if cand and cand != "__conflict__":
+                            t = cand
+                    if t:
+                        for tgt in stmt.targets:
+                            if isinstance(tgt, ast.Name):
+                                env[tgt.id] = t
+
+            for node in _own_nodes(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "getattr"
+                    and len(node.args) >= 2
+                ):
+                    seen_calls.add(id(node))
+                    base = type_of(node.args[0], env, cls_name)
+                    name = None
+                    if isinstance(node.args[1], ast.Constant) and isinstance(
+                        node.args[1].value, str
+                    ):
+                        name = node.args[1].value
+                    if base is not None and name is not None:
+                        if name not in allowed_attrs(base):
+                            yield Finding(
+                                rule="CFG003",
+                                path=sf.relpath,
+                                line=node.lineno,
+                                message=(
+                                    f"getattr names `{name}`, which is not "
+                                    f"a declared field of `{base}` — the "
+                                    "fallback masks drift (declare the "
+                                    "field, or suppress with the subclass "
+                                    "that provides it)"
+                                ),
+                                key=make_key(
+                                    "CFG003",
+                                    sf.relpath,
+                                    sf.scope_of(node),
+                                    f"{base}.{name}",
+                                ),
+                            )
+                elif isinstance(node, ast.Attribute):
+                    base = type_of(node.value, env, cls_name)
+                    if base is not None and node.attr not in allowed_attrs(base):
+                        yield Finding(
+                            rule="CFG001",
+                            path=sf.relpath,
+                            line=node.lineno,
+                            message=(
+                                f"`{base}` has no field `{node.attr}` "
+                                "(declared fields: see api/config.py)"
+                            ),
+                            key=make_key(
+                                "CFG001",
+                                sf.relpath,
+                                sf.scope_of(node),
+                                f"{base}.{node.attr}",
+                            ),
+                        )
+                elif isinstance(node, ast.Call):
+                    seen_calls.add(id(node))
+                    yield from check_ctor_kwargs(node)
+
+            # nested scopes inherit this env (minus their own bindings)
+            for node in _own_nodes(fn):
+                if isinstance(node, _DEF + (ast.Lambda,)):
+                    yield from check_scope(node, env, cls_name)
+                elif isinstance(node, ast.ClassDef):
+                    for meth in node.body:
+                        if isinstance(meth, _DEF):
+                            yield from check_scope(meth, env, node.name)
+
+        # drive: every def not nested inside another def, with class context
+        def scan(node: ast.AST, cls_name: str | None) -> Iterator[Finding]:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _DEF):
+                    yield from check_scope(child, {}, cls_name)
+                elif isinstance(child, ast.ClassDef):
+                    yield from scan(child, child.name)
+                elif not isinstance(child, ast.Lambda):
+                    yield from scan(child, cls_name)
+
+        yield from scan(sf.tree, None)
+
+        # constructor kwargs are checkable anywhere, including module scope
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and id(node) not in seen_calls:
+                seen_calls.add(id(node))
+                yield from check_ctor_kwargs(node)

@@ -1,0 +1,380 @@
+"""JAX — purity of functions captured by jit/pjit/scan/grad traces.
+
+XLA traces a Python function ONCE per (shape, dtype) signature and replays
+the compiled artifact forever after. Any side effect inside the traced
+function — a print, a logger call, host RNG, mutation of ``self`` — runs
+only during tracing and silently disappears (or worse, bakes a
+trace-time value into every subsequent step). Iterating a ``set`` during
+tracing produces host-dependent HLO: two processes of an SPMD job can
+compile DIFFERENT programs and deadlock at the first collective. Rules:
+
+  JAX001  print/logging inside a traced function (runs at trace time only)
+  JAX002  host RNG or wall-clock read inside a traced function (frozen
+          into the compiled program; use jax.random with threaded keys)
+  JAX003  mutation of enclosing state (``self.x = ...``, global/nonlocal)
+          inside a traced function (applied at trace time only)
+  JAX004  iteration over a set inside a traced function (nondeterministic
+          trace order; SPMD processes may compile different programs)
+  JAX005  dynamic ``getattr`` inside a traced function (the resolved
+          attribute — and, with a default, the fallback decision — is
+          frozen into the compiled program and invisible to the jit
+          cache key; hoist the read to host code before tracing)
+
+Traced functions are found from decorators (``@jax.jit``,
+``@partial(jax.jit, ...)``), call sites (``jax.jit(f)``,
+``lax.scan(body, ...)``, ``jax.value_and_grad(lf)`` …), and then expanded
+TRANSITIVELY: calls from traced code into same-class methods
+(``self._outputs_fn(...)``), locally-defined helpers, and simple aliases
+(``ofn = self._a if cond else self._b``) mark those bodies traced too,
+because jit purity is a property of everything the trace reaches.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.analysis.core import (
+    Finding,
+    ProjectContext,
+    SourceFile,
+    dotted_name,
+    make_key,
+)
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit"}
+# dotted transform -> positions of traced-callable arguments
+_TRACED_ARGS = {
+    "jax.jit": (0,),
+    "jit": (0,),
+    "pjit": (0,),
+    "jax.pjit": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.lax.scan": (0,),
+    "lax.scan": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "lax.while_loop": (0, 1),
+    "jax.lax.cond": (1, 2),
+    "lax.cond": (1, 2),
+    "jax.lax.fori_loop": (2,),
+    "lax.fori_loop": (2,),
+}
+_CLOCK_CALLS = {
+    "time.time",
+    "time.monotonic",
+    "time.perf_counter",
+    "time.time_ns",
+    "time.process_time",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "uuid.uuid4",
+}
+_LOG_METHODS = {
+    "debug", "info", "warning", "error", "exception", "critical", "log",
+}
+_LOGGERISH = ("logger", "logging", "log", "alog")
+
+
+def _is_partial_of_jit(call: ast.Call) -> bool:
+    fn = dotted_name(call.func)
+    if fn not in ("partial", "functools.partial"):
+        return False
+    return bool(call.args) and dotted_name(call.args[0]) in _JIT_NAMES
+
+
+class JaxPurityChecker:
+    FAMILY = "JAX"
+    RULES = {
+        "JAX001": "print/logging inside a jit-traced function",
+        "JAX002": "host RNG or clock read inside a jit-traced function",
+        "JAX003": "state mutation inside a jit-traced function",
+        "JAX004": "set iteration inside a jit-traced function",
+        "JAX005": "dynamic getattr inside a jit-traced function",
+    }
+    _MAX_HOPS = 4  # transitive trace-following depth bound
+
+    def check(self, sf: SourceFile, ctx: ProjectContext) -> Iterator[Finding]:
+        tree = sf.tree
+        has_import_random = any(
+            isinstance(n, ast.Import)
+            and any(a.name == "random" for a in n.names)
+            for n in ast.walk(tree)
+        )
+
+        traced: list[ast.AST] = []  # FunctionDef/AsyncFunctionDef/Lambda nodes
+
+        # decorator-marked defs
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                d = dotted_name(dec)
+                if d in _JIT_NAMES:
+                    traced.append(node)
+                elif isinstance(dec, ast.Call) and (
+                    dotted_name(dec.func) in _JIT_NAMES or _is_partial_of_jit(dec)
+                ):
+                    traced.append(node)
+
+        # call-site-marked callables: jax.jit(f), lax.scan(body, ...), ...
+        def resolve_local_def(name: str, from_node: ast.AST) -> ast.AST | None:
+            """Nearest enclosing scope's def with this name (lexical)."""
+            cur: ast.AST | None = from_node
+            while cur is not None:
+                cur = sf.parents.get(id(cur))
+                if cur is None or isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)
+                ):
+                    for stmt in getattr(cur, "body", []):
+                        if (
+                            isinstance(stmt, ast.FunctionDef)
+                            and stmt.name == name
+                        ):
+                            return stmt
+                    if isinstance(cur, ast.Module):
+                        return None
+            return None
+
+        for call in ast.walk(tree):
+            if not isinstance(call, ast.Call):
+                continue
+            fn = dotted_name(call.func)
+            positions = _TRACED_ARGS.get(fn) if fn else None
+            if positions is None:
+                continue
+            for pos in positions:
+                if pos >= len(call.args):
+                    continue
+                arg = call.args[pos]
+                if isinstance(arg, ast.Lambda):
+                    traced.append(arg)
+                elif isinstance(arg, ast.Name):
+                    target = resolve_local_def(arg.id, call)
+                    if target is not None:
+                        traced.append(target)
+
+        # -- transitive expansion: trace-reachable same-class methods,
+        # local helpers, and simple aliases are traced code too ------------
+        class_methods: dict[str, dict[str, ast.FunctionDef]] = {}
+        for cls in ast.walk(tree):
+            if isinstance(cls, ast.ClassDef):
+                class_methods[cls.name] = {
+                    n.name: n
+                    for n in cls.body
+                    if isinstance(n, ast.FunctionDef)
+                }
+
+        def enclosing_class(node: ast.AST) -> str | None:
+            cur = sf.parents.get(id(node))
+            while cur is not None:
+                if isinstance(cur, ast.ClassDef):
+                    return cur.name
+                cur = sf.parents.get(id(cur))
+            return None
+
+        def self_method_aliases(
+            fn: ast.AST, methods: dict[str, ast.FunctionDef]
+        ) -> dict[str, set[str]]:
+            """name -> {method,...} for ``x = self._m`` / ``x = self._a if c
+            else self._b`` assignments visible from ``fn``'s closure."""
+            aliases: dict[str, set[str]] = {}
+            cur: ast.AST | None = fn
+            while cur is not None:
+                cur = sf.parents.get(id(cur))
+                if isinstance(cur, (ast.FunctionDef, ast.Module)):
+                    for stmt in ast.walk(cur):
+                        if not isinstance(stmt, ast.Assign):
+                            continue
+                        hits = {
+                            v.attr
+                            for v in ast.walk(stmt.value)
+                            if isinstance(v, ast.Attribute)
+                            and isinstance(v.value, ast.Name)
+                            and v.value.id == "self"
+                            and v.attr in methods
+                        }
+                        if hits:
+                            for t in stmt.targets:
+                                if isinstance(t, ast.Name):
+                                    aliases.setdefault(t.id, set()).update(hits)
+                    if isinstance(cur, ast.Module):
+                        break
+            return aliases
+
+        seen: set[int] = set()
+        depth = {id(n): 0 for n in traced}
+        frontier = list(traced)
+        expanded: list[ast.AST] = []
+        while frontier:
+            node = frontier.pop()
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            expanded.append(node)
+            d = depth.get(id(node), 0)
+            if d >= self._MAX_HOPS:
+                continue
+            methods = class_methods.get(enclosing_class(node) or "", {})
+            aliases = self_method_aliases(node, methods) if methods else {}
+            for sub in ast.walk(node):
+                # nested defs/lambdas are trace-reachable too; queue them so
+                # each body is scanned exactly once (the seen-set dedups),
+                # instead of re-walking them inside the enclosing scan
+                if (
+                    sub is not node
+                    and isinstance(
+                        sub,
+                        (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                    )
+                    and id(sub) not in seen
+                ):
+                    depth[id(sub)] = d  # same hop: lexically inside
+                    frontier.append(sub)
+                if not isinstance(sub, ast.Call):
+                    continue
+                targets: list[ast.AST] = []
+                f = sub.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self"
+                    and f.attr in methods
+                ):
+                    targets.append(methods[f.attr])
+                elif isinstance(f, ast.Name):
+                    if f.id in aliases:
+                        targets.extend(methods[m] for m in aliases[f.id])
+                    else:
+                        local = resolve_local_def(f.id, sub)
+                        if local is not None:
+                            targets.append(local)
+                for t in targets:
+                    if id(t) not in seen:
+                        depth[id(t)] = d + 1
+                        frontier.append(t)
+
+        for node in expanded:
+            yield from self._check_traced(sf, node, has_import_random)
+
+    def _check_traced(
+        self, sf: SourceFile, fn_node: ast.AST, has_import_random: bool
+    ) -> Iterator[Finding]:
+        fname = getattr(fn_node, "name", "<lambda>")
+
+        def emit(rule: str, node: ast.AST, msg: str, token: str) -> Finding:
+            return Finding(
+                rule=rule,
+                path=sf.relpath,
+                line=node.lineno,
+                message=f"{msg} (inside traced function `{fname}`)",
+                key=make_key(rule, sf.relpath, sf.scope_of(node), token),
+            )
+
+        def own_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+            # stop at nested defs/lambdas: the expansion pass queues them as
+            # their own traced units, so scanning them here would double-
+            # report every finding under two scopes
+            body = [fn.body] if isinstance(fn, ast.Lambda) else list(fn.body)
+            stack: list[ast.AST] = body
+            while stack:
+                n = stack.pop()
+                yield n
+                if not isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    stack.extend(ast.iter_child_nodes(n))
+
+        for node in own_nodes(fn_node):
+            if isinstance(node, ast.Call):
+                dotted = dotted_name(node.func)
+                if dotted == "print":
+                    yield emit(
+                        "JAX001", node,
+                        "`print` runs at trace time only", "print",
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _LOG_METHODS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in _LOGGERISH
+                ):
+                    yield emit(
+                        "JAX001", node,
+                        f"logging call `{dotted}` runs at trace time only",
+                        dotted or "log",
+                    )
+                elif dotted and (
+                    dotted.startswith("np.random.")
+                    or dotted.startswith("numpy.random.")
+                    or (has_import_random and dotted.startswith("random."))
+                ):
+                    yield emit(
+                        "JAX002", node,
+                        f"host RNG `{dotted}` is frozen at trace time; "
+                        "use jax.random with a threaded key",
+                        dotted,
+                    )
+                elif dotted in _CLOCK_CALLS:
+                    yield emit(
+                        "JAX002", node,
+                        f"host clock `{dotted}` is frozen at trace time",
+                        dotted,
+                    )
+                elif dotted == "getattr":
+                    target = ""
+                    if len(node.args) >= 2:
+                        arg1 = node.args[1]
+                        if isinstance(arg1, ast.Constant):
+                            target = f" ({arg1.value!r})"
+                    yield emit(
+                        "JAX005", node,
+                        f"dynamic getattr{target} resolves at trace time and "
+                        "is invisible to the jit cache key; hoist the read "
+                        "to host code before tracing",
+                        "getattr",
+                    )
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        yield emit(
+                            "JAX003", node,
+                            f"`self.{t.attr} = ...` mutates object state at "
+                            "trace time only (invisible to later replays)",
+                            f"self.{t.attr}",
+                        )
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                kw = "global" if isinstance(node, ast.Global) else "nonlocal"
+                yield emit(
+                    "JAX003", node,
+                    f"`{kw} {', '.join(node.names)}` rebinds enclosing state "
+                    "at trace time only",
+                    f"{kw}:{','.join(node.names)}",
+                )
+            elif isinstance(node, (ast.For, ast.AsyncFor, ast.comprehension)):
+                it = node.iter
+                is_set = isinstance(it, (ast.Set, ast.SetComp)) or (
+                    isinstance(it, ast.Call)
+                    and dotted_name(it.func) in ("set", "frozenset")
+                )
+                if is_set:
+                    yield emit(
+                        "JAX004", node if not isinstance(node, ast.comprehension) else it,
+                        "iterating a set during tracing is order-"
+                        "nondeterministic; SPMD processes may compile "
+                        "different programs — sort it first",
+                        "set-iteration",
+                    )

@@ -1,0 +1,151 @@
+"""OBS — metric-name drift against the observability catalog.
+
+``observability/catalog.py`` is the single source of truth for every
+metric family: the dashboard, the fleet aggregator, and external scrape
+configs all join on these names. A metric registered elsewhere, or a name
+referenced that the catalog does not define, silently produces an
+always-empty dashboard panel. This rule subsumes the ad-hoc name lint
+that used to live in ``tools/validate_installation.py``. Rules:
+
+  OBS001  metric registered outside the catalog module
+  OBS002  reference to a metric name the catalog does not define
+  OBS003  catalog metric name violates ``^areal_[a-z0-9_]+$``
+  OBS004  catalog metric registered without help text
+  OBS005  duplicate metric name registered in the catalog
+
+Reference detection (OBS002) is prefix-scoped to avoid false positives:
+only string literals whose first two ``_``-separated tokens match an
+existing catalog family prefix are treated as metric references, with
+Prometheus ``_sum``/``_count``/``_bucket`` suffixes stripped first.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from areal_tpu.analysis.core import (
+    Finding,
+    ProjectContext,
+    SourceFile,
+    const_str,
+    make_key,
+)
+
+_NAME_RE = re.compile(r"^areal_[a-z0-9_]+$")
+_REF_RE = re.compile(r"^areal_[a-z][a-z0-9_]*[a-z0-9]$")
+_HISTO_SUFFIXES = ("_sum", "_count", "_bucket")
+_REGISTER_METHODS = ("counter", "gauge", "histogram")
+
+
+class MetricCatalogChecker:
+    FAMILY = "OBS"
+    RULES = {
+        "OBS001": "metric registered outside observability/catalog.py",
+        "OBS002": "reference to a metric name missing from the catalog",
+        "OBS003": "catalog metric name violates the naming convention",
+        "OBS004": "catalog metric registered without help text",
+        "OBS005": "duplicate metric name registered in the catalog",
+    }
+
+    def check(self, sf: SourceFile, ctx: ProjectContext) -> Iterator[Finding]:
+        is_catalog = sf.relpath == ctx.catalog_relpath
+        registered_args: set[int] = set()
+        seen_names: dict[str, int] = {}
+
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REGISTER_METHODS
+            ):
+                continue
+            name = const_str(node.args[0]) if node.args else None
+            if name is None or not name.startswith("areal_"):
+                continue
+            registered_args.add(id(node.args[0]))
+            if not is_catalog:
+                yield Finding(
+                    rule="OBS001",
+                    path=sf.relpath,
+                    line=node.lineno,
+                    message=(
+                        f"metric `{name}` registered outside the catalog; "
+                        "add a factory in observability/catalog.py so the "
+                        "name has one source of truth"
+                    ),
+                    key=make_key(
+                        "OBS001", sf.relpath, sf.scope_of(node), name
+                    ),
+                )
+                continue
+            # catalog-side lint (formerly validate_installation metrics_lint)
+            if not _NAME_RE.match(name) or name.endswith("_") or "__" in name:
+                yield Finding(
+                    rule="OBS003",
+                    path=sf.relpath,
+                    line=node.lineno,
+                    message=(
+                        f"metric `{name}` violates the naming convention "
+                        "(lower_snake, `areal_` prefix, no trailing/double "
+                        "underscores)"
+                    ),
+                    key=make_key("OBS003", sf.relpath, sf.scope_of(node), name),
+                )
+            help_arg = node.args[1] if len(node.args) > 1 else None
+            help_text = const_str(help_arg)
+            if help_text is None or not help_text.strip():
+                yield Finding(
+                    rule="OBS004",
+                    path=sf.relpath,
+                    line=node.lineno,
+                    message=f"metric `{name}` registered without help text",
+                    key=make_key("OBS004", sf.relpath, sf.scope_of(node), name),
+                )
+            if name in seen_names:
+                yield Finding(
+                    rule="OBS005",
+                    path=sf.relpath,
+                    line=node.lineno,
+                    message=(
+                        f"metric `{name}` already registered at line "
+                        f"{seen_names[name]}"
+                    ),
+                    key=make_key("OBS005", sf.relpath, sf.scope_of(node), name),
+                )
+            else:
+                seen_names[name] = node.lineno
+
+        if is_catalog or not ctx.metric_names:
+            return
+
+        # -- references elsewhere must resolve against the catalog ---------
+        for node in ast.walk(sf.tree):
+            s = const_str(node)
+            if (
+                s is None
+                or id(node) in registered_args
+                or not _REF_RE.match(s)
+            ):
+                continue
+            prefix = "_".join(s.split("_")[:2])
+            if prefix not in ctx.metric_prefixes:
+                continue  # not metric-shaped (logger names, context keys…)
+            base = s
+            for suf in _HISTO_SUFFIXES:
+                if base.endswith(suf) and base[: -len(suf)] in ctx.metric_names:
+                    base = base[: -len(suf)]
+                    break
+            if base not in ctx.metric_names:
+                yield Finding(
+                    rule="OBS002",
+                    path=sf.relpath,
+                    line=node.lineno,
+                    message=(
+                        f"metric name `{s}` is not defined in "
+                        "observability/catalog.py (drifted or misspelled)"
+                    ),
+                    key=make_key("OBS002", sf.relpath, sf.scope_of(node), s),
+                )

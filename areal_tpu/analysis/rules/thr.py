@@ -1,0 +1,199 @@
+"""THR — unguarded shared state written from thread targets.
+
+Every long-lived component here owns a background thread (dispatcher,
+decode loop, RPC engine thread, telemetry scraper). An attribute the
+thread writes and another thread reads without a lock is a data race that
+CPython's GIL usually hides — until a torn multi-step update (check-then-
+act, read-modify-write) corrupts accounting under load. Rule:
+
+  THR001  attribute written inside a thread-target method without holding
+          a lock, while other (non-``__init__``) methods of the class also
+          access it
+
+Thread targets are found from ``threading.Thread(target=self._m)`` and
+``threading.Thread(target=local_fn)``; the analysis follows ``self``
+method calls transitively, so helpers invoked from the loop body count as
+thread code. Writes inside ``with self.<lock>:`` blocks are considered
+guarded, where ``<lock>`` is any attribute assigned a
+``threading.Lock/RLock/Condition`` or with a lock-like name.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from areal_tpu.analysis.core import (
+    Finding,
+    ProjectContext,
+    SourceFile,
+    dotted_name,
+    make_key,
+)
+
+_LOCK_CTORS = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "Lock",
+    "RLock",
+    "Condition",
+}
+_LOCKISH_NAME = re.compile(r"(^|_)(lock|cv|cond|mutex)")
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class SharedStateChecker:
+    FAMILY = "THR"
+    RULES = {
+        "THR001": "unguarded attribute write on a thread target",
+    }
+
+    def check(self, sf: SourceFile, ctx: ProjectContext) -> Iterator[Finding]:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(sf, node)
+
+    def _check_class(
+        self, sf: SourceFile, cls: ast.ClassDef
+    ) -> Iterator[Finding]:
+        methods: dict[str, ast.FunctionDef] = {
+            n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)
+        }
+        if not methods:
+            return
+
+        # lock-like attributes (by constructor or by name)
+        lock_attrs: set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if dotted_name(node.value.func) in _LOCK_CTORS:
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            lock_attrs.add(attr)
+
+        def is_lockish(attr: str) -> bool:
+            return attr in lock_attrs or bool(_LOCKISH_NAME.search(attr))
+
+        # thread entry points: Thread(target=self._m | local_fn)
+        target_methods: set[str] = set()
+        local_targets: list[ast.FunctionDef] = []
+        for node in ast.walk(cls):
+            if not (
+                isinstance(node, ast.Call)
+                and dotted_name(node.func) in ("threading.Thread", "Thread")
+            ):
+                continue
+            target = next(
+                (kw.value for kw in node.keywords if kw.arg == "target"), None
+            )
+            if target is None:
+                continue
+            attr = _self_attr(target)
+            if attr and attr in methods:
+                target_methods.add(attr)
+            elif isinstance(target, ast.Name):
+                # local function defined in some enclosing method
+                enclosing = sf.parents.get(id(node))
+                while enclosing is not None and not isinstance(
+                    enclosing, ast.FunctionDef
+                ):
+                    enclosing = sf.parents.get(id(enclosing))
+                if enclosing is not None:
+                    for stmt in ast.walk(enclosing):
+                        if (
+                            isinstance(stmt, ast.FunctionDef)
+                            and stmt.name == target.id
+                        ):
+                            local_targets.append(stmt)
+                            break
+        if not target_methods and not local_targets:
+            return
+
+        # transitive closure over self-method calls from the targets
+        thread_methods: set[str] = set()
+        frontier = list(target_methods)
+        while frontier:
+            m = frontier.pop()
+            if m in thread_methods or m not in methods:
+                continue
+            thread_methods.add(m)
+            for sub in ast.walk(methods[m]):
+                if isinstance(sub, ast.Call):
+                    callee = _self_attr(sub.func)
+                    if callee and callee in methods:
+                        frontier.append(callee)
+
+        thread_nodes: list[ast.FunctionDef] = [
+            methods[m] for m in thread_methods
+        ] + local_targets
+
+        # attributes accessed from OTHER methods (excluding __init__, which
+        # runs before any thread starts)
+        outside_attrs: set[str] = set()
+        for name, meth in methods.items():
+            if name == "__init__" or name in thread_methods:
+                continue
+            for sub in ast.walk(meth):
+                attr = _self_attr(sub)
+                if attr:
+                    outside_attrs.add(attr)
+
+        def guarded(node: ast.AST, root: ast.AST) -> bool:
+            cur = sf.parents.get(id(node))
+            while cur is not None and id(cur) != id(root):
+                if isinstance(cur, ast.With):
+                    for item in cur.items:
+                        attr = _self_attr(item.context_expr)
+                        if attr and is_lockish(attr):
+                            return True
+                cur = sf.parents.get(id(cur))
+            return False
+
+        reported: set[str] = set()
+        for tnode in thread_nodes:
+            for sub in ast.walk(tnode):
+                if not isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    continue
+                targets = (
+                    sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+                )
+                for t in targets:
+                    attr = _self_attr(t)
+                    if (
+                        attr is None
+                        or attr in lock_attrs
+                        or attr not in outside_attrs
+                    ):
+                        continue
+                    # writes from nested defs that are not thread code
+                    # themselves still count: they execute on this thread
+                    if guarded(sub, tnode):
+                        continue
+                    token = f"{cls.name}.{attr}"
+                    if token in reported:
+                        continue  # one finding per (class, attr)
+                    reported.add(token)
+                    yield Finding(
+                        rule="THR001",
+                        path=sf.relpath,
+                        line=sub.lineno,
+                        message=(
+                            f"`self.{attr}` is written on thread target "
+                            f"`{tnode.name}` without a lock but accessed "
+                            "from other methods; guard both sides or "
+                            "document why the race is benign"
+                        ),
+                        key=make_key("THR001", sf.relpath, cls.name, attr),
+                    )

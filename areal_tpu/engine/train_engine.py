@@ -128,6 +128,14 @@ class JaxTrainEngine(TrainEngine):
         distributed: dict | None = None,
     ):
         self.config = config
+        # logit temperature for the logprob/entropy heads: declared on
+        # PPOActorConfig; plain TrainEngineConfig (SFT/RW/critic/ref)
+        # defaults to 1.0. Read ONCE here on the host: the value is baked
+        # into every traced forward and the jit cache key does not include
+        # it, so a getattr inside the traced body would freeze a silent
+        # fallback into the compiled program.
+        # arealint: disable-next=CFG003 polymorphic read: PPOActorConfig declares temperature; base engines default to 1.0
+        self._logit_temperature = float(getattr(config, "temperature", 1.0))
         # {"coordinator_address", "num_processes", "process_id"} — supplied
         # by TrainController for multi-host worker meshes
         self._distributed_kwargs = distributed
@@ -152,6 +160,12 @@ class JaxTrainEngine(TrainEngine):
     def initialize(self, ft_spec: FinetuneSpec | None = None, **kwargs) -> None:
         cfg = self.config
         self.ft_spec = ft_spec
+        # re-read the logit temperature: trainers sync config.actor fields
+        # (rl_trainer sets actor.temperature from gconfig) after an
+        # injectable engine may already have been constructed, and every
+        # path calls initialize() before the first trace bakes the value in
+        # arealint: disable-next=CFG003 polymorphic read: PPOActorConfig declares temperature; base engines default to 1.0
+        self._logit_temperature = float(getattr(cfg, "temperature", 1.0))
         dist = kwargs.get("distributed") or self._distributed_kwargs
         if dist and int(dist.get("num_processes", 1)) > 1:
             # multi-host mesh: every worker process joins the same XLA world
@@ -687,7 +701,7 @@ class JaxTrainEngine(TrainEngine):
                 hidden,
                 batch["labels"],
                 chunk_size=self.config.logprob_chunk_size,
-                temperature=getattr(self.config, "temperature", 1.0),
+                temperature=self._logit_temperature,
             )
             outputs["logprobs"] = logp
             outputs["entropy"] = ent
@@ -799,7 +813,7 @@ class JaxTrainEngine(TrainEngine):
             edge_hidden[None],
             batch["edge_labels"][None],
             chunk_size=self.config.logprob_chunk_size,
-            temperature=getattr(self.config, "temperature", 1.0),
+            temperature=self._logit_temperature,
         )
         gather = batch["gather_idx"]  # [B, T] -> edge index of token t+1
         outputs = {
@@ -912,6 +926,7 @@ class JaxTrainEngine(TrainEngine):
         T_orig = ids.shape[1]
         seqs = [ids[b, : lens[b]] for b in range(len(lens))]
         packs = tree_lib.pack_forest(
+            # arealint: disable-next=CFG003 polymorphic read: PPOActorConfig declares group_size; SFT/ref trees have no sample groups
             seqs, cfg.tree_node_budget, getattr(cfg, "group_size", 1)
         )
         batches: list[dict] = []

@@ -485,9 +485,11 @@ class ServerThread:
 
     def start(self) -> None:
         started = threading.Event()
+        # created before the thread exists so `self._loop` is never written
+        # concurrently with a reader's None-check (arealint THR001)
+        self._loop = asyncio.new_event_loop()
 
         def run():
-            self._loop = asyncio.new_event_loop()
             asyncio.set_event_loop(self._loop)
             self._loop.run_until_complete(self.server.astart())
             started.set()

@@ -38,6 +38,8 @@ class AsyncTaskRunner:
         self._out: queue.Queue[TimedResult | TaskFailed] = queue.Queue()
         self._n_pending = 0
         self._lock = threading.Lock()
+        # completion signal for wait_all (shares _lock with _n_pending)
+        self._pending_cv = threading.Condition(self._lock)
         self._sem: asyncio.Semaphore | None = None
         self._max_concurrency = max_concurrency
         self._paused: asyncio.Event | None = None
@@ -45,14 +47,19 @@ class AsyncTaskRunner:
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
         assert self._thread is None
+        # loop and primitives are created HERE, before the thread exists:
+        # creating them inside the thread raced every reader that checked
+        # `self._loop is not None` during startup (arealint THR001).
+        # asyncio.Event/Semaphore bind to the running loop on first await,
+        # so off-thread construction is safe on Python 3.10+.
+        self._loop = asyncio.new_event_loop()
+        if self._max_concurrency:
+            self._sem = asyncio.Semaphore(self._max_concurrency)
+        self._paused = asyncio.Event()
+        self._paused.set()  # set = running
 
         def run():
-            self._loop = asyncio.new_event_loop()
             asyncio.set_event_loop(self._loop)
-            if self._max_concurrency:
-                self._sem = asyncio.Semaphore(self._max_concurrency)
-            self._paused = asyncio.Event()
-            self._paused.set()  # set = running
             self._started.set()
             self._loop.run_forever()
 
@@ -104,8 +111,10 @@ class AsyncTaskRunner:
                 logger.exception(f"task {task_id} failed")
                 self._out.put(TaskFailed(task_id, e))
             finally:
-                with self._lock:
+                # arealint: disable-next=ASY003 microsecond counter update, never held across an await; wait_all waits on a threading primitive so the notify must be one too
+                with self._pending_cv:
                     self._n_pending -= 1
+                    self._pending_cv.notify_all()
 
         with self._lock:
             self._n_pending += 1
@@ -140,8 +149,14 @@ class AsyncTaskRunner:
             out.append(item)
 
     def wait_all(self, timeout: float = 60.0) -> None:
+        """Block until every submitted task completed. Event-driven: wakes
+        on each task completion instead of polling (was a 5 ms sleep loop)."""
         deadline = time.monotonic() + timeout
-        while self.n_pending > 0:
-            if time.monotonic() > deadline:
-                raise TimeoutError(f"{self.n_pending} tasks still pending")
-            time.sleep(0.005)
+        with self._pending_cv:
+            while self._n_pending > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"{self._n_pending} tasks still pending"
+                    )
+                self._pending_cv.wait(remaining)

@@ -202,15 +202,17 @@ class RolloutController:
         backends = [f"http://{w.address}" for w in self.proxy_workers]
         state = GatewayState(backends, admin_api_key=self._admin_key)
         started = threading.Event()
+        # loop is created and published BEFORE the thread starts, so the
+        # write can never race a reader's None-check (arealint THR001)
+        loop = asyncio.new_event_loop()
+        self._gateway_loop = loop
 
         def run():
-            loop = asyncio.new_event_loop()
             asyncio.set_event_loop(loop)
             runner = aioweb.AppRunner(create_gateway_app(state))
             loop.run_until_complete(runner.setup())
             site = aioweb.TCPSite(runner, "0.0.0.0", port)
             loop.run_until_complete(site.start())
-            self._gateway_loop = loop
             started.set()
             loop.run_forever()
             loop.run_until_complete(runner.cleanup())
@@ -219,6 +221,7 @@ class RolloutController:
         self._gateway_thread.start()
         if not started.wait(timeout=30):
             self._gateway_thread = None
+            self._gateway_loop = None
             raise RuntimeError(f"gateway failed to bind port {port}")
         from areal_tpu.utils.network import gethostip
 

@@ -134,7 +134,6 @@ class WorkflowExecutor:
     def _dispatch_loop(self) -> None:
         try:
             while not self._shutdown.is_set():
-                progressed = False
                 # eval tasks launch unconditionally (no staleness budget)
                 while not self._paused.is_set():
                     try:
@@ -142,7 +141,6 @@ class WorkflowExecutor:
                     except queue.Empty:
                         break
                     self._launch(rec, workflow, accept_fn)
-                    progressed = True
                 # move queued train inputs into the runner while capacity allows
                 while not self._paused.is_set():
                     if self.staleness.get_capacity() <= 0:
@@ -153,11 +151,12 @@ class WorkflowExecutor:
                         break
                     self.staleness.on_submit()
                     self._launch(rec, workflow, accept_fn)
-                    progressed = True
-                # drain completed tasks
+                # drain completed tasks. The timed poll doubles as the idle
+                # wait: when this turn made no progress the 20 ms block is
+                # the loop's only pause (there used to be an extra
+                # time.sleep on top — needless added latency)
                 res = self.runner.poll_result(timeout=0.02)
                 while res is not None:
-                    progressed = True
                     self._inflight -= 1
                     self._on_result(res.task_id, res.data)
                     res = self.runner.poll_result()
@@ -167,12 +166,13 @@ class WorkflowExecutor:
                 self._obs.eval_depth.set(self._input_eval.qsize())
                 self._obs.inflight.set(self._inflight)
                 self._obs.results_buffered.set(len(self._results))
-                if not progressed:
-                    time.sleep(0.005)
         except BaseException as e:  # noqa: BLE001 — fail fast to callers
             logger.exception("dispatcher thread failed")
-            self._thread_exc = e
+            # publish under the condition so waiters observe the failure in
+            # the same wakeup that notifies them (unguarded write was a
+            # THR001: _check_health reads this from caller threads)
             with self._cv:
+                self._thread_exc = e
                 self._cv.notify_all()
 
     def _launch(self, rec: _TaskRecord, workflow: RolloutWorkflow, accept_fn) -> None:
@@ -498,7 +498,12 @@ class WorkflowExecutor:
                     for tid, _, _ in out:
                         self._done_tasks.pop(tid, None)
                     return concat_padded_tensor_dicts([t for _, t, _ in out])
-            time.sleep(0.01)
+                # event-driven: _on_result notifies _cv on every completion
+                # (which is also when staleness capacity frees up). The
+                # short timeout re-checks capacity changes with no local
+                # notification — an engine version bump on another node —
+                # replacing the old blind 10 ms sleep poll.
+                self._cv.wait(timeout=0.05)
 
     def export_stats(self) -> dict[str, float]:
         return {f"rollout/{k}": float(v) for k, v in self.staleness.export_stats().items()}

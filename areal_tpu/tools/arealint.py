@@ -1,0 +1,172 @@
+"""arealint CLI — run the areal_tpu static-analysis suite.
+
+Usage:
+    python -m areal_tpu.tools.arealint [paths ...] [options]
+
+With no paths, analyzes the installed ``areal_tpu`` package. Options:
+
+    --format {text,json}   output format (default text)
+    --rules CSV            restrict to rule families (ASY,JAX,THR,CFG,OBS)
+                           or individual ids (ASY001,...)
+    --baseline PATH        baseline file (default: areal_tpu/analysis/
+                           baseline.json)
+    --no-baseline          report every finding, ignoring the baseline
+    --write-baseline       rewrite the baseline from the current findings
+                           (reasons for persisting entries are carried over;
+                           new entries get an empty reason to fill in)
+    --list-rules           print the rule catalog and exit
+
+Exit codes (the CI contract):
+    0  clean — no findings beyond the baseline
+    1  at least one non-baselined finding
+    2  usage or internal error (bad path, malformed baseline, …)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from areal_tpu.analysis import (
+    Analyzer,
+    default_baseline_path,
+    default_package_root,
+)
+from areal_tpu.analysis.core import load_baseline, render_baseline
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_ERROR = 2
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="arealint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("paths", nargs="*", help="files/directories to analyze")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--rules", default=None, help="comma-separated families/ids")
+    p.add_argument("--baseline", default=None, help="baseline json path")
+    p.add_argument("--no-baseline", action="store_true")
+    p.add_argument("--write-baseline", action="store_true")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.write_baseline and args.rules:
+        # a rule-filtered run sees only a slice of the findings; writing it
+        # as THE baseline would silently delete every other entry (and its
+        # hand-written reason)
+        print(
+            "arealint: --write-baseline cannot be combined with --rules "
+            "(a filtered run would drop all other baseline entries)",
+            file=sys.stderr,
+        )
+        return EXIT_ERROR
+
+    rules = args.rules.split(",") if args.rules else None
+    try:
+        analyzer = Analyzer(rules=rules)
+    except Exception as e:  # noqa: BLE001 — bad rule selection / context build
+        print(f"arealint: {e}", file=sys.stderr)
+        return EXIT_ERROR
+
+    if args.list_rules:
+        for rid, title in analyzer.rule_table().items():
+            print(f"{rid}  {title}")
+        return EXIT_CLEAN
+
+    paths = [Path(s) for s in args.paths] or [default_package_root()]
+    for path in paths:
+        if not path.exists():
+            print(f"arealint: no such path: {path}", file=sys.stderr)
+            return EXIT_ERROR
+
+    baseline_path = Path(args.baseline) if args.baseline else default_baseline_path()
+    baseline = None
+    if not args.no_baseline and not args.write_baseline and baseline_path.exists():
+        try:
+            baseline = load_baseline(baseline_path)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"arealint: malformed baseline {baseline_path}: {e}", file=sys.stderr)
+            return EXIT_ERROR
+
+    result = analyzer.run(paths, baseline=baseline)
+
+    if args.write_baseline:
+        old = None
+        if baseline_path.exists():
+            try:
+                old = load_baseline(baseline_path)
+            except (ValueError, json.JSONDecodeError):
+                old = None
+        doc = render_baseline(result.findings, old=old)
+        if old:
+            # entries for files OUTSIDE the analyzed paths are preserved:
+            # this run could not have observed them, and dropping them
+            # would delete their hand-written reasons
+            repo_root = analyzer.context.repo_root.resolve()
+            prefixes = []
+            for path in paths:
+                try:
+                    prefixes.append(
+                        path.resolve().relative_to(repo_root).as_posix()
+                    )
+                except ValueError:
+                    prefixes.append(path.as_posix())
+
+            def in_scope(p: str) -> bool:
+                return any(
+                    p == pre or p.startswith(pre.rstrip("/") + "/")
+                    for pre in prefixes
+                )
+
+            kept = [
+                e for e in old["findings"] if not in_scope(e.get("path", ""))
+            ]
+            doc["findings"] = sorted(
+                kept + doc["findings"],
+                key=lambda e: (e.get("path", ""), e.get("rule", ""), e.get("key", "")),
+            )
+        baseline_path.parent.mkdir(parents=True, exist_ok=True)
+        baseline_path.write_text(json.dumps(doc, indent=2) + "\n")
+        print(
+            f"arealint: wrote {len(doc['findings'])} baseline entries to "
+            f"{baseline_path}"
+        )
+        return EXIT_CLEAN
+
+    if args.format == "json":
+        print(json.dumps(result.to_dict(), indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        tail = (
+            f"arealint: {len(result.findings)} finding(s), "
+            f"{len(result.baselined)} baselined, "
+            f"{len(result.suppressed)} suppressed, "
+            f"{result.files_checked} file(s) checked"
+        )
+        print(tail)
+        for entry in result.stale_baseline:
+            print(
+                "arealint: stale baseline entry (no longer triggered): "
+                f"{entry.get('rule')} {entry.get('path')} — consider "
+                "regenerating with --write-baseline"
+            )
+    return EXIT_CLEAN if result.ok else EXIT_FINDINGS
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:
+        # output was piped into a pager/head that closed early: the
+        # receiver saw a TRUNCATED report, so fail closed — exiting 0 here
+        # would let a `... | head` CI pipeline read real findings as clean
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stderr.fileno())
+        raise SystemExit(EXIT_ERROR)

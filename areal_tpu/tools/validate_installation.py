@@ -69,29 +69,39 @@ def main(argv=None) -> int:
     _check("contracts", engine_contract, results)
 
     def metrics_lint():
-        """Every catalogued metric family obeys the naming convention
-        (^areal_[a-z0-9_]+$) and carries help text, and the registry's
-        Prometheus rendering round-trips through its own parser."""
-        import re
-
+        """Static metric-name lint is arealint's OBS family now (one source
+        of truth: registration outside the catalog, naming convention,
+        missing help, duplicate names, dangling references). Here we invoke
+        it over the package, then keep the one check that is inherently
+        runtime: the registry's Prometheus rendering must round-trip
+        through its own parser."""
+        from areal_tpu.analysis import (
+            default_baseline_path,
+            default_package_root,
+            run_analysis,
+        )
         from areal_tpu.observability import catalog
         from areal_tpu.observability.metrics import (
             Registry,
             parse_prometheus_text,
         )
 
+        res = run_analysis(
+            [default_package_root()],
+            rules=["OBS"],
+            baseline_path=default_baseline_path(),
+        )
+        if not res.ok:
+            raise RuntimeError(
+                "; ".join(f.render() for f in res.findings[:5])
+                + (f" (+{len(res.findings) - 5} more)" if len(res.findings) > 5 else "")
+            )
         reg = catalog.register_all(Registry())
-        name_re = re.compile(r"^areal_[a-z0-9_]+$")
-        bad = []
-        for fam in reg.families():
-            if not name_re.match(fam.name):
-                bad.append(f"{fam.name}: bad name")
-            if not fam.help.strip():
-                bad.append(f"{fam.name}: missing help")
-        if bad:
-            raise RuntimeError("; ".join(bad))
         parse_prometheus_text(reg.render_prometheus())
-        return f"{len(reg.families())} metric families lint-clean"
+        return (
+            f"arealint OBS clean over {res.files_checked} files; "
+            f"{len(reg.families())} families render round-trip"
+        )
 
     _check("metrics", metrics_lint, results)
 

@@ -1,0 +1,37 @@
+"""ASY true positives: blocking calls inside async bodies (parsed by the
+analyzer only — never imported)."""
+
+import time
+import urllib.request
+
+import requests  # noqa — fixture, not executed
+
+
+async def sleeps():
+    time.sleep(1.0)  # ASY001
+
+
+async def sync_http():
+    urllib.request.urlopen("http://x/health")  # ASY002
+    requests.get("http://x/metrics")  # ASY002
+
+
+class Worker:
+    async def locks(self):
+        self._lock.acquire()  # ASY003
+        with self._state_lock:  # ASY003
+            pass
+
+    def _blocking_helper(self):
+        time.sleep(0.5)
+
+    async def indirect(self):
+        self._blocking_helper()  # ASY004
+
+
+def module_helper():
+    urllib.request.urlopen("http://x/")
+
+
+async def indirect_module():
+    module_helper()  # ASY004

@@ -1,0 +1,47 @@
+"""ASY clean patterns: async-native waits, executor offload, sync contexts."""
+
+import asyncio
+import time
+
+
+async def sleeps():
+    await asyncio.sleep(1.0)  # awaited async sleep is fine
+
+
+async def offloaded():
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, time.sleep, 0.1)
+
+
+async def async_lock(lock: asyncio.Lock):
+    await lock.acquire()  # awaited acquire is the asyncio primitive
+    lock.release()
+
+
+def sync_worker_thread():
+    # dedicated worker thread: blocking here is the point
+    time.sleep(0.5)
+
+
+async def nested_sync_def():
+    def helper():
+        # defined here but the body is NOT awaited async code; the direct
+        # rule does not flag sync helper bodies (one-hop ASY004 flags the
+        # call site only when the helper blocks — this one does not)
+        return 1
+
+    return helper()
+
+
+def spawns_callback():
+    # the blocking call lives in a NESTED def (a callback handed to some
+    # scheduler), not in this helper's own body — calling spawns_callback
+    # from async code must not be flagged as ASY004
+    def callback():
+        time.sleep(1.0)
+
+    return callback
+
+
+async def calls_nonblocking_spawner():
+    spawns_callback()

@@ -1,0 +1,28 @@
+"""CFG true positives: accesses that drifted from api/config.py dataclasses."""
+
+from areal_tpu.api.config import InferenceEngineConfig, PPOConfig, ServerConfig
+
+
+def read_typo(config: InferenceEngineConfig):
+    return config.max_concurent_rollouts  # CFG001 (typo)
+
+
+def nested_chain(cfg: PPOConfig):
+    ok = cfg.rollout.consumer_batch_size
+    return ok, cfg.saver.freq_minutes  # CFG001 (no such nested field)
+
+
+def bad_ctor():
+    return ServerConfig(model_path="m", max_batchsize=8)  # CFG002 (typo)
+
+
+def masked_getattr(cfg: ServerConfig):
+    return getattr(cfg, "page_sizes", None)  # CFG003 (typo -> always None)
+
+
+class Holder:
+    def __init__(self, config: InferenceEngineConfig):
+        self.config = config
+
+    def use(self):
+        return self.config.consumer_batchsize  # CFG001 via self capture

@@ -1,0 +1,38 @@
+"""CFG clean patterns: declared fields, inherited fields, nested chains."""
+
+from areal_tpu.api.config import (
+    InferenceEngineConfig,
+    PPOActorConfig,
+    PPOConfig,
+    ServerConfig,
+)
+
+
+def reads(config: InferenceEngineConfig):
+    return config.max_concurrent_rollouts, config.consumer_batch_size
+
+
+def inherited(cfg: PPOActorConfig):
+    # lr lives on the nested optimizer; path comes from TrainEngineConfig
+    return cfg.optimizer.lr, cfg.group_size
+
+
+def nested_chain(cfg: PPOConfig):
+    return cfg.rollout.max_head_offpolicyness, cfg.saver.freq_steps
+
+
+def ctor():
+    return ServerConfig(model_path="m", max_batch_size=8)
+
+
+def declared_getattr(cfg: ServerConfig):
+    return getattr(cfg, "page_size", 128)  # declared field: fine
+
+
+class Holder:
+    def __init__(self, config: InferenceEngineConfig):
+        self.config = config
+
+    def use(self):
+        cfg = self.config
+        return cfg.consumer_batch_size  # local capture resolves too

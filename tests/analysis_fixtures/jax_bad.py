@@ -1,0 +1,51 @@
+"""JAX true positives: impure constructs inside traced functions."""
+
+import functools
+import random
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def prints(x):
+    print("tracing", x)  # JAX001
+    return x * 2
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def host_rng(x, n):
+    noise = np.random.normal(size=n)  # JAX002
+    seed = time.time()  # JAX002
+    pick = random.random()  # JAX002
+    return x + noise + seed + pick
+
+
+class Engine:
+    def step(self, x):
+        def body(carry, _):
+            self.calls = self.calls + 1  # JAX003 (trace-time only)
+            return carry * x, None
+
+        out, _ = jax.lax.scan(body, x, None, length=4)
+        return out
+
+    def _inner(self, x):
+        temp = getattr(self.config, "temperature", 1.0)  # JAX005
+        return x * temp
+
+    def outer(self, x):
+        fn = self._inner
+        return jax.jit(lambda y: fn(y))(x)  # transitive via alias
+
+
+def set_iter(params):
+    @jax.jit
+    def f(x):
+        total = x
+        for k in set(params):  # JAX004
+            total = total + params[k]
+        return total
+
+    return f

@@ -1,0 +1,37 @@
+"""JAX clean patterns: pure traced code; host side effects outside traces."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pure(x, key):
+    noise = jax.random.normal(key, x.shape)  # traced RNG with threaded key
+    return x + noise
+
+
+def host_side(params):
+    print("host logging is fine outside the trace")
+    order = sorted(params)  # sorted set -> deterministic
+
+    @jax.jit
+    def f(x):
+        total = x
+        for k in order:  # iterating a pre-sorted list is deterministic
+            total = total + params[k]
+        return total
+
+    return f
+
+
+class Engine:
+    def __init__(self, config):
+        # host-side read ONCE, then baked in as a plain float
+        self._temperature = float(config.temperature)
+
+    def step(self, x):
+        def body(carry, _):
+            return carry * self._temperature, None
+
+        out, _ = jax.lax.scan(body, x, None, length=4)
+        return out

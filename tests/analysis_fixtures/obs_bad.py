@@ -1,0 +1,17 @@
+"""OBS true positives: metric registrations/references that drift from the
+observability catalog."""
+
+from areal_tpu.observability.metrics import get_registry
+
+
+def rogue_registration():
+    reg = get_registry()
+    # OBS001: production metric registered outside the catalog module
+    return reg.counter("areal_rollout_shadow_total", "not in the catalog")
+
+
+DISPLAY_ROWS = (
+    ("areal_rollout_capacity", "fine — catalogued"),
+    ("areal_rollout_capcity", "OBS002: misspelled reference"),
+    ("areal_decode_generated_tokens_totall", "OBS002: drifted suffix"),
+)

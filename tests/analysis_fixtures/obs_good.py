@@ -1,0 +1,12 @@
+"""OBS clean patterns: catalogued references, non-metric areal_* strings."""
+
+DISPLAY_ROWS = (
+    ("areal_rollout_capacity", "staleness capacity"),
+    ("areal_decode_generated_tokens_total", "tokens"),
+    # histogram component series resolve to their base family
+    ("areal_weight_update_pause_seconds_sum", "pause time"),
+    ("areal_weight_update_pause_seconds_count", "pauses"),
+)
+
+LOGGER_NAME = "areal_tpu"  # package name, not a metric: no finding
+CONTEXT_KEY = "areal_workflow_context"  # unknown family prefix: no finding

@@ -1,0 +1,27 @@
+"""Suppression fixture: every finding here carries a disable comment."""
+
+import time
+
+
+async def same_line():
+    time.sleep(0.1)  # arealint: disable=ASY001 dedicated smoke-test coroutine, loop has no other tasks
+
+
+async def next_line():
+    # arealint: disable-next=ASY001 paced fixture sleep, justified
+    time.sleep(0.2)
+
+
+async def family_prefix():
+    time.sleep(0.3)  # arealint: disable=ASY whole-family suppression
+
+
+async def disable_all():
+    time.sleep(0.4)  # arealint: disable=all kitchen sink
+
+
+async def not_in_string():
+    # a string that merely CONTAINS the marker must not suppress anything
+    note = "# arealint: disable=ASY001"
+    time.sleep(0.5)
+    return note

@@ -1,0 +1,40 @@
+"""THR true positives: thread-target writes racing other-method readers."""
+
+import threading
+
+
+class Dispatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.last_error = None
+        self.counter = 0
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self.counter += 1  # THR001: read by status() without a lock
+            self._work()
+
+    def _work(self):
+        # transitively thread code (called from the target)
+        self.last_error = RuntimeError("boom")  # THR001
+
+    def status(self):
+        return self.counter, self.last_error
+
+
+class LocalTarget:
+    def __init__(self):
+        self.ready = False
+
+    def start(self):
+        def run():
+            self.ready = True  # THR001: local thread fn writes shared attr
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def is_ready(self):
+        return self.ready

@@ -1,0 +1,39 @@
+"""THR clean patterns: guarded writes, init-only setup, loop-private state."""
+
+import threading
+
+
+class GuardedDispatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self.counter = 0
+        self.last_error = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self.counter += 1  # guarded: no finding
+            with self._cv:
+                self.last_error = None  # condition guards too
+                self._cv.notify_all()
+
+    def status(self):
+        with self._lock:
+            return self.counter
+
+
+class PrivateState:
+    def __init__(self):
+        self._scratch = 0  # init-only setup happens before the thread
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        self._scratch = 42  # only thread code touches it: no finding

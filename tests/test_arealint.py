@@ -1,0 +1,404 @@
+"""arealint unit tests: every rule family against its good/bad fixture pair,
+suppression comments, baseline matching, finding ordering, CLI contract
+(ISSUE 2: static-analysis suite)."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from areal_tpu.analysis import Analyzer, run_analysis
+from areal_tpu.analysis.core import (
+    SourceFile,
+    load_baseline,
+    render_baseline,
+)
+from areal_tpu.tools import arealint as cli
+
+FIXTURES = Path(__file__).parent / "analysis_fixtures"
+
+
+def rules_in(path: Path, rule_filter=None) -> list[str]:
+    res = run_analysis([path], rules=rule_filter, baseline_path=None)
+    return [f.rule for f in res.findings]
+
+
+# ---------------------------------------------------------------------------
+# rule families: true positives on *_bad.py, silence on *_good.py
+# ---------------------------------------------------------------------------
+
+
+def test_asy_bad_fixture():
+    rules = rules_in(FIXTURES / "asy_bad.py", ["ASY"])
+    assert "ASY001" in rules  # time.sleep in async
+    assert "ASY002" in rules  # sync HTTP in async
+    assert "ASY003" in rules  # blocking lock in async
+    assert rules.count("ASY004") >= 2  # self-method and module helper hops
+
+
+def test_asy_good_fixture():
+    assert rules_in(FIXTURES / "asy_good.py", ["ASY"]) == []
+
+
+def test_jax_bad_fixture():
+    rules = rules_in(FIXTURES / "jax_bad.py", ["JAX"])
+    assert "JAX001" in rules  # print under @jax.jit
+    assert rules.count("JAX002") >= 3  # np.random, time.time, random.random
+    assert "JAX003" in rules  # self mutation inside lax.scan body
+    assert "JAX004" in rules  # set iteration
+    assert "JAX005" in rules  # getattr through the alias hop
+
+
+def test_jax_good_fixture():
+    assert rules_in(FIXTURES / "jax_good.py", ["JAX"]) == []
+
+
+def test_thr_bad_fixture():
+    res = run_analysis([FIXTURES / "thr_bad.py"], rules=["THR"], baseline_path=None)
+    attrs = {f.key.rsplit(":", 1)[1] for f in res.findings}
+    # direct loop write, transitive helper write, local-def thread target
+    assert {"counter", "last_error", "ready"} <= attrs
+
+
+def test_thr_good_fixture():
+    assert rules_in(FIXTURES / "thr_good.py", ["THR"]) == []
+
+
+def test_cfg_bad_fixture():
+    res = run_analysis([FIXTURES / "cfg_bad.py"], rules=["CFG"], baseline_path=None)
+    by_rule = {}
+    for f in res.findings:
+        by_rule.setdefault(f.rule, []).append(f.message)
+    assert any("max_concurent_rollouts" in m for m in by_rule["CFG001"])
+    assert any("freq_minutes" in m for m in by_rule["CFG001"])  # nested chain
+    assert any("consumer_batchsize" in m for m in by_rule["CFG001"])  # self cap
+    assert any("max_batchsize" in m for m in by_rule["CFG002"])
+    assert any("page_sizes" in m for m in by_rule["CFG003"])
+
+
+def test_cfg_good_fixture():
+    assert rules_in(FIXTURES / "cfg_good.py", ["CFG"]) == []
+
+
+def test_obs_bad_fixture():
+    rules = rules_in(FIXTURES / "obs_bad.py", ["OBS"])
+    assert "OBS001" in rules  # registration outside the catalog
+    assert rules.count("OBS002") == 2  # two misspelled references
+
+
+def test_obs_good_fixture():
+    assert rules_in(FIXTURES / "obs_good.py", ["OBS"]) == []
+
+
+def test_obs_catalog_lint_rules_exist():
+    # catalog-side lint (OBS003/OBS004/OBS005) runs on the real catalog and
+    # must be clean — it replaced validate_installation's ad-hoc check
+    from areal_tpu.analysis import default_package_root
+
+    cat = default_package_root() / "observability" / "catalog.py"
+    assert rules_in(cat, ["OBS"]) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_suppressions():
+    res = run_analysis([FIXTURES / "suppress.py"], rules=["ASY"], baseline_path=None)
+    # only the marker-inside-a-string sleep survives
+    assert len(res.findings) == 1
+    assert res.findings[0].key.endswith("not_in_string:time.sleep")
+    # the four commented sites were recorded as suppressed, not dropped
+    assert len(res.suppressed) == 4
+
+
+def test_file_level_suppression(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "# arealint: disable-file=ASY001 fixture-wide reason\n"
+        "import time\n"
+        "async def a():\n"
+        "    time.sleep(1)\n"
+        "async def b():\n"
+        "    time.sleep(2)\n"
+    )
+    res = run_analysis([src], rules=["ASY"], baseline_path=None)
+    assert res.findings == []
+    assert len(res.suppressed) == 2
+
+
+def test_suppression_reason_parsed():
+    sf = SourceFile.load(FIXTURES / "suppress.py", FIXTURES)
+    reasons = [s.reason for s in sf.suppressions.values()]
+    assert any("dedicated smoke-test coroutine" in r for r in reasons)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_matches_by_key_and_reports_stale(tmp_path):
+    res = run_analysis([FIXTURES / "asy_bad.py"], rules=["ASY"], baseline_path=None)
+    assert res.findings
+    doc = render_baseline(res.findings[:2])
+    doc["findings"].append(
+        {"rule": "ASY001", "path": "gone.py", "key": "ASY001:gone.py:f:time.sleep", "reason": "x"}
+    )
+    bpath = tmp_path / "baseline.json"
+    bpath.write_text(json.dumps(doc))
+    res2 = run_analysis(
+        [FIXTURES / "asy_bad.py"], rules=["ASY"], baseline_path=bpath
+    )
+    assert len(res2.baselined) == 2
+    assert len(res2.findings) == len(res.findings) - 2
+    assert [e["path"] for e in res2.stale_baseline] == ["gone.py"]
+
+
+def test_baseline_key_stable_across_line_shifts(tmp_path):
+    original = (FIXTURES / "asy_bad.py").read_text()
+    moved = tmp_path / "asy_bad.py"
+    moved.write_text("\n\n# shifted by a header edit\n\n" + original)
+    keys = lambda p: sorted(
+        f.key.split(":", 2)[2]  # drop rule+path (paths differ)
+        for f in run_analysis([p], rules=["ASY"], baseline_path=None).findings
+    )
+    assert keys(FIXTURES / "asy_bad.py") == keys(moved)
+
+
+def test_render_baseline_carries_reasons_forward():
+    res = run_analysis([FIXTURES / "asy_bad.py"], rules=["ASY"], baseline_path=None)
+    first = render_baseline(res.findings)
+    for e in first["findings"]:
+        e["reason"] = "justified: " + e["key"]
+    second = render_baseline(res.findings, old=first)
+    assert all(e["reason"].startswith("justified: ") for e in second["findings"])
+
+
+def test_load_baseline_rejects_malformed(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text('{"not": "a baseline"}')
+    with pytest.raises(ValueError):
+        load_baseline(p)
+
+
+# ---------------------------------------------------------------------------
+# ordering + output format
+# ---------------------------------------------------------------------------
+
+
+def test_finding_order_is_stable_and_sorted():
+    paths = sorted(FIXTURES.glob("*_bad.py"))
+    res1 = run_analysis(paths, baseline_path=None)
+    res2 = run_analysis(list(reversed(paths)), baseline_path=None)
+    assert [f.key for f in res1.findings] == [f.key for f in res2.findings]
+    triples = [(f.path, f.line, f.rule) for f in res1.findings]
+    assert triples == sorted(triples)
+
+
+def test_json_output_schema(capsys):
+    rc = cli.main([str(FIXTURES / "asy_bad.py"), "--format", "json", "--no-baseline"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == cli.EXIT_FINDINGS
+    assert out["version"] == 1 and out["ok"] is False
+    f = out["findings"][0]
+    assert {"rule", "path", "line", "message", "severity", "key"} <= set(f)
+
+
+def test_cli_exit_codes(capsys, tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert cli.main([str(clean), "--no-baseline"]) == cli.EXIT_CLEAN
+    assert (
+        cli.main([str(FIXTURES / "asy_bad.py"), "--no-baseline"])
+        == cli.EXIT_FINDINGS
+    )
+    assert cli.main([str(tmp_path / "nope.py")]) == cli.EXIT_ERROR
+    capsys.readouterr()
+
+
+def test_cli_list_rules(capsys):
+    assert cli.main(["--list-rules"]) == cli.EXIT_CLEAN
+    out = capsys.readouterr().out
+    for family_rule in ("ASY001", "JAX005", "THR001", "CFG003", "OBS001"):
+        assert family_rule in out
+
+
+def test_cli_write_baseline_roundtrip(tmp_path, capsys):
+    bpath = tmp_path / "baseline.json"
+    rc = cli.main(
+        [str(FIXTURES / "thr_bad.py"), "--baseline", str(bpath), "--write-baseline"]
+    )
+    assert rc == cli.EXIT_CLEAN
+    doc = load_baseline(bpath)
+    assert doc["findings"]
+    # now the same run against the written baseline is clean
+    rc = cli.main([str(FIXTURES / "thr_bad.py"), "--baseline", str(bpath)])
+    assert rc == cli.EXIT_CLEAN
+    capsys.readouterr()
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def broken(:\n")
+    res = run_analysis([bad], baseline_path=None)
+    assert [f.rule for f in res.findings] == ["PARSE"]
+
+
+def test_rule_filter_by_id():
+    analyzer = Analyzer(rules=["ASY001"])
+    res = analyzer.run([FIXTURES / "asy_bad.py"])
+    assert {f.rule for f in res.findings} == {"ASY001"}
+
+
+def test_cfg_nested_shadowing_param_not_confused(tmp_path):
+    # an inner function whose parameter shadows an outer config-typed name
+    # must not inherit the outer type (was a false CFG001)
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "from areal_tpu.api.config import PPOActorConfig\n"
+        "def outer(cfg: PPOActorConfig):\n"
+        "    ok = cfg.group_size\n"
+        "    def inner(cfg):\n"
+        "        return cfg.not_a_field_anywhere\n"
+        "    return ok, inner\n"
+    )
+    assert rules_in(src, ["CFG"]) == []
+
+
+def test_cfg_nested_closure_still_checked(tmp_path):
+    # a nested function that CLOSES OVER the outer config var is checked
+    # with the inherited environment
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "from areal_tpu.api.config import PPOActorConfig\n"
+        "def outer(cfg: PPOActorConfig):\n"
+        "    def inner():\n"
+        "        return cfg.group_syze\n"
+        "    return inner\n"
+    )
+    assert rules_in(src, ["CFG"]) == ["CFG001"]
+
+
+def test_asy004_scoped_to_class(tmp_path):
+    # A.flush blocks, B.flush does not: async B code calling self.flush()
+    # must not be blamed for A's body (was a false ASY004)
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import time\n"
+        "class A:\n"
+        "    def flush(self):\n"
+        "        time.sleep(1)\n"
+        "class B:\n"
+        "    def flush(self):\n"
+        "        pass\n"
+        "    async def run(self):\n"
+        "        self.flush()\n"
+        "class C:\n"
+        "    async def run(self):\n"
+        "        self.flush()  # no local def at all: unknown, no finding\n"
+    )
+    assert rules_in(src, ["ASY"]) == []
+    src.write_text(
+        "import time\n"
+        "class A:\n"
+        "    def flush(self):\n"
+        "        time.sleep(1)\n"
+        "    async def run(self):\n"
+        "        self.flush()\n"
+    )
+    assert rules_in(src, ["ASY"]) == ["ASY004"]
+
+
+def test_jax_nested_helper_reported_once(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    def helper(y):\n"
+        "        print(y)\n"
+        "        return y\n"
+        "    return helper(x)\n"
+    )
+    res = run_analysis([src], rules=["JAX"], baseline_path=None)
+    assert [f.rule for f in res.findings] == ["JAX001"]
+
+
+def test_suppression_covers_multiline_statement(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(\n"
+        "        1.0\n"
+        "    )  # arealint: disable=ASY001 trailing comment after the paren\n"
+    )
+    res = run_analysis([src], rules=["ASY"], baseline_path=None)
+    assert res.findings == []
+    assert len(res.suppressed) == 1
+
+
+def test_standalone_comment_does_not_blanket_enclosing_block(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import time\n"
+        "async def f():\n"
+        "    # arealint: disable=ASY001\n"
+        "    x = 1\n"
+        "    time.sleep(1.0)\n"  # two lines below the bare comment
+    )
+    res = run_analysis([src], rules=["ASY"], baseline_path=None)
+    assert [f.rule for f in res.findings] == ["ASY001"]
+
+
+def test_unknown_rule_selection_is_an_error(capsys):
+    with pytest.raises(ValueError):
+        Analyzer(rules=["ASY01"])  # typo must not silently check nothing
+    rc = cli.main(["--rules", "NOPE123", str(FIXTURES / "asy_bad.py")])
+    assert rc == cli.EXIT_ERROR
+    assert "unknown rule" in capsys.readouterr().err
+
+
+def test_write_baseline_refuses_rule_filter(tmp_path, capsys):
+    bpath = tmp_path / "b.json"
+    rc = cli.main(
+        [
+            str(FIXTURES / "asy_bad.py"),
+            "--rules", "ASY",
+            "--baseline", str(bpath),
+            "--write-baseline",
+        ]
+    )
+    assert rc == cli.EXIT_ERROR
+    assert not bpath.exists()
+    capsys.readouterr()
+
+
+def test_write_baseline_preserves_out_of_scope_entries(tmp_path, capsys):
+    # seed a baseline from one fixture, then rewrite scoped to ANOTHER:
+    # the first fixture's entries (and reasons) must survive the rewrite
+    bpath = tmp_path / "b.json"
+    assert (
+        cli.main(
+            [str(FIXTURES / "thr_bad.py"), "--baseline", str(bpath), "--write-baseline"]
+        )
+        == cli.EXIT_CLEAN
+    )
+    doc = load_baseline(bpath)
+    for e in doc["findings"]:
+        e["reason"] = "documented single-writer"
+    bpath.write_text(json.dumps(doc))
+    assert (
+        cli.main(
+            [str(FIXTURES / "asy_bad.py"), "--baseline", str(bpath), "--write-baseline"]
+        )
+        == cli.EXIT_CLEAN
+    )
+    merged = load_baseline(bpath)
+    thr = [e for e in merged["findings"] if e["rule"].startswith("THR")]
+    asy = [e for e in merged["findings"] if e["rule"].startswith("ASY")]
+    assert thr and asy
+    assert all(e["reason"] == "documented single-writer" for e in thr)
+    capsys.readouterr()

@@ -1,0 +1,72 @@
+"""Tier-1 gate: the whole package must be arealint-clean against the
+checked-in baseline (ISSUE 2: zero-new-findings CI gate).
+
+Any new finding fails this test. The fix is one of, in order of
+preference: fix the code; suppress at the site with
+``# arealint: disable=<rule> <why>``; or add a baseline entry with a
+written reason (``python -m areal_tpu.tools.arealint --write-baseline``
+then fill in the reason field).
+"""
+
+import pytest
+
+from areal_tpu.analysis import (
+    default_baseline_path,
+    default_package_root,
+    run_analysis,
+)
+from areal_tpu.analysis.core import load_baseline
+
+
+@pytest.fixture(scope="module")
+def package_result():
+    """One whole-package scan shared by the gate assertions."""
+    return run_analysis(
+        [default_package_root()], baseline_path=default_baseline_path()
+    )
+
+
+def test_package_is_clean_against_baseline(package_result):
+    res = package_result
+    assert res.files_checked > 100  # sanity: we really scanned the package
+    assert not res.findings, "new arealint findings:\n" + "\n".join(
+        f.render() for f in res.findings
+    )
+
+
+def test_baseline_entries_have_written_reasons():
+    doc = load_baseline(default_baseline_path())
+    missing = [e["key"] for e in doc["findings"] if not e.get("reason", "").strip()]
+    assert not missing, (
+        "baseline entries need a written reason (why the finding is "
+        f"acceptable): {missing}"
+    )
+
+
+def test_baseline_has_no_stale_entries(package_result):
+    """Every baseline entry must still match a live finding — otherwise the
+    underlying issue was fixed and the entry should be deleted so it cannot
+    mask a future regression at the same site."""
+    res = package_result
+    assert not res.stale_baseline, (
+        "stale baseline entries (regenerate with --write-baseline): "
+        + ", ".join(e["key"] for e in res.stale_baseline)
+    )
+
+
+def test_every_rule_family_is_loaded():
+    from areal_tpu.analysis import Analyzer
+
+    table = Analyzer().rule_table()
+    families = {r[:3] for r in table}
+    assert {"ASY", "JAX", "THR", "CFG", "OBS"} <= families
+
+
+def test_repo_scripts_are_clean():
+    """Entry scripts outside the package (bench, profiling, examples) ride
+    the same gate — they drive the same APIs."""
+    repo = default_package_root().parent
+    paths = [p for p in repo.glob("*.py")] + [repo / "examples"]
+    paths = [p for p in paths if p.exists()]
+    res = run_analysis(paths, baseline_path=default_baseline_path())
+    assert not res.findings, "\n".join(f.render() for f in res.findings)
