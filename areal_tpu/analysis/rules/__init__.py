@@ -10,6 +10,7 @@ from __future__ import annotations
 def all_checkers() -> list:
     from areal_tpu.analysis.rules.asy import AsyncSafetyChecker
     from areal_tpu.analysis.rules.cfg import ConfigDriftChecker
+    from areal_tpu.analysis.rules.exc import SilentExceptionChecker
     from areal_tpu.analysis.rules.jaxpurity import JaxPurityChecker
     from areal_tpu.analysis.rules.obs import MetricCatalogChecker
     from areal_tpu.analysis.rules.thr import SharedStateChecker
@@ -20,4 +21,5 @@ def all_checkers() -> list:
         SharedStateChecker(),
         ConfigDriftChecker(),
         MetricCatalogChecker(),
+        SilentExceptionChecker(),
     ]

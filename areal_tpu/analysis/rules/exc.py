@@ -1,0 +1,168 @@
+"""EXC — silent exception swallowing around network/file I/O.
+
+A ``try: <I/O> except Exception: pass`` hides exactly the failures the
+fault-tolerance layer exists to surface: a dead replica, a torn file, a
+refused connection. Swallowed silently, they degrade throughput or corrupt
+recovery with no diagnostic trail. Rule:
+
+  EXC001  a broad handler (bare ``except``, ``except Exception``, or
+          ``except BaseException``) whose body does nothing — no logging,
+          no metric, no re-raise, no state recorded — wrapping a try block
+          that performs network or file I/O
+
+A handler counts as NON-silent when its body does anything beyond
+``pass``/``continue``/``...`` — logging, incrementing a metric, assigning
+the error somewhere, raising. Narrow handlers (``except OSError``) are
+deliberate classification and never flagged. I/O is recognized from
+well-known callee shapes (urllib/requests/socket/http.client/shutil/
+pickle, ``open``, ``os.*`` file ops) plus this repo's own transport
+helpers (``http_json``, ``call_engine``, ``_get_json``/``_post_json``/
+``_post_bytes``/``_post_all``, ``urlopen``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.analysis.core import (
+    Finding,
+    ProjectContext,
+    SourceFile,
+    dotted_name,
+    make_key,
+)
+
+# dotted prefixes whose calls are network/file I/O
+_IO_PREFIXES = (
+    "urllib.",
+    "requests.",
+    "socket.",
+    "http.client.",
+    "shutil.",
+)
+# exact dotted names
+_IO_NAMES = {
+    "open",
+    "os.remove",
+    "os.unlink",
+    "os.rename",
+    "os.replace",
+    "os.makedirs",
+    "os.rmdir",
+    "os.listdir",
+    "os.stat",
+    "os.fsync",
+    "pickle.load",
+    "pickle.loads",
+    "pickle.dump",
+    "pickle.dumps",
+    "json.load",
+    "json.dump",
+}
+# final attribute/name components that mark this repo's transport helpers
+_IO_SUFFIXES = {
+    "urlopen",
+    "http_json",
+    "_http_json",
+    "call_engine",
+    "call_all",
+    "_get_json",
+    "_post_json",
+    "_post_json_failover",
+    "_post_bytes",
+    "_post_all",
+    "_post_all_bytes",
+}
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_io_call(call: ast.Call) -> str | None:
+    """The I/O token when ``call`` performs network/file I/O, else None."""
+    dotted = dotted_name(call.func)
+    if dotted is not None:
+        if dotted in _IO_NAMES:
+            return dotted
+        if any(dotted.startswith(p) for p in _IO_PREFIXES):
+            return dotted
+        last = dotted.rsplit(".", 1)[-1]
+        if last in _IO_SUFFIXES:
+            return dotted
+    elif isinstance(call.func, ast.Attribute):
+        if call.func.attr in _IO_SUFFIXES:
+            return call.func.attr
+    return None
+
+
+def _iter_io_scope(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``root`` without entering nested function/class defs — I/O
+    inside a nested def does not run under this try block."""
+    stack = [root]
+    while stack:
+        n = stack.pop()
+        if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _handler_is_silent(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    t = handler.type
+    if isinstance(t, ast.Tuple):
+        return any(dotted_name(e) in _BROAD for e in t.elts)
+    return dotted_name(t) in _BROAD
+
+
+class SilentExceptionChecker:
+    FAMILY = "EXC"
+    RULES = {
+        "EXC001": "broad except silently swallows network/file I/O errors",
+    }
+
+    def check(self, sf: SourceFile, ctx: ProjectContext) -> Iterator[Finding]:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            io_token = None
+            for stmt in node.body:
+                for sub in _iter_io_scope(stmt):
+                    if isinstance(sub, ast.Call):
+                        io_token = _is_io_call(sub)
+                        if io_token:
+                            break
+                if io_token:
+                    break
+            if not io_token:
+                continue
+            for handler in node.handlers:
+                if not _handler_is_broad(handler):
+                    continue
+                if not _handler_is_silent(handler):
+                    continue
+                yield Finding(
+                    rule="EXC001",
+                    path=sf.relpath,
+                    line=handler.lineno,
+                    message=(
+                        f"broad except silently swallows errors from "
+                        f"`{io_token}`; log, count a metric, record the "
+                        "error, or narrow the exception type"
+                    ),
+                    key=make_key(
+                        "EXC001", sf.relpath, sf.scope_of(handler), io_token
+                    ),
+                )

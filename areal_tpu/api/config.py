@@ -190,6 +190,66 @@ class OpenAIProxyConfig:
 
 
 @dataclass
+class ChaosConfig:
+    """Deterministic fault injection at the HTTP boundary (robustness/chaos.py).
+
+    Probabilities are drawn from ONE seeded RNG in call order, so a given
+    (seed, request sequence) always injects the same faults — chaos tests
+    are replayable. Disabled by default; the chaos harness and
+    ``validate_installation --chaos-self-test`` turn it on."""
+
+    enabled: bool = False
+    seed: int = 0
+    drop_prob: float = 0.0  # refuse the request (simulated connection loss)
+    delay_prob: float = 0.0  # inject latency before the request
+    delay_s: float = 0.05
+    error_prob: float = 0.0  # synthetic 5xx (server reached, request failed)
+    hang_prob: float = 0.0  # hold the request for hang_s (stuck server)
+    hang_s: float = 2.0
+    # only inject on paths starting with this prefix ("" = every path);
+    # lets a test target /generate while leaving weight updates clean
+    path_prefix: str = ""
+
+
+@dataclass
+class FaultToleranceConfig:
+    """Fault-tolerance layer knobs (robustness/): retrying transport,
+    circuit breaking + failover, replica supervision, and task-level
+    retry/quarantine. ``enabled=False`` restores the pre-robustness
+    fail-fast behavior everywhere."""
+
+    enabled: bool = True
+    # retrying transport (RetryPolicy): exponential backoff with jitter.
+    # Attempt count comes from InferenceEngineConfig.request_retries.
+    backoff_base_s: float = 0.2
+    backoff_max_s: float = 10.0
+    backoff_jitter: float = 0.2  # +/- fraction of the computed delay
+    # retry budget (token bucket): at most this many outstanding retry
+    # tokens; each successful request refunds retry_budget_refill tokens.
+    # Bounds retry amplification during a full-fleet outage. <= 0 disables.
+    retry_budget: float = 64.0
+    retry_budget_refill: float = 0.5
+    # per-replica circuit breaker: this many consecutive failures trip the
+    # circuit open (replica leaves rotation) for circuit_recovery_s, after
+    # which ONE half-open probe decides re-close vs re-open
+    circuit_failure_threshold: int = 5
+    circuit_recovery_s: float = 5.0
+    failover: bool = True  # re-route requests off tripped replicas
+    # replica supervision (client fleet probe + controller supervisor loop)
+    probe_interval_s: float = 5.0
+    probe_timeout_s: float = 2.0
+    # consecutive failed probes before the supervisor declares a worker dead
+    probe_failures_to_evict: int = 3
+    max_respawns: int = 3  # per-worker respawn budget (controller supervisor)
+    # task-level resilience (WorkflowExecutor): relaunch a failed rollout
+    # task up to task_max_retries times; task_quarantine_strikes total
+    # failures drop it as poison (counted, never fails the batch)
+    task_max_retries: int = 2
+    task_quarantine_strikes: int = 3
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
+
+
+@dataclass
 class InferenceEngineConfig:
     """Client-side rollout controls incl. staleness knobs (reference
     cli_args.py:1591-1612)."""
@@ -227,6 +287,11 @@ class InferenceEngineConfig:
     # RolloutController.initialize (requires tokenizer_path)
     openai: OpenAIProxyConfig | None = None
     tokenizer_path: str = ""  # chat templating for the proxy layer
+    # fault-tolerance layer (robustness/): retrying transport, circuit
+    # breaking + failover, supervision, task retry/quarantine, chaos knobs
+    fault_tolerance: FaultToleranceConfig = field(
+        default_factory=FaultToleranceConfig
+    )
 
 
 @dataclass

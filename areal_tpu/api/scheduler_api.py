@@ -81,6 +81,15 @@ class Scheduler(abc.ABC):
         Forked workers are auxiliary: they never take TPU ownership."""
         raise NotImplementedError(type(self).__name__)
 
+    def respawn_worker(self, worker: Worker) -> Worker:
+        """Replace a dead worker with a fresh process of the same role and
+        slot (same worker id, fresh port). Used by the replica supervisor
+        (robustness/supervisor.py) to bring evicted workers back; the
+        caller is responsible for re-creating engines on the replacement.
+        Schedulers that cannot respawn leave this unimplemented — the
+        supervisor then keeps the worker evicted."""
+        raise NotImplementedError(type(self).__name__)
+
     # engine RPC: every scheduler places the SAME RpcWorkerServer, so these
     # concrete defaults ride its HTTP surface regardless of how the worker
     # was placed (subprocess / Ray actor / sbatch task)

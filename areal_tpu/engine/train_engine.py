@@ -48,6 +48,7 @@ from areal_tpu.api.io_struct import FinetuneSpec, SaveLoadMeta, WeightUpdateMeta
 from areal_tpu.models import qwen
 from areal_tpu.models.hf import load_params_from_hf, save_params_to_hf
 from areal_tpu.parallel import mesh as mesh_lib
+from areal_tpu.utils.jax_compat import set_mesh, shard_map
 from areal_tpu.utils import logging as alog
 from areal_tpu.utils.data import TensorDict, seqlens_of
 from areal_tpu.utils.grid import Grid, pack_grid
@@ -229,7 +230,7 @@ class JaxTrainEngine(TrainEngine):
                     k: v for k, v in self.param_shardings.items() if k != "value_head"
                 },
             )
-            with jax.set_mesh(self.mesh):
+            with set_mesh(self.mesh):
                 self.params = init(jax.random.PRNGKey(kwargs.get("seed", 0)))
         else:
             t0 = time.monotonic()
@@ -308,7 +309,7 @@ class JaxTrainEngine(TrainEngine):
             self._tx = inner
         state_shapes = jax.eval_shape(self._tx.init, self.params)
         self.opt_state_shardings = self._opt_state_shardings(state_shapes)
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self.opt_state = jax.jit(
                 self._tx.init, out_shardings=self.opt_state_shardings
             )(self.params)
@@ -324,7 +325,7 @@ class JaxTrainEngine(TrainEngine):
         lora_shardings = mesh_lib.param_sharding(
             self.mesh, qwen.lora_partition_specs(mcfg)
         )
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             lora = jax.jit(
                 lambda key: qwen.init_lora_params(key, mcfg, dtype=pdtype),
                 out_shardings=lora_shardings,
@@ -348,7 +349,7 @@ class JaxTrainEngine(TrainEngine):
 
         pdtype = jnp.dtype(self.config.param_dtype)
         vshard = mesh_lib.param_sharding(self.mesh, vision_partition_specs())
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self.params["vision"] = jax.jit(
                 lambda k: init_vision_params(k, mcfg.vision, dtype=pdtype),
                 out_shardings=vshard,
@@ -419,7 +420,7 @@ class JaxTrainEngine(TrainEngine):
             return
         t0 = time.monotonic()
         sp, so = self._offload_shardings
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self.params = onload_tree(
                 self.params, None if mode[0] == "pinned_host" else sp, mode[0]
             )
@@ -538,7 +539,7 @@ class JaxTrainEngine(TrainEngine):
             self._fn_cache[key] = jax.jit(
                 lambda vp, px, c, pid: vis.vision_forward_batch(vp, vcfg, px, c, pid)
             )
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             out = np.asarray(
                 self._fn_cache[key](
                     self.params["vision"],
@@ -765,7 +766,7 @@ class JaxTrainEngine(TrainEngine):
         row = P(None, ("data", "fsdp"), None)
         data_specs = (P(None, ("data", "fsdp"), None, None), row, row)
         layer_specs = jax.tree.map(lambda _: P("pipe"), cparams["layers"])
-        mapped = jax.shard_map(
+        mapped = shard_map(
             fn,
             mesh=mesh,
             in_specs=(layer_specs, data_specs),
@@ -1021,7 +1022,7 @@ class JaxTrainEngine(TrainEngine):
         total_w = sum(weights) or 1.0
         agg: dict[str, float] = {}
         if len(batches) == 1:
-            with jax.set_mesh(self.mesh):
+            with set_mesh(self.mesh):
                 batch = self._tree_batch_to_device(batches[0])
                 shape = batch["node_ids"].shape + batch["gather_idx"].shape
                 step_before = self._opt_step_count()
@@ -1037,7 +1038,7 @@ class JaxTrainEngine(TrainEngine):
         else:
             grads = None
             accum = self._get_accum_fn()
-            with jax.set_mesh(self.mesh):
+            with set_mesh(self.mesh):
                 for b, w in zip(batches, weights):
                     batch = self._tree_batch_to_device(b)
                     shape = batch["node_ids"].shape + batch["gather_idx"].shape
@@ -1083,7 +1084,7 @@ class JaxTrainEngine(TrainEngine):
         agg: dict[str, float] = {}
         accum = self._get_accum_fn()
         if len(grids) == 1:
-            with jax.set_mesh(self.mesh):
+            with set_mesh(self.mesh):
                 batch = self._grid_to_device(grids[0])
                 step_before = self._opt_step_count()
                 fn = self._get_fused_step_fn(loss_fn, _shape_key(batch))
@@ -1096,7 +1097,7 @@ class JaxTrainEngine(TrainEngine):
             agg["n_microbatches"] = 1.0
             agg["train_batch_secs"] = time.monotonic() - t0
             return agg
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             for g, w in zip(grids, weights):
                 batch = self._grid_to_device(g)
                 shape = _shape_key(batch)
@@ -1155,7 +1156,7 @@ class JaxTrainEngine(TrainEngine):
         weights = [float(loss_weight_fn(g.data)) for g in grids]
         total_w = sum(weights) or 1.0
         agg: dict[str, float] = {}
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             for g, w in zip(grids, weights):
                 batch = self._grid_to_device(g)
                 shape = _shape_key(batch)
@@ -1185,7 +1186,7 @@ class JaxTrainEngine(TrainEngine):
         B, L = np.asarray(input_["attention_mask"]).shape
         out = np.zeros((B, L), dtype=np.float32)
         grids = self._make_grids(input_)
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             for g in grids:
                 batch = self._grid_to_device(g)
                 shape = _shape_key(batch)
@@ -1292,7 +1293,7 @@ class JaxTrainEngine(TrainEngine):
 
     def _export_params(self) -> dict:
         if self.model_cfg is not None and self.model_cfg.lora_rank > 0:
-            with jax.set_mesh(self.mesh):
+            with set_mesh(self.mesh):
                 return qwen.merge_lora(self.params, self.model_cfg)
         return self.params
 

@@ -25,6 +25,9 @@ from areal_tpu.api.engine_api import InferenceEngine
 from areal_tpu.api.io_struct import ModelRequest, ModelResponse, StopReason, WeightUpdateMeta
 from areal_tpu.infra.workflow_executor import WorkflowExecutor
 from areal_tpu.observability import catalog, tracecontext
+from areal_tpu.robustness import retry as _retry
+from areal_tpu.robustness.chaos import FaultInjector
+from areal_tpu.robustness.retry import FleetHealth, RetryBudget, RetryPolicy
 from areal_tpu.utils import logging as alog, name_resolve
 from areal_tpu.utils.data import TensorDict
 
@@ -82,6 +85,31 @@ class RemoteJaxEngine(InferenceEngine):
         self._paused = False
         self.last_pause_secs = 0.0  # last weight-update availability gap
         self._metrics = catalog.client_metrics()
+        # fault-tolerance layer (robustness/): retrying transport with a
+        # shared budget, per-replica circuit breakers, optional chaos hook
+        ft = config.fault_tolerance
+        self.fleet = FleetHealth(self.addresses, ft)
+        budget = (
+            RetryBudget(ft.retry_budget, ft.retry_budget_refill)
+            if ft.enabled
+            else None
+        )
+        self._retry_policy = RetryPolicy.from_config(
+            ft, attempts=config.request_retries, budget=budget
+        )
+        if not ft.enabled:
+            self._retry_policy.jitter = 0.0
+        self._robust = catalog.robustness_metrics()
+        self._fault_injector: FaultInjector | None = (
+            FaultInjector(ft.chaos) if ft.chaos.enabled else None
+        )
+        self._probe_thread = None
+        self._probe_stop = None
+
+    def install_fault_injector(self, injector: FaultInjector | None) -> None:
+        """Chaos harness hook: every outgoing HTTP call passes the injector
+        before touching the wire (tests + --chaos-self-test)."""
+        self._fault_injector = injector
 
     # -- discovery / lifecycle -------------------------------------------
     def initialize(self, addresses: list[str] | None = None, timeout: float | None = None) -> None:
@@ -98,25 +126,61 @@ class RemoteJaxEngine(InferenceEngine):
                 if not self.addresses:
                     time.sleep(0.5)
         assert self.addresses, "no inference server addresses"
+        for addr in self.addresses:
+            self.fleet.track(addr)  # discovery may have extended the list
         self._wait_healthy(timeout or self.config.setup_timeout)
         self.executor.initialize()
+        ft = self.config.fault_tolerance
+        if ft.enabled and len(self.addresses) > 1:
+            # fleet probe: detects replicas rejoining after a circuit
+            # opened and re-syncs their version (single-replica clients
+            # have nothing to fail over to, so no thread)
+            self.start_fleet_probe()
 
     def _wait_healthy(self, timeout: float) -> None:
+        """Block until every server answers /health with 200.
+
+        Connection-refused/reset means the server is still booting — keep
+        waiting quietly. An HTTP error status means the server is UP but
+        unhealthy (crash-looping handler, failed model load): log it
+        periodically so startup failures are diagnosable instead of
+        silently timing out. Either way the last error lands in the
+        TimeoutError."""
+        import urllib.error
         import urllib.request
 
         deadline = time.monotonic() + timeout
         for addr in self.addresses:
+            last_err: BaseException | None = None
+            n_http_err = 0
             while True:
                 try:
-                    with urllib.request.urlopen(f"http://{addr}/health", timeout=2) as r:
+                    with urllib.request.urlopen(
+                        f"http://{addr}/health", timeout=2
+                    ) as r:
                         if r.status == 200:
                             break
-                except Exception:
-                    if time.monotonic() > deadline:
-                        raise TimeoutError(f"server {addr} not healthy")
-                    time.sleep(0.5)
+                        last_err = RuntimeError(f"/health status {r.status}")
+                except urllib.error.HTTPError as e:
+                    last_err = e
+                    n_http_err += 1
+                    if n_http_err == 1 or n_http_err % 20 == 0:
+                        logger.warning(
+                            f"server {addr} is up but /health returns "
+                            f"{e.code} ({n_http_err} consecutive) — still "
+                            "waiting"
+                        )
+                except (urllib.error.URLError, ConnectionError, OSError) as e:
+                    last_err = e  # not accepting connections yet: still booting
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"server {addr} not healthy after {timeout:.0f}s; "
+                        f"last error: {last_err!r}"
+                    )
+                time.sleep(0.5)
 
     def destroy(self) -> None:
+        self.stop_fleet_probe()
         try:
             loop = self.executor.runner._loop
             if loop is not None and loop.is_running():
@@ -125,14 +189,102 @@ class RemoteJaxEngine(InferenceEngine):
             pass
         self.executor.destroy()
 
+    # -- fleet probe (replica rejoin detection) ---------------------------
+    def start_fleet_probe(self) -> None:
+        """Daemon loop probing /health so replicas whose circuit tripped
+        open rejoin rotation (and get re-synced) without waiting for the
+        half-open window to be discovered by live traffic."""
+        import threading
+
+        if self._probe_thread is not None:
+            return
+        stop = threading.Event()
+        self._probe_stop = stop
+        interval = max(0.2, self.config.fault_tolerance.probe_interval_s)
+
+        def loop():
+            while not stop.wait(interval):
+                try:
+                    self.probe_fleet()
+                except Exception:  # noqa: BLE001 — probing must never die
+                    logger.exception("fleet probe round failed")
+
+        self._probe_thread = threading.Thread(
+            target=loop, daemon=True, name="fleet-probe"
+        )
+        self._probe_thread.start()
+
+    def stop_fleet_probe(self) -> None:
+        if self._probe_thread is not None:
+            self._probe_stop.set()
+            self._probe_thread.join(timeout=5)
+            self._probe_thread = None
+            self._probe_stop = None
+
+    def probe_fleet(self) -> dict[str, str]:
+        """One probe round over every address; replicas seen healthy again
+        after an open circuit are closed and re-synced to the current
+        version. Returns the fleet state snapshot."""
+        import json as _json
+        import urllib.request
+
+        ft = self.config.fault_tolerance
+        for addr in list(self.addresses):
+            # half-open counts as "was down": the recovery window elapsing
+            # must not skip the rejoin/resync path
+            was_down = self.fleet.state(addr) != _retry.CLOSED
+            version = None
+            try:
+                with urllib.request.urlopen(
+                    f"http://{addr}/health", timeout=ft.probe_timeout_s
+                ) as r:
+                    d = _json.loads(r.read() or b"{}")
+                ok = d.get("status") == "ok"
+                version = d.get("version")
+            except Exception as e:  # noqa: BLE001 — a failed probe IS the signal
+                logger.debug(f"fleet probe {addr} failed: {e!r}")
+                ok = False
+            if ok:
+                if was_down:
+                    self.fleet.mark_rejoined(addr)
+                    self._resync_replica(addr, server_version=version)
+            else:
+                self.fleet.on_failure(addr)
+        return self.fleet.snapshot()
+
+    def _resync_replica(self, addr: str, server_version=None) -> None:
+        """A rejoined replica's weights AND version counter are whatever it
+        restarted with. Overwriting its version with the current one would
+        tag stale-weight tokens as fresh — laundering off-policy samples
+        past the staleness bound. So: leave its version truthful (the
+        staleness manager then accounts/rejects its rollouts correctly) and
+        let the next update_weights fan-out — which includes the replica
+        again now its circuit is closed — deliver current weights + version
+        atomically. Here we only surface the lag."""
+        if server_version is not None and int(server_version) == self._version:
+            logger.info(f"replica {addr} rejoined at current v{self._version}")
+            return
+        self._robust.replica_resyncs.inc()
+        logger.warning(
+            f"replica {addr} rejoined at v{server_version} (current "
+            f"v{self._version}) — serving stale weights until the next "
+            "weight update reaches it; staleness accounting stays truthful"
+        )
+
     # -- server choice ----------------------------------------------------
     def choose_server(self, rid: str | None = None) -> str:
         if rid and rid in self._rid_affinity:
-            return self._rid_affinity[rid]
+            addr = self._rid_affinity[rid]
+            # affinity only survives while the replica is in rotation; a
+            # tripped circuit drops it so the resume fails over cleanly
+            if self.fleet.allow(addr):
+                return addr
+            self._rid_affinity.pop(rid, None)
+        pool = self.fleet.healthy() or self.addresses  # all open: best effort
         if self.config.schedule_policy == "random":
-            addr = random.choice(self.addresses)
+            addr = random.choice(pool)
         else:  # round_robin
-            addr = self.addresses[self._rr % len(self.addresses)]
+            addr = pool[self._rr % len(pool)]
             self._rr += 1
         if rid:
             self._rid_affinity[rid] = addr
@@ -189,7 +341,11 @@ class RemoteJaxEngine(InferenceEngine):
                     ),
                 },
             }
-            data = await self._post_json(addr, "/generate", payload)
+            addr, data = await self._post_json_failover(addr, "/generate", payload)
+            if req.rid:
+                # failover may have moved us: resumes + pause-polls must
+                # follow the replica that actually holds the request
+                self._rid_affinity[req.rid] = addr
             toks = data["output_tokens"]
             accumulated.extend(toks)
             logprobs.extend(data["output_logprobs"])
@@ -229,24 +385,68 @@ class RemoteJaxEngine(InferenceEngine):
                 # servers (and may be an engine stat on new ones)
                 if not d.get("server_paused", d.get("paused")):
                     return
-            except Exception:  # noqa: BLE001 — server mid-restart
-                pass
+            except Exception as e:  # noqa: BLE001 — server mid-restart
+                logger.debug(f"pause-poll on {addr} failed: {e!r}")
+                if self.fleet.state(addr) == _retry.OPEN:
+                    # the replica left rotation while we waited — stop
+                    # polling a corpse; the resume request fails over
+                    return
             await asyncio.sleep(0.1)
 
     async def _post_json(self, addr: str, path: str, payload: dict) -> dict:
-        last_exc = None
+        """Retrying POST pinned to one address (no failover)."""
+        _, data = await self._post_json_failover(
+            addr, path, payload, failover=False
+        )
+        return data
+
+    async def _post_json_failover(
+        self, addr: str, path: str, payload: dict, failover: bool = True
+    ) -> tuple[str, dict]:
+        """POST through the retry policy + circuit breakers, failing over to
+        a healthy replica when the target trips open. Returns
+        ``(address_that_answered, json)`` so callers can repair affinity."""
+        ft = self.config.fault_tolerance
+        policy = self._retry_policy
+        can_failover = failover and ft.enabled and ft.failover
+        last_exc: Exception | None = None
         headers = tracecontext.inject()
-        for attempt in range(self.config.request_retries):
+        for attempt in range(policy.attempts):
+            if attempt > 0:
+                if not policy.allow_retry():
+                    self._robust.budget_exhausted.inc()
+                    break
+                self._robust.retries.labels(kind="post").inc()
+                await asyncio.sleep(policy.delay(attempt - 1))
+            if not self.fleet.allow(addr):
+                alt = self.fleet.pick_failover(addr) if can_failover else None
+                if alt is not None:
+                    self._robust.failovers.inc()
+                    addr = alt
+                # no healthy alternative: try the tripped replica anyway —
+                # a long-shot request beats guaranteed failure
             try:
+                if self._fault_injector is not None:
+                    await self._fault_injector.aperturb(addr, path)
                 sess = _get_session(self.config.request_timeout)
                 async with sess.post(
                     f"http://{addr}{path}", json=payload, headers=headers
                 ) as r:
                     r.raise_for_status()
-                    return await r.json()
+                    data = await r.json()
+                self.fleet.on_success(addr)
+                policy.on_success()
+                return addr, data
+            except asyncio.CancelledError:
+                raise
             except Exception as e:  # noqa: BLE001
                 last_exc = e
-                await asyncio.sleep(0.2 * 2**attempt)
+                self.fleet.on_failure(addr)
+                if can_failover:
+                    alt = self.fleet.pick_failover(addr)
+                    if alt is not None and alt != addr:
+                        self._robust.failovers.inc()
+                        addr = alt
         raise RuntimeError(f"POST {addr}{path} failed after retries") from last_exc
 
     # metric scrapes must not inherit the hour-scale generation timeout: a
@@ -262,40 +462,109 @@ class RemoteJaxEngine(InferenceEngine):
         timeout = timeout or min(
             self._SCRAPE_TIMEOUT_S, self.config.request_timeout
         )
+        policy = self._retry_policy
         last_exc: Exception | None = None
-        for attempt in range(2):  # initial try + one retry
+        for attempt in range(2):  # initial try + one retry (scrapes stay cheap)
+            if attempt > 0:
+                if not policy.allow_retry():
+                    self._robust.budget_exhausted.inc()
+                    break
+                self._metrics.scrape_retries.inc()
+                self._robust.retries.labels(kind="scrape").inc()
+                await asyncio.sleep(policy.delay(0))
             try:
+                if self._fault_injector is not None:
+                    await self._fault_injector.aperturb(addr, path)
                 sess = _get_session(timeout)
                 async with sess.get(
                     f"http://{addr}{path}", headers=tracecontext.inject()
                 ) as r:
                     r.raise_for_status()
-                    return await r.json()
+                    data = await r.json()
+                self.fleet.on_success(addr)
+                policy.on_success()
+                return data
+            except asyncio.CancelledError:
+                raise
             except Exception as e:  # noqa: BLE001
                 last_exc = e
-                if attempt == 0:
-                    self._metrics.scrape_retries.inc()
-                    await asyncio.sleep(0.2)
+                self.fleet.on_failure(addr)
         raise RuntimeError(f"GET {addr}{path} failed after retry") from last_exc
 
-    def _post_all(self, path: str, payload: dict) -> list[dict]:
-        """Synchronous fan-out to every server (weight updates, pause)."""
+    def _fanout_targets(self) -> list[str]:
+        """The snapshot of replicas a multi-step fan-out protocol should
+        address. Only CLOSED (fully in-rotation) replicas participate: an
+        OPEN one is dead, and a HALF_OPEN one is a recovering maybe —
+        neither can be *required* to ack a weight update. Callers running
+        begin→buckets→commit sequences must take ONE snapshot and reuse it,
+        so a replica rejoining mid-protocol cannot receive a commit for
+        buckets it never staged. Falls back to every address when none are
+        closed (best effort beats guaranteed failure)."""
+        if not self.config.fault_tolerance.enabled:
+            return list(self.addresses)
+        closed = [
+            a for a in self.addresses if self.fleet.state(a) == _retry.CLOSED
+        ]
+        skipped = [a for a in self.addresses if a not in closed]
+        if skipped and closed:
+            logger.warning(f"fan-out skipping out-of-rotation replicas {skipped}")
+            return closed
+        return list(self.addresses)
+
+    def _retry_sync(self, addr: str, path: str, send):
+        """One address, retried in place through the shared policy (the
+        sync twin of the transport loop in _post_json_failover). Fan-out
+        calls are not failover-able — they must reach this replica — so an
+        ultimate failure raises."""
+        policy = self._retry_policy
+        last_exc: Exception | None = None
+        for attempt in range(policy.attempts):
+            if attempt > 0:
+                if not policy.allow_retry():
+                    self._robust.budget_exhausted.inc()
+                    break
+                self._robust.retries.labels(kind="fanout").inc()
+                time.sleep(policy.delay(attempt - 1))
+            try:
+                if self._fault_injector is not None:
+                    self._fault_injector.perturb(addr, path)
+                out = send(addr)
+                self.fleet.on_success(addr)
+                policy.on_success()
+                return out
+            except Exception as e:  # noqa: BLE001
+                last_exc = e
+                self.fleet.on_failure(addr)
+        raise RuntimeError(f"POST {addr}{path} failed after retries") from last_exc
+
+    def _post_all(
+        self, path: str, payload: dict, targets: list[str] | None = None
+    ) -> list[dict]:
+        """Synchronous fan-out (weight updates, pause). ``targets`` lets a
+        multi-step protocol pin one _fanout_targets() snapshot across all
+        its steps; None snapshots fresh for standalone calls."""
         import concurrent.futures
         import json
         import urllib.request
 
-        def call(addr):
+        targets = targets if targets is not None else self._fanout_targets()
+
+        def send(addr):
             req = urllib.request.Request(
                 f"http://{addr}{path}",
                 data=json.dumps(payload).encode(),
                 headers={"Content-Type": "application/json"},
                 method="POST",
             )
-            with urllib.request.urlopen(req, timeout=self.config.request_timeout) as r:
+            with urllib.request.urlopen(
+                req, timeout=self.config.request_timeout
+            ) as r:
                 return json.loads(r.read())
 
         with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
-            return list(pool.map(call, self.addresses))
+            return list(
+                pool.map(lambda a: self._retry_sync(a, path, send), targets)
+            )
 
     # -- rollout submission (delegated to the executor) -------------------
     def set_completion_callback(self, url: str, worker_id: str = "") -> None:
@@ -333,11 +602,11 @@ class RemoteJaxEngine(InferenceEngine):
         self.executor.resume()
 
     # -- server-side generation pause (weight-update window) --------------
-    def pause_generation(self) -> None:
-        self._post_all("/pause_generation", {})
+    def pause_generation(self, targets: list[str] | None = None) -> None:
+        self._post_all("/pause_generation", {}, targets=targets)
 
-    def continue_generation(self) -> None:
-        self._post_all("/continue_generation", {})
+    def continue_generation(self, targets: list[str] | None = None) -> None:
+        self._post_all("/continue_generation", {}, targets=targets)
 
     # -- weights + versioning --------------------------------------------
     def update_weights(self, meta: WeightUpdateMeta, params: dict | None = None) -> None:
@@ -348,6 +617,10 @@ class RemoteJaxEngine(InferenceEngine):
         ``update_weights_pause_secs`` (reference target: <3 s at scale,
         blog/AReaL_v0_2.md:79-83)."""
         version = self._version + 1 if meta.with_version else self._version
+        # ONE snapshot of in-rotation replicas for the whole pause→push→
+        # resume protocol: a replica rejoining mid-update must not receive
+        # a commit for buckets it never staged
+        targets = self._fanout_targets()
         enc_pool = first = None
         if meta.type == "mem" and meta.lora_only:
             # LoRA-delta fast path: one tiny bucket of adapter leaves, no
@@ -359,15 +632,16 @@ class RemoteJaxEngine(InferenceEngine):
             )
             body = self._encode_bucket(sorted(params.items()))
             t0 = time.monotonic()
-            self.pause_generation()
+            self.pause_generation(targets)
             try:
                 self._post_all_bytes(
                     f"/update_weights_lora?scale={meta.lora_scale}"
                     f"&version={version}",
                     body,
+                    targets=targets,
                 )
             finally:
-                self.continue_generation()
+                self.continue_generation(targets)
             self.last_pause_secs = time.monotonic() - t0
             self._metrics.updates.inc()
             self._metrics.update_bytes.inc(len(body))
@@ -392,19 +666,23 @@ class RemoteJaxEngine(InferenceEngine):
             enc_pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
             first = enc_pool.submit(self._encode_bucket, plan[0])
         t0 = time.monotonic()
-        self.pause_generation()
+        self.pause_generation(targets)
         try:
             if meta.type == "disk":
                 assert meta.path
                 self._post_all(
-                    "/update_weights_from_disk", {"path": meta.path, "version": version}
+                    "/update_weights_from_disk",
+                    {"path": meta.path, "version": version},
+                    targets=targets,
                 )
             elif meta.type == "mem":
-                self._stream_weight_buckets(plan, version, enc_pool, first)
+                self._stream_weight_buckets(
+                    plan, version, enc_pool, first, targets
+                )
             else:
                 raise NotImplementedError(meta.type)
         finally:
-            self.continue_generation()
+            self.continue_generation(targets)
             if enc_pool is not None:
                 enc_pool.shutdown(wait=False)
         self.last_pause_secs = time.monotonic() - t0
@@ -483,7 +761,9 @@ class RemoteJaxEngine(InferenceEngine):
             entries.append((name, arr))
         return encode_weight_bucket(entries)
 
-    def _stream_weight_buckets(self, buckets, version: int, enc_pool, first) -> None:
+    def _stream_weight_buckets(
+        self, buckets, version: int, enc_pool, first, targets: list[str] | None = None
+    ) -> None:
         """Pipelined upload: encode bucket i+1 (device->host + bf16 cast)
         while bucket i is in flight to every server; servers device_put each
         bucket on arrival, so transport/serialisation/H2D all overlap.
@@ -496,21 +776,22 @@ class RemoteJaxEngine(InferenceEngine):
         NCCL broadcast role, fsdp_engine.py:1047-1137)."""
         import concurrent.futures
 
-        self._post_all("/update_weights_begin", {})
+        targets = targets if targets is not None else self._fanout_targets()
+        self._post_all("/update_weights_begin", {}, targets=targets)
         relay = (
             getattr(self.config, "weight_update_relay", False)
-            and len(self.addresses) > 1
+            and len(targets) > 1
         )
         with concurrent.futures.ThreadPoolExecutor(max_workers=8) as net_pool:
             if relay:
                 hdr = {
-                    "X-Areal-Relay": ",".join(self.addresses[1:]),
+                    "X-Areal-Relay": ",".join(targets[1:]),
                     "X-Areal-Relay-Timeout": str(self.config.request_timeout),
                 }
 
                 def send(body: bytes) -> None:
                     self._post_bytes(
-                        self.addresses[0], "/update_weights_bucket", body, headers=hdr
+                        targets[0], "/update_weights_bucket", body, headers=hdr
                     )
 
             else:
@@ -521,7 +802,7 @@ class RemoteJaxEngine(InferenceEngine):
                             lambda addr: self._post_bytes(
                                 addr, "/update_weights_bucket", body
                             ),
-                            self.addresses,
+                            targets,
                         )
                     )
 
@@ -537,20 +818,27 @@ class RemoteJaxEngine(InferenceEngine):
                 # a failed stream must not leave partial buckets pinning
                 # server HBM until the next begin — best-effort abort
                 try:
-                    self._post_all("/update_weights_abort", {})
+                    self._post_all("/update_weights_abort", {}, targets=targets)
                 except Exception:  # noqa: BLE001
-                    pass
+                    logger.warning(
+                        "weight-update abort fan-out failed; servers drop "
+                        "the staged buckets at the next begin",
+                        exc_info=True,
+                    )
                 raise
-        self._post_all("/update_weights_commit", {"version": version})
+        self._post_all("/update_weights_commit", {"version": version}, targets=targets)
 
-    def _post_all_bytes(self, path: str, body: bytes) -> None:
+    def _post_all_bytes(
+        self, path: str, body: bytes, targets: list[str] | None = None
+    ) -> None:
         import concurrent.futures
 
+        targets = targets if targets is not None else self._fanout_targets()
         with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
             list(
                 pool.map(
                     lambda addr: self._post_bytes(addr, path, body),
-                    self.addresses,
+                    targets,
                 )
             )
 
@@ -559,14 +847,22 @@ class RemoteJaxEngine(InferenceEngine):
     ) -> None:
         import urllib.request
 
-        req = urllib.request.Request(
-            f"http://{addr}{path}",
-            data=body,
-            headers={"Content-Type": "application/octet-stream", **(headers or {})},
-            method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=self.config.request_timeout) as r:
-            r.read()
+        def send(a):
+            req = urllib.request.Request(
+                f"http://{a}{path}",
+                data=body,
+                headers={
+                    "Content-Type": "application/octet-stream",
+                    **(headers or {}),
+                },
+                method="POST",
+            )
+            with urllib.request.urlopen(
+                req, timeout=self.config.request_timeout
+            ) as r:
+                r.read()
+
+        self._retry_sync(addr, path, send)
 
     def set_version(self, version: int) -> None:
         self._version = version

@@ -50,6 +50,7 @@ from areal_tpu.models import qwen
 from areal_tpu.models.hf import load_params_from_hf
 from areal_tpu.observability import catalog as obs_catalog
 from areal_tpu.parallel import mesh as mesh_lib
+from areal_tpu.utils.jax_compat import set_mesh
 from areal_tpu.utils import logging as alog
 from areal_tpu.utils.data import round_up_to_bucket
 
@@ -286,7 +287,7 @@ class DecodeEngine:
                 vshard = mesh_lib.param_sharding(
                     self.mesh, vision_partition_specs()
                 )
-                with jax.set_mesh(self.mesh):
+                with set_mesh(self.mesh):
                     self.params["vision"] = jax.jit(
                         lambda k: init_vision_params(
                             k, self.model_cfg.vision, dtype=self.model_cfg.jax_dtype
@@ -305,7 +306,7 @@ class DecodeEngine:
             # and the quantized leaves inherit the replication)
             from areal_tpu.inference.server import _unflatten
 
-            with jax.set_mesh(self.mesh):
+            with set_mesh(self.mesh):
                 self.params = _unflatten(
                     {p: self._place(p, a) for p, a in _iter_tree_paths(self.params)}
                 )
@@ -357,7 +358,7 @@ class DecodeEngine:
         # gated so default fleets pay neither the memory nor new variants.
         self._freq_enabled = bool(cfg.enable_frequency_penalty)
         self._pending_count_restore: list[tuple[int, np.ndarray]] = []
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self._dev_state = {k: jnp.asarray(v) for k, v in self._state.items()}
             if self._freq_enabled:
                 self._dev_state["freq_counts"] = jnp.zeros(
@@ -418,7 +419,7 @@ class DecodeEngine:
         fn = getattr(self, "_quantize_jit", None)
         if fn is None:
             fn = self._quantize_jit = jax.jit(qwen.quantize_params_int8)
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             return fn(params)
 
     def _init_paged_cache(self) -> None:
@@ -461,7 +462,7 @@ class DecodeEngine:
             jax.devices()[0].platform == "tpu"
             and int(np.prod(list(self.mesh.shape.values()))) == 1
         )
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self.cache = jax.jit(
                 lambda: paged_kv.init_paged_cache(mcfg, n_pages, psz, quant=kv_quant),
                 out_shardings={
@@ -644,7 +645,7 @@ class DecodeEngine:
                 )
 
         n_prog = 0
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             for task in tasks:
                 if budget_s is not None and time.monotonic() - t0 > budget_s:
                     logger.warning(
@@ -800,7 +801,7 @@ class DecodeEngine:
 
             self._lora_fold_fn = jax.jit(fold, donate_argnums=(0,))
         new_prev = {}
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             for t in targets:
                 a = jnp.asarray(flat[f"layers/{t}_lora_a"], jnp.float32)
                 b = jnp.asarray(flat[f"layers/{t}_lora_b"], jnp.float32)
@@ -979,7 +980,7 @@ class DecodeEngine:
         if not mode:
             return
         t0 = time.monotonic()
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             if mode == "pinned_host":
                 self.params = onload_tree(self.params, None, mode)
             else:
@@ -1085,7 +1086,7 @@ class DecodeEngine:
             px_pad = np.pad(px, ((0, Ppad - P), (0, 0)))
             pos_pad = np.pad(pos, ((0, Ppad - P), (0, 0)))
             mask = np.arange(Ppad) < P
-            with jax.set_mesh(self.mesh):
+            with set_mesh(self.mesh):
                 out = np.asarray(
                     self._fn_cache[key](
                         self.params["vision"],
@@ -1557,7 +1558,7 @@ class DecodeEngine:
                 self._fn_cache[key] = jax.jit(
                     paged_kv.copy_pages, donate_argnames=("cache",)
                 )
-            with jax.set_mesh(self.mesh):
+            with set_mesh(self.mesh):
                 self.cache = self._fn_cache[key](
                     self.cache, jnp.asarray(dst), jnp.asarray(src)
                 )
@@ -1611,7 +1612,7 @@ class DecodeEngine:
             flat_pages = np.pad(flat_pages, ((0, A_pad - A), (0, 0)))
             if img is not None:
                 img = np.pad(img, ((0, A_pad - A), (0, 0), (0, 0)))
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             args = [
                 self.params,
                 self.cache,
@@ -1656,7 +1657,7 @@ class DecodeEngine:
             n *= 2
         n = min(n, self.config.max_batch_size)
         upd = np.stack(rows + [rows[0]] * (n - len(rows)))
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self._dev_state = self._update_fn(n)(
                 self._dev_state, jnp.asarray(upd)
             )
@@ -1826,7 +1827,7 @@ class DecodeEngine:
         while n < len(rows):
             n *= 2
         upd = np.asarray(rows + [rows[0]] * (n - len(rows)), np.int32)
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self._dev_state = self._clamp_fn(n)(
                 self._dev_state, jnp.asarray(upd)
             )
@@ -1886,7 +1887,7 @@ class DecodeEngine:
             (st["freq_pen"] != 0.0)[active].any()
         )
         chunk = self._chunk_fn(n_steps, wp, capped, greedy_any, freq_any)
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             pt = jnp.asarray(self._pt_host[:, :wp])
             self.cache, self._dev_state, self._rng, packed = chunk(
                 self.params, self.cache, pt, self._dev_state, self._rng

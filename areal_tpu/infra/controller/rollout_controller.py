@@ -54,6 +54,12 @@ class RolloutController:
         self.gateway_url: str | None = None
         import threading as _threading
 
+        # fault-tolerance: worker fleet membership + eviction state, guarded
+        # by _fleet_lock (the supervisor thread mutates, submit paths read)
+        self._fleet_lock = _threading.Lock()
+        self._evicted: set[str] = set()
+        self._supervisor = None  # ReplicaSupervisor | None
+        self._engine_init_config = None  # for engine re-creation on respawn
         self._cb_cv = _threading.Condition()
         self._cb_done: set[str] = set()
         from collections import deque as _deque
@@ -61,6 +67,7 @@ class RolloutController:
         self._cb_order: "_deque[str]" = _deque()  # bound for never-awaited ids
         self._cb_thread = None
         self._cb_server = None
+        self._cb_url = ""  # re-registered on respawned workers
         # fleet telemetry (start_telemetry): scrape loop + HTTP endpoint
         self._telemetry_thread = None
         self._telemetry_server = None
@@ -73,6 +80,7 @@ class RolloutController:
         job = Job(replicas=self.replicas, role=self.role, env=self.worker_env)
         self.workers = self.scheduler.create_workers(job)
         self._server_addresses = list(addresses or [])
+        self._engine_init_config = config
         for w in self.workers:
             self.scheduler.create_engine(w, self.engine_path, config)
         self.scheduler.call_all(self.workers, "initialize", addresses)
@@ -94,6 +102,7 @@ class RolloutController:
             self.start_gateway()
 
     def destroy(self) -> None:
+        self.stop_supervision()
         self.stop_telemetry()
         self.disable_completion_callbacks()
         self.stop_gateway()
@@ -239,10 +248,90 @@ class RolloutController:
             self._gateway_loop = None
             self.gateway_url = None
 
+    # -- replica supervision (robustness/supervisor.py) --------------------
+    # The supervisor probes every worker's RPC /health on a cadence; dead
+    # workers are evicted from rotation, respawned through the scheduler
+    # (when it supports respawn_worker), re-initialized against the same
+    # inference fleet, and re-synced to the current policy version before
+    # rejoining. Opt-in like start_telemetry: call after initialize().
+    def start_supervision(self, probe=None) -> None:
+        from areal_tpu.api.config import FaultToleranceConfig
+        from areal_tpu.robustness.supervisor import ReplicaSupervisor
+
+        assert self.workers, "initialize() first"
+        assert self._supervisor is None, "supervision already running"
+        ft = getattr(self._engine_init_config, "fault_tolerance", None)
+        if ft is None:
+            ft = FaultToleranceConfig()
+        self._supervisor = ReplicaSupervisor(self, ft, probe=probe)
+        self._supervisor.start()
+        logger.info(
+            f"replica supervision started over {len(self.workers)} workers "
+            f"(probe every {ft.probe_interval_s}s)"
+        )
+
+    def stop_supervision(self) -> None:
+        if self._supervisor is not None:
+            self._supervisor.stop()
+            self._supervisor = None
+
+    def fleet_workers(self) -> list[Worker]:
+        """All workers, including evicted ones (supervisor probe set)."""
+        with self._fleet_lock:
+            return list(self.workers)
+
+    def active_workers(self) -> list[Worker]:
+        """Workers currently in rotation (evicted ones skipped)."""
+        with self._fleet_lock:
+            return [w for w in self.workers if w.id not in self._evicted]
+
+    def evict_worker(self, worker: Worker) -> None:
+        with self._fleet_lock:
+            if worker.id in self._evicted:
+                return
+            self._evicted.add(worker.id)
+        logger.warning(f"worker {worker.id} @ {worker.address} evicted from rotation")
+
+    def respawn_worker(self, worker: Worker) -> Worker:
+        """Replace a dead worker via the scheduler and bring the clone all
+        the way back: engine re-created, re-initialized against the same
+        inference fleet, completion callback re-registered, and version
+        re-synced — then rejoin rotation."""
+        fresh = self.scheduler.respawn_worker(worker)
+        self.scheduler.create_engine(
+            fresh, self.engine_path, self._engine_init_config
+        )
+        self.scheduler.call_engine(
+            fresh, "initialize", self._server_addresses or None
+        )
+        if self._cb_thread is not None and self._cb_url:
+            self.scheduler.call_engine(
+                fresh, "set_completion_callback", self._cb_url, fresh.id
+            )
+        # weight/version re-sync: rollout workers are clients of the shared
+        # inference fleet, so the policy weights live server-side; what the
+        # clone must recover is the version counter its staleness
+        # accounting and submissions key off
+        self.scheduler.call_engine(fresh, "set_version", self._version)
+        with self._fleet_lock:
+            self.workers = [
+                fresh if w.id == worker.id else w for w in self.workers
+            ]
+            self._evicted.discard(worker.id)
+        logger.info(f"worker {fresh.id} rejoined rotation @ {fresh.address}")
+        return fresh
+
     # -- submission -------------------------------------------------------
     def _next_worker(self) -> Worker:
-        w = self.workers[self._rr % len(self.workers)]
-        self._rr += 1
+        with self._fleet_lock:
+            pool = [w for w in self.workers if w.id not in self._evicted]
+            if not pool:
+                raise RuntimeError(
+                    "no rollout workers in rotation (all evicted) — fleet "
+                    "is down and respawn has not recovered it"
+                )
+            w = pool[self._rr % len(pool)]
+            self._rr += 1
         return w
 
     def submit(self, data: dict, workflow: str | None = None, **kw) -> str:
@@ -328,6 +417,7 @@ class RolloutController:
         )
         self._cb_thread.start()
         url = f"http://{gethostip()}:{port}/task_done"
+        self._cb_url = url
         try:
             for w in self.workers:
                 self.scheduler.call_engine(
@@ -346,21 +436,25 @@ class RolloutController:
                     self.scheduler.call_engine(
                         w, "set_completion_callback", "", w.id
                     )
-                except Exception:  # noqa: BLE001 — worker may be gone
-                    pass
+                except Exception as e:  # noqa: BLE001 — worker may be gone
+                    logger.debug(f"callback deregister on {w.id} failed: {e!r}")
             self._cb_server.shutdown()
             self._cb_server.server_close()
             self._cb_thread.join(timeout=10)
             self._cb_thread = None
             self._cb_server = None
+            self._cb_url = ""
             with self._cb_cv:
                 self._cb_done.clear()
                 self._cb_order.clear()
 
     def rollout_batch(self, data: list[dict], workflow: str | None = None, **kw):
-        """Split items across workers; each runs its share through its own
-        executor; concatenate the padded results."""
-        n = min(len(self.workers), len(data)) or 1
+        """Split items across in-rotation workers; each runs its share
+        through its own executor; concatenate the padded results."""
+        workers = self.active_workers()
+        if not workers:
+            raise RuntimeError("no rollout workers in rotation (all evicted)")
+        n = min(len(workers), len(data)) or 1
         chunks = [list(data[i::n]) for i in range(n)]
         with concurrent.futures.ThreadPoolExecutor(n) as pool:
             futs = [
@@ -372,7 +466,7 @@ class RolloutController:
                     workflow,
                     **kw,
                 )
-                for w, chunk in zip(self.workers, chunks)
+                for w, chunk in zip(workers, chunks)
                 if chunk
             ]
             results = [f.result() for f in futs]
@@ -536,6 +630,21 @@ class RolloutController:
                                 "uptime_secs": time.time() - started_at,
                                 "version": ctl._version,
                                 "n_workers": len(ctl.workers),
+                                # fault-tolerance fleet state: which rollout
+                                # workers are in rotation, plus supervisor
+                                # probe/respawn accounting when running
+                                "fleet": {
+                                    w.id: {
+                                        "address": w.address,
+                                        "evicted": w.id in ctl._evicted,
+                                    }
+                                    for w in ctl.fleet_workers()
+                                },
+                                "supervisor": (
+                                    ctl._supervisor.statusz()
+                                    if ctl._supervisor is not None
+                                    else None
+                                ),
                                 "targets": [
                                     {
                                         "target": t.target,

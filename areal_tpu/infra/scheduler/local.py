@@ -139,6 +139,7 @@ class LocalScheduler(Scheduler):
     def _wait_healthy(self, procs: list[_Proc]) -> None:
         deadline = time.monotonic() + self.start_timeout
         for p in procs:
+            last_err: BaseException | None = None
             while True:
                 if p.proc.poll() is not None:
                     raise RuntimeError(
@@ -151,12 +152,14 @@ class LocalScheduler(Scheduler):
                     )
                     if d.get("status") == "ok":
                         break
-                except Exception:  # noqa: BLE001 — still booting
-                    pass
+                    last_err = RuntimeError(f"/health says {d!r}")
+                except Exception as e:  # noqa: BLE001 — still booting
+                    last_err = e
                 if time.monotonic() > deadline:
                     raise TimeoutError(
                         f"worker {p.worker.id} not healthy after "
-                        f"{self.start_timeout}s:\n" + self._log_tail(p)
+                        f"{self.start_timeout}s (last error: {last_err!r}):\n"
+                        + self._log_tail(p)
                     )
                 time.sleep(0.2)
 
@@ -191,8 +194,8 @@ class LocalScheduler(Scheduler):
                         _http_json(
                             f"http://{p.worker.address}/kill", {}, timeout=2
                         )
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as e:  # noqa: BLE001 — SIGKILL follows
+                        logger.debug(f"graceful kill of {p.worker.id} failed: {e!r}")
                     try:
                         p.proc.wait(timeout=5)
                     except subprocess.TimeoutExpired:
@@ -206,6 +209,46 @@ class LocalScheduler(Scheduler):
 
     def set_worker_env(self, role: str, env: dict[str, str]) -> None:
         self._role_env.setdefault(role, {}).update(env)
+
+    def respawn_worker(self, worker: Worker) -> Worker:
+        """Replace one (presumed-dead) worker subprocess in place: same
+        role, same slot index (so the worker id is stable and supervisor
+        respawn budgets accumulate per slot), fresh port. Any process still
+        attached to the slot is killed first."""
+        procs = self._procs.get(worker.role)
+        assert procs, f"no workers of role {worker.role!r}"
+        slot = next(
+            (i for i, p in enumerate(procs) if p.worker.id == worker.id), None
+        )
+        assert slot is not None, f"unknown worker {worker.id}"
+        old = procs[slot]
+        if old.proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(old.proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                old.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+        job = old.job
+        index = int(worker.id.rsplit("-", 1)[-1])
+        fresh = self._spawn(
+            role=worker.role,
+            index=index,
+            module="areal_tpu.infra.rpc.rpc_server",
+            argv=["--port", "{port}"],
+            extra_env=(job.env if job is not None else None),
+            pin_cpu=(job.tpus <= 0 if job is not None else True),
+            job=job,
+        )
+        self._wait_healthy([fresh])
+        procs[slot] = fresh
+        logger.info(
+            f"respawned worker {worker.id}: {worker.address} -> "
+            f"{fresh.worker.address}"
+        )
+        return fresh.worker
 
     def fork_workers(
         self,

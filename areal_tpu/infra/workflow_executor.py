@@ -24,7 +24,7 @@ import numpy as np
 
 from areal_tpu.api.config import InferenceEngineConfig
 from areal_tpu.api.workflow_api import RolloutWorkflow, resolve_workflow
-from areal_tpu.infra.async_task_runner import AsyncTaskRunner
+from areal_tpu.infra.async_task_runner import AsyncTaskRunner, TaskFailed
 from areal_tpu.infra.staleness_manager import StalenessManager
 from areal_tpu.observability import catalog
 from areal_tpu.utils import logging as alog
@@ -52,7 +52,17 @@ def check_trajectory_format(traj: TensorDict) -> None:
 
 
 class _TaskRecord:
-    __slots__ = ("task_id", "data", "result", "accepted", "is_eval", "submit_ts")
+    __slots__ = (
+        "task_id",
+        "data",
+        "result",
+        "accepted",
+        "is_eval",
+        "submit_ts",
+        "workflow",
+        "accept_fn",
+        "strikes",
+    )
 
     def __init__(self, task_id: str, data: Any, is_eval: bool = False):
         self.task_id = task_id
@@ -61,6 +71,11 @@ class _TaskRecord:
         self.accepted: bool | None = None
         self.is_eval = is_eval
         self.submit_ts = time.monotonic()
+        # task-level resilience: what to relaunch with, and how many
+        # attempts have failed so far (quarantine strikes)
+        self.workflow: RolloutWorkflow | None = None
+        self.accept_fn: Callable | None = None
+        self.strikes = 0
 
 
 class WorkflowExecutor:
@@ -107,6 +122,7 @@ class WorkflowExecutor:
         # optional: attach a tokenizer to get decoded text in trajectory dumps
         self.tokenizer = None
         self._obs = catalog.executor_metrics()
+        self._robust = catalog.robustness_metrics()
         self._inflight = 0  # launched, not yet completed (dispatcher-only)
 
     # -- lifecycle --------------------------------------------------------
@@ -154,12 +170,25 @@ class WorkflowExecutor:
                 # drain completed tasks. The timed poll doubles as the idle
                 # wait: when this turn made no progress the 20 ms block is
                 # the loop's only pause (there used to be an extra
-                # time.sleep on top — needless added latency)
-                res = self.runner.poll_result(timeout=0.02)
-                while res is not None:
+                # time.sleep on top — needless added latency). Failed tasks
+                # surface as TaskFailed here and go through retry/
+                # quarantine instead of killing the dispatcher.
+                first = True
+                while True:
+                    try:
+                        res = self.runner.poll_result(
+                            timeout=0.02 if first else None
+                        )
+                    except TaskFailed as tf:
+                        first = False
+                        self._inflight -= 1
+                        self._on_task_failed(tf)
+                        continue
+                    first = False
+                    if res is None:
+                        break
                     self._inflight -= 1
                     self._on_result(res.task_id, res.data)
-                    res = self.runner.poll_result()
                 # queue-depth gauges: cheap last-writer-wins sets on every
                 # loop turn so a scrape always sees a fresh picture
                 self._obs.input_depth.set(self._input.qsize())
@@ -178,6 +207,9 @@ class WorkflowExecutor:
     def _launch(self, rec: _TaskRecord, workflow: RolloutWorkflow, accept_fn) -> None:
         self._obs.dispatch_latency.observe(time.monotonic() - rec.submit_ts)
         self._inflight += 1
+        # kept for relaunch-on-failure (task-level resilience)
+        rec.workflow = workflow
+        rec.accept_fn = accept_fn
 
         async def run():
             from areal_tpu.infra import workflow_context
@@ -261,6 +293,62 @@ class WorkflowExecutor:
                     self._done_tasks.pop(self._reject_order.popleft(), None)
             self._cv.notify_all()
         self._notify_completion(task_id, accepted)
+
+    def _on_task_failed(self, tf: TaskFailed) -> None:
+        """Task-level resilience: a rollout task whose coroutine raised.
+
+        With fault tolerance enabled the task is relaunched (same record,
+        same workflow) up to ``task_max_retries`` times; past
+        ``task_quarantine_strikes`` total failures it is dropped as poison —
+        counted in ``areal_task_quarantined_total`` and accounted as a
+        rejection so the pipeline keeps flowing instead of the whole batch
+        failing. With fault tolerance disabled the failure propagates and
+        kills the dispatcher (the original fail-fast contract)."""
+        ft = self.config.fault_tolerance
+        if not ft.enabled:
+            raise tf
+        task_id = tf.task_id
+        rec = self._done_tasks.get(task_id)
+        if rec is None or rec.workflow is None:
+            logger.error(f"failed task {task_id} has no record; dropping")
+            return
+        rec.strikes += 1
+        if (
+            rec.strikes <= ft.task_max_retries
+            and rec.strikes < ft.task_quarantine_strikes
+        ):
+            self._robust.task_retries.inc()
+            logger.warning(
+                f"task {task_id} attempt {rec.strikes} failed "
+                f"({tf.exc!r}); relaunching"
+            )
+            # restamp so the dispatch-latency histogram measures queue
+            # wait, not the failed attempt's runtime
+            rec.submit_ts = time.monotonic()
+            self._launch(rec, rec.workflow, rec.accept_fn)
+            return
+        self._robust.task_quarantined.inc()
+        logger.error(
+            f"task {task_id} quarantined after {rec.strikes} failed "
+            f"attempts; last error: {tf.exc!r}"
+        )
+        if not rec.is_eval:
+            self.staleness.on_reject()
+        tracker = stats_tracker.get()
+        counter_cm = (
+            tracker.scope("eval-rollout") if rec.is_eval else _nullcontext()
+        )
+        with counter_cm:
+            tracker.scalar(rollout_rejected=1.0)
+        with self._cv:
+            rec.result = None
+            rec.accepted = False
+            rec.data = None
+            self._reject_order.append(task_id)
+            while len(self._reject_order) > self._max_reject_records:
+                self._done_tasks.pop(self._reject_order.popleft(), None)
+            self._cv.notify_all()
+        self._notify_completion(task_id, False)
 
     # -- completion push (fleet-scale wait: reference rollout_controller
     # per-worker completion callbacks, rollout_controller.py:530-646) ------

@@ -26,6 +26,7 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
+from areal_tpu.utils.jax_compat import get_abstract_mesh, shard_map
 
 
 def _shard(x, spec):
@@ -127,7 +128,7 @@ def moe_ffn_dropless(h: jax.Array, layer: dict, cfg) -> tuple[jax.Array, jax.Arr
     G, L, D = h.shape
     E, K = cfg.num_experts, cfg.num_experts_per_tok
     try:
-        mesh = jax.sharding.get_abstract_mesh()
+        mesh = get_abstract_mesh()
         axes = dict(mesh.shape) if mesh is not None else {}
     except Exception:  # noqa: BLE001
         axes = {}
@@ -229,7 +230,7 @@ def moe_ffn_dropless(h: jax.Array, layer: dict, cfg) -> tuple[jax.Array, jax.Arr
             layer["we_down"],
         )
     else:
-        out, aux = jax.shard_map(
+        out, aux = shard_map(
             block,
             in_specs=(
                 P(BATCH_AXES, "seq", None),

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
+from areal_tpu.utils.jax_compat import get_abstract_mesh, shard_map
 
 # mesh axes over which the microbatch rows (G dim) shard
 BATCH_AXES = ("data", "fsdp")
@@ -461,7 +462,7 @@ def _embed_lookup(
     — no replication anywhere. Falls back to ``jnp.take`` when no mesh is
     active (single-chip serving, CPU tests)."""
     try:
-        mesh = jax.sharding.get_abstract_mesh()
+        mesh = get_abstract_mesh()
         axes = dict(mesh.shape) if mesh is not None else {}
     except Exception:  # noqa: BLE001 — no mesh context
         axes = {}
@@ -506,7 +507,7 @@ def _embed_lookup(
         d_sz = axes.get("data", 1) * f_sz
         s_sz = axes.get("seq", 1)
         if ids.shape[0] % d_sz == 0 and ids.shape[1] % s_sz == 0:
-            return jax.shard_map(
+            return shard_map(
                 local_grid,
                 in_specs=(P(("fsdp", "model"), None), P(BATCH_AXES, "seq")),
                 out_specs=P(BATCH_AXES, "seq", None),
@@ -523,7 +524,7 @@ def _embed_lookup(
             stacklevel=2,
         )
     reps = (None,) * ids.ndim
-    return jax.shard_map(  # replicated ids: decode steps, serving prefill
+    return shard_map(  # replicated ids: decode steps, serving prefill
         local_flat,
         in_specs=(P(("fsdp", "model"), None), P(*reps)),
         out_specs=P(*reps, None),

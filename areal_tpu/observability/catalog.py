@@ -233,6 +233,69 @@ def trainer_metrics(reg: Registry | None = None) -> SimpleNamespace:
     )
 
 
+def robustness_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """Fault-tolerance layer (robustness/): retry/circuit/supervision/chaos."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        replica_state=r.gauge(
+            "areal_replica_state",
+            "Replica health by address: 0 in rotation (healthy), "
+            "1 suspect (half-open circuit / failed probes), "
+            "2 evicted (circuit open or supervisor-declared dead).",
+            label_names=("replica",),
+        ),
+        retries=r.counter(
+            "areal_retry_total",
+            "HTTP requests retried after a failure, by call kind.",
+            label_names=("kind",),
+        ),
+        circuit_open=r.counter(
+            "areal_circuit_open_total",
+            "Circuit-breaker open transitions (replica evicted from "
+            "rotation after consecutive failures).",
+        ),
+        failovers=r.counter(
+            "areal_failover_total",
+            "Requests re-routed to a different replica after the preferred "
+            "one failed or tripped open.",
+        ),
+        budget_exhausted=r.counter(
+            "areal_retry_budget_exhausted_total",
+            "Retries skipped because the retry token budget was exhausted "
+            "(fail-fast under fleet-wide outage).",
+        ),
+        task_retries=r.counter(
+            "areal_task_retry_total",
+            "Rollout tasks relaunched after a failed attempt.",
+        ),
+        task_quarantined=r.counter(
+            "areal_task_quarantined_total",
+            "Rollout tasks dropped as poison after exhausting their "
+            "retry strikes.",
+        ),
+        replica_respawns=r.counter(
+            "areal_replica_respawn_total",
+            "Dead rollout workers respawned by the controller supervisor.",
+        ),
+        replica_resyncs=r.counter(
+            "areal_replica_resync_total",
+            "Replicas that rejoined the fleet needing re-sync (respawned "
+            "workers re-versioned by the supervisor; servers refreshed by "
+            "the next weight-update fan-out).",
+        ),
+        recover_fallbacks=r.counter(
+            "areal_recover_fallback_total",
+            "Recovery loads that fell back to the previous checkpoint "
+            "after detecting a corrupt or dangling recover record.",
+        ),
+        chaos_injected=r.counter(
+            "areal_chaos_injected_total",
+            "Faults injected by the chaos harness, by kind.",
+            label_names=("kind",),
+        ),
+    )
+
+
 def aggregator_metrics(reg: Registry | None = None) -> SimpleNamespace:
     """Fleet aggregator: scrape health."""
     r = reg or get_registry()
@@ -259,6 +322,7 @@ ALL_FACTORIES = (
     client_metrics,
     rpc_metrics,
     trainer_metrics,
+    robustness_metrics,
     aggregator_metrics,
 )
 

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
+from areal_tpu.utils.jax_compat import axis_size, get_abstract_mesh, shard_map
 
 
 def _block_attn(q, k, v, seg_q, seg_k, idx_q, idx_k, scale):
@@ -40,7 +41,7 @@ def _block_attn(q, k, v, seg_q, seg_k, idx_q, idx_k, scale):
 def _ring_shard_fn(q, k, v, seg, idx, axis_name: str, scale: float, vary_axes=()):
     """Per-device body under shard_map. All inputs are local shards:
     q/k/v [B, Lc, H, d], seg/idx [B, Lc]."""
-    sp = jax.lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     B, Lc, H, d = q.shape
 
     def step(i, carry):
@@ -68,8 +69,10 @@ def _ring_shard_fn(q, k, v, seg, idx, axis_name: str, scale: float, vary_axes=()
     axes = tuple(vary_axes) or (axis_name,)
     if hasattr(jax.lax, "pcast"):
         _vary = lambda x: jax.lax.pcast(x, axes, to="varying")  # noqa: E731
-    else:  # older jax
+    elif hasattr(jax.lax, "pvary"):
         _vary = lambda x: jax.lax.pvary(x, axes)  # noqa: E731
+    else:  # pre-varying-types jax: no manual-axes type system to satisfy
+        _vary = lambda x: x  # noqa: E731
     o0 = _vary(jnp.zeros((B, H, Lc, d), jnp.float32))
     m0 = _vary(jnp.full((B, H, Lc), -jnp.inf, jnp.float32))
     l0 = _vary(jnp.zeros((B, H, Lc), jnp.float32))
@@ -90,7 +93,7 @@ def ring_attention(
 ) -> jax.Array:
     """Context-parallel causal attention for packed grids. Call inside jit
     with a mesh context; outside a mesh it falls back to single-device."""
-    mesh = mesh or jax.sharding.get_abstract_mesh()
+    mesh = mesh or get_abstract_mesh()
     if mesh is None or axis_name not in mesh.shape or mesh.shape[axis_name] == 1:
         scale = q.shape[-1] ** -0.5
         logits = _block_attn(q, k, v, segment_ids, segment_ids, col_index, col_index, scale)
@@ -109,7 +112,7 @@ def ring_attention(
     spec_qkv = P(batch_spec, axis_name, None, None)
     spec_tok = P(batch_spec, axis_name)
     vary_axes = (axis_name,) + (tuple(batch_axes) if batch_spec else ())
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(
             _ring_shard_fn, axis_name=axis_name, scale=scale, vary_axes=vary_axes
         ),

@@ -1,0 +1,38 @@
+"""Fault-tolerance layer: retrying transport, circuit breaking, replica
+supervision, and deterministic chaos injection.
+
+The async rollout design (PAPER.md) only pays off when the fleet survives
+what long-running TPU jobs actually hit: preempted slices, hung HTTP
+requests, replicas dying mid-batch. This package provides the shared
+primitives the transport (inference/client.py), controller
+(infra/controller/rollout_controller.py), executor
+(infra/workflow_executor.py), and recovery (utils/recover.py) paths thread
+through. See docs/fault_tolerance.md for semantics and guarantees.
+"""
+
+from areal_tpu.robustness.chaos import KINDS, FaultInjected, FaultInjector
+from areal_tpu.robustness.retry import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    FleetHealth,
+    RetryBudget,
+    RetryPolicy,
+)
+from areal_tpu.robustness.supervisor import ReplicaSupervisor, default_probe
+
+__all__ = [
+    "CLOSED",
+    "HALF_OPEN",
+    "OPEN",
+    "CircuitBreaker",
+    "FaultInjected",
+    "FaultInjector",
+    "FleetHealth",
+    "KINDS",
+    "ReplicaSupervisor",
+    "RetryBudget",
+    "RetryPolicy",
+    "default_probe",
+]

@@ -1,0 +1,132 @@
+"""Deterministic chaos injection at the HTTP boundary.
+
+A :class:`FaultInjector` sits between the rollout client and the wire: for
+every outgoing request it draws from ONE seeded RNG (in call order) and
+either lets the request through or injects a fault — drop (simulated
+connection loss), delay, synthetic 5xx, or hang. Because the draws are
+sequential from a single ``random.Random(seed)``, a given (seed, request
+sequence) replays the exact same fault pattern, which is what makes chaos
+tests debuggable instead of flaky.
+
+Install on a client with ``RemoteJaxEngine.install_fault_injector`` (the
+client calls :meth:`aperturb`/:meth:`perturb` before each HTTP call), or
+wrap any callable with :meth:`wrap`. Replica kills are driven by the test
+harness directly (stop the server), since a real kill exercises the whole
+eviction path rather than simulating it.
+
+Injected faults are counted per-kind in ``areal_chaos_injected_total`` so a
+chaos run can assert the harness actually fired.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import threading
+import time
+
+from areal_tpu.api.config import ChaosConfig
+from areal_tpu.observability import catalog
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("robustness.chaos")
+
+KINDS = ("drop", "delay", "error", "hang")
+
+
+class FaultInjected(ConnectionError):
+    """An injected fault, typed by kind so tests can tell them apart."""
+
+    def __init__(self, kind: str, addr: str, path: str):
+        super().__init__(f"chaos[{kind}] {addr}{path}")
+        self.kind = kind
+        self.addr = addr
+        self.path = path
+
+
+class FaultInjector:
+    """Config-driven, seeded fault source for the HTTP boundary."""
+
+    def __init__(self, config: ChaosConfig):
+        self.config = config
+        self._rng = random.Random(config.seed)
+        self._lock = threading.Lock()
+        self.injected: dict[str, int] = {k: 0 for k in KINDS}
+        self.requests_seen = 0
+        self._metrics = catalog.robustness_metrics()
+
+    # -- decision ----------------------------------------------------------
+    def decide(self, addr: str, path: str) -> str | None:
+        """The fault (if any) for the next request, drawn deterministically.
+
+        One uniform draw per request keeps the sequence stable: fault kinds
+        partition [0, 1) as [drop | delay | error | hang | pass]."""
+        cfg = self.config
+        if not cfg.enabled:
+            return None
+        with self._lock:
+            self.requests_seen += 1
+            if cfg.path_prefix and not path.startswith(cfg.path_prefix):
+                return None
+            u = self._rng.random()
+        edge = cfg.drop_prob
+        if u < edge:
+            return "drop"
+        edge += cfg.delay_prob
+        if u < edge:
+            return "delay"
+        edge += cfg.error_prob
+        if u < edge:
+            return "error"
+        edge += cfg.hang_prob
+        if u < edge:
+            return "hang"
+        return None
+
+    def _record(self, kind: str, addr: str, path: str) -> None:
+        with self._lock:
+            self.injected[kind] += 1
+        self._metrics.chaos_injected.labels(kind=kind).inc()
+        logger.debug(f"injected {kind} on {addr}{path}")
+
+    # -- application -------------------------------------------------------
+    async def aperturb(self, addr: str, path: str) -> None:
+        """Async boundary hook: sleep for delay/hang, raise for drop/error."""
+        kind = self.decide(addr, path)
+        if kind is None:
+            return
+        self._record(kind, addr, path)
+        if kind == "delay":
+            await asyncio.sleep(self.config.delay_s)
+            return
+        if kind == "hang":
+            await asyncio.sleep(self.config.hang_s)
+        raise FaultInjected(kind, addr, path)
+
+    def perturb(self, addr: str, path: str) -> None:
+        """Sync boundary hook (thread-pool fan-out paths)."""
+        kind = self.decide(addr, path)
+        if kind is None:
+            return
+        self._record(kind, addr, path)
+        if kind == "delay":
+            time.sleep(self.config.delay_s)
+            return
+        if kind == "hang":
+            time.sleep(self.config.hang_s)
+        raise FaultInjected(kind, addr, path)
+
+    def wrap(self, fn, addr: str = "", path: str = ""):
+        """Decorate a sync callable so each invocation passes the boundary."""
+
+        def wrapped(*args, **kwargs):
+            self.perturb(addr, path)
+            return fn(*args, **kwargs)
+
+        return wrapped
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            out = dict(self.injected)
+            out["requests_seen"] = self.requests_seen
+        return out

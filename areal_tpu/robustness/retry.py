@@ -1,0 +1,339 @@
+"""Retrying transport primitives: backoff policy, budget, circuit breaker.
+
+Long-running TPU fleets hit transient faults as a matter of course —
+preempted slices, hung HTTP requests, replicas dying mid-batch (PAPERS.md:
+"Scalable Training of Language Models using JAX pjit and TPUv4" treats pod
+preemption as routine). This module gives every network path one shared
+vocabulary for surviving them:
+
+- :class:`RetryPolicy` — exponential backoff with jitter, bounded by a
+  shared :class:`RetryBudget` token bucket so a fleet-wide outage cannot
+  amplify into a retry storm.
+- :class:`CircuitBreaker` — per-replica closed/open/half-open state machine:
+  consecutive failures trip the replica out of rotation; after a recovery
+  window one probe request decides whether it rejoins.
+- :class:`FleetHealth` — the per-address tracker the client routes through:
+  healthy-set selection, failover picks, and rejoin detection, exporting
+  ``areal_replica_state`` / ``areal_retry_total`` / ``areal_circuit_open_total``.
+
+Everything is thread-safe: the rollout client calls in from the asyncio
+loop, sync fan-out thread pools, and probe threads concurrently.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Iterable
+
+from areal_tpu.api.config import FaultToleranceConfig
+from areal_tpu.observability import catalog
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("robustness.retry")
+
+# circuit states (exported values of areal_replica_state)
+CLOSED = "closed"
+HALF_OPEN = "half_open"
+OPEN = "open"
+_STATE_VALUE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+
+class RetryBudget:
+    """Token bucket bounding retry amplification.
+
+    Each retry spends one token; each *successful* request refunds
+    ``refill`` tokens (capped at ``capacity``). When the bucket is empty,
+    retries are denied and callers fail fast — during a full-fleet outage
+    the retry traffic decays instead of multiplying the load that the
+    recovering fleet sees. ``capacity <= 0`` disables accounting entirely.
+    """
+
+    def __init__(self, capacity: float, refill: float = 0.5):
+        self.capacity = float(capacity)
+        self.refill = float(refill)
+        self._tokens = self.capacity
+        self._lock = threading.Lock()
+
+    def try_spend(self) -> bool:
+        if self.capacity <= 0:
+            return True
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def on_success(self) -> None:
+        if self.capacity <= 0:
+            return
+        with self._lock:
+            self._tokens = min(self.capacity, self._tokens + self.refill)
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+
+class RetryPolicy:
+    """Exponential backoff + jitter + shared budget.
+
+    ``attempts`` is the TOTAL number of tries (initial + retries), matching
+    the existing ``InferenceEngineConfig.request_retries`` semantics that
+    the ad-hoc loops used. ``delay(attempt)`` is the sleep before retry
+    number ``attempt`` (0-based): ``base * 2**attempt`` capped at ``max_s``,
+    scattered by ``+/- jitter`` so a fleet of clients never thunders in
+    phase.
+    """
+
+    def __init__(
+        self,
+        attempts: int = 3,
+        base_s: float = 0.2,
+        max_s: float = 10.0,
+        jitter: float = 0.2,
+        budget: RetryBudget | None = None,
+        rng: random.Random | None = None,
+    ):
+        self.attempts = max(1, int(attempts))
+        self.base_s = float(base_s)
+        self.max_s = float(max_s)
+        self.jitter = float(jitter)
+        self.budget = budget
+        self._rng = rng or random.Random()
+
+    @classmethod
+    def from_config(
+        cls,
+        ft: FaultToleranceConfig,
+        attempts: int,
+        budget: RetryBudget | None = None,
+    ) -> "RetryPolicy":
+        return cls(
+            attempts=attempts,
+            base_s=ft.backoff_base_s,
+            max_s=ft.backoff_max_s,
+            jitter=ft.backoff_jitter,
+            budget=budget,
+        )
+
+    def delay(self, attempt: int) -> float:
+        d = min(self.max_s, self.base_s * (2.0 ** max(0, attempt)))
+        if self.jitter > 0:
+            d *= 1.0 + self._rng.uniform(-self.jitter, self.jitter)
+        return max(0.0, d)
+
+    def allow_retry(self) -> bool:
+        """Spend a budget token for one retry (True when permitted)."""
+        return self.budget is None or self.budget.try_spend()
+
+    def on_success(self) -> None:
+        if self.budget is not None:
+            self.budget.on_success()
+
+
+class CircuitBreaker:
+    """closed -> (N consecutive failures) -> open -> (recovery window)
+    -> half-open -> one probe decides closed or open again."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        recovery_s: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+        on_open: Callable[[], None] | None = None,
+    ):
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.recovery_s = float(recovery_s)
+        self._clock = clock
+        self._on_open = on_open
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        if (
+            self._state == OPEN
+            and self._clock() - self._opened_at >= self.recovery_s
+        ):
+            # recovery window elapsed: the next allow() is the probe
+            self._state = HALF_OPEN
+        return self._state
+
+    def allow(self) -> bool:
+        """May a request be sent through this replica right now?"""
+        with self._lock:
+            state = self._state_locked()
+            if state == CLOSED:
+                return True
+            if state == HALF_OPEN:
+                # exactly one probe: re-arm the open timer so concurrent
+                # callers don't all pile onto a possibly-dead replica
+                self._state = OPEN
+                self._opened_at = self._clock()
+                return True
+            return False
+
+    def on_success(self) -> None:
+        with self._lock:
+            self._state = CLOSED
+            self._consecutive_failures = 0
+
+    def on_failure(self) -> None:
+        opened = False
+        with self._lock:
+            prev = self._state  # raw: a prior read may have set HALF_OPEN
+            self._consecutive_failures += 1
+            if (
+                prev != OPEN
+                and self._consecutive_failures >= self.failure_threshold
+            ):
+                self._state = OPEN
+                self._opened_at = self._clock()
+                # a failed HALF_OPEN probe re-arms the existing outage; only
+                # CLOSED -> OPEN is a NEW eviction (otherwise the open
+                # counter/log fires once per probe round on a dead replica)
+                opened = prev == CLOSED
+            elif prev == OPEN:
+                self._opened_at = self._clock()
+        if opened and self._on_open is not None:
+            self._on_open()
+
+    def force_open(self) -> None:
+        """Administrative eviction (supervisor declared the replica dead)."""
+        opened = False
+        with self._lock:
+            if self._state == CLOSED:
+                opened = True  # re-opening a half-open probe is not a new eviction
+            self._state = OPEN
+            self._consecutive_failures = self.failure_threshold
+            self._opened_at = self._clock()
+        if opened and self._on_open is not None:
+            self._on_open()
+
+
+class FleetHealth:
+    """Per-address replica health: circuit breakers + rotation filtering.
+
+    The rollout client consults :meth:`allow` before each request,
+    reports outcomes via :meth:`on_success`/:meth:`on_failure`, and asks
+    :meth:`pick_failover` for a healthy alternative when a replica trips.
+    :meth:`mark_rejoined` is how probe loops report a replica coming back
+    (the caller then re-syncs its version/weights).
+    """
+
+    def __init__(
+        self,
+        addresses: Iterable[str],
+        ft: FaultToleranceConfig | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.ft = ft or FaultToleranceConfig()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._metrics = catalog.robustness_metrics()
+        self._rng = random.Random()
+        for addr in addresses:
+            self.track(addr)
+
+    # -- membership --------------------------------------------------------
+    def track(self, addr: str) -> None:
+        with self._lock:
+            if addr in self._breakers:
+                return
+            self._breakers[addr] = CircuitBreaker(
+                failure_threshold=self.ft.circuit_failure_threshold,
+                recovery_s=self.ft.circuit_recovery_s,
+                clock=self._clock,
+                on_open=lambda a=addr: self._record_open(a),
+            )
+        self._export_state(addr)
+
+    def untrack(self, addr: str) -> None:
+        with self._lock:
+            self._breakers.pop(addr, None)
+
+    def addresses(self) -> list[str]:
+        with self._lock:
+            return list(self._breakers)
+
+    # -- request routing ---------------------------------------------------
+    def allow(self, addr: str) -> bool:
+        if not self.ft.enabled:
+            return True
+        br = self._breaker(addr)
+        return br.allow() if br is not None else True
+
+    def healthy(self) -> list[str]:
+        """Addresses currently in rotation (closed or probing half-open)."""
+        with self._lock:
+            items = list(self._breakers.items())
+        if not self.ft.enabled:
+            return [a for a, _ in items]
+        return [a for a, br in items if br.state != OPEN]
+
+    def pick_failover(self, avoid: str) -> str | None:
+        """A healthy replica other than ``avoid`` (None when there is none)."""
+        candidates = [a for a in self.healthy() if a != avoid]
+        if not candidates:
+            return None
+        return self._rng.choice(candidates)
+
+    # -- outcome reporting -------------------------------------------------
+    def on_success(self, addr: str) -> None:
+        br = self._breaker(addr)
+        if br is not None:
+            br.on_success()
+            self._export_state(addr)
+
+    def on_failure(self, addr: str) -> None:
+        br = self._breaker(addr)
+        if br is not None:
+            br.on_failure()
+            self._export_state(addr)
+
+    def evict(self, addr: str) -> None:
+        br = self._breaker(addr)
+        if br is not None:
+            br.force_open()
+            self._export_state(addr)
+
+    def mark_rejoined(self, addr: str) -> None:
+        """A probe saw the replica healthy again: close its circuit."""
+        br = self._breaker(addr)
+        if br is not None:
+            br.on_success()
+            self._export_state(addr)
+
+    # -- introspection -----------------------------------------------------
+    def state(self, addr: str) -> str:
+        br = self._breaker(addr)
+        return br.state if br is not None else CLOSED
+
+    def snapshot(self) -> dict[str, str]:
+        with self._lock:
+            items = list(self._breakers.items())
+        return {a: br.state for a, br in items}
+
+    # -- internals ---------------------------------------------------------
+    def _breaker(self, addr: str) -> CircuitBreaker | None:
+        with self._lock:
+            return self._breakers.get(addr)
+
+    def _record_open(self, addr: str) -> None:
+        self._metrics.circuit_open.inc()
+        logger.warning(f"circuit OPEN for replica {addr} — out of rotation")
+
+    def _export_state(self, addr: str) -> None:
+        self._metrics.replica_state.labels(replica=addr).set(
+            _STATE_VALUE[self.state(addr)]
+        )

@@ -1,0 +1,173 @@
+"""Replica supervision: probe, evict, respawn, re-sync.
+
+The :class:`ReplicaSupervisor` is the controller-side half of the fault
+-tolerance layer. It runs a daemon loop that probes every rollout worker's
+RPC ``/health`` endpoint; a worker that fails ``probe_failures_to_evict``
+consecutive probes is *evicted* (``RolloutController._next_worker`` skips
+it) and — when the scheduler supports :meth:`~areal_tpu.api.scheduler_api.
+Scheduler.respawn_worker` and the per-worker respawn budget allows — is
+respawned as a fresh process. The replacement gets its engine re-created,
+re-initialized against the same inference fleet, and re-synced to the
+controller's current policy version before rejoining rotation, so a
+recovered replica can never serve stale-versioned rollouts.
+
+State is exported through the robustness metric family
+(``areal_replica_state`` / ``areal_replica_respawn_total`` /
+``areal_replica_resync_total``) and surfaced on the controller's
+``/statusz``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from areal_tpu.api.config import FaultToleranceConfig
+from areal_tpu.api.scheduler_api import Worker
+from areal_tpu.observability import catalog
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("robustness.supervisor")
+
+
+def default_probe(worker: Worker, timeout: float) -> bool:
+    """True when the worker's RPC server answers /health with status ok."""
+    from areal_tpu.utils.network import http_json
+
+    try:
+        d = http_json(f"http://{worker.address}/health", timeout=timeout)
+    except Exception as e:  # noqa: BLE001 — probe failures are the signal
+        logger.debug(f"probe {worker.id} failed: {e!r}")
+        return False
+    return d.get("status") == "ok"
+
+
+class ReplicaSupervisor:
+    """Background supervision loop over a RolloutController's workers.
+
+    The controller owns worker membership (its ``_fleet_lock`` guards the
+    list and the evicted set); the supervisor drives the state transitions
+    through the controller-provided callbacks so there is exactly one
+    mutation path.
+    """
+
+    def __init__(
+        self,
+        controller,  # RolloutController (duck-typed to avoid the import cycle)
+        ft: FaultToleranceConfig,
+        probe: Callable[[Worker, float], bool] | None = None,
+    ):
+        self.controller = controller
+        self.ft = ft
+        self.probe = probe or default_probe
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._fail_counts: dict[str, int] = {}
+        self._respawn_counts: dict[str, int] = {}
+        self._metrics = catalog.robustness_metrics()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        assert self._thread is None, "supervisor already running"
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="replica-supervisor"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._wake.set()
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def kick(self) -> None:
+        """Run a probe round promptly (tests; manual recovery)."""
+        self._wake.set()
+
+    # -- loop --------------------------------------------------------------
+    def _loop(self) -> None:
+        interval = max(0.1, self.ft.probe_interval_s)
+        while not self._stop.is_set():
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — supervision must survive bugs
+                logger.exception("supervision round failed")
+            self._wake.wait(interval)
+            self._wake.clear()
+
+    def probe_once(self) -> dict[str, str]:
+        """One probe round over the current fleet; returns {worker_id: state}."""
+        states: dict[str, str] = {}
+        for w in self.controller.fleet_workers():
+            wid = w.id
+            if self.probe(w, self.ft.probe_timeout_s):
+                with self._lock:
+                    self._fail_counts[wid] = 0
+                states[wid] = "up"
+                self._metrics.replica_state.labels(replica=w.address).set(0.0)
+                continue
+            with self._lock:
+                self._fail_counts[wid] = self._fail_counts.get(wid, 0) + 1
+                n = self._fail_counts[wid]
+            states[wid] = "down"
+            self._metrics.replica_state.labels(replica=w.address).set(1.0)
+            if n >= max(1, self.ft.probe_failures_to_evict):
+                self._handle_dead(w)
+                states[wid] = "evicted"
+        return states
+
+    # -- eviction / respawn ------------------------------------------------
+    def _handle_dead(self, worker: Worker) -> None:
+        self.controller.evict_worker(worker)
+        self._metrics.replica_state.labels(replica=worker.address).set(2.0)
+        with self._lock:
+            spawned = self._respawn_counts.get(worker.id, 0)
+            if spawned >= self.ft.max_respawns:
+                logger.error(
+                    f"worker {worker.id} dead and respawn budget exhausted "
+                    f"({spawned}/{self.ft.max_respawns}) — staying evicted"
+                )
+                return
+            self._respawn_counts[worker.id] = spawned + 1
+        try:
+            replacement = self.controller.respawn_worker(worker)
+        except NotImplementedError:
+            logger.warning(
+                f"worker {worker.id} evicted; scheduler cannot respawn — "
+                "it stays out of rotation"
+            )
+            return
+        except Exception:  # noqa: BLE001 — respawn is best-effort; retry next round
+            logger.exception(f"respawn of {worker.id} failed")
+            return
+        self._metrics.replica_respawns.inc()
+        self._metrics.replica_resyncs.inc()
+        self._metrics.replica_state.labels(replica=replacement.address).set(0.0)
+        if replacement.address != worker.address:
+            # the dead address no longer exists: clear its gauge so
+            # dashboards don't show a phantom evicted replica forever
+            self._metrics.replica_state.labels(replica=worker.address).set(0.0)
+        with self._lock:
+            self._fail_counts[replacement.id] = 0
+        logger.info(
+            f"worker {worker.id} respawned as {replacement.id} "
+            f"@ {replacement.address} and re-synced to "
+            f"v{self.controller.get_version()}"
+        )
+
+    # -- introspection -----------------------------------------------------
+    def statusz(self) -> dict:
+        with self._lock:
+            fails = dict(self._fail_counts)
+            respawns = dict(self._respawn_counts)
+        return {
+            "probe_interval_s": self.ft.probe_interval_s,
+            "fail_counts": fails,
+            "respawn_counts": respawns,
+            "checked_at": time.time(),
+        }

@@ -2,7 +2,13 @@
 checks imports, device availability, a tiny jit, and the HTTP stack; prints
 a PASS/FAIL table and exits nonzero on failure.
 
-Usage: python -m areal_tpu.tools.validate_installation [--tpu]
+``--chaos-self-test`` additionally spins up a 3-replica in-process
+inference fleet (tiny model, CPU) behind a seeded FaultInjector dropping
+10% of requests, and asserts a rollout batch completes through the
+retrying transport — a one-command smoke test of the fault-tolerance
+layer for CI.
+
+Usage: python -m areal_tpu.tools.validate_installation [--tpu] [--chaos-self-test]
 """
 
 from __future__ import annotations
@@ -22,6 +28,12 @@ def _check(name, fn, results):
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--tpu", action="store_true", help="require a TPU backend")
+    p.add_argument(
+        "--chaos-self-test",
+        action="store_true",
+        help="run a 3-replica local fleet under 10%% injected faults and "
+        "assert a rollout batch completes",
+    )
     args = p.parse_args(argv)
     results: list[tuple[str, bool, str]] = []
 
@@ -116,12 +128,106 @@ def main(argv=None) -> int:
 
     _check("native", native_kernels, results)
 
+    if args.chaos_self_test:
+        _check("chaos", chaos_self_test, results)
+
     width = max(len(n) for n, _, _ in results)
     ok = True
     for name, passed, detail in results:
         ok &= passed
         print(f"{name:<{width}}  {'PASS' if passed else 'FAIL'}  {detail}")
     return 0 if ok else 1
+
+
+def chaos_self_test(
+    n_replicas: int = 3, drop_prob: float = 0.1, n_prompts: int = 6, seed: int = 42
+) -> str:
+    """3-replica in-process fleet + seeded 10%-drop FaultInjector: a rollout
+    batch must complete through retries/failover, and the chaos harness must
+    actually have fired (otherwise the test proves nothing)."""
+    import jax
+
+    from areal_tpu.api.config import (
+        ChaosConfig,
+        FaultToleranceConfig,
+        InferenceEngineConfig,
+        MeshConfig,
+        ServerConfig,
+    )
+    from areal_tpu.api.io_struct import GenerationHyperparameters
+    from areal_tpu.inference.client import RemoteJaxEngine
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.inference.server import ServerThread
+    from areal_tpu.models import qwen
+    from areal_tpu.robustness import FaultInjector
+    from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+    tiny = qwen.ModelConfig(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_layers=2,
+        num_heads=2,
+        num_kv_heads=1,
+        dtype="float32",
+        tie_word_embeddings=True,
+        rope_theta=10000.0,
+    )
+    params = qwen.init_params(jax.random.PRNGKey(0), tiny)
+    servers = []
+    client = None
+    try:
+        for i in range(n_replicas):
+            cfg = ServerConfig(
+                max_batch_size=4,
+                max_seq_len=64,
+                decode_steps_per_call=4,
+                seed=i,
+                mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+            )
+            eng = DecodeEngine(cfg, params=params, model_cfg=tiny)
+            eng.initialize()
+            st = ServerThread(cfg, eng)
+            st.start()
+            servers.append(st)
+        client = RemoteJaxEngine(
+            InferenceEngineConfig(
+                max_concurrent_rollouts=4,
+                consumer_batch_size=2,
+                max_head_offpolicyness=100,
+                request_timeout=60,
+                request_retries=5,
+                fault_tolerance=FaultToleranceConfig(
+                    backoff_base_s=0.05, backoff_max_s=0.5
+                ),
+            ),
+            addresses=[s.address for s in servers],
+        )
+        client.initialize()
+        injector = FaultInjector(
+            ChaosConfig(enabled=True, seed=seed, drop_prob=drop_prob)
+        )
+        client.install_fault_injector(injector)
+        wf = RLVRWorkflow(
+            lambda *a, **k: 1.0,
+            GenerationHyperparameters(max_new_tokens=4, greedy=True),
+        )
+        batch = client.rollout_batch(
+            [{"prompt_ids": [2 + i, 5, 7]} for i in range(n_prompts)],
+            workflow=wf,
+        )
+        assert batch["input_ids"].shape[0] == n_prompts, batch["input_ids"].shape
+        stats = injector.stats()
+        assert stats["drop"] > 0, "fault injector never fired"
+        return (
+            f"{n_prompts} rollouts over {n_replicas} replicas survived "
+            f"{stats['drop']} injected drops ({stats['requests_seen']} requests)"
+        )
+    finally:
+        if client is not None:
+            client.destroy()
+        for st in servers:
+            st.stop()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,77 @@
+"""Version-bridging shims for jax APIs the engines rely on.
+
+Newer jax promoted several experimental APIs to the top level and renamed
+kwargs; this image ships 0.4.37 where they live in their old homes. The
+engines/models/kernels route through these shims so the same code runs on
+both:
+
+- ``set_mesh(mesh)``: newer ``jax.set_mesh`` context manager; on <= 0.4.x
+  the ``Mesh`` itself has been the ambient-mesh context since the pjit
+  era. Without this, every engine initialize dies with ``AttributeError:
+  module 'jax' has no attribute 'set_mesh'``.
+- ``shard_map(...)``: newer ``jax.shard_map`` (kwarg ``check_vma``); old
+  home is ``jax.experimental.shard_map.shard_map`` (kwarg ``check_rep``).
+- ``get_abstract_mesh()``: newer ambient-mesh query; the old equivalent is
+  the resource env's physical mesh (empty mesh when no context is active,
+  which callers already treat as "no mesh").
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "set_mesh"):
+    set_mesh = jax.set_mesh
+else:
+
+    def set_mesh(mesh):
+        """jax<=0.4 fallback: a Mesh is itself the ambient-mesh context."""
+        return mesh
+
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, *, mesh=None, in_specs=None, out_specs=None, **kw):
+        """jax<=0.4 fallback: experimental home, check_vma -> check_rep,
+        and mesh=None resolved from the ambient context (the new API does
+        that implicitly; the old one requires an explicit mesh)."""
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        else:
+            # the old replication checker has known false positives on
+            # scan carries (its own error message says to turn it off);
+            # the new API's varying-types system replaced it entirely, so
+            # code written for the new API gets it disabled by default
+            kw.setdefault("check_rep", False)
+        if mesh is None:
+            mesh = get_abstract_mesh()
+            if mesh is not None and not mesh.shape:
+                mesh = None  # empty mesh = no ambient context
+        return _shard_map_old(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:
+
+    def axis_size(axis_name):
+        """jax<=0.4 fallback: psum of 1 constant-folds to a python int
+        inside shard_map/pmap bodies (usable as a static loop bound)."""
+        return jax.lax.psum(1, axis_name)
+
+
+def get_abstract_mesh():
+    """The ambient mesh, or an empty/None mesh outside any mesh context."""
+    if hasattr(jax.sharding, "get_abstract_mesh"):
+        return jax.sharding.get_abstract_mesh()
+    try:
+        from jax._src.mesh import thread_resources
+    except ImportError:  # pragma: no cover — very old/new private layout
+        return None
+    env = getattr(thread_resources, "env", None)
+    return getattr(env, "physical_mesh", None)

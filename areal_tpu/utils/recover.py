@@ -10,6 +10,14 @@ Mode policy (reference :326-382):
 - "disabled"/"off": never dump, never load.
 - "on": always try to load at startup (error if absent ⇒ fresh start).
 - "auto": load if a recover checkpoint exists, else fresh start.
+
+Durability (robustness layer): ``dump`` writes ``recover_info.pkl`` and
+``latest`` via tmp + ``os.replace`` + fsync with an embedded sha256, and
+rotates the previous consistent pair to ``*.prev`` first. ``load`` verifies
+the pair (checksum, unpickle, checkpoint-path existence) and falls back to
+the ``.prev`` generation when the current one is truncated, corrupt, or
+dangling — a crash mid-dump can cost at most one recover interval, never
+the whole trial.
 """
 
 from __future__ import annotations
@@ -22,6 +30,8 @@ from typing import Any
 
 from areal_tpu.api.config import RecoverConfig
 from areal_tpu.api.io_struct import SaveLoadMeta, StepInfo
+from areal_tpu.observability import catalog
+from areal_tpu.utils import atomic_io
 from areal_tpu.utils import logging as alog
 from areal_tpu.utils.saver import Saver
 
@@ -35,6 +45,10 @@ class RecoverInfo:
     evaluator_state: dict = dataclasses.field(default_factory=dict)
     dataloader_state: dict = dataclasses.field(default_factory=dict)
     extra: dict = dataclasses.field(default_factory=dict)
+    # the weight checkpoint this record pairs with. Embedding the path makes
+    # the record self-contained — load() never depends on `latest` matching
+    # the info file's generation ("" on legacy records: fall back to latest)
+    ckpt_path: str = ""
 
 
 class RecoverHandler:
@@ -47,11 +61,11 @@ class RecoverHandler:
     def _root(self) -> str:
         return self.saver.save_root()
 
-    def _info_path(self) -> str:
-        return os.path.join(self._root(), "recover_info.pkl")
+    def _info_path(self, suffix: str = "") -> str:
+        return os.path.join(self._root(), "recover_info.pkl" + suffix)
 
-    def _latest_path(self) -> str:
-        return os.path.join(self._root(), "latest")
+    def _latest_path(self, suffix: str = "") -> str:
+        return os.path.join(self._root(), "latest" + suffix)
 
     # -- dump --------------------------------------------------------------
     def dump(
@@ -86,12 +100,21 @@ class RecoverHandler:
                 if dataloader is not None and hasattr(dataloader, "state_dict")
                 else {}
             ),
+            ckpt_path=path,
         )
         os.makedirs(self._root(), exist_ok=True)
-        with open(self._info_path(), "wb") as f:
-            pickle.dump(info, f)
-        with open(self._latest_path(), "w") as f:
-            f.write(path)
+        # rotate the previous consistent pair BEFORE writing the new one:
+        # if this dump crashes half-way, load() falls back to .prev
+        for cur, prev in (
+            (self._info_path(), self._info_path(".prev")),
+            (self._latest_path(), self._latest_path(".prev")),
+        ):
+            if os.path.exists(cur):
+                os.replace(cur, prev)
+        # checksummed + atomic (tmp + replace + fsync): a torn write can
+        # never masquerade as a valid record
+        atomic_io.write_checksummed(self._info_path(), pickle.dumps(info))
+        atomic_io.write_checksummed(self._latest_path(), path.encode("utf-8"))
         logger.info(f"recover checkpoint dumped at step {step_info.global_step}")
         return path
 
@@ -100,12 +123,62 @@ class RecoverHandler:
         mode = self.config.mode
         if mode in ("disabled", "off"):
             return False
-        exists = os.path.exists(self._info_path()) and os.path.exists(
-            self._latest_path()
+        exists = any(
+            os.path.exists(self._info_path(sfx)) for sfx in ("", ".prev")
         )
         if mode == "on" and not exists:
             logger.warning("recover mode 'on' but no checkpoint found; fresh start")
         return exists
+
+    def _read_pair(self, suffix: str) -> tuple[RecoverInfo, str] | None:
+        """One (info, ckpt_path) generation, fully verified: checksum,
+        unpickle, and checkpoint-directory existence. None when any of it
+        is truncated, corrupt, or dangling."""
+        info_path = self._info_path(suffix)
+        if not os.path.exists(info_path):
+            return None
+        try:
+            info: RecoverInfo = pickle.loads(
+                atomic_io.read_checksummed(info_path)
+            )
+        except Exception as e:  # noqa: BLE001 — any corruption shape falls back
+            logger.warning(f"recover record {info_path} unreadable: {e!r}")
+            return None
+        ckpt_path = getattr(info, "ckpt_path", "") or ""
+        if not ckpt_path:
+            # legacy record: the path lives only in `latest`
+            latest = self._latest_path(suffix)
+            try:
+                ckpt_path = (
+                    atomic_io.read_checksummed(latest).decode("utf-8").strip()
+                )
+            except Exception as e:  # noqa: BLE001 — missing/corrupt pointer
+                logger.warning(f"latest pointer {latest} unreadable: {e!r}")
+                return None
+        if not os.path.exists(ckpt_path):
+            logger.warning(
+                f"recover record {info_path} points at missing checkpoint "
+                f"{ckpt_path} (dangling)"
+            )
+            return None
+        return info, ckpt_path
+
+    def read_recover_info(self) -> tuple[RecoverInfo, str] | None:
+        """The newest loadable (info, ckpt_path) generation, falling back
+        from the current record to ``.prev`` on corruption. The fallback is
+        counted in ``areal_recover_fallback_total``."""
+        pair = self._read_pair("")
+        if pair is not None:
+            return pair
+        pair = self._read_pair(".prev")
+        if pair is not None:
+            catalog.robustness_metrics().recover_fallbacks.inc()
+            logger.warning(
+                "current recover record unusable — falling back to the "
+                "previous checkpoint generation"
+            )
+            return pair
+        return None
 
     def load(
         self,
@@ -118,10 +191,14 @@ class RecoverHandler:
     ) -> RecoverInfo | None:
         if not self.should_load():
             return None
-        with open(self._info_path(), "rb") as f:
-            info: RecoverInfo = pickle.load(f)
-        with open(self._latest_path()) as f:
-            ckpt_path = f.read().strip()
+        pair = self.read_recover_info()
+        if pair is None:
+            logger.warning(
+                "no loadable recover checkpoint (all generations corrupt "
+                "or dangling); fresh start"
+            )
+            return None
+        info, ckpt_path = pair
         engine.load(SaveLoadMeta(path=ckpt_path, weight_format="orbax", with_optim=True))
         engine.set_version(info.last_step_info.global_step + 1)
         if saver is not None and info.saver_state:

@@ -1,0 +1,50 @@
+"""EXC true positives: broad handlers silently swallowing I/O errors
+(parsed by the analyzer only — never imported)."""
+
+import os
+import pickle
+import shutil
+import urllib.request
+
+
+def swallow_network():
+    try:
+        urllib.request.urlopen("http://x/health")
+    except Exception:  # EXC001
+        pass
+
+
+def swallow_bare():
+    try:
+        with open("/tmp/x", "rb") as f:
+            pickle.load(f)
+    except:  # noqa: E722 — EXC001 (bare except)
+        pass
+
+
+def swallow_repo_helper(http_json):
+    try:
+        http_json("http://x/kill", {})
+    except BaseException:  # EXC001
+        ...
+
+
+class Client:
+    def swallow_method_helper(self):
+        try:
+            self._post_json("addr", "/generate", {})
+        except Exception:  # EXC001
+            pass
+
+    def swallow_continue(self, addrs):
+        for a in addrs:
+            try:
+                shutil.rmtree(a)
+            except Exception:  # EXC001 (continue-only body)
+                continue
+
+    def swallow_file_ops(self):
+        try:
+            os.replace("/tmp/a", "/tmp/b")
+        except Exception:  # EXC001
+            pass

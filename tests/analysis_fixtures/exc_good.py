@@ -1,0 +1,63 @@
+"""EXC true negatives: handlers that log/record/narrow, or try blocks with
+no I/O (parsed by the analyzer only — never imported)."""
+
+import logging
+import urllib.request
+
+logger = logging.getLogger("fixture")
+
+
+def logs_the_error():
+    try:
+        urllib.request.urlopen("http://x/health")
+    except Exception:
+        logger.warning("probe failed", exc_info=True)
+
+
+def records_the_error():
+    last = None
+    try:
+        urllib.request.urlopen("http://x/health")
+    except Exception as e:
+        last = e  # recorded for a later diagnostic
+    return last
+
+
+def narrow_handler_is_deliberate():
+    try:
+        with open("/tmp/x") as f:
+            f.read()
+    except OSError:
+        pass  # narrow classification: fine
+
+
+def counts_a_metric(metrics):
+    try:
+        urllib.request.urlopen("http://x/health")
+    except Exception:
+        metrics.probe_failures.inc()
+
+
+def no_io_in_try(d):
+    try:
+        return int(d["k"])
+    except Exception:
+        pass  # no network/file I/O swallowed
+
+
+def reraises():
+    try:
+        urllib.request.urlopen("http://x/health")
+    except Exception:
+        raise RuntimeError("probe failed")
+
+
+def io_only_in_nested_def():
+    try:
+
+        def later():
+            urllib.request.urlopen("http://x/")  # runs elsewhere, not here
+
+        return later
+    except Exception:
+        pass

@@ -90,6 +90,22 @@ def test_obs_good_fixture():
     assert rules_in(FIXTURES / "obs_good.py", ["OBS"]) == []
 
 
+def test_exc_bad_fixture():
+    res = run_analysis([FIXTURES / "exc_bad.py"], rules=["EXC"], baseline_path=None)
+    assert len(res.findings) == 6, [f.render() for f in res.findings]
+    assert all(f.rule == "EXC001" for f in res.findings)
+    tokens = {f.key.rsplit(":", 1)[1] for f in res.findings}
+    # network, file, repo transport helpers, os file ops all recognized
+    assert "urllib.request.urlopen" in tokens
+    assert "http_json" in tokens
+    assert "self._post_json" in tokens
+    assert "os.replace" in tokens
+
+
+def test_exc_good_fixture():
+    assert rules_in(FIXTURES / "exc_good.py", ["EXC"]) == []
+
+
 def test_obs_catalog_lint_rules_exist():
     # catalog-side lint (OBS003/OBS004/OBS005) runs on the real catalog and
     # must be clean — it replaced validate_installation's ad-hoc check

@@ -94,6 +94,22 @@ def test_actor_config_has_algorithm_switches():
         assert expected in fields
 
 
+def test_fault_tolerance_overrides():
+    cfg, _ = load_expr_config(
+        [
+            "rollout.fault_tolerance.circuit_failure_threshold=2",
+            "rollout.fault_tolerance.chaos.enabled=true",
+            "rollout.fault_tolerance.chaos.drop_prob=0.1",
+        ],
+        GRPOConfig,
+    )
+    ft = cfg.rollout.fault_tolerance
+    assert ft.circuit_failure_threshold == 2
+    assert ft.chaos.enabled is True and ft.chaos.drop_prob == 0.1
+    # defaults stay intact elsewhere
+    assert ft.enabled is True and cfg.rollout.fault_tolerance.failover is True
+
+
 def test_recover_mode_on_stays_string(tmp_path):
     p = tmp_path / "c.yaml"
     p.write_text("recover:\n  mode: on\n")

@@ -391,30 +391,38 @@ def test_frequency_penalty_survives_abort_resume():
     try:
         prompt = [1, 2, 3]
         g = GenerationHyperparameters(
-            max_new_tokens=20, greedy=True, frequency_penalty=5.0
+            max_new_tokens=40, greedy=True, frequency_penalty=5.0
         )
         uninterrupted = eng.generate_sync(
             ModelRequest(input_ids=prompt, gconfig=g), timeout=240
         ).output_tokens
 
         box, ev = [], threading.Event()
+        base_tokens = eng.stats["generated_tokens"]
         eng.submit(
             ModelRequest(input_ids=prompt, rid="fp-resume", gconfig=g),
             lambda r: (box.append(r), ev.set()),
         )
-        time.sleep(0.25)
+        # pause as soon as the first decode chunk lands — a fixed sleep
+        # raced fast hosts (all 20 tokens decoded before the pause)
+        deadline = time.monotonic() + 60
+        while (
+            eng.stats["generated_tokens"] == base_tokens
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.002)
         eng.pause_generation()
         assert ev.wait(120)
         first = box[0]
         assert first.stop_reason == StopReason.ABORT.value
-        assert 0 < len(first.output_tokens) < 20
+        assert 0 < len(first.output_tokens) < 40
         eng.continue_generation()
         resumes = eng.stats["kv_resumes"]
         second = eng.generate_sync(
             ModelRequest(
                 input_ids=prompt + first.output_tokens,
                 rid="fp-resume",
-                gconfig=g.new(max_new_tokens=20 - len(first.output_tokens)),
+                gconfig=g.new(max_new_tokens=40 - len(first.output_tokens)),
             ),
             timeout=240,
         )

@@ -16,6 +16,7 @@ from areal_tpu.api.config import (
 from areal_tpu.api.io_struct import FinetuneSpec
 from areal_tpu.engine.train_engine import JaxTrainEngine
 from areal_tpu.models import qwen
+from areal_tpu.utils.jax_compat import set_mesh
 
 MODEL_KW = dict(
     vocab_size=128,
@@ -121,7 +122,7 @@ def test_lora_merge_matches_adapted_forward():
     ids = jnp.asarray(rng.integers(1, 128, (2, 8)), jnp.int32)
     seg = jnp.ones((2, 8), jnp.int32)
     pos = jnp.broadcast_to(jnp.arange(8), (2, 8)).astype(jnp.int32)
-    with jax.set_mesh(eng.mesh):
+    with set_mesh(eng.mesh):
         # jit like real callers do — eager per-op sharding propagation on
         # non-DP-divisible toy shapes over sharded params is not a
         # supported path

@@ -9,6 +9,7 @@ import pytest
 
 from areal_tpu.models import qwen
 from areal_tpu.models.moe import moe_ffn
+from areal_tpu.utils.jax_compat import set_mesh
 
 MOE_CFG = qwen.ModelConfig(
     vocab_size=256,
@@ -111,7 +112,7 @@ def test_moe_forward_ep_sharded():
     ids = jnp.asarray(rng.integers(0, 256, (2, 16)), jnp.int32)
     seg = jnp.ones_like(ids)
     pos = jnp.broadcast_to(jnp.arange(16, dtype=jnp.int32), (2, 16))
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         hidden, aux = jax.jit(
             lambda p, i, s, o: qwen.forward(p, MOE_CFG, i, s, o, with_aux=True)
         )(params, ids, seg, pos)
@@ -218,7 +219,7 @@ def test_dropless_ep_sharded_matches_single_device():
     mesh = mesh_lib.make_mesh(
         MeshConfig(data=-1, fsdp=1, seq=2, model=1, expert=2)
     )
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         out, aux = jax.jit(lambda h, l: moe_ffn(h, l, cfg))(h, layer)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
 

@@ -14,6 +14,7 @@ from areal_tpu.models import qwen
 from areal_tpu.models.hf import load_params_from_hf, save_params_to_hf
 from areal_tpu.parallel import make_mesh
 from areal_tpu.api.config import MeshConfig
+from areal_tpu.utils.jax_compat import set_mesh
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpu_testing import TINY_QWEN2, TINY_QWEN3
@@ -168,7 +169,7 @@ def test_sharded_matches_single_device():
         specs,
         is_leaf=lambda x: isinstance(x, P),
     )
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         fn = jax.jit(lambda p, i, s, po: qwen.forward(p, cfg, i, s, po))
         batch_shard = NamedSharding(mesh, P(("data", "fsdp"), None))
         out = fn(

@@ -1,6 +1,10 @@
 """Checkpoint/recover tests (reference tests/test_recover.py role): orbax
 round-trip with optimizer state, RecoverHandler dump/load policy, dataloader
-position restore."""
+position restore, and the hardened-recovery corruption fallbacks (truncated
+record, checksum mismatch, dangling checkpoint pointer)."""
+
+import os
+import pickle
 
 import numpy as np
 import pytest
@@ -16,7 +20,8 @@ from areal_tpu.api.config import (
 from areal_tpu.api.io_struct import FinetuneSpec, SaveLoadMeta, StepInfo
 from areal_tpu.engine.train_engine import JaxTrainEngine
 from areal_tpu.utils.data import StatefulDataLoader
-from areal_tpu.utils.recover import RecoverHandler
+from areal_tpu.utils import atomic_io
+from areal_tpu.utils.recover import RecoverHandler, RecoverInfo
 from areal_tpu.utils.saver import Saver
 
 from tpu_testing import TINY_QWEN2, random_batch
@@ -107,3 +112,148 @@ def test_recover_handler_policy(tmp_path):
     )
     assert h2.dump(eng, step) is None
     assert not h2.should_load()
+
+    # a second dump rotates the first pair to .prev (crash-fallback fodder)
+    step3 = StepInfo(epoch=0, epoch_step=3, global_step=3, steps_per_epoch=10)
+    assert h.dump(eng, step3, saver=saver, dataloader=dl) is not None
+    assert os.path.exists(h._info_path(".prev"))
+    info3, _ = h.read_recover_info()
+    assert info3.last_step_info.global_step == 3
+
+
+# ---------------------------------------------------------------------------
+# hardened recovery: corruption fallbacks (no real engine needed — the
+# corruption logic is pure record handling)
+# ---------------------------------------------------------------------------
+
+
+class _DummyEngine:
+    def __init__(self):
+        self.loaded_path = None
+        self.version = 0
+
+    def load(self, meta):
+        self.loaded_path = meta.path
+
+    def set_version(self, v):
+        self.version = v
+
+    def get_version(self):
+        return self.version
+
+
+def _corruption_handler(tmp_path):
+    return RecoverHandler(
+        RecoverConfig(
+            mode="auto",
+            freq_steps=1,
+            fileroot=str(tmp_path),
+            experiment_name="rc",
+            trial_name="t",
+        )
+    )
+
+
+def _write_generation(h, step: int, name: str, suffix: str = "") -> str:
+    """One consistent (recover_info, ckpt) generation on disk."""
+    ckpt = os.path.join(h._root(), name)
+    os.makedirs(ckpt, exist_ok=True)
+    info = RecoverInfo(
+        last_step_info=StepInfo(
+            epoch=0, epoch_step=step, global_step=step, steps_per_epoch=10
+        ),
+        ckpt_path=ckpt,
+    )
+    atomic_io.write_checksummed(h._info_path(suffix), pickle.dumps(info))
+    atomic_io.write_checksummed(h._latest_path(suffix), ckpt.encode())
+    return ckpt
+
+
+def test_truncated_info_falls_back_to_prev(tmp_path):
+    h = _corruption_handler(tmp_path)
+    prev_ckpt = _write_generation(h, 1, "ck1", suffix=".prev")
+    _write_generation(h, 2, "ck2")
+    # torn write: keep only the first half of the current record
+    raw = open(h._info_path(), "rb").read()
+    with open(h._info_path(), "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    eng = _DummyEngine()
+    info = h.load(eng)
+    assert info is not None
+    assert info.last_step_info.global_step == 1
+    assert eng.loaded_path == prev_ckpt
+    assert eng.version == 2  # global_step + 1
+
+
+def test_checksum_mismatch_falls_back_to_prev(tmp_path):
+    h = _corruption_handler(tmp_path)
+    _write_generation(h, 1, "ck1", suffix=".prev")
+    _write_generation(h, 2, "ck2")
+    raw = bytearray(open(h._info_path(), "rb").read())
+    raw[-1] ^= 0xFF  # flip a payload byte: header intact, checksum wrong
+    with open(h._info_path(), "wb") as f:
+        f.write(bytes(raw))
+    eng = _DummyEngine()
+    info = h.load(eng)
+    assert info is not None and info.last_step_info.global_step == 1
+
+
+def test_dangling_ckpt_pointer_falls_back_to_prev(tmp_path):
+    import shutil
+
+    h = _corruption_handler(tmp_path)
+    _write_generation(h, 1, "ck1", suffix=".prev")
+    current = _write_generation(h, 2, "ck2")
+    shutil.rmtree(current)  # the record now dangles
+    eng = _DummyEngine()
+    info = h.load(eng)
+    assert info is not None and info.last_step_info.global_step == 1
+
+
+def test_all_generations_corrupt_is_fresh_start(tmp_path):
+    h = _corruption_handler(tmp_path)
+    _write_generation(h, 2, "ck2")
+    with open(h._info_path(), "wb") as f:
+        f.write(b"garbage")
+    assert h.should_load()  # the file exists…
+    eng = _DummyEngine()
+    assert h.load(eng) is None  # …but load degrades to a fresh start
+    assert eng.loaded_path is None
+
+
+def test_legacy_unchecksummed_records_still_load(tmp_path):
+    """Records written before the hardening (plain pickle, path only in
+    `latest`) must keep loading."""
+    h = _corruption_handler(tmp_path)
+    ckpt = os.path.join(h._root(), "ck_legacy")
+    os.makedirs(ckpt, exist_ok=True)
+    info = RecoverInfo(
+        last_step_info=StepInfo(
+            epoch=0, epoch_step=4, global_step=4, steps_per_epoch=10
+        )
+    )
+    with open(h._info_path(), "wb") as f:
+        pickle.dump(info, f)
+    with open(h._latest_path(), "w") as f:
+        f.write(ckpt)
+    eng = _DummyEngine()
+    out = h.load(eng)
+    assert out is not None and out.last_step_info.global_step == 4
+    assert eng.loaded_path == ckpt
+
+
+def test_atomic_io_checksum_roundtrip(tmp_path):
+    p = str(tmp_path / "blob")
+    atomic_io.write_checksummed(p, b"payload-bytes")
+    assert atomic_io.read_checksummed(p) == b"payload-bytes"
+    # tamper → ChecksumError
+    raw = bytearray(open(p, "rb").read())
+    raw[-2] ^= 0x01
+    with open(p, "wb") as f:
+        f.write(bytes(raw))
+    with pytest.raises(atomic_io.ChecksumError):
+        atomic_io.read_checksummed(p)
+    # legacy passthrough: no magic → bytes returned verbatim
+    with open(p, "wb") as f:
+        f.write(b"legacy")
+    assert atomic_io.read_checksummed(p) == b"legacy"

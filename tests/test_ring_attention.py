@@ -10,6 +10,7 @@ from areal_tpu.api.config import MeshConfig
 from areal_tpu.models import qwen
 from areal_tpu.parallel.mesh import make_mesh
 from areal_tpu.parallel.ring_attention import ring_attention, zigzag_indices
+from areal_tpu.utils.jax_compat import set_mesh
 
 from tpu_testing import TINY_QWEN2
 
@@ -48,7 +49,7 @@ def test_ring_matches_reference(sp):
     q, k, v, seg, col = _qkv()
     ref = _ref_attention(q, k, v, seg, col)
     mesh = make_mesh(MeshConfig(data=1, fsdp=8 // sp, seq=sp, model=1))
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         out = jax.jit(lambda *a: ring_attention(*a))(q, k, v, seg, col)
     valid = np.asarray(seg) != 0  # padded queries have no defined output
     np.testing.assert_allclose(
@@ -66,7 +67,7 @@ def test_ring_zigzag_layout():
     perm = zigzag_indices(q.shape[1], sp)
     inv = np.argsort(perm)
     mesh = make_mesh(MeshConfig(data=1, fsdp=8 // sp, seq=sp, model=1))
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         out_p = jax.jit(lambda *a: ring_attention(*a))(
             q[:, perm], k[:, perm], v[:, perm], seg[:, perm], col[:, perm]
         )
@@ -88,7 +89,7 @@ def test_model_forward_ring_matches_xla():
 
     ref = qwen.forward(params, cfg_x, ids, seg, pos)
     mesh = make_mesh(MeshConfig(data=1, fsdp=1, seq=4, model=2))
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         out = jax.jit(lambda p, i, s, po: qwen.forward(p, cfg_r, i, s, po))(
             params, ids, seg, pos
         )
@@ -112,7 +113,7 @@ def test_ring_gradients_flow():
         return jnp.square(h.astype(jnp.float32)).mean()
 
     mesh = make_mesh(MeshConfig(data=1, fsdp=2, seq=4, model=1))
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         g = jax.jit(jax.grad(loss))(params)
     norms = [float(jnp.linalg.norm(x)) for x in jax.tree_util.tree_leaves(g)]
     assert all(np.isfinite(n) for n in norms)

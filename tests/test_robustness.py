@@ -1,0 +1,420 @@
+"""Fault-tolerance layer unit tests: retry policy/budget, circuit breaker
+state machine, fleet health tracking, executor task retry/quarantine, and
+controller-level supervision (evict / respawn / re-sync) over a mock
+scheduler."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import (
+    FaultToleranceConfig,
+    InferenceEngineConfig,
+)
+from areal_tpu.api.scheduler_api import Job, Scheduler, Worker
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.infra.workflow_executor import WorkflowExecutor
+from areal_tpu.observability import catalog
+from areal_tpu.robustness import (
+    CLOSED,
+    OPEN,
+    CircuitBreaker,
+    FleetHealth,
+    ReplicaSupervisor,
+    RetryBudget,
+    RetryPolicy,
+)
+
+# ---------------------------------------------------------------------------
+# RetryPolicy / RetryBudget
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_backoff_schedule():
+    p = RetryPolicy(attempts=4, base_s=0.2, max_s=1.0, jitter=0.0)
+    assert p.delay(0) == pytest.approx(0.2)
+    assert p.delay(1) == pytest.approx(0.4)
+    assert p.delay(2) == pytest.approx(0.8)
+    assert p.delay(5) == pytest.approx(1.0)  # capped
+
+
+def test_retry_policy_jitter_bounds():
+    p = RetryPolicy(attempts=3, base_s=1.0, max_s=10.0, jitter=0.25)
+    for _ in range(100):
+        assert 0.75 <= p.delay(0) <= 1.25
+
+
+def test_retry_budget_spend_and_refill():
+    b = RetryBudget(capacity=2, refill=0.5)
+    assert b.try_spend() and b.try_spend()
+    assert not b.try_spend()  # exhausted
+    b.on_success()
+    b.on_success()  # +1.0 total
+    assert b.try_spend()
+    assert not b.try_spend()
+
+
+def test_retry_budget_disabled():
+    b = RetryBudget(capacity=0)
+    assert all(b.try_spend() for _ in range(100))
+
+
+def test_policy_allow_retry_consumes_budget():
+    p = RetryPolicy(attempts=5, budget=RetryBudget(capacity=1, refill=1.0))
+    assert p.allow_retry()
+    assert not p.allow_retry()
+    p.on_success()  # refund
+    assert p.allow_retry()
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+
+def test_circuit_breaker_state_machine():
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=2, recovery_s=5.0, clock=lambda: t[0])
+    assert br.state == CLOSED and br.allow()
+    br.on_failure()
+    assert br.state == CLOSED  # one failure below threshold
+    br.on_failure()
+    assert br.state == OPEN and not br.allow()
+    t[0] = 6.0  # recovery window elapsed -> half-open probe
+    assert br.allow()  # the single probe
+    assert not br.allow()  # re-armed: no pile-on
+    br.on_success()
+    assert br.state == CLOSED and br.allow()
+
+
+def test_circuit_breaker_success_resets_failure_run():
+    br = CircuitBreaker(failure_threshold=3)
+    br.on_failure()
+    br.on_failure()
+    br.on_success()  # streak broken
+    br.on_failure()
+    br.on_failure()
+    assert br.state == CLOSED
+
+
+def test_circuit_breaker_force_open_and_open_callback():
+    opened = []
+    br = CircuitBreaker(failure_threshold=5, on_open=lambda: opened.append(1))
+    br.force_open()
+    assert br.state == OPEN and opened == [1]
+
+
+# ---------------------------------------------------------------------------
+# FleetHealth
+# ---------------------------------------------------------------------------
+
+
+def _ft(**kw) -> FaultToleranceConfig:
+    defaults = dict(circuit_failure_threshold=2, circuit_recovery_s=60.0)
+    defaults.update(kw)
+    return FaultToleranceConfig(**defaults)
+
+
+def test_fleet_health_eviction_and_failover():
+    fleet = FleetHealth(["a:1", "b:2", "c:3"], _ft())
+    assert set(fleet.healthy()) == {"a:1", "b:2", "c:3"}
+    fleet.on_failure("b:2")
+    fleet.on_failure("b:2")
+    assert fleet.state("b:2") == OPEN
+    assert set(fleet.healthy()) == {"a:1", "c:3"}
+    for _ in range(20):
+        alt = fleet.pick_failover("b:2")
+        assert alt in ("a:1", "c:3")
+    fleet.mark_rejoined("b:2")
+    assert fleet.state("b:2") == CLOSED
+
+
+def test_fleet_health_disabled_never_evicts():
+    fleet = FleetHealth(["a:1"], FaultToleranceConfig(enabled=False))
+    for _ in range(50):
+        fleet.on_failure("a:1")
+    assert fleet.healthy() == ["a:1"] and fleet.allow("a:1")
+
+
+def test_fleet_health_open_counter_increments():
+    before = catalog.robustness_metrics().circuit_open.get()
+    fleet = FleetHealth(["x:9"], _ft(circuit_failure_threshold=1))
+    fleet.on_failure("x:9")
+    assert catalog.robustness_metrics().circuit_open.get() == before + 1
+
+
+# ---------------------------------------------------------------------------
+# WorkflowExecutor: task retry + poison quarantine
+# ---------------------------------------------------------------------------
+
+
+class _FakeEngine:
+    def get_version(self):
+        return 0
+
+
+class FlakyWorkflow(RolloutWorkflow):
+    """Fails the first ``fail_times`` attempts per item, then succeeds."""
+
+    def __init__(self, fail_times: int):
+        self.fail_times = fail_times
+        self.attempts: dict = {}
+
+    async def arun_episode(self, engine, data):
+        k = data["k"]
+        n = self.attempts.get(k, 0)
+        self.attempts[k] = n + 1
+        await asyncio.sleep(0.001)
+        if n < self.fail_times:
+            raise RuntimeError(f"flaky failure #{n} for {k}")
+        return [
+            {
+                "input_ids": np.arange(4, dtype=np.int32),
+                "loss_mask": np.ones(4, np.float32),
+                "rewards": np.float32(1.0),
+            }
+        ]
+
+
+def _executor(**ft_kw):
+    cfg = InferenceEngineConfig(
+        max_concurrent_rollouts=4,
+        consumer_batch_size=2,
+        max_head_offpolicyness=100,
+        fault_tolerance=FaultToleranceConfig(**ft_kw),
+    )
+    ex = WorkflowExecutor(cfg, _FakeEngine())
+    ex.initialize()
+    return ex
+
+
+def test_executor_retries_flaky_tasks():
+    before = catalog.robustness_metrics().task_retries.get()
+    ex = _executor(task_max_retries=2, task_quarantine_strikes=3)
+    try:
+        wf = FlakyWorkflow(fail_times=1)  # each task fails once, then passes
+        batch = ex.rollout_batch([{"k": i} for i in range(3)], workflow=wf)
+        assert batch["input_ids"].shape[0] == 3
+        assert catalog.robustness_metrics().task_retries.get() >= before + 3
+    finally:
+        ex.destroy()
+
+
+def test_executor_quarantines_poison_tasks():
+    before = catalog.robustness_metrics().task_quarantined.get()
+    ex = _executor(task_max_retries=2, task_quarantine_strikes=3)
+    try:
+        wf = FlakyWorkflow(fail_times=100)  # never succeeds: poison
+        tid = ex.submit({"k": "poison"}, workflow=wf)
+        assert ex.wait_for_task(tid, timeout=30) is None  # dropped, not raised
+        assert wf.attempts["poison"] == 3  # initial + 2 retries
+        assert catalog.robustness_metrics().task_quarantined.get() == before + 1
+        assert ex.staleness.export_stats()["rejected"] >= 1
+        # the dispatcher survived: later tasks still flow
+        ok = ex.submit({"k": "good"}, workflow=FlakyWorkflow(fail_times=0))
+        assert ex.wait_for_task(ok, timeout=30) is not None
+    finally:
+        ex.destroy()
+
+
+def test_executor_fail_fast_when_disabled():
+    ex = _executor(enabled=False)
+    try:
+        ex.submit({"k": "boom"}, workflow=FlakyWorkflow(fail_times=100))
+        with pytest.raises(RuntimeError, match="dispatcher failed"):
+            ex.wait(1, timeout=10)
+    finally:
+        ex.destroy()
+
+
+# ---------------------------------------------------------------------------
+# Controller supervision over a mock scheduler
+# ---------------------------------------------------------------------------
+
+
+class _SupEngine:
+    def __init__(self, config=None, **kw):
+        self.version = 0
+        self.initialized = False
+
+    def initialize(self, addresses=None, **kw):
+        self.initialized = True
+
+    def destroy(self):
+        pass
+
+    def set_version(self, v):
+        self.version = v
+
+    def rollout_batch(self, data, workflow=None, **kw):
+        n = len(data)
+        return {
+            "input_ids": np.ones((n, 4), np.int64),
+            "attention_mask": np.ones((n, 4), np.int64),
+        }
+
+
+class _MockScheduler(Scheduler):
+    """In-process scheduler; respawn support is opt-in via ``can_respawn``."""
+
+    def __init__(self, can_respawn: bool = True):
+        self.engines: dict[str, object] = {}
+        self.roles: dict[str, list[Worker]] = {}
+        self.can_respawn = can_respawn
+        self.respawned: list[str] = []
+        self._next_port = 1000
+
+    def create_workers(self, job: Job) -> list[Worker]:
+        ws = []
+        for i in range(job.replicas):
+            self._next_port += 1
+            ws.append(
+                Worker(
+                    id=f"{job.role}-{i}",
+                    role=job.role,
+                    ip="127.0.0.1",
+                    ports=[self._next_port],
+                )
+            )
+        self.roles[job.role] = ws
+        return ws
+
+    def get_workers(self, role):
+        return self.roles.get(role, [])
+
+    def delete_workers(self, role=None):
+        for r in [role] if role else list(self.roles):
+            for w in self.roles.pop(r, []):
+                self.engines.pop(w.id, None)
+
+    def set_worker_env(self, role, env):
+        pass
+
+    def respawn_worker(self, worker: Worker) -> Worker:
+        if not self.can_respawn:
+            raise NotImplementedError("no respawn")
+        self._next_port += 1
+        fresh = Worker(
+            id=worker.id,
+            role=worker.role,
+            ip=worker.ip,
+            ports=[self._next_port],
+        )
+        self.roles[worker.role] = [
+            fresh if w.id == worker.id else w
+            for w in self.roles[worker.role]
+        ]
+        self.respawned.append(worker.id)
+        return fresh
+
+    def create_engine(self, worker, engine_path, *args, **kwargs):
+        from areal_tpu.utils.dynamic_import import import_from_string
+
+        self.engines[worker.id] = import_from_string(engine_path)(*args, **kwargs)
+
+    def call_engine(self, worker, method, *args, **kwargs):
+        return getattr(self.engines[worker.id], method)(*args, **kwargs)
+
+
+def _controller(sched, ft=None):
+    from areal_tpu.infra.controller import RolloutController
+
+    rc = RolloutController(
+        sched, engine_path="test_robustness._SupEngine", replicas=2
+    )
+    cfg = InferenceEngineConfig(
+        fault_tolerance=ft
+        or FaultToleranceConfig(
+            probe_interval_s=0.05,
+            probe_failures_to_evict=2,
+            max_respawns=2,
+        )
+    )
+    rc.initialize(config=cfg)
+    return rc
+
+
+def test_supervisor_evicts_and_next_worker_skips():
+    sched = _MockScheduler(can_respawn=False)
+    rc = _controller(sched)
+    try:
+        dead = {rc.workers[1].address}
+        sup = ReplicaSupervisor(
+            rc,
+            rc._engine_init_config.fault_tolerance,
+            probe=lambda w, t: w.address not in dead,
+        )
+        sup.probe_once()
+        assert rc.active_workers()[0].id == "rollout-0"
+        assert len(rc.active_workers()) == 2  # one strike: still in rotation
+        states = sup.probe_once()  # second strike: evicted (no respawn)
+        assert states["rollout-1"] == "evicted"
+        assert [w.id for w in rc.active_workers()] == ["rollout-0"]
+        # _next_worker only ever lands on the live worker now
+        assert {rc._next_worker().id for _ in range(6)} == {"rollout-0"}
+        # rollout_batch routes around the eviction too
+        out = rc.rollout_batch([{"q": i} for i in range(4)])
+        assert out["input_ids"].shape[0] == 4
+    finally:
+        rc.destroy()
+
+
+def test_supervisor_respawns_and_resyncs_version():
+    sched = _MockScheduler(can_respawn=True)
+    rc = _controller(sched)
+    try:
+        rc.set_version(7)
+        dead = {rc.workers[1].address}
+        sup = ReplicaSupervisor(
+            rc,
+            rc._engine_init_config.fault_tolerance,
+            probe=lambda w, t: w.address not in dead,
+        )
+        before = catalog.robustness_metrics().replica_respawns.get()
+        sup.probe_once()
+        sup.probe_once()  # threshold reached -> evict + respawn + rejoin
+        assert sched.respawned == ["rollout-1"]
+        assert len(rc.active_workers()) == 2  # back in rotation
+        fresh_engine = sched.engines["rollout-1"]
+        assert fresh_engine.initialized
+        assert fresh_engine.version == 7  # re-synced to the current version
+        assert catalog.robustness_metrics().replica_respawns.get() == before + 1
+        # the replacement answers probes (new address not in dead set)
+        assert sup.probe_once()["rollout-1"] == "up"
+    finally:
+        rc.destroy()
+
+
+def test_supervisor_respawn_budget_exhausts():
+    sched = _MockScheduler(can_respawn=True)
+    ft = FaultToleranceConfig(
+        probe_interval_s=0.05, probe_failures_to_evict=1, max_respawns=1
+    )
+    rc = _controller(sched, ft=ft)
+    try:
+        sup = ReplicaSupervisor(rc, ft, probe=lambda w, t: "-1" not in w.id)
+        sup.probe_once()  # evict + respawn #1 (budget now exhausted)
+        assert sched.respawned == ["rollout-1"]
+        sup.probe_once()  # still dead: budget exhausted -> stays evicted
+        sup.probe_once()
+        assert sched.respawned == ["rollout-1"]  # no second respawn
+        assert [w.id for w in rc.active_workers()] == ["rollout-0"]
+    finally:
+        rc.destroy()
+
+
+def test_supervision_thread_lifecycle():
+    sched = _MockScheduler()
+    rc = _controller(sched)
+    try:
+        rc.start_supervision(probe=lambda w, t: True)
+        assert rc._supervisor is not None
+        time.sleep(0.2)  # a few probe rounds
+        assert len(rc.active_workers()) == 2
+        st = rc._supervisor.statusz()
+        assert set(st["fail_counts"].values()) <= {0}
+    finally:
+        rc.destroy()
+    assert rc._supervisor is None

@@ -14,6 +14,7 @@ from areal_tpu.api.config import (
 )
 from areal_tpu.api.io_struct import FinetuneSpec, SaveLoadMeta
 from areal_tpu.engine.train_engine import JaxTrainEngine
+from areal_tpu.utils.jax_compat import set_mesh
 
 from tpu_testing import TINY_QWEN2, random_batch
 
@@ -105,7 +106,7 @@ def test_microbatching_invariance():
         ws = [weight_fn(g.data) for g in grids]
         tot = sum(ws)
         acc, loss_sum = None, 0.0
-        with jax.set_mesh(eng.mesh):
+        with set_mesh(eng.mesh):
             for g, w in zip(grids, ws):
                 b = eng._grid_to_device(g)
                 gfn = eng._get_grad_fn(sft_loss, b["segment_ids"].shape)

@@ -20,6 +20,7 @@ from areal_tpu.engine.train_engine import JaxTrainEngine
 from areal_tpu.models import qwen, tree
 from areal_tpu.ops import functional as F
 from areal_tpu.utils.data import pad_sequences_to_tensors
+from areal_tpu.utils.jax_compat import set_mesh
 
 from tpu_testing import TINY_QWEN2
 
@@ -117,7 +118,7 @@ def test_tree_outputs_match_per_sequence_forward():
     batches, stats = eng._make_tree_batches(batch)
     assert stats["tree_dedup_ratio"] > 1.3
     params = eng.params
-    with jax.set_mesh(eng.mesh):
+    with set_mesh(eng.mesh):
         for host in batches:
             dev = eng._tree_batch_to_device(host)
             out = jax.jit(eng._tree_outputs_fn)(params, dev)
@@ -346,7 +347,7 @@ def test_forest_moe_fallback_under_mesh():
         return (h.astype(jnp.float32) ** 2).mean() + 0.01 * aux
 
     mesh = mesh_lib.make_mesh(MeshConfig(data=-1, fsdp=1, seq=1, model=1))
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         g = jax.jit(jax.grad(loss))(params)
     assert np.isfinite(float(jax.tree.leaves(g)[0].sum()))
 

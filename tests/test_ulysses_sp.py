@@ -11,6 +11,7 @@ import pytest
 from areal_tpu.api.config import MeshConfig
 from areal_tpu.models import qwen
 from areal_tpu.parallel.mesh import make_mesh
+from areal_tpu.utils.jax_compat import set_mesh
 
 from tpu_testing import TINY_QWEN2
 
@@ -42,7 +43,7 @@ def test_seq_parallel_matches_single_device(params, mesh_cfg):
     ref = qwen.forward(p, cfg, ids, seg, pos)
 
     mesh = make_mesh(mesh_cfg)
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         out = jax.jit(lambda p, i, s, po: qwen.forward(p, cfg, i, s, po))(
             p, ids, seg, pos
         )
@@ -55,7 +56,7 @@ def test_ulysses_uses_all_to_all(params):
     cfg, p = params
     ids, seg, pos = _inputs()
     mesh = make_mesh(MeshConfig(data=1, fsdp=1, seq=8, model=1))
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         lowered = jax.jit(
             lambda p, i, s, po: qwen.forward(p, cfg, i, s, po)
         ).lower(p, ids, seg, pos)
@@ -74,7 +75,7 @@ def test_seq_parallel_grads_match(params):
 
     g_ref = jax.grad(loss)(p)
     mesh = make_mesh(MeshConfig(data=1, fsdp=1, seq=4, model=2))
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         g_sp = jax.jit(jax.grad(loss))(p)
     flat_ref = jax.tree_util.tree_leaves(g_ref)
     flat_sp = jax.tree_util.tree_leaves(jax.tree.map(np.asarray, g_sp))
