@@ -282,6 +282,17 @@ class InferenceEngineConfig:
     # let servers relay down a fanout-2 tree (X-Areal-Relay), so the trainer
     # uplink carries 1x the model regardless of fleet size
     weight_update_relay: bool = False
+    # zero-pause weight sync: buckets stream and stage WHILE generation
+    # continues; this knob controls what happens around the commit swap only.
+    # "hold"  = soft fence: servers stop dispatching decode chunks for the
+    #           commit roundtrip but never abort in-flight requests (default;
+    #           the fleet swaps versions near-simultaneously),
+    # "none"  = no fence at all: the commit applies between decode chunks on
+    #           each replica independently (smallest possible gap; replicas
+    #           may serve mixed versions for one commit roundtrip),
+    # "abort" = legacy §3.4 behavior: full pause_generation around the commit
+    #           (in-flight requests abort and the client loop resumes them).
+    weight_commit_fence: str = "hold"
     # agentic proxy layer (reference openai knob): non-None starts the
     # per-worker OpenAI-compatible proxies + gateway during
     # RolloutController.initialize (requires tokenizer_path)
@@ -351,6 +362,20 @@ class ServerConfig:
     # KV reads dominate decode HBM traffic at long context; int8 halves
     # them AND doubles the page pool a kv_hbm_gb budget buys.
     kv_quantization: str = "none"
+    # safety net for the zero-pause hold fence: a hold whose
+    # /continue_generation got lost (client crash, partitioned network)
+    # would otherwise idle the decode loop forever while /health still
+    # reports ok; after this many seconds the engine self-releases the
+    # hold with a warning. Generous vs the intended one-commit-roundtrip
+    # fence length.
+    hold_fence_timeout_s: float = 30.0
+    # where streamed weight-update buckets stage while generation continues:
+    # "device" = device_put on arrival (staging costs a 2nd copy of the
+    #            weights in HBM until commit; the commit itself is a pointer
+    #            swap — near-zero pause), "host" = buckets stay in host RAM
+    #            and pay ONE batched H2D transfer inside the commit fence
+    #            (for HBM-tight configs that cannot hold 2x weights)
+    weight_stage_target: str = "device"
 
 
 @dataclass

@@ -8,6 +8,12 @@ a weight update) it waits out the pause and re-submits with the accumulated
 tokens, preserving per-token policy versions across the interruption; the
 rid→server affinity cache keeps resumed requests on the same server for KV
 reuse (reference :753-763).
+
+Weight updates ride the zero-pause protocol (docs/weight_sync.md): buckets
+stream and stage while the fleet keeps generating; only the commit swap is
+fenced (``weight_commit_fence``), so with the default "hold" fence the abort
+path above never fires for updates — sequences spanning a commit simply
+carry mixed per-token versions.
 """
 
 from __future__ import annotations
@@ -83,7 +89,10 @@ class RemoteJaxEngine(InferenceEngine):
         self._rid_affinity: dict[str, str] = {}
         self.executor = WorkflowExecutor(config, engine=self)
         self._paused = False
-        self.last_pause_secs = 0.0  # last weight-update availability gap
+        self.last_pause_secs = 0.0  # last update's commit-fence window
+        self.last_stage_secs = 0.0  # last update's unpaused staging window
+        self.last_update_gen_tokens = 0  # fleet tokens during last update
+        self._enc_pool = None  # persistent weight-encoder thread (lazy)
         self._metrics = catalog.client_metrics()
         # fault-tolerance layer (robustness/): retrying transport with a
         # shared budget, per-replica circuit breakers, optional chaos hook
@@ -181,6 +190,9 @@ class RemoteJaxEngine(InferenceEngine):
 
     def destroy(self) -> None:
         self.stop_fleet_probe()
+        if self._enc_pool is not None:
+            self._enc_pool.shutdown(wait=True)
+            self._enc_pool = None
         try:
             loop = self.executor.runner._loop
             if loop is not None and loop.is_running():
@@ -537,6 +549,36 @@ class RemoteJaxEngine(InferenceEngine):
                 self.fleet.on_failure(addr)
         raise RuntimeError(f"POST {addr}{path} failed after retries") from last_exc
 
+    def _send_json_once(
+        self, addr: str, path: str, payload: dict, timeout: float
+    ) -> dict:
+        """The ONE place that builds a synchronous JSON POST (both the
+        retried and the no-retry fan-out paths go through here)."""
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://{addr}{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read() or b"{}")
+
+    def _post_json_one(
+        self, addr: str, path: str, payload: dict, timeout: float | None = None
+    ) -> dict:
+        """Synchronous retried JSON POST to ONE replica (fan-out building
+        block; rides the shared retry policy + circuit accounting).
+        ``timeout`` bounds EACH attempt (default: request_timeout)."""
+        t = timeout or self.config.request_timeout
+        return self._retry_sync(
+            addr,
+            path,
+            lambda a: self._send_json_once(a, path, payload, t),
+        )
+
     def _post_all(
         self, path: str, payload: dict, targets: list[str] | None = None
     ) -> list[dict]:
@@ -544,26 +586,13 @@ class RemoteJaxEngine(InferenceEngine):
         multi-step protocol pin one _fanout_targets() snapshot across all
         its steps; None snapshots fresh for standalone calls."""
         import concurrent.futures
-        import json
-        import urllib.request
 
         targets = targets if targets is not None else self._fanout_targets()
-
-        def send(addr):
-            req = urllib.request.Request(
-                f"http://{addr}{path}",
-                data=json.dumps(payload).encode(),
-                headers={"Content-Type": "application/json"},
-                method="POST",
-            )
-            with urllib.request.urlopen(
-                req, timeout=self.config.request_timeout
-            ) as r:
-                return json.loads(r.read())
-
         with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
             return list(
-                pool.map(lambda a: self._retry_sync(a, path, send), targets)
+                pool.map(
+                    lambda a: self._post_json_one(a, path, payload), targets
+                )
             )
 
     # -- rollout submission (delegated to the executor) -------------------
@@ -602,94 +631,255 @@ class RemoteJaxEngine(InferenceEngine):
         self.executor.resume()
 
     # -- server-side generation pause (weight-update window) --------------
-    def pause_generation(self, targets: list[str] | None = None) -> None:
-        self._post_all("/pause_generation", {}, targets=targets)
+    def pause_generation(
+        self, targets: list[str] | None = None, mode: str = "abort"
+    ) -> None:
+        """mode "abort" = legacy §3.4 full pause (in-flight requests abort);
+        mode "hold" = zero-pause commit fence (the decode loop idles for one
+        commit roundtrip, nothing aborts)."""
+        payload = {} if mode == "abort" else {"mode": mode}
+        self._post_all("/pause_generation", payload, targets=targets)
 
     def continue_generation(self, targets: list[str] | None = None) -> None:
         self._post_all("/continue_generation", {}, targets=targets)
 
+    def _fence_fanout(
+        self, path: str, payload: dict, addrs: list[str], retried: bool = False
+    ) -> list[str]:
+        """Parallel per-replica fence fan-out that never raises: returns
+        the addresses that acked.
+
+        The two fence legs want opposite transports. The PAUSE leg gets
+        one short-timeout attempt per replica (``retried=False``): while
+        it runs, siblings that already acked sit fenced, so a dead replica
+        must cost seconds, not a backoff budget — and a missed pause only
+        means that replica commits unfenced. The CONTINUE leg gets the
+        full retry policy (``retried=True``): every replica is posted
+        concurrently so nobody waits on a sick one, and a LOST continue
+        is the one fence failure with teeth — the replica stays held
+        (serving /health ok!) until its hold auto-expires server-side.
+        Both legs bound each attempt well under hold_fence_timeout_s so a
+        dead replica can never stall the trainer past the self-release."""
+        import concurrent.futures
+
+        # pause-leg timeout must exceed the server's 10 s hold-ack wait
+        # (h_pause blocks until the decode loop quiesces) — a slow chunk
+        # drain is a SUCCESSFUL fence, not a dead replica
+        send = (
+            (lambda a: self._post_json_one(a, path, payload, timeout=10.0))
+            if retried
+            else (lambda a: self._send_json_once(a, path, payload, 15.0))
+        )
+        ok: list[str] = []
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+            futs = {a: pool.submit(send, a) for a in addrs}
+            for a, f in futs.items():
+                try:
+                    r = f.result()
+                    ok.append(a)
+                    if isinstance(r, dict) and r.get("fenced") is False:
+                        logger.warning(
+                            f"{a} acked the hold but its decode loop had "
+                            "not quiesced within the server wait; commit "
+                            "may land between its chunks unfenced"
+                        )
+                except Exception:  # noqa: BLE001 — fence is best-effort
+                    logger.warning(
+                        f"{path} fence fan-out to {a} failed; proceeding "
+                        "without it (a still-held replica self-releases "
+                        "after ServerConfig.hold_fence_timeout_s)",
+                        exc_info=True,
+                    )
+        return ok
+
+    def _commit_fence(self, targets: list[str]):
+        """Context manager for the commit window, per
+        ``config.weight_commit_fence``: "hold" soft-fences the fleet (no
+        aborts), "abort" restores the legacy full pause, "none" commits with
+        generation running (each replica swaps between decode chunks). The
+        fence is best-effort per replica: a pause/continue failure on one
+        replica must not fail the commit or leave its siblings fenced —
+        that replica just commits unfenced (the swap between decode chunks
+        is correct regardless; the fence only tightens fleet simultaneity)."""
+        from contextlib import contextmanager
+
+        fence = getattr(self.config, "weight_commit_fence", "hold")
+        if fence not in ("hold", "abort", "none"):
+            raise ValueError(f"unknown weight_commit_fence {fence!r}")
+
+        @contextmanager
+        def cm():
+            if fence == "none":
+                yield
+                return
+            payload = {} if fence == "abort" else {"mode": fence}
+            paused = self._fence_fanout("/pause_generation", payload, targets)
+            try:
+                yield
+            finally:
+                self._fence_fanout(
+                    "/continue_generation", {}, paused, retried=True
+                )
+
+        return cm()
+
+    def _encoder_pool(self):
+        """One persistent encoder thread shared by every update_weights call
+        (previously a fresh ThreadPoolExecutor per call, leaked via
+        shutdown(wait=False)); closed in destroy()."""
+        pool = self._enc_pool
+        if pool is None:
+            import concurrent.futures
+
+            pool = self._enc_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="weight-enc"
+            )
+        return pool
+
     # -- weights + versioning --------------------------------------------
     def update_weights(self, meta: WeightUpdateMeta, params: dict | None = None) -> None:
-        """§3.4 protocol: pause servers, push weights, resume.
+        """Zero-pause §3.4 protocol (docs/weight_sync.md): stream and stage
+        every bucket WHILE generation continues; only the commit swap sits
+        behind a fence. The availability cost of an update therefore scales
+        with the commit roundtrip, not with model bytes / wire bandwidth.
 
-        The pause window (pause_generation -> continue_generation) is the
-        availability cost of an update; it is measured and exported as
-        ``update_weights_pause_secs`` (reference target: <3 s at scale,
-        blog/AReaL_v0_2.md:79-83)."""
+        Split windows are measured and exported: ``areal_update_stage_secs``
+        (staging, generation running) vs ``areal_update_pause_secs`` (the
+        fence; reference target: <3 s at scale, blog/AReaL_v0_2.md:79-83),
+        plus ``generation_tokens_during_update`` summed from the commit
+        responses — the work the fleet did NOT lose to the update."""
         version = self._version + 1 if meta.with_version else self._version
-        # ONE snapshot of in-rotation replicas for the whole pause→push→
-        # resume protocol: a replica rejoining mid-update must not receive
+        # ONE snapshot of in-rotation replicas for the whole begin→stage→
+        # commit protocol: a replica rejoining mid-update must not receive
         # a commit for buckets it never staged
         targets = self._fanout_targets()
-        enc_pool = first = None
         if meta.type == "mem" and meta.lora_only:
             # LoRA-delta fast path: one tiny bucket of adapter leaves, no
-            # full-tree stream (see WeightUpdateMeta.lora_only)
+            # full-tree stream (see WeightUpdateMeta.lora_only). Encoding
+            # happens unfenced; only the upload+fold POST is the gap.
             assert params is not None
             assert all("_lora_" in k for k in params), (
                 "lora_only update got non-adapter leaves — caller must pass "
                 "the flat layers/{t}_lora_{a,b} dict, not the merged tree"
             )
+            t_enc = time.monotonic()
             body = self._encode_bucket(sorted(params.items()))
+            stage_secs = time.monotonic() - t_enc
             t0 = time.monotonic()
-            self.pause_generation(targets)
-            try:
+            with self._commit_fence(targets):
                 self._post_all_bytes(
                     f"/update_weights_lora?scale={meta.lora_scale}"
                     f"&version={version}",
                     body,
                     targets=targets,
                 )
-            finally:
-                self.continue_generation(targets)
-            self.last_pause_secs = time.monotonic() - t0
-            self._metrics.updates.inc()
-            self._metrics.update_bytes.inc(len(body))
-            self._metrics.pause_seconds.observe(self.last_pause_secs)
-            logger.info(
-                f"lora weight update v{version} pause window "
-                f"{self.last_pause_secs:.2f}s ({len(body)} bytes)"
+            self._finish_update(
+                version,
+                stage_secs,
+                time.monotonic() - t0,
+                gen_tokens=0,
+                kind="lora",
             )
-            self._version = version
+            self._metrics.update_bytes.inc(len(body))
             return
-        if meta.type == "mem":
-            # encode bucket 0 (device->host + bf16 cast) BEFORE pausing so
-            # the window starts with bytes ready to ship
-            assert params is not None
-            import concurrent.futures
-
-            if meta.wire_format == "q8":
-                params = self._quantize_for_wire(params)
-            elif meta.wire_format not in (None, "", "bf16"):
-                raise ValueError(f"unknown wire_format {meta.wire_format!r}")
-            plan = self._plan_weight_buckets(params)
-            enc_pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-            first = enc_pool.submit(self._encode_bucket, plan[0])
-        t0 = time.monotonic()
-        self.pause_generation(targets)
-        try:
-            if meta.type == "disk":
-                assert meta.path
+        if meta.type == "disk":
+            # disk reloads run inside the engine's apply path (the decode
+            # loop blocks for the whole load) — the fence covers it all and
+            # the window IS the availability gap; no staging to split out
+            assert meta.path
+            t0 = time.monotonic()
+            with self._commit_fence(targets):
                 self._post_all(
                     "/update_weights_from_disk",
                     {"path": meta.path, "version": version},
                     targets=targets,
                 )
-            elif meta.type == "mem":
-                self._stream_weight_buckets(
-                    plan, version, enc_pool, first, targets
-                )
-            else:
-                raise NotImplementedError(meta.type)
-        finally:
-            self.continue_generation(targets)
-            if enc_pool is not None:
-                enc_pool.shutdown(wait=False)
-        self.last_pause_secs = time.monotonic() - t0
+            self._finish_update(
+                version, 0.0, time.monotonic() - t0, gen_tokens=0, kind="disk"
+            )
+            return
+        if meta.type != "mem":
+            raise NotImplementedError(meta.type)
+        assert params is not None
+        if meta.wire_format == "q8":
+            params = self._quantize_for_wire(params)
+        elif meta.wire_format not in (None, "", "bf16"):
+            raise ValueError(f"unknown wire_format {meta.wire_format!r}")
+        plan = self._plan_weight_buckets(params)
+        enc_pool = self._encoder_pool()
+        first = enc_pool.submit(self._encode_bucket, plan[0])
+        # STAGE — generation keeps running on every replica
+        t0 = time.monotonic()
+        commit_targets = self._stream_stage_buckets(plan, enc_pool, first, targets)
+        stage_secs = time.monotonic() - t0
+        # COMMIT — the only fenced window
+        import concurrent.futures
+
+        t1 = time.monotonic()
+        replies: list[dict] = []
+        failed: list[tuple[str, Exception]] = []
+        with self._commit_fence(commit_targets):
+            with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+                futs = {
+                    a: pool.submit(
+                        self._post_json_one,
+                        a,
+                        "/update_weights_commit",
+                        {"version": version},
+                    )
+                    for a in commit_targets
+                }
+                for a, f in futs.items():
+                    try:
+                        replies.append(f.result())
+                    except Exception as e:  # noqa: BLE001 — tallied below
+                        failed.append((a, e))
+        if failed:
+            # the version number is burned no matter what: a commit POST
+            # that failed CLIENT-side (timeout) may still have applied
+            # server-side, so some replica may already serve weights tagged
+            # `version`. Advance the client counter before raising so a
+            # retried update can never reuse the number for DIFFERENT
+            # weights (per-token staleness correction depends on version ↔
+            # policy being one-to-one; a skipped number is harmless).
+            self._version = version
+            # failed-commit replicas may still hold their full staged copy
+            # (2x weight HBM); committed ones no-op the abort
+            self._abort_stage_on([a for a, _ in failed])
+            raise RuntimeError(
+                f"weight-update commit failed on "
+                f"{[a for a, _ in failed]} "
+                f"({len(replies)}/{len(commit_targets)} committed)"
+            ) from failed[0][1]
+        gen_tokens = sum(
+            int(r.get("tokens_during_update", 0) or 0) for r in replies
+        )
+        self._finish_update(
+            version, stage_secs, time.monotonic() - t1, gen_tokens, kind="mem"
+        )
+
+    def _finish_update(
+        self,
+        version: int,
+        stage_secs: float,
+        pause_secs: float,
+        gen_tokens: int,
+        kind: str,
+    ) -> None:
+        """Book one completed update: split stage/pause metrics + version."""
+        self.last_stage_secs = stage_secs
+        self.last_pause_secs = pause_secs
+        self.last_update_gen_tokens = gen_tokens
         self._metrics.updates.inc()
-        self._metrics.pause_seconds.observe(self.last_pause_secs)
+        self._metrics.pause_seconds.observe(pause_secs)
+        self._metrics.stage_seconds.observe(stage_secs)
+        self._metrics.commit_pause_seconds.observe(pause_secs)
+        if gen_tokens:
+            self._metrics.tokens_during_update.inc(gen_tokens)
         logger.info(
-            f"weight update v{version} pause window {self.last_pause_secs:.2f}s"
+            f"{kind} weight update v{version}: staged {stage_secs:.2f}s "
+            f"(unpaused), commit fence {pause_secs:.2f}s, "
+            f"{gen_tokens} tokens generated during the update"
         )
         self._version = version
 
@@ -761,13 +951,19 @@ class RemoteJaxEngine(InferenceEngine):
             entries.append((name, arr))
         return encode_weight_bucket(entries)
 
-    def _stream_weight_buckets(
-        self, buckets, version: int, enc_pool, first, targets: list[str] | None = None
-    ) -> None:
-        """Pipelined upload: encode bucket i+1 (device->host + bf16 cast)
-        while bucket i is in flight to every server; servers device_put each
-        bucket on arrival, so transport/serialisation/H2D all overlap.
-        ``first`` is bucket 0's encode future, started before the pause.
+    def _stream_stage_buckets(
+        self, buckets, enc_pool, first, targets: list[str] | None = None
+    ) -> list[str]:
+        """Pipelined STAGING upload, fully unpaused: encode bucket i+1
+        (device->host + bf16 cast) while bucket i is in flight to every
+        server; servers stage each bucket on arrival (device_put or host
+        RAM per weight_stage_target) without touching served params, so
+        transport/serialisation/H2D all overlap generation. ``first`` is
+        bucket 0's encode future. Returns the subset of ``targets`` still
+        in rotation afterwards — PR 3's pinned-snapshot rule extended to
+        the unpaused stream: a replica whose circuit tripped mid-stage may
+        have missed buckets and MUST be excluded from the commit (it
+        re-syncs on the next update fan-out, like any rejoining replica).
 
         With ``weight_update_relay`` and >1 server, each bucket is uploaded
         ONCE to the tree root with an X-Areal-Relay header; servers forward
@@ -776,13 +972,46 @@ class RemoteJaxEngine(InferenceEngine):
         NCCL broadcast role, fsdp_engine.py:1047-1137)."""
         import concurrent.futures
 
+        ft = self.config.fault_tolerance
         targets = targets if targets is not None else self._fanout_targets()
-        self._post_all("/update_weights_begin", {}, targets=targets)
+        live = list(targets)  # replicas still receiving this update
         relay = (
             getattr(self.config, "weight_update_relay", False)
             and len(targets) > 1
         )
+
+        def drop(addr: str, exc: Exception, what: str) -> None:
+            """Per-replica failure during the unpaused stream. With fault
+            tolerance on and healthy siblings, the sick replica leaves
+            THIS update only (it must not receive a commit for buckets it
+            missed); it serves stale weights with a truthful version until
+            the next fan-out re-syncs it. Relay mode can't drop mid-tree
+            — failures there fail the update as before."""
+            if relay or not ft.enabled or len(live) <= 1:
+                raise exc
+            live.remove(addr)
+            self._robust.replica_resyncs.inc()
+            logger.warning(
+                f"replica {addr} failed during weight-update {what}; "
+                f"excluded from this update's commit ({exc!r})"
+            )
+
         with concurrent.futures.ThreadPoolExecutor(max_workers=8) as net_pool:
+
+            def fanout(path: str, make_call) -> None:
+                futs = {a: net_pool.submit(make_call, a) for a in live}
+                for a, f in futs.items():
+                    try:
+                        f.result()
+                    except Exception as e:  # noqa: BLE001 — drop re-raises
+                        drop(a, e, path)
+
+            # open the staging areas — generation keeps running throughout
+            fanout(
+                "/update_weights_begin",
+                lambda a: self._post_json_one(a, "/update_weights_begin", {}),
+            )
+
             if relay:
                 hdr = {
                     "X-Areal-Relay": ",".join(targets[1:]),
@@ -797,13 +1026,11 @@ class RemoteJaxEngine(InferenceEngine):
             else:
 
                 def send(body: bytes) -> None:
-                    list(
-                        net_pool.map(
-                            lambda addr: self._post_bytes(
-                                addr, "/update_weights_bucket", body
-                            ),
-                            targets,
-                        )
+                    fanout(
+                        "/update_weights_bucket",
+                        lambda a: self._post_bytes(
+                            a, "/update_weights_bucket", body
+                        ),
                     )
 
             nxt = first
@@ -815,18 +1042,69 @@ class RemoteJaxEngine(InferenceEngine):
                     self._metrics.update_bytes.inc(len(body))
                     send(body)
             except Exception:
-                # a failed stream must not leave partial buckets pinning
-                # server HBM until the next begin — best-effort abort
+                # an unrecoverable stream failure must not leave partial
+                # buckets pinning server HBM until the next begin —
+                # best-effort abort; serving weights and version stay
+                # untouched on every replica (abort drops only staging).
+                # Replicas already dropped as dead get the no-retry path:
+                # burning the shared retry budget on a known corpse starves
+                # concurrent generate/scrape traffic.
                 try:
-                    self._post_all("/update_weights_abort", {}, targets=targets)
+                    self._post_all("/update_weights_abort", {}, targets=live)
                 except Exception:  # noqa: BLE001
                     logger.warning(
                         "weight-update abort fan-out failed; servers drop "
                         "the staged buckets at the next begin",
                         exc_info=True,
                     )
+                self._abort_stage_on([a for a in targets if a not in live])
                 raise
-        self._post_all("/update_weights_commit", {"version": version}, targets=targets)
+        if not ft.enabled:
+            return live
+        # a replica whose circuit tripped from CONCURRENT traffic (probe,
+        # generate) may have acked its buckets yet be mid-crash — exclude
+        # it from the commit too; it re-syncs like any rejoining replica
+        healthy = [a for a in live if self.fleet.state(a) == _retry.CLOSED]
+        circuit_dropped = [a for a in live if a not in healthy]
+        if not healthy:
+            raise RuntimeError(
+                f"all replicas left rotation mid-stage: {targets}"
+            )
+        if circuit_dropped:
+            logger.warning(
+                f"replicas {circuit_dropped} tripped their circuit "
+                "mid-stage; excluded from the commit (stale until the next "
+                "update fan-out re-syncs them)"
+            )
+            self._robust.replica_resyncs.inc(len(circuit_dropped))
+        # EVERY excluded replica — dropped by a failed bucket POST or by a
+        # tripped circuit — gets a best-effort stage-abort: a merely-slow
+        # replica that missed one bucket is still alive and would otherwise
+        # pin up to a full staged weight copy in HBM until the next begin
+        self._abort_stage_on([a for a in targets if a not in healthy])
+        return healthy
+
+    def _post_one_nofail(
+        self,
+        addr: str,
+        path: str,
+        payload: dict | None = None,
+        timeout: float = 2.0,
+    ) -> None:
+        """Single short-timeout POST outside the retry machinery — for
+        calls that must never stall on a sick replica (pause fence posts
+        while siblings sit paused; stage-aborts to likely-dead replicas).
+        No retries, no circuit accounting."""
+        self._send_json_once(addr, path, payload or {}, timeout)
+
+    def _abort_stage_on(self, addrs: list[str]) -> None:
+        """Best-effort /update_weights_abort to excluded replicas so a
+        partially staged update does not pin HBM until the next begin."""
+        for addr in addrs:
+            try:
+                self._post_one_nofail(addr, "/update_weights_abort")
+            except Exception as e:  # noqa: BLE001 — replica likely dead
+                logger.debug(f"stage-abort on {addr} failed: {e!r}")
 
     def _post_all_bytes(
         self, path: str, body: bytes, targets: list[str] | None = None
@@ -880,6 +1158,10 @@ class RemoteJaxEngine(InferenceEngine):
     def export_stats(self) -> dict[str, float]:
         stats = self.executor.export_stats()
         stats["update_weights_pause_secs"] = self.last_pause_secs
+        stats["update_weights_stage_secs"] = self.last_stage_secs
+        stats["generation_tokens_during_update"] = float(
+            self.last_update_gen_tokens
+        )
         return stats
 
 

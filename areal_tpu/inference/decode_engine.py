@@ -17,14 +17,22 @@ with a JAX decode engine built for the async-RL protocol (SURVEY §7.1):
   copy only the final partial page. Pool exhaustion evicts parked KV, then
   preempts the highest-budget slots (abort + client retry).
 - **interruptible generation** (the reference's crown jewel,
-  remote_inf_engine.py:771-867 + §3.4 pause protocol): ``pause()`` completes
-  all in-flight requests with ``stop_reason="abort"`` and their partial
-  tokens; the client loops, re-submitting accumulated prompts after
-  ``continue_generation``. Weight swaps happen between chunks, so aborts cost
-  at most one chunk of latency.
+  remote_inf_engine.py:771-867 + §3.4 pause protocol):
+  ``pause_generation("abort")`` completes all in-flight requests with
+  ``stop_reason="abort"`` and their partial tokens; the client loops,
+  re-submitting accumulated prompts after ``continue_generation``. Weight
+  swaps happen between chunks, so aborts cost at most one chunk of latency.
+- **zero-pause weight sync** (docs/weight_sync.md): streamed buckets stage
+  via ``begin_staged_update``/``stage_weight_bucket`` WHILE generation
+  continues (staging never touches served params); the commit is a pointer
+  swap between decode chunks, optionally behind a ``pause_generation("hold")``
+  soft fence that idles the loop for one commit roundtrip WITHOUT aborting.
+  Sequences that span a commit simply carry both versions token-by-token.
 - **per-token policy versions**: every emitted token is stamped with the
   weight version that produced it — the input to decoupled-PPO staleness
-  correction (reference io_struct.py output_versions).
+  correction (reference io_struct.py output_versions). Version tags are
+  chunk-granular: tokens before a commit carry v, tokens after carry v+1,
+  within one response.
 
 The engine is transport-free; inference/server.py wraps it in aiohttp HTTP
 speaking the reference's small protocol (/generate, /pause_generation, ...).
@@ -227,8 +235,15 @@ class DecodeEngine:
         self.model_cfg = model_cfg
         self.mesh = mesh
         self._version = 0
-        self._paused = threading.Event()  # set = paused
-        self._pause_ack = threading.Event()  # loop reached the paused branch
+        self._paused = threading.Event()  # set = paused (aborts in-flight)
+        self._held = threading.Event()  # set = commit fence (no aborts)
+        # _pause_ack's contract is strict: no chunk in flight AND _abort_all
+        # completed — release_memory depends on it. The hold fence acks on
+        # its OWN event (slots stay live under a hold; the two must never
+        # be conflated)
+        self._pause_ack = threading.Event()  # loop reached the ABORT branch
+        self._hold_ack = threading.Event()  # loop reached the hold fence
+        self._hold_since = 0.0  # monotonic ts of the current hold fence
         self._shutdown = threading.Event()
         self._queue: queue.Queue[_Task] = queue.Queue()
         self._pending_weight_update: tuple[str, Any, int] | None = None
@@ -239,6 +254,8 @@ class DecodeEngine:
         self._backlog: deque[_Task] = deque()  # tasks popped but not admitted
         self._parked: dict[str, _Parked] = {}  # rid -> retained-KV slot
         self._staged_flat: dict[str, Any] | None = None  # streamed-update staging
+        self._stage_target = "device"  # per-update: "device" | "host"
+        self.last_update_gen_tokens = 0  # tokens emitted during last update
         self.initialized = False
         self.stats = {
             "generated_tokens": 0,
@@ -692,19 +709,50 @@ class DecodeEngine:
         return box[0]
 
     # -- pause / weights (the §3.4 protocol) ------------------------------
-    def pause_generation(self) -> None:
-        """Abort all in-flight requests (they complete with stop_reason
-        "abort") and stop admitting until continue_generation."""
-        self._paused.set()
+    def pause_generation(self, mode: str = "abort") -> None:
+        """Stop the decode loop until ``continue_generation``.
+
+        mode "abort" (legacy §3.4): all in-flight requests complete with
+        stop_reason "abort" and the client's interruptible loop resumes
+        them after the pause. mode "hold" (zero-pause commit fence): the
+        loop finishes its in-flight chunk and idles WITHOUT aborting —
+        slots, KV, and device state stay intact, and decoding resumes
+        exactly where it stopped. Holds are meant to last one weight-commit
+        roundtrip; per-token version tags make the resulting mixed-version
+        sequences safe for decoupled PPO."""
+        if mode == "hold":
+            self._hold_since = time.monotonic()
+            self._held.set()
+        elif mode == "abort":
+            self._paused.set()
+        else:
+            raise ValueError(f"unknown pause mode {mode!r}")
         self._wakeup.set()
+
+    def wait_fence_ack(self, timeout: float = 10.0) -> bool:
+        """Block until the decode loop has actually reached the hold fence
+        (in-flight chunk drained) — what /pause_generation mode=hold acks
+        to the client. True immediately when the loop is not running."""
+        if self._thread is None:
+            return True
+        return self._hold_ack.wait(timeout)
 
     def continue_generation(self) -> None:
         self._paused.clear()
+        self._held.clear()
         self._pause_ack.clear()
+        self._hold_ack.clear()
         self._wakeup.set()
 
     @property
     def is_paused(self) -> bool:
+        return self._paused.is_set() or self._held.is_set()
+
+    @property
+    def is_abort_paused(self) -> bool:
+        """True only for the legacy ABORT pause (slots emptied) — what
+        release_memory requires; a hold fence keeps slots live and does
+        NOT qualify."""
         return self._paused.is_set()
 
     def _wait_weight_update_applied(self) -> None:
@@ -823,14 +871,40 @@ class DecodeEngine:
     # bucket i+1 overlaps the host->device transfer of bucket i — and the
     # commit is a pointer swap between decode chunks. Reference behavior:
     # fsdp_engine.py:998-1137 bucketed NCCL broadcast.
-    def begin_staged_update(self) -> None:
+    def begin_staged_update(self, stage_target: str | None = None) -> None:
+        """Open a staging area for streamed buckets. Generation KEEPS RUNNING
+        while buckets stage — the availability cost of an update is only the
+        commit swap. ``stage_target`` overrides
+        ``ServerConfig.weight_stage_target`` for this update: "device" puts
+        buckets on device as they arrive (2x weight HBM until commit, pointer
+        -swap commit), "host" keeps them in host RAM (one batched H2D inside
+        the commit window instead)."""
+        target = stage_target or getattr(
+            self.config, "weight_stage_target", "device"
+        )
+        if target not in ("device", "host"):
+            raise ValueError(f"unknown weight_stage_target {target!r}")
         with self._weight_lock:
             self._staged_flat: dict[str, Any] = {}
+            self._stage_target = target
+            # tokens emitted between begin and commit-applied = the work the
+            # fleet did NOT lose to this update (zero-pause visibility)
+            self._stage_gen_snapshot = self.stats["generated_tokens"]
 
     def stage_weight_bucket(self, flat: dict[str, np.ndarray]) -> None:
-        """Stage one bucket: device_put each tensor toward its target
-        sharding immediately (async dispatch)."""
-        staged = {name: self._place(name, arr) for name, arr in flat.items()}
+        """Stage one bucket WITHOUT touching served params: device target
+        device_puts each tensor toward its serving sharding immediately
+        (async dispatch, overlapping the next bucket's transport); host
+        target keeps the host arrays and defers the H2D to commit."""
+        with self._weight_lock:
+            assert self._staged_flat is not None, "begin_staged_update first"
+            target = self._stage_target
+        if target == "host":
+            staged = {name: np.asarray(arr) for name, arr in flat.items()}
+        else:
+            staged = {
+                name: self._place(name, arr) for name, arr in flat.items()
+            }
         with self._weight_lock:
             assert self._staged_flat is not None, "begin_staged_update first"
             self._staged_flat.update(staged)
@@ -841,7 +915,18 @@ class DecodeEngine:
         with self._weight_lock:
             flat = self._staged_flat
             self._staged_flat = None
-        assert flat, "no staged weights"
+        if not flat:
+            if version is not None and self._version == int(version):
+                # idempotent retry: the previous commit applied but its
+                # response was lost on the wire (the exact fault the chaos
+                # harness injects) — re-acking beats failing a succeeded
+                # fleet-wide update
+                logger.info(
+                    f"commit v{version} retried after it already applied; "
+                    "acking idempotently"
+                )
+                return
+            raise AssertionError("no staged weights")
         tree = _unflatten(flat)
         got_paths = {p for p, _ in _iter_tree_paths(tree)}
         # served_form is decided HERE, once, and travels with the payload —
@@ -863,11 +948,16 @@ class DecodeEngine:
             self._pending_weight_update = ("staged", (tree, served_form), version)
         self._wakeup.set()
         self._wait_weight_update_applied()
+        # per-update availability visibility: tokens the engine generated
+        # while this update was staging (begin -> commit applied)
+        self.last_update_gen_tokens = self.stats["generated_tokens"] - getattr(
+            self, "_stage_gen_snapshot", self.stats["generated_tokens"]
+        )
 
     def abort_staged_update(self) -> None:
         """Drop a partially staged update without committing (e.g. a
-        stream-rate probe, or a client that died mid-stream). Safe when
-        nothing is staged."""
+        stream-rate probe, or a client that died mid-stream). Serving
+        weights and version are untouched. Safe when nothing is staged."""
         with self._weight_lock:
             self._staged_flat = None
 
@@ -910,6 +1000,22 @@ class DecodeEngine:
                 # can't reach a non-quantized engine: _place rejects q8-wire
                 # leaves at stage time.)
                 tree, already_served = payload
+                if any(
+                    isinstance(v, np.ndarray)
+                    for _, v in _iter_tree_paths(tree)
+                ):
+                    # host-staged buckets: pay the ONE batched H2D here,
+                    # inside the commit window (weight_stage_target="host")
+                    from areal_tpu.inference.server import _unflatten
+
+                    tree = _unflatten(
+                        {
+                            p: self._place(p, a)
+                            if isinstance(a, np.ndarray)
+                            else a
+                            for p, a in _iter_tree_paths(tree)
+                        }
+                    )
                 self.params = (
                     self._quantize(tree)
                     if quantized and not already_served
@@ -1967,6 +2073,36 @@ class DecodeEngine:
                 # release_memory waits on this: no chunk is in flight and
                 # _abort_all (incl. KV parking) has completed
                 self._pause_ack.set()
+                self._wakeup.wait(timeout=0.05)
+                self._wakeup.clear()
+                continue
+            if self._held.is_set():
+                # commit fence (zero-pause weight sync): drain the in-flight
+                # chunk, then idle with slots/KV/device state intact — no
+                # aborts, no admissions. The pending staged commit applies at
+                # the top of the next iteration; decoding resumes in place on
+                # continue_generation and later tokens carry the new version.
+                # Acks on _hold_ack, NOT _pause_ack: slots are still live
+                # here, so the abort-pause contract does not hold.
+                expiry = getattr(self.config, "hold_fence_timeout_s", 30.0)
+                if (
+                    expiry > 0
+                    and time.monotonic() - getattr(self, "_hold_since", 0.0)
+                    > expiry
+                ):
+                    # a lost /continue_generation must not wedge a replica
+                    # that still answers /health ok — self-release
+                    logger.warning(
+                        f"hold fence exceeded {expiry:.0f}s without a "
+                        "continue_generation; self-releasing (the commit, "
+                        "if any, already applied between chunks)"
+                    )
+                    self._held.clear()
+                    self._hold_ack.clear()
+                    continue
+                self._drain(pending)
+                pending = None
+                self._hold_ack.set()
                 self._wakeup.wait(timeout=0.05)
                 self._wakeup.clear()
                 continue

@@ -207,8 +207,37 @@ class InferenceServer:
         )
 
     async def h_pause(self, request: web.Request) -> web.Response:
+        """Pause modes: default "abort" (legacy §3.4: in-flight requests
+        complete with stop_reason=abort), "hold" (zero-pause commit fence:
+        the decode loop idles without aborting; see docs/weight_sync.md).
+        Mode rides the optional JSON body so old clients keep working."""
         self._metrics.pauses.inc()
-        self.engine.pause_generation()
+        mode = "abort"
+        raw = await request.read()
+        if raw.strip():
+            # only an EMPTY body means legacy abort; a malformed body must
+            # not silently downgrade a requested no-abort hold into the
+            # destructive abort pause
+            try:
+                mode = json.loads(raw).get("mode", "abort")
+            except (ValueError, AttributeError):
+                return web.json_response(
+                    {"status": "error", "error": "unparsable JSON body"},
+                    status=400,
+                )
+        if mode == "abort":
+            self.engine.pause_generation()  # legacy signature (test engines)
+        else:
+            self.engine.pause_generation(mode=mode)
+            # the fence acks only once the decode loop actually quiesced
+            # (in-flight chunk drained) — otherwise the client's commit can
+            # land before the hold takes effect and the fence is decorative
+            waiter = getattr(self.engine, "wait_fence_ack", None)
+            if waiter is not None:
+                fenced = await asyncio.get_running_loop().run_in_executor(
+                    None, waiter, 10.0
+                )
+                return web.json_response({"status": "ok", "fenced": bool(fenced)})
         return web.json_response({"status": "ok"})
 
     async def h_continue(self, request: web.Request) -> web.Response:
@@ -244,8 +273,25 @@ class InferenceServer:
         return web.json_response({"status": "ok", "version": self.engine.get_version()})
 
     async def h_update_begin(self, request: web.Request) -> web.Response:
+        """Open the staging area. Generation is NOT paused — buckets stage
+        while decoding continues. Optional JSON body {"stage_target":
+        "device"|"host"} overrides ServerConfig.weight_stage_target for
+        this update."""
         self._update_begin_ts = time.monotonic()
-        self.engine.begin_staged_update()
+        stage_target = None
+        raw = await request.read()
+        if raw.strip():
+            try:
+                stage_target = json.loads(raw).get("stage_target")
+            except (ValueError, AttributeError):
+                return web.json_response(
+                    {"status": "error", "error": "unparsable JSON body"},
+                    status=400,
+                )
+        if stage_target is None:
+            self.engine.begin_staged_update()  # legacy signature (test engines)
+        else:
+            self.engine.begin_staged_update(stage_target=stage_target)
         return web.json_response({"status": "ok"})
 
     async def h_update_bucket(self, request: web.Request) -> web.Response:
@@ -312,7 +358,17 @@ class InferenceServer:
                 time.monotonic() - self._update_begin_ts
             )
             self._update_begin_ts = None
-        return web.json_response({"status": "ok", "version": self.engine.get_version()})
+        return web.json_response(
+            {
+                "status": "ok",
+                "version": self.engine.get_version(),
+                # tokens this replica emitted while the update staged —
+                # proof of the zero-pause property, summed trainer-side
+                "tokens_during_update": int(
+                    getattr(self.engine, "last_update_gen_tokens", 0)
+                ),
+            }
+        )
 
     async def h_update_abort(self, request: web.Request) -> web.Response:
         """Drop a partially staged update (a trainer that died mid-stream
@@ -327,9 +383,11 @@ class InferenceServer:
         return web.json_response({"status": "ok"})
 
     async def h_release_memory(self, request: web.Request) -> web.Response:
-        """Colocated-mode HBM handoff (pause first if not already paused)."""
+        """Colocated-mode HBM handoff (pause first if not already paused).
+        Requires the ABORT pause specifically: a hold fence also reports
+        is_paused but keeps slots live, which release_memory must not see."""
         loop = asyncio.get_running_loop()
-        if not self.engine.is_paused:
+        if not getattr(self.engine, "is_abort_paused", self.engine.is_paused):
             self.engine.pause_generation()
         await loop.run_in_executor(None, self.engine.release_memory)
         return web.json_response({"status": "ok"})

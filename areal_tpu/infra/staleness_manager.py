@@ -80,6 +80,17 @@ class StalenessManager:
         the drifting-version-mix signal the staleness bound exists for."""
         self._metrics.version_lag.observe(max(0, lag))
 
+    def observe_version_span(self, span: int) -> None:
+        """Record an accepted trajectory's per-token version spread (max -
+        min tagged version). Under zero-pause weight sync a sequence that
+        decodes across a commit carries BOTH versions token-by-token; span
+        > 0 counts it as a mixed-version trajectory — exactly the
+        population decoupled PPO's per-token importance correction exists
+        for (SURVEY §3.4)."""
+        self._metrics.version_span.observe(max(0, span))
+        if span > 0:
+            self._metrics.mixed_version.inc()
+
     def export_stats(self) -> dict[str, int]:
         with self._lock:
             return {

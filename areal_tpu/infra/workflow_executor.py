@@ -256,9 +256,16 @@ class WorkflowExecutor:
                     versions = np.asarray(traj["versions"])
                     vmask = versions >= 0
                     if vmask.any():
+                        vmin = int(versions[vmask].min())
                         self.staleness.observe_version_lag(
-                            int(self.engine.get_version())
-                            - int(versions[vmask].min())
+                            int(self.engine.get_version()) - vmin
+                        )
+                        # per-token tags: a sequence decoded across a
+                        # zero-pause commit carries both versions; the span
+                        # feeds the mixed-version accounting decoupled PPO
+                        # corrects per token
+                        self.staleness.observe_version_span(
+                            int(versions[vmask].max()) - vmin
                         )
             with counter_cm:
                 tracker.scalar(rollout_accepted=1.0)

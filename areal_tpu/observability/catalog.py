@@ -65,6 +65,17 @@ def staleness_metrics(reg: Registry | None = None) -> SimpleNamespace:
             "trajectories.",
             buckets=LAG_BUCKETS,
         ),
+        version_span=r.histogram(
+            "areal_rollout_version_span",
+            "Per-trajectory policy-version spread (max - min per-token "
+            "version): >0 means the sequence spanned a weight commit.",
+            buckets=LAG_BUCKETS,
+        ),
+        mixed_version=r.counter(
+            "areal_rollout_mixed_version_total",
+            "Accepted trajectories whose tokens span more than one policy "
+            "version (generated across a zero-pause weight commit).",
+        ),
     )
 
 
@@ -184,6 +195,23 @@ def client_metrics(reg: Registry | None = None) -> SimpleNamespace:
         pause_seconds=r.histogram(
             "areal_weight_update_pause_seconds",
             "Fleet availability gap per update (pause->continue window).",
+        ),
+        # zero-pause protocol split (docs/weight_sync.md): staging streams
+        # while generation runs; only the commit fence costs availability
+        stage_seconds=r.histogram(
+            "areal_update_stage_secs",
+            "Streamed weight-update staging window (begin -> last bucket "
+            "staged), during which generation keeps running.",
+        ),
+        commit_pause_seconds=r.histogram(
+            "areal_update_pause_secs",
+            "Per-update availability gap under the zero-pause protocol: "
+            "the commit fence window only.",
+        ),
+        tokens_during_update=r.counter(
+            "areal_generation_tokens_during_update",
+            "Tokens the fleet generated while weight updates were staging "
+            "(summed from commit responses; zero-pause visibility).",
         ),
         scrape_retries=r.counter(
             "areal_client_scrape_retries_total",

@@ -8,7 +8,13 @@ inference fleet (tiny model, CPU) behind a seeded FaultInjector dropping
 retrying transport — a one-command smoke test of the fault-tolerance
 layer for CI.
 
-Usage: python -m areal_tpu.tools.validate_installation [--tpu] [--chaos-self-test]
+``--weight-sync-self-test`` streams full weight updates against a
+2-replica in-process fleet while generation runs, and asserts the
+zero-pause property (docs/weight_sync.md): the commit fence is >= 5x
+smaller than the unpaused staging window and no in-flight request aborts.
+
+Usage: python -m areal_tpu.tools.validate_installation [--tpu]
+    [--chaos-self-test] [--weight-sync-self-test]
 """
 
 from __future__ import annotations
@@ -33,6 +39,13 @@ def main(argv=None) -> int:
         action="store_true",
         help="run a 3-replica local fleet under 10%% injected faults and "
         "assert a rollout batch completes",
+    )
+    p.add_argument(
+        "--weight-sync-self-test",
+        action="store_true",
+        help="run streamed weight updates against a 2-replica local fleet "
+        "under live generation load and assert the zero-pause property "
+        "(commit fence >= 5x smaller than the staging window, no aborts)",
     )
     args = p.parse_args(argv)
     results: list[tuple[str, bool, str]] = []
@@ -130,6 +143,15 @@ def main(argv=None) -> int:
 
     if args.chaos_self_test:
         _check("chaos", chaos_self_test, results)
+
+    if args.weight_sync_self_test:
+
+        def weight_sync():
+            from areal_tpu.tools.bench_weight_sync import self_test
+
+            return self_test()
+
+        _check("weight_sync", weight_sync, results)
 
     width = max(len(n) for n, _, _ in results)
     ok = True

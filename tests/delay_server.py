@@ -47,7 +47,9 @@ class DelayEchoEngine:
     def is_paused(self) -> bool:
         return self._paused.is_set()
 
-    def pause_generation(self):
+    def pause_generation(self, mode="abort"):
+        # hold vs abort is indistinguishable for a delay engine: either way
+        # generation stalls for the window and nothing is really aborted
         self._paused.set()
 
     def continue_generation(self):
@@ -100,7 +102,7 @@ class DelayEchoEngine:
         if version is not None:
             self._version = version
 
-    def begin_staged_update(self):
+    def begin_staged_update(self, stage_target=None):
         self._staged = {}
 
     def stage_weight_bucket(self, flat):

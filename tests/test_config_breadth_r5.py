@@ -267,6 +267,7 @@ def test_wandb_config_fields_load_from_yaml(tmp_path):
     assert cfg.cluster.name_resolve.etcd3_addr == "host:1234"
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_frequency_penalty_matches_reference_math():
     """ServerConfig.enable_frequency_penalty: greedy decode with a penalty
     must equal a teacher-forced loop applying logits -= penalty * counts

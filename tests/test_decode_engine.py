@@ -71,6 +71,7 @@ def test_greedy_matches_full_forward(engine):
     assert all(v == 0 for v in resp.output_versions)
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_concurrent_greedy_matches(engine):
     """Several slots decoding together must not interfere."""
     rng = np.random.default_rng(1)
@@ -129,6 +130,7 @@ def test_stop_token(engine):
     assert resp.output_tokens == free_run.output_tokens[: first_idx + 1]
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_pause_aborts_and_resume(engine):
     """pause_generation() completes in-flight requests with stop_reason=abort;
     after continue_generation() new requests run (the §3.4 protocol)."""
@@ -224,6 +226,7 @@ def test_per_slot_sampling_isolation(engine):
     assert len(results["filtered"].output_tokens) == 12
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_kv_resume_after_abort(engine):
     """Same-rid resubmission after pause resumes from the parked slot KV
     (zero re-prefill) and continues the greedy trajectory exactly."""

@@ -210,6 +210,7 @@ def test_validate_installation_chaos_self_test():
     assert "survived" in detail
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_kill_replica_mid_batch_evict_and_rejoin(tiny_params):
     """The acceptance scenario: 3 replicas, seeded 10% drops, one replica
     killed mid-batch. The batch completes via failover, the dead replica is

@@ -21,6 +21,7 @@ from areal_tpu.models.hf import save_params_to_hf
 from tpu_testing import TINY_QWEN2
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_gsm8k_rl_main_smoke(tmp_path, monkeypatch):
     """The example entry (single-host mode: trainer + in-process server +
     RLVR workflow + PPOTrainer loop) runs a short synthetic-task training
@@ -157,6 +158,7 @@ def test_gsm8k_sft_main_smoke(tmp_path, monkeypatch):
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_gsm8k_eval_main_smoke(tmp_path, monkeypatch):
     """The eval entry (examples/math/gsm8k_eval.py) greedy-decodes the test
     split against an in-process server spun from a checkpoint and reports

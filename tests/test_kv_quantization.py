@@ -6,6 +6,8 @@ convention) halve them and double what a kv_hbm_gb budget buys. CPU tests
 run the gather+dequant XLA path; the kernel path shares the same pages.
 """
 
+import pytest
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -59,6 +61,7 @@ def test_paged_attention_xla_int8_close():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_engine_serves_with_int8_kv():
     cfg = qwen.ModelConfig(**MODEL_KW)
     params = qwen.init_params(jax.random.PRNGKey(0), cfg)

@@ -86,6 +86,7 @@ def test_lora_b_zero_init_matches_base():
     np.testing.assert_allclose(np.asarray(h_lora), np.asarray(h_base), atol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_lora_trains_adapters_only():
     eng = _engine()
     rng = np.random.default_rng(0)
@@ -109,6 +110,7 @@ def test_lora_trains_adapters_only():
     assert np.array_equal(before["embed"], after["embed"])
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_lora_merge_matches_adapted_forward():
     eng = _engine()
     rng = np.random.default_rng(1)

@@ -257,6 +257,7 @@ def test_moe_hf_roundtrip(tmp_path):
     np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_moe_serving_greedy_parity():
     """The decode engine serves MoE models (prefill + paged decode run the
     dropless dispatch) and the greedy stream matches a teacher-forced full

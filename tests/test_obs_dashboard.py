@@ -1,6 +1,8 @@
 """Tier-1 smoke for the obs dashboard (ISSUE 1 satellite: CI invokes the
 --self-test mode against a fake scrape target)."""
 
+import pytest
+
 from areal_tpu.tools import obs_dashboard
 
 
@@ -22,6 +24,7 @@ def test_render_frame_tokens_per_sec():
     assert "100.0" in frame  # (300-100)/2s
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_validate_installation_metrics_lint():
     """The installation validator's metric lint passes on the catalog."""
     import io

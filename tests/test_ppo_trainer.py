@@ -127,6 +127,7 @@ def test_decoupled_loss_with_prox_recompute():
     assert "behave_imp_weight" in stats[0]
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_loglinear_prox_alpha():
     cfg = _actor_cfg(use_decoupled_loss=True, prox_logp_mode="loglinear")
     eng = JaxTrainEngine(cfg, model_config=TINY_QWEN2)
@@ -144,6 +145,7 @@ def test_loglinear_prox_alpha():
     assert np.isfinite(stats[0]["loss"])
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_gspo_and_sapo_run(actor):
     for kw in (
         dict(imp_ratio_level="sequence"),

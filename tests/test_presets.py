@@ -213,6 +213,7 @@ def test_weight_update_wire_resolution():
         resolve_weight_update_wire(cfg)
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_tree_preset_trains_through_tree_kernel():
     """VERDICT r04 #3 done-bar, literally: the gsm8k_grpo_tree preset's
     actor config (tinyified runtime fields only) drives ppo_update THROUGH

@@ -184,6 +184,7 @@ def test_offload_onload_roundtrip_int8(cfg_params):
         eng.stop()
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_tp_sharded_int8_serving(cfg_params):
     """int8 weights + int8 KV on a model=2 TP mesh (8-dev CPU): the
     quantized leaves must place under quant_partition_specs and the XLA

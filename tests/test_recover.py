@@ -55,6 +55,7 @@ def _wf(d):
     return float((np.asarray(d["loss_mask"]) > 0).sum())
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_orbax_roundtrip_with_optimizer(tmp_path):
     import jax
 

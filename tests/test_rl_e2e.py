@@ -154,6 +154,7 @@ def _first_token_hit_rate(trainer, dataset, n=16):
     return asyncio.run(probe())
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_rl_learns_target_token(stack):
     trainer, server, dataset = stack
     wf = RLVRWorkflow(reward_fn, trainer.config.gconfig)

@@ -254,6 +254,7 @@ def main(argv):
     assert name_resolve.get_subtree(lau._ns_key) == []
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_controller_started_proxy_gateway_agent_flow():
     """Single-controller agentic wiring e2e (VERDICT r03 item 7; reference
     rollout_controller.py:335-516): the controller forks colocated proxy

@@ -175,6 +175,7 @@ def test_train_batch_tree_matches_packed_loss():
         np.testing.assert_allclose(a, b, rtol=5e-2, atol=5e-4)
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_train_batch_tree_multi_pack_accumulates():
     """A node budget smaller than the batch forces >1 forest microbatch —
     the grad-accumulation path — and training still learns."""
@@ -239,6 +240,7 @@ def test_ppo_actor_trains_through_tree_path():
     assert stats[0]["tree_dedup_ratio"] > 1.2
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_tree_training_moe():
     """MoE models train through the tree path: the router aux rides the
     forest forward (load balance over unique nodes) and the policy loss
@@ -304,6 +306,7 @@ def test_tree_training_moe():
     assert s_tree["tree_dedup_ratio"] > 1.3
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_forest_moe_fallback_under_mesh():
     """The forest's [1, Npad, D] token layout can't shard over data axes as
     given; moe_ffn must reshape it to a shardable layout (or run replicated

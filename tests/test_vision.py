@@ -135,6 +135,7 @@ def _vlm_batch(seed=0, B=4, L=16, P=8):
     }
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_train_vision_tower(caplog):
     """VERDICT r04 weak #5: config.train_vision_tower lifts the frozen-ViT
     capability boundary — the tower runs inside the grad jit and its params
@@ -180,6 +181,7 @@ def test_train_vision_tower(caplog):
     )
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_train_vision_tower_learns():
     """Joint optimization reduces the LM loss through the tower path."""
     batch = _vlm_batch(seed=3)

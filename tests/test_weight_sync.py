@@ -1,0 +1,476 @@
+"""Zero-pause weight sync (docs/weight_sync.md): staging streams while
+generation continues, the pause window shrinks to the commit fence, and
+sequences that span a commit carry per-token policy versions end-to-end
+(engine -> server -> client -> WorkflowExecutor -> staleness accounting)."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import (
+    FaultToleranceConfig,
+    InferenceEngineConfig,
+    MeshConfig,
+    ServerConfig,
+)
+from areal_tpu.api.io_struct import (
+    GenerationHyperparameters,
+    ModelRequest,
+    StopReason,
+    WeightUpdateMeta,
+)
+from areal_tpu.inference.client import RemoteJaxEngine
+from areal_tpu.inference.decode_engine import DecodeEngine
+from areal_tpu.inference.server import ServerThread, flatten_params
+from areal_tpu.models import qwen
+from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+from tpu_testing import TINY_QWEN2
+
+
+def _make_engine(**overrides) -> DecodeEngine:
+    cfg = ServerConfig(
+        max_batch_size=4,
+        max_seq_len=1024,
+        decode_steps_per_call=4,
+        seed=0,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        **overrides,
+    )
+    params = qwen.init_params(jax.random.PRNGKey(0), TINY_QWEN2)
+    eng = DecodeEngine(cfg, params=params, model_cfg=TINY_QWEN2)
+    eng.initialize()
+    return eng
+
+
+def _staged_buckets(eng: DecodeEngine, delta: float = 0.5):
+    """Host bf16-ish buckets covering the full param tree, 2 buckets."""
+    flat = flatten_params(jax.tree.map(lambda x: np.asarray(x) + delta, eng.params))
+    items = sorted(flat.items())
+    mid = len(items) // 2
+    return [dict(items[:mid]), dict(items[mid:])]
+
+
+def _submit_long(eng: DecodeEngine, n_tokens: int = 512):
+    done = threading.Event()
+    box = []
+
+    def cb(resp):
+        box.append(resp)
+        done.set()
+
+    req = ModelRequest(
+        input_ids=[3, 5, 7],
+        rid="span-commit",
+        gconfig=GenerationHyperparameters(
+            max_new_tokens=n_tokens, temperature=1.0
+        ),
+    )
+    eng.start()
+    eng.submit(req, cb)
+    return done, box
+
+
+def _wait_tokens(eng: DecodeEngine, n: int, timeout: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout
+    while eng.stats["generated_tokens"] < n:
+        assert time.monotonic() < deadline, "generation never started"
+        time.sleep(0.01)
+
+
+def test_staged_commit_mid_generation_no_abort():
+    """A request in flight across begin -> stage -> commit is never aborted;
+    tokens emitted before the commit carry v0, tokens after carry v1, and
+    the boundary is monotone (the paper's interruptible generation WITHOUT
+    the abort)."""
+    eng = _make_engine()
+    try:
+        done, box = _submit_long(eng)
+        _wait_tokens(eng, 8)
+        gen_at_begin = eng.stats["generated_tokens"]
+        eng.begin_staged_update()
+        buckets = _staged_buckets(eng)
+        eng.stage_weight_bucket(buckets[0])
+        # zero-pause evidence: decoding continues BETWEEN staged buckets
+        # (times out here if staging blocked generation)
+        _wait_tokens(eng, gen_at_begin + 4)
+        eng.stage_weight_bucket(buckets[1])
+        eng.commit_staged_weights(version=1)
+        assert eng.get_version() == 1
+        assert eng.last_update_gen_tokens >= 4
+        assert done.wait(120), "generation did not finish"
+        resp = box[0]
+        assert resp.stop_reason != StopReason.ABORT.value
+        versions = resp.output_versions
+        assert len(versions) == 512
+        assert versions == sorted(versions), "per-token versions not monotone"
+        assert versions[0] == 0, "pre-commit tokens must carry the old version"
+        assert versions[-1] == 1, "post-commit tokens must carry the new version"
+    finally:
+        eng.stop()
+
+
+def test_hold_fence_pauses_without_abort():
+    """pause_generation('hold') idles the decode loop without completing
+    in-flight requests; continue resumes them in place."""
+    eng = _make_engine()
+    try:
+        done, box = _submit_long(eng, n_tokens=256)
+        _wait_tokens(eng, 4)
+        eng.pause_generation(mode="hold")
+        assert eng.is_paused
+        # hold must NOT satisfy the abort-pause contract (release_memory
+        # waits on _pause_ack expecting emptied slots)
+        assert not eng.is_abort_paused
+        assert eng._hold_ack.wait(30), "loop never reached the fence"
+        assert not eng._pause_ack.is_set()
+        held_at = eng.stats["generated_tokens"]
+        time.sleep(0.3)
+        assert eng.stats["generated_tokens"] == held_at, "loop decoded while held"
+        assert not done.is_set(), "hold must not complete the request"
+        eng.continue_generation()
+        assert not eng.is_paused
+        assert done.wait(120)
+        assert box[0].stop_reason != StopReason.ABORT.value
+        assert len(box[0].output_tokens) == 256
+    finally:
+        eng.stop()
+
+
+def test_hold_fence_self_releases_on_lost_continue():
+    """A lost /continue_generation must not wedge the replica: the hold
+    self-releases after hold_fence_timeout_s and decoding resumes."""
+    eng = _make_engine(hold_fence_timeout_s=0.5)
+    try:
+        done, box = _submit_long(eng, n_tokens=128)
+        _wait_tokens(eng, 4)
+        eng.pause_generation(mode="hold")
+        assert eng.wait_fence_ack(30), "loop never reached the fence"
+        # never send continue_generation — the engine must free itself
+        deadline = time.monotonic() + 30
+        while eng.is_paused:
+            assert time.monotonic() < deadline, "hold never self-released"
+            time.sleep(0.05)
+        assert done.wait(120)
+        assert box[0].stop_reason != StopReason.ABORT.value
+        assert len(box[0].output_tokens) == 128
+    finally:
+        eng.stop()
+
+
+def test_abort_staged_update_leaves_serving_untouched():
+    """abort_staged_update mid-stream drops staging only: served weights,
+    version, and subsequent generation are unaffected."""
+    eng = _make_engine()
+    ref = np.asarray(eng.params["embed"], np.float32).copy()
+    buckets = _staged_buckets(eng, delta=9.0)
+    eng.begin_staged_update()
+    eng.stage_weight_bucket(buckets[0])  # partial stream only
+    eng.abort_staged_update()
+    assert eng.get_version() == 0
+    np.testing.assert_array_equal(np.asarray(eng.params["embed"], np.float32), ref)
+    # a commit with nothing staged must fail loudly, not swap garbage
+    with pytest.raises(AssertionError):
+        eng.commit_staged_weights(version=1)
+    # staging again from scratch still works
+    eng.begin_staged_update()
+    for b in buckets:
+        eng.stage_weight_bucket(b)
+    eng.commit_staged_weights(version=1)
+    assert eng.get_version() == 1
+
+
+def test_host_stage_target_defers_h2d_to_commit():
+    """weight_stage_target='host': buckets stay host numpy until commit,
+    then one H2D places them; committed weights match the device path."""
+    eng = _make_engine(weight_stage_target="host")
+    buckets = _staged_buckets(eng, delta=0.25)
+    expect = {}
+    for b in buckets:
+        expect.update(b)
+    eng.begin_staged_update()
+    for b in buckets:
+        eng.stage_weight_bucket(b)
+    staged = eng._staged_flat
+    assert staged is not None
+    assert all(isinstance(v, np.ndarray) for v in staged.values()), (
+        "host staging must not device_put before commit"
+    )
+    eng.commit_staged_weights(version=3)
+    assert eng.get_version() == 3
+    got = np.asarray(eng.params["embed"], np.float32)
+    np.testing.assert_allclose(got, expect["embed"], atol=1e-2)
+    # per-update override through begin_staged_update(stage_target=...)
+    eng2 = _make_engine()
+    eng2.begin_staged_update(stage_target="host")
+    eng2.stage_weight_bucket(buckets[0])
+    assert all(isinstance(v, np.ndarray) for v in eng2._staged_flat.values())
+    eng2.abort_staged_update()
+    with pytest.raises(ValueError):
+        eng2.begin_staged_update(stage_target="hbm3")
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    servers = []
+    base = qwen.init_params(jax.random.PRNGKey(0), TINY_QWEN2)
+    for i in range(2):
+        cfg = ServerConfig(
+            max_batch_size=4,
+            max_seq_len=1024,
+            decode_steps_per_call=4,
+            seed=i,
+            mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        )
+        eng = DecodeEngine(cfg, params=base, model_cfg=TINY_QWEN2)
+        eng.initialize()
+        st = ServerThread(cfg, eng)
+        st.start()
+        servers.append(st)
+    yield servers
+    for st in servers:
+        st.stop()
+
+
+@pytest.fixture()
+def fleet_client(fleet):
+    cfg = InferenceEngineConfig(
+        max_concurrent_rollouts=4,
+        consumer_batch_size=2,
+        max_head_offpolicyness=100,
+        request_timeout=120,
+        weight_chunk_mb=1,
+        fault_tolerance=FaultToleranceConfig(
+            backoff_base_s=0.05, backoff_max_s=0.2
+        ),
+    )
+    c = RemoteJaxEngine(cfg, addresses=[s.address for s in fleet])
+    c.initialize()
+    yield c
+    c.destroy()
+    for s in fleet:
+        s.engine.set_version(0)
+        s.engine.continue_generation()
+
+
+def test_zero_pause_update_over_http(fleet, fleet_client):
+    """Full-stack acceptance: a streamed update against a live fleet never
+    aborts in-flight requests, the measured pause window (commit fence) is
+    a fraction of the staging window, and the per-token version tags
+    surface through WorkflowExecutor output with the mixed-version
+    staleness accounting fed."""
+    import asyncio
+
+    client = fleet_client
+    results = []
+
+    def run_gen():
+        req = ModelRequest(
+            input_ids=[5, 6, 7],
+            rid="span-http",
+            gconfig=GenerationHyperparameters(
+                max_new_tokens=512, temperature=1.0
+            ),
+        )
+        results.append(asyncio.run(client.agenerate(req)))
+
+    t = threading.Thread(target=run_gen)
+    t.start()
+    deadline = time.monotonic() + 60
+    while all(s.engine.stats["generated_tokens"] < 4 for s in fleet):
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    new_params = jax.tree.map(
+        lambda x: np.asarray(x) + 0.1, fleet[0].engine.params
+    )
+    client.update_weights(WeightUpdateMeta(type="mem"), params=new_params)
+    t.join(timeout=120)
+    assert not t.is_alive()
+    resp = results[0]
+    assert resp.stop_reason != StopReason.ABORT.value
+    assert len(resp.output_tokens) == 512
+    versions = resp.output_versions
+    assert versions == sorted(versions)
+    assert versions[0] == 0 and versions[-1] == 1, versions[:3] + versions[-3:]
+    for s in fleet:
+        assert s.engine.get_version() == 1
+    # split windows: the fence is a fraction of the unpaused stream
+    assert client.last_stage_secs > 0
+    assert client.last_pause_secs < client.last_stage_secs
+    stats = client.export_stats()
+    assert stats["update_weights_stage_secs"] == client.last_stage_secs
+    assert stats["update_weights_pause_secs"] == client.last_pause_secs
+    # the replica that served the request generated tokens DURING the update
+    assert client.last_update_gen_tokens > 0
+    assert stats["generation_tokens_during_update"] > 0
+
+
+def test_mixed_version_tags_through_workflow_executor(fleet, fleet_client):
+    """Rollouts spanning a commit reach the trainer with both versions in
+    traj['versions'] and feed the version-span staleness accounting."""
+    client = fleet_client
+    span_fam = client.executor.staleness._metrics.version_span
+    _, sum_before, count_before = span_fam.labels().snapshot()
+    wf = RLVRWorkflow(
+        lambda *a, **k: 1.0,
+        GenerationHyperparameters(n_samples=1, max_new_tokens=384, temperature=1.0),
+    )
+    tids = [
+        client.submit({"prompt_ids": [9 + i, 4, 2]}, wf) for i in range(2)
+    ]
+    deadline = time.monotonic() + 60
+    while all(s.engine.stats["generated_tokens"] < 4 for s in fleet):
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    new_params = jax.tree.map(
+        lambda x: np.asarray(x) + 0.05, fleet[0].engine.params
+    )
+    client.update_weights(WeightUpdateMeta(type="mem"), params=new_params)
+    trajs = [client.wait_for_task(tid, timeout=120) for tid in tids]
+    spanned = 0
+    for traj in trajs:
+        assert traj is not None
+        versions = np.asarray(traj["versions"])
+        out = versions[versions >= 0]
+        assert out.size > 0
+        # per-token tags are monotone within each sequence
+        assert (np.diff(out) >= 0).all()
+        if out.max() > out.min():
+            spanned += 1
+    assert spanned > 0, "no sequence spanned the commit — tags untested"
+    _, sum_after, count_after = span_fam.labels().snapshot()
+    assert count_after > count_before
+    assert sum_after > sum_before, (
+        "mixed-version span never observed by staleness accounting"
+    )
+
+
+def test_replica_evicted_mid_stage_excluded_from_commit(fleet, fleet_client):
+    """Supervision interplay: a replica that dies mid-stage is dropped from
+    THIS update's commit (PR 3's pinned-snapshot rule over the unpaused
+    stream); survivors commit, the corpse keeps its truthful old version."""
+    client = fleet_client
+    extra_cfg = ServerConfig(
+        max_batch_size=2,
+        max_seq_len=256,
+        decode_steps_per_call=4,
+        seed=7,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+    )
+    base = jax.tree.map(np.asarray, fleet[0].engine.params)
+    extra_eng = DecodeEngine(extra_cfg, params=base, model_cfg=TINY_QWEN2)
+    extra_eng.initialize()
+    extra = ServerThread(extra_cfg, extra_eng)
+    extra.start()
+    client.addresses.append(extra.address)
+    client.fleet.track(extra.address)
+    try:
+        new_params = jax.tree.map(lambda x: np.asarray(x) + 0.01, base)
+        # a multi-bucket stream so the replica dies mid-stage, not pre-stage
+        items = sorted(flatten_params(new_params).items())
+        mid = len(items) // 2
+        plan = [items[:mid], items[mid:]]
+        enc = client._encoder_pool()
+        targets = client._fanout_targets()
+        assert extra.address in targets
+        first = enc.submit(client._encode_bucket, plan[0])
+        # kill the extra replica right as staging begins: its bucket posts
+        # fail, the retry policy trips its circuit, and the stream drops it
+        extra.stop()
+        commit_targets = client._stream_stage_buckets(plan, enc, first, targets)
+        assert extra.address not in commit_targets, (
+            "dead replica must be excluded from the commit set"
+        )
+        assert set(commit_targets) == {s.address for s in fleet}
+        client._post_all(
+            "/update_weights_commit", {"version": 1}, targets=commit_targets
+        )
+        for s in fleet:
+            assert s.engine.get_version() == 1
+        # the evicted replica never saw the commit: version stays truthful
+        assert extra_eng.get_version() == 0
+    finally:
+        client.addresses.remove(extra.address)
+        extra.stop()
+
+
+def test_commit_fence_modes(fleet):
+    """weight_commit_fence='none' commits with generation running (no
+    /pause_generation at all); 'abort' restores the legacy full pause —
+    in-flight requests abort server-side and the client loop resumes them
+    transparently, so the response surface is identical either way."""
+    import asyncio
+
+    from areal_tpu.observability import catalog
+
+    pause_counter = catalog.server_metrics().pauses.labels()
+    # expect_pause_calls is per replica: both in-process servers share the
+    # one process-global counter
+    for fence, expect_pause_calls in (("none", 0), ("abort", 1)):
+        cfg = InferenceEngineConfig(
+            max_concurrent_rollouts=2,
+            consumer_batch_size=1,
+            request_timeout=120,
+            weight_chunk_mb=1,
+            weight_commit_fence=fence,
+        )
+        c = RemoteJaxEngine(cfg, addresses=[s.address for s in fleet])
+        c.initialize()
+        try:
+            results = []
+
+            def run_gen():
+                req = ModelRequest(
+                    input_ids=[8, 2, 4],
+                    rid=f"fence-{fence}",
+                    gconfig=GenerationHyperparameters(
+                        max_new_tokens=256, temperature=1.0
+                    ),
+                )
+                results.append(asyncio.run(c.agenerate(req)))
+
+            t = threading.Thread(target=run_gen)
+            t.start()
+            deadline = time.monotonic() + 60
+            while all(s.engine.stats["generated_tokens"] < 4 for s in fleet):
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            new_params = jax.tree.map(
+                lambda x: np.asarray(x) + 0.02, fleet[0].engine.params
+            )
+            pauses_before = pause_counter.get()
+            aborted_before = sum(s.engine.stats["aborted"] for s in fleet)
+            c.update_weights(WeightUpdateMeta(type="mem"), params=new_params)
+            t.join(timeout=120)
+            assert not t.is_alive()
+            # the fence mode actually drove the protocol: 'none' never
+            # pauses, 'abort' pauses every replica and aborts server-side
+            assert pause_counter.get() - pauses_before == expect_pause_calls * len(fleet)
+            aborted_delta = sum(s.engine.stats["aborted"] for s in fleet) - aborted_before
+            if fence == "none":
+                assert aborted_delta == 0, "fence=none must not abort"
+            else:
+                assert aborted_delta > 0, "legacy abort fence never aborted"
+            resp = results[0]
+            # both modes: the client-visible response is complete (abort
+            # mode resumes transparently via the interruptible loop)
+            assert len(resp.output_tokens) == 256
+            assert resp.stop_reason != StopReason.ABORT.value
+            assert c.get_version() == 1
+        finally:
+            c.destroy()
+            for s in fleet:
+                s.engine.set_version(0)
+                s.engine.continue_generation()
+
+
+def test_bad_fence_config_rejected(fleet):
+    c = RemoteJaxEngine(
+        InferenceEngineConfig(weight_commit_fence="sometimes"),
+        addresses=[fleet[0].address],
+    )
+    with pytest.raises(ValueError):
+        c._commit_fence([fleet[0].address])
