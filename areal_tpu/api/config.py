@@ -306,6 +306,38 @@ class InferenceEngineConfig:
 
 
 @dataclass
+class PrefixCacheConfig:
+    """Cross-request radix prefix cache over the paged KV pool
+    (inference/paged_kv.py RadixPrefixCache): completed/parked prompts
+    publish their full KV pages into a radix tree keyed on token ids at
+    page granularity; admission aliases the longest cached page-aligned
+    prefix (refcount++) and prefills only the suffix. The cross-request
+    generalization of the engine's GRPO same-prompt aliasing — the role
+    SGLang's RadixAttention plays for the reference."""
+
+    enabled: bool = True
+    # hard cap on tree-held pages; None derives it from max_fraction
+    max_pages: int | None = None
+    # cap as a fraction of the page pool when max_pages is None — the tree
+    # competes with live slots for pages, so it must never own the pool
+    max_fraction: float = 0.5
+    # what happens to cached pages at a weight commit: "flush" (default)
+    # drops the whole tree — KV computed under the old policy is stale
+    # under the new one; "keep" retains it for the staleness-ablation arm
+    # (per-token version tags audit the drift, docs/weight_sync.md)
+    across_updates: str = "flush"
+
+    def __post_init__(self):
+        # consumers compare == "flush"; an unrecognized value would
+        # silently select the unsafe keep-stale-KV behavior
+        if self.across_updates not in ("flush", "keep"):
+            raise ValueError(
+                f"prefix_cache.across_updates must be 'flush' or 'keep', "
+                f"got {self.across_updates!r}"
+            )
+
+
+@dataclass
 class ServerConfig:
     """JAX inference server (replaces reference sglang/vllm sections)."""
 
@@ -329,6 +361,9 @@ class ServerConfig:
     port: int = 0  # 0 = pick a free port
     host: str = "0.0.0.0"
     enable_prefix_caching: bool = True
+    # cross-request radix prefix cache (enable_prefix_caching must also be
+    # True; that legacy flag additionally gates GRPO in-batch aliasing)
+    prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
     # keep aborted requests' KV parked in their slots across weight updates so
     # the client's abort->resubmit loop resumes with zero re-prefill. The
     # retained KV was computed under the previous policy — the same staleness

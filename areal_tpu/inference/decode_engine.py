@@ -101,6 +101,9 @@ class _Parked:
     full_ids: list[int]  # prompt + emitted; cache holds all but the last
     pos: int  # decode position of the pending (last) token
     pages: list[int] = field(default_factory=list)  # owned KV pages
+    # policy version each page's KV was created under (parallel to pages;
+    # radix publication and the flush-on-commit staleness check need it)
+    page_versions: list[int] = field(default_factory=list)
     n_emitted: int = 0  # completion tokens so far (freq-penalty restore)
     park_time: float = field(default_factory=time.monotonic)
 
@@ -265,10 +268,17 @@ class DecodeEngine:
             "kv_resumes": 0,
             "prefills": 0,
             "prefill_batches": 0,
+            "prefill_tokens": 0,
+            "prefix_cache_hits": 0,
+            "prefix_cache_misses": 0,
+            "prefix_hit_tokens": 0,
         }
         # registry counters mirror the hot stats (thread-sharded: the
         # decode thread increments contention-free; scrapes sum shards)
         self._obs = obs_catalog.engine_metrics()
+        self._obs_pc = obs_catalog.prefix_cache_metrics()
+        self._radix = None  # cross-request prefix cache; built in initialize
+        self._radix_flush_req: tuple[threading.Event, list[int]] | None = None
 
     # -- lifecycle --------------------------------------------------------
     def initialize(self) -> None:
@@ -487,7 +497,21 @@ class DecodeEngine:
                 },
             )()
         self._slot_pages: list[list[int]] = [[] for _ in range(S)]
+        # policy version each slot page's KV was created under (parallel to
+        # _slot_pages): radix publication skips stale pages under the
+        # default flush-on-commit policy
+        self._slot_page_versions: list[list[int]] = [[] for _ in range(S)]
         self._pt_host = np.zeros((S, self._maxp), np.int32)
+        pc = getattr(cfg, "prefix_cache", None)
+        if pc is not None and pc.enabled and cfg.enable_prefix_caching:
+            cap = pc.max_pages
+            if cap is None:
+                cap = int((n_pages - 1) * pc.max_fraction)
+            self._radix = paged_kv.RadixPrefixCache(
+                self.pool, psz, max(0, min(cap, n_pages - 1))
+            )
+        else:
+            self._radix = None
 
     # prompt buckets above this warm only if on the round_up_to_bucket
     # 2^k/3*2^k series — the exact-reachable set at T=32K would otherwise be
@@ -564,6 +588,12 @@ class DecodeEngine:
         measured decode throughput on the first request waves. Servers call
         this at startup (``ServerConfig.precompile``) — the role SGLang's
         warmup phase plays for the reference's launchers.
+
+        Suffix-only prefill variants (radix prefix-cache hits) are NOT
+        pre-warmed: their (suffix bucket × prefix-table width) grid is
+        workload-dependent, so they lazy-compile on first hit and land in
+        the persistent cache — one admission-wave stall per shape, never a
+        mid-decode stall.
 
         Warm sets are derived from ``round_up_to_bucket`` itself, and
         warming uses ``jit(f).lower(...).compile()`` — compile cost only, no
@@ -1048,6 +1078,20 @@ class DecodeEngine:
             if not self.config.kv_reuse_across_updates:
                 while self._evict_oldest_parked() is not None:
                     pass
+            # cross-request prefix cache: KV cached under the old policy is
+            # stale after this commit. The default policy flushes the tree
+            # (only the tree's own refs drop — pages aliased by live slots
+            # survive until those slots free them); "keep" retains it for
+            # the staleness-ablation arm, audited by per-token version tags.
+            policy = getattr(
+                getattr(self.config, "prefix_cache", None),
+                "across_updates",
+                "flush",
+            )
+            if self._radix is not None and policy == "flush":
+                freed = self._radix.flush()
+                if freed:
+                    self._obs_pc.evicted_pages.inc(freed)
             self._pending_weight_update = None
             logger.info(
                 f"weights updated ({kind}) to v{self._version} in "
@@ -1115,6 +1159,61 @@ class DecodeEngine:
     def get_version(self) -> int:
         return self._version
 
+    # -- prefix cache (cross-request radix reuse) --------------------------
+    def prefix_cache_stats(self) -> dict:
+        """Point-in-time radix-cache state for /statusz and tests."""
+        if self._radix is None:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            "pages_held": self._radix.pages_held,
+            "max_pages": self._radix.max_pages,
+            **self._radix.stats,
+            # hit accounting is engine-owned: counted once per ADMITTED
+            # request, so backlog retries can't inflate the hit rate
+            "hits": self.stats["prefix_cache_hits"],
+            "misses": self.stats["prefix_cache_misses"],
+            "hit_tokens": self.stats["prefix_hit_tokens"],
+        }
+
+    def flush_prefix_cache(self, timeout: float = 10.0) -> int:
+        """Drop every radix-cached page (ops endpoint /flush_prefix_cache).
+        The tree is decode-loop-private, so a live loop performs the flush
+        itself between chunks; we only marshal the request. Returns freed
+        page count (0 on timeout or when the cache is disabled)."""
+        if self._radix is None:
+            return 0
+        if self._thread is None or not self._thread.is_alive():
+            freed = self._radix.flush()
+            if freed:
+                self._obs_pc.evicted_pages.inc(freed)
+            return freed
+        with self._weight_lock:
+            req = self._radix_flush_req
+            if req is None:
+                # concurrent flush calls SHARE one request: a second caller
+                # overwriting the slot would leave the first blocking its
+                # full timeout and reporting freed_pages=0
+                req = (threading.Event(), [])
+                self._radix_flush_req = req
+        ev, box = req
+        self._wakeup.set()
+        ev.wait(timeout)
+        return box[0] if box else 0
+
+    def _service_radix_flush(self) -> None:
+        with self._weight_lock:
+            req = self._radix_flush_req
+            self._radix_flush_req = None
+        if req is None:
+            return
+        ev, box = req
+        freed = self._radix.flush() if self._radix is not None else 0
+        if freed:
+            self._obs_pc.evicted_pages.inc(freed)
+        box.append(freed)
+        ev.set()
+
     # -- jitted kernels ---------------------------------------------------
     def _prefill_fn(self, n_prompts: int, bucket: int, with_images: bool = False):
         """Batched prefill: A prompts (padded to ``bucket``) in one forward,
@@ -1141,6 +1240,35 @@ class DecodeEngine:
                     params, mcfg, ids, positions, seg, image_embeds=img
                 )
                 # ks/vs: [n_layers, A, bucket, KH, hd] -> page scatter
+                return paged_kv.scatter_prefill(cache, ks, vs, flat_pages, psz)
+
+            self._fn_cache[key] = jax.jit(prefill, donate_argnames=("cache",))
+        return self._fn_cache[key]
+
+    def _prefill_paged_fn(self, n_prompts: int, bucket: int, wp: int):
+        """Suffix-only prefill over a radix-cached prefix: A suffixes
+        (padded to ``bucket``) in one forward, queries attending over each
+        row's cached prefix pages (``wp`` page-table columns) plus the
+        causal suffix; suffix KV scatters into fresh pages. The prefix
+        pages are read-only (aliased, possibly shared across requests)."""
+        key = ("prefill_sfx", n_prompts, bucket, wp)
+        if key not in self._fn_cache:
+            mcfg = self.model_cfg
+            psz = self.config.page_size
+            from areal_tpu.inference import paged_kv
+
+            def prefill(params, cache, ids, plens, offs, flat_pages, ppt):
+                # ids [A, bucket] suffix tokens; plens [A] suffix lengths;
+                # offs [A] absolute start positions — page-aligned, so they
+                # double as the cached-prefix lengths; ppt [A, wp] prefix
+                # page table
+                positions = offs[:, None] + jnp.arange(bucket, dtype=jnp.int32)[None]
+                seg = (
+                    jnp.arange(bucket, dtype=jnp.int32)[None] < plens[:, None]
+                ).astype(jnp.int32)
+                _, ks, vs = qwen.forward_prefill_paged(
+                    params, mcfg, ids, positions, seg, cache, ppt, offs
+                )
                 return paged_kv.scatter_prefill(cache, ks, vs, flat_pages, psz)
 
             self._fn_cache[key] = jax.jit(prefill, donate_argnames=("cache",))
@@ -1387,8 +1515,21 @@ class DecodeEngine:
         p = self._parked.pop(rid)
         self.pool.free(p.pages)
         self._slot_pages[p.slot] = []
+        self._slot_page_versions[p.slot] = []
         self._pt_host[p.slot] = 0
         return p.slot
+
+    def _reclaim_pages(self, n: int) -> bool:
+        """Eviction ladder below the free pool: radix LRU leaves first (pure
+        cache — any published page is re-creatable by a prefill), then
+        parked KV (rid-affinity state whose loss costs a re-prefill).
+        Returns True when anything was freed (the caller re-allocs)."""
+        if self._radix is not None:
+            freed = self._radix.evict(n)
+            if freed > 0:
+                self._obs_pc.evicted_pages.inc(freed)
+                return True
+        return self._evict_oldest_parked() is not None
 
     def _pack_row(
         self,
@@ -1516,6 +1657,7 @@ class DecodeEngine:
         # restore page ownership + block-table row (zeroed at park time so
         # in-flight chunks couldn't write into retained pages)
         self._slot_pages[slot] = p.pages
+        self._slot_page_versions[slot] = list(p.page_versions)
         self._pt_host[slot] = 0
         self._pt_host[slot, : len(p.pages)] = p.pages
         row = self._slot_update_row(
@@ -1588,9 +1730,21 @@ class DecodeEngine:
                     first_slot[key] = slot
                 primaries.append((task, slot))
 
+        # radix lookup (cross-request prefix cache): primaries whose prompt
+        # has a cached page-aligned prefix alias those pages and prefill
+        # only the suffix; the rest take the plain full-prefill path
+        cold: list[tuple[_Task, int]] = []
+        warm: list[tuple[_Task, int, list[int], list[int]]] = []
+        for task, slot in primaries:
+            m = self._radix_match(task)
+            if m is None:
+                cold.append((task, slot))
+            else:
+                warm.append((task, slot, m[0], m[1]))
+
         # group by length bucket, prefill in batches of _PREFILL_SIZES
         by_bucket: dict[int, list[tuple[_Task, int]]] = {}
-        for task, slot in primaries:
+        for task, slot in cold:
             bucket = min(T, round_up_to_bucket(len(task.req.input_ids), 256))
             by_bucket.setdefault(bucket, []).append((task, slot))
         for bucket, group in sorted(by_bucket.items()):
@@ -1599,8 +1753,147 @@ class DecodeEngine:
                 A = next(a for a in _PREFILL_SIZES if a <= len(group) - i)
                 rows.extend(self._prefill_group(group[i : i + A], bucket))
                 i += A
+        # warm admissions group by SUFFIX bucket (the only tokens prefilled)
+        warm_by_bucket: dict[int, list[tuple[_Task, int, list[int], list[int]]]] = {}
+        psz = self.config.page_size
+        for task, slot, mpages, mvers in warm:
+            sfx = len(task.req.input_ids) - len(mpages) * psz
+            bucket = min(T, round_up_to_bucket(sfx, 256))
+            warm_by_bucket.setdefault(bucket, []).append(
+                (task, slot, mpages, mvers)
+            )
+        for bucket, group in sorted(warm_by_bucket.items()):
+            i = 0
+            while i < len(group):
+                A = next(a for a in _PREFILL_SIZES if a <= len(group) - i)
+                rows.extend(
+                    self._prefill_group_prefixed(group[i : i + A], bucket)
+                )
+                i += A
         if dup_pairs:
             rows.extend(self._admit_duplicates(dup_pairs))
+        return rows
+
+    def _radix_match(self, task: _Task) -> tuple[list[int], list[int]] | None:
+        """Longest cached page-aligned prefix for a fresh admission. Takes
+        the pool refs on the matched pages IMMEDIATELY (before any further
+        eviction-ladder activity in this admission wave could free them);
+        a task that later backlogs must release them (`_unmatch`). The page
+        holding row ``plen-1`` is never matched — the decode head writes
+        there, and aliased pages are immutable."""
+        if self._radix is None or task.req.image_data is not None:
+            return None
+        ids = task.req.input_ids
+        limit = (len(ids) - 1) // self.config.page_size
+        pages, versions = self._radix.match(ids, max_pages=limit)
+        self._obs_pc.lookups.inc()
+        if not pages:
+            self.stats["prefix_cache_misses"] += 1
+            return None
+        self.pool.ref(pages)
+        # hit stats are counted at ADMISSION (in _prefill_group_prefixed),
+        # not here: a pool-pressure backlog retries the match every wave
+        # and would inflate the hit rate with re-counted tokens
+        return pages, versions
+
+    def _prefill_group_prefixed(
+        self, group: list[tuple[_Task, int, list[int], list[int]]], bucket: int
+    ) -> list[np.ndarray]:
+        """Admit tasks whose prompt prefix is radix-cached: alias the
+        matched pages (already pool-ref'd by ``_radix_match``), allocate
+        pages for the suffix only, and run the suffix-only prefill variant
+        attending over the cached prefix. ``bucket`` buckets the SUFFIX
+        length; the prefix page-table width compiles per power-of-two."""
+        psz = self.config.page_size
+        npg = -(-bucket // psz)
+        admitted: list[tuple[_Task, int, list[int], list[int]]] = []
+        page_rows: list[np.ndarray] = []
+        for task, slot, mpages, mvers in group:
+            plen = len(task.req.input_ids)
+            sfx = plen - len(mpages) * psz
+            need = -(-sfx // psz)
+            pages = self.pool.alloc(need)
+            while pages is None and self._reclaim_pages(need):
+                pages = self.pool.alloc(need)
+            if pages is None:
+                # pool pressure: release the match refs and retry the task
+                # as a fresh admission later
+                self.pool.free(mpages)
+                self._backlog.append(task)
+                continue
+            all_pages = list(mpages) + pages
+            self._slot_pages[slot] = all_pages
+            self._slot_page_versions[slot] = list(mvers) + [self._version] * len(
+                pages
+            )
+            self._pt_host[slot] = 0
+            self._pt_host[slot, : len(all_pages)] = all_pages
+            row = np.zeros(npg, np.int32)  # 0 = trash page for padded rows
+            row[:need] = pages
+            page_rows.append(row)
+            admitted.append((task, slot, mpages, mvers))
+        if not admitted:
+            return []
+        A = len(admitted)
+        flat_pages = np.stack(page_rows)
+        ids_np = np.zeros((A, bucket), np.int32)
+        plens = np.zeros(A, np.int32)
+        offs = np.zeros(A, np.int32)
+        max_mp = max(len(m) for _, _, m, _ in admitted)
+        wp = 1
+        while wp < max_mp:
+            wp *= 2
+        ppt = np.zeros((A, wp), np.int32)
+        for j, (task, _slot, mpages, _mvers) in enumerate(admitted):
+            ids = list(task.req.input_ids)
+            n_tok = len(mpages) * psz
+            ids_np[j, : len(ids) - n_tok] = ids[n_tok:]
+            plens[j] = len(ids) - n_tok
+            offs[j] = n_tok
+            ppt[j, : len(mpages)] = mpages
+        sizes = [a for a in _PREFILL_SIZES if a >= A]
+        A_pad = min(sizes) if sizes else A
+        if A_pad > A:
+            ids_np = np.pad(ids_np, ((0, A_pad - A), (0, 0)))
+            ids_np[A:, 0] = 1
+            plens = np.pad(plens, (0, A_pad - A), constant_values=1)
+            offs = np.pad(offs, (0, A_pad - A))
+            flat_pages = np.pad(flat_pages, ((0, A_pad - A), (0, 0)))
+            ppt = np.pad(ppt, ((0, A_pad - A), (0, 0)))
+        with set_mesh(self.mesh):
+            self.cache = self._prefill_paged_fn(A_pad, bucket, wp)(
+                self.params,
+                self.cache,
+                jnp.asarray(ids_np),
+                jnp.asarray(plens),
+                jnp.asarray(offs),
+                jnp.asarray(flat_pages.reshape(-1)),
+                jnp.asarray(ppt),
+            )
+        rows = []
+        sfx_tokens = 0
+        hit_tokens = 0
+        for j, (task, slot, mpages, _mvers) in enumerate(admitted):
+            full = list(task.req.input_ids)
+            P_len = len(full)
+            task.slot = slot
+            task.prompt_len = P_len
+            self._slot_task[slot] = task
+            sfx_tokens += int(plens[j])
+            hit_tokens += len(mpages) * psz
+            rows.append(
+                self._slot_update_row(
+                    task, slot, full[-1], P_len - 1, self._budget(task, P_len)
+                )
+            )
+        self.stats["prefills"] += A
+        self.stats["prefill_batches"] += 1
+        self.stats["prefill_tokens"] += sfx_tokens
+        self.stats["prefix_cache_hits"] += A
+        self.stats["prefix_hit_tokens"] += hit_tokens
+        self._obs.prefills.inc(A)
+        self._obs.prefill_tokens.inc(sfx_tokens)
+        self._obs_pc.hit_tokens.inc(hit_tokens)
         return rows
 
     def _admit_duplicates(
@@ -1628,8 +1921,7 @@ class DecodeEngine:
                 self._backlog.append(task)
                 continue
             priv = self.pool.alloc(1)
-            if priv is None:
-                self._evict_oldest_parked()
+            while priv is None and self._reclaim_pages(1):
                 priv = self.pool.alloc(1)
             if priv is None:
                 self._backlog.append(task)
@@ -1640,6 +1932,12 @@ class DecodeEngine:
             copy_dst.append(priv[0])
             copy_src.append(prim[n_shared])
             self._slot_pages[slot] = pages
+            # the private page is a byte COPY of prim[n_shared], so it
+            # inherits that page's KV version, not the current one — under
+            # the "keep" ablation the two can differ across a commit
+            self._slot_page_versions[slot] = list(
+                self._slot_page_versions[src_slot][: n_shared + 1]
+            )
             self._pt_host[slot] = 0
             self._pt_host[slot, : len(pages)] = pages
             task.slot = slot
@@ -1684,12 +1982,13 @@ class DecodeEngine:
             plen = len(task.req.input_ids)
             need = -(-plen // psz)
             pages = self.pool.alloc(need)
-            while pages is None and self._evict_oldest_parked() is not None:
+            while pages is None and self._reclaim_pages(need):
                 pages = self.pool.alloc(need)
             if pages is None:
                 self._backlog.append(task)  # pool pressure: retry later
                 continue
             self._slot_pages[slot] = pages
+            self._slot_page_versions[slot] = [self._version] * need
             self._pt_host[slot] = 0
             self._pt_host[slot, :need] = pages
             row = np.zeros(npg, np.int32)  # 0 = trash page for padded rows
@@ -1749,7 +2048,9 @@ class DecodeEngine:
             )
         self.stats["prefills"] += A
         self.stats["prefill_batches"] += 1
+        self.stats["prefill_tokens"] += int(plens[:A].sum())  # pad rows excluded
         self._obs.prefills.inc(A)
+        self._obs.prefill_tokens.inc(int(plens[:A].sum()))
         return rows
 
     def _apply_slot_updates(self, rows: list[np.ndarray]) -> None:
@@ -1775,10 +2076,55 @@ class DecodeEngine:
                 )
             self._pending_count_restore.clear()
 
+    def _publish_prefix(
+        self,
+        full_ids: list[int],
+        pages: list[int],
+        versions: list[int],
+        pos: int,
+    ) -> None:
+        """Publish a request's full KV pages into the radix tree. Only pages
+        strictly below ``pos`` are publishable (the page holding ``pos``
+        still takes decode writes — possibly from an in-flight chunk).
+        Under the default flush-on-commit policy, pages stamped with an
+        older policy version are stale and the publishable prefix truncates
+        at the first one (prefixes cannot have holes)."""
+        if self._radix is None:
+            return
+        psz = self.config.page_size
+        n_pub = min(pos // psz, len(pages), len(full_ids) // psz)
+        policy = getattr(
+            getattr(self.config, "prefix_cache", None), "across_updates", "flush"
+        )
+        if policy == "flush":
+            k = 0
+            while k < n_pub and versions[k] == self._version:
+                k += 1
+            n_pub = k
+        if n_pub <= 0:
+            return
+        adopted = self._radix.insert(
+            full_ids[: n_pub * psz], pages[:n_pub], versions[:n_pub]
+        )
+        if adopted:
+            self._obs_pc.inserted_pages.inc(adopted)
+
     def _finish(self, task: _Task, reason: str) -> None:
         if task.slot >= 0:
             self._slot_task[task.slot] = None
             self._state["active"][task.slot] = False
+            if reason != StopReason.ABORT.value:
+                # completed requests publish their prompt+output pages into
+                # the radix tree BEFORE the pool.free below — the tree's
+                # own refs keep published pages alive. Aborts don't publish
+                # here: parked rids publish in _abort_all (and keep page
+                # ownership), preemptions exist to free memory.
+                self._publish_prefix(
+                    list(task.req.input_ids) + list(task.out_tokens),
+                    self._slot_pages[task.slot],
+                    self._slot_page_versions[task.slot],
+                    int(self._state["pos"][task.slot]),
+                )
             # release KV pages (a parked rid already transferred ownership
             # to its _Parked entry, leaving this list empty). Zeroing the
             # block-table row makes any in-flight chunk's stale write for
@@ -1786,6 +2132,7 @@ class DecodeEngine:
             # owner's prefill fully rewrites before reading.
             self.pool.free(self._slot_pages[task.slot])
             self._slot_pages[task.slot] = []
+            self._slot_page_versions[task.slot] = []
             self._pt_host[task.slot] = 0
         resp = ModelResponse(
             input_tokens=list(task.req.input_ids),
@@ -1820,14 +2167,24 @@ class DecodeEngine:
                     # prompt+emitted after continue_generation); page
                     # ownership moves to the parked entry so _finish below
                     # doesn't free them
-                    self._parked[rid] = _Parked(
+                    p = _Parked(
                         slot=slot,
                         full_ids=list(task.req.input_ids) + list(task.out_tokens),
                         pos=int(st["pos"][slot]),
                         pages=self._slot_pages[slot],
+                        page_versions=list(self._slot_page_versions[slot]),
                         n_emitted=len(task.out_tokens),
                     )
+                    self._parked[rid] = p
+                    # park-time publication: if this parking is later
+                    # evicted (or the rid resubmits with EXTENDED content —
+                    # a multi-turn episode's next turn), the radix tree
+                    # still serves the prior turns' pages
+                    self._publish_prefix(
+                        p.full_ids, p.pages, p.page_versions, p.pos
+                    )
                     self._slot_pages[slot] = []
+                    self._slot_page_versions[slot] = []
                     self._pt_host[slot] = 0
                 if st["active"][slot]:
                     deact.append(slot)
@@ -1863,7 +2220,7 @@ class DecodeEngine:
             pages = self._slot_pages[slot]
             while len(pages) < need:
                 got = self.pool.alloc(need - len(pages))
-                if got is None and self._evict_oldest_parked() is not None:
+                if got is None and self._reclaim_pages(need - len(pages)):
                     continue
                 if got is None:
                     victim = self._preempt_victim()
@@ -1893,6 +2250,9 @@ class DecodeEngine:
                     continue
                 self._pt_host[slot, len(pages) : len(pages) + len(got)] = got
                 pages.extend(got)
+                self._slot_page_versions[slot].extend(
+                    [self._version] * len(got)
+                )
         if deact_rows:
             self._apply_slot_updates(deact_rows)
         if clamp_rows:
@@ -2066,6 +2426,7 @@ class DecodeEngine:
         pending: dict | None = None
         while not self._shutdown.is_set():
             self._apply_weight_update()
+            self._service_radix_flush()
             if self._paused.is_set():
                 self._drain(pending)
                 pending = None

@@ -80,6 +80,212 @@ class PagePool:
                 self._free.append(p)
 
 
+class _RadixNode:
+    """One full page of cached prompt KV: ``key`` is the page's token-id
+    tuple (length = page_size), ``page`` the pool page holding its KV, and
+    ``version`` the policy version the KV was computed under (stamped at
+    allocation; a page whose rows span a weight commit keeps the OLDER
+    stamp, so staleness checks stay conservative)."""
+
+    __slots__ = ("key", "page", "version", "children", "parent", "last_access")
+
+    def __init__(self, key, page, version, parent, tick):
+        self.key = key
+        self.page = page
+        self.version = version
+        self.parent = parent
+        self.children: dict[tuple, _RadixNode] = {}
+        self.last_access = tick
+
+
+class RadixPrefixCache:
+    """Cross-request prefix cache over the refcounted page pool.
+
+    A radix tree keyed on token ids at PAGE granularity: every node is one
+    full page (``page_size`` tokens), children keyed by the next page's
+    token tuple — so the longest cached prefix of any prompt is a plain
+    walk, with no edge-splitting (prefixes are page-aligned by
+    construction; the decode head's write page is never published). This is
+    the cross-request generalization of the engine's GRPO same-prompt
+    aliasing — the role SGLang's RadixAttention plays for the reference.
+
+    Ownership: the tree holds ONE pool reference per node page (taken at
+    ``insert``, released at evict/flush). Matched pages are aliased by the
+    caller with its own ``pool.ref`` — so eviction/flush never invalidates
+    a live slot, it only drops the tree's claim.
+
+    LRU: a monotonic tick (not wall clock) stamps every matched/inserted
+    path; eviction removes least-recently-used LEAVES only, so an interior
+    node can never be removed while live children still chain through it.
+
+    Not thread-safe — the decode loop is the only caller (same contract as
+    PagePool).
+    """
+
+    def __init__(self, pool: PagePool, page_size: int, max_pages: int):
+        assert page_size > 0 and max_pages >= 0
+        self.pool = pool
+        self.page_size = page_size
+        self.max_pages = max_pages
+        self.root = _RadixNode((), -1, -1, None, 0)
+        self._n_pages = 0
+        self._tick = 0
+        # structural stats only: HIT accounting (hits/hit_tokens) belongs
+        # to the caller, which can de-duplicate retried lookups for the
+        # same admission (a backlogged task re-matches every wave)
+        self.stats = {
+            "lookups": 0,
+            "inserts": 0,
+            "inserted_pages": 0,
+            "evicted_pages": 0,
+            "flushes": 0,
+        }
+
+    @property
+    def pages_held(self) -> int:
+        return self._n_pages
+
+    def _touch(self) -> int:
+        self._tick += 1
+        return self._tick
+
+    def match(
+        self, ids, max_pages: int | None = None
+    ) -> tuple[list[int], list[int]]:
+        """Longest cached page-aligned prefix of ``ids``.
+
+        Returns (pages, versions), one entry per matched page. ``max_pages``
+        caps the walk (callers pass ``(plen-1)//page_size`` so the page the
+        decode head writes into is never aliased). The caller must take its
+        own pool refs on the returned pages before using them."""
+        psz = self.page_size
+        tick = self._touch()
+        self.stats["lookups"] += 1
+        node = self.root
+        pages: list[int] = []
+        versions: list[int] = []
+        limit = len(ids) // psz
+        if max_pages is not None:
+            limit = min(limit, max_pages)
+        for i in range(limit):
+            key = tuple(ids[i * psz : (i + 1) * psz])
+            child = node.children.get(key)
+            if child is None:
+                break
+            child.last_access = tick
+            pages.append(child.page)
+            versions.append(child.version)
+            node = child
+        return pages, versions
+
+    def insert(self, ids, pages, versions) -> int:
+        """Publish full prompt pages: one node per page of ``ids``
+        (``len(pages)`` pages; ids beyond ``len(pages) * page_size`` are
+        ignored). Existing nodes keep their page (the caller's duplicate
+        page follows its normal free path); NEW nodes take a tree-owned
+        ``pool.ref`` on the caller's page. Returns pages newly adopted.
+
+        Capacity: before adopting beyond ``max_pages``, LRU leaves evict —
+        excluding this very insertion path (evicting the chain's own tail
+        would detach everything chained below it, leaking the pages); if
+        nothing else is evictable, the remaining suffix is simply not
+        published."""
+        psz = self.page_size
+        tick = self._touch()
+        node = self.root
+        adopted = 0
+        path_ids: set[int] = set()
+        for i, page in enumerate(pages):
+            key = tuple(ids[i * psz : (i + 1) * psz])
+            if len(key) < psz:
+                break
+            child = node.children.get(key)
+            if child is None:
+                if self._n_pages >= self.max_pages:
+                    self.evict(
+                        self._n_pages - self.max_pages + 1, _exclude=path_ids
+                    )
+                if self._n_pages >= self.max_pages:
+                    break
+                child = _RadixNode(key, page, versions[i], node, tick)
+                node.children[key] = child
+                self.pool.ref([page])
+                self._n_pages += 1
+                adopted += 1
+            else:
+                child.last_access = tick
+            node = child
+            path_ids.add(id(node))
+        if adopted:
+            self.stats["inserts"] += 1
+            self.stats["inserted_pages"] += adopted
+        return adopted
+
+    def _leaves(self) -> list[_RadixNode]:
+        out = []
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            else:
+                out.append(n)
+        return out
+
+    def evict(self, n_pages: int, _exclude: set[int] | None = None) -> int:
+        """Free up to ``n_pages`` tree-held pages, LRU leaves first. A
+        parent becomes evictable only once all its children are gone —
+        interior nodes are never removed out from under live children.
+        ``_exclude``: node ids an in-progress insert is chaining through
+        (its own path must never be evicted from under it).
+
+        One DFS builds a leaf min-heap; a parent enters the heap the
+        moment its last child is removed — so a multi-page reclaim is
+        O(tree + evicted·log leaves), not one full traversal per page."""
+        import heapq
+
+        def allowed(n: _RadixNode) -> bool:
+            return _exclude is None or id(n) not in _exclude
+
+        heap = [
+            (n.last_access, id(n), n) for n in self._leaves() if allowed(n)
+        ]
+        heapq.heapify(heap)
+        freed = 0
+        while freed < n_pages and heap:
+            _, _, victim = heapq.heappop(heap)
+            parent = victim.parent
+            self._remove_leaf(victim)
+            freed += 1
+            if parent is not self.root and not parent.children and allowed(parent):
+                heapq.heappush(heap, (parent.last_access, id(parent), parent))
+        self.stats["evicted_pages"] += freed
+        return freed
+
+    def _remove_leaf(self, node: _RadixNode) -> None:
+        assert not node.children, "evicting an interior node would orphan children"
+        del node.parent.children[node.key]
+        self.pool.free([node.page])
+        self._n_pages -= 1
+
+    def flush(self) -> int:
+        """Drop every node (the across-updates "flush" policy at weight
+        commit: cached KV is stale under the new policy). Pages also aliased
+        by live slots survive in the pool until those slots free them."""
+        freed = 0
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            self.pool.free([n.page])
+            freed += 1
+        self.root.children.clear()
+        self._n_pages = 0
+        self.stats["flushes"] += 1
+        self.stats["evicted_pages"] += freed
+        return freed
+
+
 # int8 KV quantization convention — matches the library paged-attention
 # kernel's quantization_utils (scales = max|x| over head_dim, q = rint(
 # x * 127.5 / scale)), so quantized pages feed the TPU kernel directly as

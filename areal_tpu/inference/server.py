@@ -73,6 +73,7 @@ class InferenceServer:
         self.host = config.host
         self._metrics = catalog.server_metrics()
         self._engine_obs = catalog.engine_metrics()
+        self._pc_obs = catalog.prefix_cache_metrics()
         self._started_at = time.time()
         self._update_begin_ts: float | None = None
 
@@ -102,6 +103,7 @@ class InferenceServer:
                 web.post("/set_version", self.h_set_version),
                 web.post("/release_memory_occupation", self.h_release_memory),
                 web.post("/resume_memory_occupation", self.h_resume_memory),
+                web.post("/flush_prefix_cache", self.h_flush_prefix_cache),
                 web.post("/abort_request", self.h_noop),
             ]
         )
@@ -128,6 +130,9 @@ class InferenceServer:
             self._engine_obs.batch_occupancy.set(
                 sum(1 for t in slots if t is not None)
             )
+        pc = getattr(self.engine, "prefix_cache_stats", None)
+        if pc is not None:
+            self._pc_obs.pages_held.set(float(pc().get("pages_held", 0)))
 
     async def h_metrics(self, request: web.Request) -> web.Response:
         """Content-negotiated metrics.
@@ -155,18 +160,32 @@ class InferenceServer:
         return web.json_response(out)
 
     async def h_statusz(self, request: web.Request) -> web.Response:
-        """Human/ops summary: identity, uptime, version, live state."""
+        """Human/ops summary: identity, uptime, version, live state. The
+        ``stats`` section carries every decode-loop counter (prefills,
+        prefill_batches, chunks, prefix-cache hit/miss, ...); the
+        ``prefix_cache`` section is the radix tree's own live state."""
         self._refresh_gauges()
-        return web.json_response(
-            {
-                "role": "inference_server",
-                "address": self.address,
-                "uptime_secs": time.time() - self._started_at,
-                "version": self.engine.get_version(),
-                "paused": self.engine.is_paused,
-                "stats": dict(self.engine.stats),
-            }
-        )
+        out = {
+            "role": "inference_server",
+            "address": self.address,
+            "uptime_secs": time.time() - self._started_at,
+            "version": self.engine.get_version(),
+            "paused": self.engine.is_paused,
+            "stats": dict(self.engine.stats),
+        }
+        pc = getattr(self.engine, "prefix_cache_stats", None)
+        if pc is not None:
+            out["prefix_cache"] = pc()
+        return web.json_response(out)
+
+    async def h_flush_prefix_cache(self, request: web.Request) -> web.Response:
+        """Ops escape hatch: drop every radix-cached page (e.g. before an
+        A/B window, or to reclaim pool headroom without a weight update)."""
+        flush = getattr(self.engine, "flush_prefix_cache", None)
+        if flush is None:
+            return web.json_response({"status": "ok", "freed_pages": 0})
+        freed = await asyncio.get_running_loop().run_in_executor(None, flush)
+        return web.json_response({"status": "ok", "freed_pages": int(freed)})
 
     async def h_generate(self, request: web.Request) -> web.Response:
         # trace context rides x-areal-trace from the rollout client so this

@@ -939,6 +939,102 @@ def forward_prefill(
     return hidden, ks, vs
 
 
+def forward_prefill_paged(
+    params: dict,
+    cfg: ModelConfig,
+    input_ids: jax.Array,  # [A, B] suffix tokens (page-aligned start)
+    positions: jax.Array,  # [A, B] ABSOLUTE rope positions (prefix_len + i)
+    seg: jax.Array,  # [A, B] 1=valid 0=pad
+    cache: dict,  # k/v [n_layers, KH, n_pages, psz, hd] (+ scales under int8)
+    page_table: jax.Array,  # [A, wp] int32 pages holding the cached prefix
+    prefix_lens: jax.Array,  # [A] int32 tokens cached (page-aligned; 0 = none)
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Suffix-only prefill over a radix-cached prefix: like
+    ``forward_prefill`` but each row's queries additionally attend over its
+    cached prefix pages (gathered from the paged cache), so only the
+    NON-cached suffix pays prefill FLOPs. Returns (hidden, ks, vs) for the
+    suffix positions only — the caller scatters them into fresh pages; the
+    prefix pages are read, never written (aliased, possibly shared).
+
+    XLA-only path (gather + grouped einsum, the same numerics as
+    ``paged_attention_xla``): prefill is compute-bound, so the gathered
+    prefix costs one extra HBM read per layer, not a kernel.
+    """
+    x = _embed_lookup(params["embed"], input_ids, cfg.jax_dtype, batch_sharded=False)
+    suf_mask = _attention_mask(seg)  # [A, 1, B, B] causal-within-suffix
+    H, KH, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    G = H // KH
+    A, B = input_ids.shape
+    wp = page_table.shape[1]
+    psz = cache["k"].shape[3]
+    W = wp * psz
+    kv_quant = "k_scale" in cache
+    # prefix columns valid below each row's cached length; padded suffix
+    # rows (seg == 0) attend nowhere in the prefix block
+    pre_valid = (
+        (jnp.arange(W)[None, :] < prefix_lens[:, None])[:, None, :]
+        & (seg != 0)[:, :, None]
+    )  # [A, B, W]
+
+    def gather(name, li):
+        lay = jax.lax.dynamic_index_in_dim(cache[name], li, 0, keepdims=False)
+        # [KH, A, wp, psz, d] -> [A, W, KH, d]
+        g = jnp.transpose(lay[:, page_table], (1, 2, 3, 0, 4))
+        return g.reshape(A, W, KH, g.shape[-1])
+
+    def body(x, scanned):
+        layer, li = scanned
+        h = _rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+        q = _proj(cfg, layer, "wq", h)
+        k = _proj(cfg, layer, "wk", h)
+        v = _proj(cfg, layer, "wv", h)
+        if cfg.attention_bias:
+            q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+        q = q.reshape(A, B, H, hd)
+        k = k.reshape(A, B, KH, hd)
+        v = v.reshape(A, B, KH, hd)
+        if cfg.qk_norm:
+            q = _rms_norm(q, layer["q_norm"], cfg.rms_norm_eps)
+            k = _rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        k_cache, v_cache = k, v
+        kp = gather("k", li)  # [A, W, KH, hd]
+        vp = gather("v", li)
+        if kv_quant:
+            from areal_tpu.inference.paged_kv import dequantize_kv
+
+            kp = dequantize_kv(kp, gather("k_scale", li), q.dtype)
+            vp = dequantize_kv(vp, gather("v_scale", li), q.dtype)
+        # GQA repeat + concat(prefix, suffix) along the KV length, then the
+        # same batched-matmul einsum layout as sdpa_xla — grouped 5D
+        # einsums with split batch axes lower an order of magnitude slower
+        if KH != H:
+            kp = jnp.repeat(kp, G, axis=2)
+            vp = jnp.repeat(vp, G, axis=2)
+            k_r = jnp.repeat(k, G, axis=2)
+            v_r = jnp.repeat(v, G, axis=2)
+        else:
+            k_r, v_r = k, v
+        k_full = jnp.concatenate([kp, k_r], axis=1)  # [A, W + B, H, hd]
+        v_full = jnp.concatenate([vp, v_r], axis=1)
+        mask = jnp.concatenate(
+            [pre_valid[:, None], suf_mask], axis=-1
+        )  # [A, 1, B, W + B]
+        attn = _sdpa(q, k_full, v_full, mask, hd).reshape(A, B, H * hd)
+        x = x + _proj(cfg, layer, "wo", attn)
+        h = _rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + _ffn(cfg, h, layer)
+        return x, (k_cache, v_cache)
+
+    n_layers = cfg.num_layers
+    x, (ks, vs) = jax.lax.scan(
+        body, x, (params["layers"], jnp.arange(n_layers))
+    )
+    hidden = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return hidden, ks, vs
+
+
 def forward_decode_paged(
     params: dict,
     cfg: ModelConfig,

@@ -127,12 +127,49 @@ def engine_metrics(reg: Registry | None = None) -> SimpleNamespace:
         prefills=r.counter(
             "areal_decode_prefills_total", "Sequences prefilled."
         ),
+        prefill_tokens=r.counter(
+            "areal_decode_prefill_tokens_total",
+            "Prompt tokens actually prefilled (radix-cached prefix tokens "
+            "excluded — the denominator's complement for prefix hit rate).",
+        ),
         chunks=r.counter(
             "areal_decode_chunks_total", "Jitted decode chunks executed."
         ),
         batch_occupancy=r.gauge(
             "areal_decode_batch_occupancy",
             "Active decode slots (of ServerConfig.max_batch_size).",
+        ),
+    )
+
+
+def prefix_cache_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """Cross-request radix prefix cache over the paged KV pool
+    (inference/paged_kv.py RadixPrefixCache): prompt-KV reuse visibility.
+    Hit rate = hit_tokens / (hit_tokens + areal_decode_prefill_tokens_total)."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        lookups=r.counter(
+            "areal_prefix_cache_lookups_total",
+            "Radix-cache prefix lookups at admission.",
+        ),
+        hit_tokens=r.counter(
+            "areal_prefix_cache_hit_tokens_total",
+            "Prompt tokens served from radix-cached KV pages instead of "
+            "prefill (page refcount bumps, zero FLOPs).",
+        ),
+        inserted_pages=r.counter(
+            "areal_prefix_cache_inserted_pages_total",
+            "KV pages published into the radix tree at request "
+            "completion/park time.",
+        ),
+        evicted_pages=r.counter(
+            "areal_prefix_cache_evicted_pages_total",
+            "Radix-cached pages released (LRU-leaf eviction under pool "
+            "pressure, capacity eviction, or flush at a weight commit).",
+        ),
+        pages_held=r.gauge(
+            "areal_prefix_cache_pages_held",
+            "KV pages currently owned by the radix tree.",
         ),
     )
 
@@ -346,6 +383,7 @@ ALL_FACTORIES = (
     staleness_metrics,
     executor_metrics,
     engine_metrics,
+    prefix_cache_metrics,
     server_metrics,
     client_metrics,
     rpc_metrics,

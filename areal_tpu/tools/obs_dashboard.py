@@ -37,6 +37,7 @@ _ROWS = (
     ("areal_decode_batch_occupancy", "batch occupancy"),
     ("areal_server_paused", "paused servers"),
     ("areal_weight_update_total", "weight updates"),
+    ("areal_prefix_cache_pages_held", "prefix-cache pages"),
 )
 
 
@@ -84,6 +85,14 @@ def render_frame(
         v = _merged_value(snap, name)
         if v is not None:
             lines.append(f"{label:<24} {_fmt(v):>12}")
+    # fleet-level prefix reuse: tokens served from radix-cached KV over all
+    # prompt tokens admitted (cached + actually prefilled)
+    hit_tok = _merged_value(snap, "areal_prefix_cache_hit_tokens_total")
+    pf_tok = _merged_value(snap, "areal_decode_prefill_tokens_total")
+    if hit_tok is not None and pf_tok is not None and (hit_tok + pf_tok) > 0:
+        lines.append(
+            f"{'prefix hit rate':<24} {hit_tok / (hit_tok + pf_tok):>11.1%}"
+        )
     pause_sum = _merged_value(snap, "areal_weight_update_pause_seconds_sum")
     pause_cnt = _merged_value(snap, "areal_weight_update_pause_seconds_count")
     if pause_sum is not None and pause_cnt:
@@ -143,6 +152,12 @@ areal_decode_generated_tokens_total 1234
 # HELP areal_server_paused 1 while generation is paused.
 # TYPE areal_server_paused gauge
 areal_server_paused 0
+# HELP areal_prefix_cache_hit_tokens_total Tokens served from cached KV.
+# TYPE areal_prefix_cache_hit_tokens_total counter
+areal_prefix_cache_hit_tokens_total 800
+# HELP areal_decode_prefill_tokens_total Prompt tokens prefilled.
+# TYPE areal_decode_prefill_tokens_total counter
+areal_decode_prefill_tokens_total 200
 # HELP areal_weight_update_pause_seconds Availability gap per update.
 # TYPE areal_weight_update_pause_seconds histogram
 areal_weight_update_pause_seconds_bucket{le="1"} 2
@@ -199,6 +214,11 @@ def self_test() -> int:
                 f"dead target stalled the round ({elapsed:.1f}s)",
             ),
             ("staleness capacity" in frame, "frame missing capacity row"),
+            (
+                "prefix hit rate" in frame and "80.0%" in frame,
+                "frame missing prefix hit-rate row (800/(800+200) per "
+                "target merges to the same 80% ratio)",
+            ),
             ("update pause (mean s)" in frame, "frame missing pause row"),
             ("DOWN  127.0.0.1:1" in frame, "frame missing down-target row"),
         ]

@@ -13,8 +13,14 @@ layer for CI.
 zero-pause property (docs/weight_sync.md): the commit fence is >= 5x
 smaller than the unpaused staging window and no in-flight request aborts.
 
+``--prefix-cache-self-test`` runs the shared-prefix workload of
+``tools/bench_prefix_cache`` and asserts cross-request radix reuse: a warm
+admission wave prefills only suffix tokens at >= 2x the cold prefill
+throughput, refcounts return to baseline, and a weight commit under the
+default policy leaves no stale-version pages matchable.
+
 Usage: python -m areal_tpu.tools.validate_installation [--tpu]
-    [--chaos-self-test] [--weight-sync-self-test]
+    [--chaos-self-test] [--weight-sync-self-test] [--prefix-cache-self-test]
 """
 
 from __future__ import annotations
@@ -46,6 +52,14 @@ def main(argv=None) -> int:
         help="run streamed weight updates against a 2-replica local fleet "
         "under live generation load and assert the zero-pause property "
         "(commit fence >= 5x smaller than the staging window, no aborts)",
+    )
+    p.add_argument(
+        "--prefix-cache-self-test",
+        action="store_true",
+        help="run the shared-prefix workload (tools/bench_prefix_cache) and "
+        "assert radix reuse: warm admission prefills suffixes only at >= 2x "
+        "cold throughput, zero refcount leaks, and a weight commit leaves "
+        "no stale pages matchable",
     )
     args = p.parse_args(argv)
     results: list[tuple[str, bool, str]] = []
@@ -152,6 +166,15 @@ def main(argv=None) -> int:
             return self_test()
 
         _check("weight_sync", weight_sync, results)
+
+    if args.prefix_cache_self_test:
+
+        def prefix_cache():
+            from areal_tpu.tools.bench_prefix_cache import self_test
+
+            return self_test()
+
+        _check("prefix_cache", prefix_cache, results)
 
     width = max(len(n) for n, _, _ in results)
     ok = True

@@ -36,29 +36,40 @@ import threading
 import time
 
 BASELINE_TOK_S_PER_CHIP = 4300.0
-# worst-case sum (probe + probe-retry + all phases) must stay under the
-# driver's ~25-min capture window even if every phase hits its deadline —
-# the startup assert below enforces it (ADVICE r02 #3)
+# worst-case sum (probe + short probe-retry + all phases) must stay under
+# the driver's ~25-min capture window even if every phase hits its deadline
+# — the startup assert below enforces it (ADVICE r02 #3).
+#
+# Probe sizing (BENCH_r03/r04/r05 postmortem): the first device claim +
+# warm-up compile on a cold axon lease has repeatedly outlived 90-120 s
+# (heartbeats healthy the whole way — slow, not dead), killing the probe
+# and zeroing the whole report. The probe now gets the long deadline the
+# claim actually needs, emits its payload BEFORE the warm-up matmul (a
+# wedged compile can no longer erase the device count), and the retry —
+# which only exists for the fast-failure case — runs short: if the first
+# probe burned its full deadline, a second full-length claim attempt would
+# just burn capture window on the same wedge.
 PHASE_DEADLINE_S = {
-    "probe": 90.0,
-    "decode": 390.0,
-    "longctx": 210.0,
-    "train": 270.0,
-    "async_sync": 360.0,
+    "probe": 300.0,
+    "decode": 330.0,
+    "longctx": 180.0,
+    "train": 240.0,
+    "async_sync": 300.0,
 }
+PROBE_RETRY_DEADLINE_S = 60.0
 _CAPTURE_WINDOW_S = 1500.0
 _OVERHEAD_ALLOWANCE_S = 90.0  # probe retry sleep, process spawn, parent work
 assert (
     sum(PHASE_DEADLINE_S.values())
-    + PHASE_DEADLINE_S["probe"]  # one retry
+    + PROBE_RETRY_DEADLINE_S
     + _OVERHEAD_ALLOWANCE_S
     <= _CAPTURE_WINDOW_S
 ), "phase deadlines no longer fit the driver capture window"
 # in-phase budget for the decode wait loops (< the external deadline minus
 # setup ~80s + warmup + emit slack, so the partial-result path can fire
 # before the parent SIGKILLs us)
-DECODE_WAIT_S = 240.0
-LONGCTX_WAIT_S = 140.0
+DECODE_WAIT_S = 150.0
+LONGCTX_WAIT_S = 100.0
 _PHASE_START = time.monotonic()  # reset per child in _run_phase_child
 
 # Qwen2.5-1.5B dimensions (config.json of Qwen/Qwen2.5-1.5B)
@@ -176,21 +187,28 @@ def _start_heartbeat(phase: str):
 
 
 def phase_probe():
-    """Fast TPU backend sanity check: import jax, list devices, tiny matmul."""
+    """TPU backend sanity check: import jax, list devices, tiny matmul.
+
+    The payload emits RIGHT AFTER the device claim, BEFORE the warm-up
+    matmul: the first compile on a cold lease can outlive any reasonable
+    deadline, and the parent keeps the last parseable BENCH_PHASE line —
+    so a wedged warm-up downgrades to ``warm: false`` instead of erasing
+    the device count and zeroing the whole report."""
     import jax
     import jax.numpy as jnp
 
     devs = jax.devices()
+    payload = {
+        "phase": "probe",
+        "platform": jax.default_backend(),
+        "n_devices": len(devs),
+        "warm": False,
+    }
+    _emit_phase(payload)
     x = jnp.ones((256, 256), jnp.bfloat16)
     y = (x @ x).block_until_ready()
     del y
-    _emit_phase(
-        {
-            "phase": "probe",
-            "platform": jax.default_backend(),
-            "n_devices": len(devs),
-        }
-    )
+    _emit_phase({**payload, "warm": True})
 
 
 def phase_decode():
@@ -822,6 +840,11 @@ class _PhaseDeadline(BaseException):
 def _run_phase_child(name: str) -> int:
     global _PHASE_START
     _PHASE_START = time.monotonic()
+    # a parent-overridden deadline (the short probe retry) rides the env so
+    # the in-child alarm stays ahead of the parent's SIGKILL
+    deadline = float(
+        os.environ.get("BENCH_PHASE_DEADLINE") or PHASE_DEADLINE_S[name]
+    )
     hb = _start_heartbeat(name)
     # graceful in-child deadline 25s BEFORE the parent's SIGKILL: a cleanly
     # exiting process tears down its PJRT client and releases the remote TPU
@@ -830,10 +853,10 @@ def _run_phase_child(name: str) -> int:
     # hang tunnel-wide). SIGALRM only interrupts Python bytecode, so a call
     # wedged inside the runtime still needs the parent's SIGKILL backstop.
     def on_alarm(signum, frame):
-        raise _PhaseDeadline(f"in-child deadline (parent kills at {PHASE_DEADLINE_S[name]:.0f}s)")
+        raise _PhaseDeadline(f"in-child deadline (parent kills at {deadline:.0f}s)")
 
     signal.signal(signal.SIGALRM, on_alarm)
-    signal.alarm(max(10, int(PHASE_DEADLINE_S[name] - 25)))
+    signal.alarm(max(10, int(deadline - 25)))
     try:
         # backend-gated persistent compile cache (repo .jax_cache): imports
         # jax, so it must run AFTER the alarm is armed — a wedged device
@@ -863,10 +886,12 @@ def _run_phase_child(name: str) -> int:
 # --------------------------------------------------------------------------
 
 
-def _spawn_phase(name: str) -> dict:
-    """Run one phase in a subprocess under a hard deadline. Returns the
-    BENCH_PHASE payload, or {"phase": name, "error": ...}."""
-    deadline = PHASE_DEADLINE_S[name]
+def _spawn_phase(name: str, deadline: float | None = None) -> dict:
+    """Run one phase in a subprocess under a hard deadline (default: the
+    phase's PHASE_DEADLINE_S entry). Returns the BENCH_PHASE payload, or
+    {"phase": name, "error": ...}."""
+    if deadline is None:
+        deadline = PHASE_DEADLINE_S[name]
     log(f"[parent] starting phase {name} (deadline {deadline:.0f}s)")
     proc = subprocess.Popen(
         [sys.executable, "-u", os.path.abspath(__file__), "--phase", name],
@@ -875,6 +900,7 @@ def _spawn_phase(name: str) -> dict:
         text=True,
         start_new_session=True,
         cwd=os.path.dirname(os.path.abspath(__file__)),
+        env={**os.environ, "BENCH_PHASE_DEADLINE": str(deadline)},
     )
     payload = {"phase": name, "error": f"no BENCH_PHASE line (deadline {deadline}s)"}
     timer_fired = threading.Event()
@@ -940,11 +966,15 @@ def main():
     try:
         probe = _spawn_phase("probe")
         if "error" in probe:
-            # one retry: a previous aborted run can leave the TPU client
-            # wedged; a fresh process occasionally recovers after teardown
-            log("[parent] probe failed; retrying once")
+            # one SHORT retry: a previous aborted run can leave the TPU
+            # client wedged; a fresh process occasionally recovers after
+            # teardown. The first attempt already had the full claim-length
+            # deadline, so a quick confirmation is all the retry buys —
+            # burning another full deadline on the same wedge would eat the
+            # capture window the cached-phase fallbacks need.
+            log("[parent] probe failed; retrying once (short)")
             time.sleep(10)
-            probe = _spawn_phase("probe")
+            probe = _spawn_phase("probe", deadline=PROBE_RETRY_DEADLINE_S)
         if "error" in probe:
             errors["probe"] = probe["error"]
         else:

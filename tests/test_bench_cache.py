@@ -54,7 +54,7 @@ def test_main_falls_back_to_cached_phases(cache_dir, monkeypatch, capsys):
     _seed(cache_dir, "decode", {"phase": "decode", "tok_s": 6696.5})
     _seed(cache_dir, "train", {"phase": "train", "tok_s": 5814.6})
 
-    def fake_spawn(name):
+    def fake_spawn(name, deadline=None):
         return {"phase": name, "error": "phase killed at deadline"}
 
     monkeypatch.setattr(bench, "_spawn_phase", fake_spawn)
@@ -86,7 +86,9 @@ def test_cached_chip_count_divides_the_pipeline(cache_dir, monkeypatch, capsys):
     _seed(cache_dir, "decode", {"phase": "decode", "tok_s": 8000.0}, n_chips=4)
     _seed(cache_dir, "train", {"phase": "train", "tok_s": 8000.0}, n_chips=4)
     monkeypatch.setattr(
-        bench, "_spawn_phase", lambda name: {"phase": name, "error": "wedged"}
+        bench,
+        "_spawn_phase",
+        lambda name, deadline=None: {"phase": name, "error": "wedged"},
     )
     bench.main()
     line = [
@@ -97,10 +99,43 @@ def test_cached_chip_count_divides_the_pipeline(cache_dir, monkeypatch, capsys):
     assert out["value"] == pytest.approx(1000.0, abs=0.5)
 
 
+def test_probe_retry_runs_short_and_skips_phases(cache_dir, monkeypatch):
+    """A probe that burned its full deadline gets ONE short confirmation
+    retry (not another full claim-length attempt), and a still-wedged
+    backend spawns no phases — the capture window goes to cache fallback."""
+    calls = []
+
+    def fake_spawn(name, deadline=None):
+        calls.append((name, deadline))
+        return {"phase": name, "error": "phase killed at deadline"}
+
+    monkeypatch.setattr(bench, "_spawn_phase", fake_spawn)
+    bench.main()
+    assert calls == [
+        ("probe", None),
+        ("probe", bench.PROBE_RETRY_DEADLINE_S),
+    ]
+
+
+def test_probe_emits_device_count_before_warmup(capsys, monkeypatch, tmp_path):
+    """The device count must hit stdout BEFORE the warm-up matmul: a
+    wedged first compile then downgrades to warm=false instead of killing
+    the probe (the r03/r04/r05 0.0-report failure mode)."""
+    monkeypatch.setattr(bench, "_PHASE_CACHE_DIR", str(tmp_path))
+    bench.phase_probe()
+    payloads = [
+        json.loads(ln[len("BENCH_PHASE "):])
+        for ln in capsys.readouterr().out.splitlines()
+        if ln.startswith("BENCH_PHASE ")
+    ]
+    assert payloads[0]["warm"] is False and payloads[0]["n_devices"] >= 1
+    assert payloads[-1]["warm"] is True
+
+
 def test_main_prefers_live_over_cache(cache_dir, monkeypatch, capsys):
     _seed(cache_dir, "decode", {"phase": "decode", "tok_s": 1.0})
 
-    def fake_spawn(name):
+    def fake_spawn(name, deadline=None):
         if name == "probe":
             return {"phase": "probe", "platform": "tpu", "n_devices": 1}
         if name == "decode":

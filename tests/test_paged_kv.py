@@ -116,6 +116,11 @@ def test_pool_pressure_preempts_and_recovers():
         assert any(r.stop_reason == "length" for r in results)
     finally:
         eng.stop()
+    # after all requests finish, the only pages still out are the radix
+    # tree's own (completed prompts publish their full pages); flushing the
+    # tree must drain the pool to zero — anything else is a refcount leak
+    assert eng.pool.used == eng.prefix_cache_stats().get("pages_held", 0)
+    eng.flush_prefix_cache()
     assert eng.pool.used == 0, "pages leaked after all requests finished"
 
 
@@ -141,6 +146,8 @@ def test_prefix_sharing_page_accounting():
         assert eng.stats["prefills"] < 4
     finally:
         eng.stop()
+    assert eng.pool.used == eng.prefix_cache_stats().get("pages_held", 0)
+    eng.flush_prefix_cache()
     assert eng.pool.used == 0
 
 
@@ -149,3 +156,86 @@ def test_budgeted_pool_sizes_from_hbm():
     eng = _engine(n_pages=4)
     dense_pages = 4 * (256 // 128) + 1
     assert eng.pool.n_pages == 4 < dense_pages
+
+
+# -- refcount safety under aliasing ----------------------------------------
+
+
+def test_double_free_of_aliased_page_asserts():
+    """Freeing past zero must assert even when the page was aliased along
+    the way (rc 1 -> 2 -> 1 -> 0 -> boom)."""
+    pool = PagePool(4)
+    (p,) = pool.alloc(1)
+    pool.ref([p])
+    pool.free([p])
+    pool.free([p])
+    assert pool.available == 3
+    with pytest.raises(AssertionError):
+        pool.free([p])
+
+
+def test_free_while_aliased_keeps_page_out_of_free_list():
+    """One owner freeing an aliased page must not recycle it under the
+    other owner: the page stays allocatable-to-nobody until rc hits 0."""
+    pool = PagePool(5)
+    a = pool.alloc(3)
+    pool.ref(a[:1])  # second owner of a[0]
+    pool.free(a)  # first owner drops all three
+    assert pool.used == 1  # a[0] survives at rc 1
+    got = pool.alloc(3)
+    assert got is not None and a[0] not in got, "aliased page was recycled"
+    pool.free(got)
+    pool.free(a[:1])
+    assert pool.used == 0
+
+
+def test_ref_of_unallocated_page_asserts():
+    pool = PagePool(4)
+    with pytest.raises(AssertionError):
+        pool.ref([2])  # never allocated
+
+
+def test_radix_evict_while_referenced_keeps_page_alive():
+    """Evicting a tree node whose page a live slot still references must
+    only drop the TREE's claim — the page stays out of the free list until
+    the slot frees it too."""
+    from areal_tpu.inference.paged_kv import RadixPrefixCache
+
+    pool = PagePool(8)
+    tree = RadixPrefixCache(pool, page_size=2, max_pages=4)
+    pages = pool.alloc(2)
+    tree.insert([1, 2, 3, 4], pages, [0, 0])
+    pool.free(pages)  # producer's own refs drop; tree keeps both alive
+    assert pool.used == 2
+    matched, _ = tree.match([1, 2, 3, 4])
+    assert matched == pages
+    pool.ref(matched)  # a slot aliases the cached pages
+    assert tree.evict(2) == 2  # pool pressure evicts both tree nodes
+    assert pool.used == 2, "slot-referenced pages must survive tree eviction"
+    pool.free(matched)  # the slot finishes
+    assert pool.used == 0
+
+
+def test_radix_interior_eviction_never_orphans_children():
+    """LRU eviction removes leaves only: an interior node with a live child
+    is not evictable, so a deep chain evicts bottom-up and a child's path
+    stays walkable until the child itself goes."""
+    from areal_tpu.inference.paged_kv import RadixPrefixCache
+
+    pool = PagePool(16)
+    tree = RadixPrefixCache(pool, page_size=2, max_pages=8)
+    # chain a-b-c plus a sibling branch a-d; the interior node a is OLDEST
+    # by access but must outlive both branches
+    pa = pool.alloc(3)
+    tree.insert([1, 2, 3, 4, 5, 6], pa, [0, 0, 0])
+    pd = pool.alloc(2)
+    tree.insert([1, 2, 9, 9], pd, [0, 0])  # shares node a = pages[0]
+    pool.free(pa)
+    pool.free(pd)
+    assert tree.pages_held == 4  # a, b, c, d (a shared)
+    assert tree.evict(1) == 1  # one LEAF went, never node a
+    m, _ = tree.match([1, 2])
+    assert m == [pa[0]], "interior node evaporated under a live child"
+    # evicting everything walks bottom-up and empties cleanly
+    assert tree.evict(10) == 3
+    assert tree.pages_held == 0 and pool.used == 0
