@@ -190,6 +190,60 @@ class OpenAIProxyConfig:
 
 
 @dataclass
+class RequestLifecycleConfig:
+    """Overload-safe serving: request deadlines, cancellation, admission
+    control, and progress watchdogs (docs/request_lifecycle.md).
+
+    One dataclass serves both sides of the wire. Client-side
+    (``InferenceEngineConfig.lifecycle``): ``default_deadline_s`` stamps a
+    deadline on every generation request that doesn't carry one, propagated
+    as the ``x-areal-deadline`` header (absolute unix-epoch seconds).
+    Server-side (``ServerConfig.lifecycle``): admission control rejects
+    with 429 + Retry-After when the queue or page pool is saturated, the
+    decode loop reaps deadline-expired slots between chunks (partial output
+    returned with ``truncated_by="deadline"``; KV pages freed or published
+    to the radix cache), and a per-slot watchdog aborts slots that stop
+    emitting tokens."""
+
+    enabled: bool = True
+    # client-side: deadline stamped on requests that carry none (seconds
+    # from submission; None = only explicit per-request deadlines apply)
+    default_deadline_s: float | None = None
+    # server-side admission control: reject /generate with 429 when the
+    # engine queue + backlog reaches this depth. 0 = unbounded (off).
+    max_queue_depth: int = 0
+    # free-page headroom gate: reject admission when free pool pages plus
+    # radix-reclaimable pages fall below this. 0 = off.
+    min_free_pages: int = 0
+    # Retry-After seconds returned with 429 rejections
+    retry_after_s: float = 1.0
+    # client-side: total wall-clock seconds a request keeps honoring 429
+    # Retry-After hints before giving up. Backpressure waits do NOT burn
+    # the bounded failure-retry attempts (a saturated-but-healthy fleet
+    # must not convert shedding into client exceptions and task strikes);
+    # this budget is what bounds them instead. 0 = fail on the first 429.
+    backpressure_wait_s: float = 30.0
+    # per-slot progress watchdog: an ACTIVE slot that emits no token for
+    # this long is aborted (pages freed, areal_slot_watchdog_fired_total).
+    # 0 = off. Generous values only — a legitimate decode chunk plus a
+    # weight-commit hold must always fit inside it.
+    watchdog_s: float = 0.0
+    # engine-wedge escalation: when the decode LOOP itself makes no pass
+    # for this long while work is pending, /health turns 503 ("wedged") so
+    # the client fleet probe / PR 3 supervision evicts and respawns the
+    # replica. 0 = off.
+    engine_stall_escalate_s: float = 0.0
+    # gateway load shedding (openai/proxy/gateway.py): total concurrent
+    # forwarded requests the gateway admits (0 = unbounded), and how many
+    # of those slots are RESERVED for interactive traffic — rollout-class
+    # requests (x-areal-priority: rollout) shed once
+    # max_inflight - interactive_headroom is reached, so a rollout flood
+    # can never starve interactive decode
+    gateway_max_inflight: int = 0
+    gateway_interactive_headroom: int = 0
+
+
+@dataclass
 class ChaosConfig:
     """Deterministic fault injection at the HTTP boundary (robustness/chaos.py).
 
@@ -206,6 +260,11 @@ class ChaosConfig:
     error_prob: float = 0.0  # synthetic 5xx (server reached, request failed)
     hang_prob: float = 0.0  # hold the request for hang_s (stuck server)
     hang_s: float = 2.0
+    # stall: hold the request for stall_s, then let it THROUGH (a slow but
+    # eventually-successful backend — the overload test's latency injector;
+    # unlike "hang" nothing raises, so retries don't mask it)
+    stall_prob: float = 0.0
+    stall_s: float = 0.5
     # only inject on paths starting with this prefix ("" = every path);
     # lets a test target /generate while leaving weight updates clean
     path_prefix: str = ""
@@ -302,6 +361,12 @@ class InferenceEngineConfig:
     # breaking + failover, supervision, task retry/quarantine, chaos knobs
     fault_tolerance: FaultToleranceConfig = field(
         default_factory=FaultToleranceConfig
+    )
+    # request lifecycle (docs/request_lifecycle.md): client-side deadline
+    # stamping + 429 backoff behavior; the server-side twin lives on
+    # ServerConfig.lifecycle
+    lifecycle: RequestLifecycleConfig = field(
+        default_factory=RequestLifecycleConfig
     )
 
 
@@ -404,6 +469,11 @@ class ServerConfig:
     # hold with a warning. Generous vs the intended one-commit-roundtrip
     # fence length.
     hold_fence_timeout_s: float = 30.0
+    # request lifecycle (docs/request_lifecycle.md): admission control,
+    # deadline reaping between decode chunks, per-slot progress watchdog
+    lifecycle: RequestLifecycleConfig = field(
+        default_factory=RequestLifecycleConfig
+    )
     # where streamed weight-update buckets stage while generation continues:
     # "device" = device_put on arrival (staging costs a 2nd copy of the
     #            weights in HBM until commit; the commit itself is a pointer

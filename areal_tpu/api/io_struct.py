@@ -44,6 +44,10 @@ class StopReason(str, enum.Enum):
     LENGTH = "length"  # max_new_tokens reached
     ABORT = "abort"  # interrupted (weight update in flight) — resumable
     TOOL_CALLS = "tool_calls"
+    # request-lifecycle terminals (docs/request_lifecycle.md) — NOT
+    # resumable: the client loop must not resubmit these
+    DEADLINE = "deadline"  # deadline expired; partial output returned
+    CANCEL = "cancelled"  # /abort_request (client gone / task failed)
 
 
 @dataclasses.dataclass
@@ -60,6 +64,11 @@ class ModelRequest:
     # (t, h, w) patch-grid shapes [n_images, 3] (drives the tower's 2-D rope)
     image_data: list[Any] | None = None
     image_grid_thw: list[Any] | None = None
+    # absolute unix-epoch deadline (seconds). Propagated end-to-end as the
+    # ``x-areal-deadline`` header; the decode loop reaps expired slots
+    # between chunks and returns the partial output with
+    # ``truncated_by="deadline"`` (docs/request_lifecycle.md).
+    deadline: float | None = None
 
 
 @dataclasses.dataclass
@@ -76,6 +85,11 @@ class ModelResponse:
     output_logprobs: list[float] = dataclasses.field(default_factory=list)
     output_versions: list[int] = dataclasses.field(default_factory=list)
     stop_reason: str = StopReason.STOP.value
+    # lifecycle truncation flag: "" (normal), "deadline" (reaped at its
+    # deadline between decode chunks), "watchdog" (no-progress abort), or
+    # "cancelled" (/abort_request). Partial tokens/logprobs/versions are
+    # still returned and stay per-token-version-consistent.
+    truncated_by: str = ""
     latency: float = 0.0
     ttft: float = 0.0
     rid: str = ""

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import random
+import threading
 import time
 from typing import Callable
 
@@ -114,6 +115,21 @@ class RemoteJaxEngine(InferenceEngine):
         )
         self._probe_thread = None
         self._probe_stop = None
+        self._lc_obs = catalog.lifecycle_metrics()
+        # request lifecycle: in-flight rids per workflow task, so a failed/
+        # quarantined task's outstanding generations can be cancelled
+        # server-side instead of orphaning slots (docs/request_lifecycle.md)
+        self._task_rids_lock = threading.Lock()
+        self._task_rids: dict[str, dict[str, str]] = {}  # task_id -> rid -> addr
+        # abort posts run off-thread through ONE small shared pool: a mass
+        # teardown (N coroutines cancelled at once) must not spawn N
+        # threads, and a quarantining dispatcher must not serially block on
+        # per-rid HTTP posts (threads spawn lazily on first submit)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._abort_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="abort-request"
+        )
 
     def install_fault_injector(self, injector: FaultInjector | None) -> None:
         """Chaos harness hook: every outgoing HTTP call passes the injector
@@ -190,6 +206,7 @@ class RemoteJaxEngine(InferenceEngine):
 
     def destroy(self) -> None:
         self.stop_fleet_probe()
+        self._abort_pool.shutdown(wait=False)
         if self._enc_pool is not None:
             self._enc_pool.shutdown(wait=True)
             self._enc_pool = None
@@ -206,8 +223,6 @@ class RemoteJaxEngine(InferenceEngine):
         """Daemon loop probing /health so replicas whose circuit tripped
         open rejoin rotation (and get re-synced) without waiting for the
         half-open window to be discovered by live traffic."""
-        import threading
-
         if self._probe_thread is not None:
             return
         stop = threading.Event()
@@ -303,6 +318,61 @@ class RemoteJaxEngine(InferenceEngine):
         return addr
 
     # -- generation -------------------------------------------------------
+    def _register_task_rid(self, rid: str, addr: str) -> str | None:
+        """Track this rid under the current workflow task (if any) so a
+        failed/quarantined task's in-flight generations can be cancelled
+        server-side. Returns the owning task_id (for deregistration)."""
+        if not rid:
+            return None
+        from areal_tpu.infra import workflow_context
+
+        task_id = workflow_context.get().task_id
+        if not task_id:
+            return None
+        with self._task_rids_lock:
+            self._task_rids.setdefault(task_id, {})[rid] = addr
+        return task_id
+
+    def _deregister_task_rid(self, task_id: str | None, rid: str) -> None:
+        if not task_id:
+            return
+        with self._task_rids_lock:
+            rids = self._task_rids.get(task_id)
+            if rids is not None:
+                rids.pop(rid, None)
+                if not rids:
+                    self._task_rids.pop(task_id, None)
+
+    def abort_request(self, rid: str, addr: str | None = None) -> None:
+        """Best-effort server-side cancellation of one rid: POST
+        /abort_request to the replica holding it (affinity), falling back
+        to a fleet-wide fan-out when the owner is unknown. Never raises —
+        cancellation is cleanup, not the primary path."""
+        if not rid:
+            return
+        targets = [addr or self._rid_affinity.get(rid)]
+        if targets[0] is None:
+            targets = list(self.addresses)
+        for a in targets:
+            try:
+                self._post_one_nofail(a, "/abort_request", {"rid": rid})
+            except Exception as e:  # noqa: BLE001 — replica may be dead;
+                # its slots die with it, so there is nothing to leak there
+                logger.debug(f"abort_request({rid}) on {a} failed: {e!r}")
+        self._rid_affinity.pop(rid, None)
+
+    def abort_task_requests(self, task_id: str) -> int:
+        """Cancel every in-flight generation a workflow task still owns
+        (WorkflowExecutor calls this when it quarantines the task as
+        poison). The posts run on the shared abort pool so the caller —
+        the executor's dispatch loop — never blocks on per-rid HTTP.
+        Returns the number of rids queued for cancellation."""
+        with self._task_rids_lock:
+            rids = self._task_rids.pop(task_id, {})
+        for rid, addr in rids.items():
+            self._abort_pool.submit(self.abort_request, rid, addr)
+        return len(rids)
+
     async def agenerate(self, req: ModelRequest) -> ModelResponse:
         """Interruptible generation loop (reference :771-867)."""
         addr = self.choose_server(req.rid)
@@ -314,7 +384,21 @@ class RemoteJaxEngine(InferenceEngine):
         start = time.monotonic()
         ttft = None
         stop_reason = StopReason.ABORT.value
+        truncated_by = ""
         attempt_input = list(req.input_ids)
+        # request lifecycle: stamp the config default deadline on requests
+        # that carry none; it propagates as x-areal-deadline so the server
+        # reaps the slot between decode chunks when it expires
+        lc = getattr(self.config, "lifecycle", None)
+        deadline = req.deadline
+        if (
+            deadline is None
+            and lc is not None
+            and lc.enabled
+            and lc.default_deadline_s
+        ):
+            deadline = time.time() + lc.default_deadline_s
+        owner_task = self._register_task_rid(req.rid, addr)
 
         image_b64 = None
         if req.image_data is not None:
@@ -330,58 +414,98 @@ class RemoteJaxEngine(InferenceEngine):
             else None
         )
 
-        while True:
-            payload = {
-                "input_ids": attempt_input,
-                "rid": req.rid,
-                "image_data": image_b64,
-                "image_grid_thw": grid_thw,
-                "sampling_params": {
-                    "max_new_tokens": remaining,
-                    "greedy": g.greedy,
-                    "temperature": g.temperature,
-                    "top_p": g.top_p,
-                    "top_k": g.top_k,
-                    "stop_token_ids": g.stop_token_ids,
-                    "max_tokens": g.max_tokens,
-                    "ignore_eos": g.ignore_eos,
-                    "frequency_penalty": g.frequency_penalty,
-                    # abort-resume aware: tokens already accumulated across
-                    # attempts count toward the minimum
-                    "min_new_tokens": max(
-                        0, g.min_new_tokens - len(accumulated)
-                    ),
-                },
-            }
-            addr, data = await self._post_json_failover(addr, "/generate", payload)
-            if req.rid:
-                # failover may have moved us: resumes + pause-polls must
-                # follow the replica that actually holds the request
-                self._rid_affinity[req.rid] = addr
-            toks = data["output_tokens"]
-            accumulated.extend(toks)
-            logprobs.extend(data["output_logprobs"])
-            versions.extend(data["output_versions"])
-            if ttft is None and toks:
-                ttft = time.monotonic() - start
-            stop_reason = data["stop_reason"]
-            remaining -= len(toks)
-            if stop_reason != StopReason.ABORT.value or remaining <= 0:
-                if remaining <= 0 and stop_reason == StopReason.ABORT.value:
-                    stop_reason = StopReason.LENGTH.value
-                break
-            # server paused for a weight update: wait, then resume with the
-            # accumulated sequence (KV re-prefilled server-side)
-            await self._await_unpaused(addr)
-            attempt_input = list(req.input_ids) + accumulated
+        try:
+            while True:
+                payload = {
+                    "input_ids": attempt_input,
+                    "rid": req.rid,
+                    "image_data": image_b64,
+                    "image_grid_thw": grid_thw,
+                    "deadline": deadline,
+                    "sampling_params": {
+                        "max_new_tokens": remaining,
+                        "greedy": g.greedy,
+                        "temperature": g.temperature,
+                        "top_p": g.top_p,
+                        "top_k": g.top_k,
+                        "stop_token_ids": g.stop_token_ids,
+                        "max_tokens": g.max_tokens,
+                        "ignore_eos": g.ignore_eos,
+                        "frequency_penalty": g.frequency_penalty,
+                        # abort-resume aware: tokens already accumulated across
+                        # attempts count toward the minimum
+                        "min_new_tokens": max(
+                            0, g.min_new_tokens - len(accumulated)
+                        ),
+                    },
+                }
+                headers = (
+                    {"x-areal-deadline": f"{deadline:.6f}"}
+                    if deadline is not None
+                    else None
+                )
+                addr, data = await self._post_json_failover(
+                    addr, "/generate", payload, extra_headers=headers
+                )
+                if req.rid:
+                    # failover may have moved us: resumes + pause-polls must
+                    # follow the replica that actually holds the request
+                    self._rid_affinity[req.rid] = addr
+                    if owner_task is not None:
+                        # arealint: disable-next=ASY003 microsecond dict update, never held across an await; the registry is shared with sync executor threads (abort_task_requests) so the lock must be a threading one
+                        with self._task_rids_lock:
+                            rids = self._task_rids.get(owner_task)
+                            if rids is not None and req.rid in rids:
+                                rids[req.rid] = addr
+                toks = data["output_tokens"]
+                accumulated.extend(toks)
+                logprobs.extend(data["output_logprobs"])
+                versions.extend(data["output_versions"])
+                if ttft is None and toks:
+                    ttft = time.monotonic() - start
+                stop_reason = data["stop_reason"]
+                truncated_by = data.get("truncated_by", "") or ""
+                remaining -= len(toks)
+                if stop_reason != StopReason.ABORT.value or remaining <= 0:
+                    if remaining <= 0 and stop_reason == StopReason.ABORT.value:
+                        stop_reason = StopReason.LENGTH.value
+                    break
+                if deadline is not None and time.time() > deadline:
+                    # expired while waiting out a pause: stop resubmitting —
+                    # the partial output is the answer
+                    stop_reason = StopReason.DEADLINE.value
+                    truncated_by = "deadline"
+                    break
+                # server paused for a weight update: wait, then resume with
+                # the accumulated sequence (KV re-prefilled server-side)
+                await self._await_unpaused(addr)
+                attempt_input = list(req.input_ids) + accumulated
+        except asyncio.CancelledError:
+            # the caller cancelled this coroutine (task failure, agent
+            # teardown): cancel the server-side work too instead of leaving
+            # the slot decoding for nobody. Fire-and-forget on the shared
+            # abort pool — this loop is being torn down, and a mass cancel
+            # must not spawn a thread per coroutine.
+            try:
+                self._abort_pool.submit(self.abort_request, req.rid, addr)
+            except RuntimeError:
+                # destroy() already shut the pool down (loop teardown after
+                # engine teardown); cancellation must still propagate clean
+                pass
+            raise
+        finally:
+            # on error paths too (retry/backpressure exhaustion): retries
+            # use fresh rids, so a surviving entry is a pure leak
+            self._rid_affinity.pop(req.rid, None)
+            self._deregister_task_rid(owner_task, req.rid)
 
-        self._rid_affinity.pop(req.rid, None)
         return ModelResponse(
             input_tokens=list(req.input_ids),
             output_tokens=accumulated,
             output_logprobs=logprobs,
             output_versions=versions,
             stop_reason=stop_reason,
+            truncated_by=truncated_by,
             latency=time.monotonic() - start,
             ttft=ttft or (time.monotonic() - start),
             rid=req.rid,
@@ -413,18 +537,43 @@ class RemoteJaxEngine(InferenceEngine):
         return data
 
     async def _post_json_failover(
-        self, addr: str, path: str, payload: dict, failover: bool = True
+        self,
+        addr: str,
+        path: str,
+        payload: dict,
+        failover: bool = True,
+        extra_headers: dict | None = None,
     ) -> tuple[str, dict]:
         """POST through the retry policy + circuit breakers, failing over to
         a healthy replica when the target trips open. Returns
-        ``(address_that_answered, json)`` so callers can repair affinity."""
+        ``(address_that_answered, json)`` so callers can repair affinity.
+
+        429 (admission rejected) is backpressure, not replica failure: it
+        never trips the circuit or triggers failover (a saturated fleet
+        would cascade), and it does NOT consume the bounded failure-retry
+        attempts — sustained shedding would otherwise convert into client
+        exceptions within ~attempts×Retry-After. Instead 429 waits honor
+        Retry-After under their own wall-clock budget,
+        ``lifecycle.backpressure_wait_s``."""
         ft = self.config.fault_tolerance
         policy = self._retry_policy
         can_failover = failover and ft.enabled and ft.failover
         last_exc: Exception | None = None
         headers = tracecontext.inject()
-        for attempt in range(policy.attempts):
-            if attempt > 0:
+        if extra_headers:
+            headers = {**headers, **extra_headers}
+        lc = getattr(self.config, "lifecycle", None)
+        bp_budget = (
+            lc.backpressure_wait_s if lc is not None and lc.enabled else 0.0
+        )
+        retry_after = 0.0  # >0 after a 429: sleep this instead of backoff
+        attempt = 0  # failed-POST attempts; 429 backpressure doesn't count
+        bp_deadline: float | None = None  # wall budget for 429 waits
+        while attempt < policy.attempts:
+            if retry_after > 0:
+                await asyncio.sleep(retry_after)
+                retry_after = 0.0
+            elif attempt > 0:
                 if not policy.allow_retry():
                     self._robust.budget_exhausted.inc()
                     break
@@ -444,6 +593,22 @@ class RemoteJaxEngine(InferenceEngine):
                 async with sess.post(
                     f"http://{addr}{path}", json=payload, headers=headers
                 ) as r:
+                    if r.status == 429:
+                        try:
+                            retry_after = float(
+                                r.headers.get("Retry-After", "1")
+                            )
+                        except ValueError:
+                            retry_after = 1.0
+                        last_exc = RuntimeError(
+                            f"admission rejected (429) by {addr}{path}"
+                        )
+                        now = time.monotonic()
+                        if bp_deadline is None:
+                            bp_deadline = now + bp_budget
+                        if now + retry_after > bp_deadline:
+                            break  # saturated past the backpressure budget
+                        continue  # backpressure: no failure attempt burned
                     r.raise_for_status()
                     data = await r.json()
                 self.fleet.on_success(addr)
@@ -459,6 +624,7 @@ class RemoteJaxEngine(InferenceEngine):
                     if alt is not None and alt != addr:
                         self._robust.failovers.inc()
                         addr = alt
+                attempt += 1
         raise RuntimeError(f"POST {addr}{path} failed after retries") from last_exc
 
     # metric scrapes must not inherit the hour-scale generation timeout: a

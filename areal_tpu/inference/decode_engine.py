@@ -85,6 +85,9 @@ class _Task:
     out_logprobs: list[float] = field(default_factory=list)
     out_versions: list[int] = field(default_factory=list)
     first_token_time: float | None = None
+    # lifecycle truncation flag carried into the response: "deadline",
+    # "watchdog", or "cancelled" ("" = normal termination)
+    truncated_by: str = ""
 
 
 @dataclass
@@ -272,13 +275,25 @@ class DecodeEngine:
             "prefix_cache_hits": 0,
             "prefix_cache_misses": 0,
             "prefix_hit_tokens": 0,
+            "deadline_exceeded": 0,
+            "cancelled": 0,
+            "watchdog_fired": 0,
         }
         # registry counters mirror the hot stats (thread-sharded: the
         # decode thread increments contention-free; scrapes sum shards)
         self._obs = obs_catalog.engine_metrics()
         self._obs_pc = obs_catalog.prefix_cache_metrics()
+        self._obs_lc = obs_catalog.lifecycle_metrics()
         self._radix = None  # cross-request prefix cache; built in initialize
         self._radix_flush_req: tuple[threading.Event, list[int]] | None = None
+        # request lifecycle (docs/request_lifecycle.md): rids queued for
+        # cancellation by any thread (/abort_request, generate_sync
+        # timeouts); the decode loop services them between chunks
+        self._abort_lock = threading.Lock()
+        self._abort_rids: set[str] = set()
+        # decode-loop liveness: last time the loop completed a pass (the
+        # wedge detector /health consults) — monotonic seconds
+        self._last_loop_ts = time.monotonic()
 
     # -- lifecycle --------------------------------------------------------
     def initialize(self) -> None:
@@ -363,6 +378,9 @@ class DecodeEngine:
         # per chunk; over a high-latency host<->TPU link each transfer is an
         # RPC, and that overhead tripled per-token cost.)
         self._slot_task: list[_Task | None] = [None] * S
+        # last time each slot made progress (admission or token emission);
+        # the per-slot watchdog compares against lifecycle.watchdog_s
+        self._slot_progress: list[float] = [0.0] * S
         self._state = {
             "ids": np.zeros(S, np.int32),
             "pos": np.zeros(S, np.int32),
@@ -735,8 +753,216 @@ class DecodeEngine:
 
         self.submit(req, cb)
         if not done.wait(timeout):
+            # cancel the engine-side work before giving up: without this
+            # the engine decodes to completion (and holds KV pages) for a
+            # caller that is gone — the wasted-work bug the lifecycle
+            # manager exists to close
+            # the abort resolves at the next decode-loop pass; give the
+            # callback a short grace so the slot/pages are reclaimed (and
+            # the partial response, if any, is not lost to a near-miss).
+            # No grace for rid-less requests: nothing was queued for them.
+            if self.abort_request(req.rid) and done.wait(5.0):
+                return box[0]
             raise TimeoutError(f"generation timed out after {timeout}s")
         return box[0]
+
+    def abort_request(self, rid: str) -> bool:
+        """Cancel one request by rid, wherever it is — queued, decoding, or
+        parked. Thread-safe: the rid is queued and the decode loop reaps it
+        between chunks (slot deactivated, KV pages freed or published,
+        callback fired with stop_reason="cancelled"). Returns True if the
+        rid was queued for cancellation (False for an empty rid)."""
+        if not rid:
+            return False
+        with self._abort_lock:
+            self._abort_rids.add(rid)
+        self._wakeup.set()
+        return True
+
+    # -- lifecycle (deadlines / cancellation / watchdog) -------------------
+    def _lifecycle(self):
+        lc = getattr(self.config, "lifecycle", None)
+        return lc if (lc is not None and lc.enabled) else None
+
+    def admission_snapshot(self) -> dict:
+        """Point-in-time admission-control inputs (the 429 payload and the
+        /statusz lifecycle section): queue depth, free-page headroom, and
+        slot occupancy. Reads are racy-but-monotone (queue/backlog sizes),
+        which is fine for a gate that only needs to be approximately
+        right."""
+        radix_pages = self._radix.pages_held if self._radix is not None else 0
+        return {
+            "queue_depth": self._queue.qsize() + len(self._backlog),
+            "free_pages": self.pool.available if hasattr(self, "pool") else 0,
+            "radix_pages": radix_pages,
+            "active_slots": sum(
+                1 for t in getattr(self, "_slot_task", ()) if t is not None
+            ),
+            "max_batch_size": self.config.max_batch_size,
+        }
+
+    def check_admission(self) -> tuple[bool, str, dict]:
+        """Admission-control gate for new generation requests. Returns
+        (admit, reason, snapshot); ``reason`` names the tripped gate
+        ("queue_depth" | "page_headroom") when admit is False."""
+        lc = self._lifecycle()
+        snap = self.admission_snapshot()
+        if lc is None:
+            return True, "", snap
+        if lc.max_queue_depth > 0 and snap["queue_depth"] >= lc.max_queue_depth:
+            return False, "queue_depth", snap
+        if (
+            lc.min_free_pages > 0
+            and snap["free_pages"] + snap["radix_pages"] < lc.min_free_pages
+        ):
+            # radix pages count as headroom: they are reclaimable cache,
+            # first rung of the eviction ladder
+            return False, "page_headroom", snap
+        return True, "", snap
+
+    def is_wedged(self) -> bool:
+        """True when the decode loop has made no pass for
+        ``lifecycle.engine_stall_escalate_s`` while work is pending — the
+        per-slot watchdog cannot run then (it lives on the same loop), so
+        /health turns 503 and PR 3's probe/supervision path evicts and
+        respawns the replica."""
+        lc = self._lifecycle()
+        if lc is None or lc.engine_stall_escalate_s <= 0:
+            return False
+        if self._thread is None:  # never started / cleanly stopped
+            return False
+        busy = any(t is not None for t in getattr(self, "_slot_task", ())) or (
+            self._queue.qsize() + len(self._backlog) > 0
+        )
+        if not self._thread.is_alive():
+            # the loop CRASHED (stop() nulls _thread after joining): pending
+            # work can never drain, so escalate immediately — the heartbeat
+            # below would never go stale-r, and waiting helps nobody
+            return busy
+        if self.is_paused:  # held/paused loops idle legitimately
+            return False
+        return busy and (
+            time.monotonic() - self._last_loop_ts > lc.engine_stall_escalate_s
+        )
+
+    def _reap_lifecycle(self, pending: dict | None) -> dict | None:
+        """Service cancellations, deadline expirations, and the per-slot
+        watchdog — runs between decode chunks on the decode loop (the only
+        thread that owns slots/pages). Reaped requests leave through
+        ``_finish`` with a non-abort reason, so their pages are freed or
+        published into the radix tree exactly like a completion.
+
+        Takes/returns the loop's in-flight chunk record: when anything is
+        actually reaped the chunk is drained FIRST, so tokens it emitted
+        are credited (per-token version tags intact) instead of lost with
+        the slot teardown. The no-reap fast path touches nothing."""
+        lc = self._lifecycle()
+        with self._abort_lock:
+            aborts = self._abort_rids
+            self._abort_rids = set()
+        now = time.time()
+        if lc is None and not aborts:
+            return pending
+
+        def expired(task: _Task) -> bool:
+            dl = task.req.deadline
+            return lc is not None and dl is not None and now > dl
+
+        def watchdog_hit(slot: int) -> bool:
+            return (
+                lc is not None
+                and lc.watchdog_s > 0
+                and self._state["active"][slot]
+                and self._slot_progress[slot] > 0
+                and time.monotonic() - self._slot_progress[slot] > lc.watchdog_s
+            )
+
+        # fast path: nothing queued/decoding is affected — don't disturb
+        # the chunk pipeline
+        any_hit = bool(aborts) or any(
+            expired(t) for t in self._backlog
+        )
+        if not any_hit:
+            for slot, task in enumerate(self._slot_task):
+                if task is not None and (expired(task) or watchdog_hit(slot)):
+                    any_hit = True
+                    break
+        if not any_hit:
+            # queued-task deadlines are enforced at admission time
+            # (_admit_pending) before any prefill happens
+            return pending
+        # credit the in-flight chunk before any slot teardown
+        self._drain(pending)
+        pending = None
+        # queued work first: drain the submission queue into the backlog
+        # (same FIFO order _admit_pending uses) and filter both
+        while True:
+            try:
+                self._backlog.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        kept: deque[_Task] = deque()
+        counted: set[str] = set()  # rids whose cancel _finish already counted
+        for task in self._backlog:
+            if task.req.rid and task.req.rid in aborts:
+                task.truncated_by = "cancelled"
+                counted.add(task.req.rid)
+                self._finish(task, StopReason.CANCEL.value)
+            elif expired(task):
+                task.truncated_by = "deadline"
+                self._finish(task, StopReason.DEADLINE.value)
+            else:
+                kept.append(task)
+        # arealint: disable-next=THR001 single-writer by design: the backlog is owned by the decode loop thread (this method runs between chunks on it); other threads only read its len() for racy-but-monotone depth snapshots
+        self._backlog = kept
+        # active slots: deadline, cancellation, watchdog
+        st = self._state
+        rows: list[np.ndarray] = []
+        for slot, task in enumerate(self._slot_task):
+            if task is None:
+                continue
+            reason = None
+            if task.req.rid and task.req.rid in aborts:
+                task.truncated_by = "cancelled"
+                counted.add(task.req.rid)
+                reason = StopReason.CANCEL.value
+            elif expired(task):
+                task.truncated_by = "deadline"
+                reason = StopReason.DEADLINE.value
+            elif watchdog_hit(slot):
+                task.truncated_by = "watchdog"
+                reason = StopReason.CANCEL.value
+                self.stats["watchdog_fired"] += 1
+                self._obs_lc.watchdog_fired.inc()
+                logger.warning(
+                    f"slot {slot} watchdog: no token in {lc.watchdog_s:.1f}s "
+                    f"(rid={task.req.rid}); aborting the slot"
+                )
+            if reason is None:
+                continue
+            if st["active"][slot]:
+                rows.append(
+                    self._pack_row(slot, 0, int(st["pos"][slot]), False, 0)
+                )
+            self._finish(task, reason)
+        if rows and self.cache is not None:
+            self._apply_slot_updates(rows)
+        # parked rids: cancellation drops the parking and frees its pages
+        # (deadlines leave parked KV alone — the rid owner may still resume
+        # with time left on a fresh attempt; eviction pressure bounds it)
+        for rid in aborts:
+            p = self._parked.pop(rid, None)
+            if p is not None:
+                self.pool.free(p.pages)
+                self._slot_pages[p.slot] = []
+                self._slot_page_versions[p.slot] = []
+                self._pt_host[p.slot] = 0
+                # a parked rid whose resume was reaped above already counted
+                # through _finish — one cancelled request, one increment
+                if rid not in counted:
+                    self.stats["cancelled"] += 1
+                    self._obs_lc.aborts.inc()
+        return None  # in-flight chunk was drained above
 
     # -- pause / weights (the §3.4 protocol) ------------------------------
     def pause_generation(self, mode: str = "abort") -> None:
@@ -1575,6 +1801,7 @@ class DecodeEngine:
     ) -> np.ndarray:
         """Admit ``task`` into ``slot``: derive per-slot sampling state from
         the request and pack the device scatter row."""
+        self._slot_progress[slot] = time.monotonic()  # watchdog baseline
         g = task.req.gconfig
         temp = 0.0 if g.greedy else g.temperature
         greedy = bool(g.greedy or g.temperature == 0.0)
@@ -1703,6 +1930,17 @@ class DecodeEngine:
             P_len = len(task.req.input_ids)
             if P_len >= T - 2 or P_len == 0:
                 self._finish(task, StopReason.LENGTH.value)
+                continue
+            dl = task.req.deadline
+            if (
+                self._lifecycle() is not None
+                and dl is not None
+                and time.time() > dl
+            ):
+                # expired while queued: don't waste a prefill on a request
+                # whose budget is already gone (docs/request_lifecycle.md)
+                task.truncated_by = "deadline"
+                self._finish(task, StopReason.DEADLINE.value)
                 continue
             row = self._try_resume(task)
             if row is not None:
@@ -2140,6 +2378,7 @@ class DecodeEngine:
             output_logprobs=task.out_logprobs,
             output_versions=task.out_versions,
             stop_reason=reason,
+            truncated_by=task.truncated_by,
             latency=time.monotonic() - task.submit_time,
             ttft=(task.first_token_time or time.monotonic()) - task.submit_time,
             rid=task.req.rid,
@@ -2148,6 +2387,12 @@ class DecodeEngine:
         if reason == StopReason.ABORT.value:
             self.stats["aborted"] += 1
             self._obs.aborted.inc()
+        elif reason == StopReason.DEADLINE.value:
+            self.stats["deadline_exceeded"] += 1
+            self._obs_lc.deadline_exceeded.inc()
+        elif reason == StopReason.CANCEL.value:
+            self.stats["cancelled"] += 1
+            self._obs_lc.aborts.inc()
         else:
             self.stats["completed"] += 1
             self._obs.completed.inc()
@@ -2396,6 +2641,7 @@ class DecodeEngine:
             if c:
                 if task.first_token_time is None:
                     task.first_token_time = now
+                self._slot_progress[slot] = now  # watchdog: progress seen
                 # .tolist() converts in C — a genexpr of int()/float() costs
                 # ~S*n_steps Python calls per chunk on the serving hot loop
                 task.out_tokens.extend(toks[:c, slot].tolist())
@@ -2425,6 +2671,8 @@ class DecodeEngine:
     def _loop(self) -> None:
         pending: dict | None = None
         while not self._shutdown.is_set():
+            # arealint: disable-next=THR001 monotonic float heartbeat: torn reads are impossible for a GIL-protected float rebind and the wedge detector only compares against a multi-second threshold
+            self._last_loop_ts = time.monotonic()
             self._apply_weight_update()
             self._service_radix_flush()
             if self._paused.is_set():
@@ -2463,6 +2711,12 @@ class DecodeEngine:
                     continue
                 self._drain(pending)
                 pending = None
+                # a hold is legitimate idleness: keep the per-slot watchdog
+                # baselines fresh so a long fence can't fire it on resume
+                now_m = time.monotonic()
+                for slot, t in enumerate(self._slot_task):
+                    if t is not None:
+                        self._slot_progress[slot] = now_m
                 self._hold_ack.set()
                 self._wakeup.wait(timeout=0.05)
                 self._wakeup.clear()
@@ -2472,6 +2726,12 @@ class DecodeEngine:
                 self._wakeup.wait(timeout=0.05)
                 self._wakeup.clear()
                 continue
+            # lifecycle reaping BETWEEN chunks: cancellations, expired
+            # deadlines (queued and decoding), per-slot watchdog — the
+            # overload-safety half of interruptible generation. When a reap
+            # fires, the in-flight chunk is drained first (tokens credited)
+            # and None comes back; the fast path returns pending untouched.
+            pending = self._reap_lifecycle(pending)
             # admissions enqueue prefills + ONE packed state scatter; the
             # in-flight chunk (if any) ordered before them touches only
             # previously-active slots, so there is no dataflow hazard

@@ -52,6 +52,7 @@ def _req_from_json(d: dict) -> ModelRequest:
         import io
 
         image_data = np.load(io.BytesIO(b64.b64decode(d["image_data"])))
+    deadline = d.get("deadline")
     return ModelRequest(
         input_ids=d["input_ids"],
         gconfig=gconfig,
@@ -59,6 +60,7 @@ def _req_from_json(d: dict) -> ModelRequest:
         metadata=d.get("metadata", {}),
         image_data=image_data,
         image_grid_thw=d.get("image_grid_thw"),
+        deadline=float(deadline) if deadline is not None else None,
     )
 
 
@@ -74,6 +76,7 @@ class InferenceServer:
         self._metrics = catalog.server_metrics()
         self._engine_obs = catalog.engine_metrics()
         self._pc_obs = catalog.prefix_cache_metrics()
+        self._lc_obs = catalog.lifecycle_metrics()
         self._started_at = time.time()
         self._update_begin_ts: float | None = None
 
@@ -104,13 +107,23 @@ class InferenceServer:
                 web.post("/release_memory_occupation", self.h_release_memory),
                 web.post("/resume_memory_occupation", self.h_resume_memory),
                 web.post("/flush_prefix_cache", self.h_flush_prefix_cache),
-                web.post("/abort_request", self.h_noop),
+                web.post("/abort_request", self.h_abort_request),
             ]
         )
         return app
 
     # -- handlers ---------------------------------------------------------
     async def h_health(self, request: web.Request) -> web.Response:
+        # wedge escalation (docs/request_lifecycle.md): a decode loop that
+        # stopped making passes while work is pending can't run its own
+        # watchdog — report 503 so the client fleet probe / PR 3
+        # supervision evicts and respawns this replica
+        wedged = getattr(self.engine, "is_wedged", None)
+        if wedged is not None and wedged():
+            return web.json_response(
+                {"status": "wedged", "version": self.engine.get_version()},
+                status=503,
+            )
         return web.json_response(
             {"status": "ok", "version": self.engine.get_version()}
         )
@@ -122,9 +135,10 @@ class InferenceServer:
         m.paused.set(1.0 if self.engine.is_paused else 0.0)
         q = getattr(self.engine, "_queue", None)
         backlog = getattr(self.engine, "_backlog", ())
-        m.queue_depth.set(
-            (q.qsize() if q is not None else 0) + len(backlog)
-        )
+        depth = (q.qsize() if q is not None else 0) + len(backlog)
+        m.queue_depth.set(depth)
+        # lifecycle twin: the depth the admission gate compares against
+        self._lc_obs.queue_depth.set(depth)
         slots = getattr(self.engine, "_slot_task", None)
         if slots is not None:
             self._engine_obs.batch_occupancy.set(
@@ -176,6 +190,9 @@ class InferenceServer:
         pc = getattr(self.engine, "prefix_cache_stats", None)
         if pc is not None:
             out["prefix_cache"] = pc()
+        snap = getattr(self.engine, "admission_snapshot", None)
+        if snap is not None:
+            out["lifecycle"] = snap()
         return web.json_response(out)
 
     async def h_flush_prefix_cache(self, request: web.Request) -> web.Response:
@@ -192,8 +209,36 @@ class InferenceServer:
         # server's spans correlate with the submitting workflow's session
         tracecontext.extract(request.headers)
         self._metrics.requests.labels(endpoint="generate").inc()
+        # admission control (docs/request_lifecycle.md): under overload the
+        # right answer is a FAST clean 429 with backpressure hints, not an
+        # unbounded queue that converts overload into tail latency
+        gate = getattr(self.engine, "check_admission", None)
+        if gate is not None:
+            admit, reason, snap = gate()
+            if not admit:
+                lc = getattr(self.engine.config, "lifecycle", None)
+                retry_after = getattr(lc, "retry_after_s", 1.0) or 1.0
+                self._lc_obs.admission_rejected.labels(reason=reason).inc()
+                return web.json_response(
+                    {"status": "rejected", "reason": reason, **snap},
+                    status=429,
+                    headers={"Retry-After": f"{retry_after:g}"},
+                )
         d = await request.json()
         req = _req_from_json(d)
+        # deadline rides the x-areal-deadline header (absolute unix epoch
+        # seconds) end-to-end; a JSON "deadline" field is the fallback for
+        # hand-rolled callers. Header wins: the outermost hop (gateway)
+        # owns the budget.
+        hdr_deadline = request.headers.get("x-areal-deadline")
+        if hdr_deadline:
+            try:
+                req.deadline = float(hdr_deadline)
+            except ValueError:
+                return web.json_response(
+                    {"status": "error", "error": "bad x-areal-deadline"},
+                    status=400,
+                )
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
 
@@ -202,11 +247,20 @@ class InferenceServer:
                 lambda: fut.done() or fut.set_result(resp)
             )
 
-        async with perf_tracer.atrace_scope(
-            "server.generate", perf_tracer.Category.COMPUTE, {"rid": req.rid}
-        ):
-            self.engine.submit(req, cb)
-            resp = await fut
+        try:
+            async with perf_tracer.atrace_scope(
+                "server.generate", perf_tracer.Category.COMPUTE, {"rid": req.rid}
+            ):
+                self.engine.submit(req, cb)
+                resp = await fut
+        except asyncio.CancelledError:
+            # the client disconnected (aiohttp cancels the handler): cancel
+            # the engine-side work too, or the slot decodes to completion
+            # and holds KV pages for a caller that is gone
+            abort = getattr(self.engine, "abort_request", None)
+            if abort is not None:
+                abort(req.rid)
+            raise
         # only requests that actually emitted a token have a TTFT; aborted
         # ones report submit->abort time, which would skew the histogram
         # with pause-wait durations
@@ -219,11 +273,36 @@ class InferenceServer:
                 "output_logprobs": resp.output_logprobs,
                 "output_versions": resp.output_versions,
                 "stop_reason": resp.stop_reason,
+                "truncated_by": resp.truncated_by,
                 "latency": resp.latency,
                 "ttft": resp.ttft,
                 "rid": resp.rid,
             }
         )
+
+    async def h_abort_request(self, request: web.Request) -> web.Response:
+        """Cancel one in-flight request by rid (docs/request_lifecycle.md):
+        queued, decoding, or parked — the decode loop reaps it between
+        chunks, frees/publishes its KV pages, and fires the callback with
+        stop_reason="cancelled". Idempotent; unknown rids are a no-op."""
+        self._metrics.requests.labels(endpoint="abort_request").inc()
+        raw = await request.read()
+        rid = ""
+        if raw.strip():
+            try:
+                rid = str(json.loads(raw).get("rid", ""))
+            except (ValueError, AttributeError):
+                return web.json_response(
+                    {"status": "error", "error": "unparsable JSON body"},
+                    status=400,
+                )
+        if not rid:
+            return web.json_response(
+                {"status": "error", "error": "rid required"}, status=400
+            )
+        abort = getattr(self.engine, "abort_request", None)
+        queued = bool(abort(rid)) if abort is not None else False
+        return web.json_response({"status": "ok", "queued": queued})
 
     async def h_pause(self, request: web.Request) -> web.Response:
         """Pause modes: default "abort" (legacy §3.4: in-flight requests

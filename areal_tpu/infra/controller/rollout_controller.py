@@ -196,7 +196,10 @@ class RolloutController:
     def start_gateway(self, port: int = 0) -> str:
         """Serve the gateway (openai/proxy/gateway.py) from the controller
         process on a daemon thread: ONE external base_url over all proxy
-        workers. Returns the gateway URL."""
+        workers. Returns the gateway URL. Load-shedding knobs come from the
+        engine config's RequestLifecycleConfig (docs/request_lifecycle.md):
+        rollout-class traffic sheds before interactive once
+        gateway_max_inflight fills."""
         import asyncio
         import threading
 
@@ -209,7 +212,20 @@ class RolloutController:
         assert self._gateway_thread is None, "gateway already running"
         port = port or find_free_port()
         backends = [f"http://{w.address}" for w in self.proxy_workers]
-        state = GatewayState(backends, admin_api_key=self._admin_key)
+        lc = getattr(self._engine_init_config, "lifecycle", None)
+        state = GatewayState(
+            backends,
+            admin_api_key=self._admin_key,
+            max_inflight=(
+                lc.gateway_max_inflight if lc is not None and lc.enabled else 0
+            ),
+            interactive_headroom=(
+                lc.gateway_interactive_headroom
+                if lc is not None and lc.enabled
+                else 0
+            ),
+            retry_after_s=(lc.retry_after_s if lc is not None else 1.0),
+        )
         started = threading.Event()
         # loop is created and published BEFORE the thread starts, so the
         # write can never race a reader's None-check (arealint THR001)

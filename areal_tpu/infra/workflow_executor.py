@@ -339,6 +339,22 @@ class WorkflowExecutor:
             f"task {task_id} quarantined after {rec.strikes} failed "
             f"attempts; last error: {tf.exc!r}"
         )
+        # request lifecycle: a failed episode's coroutine may have left
+        # sibling generations running on the loop (fire-and-forget tasks,
+        # un-cancelled gathers) — cancel them server-side so the fleet
+        # stops decoding for a task that will never consume the output
+        abort = getattr(self.engine, "abort_task_requests", None)
+        if abort is not None:
+            try:
+                n = abort(task_id)
+                if n:
+                    logger.warning(
+                        f"cancelled {n} in-flight generation(s) of "
+                        f"quarantined task {task_id}"
+                    )
+            except Exception:  # noqa: BLE001 — cleanup must never mask
+                # the quarantine accounting below
+                logger.exception("abort_task_requests failed")
         if not rec.is_eval:
             self.staleness.on_reject()
         tracker = stats_tracker.get()

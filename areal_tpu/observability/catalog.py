@@ -174,6 +174,60 @@ def prefix_cache_metrics(reg: Registry | None = None) -> SimpleNamespace:
     )
 
 
+def lifecycle_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """Request lifecycle manager (docs/request_lifecycle.md): deadlines,
+    cancellation, admission control, and load shedding across the stack."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        admission_rejected=r.counter(
+            "areal_admission_rejected_total",
+            "Generation requests rejected at admission with 429 + "
+            "Retry-After, by reason (queue_depth | page_headroom).",
+            label_names=("reason",),
+        ),
+        deadline_exceeded=r.counter(
+            "areal_request_deadline_exceeded_total",
+            "Requests reaped at their deadline (queued or mid-decode); "
+            "partial output returned with truncated_by=deadline.",
+        ),
+        aborts=r.counter(
+            "areal_abort_total",
+            "In-flight requests cancelled via /abort_request (client "
+            "disconnects, workflow task failures) — slots and KV pages "
+            "reclaimed instead of decoding for a caller that is gone.",
+        ),
+        queue_depth=r.gauge(
+            "areal_request_queue_depth",
+            "Lifecycle view of engine admission pressure: submission queue "
+            "+ backlog depth the admission-control gate compares against "
+            "lifecycle.max_queue_depth.",
+        ),
+        watchdog_fired=r.counter(
+            "areal_slot_watchdog_fired_total",
+            "Active slots aborted by the per-slot progress watchdog (no "
+            "token emitted within lifecycle.watchdog_s).",
+        ),
+        gateway_shed=r.counter(
+            "areal_gateway_shed_total",
+            "Requests load-shed at the gateway with 429 + Retry-After, by "
+            "priority class (rollout sheds before interactive).",
+            label_names=("priority",),
+        ),
+        gateway_latency=r.histogram(
+            "areal_gateway_admitted_latency_seconds",
+            "End-to-end latency of requests ADMITTED through the gateway, "
+            "by priority class (interactive | rollout).",
+            label_names=("priority",),
+        ),
+        gateway_inflight=r.gauge(
+            "areal_gateway_inflight",
+            "Requests currently forwarded through the gateway, by "
+            "priority class.",
+            label_names=("priority",),
+        ),
+    )
+
+
 def server_metrics(reg: Registry | None = None) -> SimpleNamespace:
     """Inference HTTP server: per-request latency + pause/update windows."""
     r = reg or get_registry()
@@ -384,6 +438,7 @@ ALL_FACTORIES = (
     executor_metrics,
     engine_metrics,
     prefix_cache_metrics,
+    lifecycle_metrics,
     server_metrics,
     client_metrics,
     rpc_metrics,

@@ -191,6 +191,7 @@ class AsyncChatCompletions:
         metadata: dict | None = None,
         stream: bool = False,
         extra_body: dict | None = None,
+        deadline: float | None = None,
         **unsupported: Any,
     ) -> ChatCompletion | AsyncIterator[ChatCompletionChunk]:
         o = self._o
@@ -322,6 +323,10 @@ class AsyncChatCompletions:
                 gconfig=gconfig,
                 rid=uuid.uuid4().hex,
                 metadata=dict(metadata or {}),
+                # request lifecycle: absolute unix-epoch deadline (the proxy
+                # fills it from the x-areal-deadline header) — rides the
+                # engine client to the serving fleet
+                deadline=deadline,
             )
             for _ in range(n_samples)
         ]

@@ -10,6 +10,15 @@ the proxy that owns the session. Same protocol here on aiohttp.
     POST /v1/chat/completions, /rl/set_reward, /rl/end_session (session key)
          -> forwarded verbatim to the owning proxy
     GET  /health
+
+Overload safety (docs/request_lifecycle.md): forwarded requests are
+classified into two priority classes by the ``x-areal-priority`` header —
+``interactive`` (default: external agents) vs ``rollout`` (the RL system's
+own bulk traffic). With ``RequestLifecycleConfig.gateway_max_inflight``
+set, rollout-class requests shed with 429 + Retry-After once
+``max_inflight - interactive_headroom`` slots fill, so a rollout flood can
+never starve interactive decode; interactive sheds only at the full cap.
+``x-areal-deadline`` and ``x-areal-priority`` pass through to the backend.
 """
 
 from __future__ import annotations
@@ -20,10 +29,15 @@ import time
 import aiohttp
 from aiohttp import web
 
+from areal_tpu.observability import catalog
 from areal_tpu.openai.proxy.common import bearer_token as _bearer
 from areal_tpu.utils import logging as alog
 
 logger = alog.getLogger("proxy_gateway")
+
+PRIORITIES = ("interactive", "rollout")
+# lifecycle headers forwarded verbatim to the owning proxy backend
+PASSTHROUGH_HEADERS = ("x-areal-deadline", "x-areal-priority")
 
 FORWARDED_PATHS = (
     "/v1/chat/completions",
@@ -43,13 +57,66 @@ class SessionRoute:
 
 
 class GatewayState:
-    def __init__(self, backends: list[str], admin_api_key: str):
+    def __init__(
+        self,
+        backends: list[str],
+        admin_api_key: str,
+        max_inflight: int = 0,
+        interactive_headroom: int = 0,
+        retry_after_s: float = 1.0,
+    ):
         assert backends, "gateway needs at least one backend proxy"
         self.backends = list(backends)
         self.admin_api_key = admin_api_key
         self.routes: dict[str, SessionRoute] = {}  # api_key -> route
         self.load: dict[str, int] = {b: 0 for b in self.backends}
         self._last_sweep = 0.0
+        # load shedding: two priority classes share max_inflight slots;
+        # interactive_headroom of them are off-limits to rollout traffic
+        self.max_inflight = max_inflight
+        self.interactive_headroom = min(
+            interactive_headroom, max_inflight if max_inflight > 0 else 0
+        )
+        # floor to a positive hint (same defense as the engine server's
+        # 429): "Retry-After: 0" turns honoring clients into hot-spinners
+        self.retry_after_s = retry_after_s if retry_after_s > 0 else 1.0
+        self.inflight: dict[str, int] = {p: 0 for p in PRIORITIES}
+        self.shed: dict[str, int] = {p: 0 for p in PRIORITIES}
+        self._lc_obs = catalog.lifecycle_metrics()
+
+    def classify(self, request: web.Request) -> str:
+        p = request.headers.get("x-areal-priority", "interactive").lower()
+        return p if p in PRIORITIES else "interactive"
+
+    def admit(self, priority: str) -> bool:
+        """Shed-or-admit for one forwarded request. Rollout traffic sheds
+        first: its cap excludes the interactive headroom."""
+        if self.max_inflight <= 0:
+            return True
+        total = sum(self.inflight.values())
+        cap = self.max_inflight
+        if priority == "rollout":
+            cap -= self.interactive_headroom
+        return total < cap
+
+    def on_admitted(self, priority: str) -> None:
+        self.inflight[priority] += 1
+        self._lc_obs.gateway_inflight.labels(priority=priority).set(
+            self.inflight[priority]
+        )
+
+    def on_done(self, priority: str, latency_s: float) -> None:
+        self.inflight[priority] = max(0, self.inflight[priority] - 1)
+        self._lc_obs.gateway_inflight.labels(priority=priority).set(
+            self.inflight[priority]
+        )
+        self._lc_obs.gateway_latency.labels(priority=priority).observe(
+            latency_s
+        )
+
+    def on_shed(self, priority: str) -> None:
+        self.shed[priority] += 1
+        self._lc_obs.gateway_shed.labels(priority=priority).inc()
 
     def pick_backend(self) -> str:
         return min(self.backends, key=lambda b: self.load.get(b, 0))
@@ -95,7 +162,14 @@ def create_gateway_app(state: GatewayState) -> web.Application:
 
     async def health(_):
         return web.json_response(
-            {"status": "ok", "backends": state.backends, "sessions": len(state.routes)}
+            {
+                "status": "ok",
+                "backends": state.backends,
+                "sessions": len(state.routes),
+                "inflight": dict(state.inflight),
+                "shed": dict(state.shed),
+                "max_inflight": state.max_inflight,
+            }
         )
 
     async def start_session(request: web.Request):
@@ -129,17 +203,47 @@ def create_gateway_app(state: GatewayState) -> web.Application:
         if route is None:
             raise web.HTTPGone(text="unknown session key")
         route.last_activity = time.time()
+        # load shedding (docs/request_lifecycle.md): classify and gate
+        # BEFORE reading the body — a shed request must stay cheap
+        priority = state.classify(request)
+        if not state.admit(priority):
+            state.on_shed(priority)
+            return web.json_response(
+                {
+                    "status": "rejected",
+                    "reason": "gateway_overload",
+                    "priority": priority,
+                    "inflight": dict(state.inflight),
+                    "max_inflight": state.max_inflight,
+                },
+                status=429,
+                headers={"Retry-After": f"{state.retry_after_s:g}"},
+            )
+        state.on_admitted(priority)
+        t0 = time.monotonic()
+        try:
+            return await _forward_admitted(request, key, route)
+        finally:
+            state.on_done(priority, time.monotonic() - t0)
+
+    async def _forward_admitted(
+        request: web.Request, key: str, route: SessionRoute
+    ):
         http = await _client(request.app)
         body = await request.read()
+        fwd_headers = {
+            "Authorization": f"Bearer {key}",
+            "Content-Type": request.headers.get(
+                "Content-Type", "application/json"
+            ),
+        }
+        for h in PASSTHROUGH_HEADERS:
+            if h in request.headers:
+                fwd_headers[h] = request.headers[h]
         async with http.post(
             f"{route.backend}{request.path}",
             data=body,
-            headers={
-                "Authorization": f"Bearer {key}",
-                "Content-Type": request.headers.get(
-                    "Content-Type", "application/json"
-                ),
-            },
+            headers=fwd_headers,
         ) as r:
             ct = r.headers.get("Content-Type", "")
             if ct.startswith("text/event-stream"):

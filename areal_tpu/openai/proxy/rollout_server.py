@@ -191,12 +191,27 @@ def create_proxy_app(state: ProxyState) -> web.Application:
         state.session_to_key[session_id] = api_key
         return web.json_response({"session_id": session_id, "api_key": api_key})
 
+    def _deadline_of(request: web.Request) -> float | None:
+        """x-areal-deadline header (absolute unix epoch seconds) — the
+        request-lifecycle budget forwarded by the gateway; see
+        docs/request_lifecycle.md."""
+        raw = request.headers.get("x-areal-deadline")
+        if not raw:
+            return None
+        try:
+            return float(raw)
+        except ValueError:
+            raise web.HTTPBadRequest(text="bad x-areal-deadline header")
+
     async def chat_completions(request: web.Request):
         sess = require_session(request)
         body = await request.json()
         body.pop("model", None)
+        body.pop("deadline", None)  # header-only: the body is agent-authored
         try:
-            result = await sess.client.chat.completions.create(**body)
+            result = await sess.client.chat.completions.create(
+                **body, deadline=_deadline_of(request)
+            )
         except (ValueError, NotImplementedError) as e:
             raise web.HTTPBadRequest(text=str(e))
         if body.get("stream"):
@@ -320,6 +335,7 @@ def create_proxy_app(state: ProxyState) -> web.Application:
             "messages": messages,
             "max_completion_tokens": body.get("max_tokens"),
             "stream": False,
+            "deadline": _deadline_of(request),
         }
         if tools:
             kw["tools"] = tools

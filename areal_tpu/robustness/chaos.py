@@ -31,7 +31,7 @@ from areal_tpu.utils import logging as alog
 
 logger = alog.getLogger("robustness.chaos")
 
-KINDS = ("drop", "delay", "error", "hang")
+KINDS = ("drop", "delay", "error", "hang", "stall")
 
 
 class FaultInjected(ConnectionError):
@@ -60,7 +60,7 @@ class FaultInjector:
         """The fault (if any) for the next request, drawn deterministically.
 
         One uniform draw per request keeps the sequence stable: fault kinds
-        partition [0, 1) as [drop | delay | error | hang | pass]."""
+        partition [0, 1) as [drop | delay | error | hang | stall | pass]."""
         cfg = self.config
         if not cfg.enabled:
             return None
@@ -81,6 +81,9 @@ class FaultInjector:
         edge += cfg.hang_prob
         if u < edge:
             return "hang"
+        edge += cfg.stall_prob
+        if u < edge:
+            return "stall"
         return None
 
     def _record(self, kind: str, addr: str, path: str) -> None:
@@ -99,6 +102,12 @@ class FaultInjector:
         if kind == "delay":
             await asyncio.sleep(self.config.delay_s)
             return
+        if kind == "stall":
+            # slow-but-successful backend: the request proceeds after the
+            # stall, so retries can't mask it (the overload test's latency
+            # injector — unlike "hang", which raises and gets retried)
+            await asyncio.sleep(self.config.stall_s)
+            return
         if kind == "hang":
             await asyncio.sleep(self.config.hang_s)
         raise FaultInjected(kind, addr, path)
@@ -111,6 +120,9 @@ class FaultInjector:
         self._record(kind, addr, path)
         if kind == "delay":
             time.sleep(self.config.delay_s)
+            return
+        if kind == "stall":
+            time.sleep(self.config.stall_s)
             return
         if kind == "hang":
             time.sleep(self.config.hang_s)

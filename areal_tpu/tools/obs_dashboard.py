@@ -34,6 +34,7 @@ _ROWS = (
     ("areal_executor_eval_queue_depth", "eval queue"),
     ("areal_executor_inflight_tasks", "in flight"),
     ("areal_server_queue_depth", "server queue"),
+    ("areal_request_queue_depth", "lifecycle queue"),
     ("areal_decode_batch_occupancy", "batch occupancy"),
     ("areal_server_paused", "paused servers"),
     ("areal_weight_update_total", "weight updates"),
@@ -48,6 +49,16 @@ def _merged_value(snap: FleetSnapshot, name: str) -> float | None:
         if n == name:
             total = (total or 0.0) + v
     return total
+
+
+def _shed_total(snap: FleetSnapshot) -> float | None:
+    """Fleet-wide count of requests turned away with a 429: gateway load
+    shedding (by priority class) + engine admission rejections (by reason)."""
+    gw = _merged_value(snap, "areal_gateway_shed_total")
+    adm = _merged_value(snap, "areal_admission_rejected_total")
+    if gw is None and adm is None:
+        return None
+    return (gw or 0.0) + (adm or 0.0)
 
 
 def _fmt(v: float | None) -> str:
@@ -93,6 +104,19 @@ def render_frame(
         lines.append(
             f"{'prefix hit rate':<24} {hit_tok / (hit_tok + pf_tok):>11.1%}"
         )
+    # overload view (docs/request_lifecycle.md): everything turned away with
+    # a 429 — gateway load shedding + engine admission rejections — as a
+    # fleet total, and as a rate once two frames exist
+    shed = _shed_total(snap)
+    if shed is not None:
+        lines.append(f"{'shed/rejected (429)':<24} {_fmt(shed):>12}")
+        if prev is not None:
+            prev_shed = _shed_total(prev)
+            dt = snap.scraped_at - prev.scraped_at
+            if prev_shed is not None and dt > 0:
+                lines.append(
+                    f"{'shed rate (429/s)':<24} {(shed - prev_shed) / dt:>12.1f}"
+                )
     pause_sum = _merged_value(snap, "areal_weight_update_pause_seconds_sum")
     pause_cnt = _merged_value(snap, "areal_weight_update_pause_seconds_count")
     if pause_sum is not None and pause_cnt:
@@ -158,6 +182,16 @@ areal_prefix_cache_hit_tokens_total 800
 # HELP areal_decode_prefill_tokens_total Prompt tokens prefilled.
 # TYPE areal_decode_prefill_tokens_total counter
 areal_decode_prefill_tokens_total 200
+# HELP areal_request_queue_depth Engine admission queue + backlog depth.
+# TYPE areal_request_queue_depth gauge
+areal_request_queue_depth 2
+# HELP areal_gateway_shed_total Requests load-shed at the gateway.
+# TYPE areal_gateway_shed_total counter
+areal_gateway_shed_total{priority="rollout"} 5
+areal_gateway_shed_total{priority="interactive"} 1
+# HELP areal_admission_rejected_total Requests rejected at engine admission.
+# TYPE areal_admission_rejected_total counter
+areal_admission_rejected_total{reason="queue_depth"} 4
 # HELP areal_weight_update_pause_seconds Availability gap per update.
 # TYPE areal_weight_update_pause_seconds histogram
 areal_weight_update_pause_seconds_bucket{le="1"} 2
@@ -220,6 +254,19 @@ def self_test() -> int:
                 "target merges to the same 80% ratio)",
             ),
             ("update pause (mean s)" in frame, "frame missing pause row"),
+            (
+                "lifecycle queue" in frame,
+                "frame missing lifecycle queue-depth row",
+            ),
+            (
+                _shed_total(snap) == 20,
+                "shed total: gateway (5+1) + admission (4) per target "
+                "should merge to 20",
+            ),
+            (
+                "shed/rejected (429)" in frame and "20" in frame,
+                "frame missing shed/rejected row",
+            ),
             ("DOWN  127.0.0.1:1" in frame, "frame missing down-target row"),
         ]
         failed = [msg for ok, msg in checks if not ok]

@@ -19,8 +19,15 @@ admission wave prefills only suffix tokens at >= 2x the cold prefill
 throughput, refcounts return to baseline, and a weight commit under the
 default policy leaves no stale-version pages matchable.
 
+``--overload-self-test`` drives a small in-process fleet at ~2x its
+sustained capacity with the chaos stall injector running, and asserts the
+overload-safety contract (docs/request_lifecycle.md): shed requests get
+clean 429 + Retry-After, admitted work keeps a bounded p99, the deadline
+reaper fires on the flood, and the PagePool ends with zero leaked pages.
+
 Usage: python -m areal_tpu.tools.validate_installation [--tpu]
     [--chaos-self-test] [--weight-sync-self-test] [--prefix-cache-self-test]
+    [--overload-self-test]
 """
 
 from __future__ import annotations
@@ -60,6 +67,14 @@ def main(argv=None) -> int:
         "assert radix reuse: warm admission prefills suffixes only at >= 2x "
         "cold throughput, zero refcount leaks, and a weight commit leaves "
         "no stale pages matchable",
+    )
+    p.add_argument(
+        "--overload-self-test",
+        action="store_true",
+        help="drive a small local fleet at ~2x sustained capacity with "
+        "chaos stalls and assert overload safety: clean 429 + Retry-After "
+        "for shed work, bounded p99 for admitted work, deadline reaping, "
+        "and zero leaked KV pages",
     )
     args = p.parse_args(argv)
     results: list[tuple[str, bool, str]] = []
@@ -176,6 +191,9 @@ def main(argv=None) -> int:
 
         _check("prefix_cache", prefix_cache, results)
 
+    if args.overload_self_test:
+        _check("overload", overload_self_test, results)
+
     width = max(len(n) for n, _, _ in results)
     ok = True
     for name, passed, detail in results:
@@ -273,6 +291,149 @@ def chaos_self_test(
             client.destroy()
         for st in servers:
             st.stop()
+
+
+def overload_self_test(
+    n_interactive: int = 4,
+    n_flood: int = 6,
+    flood_deadline_s: float = 2.0,
+    p99_bound_s: float = 60.0,
+    seed: int = 99,
+) -> str:
+    """One lifecycle-enabled server (2 slots, queue cap 3) driven at ~2x
+    sustained capacity — a flood of effectively-unbounded generations on
+    short deadlines rides alongside short interactive requests, with the
+    chaos stall injector perturbing every post. Asserts the overload
+    contract end to end; the tier-1 acceptance test
+    (tests/test_request_lifecycle.py::test_overload_acceptance) adds the
+    greedy byte-identity check against a lifecycle-disabled twin."""
+    import asyncio
+    import time
+
+    import aiohttp
+    import jax
+
+    from areal_tpu.api.config import (
+        ChaosConfig,
+        MeshConfig,
+        RequestLifecycleConfig,
+        ServerConfig,
+    )
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.inference.server import ServerThread
+    from areal_tpu.models import qwen
+    from areal_tpu.robustness import FaultInjector
+
+    tiny = qwen.ModelConfig(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_layers=2,
+        num_heads=2,
+        num_kv_heads=1,
+        dtype="float32",
+        tie_word_embeddings=True,
+        rope_theta=10000.0,
+    )
+    params = qwen.init_params(jax.random.PRNGKey(0), tiny)
+    cfg = ServerConfig(
+        max_batch_size=2,
+        max_seq_len=256,
+        decode_steps_per_call=4,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        lifecycle=RequestLifecycleConfig(
+            max_queue_depth=3, retry_after_s=0.1, watchdog_s=30.0
+        ),
+    )
+    eng = DecodeEngine(cfg, params=params, model_cfg=tiny)
+    eng.initialize()
+    srv = ServerThread(cfg, eng)
+    srv.start()
+    inj = FaultInjector(
+        ChaosConfig(enabled=True, seed=seed, stall_prob=0.3, stall_s=0.15)
+    )
+    stats = {"s429": 0, "latency": []}
+
+    async def one(i: int, ids, n_new: int, deadline_s: float | None, tag: str):
+        payload = {
+            "input_ids": ids,
+            "rid": f"{tag}-{i}",
+            "sampling_params": {"max_new_tokens": n_new, "greedy": True},
+        }
+        headers = {}
+        if deadline_s is not None:
+            headers["x-areal-deadline"] = f"{time.time() + deadline_s:.6f}"
+        t0 = time.monotonic()
+        async with aiohttp.ClientSession() as s:
+            for _ in range(200):  # bounded retry: no hung client
+                await inj.aperturb(srv.address, "/generate")
+                async with s.post(
+                    f"http://{srv.address}/generate",
+                    json=payload,
+                    headers=headers,
+                ) as r:
+                    if r.status == 429:
+                        stats["s429"] += 1
+                        ra = r.headers.get("Retry-After")
+                        if ra is None or float(ra) <= 0:
+                            raise AssertionError("429 without Retry-After")
+                        await asyncio.sleep(float(ra))
+                        continue
+                    assert r.status == 200, await r.text()
+                    await r.json()
+                    break
+            else:
+                raise AssertionError("client starved: 200 rejections")
+        if tag == "interactive":
+            stats["latency"].append(time.monotonic() - t0)
+
+    async def drive():
+        # 2 slots + queue cap 3 vs. n_interactive + n_flood concurrent
+        # requests (the flood ignores EOS) = ~2x sustained capacity
+        await asyncio.gather(
+            *[
+                one(i, [3 + i, 14 + i, 15], 8, None, "interactive")
+                for i in range(n_interactive)
+            ],
+            *[
+                one(i, [40 + i, 2, 2], 100_000, flood_deadline_s, "flood")
+                for i in range(n_flood)
+            ],
+        )
+
+    try:
+        asyncio.run(drive())
+        if stats["s429"] == 0:
+            raise AssertionError("overload never shed — not a 2x run")
+        p99 = max(stats["latency"])  # == p99 at this sample count
+        if p99 >= p99_bound_s:
+            raise AssertionError(f"admitted p99 {p99:.1f}s >= {p99_bound_s}s")
+        if eng.stats["deadline_exceeded"] == 0:
+            raise AssertionError("deadline reaper never fired on the flood")
+        if inj.stats()["stall"] == 0:
+            raise AssertionError("chaos stalls never fired")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            snap = eng.admission_snapshot()
+            if snap["queue_depth"] == 0 and snap["active_slots"] == 0:
+                break
+            time.sleep(0.05)
+        held = (
+            eng.prefix_cache_stats()["pages_held"]
+            if eng._radix is not None
+            else 0
+        )
+        leaked = eng.pool.used - held
+        if leaked != 0:
+            raise AssertionError(f"{leaked} KV pages leaked after overload")
+        return (
+            f"{n_interactive}+{n_flood} reqs @2x: {stats['s429']} clean 429s, "
+            f"admitted p99 {p99:.1f}s, "
+            f"{eng.stats['deadline_exceeded']} deadline reaps, "
+            f"{inj.stats()['stall']} stalls, 0 leaked pages"
+        )
+    finally:
+        srv.stop()
 
 
 if __name__ == "__main__":

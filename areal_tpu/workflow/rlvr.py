@@ -113,7 +113,12 @@ class RLVRWorkflow(RolloutWorkflow):
                         [np.full(p, -1, np.int32), np.asarray(resp.output_versions, np.int32)]
                     ),
                     "rewards": np.float32(reward),
-                    "seq_no_eos_mask": np.bool_(resp.stop_reason == "length"),
+                    # length-capped AND lifecycle-truncated (deadline /
+                    # cancel / watchdog) sequences did not choose to stop:
+                    # the trainer must not score them as EOS-terminated
+                    "seq_no_eos_mask": np.bool_(
+                        resp.stop_reason == "length" or bool(resp.truncated_by)
+                    ),
                 }
             )
             stats_tracker.get().scalar(

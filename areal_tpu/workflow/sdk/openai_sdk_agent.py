@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import json
 
+from areal_tpu.workflow.sdk import ROLLOUT_PRIORITY_HEADERS
+
 try:
     from openai import AsyncOpenAI
 except ImportError as e:  # pragma: no cover - SDK not in the TPU image
@@ -61,7 +63,11 @@ async def run_math_agent(
 ) -> str:
     """Tool-loop math agent: the SDK talks to the gateway like any OpenAI
     endpoint; returns the final assistant message content."""
-    client = AsyncOpenAI(base_url=f"{base_url}/v1", api_key=api_key)
+    client = AsyncOpenAI(
+        base_url=f"{base_url}/v1",
+        api_key=api_key,
+        default_headers=ROLLOUT_PRIORITY_HEADERS,
+    )
     messages = [
         {
             "role": "system",

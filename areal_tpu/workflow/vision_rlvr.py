@@ -123,7 +123,12 @@ class VisionRLVRWorkflow(RolloutWorkflow):
             # per-patch grid (row, col) for the tower's 2-D rope — ragged
             # like pixel_values, so batching machinery treats them alike
             "pixel_pos_ids": self._pos_ids(pixel_values, grid_thw),
-            "seq_no_eos_mask": np.bool_(resp.stop_reason == "length"),
+            # length-capped AND lifecycle-truncated (deadline / cancel /
+            # watchdog) sequences did not choose to stop: the trainer must
+            # not score them as EOS-terminated
+            "seq_no_eos_mask": np.bool_(
+                resp.stop_reason == "length" or bool(resp.truncated_by)
+            ),
         }
 
     def _pos_ids(self, pixel_values, grid_thw) -> np.ndarray:

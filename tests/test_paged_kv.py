@@ -6,6 +6,7 @@ core property — KV memory ∝ used tokens, correct under pressure — the role
 SGLang's paged allocator plays for the reference (blog/AReaL_v0_3.md:266)."""
 
 import threading
+import time
 
 import jax
 import numpy as np
@@ -239,3 +240,146 @@ def test_radix_interior_eviction_never_orphans_children():
     # evicting everything walks bottom-up and empties cleanly
     assert tree.evict(10) == 3
     assert tree.pages_held == 0 and pool.used == 0
+
+
+# ---------------------------------------------------------------------------
+# abort page accounting (request lifecycle manager, ISSUE 6): cancelling a
+# request at any point of its life must return every page — alias-refcounted
+# radix pages included
+# ---------------------------------------------------------------------------
+
+
+def _audit_zero(eng):
+    """Every page still out must be the radix tree's own claim; flushing the
+    tree must drain the pool to zero."""
+    assert eng.pool.used == eng.prefix_cache_stats().get("pages_held", 0), (
+        "pages out beyond the radix tree's claim"
+    )
+    eng.flush_prefix_cache()
+    assert eng.pool.used == 0, "pages leaked after abort"
+
+
+def _submit_until_decoding(eng, req):
+    done = threading.Event()
+    box = {}
+    eng.submit(req, lambda r: (box.update(r=r), done.set()))
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if any(
+            t is not None and t.req.rid == req.rid and t.out_tokens
+            for t in eng._slot_task
+        ):
+            return done, box
+        time.sleep(0.02)
+    raise TimeoutError("request never started decoding")
+
+
+def test_abort_before_prefill_returns_every_page():
+    """A request cancelled while still queued (the pre-prefill boundary: the
+    reap runs between loop passes, and admission+prefill are atomic within
+    one pass) never allocates a page."""
+    eng = _engine(n_slots=2)
+    try:
+        # keep the loop busy so the victim stays queued
+        fills = [
+            ModelRequest(
+                rid=f"fill{i}",
+                input_ids=[7 + i, 8, 9],
+                gconfig=GenerationHyperparameters(
+                    max_new_tokens=100_000, greedy=True, ignore_eos=True
+                ),
+            )
+            for i in range(2)
+        ]
+        fill_done = []
+        eng.start()
+        for f in fills:
+            d = threading.Event()
+            eng.submit(f, lambda r, d=d: d.set())
+            fill_done.append(d)
+        victim = ModelRequest(
+            rid="victim",
+            input_ids=[1, 2, 3, 4],
+            gconfig=GenerationHyperparameters(max_new_tokens=8, greedy=True),
+        )
+        vd = threading.Event()
+        vbox = {}
+        eng.submit(victim, lambda r: (vbox.update(r=r), vd.set()))
+        eng.abort_request("victim")
+        assert vd.wait(30)
+        assert vbox["r"].stop_reason == "cancelled"
+        assert vbox["r"].output_tokens == []
+        for f in fills:
+            eng.abort_request(f.rid)
+        for d in fill_done:
+            assert d.wait(60)
+    finally:
+        eng.stop()
+    _audit_zero(eng)
+
+
+def test_abort_mid_decode_returns_aliased_radix_pages():
+    """Abort a request whose prompt pages were ALIASED out of the radix
+    cache (refcount++ at admission): the abort drops only the request's
+    refs — the tree's claims stay intact, and a flush drains to zero."""
+    eng = _engine(n_slots=2, max_len=512)
+    prompt = list(range(100, 100 + 256))  # two full pages: radix-publishable
+    try:
+        eng.start()
+        # warm the tree: a completed request publishes its prompt pages
+        warm = ModelRequest(
+            rid="warm",
+            input_ids=prompt,
+            gconfig=GenerationHyperparameters(max_new_tokens=4, greedy=True),
+        )
+        [r0] = _run_all(eng, [warm])
+        assert eng.prefix_cache_stats()["pages_held"] >= 2
+        hits_before = eng.stats["prefix_cache_hits"]
+        # same prompt again: admission aliases the cached prefix pages
+        victim = ModelRequest(
+            rid="victim2",
+            input_ids=prompt,
+            gconfig=GenerationHyperparameters(
+                max_new_tokens=100_000, greedy=True, ignore_eos=True
+            ),
+        )
+        done, box = _submit_until_decoding(eng, victim)
+        assert eng.stats["prefix_cache_hits"] == hits_before + 1
+        eng.abort_request("victim2")
+        assert done.wait(30)
+        assert box["r"].stop_reason == "cancelled"
+    finally:
+        eng.stop()
+    _audit_zero(eng)
+
+
+def test_abort_while_parked_returns_every_page():
+    """A rid parked by an abort-pause (KV retained for resume) and then
+    cancelled must free the parked pages — they are owned by the parked
+    entry, not a slot."""
+    eng = _engine(n_slots=2)
+    try:
+        eng.start()
+        req = ModelRequest(
+            rid="parked",
+            input_ids=[3, 1, 4, 1, 5, 9],
+            gconfig=GenerationHyperparameters(
+                max_new_tokens=100_000, greedy=True, ignore_eos=True
+            ),
+        )
+        done, box = _submit_until_decoding(eng, req)
+        eng.pause_generation()  # abort-pause: rid parks, keeps its pages
+        assert done.wait(30)
+        assert box["r"].stop_reason == "abort"
+        assert "parked" in eng._parked
+        parked_pages = list(eng._parked["parked"].pages)
+        assert parked_pages, "nothing parked to audit"
+        eng.abort_request("parked")
+        eng.continue_generation()
+        deadline = time.monotonic() + 30
+        while "parked" in eng._parked and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert "parked" not in eng._parked
+    finally:
+        eng.stop()
+    _audit_zero(eng)
