@@ -71,6 +71,19 @@ class ModelRequest:
     deadline: float | None = None
 
 
+# the per-stage latency keys of the request-timeline breakdown, in the
+# shape they travel: ModelResponse fields == /generate "timing" keys ==
+# the client's cross-attempt accumulator == the proxy's areal_timing
+# extension. One tuple so adding a stage is one edit, not five.
+TIMING_FIELDS = (
+    "queue_wait_s",
+    "prefill_s",
+    "decode_s",
+    "fence_stall_s",
+    "park_s",
+)
+
+
 @dataclasses.dataclass
 class ModelResponse:
     """Generation result with per-token bookkeeping.
@@ -92,6 +105,18 @@ class ModelResponse:
     truncated_by: str = ""
     latency: float = 0.0
     ttft: float = 0.0
+    # request-timeline breakdown (observability/timeline.py): per-stage
+    # latency attribution stamped by the engine at the terminal and summed
+    # across abort/resume attempts by the client, so WorkflowExecutor /
+    # trainer code can attribute rollout stalls without scraping metrics.
+    # queue_wait + prefill + decode + fence_stall ≈ latency (park_s is the
+    # abort-pause wait a resumed request carried; it overlaps queue_wait
+    # of the resubmitted attempt and is informational).
+    queue_wait_s: float = 0.0
+    prefill_s: float = 0.0
+    decode_s: float = 0.0
+    fence_stall_s: float = 0.0
+    park_s: float = 0.0
     rid: str = ""
     metadata: dict[str, Any] = dataclasses.field(default_factory=dict)
 

@@ -22,6 +22,7 @@ import asyncio
 import random
 import threading
 import time
+from collections import OrderedDict
 from typing import Callable
 
 import aiohttp
@@ -29,7 +30,13 @@ import numpy as np
 
 from areal_tpu.api.config import InferenceEngineConfig
 from areal_tpu.api.engine_api import InferenceEngine
-from areal_tpu.api.io_struct import ModelRequest, ModelResponse, StopReason, WeightUpdateMeta
+from areal_tpu.api.io_struct import (
+    TIMING_FIELDS,
+    ModelRequest,
+    ModelResponse,
+    StopReason,
+    WeightUpdateMeta,
+)
 from areal_tpu.infra.workflow_executor import WorkflowExecutor
 from areal_tpu.observability import catalog, tracecontext
 from areal_tpu.robustness import retry as _retry
@@ -121,6 +128,19 @@ class RemoteJaxEngine(InferenceEngine):
         # server-side instead of orphaning slots (docs/request_lifecycle.md)
         self._task_rids_lock = threading.Lock()
         self._task_rids: dict[str, dict[str, str]] = {}  # task_id -> rid -> addr
+        # per-workflow-task latency attribution (observability/timeline.py
+        # breakdown summed over the task's requests); WorkflowExecutor pops
+        # it via take_task_latency for the per-trajectory latency log line.
+        # Taken task ids are tombstoned (bounded): a quarantined task's
+        # aborted generations resolve AFTER the executor pops, and their
+        # late _note_task_latency must not re-create an entry nobody will
+        # ever pop again. Tombstones age out by TTL, not count — a busy
+        # trainer completes hundreds of tasks while one quarantined task's
+        # abort round-trips, and count-based eviction would churn the
+        # tombstone out before its stragglers land
+        self._task_latency_lock = threading.Lock()
+        self._task_latency: dict[str, dict[str, float]] = {}
+        self._task_latency_tombstones: "OrderedDict[str, float]" = OrderedDict()
         # abort posts run off-thread through ONE small shared pool: a mass
         # teardown (N coroutines cancelled at once) must not spawn N
         # threads, and a quarantining dispatcher must not serially block on
@@ -383,6 +403,9 @@ class RemoteJaxEngine(InferenceEngine):
         remaining = g.max_new_tokens
         start = time.monotonic()
         ttft = None
+        # stage breakdown summed across abort/resume attempts (each server
+        # attempt stamps its own timeline; the logical request is the sum)
+        timing = {k: 0.0 for k in TIMING_FIELDS}
         stop_reason = StopReason.ABORT.value
         truncated_by = ""
         attempt_input = list(req.input_ids)
@@ -439,14 +462,20 @@ class RemoteJaxEngine(InferenceEngine):
                         ),
                     },
                 }
-                headers = (
-                    {"x-areal-deadline": f"{deadline:.6f}"}
-                    if deadline is not None
-                    else None
-                )
+                headers = {}
+                if deadline is not None:
+                    headers["x-areal-deadline"] = f"{deadline:.6f}"
+                prio = req.metadata.get("priority")
+                if prio:
+                    # priority class rides to the engine so server-side
+                    # TTFT histograms split by class (timeline metrics)
+                    headers["x-areal-priority"] = str(prio)
                 addr, data = await self._post_json_failover(
-                    addr, "/generate", payload, extra_headers=headers
+                    addr, "/generate", payload, extra_headers=headers or None
                 )
+                tm = data.get("timing") or {}
+                for k in timing:
+                    timing[k] += float(tm.get(k) or 0.0)
                 if req.rid:
                     # failover may have moved us: resumes + pause-polls must
                     # follow the replica that actually holds the request
@@ -462,7 +491,21 @@ class RemoteJaxEngine(InferenceEngine):
                 logprobs.extend(data["output_logprobs"])
                 versions.extend(data["output_versions"])
                 if ttft is None and toks:
-                    ttft = time.monotonic() - start
+                    # prefer the ENGINE's first-token stamp: for the
+                    # non-streaming /generate the HTTP response lands after
+                    # the attempt's whole decode, so a client-side stamp
+                    # here would be ~e2e latency, not TTFT. Anchor on the
+                    # response receipt minus the engine's own latency —
+                    # that locates the engine submit instant on the client
+                    # clock even when failover/backoff burned time BEFORE
+                    # the successful replica accepted the request
+                    eng_ttft = float(data.get("ttft") or 0.0)
+                    eng_lat = float(data.get("latency") or 0.0)
+                    t_end = time.monotonic()
+                    if eng_ttft > 0 and eng_lat > 0:
+                        ttft = max(0.0, (t_end - start) - eng_lat + eng_ttft)
+                    else:
+                        ttft = t_end - start
                 stop_reason = data["stop_reason"]
                 truncated_by = data.get("truncated_by", "") or ""
                 remaining -= len(toks)
@@ -499,7 +542,7 @@ class RemoteJaxEngine(InferenceEngine):
             self._rid_affinity.pop(req.rid, None)
             self._deregister_task_rid(owner_task, req.rid)
 
-        return ModelResponse(
+        resp = ModelResponse(
             input_tokens=list(req.input_ids),
             output_tokens=accumulated,
             output_logprobs=logprobs,
@@ -508,9 +551,50 @@ class RemoteJaxEngine(InferenceEngine):
             truncated_by=truncated_by,
             latency=time.monotonic() - start,
             ttft=ttft or (time.monotonic() - start),
+            **timing,
             rid=req.rid,
             metadata=dict(req.metadata),
         )
+        if owner_task is not None:
+            self._note_task_latency(owner_task, resp)
+        return resp
+
+    def _note_task_latency(self, task_id: str, resp: ModelResponse) -> None:
+        """Fold one finished request's stage breakdown into its workflow
+        task's aggregate (popped by WorkflowExecutor per trajectory)."""
+        with self._task_latency_lock:
+            if task_id in self._task_latency_tombstones:
+                return  # straggler of an already-popped (quarantined) task
+            agg = self._task_latency.setdefault(
+                task_id,
+                {
+                    "requests": 0.0,
+                    "tokens": 0.0,
+                    "e2e_s": 0.0,
+                    **{k: 0.0 for k in TIMING_FIELDS},
+                    "ttft_max_s": 0.0,
+                },
+            )
+            agg["requests"] += 1
+            agg["tokens"] += resp.output_len
+            agg["e2e_s"] += resp.latency
+            for k in TIMING_FIELDS:
+                agg[k] += getattr(resp, k)
+            agg["ttft_max_s"] = max(agg["ttft_max_s"], resp.ttft)
+
+    def take_task_latency(self, task_id: str) -> dict[str, float] | None:
+        """Pop the accumulated latency breakdown of one workflow task (all
+        generation requests it issued). None when nothing was recorded."""
+        now = time.monotonic()
+        with self._task_latency_lock:
+            self._task_latency_tombstones[task_id] = now
+            ts = self._task_latency_tombstones
+            # insertion order is time order: purge from the oldest end
+            while ts and (
+                now - next(iter(ts.values())) > 600.0 or len(ts) > 65536
+            ):
+                ts.popitem(last=False)
+            return self._task_latency.pop(task_id, None)
 
     async def _await_unpaused(self, addr: str) -> None:
         while True:

@@ -53,10 +53,12 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from areal_tpu.api.config import ServerConfig
+from areal_tpu.api import io_struct
 from areal_tpu.api.io_struct import ModelRequest, ModelResponse, StopReason
 from areal_tpu.models import qwen
 from areal_tpu.models.hf import load_params_from_hf
 from areal_tpu.observability import catalog as obs_catalog
+from areal_tpu.observability import timeline as tl_mod
 from areal_tpu.parallel import mesh as mesh_lib
 from areal_tpu.utils.jax_compat import set_mesh
 from areal_tpu.utils import logging as alog
@@ -88,6 +90,9 @@ class _Task:
     # lifecycle truncation flag carried into the response: "deadline",
     # "watchdog", or "cancelled" ("" = normal termination)
     truncated_by: str = ""
+    # request timeline (observability/timeline.py): stage events + the
+    # fence-stall/park accumulators, attached at submit time
+    timeline: tl_mod.RequestTimeline | None = None
 
 
 @dataclass
@@ -294,6 +299,13 @@ class DecodeEngine:
         # decode-loop liveness: last time the loop completed a pass (the
         # wedge detector /health consults) — monotonic seconds
         self._last_loop_ts = time.monotonic()
+        # request timeline observatory + flight recorder
+        # (observability/timeline.py): per-request stage attribution and
+        # the significant-event ring /debug/flight serves
+        self.timeline = tl_mod.TimelineRecorder()
+        self.flight = tl_mod.get_flight_recorder()
+        self._hold_marked = False  # one FENCE_STALL mark per hold window
+        self._wedge_dumped = False  # one flight dump per wedge escalation
 
     # -- lifecycle --------------------------------------------------------
     def initialize(self) -> None:
@@ -740,7 +752,19 @@ class DecodeEngine:
 
     # -- request API (any thread) ----------------------------------------
     def submit(self, req: ModelRequest, callback: Callable[[ModelResponse], None]):
-        self._queue.put(_Task(req=req, callback=callback))
+        # timeline starts at submission; the x-areal-trace ids are whatever
+        # the calling context carries (the HTTP server seats them before
+        # submitting), so cross-process postmortems correlate on them
+        from areal_tpu.utils import perf_tracer
+
+        task_id, session_id = perf_tracer.get_task_context()
+        tl = self.timeline.start(
+            req.rid,
+            priority=str(req.metadata.get("priority") or "interactive"),
+            task_id=task_id,
+            session_id=session_id,
+        )
+        self._queue.put(_Task(req=req, callback=callback, timeline=tl))
         self._wakeup.set()
 
     def generate_sync(self, req: ModelRequest, timeout: float = 600.0) -> ModelResponse:
@@ -838,12 +862,30 @@ class DecodeEngine:
             # the loop CRASHED (stop() nulls _thread after joining): pending
             # work can never drain, so escalate immediately — the heartbeat
             # below would never go stale-r, and waiting helps nobody
-            return busy
-        if self.is_paused:  # held/paused loops idle legitimately
+            wedged = busy
+        elif self.is_paused:  # held/paused loops idle legitimately
             return False
-        return busy and (
-            time.monotonic() - self._last_loop_ts > lc.engine_stall_escalate_s
-        )
+        else:
+            wedged = busy and (
+                time.monotonic() - self._last_loop_ts
+                > lc.engine_stall_escalate_s
+            )
+        if not wedged:
+            # a transient stall (slow cold compile) that recovered must not
+            # consume the once-only dump: re-arm so a LATER real wedge
+            # still leaves its postmortem artifact (one dump per episode)
+            self._wedge_dumped = False
+        elif not self._wedge_dumped:
+            # flight ring to disk NOW — supervision is about to evict and
+            # respawn this replica, and the postmortem needs the last
+            # events even if the process never answers another scrape
+            self._wedge_dumped = True
+            self.flight.record("wedge", severity="error")
+            try:
+                self.flight.dump(tl_mod.default_dump_path("wedge"), "wedge")
+            except OSError:
+                logger.exception("wedge flight dump failed")
+        return wedged
 
     def _reap_lifecycle(self, pending: dict | None) -> dict | None:
         """Service cancellations, deadline expirations, and the per-slot
@@ -934,6 +976,12 @@ class DecodeEngine:
                 reason = StopReason.CANCEL.value
                 self.stats["watchdog_fired"] += 1
                 self._obs_lc.watchdog_fired.inc()
+                self.flight.record(
+                    "watchdog",
+                    severity="error",
+                    slot=slot,
+                    rid=task.req.rid,
+                )
                 logger.warning(
                     f"slot {slot} watchdog: no token in {lc.watchdog_s:.1f}s "
                     f"(rid={task.req.rid}); aborting the slot"
@@ -1146,6 +1194,7 @@ class DecodeEngine:
             # tokens emitted between begin and commit-applied = the work the
             # fleet did NOT lose to this update (zero-pause visibility)
             self._stage_gen_snapshot = self.stats["generated_tokens"]
+        self.flight.record("weight_stage", target=target)
 
     def stage_weight_bucket(self, flat: dict[str, np.ndarray]) -> None:
         """Stage one bucket WITHOUT touching served params: device target
@@ -1319,6 +1368,12 @@ class DecodeEngine:
                 if freed:
                     self._obs_pc.evicted_pages.inc(freed)
             self._pending_weight_update = None
+            self.flight.record(
+                "weight_commit",
+                update_kind=kind,
+                version=self._version,
+                secs=round(time.monotonic() - t0, 4),
+            )
             logger.info(
                 f"weights updated ({kind}) to v{self._version} in "
                 f"{time.monotonic()-t0:.2f}s"
@@ -1754,8 +1809,12 @@ class DecodeEngine:
             freed = self._radix.evict(n)
             if freed > 0:
                 self._obs_pc.evicted_pages.inc(freed)
+                self.flight.record("evict_radix", pages=freed)
                 return True
-        return self._evict_oldest_parked() is not None
+        slot = self._evict_oldest_parked()
+        if slot is not None:
+            self.flight.record("evict_parked", severity="warn", slot=slot)
+        return slot is not None
 
     def _pack_row(
         self,
@@ -1802,6 +1861,13 @@ class DecodeEngine:
         """Admit ``task`` into ``slot``: derive per-slot sampling state from
         the request and pack the device scatter row."""
         self._slot_progress[slot] = time.monotonic()  # watchdog baseline
+        if task.timeline is not None:
+            task.timeline.version = self._version
+            # the prefill paths mark ADMITTED pre-prefill; only resumes and
+            # other direct admissions stamp it here (a second mark would
+            # drag the trace's queue_wait span over the prefill window)
+            if task.timeline.ts_of(tl_mod.ADMITTED) is None:
+                task.timeline.mark(tl_mod.ADMITTED, slot=slot)
         g = task.req.gconfig
         temp = 0.0 if g.greedy else g.temperature
         greedy = bool(g.greedy or g.temperature == 0.0)
@@ -1878,6 +1944,13 @@ class DecodeEngine:
         del self._parked[rid]
         slot = p.slot
         P_len = len(ids)
+        if task.timeline is not None:
+            # the abort-pause round-trip this resume closes: attributed to
+            # the RESUMED attempt (the aborted attempt's timeline already
+            # terminated with stop_reason=abort)
+            park_s = max(0.0, time.monotonic() - p.park_time)
+            task.timeline.park_s += park_s
+            task.timeline.mark(tl_mod.RESUME, park_s=round(park_s, 6))
         task.slot = slot
         task.prompt_len = P_len
         self._slot_task[slot] = task
@@ -2072,6 +2145,15 @@ class DecodeEngine:
             admitted.append((task, slot, mpages, mvers))
         if not admitted:
             return []
+        for task, slot, mpages, _mvers in admitted:
+            if task.timeline is not None:
+                task.timeline.mark(tl_mod.ADMITTED, slot=slot)
+                task.timeline.mark(
+                    tl_mod.RADIX_MATCH,
+                    hit_pages=len(mpages),
+                    hit_tokens=len(mpages) * psz,
+                )
+                task.timeline.mark(tl_mod.PREFILL_START)
         A = len(admitted)
         flat_pages = np.stack(page_rows)
         ids_np = np.zeros((A, bucket), np.int32)
@@ -2114,6 +2196,8 @@ class DecodeEngine:
         for j, (task, slot, mpages, _mvers) in enumerate(admitted):
             full = list(task.req.input_ids)
             P_len = len(full)
+            if task.timeline is not None:
+                task.timeline.mark(tl_mod.PREFILL_END, suffix_tokens=int(plens[j]))
             task.slot = slot
             task.prompt_len = P_len
             self._slot_task[slot] = task
@@ -2235,6 +2319,10 @@ class DecodeEngine:
             admitted.append((task, slot))
         if not admitted:
             return []
+        for task, slot in admitted:
+            if task.timeline is not None:
+                task.timeline.mark(tl_mod.ADMITTED, slot=slot)
+                task.timeline.mark(tl_mod.PREFILL_START)
         A = len(admitted)
         flat_pages = np.stack(page_rows)
         ids_np = np.zeros((A, bucket), np.int32)
@@ -2272,6 +2360,8 @@ class DecodeEngine:
         rows = []
         for j, (task, slot) in enumerate(admitted):
             P_len = int(plens[j])
+            if task.timeline is not None:
+                task.timeline.mark(tl_mod.PREFILL_END, prompt_tokens=P_len)
             task.slot = slot
             task.prompt_len = P_len
             self._slot_task[slot] = task
@@ -2372,6 +2462,14 @@ class DecodeEngine:
             self._slot_pages[task.slot] = []
             self._slot_page_versions[task.slot] = []
             self._pt_host[task.slot] = 0
+        bd: dict[str, float] = {}
+        if task.timeline is not None:
+            # terminal stage event + catalogued histogram observation; the
+            # breakdown rides the response so callers attribute latency
+            # without scraping (docs/observability.md "Request timelines")
+            bd = self.timeline.complete(
+                task.timeline, reason, len(task.out_tokens)
+            )
         resp = ModelResponse(
             input_tokens=list(task.req.input_ids),
             output_tokens=task.out_tokens,
@@ -2381,6 +2479,7 @@ class DecodeEngine:
             truncated_by=task.truncated_by,
             latency=time.monotonic() - task.submit_time,
             ttft=(task.first_token_time or time.monotonic()) - task.submit_time,
+            **{k: bd.get(k, 0.0) for k in io_struct.TIMING_FIELDS},
             rid=task.req.rid,
             metadata=dict(task.req.metadata),
         )
@@ -2421,6 +2520,10 @@ class DecodeEngine:
                         n_emitted=len(task.out_tokens),
                     )
                     self._parked[rid] = p
+                    if task.timeline is not None:
+                        task.timeline.mark(
+                            tl_mod.PARK, n_emitted=len(task.out_tokens)
+                        )
                     # park-time publication: if this parking is later
                     # evicted (or the rid resubmits with EXTENDED content —
                     # a multi-turn episode's next turn), the radix tree
@@ -2561,6 +2664,9 @@ class DecodeEngine:
         task = self._slot_task[slot]
         st = self._state
         row = self._pack_row(slot, 0, int(st["pos"][slot]), False, 0)
+        self.flight.record(
+            "preempt", severity="warn", slot=slot, rid=task.req.rid
+        )
         self._finish(task, StopReason.ABORT.value)
         self.stats["preempted"] = self.stats.get("preempted", 0) + 1
         return row
@@ -2641,6 +2747,14 @@ class DecodeEngine:
             if c:
                 if task.first_token_time is None:
                     task.first_token_time = now
+                    if task.timeline is not None:
+                        task.timeline.mark(tl_mod.FIRST_TOKEN)
+                if task.timeline is not None:
+                    # per-chunk decode cadence; the timeline's event cap
+                    # bounds long generations (durations stay exact)
+                    task.timeline.mark(
+                        tl_mod.DECODE_CHUNK, n_tokens=c, version=version
+                    )
                 self._slot_progress[slot] = now  # watchdog: progress seen
                 # .tolist() converts in C — a genexpr of int()/float() costs
                 # ~S*n_steps Python calls per chunk on the serving hot loop
@@ -2709,6 +2823,7 @@ class DecodeEngine:
                     self._held.clear()
                     self._hold_ack.clear()
                     continue
+                drained_chunk = pending is not None
                 self._drain(pending)
                 pending = None
                 # a hold is legitimate idleness: keep the per-slot watchdog
@@ -2717,15 +2832,40 @@ class DecodeEngine:
                 for slot, t in enumerate(self._slot_task):
                     if t is not None:
                         self._slot_progress[slot] = now_m
+                if not self._hold_marked:
+                    # timeline: one FENCE_STALL event per hold window on
+                    # every live request (the stall seconds accumulate
+                    # below, pass by pass)
+                    self._hold_marked = True
+                    for t in self._slot_task:
+                        if t is not None and t.timeline is not None:
+                            t.timeline.mark(tl_mod.FENCE_STALL)
                 self._hold_ack.set()
+                # the stall window opens at the TOP of this pass
+                # (_last_loop_ts): the staged-commit apply — the one H2D
+                # under stage_target="host" — ran before this branch and is
+                # fence stall, not decode. Only the pass that drained a real
+                # in-flight chunk starts here instead (that chunk's compute
+                # produced credited tokens, i.e. decode time).
+                t_stall = (
+                    time.monotonic() if drained_chunk else self._last_loop_ts
+                )
                 self._wakeup.wait(timeout=0.05)
                 self._wakeup.clear()
+                dt_stall = time.monotonic() - t_stall
+                for t in self._slot_task:
+                    if t is not None and t.timeline is not None:
+                        t.timeline.fence_stall_s += dt_stall
+                        if t.first_token_time is None:
+                            # pre-first-token stall: outside TPOT's window
+                            t.timeline.fence_stall_pre_first_s += dt_stall
                 continue
             if self.cache is None:
                 # memory released and not yet resumed: nothing to run on
                 self._wakeup.wait(timeout=0.05)
                 self._wakeup.clear()
                 continue
+            self._hold_marked = False  # next hold window marks afresh
             # lifecycle reaping BETWEEN chunks: cancellations, expired
             # deadlines (queued and decoding), per-slot watchdog — the
             # overload-safety half of interruptible generation. When a reap

@@ -19,9 +19,11 @@ import numpy as np
 from aiohttp import web
 
 from areal_tpu.api.config import ServerConfig
+from areal_tpu.api import io_struct
 from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
 from areal_tpu.inference.decode_engine import DecodeEngine
 from areal_tpu.observability import catalog, tracecontext
+from areal_tpu.observability import timeline as tl_mod
 from areal_tpu.observability.metrics import get_registry
 from areal_tpu.utils import logging as alog, network
 from areal_tpu.utils import name_resolve, perf_tracer
@@ -79,6 +81,18 @@ class InferenceServer:
         self._lc_obs = catalog.lifecycle_metrics()
         self._started_at = time.time()
         self._update_begin_ts: float | None = None
+        # flight recorder: the engine's ring when it has one (DecodeEngine),
+        # else the process default — /debug/flight serves it either way
+        self._flight = getattr(
+            self.engine, "flight", None
+        ) or tl_mod.get_flight_recorder()
+        # role travels INSIDE the ring, not just the HTTP snapshot: the
+        # wedge/SIGTERM disk dumps serialize the recorder directly, and
+        # postmortem keys its merged process rows on this field.
+        # First claimant wins (mirror of the controller's guard): a
+        # colocated controller's earlier claim must not be clobbered
+        if self._flight.role == "proc":
+            self._flight.role = "inference_server"
 
     @property
     def address(self) -> str:
@@ -108,6 +122,7 @@ class InferenceServer:
                 web.post("/resume_memory_occupation", self.h_resume_memory),
                 web.post("/flush_prefix_cache", self.h_flush_prefix_cache),
                 web.post("/abort_request", self.h_abort_request),
+                web.get("/debug/flight", self.h_debug_flight),
             ]
         )
         return app
@@ -193,6 +208,32 @@ class InferenceServer:
         snap = getattr(self.engine, "admission_snapshot", None)
         if snap is not None:
             out["lifecycle"] = snap()
+        tl = getattr(self.engine, "timeline", None)
+        if tl is not None:
+            # same key as /debug/flight's stats section — over THERE
+            # "timelines" is the list of timeline records
+            out["timeline_stats"] = tl.stats()
+        return web.json_response(out)
+
+    async def h_debug_flight(self, request: web.Request) -> web.Response:
+        """Flight-recorder scrape (observability/timeline.py): the bounded
+        significant-event ring plus recently completed request timelines.
+        ``tools/postmortem.py`` merges these across the fleet into one
+        Perfetto trace; ``?timelines=N`` bounds the timeline payload."""
+        self._metrics.requests.labels(endpoint="debug_flight").inc()
+        try:
+            n_tl = int(request.query.get("timelines", "128"))
+        except ValueError:
+            n_tl = 128
+        # snapshot() carries the ring's authoritative role (first claimant
+        # — may be a colocated controller's); don't clobber it here or the
+        # live scrape and the same ring's disk dumps disagree
+        out = self._flight.snapshot()
+        out["address"] = self.address
+        tl = getattr(self.engine, "timeline", None)
+        if tl is not None:
+            out["timeline_stats"] = tl.stats()
+            out["timelines"] = tl.recent(max(0, n_tl))
         return web.json_response(out)
 
     async def h_flush_prefix_cache(self, request: web.Request) -> web.Response:
@@ -219,6 +260,12 @@ class InferenceServer:
                 lc = getattr(self.engine.config, "lifecycle", None)
                 retry_after = getattr(lc, "retry_after_s", 1.0) or 1.0
                 self._lc_obs.admission_rejected.labels(reason=reason).inc()
+                self._flight.record(
+                    "admission_reject",
+                    severity="warn",
+                    reason=reason,
+                    queue_depth=snap.get("queue_depth"),
+                )
                 return web.json_response(
                     {"status": "rejected", "reason": reason, **snap},
                     status=429,
@@ -226,6 +273,14 @@ class InferenceServer:
                 )
         d = await request.json()
         req = _req_from_json(d)
+        # priority class rides x-areal-priority (gateway load-shedding
+        # classes; docs/request_lifecycle.md) into request metadata so the
+        # engine's timeline histograms split TTFT by class
+        prio = request.headers.get(
+            "x-areal-priority", req.metadata.get("priority", "")
+        )
+        if prio:
+            req.metadata["priority"] = str(prio).lower()
         # deadline rides the x-areal-deadline header (absolute unix epoch
         # seconds) end-to-end; a JSON "deadline" field is the fallback for
         # hand-rolled callers. Header wins: the outermost hop (gateway)
@@ -276,6 +331,12 @@ class InferenceServer:
                 "truncated_by": resp.truncated_by,
                 "latency": resp.latency,
                 "ttft": resp.ttft,
+                # per-request stage breakdown (observability/timeline.py);
+                # the client sums these across abort/resume attempts and
+                # stamps them onto its ModelResponse
+                "timing": {
+                    k: getattr(resp, k) for k in io_struct.TIMING_FIELDS
+                },
                 "rid": resp.rid,
             }
         )
@@ -687,6 +748,9 @@ def main(argv=None) -> None:
     args, rest = p.parse_known_args(argv)
     cfg, _ = load_expr_config(rest, ServerConfig)
     server = InferenceServer(cfg)
+    # flight recorder: persist the significant-event ring on SIGTERM so an
+    # externally killed replica still leaves a postmortem artifact
+    tl_mod.install_signal_dump()
     if args.name:
         name_resolve.add(args.name, server.address, keepalive_ttl=None)
     server.run_forever()

@@ -559,6 +559,25 @@ class RolloutController:
         import threading
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+        from areal_tpu.observability import timeline as _tl_role
+
+        # stamp the process-global ring's role so DISK dumps (sigterm)
+        # carry it too — the /debug/flight handler's snapshot patch only
+        # covers live scrapes (skipped when an in-process inference server
+        # already claimed the ring; last-writer ambiguity helps nobody) —
+        # and arm the SIGTERM dump itself: a killed controller must leave
+        # its supervision-side events (circuit trips, evicts, quarantines)
+        # on disk for the postmortem. Best-effort: install only works on
+        # the main thread, and a server entrypoint may already have armed it
+        if _tl_role.get_flight_recorder().role == "proc":
+            _tl_role.get_flight_recorder().role = "rollout_controller"
+        # armed regardless of who claimed the ring: an in-process server
+        # claims the role without arming the handler (only the standalone
+        # serve entrypoint does), and a killed controller process must
+        # still leave its dump. Main-thread-guarded; re-arming chains to
+        # the same dump path
+        _tl_role.install_signal_dump()
+
         from areal_tpu.observability.aggregator import FleetAggregator
         from areal_tpu.utils.network import find_free_port, gethostip
 
@@ -674,6 +693,18 @@ class RolloutController:
                             }
                         ).encode(),
                         "application/json",
+                    )
+                elif path == "/debug/flight":
+                    # controller-side flight ring (circuit trips, respawns,
+                    # quarantines recorded in this process) for
+                    # tools/postmortem.py fleet scrapes
+                    from areal_tpu.observability import timeline as _tl
+
+                    # snapshot() carries the ring's authoritative role
+                    # (first claimant — may be a colocated server's)
+                    snap = _tl.get_flight_recorder().snapshot()
+                    self._reply(
+                        _json.dumps(snap).encode(), "application/json"
                     )
                 else:
                     self._reply(b"not found", "text/plain", 404)

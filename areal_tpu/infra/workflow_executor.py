@@ -284,6 +284,7 @@ class WorkflowExecutor:
         perf_tracer.get_session_tracer().finalize(
             task_id, "accepted" if accepted else "rejected"
         )
+        self._log_task_latency(task_id, accepted)
         with self._cv:
             if rec is not None:
                 rec.result = traj if accepted else None
@@ -335,6 +336,15 @@ class WorkflowExecutor:
             self._launch(rec, rec.workflow, rec.accept_fn)
             return
         self._robust.task_quarantined.inc()
+        from areal_tpu.observability import timeline as tl_mod
+
+        tl_mod.get_flight_recorder().record(
+            "quarantine",
+            severity="error",
+            task_id=task_id,
+            strikes=rec.strikes,
+            error=repr(tf.exc)[:200],
+        )
         logger.error(
             f"task {task_id} quarantined after {rec.strikes} failed "
             f"attempts; last error: {tf.exc!r}"
@@ -371,6 +381,7 @@ class WorkflowExecutor:
             while len(self._reject_order) > self._max_reject_records:
                 self._done_tasks.pop(self._reject_order.popleft(), None)
             self._cv.notify_all()
+        self._log_task_latency(task_id, False)
         self._notify_completion(task_id, False)
 
     # -- completion push (fleet-scale wait: reference rollout_controller
@@ -422,6 +433,36 @@ class WorkflowExecutor:
                     },
                 )
             )
+
+    def _log_task_latency(self, task_id: str, accepted: bool) -> None:
+        """Per-trajectory latency line from the engine's request-timeline
+        breakdown (observability/timeline.py): every generation the task
+        issued, summed by stage — rollout stalls become attributable from
+        the training log alone, no metric scraping. INFO when rollout
+        tracing is on, DEBUG otherwise; always popped so the client-side
+        aggregate can't leak."""
+        take = getattr(self.engine, "take_task_latency", None)
+        if take is None:
+            return
+        try:
+            agg = take(task_id)
+        except Exception:  # noqa: BLE001 — attribution must never fail a task
+            logger.exception("take_task_latency failed")
+            return
+        if not agg:
+            return
+        line = (
+            f"trajectory {task_id[:8]} [{'accepted' if accepted else 'rejected'}] "
+            f"latency: reqs={int(agg['requests'])} tokens={int(agg['tokens'])} "
+            f"e2e={agg['e2e_s']:.3f}s queue_wait={agg['queue_wait_s']:.3f}s "
+            f"prefill={agg['prefill_s']:.3f}s decode={agg['decode_s']:.3f}s "
+            f"fence_stall={agg['fence_stall_s']:.3f}s park={agg['park_s']:.3f}s "
+            f"ttft_max={agg['ttft_max_s']:.3f}s"
+        )
+        if self.config.enable_rollout_tracing:
+            logger.info(line)
+        else:
+            logger.debug(line)
 
     def _check_health(self) -> None:
         if self._thread_exc is not None:

@@ -15,6 +15,12 @@ from areal_tpu.observability.metrics import (  # noqa: F401
     get_registry,
     parse_prometheus_text,
 )
+from areal_tpu.observability.timeline import (  # noqa: F401
+    FlightRecorder,
+    RequestTimeline,
+    TimelineRecorder,
+    get_flight_recorder,
+)
 from areal_tpu.observability.tracecontext import (  # noqa: F401
     TRACE_HEADER,
     apply_trace_header,

@@ -228,6 +228,86 @@ def lifecycle_metrics(reg: Registry | None = None) -> SimpleNamespace:
     )
 
 
+def timeline_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """Request timeline observatory (observability/timeline.py): per-stage
+    latency attribution for every engine request. Completed timelines feed
+    these histograms; the same breakdown is stamped per-request onto
+    ``ModelResponse`` (queue_wait_s / prefill_s / decode_s / ...)."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        queue_wait=r.histogram(
+            "areal_request_queue_wait_seconds",
+            "Submission-to-admission wait per request (engine queue + "
+            "backlog + slot availability).",
+            buckets=FAST_BUCKETS,
+        ),
+        prefill=r.histogram(
+            "areal_request_prefill_seconds",
+            "Prefill window per admitted request (suffix-only on a radix "
+            "prefix hit; zero-prefill resumes are not observed).",
+            buckets=FAST_BUCKETS,
+        ),
+        ttft=r.histogram(
+            "areal_request_ttft_seconds",
+            "Engine-side time to first token (queued -> first emitted "
+            "token), by priority class (interactive | rollout).",
+            label_names=("priority",),
+            buckets=FAST_BUCKETS,
+        ),
+        tpot=r.histogram(
+            "areal_request_tpot_seconds",
+            "Time per output token after the first (first-token to "
+            "terminal over tokens - 1); hold-fence stalls excluded.",
+            buckets=(
+                0.0001,
+                0.00025,
+                0.0005,
+                0.001,
+                0.0025,
+                0.005,
+                0.01,
+                0.025,
+                0.05,
+                0.1,
+                0.25,
+                1.0,
+            ),
+        ),
+        fence_stall=r.histogram(
+            "areal_request_fence_stall_seconds",
+            "Per-request decode stall under weight-commit hold fences "
+            "(zero-pause protocol; docs/weight_sync.md).",
+            buckets=FAST_BUCKETS,
+        ),
+        park=r.histogram(
+            "areal_request_park_seconds",
+            "Parked-KV wait resumed requests carried (abort pause -> "
+            "resume round-trip; rid-affinity KV reuse).",
+        ),
+    )
+
+
+def flight_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """Fleet flight recorder (observability/timeline.py FlightRecorder):
+    significant-event ring visibility."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        events=r.counter(
+            "areal_flight_events_total",
+            "Events recorded into the process flight ring, by kind "
+            "(admission_reject, evict_radix, evict_parked, preempt, "
+            "weight_stage, weight_commit, circuit_open, watchdog, wedge, "
+            "quarantine, gateway_shed, ...).",
+            label_names=("kind",),
+        ),
+        dumps=r.counter(
+            "areal_flight_dumps_total",
+            "Flight-ring dumps persisted to disk (wedge escalation, "
+            "SIGTERM, or manual /debug tooling).",
+        ),
+    )
+
+
 def server_metrics(reg: Registry | None = None) -> SimpleNamespace:
     """Inference HTTP server: per-request latency + pause/update windows."""
     r = reg or get_registry()
@@ -439,6 +519,8 @@ ALL_FACTORIES = (
     engine_metrics,
     prefix_cache_metrics,
     lifecycle_metrics,
+    timeline_metrics,
+    flight_metrics,
     server_metrics,
     client_metrics,
     rpc_metrics,

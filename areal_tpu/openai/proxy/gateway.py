@@ -36,8 +36,9 @@ from areal_tpu.utils import logging as alog
 logger = alog.getLogger("proxy_gateway")
 
 PRIORITIES = ("interactive", "rollout")
-# lifecycle headers forwarded verbatim to the owning proxy backend
-PASSTHROUGH_HEADERS = ("x-areal-deadline", "x-areal-priority")
+# lifecycle + trace headers forwarded verbatim to the owning proxy backend
+# (x-areal-trace keeps gateway-entered requests correlatable in postmortems)
+PASSTHROUGH_HEADERS = ("x-areal-deadline", "x-areal-priority", "x-areal-trace")
 
 FORWARDED_PATHS = (
     "/v1/chat/completions",
@@ -117,6 +118,14 @@ class GatewayState:
     def on_shed(self, priority: str) -> None:
         self.shed[priority] += 1
         self._lc_obs.gateway_shed.labels(priority=priority).inc()
+        from areal_tpu.observability import timeline as tl_mod
+
+        tl_mod.get_flight_recorder().record(
+            "gateway_shed",
+            severity="warn",
+            priority=priority,
+            inflight=sum(self.inflight.values()),
+        )
 
     def pick_backend(self) -> str:
         return min(self.backends, key=lambda b: self.load.get(b, 0))

@@ -203,11 +203,29 @@ def create_proxy_app(state: ProxyState) -> web.Application:
         except ValueError:
             raise web.HTTPBadRequest(text="bad x-areal-deadline header")
 
+    def _inject_priority(request: web.Request, kwargs: dict) -> None:
+        """Priority class (x-areal-priority, forwarded by the gateway)
+        rides request metadata -> ModelRequest -> engine, so the serving
+        fleet's timeline histograms split TTFT by class — on EVERY proxy
+        path, not just chat.completions."""
+        prio = request.headers.get("x-areal-priority")
+        if not prio:
+            return
+        try:
+            md = dict(kwargs.get("metadata") or {})
+        except (TypeError, ValueError):
+            # same contract as the create() calls: a malformed
+            # agent-authored body is a 400, not a 500 traceback
+            raise web.HTTPBadRequest(text="bad metadata field")
+        md["priority"] = str(prio).lower()
+        kwargs["metadata"] = md
+
     async def chat_completions(request: web.Request):
         sess = require_session(request)
         body = await request.json()
         body.pop("model", None)
         body.pop("deadline", None)  # header-only: the body is agent-authored
+        _inject_priority(request, body)
         try:
             result = await sess.client.chat.completions.create(
                 **body, deadline=_deadline_of(request)
@@ -233,7 +251,23 @@ def create_proxy_app(state: ProxyState) -> web.Application:
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
             return resp
-        return web.json_response(result.to_dict())
+        d = result.to_dict()
+        # per-request latency breakdown rides the completion as an areal
+        # extension field (the gateway goodput bench reads TTFT from it);
+        # only present when the interaction was stored (the default)
+        inter = sess.client.get_interaction(d.get("id", ""))
+        mr = getattr(inter, "model_response", None) if inter else None
+        if mr is not None:
+            from areal_tpu.api.io_struct import TIMING_FIELDS
+
+            d["areal_timing"] = {
+                "ttft_s": mr.ttft,
+                "latency_s": mr.latency,
+                **{k: getattr(mr, k) for k in TIMING_FIELDS},
+                "stop_reason": mr.stop_reason,
+                "truncated_by": mr.truncated_by,
+            }
+        return web.json_response(d)
 
     async def responses_api(request: web.Request):
         """OpenAI Responses API (`/v1/responses`) — openai-agents-SDK style
@@ -241,6 +275,7 @@ def create_proxy_app(state: ProxyState) -> web.Application:
         sess = require_session(request)
         body = await request.json()
         body.pop("model", None)
+        _inject_priority(request, body)
         if body.get("stream"):
             raise web.HTTPBadRequest(
                 text="stream is not supported on /v1/responses yet; "
@@ -337,6 +372,9 @@ def create_proxy_app(state: ProxyState) -> web.Application:
             "stream": False,
             "deadline": _deadline_of(request),
         }
+        # anthropic-shaped body metadata (user_id) is NOT forwarded; the
+        # priority class injects into the internal kwargs directly
+        _inject_priority(request, kw)
         if tools:
             kw["tools"] = tools
         if body.get("temperature") is not None:

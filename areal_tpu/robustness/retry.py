@@ -331,6 +331,11 @@ class FleetHealth:
 
     def _record_open(self, addr: str) -> None:
         self._metrics.circuit_open.inc()
+        from areal_tpu.observability import timeline as tl_mod
+
+        tl_mod.get_flight_recorder().record(
+            "circuit_open", severity="error", replica=addr
+        )
         logger.warning(f"circuit OPEN for replica {addr} — out of rotation")
 
     def _export_state(self, addr: str) -> None:

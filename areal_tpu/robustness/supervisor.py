@@ -123,8 +123,16 @@ class ReplicaSupervisor:
 
     # -- eviction / respawn ------------------------------------------------
     def _handle_dead(self, worker: Worker) -> None:
+        from areal_tpu.observability import timeline as tl_mod
+
         self.controller.evict_worker(worker)
         self._metrics.replica_state.labels(replica=worker.address).set(2.0)
+        tl_mod.get_flight_recorder().record(
+            "replica_evict",
+            severity="error",
+            worker=worker.id,
+            address=worker.address,
+        )
         with self._lock:
             spawned = self._respawn_counts.get(worker.id, 0)
             if spawned >= self.ft.max_respawns:
@@ -147,6 +155,12 @@ class ReplicaSupervisor:
             return
         self._metrics.replica_respawns.inc()
         self._metrics.replica_resyncs.inc()
+        tl_mod.get_flight_recorder().record(
+            "replica_respawn",
+            worker=worker.id,
+            replacement=replacement.id,
+            address=replacement.address,
+        )
         self._metrics.replica_state.labels(replica=replacement.address).set(0.0)
         if replacement.address != worker.address:
             # the dead address no longer exists: clear its gauge so

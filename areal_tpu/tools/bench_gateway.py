@@ -1,0 +1,529 @@
+"""Many-client open-loop gateway goodput benchmark.
+
+The standing scoreboard for ROADMAP item 3 (disaggregated, cache-aware
+serving fleet): drive the OpenAI-compatible gateway with mixed
+interactive/rollout priority traffic on per-request deadlines, at an
+OPEN-LOOP arrival schedule (clients arrive on a clock, not when the
+previous one finishes — so overload shows up as queueing/shedding, not as
+a slower client), and report per class:
+
+- p50/p99 TTFT (from the ``areal_timing`` extension the proxy stamps onto
+  completions — the engine-side request-timeline breakdown)
+- p50/p99 end-to-end latency
+- goodput: tokens completed WITHIN deadline per second
+- shed/429, deadline-reap, and error counts
+
+as a JSON artifact (``--output``), so router changes (prefix-locality
+routing, prefill/decode disaggregation) have a fixed number to move.
+
+Usage:
+    # self-contained local fleet (tiny model, CPU-safe) under chaos stalls:
+    python -m areal_tpu.tools.bench_gateway --local --replicas 2 \
+        --interactive 8 --rollout 8 --duration 20 -o report.json
+    # against an existing gateway:
+    python -m areal_tpu.tools.bench_gateway --gateway http://host:port \
+        --admin-key KEY --interactive 64 --rollout 64 --duration 60
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+# the self-contained local fleet serves the toy char tokenizer — the bench
+# measures serving latency, not tokenization; real deployments pass
+# --gateway at a fleet whose proxies run the production tokenizer
+from areal_tpu.infra.rpc.echo_engine import CharTokenizer  # noqa: F401
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("bench_gateway")
+
+PRIORITIES = ("interactive", "rollout")
+
+
+def _percentile(values: list[float], q: float) -> float | None:
+    if not values:
+        return None
+    xs = sorted(values)
+    idx = min(len(xs) - 1, max(0, math.ceil(q * len(xs)) - 1))
+    return xs[idx]
+
+
+@dataclass
+class _ClassStats:
+    sent: int = 0
+    completed: int = 0
+    # shed_429 counts 429 RESPONSES (a retrying client can collect several
+    # before admission and shed_429 may exceed sent); shed_requests counts
+    # requests that were shed at least once — the router-comparison ratio
+    shed_429: int = 0
+    shed_requests: int = 0
+    deadline_reaped: int = 0
+    errors: int = 0
+    ttft_s: list[float] = field(default_factory=list)
+    e2e_s: list[float] = field(default_factory=list)
+    tokens: int = 0
+    tokens_within_deadline: int = 0
+
+    def report(self, duration_s: float) -> dict[str, Any]:
+        return {
+            "sent": self.sent,
+            "completed": self.completed,
+            "shed_429": self.shed_429,
+            "shed_requests": self.shed_requests,
+            "deadline_reaped": self.deadline_reaped,
+            "errors": self.errors,
+            "ttft_p50_s": _percentile(self.ttft_s, 0.50),
+            "ttft_p99_s": _percentile(self.ttft_s, 0.99),
+            "e2e_p50_s": _percentile(self.e2e_s, 0.50),
+            "e2e_p99_s": _percentile(self.e2e_s, 0.99),
+            "tokens": self.tokens,
+            "tokens_within_deadline": self.tokens_within_deadline,
+            "goodput_tok_s": (
+                self.tokens_within_deadline / duration_s if duration_s > 0 else 0.0
+            ),
+        }
+
+
+async def _one_client(
+    http,
+    gateway_url: str,
+    admin_key: str,
+    priority: str,
+    deadline_s: float,
+    max_completion_tokens: int,
+    prompt: str,
+    stats: _ClassStats,
+) -> None:
+    """One open-loop client: session -> one prioritized chat completion
+    (honoring 429 Retry-After inside the deadline budget) -> end session.
+    The session ends on EVERY exit path: an abandoned session burns one of
+    the proxy's capacity units forever, and a bench that leaks capacity
+    under sustained overload corrupts its own scoreboard (start_session
+    eventually 429s and every later client counts as an error)."""
+    stats.sent += 1
+    t0 = time.monotonic()
+    budget_end = t0 + deadline_s
+    key = None
+    try:
+        admin = {"Authorization": f"Bearer {admin_key}"}
+        async with http.post(
+            f"{gateway_url}/rl/start_session",
+            json={"task_id": f"bench-{priority}"},
+            headers=admin,
+        ) as r:
+            if r.status != 200:
+                stats.errors += 1
+                return
+            sess = await r.json(content_type=None)
+        key = sess["api_key"]
+        headers = {
+            "Authorization": f"Bearer {key}",
+            "x-areal-priority": priority,
+            "x-areal-deadline": f"{time.time() + (budget_end - time.monotonic()):.6f}",
+        }
+        body = {
+            "messages": [{"role": "user", "content": prompt}],
+            "max_completion_tokens": max_completion_tokens,
+            "model": "bench",
+        }
+        comp = None
+        was_shed = False
+        while True:
+            async with http.post(
+                f"{gateway_url}/v1/chat/completions", json=body, headers=headers
+            ) as r:
+                if r.status == 429:
+                    stats.shed_429 += 1
+                    if not was_shed:
+                        was_shed = True
+                        stats.shed_requests += 1
+                    # floor: a foreign gateway's "Retry-After: 0" must not
+                    # hot-spin the bench into amplifying the overload; the
+                    # RFC 7231 HTTP-date form falls back to the default
+                    # rather than misclassifying the shed as an error
+                    try:
+                        ra = float(r.headers.get("Retry-After", "0.5") or 0.5)
+                    except ValueError:
+                        ra = 0.5
+                    ra = max(0.05, ra)
+                    if time.monotonic() + ra >= budget_end:
+                        return  # budget exhausted while shed
+                    await asyncio.sleep(ra)
+                    continue
+                if r.status != 200:
+                    stats.errors += 1
+                    return
+                comp = await r.json(content_type=None)
+                break
+        e2e = time.monotonic() - t0
+        timing = comp.get("areal_timing") or {}
+        usage = comp.get("usage") or {}
+        n_tok = int(usage.get("completion_tokens") or 0)
+        reaped = (
+            timing.get("truncated_by") == "deadline"
+            or timing.get("stop_reason") == "deadline"
+        )
+        stats.completed += 1
+        stats.e2e_s.append(e2e)
+        stats.tokens += n_tok
+        if n_tok > 0 and timing.get("ttft_s"):
+            # zero-token completions (queued-expiry reaps) never emitted a
+            # first token: their fallback ttft is the full wall latency and
+            # would saturate p99 at the deadline — they are counted by
+            # deadline_reaped, not by the TTFT distribution
+            stats.ttft_s.append(float(timing["ttft_s"]))
+        if reaped:
+            stats.deadline_reaped += 1
+        elif e2e <= deadline_s:
+            stats.tokens_within_deadline += n_tok
+    except Exception as e:  # noqa: BLE001 — one client's failure is a data
+        # point (errors count), not a bench abort
+        logger.debug(f"bench client failed: {e!r}")
+        stats.errors += 1
+    finally:
+        if key is not None:
+            try:
+                async with http.post(
+                    f"{gateway_url}/rl/end_session",
+                    json={},
+                    headers={"Authorization": f"Bearer {key}"},
+                ):
+                    pass
+            except Exception as e:  # noqa: BLE001 — best-effort release
+                logger.debug(f"end_session failed: {e!r}")
+
+
+async def drive_gateway(
+    gateway_url: str,
+    admin_key: str,
+    n_interactive: int,
+    n_rollout: int,
+    duration_s: float,
+    interactive_deadline_s: float = 20.0,
+    rollout_deadline_s: float = 30.0,
+    interactive_tokens: int = 16,
+    rollout_tokens: int = 128,
+) -> dict[str, Any]:
+    """Open-loop drive: each class's clients start on a fixed arrival
+    schedule spread over ``duration_s``. Returns the report dict."""
+    import aiohttp
+
+    stats = {p: _ClassStats() for p in PRIORITIES}
+    t_start = time.monotonic()
+
+    async def schedule(priority, n, deadline_s, max_tokens, prompt):
+        async with aiohttp.ClientSession() as http:
+            tasks = []
+            for i in range(n):
+                target = t_start + (i * duration_s / max(1, n))
+                delay = max(0.0, target - time.monotonic())
+                if delay:
+                    await asyncio.sleep(delay)
+                tasks.append(
+                    asyncio.ensure_future(
+                        _one_client(
+                            http,
+                            gateway_url,
+                            admin_key,
+                            priority,
+                            deadline_s,
+                            max_tokens,
+                            prompt,
+                            stats[priority],
+                        )
+                    )
+                )
+            await asyncio.gather(*tasks)
+
+    await asyncio.gather(
+        schedule(
+            "interactive",
+            n_interactive,
+            interactive_deadline_s,
+            interactive_tokens,
+            "ping?",
+        ),
+        schedule(
+            "rollout",
+            n_rollout,
+            rollout_deadline_s,
+            rollout_tokens,
+            "solve this problem step by step please",
+        ),
+    )
+    wall = time.monotonic() - t_start
+    report = {
+        "bench": "gateway_goodput",
+        "gateway": gateway_url,
+        "duration_s": round(wall, 3),
+        "classes": {p: stats[p].report(wall) for p in PRIORITIES},
+    }
+    tot = _ClassStats()
+    for s in stats.values():
+        tot.sent += s.sent
+        tot.completed += s.completed
+        tot.shed_429 += s.shed_429
+        tot.shed_requests += s.shed_requests
+        tot.deadline_reaped += s.deadline_reaped
+        tot.errors += s.errors
+        tot.ttft_s += s.ttft_s
+        tot.e2e_s += s.e2e_s
+        tot.tokens += s.tokens
+        tot.tokens_within_deadline += s.tokens_within_deadline
+    report["totals"] = tot.report(wall)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# self-contained local fleet (tiny model; CPU-safe) under chaos stalls
+# ---------------------------------------------------------------------------
+
+
+class LocalFleet:
+    """N engine replicas + rollout client + OpenAI proxy + gateway, all
+    in-process — the 2-replica-under-chaos configuration the ISSUE's
+    acceptance scenario names. ``start`` returns (gateway_url, admin_key)."""
+
+    def __init__(
+        self,
+        n_replicas: int = 2,
+        max_batch_size: int = 4,
+        chaos_stall_prob: float = 0.3,
+        chaos_stall_s: float = 0.1,
+        max_queue_depth: int = 32,
+        gateway_max_inflight: int = 0,
+        gateway_interactive_headroom: int = 0,
+        seed: int = 7,
+    ):
+        self.n_replicas = n_replicas
+        self.max_batch_size = max_batch_size
+        self.chaos_stall_prob = chaos_stall_prob
+        self.chaos_stall_s = chaos_stall_s
+        self.max_queue_depth = max_queue_depth
+        self.gateway_max_inflight = gateway_max_inflight
+        self.gateway_interactive_headroom = gateway_interactive_headroom
+        self.seed = seed
+        self.servers: list[Any] = []
+        self.client = None
+        self._proxy_runner = None
+        self._gateway_runner = None
+        self.admin_key = "bench-admin"
+        self.gateway_url = ""
+        self.proxy_url = ""
+
+    async def astart(self) -> tuple[str, str]:
+        import jax
+        from aiohttp import web
+
+        from areal_tpu.api.config import (
+            ChaosConfig,
+            InferenceEngineConfig,
+            MeshConfig,
+            RequestLifecycleConfig,
+            ServerConfig,
+        )
+        from areal_tpu.inference.client import RemoteJaxEngine
+        from areal_tpu.inference.decode_engine import DecodeEngine
+        from areal_tpu.inference.server import ServerThread
+        from areal_tpu.models import qwen
+        from areal_tpu.openai.proxy.gateway import (
+            GatewayState,
+            create_gateway_app,
+        )
+        from areal_tpu.openai.proxy.rollout_server import (
+            ProxyState,
+            create_proxy_app,
+        )
+        from areal_tpu.robustness import FaultInjector
+        from areal_tpu.utils.network import find_free_port
+
+        from areal_tpu.tools.validate_installation import tiny_model_config
+
+        tiny = tiny_model_config()
+        params = qwen.init_params(jax.random.PRNGKey(0), tiny)
+        for i in range(self.n_replicas):
+            cfg = ServerConfig(
+                max_batch_size=self.max_batch_size,
+                max_seq_len=512,
+                decode_steps_per_call=4,
+                seed=self.seed + i,
+                mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+                lifecycle=RequestLifecycleConfig(
+                    max_queue_depth=self.max_queue_depth,
+                    retry_after_s=0.1,
+                    watchdog_s=60.0,
+                ),
+            )
+            eng = DecodeEngine(cfg, params=params, model_cfg=tiny)
+            eng.initialize()
+            st = ServerThread(cfg, eng)
+            st.start()
+            self.servers.append(st)
+        self.client = RemoteJaxEngine(
+            InferenceEngineConfig(
+                max_concurrent_rollouts=64,
+                consumer_batch_size=8,
+                max_head_offpolicyness=1000,
+                request_timeout=120,
+                request_retries=3,
+            ),
+            addresses=[s.address for s in self.servers],
+        )
+        self.client.initialize()
+        if self.chaos_stall_prob > 0:
+            self.client.install_fault_injector(
+                FaultInjector(
+                    ChaosConfig(
+                        enabled=True,
+                        seed=self.seed,
+                        stall_prob=self.chaos_stall_prob,
+                        stall_s=self.chaos_stall_s,
+                        path_prefix="/generate",
+                    )
+                )
+            )
+        proxy_state = ProxyState(
+            self.client,
+            CharTokenizer(),
+            admin_api_key=self.admin_key,
+            capacity=4096,
+        )
+        self._proxy_runner = web.AppRunner(create_proxy_app(proxy_state))
+        await self._proxy_runner.setup()
+        pport = find_free_port()
+        await web.TCPSite(self._proxy_runner, "127.0.0.1", pport).start()
+        self.proxy_url = f"http://127.0.0.1:{pport}"
+        gw_state = GatewayState(
+            [self.proxy_url],
+            admin_api_key=self.admin_key,
+            max_inflight=self.gateway_max_inflight,
+            interactive_headroom=self.gateway_interactive_headroom,
+            retry_after_s=0.2,
+        )
+        self._gateway_runner = web.AppRunner(create_gateway_app(gw_state))
+        await self._gateway_runner.setup()
+        gport = find_free_port()
+        await web.TCPSite(self._gateway_runner, "127.0.0.1", gport).start()
+        self.gateway_url = f"http://127.0.0.1:{gport}"
+        return self.gateway_url, self.admin_key
+
+    async def astop(self) -> None:
+        from areal_tpu.inference.client import close_loop_sessions
+
+        if self._gateway_runner is not None:
+            await self._gateway_runner.cleanup()
+        if self._proxy_runner is not None:
+            await self._proxy_runner.cleanup()
+        if self.client is not None:
+            self.client.destroy()
+        # the proxy drove agenerate on THIS loop: close its cached session
+        # (destroy only reaches the client's executor-loop cache)
+        await close_loop_sessions()
+        for st in self.servers:
+            st.stop()
+
+    def engine_stats(self) -> dict[str, Any]:
+        """Fleet-level engine counters folded into the report (deadline
+        reaps and timeline health come from the engines themselves)."""
+        out: dict[str, Any] = {"replicas": []}
+        for st in self.servers:
+            eng = st.engine
+            out["replicas"].append(
+                {
+                    "address": st.address,
+                    "generated_tokens": eng.stats["generated_tokens"],
+                    "deadline_exceeded": eng.stats["deadline_exceeded"],
+                    "timelines": eng.timeline.stats(),
+                }
+            )
+        return out
+
+
+async def run_local_bench(
+    n_replicas: int = 2,
+    n_interactive: int = 8,
+    n_rollout: int = 8,
+    duration_s: float = 15.0,
+    **fleet_kw: Any,
+) -> dict[str, Any]:
+    fleet = LocalFleet(n_replicas=n_replicas, **fleet_kw)
+    try:
+        gateway_url, admin_key = await fleet.astart()
+        report = await drive_gateway(
+            gateway_url,
+            admin_key,
+            n_interactive=n_interactive,
+            n_rollout=n_rollout,
+            duration_s=duration_s,
+        )
+        report["fleet"] = fleet.engine_stats()
+        return report
+    finally:
+        await fleet.astop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--gateway", default="", help="existing gateway base url")
+    p.add_argument("--admin-key", default="", help="gateway admin API key")
+    p.add_argument(
+        "--local",
+        action="store_true",
+        help="spin a self-contained local fleet (tiny model) to bench",
+    )
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--interactive", type=int, default=8)
+    p.add_argument("--rollout", type=int, default=8)
+    p.add_argument("--duration", type=float, default=15.0)
+    p.add_argument("--stall-prob", type=float, default=0.3)
+    p.add_argument("--stall-s", type=float, default=0.1)
+    p.add_argument("--max-inflight", type=int, default=0)
+    p.add_argument("--headroom", type=int, default=0)
+    p.add_argument("-o", "--output", default="", help="JSON report path")
+    args = p.parse_args(argv)
+
+    if args.local or not args.gateway:
+        report = asyncio.run(
+            run_local_bench(
+                n_replicas=args.replicas,
+                n_interactive=args.interactive,
+                n_rollout=args.rollout,
+                duration_s=args.duration,
+                chaos_stall_prob=args.stall_prob,
+                chaos_stall_s=args.stall_s,
+                gateway_max_inflight=args.max_inflight,
+                gateway_interactive_headroom=args.headroom,
+            )
+        )
+    else:
+        report = asyncio.run(
+            drive_gateway(
+                args.gateway,
+                args.admin_key,
+                n_interactive=args.interactive,
+                n_rollout=args.rollout,
+                duration_s=args.duration,
+            )
+        )
+    text = json.dumps(report, indent=1)
+    print(text)
+    if args.output:
+        from areal_tpu.utils import atomic_io
+
+        atomic_io.atomic_write_text(args.output, text)
+        print(f"wrote {args.output}")
+    # non-null scoreboard or the run proved nothing
+    ok = all(
+        report["classes"][p]["ttft_p50_s"] is not None for p in PRIORITIES
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

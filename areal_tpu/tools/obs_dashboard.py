@@ -61,6 +61,43 @@ def _shed_total(snap: FleetSnapshot) -> float | None:
     return (gw or 0.0) + (adm or 0.0)
 
 
+def _histogram_quantile(
+    snap: FleetSnapshot, name: str, q: float
+) -> float | None:
+    """Approximate quantile from merged histogram buckets (classic
+    Prometheus-style linear interpolation inside the winning bucket).
+    Label children (e.g. ttft's priority classes) are summed — per-``le``
+    cumulative counts stay cumulative under addition."""
+    buckets: dict[float, float] = {}
+    target_name = name + "_bucket"
+    for (n, labels), v in snap.merged.items():
+        if n != target_name:
+            continue
+        le = dict(labels).get("le")
+        if le is None:
+            continue
+        lef = float("inf") if le == "+Inf" else float(le)
+        buckets[lef] = buckets.get(lef, 0.0) + v
+    total = buckets.get(float("inf"))
+    if not total:
+        return None
+    target = q * total
+    prev_le, prev_c = 0.0, 0.0
+    for le in sorted(buckets):
+        c = buckets[le]
+        if c >= target:
+            if le == float("inf") or c == prev_c:
+                return prev_le if le == float("inf") else le
+            return prev_le + (le - prev_le) * (target - prev_c) / (c - prev_c)
+        prev_le, prev_c = le, c
+    return prev_le
+
+
+def _flight_total(snap: FleetSnapshot) -> float | None:
+    """Flight-recorder events across all kinds (rate needs two frames)."""
+    return _merged_value(snap, "areal_flight_events_total")
+
+
 def _fmt(v: float | None) -> str:
     if v is None:
         return "-"
@@ -116,6 +153,35 @@ def render_frame(
             if prev_shed is not None and dt > 0:
                 lines.append(
                     f"{'shed rate (429/s)':<24} {(shed - prev_shed) / dt:>12.1f}"
+                )
+    # request-timeline stage view (observability/timeline.py): TTFT/TPOT
+    # tails from the catalogued stage histograms, fence-stall cost, and the
+    # flight-recorder event cadence
+    for metric, label in (
+        ("areal_request_ttft_seconds", "ttft"),
+        ("areal_request_tpot_seconds", "tpot"),
+    ):
+        p50 = _histogram_quantile(snap, metric, 0.50)
+        p99 = _histogram_quantile(snap, metric, 0.99)
+        if p50 is not None and p99 is not None:
+            lines.append(
+                f"{label + ' p50/p99 (s)':<24} {p50:>6.3f} / {p99:.3f}"
+            )
+    fence_sum = _merged_value(snap, "areal_request_fence_stall_seconds_sum")
+    fence_cnt = _merged_value(snap, "areal_request_fence_stall_seconds_count")
+    if fence_sum is not None and fence_cnt:
+        lines.append(
+            f"{'fence stall (mean s)':<24} {fence_sum / fence_cnt:>12.3f}"
+        )
+    flight = _flight_total(snap)
+    if flight is not None:
+        lines.append(f"{'flight events':<24} {_fmt(flight):>12}")
+        if prev is not None:
+            prev_flight = _flight_total(prev)
+            dt = snap.scraped_at - prev.scraped_at
+            if prev_flight is not None and dt > 0:
+                lines.append(
+                    f"{'flight events/s':<24} {(flight - prev_flight) / dt:>12.1f}"
                 )
     pause_sum = _merged_value(snap, "areal_weight_update_pause_seconds_sum")
     pause_cnt = _merged_value(snap, "areal_weight_update_pause_seconds_count")
@@ -198,6 +264,30 @@ areal_weight_update_pause_seconds_bucket{le="1"} 2
 areal_weight_update_pause_seconds_bucket{le="+Inf"} 2
 areal_weight_update_pause_seconds_sum 1.5
 areal_weight_update_pause_seconds_count 2
+# HELP areal_request_ttft_seconds Engine-side time to first token.
+# TYPE areal_request_ttft_seconds histogram
+areal_request_ttft_seconds_bucket{priority="interactive",le="0.05"} 8
+areal_request_ttft_seconds_bucket{priority="interactive",le="0.1"} 10
+areal_request_ttft_seconds_bucket{priority="interactive",le="+Inf"} 10
+areal_request_ttft_seconds_sum{priority="interactive"} 0.5
+areal_request_ttft_seconds_count{priority="interactive"} 10
+# HELP areal_request_tpot_seconds Time per output token after the first.
+# TYPE areal_request_tpot_seconds histogram
+areal_request_tpot_seconds_bucket{le="0.005"} 90
+areal_request_tpot_seconds_bucket{le="0.01"} 100
+areal_request_tpot_seconds_bucket{le="+Inf"} 100
+areal_request_tpot_seconds_sum 0.4
+areal_request_tpot_seconds_count 100
+# HELP areal_request_fence_stall_seconds Fence stall per request.
+# TYPE areal_request_fence_stall_seconds histogram
+areal_request_fence_stall_seconds_bucket{le="0.1"} 4
+areal_request_fence_stall_seconds_bucket{le="+Inf"} 4
+areal_request_fence_stall_seconds_sum 0.2
+areal_request_fence_stall_seconds_count 4
+# HELP areal_flight_events_total Flight-recorder events by kind.
+# TYPE areal_flight_events_total counter
+areal_flight_events_total{kind="admission_reject"} 3
+areal_flight_events_total{kind="weight_commit"} 2
 """
 
 
@@ -254,6 +344,37 @@ def self_test() -> int:
                 "target merges to the same 80% ratio)",
             ),
             ("update pause (mean s)" in frame, "frame missing pause row"),
+            (
+                "ttft p50/p99 (s)" in frame,
+                "frame missing timeline ttft quantile row",
+            ),
+            (
+                "tpot p50/p99 (s)" in frame,
+                "frame missing timeline tpot quantile row",
+            ),
+            (
+                abs(
+                    (
+                        _histogram_quantile(
+                            snap, "areal_request_ttft_seconds", 0.5
+                        )
+                        or 0.0
+                    )
+                    - 0.03125
+                )
+                < 1e-9,
+                "ttft p50 should interpolate to 0.03125 (target 10 of 16 "
+                "in the 0.05 bucket)",
+            ),
+            (
+                "fence stall (mean s)" in frame and "0.050" in frame,
+                "frame missing fence-stall row (0.2/4 = 0.050)",
+            ),
+            (
+                "flight events" in frame
+                and _flight_total(snap) == 10,
+                "flight events should sum kinds across targets (2x(3+2))",
+            ),
             (
                 "lifecycle queue" in frame,
                 "frame missing lifecycle queue-depth row",

@@ -1,0 +1,188 @@
+"""Fleet postmortem: merge flight recorders + request timelines into one
+Perfetto trace.
+
+When a replica wedges (watchdog, circuit trip, supervision eviction) the
+question is always *what was the fleet doing at that moment*. Every
+process keeps a bounded flight-recorder ring (observability/timeline.py)
+exposed at ``/debug/flight`` — inference servers additionally attach their
+recently completed request timelines — and wedge/SIGTERM escalations dump
+the same payload to disk via atomic_io. This tool scrapes live endpoints
+and/or reads dump files, converts each process into catapult
+``traceEvents`` (flight events as instants, timeline stages as spans,
+correlated across processes by their ``x-areal-trace`` task/session ids in
+``args``), and merges everything through
+:mod:`areal_tpu.tools.perf_trace_converter` into ONE trace loadable in
+chrome://tracing / Perfetto.
+
+Usage:
+    python -m areal_tpu.tools.postmortem --targets host:port,host:port \
+        [--files dump1.json ...] [-o incident_trace.json] [--timelines N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import tempfile
+import urllib.request
+from pathlib import Path
+
+from areal_tpu.observability.timeline import (
+    flight_to_trace_events,
+    timelines_to_trace_events,
+)
+from areal_tpu.tools import perf_trace_converter
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("postmortem")
+
+
+def scrape_flight(
+    target: str, timeout: float = 5.0, n_timelines: int = 256
+) -> dict | None:
+    """GET one process's /debug/flight payload; None when unreachable
+    (a wedged process may only have its on-disk dump)."""
+    url = f"http://{target}/debug/flight?timelines={n_timelines}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except Exception as e:  # noqa: BLE001 — a dead target must not kill
+        # the postmortem of the rest of the fleet
+        logger.warning(f"scrape of {target} failed: {e!r}")
+        return None
+
+
+def snapshot_to_events(snap: dict) -> list[dict]:
+    """One process's /debug/flight payload (or dump file) -> traceEvents.
+
+    ``_dup_flight_ring`` (set by :func:`dedup_shared_rings`) suppresses the
+    flight events while keeping the timelines: colocated replicas share one
+    process-global ring, and merging it once per scraped port would show
+    every admission-reject/eviction/commit twice."""
+    events = [] if snap.get("_dup_flight_ring") else flight_to_trace_events(snap)
+    events.extend(timelines_to_trace_events(snap.get("timelines", [])))
+    return events
+
+
+def dedup_shared_rings(snapshots: list[tuple[str, dict]]) -> None:
+    """Mark duplicate flight rings in place. Two snapshots are the same
+    process's ring when their pids match and they share any recorded event
+    (same seq AND same wall-clock stamp — one `record()` call). That covers
+    both colocated replicas serving one process-global ring from two ports
+    (LocalFleet) and a process that is scraped live AND read back from its
+    wedge/SIGTERM dump file. Each duplicate still contributes its own
+    timelines; only its flight events are suppressed."""
+    # one entry per distinct process: (pid, union of member signatures,
+    # the currently unsuppressed snapshot). The union keeps the group
+    # matchable by EVERY later member (a live scrape, a wedge dump, and a
+    # sigterm dump of one process overlap pairwise but not identically)
+    kept: list[tuple[int, set[tuple], dict]] = []
+    for label, snap in snapshots:
+        pid = snap.get("pid")
+        sig = {
+            (e.get("seq"), e.get("ts")) for e in snap.get("events", [])
+        }
+        matches = [
+            i
+            for i, (k_pid, k_sig, _s) in enumerate(kept)
+            if k_pid == pid and (k_sig & sig)
+        ]
+        if not matches:
+            kept.append((pid, sig, snap))
+            continue
+        # a snapshot can BRIDGE previously disjoint groups (an old wedge
+        # dump and a post-rotation live scrape, connected by a sigterm
+        # dump covering both): merge every matched group, keep exactly
+        # the largest member unsuppressed
+        union = set(sig)
+        candidates = []
+        for i in matches:
+            union |= kept[i][1]
+            candidates.append(kept[i][2])
+        candidates.append(snap)  # last: ties keep the earliest member
+        best = max(candidates, key=lambda s: len(s.get("events", [])))
+        for s in candidates:
+            if s is best:
+                s.pop("_dup_flight_ring", None)
+            else:
+                s["_dup_flight_ring"] = True
+        for i in reversed(matches):
+            del kept[i]
+        kept.append((pid, union, best))
+        logger.info(f"{label}: flight ring already merged (pid {pid})")
+
+
+def build_incident_trace(
+    snapshots: list[tuple[str, dict]], output: str | Path
+) -> Path:
+    """Write per-process catapult files named ``{role}-r{idx}.json`` (the
+    rank/role scheme perf_trace_converter parses) and merge them into one
+    trace at ``output``."""
+    if not snapshots:
+        raise ValueError("no flight snapshots to merge")
+    with tempfile.TemporaryDirectory(prefix="areal_postmortem_") as td:
+        tdir = Path(td)
+        for idx, (label, snap) in enumerate(snapshots):
+            role = str(snap.get("role") or label or "proc").replace("/", "_")
+            # keep only [A-Za-z_] so the converter's role regex matches
+            role = "".join(c if c.isalpha() or c == "_" else "_" for c in role)
+            path = tdir / f"{role}-r{idx}.json"
+            path.write_text(
+                json.dumps({"traceEvents": snapshot_to_events(snap)})
+            )
+        return perf_trace_converter.convert(tdir, output)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--targets",
+        default="",
+        help="comma-separated host:port /debug/flight endpoints",
+    )
+    p.add_argument(
+        "--files",
+        nargs="*",
+        default=[],
+        help="flight dump files (wedge/SIGTERM dumps) to include",
+    )
+    p.add_argument("-o", "--output", default="incident_trace.json")
+    p.add_argument(
+        "--timelines",
+        type=int,
+        default=256,
+        help="recent request timelines to pull per target",
+    )
+    p.add_argument("--timeout", type=float, default=5.0)
+    args = p.parse_args(argv)
+
+    snapshots: list[tuple[str, dict]] = []
+    for target in [t for t in args.targets.split(",") if t]:
+        snap = scrape_flight(
+            target, timeout=args.timeout, n_timelines=args.timelines
+        )
+        if snap is not None:
+            snapshots.append((target, snap))
+    for f in args.files:
+        try:
+            snapshots.append((Path(f).stem, json.loads(Path(f).read_text())))
+        except (OSError, ValueError) as e:
+            logger.warning(f"skipping dump {f}: {e!r}")
+    dedup_shared_rings(snapshots)
+    if not snapshots:
+        print("no reachable targets and no readable dumps")
+        return 1
+    out = build_incident_trace(snapshots, args.output)
+    n_ev = sum(
+        len(s.get("events", [])) + len(s.get("timelines", []))
+        for _, s in snapshots
+    )
+    print(
+        f"wrote {out} ({len(snapshots)} processes, "
+        f"{n_ev} flight events + timelines)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

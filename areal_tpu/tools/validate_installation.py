@@ -36,6 +36,25 @@ import argparse
 import sys
 
 
+def tiny_model_config():
+    """The toy model every in-process self-test fleet serves (shared with
+    tools/bench_gateway's LocalFleet — one definition, or the self-tests
+    and the bench silently measure different models)."""
+    from areal_tpu.models import qwen
+
+    return qwen.ModelConfig(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_layers=2,
+        num_heads=2,
+        num_kv_heads=1,
+        dtype="float32",
+        tie_word_embeddings=True,
+        rope_theta=10000.0,
+    )
+
+
 def _check(name, fn, results):
     try:
         detail = fn() or ""
@@ -75,6 +94,14 @@ def main(argv=None) -> int:
         "chaos stalls and assert overload safety: clean 429 + Retry-After "
         "for shed work, bounded p99 for admitted work, deadline reaping, "
         "and zero leaked KV pages",
+    )
+    p.add_argument(
+        "--timeline-self-test",
+        action="store_true",
+        help="run a short serve (incl. a weight-commit hold fence) and "
+        "assert the request-timeline observatory: stage sums ≈ wall time "
+        "per request, fence stalls attributed, and zero unterminated "
+        "timelines",
     )
     args = p.parse_args(argv)
     results: list[tuple[str, bool, str]] = []
@@ -194,6 +221,9 @@ def main(argv=None) -> int:
     if args.overload_self_test:
         _check("overload", overload_self_test, results)
 
+    if args.timeline_self_test:
+        _check("timeline", timeline_self_test, results)
+
     width = max(len(n) for n, _, _ in results)
     ok = True
     for name, passed, detail in results:
@@ -225,17 +255,7 @@ def chaos_self_test(
     from areal_tpu.robustness import FaultInjector
     from areal_tpu.workflow.rlvr import RLVRWorkflow
 
-    tiny = qwen.ModelConfig(
-        vocab_size=128,
-        hidden_size=32,
-        intermediate_size=64,
-        num_layers=2,
-        num_heads=2,
-        num_kv_heads=1,
-        dtype="float32",
-        tie_word_embeddings=True,
-        rope_theta=10000.0,
-    )
+    tiny = tiny_model_config()
     params = qwen.init_params(jax.random.PRNGKey(0), tiny)
     servers = []
     client = None
@@ -324,17 +344,7 @@ def overload_self_test(
     from areal_tpu.models import qwen
     from areal_tpu.robustness import FaultInjector
 
-    tiny = qwen.ModelConfig(
-        vocab_size=128,
-        hidden_size=32,
-        intermediate_size=64,
-        num_layers=2,
-        num_heads=2,
-        num_kv_heads=1,
-        dtype="float32",
-        tie_word_embeddings=True,
-        rope_theta=10000.0,
-    )
+    tiny = tiny_model_config()
     params = qwen.init_params(jax.random.PRNGKey(0), tiny)
     cfg = ServerConfig(
         max_batch_size=2,
@@ -434,6 +444,129 @@ def overload_self_test(
         )
     finally:
         srv.stop()
+
+
+def timeline_self_test(
+    n_short: int = 4, coverage_floor: float = 0.5
+) -> str:
+    """Short serve over one tiny engine asserting the request-timeline
+    observatory end to end (docs/observability.md "Request timelines"):
+
+    - every request's named stages (queue_wait + prefill + decode +
+      fence_stall) cover >= ``coverage_floor`` of its wall time — i.e.
+      the explicit ``other_s`` residual is small, so timelines actually
+      attribute latency instead of hiding it;
+    - a weight-commit hold fence mid-decode lands in ``fence_stall_s``;
+    - zero unterminated timelines once the engine drains (every request
+      that entered the engine left through a terminal stage)."""
+    import threading
+    import time
+
+    import jax
+
+    from areal_tpu.api.config import MeshConfig, ServerConfig
+    from areal_tpu.api.io_struct import (
+        GenerationHyperparameters,
+        ModelRequest,
+    )
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.models import qwen
+
+    tiny = tiny_model_config()
+    params = qwen.init_params(jax.random.PRNGKey(0), tiny)
+    cfg = ServerConfig(
+        max_batch_size=4,
+        max_seq_len=256,
+        decode_steps_per_call=4,
+        seed=1,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+    )
+    eng = DecodeEngine(cfg, params=params, model_cfg=tiny)
+    eng.initialize()
+    eng.start()
+    try:
+        # short mixed-priority wave (warms the compiled programs too, so
+        # the fence request below measures serving, not compilation)
+        for i in range(n_short):
+            resp = eng.generate_sync(
+                ModelRequest(
+                    input_ids=[3 + i, 7, 9],
+                    gconfig=GenerationHyperparameters(
+                        max_new_tokens=8, greedy=True
+                    ),
+                    metadata={"priority": "rollout" if i % 2 else "interactive"},
+                ),
+                timeout=120,
+            )
+            assert resp.queue_wait_s >= 0 and resp.decode_s >= 0
+        # long request with a hold fence dropped mid-decode
+        done = threading.Event()
+        box = []
+        eng.submit(
+            ModelRequest(
+                input_ids=[5, 6, 7],
+                gconfig=GenerationHyperparameters(
+                    max_new_tokens=200, greedy=True, ignore_eos=True
+                ),
+            ),
+            lambda r: (box.append(r), done.set()),
+        )
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if any(
+                t is not None and t.out_tokens for t in eng._slot_task
+            ):
+                break
+            time.sleep(0.01)
+        eng.pause_generation(mode="hold")
+        eng.wait_fence_ack(10.0)
+        time.sleep(0.4)  # the measurable stall
+        eng.continue_generation()
+        assert done.wait(120), "fence request never completed"
+        fenced = box[0]
+        if fenced.fence_stall_s < 0.2:
+            raise AssertionError(
+                f"hold fence not attributed: fence_stall_s="
+                f"{fenced.fence_stall_s:.3f}s (held ~0.4s)"
+            )
+        # settle, then audit the recorder
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            snap = eng.admission_snapshot()
+            if snap["queue_depth"] == 0 and snap["active_slots"] == 0:
+                break
+            time.sleep(0.05)
+        stats = eng.timeline.stats()
+        if stats["unterminated"] != 0:
+            raise AssertionError(
+                f"{stats['unterminated']} unterminated timelines "
+                f"(started {stats['started']}, completed {stats['completed']})"
+            )
+        worst, n_audited = 1.0, 0
+        for rec in eng.timeline.recent():
+            bd = rec["breakdown"]
+            if bd["total_s"] <= 0 or rec["terminal_reason"] not in (
+                "stop",
+                "length",
+            ):
+                continue
+            n_audited += 1
+            covered = 1.0 - bd["other_s"] / bd["total_s"]
+            worst = min(worst, covered)
+        if n_audited == 0:
+            raise AssertionError("no completed timelines to audit")
+        if worst < coverage_floor:
+            raise AssertionError(
+                f"stage coverage {worst:.0%} < {coverage_floor:.0%} of "
+                "wall time — timelines are not attributing latency"
+            )
+        return (
+            f"{stats['completed']} timelines terminated cleanly, stage "
+            f"coverage >= {worst:.0%}, fence stall "
+            f"{fenced.fence_stall_s:.2f}s attributed"
+        )
+    finally:
+        eng.stop()
 
 
 if __name__ == "__main__":

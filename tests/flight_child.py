@@ -1,0 +1,71 @@
+"""Child process for tests/test_timeline.py's two-process postmortem
+acceptance: one tiny inference server whose decode loop can be
+deliberately wedged from outside — when the marker file passed as argv[1]
+appears, a thread grabs the engine's weight lock and submits pending
+work, so the loop stalls at `_apply_weight_update`, the heartbeat goes
+stale, `/health` turns 503 "wedged", and the wedge escalation dumps the
+flight ring to $AREAL_FLIGHT_DIR."""
+
+import os
+import sys
+import threading
+import time
+
+
+def main() -> None:
+    wedge_file = sys.argv[1]
+
+    import jax
+
+    from areal_tpu.api.config import (
+        MeshConfig,
+        RequestLifecycleConfig,
+        ServerConfig,
+    )
+    from areal_tpu.api.io_struct import (
+        GenerationHyperparameters,
+        ModelRequest,
+    )
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.inference.server import ServerThread
+    from areal_tpu.models import qwen
+    from tpu_testing import TINY_QWEN2  # tests/ is sys.path[0] when spawned
+
+    tiny = TINY_QWEN2
+    cfg = ServerConfig(
+        max_batch_size=2,
+        max_seq_len=256,
+        decode_steps_per_call=4,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        lifecycle=RequestLifecycleConfig(
+            engine_stall_escalate_s=1.0, watchdog_s=300.0
+        ),
+    )
+    eng = DecodeEngine(
+        cfg, params=qwen.init_params(jax.random.PRNGKey(0), tiny), model_cfg=tiny
+    )
+    eng.initialize()
+    st = ServerThread(cfg, eng)
+    st.start()
+    print(f"READY {st.address}", flush=True)
+
+    def wedger() -> None:
+        while not os.path.exists(wedge_file):
+            time.sleep(0.05)
+        # lock first, submit second: the loop stalls with work pending
+        eng._weight_lock.acquire()
+        eng.submit(
+            ModelRequest(
+                input_ids=[1, 2, 3],
+                gconfig=GenerationHyperparameters(max_new_tokens=4, greedy=True),
+            ),
+            lambda r: None,
+        )
+
+    threading.Thread(target=wedger, daemon=True).start()
+    while True:
+        time.sleep(1.0)
+
+
+if __name__ == "__main__":
+    main()

@@ -132,6 +132,62 @@ def test_probe_emits_device_count_before_warmup(capsys, monkeypatch, tmp_path):
     assert payloads[-1]["warm"] is True
 
 
+def test_main_folds_gateway_scoreboard(cache_dir, monkeypatch, capsys):
+    """The serving scoreboard (many-client gateway goodput bench) rides the
+    round payload: goodput + per-class tails land in detail["gateway"]."""
+
+    def fake_spawn(name, deadline=None):
+        if name == "probe":
+            return {"phase": "probe", "platform": "tpu", "n_devices": 1}
+        if name == "gateway":
+            return {
+                "phase": "gateway",
+                "goodput_tok_s": 123.4,
+                "classes": {
+                    "interactive": {"ttft_p99_s": 0.5, "goodput_tok_s": 20.0},
+                    "rollout": {"ttft_p99_s": 1.5, "goodput_tok_s": 103.4},
+                },
+            }
+        return {"phase": name, "error": "skipped"}
+
+    monkeypatch.setattr(bench, "_spawn_phase", fake_spawn)
+    bench.main()
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    gw = out["detail"]["gateway"]
+    assert gw["goodput_tok_s"] == 123.4
+    assert gw["classes"]["rollout"]["ttft_p99_s"] == 1.5
+    assert out["detail"]["sources"]["gateway"] == "live"
+
+
+def test_window_guard_skips_phases_that_no_longer_fit(cache_dir, monkeypatch, capsys):
+    """A successful probe RETRY eats ~70s beyond the static budget: phases
+    whose full deadline no longer fits the remaining capture window are
+    skipped (cache fallback), never started-and-SIGKILLed mid-measurement."""
+    calls = []
+
+    def fake_spawn(name, deadline=None):
+        calls.append(name)
+        if name == "probe":
+            return {"phase": "probe", "platform": "tpu", "n_devices": 1}
+        return {"phase": name, "tok_s": 1.0}
+
+    monkeypatch.setattr(bench, "_spawn_phase", fake_spawn)
+    # shrink the window so only the 90s gateway phase still fits
+    monkeypatch.setattr(
+        bench, "_CAPTURE_WINDOW_S", bench._OVERHEAD_ALLOWANCE_S + 100.0
+    )
+    bench.main()
+    assert calls == ["probe", "gateway"]
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    assert "capture window exhausted" in out["detail"]["errors"]["decode"]
+
+
 def test_main_prefers_live_over_cache(cache_dir, monkeypatch, capsys):
     _seed(cache_dir, "decode", {"phase": "decode", "tok_s": 1.0})
 
