@@ -13,6 +13,7 @@ def all_checkers() -> list:
     from areal_tpu.analysis.rules.exc import SilentExceptionChecker
     from areal_tpu.analysis.rules.jaxpurity import JaxPurityChecker
     from areal_tpu.analysis.rules.obs import MetricCatalogChecker
+    from areal_tpu.analysis.rules.sig import SignalSafetyChecker
     from areal_tpu.analysis.rules.thr import SharedStateChecker
 
     return [
@@ -22,4 +23,5 @@ def all_checkers() -> list:
         ConfigDriftChecker(),
         MetricCatalogChecker(),
         SilentExceptionChecker(),
+        SignalSafetyChecker(),
     ]

@@ -1,0 +1,329 @@
+"""SIG — signal handlers must only set flags/events.
+
+A CPython signal handler runs on the main thread between two arbitrary
+bytecodes. Whatever the interrupted code was holding — the flight-ring
+lock, a metrics shard lock, a half-mutated dict — is frozen underneath the
+handler, so anything beyond flipping a flag risks deadlock (blocking on a
+lock the frozen frame holds), re-entrancy corruption, or eating the
+platform's preemption grace window inside the handler itself. The
+sanctioned pattern (robustness/preemption.py): the handler sets a
+``threading.Event``; a pre-armed drainer thread or the owning loop does
+the real work. Rules:
+
+  SIG001  blocking call in signal-handler context: file/network I/O,
+          ``time.sleep``, ``.join``/``.wait``/``.acquire``, subprocess,
+          logging, print, flight/ring dumps
+  SIG002  lock usage in signal-handler context (``with <lock>:`` or
+          ``.acquire()``) — the interrupted frame may already hold it
+  SIG003  allocation of threads/processes/executors or bulk containers
+          (comprehensions) in signal-handler context
+
+Handler context = the function registered via ``signal.signal(sig, fn)``
+(named function, ``self.method``, or lambda), plus same-file helpers it
+calls DIRECTLY. Functions merely referenced (e.g. as a ``Thread`` target —
+they run on that thread, not in handler context) are not followed.
+``asyncio`` ``add_signal_handler`` callbacks run on the event loop, not in
+handler context, and are exempt. Allowed in handlers: assignments,
+``Event.set/clear``, ``signal.*`` re-arming, clock reads
+(``time.monotonic``/``time.time``), ``os.kill``/``os._exit``/
+``sys.exit``, and control flow.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.analysis.core import (
+    Finding,
+    ProjectContext,
+    SourceFile,
+    dotted_name,
+    make_key,
+)
+
+# exact dotted callees that block (I/O, sleeps, process waits)
+_BLOCKING_NAMES = {
+    "open",
+    "print",
+    "time.sleep",
+    "os.system",
+    "os.fsync",
+    "os.makedirs",
+    "os.replace",
+    "os.rename",
+    "os.remove",
+    "os.unlink",
+    "input",
+}
+_BLOCKING_PREFIXES = (
+    "urllib.",
+    "requests.",
+    "socket.",
+    "http.client.",
+    "shutil.",
+    "subprocess.",
+    "logging.",
+    "pickle.",
+    "json.",
+)
+# attribute-call suffixes that block wherever they appear
+_BLOCKING_SUFFIXES = {
+    "join",
+    "wait",
+    "sleep",
+    "urlopen",
+    "dump",
+    "dumps",  # ring/trace dumps write disk (FlightRecorder.dump)
+    "flush",
+    "fsync",
+    "write",
+    "read",
+    "recv",
+    "send",
+    "sendall",
+    "connect",
+}
+# names that are (or conventionally hold) loggers — logging takes the
+# logging module's module-level lock AND writes to a stream
+_LOGGERISH = {"logger", "log", "logging", "alog"}
+_LOG_METHODS = {
+    "debug",
+    "info",
+    "warning",
+    "warn",
+    "error",
+    "exception",
+    "critical",
+}
+_THREADISH_CTORS = {
+    "threading.Thread",
+    "Thread",
+    "multiprocessing.Process",
+    "Process",
+    "subprocess.Popen",
+    "Popen",
+    "ThreadPoolExecutor",
+    "ProcessPoolExecutor",
+    "concurrent.futures.ThreadPoolExecutor",
+    "concurrent.futures.ProcessPoolExecutor",
+}
+_COMPREHENSIONS = (ast.ListComp, ast.DictComp, ast.SetComp, ast.GeneratorExp)
+
+
+def _last_part(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1]
+
+
+def _lockish(dotted: str | None) -> bool:
+    if not dotted:
+        return False
+    last = _last_part(dotted).lower()
+    return any(t in last for t in ("lock", "mutex", "cv", "cond", "sem"))
+
+
+def _iter_direct(root: ast.AST):
+    """Walk without entering nested defs/lambdas/classes — code inside a
+    nested def does not run in handler context unless called (the one-hop
+    resolution below handles direct calls)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        n = stack.pop()
+        if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class SignalSafetyChecker:
+    FAMILY = "SIG"
+    RULES = {
+        "SIG001": "blocking call in signal-handler context",
+        "SIG002": "lock usage in signal-handler context",
+        "SIG003": "allocation/thread creation in signal-handler context",
+    }
+
+    # -- handler discovery -------------------------------------------------
+    def _defs_by_name(self, sf: SourceFile) -> dict[str, list[ast.AST]]:
+        out: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.setdefault(node.name, []).append(node)
+        return out
+
+    def _handler_roots(self, sf: SourceFile) -> list[tuple[str, ast.AST]]:
+        """(handler_name, body_root) for every resolvable handler passed to
+        ``signal.signal``. Unresolvable expressions (``prev or SIG_DFL``,
+        ``signal.SIG_IGN``, names imported from elsewhere) are skipped —
+        this rule is about handlers defined here."""
+        defs = self._defs_by_name(sf)
+        roots: list[tuple[str, ast.AST]] = []
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and len(node.args) >= 2):
+                continue
+            callee = dotted_name(node.func)
+            if callee not in ("signal.signal", "signal"):
+                continue
+            target = node.args[1]
+            if isinstance(target, ast.Lambda):
+                roots.append(("<lambda>", target))
+                continue
+            name = None
+            if isinstance(target, ast.Name):
+                name = target.id
+            elif isinstance(target, ast.Attribute):
+                # self._on_signal / handler.method — resolve by attr name
+                name = target.attr
+            if name and not name.startswith("SIG"):
+                for d in defs.get(name, []):
+                    roots.append((name, d))
+        return roots
+
+    # -- body analysis -----------------------------------------------------
+    def _analyze(
+        self,
+        sf: SourceFile,
+        handler: str,
+        root: ast.AST,
+        defs: dict[str, list[ast.AST]],
+        seen: set[int],
+        depth: int,
+    ) -> Iterator[Finding]:
+        if id(root) in seen or depth > 2:
+            return
+        seen.add(id(root))
+        via = "" if depth == 0 else f" (reached from handler '{handler}')"
+        for node in _iter_direct(root):
+            if isinstance(node, _COMPREHENSIONS):
+                yield Finding(
+                    rule="SIG003",
+                    path=sf.relpath,
+                    line=node.lineno,
+                    message=(
+                        "bulk container built in signal-handler context"
+                        + via
+                        + "; handlers must only set flags/events"
+                    ),
+                    key=make_key(
+                        "SIG003", sf.relpath, f"handler:{handler}", "comprehension"
+                    ),
+                )
+                continue
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    ctx = item.context_expr
+                    ctx_name = dotted_name(
+                        ctx.func if isinstance(ctx, ast.Call) else ctx
+                    )
+                    if _lockish(ctx_name):
+                        yield Finding(
+                            rule="SIG002",
+                            path=sf.relpath,
+                            line=node.lineno,
+                            message=(
+                                f"`with {ctx_name}:` in signal-handler "
+                                "context" + via + "; the interrupted frame "
+                                "may already hold the lock (deadlock)"
+                            ),
+                            key=make_key(
+                                "SIG002",
+                                sf.relpath,
+                                f"handler:{handler}",
+                                ctx_name or "with",
+                            ),
+                        )
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            last = _last_part(callee) if callee else (
+                node.func.attr if isinstance(node.func, ast.Attribute) else ""
+            )
+            if not callee and not last:
+                continue
+            # allowed portals: Event.set/clear, signal re-arm, clock reads,
+            # process exits, os.kill
+            if last in ("set", "clear", "is_set", "monotonic", "time", "kill",
+                        "_exit", "exit", "raise_signal", "getsignal", "signal"):
+                continue
+            if callee in _THREADISH_CTORS or (
+                callee and _last_part(callee) in {"Thread", "Process", "Popen"}
+            ):
+                yield Finding(
+                    rule="SIG003",
+                    path=sf.relpath,
+                    line=node.lineno,
+                    message=(
+                        f"`{callee}` created in signal-handler context"
+                        + via
+                        + "; arm worker threads BEFORE installing the "
+                        "handler and have the handler set their event"
+                    ),
+                    key=make_key(
+                        "SIG003", sf.relpath, f"handler:{handler}", callee or last
+                    ),
+                )
+                continue
+            if last == "acquire":
+                yield Finding(
+                    rule="SIG002",
+                    path=sf.relpath,
+                    line=node.lineno,
+                    message=(
+                        f"`{callee or last}` in signal-handler context"
+                        + via
+                        + "; the interrupted frame may already hold the "
+                        "lock (deadlock)"
+                    ),
+                    key=make_key(
+                        "SIG002", sf.relpath, f"handler:{handler}", callee or last
+                    ),
+                )
+                continue
+            blocking = (
+                (callee in _BLOCKING_NAMES)
+                or (
+                    callee
+                    and any(callee.startswith(p) for p in _BLOCKING_PREFIXES)
+                )
+                or (last in _BLOCKING_SUFFIXES)
+                or (
+                    isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in _LOGGERISH
+                    and last in _LOG_METHODS
+                )
+            )
+            if blocking:
+                yield Finding(
+                    rule="SIG001",
+                    path=sf.relpath,
+                    line=node.lineno,
+                    message=(
+                        f"blocking call `{callee or last}` in signal-handler "
+                        "context" + via + "; handlers must only set "
+                        "flags/events — move the work to a pre-armed "
+                        "drainer thread"
+                    ),
+                    key=make_key(
+                        "SIG001", sf.relpath, f"handler:{handler}", callee or last
+                    ),
+                )
+                continue
+            # one-hop reachability: a same-file function called DIRECTLY
+            # runs in handler context too
+            if isinstance(node.func, ast.Name) and node.func.id in defs:
+                for d in defs[node.func.id]:
+                    yield from self._analyze(
+                        sf, handler, d, defs, seen, depth + 1
+                    )
+
+    def check(self, sf: SourceFile, ctx: ProjectContext) -> Iterator[Finding]:
+        roots = self._handler_roots(sf)
+        if not roots:
+            return
+        defs = self._defs_by_name(sf)
+        for handler, root in roots:
+            yield from self._analyze(sf, handler, root, defs, set(), 0)

@@ -265,9 +265,68 @@ class ChaosConfig:
     # unlike "hang" nothing raises, so retries don't mask it)
     stall_prob: float = 0.0
     stall_s: float = 0.5
+    # preempt: deliver SIGTERM to one registered live worker process (the
+    # spot-TPU lifecycle injected mid-run — robustness/preemption.py is the
+    # machinery under test). The request itself proceeds untouched; targets
+    # register via FaultInjector.set_preempt_targets. Each target is
+    # preempted at most once per injector so a chaos run kills a bounded
+    # set of workers instead of the whole fleet.
+    preempt_prob: float = 0.0
     # only inject on paths starting with this prefix ("" = every path);
     # lets a test target /generate while leaving weight updates clean
     path_prefix: str = ""
+
+
+@dataclass
+class PreemptionConfig:
+    """Preemption-tolerant lifecycle (robustness/preemption.py,
+    docs/fault_tolerance.md "Preemption & graceful drain").
+
+    TPU fleets are routinely preemptible: the platform delivers SIGTERM
+    with a short grace window before SIGKILL. The handler itself only sets
+    flags/events (arealint SIG family); the actual work — trainer emergency
+    recover dump + rollout drain, serving admission-stop + finish-or-park —
+    runs on the owning thread inside ``grace_s``."""
+
+    enabled: bool = True
+    # total budget from signal delivery to clean exit. The platform grace
+    # window minus headroom for process teardown; work that would overrun
+    # it is aborted rather than finished.
+    grace_s: float = 25.0
+    # serving-side finish-or-park window: in-flight decodes that complete
+    # within it return normally; at the deadline survivors are parked
+    # (rid-affinity KV, partial tokens returned) or aborted. Must leave
+    # room inside grace_s for the flight dump + deregistration.
+    drain_budget_s: float = 10.0
+    # process exit code after a clean preemption drain (0 lets supervisors
+    # distinguish "drained on request" from a crash)
+    exit_code: int = 0
+    # also listen on SIGUSR1 (driver-initiated drains without the
+    # platform's SIGTERM semantics)
+    handle_sigusr1: bool = True
+
+
+@dataclass
+class TrajectoryJournalConfig:
+    """Durable trajectory journal (infra/trajectory_journal.py).
+
+    Accepted rollout trajectories are appended to a crash-tolerant
+    segmented journal with their per-token policy-version tags; on
+    recovery, entries still inside the staleness bound are replayed into
+    the batch queue instead of re-generated (over-stale entries are
+    counted and dropped). Off by default: journaling costs one fsync'd
+    append per accepted trajectory."""
+
+    enabled: bool = False
+    # journal directory; "" derives {fileroot}/{experiment}/{trial}/journal
+    dir: str = ""
+    # active segment seals (atomic rename + checksum footer) after either
+    # bound; smaller segments bound torn-tail loss to fewer records
+    segment_max_records: int = 64
+    segment_max_bytes: int = 64 * 1024 * 1024
+    # fsync every appended record. True survives power loss at ~fsync cost
+    # per trajectory; False still survives process death (page cache).
+    fsync: bool = True
 
 
 @dataclass
@@ -367,6 +426,12 @@ class InferenceEngineConfig:
     # ServerConfig.lifecycle
     lifecycle: RequestLifecycleConfig = field(
         default_factory=RequestLifecycleConfig
+    )
+    # durable trajectory journal (infra/trajectory_journal.py): accepted
+    # trajectories survive a trainer crash/preemption and replay on
+    # recovery instead of being re-generated
+    journal: TrajectoryJournalConfig = field(
+        default_factory=TrajectoryJournalConfig
     )
 
 
@@ -474,6 +539,10 @@ class ServerConfig:
     lifecycle: RequestLifecycleConfig = field(
         default_factory=RequestLifecycleConfig
     )
+    # spot-TPU lifecycle (docs/fault_tolerance.md): SIGTERM enters a
+    # graceful drain — admission stops (429), in-flight decodes finish or
+    # park within preemption.drain_budget_s, the replica deregisters
+    preemption: PreemptionConfig = field(default_factory=PreemptionConfig)
     # where streamed weight-update buckets stage while generation continues:
     # "device" = device_put on arrival (staging costs a 2nd copy of the
     #            weights in HBM until commit; the commit itself is a pointer
@@ -644,6 +713,10 @@ class BaseExperimentConfig:
     launcher: LauncherConfig = field(default_factory=LauncherConfig)
     perf_tracer: PerfTracerConfig = field(default_factory=PerfTracerConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    # trainer-side preemption tolerance (robustness/preemption.py): SIGTERM
+    # finishes/aborts the step, forces an emergency recover dump, drains
+    # rollout, exits cleanly inside the grace window
+    preemption: PreemptionConfig = field(default_factory=PreemptionConfig)
 
 
 @dataclass

@@ -1328,6 +1328,30 @@ class JaxTrainEngine(TrainEngine):
             )
         return self._async_ckptr
 
+    # -- async recover dumps (utils/saver.py Saver.save_async) -------------
+    # Orbax's AsyncCheckpointer still BLOCKS the caller for device->host
+    # staging plus any previous save; the step loop's pause should be the
+    # host snapshot alone. Split the save so Saver can run the Orbax write
+    # on its own background thread against an immutable numpy tree.
+    def snapshot_for_save(self, with_optim: bool = True) -> dict:
+        """Host (numpy) snapshot of params (+ optimizer state): the ONLY
+        step-loop-blocking part of an async checkpoint. jax arrays are
+        immutable, so the copy is consistent without pausing anything."""
+        self.wait_for_save()  # order after any in-flight orbax async save
+        ckpt = {"params": jax.tree.map(np.asarray, self.params)}
+        if with_optim:
+            ckpt["opt_state"] = jax.tree.map(np.asarray, self.opt_state)
+        return ckpt
+
+    def write_snapshot(self, snapshot: dict, path: str) -> None:
+        """Write a :meth:`snapshot_for_save` tree as the same Orbax layout
+        :meth:`load` restores. Runs on the saver's background thread —
+        touches no engine state."""
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(os.path.join(path, "state"), snapshot, force=True)
+
     def wait_for_save(self) -> None:
         """Block until any in-flight async checkpoint finished staging+write
         (must run before params/opt_state mutate)."""

@@ -880,6 +880,20 @@ class RemoteJaxEngine(InferenceEngine):
         self._paused = False
         self.executor.resume()
 
+    # -- preemption / durability (docs/fault_tolerance.md) -----------------
+    def attach_journal(self, journal) -> None:
+        """Durable trajectory journal: accepted trajectories survive a
+        trainer crash and replay on recovery (infra/trajectory_journal.py)."""
+        self.executor.attach_journal(journal)
+
+    def replay_from_journal(self, max_staleness: int | None = None) -> tuple[int, int]:
+        return self.executor.replay_from_journal(max_staleness)
+
+    def set_interrupt(self, event) -> None:
+        """Preemption: alias the handler's requested-event into the
+        executor's blocking waits (they raise RolloutInterrupted)."""
+        self.executor.set_interrupt(event)
+
     # -- server-side generation pause (weight-update window) --------------
     def pause_generation(
         self, targets: list[str] | None = None, mode: str = "abort"

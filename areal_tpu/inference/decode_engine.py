@@ -306,6 +306,11 @@ class DecodeEngine:
         self.flight = tl_mod.get_flight_recorder()
         self._hold_marked = False  # one FENCE_STALL mark per hold window
         self._wedge_dumped = False  # one flight dump per wedge escalation
+        # preemption drain (docs/fault_tolerance.md): set = admission
+        # closed, replica finishing-or-parking toward process exit
+        self._draining = threading.Event()
+        self._drain_summary: dict | None = None
+        self._obs_preempt = obs_catalog.preemption_metrics()
 
     # -- lifecycle --------------------------------------------------------
     def initialize(self) -> None:
@@ -828,9 +833,15 @@ class DecodeEngine:
     def check_admission(self) -> tuple[bool, str, dict]:
         """Admission-control gate for new generation requests. Returns
         (admit, reason, snapshot); ``reason`` names the tripped gate
-        ("queue_depth" | "page_headroom") when admit is False."""
+        ("queue_depth" | "page_headroom" | "draining") when admit is
+        False."""
         lc = self._lifecycle()
         snap = self.admission_snapshot()
+        # a draining replica admits NOTHING, lifecycle config or not — the
+        # process is on its way out (preemption grace window); clients see
+        # 429 + Retry-After and fail over to a sibling
+        if self._draining.is_set():
+            return False, "draining", snap
         if lc is None:
             return True, "", snap
         if lc.max_queue_depth > 0 and snap["queue_depth"] >= lc.max_queue_depth:
@@ -1058,6 +1069,125 @@ class DecodeEngine:
         release_memory requires; a hold fence keeps slots live and does
         NOT qualify."""
         return self._paused.is_set()
+
+    # -- preemption drain (docs/fault_tolerance.md) ------------------------
+    @property
+    def is_draining(self) -> bool:
+        return self._draining.is_set()
+
+    def begin_drain(self) -> None:
+        """Close admission (check_admission rejects with reason
+        "draining") while in-flight decodes keep running — the first half
+        of the finish-or-park drain. Idempotent."""
+        if not self._draining.is_set():
+            self._draining.set()
+            self.flight.record("drain_begin", severity="warn")
+        self._wakeup.set()
+
+    def end_drain(self) -> None:
+        """Re-open admission (ops escape hatch / tests; a preempted
+        process never calls this)."""
+        self._draining.clear()
+
+    def _abort_queued(self) -> None:
+        """Finish every queued/backlogged task with stop_reason=abort —
+        decode-loop-thread only (backlog ownership). A draining replica
+        must leave no request without a terminal: the callback's partial
+        response is what lets the client resubmit elsewhere."""
+        while True:
+            try:
+                self._backlog.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        while self._backlog:
+            task = self._backlog.popleft()
+            self._finish(task, StopReason.ABORT.value)
+
+    def drain(self, budget_s: float = 10.0) -> dict:
+        """Graceful preemption drain: stop admission, let in-flight
+        decodes finish inside ``budget_s``, then park (rid-affinity KV,
+        partial tokens returned) or abort the survivors and the queue.
+        Blocks until the engine is quiescent; returns (and stores for
+        /statusz) a summary incl. the leak audit. Any thread."""
+        t0 = time.monotonic()
+        self.begin_drain()
+        aborted_before = self.stats["aborted"]
+        deadline = t0 + max(0.0, budget_s)
+        finished_in_budget = True
+        while True:
+            loop_alive = self._thread is not None and self._thread.is_alive()
+            busy = any(t is not None for t in self._slot_task) or (
+                self._queue.qsize() + len(self._backlog) > 0
+            )
+            if not busy:
+                break
+            if not loop_alive or self.is_paused:
+                # nothing will finish on its own — park/abort immediately
+                finished_in_budget = False
+                break
+            if time.monotonic() >= deadline:
+                finished_in_budget = False
+                break
+            time.sleep(0.02)
+        # survivors: the abort pause parks rid'd in-flight requests
+        # (_abort_all) and the paused loop branch clears the queue
+        self.pause_generation()
+        if self._thread is not None and self._thread.is_alive():
+            self._pause_ack.wait(timeout=max(5.0, budget_s))
+            # _pause_ack may pre-date this drain (engine already abort-
+            # paused): the loop aborts the queue on its NEXT pass — wait
+            # for it so the summary reflects every terminal having fired
+            qdeadline = time.monotonic() + 5.0
+            while (
+                self._queue.qsize() + len(self._backlog) > 0
+                and time.monotonic() < qdeadline
+            ):
+                self._wakeup.set()
+                time.sleep(0.01)
+        else:
+            # no loop: this thread owns the state — drain inline
+            self._abort_all()
+            self._abort_queued()
+        held = self._radix.pages_held if self._radix is not None else 0
+        parked_pages = sum(len(p.pages) for p in self._parked.values())
+        pool_used = self.pool.used if hasattr(self, "pool") else 0
+        summary = {
+            "draining": True,
+            "drain_seconds": time.monotonic() - t0,
+            "finished_in_budget": finished_in_budget,
+            "budget_s": budget_s,
+            "parked": len(self._parked),
+            "aborted": self.stats["aborted"] - aborted_before,
+            "leaked_pages": int(pool_used - held - parked_pages),
+            "unterminated_timelines": self.timeline.stats()["unterminated"],
+        }
+        self._drain_summary = summary
+        self._obs_preempt.drain_seconds.observe(summary["drain_seconds"])
+        self.flight.record(
+            "drain_end",
+            severity="warn",
+            seconds=round(summary["drain_seconds"], 3),
+            parked=summary["parked"],
+            aborted=summary["aborted"],
+            leaked_pages=summary["leaked_pages"],
+        )
+        logger.warning(
+            f"drain complete in {summary['drain_seconds']:.2f}s "
+            f"(finished_in_budget={finished_in_budget}, "
+            f"parked={summary['parked']}, aborted={summary['aborted']}, "
+            f"leaked_pages={summary['leaked_pages']})"
+        )
+        return summary
+
+    def drain_status(self) -> dict:
+        """The /statusz drain section: live flag + last drain summary
+        (``draining`` always reflects the CURRENT state — an undrained
+        replica must not keep reporting its historical drain as live)."""
+        out = (
+            dict(self._drain_summary) if self._drain_summary is not None else {}
+        )
+        out["draining"] = self._draining.is_set()
+        return out
 
     def _wait_weight_update_applied(self) -> None:
         """Wait for the decode loop to apply the pending update (or apply it
@@ -2793,6 +2923,11 @@ class DecodeEngine:
                 self._drain(pending)
                 pending = None
                 self._abort_all()
+                if self._draining.is_set():
+                    # a draining replica leaves no queued request without a
+                    # terminal — abort them now so callbacks fire (partial
+                    # responses let callers resubmit elsewhere)
+                    self._abort_queued()
                 # release_memory waits on this: no chunk is in flight and
                 # _abort_all (incl. KV parking) has completed
                 self._pause_ack.set()

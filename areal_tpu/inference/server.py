@@ -122,6 +122,8 @@ class InferenceServer:
                 web.post("/resume_memory_occupation", self.h_resume_memory),
                 web.post("/flush_prefix_cache", self.h_flush_prefix_cache),
                 web.post("/abort_request", self.h_abort_request),
+                web.post("/drain", self.h_drain),
+                web.post("/undrain", self.h_undrain),
                 web.get("/debug/flight", self.h_debug_flight),
             ]
         )
@@ -129,6 +131,16 @@ class InferenceServer:
 
     # -- handlers ---------------------------------------------------------
     async def h_health(self, request: web.Request) -> web.Response:
+        # preemption drain (docs/fault_tolerance.md): a draining replica is
+        # leaving the fleet — 503 makes the client fleet probe / PR 3
+        # supervision stop routing to it immediately, while in-flight
+        # decodes finish-or-park inside the drain budget
+        draining = getattr(self.engine, "is_draining", False)
+        if draining:
+            return web.json_response(
+                {"status": "draining", "version": self.engine.get_version()},
+                status=503,
+            )
         # wedge escalation (docs/request_lifecycle.md): a decode loop that
         # stopped making passes while work is pending can't run its own
         # watchdog — report 503 so the client fleet probe / PR 3
@@ -208,6 +220,12 @@ class InferenceServer:
         snap = getattr(self.engine, "admission_snapshot", None)
         if snap is not None:
             out["lifecycle"] = snap()
+        ds = getattr(self.engine, "drain_status", None)
+        if ds is not None:
+            # preemption drain view (docs/fault_tolerance.md): live flag
+            # plus the last drain's summary (finish-or-park outcome, leak
+            # audit) — what an operator checks after a spot reclaim
+            out["drain"] = ds()
         tl = getattr(self.engine, "timeline", None)
         if tl is not None:
             # same key as /debug/flight's stats section — over THERE
@@ -364,6 +382,46 @@ class InferenceServer:
         abort = getattr(self.engine, "abort_request", None)
         queued = bool(abort(rid)) if abort is not None else False
         return web.json_response({"status": "ok", "queued": queued})
+
+    async def h_drain(self, request: web.Request) -> web.Response:
+        """Ops/driver-initiated graceful drain (the same path a SIGTERM
+        preemption takes, minus the process exit): admission closes with
+        429 reason="draining", in-flight decodes finish or park within the
+        budget, and the summary (incl. the leak audit) comes back.
+        Optional JSON body: {"budget_s": seconds}."""
+        self._metrics.requests.labels(endpoint="drain").inc()
+        drain = getattr(self.engine, "drain", None)
+        if drain is None:
+            return web.json_response(
+                {"status": "error", "error": "engine has no drain"}, status=501
+            )
+        budget = getattr(
+            getattr(self.engine.config, "preemption", None), "drain_budget_s", 10.0
+        )
+        raw = await request.read()
+        if raw.strip():
+            try:
+                budget = float(json.loads(raw).get("budget_s", budget))
+            except (ValueError, AttributeError):
+                return web.json_response(
+                    {"status": "error", "error": "unparsable JSON body"},
+                    status=400,
+                )
+        summary = await asyncio.get_running_loop().run_in_executor(
+            None, drain, budget
+        )
+        return web.json_response({"status": "ok", **summary})
+
+    async def h_undrain(self, request: web.Request) -> web.Response:
+        """Cancel an ops-initiated drain (a migration called off): re-open
+        admission and resume the decode loop. A SIGTERM-driven drain never
+        comes back this way — that process is exiting."""
+        self._metrics.requests.labels(endpoint="undrain").inc()
+        end = getattr(self.engine, "end_drain", None)
+        if end is not None:
+            end()
+        self.engine.continue_generation()
+        return web.json_response({"status": "ok"})
 
     async def h_pause(self, request: web.Request) -> web.Response:
         """Pause modes: default "abort" (legacy §3.4: in-flight requests
@@ -748,9 +806,43 @@ def main(argv=None) -> None:
     args, rest = p.parse_known_args(argv)
     cfg, _ = load_expr_config(rest, ServerConfig)
     server = InferenceServer(cfg)
-    # flight recorder: persist the significant-event ring on SIGTERM so an
-    # externally killed replica still leaves a postmortem artifact
-    tl_mod.install_signal_dump()
+    pre_cfg = getattr(cfg, "preemption", None)
+    if pre_cfg is not None and pre_cfg.enabled:
+        # preemption-tolerant serving (docs/fault_tolerance.md): SIGTERM /
+        # SIGUSR1 only set a flag; the drainer thread (armed BEFORE the
+        # handler installs) closes admission, finish-or-parks in-flight
+        # decodes within the drain budget, deregisters from the fleet,
+        # persists the flight ring (composing with the PR 7 dump), and
+        # exits cleanly inside the grace window
+        from areal_tpu.robustness.preemption import PreemptionHandler
+
+        handler = PreemptionHandler(
+            role="inference_server",
+            grace_s=pre_cfg.grace_s,
+            handle_sigusr1=pre_cfg.handle_sigusr1,
+        )
+
+        def drain_replica(h: PreemptionHandler) -> None:
+            budget = min(pre_cfg.drain_budget_s, max(0.0, h.remaining() - 2.0))
+            server.engine.drain(budget)
+            if args.name:
+                try:
+                    name_resolve.delete(args.name)
+                except Exception:  # noqa: BLE001 — a dead discovery backend
+                    # must not eat the remaining grace window
+                    logger.warning("name_resolve deregister failed", exc_info=True)
+            ring = tl_mod.get_flight_recorder()
+            try:
+                ring.dump(tl_mod.default_dump_path("preempt"), "preempt")
+            except OSError:
+                logger.exception("preempt flight dump failed")
+
+        handler.spawn_drainer(drain_replica, exit_code=pre_cfg.exit_code)
+        handler.install()
+    else:
+        # flight recorder: persist the significant-event ring on SIGTERM so
+        # an externally killed replica still leaves a postmortem artifact
+        tl_mod.install_signal_dump()
     if args.name:
         name_resolve.add(args.name, server.address, keepalive_ttl=None)
     server.run_forever()

@@ -68,6 +68,7 @@ class RolloutController:
         self._cb_thread = None
         self._cb_server = None
         self._cb_url = ""  # re-registered on respawned workers
+        self._preemption = None  # PreemptionHandler | None (install_preemption)
         # fleet telemetry (start_telemetry): scrape loop + HTTP endpoint
         self._telemetry_thread = None
         self._telemetry_server = None
@@ -263,6 +264,44 @@ class RolloutController:
             self._gateway_thread = None
             self._gateway_loop = None
             self.gateway_url = None
+
+    # -- preemption (robustness/preemption.py) -----------------------------
+    def install_preemption(
+        self, grace_s: float = 25.0, exit_code: int | None = 0
+    ):
+        """Standalone-controller preemption tolerance
+        (docs/fault_tolerance.md "Preemption & graceful drain"): SIGTERM
+        sets a flag; the pre-armed drainer stops supervision FIRST (a
+        reclaim usually takes the whole allocation — respawning workers
+        the platform is about to kill anyway just burns the grace
+        window), pauses submissions fleet-wide, persists the flight ring,
+        then exits cleanly. Controllers embedded in a trainer process
+        must NOT call this — the trainer's handler owns the signal there.
+        Returns the handler (``exit_code=None`` skips the process exit,
+        for tests/drivers that manage their own shutdown)."""
+        from areal_tpu.observability import timeline as _tl
+        from areal_tpu.robustness.preemption import PreemptionHandler
+
+        handler = PreemptionHandler(role="rollout_controller", grace_s=grace_s)
+
+        def drain(h: PreemptionHandler) -> None:
+            self.stop_supervision()
+            try:
+                self.pause()
+            except Exception:  # noqa: BLE001 — workers may already be
+                # dying under the same reclaim; keep draining
+                logger.warning("fleet pause on drain failed", exc_info=True)
+            try:
+                _tl.get_flight_recorder().dump(
+                    _tl.default_dump_path("preempt"), "preempt"
+                )
+            except OSError:
+                logger.exception("preempt flight dump failed")
+
+        handler.spawn_drainer(drain, exit_code=exit_code)
+        handler.install()
+        self._preemption = handler
+        return handler
 
     # -- replica supervision (robustness/supervisor.py) --------------------
     # The supervisor probes every worker's RPC /health on a cadence; dead

@@ -242,8 +242,56 @@ def main(argv=None) -> None:
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--name", default="", help="name_resolve key to register")
+    p.add_argument(
+        "--no-preemption",
+        action="store_true",
+        help="keep default SIGTERM semantics (no graceful drain handler)",
+    )
     args = p.parse_args(argv)
     server = RpcWorkerServer(host=args.host, port=args.port)
+    if args.no_preemption:
+        _serve_forever(server, args)
+        return
+
+    # preemption-tolerant worker (docs/fault_tolerance.md): SIGTERM sets a
+    # flag; the pre-armed drainer pauses hosted engines (journals seal via
+    # their owners), deregisters, and exits cleanly inside the grace
+    # window so supervision respawns instead of diagnosing a crash
+    from areal_tpu.robustness.preemption import PreemptionHandler
+
+    handler = PreemptionHandler(role="rollout_worker")
+
+    def drain_worker(h: PreemptionHandler) -> None:
+        for eng in list(server.engines.values()):
+            pause = getattr(eng, "pause", None)
+            if pause is not None:
+                try:
+                    pause()
+                except Exception:  # noqa: BLE001 — best-effort quiesce;
+                    # the grace window matters more than a clean pause
+                    logger.warning("engine pause on drain failed", exc_info=True)
+        if args.name:
+            try:
+                from areal_tpu.utils import name_resolve as _nr
+
+                _nr.delete(args.name)
+            except Exception:  # noqa: BLE001 — dead discovery backend
+                logger.warning("name_resolve deregister failed", exc_info=True)
+        from areal_tpu.observability import timeline as _tl
+
+        try:
+            _tl.get_flight_recorder().dump(
+                _tl.default_dump_path("preempt"), "preempt"
+            )
+        except OSError:
+            logger.exception("preempt flight dump failed")
+
+    handler.spawn_drainer(drain_worker, exit_code=0)
+    handler.install()
+    _serve_forever(server, args)
+
+
+def _serve_forever(server: RpcWorkerServer, args) -> None:
     if args.name:
         from areal_tpu.utils import name_resolve
 

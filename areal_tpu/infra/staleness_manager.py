@@ -74,6 +74,19 @@ class StalenessManager:
             self.stat.rejected += n
         self._metrics.rejected.inc(n)
 
+    def restore_accepted(self, n: int = 1) -> None:
+        """Recovery-time accounting restoration (trajectory-journal
+        replay, docs/fault_tolerance.md): the trajectories were submitted
+        AND accepted in a previous life, so only the accepted count
+        re-enters the capacity formula — the staleness bound re-tightens
+        exactly as before the crash, while the cumulative
+        submitted/accepted *counters* (which the stats pipeline exports as
+        this-life throughput) are not inflated by re-counting old work."""
+        if n <= 0:
+            return
+        with self._lock:
+            self.stat.accepted += n
+
     def observe_version_lag(self, lag: int) -> None:
         """Record an accepted trajectory's version lag (current policy
         version minus the oldest per-token version in the trajectory) —

@@ -34,6 +34,12 @@ from areal_tpu.utils import stats_tracker
 logger = alog.getLogger("workflow_executor")
 
 
+class RolloutInterrupted(RuntimeError):
+    """A blocking rollout wait was interrupted (preemption drain): the
+    trainer's step must abort instead of waiting out the request timeout —
+    the grace window is far shorter."""
+
+
 def check_trajectory_format(traj: TensorDict) -> None:
     """Guard user workflow output (reference workflow_executor.py:42-221)."""
     if not isinstance(traj, dict) or not traj:
@@ -123,7 +129,15 @@ class WorkflowExecutor:
         self.tokenizer = None
         self._obs = catalog.executor_metrics()
         self._robust = catalog.robustness_metrics()
+        self._preempt_obs = catalog.preemption_metrics()
         self._inflight = 0  # launched, not yet completed (dispatcher-only)
+        # durable trajectory journal (infra/trajectory_journal.py): accepted
+        # trajectories are appended with their version tags; consumption is
+        # journaled at pop time so recovery knows what is replayable
+        self.journal = None
+        # preemption: an external Event that aborts blocking waits
+        # (wait/prepare_batch raise RolloutInterrupted once it sets)
+        self._interrupt: threading.Event | None = None
 
     # -- lifecycle --------------------------------------------------------
     def initialize(self) -> None:
@@ -145,6 +159,92 @@ class WorkflowExecutor:
 
     def resume(self) -> None:
         self._paused.clear()
+
+    # -- preemption / durability hooks ------------------------------------
+    def set_interrupt(self, event: threading.Event | None) -> None:
+        """Alias an external event (the PreemptionHandler's ``requested``)
+        into the blocking waits: once set, wait()/prepare_batch raise
+        RolloutInterrupted instead of blocking out their timeout — the
+        signal handler itself only sets the event."""
+        self._interrupt = event
+        with self._cv:
+            self._cv.notify_all()
+
+    def attach_journal(self, journal) -> None:
+        """Attach a TrajectoryJournal: every accepted train trajectory is
+        appended (with per-token version tags) and every popped batch is
+        marked consumed, so a crashed trainer replays instead of
+        re-generating (docs/fault_tolerance.md)."""
+        self.journal = journal
+
+    def _journal_append(self, traj: TensorDict, task_id: str, ntok: int) -> None:
+        if self.journal is None:
+            return
+        try:
+            versions = np.asarray(traj.get("versions", np.empty(0)))
+            vmask = versions >= 0
+            if versions.size and vmask.any():
+                head_v = int(versions[vmask].min())
+                tail_v = int(versions[vmask].max())
+            else:
+                head_v = tail_v = int(self.engine.get_version())
+            self.journal.append_trajectory(
+                traj, task_id, head_v, tail_v, ntok
+            )
+        except Exception:  # noqa: BLE001 — durability is best-effort; a
+            # full disk must degrade to the pre-journal behavior, not kill
+            # the rollout pipeline
+            logger.exception("trajectory journal append failed")
+
+    def _journal_consumed(self, task_ids: list[str]) -> None:
+        if self.journal is None or not task_ids:
+            return
+        try:
+            self.journal.mark_consumed(
+                task_ids, int(self.engine.get_version())
+            )
+        except Exception:  # noqa: BLE001 — see _journal_append
+            logger.exception("trajectory journal consume-mark failed")
+
+    def replay_from_journal(self, max_staleness: int | None = None) -> tuple[int, int]:
+        """Recovery: re-inject journaled trajectories that are pending
+        (never consumed, or consumed by a step the crash destroyed) and
+        still inside the staleness bound. Restores StalenessManager
+        accounting (submitted/accepted) so the capacity formula sees the
+        replayed work. Returns (n_replayed, n_dropped_stale)."""
+        if self.journal is None:
+            return 0, 0
+        if max_staleness is None:
+            max_staleness = self.staleness.max_staleness
+        version = int(self.engine.get_version())
+        replayable, n_stale, n_consumed = self.journal.pending_for_replay(
+            version, max_staleness
+        )
+        for e in replayable:
+            self.staleness.observe_version_lag(version - e.head_version)
+            self.staleness.observe_version_span(e.tail_version - e.head_version)
+            with self._cv:
+                self._results.append((e.task_id, e.traj, e.n_real_tokens))
+                self._cv.notify_all()
+        # accepted-count restoration only: the capacity formula re-tightens
+        # as before the crash without inflating this-life throughput counters
+        self.staleness.restore_accepted(len(replayable))
+        if replayable:
+            self._preempt_obs.journal_replayed.inc(len(replayable))
+        if n_stale:
+            self._preempt_obs.journal_dropped_stale.inc(n_stale)
+        logger.info(
+            f"journal replay: {len(replayable)} trajectories re-injected, "
+            f"{n_stale} dropped over-stale (bound {max_staleness}), "
+            f"{n_consumed} already consumed by checkpointed steps"
+        )
+        return len(replayable), n_stale
+
+    def _check_interrupt(self) -> None:
+        if self._interrupt is not None and self._interrupt.is_set():
+            raise RolloutInterrupted(
+                "rollout wait interrupted (preemption drain in progress)"
+            )
 
     # -- dispatch loop ----------------------------------------------------
     def _dispatch_loop(self) -> None:
@@ -285,6 +385,14 @@ class WorkflowExecutor:
             task_id, "accepted" if accepted else "rejected"
         )
         self._log_task_latency(task_id, accepted)
+        ntok = (
+            int(np.asarray(traj["attention_mask"]).sum()) if accepted else 0
+        )
+        if accepted and not is_eval:
+            # durable BEFORE visible: once a trajectory can be popped into
+            # a batch it must already be journaled, or a crash between pop
+            # and the next dump silently loses it
+            self._journal_append(traj, task_id, ntok)
         with self._cv:
             if rec is not None:
                 rec.result = traj if accepted else None
@@ -292,9 +400,7 @@ class WorkflowExecutor:
                 rec.data = None  # release the input payload
             if accepted:
                 bucket = self._eval_results if is_eval else self._results
-                bucket.append(
-                    (task_id, traj, int(np.asarray(traj["attention_mask"]).sum()))
-                )
+                bucket.append((task_id, traj, ntok))
             elif rec is not None:
                 self._reject_order.append(task_id)
                 while len(self._reject_order) > self._max_reject_records:
@@ -561,6 +667,7 @@ class WorkflowExecutor:
             bucket = lambda: self._eval_results if is_eval else self._results
             while len(bucket()) < count:
                 self._check_health()
+                self._check_interrupt()
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise TimeoutError(
@@ -574,6 +681,8 @@ class WorkflowExecutor:
                 self._results = self._results[count:]
             for tid, _, _ in out:
                 self._done_tasks.pop(tid, None)
+        if not is_eval:
+            self._journal_consumed([tid for tid, _, _ in out])
         return concat_padded_tensor_dicts([t for _, t, _ in out])
 
     def wait_for_task(self, task_id: str, timeout: float | None = None):
@@ -582,6 +691,7 @@ class WorkflowExecutor:
         with self._cv:
             while rec.accepted is None:
                 self._check_health()
+                self._check_interrupt()
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise TimeoutError(f"task {task_id} not done")
@@ -622,6 +732,7 @@ class WorkflowExecutor:
         workflow = resolve_workflow(workflow)
         while True:
             self._check_health()
+            self._check_interrupt()
             # top up submissions while there is capacity and queue space
             while (
                 self.staleness.get_capacity() > 0
@@ -644,11 +755,13 @@ class WorkflowExecutor:
                         self._results = self._results[n_take:]
                         for tid, _, _ in out:
                             self._done_tasks.pop(tid, None)
+                        self._journal_consumed([tid for tid, _, _ in out])
                         return concat_padded_tensor_dicts([t for _, t, _ in out])
                 elif len(self._results) >= bs:
                     out, self._results = self._results[:bs], self._results[bs:]
                     for tid, _, _ in out:
                         self._done_tasks.pop(tid, None)
+                    self._journal_consumed([tid for tid, _, _ in out])
                     return concat_padded_tensor_dicts([t for _, t, _ in out])
                 # event-driven: _on_result notifies _cv on every completion
                 # (which is also when staleness capacity frees up). The

@@ -495,6 +495,54 @@ def robustness_metrics(reg: Registry | None = None) -> SimpleNamespace:
     )
 
 
+def preemption_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """Preemption tolerance (robustness/preemption.py + the async
+    checkpoint / trajectory-journal paths it drives): graceful-drain
+    visibility across trainer and serving roles."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        preemptions=r.counter(
+            "areal_preemption_total",
+            "Preemption signals honored (SIGTERM/SIGUSR1 entered the "
+            "grace-window drain state machine), by process role "
+            "(trainer | inference_server | rollout_worker).",
+            label_names=("role",),
+        ),
+        drain_seconds=r.histogram(
+            "areal_drain_seconds",
+            "Graceful-drain duration: signal (or drain request) to "
+            "drained — trainer rollout drain, or serving finish-or-park "
+            "of in-flight decodes.",
+            buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+        ),
+        ckpt_save_seconds=r.histogram(
+            "areal_ckpt_save_seconds",
+            "Step-loop pause per recover/checkpoint save, by mode: "
+            "\"sync\" blocks for the full Orbax write, \"async\" only for "
+            "the host snapshot (the write runs on a background thread).",
+            label_names=("mode",),
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0),
+        ),
+        journal_appended=r.counter(
+            "areal_journal_appended_total",
+            "Accepted trajectories appended to the durable trajectory "
+            "journal (infra/trajectory_journal.py).",
+        ),
+        journal_replayed=r.counter(
+            "areal_journal_replayed_total",
+            "Journaled trajectories replayed into the batch queue on "
+            "recovery (still inside the staleness bound — rollout work "
+            "saved instead of re-generated).",
+        ),
+        journal_dropped_stale=r.counter(
+            "areal_journal_dropped_stale_total",
+            "Journaled trajectories dropped at replay: over-stale for the "
+            "restored policy version, or already consumed by a training "
+            "step the recover checkpoint covers.",
+        ),
+    )
+
+
 def aggregator_metrics(reg: Registry | None = None) -> SimpleNamespace:
     """Fleet aggregator: scrape health."""
     r = reg or get_registry()
@@ -526,6 +574,7 @@ ALL_FACTORIES = (
     rpc_metrics,
     trainer_metrics,
     robustness_metrics,
+    preemption_metrics,
     aggregator_metrics,
 )
 

@@ -378,8 +378,10 @@ def install_signal_dump(path: str | None = None) -> bool:
 
         def _on_term(signum, frame):
             try:
+                # arealint: disable-next=SIG003 last-gasp dump: this process is terminating either way; the worker thread exists precisely so the dump cannot deadlock on a ring/metrics lock the frozen main frame holds (the preferred pre-armed pattern lives in robustness/preemption.py — this is the fallback for processes without a drainer)
                 t = threading.Thread(target=_dump, daemon=True)
                 t.start()
+                # arealint: disable-next=SIG001 bounded 5s join, then SIGTERM proceeds regardless — no dump beats no termination, and the process has no later point to wait at
                 t.join(timeout=5.0)
             finally:
                 signal.signal(signal.SIGTERM, prev or signal.SIG_DFL)

@@ -11,6 +11,12 @@ through. See docs/fault_tolerance.md for semantics and guarantees.
 """
 
 from areal_tpu.robustness.chaos import KINDS, FaultInjected, FaultInjector
+from areal_tpu.robustness.preemption import (
+    DRAINED,
+    DRAINING,
+    RUNNING,
+    PreemptionHandler,
+)
 from areal_tpu.robustness.retry import (
     CLOSED,
     HALF_OPEN,
@@ -24,13 +30,17 @@ from areal_tpu.robustness.supervisor import ReplicaSupervisor, default_probe
 
 __all__ = [
     "CLOSED",
+    "DRAINED",
+    "DRAINING",
     "HALF_OPEN",
     "OPEN",
+    "RUNNING",
     "CircuitBreaker",
     "FaultInjected",
     "FaultInjector",
     "FleetHealth",
     "KINDS",
+    "PreemptionHandler",
     "ReplicaSupervisor",
     "RetryBudget",
     "RetryPolicy",

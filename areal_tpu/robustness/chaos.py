@@ -21,7 +21,9 @@ chaos run can assert the harness actually fired.
 from __future__ import annotations
 
 import asyncio
+import os
 import random
+import signal
 import threading
 import time
 
@@ -31,7 +33,7 @@ from areal_tpu.utils import logging as alog
 
 logger = alog.getLogger("robustness.chaos")
 
-KINDS = ("drop", "delay", "error", "hang", "stall")
+KINDS = ("drop", "delay", "error", "hang", "stall", "preempt")
 
 
 class FaultInjected(ConnectionError):
@@ -54,6 +56,18 @@ class FaultInjector:
         self.injected: dict[str, int] = {k: 0 for k in KINDS}
         self.requests_seen = 0
         self._metrics = catalog.robustness_metrics()
+        # preemption targets (ChaosConfig.preempt_prob): live worker pids
+        # to SIGTERM, each at most once per injector — chaos preempts a
+        # bounded set of workers, never the whole fleet in one run
+        self._preempt_targets: list[int] = []
+        self._preempted: set[int] = set()
+
+    def set_preempt_targets(self, pids: list[int]) -> None:
+        """Register the live worker pids eligible for chaos preemption
+        (ChaosConfig SIGTERM injection — drives robustness/preemption.py's
+        grace-window drain end to end)."""
+        with self._lock:
+            self._preempt_targets = [int(p) for p in pids]
 
     # -- decision ----------------------------------------------------------
     def decide(self, addr: str, path: str) -> str | None:
@@ -84,6 +98,9 @@ class FaultInjector:
         edge += cfg.stall_prob
         if u < edge:
             return "stall"
+        edge += cfg.preempt_prob
+        if u < edge:
+            return "preempt"
         return None
 
     def _record(self, kind: str, addr: str, path: str) -> None:
@@ -92,11 +109,35 @@ class FaultInjector:
         self._metrics.chaos_injected.labels(kind=kind).inc()
         logger.debug(f"injected {kind} on {addr}{path}")
 
+    def _do_preempt(self) -> bool:
+        """SIGTERM the next not-yet-preempted registered target (seeded
+        choice). The triggering request proceeds untouched — preemption is
+        a process-lifecycle fault, not a request fault. Returns whether a
+        signal was actually sent (the "preempt" injection count only
+        reflects real deliveries)."""
+        with self._lock:
+            pool = [p for p in self._preempt_targets if p not in self._preempted]
+            if not pool:
+                return False
+            pid = pool[self._rng.randrange(len(pool))]
+            self._preempted.add(pid)
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except (OSError, ProcessLookupError) as e:
+            logger.warning(f"chaos preempt of pid {pid} failed: {e!r}")
+            return False
+        logger.warning(f"chaos: SIGTERM delivered to live worker pid {pid}")
+        return True
+
     # -- application -------------------------------------------------------
     async def aperturb(self, addr: str, path: str) -> None:
         """Async boundary hook: sleep for delay/hang, raise for drop/error."""
         kind = self.decide(addr, path)
         if kind is None:
+            return
+        if kind == "preempt":
+            if self._do_preempt():
+                self._record(kind, addr, path)
             return
         self._record(kind, addr, path)
         if kind == "delay":
@@ -116,6 +157,10 @@ class FaultInjector:
         """Sync boundary hook (thread-pool fan-out paths)."""
         kind = self.decide(addr, path)
         if kind is None:
+            return
+        if kind == "preempt":
+            if self._do_preempt():
+                self._record(kind, addr, path)
             return
         self._record(kind, addr, path)
         if kind == "delay":

@@ -1,0 +1,207 @@
+"""Preemption handler: SIGTERM enters a grace-window drain state machine.
+
+TPU fleets are routinely preemptible — the platform delivers SIGTERM and
+grants a short grace window before SIGKILL (spot reclaim, maintenance
+events). Everything this repo runs (trainer step loop, inference replicas,
+rollout workers) must convert that signal into a CLEAN exit inside the
+window: trainer finishes or aborts the current step, forces an emergency
+recover dump, and drains rollout; serving replicas stop admission (429),
+finish-or-park in-flight decodes within a drain budget, and deregister so
+routing/supervision stops sending.
+
+The state machine::
+
+    RUNNING --signal/request()--> DRAINING --drain done--> DRAINED
+                                      |                        |
+                                      +--(grace expires)-------+--> exit
+
+Signal-handler discipline (arealint SIG family, docs/static_analysis.md):
+the installed handler ONLY sets flags — no I/O, no locks, no allocation.
+All actual drain work runs on whichever thread owns it: the trainer's step
+loop polls :meth:`requested`, and serving processes run
+:meth:`spawn_drainer`'s dedicated thread, armed BEFORE install so the
+handler never creates one.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Callable
+
+from areal_tpu.observability import catalog
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("robustness.preemption")
+
+RUNNING = "running"
+DRAINING = "draining"
+DRAINED = "drained"
+
+
+class PreemptionHandler:
+    """Flag-only signal handler + grace-window bookkeeping for one process.
+
+    ``install()`` must run on the main thread (CPython signal contract).
+    The drain work itself is pulled, not pushed: poll :attr:`requested`
+    (trainer step loop) or park a dedicated drainer thread on it via
+    :meth:`spawn_drainer` (serving)."""
+
+    def __init__(
+        self,
+        role: str,
+        grace_s: float = 25.0,
+        handle_sigusr1: bool = True,
+    ):
+        self.role = role
+        self.grace_s = grace_s
+        self.handle_sigusr1 = handle_sigusr1
+        self.requested = threading.Event()
+        self.drained = threading.Event()
+        self._signum: int | None = None
+        # monotonic ts the signal landed — written ONLY by the handler /
+        # request(); GIL-protected float rebind, readers tolerate staleness
+        self._requested_ts: float | None = None
+        self._installed: list[tuple[int, object]] = []
+        self._counted = False
+        self._count_lock = threading.Lock()
+        self._metrics = catalog.preemption_metrics()
+
+    # -- state -------------------------------------------------------------
+    @property
+    def state(self) -> str:
+        if self.drained.is_set():
+            return DRAINED
+        if self.requested.is_set():
+            return DRAINING
+        return RUNNING
+
+    @property
+    def signum(self) -> int | None:
+        return self._signum
+
+    def deadline(self) -> float | None:
+        """Monotonic deadline for the whole grace window (None until a
+        request lands)."""
+        if self._requested_ts is None:
+            return None
+        return self._requested_ts + self.grace_s
+
+    def remaining(self) -> float:
+        """Grace seconds left (inf while running, clamped at 0)."""
+        dl = self.deadline()
+        if dl is None:
+            return float("inf")
+        return max(0.0, dl - time.monotonic())
+
+    # -- entry points ------------------------------------------------------
+    def _on_signal(self, signum, frame) -> None:
+        # HANDLER CONTEXT: flags only (arealint SIG) — the GIL makes these
+        # two rebinds safe, and Event.set is the sanctioned "flag" portal
+        self._signum = signum
+        self._requested_ts = time.monotonic()
+        self.requested.set()
+
+    def request(self, signum: int | None = None) -> None:
+        """Programmatic preemption (driver-initiated drain, tests): same
+        state transition as a delivered signal."""
+        self._signum = signum
+        self._requested_ts = time.monotonic()
+        self.requested.set()
+
+    def install(self) -> bool:
+        """Arm SIGTERM (+ SIGUSR1) -> :meth:`_on_signal`. Main-thread only;
+        returns False elsewhere (the poll/drainer machinery still works via
+        :meth:`request`)."""
+        sigs = [signal.SIGTERM]
+        if self.handle_sigusr1 and hasattr(signal, "SIGUSR1"):
+            sigs.append(signal.SIGUSR1)
+        try:
+            for s in sigs:
+                prev = signal.getsignal(s)
+                signal.signal(s, self._on_signal)
+                self._installed.append((s, prev))
+            return True
+        except ValueError:  # not the main thread
+            logger.warning(
+                f"preemption handler for role={self.role} not installed "
+                "(off the main thread); programmatic request() still works"
+            )
+            return False
+
+    def uninstall(self) -> None:
+        for s, prev in self._installed:
+            try:
+                signal.signal(s, prev if prev is not None else signal.SIG_DFL)
+            except (ValueError, TypeError):
+                pass  # off-main-thread teardown / non-restorable handler
+        self._installed = []
+
+    # -- accounting --------------------------------------------------------
+    def note_draining(self) -> None:
+        """Count the preemption ONCE (``areal_preemption_total{role}``) and
+        leave a flight-recorder event; call from the draining thread, never
+        the handler."""
+        with self._count_lock:
+            if self._counted:
+                return
+            self._counted = True
+        self._metrics.preemptions.labels(role=self.role).inc()
+        from areal_tpu.observability import timeline as tl_mod
+
+        tl_mod.get_flight_recorder().record(
+            "preempt_signal",
+            severity="warn",
+            role=self.role,
+            signum=self._signum,
+            grace_s=self.grace_s,
+        )
+        logger.warning(
+            f"preemption requested (role={self.role}, signum={self._signum}); "
+            f"draining inside a {self.grace_s:.0f}s grace window"
+        )
+
+    def note_drained(self, drain_seconds: float | None = None) -> None:
+        if drain_seconds is None and self._requested_ts is not None:
+            drain_seconds = time.monotonic() - self._requested_ts
+        if drain_seconds is not None:
+            self._metrics.drain_seconds.observe(drain_seconds)
+        self.drained.set()
+        logger.info(
+            f"preemption drain complete (role={self.role}"
+            + (f", {drain_seconds:.2f}s" if drain_seconds is not None else "")
+            + ")"
+        )
+
+    # -- serving-side drainer ---------------------------------------------
+    def spawn_drainer(
+        self,
+        drain_fn: Callable[["PreemptionHandler"], None],
+        exit_code: int | None = 0,
+    ) -> threading.Thread:
+        """Start the dedicated drain thread NOW (before install, so the
+        signal handler never allocates). It parks on :attr:`requested`,
+        runs ``drain_fn(self)`` bounded by the grace window, then — when
+        ``exit_code`` is not None — hard-exits the process. ``os._exit``
+        is deliberate: after a drain the event loop / decode thread may be
+        half-dismantled, and a wedged atexit must not eat the rest of the
+        platform's grace window."""
+
+        def run():
+            self.requested.wait()
+            self.note_draining()
+            t0 = time.monotonic()
+            try:
+                drain_fn(self)
+            except Exception:  # noqa: BLE001 — a failed drain still exits;
+                # the supervisor treats it like a crash (recover covers it)
+                logger.exception("preemption drain failed")
+            self.note_drained(time.monotonic() - t0)
+            if exit_code is not None:
+                os._exit(exit_code)
+
+        t = threading.Thread(target=run, daemon=True, name="preempt-drainer")
+        t.start()
+        return t

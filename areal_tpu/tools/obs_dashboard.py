@@ -51,6 +51,21 @@ def _merged_value(snap: FleetSnapshot, name: str) -> float | None:
     return total
 
 
+def _merged_value_labeled(
+    snap: FleetSnapshot, name: str, **want: str
+) -> float | None:
+    """Sum a metric over the label children matching ``want`` (e.g. the
+    mode="sync" slice of areal_ckpt_save_seconds_sum)."""
+    total = None
+    for (n, labels), v in snap.merged.items():
+        if n != name:
+            continue
+        ld = dict(labels)
+        if all(ld.get(k) == val for k, val in want.items()):
+            total = (total or 0.0) + v
+    return total
+
+
 def _shed_total(snap: FleetSnapshot) -> float | None:
     """Fleet-wide count of requests turned away with a 429: gateway load
     shedding (by priority class) + engine admission rejections (by reason)."""
@@ -189,6 +204,36 @@ def render_frame(
         lines.append(
             f"{'update pause (mean s)':<24} {pause_sum / pause_cnt:>12.3f}"
         )
+    # preemption tolerance (docs/fault_tolerance.md): drains survived,
+    # drain cost, step-loop checkpoint pause by mode, and how much rollout
+    # work the trajectory journal saved from re-generation
+    preempts = _merged_value(snap, "areal_preemption_total")
+    if preempts is not None:
+        lines.append(f"{'preemptions':<24} {_fmt(preempts):>12}")
+    drain_sum = _merged_value(snap, "areal_drain_seconds_sum")
+    drain_cnt = _merged_value(snap, "areal_drain_seconds_count")
+    if drain_sum is not None and drain_cnt:
+        lines.append(
+            f"{'drain (mean s)':<24} {drain_sum / drain_cnt:>12.2f}"
+        )
+    for mode in ("sync", "async"):
+        s = _merged_value_labeled(
+            snap, "areal_ckpt_save_seconds_sum", mode=mode
+        )
+        c = _merged_value_labeled(
+            snap, "areal_ckpt_save_seconds_count", mode=mode
+        )
+        if s is not None and c:
+            lines.append(
+                f"{'ckpt pause ' + mode + ' (s)':<24} {s / c:>12.3f}"
+            )
+    replayed = _merged_value(snap, "areal_journal_replayed_total")
+    dropped = _merged_value(snap, "areal_journal_dropped_stale_total")
+    if replayed is not None or dropped is not None:
+        lines.append(
+            f"{'journal replay/stale':<24} "
+            f"{_fmt(replayed or 0):>6} / {_fmt(dropped or 0)}"
+        )
     # straggler view: per-target token counters expose a lagging server
     # that the fleet-merged sums hide
     per = snap.per_target("areal_decode_generated_tokens_total")
@@ -288,6 +333,30 @@ areal_request_fence_stall_seconds_count 4
 # TYPE areal_flight_events_total counter
 areal_flight_events_total{kind="admission_reject"} 3
 areal_flight_events_total{kind="weight_commit"} 2
+# HELP areal_preemption_total Preemption signals honored, by role.
+# TYPE areal_preemption_total counter
+areal_preemption_total{role="trainer"} 1
+areal_preemption_total{role="inference_server"} 2
+# HELP areal_drain_seconds Graceful-drain duration.
+# TYPE areal_drain_seconds histogram
+areal_drain_seconds_bucket{le="5"} 3
+areal_drain_seconds_bucket{le="+Inf"} 3
+areal_drain_seconds_sum 6.0
+areal_drain_seconds_count 3
+# HELP areal_ckpt_save_seconds Step-loop pause per checkpoint save, by mode.
+# TYPE areal_ckpt_save_seconds histogram
+areal_ckpt_save_seconds_bucket{mode="sync",le="+Inf"} 2
+areal_ckpt_save_seconds_sum{mode="sync"} 5.0
+areal_ckpt_save_seconds_count{mode="sync"} 2
+areal_ckpt_save_seconds_bucket{mode="async",le="+Inf"} 4
+areal_ckpt_save_seconds_sum{mode="async"} 0.4
+areal_ckpt_save_seconds_count{mode="async"} 4
+# HELP areal_journal_replayed_total Journaled trajectories replayed on recovery.
+# TYPE areal_journal_replayed_total counter
+areal_journal_replayed_total 7
+# HELP areal_journal_dropped_stale_total Journaled trajectories dropped over-stale.
+# TYPE areal_journal_dropped_stale_total counter
+areal_journal_dropped_stale_total 1
 """
 
 
@@ -387,6 +456,27 @@ def self_test() -> int:
             (
                 "shed/rejected (429)" in frame and "20" in frame,
                 "frame missing shed/rejected row",
+            ),
+            (
+                "preemptions" in frame
+                and _merged_value(snap, "areal_preemption_total") == 6,
+                "preemption total should sum roles across targets (2x(1+2))",
+            ),
+            (
+                "drain (mean s)" in frame and "2.00" in frame,
+                "frame missing drain row (6.0/3 = 2.00 mean)",
+            ),
+            (
+                "ckpt pause sync (s)" in frame
+                and "ckpt pause async (s)" in frame
+                and "2.500" in frame
+                and "0.100" in frame,
+                "frame missing per-mode ckpt pause rows (sync 5.0/2, "
+                "async 0.4/4)",
+            ),
+            (
+                "journal replay/stale" in frame and "14 / 2" in frame,
+                "frame missing journal replay row (2x7 / 2x1)",
             ),
             ("DOWN  127.0.0.1:1" in frame, "frame missing down-target row"),
         ]

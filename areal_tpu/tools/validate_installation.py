@@ -103,6 +103,15 @@ def main(argv=None) -> int:
         "per request, fence stalls attributed, and zero unterminated "
         "timelines",
     )
+    p.add_argument(
+        "--preemption-self-test",
+        action="store_true",
+        help="run a tiny CPU fleet + trainer, deliver a REAL SIGTERM "
+        "mid-step, and assert the preemption contract: trainer emergency-"
+        "dumps and exits cleanly, the replica drains (0 leaked pages, all "
+        "timelines terminated), a relaunch replays journaled trajectories, "
+        "and the async save path pauses the step loop <= 1/5 of a sync save",
+    )
     args = p.parse_args(argv)
     results: list[tuple[str, bool, str]] = []
 
@@ -223,6 +232,9 @@ def main(argv=None) -> int:
 
     if args.timeline_self_test:
         _check("timeline", timeline_self_test, results)
+
+    if args.preemption_self_test:
+        _check("preemption", preemption_self_test, results)
 
     width = max(len(n) for n, _, _ in results)
     ok = True
@@ -567,6 +579,254 @@ def timeline_self_test(
         )
     finally:
         eng.stop()
+
+
+def preemption_self_test(kill_after_version: int = 1) -> str:
+    """The whole spot-TPU lifecycle on CPU (docs/fault_tolerance.md):
+
+    1. tiny 1-replica fleet + real PPOTrainer (journal on, recover
+       freq_steps=1, async dumps);
+    2. a REAL SIGTERM delivered to this process mid-step — the flag-only
+       handler + step-loop polling must abort the step, emergency-dump,
+       and return from train() cleanly (``trainer.preempted``);
+    3. relaunch: a second trainer resumes one step after the dump and
+       replays >= 1 journaled in-bound trajectory (re-generation saved);
+    4. the replica drains under load: 429s on new admissions, in-flight
+       work finished/parked, 0 leaked pages, 0 unterminated timelines;
+    5. async-vs-sync checkpoint pause: the async path's step-loop pause
+       must be <= 1/5 of the measured sync save time.
+    """
+    import os
+    import signal
+    import threading
+    import time
+
+    import jax
+    import numpy as np
+
+    from areal_tpu.api.config import (
+        DatasetConfig,
+        InferenceEngineConfig,
+        MeshConfig,
+        MicroBatchSpec,
+        OptimizerConfig,
+        PPOActorConfig,
+        PPOConfig,
+        PreemptionConfig,
+        RecoverConfig,
+        SaverConfig,
+        ServerConfig,
+        StatsLoggerConfig,
+        TrajectoryJournalConfig,
+    )
+    from areal_tpu.api.io_struct import (
+        FinetuneSpec,
+        GenerationHyperparameters,
+        ModelRequest,
+        StepInfo,
+    )
+    from areal_tpu.engine.train_engine import JaxTrainEngine
+    from areal_tpu.inference.client import RemoteJaxEngine
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.inference.server import ServerThread
+    from areal_tpu.models import qwen
+    from areal_tpu.trainer.rl_trainer import PPOTrainer
+    from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="areal_preempt_selftest_")
+    tiny = tiny_model_config()
+
+    def make_actor_cfg():
+        return PPOActorConfig(
+            init_from_scratch=True,
+            dtype="float32",
+            param_dtype="float32",
+            mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+            optimizer=OptimizerConfig(lr=1e-3, lr_scheduler_type="constant"),
+            mb_spec=MicroBatchSpec(max_tokens_per_mb=100_000),
+            bucket_step=64,
+            group_size=1,
+            ppo_n_minibatches=1,
+            adv_norm=None,
+            kl_ctl=0.0,
+            use_decoupled_loss=False,
+            recompute_logprob=False,
+        )
+
+    def make_cfg(actor_cfg):
+        cfg = PPOConfig(
+            experiment_name="preempt",
+            trial_name="t0",
+            total_train_epochs=50,
+            weight_update_mode="mem",
+            gconfig=GenerationHyperparameters(
+                n_samples=1, max_new_tokens=4, greedy=True
+            ),
+            train_dataset=DatasetConfig(batch_size=2, shuffle=True),
+            actor=actor_cfg,
+            saver=SaverConfig(fileroot=root),
+            checkpointer=SaverConfig(fileroot=root),
+            recover=RecoverConfig(mode="auto", freq_steps=1, fileroot=root),
+            stats_logger=StatsLoggerConfig(fileroot=root),
+        )
+        cfg.evaluator.fileroot = root
+        cfg.cluster.fileroot = root
+        cfg.rollout = InferenceEngineConfig(
+            max_concurrent_rollouts=4,
+            consumer_batch_size=2,
+            max_head_offpolicyness=4,
+            request_timeout=120,
+            journal=TrajectoryJournalConfig(enabled=True),
+        )
+        cfg.preemption = PreemptionConfig(grace_s=60.0)
+        return cfg
+
+    # -- fleet -------------------------------------------------------------
+    engine = JaxTrainEngine(make_actor_cfg(), model_config=tiny)
+    engine.initialize(FinetuneSpec(1, 16, 2))
+    scfg = ServerConfig(
+        max_batch_size=4,
+        max_seq_len=128,
+        decode_steps_per_call=4,
+        seed=0,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+    )
+    dec = DecodeEngine(
+        scfg, params=jax.tree.map(np.asarray, engine.params), model_cfg=tiny
+    )
+    dec.initialize()
+    server = ServerThread(scfg, dec)
+    server.start()
+    rng = np.random.default_rng(0)
+    dataset = [
+        {"prompt_ids": rng.integers(2, 100, 3).tolist()} for _ in range(16)
+    ]
+    wf = RLVRWorkflow(
+        lambda *a, **k: 1.0,
+        GenerationHyperparameters(max_new_tokens=4, greedy=True),
+    )
+
+    def make_rollout():
+        r = RemoteJaxEngine(
+            make_cfg(make_actor_cfg()).rollout, addresses=[server.address]
+        )
+        r.initialize()
+        return r
+
+    rollout = make_rollout()
+    cfg = make_cfg(make_actor_cfg())
+    trainer = PPOTrainer(cfg, dataset, rollout=rollout, actor_engine=engine)
+
+    # -- SIGTERM mid-step --------------------------------------------------
+    def killer():
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if rollout.get_version() >= kill_after_version:
+                break
+            time.sleep(0.05)
+        time.sleep(0.2)  # land inside the NEXT step's rollout wait
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    kt = threading.Thread(target=killer, daemon=True)
+    kt.start()
+    trainer.train(workflow=wf)
+    kt.join(timeout=10)
+    if not trainer.preempted:
+        raise AssertionError("SIGTERM did not preempt the trainer")
+    pair = trainer.recover_handler.read_recover_info()
+    if pair is None:
+        raise AssertionError("no loadable recover generation after preemption")
+    info, _ = pair
+    dumped_step = info.last_step_info.global_step
+    journal_stats = trainer.journal.stats()
+    trainer.close()
+
+    # -- relaunch: resume + journal replay ---------------------------------
+    engine2 = JaxTrainEngine(make_actor_cfg(), model_config=tiny)
+    engine2.initialize(FinetuneSpec(1, 16, 2))
+    rollout2 = make_rollout()
+    trainer2 = PPOTrainer(
+        make_cfg(make_actor_cfg()), dataset, rollout=rollout2, actor_engine=engine2
+    )
+    if trainer2.recover_info is None:
+        raise AssertionError("relaunch did not load the recover checkpoint")
+    resume_step = trainer2.recover_info.last_step_info.next().global_step
+    if resume_step != dumped_step + 1:
+        raise AssertionError(
+            f"resume at step {resume_step}, expected {dumped_step + 1} "
+            "(one recover interval)"
+        )
+    replayed = len(rollout2.executor._results)
+    if replayed < 1:
+        raise AssertionError(
+            "relaunch replayed no journaled trajectories "
+            f"(journal had {journal_stats['appended']} appended)"
+        )
+
+    # -- async-vs-sync checkpoint pause ------------------------------------
+    sync_saver_dir = os.path.join(root, "pause_probe")
+    from areal_tpu.utils.saver import Saver
+
+    probe = Saver(
+        SaverConfig(fileroot=sync_saver_dir, freq_steps=1), None, for_recover=True
+    )
+    t0 = time.monotonic()
+    probe.save(engine2, 0, 0, 100)
+    engine2.wait_for_save()
+    sync_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    probe.save_async(engine2, 0, 0, 101)
+    async_pause_s = time.monotonic() - t0
+    probe.wait_async()
+    if async_pause_s * 5 > sync_s:
+        raise AssertionError(
+            f"async save pause {async_pause_s * 1e3:.1f}ms > 1/5 of sync "
+            f"save {sync_s * 1e3:.1f}ms"
+        )
+    trainer2.close()
+
+    # -- replica drain under load ------------------------------------------
+    done: list = []
+    for i in range(3):
+        dec.submit(
+            ModelRequest(
+                input_ids=[3 + i, 7, 9],
+                rid=f"drainload-{i}",
+                gconfig=GenerationHyperparameters(
+                    max_new_tokens=100_000, greedy=True, ignore_eos=True
+                ),
+            ),
+            done.append,
+        )
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if any(t is not None and t.out_tokens for t in dec._slot_task):
+            break
+        time.sleep(0.01)
+    summary = dec.drain(budget_s=2.0)
+    admit, reason, _ = dec.check_admission()
+    if admit or reason != "draining":
+        raise AssertionError(f"drained replica still admits ({reason!r})")
+    if len(done) != 3:
+        raise AssertionError(
+            f"{3 - len(done)} in-flight requests left without a terminal"
+        )
+    if summary["leaked_pages"] != 0:
+        raise AssertionError(f"{summary['leaked_pages']} KV pages leaked")
+    if summary["unterminated_timelines"] != 0:
+        raise AssertionError(
+            f"{summary['unterminated_timelines']} unterminated timelines"
+        )
+    server.stop()
+    return (
+        f"SIGTERM mid-step -> emergency dump @ step {dumped_step}, resume @ "
+        f"{resume_step}, {replayed} journaled trajectories replayed "
+        f"(re-generation saved), drain {summary['drain_seconds']:.2f}s "
+        f"(parked {summary['parked']}, 0 leaks), ckpt pause sync "
+        f"{sync_s * 1e3:.0f}ms vs async {async_pause_s * 1e3:.0f}ms"
+    )
 
 
 if __name__ == "__main__":

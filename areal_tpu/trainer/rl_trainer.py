@@ -22,7 +22,10 @@ import numpy as np
 from areal_tpu.api.config import PPOConfig
 from areal_tpu.api.io_struct import StepInfo, WeightUpdateMeta
 from areal_tpu.engine.train_engine import JaxTrainEngine
+from areal_tpu.infra.trajectory_journal import journal_from_config
+from areal_tpu.infra.workflow_executor import RolloutInterrupted
 from areal_tpu.observability import catalog as obs_catalog
+from areal_tpu.robustness.preemption import PreemptionHandler
 from areal_tpu.trainer.ppo import PPOActor, PPOCritic
 from areal_tpu.utils import logging as alog, perf_tracer, stats_tracker
 from areal_tpu.utils.perf_tracer import Category
@@ -199,6 +202,91 @@ class PPOTrainer:
             weight_update_meta=self.weight_update_meta,
         )
 
+        # durable trajectory journal (infra/trajectory_journal.py):
+        # accepted-but-unconsumed rollouts survive trainer death; on a
+        # recovered start the in-bound entries replay into the batch queue
+        # instead of being re-generated
+        self.journal = journal_from_config(
+            config.rollout.journal,
+            fileroot=config.cluster.fileroot,
+            experiment=config.experiment_name,
+            trial=config.trial_name,
+        )
+        if self.journal is not None and hasattr(self.rollout, "attach_journal"):
+            self.rollout.attach_journal(self.journal)
+            if self.recover_info is not None and hasattr(
+                self.rollout, "replay_from_journal"
+            ):
+                t_replay = time.monotonic()
+                replayed, dropped = self.rollout.replay_from_journal(
+                    config.rollout.max_head_offpolicyness
+                )
+                if replayed or dropped:
+                    logger.info(
+                        f"recovered {replayed} journaled trajectories "
+                        f"({dropped} over-stale dropped) in "
+                        f"{time.monotonic() - t_replay:.2f}s — rollout "
+                        "regeneration saved"
+                    )
+
+        # journal GC lags one recover generation: segments consumed below
+        # this version are durable inside a checkpoint load() can reach
+        self._journal_gc_version = (
+            self.recover_info.last_step_info.global_step + 1
+            if self.recover_info is not None
+            else 0
+        )
+
+        # preemption tolerance (robustness/preemption.py): the SIGTERM
+        # handler only sets an event; the step loop polls it at phase
+        # boundaries and the executor's blocking waits abort on it
+        self.preempted = False
+        self.preemption: PreemptionHandler | None = None
+        if config.preemption.enabled:
+            self.preemption = PreemptionHandler(
+                role="trainer",
+                grace_s=config.preemption.grace_s,
+                handle_sigusr1=config.preemption.handle_sigusr1,
+            )
+            if hasattr(self.rollout, "set_interrupt"):
+                self.rollout.set_interrupt(self.preemption.requested)
+
+    # -- preemption (robustness/preemption.py) -----------------------------
+    def _preempt_requested(self) -> bool:
+        return self.preemption is not None and self.preemption.requested.is_set()
+
+    def _handle_preemption(self, last_completed: StepInfo | None) -> None:
+        """Grace-window drain: stop rollout submissions, force an
+        emergency (sync, durable) recover dump of the last COMPLETED step,
+        seal the trajectory journal, and mark the trial preempted — the
+        caller exits cleanly and the relauncher resumes from here."""
+        assert self.preemption is not None
+        self.preemption.note_draining()
+        t0 = time.monotonic()
+        self.rollout.pause()
+        if last_completed is not None:
+            try:
+                self.recover_handler.dump_emergency(
+                    self.actor_engine,
+                    last_completed,
+                    saver=self.saver,
+                    evaluator=self.evaluator,
+                    dataloader=self.train_dataloader,
+                    tokenizer=self.tokenizer,
+                )
+            except Exception:  # noqa: BLE001 — an older durable generation
+                # (plus the journal) still recovers the trial; dying inside
+                # the grace window with no exit is the one unacceptable path
+                logger.exception("emergency recover dump failed")
+        if self.journal is not None:
+            self.journal.seal_active()
+        self.preemption.note_drained(time.monotonic() - t0)
+        self.preempted = True
+        logger.warning(
+            "trainer preempted: emergency state durable, rollout drained — "
+            "exiting the step loop cleanly"
+        )
+
     # -- step loop --------------------------------------------------------
     def train(
         self,
@@ -216,8 +304,18 @@ class PPOTrainer:
         max_steps = config.total_train_epochs * steps_per_epoch
         if config.total_train_steps is not None:
             max_steps = min(max_steps, config.total_train_steps)
+        if self.preemption is not None:
+            self.preemption.install()
+        last_completed: StepInfo | None = (
+            self.recover_info.last_step_info
+            if self.recover_info is not None
+            else None
+        )
 
         for global_step in range(start_step, max_steps):
+            if self._preempt_requested():
+                self._handle_preemption(last_completed)
+                return
             epoch = global_step // steps_per_epoch
             step = global_step % steps_per_epoch
             t_step = time.monotonic()
@@ -231,14 +329,28 @@ class PPOTrainer:
             if profiling:
                 perf_tracer.start_device_profile()
 
-            with stats_tracker.record_timing("rollout"), perf_tracer.trace_scope(
-                "train.rollout", Category.COMPUTE, {"global_step": global_step}
-            ):
-                batch = self.rollout.prepare_batch(
-                    self.train_dataloader,
-                    workflow=workflow,
-                    should_accept_fn=dynamic_filter_fn,
-                )
+            try:
+                with stats_tracker.record_timing("rollout"), perf_tracer.trace_scope(
+                    "train.rollout", Category.COMPUTE, {"global_step": global_step}
+                ):
+                    batch = self.rollout.prepare_batch(
+                        self.train_dataloader,
+                        workflow=workflow,
+                        should_accept_fn=dynamic_filter_fn,
+                    )
+            except RolloutInterrupted:
+                # SIGTERM landed while waiting on rollout: abort this step
+                # (the executor raised out of its blocking wait; accepted
+                # work is journaled and replays after relaunch)
+                self._handle_preemption(last_completed)
+                return
+            if self._preempt_requested():
+                # signal landed after the batch was ready — the remaining
+                # phases (fwd/bwd, weight push) can outlast the grace
+                # window, so abort the step; the popped batch replays from
+                # the journal (its consumption marker post-dates the dump)
+                self._handle_preemption(last_completed)
+                return
 
             if self.critic is not None:
                 with stats_tracker.record_timing("critic_values"), perf_tracer.trace_scope(
@@ -294,7 +406,11 @@ class PPOTrainer:
                 self.saver.maybe_save(
                     self.actor_engine, epoch, step, global_step, self.tokenizer
                 )
-                self.recover_handler.dump(
+                # async recover dump: the step loop pauses only for the
+                # host snapshot; Orbax writes (and the recover records
+                # land) on a background thread. Emergency dumps on the
+                # preemption path stay synchronous.
+                dumped = self.recover_handler.dump(
                     self.actor_engine,
                     StepInfo(
                         epoch=epoch,
@@ -306,7 +422,15 @@ class PPOTrainer:
                     evaluator=self.evaluator,
                     dataloader=self.train_dataloader,
                     tokenizer=self.tokenizer,
+                    async_=True,
                 )
+                if dumped is not None and self.journal is not None:
+                    # GC journal segments fully consumed by steps the
+                    # PREVIOUS dump already covers (this dump's write may
+                    # still be in flight; the lag keeps gc safe even if it
+                    # fails and recovery falls back a generation)
+                    self.journal.gc(self._journal_gc_version)
+                    self._journal_gc_version = new_version
 
             # resume BEFORE eval: the default eval client is the training
             # rollout client, whose dispatcher skips submissions while paused
@@ -321,6 +445,12 @@ class PPOTrainer:
             self._obs.step_seconds.observe(stats["step_secs"])
             stats["version"] = float(new_version)
             self.stats_logger.commit(epoch, step, global_step, stats)
+            last_completed = StepInfo(
+                epoch=epoch,
+                epoch_step=step,
+                global_step=global_step,
+                steps_per_epoch=steps_per_epoch,
+            )
             if profiling:
                 perf_tracer.stop_device_profile()
             perf_tracer.save(step=global_step)
@@ -346,5 +476,17 @@ class PPOTrainer:
         self.evaluator.maybe_evaluate(epoch, global_step, run_eval)
 
     def close(self) -> None:
+        try:
+            # a periodic async recover dump may still be writing: join it
+            # so close() means "everything durable" (preemption's emergency
+            # dump already forces this)
+            self.saver.wait_async()
+            self.recover_handler.saver.wait_async()
+        except RuntimeError:
+            logger.exception("async checkpoint write failed during close")
+        if self.journal is not None:
+            self.journal.close()
+        if self.preemption is not None:
+            self.preemption.uninstall()
         self.stats_logger.close()
         self.rollout.destroy()

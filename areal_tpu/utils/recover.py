@@ -77,20 +77,25 @@ class RecoverHandler:
         dataloader=None,
         stats_logger=None,
         tokenizer=None,
+        force: bool = False,
+        async_: bool = False,
     ) -> str | None:
+        """Dump a recover generation when a frequency trigger fires
+        (``force=True`` skips the gate — the preemption emergency path).
+
+        ``async_=True`` routes the checkpoint through
+        :meth:`Saver.save_async`: the step loop pauses only for the host
+        snapshot, and the (info, latest) record pair is written by the
+        background thread AFTER the Orbax bytes are durable — a crash
+        mid-write leaves the previous generation's records in place, so
+        load() never sees a pointer to a half-written checkpoint."""
         if self.config.mode in ("disabled", "off"):
             return None
-        if not self.saver.freq_ctl.check(
+        if not force and not self.saver.freq_ctl.check(
             epochs=step_info.epoch, steps=step_info.global_step + 1
         ):
             return None
-        path = self.saver.save(
-            engine,
-            step_info.epoch,
-            step_info.epoch_step,
-            step_info.global_step,
-            tokenizer,
-        )
+        # timer/dataloader state is captured NOW, paired with the snapshot
         info = RecoverInfo(
             last_step_info=step_info,
             saver_state=saver.state_dict() if saver else {},
@@ -100,22 +105,75 @@ class RecoverHandler:
                 if dataloader is not None and hasattr(dataloader, "state_dict")
                 else {}
             ),
-            ckpt_path=path,
         )
-        os.makedirs(self._root(), exist_ok=True)
-        # rotate the previous consistent pair BEFORE writing the new one:
-        # if this dump crashes half-way, load() falls back to .prev
-        for cur, prev in (
-            (self._info_path(), self._info_path(".prev")),
-            (self._latest_path(), self._latest_path(".prev")),
-        ):
-            if os.path.exists(cur):
-                os.replace(cur, prev)
-        # checksummed + atomic (tmp + replace + fsync): a torn write can
-        # never masquerade as a valid record
-        atomic_io.write_checksummed(self._info_path(), pickle.dumps(info))
-        atomic_io.write_checksummed(self._latest_path(), path.encode("utf-8"))
-        logger.info(f"recover checkpoint dumped at step {step_info.global_step}")
+
+        def write_records(path: str) -> None:
+            info.ckpt_path = path
+            os.makedirs(self._root(), exist_ok=True)
+            # rotate the previous consistent pair BEFORE writing the new
+            # one: if this dump crashes half-way, load() falls back to .prev
+            for cur, prev in (
+                (self._info_path(), self._info_path(".prev")),
+                (self._latest_path(), self._latest_path(".prev")),
+            ):
+                if os.path.exists(cur):
+                    os.replace(cur, prev)
+            # checksummed + atomic (tmp + replace + fsync): a torn write
+            # can never masquerade as a valid record
+            atomic_io.write_checksummed(self._info_path(), pickle.dumps(info))
+            atomic_io.write_checksummed(
+                self._latest_path(), path.encode("utf-8")
+            )
+            logger.info(
+                f"recover checkpoint dumped at step {step_info.global_step}"
+            )
+
+        if async_:
+            return self.saver.save_async(
+                engine,
+                step_info.epoch,
+                step_info.epoch_step,
+                step_info.global_step,
+                tokenizer,
+                on_written=write_records,
+            )
+        path = self.saver.save(
+            engine,
+            step_info.epoch,
+            step_info.epoch_step,
+            step_info.global_step,
+            tokenizer,
+        )
+        write_records(path)
+        return path
+
+    def dump_emergency(
+        self,
+        engine,
+        step_info: StepInfo,
+        saver=None,
+        evaluator=None,
+        dataloader=None,
+        tokenizer=None,
+    ) -> str | None:
+        """Preemption-path dump: force-synchronous, frequency gate
+        bypassed, and fully durable before returning (any in-flight async
+        write joined first, Orbax staging waited out) — the last thing a
+        SIGTERM'd trainer does before exiting."""
+        self.saver.wait_async()
+        path = self.dump(
+            engine,
+            step_info,
+            saver=saver,
+            evaluator=evaluator,
+            dataloader=dataloader,
+            tokenizer=tokenizer,
+            force=True,
+            async_=False,
+        )
+        wait = getattr(engine, "wait_for_save", None)
+        if wait is not None:
+            wait()
         return path
 
     # -- load --------------------------------------------------------------

@@ -1,14 +1,32 @@
 """Timer-gated checkpoint saver + evaluator (reference areal/utils/saver.py
-:1-185, evaluator.py:1-35). Orbax handles async staging TPU-side — ``save``
-can return before bytes hit disk; ``wait_for_staging`` blocks before params
-mutate (reference async_checkpoint.py role)."""
+:1-185, evaluator.py:1-35).
+
+Two save modes (docs/fault_tolerance.md "Async checkpointing"):
+
+- ``save`` blocks for the full write (Orbax stages device arrays before
+  returning, so the step loop pays D2H + any previous save's tail).
+- ``save_async`` blocks ONLY for a host snapshot of params/optimizer
+  state, then writes Orbax on a background thread — periodic recover
+  dumps stop pausing the step loop. One write in flight at a time; the
+  ``on_written`` callback runs after the bytes are durable (the
+  RecoverHandler writes its info records there, so a crash mid-write can
+  never leave a record pointing at a half-written checkpoint — the
+  ``.prev`` fallback generation stays loadable throughout). Emergency
+  (preemption) dumps force the sync path.
+
+Step-loop pause per save lands in ``areal_ckpt_save_seconds{mode}``.
+"""
 
 from __future__ import annotations
 
 import os
+import threading
+import time
+from typing import Callable
 
 from areal_tpu.api.config import EvaluatorConfig, SaverConfig
 from areal_tpu.api.io_struct import SaveLoadMeta
+from areal_tpu.observability import catalog
 from areal_tpu.utils import logging as alog
 from areal_tpu.utils.timeutil import FrequencyControl
 
@@ -25,6 +43,11 @@ class Saver:
             freq_step=config.freq_steps,
             freq_sec=config.freq_secs,
         )
+        self._metrics = catalog.preemption_metrics()
+        self._async_thread: threading.Thread | None = None
+        # written by the background writer, consumed by wait_async
+        self._async_lock = threading.Lock()
+        self._async_exc: BaseException | None = None
 
     def save_root(self) -> str:
         sub = "recover" if self.for_recover else "checkpoints"
@@ -43,11 +66,19 @@ class Saver:
             return None
         return self.save(engine, epoch, step, global_step, tokenizer)
 
+    def _ckpt_path(self, epoch: int, step: int, global_step: int) -> str:
+        name = f"epoch{epoch}epochstep{step}globalstep{global_step}"
+        return os.path.join(self.save_root(), name)
+
     def save(
         self, engine, epoch: int, step: int, global_step: int, tokenizer=None
     ) -> str:
-        name = f"epoch{epoch}epochstep{step}globalstep{global_step}"
-        path = os.path.join(self.save_root(), name)
+        # a still-running async write must land first: Orbax directories
+        # are not versioned per-save here, and the emergency path relies
+        # on "save returned == bytes durable"
+        self.wait_async()
+        t0 = time.monotonic()
+        path = self._ckpt_path(epoch, step, global_step)
         os.makedirs(path, exist_ok=True)
         meta = SaveLoadMeta(
             path=path,
@@ -56,8 +87,78 @@ class Saver:
             tokenizer=tokenizer,
         )
         engine.save(meta)
+        # the sync pause covers the whole engine.save call (for orbax that
+        # includes staging; the background tail, if any, is orbax's own)
+        self._metrics.ckpt_save_seconds.labels(mode="sync").observe(
+            time.monotonic() - t0
+        )
         logger.info(f"saved {'recover ' if self.for_recover else ''}ckpt to {path}")
         return path
+
+    # -- async path (docs/fault_tolerance.md) ------------------------------
+    def save_async(
+        self,
+        engine,
+        epoch: int,
+        step: int,
+        global_step: int,
+        tokenizer=None,
+        on_written: Callable[[str], None] | None = None,
+    ) -> str:
+        """Snapshot-to-host now, write Orbax on a background thread.
+
+        Blocks only for the host snapshot (the ``mode="async"`` pause
+        observation) plus any previous async write still in flight.
+        Engines without the snapshot/write split (and the HF-format
+        checkpoint saver) fall back to the sync path — ``on_written``
+        fires either way once bytes are durable."""
+        snap_fn = getattr(engine, "snapshot_for_save", None)
+        write_fn = getattr(engine, "write_snapshot", None)
+        if snap_fn is None or write_fn is None or not self.for_recover:
+            path = self.save(engine, epoch, step, global_step, tokenizer)
+            if on_written is not None:
+                on_written(path)
+            return path
+        self.wait_async()  # one write in flight; also surfaces its error
+        t0 = time.monotonic()
+        snapshot = snap_fn(with_optim=True)
+        self._metrics.ckpt_save_seconds.labels(mode="async").observe(
+            time.monotonic() - t0
+        )
+        path = self._ckpt_path(epoch, step, global_step)
+        os.makedirs(path, exist_ok=True)
+
+        def writer():
+            try:
+                write_fn(snapshot, path)
+                logger.info(f"async recover ckpt written to {path}")
+                if on_written is not None:
+                    on_written(path)
+            except BaseException as e:  # noqa: BLE001 — surfaced on the
+                # next wait_async/save; a failed write must not be silent
+                logger.exception("async checkpoint write failed")
+                with self._async_lock:
+                    self._async_exc = e
+
+        self._async_thread = threading.Thread(
+            target=writer, daemon=True, name="saver-async-write"
+        )
+        self._async_thread.start()
+        return path
+
+    def wait_async(self, timeout: float | None = None) -> None:
+        """Join any in-flight async write; re-raise its failure. The
+        emergency-dump path calls this first so a preemption never races
+        a half-written periodic dump."""
+        t = self._async_thread
+        if t is not None:
+            t.join(timeout)
+            if not t.is_alive():
+                self._async_thread = None
+        with self._async_lock:
+            exc, self._async_exc = self._async_exc, None
+        if exc is not None:
+            raise RuntimeError("async checkpoint write failed") from exc
 
     def state_dict(self) -> dict:
         return self.freq_ctl.state_dict()

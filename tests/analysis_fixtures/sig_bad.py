@@ -1,0 +1,46 @@
+"""SIG true positives: signal handlers doing real work in handler context
+(parsed by the analyzer only — never imported)."""
+
+import signal
+import threading
+import time
+
+lock = threading.Lock()
+log_lines = []
+
+
+def dump_state():
+    with open("/tmp/state.json", "w") as f:  # SIG001 (one-hop reach)
+        f.write("{}")
+
+
+def handler_blocks(signum, frame):
+    time.sleep(1.0)  # SIG001
+    dump_state()  # reached: helper runs in handler context
+
+
+def handler_locks(signum, frame):
+    with lock:  # SIG002
+        log_lines.append("term")
+    lock.acquire()  # SIG002
+
+
+def handler_allocates(signum, frame):
+    t = threading.Thread(target=dump_state)  # SIG003
+    t.start()
+    t.join(timeout=5)  # SIG001
+    _ = [x for x in range(1000)]  # SIG003
+
+
+def install():
+    signal.signal(signal.SIGTERM, handler_blocks)
+    signal.signal(signal.SIGUSR1, handler_locks)
+    signal.signal(signal.SIGUSR2, handler_allocates)
+
+
+class Server:
+    def _on_term(self, signum, frame):
+        print("terminating")  # SIG001
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_term)

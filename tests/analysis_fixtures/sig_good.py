@@ -1,0 +1,46 @@
+"""SIG true negatives: flag-only handlers, pre-armed drainer threads, and
+non-handler functions that may block freely (parsed by the analyzer only —
+never imported)."""
+
+import signal
+import threading
+import time
+
+requested = threading.Event()
+_signum = None
+_ts = 0.0
+
+
+def flag_only_handler(signum, frame):
+    global _signum, _ts
+    _signum = signum
+    _ts = time.monotonic()  # clock read: allowed
+    requested.set()  # the sanctioned flag portal
+
+
+def rearming_handler(signum, frame):
+    requested.set()
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    signal.raise_signal(signal.SIGTERM)
+
+
+def drainer():
+    # NOT handler context: parked on the event BEFORE install; blocking
+    # I/O, locks, and allocation are all fine here
+    requested.wait()
+    with open("/tmp/state.json", "w") as f:
+        f.write("{}")
+
+
+def install():
+    t = threading.Thread(target=drainer, daemon=True)
+    t.start()
+    signal.signal(signal.SIGTERM, flag_only_handler)
+    signal.signal(signal.SIGUSR1, rearming_handler)
+
+
+def ordinary_function_blocks_freely():
+    # never registered as a handler: no findings
+    time.sleep(0.1)
+    with threading.Lock():
+        pass

@@ -106,6 +106,28 @@ def test_exc_good_fixture():
     assert rules_in(FIXTURES / "exc_good.py", ["EXC"]) == []
 
 
+def test_sig_bad_fixture():
+    rules = rules_in(FIXTURES / "sig_bad.py", ["SIG"])
+    # direct blocking call, one-hop helper reach, print in a self.method
+    # handler resolved through its Attribute registration
+    assert rules.count("SIG001") >= 4
+    assert rules.count("SIG002") >= 2  # with-lock + .acquire()
+    assert rules.count("SIG003") >= 2  # Thread ctor + comprehension
+
+
+def test_sig_bad_reaches_helpers_and_methods():
+    res = run_analysis([FIXTURES / "sig_bad.py"], rules=["SIG"], baseline_path=None)
+    msgs = [f.message for f in res.findings]
+    assert any("reached from handler 'handler_blocks'" in m for m in msgs)
+    assert any("`print`" in m for m in msgs)  # self._on_term method handler
+
+
+def test_sig_good_fixture():
+    # flag-only handlers, pre-armed drainer threads, and unregistered
+    # functions that block freely: all silent
+    assert rules_in(FIXTURES / "sig_good.py", ["SIG"]) == []
+
+
 def test_obs_catalog_lint_rules_exist():
     # catalog-side lint (OBS003/OBS004/OBS005) runs on the real catalog and
     # must be clean — it replaced validate_installation's ad-hoc check

@@ -59,7 +59,7 @@ def test_every_rule_family_is_loaded():
 
     table = Analyzer().rule_table()
     families = {r[:3] for r in table}
-    assert {"ASY", "JAX", "THR", "CFG", "OBS", "EXC"} <= families
+    assert {"ASY", "JAX", "THR", "CFG", "OBS", "EXC", "SIG"} <= families
 
 
 def test_repo_scripts_are_clean():

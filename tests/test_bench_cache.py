@@ -188,6 +188,47 @@ def test_window_guard_skips_phases_that_no_longer_fit(cache_dir, monkeypatch, ca
     assert "capture window exhausted" in out["detail"]["errors"]["decode"]
 
 
+def test_round_payload_carries_gateway_alongside_decode(cache_dir, monkeypatch, capsys):
+    """ROADMAP housekeeping: post-PR 5 probe fix, a healthy round must emit
+    REAL numbers — non-null detail.gateway (the PR 7 serving scoreboard)
+    riding alongside a non-zero decode tok/s in the SAME payload, so r06+
+    rounds are trustworthy on both axes at once."""
+
+    def fake_spawn(name, deadline=None):
+        if name == "probe":
+            return {"phase": "probe", "platform": "tpu", "n_devices": 1}
+        if name == "decode":
+            return {"phase": "decode", "tok_s": 6700.0}
+        if name == "train":
+            return {"phase": "train", "tok_s": 5800.0}
+        if name == "gateway":
+            return {
+                "phase": "gateway",
+                "goodput_tok_s": 250.0,
+                "classes": {
+                    "interactive": {"ttft_p99_s": 0.4, "goodput_tok_s": 50.0},
+                    "rollout": {"ttft_p99_s": 1.1, "goodput_tok_s": 200.0},
+                },
+            }
+        return {"phase": name, "error": "skipped"}
+
+    monkeypatch.setattr(bench, "_spawn_phase", fake_spawn)
+    bench.main()
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    # decode tok/s real and live…
+    assert out["value"] > 0
+    assert out["detail"]["sources"]["decode"] == "live"
+    assert out["detail"]["errors"].get("decode") is None
+    # …AND the serving scoreboard is non-null in the same round payload
+    gw = out["detail"]["gateway"]
+    assert gw is not None and gw["goodput_tok_s"] == 250.0
+    assert set(gw["classes"]) == {"interactive", "rollout"}
+    assert out["detail"]["sources"]["gateway"] == "live"
+
+
 def test_main_prefers_live_over_cache(cache_dir, monkeypatch, capsys):
     _seed(cache_dir, "decode", {"phase": "decode", "tok_s": 1.0})
 

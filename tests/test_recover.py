@@ -243,6 +243,59 @@ def test_legacy_unchecksummed_records_still_load(tmp_path):
     assert eng.loaded_path == ckpt
 
 
+def test_exact_position_resume_mid_epoch(tmp_path):
+    """Kill-relaunch fidelity (ISSUE 9 satellite): a trainer killed
+    mid-epoch must, after relaunch, (a) continue the StatefulDataLoader at
+    the exact same sample index — same shuffled order, no skipped or
+    repeated batches — and (b) not double-fire Saver/Evaluator frequency
+    timers for steps the dead process already handled."""
+    from areal_tpu.api.config import EvaluatorConfig
+    from areal_tpu.utils.saver import Evaluator
+
+    h = _corruption_handler(tmp_path)
+    dl = StatefulDataLoader(list(range(40)), batch_size=4, shuffle=True, seed=7)
+    it = iter(dl)
+    consumed = [next(it) for _ in range(3)]  # 3 batches into epoch 0
+    saver = Saver(SaverConfig(freq_steps=5, fileroot=str(tmp_path)), None)
+    evaluator = Evaluator(
+        EvaluatorConfig(freq_steps=5, fileroot=str(tmp_path)), None
+    )
+    # steps 0..4 drove the timers; both fired at step 4 (steps=5 crossing)
+    for gs in range(5):
+        saver.freq_ctl.check(steps=gs + 1)
+        evaluator.freq_ctl.check(steps=gs + 1)
+    eng = _DummyEngine()
+    eng.save = lambda meta: None  # dump() creates the ckpt dir itself
+    step = StepInfo(epoch=0, epoch_step=4, global_step=4, steps_per_epoch=10)
+    assert h.dump(eng, step, saver=saver, evaluator=evaluator, dataloader=dl)
+    upcoming = next(it)  # what the pre-kill trainer WOULD have seen next
+
+    # ---- "kill": everything above is garbage now; relaunch from disk ----
+    dl2 = StatefulDataLoader(list(range(40)), batch_size=4, shuffle=True, seed=7)
+    saver2 = Saver(SaverConfig(freq_steps=5, fileroot=str(tmp_path)), None)
+    evaluator2 = Evaluator(
+        EvaluatorConfig(freq_steps=5, fileroot=str(tmp_path)), None
+    )
+    eng2 = _DummyEngine()
+    info = h.load(eng2, saver=saver2, evaluator=evaluator2, dataloader=dl2)
+    assert info is not None and info.last_step_info.next().global_step == 5
+    # (a) exact sample position: the next batch is bit-identical to what
+    # the dead process would have consumed (neither repeated nor skipped);
+    # the batch the dump followed (consumed[2]...) never reappears
+    it2 = iter(dl2)
+    resumed = next(it2)
+    assert resumed == upcoming
+    assert resumed != consumed[-1]
+    # (b) timers restored mid-interval: the step-4 firing is remembered —
+    # re-checking the same step must NOT double-fire, and the next firing
+    # lands exactly at step 9 (steps=10 crossing), not earlier
+    for gs in range(5, 9):
+        assert not saver2.freq_ctl.check(steps=gs + 1), gs
+        assert not evaluator2.freq_ctl.check(steps=gs + 1), gs
+    assert saver2.freq_ctl.check(steps=10)
+    assert evaluator2.freq_ctl.check(steps=10)
+
+
 def test_atomic_io_checksum_roundtrip(tmp_path):
     p = str(tmp_path / "blob")
     atomic_io.write_checksummed(p, b"payload-bytes")
