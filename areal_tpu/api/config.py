@@ -661,6 +661,13 @@ class TelemetryConfig:
     # controller telemetry endpoint port (0 = pick a free port)
     export_port: int = 0
     dashboard_refresh_s: float = 2.0  # tools/obs_dashboard.py redraw period
+    # chip-spec overrides for the trainer goodput observatory
+    # (observability/hw_accounting.py): peak bf16 TFLOPs and HBM GB per
+    # chip, for chips the built-in table doesn't know. None = use the
+    # device_kind lookup; MFU / the analytic HBM limit are simply omitted
+    # when neither resolves (never fabricated).
+    chip_peak_tflops: float | None = None
+    chip_hbm_gb: float | None = None
 
 
 @dataclass

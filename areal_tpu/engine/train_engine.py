@@ -47,6 +47,7 @@ from areal_tpu.api.engine_api import InferenceEngine, TrainEngine
 from areal_tpu.api.io_struct import FinetuneSpec, SaveLoadMeta, WeightUpdateMeta
 from areal_tpu.models import qwen
 from areal_tpu.models.hf import load_params_from_hf, save_params_to_hf
+from areal_tpu.observability.step_timeline import engine_phase
 from areal_tpu.parallel import mesh as mesh_lib
 from areal_tpu.utils.jax_compat import set_mesh, shard_map
 from areal_tpu.utils import logging as alog
@@ -1017,22 +1018,26 @@ class JaxTrainEngine(TrainEngine):
         loss_weight_fn: Callable[[TensorDict], float],
     ) -> dict[str, float]:
         t0 = time.monotonic()
-        batches, tstats = self._make_tree_batches(input_)
-        weights = [float(loss_weight_fn(b)) for b in batches]
+        with engine_phase("host_prep"):
+            batches, tstats = self._make_tree_batches(input_)
+            weights = [float(loss_weight_fn(b)) for b in batches]
         total_w = sum(weights) or 1.0
         agg: dict[str, float] = {}
         if len(batches) == 1:
             with set_mesh(self.mesh):
-                batch = self._tree_batch_to_device(batches[0])
+                with engine_phase("host_prep"):
+                    batch = self._tree_batch_to_device(batches[0])
                 shape = batch["node_ids"].shape + batch["gather_idx"].shape
                 step_before = self._opt_step_count()
                 fn = self._get_fused_step_fn(loss_fn, shape, kind="tree")
-                self.params, self.opt_state, gnorm, loss, stats = fn(
-                    self.params,
-                    self.opt_state,
-                    batch,
-                    jnp.float32(weights[0] / total_w),
-                )
+                with engine_phase("forward_backward"):
+                    self.params, self.opt_state, gnorm, loss, stats = fn(
+                        self.params,
+                        self.opt_state,
+                        batch,
+                        jnp.float32(weights[0] / total_w),
+                    )
+                    gnorm = jax.block_until_ready(gnorm)
             agg = {k: float(v) for k, v in {**stats, "loss": loss}.items()}
             agg["n_microbatches"] = 1.0
         else:
@@ -1040,19 +1045,24 @@ class JaxTrainEngine(TrainEngine):
             accum = self._get_accum_fn()
             with set_mesh(self.mesh):
                 for b, w in zip(batches, weights):
-                    batch = self._tree_batch_to_device(b)
+                    with engine_phase("host_prep"):
+                        batch = self._tree_batch_to_device(b)
                     shape = batch["node_ids"].shape + batch["gather_idx"].shape
                     gfn = self._get_grad_fn(loss_fn, shape, kind="tree")
-                    new_grads, loss, stats = gfn(
-                        self.params, batch, jnp.float32(w / total_w)
-                    )
-                    grads = new_grads if grads is None else accum(grads, new_grads)
+                    with engine_phase("forward_backward"):
+                        new_grads, loss, stats = gfn(
+                            self.params, batch, jnp.float32(w / total_w)
+                        )
+                        grads = new_grads if grads is None else accum(grads, new_grads)
+                        loss = jax.block_until_ready(loss)
                     for k, v in {**stats, "loss": loss}.items():
                         agg[k] = agg.get(k, 0.0) + float(v) * (w / total_w)
                 step_before = self._opt_step_count()
-                self.params, self.opt_state, gnorm = self._get_apply_fn()(
-                    self.params, self.opt_state, grads
-                )
+                with engine_phase("optimizer"):
+                    self.params, self.opt_state, gnorm = self._get_apply_fn()(
+                        self.params, self.opt_state, grads
+                    )
+                    gnorm = jax.block_until_ready(gnorm)
             agg["n_microbatches"] = float(len(batches))
         agg["grad_norm"] = float(gnorm)
         agg["lr"] = float(self._lr_schedule(step_before))
@@ -1076,8 +1086,9 @@ class JaxTrainEngine(TrainEngine):
             )
             return self._train_batch_tree(input_, loss_fn, loss_weight_fn)
         t0 = time.monotonic()
-        grids = self._make_grids(input_, mb_spec=mb_spec)
-        weights = [float(loss_weight_fn(g.data)) for g in grids]
+        with engine_phase("host_prep"):
+            grids = self._make_grids(input_, mb_spec=mb_spec)
+            weights = [float(loss_weight_fn(g.data)) for g in grids]
         total_w = sum(weights) or 1.0
 
         grads = None
@@ -1085,12 +1096,18 @@ class JaxTrainEngine(TrainEngine):
         accum = self._get_accum_fn()
         if len(grids) == 1:
             with set_mesh(self.mesh):
-                batch = self._grid_to_device(grids[0])
+                with engine_phase("host_prep"):
+                    batch = self._grid_to_device(grids[0])
                 step_before = self._opt_step_count()
                 fn = self._get_fused_step_fn(loss_fn, _shape_key(batch))
-                self.params, self.opt_state, gnorm, loss, stats = fn(
-                    self.params, self.opt_state, batch, jnp.float32(weights[0] / total_w)
-                )
+                # the fused jit folds the optimizer apply into the same
+                # program, so this span carries BOTH fwd/bwd and the
+                # update (docs/observability.md phase taxonomy note)
+                with engine_phase("forward_backward"):
+                    self.params, self.opt_state, gnorm, loss, stats = fn(
+                        self.params, self.opt_state, batch, jnp.float32(weights[0] / total_w)
+                    )
+                    gnorm = jax.block_until_ready(gnorm)
             agg = {k: float(v) for k, v in {**stats, "loss": loss}.items()}
             agg["grad_norm"] = float(gnorm)
             agg["lr"] = float(self._lr_schedule(step_before))
@@ -1099,19 +1116,24 @@ class JaxTrainEngine(TrainEngine):
             return agg
         with set_mesh(self.mesh):
             for g, w in zip(grids, weights):
-                batch = self._grid_to_device(g)
+                with engine_phase("host_prep"):
+                    batch = self._grid_to_device(g)
                 shape = _shape_key(batch)
                 gfn = self._get_grad_fn(loss_fn, shape)
-                new_grads, loss, stats = gfn(
-                    self.params, batch, jnp.float32(w / total_w)
-                )
-                grads = new_grads if grads is None else accum(grads, new_grads)
+                with engine_phase("forward_backward"):
+                    new_grads, loss, stats = gfn(
+                        self.params, batch, jnp.float32(w / total_w)
+                    )
+                    grads = new_grads if grads is None else accum(grads, new_grads)
+                    loss = jax.block_until_ready(loss)
                 for k, v in {**stats, "loss": loss}.items():
                     agg[k] = agg.get(k, 0.0) + float(v) * (w / total_w)
             step_before = self._opt_step_count()
-            self.params, self.opt_state, gnorm = self._get_apply_fn()(
-                self.params, self.opt_state, grads
-            )
+            with engine_phase("optimizer"):
+                self.params, self.opt_state, gnorm = self._get_apply_fn()(
+                    self.params, self.opt_state, grads
+                )
+                gnorm = jax.block_until_ready(gnorm)
         agg["grad_norm"] = float(gnorm)
         agg["lr"] = float(self._lr_schedule(step_before))
         agg["n_microbatches"] = float(len(grids))
@@ -1152,13 +1174,15 @@ class JaxTrainEngine(TrainEngine):
         loss_fn: Callable,
         loss_weight_fn: Callable[[TensorDict], float],
     ) -> dict[str, float]:
-        grids = self._make_grids(input_)
-        weights = [float(loss_weight_fn(g.data)) for g in grids]
+        with engine_phase("host_prep"):
+            grids = self._make_grids(input_)
+            weights = [float(loss_weight_fn(g.data)) for g in grids]
         total_w = sum(weights) or 1.0
         agg: dict[str, float] = {}
         with set_mesh(self.mesh):
             for g, w in zip(grids, weights):
-                batch = self._grid_to_device(g)
+                with engine_phase("host_prep"):
+                    batch = self._grid_to_device(g)
                 shape = _shape_key(batch)
                 key = ("eval", shape, id(loss_fn))
                 if key not in self._fn_cache:
@@ -1168,7 +1192,9 @@ class JaxTrainEngine(TrainEngine):
                         return loss_fn(outputs, batch)
 
                     self._fn_cache[key] = jax.jit(compute)
-                loss, stats = self._fn_cache[key](self.params, batch)
+                with engine_phase("forward_backward"):
+                    loss, stats = self._fn_cache[key](self.params, batch)
+                    loss = jax.block_until_ready(loss)
                 for k, v in {**stats, "loss": loss}.items():
                     agg[k] = agg.get(k, 0.0) + float(v) * (w / total_w)
         return agg
@@ -1185,14 +1211,19 @@ class JaxTrainEngine(TrainEngine):
         V(prefix incl. t)."""
         B, L = np.asarray(input_["attention_mask"]).shape
         out = np.zeros((B, L), dtype=np.float32)
-        grids = self._make_grids(input_)
+        with engine_phase("host_prep"):
+            grids = self._make_grids(input_)
         with set_mesh(self.mesh):
             for g in grids:
-                batch = self._grid_to_device(g)
+                with engine_phase("host_prep"):
+                    batch = self._grid_to_device(g)
                 shape = _shape_key(batch)
                 fn = self._get_forward_fn(shape, post_hook)
-                outputs = fn(self.params, batch)
-                vals = np.asarray(jax.device_get(outputs[output_key]), np.float32)
+                with engine_phase("forward_backward"):
+                    outputs = fn(self.params, batch)
+                    vals = np.asarray(
+                        jax.device_get(outputs[output_key]), np.float32
+                    )
                 # vectorized grid->batch scatter (one fancy-indexed copy
                 # instead of a per-sequence Python loop). For logprobs the
                 # label-aligned output shifts right one: token t's logp was
@@ -1397,3 +1428,17 @@ class JaxTrainEngine(TrainEngine):
 
     def export_stats(self) -> dict[str, float]:
         return {"version": float(self.get_version())}
+
+    def hbm_ledger(self, override_hbm_gb: float | None = None) -> dict:
+        """Itemized device-memory account of this engine (params +
+        optimizer state vs the device limit; analytic byte sums when the
+        backend has no memory_stats — docs/observability.md "HBM ledger")."""
+        from areal_tpu.observability import hw_accounting as hw
+
+        components = {
+            "params": hw.tree_bytes(self.params),
+            "opt_state": hw.tree_bytes(self.opt_state),
+        }
+        return hw.build_hbm_ledger(
+            components, override_hbm_gb=override_hbm_gb
+        )

@@ -315,6 +315,11 @@ class DecodeEngine:
     # -- lifecycle --------------------------------------------------------
     def initialize(self) -> None:
         cfg = self.config
+        # serving-side compile visibility: a recompile storm (drifting
+        # chunk/scatter shape keys) shows as areal_xla_compiles_total climb
+        from areal_tpu.utils.compile_cache import install_compile_counters
+
+        install_compile_counters()
         if self.mesh is None:
             self.mesh = mesh_lib.make_mesh(cfg.mesh)
         if self.params is None:
@@ -1569,6 +1574,35 @@ class DecodeEngine:
 
     def get_version(self) -> int:
         return self._version
+
+    # -- HBM ledger (docs/observability.md "Trainer observatory") ----------
+    def hbm_ledger(self, override_hbm_gb: float | None = None) -> dict:
+        """Itemized device-memory account of this serving replica: params,
+        the paged KV pool, the radix cache's held-page share (a view INTO
+        the pool — excluded from the itemized total), and any staged
+        weight-update buffers. Device memory_stats where the backend has
+        them; analytic byte sums on CPU. Exported on /statusz."""
+        from areal_tpu.observability import hw_accounting as hw
+
+        kv_bytes = hw.tree_bytes(getattr(self, "cache", None))
+        pool = getattr(self, "pool", None)
+        page_bytes = (
+            kv_bytes / pool.n_pages if pool is not None and pool.n_pages else 0
+        )
+        radix_pages = self._radix.pages_held if self._radix is not None else 0
+        components = {
+            "params": hw.tree_bytes(self.params),
+            "kv_page_pool": kv_bytes,
+            "radix_cache": int(radix_pages * page_bytes),
+            "staged_update": hw.tree_bytes(
+                getattr(self, "_staged_flat", None)
+            ),
+        }
+        return hw.build_hbm_ledger(
+            components,
+            override_hbm_gb=override_hbm_gb,
+            exclude_from_total=("radix_cache",),
+        )
 
     # -- prefix cache (cross-request radix reuse) --------------------------
     def prefix_cache_stats(self) -> dict:

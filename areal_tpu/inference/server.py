@@ -79,6 +79,7 @@ class InferenceServer:
         self._engine_obs = catalog.engine_metrics()
         self._pc_obs = catalog.prefix_cache_metrics()
         self._lc_obs = catalog.lifecycle_metrics()
+        self._hw_obs = catalog.train_obs_metrics()  # HBM ledger gauges
         self._started_at = time.time()
         self._update_begin_ts: float | None = None
         # flight recorder: the engine's ring when it has one (DecodeEngine),
@@ -125,6 +126,7 @@ class InferenceServer:
                 web.post("/drain", self.h_drain),
                 web.post("/undrain", self.h_undrain),
                 web.get("/debug/flight", self.h_debug_flight),
+                web.post("/debug/profile", self.h_debug_profile),
             ]
         )
         return app
@@ -174,6 +176,15 @@ class InferenceServer:
         pc = getattr(self.engine, "prefix_cache_stats", None)
         if pc is not None:
             self._pc_obs.pages_held.set(float(pc().get("pages_held", 0)))
+        hb = getattr(self.engine, "hbm_ledger", None)
+        if hb is not None:
+            try:
+                from areal_tpu.observability import hw_accounting
+
+                hw_accounting.observe_hbm_ledger(hb(), obs=self._hw_obs)
+            except Exception:  # noqa: BLE001 — scrape must not 500 on an
+                # accounting edge (mid-initialize engine, missing pool)
+                pass
 
     async def h_metrics(self, request: web.Request) -> web.Response:
         """Content-negotiated metrics.
@@ -231,6 +242,15 @@ class InferenceServer:
             # same key as /debug/flight's stats section — over THERE
             # "timelines" is the list of timeline records
             out["timeline_stats"] = tl.stats()
+        hb = getattr(self.engine, "hbm_ledger", None)
+        if hb is not None:
+            try:
+                # itemized device-memory account incl. OOM headroom
+                # (docs/observability.md "HBM ledger")
+                out["hbm"] = hb()
+            except Exception:  # noqa: BLE001 — statusz must render even if
+                # the ledger can't (mid-initialize engine)
+                pass
         return web.json_response(out)
 
     async def h_debug_flight(self, request: web.Request) -> web.Response:
@@ -253,6 +273,42 @@ class InferenceServer:
             out["timeline_stats"] = tl.stats()
             out["timelines"] = tl.recent(max(0, n_tl))
         return web.json_response(out)
+
+    async def h_debug_profile(self, request: web.Request) -> web.Response:
+        """On-demand XLA device profile: ``POST /debug/profile?duration_s=N``
+        starts a jax.profiler capture and returns its dir immediately (the
+        xplane/trace files land when the background timer stops it N
+        seconds later); ``duration_s=0`` stops an active capture early.
+        One capture at a time per process — a second start gets a 409
+        carrying the active dir. ``tools/postmortem.py --profile-dirs``
+        links the capture next to the merged Perfetto trace."""
+        from areal_tpu.utils import perf_tracer
+
+        self._metrics.requests.labels(endpoint="debug_profile").inc()
+        try:
+            duration = float(request.query.get("duration_s", "5"))
+        except ValueError:
+            return web.json_response(
+                {"error": "duration_s must be a number"}, status=400
+            )
+        if duration <= 0:
+            d = perf_tracer.stop_device_profile()
+            return web.json_response(
+                {"status": "stopped" if d else "idle", "trace_dir": d}
+            )
+        active = perf_tracer.device_profile_active()
+        if active is not None:
+            return web.json_response(
+                {"error": "profile already active", "trace_dir": active},
+                status=409,
+            )
+        try:
+            d = perf_tracer.profile_for(duration)
+        except RuntimeError as e:  # lost the start race
+            return web.json_response({"error": str(e)}, status=409)
+        return web.json_response(
+            {"status": "profiling", "trace_dir": d, "duration_s": duration}
+        )
 
     async def h_flush_prefix_cache(self, request: web.Request) -> web.Response:
         """Ops escape hatch: drop every radix-cached page (e.g. before an

@@ -6,6 +6,8 @@ Entry points:
     catalog.*_metrics()         per-layer metric family handles
     tracecontext.inject/extract x-areal-trace header propagation
     aggregator.FleetAggregator  controller-side /metrics fleet merge
+    step_timeline.*             trainer step-phase observatory
+    hw_accounting.*             MFU/FLOP formulas + HBM ledger
 
 See docs/observability.md for the full metric catalog and wire formats.
 """
@@ -14,6 +16,10 @@ from areal_tpu.observability.metrics import (  # noqa: F401
     Registry,
     get_registry,
     parse_prometheus_text,
+)
+from areal_tpu.observability.step_timeline import (  # noqa: F401
+    StepTimeline,
+    StepTimelineRecorder,
 )
 from areal_tpu.observability.timeline import (  # noqa: F401
     FlightRecorder,

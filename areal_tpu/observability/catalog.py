@@ -432,6 +432,72 @@ def trainer_metrics(reg: Registry | None = None) -> SimpleNamespace:
     )
 
 
+def train_obs_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """Trainer goodput observatory (observability/step_timeline.py +
+    hw_accounting.py): step-phase attribution, utilization, HBM ledger,
+    and XLA compile visibility. The phase histogram labels by the step
+    phase taxonomy (rollout_wait | host_prep | forward_backward |
+    optimizer | weight_publish | ckpt_eval | other)."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        phase_seconds=r.histogram(
+            "areal_train_phase_seconds",
+            "Wall-clock seconds per training-step phase (rollout_wait is "
+            "the async bubble: blocking in prepare_batch). Named phases + "
+            "the explicit `other` residual sum exactly to the step wall "
+            "time (areal_train_step_seconds).",
+            label_names=("phase",),
+            buckets=(0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0),
+        ),
+        bubble_fraction=r.gauge(
+            "areal_train_bubble_fraction",
+            "rollout_wait / step wall time of the last completed step — "
+            "the trainer bubble fully-async RL is supposed to remove.",
+        ),
+        mfu=r.gauge(
+            "areal_train_mfu",
+            "Model FLOPs utilization over the last step's compute window "
+            "(forward_backward + optimizer phases) vs the chip peak spec "
+            "(TelemetryConfig.chip_peak_tflops overrides unknown chips).",
+        ),
+        tokens_per_chip=r.gauge(
+            "areal_train_tokens_per_sec_per_chip",
+            "Trained tokens per second per chip over the last full step "
+            "(end-to-end goodput; the bubble fraction explains gaps vs "
+            "the compute-window MFU).",
+        ),
+        hbm_bytes=r.gauge(
+            "areal_hbm_bytes",
+            "Itemized device-memory ledger by component (params, "
+            "opt_state, kv_page_pool, radix_cache, staged_update, "
+            "in_use, limit); device memory_stats where available, "
+            "analytic byte sums on CPU.",
+            label_names=("component",),
+        ),
+        hbm_headroom=r.gauge(
+            "areal_hbm_headroom_fraction",
+            "Free fraction of device memory (1 - in_use/limit) — the "
+            "OOM-headroom number to alert on.",
+        ),
+        compiles=r.counter(
+            "areal_xla_compiles_total",
+            "XLA backend compilations observed in this process "
+            "(utils/compile_cache counters; a climbing rate mid-training "
+            "is a recompile storm — check bucketing/shape keys).",
+        ),
+        compile_seconds=r.histogram(
+            "areal_xla_compile_seconds",
+            "Per-compilation backend compile time (jax monitoring hook).",
+            buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0),
+        ),
+        compile_cache_hits=r.counter(
+            "areal_xla_compile_cache_hits_total",
+            "Compilations served from the persistent XLA compile cache "
+            "instead of a fresh backend compile.",
+        ),
+    )
+
+
 def robustness_metrics(reg: Registry | None = None) -> SimpleNamespace:
     """Fault-tolerance layer (robustness/): retry/circuit/supervision/chaos."""
     r = reg or get_registry()
@@ -573,6 +639,7 @@ ALL_FACTORIES = (
     client_metrics,
     rpc_metrics,
     trainer_metrics,
+    train_obs_metrics,
     robustness_metrics,
     preemption_metrics,
     aggregator_metrics,

@@ -113,6 +113,37 @@ def _flight_total(snap: FleetSnapshot) -> float | None:
     return _merged_value(snap, "areal_flight_events_total")
 
 
+def _mean_per_target(snap: FleetSnapshot, name: str) -> float | None:
+    """Mean of a gauge across live targets: fractions (bubble, MFU,
+    headroom) are per-process ratios — SUMMING them across a fleet would
+    report 200% utilization from two healthy trainers."""
+    per = snap.per_target(name)
+    if not per:
+        return None
+    return sum(per.values()) / len(per)
+
+
+def _min_per_target(snap: FleetSnapshot, name: str) -> float | None:
+    """Worst-replica view of a gauge (the HBM headroom that matters is the
+    replica closest to OOM, not the fleet average)."""
+    per = snap.per_target(name)
+    if not per:
+        return None
+    return min(per.values())
+
+
+# trainer observatory phase taxonomy (observability/step_timeline.py)
+_TRAIN_PHASES = (
+    "rollout_wait",
+    "host_prep",
+    "forward_backward",
+    "optimizer",
+    "weight_publish",
+    "ckpt_eval",
+    "other",
+)
+
+
 def _fmt(v: float | None) -> str:
     if v is None:
         return "-"
@@ -234,6 +265,43 @@ def render_frame(
             f"{'journal replay/stale':<24} "
             f"{_fmt(replayed or 0):>6} / {_fmt(dropped or 0)}"
         )
+    # trainer observatory (docs/observability.md "Trainer observatory"):
+    # step-phase means with the async bubble highlighted, utilization,
+    # worst-replica HBM headroom, and the recompile-storm counters
+    phase_rows = []
+    for ph in _TRAIN_PHASES:
+        s = _merged_value_labeled(
+            snap, "areal_train_phase_seconds_sum", phase=ph
+        )
+        c = _merged_value_labeled(
+            snap, "areal_train_phase_seconds_count", phase=ph
+        )
+        if s is not None and c:
+            phase_rows.append((ph, s / c))
+    if phase_rows:
+        lines.append("-" * 64)
+        lines.append("trainer step phases (mean s)")
+        for ph, v in phase_rows:
+            label = "  " + ph + (" (bubble)" if ph == "rollout_wait" else "")
+            lines.append(f"{label:<24} {v:>12.3f}")
+    bub = _mean_per_target(snap, "areal_train_bubble_fraction")
+    if bub is not None:
+        lines.append(f"{'bubble fraction':<24} {bub:>11.1%}")
+    mfu = _mean_per_target(snap, "areal_train_mfu")
+    if mfu is not None:
+        lines.append(f"{'mfu':<24} {mfu:>11.1%}")
+    tok_chip = _mean_per_target(snap, "areal_train_tokens_per_sec_per_chip")
+    if tok_chip is not None:
+        lines.append(f"{'train tok/s/chip':<24} {tok_chip:>12.1f}")
+    head = _min_per_target(snap, "areal_hbm_headroom_fraction")
+    if head is not None:
+        lines.append(f"{'hbm headroom (worst)':<24} {head:>11.1%}")
+    compiles = _merged_value(snap, "areal_xla_compiles_total")
+    if compiles is not None:
+        lines.append(f"{'xla compiles':<24} {_fmt(compiles):>12}")
+        cs = _merged_value(snap, "areal_xla_compile_seconds_sum")
+        if cs is not None:
+            lines.append(f"{'xla compile time (s)':<24} {cs:>12.1f}")
     # straggler view: per-target token counters expose a lagging server
     # that the fleet-merged sums hide
     per = snap.per_target("areal_decode_generated_tokens_total")
@@ -357,6 +425,34 @@ areal_journal_replayed_total 7
 # HELP areal_journal_dropped_stale_total Journaled trajectories dropped over-stale.
 # TYPE areal_journal_dropped_stale_total counter
 areal_journal_dropped_stale_total 1
+# HELP areal_train_phase_seconds Wall-clock seconds per training-step phase.
+# TYPE areal_train_phase_seconds histogram
+areal_train_phase_seconds_bucket{phase="rollout_wait",le="+Inf"} 4
+areal_train_phase_seconds_sum{phase="rollout_wait"} 6.0
+areal_train_phase_seconds_count{phase="rollout_wait"} 4
+areal_train_phase_seconds_bucket{phase="forward_backward",le="+Inf"} 4
+areal_train_phase_seconds_sum{phase="forward_backward"} 2.0
+areal_train_phase_seconds_count{phase="forward_backward"} 4
+# HELP areal_train_bubble_fraction rollout_wait / step wall time.
+# TYPE areal_train_bubble_fraction gauge
+areal_train_bubble_fraction 0.6
+# HELP areal_train_mfu Model FLOPs utilization over the compute window.
+# TYPE areal_train_mfu gauge
+areal_train_mfu 0.35
+# HELP areal_train_tokens_per_sec_per_chip Trained tokens/s per chip.
+# TYPE areal_train_tokens_per_sec_per_chip gauge
+areal_train_tokens_per_sec_per_chip 5200
+# HELP areal_hbm_headroom_fraction Free fraction of device memory.
+# TYPE areal_hbm_headroom_fraction gauge
+areal_hbm_headroom_fraction 0.25
+# HELP areal_xla_compiles_total XLA backend compilations.
+# TYPE areal_xla_compiles_total counter
+areal_xla_compiles_total 12
+# HELP areal_xla_compile_seconds Per-compilation backend compile time.
+# TYPE areal_xla_compile_seconds histogram
+areal_xla_compile_seconds_bucket{le="+Inf"} 12
+areal_xla_compile_seconds_sum 30.0
+areal_xla_compile_seconds_count 12
 """
 
 
@@ -477,6 +573,40 @@ def self_test() -> int:
             (
                 "journal replay/stale" in frame and "14 / 2" in frame,
                 "frame missing journal replay row (2x7 / 2x1)",
+            ),
+            (
+                "trainer step phases (mean s)" in frame
+                and "rollout_wait (bubble)" in frame
+                and "1.500" in frame,
+                "frame missing trainer phase rows (rollout_wait mean "
+                "6.0/4 = 1.500, merged across targets)",
+            ),
+            (
+                "bubble fraction" in frame and "60.0%" in frame,
+                "frame missing bubble-fraction row (per-target MEAN of "
+                "0.6, not the 1.2 a fleet sum would claim)",
+            ),
+            (
+                "mfu" in frame and "35.0%" in frame,
+                "frame missing mfu row (per-target mean of 0.35)",
+            ),
+            (
+                "train tok/s/chip" in frame and "5200.0" in frame,
+                "frame missing train tok/s/chip row",
+            ),
+            (
+                "hbm headroom (worst)" in frame and "25.0%" in frame,
+                "frame missing hbm-headroom row (worst replica, 0.25)",
+            ),
+            (
+                "xla compiles" in frame
+                and _merged_value(snap, "areal_xla_compiles_total") == 24,
+                "frame missing compile-count row (12 per target sums to 24)",
+            ),
+            (
+                "xla compile time (s)" in frame and "60.0" in frame,
+                "frame missing compile-time row (30.0s per target sums "
+                "to 60.0)",
             ),
             ("DOWN  127.0.0.1:1" in frame, "frame missing down-target row"),
         ]

@@ -133,6 +133,36 @@ def build_incident_trace(
         return perf_trace_converter.convert(tdir, output)
 
 
+def discover_profile_sessions(roots: list[str]) -> list[str]:
+    """Find jax.profiler capture session dirs (the dirs holding
+    ``plugins/profile/<ts>/*.xplane.pb``) under the given roots. Each
+    on-demand capture (``POST /debug/profile``, the trainer's SIGUSR2 /
+    profile_steps path) writes one timestamped dir under the perf-tracer
+    output root; the incident trace links them so the detailed device
+    view sits next to the merged host-side timeline."""
+    found: set[str] = set()
+    for root in roots:
+        p = Path(root)
+        if not p.is_dir():
+            continue
+        for xplane in p.rglob("*.xplane.pb"):
+            # .../<capture>/plugins/profile/<session>/<host>.xplane.pb
+            session = xplane.parent
+            capture = session.parent.parent.parent
+            found.add(str(capture if capture != p else session))
+    return sorted(found)
+
+
+def link_device_profiles(trace_path: str | Path, profile_dirs: list[str]) -> None:
+    """Stamp capture-dir pointers into the merged trace's ``metadata``
+    (catapult tolerates extra top-level keys), so the one incident
+    artifact also says WHERE the loadable jax.profiler traces live."""
+    p = Path(trace_path)
+    data = json.loads(p.read_text())
+    data.setdefault("metadata", {})["device_profiles"] = list(profile_dirs)
+    p.write_text(json.dumps(data))
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument(
@@ -152,6 +182,14 @@ def main(argv=None) -> int:
         type=int,
         default=256,
         help="recent request timelines to pull per target",
+    )
+    p.add_argument(
+        "--profile-dirs",
+        nargs="*",
+        default=None,
+        help="roots to scan for jax.profiler captures (default: the "
+        "perf-tracer xprof root); found sessions are linked into the "
+        "merged trace's metadata",
     )
     p.add_argument("--timeout", type=float, default=5.0)
     args = p.parse_args(argv)
@@ -173,6 +211,15 @@ def main(argv=None) -> int:
         print("no reachable targets and no readable dumps")
         return 1
     out = build_incident_trace(snapshots, args.output)
+    if args.profile_dirs is None:
+        from areal_tpu.utils.perf_tracer import default_profile_root
+
+        roots = [default_profile_root()]
+    else:
+        roots = list(args.profile_dirs)
+    profiles = discover_profile_sessions(roots)
+    if profiles:
+        link_device_profiles(out, profiles)
     n_ev = sum(
         len(s.get("events", [])) + len(s.get("timelines", []))
         for _, s in snapshots
@@ -181,6 +228,8 @@ def main(argv=None) -> int:
         f"wrote {out} ({len(snapshots)} processes, "
         f"{n_ev} flight events + timelines)"
     )
+    for d in profiles:
+        print(f"device profile: {d}")
     return 0
 
 

@@ -25,9 +25,17 @@ overload-safety contract (docs/request_lifecycle.md): shed requests get
 clean 429 + Retry-After, admitted work keeps a bounded p99, the deadline
 reaper fires on the flood, and the PagePool ends with zero leaked pages.
 
+``--train-obs-self-test`` runs a short synchronous-mode CPU RL loop under
+a chaos-throttled rollout and asserts the trainer goodput observatory
+(docs/observability.md "Trainer observatory"): the step-phase breakdown
+identity with >= 90% measured named-phase coverage, a non-zero measured
+rollout_wait bubble, a populated HBM ledger (analytic CPU fallback), and
+live XLA compile counters.
+
 Usage: python -m areal_tpu.tools.validate_installation [--tpu]
     [--chaos-self-test] [--weight-sync-self-test] [--prefix-cache-self-test]
-    [--overload-self-test]
+    [--overload-self-test] [--timeline-self-test] [--train-obs-self-test]
+    [--preemption-self-test]
 """
 
 from __future__ import annotations
@@ -102,6 +110,14 @@ def main(argv=None) -> int:
         "assert the request-timeline observatory: stage sums ≈ wall time "
         "per request, fence stalls attributed, and zero unterminated "
         "timelines",
+    )
+    p.add_argument(
+        "--train-obs-self-test",
+        action="store_true",
+        help="run a short CPU RL loop under a throttled rollout and assert "
+        "the trainer goodput observatory: step-phase breakdown sums to the "
+        "step wall time with >= 90%% named-phase coverage, non-zero "
+        "rollout_wait (the async bubble), and a populated HBM ledger",
     )
     p.add_argument(
         "--preemption-self-test",
@@ -232,6 +248,9 @@ def main(argv=None) -> int:
 
     if args.timeline_self_test:
         _check("timeline", timeline_self_test, results)
+
+    if args.train_obs_self_test:
+        _check("train_obs", train_obs_self_test, results)
 
     if args.preemption_self_test:
         _check("preemption", preemption_self_test, results)
@@ -579,6 +598,189 @@ def timeline_self_test(
         )
     finally:
         eng.stop()
+
+
+def train_obs_self_test(
+    n_steps: int = 2, coverage_floor: float = 0.9, stall_s: float = 0.1
+) -> str:
+    """Short CPU RL run asserting the trainer goodput observatory
+    (docs/observability.md "Trainer observatory") with MEASURED numbers:
+
+    - every completed step's phase breakdown satisfies the identity
+      (named phases + other_s == step wall time) and the named phases
+      cover >= ``coverage_floor`` of it — the residual attributes, it
+      doesn't hide;
+    - rollout_wait is non-zero under a throttled rollout (a seeded chaos
+      stall injector on every client POST — the async bubble measured,
+      not mocked);
+    - the trainer HBM ledger itemizes params + optimizer state (analytic
+      CPU fallback) and the XLA compile counters saw this run's compiles.
+    """
+    import jax
+    import numpy as np
+
+    from areal_tpu.api.config import (
+        ChaosConfig,
+        DatasetConfig,
+        InferenceEngineConfig,
+        MeshConfig,
+        MicroBatchSpec,
+        OptimizerConfig,
+        PPOActorConfig,
+        PPOConfig,
+        RecoverConfig,
+        SaverConfig,
+        ServerConfig,
+        StatsLoggerConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec, GenerationHyperparameters
+    from areal_tpu.engine.train_engine import JaxTrainEngine
+    from areal_tpu.inference.client import RemoteJaxEngine
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.inference.server import ServerThread
+    from areal_tpu.models import qwen
+    from areal_tpu.robustness import FaultInjector
+    from areal_tpu.trainer.rl_trainer import PPOTrainer
+    from areal_tpu.utils.compile_cache import compile_stats
+    from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="areal_train_obs_selftest_")
+    tiny = tiny_model_config()
+    actor_cfg = PPOActorConfig(
+        init_from_scratch=True,
+        dtype="float32",
+        param_dtype="float32",
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        optimizer=OptimizerConfig(lr=1e-3, lr_scheduler_type="constant"),
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=100_000),
+        bucket_step=64,
+        group_size=1,
+        ppo_n_minibatches=1,
+        adv_norm=None,
+        kl_ctl=0.0,
+        use_decoupled_loss=False,
+        recompute_logprob=False,
+    )
+    engine = JaxTrainEngine(actor_cfg, model_config=tiny)
+    engine.initialize(FinetuneSpec(1, 16, 2))
+    scfg = ServerConfig(
+        max_batch_size=4,
+        max_seq_len=128,
+        decode_steps_per_call=4,
+        seed=0,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+    )
+    dec = DecodeEngine(
+        scfg, params=jax.tree.map(np.asarray, engine.params), model_cfg=tiny
+    )
+    dec.initialize()
+    server = ServerThread(scfg, dec)
+    server.start()
+    rollout = RemoteJaxEngine(
+        InferenceEngineConfig(
+            max_concurrent_rollouts=4,
+            consumer_batch_size=2,
+            # SYNCHRONOUS mode: with any lookahead the async pipeline
+            # pre-generates the next batch during this step's compute and
+            # the bubble (correctly!) vanishes — offpolicyness 0 forces
+            # every step to sit in prepare_batch so the test can assert
+            # the bubble is MEASURED, not merely absent
+            max_head_offpolicyness=0,
+            request_timeout=120,
+        ),
+        addresses=[server.address],
+    )
+    rollout.initialize()
+    # the throttle: every client POST eats a deterministic stall, so the
+    # prepare_batch wait (rollout_wait) is guaranteed measurable
+    rollout.install_fault_injector(
+        FaultInjector(
+            ChaosConfig(enabled=True, seed=7, stall_prob=1.0, stall_s=stall_s)
+        )
+    )
+    cfg = PPOConfig(
+        experiment_name="train-obs",
+        trial_name="t0",
+        total_train_epochs=50,
+        total_train_steps=n_steps,
+        weight_update_mode="mem",
+        gconfig=GenerationHyperparameters(
+            n_samples=1, max_new_tokens=4, greedy=True
+        ),
+        train_dataset=DatasetConfig(batch_size=2, shuffle=True),
+        actor=actor_cfg,
+        saver=SaverConfig(fileroot=root),
+        checkpointer=SaverConfig(fileroot=root),
+        recover=RecoverConfig(mode="disabled", fileroot=root),
+        stats_logger=StatsLoggerConfig(fileroot=root),
+    )
+    cfg.evaluator.fileroot = root
+    cfg.cluster.fileroot = root
+    rng = np.random.default_rng(0)
+    dataset = [
+        {"prompt_ids": rng.integers(2, 100, 3).tolist()} for _ in range(16)
+    ]
+    wf = RLVRWorkflow(
+        lambda *a, **k: 1.0,
+        GenerationHyperparameters(max_new_tokens=4, greedy=True),
+    )
+    trainer = PPOTrainer(cfg, dataset, rollout=rollout, actor_engine=engine)
+    try:
+        c0 = compile_stats()["compiles"]
+        trainer.train(workflow=wf)
+        recent = trainer.step_recorder.recent()
+        if len(recent) < n_steps:
+            raise AssertionError(
+                f"{len(recent)} step timelines recorded, expected {n_steps}"
+            )
+        worst_cov, min_wait = 1.0, float("inf")
+        from areal_tpu.observability.step_timeline import PHASES
+
+        for rec in recent:
+            bd = rec["breakdown"]
+            named = sum(bd[f"{p}_s"] for p in PHASES)
+            if abs(named + bd["other_s"] - bd["total_s"]) > 1e-6:
+                raise AssertionError(
+                    f"breakdown identity violated at step {rec['step']}: "
+                    f"{named + bd['other_s']:.6f} != {bd['total_s']:.6f}"
+                )
+            worst_cov = min(worst_cov, named / bd["total_s"])
+            min_wait = min(min_wait, bd["rollout_wait_s"])
+        if worst_cov < coverage_floor:
+            raise AssertionError(
+                f"phase coverage {worst_cov:.0%} < {coverage_floor:.0%} of "
+                "step wall time — the timeline is not attributing latency"
+            )
+        if min_wait < stall_s / 2:
+            raise AssertionError(
+                f"rollout_wait {min_wait * 1e3:.0f}ms under a throttled "
+                "rollout — the async bubble is not being measured"
+            )
+        ledger = trainer.last_hbm_ledger
+        if ledger is None:
+            raise AssertionError("no HBM ledger recorded")
+        comp = ledger["components"]
+        if comp.get("params", 0) <= 0 or comp.get("opt_state", 0) <= 0:
+            raise AssertionError(f"HBM ledger not itemized: {comp}")
+        if ledger["bytes_in_use"] <= 0:
+            raise AssertionError("HBM ledger has no in-use accounting")
+        compiled = compile_stats()["compiles"] - c0
+        if compiled <= 0:
+            raise AssertionError("compile counters saw no XLA compiles")
+        bubbles = [r["breakdown"]["bubble_fraction"] for r in recent]
+        return (
+            f"{len(recent)} steps: phase coverage >= {worst_cov:.0%}, "
+            f"bubble {min(bubbles):.0%}..{max(bubbles):.0%} "
+            f"(rollout_wait >= {min_wait * 1e3:.0f}ms under throttle), "
+            f"hbm ledger params {comp['params'] / 1e3:.0f}kB + opt "
+            f"{comp['opt_state'] / 1e3:.0f}kB ({ledger['source']}), "
+            f"{compiled} compiles counted"
+        )
+    finally:
+        trainer.close()
+        server.stop()
 
 
 def preemption_self_test(kill_after_version: int = 1) -> str:

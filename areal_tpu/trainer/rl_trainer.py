@@ -14,6 +14,8 @@ version — reference blog AReaL_v0_3 η semantics).
 from __future__ import annotations
 
 import os
+import signal
+import threading
 import time
 from typing import Any, Callable
 
@@ -25,6 +27,7 @@ from areal_tpu.engine.train_engine import JaxTrainEngine
 from areal_tpu.infra.trajectory_journal import journal_from_config
 from areal_tpu.infra.workflow_executor import RolloutInterrupted
 from areal_tpu.observability import catalog as obs_catalog
+from areal_tpu.observability import step_timeline
 from areal_tpu.robustness.preemption import PreemptionHandler
 from areal_tpu.trainer.ppo import PPOActor, PPOCritic
 from areal_tpu.utils import logging as alog, perf_tracer, stats_tracker
@@ -189,6 +192,18 @@ class PPOTrainer:
                 c.fileroot = c.fileroot or config.cluster.fileroot
         perf_tracer.configure(config.perf_tracer, rank=0, role="trainer")
         self._obs = obs_catalog.trainer_metrics()
+        # trainer goodput observatory (docs/observability.md "Trainer
+        # observatory"): per-step phase timeline + utilization gauges, and
+        # the XLA compile counters that make recompile storms visible
+        self.step_recorder = step_timeline.StepTimelineRecorder()
+        from areal_tpu.utils import compile_cache
+
+        compile_cache.install_compile_counters()
+        # on-demand device profiling: SIGUSR2 sets this flag (handler is
+        # flag-only per the arealint SIG contract) and the NEXT step runs
+        # under a jax.profiler trace, exactly like perf_tracer.profile_steps
+        self._profile_requested = threading.Event()
+        self.last_hbm_ledger: dict | None = None
         self.saver = Saver(config.saver, self.ft_spec)
         self.evaluator = Evaluator(config.evaluator, self.ft_spec)
         self.recover_handler = RecoverHandler(config.recover, self.ft_spec)
@@ -287,6 +302,10 @@ class PPOTrainer:
             "exiting the step loop cleanly"
         )
 
+    def _on_profile_signal(self, signum, frame) -> None:
+        # flag-only (arealint SIG family): the step loop does the work
+        self._profile_requested.set()
+
     # -- step loop --------------------------------------------------------
     def train(
         self,
@@ -306,6 +325,12 @@ class PPOTrainer:
             max_steps = min(max_steps, config.total_train_steps)
         if self.preemption is not None:
             self.preemption.install()
+        try:
+            # docs/observability.md "On-demand device profiling": SIGUSR2
+            # profiles the next step without restarting the trial
+            signal.signal(signal.SIGUSR2, self._on_profile_signal)
+        except ValueError:
+            logger.debug("SIGUSR2 profile trigger unavailable off the main thread")
         last_completed: StepInfo | None = (
             self.recover_info.last_step_info
             if self.recover_info is not None
@@ -321,16 +346,22 @@ class PPOTrainer:
             t_step = time.monotonic()
             # detailed device profile at requested steps (perf_tracer
             # .profile_steps — reference knob; XLA profiler instead of
-            # torch.profiler, traces viewable in TensorBoard/XProf)
+            # torch.profiler, traces viewable in TensorBoard/XProf), or on
+            # demand via SIGUSR2 (flag consumed here, one step per signal)
             profiling = bool(
                 config.perf_tracer.profile_steps
                 and global_step in config.perf_tracer.profile_steps
             )
+            if self._profile_requested.is_set():
+                self._profile_requested.clear()
+                profiling = True
+                logger.info(f"SIGUSR2: device-profiling step {global_step}")
             if profiling:
                 perf_tracer.start_device_profile()
 
+            tl = self.step_recorder.start(global_step)
             try:
-                with stats_tracker.record_timing("rollout"), perf_tracer.trace_scope(
+                with tl.phase("rollout_wait"), perf_tracer.trace_scope(
                     "train.rollout", Category.COMPUTE, {"global_step": global_step}
                 ):
                     batch = self.rollout.prepare_batch(
@@ -342,6 +373,7 @@ class PPOTrainer:
                 # SIGTERM landed while waiting on rollout: abort this step
                 # (the executor raised out of its blocking wait; accepted
                 # work is journaled and replays after relaunch)
+                self.step_recorder.abandon(tl)
                 self._handle_preemption(last_completed)
                 return
             if self._preempt_requested():
@@ -349,46 +381,54 @@ class PPOTrainer:
                 # phases (fwd/bwd, weight push) can outlast the grace
                 # window, so abort the step; the popped batch replays from
                 # the journal (its consumption marker post-dates the dump)
+                self.step_recorder.abandon(tl)
                 self._handle_preemption(last_completed)
                 return
 
+            # device fwd passes + the update: the engine attributes its own
+            # host_prep / forward_backward / optimizer spans into ``tl``
+            # through the step_timeline.engine_phase hook — the superseded
+            # per-block stats_tracker timing keys are gone (docs note)
+            n_extra_fwd = 0
             if self.critic is not None:
-                with stats_tracker.record_timing("critic_values"), perf_tracer.trace_scope(
+                with perf_tracer.trace_scope(
                     "train.compute_values", Category.COMPUTE
                 ):
                     batch["values"] = self.critic.compute_values(batch)
+                n_extra_fwd += 1
 
             if self.actor.should_compute_prox_logp():
-                with stats_tracker.record_timing("recompute_logp"), perf_tracer.trace_scope(
+                with perf_tracer.trace_scope(
                     "train.recompute_logp", Category.COMPUTE
                 ):
                     batch["prox_logp"] = self.actor.compute_logp(batch)
+                n_extra_fwd += 1
 
             if self.ref is not None:
-                with stats_tracker.record_timing("ref_logp"), perf_tracer.trace_scope(
+                with perf_tracer.trace_scope(
                     "train.ref_logp", Category.COMPUTE
                 ):
                     batch["ref_logp"] = self.ref.compute_logp(batch)
+                n_extra_fwd += 1
 
-            with stats_tracker.record_timing("compute_advantages"), perf_tracer.trace_scope(
+            with tl.phase("host_prep"), perf_tracer.trace_scope(
                 "train.compute_advantages", Category.COMPUTE
             ):
                 adv_batch = self.actor.compute_advantages(batch)
 
-            with stats_tracker.record_timing("train_step"), perf_tracer.trace_scope(
-                "train.ppo_update", Category.COMPUTE
-            ):
+            t_train = time.monotonic()
+            with perf_tracer.trace_scope("train.ppo_update", Category.COMPUTE):
                 self.actor.ppo_update(adv_batch)
             if self.critic is not None:
-                with stats_tracker.record_timing("critic_train_step"):
-                    self.critic.ppo_update(adv_batch)
+                self.critic.ppo_update(adv_batch)
+            train_step_secs = time.monotonic() - t_train
 
             # §3.4 protocol: stop submissions, push weights, advance version
-            self.rollout.pause()
-            t_update = time.monotonic()
-            with stats_tracker.record_timing("update_weights"), perf_tracer.trace_scope(
+            with tl.phase("weight_publish"), perf_tracer.trace_scope(
                 "train.update_weights", Category.COMM
             ):
+                self.rollout.pause()
+                t_update = time.monotonic()
                 new_version = global_step + 1
                 self.actor_engine.update_weights(self.weight_update_meta)
                 self.actor_engine.set_version(new_version)
@@ -400,7 +440,8 @@ class PPOTrainer:
             self._obs.update_seconds.observe(time.monotonic() - t_update)
             self._obs.version.set(new_version)
 
-            with stats_tracker.record_timing("save"), perf_tracer.trace_scope(
+            t_save = time.monotonic()
+            with tl.phase("ckpt_eval"), perf_tracer.trace_scope(
                 "train.save", Category.IO
             ):
                 self.saver.maybe_save(
@@ -432,18 +473,43 @@ class PPOTrainer:
                     self.journal.gc(self._journal_gc_version)
                     self._journal_gc_version = new_version
 
+            save_secs = time.monotonic() - t_save
             # resume BEFORE eval: the default eval client is the training
             # rollout client, whose dispatcher skips submissions while paused
             # (a dedicated eval_rollout keeps the reference's order anyway)
             self.rollout.resume()
-            with stats_tracker.record_timing("eval"):
+            t_eval = time.monotonic()
+            with tl.phase("ckpt_eval"):
                 self._maybe_evaluate(eval_workflow or workflow, epoch, global_step)
+            eval_secs = time.monotonic() - t_eval
 
+            bd = self._complete_step_timeline(tl, batch, n_extra_fwd)
             stats = stats_tracker.export_all()
             stats.update(self.rollout.export_stats())
+            stats.update(step_timeline.breakdown_stat_keys(bd))
+            # backward-compatible timing keys (the per-block ad-hoc
+            # record_timing scopes these replace; the dropped keys —
+            # critic_values/recompute_logp/ref_logp/compute_advantages/
+            # critic_train_step — are folded into the phase taxonomy)
+            stats["timing/rollout"] = bd["rollout_wait_s"]
+            stats["timing/train_step"] = train_step_secs
+            stats["timing/update_weights"] = bd["weight_publish_s"]
+            stats["timing/save"] = save_secs
+            stats["timing/eval"] = eval_secs
+            if self.last_hbm_ledger is not None:
+                stats["hbm/in_use_bytes"] = float(
+                    self.last_hbm_ledger["bytes_in_use"]
+                )
+                if self.last_hbm_ledger["headroom_fraction"] is not None:
+                    stats["hbm/headroom_fraction"] = float(
+                        self.last_hbm_ledger["headroom_fraction"]
+                    )
             stats["step_secs"] = time.monotonic() - t_step
             self._obs.step_seconds.observe(stats["step_secs"])
             stats["version"] = float(new_version)
+            logger.info(
+                f"step {global_step}: {step_timeline.format_phase_line(bd)}"
+            )
             self.stats_logger.commit(epoch, step, global_step, stats)
             last_completed = StepInfo(
                 epoch=epoch,
@@ -452,8 +518,28 @@ class PPOTrainer:
                 steps_per_epoch=steps_per_epoch,
             )
             if profiling:
-                perf_tracer.stop_device_profile()
+                trace_dir = perf_tracer.stop_device_profile()
+                if trace_dir:
+                    logger.info(f"device profile captured: {trace_dir}")
             perf_tracer.save(step=global_step)
+
+    def _complete_step_timeline(self, tl, batch, n_extra_fwd: int) -> dict:
+        """Close the step's phase timeline (shared helper: utilization
+        inputs + HBM ledger refresh — step_timeline.complete_trainer_step)."""
+        bd, ledger = step_timeline.complete_trainer_step(
+            self.step_recorder,
+            tl,
+            self.actor_engine,
+            self.config.telemetry,
+            batch,
+            n_extra_forwards=n_extra_fwd,
+            remat=bool(
+                getattr(self.config.actor, "gradient_checkpointing", False)
+            ),
+        )
+        if ledger is not None:
+            self.last_hbm_ledger = ledger
+        return bd
 
     def _maybe_evaluate(self, eval_workflow, epoch: int, global_step: int) -> None:
         if self.valid_dataset is None or eval_workflow is None:

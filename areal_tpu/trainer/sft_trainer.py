@@ -21,6 +21,7 @@ import numpy as np
 from areal_tpu.api.config import MicroBatchSpec, SFTConfig
 from areal_tpu.api.io_struct import FinetuneSpec, StepInfo
 from areal_tpu.engine.train_engine import JaxTrainEngine
+from areal_tpu.observability import step_timeline
 from areal_tpu.utils import logging as alog, stats_tracker
 from areal_tpu.utils.data import (
     StatefulDataLoader,
@@ -203,6 +204,13 @@ class SFTTrainer:
         self.evaluator = Evaluator(config.evaluator, self.ft_spec)
         self.recover_handler = RecoverHandler(config.recover, self.ft_spec)
         self.stats_logger = StatsLogger(config.stats_logger, self.ft_spec)
+        # trainer goodput observatory: same step-phase contract as the RL
+        # loop (rollout_wait stays 0 here — SFT has no async bubble)
+        self.step_recorder = step_timeline.StepTimelineRecorder()
+        self.last_hbm_ledger: dict | None = None
+        from areal_tpu.utils import compile_cache
+
+        compile_cache.install_compile_counters()
         self.recover_info = self.recover_handler.load(
             self.engine,
             saver=self.saver,
@@ -249,32 +257,68 @@ class SFTTrainer:
             epoch = global_step // steps_per_epoch
             step = global_step % steps_per_epoch
             t0 = time.monotonic()
-            rows = next(gen)
-            batch = self._collate(rows)
+            tl = self.step_recorder.start(global_step)
+            with tl.phase("host_prep"):
+                rows = next(gen)
+                batch = self._collate(rows)
+            # the engine attributes its host_prep/forward_backward/
+            # optimizer spans into ``tl`` via step_timeline.engine_phase
             stats = self._train_step(batch)
             self.engine.set_version(global_step + 1)
             losses.append(stats[self.loss_key])
 
-            self.saver.maybe_save(self.engine, epoch, step, global_step, self.tokenizer)
-            self.recover_handler.dump(
-                self.engine,
-                StepInfo(
-                    epoch=epoch,
-                    epoch_step=step,
-                    global_step=global_step,
-                    steps_per_epoch=steps_per_epoch,
-                ),
-                saver=self.saver,
-                evaluator=self.evaluator,
-                dataloader=self.train_dataloader,
-                tokenizer=self.tokenizer,
-            )
-            if self.valid_dataset is not None:
-                self.evaluator.maybe_evaluate(epoch, global_step, self._run_eval)
+            with tl.phase("ckpt_eval"):
+                self.saver.maybe_save(
+                    self.engine, epoch, step, global_step, self.tokenizer
+                )
+                self.recover_handler.dump(
+                    self.engine,
+                    StepInfo(
+                        epoch=epoch,
+                        epoch_step=step,
+                        global_step=global_step,
+                        steps_per_epoch=steps_per_epoch,
+                    ),
+                    saver=self.saver,
+                    evaluator=self.evaluator,
+                    dataloader=self.train_dataloader,
+                    tokenizer=self.tokenizer,
+                )
+                if self.valid_dataset is not None:
+                    self.evaluator.maybe_evaluate(
+                        epoch, global_step, self._run_eval
+                    )
+            bd = self._complete_step_timeline(tl, batch)
+            stats.update(step_timeline.breakdown_stat_keys(bd))
+            if self.last_hbm_ledger is not None:
+                stats["hbm/in_use_bytes"] = float(
+                    self.last_hbm_ledger["bytes_in_use"]
+                )
+                if self.last_hbm_ledger["headroom_fraction"] is not None:
+                    stats["hbm/headroom_fraction"] = float(
+                        self.last_hbm_ledger["headroom_fraction"]
+                    )
             stats["step_secs"] = time.monotonic() - t0
             stats.update(stats_tracker.export_all())
             self.stats_logger.commit(epoch, step, global_step, stats)
         return losses
+
+    def _complete_step_timeline(self, tl, batch) -> dict:
+        """Close the step timeline (shared helper — SFT has exactly one
+        fwd/bwd pass per step, so no extra forwards)."""
+        bd, ledger = step_timeline.complete_trainer_step(
+            self.step_recorder,
+            tl,
+            self.engine,
+            self.config.telemetry,
+            batch,
+            remat=bool(
+                getattr(self.config.model, "gradient_checkpointing", False)
+            ),
+        )
+        if ledger is not None:
+            self.last_hbm_ledger = ledger
+        return bd
 
     def _run_eval(self) -> None:
         bs = self.config.train_dataset.batch_size

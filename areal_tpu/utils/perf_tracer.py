@@ -283,23 +283,88 @@ def configure(config: PerfTracerConfig, rank: int = 0, role: str | None = None) 
     )
 
 
-def start_device_profile(output_dir: str | None = None) -> None:
-    """Begin a detailed XLA device profile (jax.profiler trace; view in
-    TensorBoard/XProf). Reference knob: PerfTracerConfig.profile_steps."""
-    import jax
+# on-demand device profiling state: one jax.profiler trace at a time per
+# process (the profiler itself is a process-global); each capture gets its
+# own timestamped dir so postmortem can link individual sessions
+_PROFILE_LOCK = threading.Lock()
+_PROFILE_DIR: str | None = None
 
-    d = os.path.join(
+
+def default_profile_root(output_dir: str | None = None) -> str:
+    return os.path.join(
         output_dir or _TRACER.config.output_dir or "/tmp/areal_tpu/traces",
         "xprof",
     )
-    os.makedirs(d, exist_ok=True)
-    jax.profiler.start_trace(d)
 
 
-def stop_device_profile() -> None:
+def start_device_profile(output_dir: str | None = None) -> str:
+    """Begin a detailed XLA device profile (jax.profiler trace; view in
+    TensorBoard/XProf). Returns the capture dir. Raises RuntimeError when
+    a profile is already running (one at a time per process — the HTTP
+    endpoint turns this into a 409). Reference knob:
+    PerfTracerConfig.profile_steps."""
+    global _PROFILE_DIR
     import jax
 
-    jax.profiler.stop_trace()
+    with _PROFILE_LOCK:
+        if _PROFILE_DIR is not None:
+            raise RuntimeError(
+                f"device profile already active at {_PROFILE_DIR}"
+            )
+        d = os.path.join(
+            default_profile_root(output_dir),
+            f"profile_{int(time.time() * 1000)}",
+        )
+        os.makedirs(d, exist_ok=True)
+        jax.profiler.start_trace(d)
+        _PROFILE_DIR = d
+    return d
+
+
+def stop_device_profile(only_dir: str | None = None) -> str | None:
+    """End the active capture; returns its dir (None if none active).
+    ``only_dir`` stops the capture only if it is still the active one —
+    the guard profile_for's background timer needs so a stale timer from
+    an early-stopped capture can never truncate a newer unrelated one."""
+    global _PROFILE_DIR
+    import jax
+
+    with _PROFILE_LOCK:
+        if _PROFILE_DIR is None:
+            return None
+        if only_dir is not None and _PROFILE_DIR != only_dir:
+            return None
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            d, _PROFILE_DIR = _PROFILE_DIR, None
+    return d
+
+
+def device_profile_active() -> str | None:
+    """The active capture's dir, or None."""
+    with _PROFILE_LOCK:
+        return _PROFILE_DIR
+
+
+def profile_for(duration_s: float, output_dir: str | None = None) -> str:
+    """Start a capture and stop it after ``duration_s`` on a background
+    timer thread — the POST /debug/profile implementation. Returns the
+    capture dir immediately; the xplane/trace files land at stop time."""
+    d = start_device_profile(output_dir)
+
+    def _stop():
+        time.sleep(max(0.0, duration_s))
+        try:
+            stop_device_profile(only_dir=d)
+        except Exception:  # noqa: BLE001 — a failed stop must not kill
+            # the timer thread silently holding the active slot
+            logger.exception("device-profile stop failed")
+
+    threading.Thread(
+        target=_stop, name="device-profile-stop", daemon=True
+    ).start()
+    return d
 
 
 def get_tracer() -> PerfTracer:

@@ -624,12 +624,47 @@ def phase_train():
     t0 = time.monotonic()
     eng.train_batch(batch, grpo_loss, weight_fn)  # compile + first step
     log(f"[train] first step (compile) {time.monotonic()-t0:.1f}s")
+    # trainer scoreboard (detail.train): measured step-phase split via the
+    # goodput observatory — MFU from model dims + chip peak spec, bubble
+    # fraction measured (0 here: this phase has no rollout to wait on)
+    from areal_tpu.observability import hw_accounting, step_timeline
+
+    rec = step_timeline.StepTimelineRecorder()
     n_steps = 3
     t0 = time.monotonic()
-    for _ in range(n_steps):
+    for i in range(n_steps):
+        tl = rec.start(i)
         eng.train_batch(batch, grpo_loss, weight_fn)
+        rec.complete(tl)
     dt = time.monotonic() - t0
-    _emit_phase({"phase": "train", "tok_s": n_tokens * n_steps / dt})
+    import jax
+
+    chips = jax.device_count()
+    peak = hw_accounting.chip_peak_flops()
+    flops = hw_accounting.train_step_flops(model_cfg, n_tokens, remat=True)
+    recent = rec.recent()
+    compute_s = sum(
+        r["breakdown"]["forward_backward_s"] + r["breakdown"]["optimizer_s"]
+        for r in recent
+    )
+    mfu = (
+        round(flops * n_steps / (compute_s * peak * chips), 4)
+        if peak and compute_s > 0
+        else None
+    )
+    bubble = round(
+        sum(r["breakdown"]["bubble_fraction"] for r in recent)
+        / max(1, len(recent)),
+        4,
+    )
+    _emit_phase(
+        {
+            "phase": "train",
+            "tok_s": n_tokens * n_steps / dt,
+            "mfu": mfu,
+            "bubble_fraction": bubble,
+        }
+    )
     try:
         eng.destroy()
     except Exception:
@@ -1008,6 +1043,7 @@ def main():
     sources = {}
     gen_tok_s = train_tok_s = weight_update_secs = longctx = async_sync = None
     gateway = None
+    train_detail = None
     wu_detail = {}
     n_chips = 1
     gen_chips = train_chips = 1
@@ -1108,6 +1144,14 @@ def main():
         if t is not None:
             train_tok_s = float(t["tok_s"])
             train_chips = t["_chips"]
+            # the trainer scoreboard next to detail.gateway: MFU + tok/s/
+            # chip + bubble fraction (cached pre-observatory payloads carry
+            # tok/s only; the other fields stay None until remeasured)
+            train_detail = {
+                "mfu": t.get("mfu"),
+                "tok_s_per_chip": round(train_tok_s / train_chips, 1),
+                "bubble_fraction": t.get("bubble_fraction"),
+            }
         a = resolve("async_sync", spawn_in_window("async_sync") if live else None)
         if a is not None:
             async_sync = {
@@ -1137,6 +1181,7 @@ def main():
         "longctx": longctx,
         "async_vs_sync": async_sync,
         "gateway": gateway,
+        "train": train_detail,
         # the chip count the pipeline number is normalized by: each phase's
         # rate divides by ITS OWN measurement's chip count (a live 1-chip
         # decode must not be divided by a cached 4-chip train's grant)

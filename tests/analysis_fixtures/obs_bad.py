@@ -10,6 +10,17 @@ def rogue_registration():
     return reg.counter("areal_rollout_shadow_total", "not in the catalog")
 
 
+def rogue_phase_histogram():
+    reg = get_registry()
+    # OBS001: a step-phase histogram minted outside the catalog — the
+    # trainer observatory's dashboard panel would silently never see it
+    return reg.histogram(
+        "areal_train_phase_shadow_seconds",
+        "phase histogram not in the catalog",
+        label_names=("phase",),
+    )
+
+
 DISPLAY_ROWS = (
     ("areal_rollout_capacity", "fine — catalogued"),
     ("areal_rollout_capcity", "OBS002: misspelled reference"),

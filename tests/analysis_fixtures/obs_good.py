@@ -6,6 +6,11 @@ DISPLAY_ROWS = (
     # histogram component series resolve to their base family
     ("areal_weight_update_pause_seconds_sum", "pause time"),
     ("areal_weight_update_pause_seconds_count", "pauses"),
+    # trainer-observatory phase histogram: catalogued family, so both the
+    # base name and its Prometheus component series are clean references
+    ("areal_train_phase_seconds", "step phases"),
+    ("areal_train_phase_seconds_sum", "phase time"),
+    ("areal_train_bubble_fraction", "bubble"),
 )
 
 LOGGER_NAME = "areal_tpu"  # package name, not a metric: no finding

@@ -82,7 +82,10 @@ def test_cfg_good_fixture():
 
 def test_obs_bad_fixture():
     rules = rules_in(FIXTURES / "obs_bad.py", ["OBS"])
-    assert "OBS001" in rules  # registration outside the catalog
+    # two registrations outside the catalog: the counter AND the
+    # trainer-observatory phase histogram (histogram() is a registration
+    # method too — a rogue phase panel must not slip past the gate)
+    assert rules.count("OBS001") == 2
     assert rules.count("OBS002") == 2  # two misspelled references
 
 

@@ -191,8 +191,9 @@ def test_window_guard_skips_phases_that_no_longer_fit(cache_dir, monkeypatch, ca
 def test_round_payload_carries_gateway_alongside_decode(cache_dir, monkeypatch, capsys):
     """ROADMAP housekeeping: post-PR 5 probe fix, a healthy round must emit
     REAL numbers — non-null detail.gateway (the PR 7 serving scoreboard)
-    riding alongside a non-zero decode tok/s in the SAME payload, so r06+
-    rounds are trustworthy on both axes at once."""
+    AND non-null detail.train (the trainer goodput observatory scoreboard:
+    MFU, tok/s/chip, bubble fraction) riding alongside a non-zero decode
+    tok/s in the SAME payload, so r06+ rounds record both scoreboards."""
 
     def fake_spawn(name, deadline=None):
         if name == "probe":
@@ -200,7 +201,12 @@ def test_round_payload_carries_gateway_alongside_decode(cache_dir, monkeypatch, 
         if name == "decode":
             return {"phase": "decode", "tok_s": 6700.0}
         if name == "train":
-            return {"phase": "train", "tok_s": 5800.0}
+            return {
+                "phase": "train",
+                "tok_s": 5800.0,
+                "mfu": 0.41,
+                "bubble_fraction": 0.0,
+            }
         if name == "gateway":
             return {
                 "phase": "gateway",
@@ -227,6 +233,33 @@ def test_round_payload_carries_gateway_alongside_decode(cache_dir, monkeypatch, 
     assert gw is not None and gw["goodput_tok_s"] == 250.0
     assert set(gw["classes"]) == {"interactive", "rollout"}
     assert out["detail"]["sources"]["gateway"] == "live"
+    # …AND the training scoreboard rides next to it (r06+ trajectory)
+    tr = out["detail"]["train"]
+    assert tr is not None and tr["mfu"] == 0.41
+    assert tr["tok_s_per_chip"] == 5800.0
+    assert tr["bubble_fraction"] == 0.0
+    assert out["detail"]["sources"]["train"] == "live"
+
+
+def test_cached_train_payload_still_yields_train_detail(cache_dir, monkeypatch, capsys):
+    """A pre-observatory cached train payload (tok/s only) must still fold
+    to a non-null detail.train — tok/s/chip computable, mfu/bubble None
+    until remeasured — so the scoreboard field never silently vanishes."""
+    _seed(cache_dir, "train", {"phase": "train", "tok_s": 8000.0}, n_chips=4)
+    monkeypatch.setattr(
+        bench,
+        "_spawn_phase",
+        lambda name, deadline=None: {"phase": name, "error": "wedged"},
+    )
+    bench.main()
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    tr = out["detail"]["train"]
+    assert tr is not None
+    assert tr["tok_s_per_chip"] == 2000.0
+    assert tr["mfu"] is None and tr["bubble_fraction"] is None
 
 
 def test_main_prefers_live_over_cache(cache_dir, monkeypatch, capsys):

@@ -316,7 +316,13 @@ def test_mixed_version_tags_through_workflow_executor(fleet, fleet_client):
     _, sum_before, count_before = span_fam.labels().snapshot()
     wf = RLVRWorkflow(
         lambda *a, **k: 1.0,
-        GenerationHyperparameters(n_samples=1, max_new_tokens=384, temperature=1.0),
+        # ignore_eos: an early sampled EOS shrinks the window the staged
+        # commit must land inside and flakes the spanned>0 assert under
+        # load — the full 384 tokens keep the race wide open without
+        # changing what is tested (per-token tags across the commit)
+        GenerationHyperparameters(
+            n_samples=1, max_new_tokens=384, temperature=1.0, ignore_eos=True
+        ),
     )
     tids = [
         client.submit({"prompt_ids": [9 + i, 4, 2]}, wf) for i in range(2)
