@@ -5,12 +5,22 @@ a blocking call starving the rollout event loop, a side effect captured
 inside a ``jax.jit`` trace, a config field drifting from its dataclass, a
 metric name drifting from the catalog. These corrupt throughput or
 training signal silently. This package makes those invariants
-machine-checked: a rule engine (`core`), five rule families (`rules/`),
-and a burn-down baseline (`baseline.json`) so the gate is
+machine-checked: a rule engine (`core`), eleven rule families
+(`rules/`), and a burn-down baseline (`baseline.json`) so the gate is
 zero-new-findings from day one.
 
+Since v2 the engine is dataflow-aware (`dataflow`): a package-wide call
+graph with hot-path reachability (seeded from the decode loop, the
+trainer step loops, jit-traced callables, and ``# arealint: hot-path``
+markers) plus device/host value-origin tracking. The performance
+families — PRF (hot-path host<->device syncs), DON (jit buffer
+donation), SHD (PartitionSpec/mesh consistency), RCP (recompile risk) —
+consume it to enforce statically what the goodput observatory measures
+at runtime (docs/static_analysis.md, docs/perf.md).
+
 Entry points:
-  - CLI: ``python -m areal_tpu.tools.arealint [paths]``
+  - CLI: ``python -m areal_tpu.tools.arealint [paths]`` (``--changed-only``
+    for git-diff-scoped runs, ``--format sarif`` for CI annotation)
   - API: :func:`run_analysis`
 """
 

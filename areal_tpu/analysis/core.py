@@ -2,8 +2,11 @@
 
 Design:
   - Every rule family is a class with a ``FAMILY`` prefix (ASY/JAX/THR/
-    CFG/OBS), a ``RULES`` table (id -> one-line title), and a
-    ``check(sf, ctx)`` generator yielding :class:`Finding`.
+    CFG/OBS/EXC/SIG and the dataflow-backed PRF/DON/SHD/RCP), a
+    ``RULES`` table (id -> one-line title), and a ``check(sf, ctx)``
+    generator yielding :class:`Finding`. Interprocedural facts (call
+    graph, hot-path reachability, value origins) come from
+    :mod:`areal_tpu.analysis.dataflow` via :meth:`ProjectContext.graph_for`.
   - Findings carry a line number for humans and a line-independent ``key``
     for the baseline, so baselined findings survive unrelated edits that
     shift line numbers.
@@ -285,8 +288,17 @@ class ProjectContext:
         self.metric_names: set[str] = set()
         self.metric_prefixes: set[str] = set()
         self.catalog_relpath = "areal_tpu/observability/catalog.py"
+        # declared device-mesh axis names (parallel/mesh.py MESH_AXES) —
+        # the SHD family validates every PartitionSpec string against them
+        self.mesh_axes: frozenset[str] = frozenset()
+        # lazy interprocedural state (dataflow.py): one package-wide call
+        # graph shared by every PRF/DON/RCP check, plus per-file graphs
+        # for sources outside the package (fixtures, repo scripts)
+        self._package_graph = None
+        self._file_graphs: dict[str, object] = {}
         self._build_config_registry()
         self._build_metric_catalog()
+        self._build_mesh_axes()
 
     # -- config dataclasses ------------------------------------------------
     def _build_config_registry(self) -> None:
@@ -367,6 +379,56 @@ class ProjectContext:
         self.metric_prefixes = {
             "_".join(n.split("_")[:2]) for n in self.metric_names
         }
+
+    # -- mesh axes ---------------------------------------------------------
+    def _build_mesh_axes(self) -> None:
+        path = self.package_root / "parallel" / "mesh.py"
+        if not path.exists():
+            return
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"))
+        except SyntaxError:
+            return
+        for node in tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == "MESH_AXES"
+                for t in node.targets
+            ):
+                continue
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                self.mesh_axes = frozenset(
+                    e.value
+                    for e in node.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                )
+
+    # -- interprocedural graphs (dataflow.py) ------------------------------
+    def graph_for(self, sf: "SourceFile"):
+        """The call graph covering ``sf``: the shared package graph when
+        the file lives under the package root, a single-file graph
+        otherwise (fixtures, bench/prof scripts). Both are cached —
+        hot-path reachability is computed once per process."""
+        from areal_tpu.analysis import dataflow
+
+        try:
+            sf.path.resolve().relative_to(self.package_root.resolve())
+            in_package = True
+        except ValueError:
+            in_package = False
+        if in_package:
+            if self._package_graph is None:
+                self._package_graph = dataflow.build_package_graph(
+                    self.package_root
+                )
+            if sf.relpath in self._package_graph.modules:
+                return self._package_graph
+        g = self._file_graphs.get(sf.relpath)
+        if g is None:
+            g = dataflow.single_file_graph(sf.relpath, sf.text, sf.tree)
+            self._file_graphs[sf.relpath] = g
+        return g
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,1009 @@
+"""Interprocedural dataflow for arealint's performance families (PRF/DON/
+SHD/RCP).
+
+The one-hop AST checks (ASY/JAX/THR/...) answer "what does this statement
+do"; the performance rules need two more answers:
+
+1. **Is this code hot?** A `jax.device_get` in `initialize()` costs one
+   transfer per process lifetime; the same call in the decode loop costs
+   one round-trip *per chunk* and serializes host dispatch against device
+   compute. Hotness is computed as call-graph reachability from a seed
+   set: the decode loop, the trainer step loop, jit-traced callables, and
+   any function carrying an explicit ``# arealint: hot-path`` marker.
+
+2. **Is this value a device array?** ``np.asarray(host_thing)`` is free;
+   ``np.asarray(device_thing)`` is a blocking device->host transfer. The
+   grep surface for sync-shaped calls is ~360 sites repo-wide and most
+   are benign — value-origin tracking is what separates the stats-path
+   reads from the per-token-loop syncs.
+
+Both facts are *approximate by design* (flow-insensitive origins, name-
+resolved call edges, no cross-file attribute types). The rules that
+consume them are tuned to fail quiet on "unknown": a finding requires a
+positive hot-path hit and (where it matters) a positive device-origin
+hit, so precision errors become missed findings, never false alarms.
+
+Call-graph resolution covers the shapes this repo actually uses:
+``f()`` to module-level defs and lexically-enclosing local defs,
+``self.m()`` to methods of the enclosing class and its same-module
+bases, ``mod.f()``/``from pkg.mod import f`` across package modules, and
+the ``fn = self._get_step()`` / ``fn(...)`` jit-getter idiom (see
+:class:`JitIndex`).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Iterable, Iterator
+
+HOT_MARKER_RE = re.compile(r"arealint:\s*hot-path\b")
+
+# Qualname tails that seed the hot set by convention: the decode loop and
+# the TrainEngine step entry points. Name-based so fixtures, subclasses,
+# and future engines participate without registration.
+DEFAULT_HOT_SEED_NAMES = frozenset(
+    {
+        "_loop",
+        "train_batch",
+        "eval_batch",
+        "forward_batch",
+        "train_step",
+        "decode_step",
+    }
+)
+
+# dotted transform -> positions of traced-callable arguments (the traced
+# bodies join the hot set: everything the trace reaches replays per step)
+TRACED_ARG_POSITIONS = {
+    "jax.jit": (0,),
+    "jit": (0,),
+    "pjit": (0,),
+    "jax.pjit": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.lax.scan": (0,),
+    "lax.scan": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "lax.while_loop": (0, 1),
+    "jax.lax.cond": (1, 2),
+    "lax.cond": (1, 2),
+    "jax.lax.fori_loop": (2,),
+    "lax.fori_loop": (2,),
+}
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+# -- value origins -----------------------------------------------------------
+
+DEVICE = "device"
+HOST = "host"
+UNKNOWN = "unknown"
+
+# dotted-prefix -> origin of the call's result
+_DEVICE_CALL_PREFIXES = (
+    "jnp.",
+    "jax.numpy.",
+    "jax.lax.",
+    "jax.nn.",
+    "jax.random.",
+    "jax.scipy.",
+    "lax.",
+)
+_DEVICE_CALLS = {
+    "jax.device_put",
+    "jax.make_array_from_callback",
+    "jax.block_until_ready",  # returns its (device) operand
+}
+_HOST_CALL_PREFIXES = ("np.", "numpy.", "time.", "os.", "math.")
+_HOST_CALLS = {
+    "float",
+    "int",
+    "bool",
+    "len",
+    "str",
+    "list",
+    "tuple",
+    "dict",
+    "set",
+    "range",
+    "sorted",
+    "jax.device_get",
+    "min",
+    "max",
+    "sum",
+    "abs",
+    "round",
+    "enumerate",
+    "zip",
+}
+_HOST_METHODS = {"tolist", "item"}
+# array-producing methods that preserve their receiver's origin
+_PRESERVING_METHODS = {
+    "astype",
+    "reshape",
+    "sum",
+    "mean",
+    "max",
+    "min",
+    "copy",
+    "transpose",
+    "squeeze",
+    "at",
+    "set",
+    "add",
+    "take",
+    "view",
+}
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function/method in the graph."""
+
+    key: str  # "relpath::Qual.Name"
+    relpath: str
+    qualname: str
+    name: str
+    node: ast.AST  # FunctionDef / AsyncFunctionDef / Lambda
+    cls: str | None = None
+
+
+def _comment_lines(text: str) -> dict[int, str]:
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(text).readline)
+        return {
+            t.start[0]: t.string for t in toks if t.type == tokenize.COMMENT
+        }
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return {}
+
+
+class ModuleInfo:
+    """Per-module function index, import table and intra-module call edges."""
+
+    def __init__(self, relpath: str, text: str, tree: ast.Module):
+        self.relpath = relpath
+        self.tree = tree
+        self.comments = _comment_lines(text)
+        self.funcs: dict[str, FuncInfo] = {}  # qualname -> info
+        self.module_defs: dict[str, str] = {}  # bare name -> qualname
+        self.class_methods: dict[str, dict[str, str]] = {}  # cls -> name -> qualname
+        self.class_bases: dict[str, list[str]] = {}
+        # import resolution: local alias -> dotted module; name -> (module, name)
+        self.import_modules: dict[str, str] = {}
+        self.import_names: dict[str, tuple[str, str]] = {}
+        self.parents: dict[int, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[id(child)] = node
+        self._jit_index = None  # lazy, shared by PRF/DON/RCP + attr origins
+        self._index()
+
+    def jit_index(self) -> "JitIndex":
+        """The module's JitIndex, built once — three rule families and
+        the device-attr inference all consume it."""
+        if self._jit_index is None:
+            self._jit_index = JitIndex(self)
+        return self._jit_index
+
+    def _index(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_modules[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for a in node.names:
+                    self.import_names[a.asname or a.name] = (node.module, a.name)
+
+        def walk(body: list[ast.stmt], prefix: str, cls: str | None) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{stmt.name}"
+                    key = f"{self.relpath}::{qual}"
+                    self.funcs[qual] = FuncInfo(
+                        key, self.relpath, qual, stmt.name, stmt, cls
+                    )
+                    if cls is None and not prefix.count("."):
+                        self.module_defs[stmt.name] = qual
+                    if cls is not None and prefix == f"{cls}.":
+                        self.class_methods.setdefault(cls, {})[stmt.name] = qual
+                    walk(stmt.body, f"{qual}.", cls)
+                elif isinstance(stmt, ast.ClassDef):
+                    self.class_bases[stmt.name] = [
+                        b.id for b in stmt.bases if isinstance(b, ast.Name)
+                    ]
+                    walk(stmt.body, f"{stmt.name}.", stmt.name)
+                else:
+                    # defs nested in compound statements (if/for/with/try)
+                    # bind in the enclosing scope — register them too
+                    for attr in ("body", "orelse", "finalbody"):
+                        sub = getattr(stmt, attr, None)
+                        if sub:
+                            walk(sub, prefix, cls)
+                    for h in getattr(stmt, "handlers", []):
+                        walk(h.body, prefix, cls)
+
+        walk(self.tree.body, "", None)
+
+    # -- seed detection ----------------------------------------------------
+    def seed_quals(self) -> set[str]:
+        """Hot seeds in this module: marker comments, seed-named defs, and
+        jit/scan-traced callables."""
+        seeds: set[str] = set()
+        for qual, fi in self.funcs.items():
+            node = fi.node
+            if fi.name in DEFAULT_HOT_SEED_NAMES:
+                seeds.add(qual)
+                continue
+            lines = [node.lineno]
+            if node.decorator_list:
+                lines.append(min(d.lineno for d in node.decorator_list))
+            # plus the contiguous comment block directly above the def —
+            # the marker may share a multi-line rationale comment
+            ln = min(lines) - 1
+            while ln in self.comments:
+                lines.append(ln)
+                ln -= 1
+            if any(
+                HOT_MARKER_RE.search(self.comments.get(ln, "")) for ln in lines
+            ):
+                seeds.add(qual)
+                continue
+            for dec in node.decorator_list:
+                d = dotted_name(dec)
+                if d in _JIT_NAMES or (
+                    isinstance(dec, ast.Call)
+                    and (
+                        dotted_name(dec.func) in _JIT_NAMES
+                        or _is_partial_of_jit(dec)
+                    )
+                ):
+                    seeds.add(qual)
+                    break
+        # call-site-traced callables: jax.jit(f), lax.scan(body, ...)
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            fn = dotted_name(call.func)
+            positions = TRACED_ARG_POSITIONS.get(fn) if fn else None
+            if positions is None:
+                continue
+            for pos in positions:
+                if pos >= len(call.args):
+                    continue
+                arg = call.args[pos]
+                target = None
+                if isinstance(arg, ast.Name):
+                    target = self._resolve_local(arg.id, call)
+                elif (
+                    isinstance(arg, ast.Attribute)
+                    and isinstance(arg.value, ast.Name)
+                    and arg.value.id == "self"
+                ):
+                    cls = self.enclosing_class(call)
+                    if cls:
+                        target = self.method_qual(cls, arg.attr)
+                if target:
+                    seeds.add(target)
+        return seeds
+
+    # -- resolution helpers ------------------------------------------------
+    def enclosing_class(self, node: ast.AST) -> str | None:
+        cur = self.parents.get(id(node))
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = self.parents.get(id(cur))
+        return None
+
+    def enclosing_func(self, node: ast.AST) -> FuncInfo | None:
+        cur = self.parents.get(id(node))
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for fi in self.funcs.values():
+                    if fi.node is cur:
+                        return fi
+            cur = self.parents.get(id(cur))
+        return None
+
+    def method_qual(self, cls: str, name: str, _seen: frozenset = frozenset()) -> str | None:
+        """Method lookup through same-module single inheritance."""
+        if cls in _seen:
+            return None
+        qual = self.class_methods.get(cls, {}).get(name)
+        if qual:
+            return qual
+        for base in self.class_bases.get(cls, []):
+            found = self.method_qual(base, name, _seen | {cls})
+            if found:
+                return found
+        return None
+
+    def _resolve_local(self, name: str, from_node: ast.AST) -> str | None:
+        """Bare-name resolution: nearest enclosing scope's def, then
+        module level. A def anywhere in a scope's statement tree (e.g.
+        inside an ``if``) binds in that scope, so the search stops only
+        at NESTED function boundaries."""
+
+        def scope_defs(scope: ast.AST):
+            stack = list(scope.body)
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield n
+                    continue  # its body is a deeper scope
+                if not isinstance(n, (ast.Lambda, ast.ClassDef)):
+                    stack.extend(ast.iter_child_nodes(n))
+
+        cur: ast.AST | None = from_node
+        while cur is not None:
+            cur = self.parents.get(id(cur))
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+                for stmt in scope_defs(cur):
+                    if stmt.name == name:
+                        for fi in self.funcs.values():
+                            if fi.node is stmt:
+                                return fi.qualname
+                if isinstance(cur, ast.Module):
+                    return None
+        return None
+
+
+def _is_partial_of_jit(call: ast.Call) -> bool:
+    fn = dotted_name(call.func)
+    if fn not in ("partial", "functools.partial"):
+        return False
+    return bool(call.args) and dotted_name(call.args[0]) in _JIT_NAMES
+
+
+class PackageGraph:
+    """Call graph over a set of modules with hot-path reachability.
+
+    ``hot_reason`` maps each hot function key to a human-readable chain
+    root ("seeded" or "reachable from <seed qualname>") used in finding
+    messages.
+    """
+
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}  # relpath -> module
+        self.edges: dict[str, set[str]] = {}
+        self._hot: dict[str, str] | None = None  # key -> reason
+
+    @classmethod
+    def build(cls, sources: Iterable[tuple[str, str, ast.Module]]) -> "PackageGraph":
+        g = cls()
+        for relpath, text, tree in sources:
+            g.modules[relpath] = ModuleInfo(relpath, text, tree)
+        g._link()
+        return g
+
+    # -- linking -----------------------------------------------------------
+    def _module_for_dotted(self, dotted: str) -> ModuleInfo | None:
+        """areal_tpu.engine.train_engine -> its ModuleInfo (by relpath
+        suffix match, so the graph works from any repo root)."""
+        tail = dotted.replace(".", "/") + ".py"
+        init = dotted.replace(".", "/") + "/__init__.py"
+        for relpath, mod in self.modules.items():
+            if relpath.endswith(tail) or relpath.endswith(init):
+                return mod
+        return None
+
+    def _link(self) -> None:
+        for mod in self.modules.values():
+            for fi in mod.funcs.values():
+                self.edges.setdefault(fi.key, set())
+                for call in ast.walk(fi.node):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    # skip calls that belong to a nested def (they get
+                    # their own node); lambda bodies stay attributed here
+                    encl = mod.enclosing_func(call)
+                    if encl is not None and encl.node is not fi.node:
+                        continue
+                    for tgt in self._resolve_call(mod, fi, call):
+                        self.edges[fi.key].add(tgt)
+
+    def _resolve_call(
+        self, mod: ModuleInfo, fi: FuncInfo, call: ast.Call
+    ) -> Iterator[str]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            qual = mod._resolve_local(f.id, call)
+            if qual:
+                yield f"{mod.relpath}::{qual}"
+                return
+            imp = mod.import_names.get(f.id)
+            if imp:
+                other = self._module_for_dotted(imp[0])
+                if other and imp[1] in other.module_defs:
+                    yield f"{other.relpath}::{other.module_defs[imp[1]]}"
+            return
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                cls = mod.enclosing_class(call)
+                if cls:
+                    qual = mod.method_qual(cls, f.attr)
+                    if qual:
+                        yield f"{mod.relpath}::{qual}"
+                return
+            base = dotted_name(f.value)
+            if base is None:
+                return
+            # mod_alias.f() across package modules
+            target_mod = None
+            if base in mod.import_modules:
+                target_mod = self._module_for_dotted(mod.import_modules[base])
+            elif base in mod.import_names:
+                m, n = mod.import_names[base]
+                target_mod = self._module_for_dotted(f"{m}.{n}")
+            if target_mod and f.attr in target_mod.module_defs:
+                yield f"{target_mod.relpath}::{target_mod.module_defs[f.attr]}"
+
+    # -- hot set -----------------------------------------------------------
+    @property
+    def hot(self) -> dict[str, str]:
+        if self._hot is None:
+            hot: dict[str, str] = {}
+            frontier: list[str] = []
+            for mod in self.modules.values():
+                for qual in mod.seed_quals():
+                    key = f"{mod.relpath}::{qual}"
+                    hot[key] = qual
+                    frontier.append(key)
+            while frontier:
+                cur = frontier.pop()
+                for nxt in self.edges.get(cur, ()):
+                    if nxt not in hot:
+                        hot[nxt] = hot[cur]
+                        frontier.append(nxt)
+            self._hot = hot
+        return self._hot
+
+    def hot_funcs_in(self, relpath: str) -> dict[int, tuple[FuncInfo, str]]:
+        """id(fn node) -> (info, seed qualname) for hot functions of one
+        file."""
+        mod = self.modules.get(relpath)
+        if mod is None:
+            return {}
+        out: dict[int, tuple[FuncInfo, str]] = {}
+        for fi in mod.funcs.values():
+            reason = self.hot.get(fi.key)
+            if reason is not None:
+                out[id(fi.node)] = (fi, reason)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# jit construction index (DON/RCP/PRF share it)
+# ---------------------------------------------------------------------------
+
+
+def _int_tuple(node: ast.expr | None) -> tuple[int, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _str_tuple(node: ast.expr | None) -> tuple[str, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return ()
+
+
+@dataclasses.dataclass
+class JitSite:
+    """One ``jax.jit(...)`` construction."""
+
+    call: ast.Call
+    target: ast.AST | None  # resolved FunctionDef/Lambda being wrapped
+    params: tuple[str, ...]  # positional params of the target (when known)
+    donate_pos: tuple[int, ...]
+    donate_names: tuple[str, ...]
+    static_pos: tuple[int, ...]
+    static_names: tuple[str, ...]
+
+    def donates(self, index: int, name: str | None) -> bool:
+        if index in self.donate_pos:
+            return True
+        if name is not None and name in self.donate_names:
+            return True
+        return False
+
+    def is_static(self, index: int, name: str | None) -> bool:
+        if index in self.static_pos:
+            return True
+        if name is not None and name in self.static_names:
+            return True
+        return False
+
+
+class JitIndex:
+    """All jit constructions in one module, plus the two idioms this repo
+    uses to reach them from call sites:
+
+    - direct binding: ``g = jax.jit(f, donate_argnums=...)`` -> calls of
+      ``g(...)`` in the same scope;
+    - getter methods: ``def _get_step(self): ... self._cache[k] =
+      jax.jit(step, ...); return self._cache[k]`` -> calls of
+      ``self._get_step(...)(...)`` or ``fn = self._get_step(...); fn(...)``.
+    """
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.sites: list[JitSite] = []
+        self._by_call_id: dict[int, JitSite] = {}
+        self.direct: dict[str, JitSite] = {}  # bound name -> site
+        self.getters: dict[str, JitSite] = {}  # method/function name -> site
+        # self.<attr> dicts that ever receive a jit construction via
+        # subscript store: calls THROUGH them dispatch onto device
+        self.cache_attrs: set[str] = set()
+        self._build()
+
+    def _build(self) -> None:
+        tree = self.mod.tree
+        for call in ast.walk(tree):
+            if not isinstance(call, ast.Call):
+                continue
+            fn = dotted_name(call.func)
+            if fn not in _JIT_NAMES and not (
+                fn in ("partial", "functools.partial")
+                and call.args
+                and dotted_name(call.args[0]) in _JIT_NAMES
+            ):
+                continue
+            if fn not in _JIT_NAMES:
+                continue  # partial(jax.jit, ...) decorators handled via decorator scan
+            target_node: ast.AST | None = None
+            params: tuple[str, ...] = ()
+            if call.args:
+                arg = call.args[0]
+                if isinstance(arg, ast.Lambda):
+                    target_node = arg
+                    params = tuple(a.arg for a in arg.args.args)
+                elif isinstance(arg, ast.Name):
+                    qual = self.mod._resolve_local(arg.id, call)
+                    if qual:
+                        target_node = self.mod.funcs[qual].node
+                elif (
+                    isinstance(arg, ast.Attribute)
+                    and isinstance(arg.value, ast.Name)
+                    and arg.value.id == "self"
+                ):
+                    cls = self.mod.enclosing_class(call)
+                    qual = self.mod.method_qual(cls, arg.attr) if cls else None
+                    if qual:
+                        target_node = self.mod.funcs[qual].node
+                if target_node is not None and not isinstance(
+                    target_node, ast.Lambda
+                ):
+                    args = target_node.args
+                    params = tuple(a.arg for a in args.posonlyargs + args.args)
+                    if params and params[0] in ("self", "cls"):
+                        params = params[1:]
+            kw = {k.arg: k.value for k in call.keywords if k.arg}
+            site = JitSite(
+                call=call,
+                target=target_node,
+                params=params,
+                donate_pos=_int_tuple(kw.get("donate_argnums")),
+                donate_names=_str_tuple(kw.get("donate_argnames")),
+                static_pos=_int_tuple(kw.get("static_argnums")),
+                static_names=_str_tuple(kw.get("static_argnames")),
+            )
+            self.sites.append(site)
+            self._by_call_id[id(call)] = site
+
+        # direct bindings + getter pattern
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                site = self._by_call_id.get(id(node.value))
+                if site is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.direct[t.id] = site
+                    elif (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Attribute)
+                        and isinstance(t.value.value, ast.Name)
+                        and t.value.value.id == "self"
+                    ):
+                        self.cache_attrs.add(t.value.attr)
+        for fi in self.mod.funcs.values():
+            if isinstance(fi.node, ast.Lambda):
+                continue
+            returned_jit = self._getter_site(fi.node)
+            if returned_jit is not None:
+                self.getters[fi.name] = returned_jit
+
+    def _getter_site(self, fn: ast.AST) -> JitSite | None:
+        """A function is a jit getter when it assigns a jit construction
+        (to anything — a cache subscript counts) and every return
+        statement returns either that binding or a subscript of the same
+        cache. Only the getter's OWN nodes count: the jit *target* is
+        usually a nested def whose returns must not disqualify the
+        pattern."""
+
+        def own_nodes(root: ast.AST) -> Iterator[ast.AST]:
+            stack = list(getattr(root, "body", []))
+            while stack:
+                n = stack.pop()
+                yield n
+                if not isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    stack.extend(ast.iter_child_nodes(n))
+
+        site: JitSite | None = None
+        assigned_to: set[str] = set()  # rendered targets of the jit assign
+        for node in own_nodes(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                s = self._by_call_id.get(id(node.value))
+                if s is not None:
+                    if site is not None and s is not site:
+                        return None  # two different jits: ambiguous
+                    site = s
+                    for t in node.targets:
+                        assigned_to.add(ast.dump(t))
+        if site is None:
+            return None
+        returns = [
+            n
+            for n in own_nodes(fn)
+            if isinstance(n, ast.Return) and n.value is not None
+        ]
+        if not returns:
+            return None
+        for r in returns:
+            if ast.dump(r.value) not in assigned_to and not self._same_cache(
+                r.value, assigned_to
+            ):
+                return None
+        return site
+
+    @staticmethod
+    def _same_cache(ret: ast.expr, assigned: set[str]) -> bool:
+        """return self._cache[key] matches an assign to self._cache[key2]
+        (key expressions may differ textually; match on the cache base)."""
+        if not isinstance(ret, ast.Subscript):
+            return False
+        base = ast.dump(ret.value)
+        for a in assigned:
+            if f"value={base}" in a or a.startswith(
+                f"Subscript(value={base}"
+            ):
+                return True
+        return False
+
+    def site_for_callsite(self, call: ast.Call) -> JitSite | None:
+        """The JitSite a *call site* dispatches into, through the direct
+        or getter idiom, or an inline ``jax.jit(f)(x)``."""
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in self.direct:
+            return self.direct[f.id]
+        if isinstance(f, ast.Call):
+            inline = self._by_call_id.get(id(f))
+            if inline is not None:
+                return inline
+            g = f.func
+            if (
+                isinstance(g, ast.Attribute)
+                and isinstance(g.value, ast.Name)
+                and g.value.id == "self"
+                and g.attr in self.getters
+            ):
+                return self.getters[g.attr]
+            if isinstance(g, ast.Name) and g.id in self.getters:
+                return self.getters[g.id]
+        return None
+
+
+# ---------------------------------------------------------------------------
+# value-origin tracking
+# ---------------------------------------------------------------------------
+
+
+class OriginTracker:
+    """Flow-ordered (single forward pass) device/host origin inference for
+    the locals of one function.
+
+    ``device_names``: names known to dispatch onto device when *called*
+    (locally-bound jit functions, jit-getter methods). ``device_attrs``:
+    ``self.<attr>`` names holding device trees (inferred per class from
+    assignment sites)."""
+
+    def __init__(
+        self,
+        fn: ast.AST,
+        device_names: set[str] | None = None,
+        device_attrs: set[str] | None = None,
+        jit_index: JitIndex | None = None,
+        param_origins: dict[str, str] | None = None,
+    ):
+        self.fn = fn
+        self.device_names = device_names or set()
+        self.device_attrs = device_attrs or set()
+        self.jit_index = jit_index
+        self.env: dict[str, str] = dict(param_origins or {})
+        self._annotate_params()
+        self._sweep()
+
+    def _annotate_params(self) -> None:
+        args = getattr(self.fn, "args", None)
+        if args is None:
+            return
+        for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+            if a.arg in self.env:
+                continue
+            ann = a.annotation
+            label = None
+            if ann is not None:
+                d = dotted_name(ann) or (
+                    ann.value if isinstance(ann, ast.Constant) else None
+                )
+                if isinstance(d, str):
+                    if "jax" in d or "jnp" in d or d.endswith("Array"):
+                        label = DEVICE
+                    elif d.startswith("np.") or "ndarray" in d:
+                        label = HOST
+            self.env[a.arg] = label or UNKNOWN
+
+    def _own_statements(self) -> list[ast.stmt]:
+        body = (
+            [self.fn.body]
+            if isinstance(self.fn, ast.Lambda)
+            else list(getattr(self.fn, "body", []))
+        )
+        out: list[ast.stmt] = []
+        stack: list[ast.AST] = list(body)
+        while stack:
+            n = stack.pop(0)
+            if isinstance(n, ast.stmt):
+                out.append(n)
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(n))
+        out.sort(key=lambda s: s.lineno)
+        return out
+
+    def _sweep(self) -> None:
+        # two source-ordered passes: the second resolves bindings whose
+        # right-hand side reads a name bound later in pass one (loop
+        # targets over dicts of step outputs, branch-divergent binds)
+        for _ in range(2):
+            self._sweep_once()
+
+    def _sweep_once(self) -> None:
+        for stmt in self._own_statements():
+            if isinstance(stmt, ast.Assign):
+                origin = self.origin_of(stmt.value)
+                for t in stmt.targets:
+                    self._bind(t, origin, stmt.value)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                self._bind(stmt.target, self.origin_of(stmt.value), stmt.value)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._bind_loop_target(stmt.target, stmt.iter)
+            elif isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    if item.optional_vars is not None:
+                        self._bind(
+                            item.optional_vars,
+                            self.origin_of(item.context_expr),
+                            item.context_expr,
+                        )
+
+    def _bind(self, target: ast.expr, origin: str, value: ast.expr) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = origin
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            # tuple unpack of a device-returning call: every element is
+            # device (the jit boundary returns arrays, not mixed tuples)
+            for el in target.elts:
+                self._bind(el, origin if origin == DEVICE else UNKNOWN, value)
+
+    def _bind_loop_target(self, target: ast.expr, it: ast.expr) -> None:
+        origin = UNKNOWN
+        # for k, v in <device-dict>.items(): the VALUES are device
+        if (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Attribute)
+            and it.func.attr == "items"
+            and self.origin_of(it.func.value) == DEVICE
+        ):
+            if isinstance(target, (ast.Tuple, ast.List)) and len(target.elts) == 2:
+                self._bind(target.elts[0], HOST, it)
+                self._bind(target.elts[1], DEVICE, it)
+                return
+        elif self.origin_of(it) == DEVICE:
+            origin = DEVICE
+        self._bind(target, origin, it)
+
+    # -- expression origins ----------------------------------------------
+    def origin_of(self, node: ast.expr) -> str:
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, UNKNOWN)
+        if isinstance(node, ast.Constant):
+            return HOST
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                return DEVICE if node.attr in self.device_attrs else UNKNOWN
+            base = self.origin_of(node.value)
+            return base if base == DEVICE else UNKNOWN
+        if isinstance(node, ast.Subscript):
+            return self.origin_of(node.value)
+        if isinstance(node, ast.BinOp):
+            if DEVICE in (self.origin_of(node.left), self.origin_of(node.right)):
+                return DEVICE
+            return UNKNOWN
+        if isinstance(node, ast.UnaryOp):
+            return self.origin_of(node.operand)
+        if isinstance(node, ast.IfExp):
+            a, b = self.origin_of(node.body), self.origin_of(node.orelse)
+            return a if a == b else UNKNOWN
+        if isinstance(node, (ast.Dict,)):
+            vals = [self.origin_of(v) for v in node.values if v is not None]
+            if vals and any(v == DEVICE for v in vals):
+                return DEVICE
+            return UNKNOWN
+        if isinstance(node, (ast.Tuple, ast.List)):
+            vals = [self.origin_of(v) for v in node.elts]
+            if vals and any(v == DEVICE for v in vals):
+                return DEVICE
+            return UNKNOWN
+        if isinstance(node, ast.DictComp):
+            return self.origin_of(node.value)
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            return self.origin_of(node.elt)
+        if isinstance(node, ast.Call):
+            return self._call_origin(node)
+        return UNKNOWN
+
+    def _call_origin(self, call: ast.Call) -> str:
+        d = dotted_name(call.func)
+        if d is not None:
+            if d in _DEVICE_CALLS or any(
+                d.startswith(p) for p in _DEVICE_CALL_PREFIXES
+            ):
+                return DEVICE
+            if d in _HOST_CALLS or any(
+                d.startswith(p) for p in _HOST_CALL_PREFIXES
+            ):
+                return HOST
+            if d.startswith("jax.tree.") or d.startswith("jax.tree_util."):
+                # tree.map over a device tree yields a device tree
+                for a in call.args:
+                    if self.origin_of(a) == DEVICE:
+                        return DEVICE
+                return UNKNOWN
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in self.device_names:
+                return DEVICE
+            if self.env.get(f.id) == DEVICE:
+                # calling a value that IS a device-dispatching callable
+                return DEVICE
+        if isinstance(f, ast.Attribute):
+            if f.attr in _HOST_METHODS:
+                return HOST
+            if (
+                isinstance(f.value, ast.Name)
+                and f.value.id == "self"
+                and f.attr in self.device_names
+            ):
+                return DEVICE
+            if f.attr in _PRESERVING_METHODS:
+                return self.origin_of(f.value)
+        if (
+            isinstance(f, ast.Subscript)
+            and isinstance(f.value, ast.Attribute)
+            and isinstance(f.value.value, ast.Name)
+            and f.value.value.id == "self"
+            and self.jit_index is not None
+            and f.value.attr in self.jit_index.cache_attrs
+        ):
+            # self._fn_cache[key](...) — a call through a jit cache
+            return DEVICE
+        if isinstance(f, ast.Call) and self.jit_index is not None:
+            if self.jit_index.site_for_callsite(call) is not None:
+                return DEVICE
+        return UNKNOWN
+
+
+def device_attrs_of_class(mod: ModuleInfo, cls: str) -> set[str]:
+    """``self.<attr>`` names that are device trees: every observed
+    assignment to the attr (outside nested defs) has device origin.
+    Mixed or host-assigned attrs are excluded."""
+    cls_node = None
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            cls_node = node
+            break
+    if cls_node is None:
+        return set()
+    jit_idx = mod.jit_index()
+    device_names = set(jit_idx.direct) | set(jit_idx.getters)
+    verdict: dict[str, bool] = {}
+    for meth in cls_node.body:
+        if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tracker = OriginTracker(
+            meth, device_names=device_names, jit_index=jit_idx
+        )
+        for stmt in tracker._own_statements():
+            if not isinstance(stmt, ast.Assign):
+                continue
+            origin = tracker.origin_of(stmt.value)
+            for t in stmt.targets:
+                targets = (
+                    t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                )
+                for el in targets:
+                    if (
+                        isinstance(el, ast.Attribute)
+                        and isinstance(el.value, ast.Name)
+                        and el.value.id == "self"
+                    ):
+                        ok = origin == DEVICE
+                        verdict[el.attr] = verdict.get(el.attr, True) and ok
+    return {a for a, ok in verdict.items() if ok}
+
+
+# ---------------------------------------------------------------------------
+# graph construction entry points
+# ---------------------------------------------------------------------------
+
+
+def build_package_graph(package_root: Path) -> PackageGraph:
+    sources: list[tuple[str, str, ast.Module]] = []
+    repo_root = package_root.parent
+    for path in sorted(package_root.rglob("*.py")):
+        if any(part.startswith(".") for part in path.parts):
+            continue
+        try:
+            text = path.read_text(encoding="utf-8", errors="replace")
+            tree = ast.parse(text)
+        except SyntaxError:
+            continue
+        try:
+            rel = path.resolve().relative_to(repo_root.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        sources.append((rel, text, tree))
+    return PackageGraph.build(sources)
+
+
+def single_file_graph(relpath: str, text: str, tree: ast.Module) -> PackageGraph:
+    return PackageGraph.build([(relpath, text, tree)])

@@ -10,9 +10,13 @@ from __future__ import annotations
 def all_checkers() -> list:
     from areal_tpu.analysis.rules.asy import AsyncSafetyChecker
     from areal_tpu.analysis.rules.cfg import ConfigDriftChecker
+    from areal_tpu.analysis.rules.don import DonationChecker
     from areal_tpu.analysis.rules.exc import SilentExceptionChecker
     from areal_tpu.analysis.rules.jaxpurity import JaxPurityChecker
     from areal_tpu.analysis.rules.obs import MetricCatalogChecker
+    from areal_tpu.analysis.rules.prf import HotPathSyncChecker
+    from areal_tpu.analysis.rules.rcp import RecompileRiskChecker
+    from areal_tpu.analysis.rules.shd import ShardingSpecChecker
     from areal_tpu.analysis.rules.sig import SignalSafetyChecker
     from areal_tpu.analysis.rules.thr import SharedStateChecker
 
@@ -24,4 +28,8 @@ def all_checkers() -> list:
         MetricCatalogChecker(),
         SilentExceptionChecker(),
         SignalSafetyChecker(),
+        HotPathSyncChecker(),
+        DonationChecker(),
+        ShardingSpecChecker(),
+        RecompileRiskChecker(),
     ]

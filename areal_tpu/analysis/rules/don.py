@@ -1,0 +1,295 @@
+"""DON — buffer donation on jit'd train/optimizer steps.
+
+A jit'd step that takes ``params``/``opt_state`` and returns their
+updated versions holds BOTH generations live unless the inputs are
+donated: for a model whose optimizer state is 2x params, the un-donated
+step transiently doubles the largest tensors in HBM — the difference
+between fitting a batch and OOM (the PR 9 HBM ledger's ``headroom``
+gauge is the runtime view of the same budget). Donation is also a
+correctness contract: a donated buffer is dead the moment the call
+returns, so reading the old binding afterwards returns garbage on real
+backends (and silently works on CPU, which is why it must be linted).
+
+  DON001  jit'd step function takes a state-like argument (params /
+          opt_state / grads / cache / *_state), rebinds it in the body
+          and returns the update, but the argument is not in
+          donate_argnums/donate_argnames
+  DON002  use-after-donation: a name or ``self.<attr>`` passed in a
+          donated position is read again after the call without being
+          rebound
+
+Call sites are resolved through the repo's two dispatch idioms (see
+analysis/dataflow.py JitIndex): direct bindings ``g = jax.jit(f, ...)``
+and jit-getter methods (``self._get_step()(...)``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from areal_tpu.analysis.core import (
+    Finding,
+    ProjectContext,
+    SourceFile,
+    dotted_name,
+    make_key,
+)
+from areal_tpu.analysis.dataflow import JitIndex, ModuleInfo
+
+_STATE_PARAM_RE = re.compile(
+    r"^(params|opt_state|state|cache|grads?|mu|nu|opt|.*_state)$"
+)
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _own_nodes(fn: ast.AST):
+    """Nodes of ``fn``'s own body, stopping at nested defs/lambdas — a
+    scan body that rebinds its carry must not make the OUTER function
+    look like it returns the update."""
+    body = [fn.body] if isinstance(fn, ast.Lambda) else list(fn.body)
+    stack: list[ast.AST] = body
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _returns_updated(fn: ast.AST, param: str) -> bool:
+    """True when ``param`` is rebound in the body and flows into a
+    return value — the donate-or-double shape."""
+    rebound = False
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                targets = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                if any(
+                    isinstance(el, ast.Name) and el.id == param
+                    for el in targets
+                ):
+                    rebound = True
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Name) and node.target.id == param:
+                rebound = True
+    if not rebound:
+        return False
+    if isinstance(fn, ast.Lambda):
+        return param in _names_in(fn.body)
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if param in _names_in(node.value):
+                return True
+    return False
+
+
+def _render_arg(node: ast.expr) -> str | None:
+    """A stable token for trackable donated-argument expressions: bare
+    names and ``self.<attr>`` chains."""
+    if isinstance(node, ast.Name):
+        return node.id
+    d = dotted_name(node)
+    if d is not None and d.startswith("self."):
+        return d
+    return None
+
+
+class DonationChecker:
+    FAMILY = "DON"
+    RULES = {
+        "DON001": "jit'd step missing donation of a state argument",
+        "DON002": "use of a buffer after donating it to a jit'd call",
+    }
+
+    def check(self, sf: SourceFile, ctx: ProjectContext) -> Iterator[Finding]:
+        graph = ctx.graph_for(sf)
+        mod = graph.modules.get(sf.relpath)
+        if mod is None:
+            return
+        jit_idx = mod.jit_index()
+
+        # -- DON001: missing donation at the jit construction -------------
+        for site in jit_idx.sites:
+            if site.target is None or not site.params:
+                continue
+            for idx, p in enumerate(site.params):
+                if not _STATE_PARAM_RE.match(p):
+                    continue
+                if site.donates(idx, p):
+                    continue
+                if site.is_static(idx, p):
+                    continue
+                if not _returns_updated(site.target, p):
+                    continue
+                yield Finding(
+                    rule="DON001",
+                    path=sf.relpath,
+                    line=site.call.lineno,
+                    message=(
+                        f"jit'd step rebinds and returns `{p}` but does not "
+                        f"donate it (add donate_argnums={idx} or "
+                        f"donate_argnames=('{p}',)): both generations stay "
+                        "live in HBM across the update"
+                    ),
+                    key=make_key(
+                        "DON001",
+                        sf.relpath,
+                        sf.scope_of(site.call),
+                        p,
+                    ),
+                )
+
+        # -- DON002: use-after-donation at call sites ----------------------
+        yield from self._check_use_after_donation(sf, mod, jit_idx)
+
+    def _check_use_after_donation(
+        self, sf: SourceFile, mod: ModuleInfo, jit_idx: JitIndex
+    ) -> Iterator[Finding]:
+        for fi in mod.funcs.values():
+            fn = fi.node
+            if isinstance(fn, ast.Lambda):
+                continue
+            # statements of this function only (not nested defs)
+            stmts: list[ast.stmt] = []
+
+            def collect(body: list[ast.stmt]) -> None:
+                for s in body:
+                    if isinstance(
+                        s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                    ):
+                        continue
+                    stmts.append(s)
+                    for attr in ("body", "orelse", "finalbody"):
+                        collect(getattr(s, attr, []))
+                    for h in getattr(s, "handlers", []):
+                        collect(h.body)
+
+            collect(fn.body)
+            stmts.sort(key=lambda s: s.lineno)
+
+            # anchor every call at its INNERMOST enclosing statement: a
+            # multi-line donating call inside a `with` block must not be
+            # re-walked from the `with` and have its own continuation
+            # lines read as uses-after-donation
+            def innermost_stmt(node: ast.AST) -> ast.stmt | None:
+                cur = mod.parents.get(id(node))
+                while cur is not None:
+                    if isinstance(cur, ast.stmt) and cur in stmts:
+                        return cur
+                    cur = mod.parents.get(id(cur))
+                return None
+
+            def branch_chain(node: ast.AST) -> dict[int, str]:
+                """id(If) -> 'body'|'orelse' for every If ancestor."""
+                out: dict[int, str] = {}
+                prev, cur = node, mod.parents.get(id(node))
+                while cur is not None:
+                    if isinstance(cur, ast.If):
+                        out[id(cur)] = (
+                            "body" if prev in cur.body else "orelse"
+                        )
+                    prev, cur = cur, mod.parents.get(id(cur))
+                return out
+
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                site = jit_idx.site_for_callsite(call)
+                if site is None:
+                    continue
+                anchor = innermost_stmt(call)
+                if anchor is None:
+                    continue
+                donated: list[tuple[str, str]] = []  # (token, param name)
+                for idx, arg in enumerate(call.args):
+                    pname = (
+                        site.params[idx]
+                        if idx < len(site.params)
+                        else None
+                    )
+                    if not site.donates(idx, pname):
+                        continue
+                    token = _render_arg(arg)
+                    if token is not None:
+                        donated.append((token, pname or f"arg{idx}"))
+                if donated:
+                    # the statement containing the call may rebind the
+                    # donated token itself (the canonical
+                    # `x, y = step(x, y, ...)` shape)
+                    rebound_here = self._stores_in(anchor)
+                    anchor_branches = branch_chain(anchor)
+                    for token, pname in donated:
+                        if token in rebound_here:
+                            continue
+                        use = self._first_use_after(
+                            stmts, anchor, token, anchor_branches, branch_chain
+                        )
+                        if use is not None:
+                            yield Finding(
+                                rule="DON002",
+                                path=sf.relpath,
+                                line=use,
+                                message=(
+                                    f"`{token}` was donated to the jit'd "
+                                    f"call at line {call.lineno} "
+                                    f"(parameter `{pname}`) and read again "
+                                    "here without rebinding — the buffer "
+                                    "is dead after donation"
+                                ),
+                                key=make_key(
+                                    "DON002",
+                                    sf.relpath,
+                                    fi.qualname,
+                                    token,
+                                ),
+                            )
+
+    @staticmethod
+    def _stores_in(stmt: ast.stmt) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(node, "ctx", None), ast.Store
+            ):
+                tok = _render_arg(node)
+                if tok is not None:
+                    out.add(tok)
+        return out
+
+    def _first_use_after(
+        self,
+        stmts: list[ast.stmt],
+        anchor: ast.stmt,
+        token: str,
+        anchor_branches: dict[int, str],
+        branch_chain,
+    ) -> int | None:
+        """Line of the first Load of ``token`` in statements after the
+        donating statement's full extent, stopping at the first rebind.
+        Statements in the OPPOSITE branch of any If the anchor sits in
+        are skipped — on that path the donation never executed. Loop
+        back-edges are approximated away: a donation inside a loop whose
+        same statement rebinds the token is the supported pattern."""
+        end = getattr(anchor, "end_lineno", anchor.lineno) or anchor.lineno
+        for stmt in stmts:
+            if stmt.lineno <= end:
+                continue
+            sb = branch_chain(stmt)
+            if any(
+                sb.get(if_id) not in (None, which)
+                for if_id, which in anchor_branches.items()
+            ):
+                continue  # mutually-exclusive branch: not a use-after
+            for node in ast.walk(stmt):
+                if not isinstance(node, (ast.Name, ast.Attribute)):
+                    continue
+                if _render_arg(node) != token:
+                    continue
+                if isinstance(getattr(node, "ctx", None), ast.Store):
+                    return None
+                return node.lineno
+        return None

@@ -1,0 +1,168 @@
+"""PRF — host-device synchronization on hot paths.
+
+The paper's throughput claim rests on the device never waiting for the
+host: the decode loop dispatches chunk N+1 before pulling chunk N's
+tokens, and the trainer queues every microbatch before reading a single
+stat. One blocking read in the wrong place re-serializes all of it —
+``float(device_scalar)`` stalls host dispatch until the device drains,
+and inside a per-microbatch or per-token loop that happens every
+iteration. None of this raises; it just shows up as bubble fraction in
+the PR 9 step timeline.
+
+The family is dataflow-gated (analysis/dataflow.py): a site only fires
+when its enclosing function is *hot-path reachable* (call-graph BFS from
+the decode loop / trainer step seeds, jit-traced callables, and
+``# arealint: hot-path`` markers), and value-dependent shapes
+(``float(x)``, ``np.asarray(x)``) additionally require ``x`` to have
+*device* origin. Cold-path syncs and host-array conversions never fire.
+
+  PRF001  explicit sync API on a hot path (`jax.device_get`,
+          `block_until_ready`) outside a loop — one blocking round-trip
+          per call; batch it at a chunk/step boundary or suppress with
+          the boundary rationale
+  PRF002  device->host coercion on a hot path (`float()`/`int()`/
+          `bool()`/`np.asarray()`/`.item()` on a device value) outside
+          a loop
+  PRF003  any of the above lexically inside a `for`/`while` loop of a
+          hot function — one blocking round-trip *per iteration*; hoist
+          the read out of the loop and fetch once at the boundary
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.analysis.core import (
+    Finding,
+    ProjectContext,
+    SourceFile,
+    dotted_name,
+    make_key,
+)
+from areal_tpu.analysis import dataflow
+from areal_tpu.analysis.dataflow import DEVICE, OriginTracker
+
+_SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
+_COERCIONS = {"float", "int", "bool"}
+_NP_TRANSFERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+class HotPathSyncChecker:
+    FAMILY = "PRF"
+    RULES = {
+        "PRF001": "blocking sync API on a hot path",
+        "PRF002": "device->host coercion on a hot path",
+        "PRF003": "per-iteration device sync inside a hot-path loop",
+    }
+
+    def check(self, sf: SourceFile, ctx: ProjectContext) -> Iterator[Finding]:
+        graph = ctx.graph_for(sf)
+        hot = graph.hot_funcs_in(sf.relpath)
+        if not hot:
+            return
+        mod = graph.modules[sf.relpath]
+        jit_idx = mod.jit_index()
+        device_names = set(jit_idx.direct) | set(jit_idx.getters)
+        attr_cache: dict[str, set[str]] = {}
+
+        for fid, (fi, seed) in hot.items():
+            fn = fi.node
+            if isinstance(fn, ast.Lambda):
+                continue
+            if fi.cls is not None and fi.cls not in attr_cache:
+                attr_cache[fi.cls] = dataflow.device_attrs_of_class(
+                    mod, fi.cls
+                )
+            tracker = OriginTracker(
+                fn,
+                device_names=device_names,
+                device_attrs=attr_cache.get(fi.cls or "", set()),
+                jit_index=jit_idx,
+            )
+            yield from self._scan(sf, fi, seed, tracker)
+
+    # -- per-function scan -------------------------------------------------
+    def _scan(
+        self, sf: SourceFile, fi, seed: str, tracker: OriginTracker
+    ) -> Iterator[Finding]:
+        fn = fi.node
+        where = (
+            "" if seed == fi.qualname else f", reachable from hot `{seed}`"
+        )
+
+        def emit(node: ast.AST, in_loop: bool, what: str, token: str) -> Finding:
+            if in_loop:
+                rule = "PRF003"
+                msg = (
+                    f"{what} inside a loop of hot-path function "
+                    f"`{fi.qualname}`{where}: one blocking device round-trip "
+                    "per iteration — hoist the read and batch the transfer "
+                    "at the chunk/step boundary"
+                )
+            else:
+                rule = "PRF001" if what.startswith("sync API") else "PRF002"
+                msg = (
+                    f"{what} in hot-path function `{fi.qualname}`{where}: "
+                    "blocks host dispatch until the device drains"
+                )
+            return Finding(
+                rule=rule,
+                path=sf.relpath,
+                line=node.lineno,
+                message=msg,
+                key=make_key(rule, sf.relpath, sf.scope_of(node), token),
+            )
+
+        # walk own nodes tracking loop depth; nested defs are separate
+        # graph nodes (hot on their own merit), so stop at them
+        def walk(node: ast.AST, in_loop: bool) -> Iterator[tuple[ast.AST, bool]]:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    continue
+                child_in_loop = in_loop or isinstance(
+                    node, (ast.For, ast.AsyncFor, ast.While)
+                ) and child in (
+                    getattr(node, "body", []) + getattr(node, "orelse", [])
+                )
+                yield child, child_in_loop
+                yield from walk(child, child_in_loop)
+
+        for node, in_loop in walk(fn, False):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if d in _SYNC_CALLS:
+                yield emit(node, in_loop, f"sync API `{d}`", d)
+                continue
+            # x.block_until_ready()
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"
+            ):
+                yield emit(
+                    node, in_loop, "sync API `block_until_ready`",
+                    "block_until_ready",
+                )
+                continue
+            # .item() on a device value
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item"
+                and tracker.origin_of(node.func.value) == DEVICE
+            ):
+                yield emit(
+                    node, in_loop, "device->host read `.item()`", "item"
+                )
+                continue
+            # float()/int()/bool()/np.asarray() on a device value
+            if d in _COERCIONS or d in _NP_TRANSFERS:
+                if node.args and tracker.origin_of(node.args[0]) == DEVICE:
+                    yield emit(
+                        node,
+                        in_loop,
+                        f"device->host coercion `{d}(...)` of a device value",
+                        d,
+                    )

@@ -1,0 +1,275 @@
+"""RCP — recompile-risk call patterns.
+
+XLA compiles one program per (function identity, static args, pytree
+structure, shapes) key. Each of those key components has a classic
+footgun that turns steady-state serving into a recompile storm — exactly
+what the PR 9 ``areal_xla_compiles_total`` counter exists to catch at
+runtime; this family catches the patterns statically:
+
+  RCP001  un-cached jit construction on a repeating path: ``jax.jit(...)``
+          evaluated inside a loop, or wrapping a lambda/local closure
+          inside a hot-path function without a cache guard — every
+          evaluation creates a fresh function identity, so the compile
+          cache never hits
+  RCP002  static-argument drift: a call into a jit with
+          static_argnums/static_argnames passing a loop-varying value in
+          a static position — one full recompile per distinct value
+  RCP003  unstable pytree structure: a dict built with condition-
+          dependent keys passed to a jit'd call — every key-set change
+          is a new pytree structure and a new compile
+
+The accepted shape for per-variant compiles is the repo's fn-cache
+idiom: ``if key not in self._fn_cache: self._fn_cache[key] = jax.jit(...)``
+with the variant dimensions in ``key`` — RCP001 recognizes both the
+subscript-cache store and the ``not in`` guard and stays silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.analysis.core import (
+    Finding,
+    ProjectContext,
+    SourceFile,
+    dotted_name,
+    make_key,
+)
+from areal_tpu.analysis.dataflow import JitIndex
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+class RecompileRiskChecker:
+    FAMILY = "RCP"
+    RULES = {
+        "RCP001": "un-cached jit construction on a repeating path",
+        "RCP002": "loop-varying value in a jit static argument position",
+        "RCP003": "condition-dependent pytree structure fed to a jit'd call",
+    }
+
+    def check(self, sf: SourceFile, ctx: ProjectContext) -> Iterator[Finding]:
+        graph = ctx.graph_for(sf)
+        mod = graph.modules.get(sf.relpath)
+        if mod is None:
+            return
+        hot = graph.hot_funcs_in(sf.relpath)
+        jit_idx = mod.jit_index()
+
+        yield from self._check_uncached_jit(sf, mod, hot)
+        yield from self._check_static_drift(sf, mod, jit_idx)
+        yield from self._check_pytree_drift(sf, mod, jit_idx)
+
+    # -- RCP001 ------------------------------------------------------------
+    def _check_uncached_jit(self, sf: SourceFile, mod, hot) -> Iterator[Finding]:
+        for call in ast.walk(sf.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if dotted_name(call.func) not in _JIT_NAMES:
+                continue
+            in_loop = False
+            cached = False
+            cur = sf.parents.get(id(call))
+            node: ast.AST = call
+            while cur is not None:
+                if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                    in_loop = True
+                if isinstance(cur, ast.Assign) and any(
+                    isinstance(t, ast.Subscript) for t in cur.targets
+                ):
+                    cached = True  # stored into a keyed cache
+                if isinstance(cur, ast.If) and self._is_cache_guard(cur.test):
+                    cached = True
+                if isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    break
+                node, cur = cur, sf.parents.get(id(cur))
+            encl = mod.enclosing_func(call)
+            encl_hot = encl is not None and id(encl.node) in hot
+            wraps_closure = bool(call.args) and isinstance(
+                call.args[0], ast.Lambda
+            )
+            if cached:
+                continue
+            if in_loop or (encl_hot and wraps_closure):
+                where = (
+                    "inside a loop"
+                    if in_loop
+                    else f"in hot-path function `{encl.qualname}`"
+                )
+                yield Finding(
+                    rule="RCP001",
+                    path=sf.relpath,
+                    line=call.lineno,
+                    message=(
+                        f"jax.jit evaluated {where} without a cache guard: "
+                        "each evaluation is a fresh function identity, so "
+                        "XLA recompiles every call — hoist it or key it in "
+                        "a fn-cache (`if key not in cache: cache[key] = "
+                        "jax.jit(...)`)"
+                    ),
+                    key=make_key(
+                        "RCP001",
+                        sf.relpath,
+                        sf.scope_of(call),
+                        "jit-in-loop" if in_loop else "jit-closure",
+                    ),
+                )
+
+    @staticmethod
+    def _is_cache_guard(test: ast.expr) -> bool:
+        """`key not in <cache>` (possibly inside a BoolOp)."""
+        for node in ast.walk(test):
+            if isinstance(node, ast.Compare) and any(
+                isinstance(op, ast.NotIn) for op in node.ops
+            ):
+                return True
+        return False
+
+    # -- RCP002 ------------------------------------------------------------
+    def _check_static_drift(
+        self, sf: SourceFile, mod, jit_idx: JitIndex
+    ) -> Iterator[Finding]:
+        for fi in mod.funcs.values():
+            fn = fi.node
+            if isinstance(fn, ast.Lambda):
+                continue
+            loop_vars = self._loop_vars(fn)
+            if not loop_vars:
+                continue
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                site = jit_idx.site_for_callsite(call)
+                if site is None or not (site.static_pos or site.static_names):
+                    continue
+                if not self._inside_loop(sf, call, fn):
+                    continue
+                for idx, arg in enumerate(call.args):
+                    pname = (
+                        site.params[idx] if idx < len(site.params) else None
+                    )
+                    if not site.is_static(idx, pname):
+                        continue
+                    names = {
+                        n.id
+                        for n in ast.walk(arg)
+                        if isinstance(n, ast.Name)
+                    }
+                    hit = names & loop_vars
+                    if hit:
+                        var = sorted(hit)[0]
+                        yield Finding(
+                            rule="RCP002",
+                            path=sf.relpath,
+                            line=call.lineno,
+                            message=(
+                                f"static argument "
+                                f"`{pname or f'arg{idx}'}` receives loop-"
+                                f"varying `{var}`: one full XLA recompile "
+                                "per distinct value — bucket it or make "
+                                "the argument traced"
+                            ),
+                            key=make_key(
+                                "RCP002",
+                                sf.relpath,
+                                sf.scope_of(call),
+                                f"{pname or idx}:{var}",
+                            ),
+                        )
+
+    @staticmethod
+    def _loop_vars(fn: ast.AST) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+        return out
+
+    @staticmethod
+    def _inside_loop(sf: SourceFile, node: ast.AST, stop: ast.AST) -> bool:
+        cur = sf.parents.get(id(node))
+        while cur is not None and cur is not stop:
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                return True
+            cur = sf.parents.get(id(cur))
+        return False
+
+    # -- RCP003 ------------------------------------------------------------
+    def _check_pytree_drift(
+        self, sf: SourceFile, mod, jit_idx: JitIndex
+    ) -> Iterator[Finding]:
+        for fi in mod.funcs.values():
+            fn = fi.node
+            if isinstance(fn, ast.Lambda):
+                continue
+            # dicts whose key set depends on a condition: d[k] = v inside
+            # an `if` after `d = {...}` / `d = dict(...)`
+            dict_names: set[str] = set()
+            for stmt in ast.walk(fn):
+                if isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.value, (ast.Dict, ast.DictComp)
+                ):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            dict_names.add(t.id)
+                elif (
+                    isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Call)
+                    and dotted_name(stmt.value.func) == "dict"
+                ):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            dict_names.add(t.id)
+            if not dict_names:
+                continue
+            conditional: dict[str, int] = {}  # name -> line of the branch add
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.If):
+                    continue
+                for stmt in ast.walk(node):
+                    if (
+                        isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Subscript)
+                        and isinstance(stmt.targets[0].value, ast.Name)
+                        and stmt.targets[0].value.id in dict_names
+                    ):
+                        conditional.setdefault(
+                            stmt.targets[0].value.id, stmt.lineno
+                        )
+            if not conditional:
+                continue
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                if jit_idx.site_for_callsite(call) is None:
+                    continue
+                for arg in call.args:
+                    if (
+                        isinstance(arg, ast.Name)
+                        and arg.id in conditional
+                    ):
+                        yield Finding(
+                            rule="RCP003",
+                            path=sf.relpath,
+                            line=call.lineno,
+                            message=(
+                                f"dict `{arg.id}` gains keys under a "
+                                f"condition (line {conditional[arg.id]}) "
+                                "and feeds a jit'd call: every key-set "
+                                "change is a new pytree structure and a "
+                                "full recompile — make the key set static "
+                                "(always-present keys, masked values)"
+                            ),
+                            key=make_key(
+                                "RCP003",
+                                sf.relpath,
+                                sf.scope_of(call),
+                                arg.id,
+                            ),
+                        )

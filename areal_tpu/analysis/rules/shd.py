@@ -1,0 +1,278 @@
+"""SHD — PartitionSpec / shard_map consistency against the declared mesh.
+
+GSPMD sharding is stringly-typed: a ``PartitionSpec("modle")`` with a
+typo'd axis raises nothing at construction — it fails at `device_put` /
+trace time on a real mesh, or (worse) silently falls back to replication
+under some jax versions' permissive paths. The mesh axis vocabulary is
+declared exactly once (``parallel/mesh.py MESH_AXES``); every literal
+axis name in the package must come from it. ``ProjectContext.mesh_axes``
+carries the parsed tuple; a file can extend it locally by defining its
+own ``MESH_AXES = (...)`` (the jax_compat shim and tests do).
+
+  SHD001  PartitionSpec axis name not declared on the mesh
+  SHD002  shard_map in_specs/out_specs arity differs from the wrapped
+          function's signature (specs zip positionally with args; a
+          mismatch is a TypeError at trace time at best, a silently
+          mis-sharded closure capture at worst)
+  SHD003  the same mesh axis used twice in one PartitionSpec — an array
+          dimension cannot shard over an axis that another dimension
+          already consumed
+
+Only call sites whose callee name binds to ``jax.sharding.PartitionSpec``
+(via import aliasing, e.g. ``PartitionSpec as P``) are checked, so an
+unrelated local ``P(...)`` helper never false-positives. Non-literal
+spec entries (names, unpacking) are skipped — unknown stays silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.analysis.core import (
+    Finding,
+    ProjectContext,
+    SourceFile,
+    dotted_name,
+    make_key,
+)
+
+
+def _spec_aliases(tree: ast.Module) -> set[str]:
+    """Local names bound to jax.sharding.PartitionSpec."""
+    aliases: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+            node.module.startswith("jax")
+        ):
+            for a in node.names:
+                if a.name == "PartitionSpec":
+                    aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.Assign) and isinstance(
+            node.value, (ast.Name, ast.Attribute)
+        ):
+            d = dotted_name(node.value)
+            if d in ("jax.sharding.PartitionSpec", "PartitionSpec"):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.add(t.id)
+    return aliases
+
+
+def _local_mesh_axes(tree: ast.Module) -> frozenset[str] | None:
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "MESH_AXES"
+            for t in node.targets
+        ):
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                return frozenset(
+                    e.value
+                    for e in node.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                )
+    return None
+
+
+def _declared_mesh_axes(tree: ast.Module) -> frozenset[str]:
+    """Axis names a file declares itself by constructing a Mesh:
+    ``Mesh(devs, ("stage",))`` / ``axis_names=(...)`` — tests and smoke
+    scripts build ad-hoc meshes whose axes are legitimate in that file."""
+    mesh_names = {"Mesh", "make_mesh"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+            node.module.startswith("jax")
+        ):
+            for a in node.names:
+                if a.name == "Mesh" and a.asname:
+                    mesh_names.add(a.asname)
+    axes: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted_name(node.func)
+        if d is None or d.split(".")[-1] not in mesh_names:
+            continue
+        candidates: list[ast.expr] = []
+        if len(node.args) >= 2:
+            candidates.append(node.args[1])
+        for kw in node.keywords:
+            if kw.arg == "axis_names":
+                candidates.append(kw.value)
+        for cand in candidates:
+            if isinstance(cand, (ast.Tuple, ast.List)):
+                for e in cand.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        axes.add(e.value)
+            elif isinstance(cand, ast.Constant) and isinstance(cand.value, str):
+                axes.add(cand.value)
+    return frozenset(axes)
+
+
+def _literal_axes(entry: ast.expr) -> list[str] | None:
+    """Axis strings of one spec entry: "axis", ("a", "b"), or None.
+    Returns None when the entry is not fully literal (skip)."""
+    if isinstance(entry, ast.Constant):
+        if entry.value is None:
+            return []
+        if isinstance(entry.value, str):
+            return [entry.value]
+        return None
+    if isinstance(entry, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for e in entry.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            elif isinstance(e, ast.Constant) and e.value is None:
+                continue
+            else:
+                return None
+        return out
+    return None
+
+
+def _positional_arity(fn: ast.AST) -> int | None:
+    """Positional parameter count (None when *args makes it open)."""
+    args = getattr(fn, "args", None)
+    if args is None:
+        return None
+    if args.vararg is not None:
+        return None
+    n = len(args.posonlyargs) + len(args.args)
+    if n and args.args and args.args[0].arg in ("self", "cls"):
+        n -= 1
+    return n
+
+
+class ShardingSpecChecker:
+    FAMILY = "SHD"
+    RULES = {
+        "SHD001": "PartitionSpec axis not declared on the mesh",
+        "SHD002": "shard_map spec arity differs from function signature",
+        "SHD003": "mesh axis used twice in one PartitionSpec",
+    }
+
+    def check(self, sf: SourceFile, ctx: ProjectContext) -> Iterator[Finding]:
+        axes = _local_mesh_axes(sf.tree)
+        if axes is None:
+            axes = ctx.mesh_axes
+        axes = frozenset(axes | _declared_mesh_axes(sf.tree))
+        aliases = _spec_aliases(sf.tree)
+        if aliases and axes:
+            yield from self._check_specs(sf, aliases, axes)
+        yield from self._check_shard_map(sf)
+
+    def _check_specs(
+        self, sf: SourceFile, aliases: set[str], axes: frozenset[str]
+    ) -> Iterator[Finding]:
+        for call in ast.walk(sf.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if not (
+                isinstance(call.func, ast.Name) and call.func.id in aliases
+            ):
+                continue
+            seen: dict[str, int] = {}
+            for entry in call.args:
+                lit = _literal_axes(entry)
+                if lit is None:
+                    continue
+                for axis in lit:
+                    if axis not in axes:
+                        yield Finding(
+                            rule="SHD001",
+                            path=sf.relpath,
+                            line=call.lineno,
+                            message=(
+                                f"PartitionSpec axis '{axis}' is not a "
+                                f"declared mesh axis "
+                                f"({', '.join(sorted(axes))}); typo'd axes "
+                                "fail only at trace time on a real mesh"
+                            ),
+                            key=make_key(
+                                "SHD001",
+                                sf.relpath,
+                                sf.scope_of(call),
+                                axis,
+                            ),
+                        )
+                    seen[axis] = seen.get(axis, 0) + 1
+            for axis, count in seen.items():
+                if count > 1 and axis in axes:
+                    yield Finding(
+                        rule="SHD003",
+                        path=sf.relpath,
+                        line=call.lineno,
+                        message=(
+                            f"mesh axis '{axis}' appears {count} times in "
+                            "one PartitionSpec: a dimension cannot shard "
+                            "over an axis another dimension already consumed"
+                        ),
+                        key=make_key(
+                            "SHD003",
+                            sf.relpath,
+                            sf.scope_of(call),
+                            f"dup:{axis}",
+                        ),
+                    )
+
+    def _check_shard_map(self, sf: SourceFile) -> Iterator[Finding]:
+        # local defs by name for callee resolution — vetoed for any name
+        # that is ALSO the target of an assignment somewhere in the file
+        # (`fn = gpipe(...)` must not resolve to an unrelated `def fn`)
+        local_defs: dict[str, ast.AST] = {}
+        assigned: set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs[node.name] = node
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    els = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                    for el in els:
+                        if isinstance(el, ast.Name):
+                            assigned.add(el.id)
+        for name in assigned:
+            local_defs.pop(name, None)
+        for call in ast.walk(sf.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            d = dotted_name(call.func)
+            if d is None or d.split(".")[-1] != "shard_map":
+                continue
+            if not call.args:
+                continue
+            target = call.args[0]
+            fn: ast.AST | None = None
+            if isinstance(target, ast.Lambda):
+                fn = target
+            elif isinstance(target, ast.Name):
+                fn = local_defs.get(target.id)
+            if fn is None:
+                continue
+            arity = _positional_arity(fn)
+            if arity is None:
+                continue
+            kw = {k.arg: k.value for k in call.keywords if k.arg}
+            in_specs = kw.get("in_specs")
+            if len(call.args) >= 3 and in_specs is None:
+                in_specs = call.args[2]  # shard_map(f, mesh, in_specs, ...)
+            if isinstance(in_specs, (ast.Tuple, ast.List)) and (
+                len(in_specs.elts) != arity
+            ):
+                yield Finding(
+                    rule="SHD002",
+                    path=sf.relpath,
+                    line=call.lineno,
+                    message=(
+                        f"shard_map in_specs has {len(in_specs.elts)} "
+                        f"entries but `{getattr(fn, 'name', '<lambda>')}` "
+                        f"takes {arity} positional argument(s); specs zip "
+                        "positionally with arguments"
+                    ),
+                    key=make_key(
+                        "SHD002",
+                        sf.relpath,
+                        sf.scope_of(call),
+                        getattr(fn, "name", "<lambda>"),
+                    ),
+                )

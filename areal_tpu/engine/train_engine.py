@@ -100,6 +100,17 @@ _GRID_KEYS = (
 )
 
 
+def _fold_weighted_stats(
+    agg: dict[str, float], mb_host: list[dict], weights: list[float], total_w: float
+) -> None:
+    """Fold per-microbatch stat dicts (host values from the one boundary
+    pull) into the step aggregate, weighted by each microbatch's loss
+    weight — the reference's loss-weight all-reduce as a host sum."""
+    for s, w in zip(mb_host, weights):
+        for k, v in s.items():
+            agg[k] = agg.get(k, 0.0) + float(v) * (w / total_w)
+
+
 def make_lr_schedule(cfg: OptimizerConfig, total_steps: int):
     warmup = max(1, int(cfg.warmup_steps_proportion * total_steps))
     peak, floor = cfg.lr, cfg.lr * cfg.min_lr_ratio
@@ -146,6 +157,9 @@ class JaxTrainEngine(TrainEngine):
         self._model_config = model_config
         self._version = 0
         self._version_lock = threading.Lock()
+        # host mirror of the optimizer step count (None = re-read from
+        # opt_state on next use; see _opt_step_count)
+        self._step_count: int | None = None
         self.mesh = None
         self.params = None
         self.opt_state = None
@@ -314,6 +328,7 @@ class JaxTrainEngine(TrainEngine):
             self.opt_state = jax.jit(
                 self._tx.init, out_shardings=self.opt_state_shardings
             )(self.params)
+        self._step_count = None  # fresh opt_state: re-sync the host mirror
 
     def _add_lora_adapters(self, seed: int = 0) -> None:
         """Insert freshly-initialized adapter leaves into an adapter-less
@@ -390,6 +405,7 @@ class JaxTrainEngine(TrainEngine):
         self.wait_for_save()
         self.params = None
         self.opt_state = None
+        self._step_count = None
         self._fn_cache.clear()
 
     # -- offload / onload -------------------------------------------------
@@ -541,6 +557,7 @@ class JaxTrainEngine(TrainEngine):
                 lambda vp, px, c, pid: vis.vision_forward_batch(vp, vcfg, px, c, pid)
             )
         with set_mesh(self.mesh):
+            # arealint: disable-next=PRF002 designed batch-boundary sync: the frozen ViT runs ONCE per batch (memoized across forward/train) and its embeds are scattered host-side into the packed grids
             out = np.asarray(
                 self._fn_cache[key](
                     self.params["vision"],
@@ -843,7 +860,11 @@ class JaxTrainEngine(TrainEngine):
                 (loss, stats), grads = jax.value_and_grad(lf, has_aux=True)(params)
                 return grads, loss, stats
 
-            self._fn_cache[key] = jax.jit(compute)
+            # the microbatch grid is consumed by this one call (every
+            # iteration device_puts a fresh one), so donate it — its pages
+            # free as the forward consumes them instead of surviving the
+            # whole fwd/bwd
+            self._fn_cache[key] = jax.jit(compute, donate_argnums=(1,))
         return self._fn_cache[key]
 
     def _get_forward_fn(self, shape: tuple, post_hook: Callable | None = None):
@@ -862,8 +883,12 @@ class JaxTrainEngine(TrainEngine):
     def _get_accum_fn(self):
         key = ("accum",)
         if key not in self._fn_cache:
+            # BOTH operands are dead after the add (the caller rebinds the
+            # accumulator and drops the fresh grads), so donating both lets
+            # XLA reuse one of them as the output — the accumulate path
+            # carries two grad trees transiently instead of three
             self._fn_cache[key] = jax.jit(
-                lambda a, b: jax.tree.map(jnp.add, a, b), donate_argnums=(0,)
+                lambda a, b: jax.tree.map(jnp.add, a, b), donate_argnums=(0, 1)
             )
         return self._fn_cache[key]
 
@@ -890,7 +915,9 @@ class JaxTrainEngine(TrainEngine):
                 params = optax.apply_updates(params, updates)
                 return params, opt_state, gnorm, loss, stats
 
-            self._fn_cache[key] = jax.jit(step, donate_argnums=(0, 1))
+            # params/opt_state are rebound by every caller (DON001 contract)
+            # and the batch is single-use — donate all three
+            self._fn_cache[key] = jax.jit(step, donate_argnums=(0, 1, 2))
         return self._fn_cache[key]
 
     def _get_apply_fn(self):
@@ -903,7 +930,12 @@ class JaxTrainEngine(TrainEngine):
                 params = optax.apply_updates(params, updates)
                 return params, opt_state, gnorm
 
-            self._fn_cache[key] = jax.jit(apply, donate_argnums=(0, 1))
+            # grads are dead after the apply (the accumulate loop rebinds
+            # them next step) — donating them lets XLA write the optax
+            # update tree into the grad buffers instead of allocating a
+            # third params-sized transient (DON burn-down; the HBM ledger's
+            # step_transient component accounts for exactly this)
+            self._fn_cache[key] = jax.jit(apply, donate_argnums=(0, 1, 2))
         return self._fn_cache[key]
 
     # -- tree training ----------------------------------------------------
@@ -1037,12 +1069,16 @@ class JaxTrainEngine(TrainEngine):
                         batch,
                         jnp.float32(weights[0] / total_w),
                     )
-                    gnorm = jax.block_until_ready(gnorm)
-            agg = {k: float(v) for k, v in {**stats, "loss": loss}.items()}
+                    # arealint: disable-next=PRF001 designed step-boundary sync: single batched pull, nothing left to overlap
+                    host = jax.device_get(
+                        {**stats, "loss": loss, "grad_norm": gnorm}
+                    )
+            agg = {k: float(v) for k, v in host.items()}
             agg["n_microbatches"] = 1.0
         else:
             grads = None
             accum = self._get_accum_fn()
+            pending_stats: list[dict] = []  # per-microbatch DEVICE trees
             with set_mesh(self.mesh):
                 for b, w in zip(batches, weights):
                     with engine_phase("host_prep"):
@@ -1054,18 +1090,21 @@ class JaxTrainEngine(TrainEngine):
                             self.params, batch, jnp.float32(w / total_w)
                         )
                         grads = new_grads if grads is None else accum(grads, new_grads)
-                        loss = jax.block_until_ready(loss)
-                    for k, v in {**stats, "loss": loss}.items():
-                        agg[k] = agg.get(k, 0.0) + float(v) * (w / total_w)
+                    # stats stay on device until the step boundary (one
+                    # batched pull below, not one sync per microbatch)
+                    pending_stats.append({**stats, "loss": loss})
                 step_before = self._opt_step_count()
                 with engine_phase("optimizer"):
                     self.params, self.opt_state, gnorm = self._get_apply_fn()(
                         self.params, self.opt_state, grads
                     )
-                    gnorm = jax.block_until_ready(gnorm)
+                    # arealint: disable-next=PRF001 designed step-boundary sync: single batched pull, nothing left to overlap
+                    gnorm_h, mb_host = jax.device_get((gnorm, pending_stats))
+            _fold_weighted_stats(agg, mb_host, weights, total_w)
+            agg["grad_norm"] = float(gnorm_h)
             agg["n_microbatches"] = float(len(batches))
-        agg["grad_norm"] = float(gnorm)
         agg["lr"] = float(self._lr_schedule(step_before))
+        self._count_opt_step()
         agg.update(tstats)
         agg["train_batch_secs"] = time.monotonic() - t0
         return agg
@@ -1107,13 +1146,18 @@ class JaxTrainEngine(TrainEngine):
                     self.params, self.opt_state, gnorm, loss, stats = fn(
                         self.params, self.opt_state, batch, jnp.float32(weights[0] / total_w)
                     )
-                    gnorm = jax.block_until_ready(gnorm)
-            agg = {k: float(v) for k, v in {**stats, "loss": loss}.items()}
-            agg["grad_norm"] = float(gnorm)
+                    # ONE batched transfer fetches every stat and fences the
+                    # step (replaces block_until_ready + one blocking float()
+                    # per stat — PRF burn-down, docs/static_analysis.md)
+                    # arealint: disable-next=PRF001 designed step-boundary sync: single batched pull, nothing left to overlap
+                    host = jax.device_get({**stats, "loss": loss, "grad_norm": gnorm})
+            agg = {k: float(v) for k, v in host.items()}
             agg["lr"] = float(self._lr_schedule(step_before))
             agg["n_microbatches"] = 1.0
             agg["train_batch_secs"] = time.monotonic() - t0
+            self._count_opt_step()
             return agg
+        pending_stats: list[dict] = []  # per-microbatch DEVICE stat trees
         with set_mesh(self.mesh):
             for g, w in zip(grids, weights):
                 with engine_phase("host_prep"):
@@ -1125,19 +1169,25 @@ class JaxTrainEngine(TrainEngine):
                         self.params, batch, jnp.float32(w / total_w)
                     )
                     grads = new_grads if grads is None else accum(grads, new_grads)
-                    loss = jax.block_until_ready(loss)
-                for k, v in {**stats, "loss": loss}.items():
-                    agg[k] = agg.get(k, 0.0) + float(v) * (w / total_w)
+                # stats stay on device: a float()/block here would stall
+                # host dispatch once per microbatch, serializing the queue
+                # XLA could otherwise run ahead on
+                pending_stats.append({**stats, "loss": loss})
             step_before = self._opt_step_count()
             with engine_phase("optimizer"):
                 self.params, self.opt_state, gnorm = self._get_apply_fn()(
                     self.params, self.opt_state, grads
                 )
-                gnorm = jax.block_until_ready(gnorm)
-        agg["grad_norm"] = float(gnorm)
+                # single step-boundary fence + batched pull of every
+                # microbatch's stats (was: one sync per microbatch)
+                # arealint: disable-next=PRF001 designed step-boundary sync: single batched pull, nothing left to overlap
+                gnorm_h, mb_host = jax.device_get((gnorm, pending_stats))
+        _fold_weighted_stats(agg, mb_host, weights, total_w)
+        agg["grad_norm"] = float(gnorm_h)
         agg["lr"] = float(self._lr_schedule(step_before))
         agg["n_microbatches"] = float(len(grids))
         agg["train_batch_secs"] = time.monotonic() - t0
+        self._count_opt_step()
         return agg
 
     # -- RPC-friendly dispatch (single-controller mode) -------------------
@@ -1163,10 +1213,25 @@ class JaxTrainEngine(TrainEngine):
         )
 
     def _opt_step_count(self) -> int:
+        """Host-mirrored optimizer step count. The count leaf lives in
+        ``opt_state`` on device; pulling it every step is a blocking
+        scalar read in the step path (PRF burn-down). The mirror does one
+        device read whenever opt_state was replaced wholesale (init /
+        load) and host-increments per applied step after that."""
+        if self._step_count is None:
+            # arealint: disable-next=PRF002 one-time re-sync after init/load, not a per-step read
+            self._step_count = self._read_opt_step_count()
+        return self._step_count
+
+    def _read_opt_step_count(self) -> int:
         for path, leaf in jax.tree_util.tree_flatten_with_path(self.opt_state)[0]:
             if "count" in jax.tree_util.keystr(path):
                 return int(leaf)
         return 0
+
+    def _count_opt_step(self) -> None:
+        if self._step_count is not None:
+            self._step_count += 1
 
     def eval_batch(
         self,
@@ -1179,6 +1244,7 @@ class JaxTrainEngine(TrainEngine):
             weights = [float(loss_weight_fn(g.data)) for g in grids]
         total_w = sum(weights) or 1.0
         agg: dict[str, float] = {}
+        pending_stats: list[dict] = []  # per-microbatch DEVICE stat trees
         with set_mesh(self.mesh):
             for g, w in zip(grids, weights):
                 with engine_phase("host_prep"):
@@ -1194,9 +1260,12 @@ class JaxTrainEngine(TrainEngine):
                     self._fn_cache[key] = jax.jit(compute)
                 with engine_phase("forward_backward"):
                     loss, stats = self._fn_cache[key](self.params, batch)
-                    loss = jax.block_until_ready(loss)
-                for k, v in {**stats, "loss": loss}.items():
-                    agg[k] = agg.get(k, 0.0) + float(v) * (w / total_w)
+                # stats stay on device; every microbatch is fetched in one
+                # batched pull at the boundary below
+                pending_stats.append({**stats, "loss": loss})
+            # arealint: disable-next=PRF001 designed batch-boundary sync: single batched pull, nothing left to overlap
+            mb_host = jax.device_get(pending_stats)
+        _fold_weighted_stats(agg, mb_host, weights, total_w)
         return agg
 
     def forward_batch(
@@ -1213,6 +1282,7 @@ class JaxTrainEngine(TrainEngine):
         out = np.zeros((B, L), dtype=np.float32)
         with engine_phase("host_prep"):
             grids = self._make_grids(input_)
+        pending: list = []  # per-grid DEVICE outputs, pulled once below
         with set_mesh(self.mesh):
             for g in grids:
                 with engine_phase("host_prep"):
@@ -1221,24 +1291,29 @@ class JaxTrainEngine(TrainEngine):
                 fn = self._get_forward_fn(shape, post_hook)
                 with engine_phase("forward_backward"):
                     outputs = fn(self.params, batch)
-                    vals = np.asarray(
-                        jax.device_get(outputs[output_key]), np.float32
-                    )
-                # vectorized grid->batch scatter (one fancy-indexed copy
-                # instead of a per-sequence Python loop). For logprobs the
-                # label-aligned output shifts right one: token t's logp was
-                # computed at position t-1, so out[src, 1:n] = row[:n-1].
-                lens = np.asarray(g.seq_lens, np.int64)
-                n_eff = lens if output_key == "values" else np.maximum(lens - 1, 0)
-                seq_of = np.repeat(np.arange(len(lens)), n_eff)
-                within = np.arange(n_eff.sum()) - np.repeat(
-                    np.cumsum(n_eff) - n_eff, n_eff
-                )
-                src_r = np.asarray(g.row_of_seq)[seq_of]
-                src_c = np.asarray(g.col_of_seq)[seq_of] + within
-                dst_r = np.asarray(g.seq_index)[seq_of]
-                dst_c = within if output_key == "values" else within + 1
-                out[dst_r, dst_c] = vals[src_r, src_c]
+                # keep the result on device: pulling here would stall
+                # dispatch of the NEXT grid behind this grid's compute
+                pending.append(outputs[output_key])
+            with engine_phase("forward_backward"):
+                # arealint: disable-next=PRF001 designed batch-boundary sync: single batched pull after every grid is dispatched
+                fetched = jax.device_get(pending)
+        for vals, g in zip(fetched, grids):
+            vals = np.asarray(vals, np.float32)
+            # vectorized grid->batch scatter (one fancy-indexed copy
+            # instead of a per-sequence Python loop). For logprobs the
+            # label-aligned output shifts right one: token t's logp was
+            # computed at position t-1, so out[src, 1:n] = row[:n-1].
+            lens = np.asarray(g.seq_lens, np.int64)
+            n_eff = lens if output_key == "values" else np.maximum(lens - 1, 0)
+            seq_of = np.repeat(np.arange(len(lens)), n_eff)
+            within = np.arange(n_eff.sum()) - np.repeat(
+                np.cumsum(n_eff) - n_eff, n_eff
+            )
+            src_r = np.asarray(g.row_of_seq)[seq_of]
+            src_c = np.asarray(g.col_of_seq)[seq_of] + within
+            dst_r = np.asarray(g.seq_index)[seq_of]
+            dst_c = within if output_key == "values" else within + 1
+            out[dst_r, dst_c] = vals[src_r, src_c]
         return out
 
     # -- rollout plumbing -------------------------------------------------
@@ -1423,22 +1498,45 @@ class JaxTrainEngine(TrainEngine):
             self.params = restored["params"]
             if meta.with_optim:
                 self.opt_state = restored["opt_state"]
+                self._step_count = None  # restored count: re-sync the mirror
         else:
             raise NotImplementedError(meta.weight_format)
 
     def export_stats(self) -> dict[str, float]:
         return {"version": float(self.get_version())}
 
+    # Whether the optimizer-step jits donate params/opt_state/grads. The
+    # constant documents (and the HBM ledger + its test assert) the
+    # donation contract of _get_fused_step_fn/_get_apply_fn: flipping a
+    # donate_argnums there without updating this shows up as a ledger
+    # regression, not a silent HBM doubling.
+    STEP_DONATES_STATE = True
+
     def hbm_ledger(self, override_hbm_gb: float | None = None) -> dict:
         """Itemized device-memory account of this engine (params +
         optimizer state vs the device limit; analytic byte sums when the
-        backend has no memory_stats — docs/observability.md "HBM ledger")."""
+        backend has no memory_stats — docs/observability.md "HBM ledger").
+
+        ``step_transient`` is the analytic peak of extra bytes the
+        optimizer step holds beyond the standing params/opt_state: one
+        grads tree, plus — only when the step jits do NOT donate — a
+        second params+opt_state generation (the donated buffers would
+        otherwise stay live until the new trees materialize)."""
         from areal_tpu.observability import hw_accounting as hw
 
         components = {
             "params": hw.tree_bytes(self.params),
             "opt_state": hw.tree_bytes(self.opt_state),
         }
+        components["step_transient"] = hw.step_transient_bytes(
+            components["params"],
+            components["opt_state"],
+            donate=self.STEP_DONATES_STATE,
+        )
         return hw.build_hbm_ledger(
-            components, override_hbm_gb=override_hbm_gb
+            components,
+            override_hbm_gb=override_hbm_gb,
+            # a peak-of-step estimate, not standing allocation: itemize it
+            # (the OOM margin the step needs) without counting it in_use
+            exclude_from_total=("step_transient",),
         )

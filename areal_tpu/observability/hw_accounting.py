@@ -141,6 +141,21 @@ def train_step_flops(
 # ---------------------------------------------------------------------------
 
 
+def step_transient_bytes(
+    params_bytes: int, opt_state_bytes: int, donate: bool
+) -> int:
+    """Analytic peak of the optimizer step's *extra* HBM beyond the
+    standing params/opt_state: one grads tree (params-sized) always; a
+    donating step writes the updated params/opt_state into the donated
+    input buffers, while an un-donated step holds BOTH generations live
+    until the outputs materialize — the classic donate-or-double
+    footgun arealint's DON family lints for."""
+    transient = params_bytes  # grads
+    if not donate:
+        transient += params_bytes + opt_state_bytes
+    return int(transient)
+
+
 def tree_bytes(tree) -> int:
     """Total buffer bytes of a pytree of jax/numpy arrays (0 for None)."""
     if tree is None:

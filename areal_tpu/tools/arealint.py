@@ -5,27 +5,40 @@ Usage:
 
 With no paths, analyzes the installed ``areal_tpu`` package. Options:
 
-    --format {text,json}   output format (default text)
-    --rules CSV            restrict to rule families (ASY,JAX,THR,CFG,OBS)
-                           or individual ids (ASY001,...)
+    --format {text,json,sarif}
+                           output format (default text); sarif emits a
+                           SARIF 2.1.0 document for CI code-scanning
+                           annotation
+    --rules CSV            restrict to rule families (ASY,JAX,THR,CFG,OBS,
+                           EXC,SIG,PRF,DON,SHD,RCP) or individual ids
     --baseline PATH        baseline file (default: areal_tpu/analysis/
                            baseline.json)
     --no-baseline          report every finding, ignoring the baseline
     --write-baseline       rewrite the baseline from the current findings
                            (reasons for persisting entries are carried over;
                            new entries get an empty reason to fill in)
+    --changed-only         restrict the run to .py files the working tree
+                           changed vs HEAD (staged, unstaged, and
+                           untracked), intersected with the requested
+                           paths — the fast local/CI-diff iteration mode
     --list-rules           print the rule catalog and exit
 
 Exit codes (the CI contract):
-    0  clean — no findings beyond the baseline
+    0  clean — no findings beyond the baseline. A --changed-only run
+       whose changed set is EMPTY also exits 0 ("nothing to check" is
+       clean by definition; it prints a note so a misconfigured CI diff
+       doesn't silently pass) — gate jobs that must always scan
+       everything simply omit the flag
     1  at least one non-baselined finding
-    2  usage or internal error (bad path, malformed baseline, …)
+    2  usage or internal error (bad path, malformed baseline, not a git
+       worktree under --changed-only, …)
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
 
@@ -40,6 +53,104 @@ EXIT_CLEAN = 0
 EXIT_FINDINGS = 1
 EXIT_ERROR = 2
 
+_SARIF_LEVELS = {"error": "error", "warning": "warning"}
+
+
+def changed_python_files(repo_root: Path) -> list[Path] | None:
+    """Absolute paths of .py files the working tree changed vs HEAD:
+    staged + unstaged (``git diff HEAD``) plus untracked. None when git
+    is unavailable or the directory is not a worktree.
+
+    ``--relative`` keeps the diff output relative to ``repo_root`` (and
+    scoped to its subtree) even when the git toplevel is a parent
+    directory — without it a monorepo layout would join
+    toplevel-relative names onto repo_root, drop every file as
+    non-existent, and silently report "nothing to check".
+    ``ls-files`` is cwd-relative already."""
+    def git(*args: str) -> list[str] | None:
+        try:
+            out = subprocess.run(
+                ["git", *args],
+                cwd=repo_root,
+                capture_output=True,
+                text=True,
+                timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if out.returncode != 0:
+            return None
+        return [ln for ln in out.stdout.splitlines() if ln.strip()]
+
+    diff = git("diff", "--name-only", "--relative", "HEAD", "--", "*.py")
+    if diff is None:
+        # HEAD may be unborn (fresh repo before the first commit): diff
+        # against the canonical empty tree so staged files still count,
+        # instead of mis-reporting "not a git worktree"
+        if git("rev-parse", "--is-inside-work-tree") is not None:
+            diff = git(
+                "diff", "--name-only", "--relative",
+                "4b825dc642cb6eb9a060e54bf8d69288fbee4904", "--", "*.py",
+            )
+    untracked = git("ls-files", "--others", "--exclude-standard", "--", "*.py")
+    if diff is None or untracked is None:
+        return None
+    seen: dict[str, None] = {}
+    for rel in diff + untracked:
+        seen.setdefault(rel)
+    return [repo_root / rel for rel in seen if (repo_root / rel).exists()]
+
+
+def render_sarif(result, rule_table: dict[str, str]) -> dict:
+    """Minimal SARIF 2.1.0 document: one run, one result per finding,
+    rule metadata from the catalog. CI annotators (GitHub code scanning,
+    reviewdog) consume this directly."""
+    rules_used = sorted({f.rule for f in result.findings})
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "arealint",
+                        "informationUri": "docs/static_analysis.md",
+                        "rules": [
+                            {
+                                "id": rid,
+                                "shortDescription": {
+                                    "text": rule_table.get(rid, rid)
+                                },
+                            }
+                            for rid in rules_used
+                        ],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": _SARIF_LEVELS.get(f.severity, "error"),
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": f.path},
+                                    "region": {"startLine": f.line},
+                                }
+                            }
+                        ],
+                        # line-independent identity for annotation dedup
+                        "partialFingerprints": {"arealintKey": f.key},
+                    }
+                    for f in result.findings
+                ],
+            }
+        ],
+    }
+
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
@@ -47,13 +158,29 @@ def main(argv: list[str] | None = None) -> int:
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     p.add_argument("paths", nargs="*", help="files/directories to analyze")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"), default="text")
     p.add_argument("--rules", default=None, help="comma-separated families/ids")
     p.add_argument("--baseline", default=None, help="baseline json path")
     p.add_argument("--no-baseline", action="store_true")
     p.add_argument("--write-baseline", action="store_true")
+    p.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="restrict to .py files changed vs HEAD (plus untracked)",
+    )
     p.add_argument("--list-rules", action="store_true")
     args = p.parse_args(argv)
+
+    if args.write_baseline and args.changed_only:
+        # the changed set sees a slice of the findings; writing it as THE
+        # baseline would delete every entry outside the diff
+        print(
+            "arealint: --write-baseline cannot be combined with "
+            "--changed-only (a diff-scoped run would drop all other "
+            "baseline entries)",
+            file=sys.stderr,
+        )
+        return EXIT_ERROR
 
     if args.write_baseline and args.rules:
         # a rule-filtered run sees only a slice of the findings; writing it
@@ -84,6 +211,40 @@ def main(argv: list[str] | None = None) -> int:
             print(f"arealint: no such path: {path}", file=sys.stderr)
             return EXIT_ERROR
 
+    if args.changed_only:
+        repo_root = analyzer.context.repo_root
+        changed = changed_python_files(repo_root)
+        if changed is None:
+            print(
+                f"arealint: --changed-only needs a git worktree at "
+                f"{repo_root}",
+                file=sys.stderr,
+            )
+            return EXIT_ERROR
+
+        def under_requested(f: Path) -> bool:
+            rf = f.resolve()
+            for root in paths:
+                r = root.resolve()
+                if rf == r:
+                    return True
+                try:
+                    rf.relative_to(r)
+                    return True
+                except ValueError:
+                    continue
+            return False
+
+        paths = [f for f in changed if under_requested(f)]
+        if not paths:
+            # exit-code contract: an empty changed set is CLEAN (0) — but
+            # loudly, so a misconfigured diff in CI is visible in the log
+            print(
+                "arealint: --changed-only: no changed .py files under the "
+                "requested paths; nothing to check (exit 0)"
+            )
+            return EXIT_CLEAN
+
     baseline_path = Path(args.baseline) if args.baseline else default_baseline_path()
     baseline = None
     if not args.no_baseline and not args.write_baseline and baseline_path.exists():
@@ -94,6 +255,12 @@ def main(argv: list[str] | None = None) -> int:
             return EXIT_ERROR
 
     result = analyzer.run(paths, baseline=baseline)
+    if args.changed_only:
+        # a diff-scoped run cannot observe findings outside the changed
+        # set, so unmatched baseline entries are OUT OF SCOPE, not stale
+        # — reporting them (with --write-baseline advice this mode
+        # rejects) would train CI readers to ignore the real signal
+        result.stale_baseline = []
 
     if args.write_baseline:
         old = None
@@ -140,6 +307,8 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.format == "json":
         print(json.dumps(result.to_dict(), indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(render_sarif(result, analyzer.rule_table()), indent=2))
     else:
         for f in result.findings:
             print(f.render())

@@ -211,6 +211,35 @@ def main(argv=None) -> int:
 
     _check("metrics", metrics_lint, results)
 
+    def perf_lint():
+        """The dataflow-aware performance families (PRF hot-path syncs,
+        DON donation, SHD sharding specs, RCP recompile risk) over the
+        package, against the checked-in baseline — the static half of
+        what the PR 9 observatory measures at runtime
+        (docs/static_analysis.md)."""
+        from areal_tpu.analysis import (
+            default_baseline_path,
+            default_package_root,
+            run_analysis,
+        )
+
+        res = run_analysis(
+            [default_package_root()],
+            rules=["PRF", "DON", "SHD", "RCP"],
+            baseline_path=default_baseline_path(),
+        )
+        if not res.ok:
+            raise RuntimeError(
+                "; ".join(f.render() for f in res.findings[:5])
+                + (f" (+{len(res.findings) - 5} more)" if len(res.findings) > 5 else "")
+            )
+        return (
+            f"PRF/DON/SHD/RCP clean over {res.files_checked} files "
+            f"({len(res.suppressed)} reasoned suppressions)"
+        )
+
+    _check("perf_lint", perf_lint, results)
+
     def native_kernels():
         from areal_tpu.native import datapack_lib
         from areal_tpu.utils.datapack import ffd_allocate

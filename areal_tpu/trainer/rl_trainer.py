@@ -307,6 +307,8 @@ class PPOTrainer:
         self._profile_requested.set()
 
     # -- step loop --------------------------------------------------------
+    # arealint: hot-path — the RL step loop: every statement here runs once
+    # per global step, so PRF flags any blocking device read added to it
     def train(
         self,
         workflow: Any = None,

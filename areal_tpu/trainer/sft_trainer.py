@@ -237,6 +237,8 @@ class SFTTrainer:
     def _train_step(self, batch) -> dict:
         return self.lm.train_lm(batch)
 
+    # arealint: hot-path — the SFT step loop: one pass per global step, so
+    # PRF flags any blocking device read added to it
     def train(self) -> list[float]:
         config = self.config
         start_step = (

@@ -1,0 +1,40 @@
+"""DON bad fixture: un-donated step state and use-after-donation."""
+
+import jax
+import optax
+
+
+def make_step(tx):
+    def step(params, opt_state, batch):
+        grads = batch
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state
+
+    # DON001 twice: params and opt_state are rebound and returned but
+    # neither is donated — both generations stay live across the update
+    return jax.jit(step)
+
+
+class Engine:
+    def __init__(self, params):
+        self.params = params
+        self._fn_cache = {}
+
+    def _get_apply(self):
+        key = "apply"
+        if key not in self._fn_cache:
+
+            def apply(params, grads):
+                params = optax.apply_updates(params, grads)
+                return params
+
+            self._fn_cache[key] = jax.jit(apply, donate_argnums=(0,))
+        return self._fn_cache[key]
+
+    def train_once(self, grads):
+        new = self._get_apply()(self.params, grads)
+        # DON002: self.params was donated above and is dead now
+        stale = jax.tree.map(lambda x: x, self.params)
+        self.params = new
+        return stale

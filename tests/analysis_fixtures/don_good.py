@@ -1,0 +1,46 @@
+"""DON good fixture: donated step state, rebind-at-call-site reads."""
+
+import jax
+import optax
+
+
+def make_step(tx):
+    def step(params, opt_state, batch):
+        grads = batch
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def make_grad_fn(loss):
+    # params flow IN only (no rebind, no update returned): donation is
+    # not required — the caller keeps using them
+    def compute(params, batch):
+        return jax.grad(loss)(params, batch)
+
+    return jax.jit(compute)
+
+
+class Engine:
+    def __init__(self, params):
+        self.params = params
+        self._fn_cache = {}
+
+    def _get_apply(self):
+        key = "apply"
+        if key not in self._fn_cache:
+
+            def apply(params, grads):
+                params = optax.apply_updates(params, grads)
+                return params
+
+            self._fn_cache[key] = jax.jit(apply, donate_argnums=(0,))
+        return self._fn_cache[key]
+
+    def train_once(self, grads):
+        # the donated buffer is rebound by the same statement: no use of
+        # the dead generation is possible afterwards
+        self.params = self._get_apply()(self.params, grads)
+        return self.params

@@ -1,0 +1,57 @@
+"""PRF bad fixture: host-device syncs on hot paths.
+
+``_loop`` is a hot seed by name; ``marked_poller`` by marker comment;
+``_drain`` is hot by one-hop reachability from ``_loop``. ``initialize``
+is COLD — its syncs must never fire (the reachability negative the unit
+tests pin)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _step_fn(x):
+    return x * 2
+
+
+def _drain(pending):
+    # hot via the call edge from _loop
+    vals = jax.device_get(pending)  # PRF001 through reachability
+    return vals
+
+
+class Engine:
+    def __init__(self):
+        self._fn_cache = {}
+
+    def _get_step(self):
+        key = ("step",)
+        if key not in self._fn_cache:
+            self._fn_cache[key] = jax.jit(_step_fn)
+        return self._fn_cache[key]
+
+    def _loop(self):
+        fn = self._get_step()
+        out = fn(jnp.ones((4,)))
+        total = 0.0
+        for _ in range(8):
+            out = fn(out)
+            total += float(out.sum())  # PRF003: per-iteration coercion
+        jax.block_until_ready(out)  # PRF001: sync API outside the loop
+        host = np.asarray(out)  # PRF002: device->host transfer
+        _drain(out)
+        return total, host
+
+
+# arealint: hot-path
+def marked_poller():
+    for _ in range(4):
+        x = jnp.exp(jnp.zeros(()))
+        _ = x.item()  # PRF003: .item() in a loop of a marked function
+
+
+def initialize():
+    # cold path: identical call shapes, zero findings
+    w = jnp.ones((4,))
+    jax.block_until_ready(w)
+    return float(w.sum())

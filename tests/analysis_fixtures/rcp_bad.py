@@ -1,0 +1,31 @@
+"""RCP bad fixture: jit churn in a loop, static-arg drift, and a
+condition-dependent pytree fed to a jit'd call."""
+
+import jax
+import jax.numpy as jnp
+
+
+def train_batch(xs):  # hot seed by name
+    out = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)  # RCP001: fresh identity per iteration
+        out.append(f(x))
+    return out
+
+
+_g = jax.jit(lambda n, v: v.reshape((n,)), static_argnums=(0,))
+
+
+def _loop(sizes):
+    for n in sizes:
+        _g(n, jnp.ones((8,)))  # RCP002: loop-varying static argument
+
+
+_fwd = jax.jit(lambda batch: batch["a"])
+
+
+def eval_batch(flag):
+    batch = {"a": jnp.zeros(())}
+    if flag:
+        batch["b"] = jnp.ones(())  # key set varies with `flag`
+    return _fwd(batch)  # RCP003: unstable pytree structure

@@ -1,0 +1,40 @@
+"""RCP good fixture: the sanctioned shapes — keyed fn-cache, bucketed
+static arguments hoisted out of loops, stable pytree key sets."""
+
+import jax
+import jax.numpy as jnp
+
+
+class Engine:
+    def __init__(self):
+        self._fn_cache = {}
+
+    def _get_step(self, n: int):
+        key = ("step", n)
+        if key not in self._fn_cache:
+            # cached per variant: the guard + keyed store is the accepted
+            # shape for a bounded compile-variant set
+            self._fn_cache[key] = jax.jit(lambda v: v.reshape((n,)))
+        return self._fn_cache[key]
+
+    def train_batch(self, xs):
+        out = []
+        for x in xs:
+            fn = self._get_step(8)
+            out.append(fn(x))
+        return out
+
+
+_fwd = jax.jit(lambda batch: batch["a"])
+
+
+def eval_batch(flag):
+    # stable key set: always-present keys, masked values
+    batch = {"a": jnp.zeros(()), "b": jnp.ones(()) if flag else jnp.zeros(())}
+    return _fwd(batch)
+
+
+def initialize():
+    # one-time jit of a lambda on a cold path is fine
+    init = jax.jit(lambda k: jax.random.normal(k, (4,)))
+    return init(jax.random.PRNGKey(0))

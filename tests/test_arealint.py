@@ -131,6 +131,151 @@ def test_sig_good_fixture():
     assert rules_in(FIXTURES / "sig_good.py", ["SIG"]) == []
 
 
+def test_prf_bad_fixture():
+    res = run_analysis([FIXTURES / "prf_bad.py"], rules=["PRF"], baseline_path=None)
+    rules = [f.rule for f in res.findings]
+    assert "PRF001" in rules  # block_until_ready in hot fn
+    assert "PRF002" in rules  # np.asarray of a device value
+    assert rules.count("PRF003") >= 2  # float() in loop + .item() in marked fn
+    msgs = [f.message for f in res.findings]
+    # one-hop reachability names the seed that made the helper hot
+    assert any("reachable from hot `Engine._loop`" in m for m in msgs)
+    # the marker comment seeds hotness without a conventional name
+    assert any("marked_poller" in m for m in msgs)
+
+
+def test_prf_good_fixture():
+    assert rules_in(FIXTURES / "prf_good.py", ["PRF"]) == []
+
+
+def test_prf_cold_path_never_fires():
+    """The reachability negative: `initialize` holds the same sync calls
+    as the hot loop and must stay silent — hotness is a call-graph fact,
+    not a per-call pattern."""
+    res = run_analysis([FIXTURES / "prf_bad.py"], rules=["PRF"], baseline_path=None)
+    assert all("initialize" not in f.key for f in res.findings)
+    assert all("initialize" not in f.message for f in res.findings)
+
+
+def test_prf_hot_marker_in_new_file(tmp_path):
+    # a sync is only a finding when reachable from a seed; the marker
+    # makes an arbitrarily-named function a seed
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import jax\n"
+        "def quiet(x):\n"
+        "    return jax.device_get(x)\n"
+    )
+    assert rules_in(src, ["PRF"]) == []
+    src.write_text(
+        "import jax\n"
+        "# arealint: hot-path\n"
+        "def loud(x):\n"
+        "    return jax.device_get(x)\n"
+    )
+    assert rules_in(src, ["PRF"]) == ["PRF001"]
+
+
+def test_don_bad_fixture():
+    res = run_analysis([FIXTURES / "don_bad.py"], rules=["DON"], baseline_path=None)
+    by_rule = {}
+    for f in res.findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    tokens = {f.key.rsplit(":", 1)[1] for f in by_rule["DON001"]}
+    assert {"params", "opt_state"} <= tokens  # both un-donated step args
+    assert len(by_rule["DON002"]) == 1  # self.params read after donation
+    assert "self.params" in by_rule["DON002"][0].message
+
+
+def test_don_good_fixture():
+    assert rules_in(FIXTURES / "don_good.py", ["DON"]) == []
+
+
+def test_don002_opposite_branch_is_not_use_after(tmp_path):
+    """A read in the OTHER branch of the donating if never executes on
+    the donation path — must not fire (branch-aware scan); a read on the
+    shared path after the if still must."""
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import jax\n"
+        "step = jax.jit(lambda state: state, donate_argnums=(0,))\n"
+        "def run(self, fast):\n"
+        "    if fast:\n"
+        "        tmp = step(self.state)\n"
+        "    else:\n"
+        "        tmp = len(self.state)\n"  # exclusive branch: fine
+        "    return tmp\n"
+    )
+    assert rules_in(src, ["DON"]) == []
+    src.write_text(
+        "import jax\n"
+        "step = jax.jit(lambda state: state, donate_argnums=(0,))\n"
+        "def run(self, fast):\n"
+        "    if fast:\n"
+        "        tmp = step(self.state)\n"
+        "    return len(self.state)\n"  # shared path: dead on fast=True
+    )
+    assert rules_in(src, ["DON"]) == ["DON002"]
+
+
+def test_shd_bad_fixture():
+    rules = rules_in(FIXTURES / "shd_bad.py", ["SHD"])
+    assert sorted(rules) == ["SHD001", "SHD002", "SHD003"]
+
+
+def test_shd_good_fixture():
+    # includes a locally-declared Mesh axis ('stage') and a spec-shaped
+    # helper name that must not be mistaken for PartitionSpec
+    assert rules_in(FIXTURES / "shd_good.py", ["SHD"]) == []
+
+
+def test_rcp_bad_fixture():
+    rules = rules_in(FIXTURES / "rcp_bad.py", ["RCP"])
+    assert sorted(rules) == ["RCP001", "RCP002", "RCP003"]
+
+
+def test_rcp_good_fixture():
+    # the keyed fn-cache guard idiom and stable-key pytrees stay silent
+    assert rules_in(FIXTURES / "rcp_good.py", ["RCP"]) == []
+
+
+def test_new_family_suppression_roundtrip(tmp_path):
+    """Inline suppression + baseline matching both work for the dataflow
+    families (they key on scope/token exactly like the one-hop rules)."""
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import jax\n"
+        "def _loop(fn, x):\n"
+        "    for _ in range(4):\n"
+        "        x = fn(x)\n"
+        "    # arealint: disable-next=PRF001 boundary pull with written reason\n"
+        "    host = jax.device_get(x)\n"
+        "    jax.block_until_ready(x)\n"
+        "    return host\n"
+    )
+    res = run_analysis([src], rules=["PRF"], baseline_path=None)
+    assert [f.rule for f in res.findings] == ["PRF001"]  # only the unsuppressed one
+    assert len(res.suppressed) == 1
+    # baseline round-trip: the surviving finding baselines by key
+    doc = render_baseline(res.findings)
+    bpath = tmp_path / "b.json"
+    bpath.write_text(json.dumps(doc))
+    res2 = run_analysis([src], rules=["PRF"], baseline_path=bpath)
+    assert res2.findings == []
+    assert len(res2.baselined) == 1
+
+
+def test_prf_key_stable_across_line_shifts(tmp_path):
+    original = (FIXTURES / "prf_bad.py").read_text()
+    moved = tmp_path / "prf_bad.py"
+    moved.write_text("\n\n# header edit\n\n" + original)
+    keys = lambda p: sorted(
+        f.key.split(":", 2)[2]
+        for f in run_analysis([p], rules=["PRF"], baseline_path=None).findings
+    )
+    assert keys(FIXTURES / "prf_bad.py") == keys(moved)
+
+
 def test_obs_catalog_lint_rules_exist():
     # catalog-side lint (OBS003/OBS004/OBS005) runs on the real catalog and
     # must be clean — it replaced validate_installation's ad-hoc check
@@ -415,6 +560,165 @@ def test_write_baseline_refuses_rule_filter(tmp_path, capsys):
     assert rc == cli.EXIT_ERROR
     assert not bpath.exists()
     capsys.readouterr()
+
+
+def test_cli_sarif_output(capsys):
+    rc = cli.main(
+        [str(FIXTURES / "shd_bad.py"), "--format", "sarif", "--no-baseline"]
+    )
+    assert rc == cli.EXIT_FINDINGS
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "arealint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"SHD001", "SHD002", "SHD003"} <= rule_ids
+    res = run["results"][0]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("shd_bad.py")
+    assert loc["region"]["startLine"] > 0
+    # line-independent identity for CI annotation dedup
+    assert res["partialFingerprints"]["arealintKey"].startswith(res["ruleId"])
+
+
+def test_cli_sarif_clean_is_exit_zero(capsys, tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    rc = cli.main([str(clean), "--format", "sarif", "--no-baseline"])
+    assert rc == cli.EXIT_CLEAN
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["runs"][0]["results"] == []
+
+
+def test_cli_changed_only_empty_set_is_clean(tmp_path, capsys, monkeypatch):
+    """Exit-code contract: an empty changed set exits 0 with a loud note
+    (documented in the CLI help)."""
+    repo = tmp_path / "repo"
+    (repo / "pkg").mkdir(parents=True)
+    (repo / "pkg" / "mod.py").write_text("import time\n")
+    monkeypatch.setattr(cli, "changed_python_files", lambda root: [])
+    rc = cli.main([str(repo / "pkg"), "--changed-only", "--no-baseline"])
+    assert rc == cli.EXIT_CLEAN
+    out = capsys.readouterr().out
+    assert "no changed .py files" in out
+
+
+def test_cli_changed_only_scopes_to_diff(tmp_path, capsys, monkeypatch):
+    """Only the intersection of (changed files, requested paths) is
+    analyzed: the dirty file outside the requested path is ignored and
+    the unchanged bad file inside it is not scanned."""
+    import subprocess
+
+    from areal_tpu.tools import arealint as cli_mod
+
+    changed = tmp_path / "changed.py"
+    changed.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    unchanged = tmp_path / "unchanged.py"
+    unchanged.write_text("import time\nasync def g():\n    time.sleep(2)\n")
+    outside = tmp_path / "outside.py"
+    outside.write_text("import time\nasync def h():\n    time.sleep(3)\n")
+
+    def fake_changed(repo_root):
+        return [changed, outside]
+
+    monkeypatch.setattr(cli_mod, "changed_python_files", fake_changed)
+    rc = cli_mod.main(
+        [str(changed), str(unchanged), "--changed-only", "--no-baseline"]
+    )
+    out = capsys.readouterr().out
+    assert rc == cli_mod.EXIT_FINDINGS
+    assert "changed.py" in out
+    assert "unchanged.py" not in out
+    assert "outside.py" not in out
+
+
+def test_cli_changed_only_rejects_write_baseline(capsys):
+    rc = cli.main(["--changed-only", "--write-baseline"])
+    assert rc == cli.EXIT_ERROR
+    assert "--changed-only" in capsys.readouterr().err
+
+
+def test_changed_python_files_in_this_repo(tmp_path):
+    """Against a real throwaway git repo: diffed + untracked .py files
+    are returned, committed-clean ones are not."""
+    import subprocess
+
+    repo = tmp_path / "r"
+    repo.mkdir()
+    env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+    import os
+
+    def git(*a):
+        subprocess.run(
+            ["git", *a], cwd=repo, check=True, capture_output=True,
+            env={**os.environ, **env},
+        )
+
+    git("init", "-q")
+    (repo / "clean.py").write_text("x = 1\n")
+    # project root NESTED under the git toplevel (monorepo layout): diff
+    # output must stay relative to the project root, not the toplevel
+    sub = repo / "proj"
+    sub.mkdir()
+    (sub / "inner.py").write_text("z = 1\n")
+    git("add", "clean.py", "proj/inner.py")
+    git("commit", "-qm", "init")
+    (repo / "clean.py").write_text("x = 2\n")  # modified
+    (repo / "fresh.py").write_text("y = 1\n")  # untracked
+    (sub / "inner.py").write_text("z = 2\n")  # modified in the subdir
+    got = {p.name for p in cli.changed_python_files(repo)}
+    assert got == {"clean.py", "fresh.py", "inner.py"}
+    # scanning FROM the nested project root sees only its own subtree
+    got_sub = {p.name for p in cli.changed_python_files(sub)}
+    assert got_sub == {"inner.py"}
+
+
+def test_changed_python_files_unborn_head(tmp_path):
+    """A worktree before its first commit is still a worktree: staged and
+    untracked files are reported (empty-tree diff fallback), not a
+    misleading 'needs a git worktree' error."""
+    import os
+    import subprocess
+
+    repo = tmp_path / "r"
+    repo.mkdir()
+    env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+
+    def git(*a):
+        subprocess.run(
+            ["git", *a], cwd=repo, check=True, capture_output=True,
+            env={**os.environ, **env},
+        )
+
+    git("init", "-q")
+    (repo / "staged.py").write_text("a = 1\n")
+    git("add", "staged.py")
+    (repo / "loose.py").write_text("b = 1\n")
+    got = {p.name for p in cli.changed_python_files(repo)}
+    assert got == {"staged.py", "loose.py"}
+
+
+def test_cli_changed_only_suppresses_stale_baseline_noise(
+    tmp_path, capsys, monkeypatch
+):
+    """A diff-scoped run cannot prove baseline entries stale — it must
+    not print the stale advice for out-of-scope entries."""
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    bpath = tmp_path / "b.json"
+    bpath.write_text(json.dumps({"version": 1, "findings": [
+        {"rule": "ASY001", "path": "elsewhere.py",
+         "key": "ASY001:elsewhere.py:f:time.sleep", "reason": "r"}
+    ]}))
+    monkeypatch.setattr(cli, "changed_python_files", lambda root: [clean])
+    rc = cli.main(
+        [str(clean), "--changed-only", "--baseline", str(bpath)]
+    )
+    out = capsys.readouterr().out
+    assert rc == cli.EXIT_CLEAN
+    assert "stale baseline" not in out
 
 
 def test_write_baseline_preserves_out_of_scope_entries(tmp_path, capsys):
