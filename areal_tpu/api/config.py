@@ -368,6 +368,67 @@ class FaultToleranceConfig:
 
 
 @dataclass
+class RoutingConfig:
+    """Cache-aware replica selection (areal_tpu/routing/, docs/serving.md
+    "Cache-aware routing").
+
+    Consumed by the inference client's ``choose_server`` and the proxy
+    gateway's ``pick_backend`` when ``InferenceEngineConfig.routing_policy``
+    is ``"cache_aware"``. The router is placement-only: a misprediction can
+    cost latency, never correctness (greedy outputs are byte-identical
+    across policies)."""
+
+    # replica snapshot poller: /statusz scrape cadence and how long a
+    # snapshot stays trusted. A replica with no fresh snapshot scores on
+    # neutral defaults; when NO candidate has one the policy degrades to
+    # round-robin (no request ever fails because routing failed).
+    poll_interval_s: float = 2.0
+    snapshot_ttl_s: float = 15.0
+    # shadow prefix index: client-side page-granular radix over the token
+    # ids of prompts it has routed (page size learned from each replica's
+    # prefix_cache /statusz section; this is the fallback). Bounded per
+    # replica; LRU leaves evict past the cap.
+    shadow_page_size: int = 128
+    shadow_max_pages: int = 8192
+    # scoring weights — score = w_prefix * overlap_frac
+    #   - w_queue * queue_frac - w_pages * page_pressure - w_ttft * ttft_s
+    # (overlap_frac = cached prefix pages / prompt pages; queue_frac =
+    # queue depth / max_queue_norm; page_pressure = 1 - free-page fraction)
+    w_prefix: float = 2.0
+    w_queue: float = 1.0
+    w_pages: float = 0.5
+    w_ttft: float = 0.25
+    queue_norm: int = 16  # queue depth that counts as "fully busy"
+    # client-local outstanding-request pressure (counted at dispatch,
+    # released at completion — fresh at any request rate, unlike the
+    # polled snapshots): normalized by the replica's slot count, so a
+    # warm cache stops winning once its backlog costs more than the
+    # suffix-only prefill saves
+    w_inflight: float = 1.0
+    # deadline awareness: requests whose remaining slack is below
+    # rush_slack_s are in a hurry — prefix affinity stops mattering and the
+    # emptiest/fastest replica wins (a cold prefill beats queueing behind a
+    # warm cache)
+    rush_slack_s: float = 2.0
+    # 429 backpressure demotion: a replica that just shed load scores this
+    # much lower for demote_s seconds instead of tripping circuit/failover
+    demote_penalty: float = 2.0
+    demote_s: float = 5.0
+    # role pools: replica address -> "prefill" | "interactive". Prompts of
+    # >= long_prompt_tokens prefer prefill-tagged replicas and interactive
+    # traffic avoids them (soft fencing: a pool with no healthy member
+    # falls back to the full candidate set — routing-only, KV never moves
+    # across replicas). Empty map = no fencing.
+    role_map: dict[str, str] = field(default_factory=dict)
+    long_prompt_tokens: int = 1024
+    # rid -> replica affinity entries idle longer than this are swept (the
+    # gateway's sweep_stale_routes mirrored client-side; parked/resumed
+    # rids refresh on every attempt). Must exceed the longest legitimate
+    # pause a parked request waits out.
+    affinity_ttl_s: float = 3600.0
+
+
+@dataclass
 class InferenceEngineConfig:
     """Client-side rollout controls incl. staleness knobs (reference
     cli_args.py:1591-1612)."""
@@ -381,6 +442,12 @@ class InferenceEngineConfig:
     enable_rollout_tracing: bool = False
     check_trajectory_format: bool = True
     schedule_policy: str = "round_robin"
+    # replica selection brain (areal_tpu/routing/): "round_robin" keeps the
+    # legacy rotation (schedule_policy picks round_robin vs random);
+    # "cache_aware" scores candidates on prefix-cache overlap, load,
+    # free-page headroom, and deadline slack (docs/serving.md)
+    routing_policy: str = "round_robin"
+    routing: RoutingConfig = field(default_factory=RoutingConfig)
     request_timeout: float = 3600.0
     request_retries: int = 3
     pause_grace_period: float = 0.0

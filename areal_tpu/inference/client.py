@@ -42,6 +42,7 @@ from areal_tpu.observability import catalog, tracecontext
 from areal_tpu.robustness import retry as _retry
 from areal_tpu.robustness.chaos import FaultInjector
 from areal_tpu.robustness.retry import FleetHealth, RetryBudget, RetryPolicy
+from areal_tpu.routing import AffinityMap, Router
 from areal_tpu.utils import logging as alog, name_resolve
 from areal_tpu.utils.data import TensorDict
 
@@ -94,7 +95,20 @@ class RemoteJaxEngine(InferenceEngine):
         self.addresses = list(addresses or [])
         self._version = 0
         self._rr = 0  # round-robin cursor
-        self._rid_affinity: dict[str, str] = {}
+        # rid -> replica affinity (resumes + pause polls must follow the
+        # replica holding the rid's KV). Idle-TTL swept so rids that never
+        # complete (crashed caller, abandoned workflow) can't accumulate
+        # forever — the gateway's sweep_stale_routes, client-side.
+        self._rid_affinity = AffinityMap(ttl_s=config.routing.affinity_ttl_s)
+        # cache-aware routing brain (docs/serving.md "Cache-aware
+        # routing"): consulted by choose_server when
+        # config.routing_policy == "cache_aware"; its snapshot poller
+        # starts in initialize(). The shadow prefix index is only fed
+        # under that policy — a round-robin client would pay its memory
+        # (bounded, but real) for an index nothing reads.
+        self.router = Router(
+            config.routing, addresses_fn=lambda: list(self.addresses)
+        )
         self.executor = WorkflowExecutor(config, engine=self)
         self._paused = False
         self.last_pause_secs = 0.0  # last update's commit-fence window
@@ -175,6 +189,11 @@ class RemoteJaxEngine(InferenceEngine):
             self.fleet.track(addr)  # discovery may have extended the list
         self._wait_healthy(timeout or self.config.setup_timeout)
         self.executor.initialize()
+        if self.config.routing_policy == "cache_aware" and len(self.addresses) > 1:
+            # replica snapshot poller (routing/snapshot.py): /statusz view
+            # of queue depth / free pages / prefix-cache state per replica.
+            # Single-replica fleets have nothing to choose between.
+            self.router.start()
         ft = self.config.fault_tolerance
         if ft.enabled and len(self.addresses) > 1:
             # fleet probe: detects replicas rejoining after a circuit
@@ -226,6 +245,7 @@ class RemoteJaxEngine(InferenceEngine):
 
     def destroy(self) -> None:
         self.stop_fleet_probe()
+        self.router.stop()
         self._abort_pool.shutdown(wait=False)
         if self._enc_pool is not None:
             self._enc_pool.shutdown(wait=True)
@@ -294,6 +314,10 @@ class RemoteJaxEngine(InferenceEngine):
             if ok:
                 if was_down:
                     self.fleet.mark_rejoined(addr)
+                    # the replica likely restarted (supervision respawn):
+                    # its radix tree is empty — the router must read it
+                    # as cold, not as holding pre-eviction prefixes
+                    self.router.on_replica_reset(addr)
                     self._resync_replica(addr, server_version=version)
             else:
                 self.fleet.on_failure(addr)
@@ -319,22 +343,59 @@ class RemoteJaxEngine(InferenceEngine):
         )
 
     # -- server choice ----------------------------------------------------
-    def choose_server(self, rid: str | None = None) -> str:
-        if rid and rid in self._rid_affinity:
-            addr = self._rid_affinity[rid]
-            # affinity only survives while the replica is in rotation; a
-            # tripped circuit drops it so the resume fails over cleanly
-            if self.fleet.allow(addr):
-                return addr
-            self._rid_affinity.pop(rid, None)
+    def choose_server(
+        self,
+        rid: str | None = None,
+        req: ModelRequest | None = None,
+        deadline: float | None = None,
+    ) -> str:
+        """Replica selection. ``req``/``deadline`` give the cache-aware
+        policy its inputs (prompt token ids, deadline slack, priority
+        class); without them — legacy callers, tests — the policy scores
+        on load alone. Selection is placement-only: whichever replica is
+        chosen, greedy output is byte-identical."""
+        if rid:
+            addr = self._rid_affinity.get(rid)
+            if addr is not None:
+                # affinity only survives while the replica is in rotation;
+                # a tripped circuit drops it so the resume fails over
+                if self.fleet.allow(addr):
+                    if self.config.routing_policy == "cache_aware":
+                        self.router.note_affinity(
+                            addr,
+                            rid,
+                            token_ids=(
+                                list(req.input_ids)
+                                if req is not None
+                                else None
+                            ),
+                        )
+                    return addr
+                self._rid_affinity.pop(rid)
         pool = self.fleet.healthy() or self.addresses  # all open: best effort
-        if self.config.schedule_policy == "random":
+        if self.config.routing_policy == "cache_aware":
+            addr = self.router.choose(
+                pool,
+                rid=rid,
+                token_ids=(list(req.input_ids) if req is not None else None),
+                deadline=(
+                    deadline
+                    if deadline is not None
+                    else (req.deadline if req is not None else None)
+                ),
+                priority=(
+                    str(req.metadata.get("priority") or "")
+                    if req is not None
+                    else None
+                ),
+            ).addr
+        elif self.config.schedule_policy == "random":
             addr = random.choice(pool)
         else:  # round_robin
             addr = pool[self._rr % len(pool)]
             self._rr += 1
         if rid:
-            self._rid_affinity[rid] = addr
+            self._rid_affinity.set(rid, addr)
         return addr
 
     # -- generation -------------------------------------------------------
@@ -395,7 +456,6 @@ class RemoteJaxEngine(InferenceEngine):
 
     async def agenerate(self, req: ModelRequest) -> ModelResponse:
         """Interruptible generation loop (reference :771-867)."""
-        addr = self.choose_server(req.rid)
         g = req.gconfig
         accumulated: list[int] = []
         logprobs: list[float] = []
@@ -421,7 +481,14 @@ class RemoteJaxEngine(InferenceEngine):
             and lc.default_deadline_s
         ):
             deadline = time.time() + lc.default_deadline_s
+        # replica choice AFTER the deadline is known: the cache-aware
+        # policy weighs deadline slack (a rush request goes to the
+        # emptiest replica, not the warmest cache)
+        addr = self.choose_server(req.rid, req=req, deadline=deadline)
         owner_task = self._register_task_rid(req.rid, addr)
+        # replica-reported cached-prefix tokens, summed across attempts —
+        # the "actual" leg of the router's predicted-vs-actual hit audit
+        cached_prefix_tokens = 0
 
         image_b64 = None
         if req.image_data is not None:
@@ -437,6 +504,13 @@ class RemoteJaxEngine(InferenceEngine):
             else None
         )
 
+        # outstanding-request accounting (the router's freshest load
+        # signal); `counted` tracks which replica currently holds our +1.
+        # Taken immediately before the try so EVERY exit path reaches the
+        # finally's end_request — an early raise (bad image payload) must
+        # not leak a permanent +1 against a healthy replica.
+        self.router.begin_request(addr)
+        counted = addr
         try:
             while True:
                 payload = {
@@ -473,13 +547,16 @@ class RemoteJaxEngine(InferenceEngine):
                 addr, data = await self._post_json_failover(
                     addr, "/generate", payload, extra_headers=headers or None
                 )
+                if addr != counted:  # failover moved the request
+                    self.router.move_request(counted, addr)
+                    counted = addr
                 tm = data.get("timing") or {}
                 for k in timing:
                     timing[k] += float(tm.get(k) or 0.0)
                 if req.rid:
                     # failover may have moved us: resumes + pause-polls must
                     # follow the replica that actually holds the request
-                    self._rid_affinity[req.rid] = addr
+                    self._rid_affinity.set(req.rid, addr)
                     if owner_task is not None:
                         # arealint: disable-next=ASY003 microsecond dict update, never held across an await; the registry is shared with sync executor threads (abort_task_requests) so the lock must be a threading one
                         with self._task_rids_lock:
@@ -490,6 +567,9 @@ class RemoteJaxEngine(InferenceEngine):
                 accumulated.extend(toks)
                 logprobs.extend(data["output_logprobs"])
                 versions.extend(data["output_versions"])
+                cached_prefix_tokens += int(
+                    data.get("cached_prefix_tokens") or 0
+                )
                 if ttft is None and toks:
                     # prefer the ENGINE's first-token stamp: for the
                     # non-streaming /generate the HTTP response lands after
@@ -539,9 +619,27 @@ class RemoteJaxEngine(InferenceEngine):
         finally:
             # on error paths too (retry/backpressure exhaustion): retries
             # use fresh rids, so a surviving entry is a pure leak
+            self.router.end_request(counted)
             self._rid_affinity.pop(req.rid, None)
             self._deregister_task_rid(owner_task, req.rid)
 
+        # routing feedback (success paths only): the finished sequence is
+        # now presumably radix-cached on its replica (shadow prefix index),
+        # the TTFT feeds the replica's EWMA, and a replica-reported cache
+        # hit closes the predicted-vs-actual audit loop
+        # the hit audit is gated like the shadow feed: without the
+        # cache-aware policy there are no predictions, and actual-hit
+        # counts alone would read as shadow-index drift on the dashboard
+        cache_aware = self.config.routing_policy == "cache_aware"
+        self.router.note_result(
+            addr,
+            ids=(
+                list(req.input_ids) + accumulated if cache_aware else None
+            ),
+            version=versions[-1] if versions else self._version,
+            ttft_s=ttft,
+            cached_prefix_tokens=cached_prefix_tokens if cache_aware else 0,
+        )
         resp = ModelResponse(
             input_tokens=list(req.input_ids),
             output_tokens=accumulated,
@@ -684,6 +782,12 @@ class RemoteJaxEngine(InferenceEngine):
                             )
                         except ValueError:
                             retry_after = 1.0
+                        if self.config.routing_policy == "cache_aware":
+                            # backpressure is routing signal, not replica
+                            # death: demote this replica's score for a few
+                            # seconds so new placements drift elsewhere —
+                            # the circuit/failover machinery stays out of it
+                            self.router.note_backpressure(addr)
                         last_exc = RuntimeError(
                             f"admission rejected (429) by {addr}{path}"
                         )
@@ -1146,6 +1250,9 @@ class RemoteJaxEngine(InferenceEngine):
             f"{gen_tokens} tokens generated during the update"
         )
         self._version = version
+        # the fleet flushed its radix trees at the commit (PR 5
+        # across_updates="flush"): the shadow prefix index follows suit
+        self.router.on_weight_commit(version)
 
     @staticmethod
     def _quantize_for_wire(params: dict) -> dict:
@@ -1408,6 +1515,7 @@ class RemoteJaxEngine(InferenceEngine):
 
     def set_version(self, version: int) -> None:
         self._version = version
+        self.router.on_weight_commit(version)
         try:
             self._post_all("/set_version", {"version": version})
         except Exception:  # noqa: BLE001 — servers may be mid-update

@@ -829,6 +829,9 @@ class DecodeEngine:
             "queue_depth": self._queue.qsize() + len(self._backlog),
             "free_pages": self.pool.available if hasattr(self, "pool") else 0,
             "radix_pages": radix_pages,
+            # pool size so remote consumers (the routing snapshot poller)
+            # can turn free_pages into a headroom fraction
+            "n_pages": self.pool.n_pages if hasattr(self, "pool") else 0,
             "active_slots": sum(
                 1 for t in getattr(self, "_slot_task", ()) if t is not None
             ),
@@ -1613,6 +1616,9 @@ class DecodeEngine:
             "enabled": True,
             "pages_held": self._radix.pages_held,
             "max_pages": self._radix.max_pages,
+            # page granularity, so the client-side shadow prefix index
+            # (routing/shadow_index.py) keys its radix on the same pages
+            "page_size": self.config.page_size,
             **self._radix.stats,
             # hit accounting is engine-owned: counted once per ADMITTED
             # request, so backlog retries can't inflate the hit rate
@@ -2321,6 +2327,9 @@ class DecodeEngine:
         if not admitted:
             return []
         for task, slot, mpages, _mvers in admitted:
+            # the hit rides response metadata -> /generate JSON so the
+            # routing brain can audit predicted-vs-actual prefix locality
+            task.req.metadata["cached_prefix_tokens"] = len(mpages) * psz
             if task.timeline is not None:
                 task.timeline.mark(tl_mod.ADMITTED, slot=slot)
                 task.timeline.mark(

@@ -411,6 +411,11 @@ class InferenceServer:
                 "timing": {
                     k: getattr(resp, k) for k in io_struct.TIMING_FIELDS
                 },
+                # prompt tokens served from radix-cached KV (0 = cold):
+                # the "actual" half of the router's hit audit
+                "cached_prefix_tokens": int(
+                    resp.metadata.get("cached_prefix_tokens") or 0
+                ),
                 "rid": resp.rid,
             }
         )

@@ -609,6 +609,50 @@ def preemption_metrics(reg: Registry | None = None) -> SimpleNamespace:
     )
 
 
+def router_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """Cache-aware routing brain (areal_tpu/routing/): replica-selection
+    decisions and the predicted-vs-actual prefix-hit audit. Predicted hit
+    rate that diverges from actual means the shadow index has drifted from
+    the fleet's radix trees (docs/serving.md "Cache-aware routing")."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        decisions=r.counter(
+            "areal_router_decisions_total",
+            "Replica-selection decisions, by reason (affinity | "
+            "prefix_overlap | least_loaded | rush_deadline | role_pool | "
+            "round_robin | stale_snapshots | single_candidate).",
+            label_names=("reason",),
+        ),
+        prefix_overlap=r.histogram(
+            "areal_router_prefix_overlap_pages",
+            "Shadow-index cached-prefix overlap (KV pages) of the chosen "
+            "replica at decision time.",
+            buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256),
+        ),
+        predicted_hits=r.counter(
+            "areal_router_predicted_hit_total",
+            "Decisions that predicted a warm prefix (shadow-index overlap "
+            "> 0 pages on the chosen replica).",
+        ),
+        actual_hits=r.counter(
+            "areal_router_actual_hit_total",
+            "Routed requests whose replica reported serving cached prefix "
+            "tokens (the engine's radix cache actually hit).",
+        ),
+        backpressure_demotions=r.counter(
+            "areal_router_backpressure_demotions_total",
+            "429 responses folded into a replica's score as a transient "
+            "demotion instead of circuit-trip/failover.",
+        ),
+        snapshot_age=r.gauge(
+            "areal_router_snapshot_age_seconds",
+            "Age of the OLDEST live replica snapshot the router holds "
+            "(staleness past routing.snapshot_ttl_s degrades the policy "
+            "to round-robin).",
+        ),
+    )
+
+
 def aggregator_metrics(reg: Registry | None = None) -> SimpleNamespace:
     """Fleet aggregator: scrape health."""
     r = reg or get_registry()
@@ -642,6 +686,7 @@ ALL_FACTORIES = (
     train_obs_metrics,
     robustness_metrics,
     preemption_metrics,
+    router_metrics,
     aggregator_metrics,
 )
 

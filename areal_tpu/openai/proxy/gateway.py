@@ -84,6 +84,12 @@ class GatewayState:
         self.inflight: dict[str, int] = {p: 0 for p in PRIORITIES}
         self.shed: dict[str, int] = {p: 0 for p in PRIORITIES}
         self._lc_obs = catalog.lifecycle_metrics()
+        # session placement rides the shared routing policy (areal_tpu/
+        # routing/): least-loaded with rotation among ties, every decision
+        # audited (areal_router_decisions_total + flight recorder) like
+        # the inference client's replica choices
+        self._rr = 0
+        self._router_obs = catalog.router_metrics()
 
     def classify(self, request: web.Request) -> str:
         p = request.headers.get("x-areal-priority", "interactive").lower()
@@ -128,7 +134,20 @@ class GatewayState:
         )
 
     def pick_backend(self) -> str:
-        return min(self.backends, key=lambda b: self.load.get(b, 0))
+        from areal_tpu.observability import timeline as tl_mod
+        from areal_tpu.routing import pick_least_loaded
+
+        backend, reason = pick_least_loaded(self.backends, self.load, self._rr)
+        self._rr += 1
+        self._router_obs.decisions.labels(reason=reason).inc()
+        tl_mod.get_flight_recorder().record(
+            "router_decision",
+            scope="gateway",
+            replica=backend,
+            reason=reason,
+            load=self.load.get(backend, 0),
+        )
+        return backend
 
     def drop_route(self, api_key: str) -> None:
         route = self.routes.pop(api_key, None)

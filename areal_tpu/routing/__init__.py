@@ -1,0 +1,32 @@
+"""Cache-aware routing brain for the serving fleet (docs/serving.md
+"Cache-aware routing").
+
+Replaces blind round-robin replica selection with scored placement:
+prefix-cache locality (shadow radix index over routed prompts), load and
+free-page headroom (replica /statusz snapshots), deadline slack, priority
+classes, and role pools — degrading to round-robin whenever the signals
+go stale. Placement-only by construction: a routing misprediction can
+cost latency, never change output.
+"""
+
+from areal_tpu.routing.policy import (
+    Candidate,
+    RouteDecision,
+    pick,
+    pick_least_loaded,
+)
+from areal_tpu.routing.router import Router
+from areal_tpu.routing.shadow_index import AffinityMap, ShadowPrefixIndex
+from areal_tpu.routing.snapshot import ReplicaSnapshot, SnapshotPoller
+
+__all__ = [
+    "AffinityMap",
+    "Candidate",
+    "ReplicaSnapshot",
+    "RouteDecision",
+    "Router",
+    "ShadowPrefixIndex",
+    "SnapshotPoller",
+    "pick",
+    "pick_least_loaded",
+]

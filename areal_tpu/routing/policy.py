@@ -1,0 +1,191 @@
+"""Replica scoring policy: prefix overlap, load, headroom, deadline slack.
+
+Pure functions over :class:`~areal_tpu.routing.snapshot.ReplicaSnapshot`
+views + shadow-index overlap estimates — no I/O, no clocks beyond the
+slack the caller computed — so every decision is unit-testable and
+auditable. The Router facade owns the stateful parts (poller, shadow,
+demotions, RR cursors) and calls :func:`pick`.
+
+Score (higher wins)::
+
+    w_prefix * overlap_frac            # cached prefix pages / prompt pages
+  - w_queue  * queue_fraction          # slots busy + queue behind them
+  - w_pages  * (1 - free_page_frac)    # KV pool pressure
+  - w_ttft   * ewma_ttft_s             # recent responsiveness
+  - demotion                           # transient 429-backpressure penalty
+
+Deadline rush: when the request's remaining slack is below
+``rush_slack_s``, the prefix term is dropped — a cold prefill on an empty
+replica beats queueing behind a warm cache when the deadline is already
+breathing down the request's neck.
+
+Role pools (soft fencing): with a non-empty ``role_map``, prompts of
+``long_prompt_tokens`` or more are fenced INTO the ``prefill``-tagged
+pool and everything else OUT of it, so a long prompt's prefill can't
+stall interactive decode on the interactive replicas. Routing-only: if
+the preferred pool has no healthy member the full candidate set is used —
+fencing must never strand a request.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from areal_tpu.routing.snapshot import ReplicaSnapshot
+
+# reasons exported on areal_router_decisions_total{reason}
+REASON_AFFINITY = "affinity"
+REASON_PREFIX = "prefix_overlap"
+REASON_LEAST_LOADED = "least_loaded"
+REASON_RUSH = "rush_deadline"
+REASON_ROLE_POOL = "role_pool"
+REASON_ROUND_ROBIN = "round_robin"
+REASON_STALE = "stale_snapshots"
+REASON_SINGLE = "single_candidate"
+
+# scores within this of the max are a tie (broken by rotation so equal
+# replicas share load instead of the first one absorbing everything)
+TIE_EPS = 1e-6
+
+
+@dataclasses.dataclass
+class Candidate:
+    addr: str
+    snapshot: ReplicaSnapshot | None = None
+    overlap_pages: int = 0
+    inflight: int = 0  # this client's own outstanding requests on the replica
+    ewma_ttft_s: float = 0.0
+    demotion: float = 0.0
+    score: float = 0.0
+
+
+@dataclasses.dataclass
+class RouteDecision:
+    addr: str
+    reason: str
+    score: float = 0.0
+    overlap_pages: int = 0
+    considered: int = 0
+
+
+def score_candidate(
+    cand: Candidate, prompt_pages: int, cfg, rush: bool
+) -> float:
+    """One candidate's score (cfg is api.config.RoutingConfig)."""
+    snap = cand.snapshot
+    s = 0.0
+    if not rush and prompt_pages > 0:
+        s += cfg.w_prefix * (cand.overlap_pages / prompt_pages)
+    if snap is not None:
+        s -= cfg.w_queue * (
+            snap.load_fraction()
+            + snap.queue_depth / max(1, cfg.queue_norm)
+        )
+        s -= cfg.w_pages * (1.0 - snap.free_page_fraction())
+    # the client's own outstanding requests: fresh at any rate (snapshots
+    # lag a poll interval, which under a burst is long enough to pile the
+    # whole wave onto one warm replica)
+    slots = snap.max_batch_size if snap is not None else 4
+    s -= cfg.w_inflight * (cand.inflight / max(1, slots))
+    s -= cfg.w_ttft * cand.ewma_ttft_s
+    s -= cand.demotion
+    return s
+
+
+def apply_role_pool(
+    candidates: list[Candidate], cfg, prompt_tokens: int
+) -> tuple[list[Candidate], bool]:
+    """Soft role fencing. Returns (pool, fenced): ``fenced`` is True when
+    the map actually narrowed the set (for the decision reason)."""
+    if not cfg.role_map:
+        return candidates, False
+    want_prefill = prompt_tokens >= cfg.long_prompt_tokens
+
+    def role_of(c: Candidate) -> str:
+        return cfg.role_map.get(c.addr, "")
+
+    if want_prefill:
+        pool = [c for c in candidates if role_of(c) == "prefill"]
+    else:
+        pool = [c for c in candidates if role_of(c) != "prefill"]
+    if not pool or len(pool) == len(candidates):
+        return candidates, False
+    return pool, True
+
+
+def pick(
+    candidates: list[Candidate],
+    cfg,
+    rr_cursor: int,
+    prompt_tokens: int = 0,
+    rush: bool = False,
+    page_size: int | None = None,
+) -> RouteDecision:
+    """Score-and-select over healthy candidates.
+
+    ``rr_cursor`` breaks ties (and drives the degraded round-robin path)
+    deterministically — the caller advances it per decision. Degradation:
+    when no candidate has a live snapshot AND no shadow overlap exists,
+    there is nothing to score on, so the pick is plain rotation with
+    reason ``stale_snapshots``.
+    """
+    assert candidates, "pick() needs at least one candidate"
+    n_all = len(candidates)
+    if n_all == 1:
+        return RouteDecision(
+            addr=candidates[0].addr,
+            reason=REASON_SINGLE,
+            overlap_pages=candidates[0].overlap_pages,
+            considered=1,
+        )
+    pool, fenced = apply_role_pool(candidates, cfg, prompt_tokens)
+    have_signal = any(
+        c.snapshot is not None or c.overlap_pages > 0 or c.inflight > 0
+        for c in pool
+    )
+    if not have_signal:
+        chosen = pool[rr_cursor % len(pool)]
+        return RouteDecision(
+            addr=chosen.addr, reason=REASON_STALE, considered=n_all
+        )
+    psz = max(1, page_size or cfg.shadow_page_size)
+    prompt_pages = max(0, (prompt_tokens - 1) // psz) if prompt_tokens else 0
+    for c in pool:
+        c.score = score_candidate(c, prompt_pages, cfg, rush)
+    best = max(c.score for c in pool)
+    tied = [c for c in pool if best - c.score <= TIE_EPS]
+    chosen = tied[rr_cursor % len(tied)]
+    if rush:
+        reason = REASON_RUSH
+    elif chosen.overlap_pages > 0:
+        reason = REASON_PREFIX
+    elif len(tied) == len(pool):
+        # nothing separated the pool: this was rotation, say so
+        reason = REASON_ROLE_POOL if fenced else REASON_ROUND_ROBIN
+    elif fenced:
+        reason = REASON_ROLE_POOL
+    else:
+        reason = REASON_LEAST_LOADED
+    return RouteDecision(
+        addr=chosen.addr,
+        reason=reason,
+        score=chosen.score,
+        overlap_pages=chosen.overlap_pages,
+        considered=n_all,
+    )
+
+
+def pick_least_loaded(
+    backends: list[str], load: dict[str, int], rr_cursor: int
+) -> tuple[str, str]:
+    """The gateway's session-placement policy (one shared brain with the
+    client so both report through areal_router_decisions_total): least
+    current load, rotation among ties. Returns (backend, reason)."""
+    assert backends, "need at least one backend"
+    if len(backends) == 1:
+        return backends[0], REASON_SINGLE
+    lo = min(load.get(b, 0) for b in backends)
+    tied = [b for b in backends if load.get(b, 0) == lo]
+    chosen = tied[rr_cursor % len(tied)]
+    reason = REASON_ROUND_ROBIN if len(tied) == len(backends) else REASON_LEAST_LOADED
+    return chosen, reason

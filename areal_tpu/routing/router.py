@@ -1,0 +1,280 @@
+"""Router facade: the stateful routing brain shared by client and gateway.
+
+Owns the replica snapshot poller, the shadow prefix index, per-replica
+EWMA TTFT, transient 429-backpressure demotions, and the round-robin
+cursors — and turns a candidate list + request context into an audited
+:class:`~areal_tpu.routing.policy.RouteDecision`. Composes with the
+robustness layer rather than replacing it:
+
+- the caller passes only replicas its :class:`FleetHealth` still allows
+  (evicted/tripped replicas never reach the router); the router
+  additionally drops replicas whose snapshot says ``draining``;
+- a 429 is backpressure, not failure: :meth:`note_backpressure` demotes
+  the replica's score for ``demote_s`` instead of tripping a circuit;
+- a stale/absent snapshot degrades the policy to round-robin — no request
+  ever fails because routing failed (misprediction costs placement, never
+  output: the decode engines are deterministic under greedy regardless of
+  which replica runs the request).
+
+Every decision lands in the PR 7 flight recorder (kind
+``router_decision``) and on ``areal_router_decisions_total{reason}``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from areal_tpu.observability import catalog
+from areal_tpu.observability import timeline as tl_mod
+from areal_tpu.routing import policy as _policy
+from areal_tpu.routing.policy import Candidate, RouteDecision
+from areal_tpu.routing.shadow_index import ShadowPrefixIndex
+from areal_tpu.routing.snapshot import SnapshotPoller
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("routing.router")
+
+_EWMA_ALPHA = 0.3
+
+
+class Router:
+    """One per client process (and one per gateway, load-only)."""
+
+    def __init__(
+        self,
+        routing_cfg,
+        addresses_fn=None,
+        fetch_statusz=None,
+        flight=None,
+    ):
+        self.cfg = routing_cfg
+        self.shadow = ShadowPrefixIndex(
+            page_size=routing_cfg.shadow_page_size,
+            max_pages_per_replica=routing_cfg.shadow_max_pages,
+        )
+        self.poller = SnapshotPoller(
+            addresses_fn or (lambda: []),
+            fetch=fetch_statusz,
+            interval_s=routing_cfg.poll_interval_s,
+            ttl_s=routing_cfg.snapshot_ttl_s,
+            on_snapshot=self._on_snapshot,
+        )
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._ewma_ttft: dict[str, float] = {}
+        self._demoted_until: dict[str, float] = {}
+        self._inflight: dict[str, int] = {}
+        self._obs = catalog.router_metrics()
+        self._flight = flight or tl_mod.get_flight_recorder()
+        # local decision ledger for bench/self-test reporting (the metric
+        # registry is process-global; A/B arms need their own view)
+        self.decisions: dict[str, int] = {}
+        self.predicted_hits = 0
+        self.actual_hits = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.poller.start()
+
+    def stop(self) -> None:
+        self.poller.stop()
+
+    # -- snapshot feedback -------------------------------------------------
+    def _on_snapshot(self, addr, snap, doc) -> None:
+        pc = doc.get("prefix_cache")
+        if pc is not None:
+            self.shadow.reconcile(addr, pc)
+
+    # -- request-path feedback ---------------------------------------------
+    def begin_request(self, addr: str) -> None:
+        """One outstanding request dispatched to ``addr`` (paired with
+        :meth:`end_request`). This client-local counter is the score's
+        freshest load signal — polled snapshots lag a poll interval, which
+        under a burst is long enough to pile a whole arrival wave onto
+        one warm replica before its queue depth ever gets scraped."""
+        with self._lock:
+            self._inflight[addr] = self._inflight.get(addr, 0) + 1
+
+    def end_request(self, addr: str) -> None:
+        with self._lock:
+            n = self._inflight.get(addr, 0) - 1
+            if n > 0:
+                self._inflight[addr] = n
+            else:
+                self._inflight.pop(addr, None)
+
+    def move_request(self, old: str, new: str) -> None:
+        """Failover moved an outstanding request between replicas."""
+        if old != new:
+            self.end_request(old)
+            self.begin_request(new)
+
+    def note_backpressure(self, addr: str) -> None:
+        """A 429 from ``addr``: demote its score for demote_s — the
+        admission gate said "not here right now", which is routing signal,
+        not replica death (circuit/failover must NOT trip)."""
+        with self._lock:
+            self._demoted_until[addr] = time.monotonic() + self.cfg.demote_s
+        self._obs.backpressure_demotions.inc()
+
+    def note_result(
+        self,
+        addr: str,
+        ids=None,
+        version: int | None = None,
+        ttft_s: float | None = None,
+        cached_prefix_tokens: int = 0,
+    ) -> None:
+        """Fold one finished generation back in: the full token sequence
+        becomes shadow-cached prefix on its replica, the TTFT feeds the
+        EWMA, and a replica-reported radix hit scores the predicted-vs-
+        actual audit."""
+        if ids:
+            self.shadow.note_routed(addr, ids, version=version)
+        if ttft_s is not None and ttft_s > 0:
+            with self._lock:
+                prev = self._ewma_ttft.get(addr)
+                self._ewma_ttft[addr] = (
+                    ttft_s
+                    if prev is None
+                    else _EWMA_ALPHA * ttft_s + (1 - _EWMA_ALPHA) * prev
+                )
+        if cached_prefix_tokens > 0:
+            self._obs.actual_hits.inc()
+            with self._lock:
+                self.actual_hits += 1
+
+    def on_weight_commit(self, version: int | None = None) -> None:
+        self.shadow.on_weight_commit(version)
+
+    def on_replica_reset(self, addr: str) -> None:
+        """Evict/respawn: the replica's cache restarted empty."""
+        self.shadow.drop_replica(addr)
+        self.poller.forget(addr)
+        with self._lock:
+            self._ewma_ttft.pop(addr, None)
+            self._demoted_until.pop(addr, None)
+
+    # -- the decision ------------------------------------------------------
+    def choose(
+        self,
+        candidates: list[str],
+        rid: str | None = None,
+        token_ids=None,
+        deadline: float | None = None,
+        priority: str | None = None,
+    ) -> RouteDecision:
+        """Pick a replica from ``candidates`` (already health-filtered by
+        the caller). Never raises on routing grounds: with no usable
+        signal it degrades to rotation over the given candidates."""
+        assert candidates, "choose() needs at least one candidate"
+        now = time.monotonic()
+        with self._lock:
+            rr = self._rr
+            self._rr += 1
+            demoted = {
+                a: u for a, u in self._demoted_until.items() if u > now
+            }
+            self._demoted_until = demoted
+            ewma = dict(self._ewma_ttft)
+            inflight = dict(self._inflight)
+        cands: list[Candidate] = []
+        for addr in candidates:
+            snap = self.poller.get(addr)
+            if snap is not None and snap.draining:
+                continue
+            cands.append(
+                Candidate(
+                    addr=addr,
+                    snapshot=snap,
+                    overlap_pages=(
+                        self.shadow.overlap_pages(addr, token_ids)
+                        if token_ids
+                        else 0
+                    ),
+                    inflight=inflight.get(addr, 0),
+                    ewma_ttft_s=ewma.get(addr, 0.0),
+                    demotion=(
+                        self.cfg.demote_penalty if addr in demoted else 0.0
+                    ),
+                )
+            )
+        if not cands:
+            # the whole candidate set is draining: last-resort rotation
+            # (their admission gates will 429 and backpressure handles it)
+            cands = [Candidate(addr=a) for a in candidates]
+        rush = (
+            deadline is not None
+            and (deadline - time.time()) < self.cfg.rush_slack_s
+        )
+        decision = _policy.pick(
+            cands,
+            self.cfg,
+            rr,
+            prompt_tokens=len(token_ids) if token_ids else 0,
+            rush=rush,
+            page_size=self.shadow.page_size,
+        )
+        self._audit(decision, rid=rid, priority=priority)
+        return decision
+
+    def note_affinity(
+        self, addr: str, rid: str | None = None, token_ids=None
+    ) -> None:
+        """Audit an affinity-pinned placement (the caller short-circuited
+        the scorer because the rid's KV already lives on ``addr``). The
+        shadow overlap is still computed so the predicted-vs-actual hit
+        audit stays symmetric — affinity placements produce real engine
+        hits, and skipping the prediction here would read as shadow-index
+        drift on the dashboard."""
+        self._audit(
+            RouteDecision(
+                addr=addr,
+                reason=_policy.REASON_AFFINITY,
+                overlap_pages=(
+                    self.shadow.overlap_pages(addr, token_ids)
+                    if token_ids
+                    else 0
+                ),
+            ),
+            rid=rid,
+        )
+
+    def _audit(
+        self,
+        decision: RouteDecision,
+        rid: str | None = None,
+        priority: str | None = None,
+    ) -> None:
+        self._obs.decisions.labels(reason=decision.reason).inc()
+        self._obs.prefix_overlap.observe(float(decision.overlap_pages))
+        if decision.overlap_pages > 0:
+            self._obs.predicted_hits.inc()
+        with self._lock:
+            self.decisions[decision.reason] = (
+                self.decisions.get(decision.reason, 0) + 1
+            )
+            if decision.overlap_pages > 0:
+                self.predicted_hits += 1
+        data = {
+            "replica": decision.addr,
+            "reason": decision.reason,
+            "overlap_pages": decision.overlap_pages,
+        }
+        if rid:
+            data["rid"] = rid
+        if priority:
+            data["priority"] = priority
+        self._flight.record("router_decision", **data)
+
+    # -- reporting ---------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "decisions": dict(self.decisions),
+                "predicted_hits": self.predicted_hits,
+                "actual_hits": self.actual_hits,
+                "shadow": dict(self.shadow.stats),
+                "ewma_ttft_s": dict(self._ewma_ttft),
+            }

@@ -1,0 +1,236 @@
+"""Replica snapshot poller: the router's per-replica view of the fleet.
+
+The cache-aware policy needs to know, per replica, how busy it is (queue
+depth, active slots), how much KV headroom it has (free pages), and what
+its radix prefix cache holds (pages held, flush count) — all of which the
+inference server already publishes on ``/statusz`` (the ``lifecycle``,
+``prefix_cache``, and ``drain`` sections PR 5–PR 8 built). This module
+polls those sections on a background thread and serves bounded-staleness
+:class:`ReplicaSnapshot` views to the scoring policy.
+
+Degradation contract (docs/serving.md "Cache-aware routing"): a replica
+whose scrape fails keeps its last snapshot until ``ttl_s`` expires, then
+reads as *absent* — and when NO candidate has a live snapshot the policy
+falls back to round-robin. Routing never fails a request; it only places
+it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable
+
+from areal_tpu.observability import catalog
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("routing.snapshot")
+
+# /statusz scrape timeout: a dead replica must cost the poll loop
+# milliseconds-to-seconds, never a request timeout
+SCRAPE_TIMEOUT_S = 2.0
+
+
+@dataclasses.dataclass
+class ReplicaSnapshot:
+    """One replica's routing-relevant state at ``fetched_at`` (monotonic)."""
+
+    addr: str
+    fetched_at: float
+    version: int = -1
+    draining: bool = False
+    paused: bool = False
+    # lifecycle section (DecodeEngine.admission_snapshot)
+    queue_depth: int = 0
+    active_slots: int = 0
+    max_batch_size: int = 1
+    free_pages: int = 0
+    radix_pages: int = 0
+    n_pages: int = 0
+    # prefix_cache section (DecodeEngine.prefix_cache_stats)
+    cache_enabled: bool = False
+    pages_held: int = 0
+    flushes: int = 0
+    page_size: int = 0
+    hit_tokens: int = 0
+
+    @classmethod
+    def from_statusz(
+        cls, addr: str, doc: dict, now: float | None = None
+    ) -> "ReplicaSnapshot":
+        """Parse a /statusz document, tolerating absent sections (older
+        servers, or engines without lifecycle/prefix-cache support): every
+        missing field keeps its neutral default, and the snapshot is still
+        usable for load-only scoring."""
+        snap = cls(
+            addr=addr,
+            fetched_at=now if now is not None else time.monotonic(),
+        )
+        try:
+            snap.version = int(doc.get("version", -1))
+        except (TypeError, ValueError):
+            pass
+        snap.paused = bool(doc.get("paused", False))
+        lc = doc.get("lifecycle")
+        if isinstance(lc, dict):
+            snap.queue_depth = int(lc.get("queue_depth", 0) or 0)
+            snap.active_slots = int(lc.get("active_slots", 0) or 0)
+            snap.max_batch_size = max(1, int(lc.get("max_batch_size", 1) or 1))
+            snap.free_pages = int(lc.get("free_pages", 0) or 0)
+            snap.radix_pages = int(lc.get("radix_pages", 0) or 0)
+            snap.n_pages = int(lc.get("n_pages", 0) or 0)
+        pc = doc.get("prefix_cache")
+        if isinstance(pc, dict):
+            snap.cache_enabled = bool(pc.get("enabled", False))
+            snap.pages_held = int(pc.get("pages_held", 0) or 0)
+            snap.flushes = int(pc.get("flushes", 0) or 0)
+            snap.page_size = int(pc.get("page_size", 0) or 0)
+            snap.hit_tokens = int(pc.get("hit_tokens", 0) or 0)
+        dr = doc.get("drain")
+        if isinstance(dr, dict):
+            snap.draining = bool(dr.get("draining", False))
+        return snap
+
+    def age(self, now: float | None = None) -> float:
+        return (now if now is not None else time.monotonic()) - self.fetched_at
+
+    def load_fraction(self) -> float:
+        """Busy-ness in [0, inf): active slots over capacity, plus the
+        queue behind them (normalized by the scoring policy)."""
+        return self.active_slots / max(1, self.max_batch_size)
+
+    def free_page_fraction(self) -> float:
+        """Allocatable-page headroom in [0, 1]; radix-held pages count as
+        reclaimable (first rung of the eviction ladder). Unknown pool size
+        reads as fully free — absent data must not repel traffic."""
+        if self.n_pages <= 1:
+            return 1.0
+        return min(1.0, (self.free_pages + self.radix_pages) / (self.n_pages - 1))
+
+
+def _default_fetch(addr: str) -> dict:
+    """GET http://{addr}/statusz with a short timeout (poll-thread only)."""
+    import json
+    import urllib.request
+
+    with urllib.request.urlopen(
+        f"http://{addr}/statusz", timeout=SCRAPE_TIMEOUT_S
+    ) as r:
+        return json.loads(r.read() or b"{}")
+
+
+class SnapshotPoller:
+    """Background /statusz poller with bounded-staleness reads.
+
+    ``addresses_fn`` supplies the live fleet each round (discovery may
+    extend it). ``on_snapshot(addr, snapshot, doc)`` fires per successful
+    scrape — the router uses it to reconcile the shadow prefix index
+    against the replica's own ``prefix_cache`` stats. All state is behind
+    one lock: the poll thread writes, request paths read.
+    """
+
+    def __init__(
+        self,
+        addresses_fn: Callable[[], list[str]],
+        fetch: Callable[[str], dict] | None = None,
+        interval_s: float = 2.0,
+        ttl_s: float = 15.0,
+        on_snapshot: Callable[[str, ReplicaSnapshot, dict], None] | None = None,
+    ):
+        self._addresses_fn = addresses_fn
+        self._fetch = fetch or _default_fetch
+        self.interval_s = max(0.1, interval_s)
+        self.ttl_s = ttl_s
+        self._on_snapshot = on_snapshot
+        self._lock = threading.Lock()
+        self._snapshots: dict[str, ReplicaSnapshot] = {}
+        self._thread: threading.Thread | None = None
+        self._stop: threading.Event | None = None
+        self._obs = catalog.router_metrics()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        stop = threading.Event()
+        self._stop = stop
+
+        def loop():
+            while not stop.wait(self.interval_s):
+                try:
+                    self.poll_once()
+                except Exception:  # noqa: BLE001 — polling must never die
+                    logger.exception("snapshot poll round failed")
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="router-snapshot-poll"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=5)
+            self._thread = None
+            self._stop = None
+
+    # -- polling -----------------------------------------------------------
+    def poll_once(self) -> dict[str, ReplicaSnapshot]:
+        """One scrape round over the current fleet. A failed scrape leaves
+        the previous snapshot in place (it ages out via ttl_s) — transient
+        scrape noise must not flap the candidate set."""
+        fleet = list(self._addresses_fn() or [])
+        for addr in fleet:
+            try:
+                doc = self._fetch(addr)
+            except Exception as e:  # noqa: BLE001 — a failed scrape IS the
+                # signal; the stale snapshot ages out on its own
+                logger.debug(f"statusz scrape {addr} failed: {e!r}")
+                continue
+            self.ingest(addr, doc)
+        # gauge over every CURRENT fleet member's snapshot, stale or not:
+        # when replicas stop answering the age must keep climbing past
+        # ttl_s (that crossing IS the documented degraded-to-round-robin
+        # alert condition) — but a replica that left the fleet entirely
+        # must not pin the gauge high forever
+        with self._lock:
+            ages = [
+                self._snapshots[a].age() for a in fleet if a in self._snapshots
+            ]
+        if ages:
+            self._obs.snapshot_age.set(max(ages))
+        return self.live()
+
+    def ingest(self, addr: str, doc: dict) -> ReplicaSnapshot:
+        """Fold one /statusz document (scraped or injected by tests /
+        in-process fleets) into the snapshot table."""
+        snap = ReplicaSnapshot.from_statusz(addr, doc)
+        with self._lock:
+            self._snapshots[addr] = snap
+        if self._on_snapshot is not None:
+            try:
+                self._on_snapshot(addr, snap, doc)
+            except Exception:  # noqa: BLE001 — reconcile bugs must not
+                # break polling (the router degrades, never fails)
+                logger.exception("snapshot callback failed")
+        return snap
+
+    # -- reads -------------------------------------------------------------
+    def get(self, addr: str, now: float | None = None) -> ReplicaSnapshot | None:
+        """The replica's snapshot, or None once it is older than ttl_s."""
+        with self._lock:
+            snap = self._snapshots.get(addr)
+        if snap is None or snap.age(now) > self.ttl_s:
+            return None
+        return snap
+
+    def live(self, now: float | None = None) -> dict[str, ReplicaSnapshot]:
+        now = now if now is not None else time.monotonic()
+        with self._lock:
+            items = list(self._snapshots.items())
+        return {a: s for a, s in items if s.age(now) <= self.ttl_s}
+
+    def forget(self, addr: str) -> None:
+        with self._lock:
+            self._snapshots.pop(addr, None)

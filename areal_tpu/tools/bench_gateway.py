@@ -23,6 +23,10 @@ Usage:
     # against an existing gateway:
     python -m areal_tpu.tools.bench_gateway --gateway http://host:port \
         --admin-key KEY --interactive 64 --rollout 64 --duration 60
+    # routing A/B (ROADMAP item 3): round_robin vs cache_aware on an
+    # 80%-shared-prefix multi-turn-style workload, one report:
+    python -m areal_tpu.tools.bench_gateway --ab --replicas 3 \
+        --workload shared_prefix --duration 15 -o ab.json
 """
 
 from __future__ import annotations
@@ -44,6 +48,34 @@ from areal_tpu.utils import logging as alog
 logger = alog.getLogger("bench_gateway")
 
 PRIORITIES = ("interactive", "rollout")
+
+
+def make_shared_prefix_prompts(
+    n: int,
+    shared_frac: float = 0.8,
+    total_chars: int = 400,
+    seed: int = 11,
+) -> list[str]:
+    """The router scoreboard's workload: ``n`` prompts sharing the first
+    ``shared_frac`` of their characters (the CharTokenizer maps one char
+    to one token, so this IS an 80%-shared token prefix) with unique
+    suffixes — the multi-turn-agent shape where prefix-locality routing
+    pays: replicas that already hold the shared prefix's KV pages prefill
+    only the suffix."""
+    import random as _random
+    import string
+
+    rng = _random.Random(seed)
+    alphabet = string.ascii_lowercase + " "
+    shared_len = max(0, min(total_chars, int(total_chars * shared_frac)))
+    shared = "".join(rng.choice(alphabet) for _ in range(shared_len))
+    out = []
+    for _ in range(n):
+        sfx = "".join(
+            rng.choice(alphabet) for _ in range(total_chars - shared_len)
+        )
+        out.append(shared + sfx)
+    return out
 
 
 def _percentile(values: list[float], q: float) -> float | None:
@@ -99,9 +131,15 @@ async def _one_client(
     max_completion_tokens: int,
     prompt: str,
     stats: _ClassStats,
+    turns: int = 1,
 ) -> None:
-    """One open-loop client: session -> one prioritized chat completion
-    (honoring 429 Retry-After inside the deadline budget) -> end session.
+    """One open-loop client: session -> ``turns`` sequential prioritized
+    chat completions -> end session, honoring 429 Retry-After inside the
+    deadline budget. With ``turns > 1`` this is a multi-turn episode: each
+    turn appends the assistant's reply plus a follow-up message, so turn
+    t's prompt extends turn t-1's — the conversation-history locality
+    that prefix-aware routing exploits (and round-robin re-prefills on a
+    cold replica ~(N-1)/N of the time).
     The session ends on EVERY exit path: an abandoned session burns one of
     the proxy's capacity units forever, and a bench that leaks capacity
     under sustained overload corrupts its own scoreboard (start_session
@@ -127,61 +165,83 @@ async def _one_client(
             "x-areal-priority": priority,
             "x-areal-deadline": f"{time.time() + (budget_end - time.monotonic()):.6f}",
         }
-        body = {
-            "messages": [{"role": "user", "content": prompt}],
-            "max_completion_tokens": max_completion_tokens,
-            "model": "bench",
-        }
-        comp = None
+        messages = [{"role": "user", "content": prompt}]
         was_shed = False
-        while True:
-            async with http.post(
-                f"{gateway_url}/v1/chat/completions", json=body, headers=headers
-            ) as r:
-                if r.status == 429:
-                    stats.shed_429 += 1
-                    if not was_shed:
-                        was_shed = True
-                        stats.shed_requests += 1
-                    # floor: a foreign gateway's "Retry-After: 0" must not
-                    # hot-spin the bench into amplifying the overload; the
-                    # RFC 7231 HTTP-date form falls back to the default
-                    # rather than misclassifying the shed as an error
-                    try:
-                        ra = float(r.headers.get("Retry-After", "0.5") or 0.5)
-                    except ValueError:
-                        ra = 0.5
-                    ra = max(0.05, ra)
-                    if time.monotonic() + ra >= budget_end:
-                        return  # budget exhausted while shed
-                    await asyncio.sleep(ra)
-                    continue
-                if r.status != 200:
-                    stats.errors += 1
-                    return
-                comp = await r.json(content_type=None)
+        session_tokens = 0
+        reaped = False
+        for turn in range(max(1, turns)):
+            body = {
+                "messages": messages,
+                "max_completion_tokens": max_completion_tokens,
+                "model": "bench",
+            }
+            comp = None
+            while True:
+                async with http.post(
+                    f"{gateway_url}/v1/chat/completions",
+                    json=body,
+                    headers=headers,
+                ) as r:
+                    if r.status == 429:
+                        stats.shed_429 += 1
+                        if not was_shed:
+                            was_shed = True
+                            stats.shed_requests += 1
+                        # floor: a foreign gateway's "Retry-After: 0" must
+                        # not hot-spin the bench into amplifying the
+                        # overload; the RFC 7231 HTTP-date form falls back
+                        # to the default rather than misclassifying the
+                        # shed as an error
+                        try:
+                            ra = float(
+                                r.headers.get("Retry-After", "0.5") or 0.5
+                            )
+                        except ValueError:
+                            ra = 0.5
+                        ra = max(0.05, ra)
+                        if time.monotonic() + ra >= budget_end:
+                            return  # budget exhausted while shed
+                        await asyncio.sleep(ra)
+                        continue
+                    if r.status != 200:
+                        stats.errors += 1
+                        return
+                    comp = await r.json(content_type=None)
+                    break
+            timing = comp.get("areal_timing") or {}
+            usage = comp.get("usage") or {}
+            n_tok = int(usage.get("completion_tokens") or 0)
+            session_tokens += n_tok
+            stats.tokens += n_tok
+            if n_tok > 0 and timing.get("ttft_s"):
+                # EVERY turn's TTFT enters the distribution — turns 2+
+                # are exactly where prefix routing shows up (warm
+                # suffix-only prefill vs a cold re-prefill of the whole
+                # history). Zero-token completions (queued-expiry reaps)
+                # never emitted a first token: their fallback ttft is the
+                # full wall latency and would saturate p99 at the
+                # deadline — counted by deadline_reaped, not the TTFT dist
+                stats.ttft_s.append(float(timing["ttft_s"]))
+            if (
+                timing.get("truncated_by") == "deadline"
+                or timing.get("stop_reason") == "deadline"
+            ):
+                reaped = True
                 break
+            messages = messages + [
+                {
+                    "role": "assistant",
+                    "content": comp["choices"][0]["message"]["content"] or "",
+                },
+                {"role": "user", "content": f"go deeper on part {turn + 2}"},
+            ]
         e2e = time.monotonic() - t0
-        timing = comp.get("areal_timing") or {}
-        usage = comp.get("usage") or {}
-        n_tok = int(usage.get("completion_tokens") or 0)
-        reaped = (
-            timing.get("truncated_by") == "deadline"
-            or timing.get("stop_reason") == "deadline"
-        )
         stats.completed += 1
         stats.e2e_s.append(e2e)
-        stats.tokens += n_tok
-        if n_tok > 0 and timing.get("ttft_s"):
-            # zero-token completions (queued-expiry reaps) never emitted a
-            # first token: their fallback ttft is the full wall latency and
-            # would saturate p99 at the deadline — they are counted by
-            # deadline_reaped, not by the TTFT distribution
-            stats.ttft_s.append(float(timing["ttft_s"]))
         if reaped:
             stats.deadline_reaped += 1
         elif e2e <= deadline_s:
-            stats.tokens_within_deadline += n_tok
+            stats.tokens_within_deadline += session_tokens
     except Exception as e:  # noqa: BLE001 — one client's failure is a data
         # point (errors count), not a bench abort
         logger.debug(f"bench client failed: {e!r}")
@@ -209,19 +269,28 @@ async def drive_gateway(
     rollout_deadline_s: float = 30.0,
     interactive_tokens: int = 16,
     rollout_tokens: int = 128,
+    interactive_prompts: list[str] | None = None,
+    rollout_prompts: list[str] | None = None,
+    turns: int = 1,
+    rounds: int = 1,
 ) -> dict[str, Any]:
     """Open-loop drive: each class's clients start on a fixed arrival
-    schedule spread over ``duration_s``. Returns the report dict."""
+    schedule spread over ``duration_s``. ``*_prompts`` override the default
+    single prompt per class (client i takes prompts[i % len]) — the
+    shared-prefix router workload rides through here; ``turns`` makes each
+    client a multi-turn episode. ``rounds`` repeats the whole schedule
+    back-to-back into ONE aggregated report (the A/B uses it to average
+    out scheduling transients). Returns the report dict."""
     import aiohttp
 
     stats = {p: _ClassStats() for p in PRIORITIES}
     t_start = time.monotonic()
 
-    async def schedule(priority, n, deadline_s, max_tokens, prompt):
+    async def schedule(priority, n, deadline_s, max_tokens, prompts, t0, rnd):
         async with aiohttp.ClientSession() as http:
             tasks = []
             for i in range(n):
-                target = t_start + (i * duration_s / max(1, n))
+                target = t0 + (i * duration_s / max(1, n))
                 delay = max(0.0, target - time.monotonic())
                 if delay:
                     await asyncio.sleep(delay)
@@ -234,29 +303,38 @@ async def drive_gateway(
                             priority,
                             deadline_s,
                             max_tokens,
-                            prompt,
+                            # rounds walk forward through the prompt list so
+                            # a replayed schedule still sees fresh suffixes
+                            prompts[(rnd * n + i) % len(prompts)],
                             stats[priority],
+                            turns=turns,
                         )
                     )
                 )
             await asyncio.gather(*tasks)
 
-    await asyncio.gather(
-        schedule(
-            "interactive",
-            n_interactive,
-            interactive_deadline_s,
-            interactive_tokens,
-            "ping?",
-        ),
-        schedule(
-            "rollout",
-            n_rollout,
-            rollout_deadline_s,
-            rollout_tokens,
-            "solve this problem step by step please",
-        ),
-    )
+    for rnd in range(max(1, rounds)):
+        t0 = time.monotonic()
+        await asyncio.gather(
+            schedule(
+                "interactive",
+                n_interactive,
+                interactive_deadline_s,
+                interactive_tokens,
+                interactive_prompts or ["ping?"],
+                t0,
+                rnd,
+            ),
+            schedule(
+                "rollout",
+                n_rollout,
+                rollout_deadline_s,
+                rollout_tokens,
+                rollout_prompts or ["solve this problem step by step please"],
+                t0,
+                rnd,
+            ),
+        )
     wall = time.monotonic() - t_start
     report = {
         "bench": "gateway_goodput",
@@ -300,6 +378,10 @@ class LocalFleet:
         gateway_max_inflight: int = 0,
         gateway_interactive_headroom: int = 0,
         seed: int = 7,
+        route_policy: str = "round_robin",
+        max_seq_len: int = 512,
+        routing_kw: dict | None = None,
+        model: str = "tiny",
     ):
         self.n_replicas = n_replicas
         self.max_batch_size = max_batch_size
@@ -309,6 +391,10 @@ class LocalFleet:
         self.gateway_max_inflight = gateway_max_inflight
         self.gateway_interactive_headroom = gateway_interactive_headroom
         self.seed = seed
+        self.route_policy = route_policy
+        self.max_seq_len = max_seq_len
+        self.routing_kw = dict(routing_kw or {})
+        self.model = model
         self.servers: list[Any] = []
         self.client = None
         self._proxy_runner = None
@@ -326,6 +412,7 @@ class LocalFleet:
             InferenceEngineConfig,
             MeshConfig,
             RequestLifecycleConfig,
+            RoutingConfig,
             ServerConfig,
         )
         from areal_tpu.inference.client import RemoteJaxEngine
@@ -345,13 +432,39 @@ class LocalFleet:
 
         from areal_tpu.tools.validate_installation import tiny_model_config
 
-        tiny = tiny_model_config()
+        if self.model == "small":
+            # prefill-costly bench model (the routing A/B): on the toy
+            # 32-dim model a 700-token prefill costs single-digit ms, so
+            # there is nothing for prefix routing to save — this one makes
+            # prompt prefill the dominant per-request cost, like real
+            # serving, while still CPU-feasible
+            tiny = qwen.ModelConfig(
+                vocab_size=128,
+                hidden_size=128,
+                intermediate_size=512,
+                num_layers=4,
+                num_heads=4,
+                num_kv_heads=2,
+                dtype="float32",
+                tie_word_embeddings=True,
+                rope_theta=10000.0,
+            )
+        else:
+            tiny = tiny_model_config()
         params = qwen.init_params(jax.random.PRNGKey(0), tiny)
         for i in range(self.n_replicas):
             cfg = ServerConfig(
                 max_batch_size=self.max_batch_size,
-                max_seq_len=512,
+                max_seq_len=self.max_seq_len,
                 decode_steps_per_call=4,
+                # a real (shared-pool) page budget instead of the dense-
+                # equivalent default: the radix cache may hold up to half
+                # of it, so cross-request prefix reuse isn't evicted by a
+                # handful of concurrent sessions (the router workload's
+                # whole premise). The bigger bench model carries a bigger
+                # per-page cost, so its budget scales to keep a few dozen
+                # session prefixes resident.
+                kv_hbm_gb=0.1 if self.model == "small" else 0.005,
                 seed=self.seed + i,
                 mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
                 lifecycle=RequestLifecycleConfig(
@@ -372,6 +485,11 @@ class LocalFleet:
                 max_head_offpolicyness=1000,
                 request_timeout=120,
                 request_retries=3,
+                routing_policy=self.route_policy,
+                # short bench: snapshots must refresh well inside the run
+                routing=RoutingConfig(
+                    poll_interval_s=0.5, **self.routing_kw
+                ),
             ),
             addresses=[s.address for s in self.servers],
         )
@@ -428,21 +546,152 @@ class LocalFleet:
         for st in self.servers:
             st.stop()
 
+    def mark_baseline(self) -> None:
+        """Snapshot the cumulative engine counters so ``engine_stats``
+        reports deltas from here — the A/B measures its timed window, not
+        the warm-up traffic before it."""
+        self._baseline = {
+            st.address: {
+                k: st.engine.stats[k]
+                for k in (
+                    "generated_tokens",
+                    "prefix_cache_hits",
+                    "prefix_hit_tokens",
+                    "prefill_tokens",
+                )
+            }
+            for st in self.servers
+        }
+
     def engine_stats(self) -> dict[str, Any]:
         """Fleet-level engine counters folded into the report (deadline
-        reaps and timeline health come from the engines themselves)."""
+        reaps, timeline health, and the prefix-reuse numbers the routing
+        A/B compares come from the engines themselves). Counters are
+        deltas from ``mark_baseline`` when one was taken."""
+        base = getattr(self, "_baseline", {})
         out: dict[str, Any] = {"replicas": []}
+        hit_tokens = prefill_tokens = 0
         for st in self.servers:
             eng = st.engine
+            b = base.get(st.address, {})
+
+            def d(key: str) -> int:
+                return eng.stats[key] - b.get(key, 0)
+
+            hit_tokens += d("prefix_hit_tokens")
+            prefill_tokens += d("prefill_tokens")
             out["replicas"].append(
                 {
                     "address": st.address,
-                    "generated_tokens": eng.stats["generated_tokens"],
+                    "generated_tokens": d("generated_tokens"),
                     "deadline_exceeded": eng.stats["deadline_exceeded"],
+                    "prefix_cache_hits": d("prefix_cache_hits"),
+                    "prefix_hit_tokens": d("prefix_hit_tokens"),
+                    "prefill_tokens": d("prefill_tokens"),
                     "timelines": eng.timeline.stats(),
                 }
             )
+        # suffix-only prefill economics: warm tokens over all prompt
+        # tokens admitted (cached + actually prefilled) — the number the
+        # cache-aware arm must raise
+        out["prefix_hit_tokens"] = hit_tokens
+        out["prefill_tokens"] = prefill_tokens
+        out["prefix_hit_rate"] = (
+            hit_tokens / (hit_tokens + prefill_tokens)
+            if (hit_tokens + prefill_tokens) > 0
+            else None
+        )
         return out
+
+
+async def _greedy_probes(
+    gateway_url: str,
+    admin_key: str,
+    prompts: list[str],
+    max_tokens: int = 8,
+) -> list[str]:
+    """Sequential greedy (temperature=0) completions through the gateway.
+
+    Dual duty in the A/B: the returned texts are the byte-identity
+    evidence (routing is placement-only — greedy output must not depend
+    on the policy), and running them BEFORE the timed drive warms both
+    arms' compile caches (incl. the suffix-only prefill variant) so the
+    measured window compares steady-state serving, not XLA compiles."""
+    import aiohttp
+
+    texts: list[str] = []
+    async with aiohttp.ClientSession() as http:
+        for i, prompt in enumerate(prompts):
+            admin = {"Authorization": f"Bearer {admin_key}"}
+            async with http.post(
+                f"{gateway_url}/rl/start_session",
+                json={"task_id": f"probe-{i}"},
+                headers=admin,
+            ) as r:
+                sess = await r.json(content_type=None)
+            key = sess["api_key"]
+            headers = {"Authorization": f"Bearer {key}"}
+            try:
+                async with http.post(
+                    f"{gateway_url}/v1/chat/completions",
+                    json={
+                        "messages": [{"role": "user", "content": prompt}],
+                        "max_completion_tokens": max_tokens,
+                        "temperature": 0,
+                        "model": "bench",
+                    },
+                    headers=headers,
+                ) as r:
+                    # a failed probe is evidence, not an abort: a marker
+                    # text keeps the byte-identity comparison meaningful
+                    # (both arms see the same fleet, so a persistent error
+                    # reproduces; a transient one shows as a mismatch)
+                    if r.status != 200:
+                        texts.append(f"<probe-error:{r.status}>")
+                        continue
+                    comp = await r.json(content_type=None)
+                choices = comp.get("choices") or []
+                msg = (choices[0].get("message") or {}) if choices else {}
+                texts.append(
+                    msg.get("content") or ("" if choices else "<probe-malformed>")
+                )
+            finally:
+                async with http.post(
+                    f"{gateway_url}/rl/end_session",
+                    json={},
+                    headers=headers,
+                ):
+                    pass
+    return texts
+
+
+def _workload_prompts(
+    workload: str,
+    n_interactive: int,
+    n_rollout: int,
+    shared_frac: float,
+    prompt_chars: int,
+    generation: int = 0,
+    generations: int = 1,
+) -> tuple[list[str] | None, list[str] | None]:
+    if workload != "shared_prefix":
+        return None, None
+    # one shared family across BOTH classes (the agent-fleet shape: many
+    # concurrent episodes over one system prompt/task template).
+    # ``generation`` skips past earlier windows' suffix sets over the SAME
+    # shared prefix — the warm-up and measured windows (and each measured
+    # round, via ``generations``) must not replay identical prompts (a
+    # full-prompt radix match would measure memoization, not prefix
+    # routing). Suffixes are split per class so round r's interactive set
+    # never collides with round r-1's rollout set.
+    n = n_interactive + n_rollout
+    prompts = make_shared_prefix_prompts(
+        n * (generation + generations),
+        shared_frac=shared_frac,
+        total_chars=prompt_chars,
+    )[n * generation :]
+    ni_all = n_interactive * generations
+    return prompts[:ni_all] or None, prompts[ni_all:] or None
 
 
 async def run_local_bench(
@@ -450,22 +699,188 @@ async def run_local_bench(
     n_interactive: int = 8,
     n_rollout: int = 8,
     duration_s: float = 15.0,
+    workload: str = "mixed",
+    shared_frac: float = 0.8,
+    prompt_chars: int = 400,
+    interactive_tokens: int = 16,
+    rollout_tokens: int = 128,
+    turns: int = 1,
+    rounds: int = 1,
+    probe_prompts: list[str] | None = None,
+    warmup_s: float = 0.0,
     **fleet_kw: Any,
 ) -> dict[str, Any]:
     fleet = LocalFleet(n_replicas=n_replicas, **fleet_kw)
     try:
         gateway_url, admin_key = await fleet.astart()
+        probe_texts = None
+        if probe_prompts:
+            probe_texts = await _greedy_probes(
+                gateway_url, admin_key, probe_prompts
+            )
+        if warmup_s > 0:
+            # uncounted steady-state warm-up: first-use XLA compiles (incl.
+            # the suffix-only prefill variant at its batched shapes) and
+            # the radix/shadow warm-up must not land inside the measured
+            # window of either A/B arm. Its prompts share the prefix but
+            # none of the suffixes of the measured set (generation 0 vs 1).
+            warm_ip, warm_rp = _workload_prompts(
+                workload,
+                n_interactive,
+                n_rollout,
+                shared_frac,
+                prompt_chars,
+                generation=0,
+            )
+            # FULL client count: the warm-up must reach the same batched
+            # admission shapes (prefill A_pad x bucket x page-table width)
+            # as the measured window, or first-use compiles land in it
+            await drive_gateway(
+                gateway_url,
+                admin_key,
+                n_interactive=n_interactive,
+                n_rollout=n_rollout,
+                duration_s=warmup_s,
+                interactive_tokens=interactive_tokens,
+                rollout_tokens=rollout_tokens,
+                interactive_prompts=warm_ip,
+                rollout_prompts=warm_rp,
+                turns=turns,
+            )
+        ip, rp = _workload_prompts(
+            workload,
+            n_interactive,
+            n_rollout,
+            shared_frac,
+            prompt_chars,
+            generation=1 if warmup_s > 0 else 0,
+            generations=max(1, rounds),
+        )
+        fleet.mark_baseline()
         report = await drive_gateway(
             gateway_url,
             admin_key,
             n_interactive=n_interactive,
             n_rollout=n_rollout,
             duration_s=duration_s,
+            interactive_tokens=interactive_tokens,
+            rollout_tokens=rollout_tokens,
+            interactive_prompts=ip,
+            rollout_prompts=rp,
+            turns=turns,
+            rounds=rounds,
         )
+        report["workload"] = workload
+        report["turns"] = turns
+        report["route_policy"] = fleet.route_policy
         report["fleet"] = fleet.engine_stats()
+        report["router"] = fleet.client.router.stats()
+        report["router_hit_rate"] = report["fleet"]["prefix_hit_rate"]
+        if probe_texts is not None:
+            report["probe_texts"] = probe_texts
         return report
     finally:
         await fleet.astop()
+
+
+async def run_ab(
+    n_replicas: int = 3,
+    n_interactive: int = 18,
+    n_rollout: int = 18,
+    duration_s: float = 4.0,
+    workload: str = "shared_prefix",
+    shared_frac: float = 0.1,
+    # long unique base prompts (the A/B fleet runs a 1024-token context
+    # and a prefill-costly bench model) with short completions: the
+    # workload where prefix routing pays is prefill-dominated — the
+    # multi-turn agent / RL-scoring shape. Short prompts + long decodes
+    # are load-balancing's domain (the score's queue/inflight terms), not
+    # a prefix-locality scoreboard.
+    prompt_chars: int = 680,
+    interactive_tokens: int = 4,
+    rollout_tokens: int = 8,
+    turns: int = 3,
+    rounds: int = 2,
+    **fleet_kw: Any,
+) -> dict[str, Any]:
+    """The routing scoreboard: one fresh fleet per arm (identical seeds,
+    params, chaos schedule), round_robin then cache_aware, same
+    80%-shared-prefix multi-turn workload, each arm warmed (probes + an
+    uncounted drive) before its measured window.
+
+    Workload shape: each session's base prompt is unique (plus a small
+    fleet-global task preamble, ``shared_frac``); the ~80%+ prefix
+    sharing is per-request CONVERSATION HISTORY — turn t's prompt extends
+    turn t-1's sequence, so every turn past the first shares >85% of its
+    tokens with state some replica already holds. That is the sharing a
+    router can actually exploit: a fleet-global prefix replicates onto
+    every replica within one warm-up pass and round-robin gets it for
+    free, while session history lives on exactly ONE replica — blind
+    rotation re-prefills it ~(N-1)/N of the time and prefix routing never
+    does. Arrivals outpace service (open-loop saturation) so the saved
+    prefill converts into wall-clock/goodput, not idle slots.
+
+    The comparison block is what the driver reads: goodput, warm
+    suffix-only prefill economics, and greedy byte-identity across arms
+    (placement only, never output)."""
+    # probes repeat 2 prompts x3 so every replica sees the shared prefix
+    # at least once under round-robin too — compile + radix warm-up in
+    # both arms, and 6 texts of identity evidence
+    probe_prompts = make_shared_prefix_prompts(
+        2, shared_frac=shared_frac, total_chars=prompt_chars, seed=97
+    ) * 3
+    arms: dict[str, dict[str, Any]] = {}
+    for policy in ("round_robin", "cache_aware"):
+        arms[policy] = await run_local_bench(
+            n_replicas=n_replicas,
+            n_interactive=n_interactive,
+            n_rollout=n_rollout,
+            duration_s=duration_s,
+            workload=workload,
+            shared_frac=shared_frac,
+            prompt_chars=prompt_chars,
+            interactive_tokens=interactive_tokens,
+            rollout_tokens=rollout_tokens,
+            turns=turns,
+            rounds=rounds,
+            probe_prompts=probe_prompts,
+            warmup_s=max(2.0, duration_s / 2),
+            route_policy=policy,
+            max_seq_len=1024,
+            model="small",
+            **fleet_kw,
+        )
+    rr, ca = arms["round_robin"], arms["cache_aware"]
+    comparison = {
+        "goodput_tok_s": {
+            "round_robin": rr["totals"]["goodput_tok_s"],
+            "cache_aware": ca["totals"]["goodput_tok_s"],
+        },
+        "prefix_hit_rate": {
+            "round_robin": rr["fleet"]["prefix_hit_rate"],
+            "cache_aware": ca["fleet"]["prefix_hit_rate"],
+        },
+        "suffix_prefill_tokens": {
+            "round_robin": rr["fleet"]["prefill_tokens"],
+            "cache_aware": ca["fleet"]["prefill_tokens"],
+        },
+        "cache_aware_wins_goodput": (
+            ca["totals"]["goodput_tok_s"] > rr["totals"]["goodput_tok_s"]
+        ),
+        "cache_aware_wins_prefill": (
+            (ca["fleet"]["prefix_hit_rate"] or 0.0)
+            > (rr["fleet"]["prefix_hit_rate"] or 0.0)
+        ),
+        "greedy_identical": rr.get("probe_texts") == ca.get("probe_texts"),
+    }
+    return {
+        "bench": "gateway_route_ab",
+        "workload": workload,
+        "shared_frac": shared_frac,
+        "prompt_chars": prompt_chars,
+        "arms": arms,
+        "comparison": comparison,
+    }
 
 
 def main(argv=None) -> int:
@@ -477,28 +892,106 @@ def main(argv=None) -> int:
         action="store_true",
         help="spin a self-contained local fleet (tiny model) to bench",
     )
-    p.add_argument("--replicas", type=int, default=2)
-    p.add_argument("--interactive", type=int, default=8)
-    p.add_argument("--rollout", type=int, default=8)
-    p.add_argument("--duration", type=float, default=15.0)
+    p.add_argument("--replicas", type=int, default=None)
+    p.add_argument("--interactive", type=int, default=None)
+    p.add_argument("--rollout", type=int, default=None)
+    p.add_argument("--duration", type=float, default=None)
     p.add_argument("--stall-prob", type=float, default=0.3)
     p.add_argument("--stall-s", type=float, default=0.1)
     p.add_argument("--max-inflight", type=int, default=0)
     p.add_argument("--headroom", type=int, default=0)
+    p.add_argument(
+        "--route-policy",
+        choices=("round_robin", "cache_aware"),
+        default="round_robin",
+        help="replica-selection policy for the local fleet's client",
+    )
+    p.add_argument(
+        "--workload",
+        choices=("mixed", "shared_prefix"),
+        default=None,
+        help="shared_prefix = 80%%-shared multi-turn-style prompts (the "
+        "prefix-locality routing scoreboard). Default: mixed, or "
+        "shared_prefix under --ab",
+    )
+    p.add_argument(
+        "--shared-frac",
+        type=float,
+        default=None,
+        help="fleet-global shared task-preamble fraction of each base "
+        "prompt. Default: 0.8, or 0.1 under --ab (there the ~80%% "
+        "per-request sharing comes from multi-turn conversation history "
+        "— the prefix structure a router can actually exploit)",
+    )
+    p.add_argument("--prompt-chars", type=int, default=None)
+    p.add_argument(
+        "--turns",
+        type=int,
+        default=None,
+        help="chat turns per client session (default: 3 under --ab, else 1)",
+    )
+    p.add_argument(
+        "--ab",
+        action="store_true",
+        help="run BOTH policies on fresh identical local fleets and emit "
+        "one comparison report (goodput, suffix-prefill tokens, greedy "
+        "byte-identity)",
+    )
     p.add_argument("-o", "--output", default="", help="JSON report path")
     args = p.parse_args(argv)
+    # mode-dependent defaults: the A/B needs a saturated shared-prefix
+    # multi-turn fleet; the plain bench keeps its standing configuration
+    if args.workload is None:
+        args.workload = "shared_prefix" if args.ab else "mixed"
+    if args.turns is None:
+        args.turns = 3 if args.ab else 1
+    if args.replicas is None:
+        args.replicas = 3 if args.ab else 2
+    if args.interactive is None:
+        args.interactive = 18 if args.ab else 8
+    if args.rollout is None:
+        args.rollout = 18 if args.ab else 8
+    if args.duration is None:
+        args.duration = 4.0 if args.ab else 15.0
+    if args.shared_frac is None:
+        args.shared_frac = 0.1 if args.ab else 0.8
 
-    if args.local or not args.gateway:
+    if args.ab:
+        kw = {}
+        if args.prompt_chars is not None:
+            kw["prompt_chars"] = args.prompt_chars
+        report = asyncio.run(
+            run_ab(
+                n_replicas=args.replicas,
+                n_interactive=args.interactive,
+                n_rollout=args.rollout,
+                duration_s=args.duration,
+                workload=args.workload,
+                shared_frac=args.shared_frac,
+                turns=args.turns,
+                chaos_stall_prob=args.stall_prob,
+                chaos_stall_s=args.stall_s,
+                gateway_max_inflight=args.max_inflight,
+                gateway_interactive_headroom=args.headroom,
+                **kw,
+            )
+        )
+    elif args.local or not args.gateway:
         report = asyncio.run(
             run_local_bench(
                 n_replicas=args.replicas,
                 n_interactive=args.interactive,
                 n_rollout=args.rollout,
                 duration_s=args.duration,
+                workload=args.workload,
+                shared_frac=args.shared_frac,
+                prompt_chars=args.prompt_chars or 400,
+                turns=args.turns,
                 chaos_stall_prob=args.stall_prob,
                 chaos_stall_s=args.stall_s,
                 gateway_max_inflight=args.max_inflight,
                 gateway_interactive_headroom=args.headroom,
+                route_policy=args.route_policy,
             )
         )
     else:
@@ -519,9 +1012,21 @@ def main(argv=None) -> int:
         atomic_io.atomic_write_text(args.output, text)
         print(f"wrote {args.output}")
     # non-null scoreboard or the run proved nothing
-    ok = all(
-        report["classes"][p]["ttft_p50_s"] is not None for p in PRIORITIES
-    )
+    if args.ab:
+        cmp_ = report["comparison"]
+        ok = (
+            cmp_["greedy_identical"]
+            and cmp_["cache_aware_wins_prefill"]
+            and all(
+                arm["classes"][p]["ttft_p50_s"] is not None
+                for arm in report["arms"].values()
+                for p in PRIORITIES
+            )
+        )
+    else:
+        ok = all(
+            report["classes"][p]["ttft_p50_s"] is not None for p in PRIORITIES
+        )
     return 0 if ok else 1
 
 

@@ -187,6 +187,27 @@ def render_frame(
         lines.append(
             f"{'prefix hit rate':<24} {hit_tok / (hit_tok + pf_tok):>11.1%}"
         )
+    # routing brain (docs/serving.md "Cache-aware routing"): decision
+    # totals by reason plus the predicted-vs-actual prefix-hit audit —
+    # divergence means the shadow index drifted from the fleet's caches
+    decisions = _merged_value(snap, "areal_router_decisions_total")
+    if decisions is not None:
+        lines.append(f"{'router decisions':<24} {_fmt(decisions):>12}")
+        reasons = {}
+        for (n, labels), v in snap.merged.items():
+            if n == "areal_router_decisions_total":
+                key = dict(labels).get("reason", "?")
+                reasons[key] = reasons.get(key, 0.0) + v
+        top = sorted(reasons.items(), key=lambda kv: -kv[1])[:4]
+        for reason, v in top:
+            lines.append(f"{'  ' + reason:<24} {_fmt(v):>12}")
+        pred = _merged_value(snap, "areal_router_predicted_hit_total")
+        act = _merged_value(snap, "areal_router_actual_hit_total")
+        if pred is not None or act is not None:
+            lines.append(
+                f"{'router hit pred/actual':<24} "
+                f"{_fmt(pred or 0):>6} / {_fmt(act or 0)}"
+            )
     # overload view (docs/request_lifecycle.md): everything turned away with
     # a 429 — gateway load shedding + engine admission rejections — as a
     # fleet total, and as a rate once two frames exist
@@ -368,6 +389,17 @@ areal_request_queue_depth 2
 # TYPE areal_gateway_shed_total counter
 areal_gateway_shed_total{priority="rollout"} 5
 areal_gateway_shed_total{priority="interactive"} 1
+# HELP areal_router_decisions_total Replica-selection decisions by reason.
+# TYPE areal_router_decisions_total counter
+areal_router_decisions_total{reason="prefix_overlap"} 6
+areal_router_decisions_total{reason="least_loaded"} 3
+areal_router_decisions_total{reason="stale_snapshots"} 1
+# HELP areal_router_predicted_hit_total Decisions predicting a warm prefix.
+# TYPE areal_router_predicted_hit_total counter
+areal_router_predicted_hit_total 6
+# HELP areal_router_actual_hit_total Routed requests with a real radix hit.
+# TYPE areal_router_actual_hit_total counter
+areal_router_actual_hit_total 5
 # HELP areal_admission_rejected_total Requests rejected at engine admission.
 # TYPE areal_admission_rejected_total counter
 areal_admission_rejected_total{reason="queue_depth"} 4
@@ -543,6 +575,22 @@ def self_test() -> int:
             (
                 "lifecycle queue" in frame,
                 "frame missing lifecycle queue-depth row",
+            ),
+            (
+                "router decisions" in frame
+                and _merged_value(snap, "areal_router_decisions_total")
+                == 20,
+                "router decisions should sum reasons across targets "
+                "(2x(6+3+1))",
+            ),
+            (
+                "prefix_overlap" in frame,
+                "frame missing top decision-reason rows",
+            ),
+            (
+                "router hit pred/actual" in frame and "12 / 10" in frame,
+                "frame missing predicted-vs-actual router hit row "
+                "(2x6 / 2x5)",
             ),
             (
                 _shed_total(snap) == 20,

@@ -32,10 +32,19 @@ identity with >= 90% measured named-phase coverage, a non-zero measured
 rollout_wait bubble, a populated HBM ledger (analytic CPU fallback), and
 live XLA compile counters.
 
+``--routing-self-test`` drives a 3-replica in-process fleet under seeded
+chaos with an 80%-shared-prefix multi-turn workload through BOTH routing
+policies (docs/serving.md "Cache-aware routing"), and asserts the routing
+brain end to end: cache-aware measurably raises warm suffix-only prefill
+(radix hit tokens) over round-robin, every decision lands in the flight
+ring with a reason, and an evict -> respawn cycle yields zero routes to
+the evicted replica while it is down (with its shadow prefix index read
+as cold after the rejoin).
+
 Usage: python -m areal_tpu.tools.validate_installation [--tpu]
     [--chaos-self-test] [--weight-sync-self-test] [--prefix-cache-self-test]
     [--overload-self-test] [--timeline-self-test] [--train-obs-self-test]
-    [--preemption-self-test]
+    [--preemption-self-test] [--routing-self-test]
 """
 
 from __future__ import annotations
@@ -118,6 +127,14 @@ def main(argv=None) -> int:
         "the trainer goodput observatory: step-phase breakdown sums to the "
         "step wall time with >= 90%% named-phase coverage, non-zero "
         "rollout_wait (the async bubble), and a populated HBM ledger",
+    )
+    p.add_argument(
+        "--routing-self-test",
+        action="store_true",
+        help="3-replica fleet under seeded chaos: cache-aware routing "
+        "must raise warm suffix-only prefill vs round-robin, audit every "
+        "decision to the flight ring, and never route to an evicted "
+        "replica (docs/serving.md)",
     )
     p.add_argument(
         "--preemption-self-test",
@@ -283,6 +300,9 @@ def main(argv=None) -> int:
 
     if args.preemption_self_test:
         _check("preemption", preemption_self_test, results)
+
+    if args.routing_self_test:
+        _check("routing", routing_self_test, results)
 
     width = max(len(n) for n, _, _ in results)
     ok = True
@@ -1058,6 +1078,194 @@ def preemption_self_test(kill_after_version: int = 1) -> str:
         f"(parked {summary['parked']}, 0 leaks), ckpt pause sync "
         f"{sync_s * 1e3:.0f}ms vs async {async_pause_s * 1e3:.0f}ms"
     )
+
+
+def routing_self_test(
+    n_replicas: int = 3, n_sessions: int = 6, turns: int = 3, seed: int = 17
+) -> str:
+    """Cache-aware routing brain end to end (docs/serving.md "Cache-aware
+    routing"): a 3-replica fleet under seeded chaos stalls serves an
+    80%-shared-prefix multi-turn workload through BOTH policies.
+
+    Asserts: (1) cache-aware yields measurably more warm suffix-only
+    prefill (radix hit tokens) than round-robin on the identical workload;
+    (2) router decisions are audited into the flight ring with reasons;
+    (3) an evicted replica receives ZERO routes while down, and after the
+    respawn/rejoin its shadow prefix index reads cold."""
+    import asyncio
+
+    import jax
+
+    from areal_tpu.api.config import (
+        ChaosConfig,
+        FaultToleranceConfig,
+        InferenceEngineConfig,
+        MeshConfig,
+        RoutingConfig,
+        ServerConfig,
+    )
+    from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+    from areal_tpu.inference.client import RemoteJaxEngine, close_loop_sessions
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.inference.server import ServerThread
+    from areal_tpu.models import qwen
+    from areal_tpu.observability import timeline as tl_mod
+    from areal_tpu.robustness import FaultInjector
+
+    tiny = tiny_model_config()
+    params = qwen.init_params(jax.random.PRNGKey(0), tiny)
+    servers = []
+    clients = []
+    psz = 16
+    try:
+        for i in range(n_replicas):
+            cfg = ServerConfig(
+                max_batch_size=4,
+                max_seq_len=256,
+                decode_steps_per_call=4,
+                page_size=psz,
+                seed=i,
+                mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+            )
+            eng = DecodeEngine(cfg, params=params, model_cfg=tiny)
+            eng.initialize()
+            st = ServerThread(cfg, eng)
+            st.start()
+            servers.append(st)
+        addrs = [s.address for s in servers]
+
+        def make_client(policy: str) -> RemoteJaxEngine:
+            c = RemoteJaxEngine(
+                InferenceEngineConfig(
+                    max_concurrent_rollouts=8,
+                    consumer_batch_size=2,
+                    max_head_offpolicyness=100,
+                    request_timeout=60,
+                    request_retries=5,
+                    routing_policy=policy,
+                    routing=RoutingConfig(
+                        poll_interval_s=0.25, shadow_page_size=psz
+                    ),
+                    fault_tolerance=FaultToleranceConfig(
+                        backoff_base_s=0.05,
+                        backoff_max_s=0.5,
+                        probe_interval_s=60.0,
+                    ),
+                ),
+                addresses=list(addrs),
+            )
+            c.initialize()
+            c.install_fault_injector(
+                FaultInjector(
+                    ChaosConfig(
+                        enabled=True,
+                        seed=seed,
+                        stall_prob=0.2,
+                        stall_s=0.05,
+                        path_prefix="/generate",
+                    )
+                )
+            )
+            clients.append(c)
+            return c
+
+        g = GenerationHyperparameters(max_new_tokens=8, greedy=True)
+
+        async def drive(client: RemoteJaxEngine, tag: str) -> None:
+            # multi-turn sessions: each turn's prompt extends the previous
+            # sequence — the conversation-history prefix structure the
+            # router exploits. 80%+ of every turn-2+ prompt is shared
+            # with state one replica already holds.
+            async def session(s: int) -> None:
+                base = [2 + (s % 40), 5] + [
+                    3 + ((s * 7 + j) % 90) for j in range(62)
+                ]
+                ids = list(base)
+                for t in range(turns):
+                    req = ModelRequest(
+                        input_ids=ids,
+                        rid=f"{tag}-s{s}-t{t}",
+                        gconfig=g,
+                    )
+                    resp = await client.agenerate(req)
+                    ids = ids + list(resp.output_tokens) + [9 + t, 11, 13]
+            await asyncio.gather(*[session(s) for s in range(n_sessions)])
+            await close_loop_sessions()
+
+        def fleet_stats() -> tuple[int, int]:
+            hit = sum(s.engine.stats["prefix_hit_tokens"] for s in servers)
+            pf = sum(s.engine.stats["prefill_tokens"] for s in servers)
+            return hit, pf
+
+        # --- arm 1: round robin -------------------------------------------
+        rr = make_client("round_robin")
+        asyncio.run(drive(rr, "rr"))
+        rr_hit, rr_pf = fleet_stats()
+        # flush every radix tree so the cache-aware arm starts as cold as
+        # the round-robin arm did
+        for s in servers:
+            s.engine.flush_prefix_cache()
+        # --- arm 2: cache aware -------------------------------------------
+        ca = make_client("cache_aware")
+        ca.router.poller.poll_once()  # live snapshots before first choice
+        asyncio.run(drive(ca, "ca"))
+        ca_hit, ca_pf = fleet_stats()
+        ca_hit, ca_pf = ca_hit - rr_hit, ca_pf - rr_pf
+        if ca_hit <= rr_hit:
+            raise AssertionError(
+                f"cache-aware warm prefill did not improve: hit tokens "
+                f"{ca_hit} (cache_aware) vs {rr_hit} (round_robin)"
+            )
+        st = ca.router.stats()
+        if st["decisions"].get("prefix_overlap", 0) == 0:
+            raise AssertionError(
+                f"no prefix_overlap decisions recorded: {st['decisions']}"
+            )
+        # decisions must be auditable in the flight ring
+        ring = tl_mod.get_flight_recorder().snapshot()["events"]
+        router_events = [e for e in ring if e.get("kind") == "router_decision"]
+        if not router_events:
+            raise AssertionError("no router_decision events in flight ring")
+        if not all(
+            (e.get("data") or {}).get("reason") for e in router_events[-10:]
+        ):
+            raise AssertionError("router_decision events missing reasons")
+        # --- evict -> zero routes while down -> cold after respawn --------
+        victim = addrs[0]
+        ca.fleet.evict(victim)  # PR 3 supervision's administrative eviction
+        routed = {
+            ca.choose_server(req=ModelRequest(input_ids=[2, 3, 4 + i], gconfig=g))
+            for i in range(24)
+        }
+        if victim in routed:
+            raise AssertionError(f"evicted replica {victim} was routed to")
+        # respawn/rejoin: the probe path closes the circuit and resets the
+        # replica's router state (its radix tree restarted empty)
+        ca.probe_fleet()
+        if ca.fleet.state(victim) == "open":
+            raise AssertionError("probe did not rejoin the healthy replica")
+        if ca.router.shadow.pages_for(victim) != 0:
+            raise AssertionError(
+                "rejoined replica's shadow index was not reset to cold"
+            )
+        routed_after = {
+            ca.choose_server(req=ModelRequest(input_ids=[2, 3, 4 + i], gconfig=g))
+            for i in range(24)
+        }
+        if victim not in routed_after:
+            raise AssertionError("rejoined replica never selected again")
+        return (
+            f"{n_sessions}x{turns}-turn sessions over {n_replicas} replicas "
+            f"under chaos: warm hit tokens {rr_hit} (rr) -> {ca_hit} "
+            f"(cache-aware), suffix prefill {rr_pf} -> {ca_pf}, "
+            f"{len(router_events)} audited decisions, evicted replica got "
+            f"0/24 routes while down and rejoined cold"
+        )
+    finally:
+        for c in clients:
+            c.destroy()
+        for s in servers:
+            s.stop()
 
 
 if __name__ == "__main__":

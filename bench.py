@@ -888,6 +888,22 @@ def phase_gateway():
             duration_s=duration,
             chaos_stall_prob=0.2,
             chaos_stall_s=0.05,
+            # the routing brain is live in the standing scoreboard: the
+            # cache-aware policy over an 80%-shared-prefix MULTI-TURN
+            # workload (turns>1 is what makes the hit rate
+            # policy-sensitive — a fleet-global prefix alone replicates
+            # onto every replica and memoizes under any policy), with the
+            # active policy + fleet prefix-hit rate recorded so the
+            # router's contribution is auditable round over round
+            route_policy="cache_aware",
+            workload="shared_prefix",
+            turns=3,
+            # bounded so a 3-turn history always fits the tiny fleet's
+            # 512-token context even if no EOS fires: 287-token base +
+            # 2 x (32-token reply + ~36 template/followup) + 32 decode
+            prompt_chars=280,
+            interactive_tokens=8,
+            rollout_tokens=32,
         )
     )
     classes = {}
@@ -902,11 +918,16 @@ def phase_gateway():
             "deadline_reaped": c["deadline_reaped"],
             "errors": c["errors"],
         }
+    hit_rate = report.get("router_hit_rate")
     _emit_phase(
         {
             "phase": "gateway",
             "duration_s": report["duration_s"],
             "goodput_tok_s": round(report["totals"]["goodput_tok_s"], 1),
+            "route_policy": report.get("route_policy"),
+            "router_hit_rate": (
+                round(hit_rate, 4) if hit_rate is not None else None
+            ),
             "classes": classes,
         }
     )
@@ -1163,9 +1184,14 @@ def main():
         gw = resolve("gateway", spawn_in_window("gateway") if live else None)
         if gw is not None:
             # the serving scoreboard (many-client goodput bench): p50/p99
-            # TTFT + goodput per priority class next to decode tok/s
+            # TTFT + goodput per priority class next to decode tok/s,
+            # plus the active routing policy + fleet prefix-hit rate
+            # (cached pre-router payloads fold these as None — the
+            # scoreboard itself is never null)
             gateway = {
                 "goodput_tok_s": gw.get("goodput_tok_s"),
+                "route_policy": gw.get("route_policy"),
+                "router_hit_rate": gw.get("router_hit_rate"),
                 "classes": gw.get("classes"),
             }
     except Exception as e:  # noqa: BLE001 — the JSON line must still print

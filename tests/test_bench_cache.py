@@ -143,6 +143,8 @@ def test_main_folds_gateway_scoreboard(cache_dir, monkeypatch, capsys):
             return {
                 "phase": "gateway",
                 "goodput_tok_s": 123.4,
+                "route_policy": "cache_aware",
+                "router_hit_rate": 0.61,
                 "classes": {
                     "interactive": {"ttft_p99_s": 0.5, "goodput_tok_s": 20.0},
                     "rollout": {"ttft_p99_s": 1.5, "goodput_tok_s": 103.4},
@@ -158,8 +160,45 @@ def test_main_folds_gateway_scoreboard(cache_dir, monkeypatch, capsys):
     out = json.loads(line)
     gw = out["detail"]["gateway"]
     assert gw["goodput_tok_s"] == 123.4
+    assert gw["route_policy"] == "cache_aware"
+    assert gw["router_hit_rate"] == 0.61
     assert gw["classes"]["rollout"]["ttft_p99_s"] == 1.5
     assert out["detail"]["sources"]["gateway"] == "live"
+
+
+def test_cached_pre_router_gateway_payload_folds_with_none(
+    cache_dir, monkeypatch, capsys
+):
+    """A cached gateway payload measured BEFORE the routing brain landed
+    has no route_policy/router_hit_rate — those fields fold as None, the
+    scoreboard itself (goodput + classes) never nulls out."""
+
+    def fake_spawn(name, deadline=None):
+        if name == "probe":
+            return {"phase": "probe", "platform": "tpu", "n_devices": 1}
+        if name == "gateway":
+            # pre-router payload shape (PR 7): no router fields at all
+            return {
+                "phase": "gateway",
+                "goodput_tok_s": 99.0,
+                "classes": {
+                    "interactive": {"ttft_p99_s": 0.4},
+                    "rollout": {"ttft_p99_s": 1.2},
+                },
+            }
+        return {"phase": name, "error": "skipped"}
+
+    monkeypatch.setattr(bench, "_spawn_phase", fake_spawn)
+    bench.main()
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    gw = out["detail"]["gateway"]
+    assert gw["goodput_tok_s"] == 99.0
+    assert gw["route_policy"] is None
+    assert gw["router_hit_rate"] is None
+    assert gw["classes"]["interactive"]["ttft_p99_s"] == 0.4
 
 
 def test_window_guard_skips_phases_that_no_longer_fit(cache_dir, monkeypatch, capsys):

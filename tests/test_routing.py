@@ -1,0 +1,468 @@
+"""Cache-aware routing brain (areal_tpu/routing/, docs/serving.md
+"Cache-aware routing"): scoring policy, shadow prefix index, snapshot
+degradation, affinity TTL, and the placement-only guarantee (greedy
+byte-identity across policies)."""
+
+import asyncio
+import time
+
+import pytest
+
+from areal_tpu.api.config import (
+    FaultToleranceConfig,
+    InferenceEngineConfig,
+    MeshConfig,
+    RoutingConfig,
+    ServerConfig,
+)
+from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+from areal_tpu.routing import (
+    AffinityMap,
+    Candidate,
+    Router,
+    ShadowPrefixIndex,
+    pick,
+    pick_least_loaded,
+)
+from areal_tpu.routing.snapshot import ReplicaSnapshot
+
+PSZ = 4  # small shadow pages keep unit-test prompts short
+
+
+def _cfg(**kw) -> RoutingConfig:
+    kw.setdefault("shadow_page_size", PSZ)
+    return RoutingConfig(**kw)
+
+
+def _router(**kw) -> Router:
+    return Router(_cfg(**kw), addresses_fn=lambda: [])
+
+
+def _statusz(
+    queue=0,
+    active=0,
+    slots=4,
+    free=50,
+    radix=0,
+    n_pages=51,
+    draining=False,
+    pages_held=0,
+    flushes=0,
+    enabled=True,
+    version=0,
+):
+    return {
+        "version": version,
+        "lifecycle": {
+            "queue_depth": queue,
+            "active_slots": active,
+            "max_batch_size": slots,
+            "free_pages": free,
+            "radix_pages": radix,
+            "n_pages": n_pages,
+        },
+        "prefix_cache": {
+            "enabled": enabled,
+            "pages_held": pages_held,
+            "flushes": flushes,
+            "page_size": PSZ,
+            "hit_tokens": 0,
+        },
+        "drain": {"draining": draining},
+    }
+
+
+# ---------------------------------------------------------------------------
+# scoring policy (pure)
+# ---------------------------------------------------------------------------
+
+
+def test_tie_break_rotates_among_equals():
+    """Indistinguishable candidates share load via rotation — the first
+    replica must not absorb every request between snapshot refreshes."""
+    cfg = _cfg()
+    snaps = [
+        ReplicaSnapshot.from_statusz(a, _statusz()) for a in ("a", "b", "c")
+    ]
+    picks = []
+    for rr in range(6):
+        cands = [Candidate(addr=s.addr, snapshot=s) for s in snaps]
+        picks.append(pick(cands, cfg, rr, prompt_tokens=8).addr)
+    assert picks == ["a", "b", "c", "a", "b", "c"]
+
+
+def test_stale_snapshots_degrade_to_round_robin():
+    """No live snapshot, no overlap, no inflight -> nothing to score on:
+    plain rotation with an explicit stale_snapshots reason (no request
+    ever fails because routing failed)."""
+    cfg = _cfg()
+    picks = []
+    for rr in range(4):
+        cands = [Candidate(addr=a) for a in ("a", "b")]
+        d = pick(cands, cfg, rr, prompt_tokens=8)
+        assert d.reason == "stale_snapshots"
+        picks.append(d.addr)
+    assert picks == ["a", "b", "a", "b"]
+
+
+def test_prefix_overlap_wins_over_equal_load():
+    cfg = _cfg()
+    s = _statusz()
+    cands = [
+        Candidate(addr="cold", snapshot=ReplicaSnapshot.from_statusz("cold", s)),
+        Candidate(
+            addr="warm",
+            snapshot=ReplicaSnapshot.from_statusz("warm", s),
+            overlap_pages=3,
+        ),
+    ]
+    d = pick(cands, cfg, 0, prompt_tokens=16, page_size=PSZ)
+    assert d.addr == "warm"
+    assert d.reason == "prefix_overlap"
+    assert d.overlap_pages == 3
+
+
+def test_loaded_replica_loses_to_idle():
+    cfg = _cfg()
+    cands = [
+        Candidate(
+            addr="busy",
+            snapshot=ReplicaSnapshot.from_statusz(
+                "busy", _statusz(queue=12, active=4)
+            ),
+        ),
+        Candidate(
+            addr="idle", snapshot=ReplicaSnapshot.from_statusz("idle", _statusz())
+        ),
+    ]
+    d = pick(cands, cfg, 0, prompt_tokens=8)
+    assert d.addr == "idle"
+    assert d.reason == "least_loaded"
+
+
+def test_deadline_rush_ignores_prefix_warmth():
+    """With slack below rush_slack_s the warm-but-queued replica loses to
+    the empty one: a cold prefill beats queueing behind a warm cache when
+    the deadline is close."""
+    cfg = _cfg()
+    warm = Candidate(
+        addr="warm",
+        snapshot=ReplicaSnapshot.from_statusz("warm", _statusz(queue=6, active=4)),
+        overlap_pages=4,
+    )
+    idle = Candidate(
+        addr="idle", snapshot=ReplicaSnapshot.from_statusz("idle", _statusz())
+    )
+    relaxed = pick([warm, idle], cfg, 0, prompt_tokens=17, page_size=PSZ)
+    assert relaxed.addr == "warm"
+    rushed = pick(
+        [warm, idle], cfg, 0, prompt_tokens=17, rush=True, page_size=PSZ
+    )
+    assert rushed.addr == "idle"
+    assert rushed.reason == "rush_deadline"
+
+
+def test_inflight_pressure_spreads_bursts():
+    """The client-local outstanding counter must repel a burst away from
+    the warm replica well before any snapshot refresh could."""
+    cfg = _cfg()
+    s = _statusz()
+    warm = Candidate(
+        addr="warm",
+        snapshot=ReplicaSnapshot.from_statusz("warm", s),
+        overlap_pages=4,
+        inflight=12,
+    )
+    idle = Candidate(
+        addr="idle", snapshot=ReplicaSnapshot.from_statusz("idle", s)
+    )
+    assert pick([warm, idle], cfg, 0, prompt_tokens=17, page_size=PSZ).addr == "idle"
+
+
+def test_role_pool_fencing():
+    """Long prompts fence INTO the prefill pool, short ones OUT of it;
+    an empty preferred pool falls back to everyone (soft fencing)."""
+    cfg = _cfg(role_map={"p": "prefill"}, long_prompt_tokens=100)
+    s = _statusz()
+
+    def cands():
+        return [
+            Candidate(addr="p", snapshot=ReplicaSnapshot.from_statusz("p", s)),
+            Candidate(addr="i", snapshot=ReplicaSnapshot.from_statusz("i", s)),
+        ]
+
+    long = pick(cands(), cfg, 0, prompt_tokens=200)
+    assert long.addr == "p"
+    assert long.reason == "role_pool"
+    short = pick(cands(), cfg, 0, prompt_tokens=8)
+    assert short.addr == "i"
+    # preferred pool empty -> full candidate set, never a stranded request
+    cfg2 = _cfg(role_map={"x": "prefill"}, long_prompt_tokens=100)
+    fallback = pick(cands(), cfg2, 0, prompt_tokens=200)
+    assert fallback.addr in ("p", "i")
+
+
+def test_gateway_pick_least_loaded():
+    backends = ["b1", "b2", "b3"]
+    addr, reason = pick_least_loaded(backends, {"b1": 2, "b2": 0, "b3": 1}, 0)
+    assert addr == "b2" and reason == "least_loaded"
+    # all equal -> rotation, reported as such
+    picks = {pick_least_loaded(backends, {}, rr)[0] for rr in range(3)}
+    assert picks == set(backends)
+    assert pick_least_loaded(backends, {}, 0)[1] == "round_robin"
+    assert pick_least_loaded(["only"], {}, 0) == ("only", "single_candidate")
+
+
+# ---------------------------------------------------------------------------
+# shadow prefix index
+# ---------------------------------------------------------------------------
+
+
+def test_shadow_overlap_and_weight_commit_invalidation():
+    sh = ShadowPrefixIndex(page_size=PSZ)
+    seq = list(range(20))
+    assert sh.note_routed("a", seq, version=0) == 4  # (20-1)//4 full pages
+    assert sh.overlap_pages("a", seq) == 4
+    assert sh.overlap_pages("a", seq[:9]) == 2
+    assert sh.overlap_pages("b", seq) == 0
+    # weight commit: every replica flushes its radix tree -> shadow void
+    sh.on_weight_commit(1)
+    assert sh.overlap_pages("a", seq) == 0
+    # sequences generated under a stale version are not recorded
+    assert sh.note_routed("a", seq, version=0) == 0
+    assert sh.note_routed("a", seq, version=1) == 4
+
+
+def test_shadow_reconcile_trims_and_drops():
+    sh = ShadowPrefixIndex(page_size=PSZ)
+    seq = list(range(24))
+    sh.note_routed("a", seq, version=0)
+    assert sh.pages_for("a") == 5
+    # replica reports fewer pages than the shadow claims -> trim (the
+    # shadow must only ever under-promise)
+    sh.reconcile("a", {"enabled": True, "pages_held": 2, "flushes": 0, "page_size": PSZ})
+    assert sh.pages_for("a") == 2
+    # flush counter advanced -> the replica dropped its tree -> drop ours
+    sh.reconcile("a", {"enabled": True, "pages_held": 2, "flushes": 1, "page_size": PSZ})
+    assert sh.pages_for("a") == 0
+    # disabled cache -> nothing can be warm there
+    sh.note_routed("b", seq, version=0)
+    sh.reconcile("b", {"enabled": False})
+    assert sh.pages_for("b") == 0
+
+
+def test_shadow_capacity_lru_eviction():
+    sh = ShadowPrefixIndex(page_size=PSZ, max_pages_per_replica=4)
+    old = list(range(16))  # 3 pages
+    sh.note_routed("a", old, version=0)
+    newer = list(range(100, 120))  # 4 pages, distinct
+    sh.note_routed("a", newer, version=0)
+    assert sh.pages_for("a") <= 4
+    # the newest sequence survives the cap
+    assert sh.overlap_pages("a", newer) > 0
+
+
+# ---------------------------------------------------------------------------
+# router facade
+# ---------------------------------------------------------------------------
+
+
+def test_router_drains_and_demotions():
+    r = _router(demote_s=30.0)
+    r.poller.ingest("a", _statusz())
+    r.poller.ingest("b", _statusz(draining=True))
+    # draining replicas leave the candidate set
+    for rr in range(4):
+        assert r.choose(["a", "b"], token_ids=[1, 2, 3]).addr == "a"
+    # 429 backpressure demotes a's score instead of tripping failover:
+    # traffic drifts to the (now undraining) sibling
+    r.poller.ingest("b", _statusz())
+    r.note_backpressure("a")
+    assert r.choose(["a", "b"], token_ids=[1, 2, 3]).addr == "b"
+
+
+def test_router_all_draining_falls_back():
+    """A fully-draining candidate set still routes (last resort): the
+    admission gates answer 429 and backpressure takes over — routing
+    itself never fails a request."""
+    r = _router()
+    r.poller.ingest("a", _statusz(draining=True))
+    r.poller.ingest("b", _statusz(draining=True))
+    assert r.choose(["a", "b"], token_ids=[1, 2]).addr in ("a", "b")
+
+
+def test_router_predicted_vs_actual_audit():
+    r = _router()
+    seq = list(range(20))
+    r.poller.ingest("a", _statusz())
+    r.poller.ingest("b", _statusz())
+    r.note_result("a", seq, version=0, ttft_s=0.1, cached_prefix_tokens=0)
+    d = r.choose(["a", "b"], token_ids=seq)
+    assert d.addr == "a" and d.overlap_pages > 0
+    assert r.stats()["predicted_hits"] == 1
+    r.note_result("a", seq, version=0, ttft_s=0.05, cached_prefix_tokens=16)
+    assert r.stats()["actual_hits"] == 1
+
+
+def test_router_replica_reset_reads_cold():
+    r = _router()
+    seq = list(range(20))
+    r.note_result("a", seq, version=0)
+    assert r.shadow.pages_for("a") > 0
+    r.on_replica_reset("a")
+    assert r.shadow.pages_for("a") == 0
+    assert r.poller.get("a") is None
+
+
+def test_router_decisions_reach_flight_ring():
+    from areal_tpu.observability import timeline as tl_mod
+
+    ring = tl_mod.FlightRecorder(capacity=16)
+    r = Router(_cfg(), addresses_fn=lambda: [], flight=ring)
+    r.poller.ingest("a", _statusz())
+    r.choose(["a", "b"], rid="r1", token_ids=[1, 2, 3], priority="interactive")
+    ev = [
+        e
+        for e in ring.snapshot()["events"]
+        if e["kind"] == "router_decision"
+    ]
+    assert ev and ev[-1]["data"]["reason"]
+    assert ev[-1]["data"]["rid"] == "r1"
+
+
+# ---------------------------------------------------------------------------
+# affinity TTL (the unbounded-_rid_affinity fix)
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_abandoned_rids_expire_resumed_keep():
+    """Abandoned rids (caller crashed, workflow quarantined without the
+    abort reaching us) age out on idle time; a parked-and-resumed rid —
+    which re-touches its entry on every resume attempt — keeps affinity
+    across the same wall-clock span."""
+    am = AffinityMap(ttl_s=0.2, sweep_every=1)
+    am.set("abandoned", "a:1")
+    am.set("resumed", "b:2")
+    for _ in range(3):
+        time.sleep(0.09)
+        assert am.get("resumed") == "b:2"  # resume attempt touches it
+    # > ttl since 'abandoned' was last touched; the next set sweeps
+    am.set("fresh", "c:3")
+    assert "abandoned" not in am
+    assert am.get("resumed") == "b:2"
+    assert am.swept_total >= 1
+
+
+def test_affinity_pop_and_len():
+    am = AffinityMap(ttl_s=60.0)
+    am.set("r1", "a:1")
+    assert len(am) == 1
+    assert am.pop("r1") == "a:1"
+    assert am.pop("r1") is None
+    assert len(am) == 0
+
+
+def test_client_affinity_is_ttl_swept():
+    """The inference client's rid-affinity map is the TTL-swept kind, fed
+    from RoutingConfig.affinity_ttl_s — not the old unbounded dict."""
+    from areal_tpu.inference.client import RemoteJaxEngine
+
+    c = RemoteJaxEngine(
+        InferenceEngineConfig(
+            routing=RoutingConfig(affinity_ttl_s=123.0),
+        ),
+        addresses=["127.0.0.1:1"],
+    )
+    try:
+        assert isinstance(c._rid_affinity, AffinityMap)
+        assert c._rid_affinity.ttl_s == 123.0
+    finally:
+        c.destroy()
+
+
+# ---------------------------------------------------------------------------
+# placement-only guarantee: greedy byte-identity across policies
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def twin_fleet():
+    import jax
+
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.inference.server import ServerThread
+    from areal_tpu.models import qwen
+    from areal_tpu.tools.validate_installation import tiny_model_config
+
+    tiny = tiny_model_config()
+    params = qwen.init_params(jax.random.PRNGKey(0), tiny)
+    servers = []
+    for i in range(2):
+        cfg = ServerConfig(
+            max_batch_size=2,
+            max_seq_len=128,
+            decode_steps_per_call=4,
+            page_size=16,
+            seed=0,  # identical sampling seed: byte-identity must come
+            # from determinism, and greedy decode has no RNG at all
+            mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        )
+        eng = DecodeEngine(cfg, params=params, model_cfg=tiny)
+        eng.initialize()
+        st = ServerThread(cfg, eng)
+        st.start()
+        servers.append(st)
+    yield servers
+    for st in servers:
+        st.stop()
+
+
+def _generate_all(addresses, policy, prompts):
+    from areal_tpu.inference.client import RemoteJaxEngine, close_loop_sessions
+
+    client = RemoteJaxEngine(
+        InferenceEngineConfig(
+            request_timeout=60,
+            routing_policy=policy,
+            routing=RoutingConfig(shadow_page_size=16, poll_interval_s=60.0),
+            fault_tolerance=FaultToleranceConfig(probe_interval_s=60.0),
+        ),
+        addresses=list(addresses),
+    )
+    client.initialize()
+    try:
+
+        async def go():
+            outs = []
+            for i, ids in enumerate(prompts):
+                resp = await client.agenerate(
+                    ModelRequest(
+                        input_ids=ids,
+                        rid=f"{policy}-{i}",
+                        gconfig=GenerationHyperparameters(
+                            max_new_tokens=6, greedy=True
+                        ),
+                    )
+                )
+                outs.append(list(resp.output_tokens))
+            await close_loop_sessions()
+            return outs
+
+        return asyncio.run(go())
+    finally:
+        client.destroy()
+
+
+def test_greedy_byte_identity_across_policies(twin_fleet):
+    """Routing is placement-only: the same greedy prompts produce
+    byte-identical outputs whether pinned to one replica, rotated, or
+    routed cache-aware (a routing misprediction can cost latency, never
+    correctness)."""
+    addrs = [s.address for s in twin_fleet]
+    base = [2, 5, 7, 11, 13, 17, 19, 23] * 3
+    prompts = [base + [30 + i] for i in range(4)]
+    pinned = _generate_all(addrs[:1], "round_robin", prompts)
+    rotated = _generate_all(addrs, "round_robin", prompts)
+    cache_aware = _generate_all(addrs, "cache_aware", prompts)
+    assert pinned == rotated == cache_aware
+    assert all(len(o) == 6 for o in pinned)
