@@ -429,6 +429,156 @@ class RoutingConfig:
 
 
 @dataclass
+class StalenessControllerConfig:
+    """Autopilot staleness controller: retunes the paper's core async
+    knob — ``max_head_offpolicyness`` — from the MEASURED trainer bubble
+    (``areal_train_bubble_fraction``) and the accepted-trajectory
+    version-span tail, instead of leaving it a hand-set constant. Grow
+    when the trainer starves waiting on rollouts; shrink when the bubble
+    is gone but trajectories span many versions (off-policyness bought
+    nothing)."""
+
+    enabled: bool = True
+    # hard clamp on the bound the controller may set
+    min_staleness: int = 0
+    max_staleness: int = 8
+    # bubble fraction at/above which the trainer counts as starved (grow
+    # the bound by 1); at/below shrink_bubble_fraction AND a wide span
+    # tail, shrink by 1. The gap between them is the hysteresis dead band.
+    grow_bubble_fraction: float = 0.25
+    shrink_bubble_fraction: float = 0.05
+    # version-span p99 at/above which accepted trajectories count as
+    # "wide" (the off-policyness the bound permits is actually being used)
+    wide_span_p99: float = 1.0
+    cooldown_s: float = 30.0
+
+
+@dataclass
+class AdmissionControllerConfig:
+    """Autopilot admission controller: AIMD on the engine admission gates
+    (``lifecycle.max_queue_depth``, ``lifecycle.min_free_pages``) and the
+    gateway's interactive headroom, driven by queue-wait p99, shed rate,
+    and deadline-reap rate. Multiplicative decrease under latency pain,
+    additive increase under clean shedding — with a dead band between the
+    two thresholds so the gate never flaps."""
+
+    enabled: bool = True
+    # max_queue_depth clamp + AIMD steps
+    min_queue_depth: int = 4
+    max_queue_depth: int = 256
+    queue_depth_step: int = 4  # additive increase
+    queue_depth_decrease: float = 0.5  # multiplicative decrease factor
+    # queue-wait p99 above high -> shrink the queue (shed earlier, protect
+    # latency); below low AND shedding -> grow it (stop turning away work
+    # the fleet could finish). Between them: hold (hysteresis).
+    high_queue_wait_s: float = 5.0
+    low_queue_wait_s: float = 1.0
+    high_shed_rate_per_s: float = 1.0
+    # min_free_pages clamp + step (deadline reaps mean admitted work could
+    # not finish — demand more KV headroom before admitting)
+    min_free_pages_floor: int = 0
+    min_free_pages_ceiling: int = 256
+    free_pages_step: int = 8
+    high_reap_rate_per_s: float = 0.5
+    # gateway interactive headroom: widen while interactive traffic sheds;
+    # narrow after this many consecutive quiet control rounds
+    min_headroom: int = 0
+    max_headroom: int = 64
+    headroom_step: int = 2
+    narrow_after_quiet_rounds: int = 6
+    cooldown_s: float = 10.0
+
+
+@dataclass
+class CacheControllerConfig:
+    """Autopilot cache controller: grows the radix prefix cache's
+    ``max_fraction`` while the cache is earning (high prefix-hit rate)
+    and HBM headroom allows, shrinks it under HBM pressure or when the
+    workload has no prefix reuse to exploit."""
+
+    enabled: bool = True
+    min_fraction: float = 0.1
+    max_fraction: float = 0.8
+    fraction_step: float = 0.05
+    # grow only while hit rate is at/above high_hit_rate AND headroom is
+    # at/above high_headroom; shrink below low_headroom (HBM pressure) or
+    # at/below low_hit_rate (cache idle). Gaps are the hysteresis bands.
+    high_hit_rate: float = 0.3
+    low_hit_rate: float = 0.02
+    high_headroom_fraction: float = 0.15
+    low_headroom_fraction: float = 0.05
+    cooldown_s: float = 20.0
+
+
+@dataclass
+class FleetControllerConfig:
+    """Autopilot fleet controller: a load-following autoscaler over the
+    PR 8 drain/undrain primitives (PR 3 supervision respawns evicted
+    workers). Sustained low utilization drains the least-loaded replica
+    (scale down without killing in-flight work); sustained queue backlog
+    undrains one (scale back up). Floor/ceiling + cooldown + a sustain
+    requirement keep it from flapping on transients."""
+
+    enabled: bool = True
+    min_replicas: int = 1
+    max_replicas: int = 0  # 0 = the fleet's initial size
+    # drain one replica after sustain_rounds consecutive control rounds
+    # with mean load fraction below drain_below_load AND an empty queue
+    drain_below_load: float = 0.3
+    # undrain one after undrain_sustain_rounds consecutive rounds with
+    # mean queue depth above undrain_above_queue. Scale-up is the
+    # safety direction, so it is deliberately twitchier than scale-down
+    # (1 round by default) and exempt from the cooldown — a backlog must
+    # never wait out a recent drain's cooldown.
+    undrain_above_queue: float = 2.0
+    sustain_rounds: int = 3
+    undrain_sustain_rounds: int = 1
+    # cooldown between DRAIN actions (scale-down only)
+    cooldown_s: float = 30.0
+
+
+@dataclass
+class AutopilotConfig:
+    """Goodput autopilot (areal_tpu/autopilot/, docs/autopilot.md): the
+    adaptive control plane that closes the loop the observatories opened.
+    Four controllers read the signals the fleet already exports (trainer
+    bubble, queue-wait/shed/reap tails, prefix-hit rate vs HBM headroom,
+    per-replica load) and actuate the knobs the fleet already has (the
+    staleness bound, admission gates + gateway headroom, the radix cache
+    cap, drain/undrain). Disabled by default: ``enabled=False`` preserves
+    today's hand-set static configuration byte-for-byte. Every decision
+    is audited to the flight ring (``kind=autopilot_decision``) and the
+    ``areal_autopilot_*`` metrics."""
+
+    enabled: bool = False
+    interval_s: float = 5.0  # control-loop period
+    # a controller whose input signals are older than this holds position
+    # (mirrors the PR 12 stale-snapshot round-robin degradation)
+    signal_ttl_s: float = 30.0
+    # shared secret for POST /autopilot/knobs actuation; must match each
+    # server's ServerConfig.autopilot_token. Empty = unauthenticated
+    # (matching the other ops endpoints).
+    token: str = ""
+    # where the signal plane reads Prometheus metrics from. Empty = the
+    # local process registry — right when the autopilot is colocated with
+    # what it observes (in-process fleets; the trainer's own bubble/span
+    # gauges). A REMOTE replica fleet exports its serving tails
+    # (queue-wait, sheds, prefix-hit, HBM) in its own processes: point
+    # this at the controller telemetry endpoint's merged /metrics
+    # (host:port; RolloutController.start_telemetry) or the admission and
+    # cache controllers will hold forever on absent signals.
+    metrics_addr: str = ""
+    staleness: StalenessControllerConfig = field(
+        default_factory=StalenessControllerConfig
+    )
+    admission: AdmissionControllerConfig = field(
+        default_factory=AdmissionControllerConfig
+    )
+    cache: CacheControllerConfig = field(default_factory=CacheControllerConfig)
+    fleet: FleetControllerConfig = field(default_factory=FleetControllerConfig)
+
+
+@dataclass
 class InferenceEngineConfig:
     """Client-side rollout controls incl. staleness knobs (reference
     cli_args.py:1591-1612)."""
@@ -500,6 +650,10 @@ class InferenceEngineConfig:
     journal: TrajectoryJournalConfig = field(
         default_factory=TrajectoryJournalConfig
     )
+    # goodput autopilot (areal_tpu/autopilot/): adaptive controllers over
+    # the staleness bound, admission gates, cache cap, and fleet size.
+    # Off by default — static configs behave exactly as before.
+    autopilot: AutopilotConfig = field(default_factory=AutopilotConfig)
 
 
 @dataclass
@@ -610,6 +764,11 @@ class ServerConfig:
     # graceful drain — admission stops (429), in-flight decodes finish or
     # park within preemption.drain_budget_s, the replica deregisters
     preemption: PreemptionConfig = field(default_factory=PreemptionConfig)
+    # shared secret the goodput autopilot must present (header
+    # x-areal-autopilot-token) on POST /autopilot/knobs before the server
+    # applies control-plane setpoints. Empty = unauthenticated (matching
+    # the other ops endpoints on a trusted network).
+    autopilot_token: str = ""
     # where streamed weight-update buckets stage while generation continues:
     # "device" = device_put on arrival (staging costs a 2nd copy of the
     #            weights in HBM until commit; the commit itself is a pointer

@@ -1,0 +1,34 @@
+"""Goodput autopilot — the adaptive control plane over the areal_tpu
+fleet (docs/autopilot.md).
+
+PRs 7/9/12 built the observatories (request timelines, trainer step
+phases, router scoreboards); PRs 3/8 built the actuation primitives
+(supervised respawn, drain/undrain). This package closes the loop: four
+controllers behind one :class:`Autopilot` facade read the signals the
+fleet already exports and retune the knobs the fleet already has —
+staleness bound, admission gates + gateway headroom, radix-cache cap,
+and fleet size — with every decision audited to the flight ring and the
+``areal_autopilot_*`` metrics. ``AutopilotConfig.enabled=False``
+(default) preserves static-config behavior byte-for-byte.
+"""
+
+from areal_tpu.autopilot.autopilot import Autopilot, autopilot_from_config
+from areal_tpu.autopilot.controllers import (
+    Action,
+    AdmissionController,
+    CacheController,
+    FleetController,
+    StalenessController,
+)
+from areal_tpu.autopilot.signals import Signals
+
+__all__ = [
+    "Action",
+    "AdmissionController",
+    "Autopilot",
+    "autopilot_from_config",
+    "CacheController",
+    "FleetController",
+    "Signals",
+    "StalenessController",
+]

@@ -1,0 +1,490 @@
+"""Autopilot facade: the control loop that closes measurement to action.
+
+One background thread per control plane. Every ``interval_s`` it
+
+1. polls the fleet's ``/statusz`` through the PR 12
+   :class:`~areal_tpu.routing.snapshot.SnapshotPoller` and fetches one
+   Prometheus-shaped metrics sample (local registry by default),
+2. assembles a :class:`~areal_tpu.autopilot.signals.Signals` snapshot,
+3. runs each enabled controller's ``decide()``, and
+4. applies the resulting :class:`~areal_tpu.autopilot.controllers.Action`
+   list through the actuators:
+
+   - ``max_staleness`` -> the in-process
+     :meth:`StalenessManager.set_max_staleness` hook (trainer side);
+   - ``max_queue_depth`` / ``min_free_pages`` / ``radix_max_fraction``
+     -> ``POST /autopilot/knobs`` on every replica (authenticated by
+     ``AutopilotConfig.token`` when the servers configure one);
+   - ``gateway_interactive_headroom`` -> the in-process
+     :meth:`GatewayState.set_interactive_headroom` hook;
+   - fleet scale-down/up -> ``POST /drain`` / ``POST /undrain`` (the
+     PR 8 primitives; PR 3 supervision respawns evicted workers).
+
+Every applied action is audited to the flight ring
+(``kind=autopilot_decision``: controller, knob, old -> new, reason, the
+signal values that drove it) and onto the ``areal_autopilot_*`` metrics,
+so any setpoint the fleet is running can be traced to the measurement
+that set it (docs/autopilot.md, "Audit & postmortem").
+
+Failed actuations count on ``areal_autopilot_apply_failures_total`` and
+the controller's setpoint stands — the next round re-applies (replicas
+report their active knobs in the ``/statusz`` ``autopilot`` section, so
+drift is visible).
+"""
+
+from __future__ import annotations
+
+import json as _json
+import threading
+import time
+
+from areal_tpu.autopilot import signals as sig_mod
+from areal_tpu.autopilot.controllers import (
+    Action,
+    AdmissionController,
+    CacheController,
+    FleetController,
+    StalenessController,
+)
+from areal_tpu.observability import catalog
+from areal_tpu.observability import timeline as tl_mod
+from areal_tpu.utils import logging as alog
+
+logger = alog.getLogger("autopilot")
+
+KNOB_POST_TIMEOUT_S = 5.0
+DRAIN_POST_TIMEOUT_S = 30.0
+
+# the per-replica knobs POST /autopilot/knobs accepts (the rest of an
+# Action's knobs actuate through in-process hooks)
+REPLICA_KNOBS = ("max_queue_depth", "min_free_pages", "radix_max_fraction")
+
+
+def _default_post(addr: str, path: str, payload: dict, token: str, timeout: float) -> dict:
+    import urllib.request
+
+    headers = {"Content-Type": "application/json"}
+    if token:
+        headers["x-areal-autopilot-token"] = token
+    req = urllib.request.Request(
+        f"http://{addr}{path}",
+        data=_json.dumps(payload).encode(),
+        headers=headers,
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return _json.loads(r.read() or b"{}")
+
+
+class Autopilot:
+    """One control plane over one fleet (plus optional in-process hooks).
+
+    ``addresses_fn`` supplies the replica fleet each round (same contract
+    as the router's poller). ``staleness_manager`` and ``gateway`` are
+    the in-process actuation hooks — pass them where the autopilot is
+    colocated with the trainer / gateway; leave None and those
+    controllers hold their knobs. ``metrics_source`` defaults to the
+    process registry; ``post_fn`` is injectable for tests."""
+
+    def __init__(
+        self,
+        cfg,
+        addresses_fn,
+        *,
+        staleness_manager=None,
+        gateway=None,
+        metrics_source=None,
+        poller=None,
+        fetch_statusz=None,
+        post_fn=None,
+        flight=None,
+    ):
+        from areal_tpu.routing.snapshot import SnapshotPoller
+
+        self.cfg = cfg
+        self._addresses_fn = addresses_fn
+        self._staleness_manager = staleness_manager
+        self._gateway = gateway
+        if metrics_source is not None:
+            self._source = metrics_source
+        elif getattr(cfg, "metrics_addr", ""):
+            # a remote fleet's serving tails live in ITS processes —
+            # scrape the configured merged /metrics endpoint
+            self._source = sig_mod.HttpMetricsSource(cfg.metrics_addr)
+        else:
+            self._source = sig_mod.LocalRegistrySource()
+        self._owns_poller = poller is None
+        self.poller = poller or SnapshotPoller(
+            addresses_fn,
+            fetch=fetch_statusz,
+            interval_s=max(0.1, cfg.interval_s / 2),
+            ttl_s=cfg.signal_ttl_s,
+        )
+        self._post = post_fn or (
+            lambda addr, path, payload, timeout=KNOB_POST_TIMEOUT_S: _default_post(
+                addr, path, payload, cfg.token, timeout
+            )
+        )
+        self._flight = flight or tl_mod.get_flight_recorder()
+        self._obs = catalog.autopilot_metrics()
+        self._rates = sig_mod.RateTracker()
+        self.controllers = []
+        if cfg.staleness.enabled and staleness_manager is not None:
+            self.controllers.append(
+                StalenessController(
+                    cfg.staleness, staleness_manager.max_staleness
+                )
+            )
+        if cfg.admission.enabled:
+            self.controllers.append(
+                AdmissionController(
+                    cfg.admission,
+                    queue_depth=self._initial_knob("max_queue_depth", 32),
+                    min_free_pages=self._initial_knob("min_free_pages", 0),
+                    headroom=(
+                        gateway.interactive_headroom if gateway is not None else 0
+                    ),
+                    # no gateway hook -> the headroom knob is unmanageable
+                    # from here; the controller must not ratchet a
+                    # setpoint nobody can apply
+                    manage_headroom=gateway is not None,
+                )
+            )
+        if cfg.cache.enabled:
+            self.controllers.append(
+                CacheController(cfg.cache, initial_fraction=0.5)
+            )
+        if cfg.fleet.enabled:
+            self.controllers.append(
+                FleetController(
+                    cfg.fleet, initial_replicas=len(addresses_fn() or [])
+                )
+            )
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop: threading.Event | None = None
+        self._decisions: dict[str, int] = {}  # reason -> count
+        self._n_decisions = 0
+        # addr -> (last acked knob set, monotonic ack time): the ack time
+        # arbitrates against snapshot staleness — only a snapshot FRESHER
+        # than the ack may re-open a push (respawn detection without
+        # re-POSTing every round while the poller catches up)
+        self._applied_knobs: dict[str, tuple[dict, float]] = {}
+        # PER-KNOB actuation ledger: only knobs whose controller actually
+        # decided are ever pushed — a never-acted controller's initial
+        # guess (e.g. the cache fraction default) must not silently
+        # override operator config without an audited decision
+        self._actuated_knobs: set[str] = set()
+        self._last_actions: list[dict] = []  # bounded recent-action ledger
+
+    def _initial_knob(self, name: str, default: int) -> int:
+        # the admission controller starts from whatever the operator set
+        # (the first replica snapshot is not in yet at construction time);
+        # callers wiring a known config pass it via seed_setpoints
+        return default
+
+    def seed_setpoints(self, **knobs) -> None:
+        """Initialize controller setpoints from the operator's static
+        config (e.g. the fleet's configured max_queue_depth) so the first
+        decision steps from there, not from a built-in default."""
+        for ctrl in self.controllers:
+            if isinstance(ctrl, AdmissionController):
+                if "max_queue_depth" in knobs:
+                    ctrl.queue_depth = max(
+                        ctrl.cfg.min_queue_depth,
+                        min(
+                            ctrl.cfg.max_queue_depth,
+                            int(knobs["max_queue_depth"]),
+                        ),
+                    )
+                if "min_free_pages" in knobs:
+                    ctrl.min_free_pages = max(
+                        ctrl.cfg.min_free_pages_floor,
+                        min(
+                            ctrl.cfg.min_free_pages_ceiling,
+                            int(knobs["min_free_pages"]),
+                        ),
+                    )
+                if "gateway_interactive_headroom" in knobs:
+                    ctrl.headroom = max(
+                        ctrl.cfg.min_headroom,
+                        min(
+                            ctrl.cfg.max_headroom,
+                            int(knobs["gateway_interactive_headroom"]),
+                        ),
+                    )
+            if isinstance(ctrl, CacheController) and "radix_max_fraction" in knobs:
+                ctrl.fraction = max(
+                    ctrl.cfg.min_fraction,
+                    min(
+                        ctrl.cfg.max_fraction,
+                        float(knobs["radix_max_fraction"]),
+                    ),
+                )
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        if self._owns_poller:
+            self.poller.start()
+        stop = threading.Event()
+        self._stop = stop
+
+        def loop():
+            while not stop.wait(self.cfg.interval_s):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — the control loop must
+                    # outlive any single bad round (a dead autopilot is a
+                    # silently static fleet again)
+                    logger.exception("autopilot round failed")
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="autopilot"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=10)
+            self._thread = None
+            self._stop = None
+        if self._owns_poller:
+            self.poller.stop()
+
+    # -- the control round -------------------------------------------------
+    def read_signals(self) -> sig_mod.Signals:
+        try:
+            samples = self._source.fetch()
+        except Exception:  # noqa: BLE001 — a failed scrape is a stale
+            # signal, and stale signals hold position by design
+            logger.warning("autopilot metrics fetch failed", exc_info=True)
+            samples = []
+        return sig_mod.assemble(
+            samples, self._rates, snapshots=self.poller.live()
+        )
+
+    def tick(self) -> list[Action]:
+        """One control round; returns the applied actions (tests and the
+        self-test call this directly — no thread required)."""
+        sig = self.read_signals()
+        applied: list[Action] = []
+        for ctrl in self.controllers:
+            actions = ctrl.decide(sig)
+            if ctrl.last_hold is not None:
+                self._obs.signal_holds.labels(controller=ctrl.name).inc()
+            for action in actions:
+                if self._apply(action, sig):
+                    applied.append(action)
+        # ONE convergence sweep per round (replica-knob actions above only
+        # mark their knob actuated): pushes dedupe through the ack ledger,
+        # and replicas whose push failed, joined late, or respawned at the
+        # same address (their /statusz autopilot section reads cold and
+        # FRESHER than our ack) are re-pushed until the fleet matches
+        if self._actuated_knobs:
+            self._push_replica_knobs()
+        self._export(sig, applied)
+        return applied
+
+    # -- actuation ---------------------------------------------------------
+    def _apply(self, action: Action, sig: sig_mod.Signals) -> bool:
+        ok = True
+        if action.knob == "max_staleness":
+            if self._staleness_manager is None:
+                return False
+            self._staleness_manager.set_max_staleness(int(action.new))
+        elif action.knob == "gateway_interactive_headroom":
+            if self._gateway is None:
+                return False
+            self._gateway.set_interactive_headroom(int(action.new))
+        elif action.knob in REPLICA_KNOBS:
+            # the end-of-tick convergence sweep does the actual push —
+            # several same-round actions must not each fan a POST wave
+            self._actuated_knobs.add(action.knob)
+        elif action.knob == "target_replicas":
+            path = "/drain" if action.new < action.old else "/undrain"
+            if path == "/drain":
+                # /drain blocks server-side until the replica quiesces
+                # (up to its drain budget) — that must not stall the
+                # control loop, where the cooldown-exempt UNDRAIN safety
+                # direction lives. Fire-and-observe: the snapshot's
+                # draining flag confirms within a poll interval, and a
+                # failure re-decides from fresh snapshots.
+                threading.Thread(
+                    target=self._post_drain,
+                    args=(action.target,),
+                    daemon=True,
+                    name="autopilot-drain",
+                ).start()
+            else:
+                try:
+                    self._post(
+                        action.target, path, {}, timeout=DRAIN_POST_TIMEOUT_S
+                    )
+                except Exception:  # noqa: BLE001 — a failed undrain is
+                    # re-decided next round from fresh snapshots
+                    logger.warning(
+                        f"autopilot {path} {action.target} failed",
+                        exc_info=True,
+                    )
+                    self._obs.apply_failures.inc()
+                    return False
+        else:
+            return False
+        self._audit(action, sig)
+        return ok
+
+    def _post_drain(self, target: str) -> None:
+        try:
+            self._post(target, "/drain", {}, timeout=DRAIN_POST_TIMEOUT_S)
+        except Exception:  # noqa: BLE001 — observed via snapshots; the
+            # controller re-decides if the replica never reads draining
+            logger.warning(f"autopilot /drain {target} failed", exc_info=True)
+            self._obs.apply_failures.inc()
+
+    def _desired_replica_knobs(self) -> dict:
+        """The replica-side knob set to converge the fleet on — only
+        knobs whose controller has actually DECIDED at least once: a
+        quiet controller's initial guess never overrides operator config
+        without an audited action behind it."""
+        knobs: dict[str, float] = {}
+        for ctrl in self.controllers:
+            for k, v in ctrl.setpoints().items():
+                if k in REPLICA_KNOBS and k in self._actuated_knobs:
+                    knobs[k] = v
+        return knobs
+
+    def _push_replica_knobs(self) -> bool:
+        """POST the replica-side knob set to every fleet member that does
+        not already run it. The ack ledger dedupes (a pushed-and-acked
+        replica is not re-POSTed every round while the /statusz snapshot
+        lags); a snapshot FRESHER than the ack that disagrees re-opens
+        the push — that is the respawned-replica-at-the-same-address
+        signature (its autopilot section reads cold)."""
+        knobs = self._desired_replica_knobs()
+        if not knobs:
+            return True
+        ok = True
+        snaps = self.poller.live()
+        for addr in list(self._addresses_fn() or []):
+            entry = self._applied_knobs.get(addr)
+            snap = snaps.get(addr)
+            if entry is not None and entry[0] == knobs:
+                diverged = (
+                    snap is not None
+                    and snap.fetched_at > entry[1]
+                    and not all(
+                        snap.autopilot_knobs.get(k) == v
+                        for k, v in knobs.items()
+                    )
+                )
+                if not diverged:
+                    continue
+            try:
+                self._post(addr, "/autopilot/knobs", knobs)
+                self._applied_knobs[addr] = (dict(knobs), time.monotonic())
+            except Exception:  # noqa: BLE001 — one dead replica must not
+                # stall the rest of the fleet's convergence
+                logger.warning(
+                    f"autopilot knob push to {addr} failed", exc_info=True
+                )
+                self._obs.apply_failures.inc()
+                self._applied_knobs.pop(addr, None)
+                ok = False
+        return ok
+
+    # -- audit & export ----------------------------------------------------
+    def _audit(self, action: Action, sig: sig_mod.Signals) -> None:
+        data = {
+            "controller": action.controller,
+            "knob": action.knob,
+            "old": action.old,
+            "new": action.new,
+            "reason": action.reason,
+        }
+        if action.target:
+            data["target"] = action.target
+        # the signal values that drove the decision ride along so a
+        # postmortem reads the WHY without correlating scrape timelines
+        for k in (
+            "bubble_fraction",
+            "version_span_p99",
+            "queue_wait_p99_s",
+            "shed_rate_per_s",
+            "interactive_shed_rate_per_s",
+            "reap_rate_per_s",
+            "prefix_hit_rate",
+            "hbm_headroom_fraction",
+            "mean_load_fraction",
+            "mean_queue_depth",
+        ):
+            v = getattr(sig, k)
+            if v is not None:
+                data[k] = round(float(v), 4)
+        self._flight.record("autopilot_decision", **data)
+        self._obs.decisions.labels(
+            controller=action.controller, reason=action.reason
+        ).inc()
+        with self._lock:
+            self._n_decisions += 1
+            self._decisions[action.reason] = (
+                self._decisions.get(action.reason, 0) + 1
+            )
+            self._last_actions.append(
+                {**data, "ts": time.time()}
+            )
+            del self._last_actions[:-64]
+
+    def _export(self, sig: sig_mod.Signals, applied: list[Action]) -> None:
+        now = sig.now
+        for ctrl in self.controllers:
+            for knob, v in ctrl.setpoints().items():
+                self._obs.setpoint.labels(knob=knob).set(v)
+            age = (
+                now - ctrl.last_action_ts
+                if ctrl.last_action_ts is not None
+                else -1.0
+            )
+            self._obs.last_action_age.labels(controller=ctrl.name).set(age)
+
+    def setpoints(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for ctrl in self.controllers:
+            out.update(ctrl.setpoints())
+        return out
+
+    def status(self) -> dict:
+        """Live control-plane summary (bench ``detail.autopilot``, the
+        dashboard's source of truth in-process)."""
+        with self._lock:
+            return {
+                "enabled": bool(self.cfg.enabled),
+                "setpoints": self.setpoints(),
+                "decisions": self._n_decisions,
+                "decisions_by_reason": dict(self._decisions),
+                "controllers": [c.name for c in self.controllers],
+                "recent_actions": list(self._last_actions[-8:]),
+            }
+
+
+def autopilot_from_config(
+    cfg,
+    addresses_fn,
+    *,
+    staleness_manager=None,
+    gateway=None,
+    **kw,
+):
+    """Build-and-None helper: returns a started-able Autopilot when
+    ``cfg.enabled``, else None — the one-line wiring call sites use."""
+    if cfg is None or not cfg.enabled:
+        return None
+    return Autopilot(
+        cfg,
+        addresses_fn,
+        staleness_manager=staleness_manager,
+        gateway=gateway,
+        **kw,
+    )

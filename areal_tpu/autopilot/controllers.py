@@ -1,0 +1,475 @@
+"""The four autopilot controllers (docs/autopilot.md, controller catalog).
+
+Each controller is a small pure-ish state machine: ``decide(signals)``
+maps one :class:`~areal_tpu.autopilot.signals.Signals` snapshot to a list
+of :class:`Action` setpoint changes, under four shared disciplines:
+
+- **hysteresis**: act only outside a dead band between the low and high
+  thresholds, so measurement noise never flaps a knob;
+- **cooldown**: at most one change per ``cooldown_s`` per controller, so
+  the fleet settles between actions;
+- **clamps**: every setpoint lives inside configured hard min/max — the
+  autopilot can tune, never escape, the operator's envelope;
+- **stale-signal hold**: a required signal that is absent (``None``)
+  holds position (``last_hold`` names the missing signal), mirroring the
+  router's degrade-to-round-robin rather than acting on fabricated zeros.
+
+Controllers only *decide*; the :class:`~areal_tpu.autopilot.autopilot.
+Autopilot` facade applies, audits, and owns the wall clock (``decide``
+takes ``signals.now`` so tests drive time explicitly — no fleet needed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from areal_tpu.autopilot.signals import Signals
+
+
+@dataclasses.dataclass
+class Action:
+    """One setpoint change: knob ``old -> new`` for a reason, optionally
+    targeted at a single replica (fleet drain/undrain)."""
+
+    controller: str
+    knob: str
+    old: float
+    new: float
+    reason: str
+    target: str = ""  # replica address for drain/undrain, "" = fleet-wide
+
+
+class _Base:
+    name = "base"
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.last_action_ts: float | None = None
+        self.last_hold: str | None = None  # missing-signal name, else None
+
+    def _cooling(self, now: float) -> bool:
+        return (
+            self.last_action_ts is not None
+            and now - self.last_action_ts < self.cfg.cooldown_s
+        )
+
+    def _acted(self, now: float) -> None:
+        self.last_action_ts = now
+
+    def setpoints(self) -> dict[str, float]:
+        return {}
+
+    def decide(self, sig: Signals) -> list[Action]:
+        raise NotImplementedError
+
+
+class StalenessController(_Base):
+    """max_head_offpolicyness from the measured trainer bubble + span tail.
+
+    Grow when the trainer starves (bubble high: more in-flight staleness
+    would keep it fed); shrink when the bubble is gone AND accepted
+    trajectories still span many versions (the permitted off-policyness
+    buys nothing — tighten it and decoupled PPO corrects less)."""
+
+    name = "staleness"
+
+    def __init__(self, cfg, initial: int):
+        super().__init__(cfg)
+        self.bound = max(cfg.min_staleness, min(cfg.max_staleness, initial))
+
+    def setpoints(self) -> dict[str, float]:
+        return {"max_staleness": float(self.bound)}
+
+    def decide(self, sig: Signals) -> list[Action]:
+        self.last_hold = None
+        if sig.bubble_fraction is None:
+            self.last_hold = "bubble_fraction"
+            return []
+        if self._cooling(sig.now):
+            return []
+        if (
+            sig.bubble_fraction >= self.cfg.grow_bubble_fraction
+            and self.bound < self.cfg.max_staleness
+        ):
+            old, self.bound = self.bound, self.bound + 1
+            self._acted(sig.now)
+            return [
+                Action(
+                    self.name,
+                    "max_staleness",
+                    old,
+                    self.bound,
+                    "trainer_starved",
+                )
+            ]
+        if (
+            sig.bubble_fraction <= self.cfg.shrink_bubble_fraction
+            and self.bound > self.cfg.min_staleness
+        ):
+            # shrinking additionally needs the span evidence — without it
+            # the wide bound is harmless and tightening risks a bubble
+            if sig.version_span_p99 is None:
+                self.last_hold = "version_span_p99"
+                return []
+            if sig.version_span_p99 >= self.cfg.wide_span_p99:
+                old, self.bound = self.bound, self.bound - 1
+                self._acted(sig.now)
+                return [
+                    Action(
+                        self.name,
+                        "max_staleness",
+                        old,
+                        self.bound,
+                        "low_bubble_wide_span",
+                    )
+                ]
+        return []
+
+
+class AdmissionController(_Base):
+    """AIMD over the engine admission gates + gateway headroom.
+
+    max_queue_depth: multiplicative decrease when queue-wait p99 crosses
+    ``high_queue_wait_s`` (overload is becoming tail latency — shed
+    earlier), additive increase when the fleet sheds while queue wait is
+    comfortably low (capacity is being turned away). min_free_pages rises
+    while deadline reaps persist (admitted work can't finish — demand KV
+    headroom first) and relaxes under clean shedding with no reaps.
+    Interactive headroom widens while interactive traffic sheds and
+    narrows after ``narrow_after_quiet_rounds`` quiet rounds."""
+
+    name = "admission"
+
+    def __init__(
+        self,
+        cfg,
+        queue_depth: int,
+        min_free_pages: int,
+        headroom: int,
+        manage_headroom: bool = True,
+    ):
+        super().__init__(cfg)
+        self.queue_depth = max(
+            cfg.min_queue_depth, min(cfg.max_queue_depth, queue_depth)
+        )
+        self.min_free_pages = max(
+            cfg.min_free_pages_floor,
+            min(cfg.min_free_pages_ceiling, min_free_pages),
+        )
+        self.headroom = max(cfg.min_headroom, min(cfg.max_headroom, headroom))
+        # False when no gateway hook is wired (e.g. the trainer-side
+        # facade with a remote gateway): the headroom branch is skipped
+        # entirely — a setpoint nobody can actuate must not ratchet,
+        # consume cooldown, or report a phantom value
+        self.manage_headroom = manage_headroom
+        self._quiet_rounds = 0
+
+    def setpoints(self) -> dict[str, float]:
+        out = {
+            "max_queue_depth": float(self.queue_depth),
+            "min_free_pages": float(self.min_free_pages),
+        }
+        if self.manage_headroom:
+            out["gateway_interactive_headroom"] = float(self.headroom)
+        return out
+
+    def decide(self, sig: Signals) -> list[Action]:
+        self.last_hold = None
+        if sig.queue_wait_p99_s is None or sig.shed_rate_per_s is None:
+            self.last_hold = (
+                "queue_wait_p99_s"
+                if sig.queue_wait_p99_s is None
+                else "shed_rate_per_s"
+            )
+            return []
+        # the quiet-round counter advances every round with live signals
+        # (not just actionable ones) so "sustained quiet" means wall time
+        if (sig.interactive_shed_rate_per_s or 0.0) > 0.0:
+            self._quiet_rounds = 0
+        else:
+            self._quiet_rounds += 1
+        if self._cooling(sig.now):
+            return []
+        actions: list[Action] = []
+        c = self.cfg
+        if (
+            sig.queue_wait_p99_s >= c.high_queue_wait_s
+            and self.queue_depth > c.min_queue_depth
+        ):
+            old = self.queue_depth
+            self.queue_depth = max(
+                c.min_queue_depth, int(old * c.queue_depth_decrease)
+            )
+            actions.append(
+                Action(
+                    self.name,
+                    "max_queue_depth",
+                    old,
+                    self.queue_depth,
+                    "queue_wait_high",
+                )
+            )
+        elif (
+            sig.queue_wait_p99_s <= c.low_queue_wait_s
+            and sig.shed_rate_per_s >= c.high_shed_rate_per_s
+            and self.queue_depth < c.max_queue_depth
+        ):
+            old = self.queue_depth
+            self.queue_depth = min(
+                c.max_queue_depth, old + c.queue_depth_step
+            )
+            actions.append(
+                Action(
+                    self.name,
+                    "max_queue_depth",
+                    old,
+                    self.queue_depth,
+                    "shed_under_capacity",
+                )
+            )
+        reap = sig.reap_rate_per_s
+        if reap is not None:
+            if (
+                reap >= c.high_reap_rate_per_s
+                and self.min_free_pages < c.min_free_pages_ceiling
+            ):
+                old = self.min_free_pages
+                self.min_free_pages = min(
+                    c.min_free_pages_ceiling, old + c.free_pages_step
+                )
+                actions.append(
+                    Action(
+                        self.name,
+                        "min_free_pages",
+                        old,
+                        self.min_free_pages,
+                        "deadline_reaps",
+                    )
+                )
+            elif (
+                reap == 0.0
+                and sig.shed_rate_per_s >= c.high_shed_rate_per_s
+                and self.min_free_pages > c.min_free_pages_floor
+            ):
+                old = self.min_free_pages
+                self.min_free_pages = max(
+                    c.min_free_pages_floor, old - c.free_pages_step
+                )
+                actions.append(
+                    Action(
+                        self.name,
+                        "min_free_pages",
+                        old,
+                        self.min_free_pages,
+                        "shed_without_reaps",
+                    )
+                )
+        ishd = sig.interactive_shed_rate_per_s
+        if self.manage_headroom and ishd is not None:
+            if ishd > 0.0 and self.headroom < c.max_headroom:
+                old = self.headroom
+                self.headroom = min(c.max_headroom, old + c.headroom_step)
+                actions.append(
+                    Action(
+                        self.name,
+                        "gateway_interactive_headroom",
+                        old,
+                        self.headroom,
+                        "interactive_shed",
+                    )
+                )
+            elif (
+                self._quiet_rounds >= c.narrow_after_quiet_rounds
+                and self.headroom > c.min_headroom
+            ):
+                old = self.headroom
+                self.headroom = max(c.min_headroom, old - c.headroom_step)
+                self._quiet_rounds = 0
+                actions.append(
+                    Action(
+                        self.name,
+                        "gateway_interactive_headroom",
+                        old,
+                        self.headroom,
+                        "sustained_quiet",
+                    )
+                )
+        if actions:
+            self._acted(sig.now)
+        return actions
+
+
+class CacheController(_Base):
+    """Radix-cache ``max_fraction`` from hit rate vs HBM headroom."""
+
+    name = "cache"
+
+    def __init__(self, cfg, initial_fraction: float):
+        super().__init__(cfg)
+        self.fraction = max(
+            cfg.min_fraction, min(cfg.max_fraction, initial_fraction)
+        )
+
+    def setpoints(self) -> dict[str, float]:
+        return {"radix_max_fraction": round(self.fraction, 4)}
+
+    def decide(self, sig: Signals) -> list[Action]:
+        self.last_hold = None
+        if sig.prefix_hit_rate is None or sig.hbm_headroom_fraction is None:
+            self.last_hold = (
+                "prefix_hit_rate"
+                if sig.prefix_hit_rate is None
+                else "hbm_headroom_fraction"
+            )
+            return []
+        if self._cooling(sig.now):
+            return []
+        c = self.cfg
+        step = c.fraction_step
+        if (
+            sig.hbm_headroom_fraction < c.low_headroom_fraction
+            and self.fraction > c.min_fraction
+        ):
+            old = self.fraction
+            self.fraction = max(c.min_fraction, round(old - step, 4))
+            self._acted(sig.now)
+            return [
+                Action(
+                    self.name,
+                    "radix_max_fraction",
+                    old,
+                    self.fraction,
+                    "hbm_pressure",
+                )
+            ]
+        if (
+            sig.prefix_hit_rate <= c.low_hit_rate
+            and self.fraction > c.min_fraction
+        ):
+            old = self.fraction
+            self.fraction = max(c.min_fraction, round(old - step, 4))
+            self._acted(sig.now)
+            return [
+                Action(
+                    self.name,
+                    "radix_max_fraction",
+                    old,
+                    self.fraction,
+                    "cache_idle",
+                )
+            ]
+        if (
+            sig.prefix_hit_rate >= c.high_hit_rate
+            and sig.hbm_headroom_fraction >= c.high_headroom_fraction
+            and self.fraction < c.max_fraction
+        ):
+            old = self.fraction
+            self.fraction = min(c.max_fraction, round(old + step, 4))
+            self._acted(sig.now)
+            return [
+                Action(
+                    self.name,
+                    "radix_max_fraction",
+                    old,
+                    self.fraction,
+                    "cache_earning",
+                )
+            ]
+        return []
+
+
+class FleetController(_Base):
+    """Load-following autoscaler over drain/undrain.
+
+    Sustained low mean load with an empty queue drains the least-loaded
+    live replica (finish-or-park — nothing dies responseless); sustained
+    queue backlog undrains one previously drained replica. A drained
+    replica 503s /health, so PR 3 supervision stops routing to it and a
+    respawned worker re-enters through the same undrain path. The sustain
+    requirement (``sustain_rounds`` consecutive observations) is the
+    hysteresis; floor/ceiling and the cooldown bound the blast radius."""
+
+    name = "fleet"
+
+    def __init__(self, cfg, initial_replicas: int):
+        super().__init__(cfg)
+        self.ceiling = cfg.max_replicas or initial_replicas
+        self._low_rounds = 0
+        self._high_rounds = 0
+        self._undrain_sustain = max(
+            1, getattr(cfg, "undrain_sustain_rounds", 1)
+        )
+
+    def setpoints(self) -> dict[str, float]:
+        return {}
+
+    def decide(self, sig: Signals) -> list[Action]:
+        self.last_hold = None
+        if sig.mean_load_fraction is None or sig.mean_queue_depth is None:
+            self.last_hold = "fleet_snapshots"
+            # a blind round breaks the sustain streak: "sustained" must
+            # mean consecutively OBSERVED, not assumed across a blackout
+            self._low_rounds = self._high_rounds = 0
+            return []
+        c = self.cfg
+        if (
+            sig.mean_load_fraction < c.drain_below_load
+            and sig.mean_queue_depth == 0
+        ):
+            self._low_rounds += 1
+        else:
+            self._low_rounds = 0
+        if sig.mean_queue_depth > c.undrain_above_queue:
+            self._high_rounds += 1
+        else:
+            self._high_rounds = 0
+        live = [r for r in sig.replicas if not r.draining]
+        # only CANCELLABLE drains are scale-up candidates: a terminal
+        # drain belongs to a process the platform is about to SIGKILL —
+        # undraining it would re-open admission on a dying replica
+        drained = [
+            r for r in sig.replicas if r.draining and not r.drain_terminal
+        ]
+        # scale-up first, and NOT behind the cooldown: bringing capacity
+        # back is the safety direction — a backlog must never wait out a
+        # recent drain's cooldown (the classic autoscaler asymmetry)
+        if (
+            self._high_rounds >= self._undrain_sustain
+            and drained
+            and len(live) < self.ceiling
+        ):
+            # wake the least recently useful first: any drained replica
+            # works (its cache restarted cold either way)
+            target = drained[0].addr
+            self._high_rounds = 0
+            self._acted(sig.now)
+            return [
+                Action(
+                    self.name,
+                    "target_replicas",
+                    len(live),
+                    len(live) + 1,
+                    "sustained_backlog",
+                    target=target,
+                )
+            ]
+        if self._cooling(sig.now):
+            return []
+        if (
+            self._low_rounds >= c.sustain_rounds
+            and len(live) > max(1, c.min_replicas)
+        ):
+            target = min(live, key=lambda r: (r.load_fraction, r.addr)).addr
+            self._low_rounds = 0
+            self._acted(sig.now)
+            return [
+                Action(
+                    self.name,
+                    "target_replicas",
+                    len(live),
+                    len(live) - 1,
+                    "sustained_idle",
+                    target=target,
+                )
+            ]
+        return []

@@ -1,0 +1,356 @@
+"""Signal plane for the goodput autopilot.
+
+Controllers never read raw metrics themselves: every control round the
+:class:`Autopilot` facade assembles ONE :class:`Signals` snapshot from
+
+- a Prometheus-shaped metrics source — :class:`LocalRegistrySource` reads
+  the process registry (colocated trainer/client/gateway, the in-process
+  fleets tests and self-tests run), :class:`HttpMetricsSource` scrapes a
+  remote ``/metrics`` endpoint in text exposition (the controller
+  telemetry aggregator, or a remote trainer — the SnapshotPoller's
+  trainer-stats extension); and
+- the PR 12 :class:`~areal_tpu.routing.snapshot.SnapshotPoller` views
+  (per-replica queue depth, load, free pages, draining flag).
+
+Rates (shed/s, reap/s) are deltas between consecutive rounds of the same
+source. Absent data stays ``None`` — a controller with a missing signal
+holds position; it never acts on a fabricated zero.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Iterable
+
+from areal_tpu.observability.metrics import (
+    get_registry,
+    parse_prometheus_text,
+)
+
+Sample = tuple[str, dict[str, str], float]
+
+
+@dataclasses.dataclass
+class ReplicaView:
+    """The fleet controller's per-replica slice of a snapshot round."""
+
+    addr: str
+    draining: bool = False
+    # a terminal drain belongs to an EXITING process (preemption) — it
+    # can never be undrained, so scale-up must pick someone else
+    drain_terminal: bool = False
+    load_fraction: float = 0.0
+    queue_depth: int = 0
+    free_page_fraction: float = 1.0
+
+
+@dataclasses.dataclass
+class Signals:
+    """One control round's inputs. ``None`` = signal absent/stale —
+    controllers must hold position on it, never treat it as zero."""
+
+    now: float
+    # trainer (staleness controller)
+    bubble_fraction: float | None = None
+    version_span_p99: float | None = None
+    # serving tails + rates (admission controller)
+    queue_wait_p99_s: float | None = None
+    shed_rate_per_s: float | None = None
+    interactive_shed_rate_per_s: float | None = None
+    reap_rate_per_s: float | None = None
+    # cache vs memory (cache controller)
+    prefix_hit_rate: float | None = None
+    hbm_headroom_fraction: float | None = None
+    # fleet (fleet controller) — live = snapshot present and not draining
+    replicas: list[ReplicaView] = dataclasses.field(default_factory=list)
+    mean_load_fraction: float | None = None
+    mean_queue_depth: float | None = None
+
+    @property
+    def n_live(self) -> int:
+        return sum(1 for r in self.replicas if not r.draining)
+
+    @property
+    def n_draining(self) -> int:
+        return sum(1 for r in self.replicas if r.draining)
+
+
+# ---------------------------------------------------------------------------
+# metrics sources (Prometheus-sample shaped)
+# ---------------------------------------------------------------------------
+
+
+class LocalRegistrySource:
+    """The process metrics registry as Prometheus samples — the right
+    source whenever the autopilot is colocated with what it observes (the
+    trainer process owns the bubble gauge; in-process serving fleets share
+    one registry)."""
+
+    def __init__(self, registry=None):
+        self._registry = registry
+
+    def fetch(self) -> list[Sample]:
+        reg = self._registry or get_registry()
+        return parse_prometheus_text(reg.render_prometheus())
+
+
+class HttpMetricsSource:
+    """Scrape ``http://{addr}/metrics`` (text exposition) — a remote
+    trainer or the controller's fleet-merged telemetry endpoint."""
+
+    def __init__(self, addr: str, timeout_s: float = 2.0):
+        self.addr = addr
+        self.timeout_s = timeout_s
+
+    def fetch(self) -> list[Sample]:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://{self.addr}/metrics", headers={"Accept": "text/plain"}
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            return parse_prometheus_text(r.read().decode("utf-8", "replace"))
+
+
+# ---------------------------------------------------------------------------
+# sample readers
+# ---------------------------------------------------------------------------
+
+
+def total(samples: Iterable[Sample], name: str) -> float | None:
+    """Sum of a counter/gauge family over its label children, or None if
+    the family is absent from the scrape entirely."""
+    vals = [v for n, _, v in samples if n == name and math.isfinite(v)]
+    return sum(vals) if vals else None
+
+
+def labeled_total(
+    samples: Iterable[Sample], name: str, **match: str
+) -> float | None:
+    vals = [
+        v
+        for n, labels, v in samples
+        if n == name
+        and math.isfinite(v)
+        and all(labels.get(k) == mv for k, mv in match.items())
+    ]
+    return sum(vals) if vals else None
+
+
+def bucket_totals(
+    samples: Iterable[Sample], name: str
+) -> dict[float, float] | None:
+    """A family's merged cumulative ``_bucket`` samples (all label
+    children folded — the fleet-wide distribution), or None when absent."""
+    buckets: dict[float, float] = {}
+    for n, labels, v in samples:
+        if n != name + "_bucket":
+            continue
+        le = labels.get("le", "")
+        bound = math.inf if le == "+Inf" else float(le)
+        buckets[bound] = buckets.get(bound, 0.0) + v
+    return buckets or None
+
+
+def quantile_from_buckets(
+    buckets: dict[float, float] | None, q: float
+) -> float | None:
+    """Linear-interpolated quantile from cumulative le->count buckets
+    (works identically on a between-rounds bucket DELTA — the windowed
+    tail the control loop acts on)."""
+    if not buckets:
+        return None
+    bounds = sorted(buckets)
+    count = buckets.get(math.inf, buckets[bounds[-1]])
+    if count <= 0:
+        return None
+    rank = q * count
+    prev_bound, prev_cum = 0.0, 0.0
+    for b in bounds:
+        cum = buckets[b]
+        if cum >= rank:
+            if not math.isfinite(b):
+                return prev_bound
+            width = cum - prev_cum
+            if width <= 0:
+                return b
+            frac = (rank - prev_cum) / width
+            return prev_bound + (b - prev_bound) * frac
+        prev_bound, prev_cum = (b if math.isfinite(b) else prev_bound), cum
+    return prev_bound
+
+
+def histogram_count(samples: Iterable[Sample], name: str) -> float | None:
+    return total(samples, name + "_count")
+
+
+class RateTracker:
+    """Between-rounds windowing for one source: counter rates and
+    histogram-bucket deltas. The first observation of a name yields None
+    (no interval yet); a counter/bucket that goes BACKWARD (source
+    restarted) re-primes instead of reporting a negative window. The
+    windowed view is what a control loop should act on — the RECENT tail
+    responds to load changes a lifetime distribution would average away."""
+
+    def __init__(self):
+        self._prev: dict[str, tuple[float, float]] = {}  # name -> (ts, total)
+        self._prev_buckets: dict[str, dict[float, float]] = {}
+
+    def rate(self, name: str, value: float | None, now: float) -> float | None:
+        if value is None:
+            self._prev.pop(name, None)
+            return None
+        prev = self._prev.get(name)
+        self._prev[name] = (now, value)
+        if prev is None:
+            return None
+        ts, tot = prev
+        dt = now - ts
+        if dt <= 0 or value < tot:
+            return None
+        return (value - tot) / dt
+
+    def window(
+        self, name: str, buckets: dict[float, float] | None
+    ) -> dict[float, float] | None:
+        """Per-bucket delta since this tracker last saw ``name``. None on
+        the first observation, an absent family, or a counter reset."""
+        if buckets is None:
+            self._prev_buckets.pop(name, None)
+            return None
+        prev = self._prev_buckets.get(name)
+        self._prev_buckets[name] = dict(buckets)
+        if prev is None:
+            return None
+        delta = {}
+        for bound, v in buckets.items():
+            d = v - prev.get(bound, 0.0)
+            if d < 0:
+                return None  # source restarted mid-window
+            delta[bound] = d
+        return delta
+
+
+# ---------------------------------------------------------------------------
+# assembly
+# ---------------------------------------------------------------------------
+
+
+def fleet_views(snapshots: dict) -> list[ReplicaView]:
+    """SnapshotPoller.live() -> the fleet controller's replica views."""
+    out = []
+    for addr, snap in snapshots.items():
+        out.append(
+            ReplicaView(
+                addr=addr,
+                draining=bool(snap.draining),
+                drain_terminal=bool(getattr(snap, "drain_terminal", False)),
+                load_fraction=float(snap.load_fraction()),
+                queue_depth=int(snap.queue_depth),
+                free_page_fraction=float(snap.free_page_fraction()),
+            )
+        )
+    return out
+
+
+def assemble(
+    samples: list[Sample],
+    rates: RateTracker,
+    snapshots: dict | None = None,
+    now: float | None = None,
+) -> Signals:
+    """One control round's Signals from a metrics fetch + poller views."""
+    now = now if now is not None else time.monotonic()
+    sig = Signals(now=now)
+    if not samples:
+        # a failed/empty scrape is a BLIND round, not a zero reading:
+        # feeding 0.0 into the counter trackers would reprime them at 0
+        # and make the next good scrape fabricate a rate spike (the
+        # whole counter total read as this-interval events). Every
+        # signal stays None -> controllers hold position.
+        if snapshots:
+            sig.replicas = fleet_views(snapshots)
+            live = [r for r in sig.replicas if not r.draining]
+            if live:
+                sig.mean_load_fraction = sum(
+                    r.load_fraction for r in live
+                ) / len(live)
+                sig.mean_queue_depth = sum(
+                    r.queue_depth for r in live
+                ) / len(live)
+        return sig
+    # trainer presence witness: the bubble gauge materializes at 0 on
+    # registration, so a step having completed is what makes it a SIGNAL
+    steps = histogram_count(samples, "areal_train_step_seconds")
+    if steps:
+        sig.bubble_fraction = total(samples, "areal_train_bubble_fraction")
+    # tails are WINDOWED between rounds (bucket deltas): the controller
+    # reacts to the recent distribution, and one process serving several
+    # bench arms can't leak arm 1's tail into arm 2's signals. An empty
+    # window (no new observations) reads as absent -> hold position.
+    span_w = rates.window(
+        "version_span", bucket_totals(samples, "areal_rollout_version_span")
+    )
+    if span_w and max(span_w.values()) > 0:  # +Inf delta = window count
+        sig.version_span_p99 = quantile_from_buckets(span_w, 0.99)
+    qw_w = rates.window(
+        "queue_wait",
+        bucket_totals(samples, "areal_request_queue_wait_seconds"),
+    )
+    if qw_w and max(qw_w.values()) > 0:
+        sig.queue_wait_p99_s = quantile_from_buckets(qw_w, 0.99)
+    # counters: absence genuinely means zero events so far (labeled
+    # families materialize children on first increment), so rates compute
+    # unconditionally — only the first round (no interval yet) is None
+    shed = total(samples, "areal_gateway_shed_total") or 0.0
+    rejected = total(samples, "areal_admission_rejected_total") or 0.0
+    sig.shed_rate_per_s = rates.rate("shed", shed + rejected, now)
+    ishred = (
+        labeled_total(
+            samples, "areal_gateway_shed_total", priority="interactive"
+        )
+        or 0.0
+    )
+    sig.interactive_shed_rate_per_s = rates.rate(
+        "interactive_shed", ishred, now
+    )
+    reaps = total(samples, "areal_request_deadline_exceeded_total") or 0.0
+    sig.reap_rate_per_s = rates.rate("reaps", reaps, now)
+    # hit rate over the window's prompt tokens (lifetime ratios are too
+    # sticky to steer on); a window with no admissions reads absent
+    hit_r = rates.rate(
+        "hit_tokens",
+        total(samples, "areal_prefix_cache_hit_tokens_total") or 0.0,
+        now,
+    )
+    pf_r = rates.rate(
+        "prefill_tokens",
+        total(samples, "areal_decode_prefill_tokens_total") or 0.0,
+        now,
+    )
+    if hit_r is not None and pf_r is not None and (hit_r + pf_r) > 0:
+        sig.prefix_hit_rate = hit_r / (hit_r + pf_r)
+    # headroom is DERIVED from the byte gauges, never read from the
+    # fraction gauge: a fleet-merged /metrics endpoint sums gauges per
+    # replica, and summed fractions are meaningless (4 replicas at 0.04
+    # headroom would read 0.16 — growth territory — while every one is
+    # under memory pressure). Summed BYTES stay meaningful: fleet in-use
+    # over fleet limit. A known limit is also the presence witness — the
+    # fraction gauge materializes at 0 on registration.
+    limit = labeled_total(samples, "areal_hbm_bytes", component="limit")
+    in_use = labeled_total(samples, "areal_hbm_bytes", component="in_use")
+    if limit and in_use is not None:
+        sig.hbm_headroom_fraction = max(0.0, 1.0 - in_use / limit)
+    if snapshots:
+        sig.replicas = fleet_views(snapshots)
+        live = [r for r in sig.replicas if not r.draining]
+        if live:
+            sig.mean_load_fraction = sum(
+                r.load_fraction for r in live
+            ) / len(live)
+            sig.mean_queue_depth = sum(r.queue_depth for r in live) / len(
+                live
+            )
+    return sig

@@ -782,15 +782,42 @@ class RemoteJaxEngine(InferenceEngine):
                             )
                         except ValueError:
                             retry_after = 1.0
-                        if self.config.routing_policy == "cache_aware":
-                            # backpressure is routing signal, not replica
-                            # death: demote this replica's score for a few
-                            # seconds so new placements drift elsewhere —
-                            # the circuit/failover machinery stays out of it
-                            self.router.note_backpressure(addr)
-                        last_exc = RuntimeError(
-                            f"admission rejected (429) by {addr}{path}"
-                        )
+                        try:
+                            body_429 = await r.json()
+                        except Exception:  # noqa: BLE001 — a bare 429 is
+                            # still backpressure; the body is a hint only
+                            body_429 = {}
+                        drained_over = False
+                        if body_429.get("reason") == "draining" and can_failover:
+                            # a DRAINING replica is leaving the fleet (ops
+                            # drain, autopilot scale-down, preemption) —
+                            # waiting out Retry-After for it to come back
+                            # is wrong; go to a sibling now. Parked work
+                            # resumes elsewhere with a re-prefill. The hop
+                            # still pays a short pace and rides the
+                            # backpressure budget below: a whole fleet
+                            # draining at once (preemption wave) must not
+                            # become a zero-sleep ping-pong request storm
+                            # against replicas trying to leave.
+                            alt = self.fleet.pick_failover(addr)
+                            if alt is not None and alt != addr:
+                                self._robust.failovers.inc()
+                                last_exc = RuntimeError(
+                                    f"replica {addr} draining"
+                                )
+                                addr = alt
+                                retry_after = min(retry_after, 0.05)
+                                drained_over = True
+                        if not drained_over:
+                            if self.config.routing_policy == "cache_aware":
+                                # backpressure is routing signal, not replica
+                                # death: demote this replica's score for a few
+                                # seconds so new placements drift elsewhere —
+                                # the circuit/failover machinery stays out of it
+                                self.router.note_backpressure(addr)
+                            last_exc = RuntimeError(
+                                f"admission rejected (429) by {addr}{path}"
+                            )
                         now = time.monotonic()
                         if bp_deadline is None:
                             bp_deadline = now + bp_budget

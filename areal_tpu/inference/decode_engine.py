@@ -309,8 +309,15 @@ class DecodeEngine:
         # preemption drain (docs/fault_tolerance.md): set = admission
         # closed, replica finishing-or-parking toward process exit
         self._draining = threading.Event()
+        self._drain_terminal = False  # True = drain of an exiting process
         self._drain_summary: dict | None = None
         self._obs_preempt = obs_catalog.preemption_metrics()
+        # goodput-autopilot setpoints applied to this replica via POST
+        # /autopilot/knobs (docs/autopilot.md): what /statusz reports back
+        # so the control plane can see its pushes took effect
+        self._autopilot_lock = threading.Lock()
+        self._autopilot_knobs: dict[str, float] = {}
+        self._autopilot_applied_at: float | None = None
 
     # -- lifecycle --------------------------------------------------------
     def initialize(self) -> None:
@@ -863,6 +870,60 @@ class DecodeEngine:
             return False, "page_headroom", snap
         return True, "", snap
 
+    def apply_autopilot_knobs(self, knobs: dict) -> dict:
+        """Apply control-plane setpoints (docs/autopilot.md): admission
+        gates (``max_queue_depth``, ``min_free_pages`` — plain int stores
+        the admission gate reads racily-but-atomically) and the radix
+        cache's ``radix_max_fraction`` (recomputed into a page cap; a live
+        decode loop evicts LRU leaves down to a shrunk cap between chunks,
+        a stopped engine converges inline). Unknown keys are ignored so an
+        older server survives a newer control plane. Returns the applied
+        status (same shape as the /statusz ``autopilot`` section)."""
+        applied: dict[str, float] = {}
+        lc = getattr(self.config, "lifecycle", None)
+        if lc is not None:
+            for k in ("max_queue_depth", "min_free_pages"):
+                if knobs.get(k) is not None:
+                    setattr(lc, k, max(0, int(knobs[k])))
+                    applied[k] = float(getattr(lc, k))
+        frac = knobs.get("radix_max_fraction")
+        if frac is not None and self._radix is not None and hasattr(self, "pool"):
+            frac = max(0.0, min(1.0, float(frac)))
+            self._radix.max_pages = max(
+                0, min(int((self.pool.n_pages - 1) * frac), self.pool.n_pages - 1)
+            )
+            applied["radix_max_fraction"] = frac
+            if self._thread is not None and self._thread.is_alive():
+                # the tree is decode-loop-private while the loop runs: it
+                # converges to the new cap between chunks
+                self._wakeup.set()
+            else:
+                self._service_radix_cap()
+        if applied:
+            with self._autopilot_lock:
+                self._autopilot_knobs.update(applied)
+                self._autopilot_applied_at = time.time()
+        return self.autopilot_status()
+
+    def autopilot_status(self) -> dict:
+        """The /statusz ``autopilot`` section: setpoints this replica is
+        actually running (empty until the control plane pushes one)."""
+        with self._autopilot_lock:
+            return {
+                "knobs": dict(self._autopilot_knobs),
+                "applied_at": self._autopilot_applied_at,
+            }
+
+    def _service_radix_cap(self) -> None:
+        """Converge the radix tree onto a shrunk autopilot cap — runs on
+        the decode loop (tree/pool owner) between chunks, or inline when
+        the loop is down."""
+        r = self._radix
+        if r is not None and r.pages_held > r.max_pages:
+            freed = r.evict(r.pages_held - r.max_pages)
+            if freed:
+                self._obs_pc.evicted_pages.inc(freed)
+
     def is_wedged(self) -> bool:
         """True when the decode loop has made no pass for
         ``lifecycle.engine_stall_escalate_s`` while work is pending — the
@@ -1083,19 +1144,36 @@ class DecodeEngine:
     def is_draining(self) -> bool:
         return self._draining.is_set()
 
-    def begin_drain(self) -> None:
+    def begin_drain(self, terminal: bool = False) -> None:
         """Close admission (check_admission rejects with reason
         "draining") while in-flight decodes keep running — the first half
-        of the finish-or-park drain. Idempotent."""
+        of the finish-or-park drain. ``terminal`` marks a drain whose
+        process is EXITING (SIGTERM preemption): it can never be
+        cancelled. Idempotent; terminal is sticky across overlapping
+        drains."""
+        if terminal:
+            self._drain_terminal = True
         if not self._draining.is_set():
             self._draining.set()
-            self.flight.record("drain_begin", severity="warn")
+            self.flight.record(
+                "drain_begin", severity="warn", terminal=bool(terminal)
+            )
         self._wakeup.set()
 
-    def end_drain(self) -> None:
-        """Re-open admission (ops escape hatch / tests; a preempted
-        process never calls this)."""
+    def end_drain(self) -> bool:
+        """Re-open admission (ops escape hatch / autopilot scale-up).
+        REFUSED for a terminal drain: the process is on its way out (the
+        platform will SIGKILL it) and re-opened admission would accept
+        requests that die responseless — the autoscaler must pick a
+        different replica. Returns True when admission re-opened."""
+        if getattr(self, "_drain_terminal", False):
+            logger.warning(
+                "end_drain refused: this drain is terminal (preemption "
+                "grace window) — the process is exiting"
+            )
+            return False
         self._draining.clear()
+        return True
 
     def _abort_queued(self) -> None:
         """Finish every queued/backlogged task with stop_reason=abort —
@@ -1111,14 +1189,16 @@ class DecodeEngine:
             task = self._backlog.popleft()
             self._finish(task, StopReason.ABORT.value)
 
-    def drain(self, budget_s: float = 10.0) -> dict:
+    def drain(self, budget_s: float = 10.0, terminal: bool = False) -> dict:
         """Graceful preemption drain: stop admission, let in-flight
         decodes finish inside ``budget_s``, then park (rid-affinity KV,
         partial tokens returned) or abort the survivors and the queue.
         Blocks until the engine is quiescent; returns (and stores for
-        /statusz) a summary incl. the leak audit. Any thread."""
+        /statusz) a summary incl. the leak audit. Any thread.
+        ``terminal=True`` (the SIGTERM preemption path) makes the drain
+        uncancellable — see :meth:`begin_drain`."""
         t0 = time.monotonic()
-        self.begin_drain()
+        self.begin_drain(terminal=terminal)
         aborted_before = self.stats["aborted"]
         deadline = t0 + max(0.0, budget_s)
         finished_in_budget = True
@@ -1195,6 +1275,9 @@ class DecodeEngine:
             dict(self._drain_summary) if self._drain_summary is not None else {}
         )
         out["draining"] = self._draining.is_set()
+        # the autoscaler (and ops) must distinguish a cancellable drain
+        # from a process that is exiting — only the former can undrain
+        out["terminal"] = bool(self._drain_terminal)
         return out
 
     def _wait_weight_update_applied(self) -> None:
@@ -2973,6 +3056,7 @@ class DecodeEngine:
             self._last_loop_ts = time.monotonic()
             self._apply_weight_update()
             self._service_radix_flush()
+            self._service_radix_cap()
             if self._paused.is_set():
                 self._drain(pending)
                 pending = None

@@ -125,6 +125,7 @@ class InferenceServer:
                 web.post("/abort_request", self.h_abort_request),
                 web.post("/drain", self.h_drain),
                 web.post("/undrain", self.h_undrain),
+                web.post("/autopilot/knobs", self.h_autopilot_knobs),
                 web.get("/debug/flight", self.h_debug_flight),
                 web.post("/debug/profile", self.h_debug_profile),
             ]
@@ -237,6 +238,12 @@ class InferenceServer:
             # plus the last drain's summary (finish-or-park outcome, leak
             # audit) — what an operator checks after a spot reclaim
             out["drain"] = ds()
+        ap = getattr(self.engine, "autopilot_status", None)
+        if ap is not None:
+            # control-plane view (docs/autopilot.md): the setpoints this
+            # replica is actually running, so the autopilot (and an
+            # operator postmortem) can confirm pushes took effect
+            out["autopilot"] = ap()
         tl = getattr(self.engine, "timeline", None)
         if tl is not None:
             # same key as /debug/flight's stats section — over THERE
@@ -474,15 +481,54 @@ class InferenceServer:
         return web.json_response({"status": "ok", **summary})
 
     async def h_undrain(self, request: web.Request) -> web.Response:
-        """Cancel an ops-initiated drain (a migration called off): re-open
-        admission and resume the decode loop. A SIGTERM-driven drain never
-        comes back this way — that process is exiting."""
+        """Cancel an ops/autopilot-initiated drain (a migration or
+        scale-down called off): re-open admission and resume the decode
+        loop. A SIGTERM-driven (terminal) drain is REFUSED with 409 —
+        that process is exiting, and re-opened admission would accept
+        requests that die responseless at the SIGKILL."""
         self._metrics.requests.labels(endpoint="undrain").inc()
         end = getattr(self.engine, "end_drain", None)
-        if end is not None:
-            end()
+        if end is not None and end() is False:
+            return web.json_response(
+                {"status": "error", "error": "drain is terminal"},
+                status=409,
+            )
         self.engine.continue_generation()
         return web.json_response({"status": "ok"})
+
+    async def h_autopilot_knobs(self, request: web.Request) -> web.Response:
+        """Goodput-autopilot actuation (docs/autopilot.md): apply
+        control-plane setpoints to this replica. Authenticated by config:
+        when ``ServerConfig.autopilot_token`` is set, the request must
+        carry it in ``x-areal-autopilot-token`` (403 otherwise); empty
+        token leaves the endpoint open like the other ops endpoints."""
+        self._metrics.requests.labels(endpoint="autopilot_knobs").inc()
+        token = getattr(self.config, "autopilot_token", "") or ""
+        if token and request.headers.get("x-areal-autopilot-token") != token:
+            return web.json_response(
+                {"status": "error", "error": "bad autopilot token"},
+                status=403,
+            )
+        apply = getattr(self.engine, "apply_autopilot_knobs", None)
+        if apply is None:
+            return web.json_response(
+                {"status": "error", "error": "engine has no autopilot knobs"},
+                status=501,
+            )
+        try:
+            knobs = await request.json()
+        except ValueError:
+            return web.json_response(
+                {"status": "error", "error": "unparsable JSON body"},
+                status=400,
+            )
+        if not isinstance(knobs, dict):
+            return web.json_response(
+                {"status": "error", "error": "body must be a knob object"},
+                status=400,
+            )
+        status = apply(knobs)
+        return web.json_response({"status": "ok", **status})
 
     async def h_pause(self, request: web.Request) -> web.Response:
         """Pause modes: default "abort" (legacy §3.4: in-flight requests
@@ -885,7 +931,9 @@ def main(argv=None) -> None:
 
         def drain_replica(h: PreemptionHandler) -> None:
             budget = min(pre_cfg.drain_budget_s, max(0.0, h.remaining() - 2.0))
-            server.engine.drain(budget)
+            # terminal: this process is exiting — /undrain (ops or the
+            # autopilot's scale-up) must not re-open admission on it
+            server.engine.drain(budget, terminal=True)
             if args.name:
                 try:
                     name_resolve.delete(args.name)

@@ -87,6 +87,17 @@ class StalenessManager:
         with self._lock:
             self.stat.accepted += n
 
+    def set_max_staleness(self, n: int) -> int:
+        """Goodput-autopilot hook (docs/autopilot.md): retune the
+        staleness bound live. Takes effect at the next ``get_capacity``
+        call — in-flight rollouts are never clawed back; a tightened
+        bound simply stops admitting until the accepted backlog drains
+        under the new formula. Clamped at >= 0; returns the applied
+        value."""
+        with self._lock:
+            self.max_staleness = max(0, int(n))
+            return self.max_staleness
+
     def observe_version_lag(self, lag: int) -> None:
         """Record an accepted trajectory's version lag (current policy
         version minus the oldest per-token version in the trajectory) —

@@ -653,6 +653,53 @@ def router_metrics(reg: Registry | None = None) -> SimpleNamespace:
     )
 
 
+def autopilot_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """Goodput autopilot (areal_tpu/autopilot/): the adaptive control
+    plane's decision audit. Every setpoint change also lands in the
+    flight ring as ``kind=autopilot_decision`` with the signal values
+    that drove it (docs/autopilot.md)."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        decisions=r.counter(
+            "areal_autopilot_decisions_total",
+            "Autopilot setpoint changes applied, by controller "
+            "(staleness | admission | cache | fleet) and reason "
+            "(trainer_starved | queue_wait_high | shed_under_capacity | "
+            "hbm_pressure | sustained_idle | sustained_backlog | ...).",
+            label_names=("controller", "reason"),
+        ),
+        setpoint=r.gauge(
+            "areal_autopilot_setpoint",
+            "Current autopilot-managed setpoint value, by knob "
+            "(max_staleness | max_queue_depth | min_free_pages | "
+            "gateway_interactive_headroom | radix_max_fraction | "
+            "target_replicas).",
+            label_names=("knob",),
+        ),
+        last_action_age=r.gauge(
+            "areal_autopilot_last_action_age_seconds",
+            "Seconds since each controller last changed a setpoint "
+            "(refreshed every control round; -1 until a controller has "
+            "acted).",
+            label_names=("controller",),
+        ),
+        signal_holds=r.counter(
+            "areal_autopilot_signal_hold_total",
+            "Control rounds a controller held position because a required "
+            "signal was absent or older than autopilot.signal_ttl_s (the "
+            "stale-signal degradation mirroring the router's round-robin "
+            "fallback).",
+            label_names=("controller",),
+        ),
+        apply_failures=r.counter(
+            "areal_autopilot_apply_failures_total",
+            "Actuations that failed to apply (replica knob POST errored, "
+            "drain/undrain failed); the controller's setpoint stands and "
+            "the next round re-applies.",
+        ),
+    )
+
+
 def aggregator_metrics(reg: Registry | None = None) -> SimpleNamespace:
     """Fleet aggregator: scrape health."""
     r = reg or get_registry()
@@ -687,6 +734,7 @@ ALL_FACTORIES = (
     robustness_metrics,
     preemption_metrics,
     router_metrics,
+    autopilot_metrics,
     aggregator_metrics,
 )
 

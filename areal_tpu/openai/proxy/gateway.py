@@ -91,6 +91,18 @@ class GatewayState:
         self._rr = 0
         self._router_obs = catalog.router_metrics()
 
+    def set_interactive_headroom(self, n: int) -> int:
+        """Goodput-autopilot hook (docs/autopilot.md): resize the slots
+        reserved for interactive traffic live. Clamped into
+        [0, max_inflight] with the ctor's rule; with shedding disabled
+        (max_inflight <= 0) the value pins to 0 — there is no cap to
+        carve headroom out of. Returns the applied value."""
+        n = max(0, int(n))
+        self.interactive_headroom = min(
+            n, self.max_inflight if self.max_inflight > 0 else 0
+        )
+        return self.interactive_headroom
+
     def classify(self, request: web.Request) -> str:
         p = request.headers.get("x-areal-priority", "interactive").lower()
         return p if p in PRIORITIES else "interactive"

@@ -40,6 +40,9 @@ class ReplicaSnapshot:
     fetched_at: float
     version: int = -1
     draining: bool = False
+    # terminal = the drain belongs to an exiting process (preemption):
+    # it can never be undrained — the autopilot's scale-up skips it
+    drain_terminal: bool = False
     paused: bool = False
     # lifecycle section (DecodeEngine.admission_snapshot)
     queue_depth: int = 0
@@ -54,6 +57,13 @@ class ReplicaSnapshot:
     flushes: int = 0
     page_size: int = 0
     hit_tokens: int = 0
+    # stats-section counters the goodput autopilot folds into fleet rates
+    # (docs/autopilot.md); cumulative per replica life
+    deadline_exceeded: int = 0
+    generated_tokens: int = 0
+    # autopilot section: the control-plane setpoints this replica is
+    # actually running (empty until one is pushed)
+    autopilot_knobs: dict = dataclasses.field(default_factory=dict)
 
     @classmethod
     def from_statusz(
@@ -90,6 +100,17 @@ class ReplicaSnapshot:
         dr = doc.get("drain")
         if isinstance(dr, dict):
             snap.draining = bool(dr.get("draining", False))
+            snap.drain_terminal = bool(dr.get("terminal", False))
+        st = doc.get("stats")
+        if isinstance(st, dict):
+            try:
+                snap.deadline_exceeded = int(st.get("deadline_exceeded", 0) or 0)
+                snap.generated_tokens = int(st.get("generated_tokens", 0) or 0)
+            except (TypeError, ValueError):
+                pass
+        ap = doc.get("autopilot")
+        if isinstance(ap, dict) and isinstance(ap.get("knobs"), dict):
+            snap.autopilot_knobs = dict(ap["knobs"])
         return snap
 
     def age(self, now: float | None = None) -> float:

@@ -49,6 +49,54 @@ logger = alog.getLogger("bench_gateway")
 
 PRIORITIES = ("interactive", "rollout")
 
+# time-varying open-loop arrival profiles: (fraction_of_duration,
+# relative_rate) segments. "step" doubles down mid-run, "diurnal" ramps
+# up and back (the traffic shape the fleet autoscaler tracks), "burst"
+# is a calm fleet hit by a 6x spike — the shape a static admission
+# config must lose on somewhere (shed the calm or drown in the spike).
+LOAD_PROFILES: dict[str, list[tuple[float, float]]] = {
+    "step": [(0.5, 1.0), (0.5, 3.0)],
+    # a real night: the trough runs at ~5% of the peak rate, so a
+    # load-following fleet has genuine idle capacity to return
+    "diurnal": [(0.3, 0.25), (0.25, 2.0), (0.25, 5.0), (0.2, 1.0)],
+    "burst": [(0.4, 1.0), (0.2, 6.0), (0.4, 1.0)],
+}
+
+
+def profile_arrivals(
+    n: int, duration_s: float, segments: list[tuple[float, float]]
+) -> list[float]:
+    """Client arrival offsets in [0, duration_s) following the piecewise-
+    constant relative rate (inverse CDF of the integrated rate, midpoint
+    rule — n clients land exactly where the profile says the traffic
+    is). A uniform profile reproduces the legacy even spread."""
+    total = sum(f * w for f, w in segments) or 1.0
+    out: list[float] = []
+    for i in range(n):
+        u = (i + 0.5) / max(1, n) * total
+        t, start, cum = 1.0, 0.0, 0.0
+        for f, w in segments:
+            seg = f * w
+            if seg > 0 and cum + seg >= u:
+                t = start + (u - cum) / w
+                break
+            start += f
+            cum += seg
+        out.append(min(duration_s, t * duration_s))
+    return out
+
+
+def resolve_load_profile(
+    profile: str | list | None,
+) -> list[tuple[float, float]] | None:
+    if profile is None:
+        return None
+    if isinstance(profile, str):
+        if profile in ("", "uniform"):
+            return None
+        return LOAD_PROFILES[profile]
+    return [(float(f), float(w)) for f, w in profile]
+
 
 def make_shared_prefix_prompts(
     n: int,
@@ -132,6 +180,7 @@ async def _one_client(
     prompt: str,
     stats: _ClassStats,
     turns: int = 1,
+    greedy: bool = False,
 ) -> None:
     """One open-loop client: session -> ``turns`` sequential prioritized
     chat completions -> end session, honoring 429 Retry-After inside the
@@ -175,6 +224,11 @@ async def _one_client(
                 "max_completion_tokens": max_completion_tokens,
                 "model": "bench",
             }
+            if greedy:
+                # deterministic decode lengths: an A/B comparing CONTROL
+                # policies must not let sampling-dependent EOS timing
+                # masquerade as a goodput difference between arms
+                body["temperature"] = 0
             comp = None
             while True:
                 async with http.post(
@@ -273,6 +327,8 @@ async def drive_gateway(
     rollout_prompts: list[str] | None = None,
     turns: int = 1,
     rounds: int = 1,
+    load_profile: str | list | None = None,
+    greedy: bool = False,
 ) -> dict[str, Any]:
     """Open-loop drive: each class's clients start on a fixed arrival
     schedule spread over ``duration_s``. ``*_prompts`` override the default
@@ -280,17 +336,26 @@ async def drive_gateway(
     shared-prefix router workload rides through here; ``turns`` makes each
     client a multi-turn episode. ``rounds`` repeats the whole schedule
     back-to-back into ONE aggregated report (the A/B uses it to average
-    out scheduling transients). Returns the report dict."""
+    out scheduling transients). ``load_profile`` (a LOAD_PROFILES name or
+    explicit (time_fraction, relative_rate) segments) makes the arrival
+    rate time-varying — the overload-study / autopilot-acceptance shape;
+    None keeps the legacy even spread. Returns the report dict."""
     import aiohttp
 
     stats = {p: _ClassStats() for p in PRIORITIES}
+    segments = resolve_load_profile(load_profile)
     t_start = time.monotonic()
 
     async def schedule(priority, n, deadline_s, max_tokens, prompts, t0, rnd):
+        offsets = (
+            profile_arrivals(n, duration_s, segments)
+            if segments is not None
+            else [i * duration_s / max(1, n) for i in range(n)]
+        )
         async with aiohttp.ClientSession() as http:
             tasks = []
             for i in range(n):
-                target = t0 + (i * duration_s / max(1, n))
+                target = t0 + offsets[i]
                 delay = max(0.0, target - time.monotonic())
                 if delay:
                     await asyncio.sleep(delay)
@@ -308,6 +373,7 @@ async def drive_gateway(
                             prompts[(rnd * n + i) % len(prompts)],
                             stats[priority],
                             turns=turns,
+                            greedy=greedy,
                         )
                     )
                 )
@@ -342,6 +408,13 @@ async def drive_gateway(
         "duration_s": round(wall, 3),
         "classes": {p: stats[p].report(wall) for p in PRIORITIES},
     }
+    if segments is not None:
+        # the piecewise schedule rides the artifact so a report is
+        # self-describing (which seconds were the spike)
+        report["load_profile"] = {
+            "name": load_profile if isinstance(load_profile, str) else "custom",
+            "segments": [[f, w] for f, w in segments],
+        }
     tot = _ClassStats()
     for s in stats.values():
         tot.sent += s.sent
@@ -375,6 +448,7 @@ class LocalFleet:
         chaos_stall_prob: float = 0.3,
         chaos_stall_s: float = 0.1,
         max_queue_depth: int = 32,
+        retry_after_s: float = 0.1,
         gateway_max_inflight: int = 0,
         gateway_interactive_headroom: int = 0,
         seed: int = 7,
@@ -382,12 +456,14 @@ class LocalFleet:
         max_seq_len: int = 512,
         routing_kw: dict | None = None,
         model: str = "tiny",
+        autopilot_cfg: Any = None,
     ):
         self.n_replicas = n_replicas
         self.max_batch_size = max_batch_size
         self.chaos_stall_prob = chaos_stall_prob
         self.chaos_stall_s = chaos_stall_s
         self.max_queue_depth = max_queue_depth
+        self.retry_after_s = retry_after_s
         self.gateway_max_inflight = gateway_max_inflight
         self.gateway_interactive_headroom = gateway_interactive_headroom
         self.seed = seed
@@ -395,6 +471,9 @@ class LocalFleet:
         self.max_seq_len = max_seq_len
         self.routing_kw = dict(routing_kw or {})
         self.model = model
+        self.autopilot_cfg = autopilot_cfg
+        self.autopilot = None
+        self.gw_state = None
         self.servers: list[Any] = []
         self.client = None
         self._proxy_runner = None
@@ -402,6 +481,8 @@ class LocalFleet:
         self.admin_key = "bench-admin"
         self.gateway_url = ""
         self.proxy_url = ""
+        self._act_stop: Any = None
+        self._act_samples: list[int] = []
 
     async def astart(self) -> tuple[str, str]:
         import jax
@@ -469,7 +550,7 @@ class LocalFleet:
                 mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
                 lifecycle=RequestLifecycleConfig(
                     max_queue_depth=self.max_queue_depth,
-                    retry_after_s=0.1,
+                    retry_after_s=self.retry_after_s,
                     watchdog_s=60.0,
                 ),
             )
@@ -529,11 +610,30 @@ class LocalFleet:
         gport = find_free_port()
         await web.TCPSite(self._gateway_runner, "127.0.0.1", gport).start()
         self.gateway_url = f"http://127.0.0.1:{gport}"
+        self.gw_state = gw_state
+        if self.autopilot_cfg is not None and self.autopilot_cfg.enabled:
+            # the goodput autopilot over this fleet: knob pushes over HTTP
+            # like production, the gateway headroom via the in-process
+            # hook (the gateway lives in the controller process there too)
+            from areal_tpu.autopilot import Autopilot
+
+            self.autopilot = Autopilot(
+                self.autopilot_cfg,
+                lambda: [s.address for s in self.servers],
+                gateway=gw_state,
+            )
+            self.autopilot.seed_setpoints(
+                max_queue_depth=self.max_queue_depth,
+                gateway_interactive_headroom=self.gateway_interactive_headroom,
+            )
+            self.autopilot.start()
         return self.gateway_url, self.admin_key
 
     async def astop(self) -> None:
         from areal_tpu.inference.client import close_loop_sessions
 
+        if self.autopilot is not None:
+            self.autopilot.stop()
         if self._gateway_runner is not None:
             await self._gateway_runner.cleanup()
         if self._proxy_runner is not None:
@@ -545,6 +645,34 @@ class LocalFleet:
         await close_loop_sessions()
         for st in self.servers:
             st.stop()
+
+    # -- fleet-activity accounting (the autoscaler scoreboard) -------------
+    def start_activity_sampler(self, period_s: float = 0.25) -> None:
+        """Sample the count of non-draining replicas on a wall clock so
+        the report can price goodput per replica-second — the number the
+        fleet controller must move (drained capacity is returned
+        capacity)."""
+        import threading
+
+        stop = threading.Event()
+        self._act_stop = stop
+        self._act_samples = []
+
+        def run():
+            while not stop.wait(period_s):
+                self._act_samples.append(
+                    sum(1 for st in self.servers if not st.engine.is_draining)
+                )
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def stop_activity_sampler(self) -> float | None:
+        if self._act_stop is not None:
+            self._act_stop.set()
+            self._act_stop = None
+        if not self._act_samples:
+            return None
+        return sum(self._act_samples) / len(self._act_samples)
 
     def mark_baseline(self) -> None:
         """Snapshot the cumulative engine counters so ``engine_stats``
@@ -704,10 +832,14 @@ async def run_local_bench(
     prompt_chars: int = 400,
     interactive_tokens: int = 16,
     rollout_tokens: int = 128,
+    interactive_deadline_s: float = 20.0,
+    rollout_deadline_s: float = 30.0,
     turns: int = 1,
     rounds: int = 1,
     probe_prompts: list[str] | None = None,
     warmup_s: float = 0.0,
+    load_profile: str | list | None = None,
+    greedy: bool = False,
     **fleet_kw: Any,
 ) -> dict[str, Any]:
     fleet = LocalFleet(n_replicas=n_replicas, **fleet_kw)
@@ -743,9 +875,12 @@ async def run_local_bench(
                 duration_s=warmup_s,
                 interactive_tokens=interactive_tokens,
                 rollout_tokens=rollout_tokens,
+                interactive_deadline_s=interactive_deadline_s,
+                rollout_deadline_s=rollout_deadline_s,
                 interactive_prompts=warm_ip,
                 rollout_prompts=warm_rp,
                 turns=turns,
+                greedy=greedy,
             )
         ip, rp = _workload_prompts(
             workload,
@@ -757,6 +892,7 @@ async def run_local_bench(
             generations=max(1, rounds),
         )
         fleet.mark_baseline()
+        fleet.start_activity_sampler()
         report = await drive_gateway(
             gateway_url,
             admin_key,
@@ -765,17 +901,32 @@ async def run_local_bench(
             duration_s=duration_s,
             interactive_tokens=interactive_tokens,
             rollout_tokens=rollout_tokens,
+            interactive_deadline_s=interactive_deadline_s,
+            rollout_deadline_s=rollout_deadline_s,
             interactive_prompts=ip,
             rollout_prompts=rp,
             turns=turns,
             rounds=rounds,
+            load_profile=load_profile,
+            greedy=greedy,
         )
+        active_mean = fleet.stop_activity_sampler()
         report["workload"] = workload
         report["turns"] = turns
         report["route_policy"] = fleet.route_policy
         report["fleet"] = fleet.engine_stats()
+        report["fleet"]["active_replicas_mean"] = active_mean
+        goodput = report["totals"]["goodput_tok_s"]
+        report["goodput_per_replica_tok_s"] = (
+            goodput / active_mean if active_mean else None
+        )
         report["router"] = fleet.client.router.stats()
         report["router_hit_rate"] = report["fleet"]["prefix_hit_rate"]
+        # the control plane's scoreboard entry: active setpoints + the
+        # decision ledger (bench.py folds this into detail.autopilot)
+        report["autopilot"] = (
+            fleet.autopilot.status() if fleet.autopilot is not None else None
+        )
         if probe_texts is not None:
             report["probe_texts"] = probe_texts
         return report
@@ -883,6 +1034,204 @@ async def run_ab(
     }
 
 
+def bench_autopilot_config(
+    interval_s: float = 1.0,
+    min_queue_depth: int = 2,
+    max_queue_depth: int = 128,
+    high_queue_wait_s: float = 2.0,
+    low_queue_wait_s: float = 0.8,
+    fleet: bool = False,
+    fleet_floor: int = 1,
+):
+    """A fast-cadence AutopilotConfig tuned for short CPU benches and
+    self-tests (sub-second control rounds, 1-2s cooldowns). Production
+    deployments should keep the config defaults — 5s rounds and 10-30s
+    cooldowns — and let hysteresis do its job over minutes, not seconds."""
+    from areal_tpu.api.config import (
+        AdmissionControllerConfig,
+        AutopilotConfig,
+        CacheControllerConfig,
+        FleetControllerConfig,
+        StalenessControllerConfig,
+    )
+
+    return AutopilotConfig(
+        enabled=True,
+        interval_s=interval_s,
+        signal_ttl_s=10.0,
+        staleness=StalenessControllerConfig(enabled=False),
+        cache=CacheControllerConfig(enabled=False),
+        admission=AdmissionControllerConfig(
+            enabled=not fleet,
+            cooldown_s=interval_s * 2,
+            min_queue_depth=min_queue_depth,
+            max_queue_depth=max_queue_depth,
+            queue_depth_step=8,
+            high_queue_wait_s=high_queue_wait_s,
+            low_queue_wait_s=low_queue_wait_s,
+            high_shed_rate_per_s=0.5,
+            # the page-headroom subcontroller is the self-test's subject
+            # (it needs a page-tight fleet to matter); on the short A/B it
+            # would only add decision churn
+            high_reap_rate_per_s=1e9,
+            headroom_step=2,
+            max_headroom=16,
+            narrow_after_quiet_rounds=8,
+        ),
+        fleet=FleetControllerConfig(
+            enabled=fleet,
+            min_replicas=fleet_floor,
+            drain_below_load=0.4,
+            undrain_above_queue=0.3,
+            sustain_rounds=3,
+            undrain_sustain_rounds=1,
+            cooldown_s=interval_s * 3,
+        ),
+    )
+
+
+async def run_autopilot_ab(
+    n_replicas: int = 1,
+    n_interactive: int = 10,
+    n_rollout: int = 80,
+    duration_s: float = 16.0,
+    load_profile: str = "burst",
+    static_queue_depths: tuple[int, ...] = (24, 96),
+    autopilot_start_depth: int = 24,
+    deadline_s: float = 3.0,
+    fleet_run: bool = False,
+    **fleet_kw: Any,
+) -> dict[str, Any]:
+    """The autopilot acceptance scoreboard (ROADMAP item 6): one fresh
+    fleet per arm, identical seeds/params/chaos schedule and the SAME
+    time-varying ``load_profile``, comparing a small static-config sweep
+    against autopilot-on.
+
+    The admission run (default): static ``max_queue_depth`` arms must
+    lose somewhere on a bursty profile — a small queue sheds the calm
+    phase, a big one converts the spike into deadline-missed tail latency
+    — while the autopilot's AIMD tracks the phase it is in. Scored on
+    within-deadline goodput. The greedy probes double as the byte-identity
+    evidence: the control plane moves ADMISSION, never sampling.
+
+    ``fleet_run=True`` instead scores the fleet controller on
+    goodput-per-replica-second over a diurnal profile: draining idle
+    replicas during the trough returns capacity (the denominator) that a
+    static fleet keeps burning.
+
+    Every autopilot arm also reports its decision ledger, and the driver
+    can join each setpoint change against the flight ring
+    (``kind=autopilot_decision``) for the audit trail."""
+    from areal_tpu.observability import timeline as tl_mod
+
+    if fleet_run:
+        n_replicas = max(3, n_replicas)
+        load_profile = "diurnal"
+        # mean demand ~60% of fleet capacity: the autoscaler's win is the
+        # trough's returned replica-seconds, not overload admission
+        n_rollout = min(n_rollout, 50)
+        # bounded per-replica queues in BOTH arms: after a scale-down, a
+        # rising wave must spill to siblings (429 -> failover) instead of
+        # piling deadline-doomed work onto the survivor
+        fleet_kw.setdefault("max_queue_depth", 8)
+    probe_prompts = make_shared_prefix_prompts(
+        2, shared_frac=0.5, total_chars=120, seed=31
+    ) * 2
+    common = dict(
+        n_replicas=n_replicas,
+        n_interactive=n_interactive,
+        n_rollout=n_rollout,
+        duration_s=duration_s,
+        interactive_tokens=8,
+        # rollout decodes are the capacity sink: on the decode-costly
+        # "small" bench model, 256-token greedy decodes make per-request
+        # service time a real fraction of the deadline, so the burst
+        # overcommits the engine ~3x while the calm phases stay under
+        # capacity — the regime where a static queue depth must pick its
+        # poison: a deep queue decodes doomed work past its deadline
+        # (measured: depth 96 loses ~10% goodput here), a shallow one
+        # idles the engine between Retry-After waves
+        rollout_tokens=256,
+        interactive_deadline_s=deadline_s,
+        rollout_deadline_s=deadline_s,
+        load_profile=load_profile,
+        probe_prompts=probe_prompts,
+        warmup_s=3.0,
+        model="small",
+        max_batch_size=2,
+        retry_after_s=0.4,
+        greedy=True,
+        **fleet_kw,
+    )
+    arms: dict[str, dict[str, Any]] = {}
+    if fleet_run:
+        # the static fleet-size sweep: the full fleet, always on
+        static_arms = {f"static_{n_replicas}_replicas": dict(common)}
+    else:
+        static_arms = {
+            f"static_depth_{d}": dict(common, max_queue_depth=d)
+            for d in static_queue_depths
+        }
+    for name, kw in static_arms.items():
+        arms[name] = await run_local_bench(**kw)
+    # autopilot arm: count only ITS decisions (the ring is process-global)
+    ring_seq0 = max(
+        (e.get("seq", 0) for e in tl_mod.get_flight_recorder().snapshot()["events"]),
+        default=0,
+    )
+    # floor 2 of 3: the trough returns one replica's worth of capacity
+    # while two survivors keep every deadline coverable (a floor of 1
+    # measured ~20% deadline reaps when the rising wave lands before the
+    # undrain — scale-down depth is a safety knob, not a free lunch)
+    ap_cfg = bench_autopilot_config(fleet=fleet_run, fleet_floor=2)
+    auto_kw = dict(common, autopilot_cfg=ap_cfg)
+    if not fleet_run:
+        auto_kw["max_queue_depth"] = autopilot_start_depth
+    arms["autopilot"] = await run_local_bench(**auto_kw)
+    decisions = [
+        e
+        for e in tl_mod.get_flight_recorder().snapshot()["events"]
+        if e.get("kind") == "autopilot_decision" and e.get("seq", 0) > ring_seq0
+    ]
+    metric = "goodput_per_replica_tok_s" if fleet_run else None
+
+    def score(arm: dict[str, Any]) -> float:
+        if metric:
+            return float(arm.get(metric) or 0.0)
+        return float(arm["totals"]["goodput_tok_s"])
+
+    static_scores = {n: score(arms[n]) for n in static_arms}
+    auto_score = score(arms["autopilot"])
+    probe_sets = {n: arms[n].get("probe_texts") for n in arms}
+    comparison = {
+        "metric": metric or "goodput_tok_s",
+        "load_profile": load_profile,
+        "static": static_scores,
+        "autopilot": auto_score,
+        "autopilot_wins": bool(
+            static_scores and auto_score > max(static_scores.values())
+        ),
+        "autopilot_decisions": len(decisions),
+        "decisions_audited": all(
+            (e.get("data") or {}).get("reason")
+            and (e.get("data") or {}).get("knob")
+            for e in decisions
+        )
+        and len(decisions) > 0,
+        # placement/admission only, never output: greedy probes must be
+        # byte-identical across every arm
+        "greedy_identical": len({tuple(v or ()) for v in probe_sets.values()})
+        == 1,
+    }
+    return {
+        "bench": "gateway_autopilot_ab",
+        "fleet_run": fleet_run,
+        "arms": arms,
+        "decisions": [e.get("data") for e in decisions[-32:]],
+        "comparison": comparison,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--gateway", default="", help="existing gateway base url")
@@ -937,6 +1286,28 @@ def main(argv=None) -> int:
         "one comparison report (goodput, suffix-prefill tokens, greedy "
         "byte-identity)",
     )
+    p.add_argument(
+        "--load-profile",
+        choices=("uniform", *sorted(LOAD_PROFILES)),
+        default="uniform",
+        help="time-varying open-loop arrival-rate profile (piecewise "
+        "schedule recorded in the JSON artifact); uniform keeps the "
+        "legacy even spread",
+    )
+    p.add_argument(
+        "--autopilot-ab",
+        action="store_true",
+        help="autopilot acceptance A/B: a static max_queue_depth sweep vs "
+        "autopilot-on under the chosen --load-profile (default: burst), "
+        "scored on within-deadline goodput with the decision audit "
+        "attached",
+    )
+    p.add_argument(
+        "--fleet-run",
+        action="store_true",
+        help="with --autopilot-ab: score the FLEET controller instead "
+        "(3 replicas, diurnal profile, goodput per replica-second)",
+    )
     p.add_argument("-o", "--output", default="", help="JSON report path")
     args = p.parse_args(argv)
     # mode-dependent defaults: the A/B needs a saturated shared-prefix
@@ -956,7 +1327,20 @@ def main(argv=None) -> int:
     if args.shared_frac is None:
         args.shared_frac = 0.1 if args.ab else 0.8
 
-    if args.ab:
+    if args.autopilot_ab:
+        report = asyncio.run(
+            run_autopilot_ab(
+                load_profile=(
+                    "burst"
+                    if args.load_profile == "uniform" and not args.fleet_run
+                    else args.load_profile
+                ),
+                fleet_run=args.fleet_run,
+                chaos_stall_prob=args.stall_prob,
+                chaos_stall_s=args.stall_s,
+            )
+        )
+    elif args.ab:
         kw = {}
         if args.prompt_chars is not None:
             kw["prompt_chars"] = args.prompt_chars
@@ -987,6 +1371,7 @@ def main(argv=None) -> int:
                 shared_frac=args.shared_frac,
                 prompt_chars=args.prompt_chars or 400,
                 turns=args.turns,
+                load_profile=args.load_profile,
                 chaos_stall_prob=args.stall_prob,
                 chaos_stall_s=args.stall_s,
                 gateway_max_inflight=args.max_inflight,
@@ -1002,6 +1387,7 @@ def main(argv=None) -> int:
                 n_interactive=args.interactive,
                 n_rollout=args.rollout,
                 duration_s=args.duration,
+                load_profile=args.load_profile,
             )
         )
     text = json.dumps(report, indent=1)
@@ -1012,7 +1398,14 @@ def main(argv=None) -> int:
         atomic_io.atomic_write_text(args.output, text)
         print(f"wrote {args.output}")
     # non-null scoreboard or the run proved nothing
-    if args.ab:
+    if args.autopilot_ab:
+        cmp_ = report["comparison"]
+        ok = (
+            cmp_["autopilot_wins"]
+            and cmp_["decisions_audited"]
+            and cmp_["greedy_identical"]
+        )
+    elif args.ab:
         cmp_ = report["comparison"]
         ok = (
             cmp_["greedy_identical"]

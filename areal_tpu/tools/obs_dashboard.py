@@ -144,6 +144,22 @@ _TRAIN_PHASES = (
 )
 
 
+def _labeled_values(
+    snap: FleetSnapshot, name: str, label: str
+) -> dict[str, list[float]]:
+    """{label value: [per-target raw values]} for one labeled family —
+    the un-summed view for gauges whose fleet semantics are not additive
+    (autopilot setpoints, last-action ages)."""
+    out: dict[str, list[float]] = {}
+    for t in snap.targets:
+        if not t.up:
+            continue
+        for n, labels, v in t.samples:
+            if n == name:
+                out.setdefault(dict(labels).get(label, "?"), []).append(v)
+    return out
+
+
 def _fmt(v: float | None) -> str:
     if v is None:
         return "-"
@@ -208,6 +224,36 @@ def render_frame(
                 f"{'router hit pred/actual':<24} "
                 f"{_fmt(pred or 0):>6} / {_fmt(act or 0)}"
             )
+    # goodput autopilot (docs/autopilot.md): current setpoints, decision
+    # totals by reason, and each controller's last-action age — the
+    # at-a-glance answer to "what is the control plane doing right now".
+    # Setpoints and ages are per-control-plane FACTS, not additive: they
+    # bypass the fleet merge-sum (two scrapes of one plane must not
+    # double a setpoint; a mixed acted/never fleet must not average the
+    # -1 sentinel into a bogus age).
+    ap_decisions = _merged_value(snap, "areal_autopilot_decisions_total")
+    if ap_decisions is not None:
+        lines.append("-" * 64)
+        lines.append(f"{'autopilot decisions':<24} {_fmt(ap_decisions):>12}")
+        reasons: dict[str, float] = {}
+        for (n, labels), v in snap.merged.items():
+            if n == "areal_autopilot_decisions_total":
+                key = dict(labels).get("reason", "?")
+                reasons[key] = reasons.get(key, 0.0) + v
+        for reason, v in sorted(reasons.items(), key=lambda kv: -kv[1])[:4]:
+            lines.append(f"{'  ' + reason:<24} {_fmt(v):>12}")
+        for knob, vs in sorted(
+            _labeled_values(snap, "areal_autopilot_setpoint", "knob").items()
+        ):
+            lines.append(f"{'  set ' + knob:<28} {_fmt(max(vs)):>8}")
+        for ctrl, vs in sorted(
+            _labeled_values(
+                snap, "areal_autopilot_last_action_age_seconds", "controller"
+            ).items()
+        ):
+            nonneg = [v for v in vs if v >= 0]
+            age = f"{min(nonneg):.0f}s ago" if nonneg else "never"
+            lines.append(f"{'  ' + ctrl + ' acted':<24} {age:>12}")
     # overload view (docs/request_lifecycle.md): everything turned away with
     # a 429 — gateway load shedding + engine admission rejections — as a
     # fleet total, and as a rate once two frames exist
@@ -403,6 +449,17 @@ areal_router_actual_hit_total 5
 # HELP areal_admission_rejected_total Requests rejected at engine admission.
 # TYPE areal_admission_rejected_total counter
 areal_admission_rejected_total{reason="queue_depth"} 4
+# HELP areal_autopilot_decisions_total Autopilot setpoint changes applied.
+# TYPE areal_autopilot_decisions_total counter
+areal_autopilot_decisions_total{controller="admission",reason="queue_wait_high"} 3
+areal_autopilot_decisions_total{controller="fleet",reason="sustained_idle"} 1
+# HELP areal_autopilot_setpoint Current autopilot-managed setpoint by knob.
+# TYPE areal_autopilot_setpoint gauge
+areal_autopilot_setpoint{knob="max_queue_depth"} 16
+# HELP areal_autopilot_last_action_age_seconds Seconds since each controller acted.
+# TYPE areal_autopilot_last_action_age_seconds gauge
+areal_autopilot_last_action_age_seconds{controller="admission"} 12
+areal_autopilot_last_action_age_seconds{controller="cache"} -1
 # HELP areal_weight_update_pause_seconds Availability gap per update.
 # TYPE areal_weight_update_pause_seconds histogram
 areal_weight_update_pause_seconds_bucket{le="1"} 2
@@ -596,6 +653,32 @@ def self_test() -> int:
                 _shed_total(snap) == 20,
                 "shed total: gateway (5+1) + admission (4) per target "
                 "should merge to 20",
+            ),
+            (
+                "autopilot decisions" in frame
+                and _merged_value(snap, "areal_autopilot_decisions_total")
+                == 8,
+                "autopilot decisions should sum controller/reason children "
+                "across targets (2x(3+1))",
+            ),
+            (
+                "queue_wait_high" in frame,
+                "frame missing autopilot decision-reason rows",
+            ),
+            (
+                "set max_queue_depth" in frame and "16" in frame,
+                "frame missing autopilot setpoint row (a per-plane fact: "
+                "16, never the 32 a fleet merge-sum would claim)",
+            ),
+            (
+                "admission acted" in frame and "12s ago" in frame,
+                "frame missing per-controller last-action age row (12s "
+                "per target must stay 12s, not merge-sum to 24)",
+            ),
+            (
+                "cache acted" in frame and "never" in frame,
+                "a controller that never acted must read 'never', not a "
+                "negative age",
             ),
             (
                 "shed/rejected (429)" in frame and "20" in frame,

@@ -252,6 +252,48 @@ class PPOTrainer:
             else 0
         )
 
+        # goodput autopilot (areal_tpu/autopilot/, docs/autopilot.md):
+        # trainer-side placement — the staleness controller actuates the
+        # in-process StalenessManager directly while the replica knobs
+        # ride POST /autopilot/knobs. Off by default; the static config
+        # then behaves exactly as before.
+        self.autopilot = None
+        ap_cfg = getattr(config.rollout, "autopilot", None)
+        if ap_cfg is not None and ap_cfg.enabled:
+            from areal_tpu.autopilot import autopilot_from_config
+
+            self.autopilot = autopilot_from_config(
+                ap_cfg,
+                lambda: list(getattr(self.rollout, "addresses", []) or []),
+                staleness_manager=getattr(
+                    getattr(self.rollout, "executor", None), "staleness", None
+                ),
+            )
+            if self.autopilot is not None:
+                self.autopilot.seed_setpoints(
+                    max_queue_depth=config.server.lifecycle.max_queue_depth,
+                    min_free_pages=config.server.lifecycle.min_free_pages,
+                    radix_max_fraction=config.server.prefix_cache.max_fraction,
+                )
+                self.autopilot.start()
+                logger.info(
+                    "goodput autopilot started: "
+                    f"{[c.name for c in self.autopilot.controllers]} "
+                    f"(signals: {ap_cfg.metrics_addr or 'local registry'})"
+                )
+                if not ap_cfg.metrics_addr:
+                    # the trainer registry carries bubble/span but NOT the
+                    # remote fleet's serving tails — without metrics_addr
+                    # the admission/cache controllers hold on absent
+                    # signals (areal_autopilot_signal_hold_total counts it)
+                    logger.warning(
+                        "autopilot.metrics_addr is unset: serving-side "
+                        "signals (queue-wait, shed, prefix-hit, HBM) are "
+                        "only visible for in-process fleets — point it at "
+                        "the controller telemetry /metrics for a remote "
+                        "fleet (docs/autopilot.md)"
+                    )
+
         # preemption tolerance (robustness/preemption.py): the SIGTERM
         # handler only sets an event; the step loop polls it at phase
         # boundaries and the executor's blocking waits abort on it
@@ -574,6 +616,8 @@ class PPOTrainer:
             logger.exception("async checkpoint write failed during close")
         if self.journal is not None:
             self.journal.close()
+        if self.autopilot is not None:
+            self.autopilot.stop()
         if self.preemption is not None:
             self.preemption.uninstall()
         self.stats_logger.close()

@@ -875,7 +875,10 @@ def phase_gateway():
     decode tok/s already covers that."""
     import asyncio
 
-    from areal_tpu.tools.bench_gateway import run_local_bench
+    from areal_tpu.tools.bench_gateway import (
+        bench_autopilot_config,
+        run_local_bench,
+    )
 
     n_int, n_roll, duration = 12, 12, 12.0
     if os.environ.get("BENCH_SMOKE"):
@@ -888,6 +891,22 @@ def phase_gateway():
             duration_s=duration,
             chaos_stall_prob=0.2,
             chaos_stall_s=0.05,
+            # the goodput autopilot rides the standing scoreboard
+            # (admission controller, production-ish 1s cadence): its
+            # active setpoints + decision count land in detail.autopilot
+            # so control-plane behavior is auditable round over round.
+            # Thresholds sit WIDE of this phase's healthy operating point
+            # (20-30s deadlines, sub-second steady-state waits) so a
+            # normal round records ~0 decisions — first-compile queue
+            # waits must not read as overload and move the standing
+            # number; the A/B (--autopilot-ab) is where the controller
+            # is driven hard
+            autopilot_cfg=bench_autopilot_config(
+                interval_s=1.0,
+                min_queue_depth=8,
+                high_queue_wait_s=8.0,
+                low_queue_wait_s=1.0,
+            ),
             # the routing brain is live in the standing scoreboard: the
             # cache-aware policy over an 80%-shared-prefix MULTI-TURN
             # workload (turns>1 is what makes the hit rate
@@ -919,6 +938,7 @@ def phase_gateway():
             "errors": c["errors"],
         }
     hit_rate = report.get("router_hit_rate")
+    ap = report.get("autopilot")
     _emit_phase(
         {
             "phase": "gateway",
@@ -927,6 +947,17 @@ def phase_gateway():
             "route_policy": report.get("route_policy"),
             "router_hit_rate": (
                 round(hit_rate, 4) if hit_rate is not None else None
+            ),
+            # control-plane scoreboard next to the routing one: active
+            # setpoints + decision count (docs/autopilot.md)
+            "autopilot": (
+                {
+                    "setpoints": ap.get("setpoints"),
+                    "decisions": ap.get("decisions"),
+                    "decisions_by_reason": ap.get("decisions_by_reason"),
+                }
+                if ap is not None
+                else None
             ),
             "classes": classes,
         }
@@ -1069,6 +1100,8 @@ def main():
     n_chips = 1
     gen_chips = train_chips = 1
 
+    deadlined: dict[str, bool] = {}
+
     def resolve(name: str, payload) -> dict | None:
         """Live payload if the phase succeeded, else the last persisted
         on-chip measurement (marked in sources), else None. The returned
@@ -1081,6 +1114,16 @@ def main():
             return payload
         if payload is not None:
             errors[name] = payload["error"]
+            err = str(payload["error"])
+            # match ONLY the two real deadline-kill shapes (parent
+            # SIGKILL / in-child alarm): the no-BENCH_PHASE-line default
+            # also mentions its deadline value, but a crash 2s in is a
+            # real failure, not "could not measure on this host"
+            if "killed at deadline" in err or "in-child deadline" in err:
+                # "phase deadlined on THIS host" is a fact about the host,
+                # not a zero measurement — stamped into detail so the
+                # r03-r05 failure mode can never read as a regression
+                deadlined[name] = True
         cached = _load_cached_phase(name)
         if cached is not None:
             sources[name] = f"cached@{cached.get('measured_at')}"
@@ -1192,6 +1235,9 @@ def main():
                 "goodput_tok_s": gw.get("goodput_tok_s"),
                 "route_policy": gw.get("route_policy"),
                 "router_hit_rate": gw.get("router_hit_rate"),
+                # the control plane's setpoints + decision count (cached
+                # pre-autopilot payloads fold None, never a missing key)
+                "autopilot": gw.get("autopilot"),
                 "classes": gw.get("classes"),
             }
     except Exception as e:  # noqa: BLE001 — the JSON line must still print
@@ -1215,6 +1261,22 @@ def main():
     }
     if gen_chips != train_chips:
         detail["phase_chips"] = {"decode": gen_chips, "train": train_chips}
+    # a phase that deadline-killed on this host with no cached fallback is
+    # stamped {"deadlined": true} instead of a silent null/zero — the
+    # scoreboard distinguishes "could not measure here" from "measured 0"
+    for phase, key in (
+        ("decode", "decode"),
+        ("longctx", "longctx"),
+        ("train", "train"),
+        ("async_sync", "async_vs_sync"),
+        ("gateway", "gateway"),
+    ):
+        if (
+            deadlined.get(phase)
+            and phase not in sources  # a cached fallback still counts
+            and detail.get(key) is None
+        ):
+            detail[key] = {"deadlined": True}
     if sources:
         detail["sources"] = sources
     if errors:

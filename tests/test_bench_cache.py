@@ -301,6 +301,132 @@ def test_cached_train_payload_still_yields_train_detail(cache_dir, monkeypatch, 
     assert tr["mfu"] is None and tr["bubble_fraction"] is None
 
 
+def test_deadlined_phase_stamps_detail_flag(cache_dir, monkeypatch, capsys):
+    """A phase killed at its deadline on THIS host with no cached fallback
+    must fold as {"deadlined": true} — never a silent null/zero the
+    scoreboard could mistake for a regression (the r03-r05 failure mode)."""
+
+    def fake_spawn(name, deadline=None):
+        if name == "probe":
+            return {"phase": "probe", "platform": "tpu", "n_devices": 1}
+        if name == "decode":
+            return {"phase": name, "error": "phase killed at deadline 330s"}
+        if name == "gateway":
+            return {
+                "phase": name,
+                "error": "in-child deadline (parent kills at 90s)",
+            }
+        if name == "train":
+            # a crash that emitted no BENCH_PHASE line: the default error
+            # string mentions its deadline VALUE but the phase was not
+            # deadline-killed — it must fold as a real failure
+            return {"phase": name, "error": "no BENCH_PHASE line (deadline 240s)"}
+        return {"phase": name, "error": "some other failure"}
+
+    monkeypatch.setattr(bench, "_spawn_phase", fake_spawn)
+    bench.main()
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    # both deadline shapes (parent SIGKILL + in-child alarm) stamp the flag
+    assert out["detail"]["decode"] == {"deadlined": True}
+    assert out["detail"]["gateway"] == {"deadlined": True}
+    # a crash (no BENCH_PHASE line) and a plain failure stay null + error —
+    # never mislabeled as the benign could-not-measure case
+    assert out["detail"]["train"] is None
+    assert out["detail"]["longctx"] is None
+    assert "train" in out["detail"]["errors"]
+
+
+def test_deadlined_phase_with_cache_folds_cached_payload(
+    cache_dir, monkeypatch, capsys
+):
+    """A deadline kill with a persisted measurement serves the CACHED
+    number (sources marked cached@) — the deadlined stamp is only for
+    phases with no data at all."""
+    _seed(cache_dir, "decode", {"phase": "decode", "tok_s": 6696.5})
+
+    def fake_spawn(name, deadline=None):
+        if name == "probe":
+            return {"phase": "probe", "platform": "tpu", "n_devices": 1}
+        return {"phase": name, "error": "phase killed at deadline"}
+
+    monkeypatch.setattr(bench, "_spawn_phase", fake_spawn)
+    bench.main()
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    assert out["detail"]["gen_tok_s"] == 6696.5
+    assert "decode" not in out["detail"]  # cached data, no deadlined stamp
+    assert out["detail"]["sources"]["decode"].startswith("cached@")
+    # train deadlined with no cache: stamped
+    assert out["detail"]["train"] == {"deadlined": True}
+
+
+def test_gateway_phase_folds_autopilot_scoreboard(cache_dir, monkeypatch, capsys):
+    """detail.gateway carries the control plane's scoreboard (active
+    setpoints + decision count) next to route_policy."""
+
+    def fake_spawn(name, deadline=None):
+        if name == "probe":
+            return {"phase": "probe", "platform": "tpu", "n_devices": 1}
+        if name == "gateway":
+            return {
+                "phase": "gateway",
+                "goodput_tok_s": 200.0,
+                "route_policy": "cache_aware",
+                "router_hit_rate": 0.5,
+                "autopilot": {
+                    "setpoints": {"max_queue_depth": 16.0},
+                    "decisions": 3,
+                    "decisions_by_reason": {"queue_wait_high": 3},
+                },
+                "classes": {},
+            }
+        return {"phase": name, "error": "skipped"}
+
+    monkeypatch.setattr(bench, "_spawn_phase", fake_spawn)
+    bench.main()
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    ap = out["detail"]["gateway"]["autopilot"]
+    assert ap["setpoints"]["max_queue_depth"] == 16.0
+    assert ap["decisions"] == 3
+    assert ap["decisions_by_reason"] == {"queue_wait_high": 3}
+
+
+def test_cached_pre_autopilot_gateway_payload_folds_none(
+    cache_dir, monkeypatch, capsys
+):
+    """A gateway payload measured before the autopilot landed has no
+    autopilot field — it folds as None, the scoreboard never nulls out."""
+
+    def fake_spawn(name, deadline=None):
+        if name == "probe":
+            return {"phase": "probe", "platform": "tpu", "n_devices": 1}
+        if name == "gateway":
+            return {
+                "phase": "gateway",
+                "goodput_tok_s": 99.0,
+                "classes": {"interactive": {}, "rollout": {}},
+            }
+        return {"phase": name, "error": "skipped"}
+
+    monkeypatch.setattr(bench, "_spawn_phase", fake_spawn)
+    bench.main()
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    gw = out["detail"]["gateway"]
+    assert gw["goodput_tok_s"] == 99.0
+    assert gw["autopilot"] is None
+
+
 def test_main_prefers_live_over_cache(cache_dir, monkeypatch, capsys):
     _seed(cache_dir, "decode", {"phase": "decode", "tok_s": 1.0})
 
