@@ -451,6 +451,24 @@ class StalenessControllerConfig:
     # "wide" (the off-policyness the bound permits is actually being used)
     wide_span_p99: float = 1.0
     cooldown_s: float = 30.0
+    # -- learning-health guard (docs/autopilot.md "Learning-health
+    # guard"): before GROWING the bound, consult the learning-health
+    # observatory's high-lag bucket ("4+"; docs/observability.md). If the
+    # tokens that bucket trains on have stopped contributing gradient —
+    # windowed clip fraction at/above guard_high_lag_clip_fraction, or
+    # windowed behave |KL| at/above guard_high_lag_kl — the raise is
+    # VETOED (audited as kind=autopilot_guard_veto): more staleness would
+    # buy dead weight, not throughput. Absent signal = no veto (the PR 13
+    # stale-signal -> hold convention applies to the PRIMARY bubble
+    # signal; the guard only ever blocks, never causes, an action), so a
+    # serving-only deployment with no trainer metrics behaves exactly as
+    # before. The guard only consults buckets carrying at least
+    # guard_min_token_share of the window's tokens — a near-empty bucket
+    # is noise, not evidence.
+    learning_guard: bool = True
+    guard_high_lag_kl: float = 0.5
+    guard_high_lag_clip_fraction: float = 0.9
+    guard_min_token_share: float = 0.01
 
 
 @dataclass

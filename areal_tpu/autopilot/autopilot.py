@@ -275,6 +275,24 @@ class Autopilot:
             actions = ctrl.decide(sig)
             if ctrl.last_hold is not None:
                 self._obs.signal_holds.labels(controller=ctrl.name).inc()
+            veto = getattr(ctrl, "last_veto", None)
+            if veto is not None:
+                # a learning-health guard blocked an otherwise-due action:
+                # audited like a decision, so the postmortem reads WHY the
+                # bound stopped climbing while the bubble stayed high
+                reason, value = veto
+                self._obs.guard_vetoes.labels(controller=ctrl.name).inc()
+                self._flight.record(
+                    "autopilot_guard_veto",
+                    controller=ctrl.name,
+                    reason=reason,
+                    signal_value=round(float(value), 4),
+                    high_lag_token_share=(
+                        None
+                        if sig.high_lag_token_share is None
+                        else round(sig.high_lag_token_share, 4)
+                    ),
+                )
             for action in actions:
                 if self._apply(action, sig):
                     applied.append(action)
@@ -411,6 +429,10 @@ class Autopilot:
         for k in (
             "bubble_fraction",
             "version_span_p99",
+            "high_lag_token_share",
+            "high_lag_clip_fraction",
+            "high_lag_cap_fraction",
+            "high_lag_behave_kl",
             "queue_wait_p99_s",
             "shed_rate_per_s",
             "interactive_shed_rate_per_s",

@@ -69,19 +69,62 @@ class StalenessController(_Base):
     Grow when the trainer starves (bubble high: more in-flight staleness
     would keep it fed); shrink when the bubble is gone AND accepted
     trajectories still span many versions (the permitted off-policyness
-    buys nothing — tighten it and decoupled PPO corrects less)."""
+    buys nothing — tighten it and decoupled PPO corrects less).
+
+    The optional **learning-health guard** (cfg.learning_guard) closes the
+    loop the throughput signals cannot see: growing the bound is only
+    useful if high-lag tokens still contribute gradient. When the
+    learning-health observatory's high-lag bucket shows its tokens
+    clipped dead weight (windowed clip fraction high) or far off-policy
+    (windowed behave |KL| high), the GROW action is vetoed — recorded in
+    ``last_veto`` for the facade's audit. Absence of the signal is never
+    a veto (no trainer metrics = the guard does not exist), and the guard
+    never blocks the SHRINK direction."""
 
     name = "staleness"
 
     def __init__(self, cfg, initial: int):
         super().__init__(cfg)
         self.bound = max(cfg.min_staleness, min(cfg.max_staleness, initial))
+        # set by decide() when the learning-health guard blocked a grow:
+        # (reason, signal value) — the facade audits + counts it
+        self.last_veto: tuple[str, float] | None = None
 
     def setpoints(self) -> dict[str, float]:
         return {"max_staleness": float(self.bound)}
 
+    def _learning_veto(self, sig: Signals) -> tuple[str, float] | None:
+        c = self.cfg
+        if not getattr(c, "learning_guard", False):
+            return None
+        share = sig.high_lag_token_share
+        if share is not None and share < c.guard_min_token_share:
+            return None  # near-empty bucket: noise, not evidence
+        if (
+            sig.high_lag_clip_fraction is not None
+            and sig.high_lag_clip_fraction >= c.guard_high_lag_clip_fraction
+        ):
+            return ("high_lag_clipped_dead", sig.high_lag_clip_fraction)
+        # the cap is the other dead-weight mode (tokens masked out at
+        # behav_imp_weight_cap contribute no gradient AND no KL — a
+        # cap-dominated bucket dilutes the KL signal toward zero), so it
+        # shares the clip threshold: both mean "fraction of the bucket
+        # contributing nothing"
+        if (
+            sig.high_lag_cap_fraction is not None
+            and sig.high_lag_cap_fraction >= c.guard_high_lag_clip_fraction
+        ):
+            return ("high_lag_capped_dead", sig.high_lag_cap_fraction)
+        if (
+            sig.high_lag_behave_kl is not None
+            and sig.high_lag_behave_kl >= c.guard_high_lag_kl
+        ):
+            return ("high_lag_kl_divergence", sig.high_lag_behave_kl)
+        return None
+
     def decide(self, sig: Signals) -> list[Action]:
         self.last_hold = None
+        self.last_veto = None
         if sig.bubble_fraction is None:
             self.last_hold = "bubble_fraction"
             return []
@@ -91,6 +134,12 @@ class StalenessController(_Base):
             sig.bubble_fraction >= self.cfg.grow_bubble_fraction
             and self.bound < self.cfg.max_staleness
         ):
+            veto = self._learning_veto(sig)
+            if veto is not None:
+                # no action, no cooldown consumed: the next round
+                # re-evaluates with fresh evidence
+                self.last_veto = veto
+                return []
             old, self.bound = self.bound, self.bound + 1
             self._acted(sig.now)
             return [

@@ -55,6 +55,18 @@ class Signals:
     # trainer (staleness controller)
     bubble_fraction: float | None = None
     version_span_p99: float | None = None
+    # learning-health guard (staleness controller; docs/observability.md
+    # "Learning-health observatory"): the HIGH-LAG bucket's windowed loss
+    # diagnostics, derived from the areal_train_lag_* counter deltas —
+    # None until a window with trained high-lag tokens exists
+    high_lag_token_share: float | None = None
+    high_lag_clip_fraction: float | None = None
+    # fraction of the bucket masked out at behav_imp_weight_cap — the
+    # OTHER dead-weight mode: capped tokens contribute nothing to the
+    # gradient OR to behave_kl (their KL is zeroed), so a cap-dominated
+    # bucket dilutes the KL signal toward 0 exactly as it dies
+    high_lag_cap_fraction: float | None = None
+    high_lag_behave_kl: float | None = None
     # serving tails + rates (admission controller)
     queue_wait_p99_s: float | None = None
     shed_rate_per_s: float | None = None
@@ -295,6 +307,53 @@ def assemble(
     )
     if span_w and max(span_w.values()) > 0:  # +Inf delta = window count
         sig.version_span_p99 = quantile_from_buckets(span_w, 0.99)
+    # learning-health guard signals: windowed ratios of the high-lag
+    # bucket's counter deltas (clip fraction = Δclipped/Δtokens, behave
+    # |KL| = Δkl_sum/Δtokens — rates share one dt, so rate ratios ARE
+    # delta ratios). A window with no freshly trained high-lag tokens
+    # reads absent -> the guard cannot veto on stale evidence.
+    from areal_tpu.infra.staleness_manager import HIGH_LAG_BUCKET
+
+    hl = labeled_total(
+        samples, "areal_train_lag_tokens_total", lag_bucket=HIGH_LAG_BUCKET
+    )
+    if hl is not None:
+        hl_r = rates.rate("hl_tokens", hl, now)
+        tot_r = rates.rate(
+            "lag_tokens", total(samples, "areal_train_lag_tokens_total"), now
+        )
+        hl_clip = labeled_total(
+            samples, "areal_train_lag_clipped_total", lag_bucket=HIGH_LAG_BUCKET
+        )
+        clip_r = (
+            rates.rate("hl_clipped", hl_clip, now)
+            if hl_clip is not None
+            else None
+        )
+        hl_kl = labeled_total(
+            samples,
+            "areal_train_lag_behave_kl_sum_total",
+            lag_bucket=HIGH_LAG_BUCKET,
+        )
+        hl_cap = labeled_total(
+            samples, "areal_train_lag_capped_total", lag_bucket=HIGH_LAG_BUCKET
+        )
+        cap_r = (
+            rates.rate("hl_capped", hl_cap, now) if hl_cap is not None else None
+        )
+        kl_r = rates.rate("hl_kl_sum", hl_kl, now) if hl_kl is not None else None
+        if hl_r is not None and hl_r > 0:
+            if tot_r is not None and tot_r > 0:
+                sig.high_lag_token_share = hl_r / tot_r
+            if clip_r is not None:
+                sig.high_lag_clip_fraction = min(1.0, clip_r / hl_r)
+            if cap_r is not None:
+                sig.high_lag_cap_fraction = min(1.0, cap_r / hl_r)
+            if kl_r is not None:
+                # mean over the bucket's TOKENS: capped tokens count in
+                # the denominator with zero KL, so this is deliberately a
+                # lower bound — the cap signal above owns that regime
+                sig.high_lag_behave_kl = kl_r / hl_r
     qw_w = rates.window(
         "queue_wait",
         bucket_totals(samples, "areal_request_queue_wait_seconds"),

@@ -88,6 +88,7 @@ _GRID_KEYS = (
     "ref_logprobs",
     "logprobs",
     "versions",
+    "version_lag",
     "values",
     "target_values",
     "old_values",
@@ -105,10 +106,25 @@ def _fold_weighted_stats(
 ) -> None:
     """Fold per-microbatch stat dicts (host values from the one boundary
     pull) into the step aggregate, weighted by each microbatch's loss
-    weight — the reference's loss-weight all-reduce as a host sum."""
+    weight — the reference's loss-weight all-reduce as a host sum.
+    Array-valued stats (per-sequence attribution) are split off before
+    this runs; skip any stragglers rather than crash on float()."""
     for s, w in zip(mb_host, weights):
         for k, v in s.items():
+            if getattr(v, "ndim", 0):
+                continue
             agg[k] = agg.get(k, 0.0) + float(v) * (w / total_w)
+
+
+def _split_seq_stats(host: dict) -> dict[str, np.ndarray]:
+    """Pop array-valued (per-sequence) stats out of one microbatch's host
+    stat dict, leaving only scalars for the weighted fold."""
+    arrays = {
+        k: np.asarray(v) for k, v in host.items() if getattr(v, "ndim", 0)
+    }
+    for k in arrays:
+        host.pop(k)
+    return arrays
 
 
 def make_lr_schedule(cfg: OptimizerConfig, total_steps: int):
@@ -171,6 +187,11 @@ class JaxTrainEngine(TrainEngine):
         self._weight_update_meta: WeightUpdateMeta | None = None
         self._rollout_coord = None
         self.ft_spec: FinetuneSpec | None = None
+        # per-sequence loss attribution from the LAST train_batch call
+        # (key -> [B_input] array, input order), or None when the loss
+        # emitted no seq__* stats. Read by PPOActor.ppo_update to join
+        # loss stats onto the trajectory lineage ring.
+        self.last_seq_stats: dict[str, np.ndarray] | None = None
 
     # -- lifecycle --------------------------------------------------------
     def initialize(self, ft_spec: FinetuneSpec | None = None, **kwargs) -> None:
@@ -594,6 +615,9 @@ class JaxTrainEngine(TrainEngine):
             rows_per_mb = max(1, max_tok // row_len)
         rows_per_mb = max(dp, -(-rows_per_mb // dp) * dp) if dp > 1 else rows_per_mb
         if rows_per_mb >= grid.n_rows and grid.n_rows % max(dp, 1) == 0:
+            # source_index: grid-local sequence order -> index in input_
+            # (per-seq loss attribution maps device outputs back through it)
+            grid.source_index = list(grid.seq_index)
             return [grid]
         # re-pack per microbatch: chunk sequences by their assigned row
         n_mbs = -(-grid.n_rows // rows_per_mb)
@@ -606,11 +630,22 @@ class JaxTrainEngine(TrainEngine):
             if not seqs:
                 continue
             sub = {k: np.asarray(v)[seqs] for k, v in input_.items()}
-            out.append(pack_grid(sub, row_len=row_len, pad_rows_to=max(dp, 1)))
+            g = pack_grid(sub, row_len=row_len, pad_rows_to=max(dp, 1))
+            # compose the sub-batch indirection: g.seq_index points into
+            # ``sub``; the attribution needs indices into ``input_``
+            g.source_index = [seqs[i] for i in g.seq_index]
+            out.append(g)
         return out
 
-    def _grid_to_device(self, grid: Grid) -> dict[str, jax.Array]:
-        """Ship per-token grid arrays to the mesh with batch sharding."""
+    def _grid_to_device(
+        self, grid: Grid, seq_attribution: bool = False
+    ) -> dict[str, jax.Array]:
+        """Ship per-token grid arrays to the mesh with batch sharding.
+
+        ``seq_attribution`` additionally builds the packed-batch segment
+        map (``seq_slot``/``seq_slots``) for per-trajectory loss stats —
+        only the train_batch loss path consumes it, so forward_batch /
+        eval_batch skip the host loop and the two extra transfers."""
         seg = grid.data["segment_ids"]
         labels, label_valid = qwen.make_causal_inputs(grid.data["input_ids"], seg)
         batch: dict[str, np.ndarray] = {
@@ -626,6 +661,25 @@ class JaxTrainEngine(TrainEngine):
         dev = {}
         for k, v in batch.items():
             dev[k] = jax.device_put(_np_device_dtype(np.asarray(v)), sharding)
+        if seq_attribution and "lineage_id" in grid.data:
+            # learning-health observatory: the packed-batch segment map for
+            # per-trajectory loss attribution (trainer/ppo.py
+            # _per_sequence_stats). ``seq_slot`` tags each cell with its
+            # grid-local sequence slot; ``seq_slots`` is a dummy whose
+            # bucketed SHAPE gives the traced reduction its static slot
+            # count (n_seqs varies per batch — unbucketed it would recompile
+            # the fwd/bwd per distinct count).
+            n_local = len(grid.seq_index)
+            n_slots = round_up_to_bucket(max(n_local, 1), 8)
+            slot = np.full((grid.data["segment_ids"].shape), -1, np.int32)
+            for local, (r, c, n) in enumerate(
+                zip(grid.row_of_seq, grid.col_of_seq, grid.seq_lens)
+            ):
+                slot[r, c : c + n] = local
+            dev["seq_slot"] = jax.device_put(slot, sharding)
+            dev["seq_slots"] = jax.device_put(
+                np.zeros(n_slots, np.int32), mesh_lib.replicated(self.mesh)
+            )
         if "pixel_values" in grid.data and "image_k" in grid.data:
             # trainable-tower path: pixel tensors ride to the jit (replicated
             # — n_seqs is not dp-divisible in general and the tower is small
@@ -1118,6 +1172,7 @@ class JaxTrainEngine(TrainEngine):
         mb_spec: MicroBatchSpec | None = None,
     ) -> dict[str, float]:
         assert self.params is not None, "engine not initialized"
+        self.last_seq_stats = None
         if self.config.tree_training:
             assert not self.value_head, "tree training is a policy-only path"
             assert "pixel_values" not in input_ and "image_embeds" not in input_, (
@@ -1136,7 +1191,7 @@ class JaxTrainEngine(TrainEngine):
         if len(grids) == 1:
             with set_mesh(self.mesh):
                 with engine_phase("host_prep"):
-                    batch = self._grid_to_device(grids[0])
+                    batch = self._grid_to_device(grids[0], seq_attribution=True)
                 step_before = self._opt_step_count()
                 fn = self._get_fused_step_fn(loss_fn, _shape_key(batch))
                 # the fused jit folds the optimizer apply into the same
@@ -1151,6 +1206,12 @@ class JaxTrainEngine(TrainEngine):
                     # per stat — PRF burn-down, docs/static_analysis.md)
                     # arealint: disable-next=PRF001 designed step-boundary sync: single batched pull, nothing left to overlap
                     host = jax.device_get({**stats, "loss": loss, "grad_norm": gnorm})
+            seq_arrays = _split_seq_stats(host)
+            if seq_arrays:
+                self._collect_seq_stats(
+                    [(grids[0], seq_arrays)],
+                    int(np.asarray(input_["attention_mask"]).shape[0]),
+                )
             agg = {k: float(v) for k, v in host.items()}
             agg["lr"] = float(self._lr_schedule(step_before))
             agg["n_microbatches"] = 1.0
@@ -1161,7 +1222,7 @@ class JaxTrainEngine(TrainEngine):
         with set_mesh(self.mesh):
             for g, w in zip(grids, weights):
                 with engine_phase("host_prep"):
-                    batch = self._grid_to_device(g)
+                    batch = self._grid_to_device(g, seq_attribution=True)
                 shape = _shape_key(batch)
                 gfn = self._get_grad_fn(loss_fn, shape)
                 with engine_phase("forward_backward"):
@@ -1182,6 +1243,13 @@ class JaxTrainEngine(TrainEngine):
                 # microbatch's stats (was: one sync per microbatch)
                 # arealint: disable-next=PRF001 designed step-boundary sync: single batched pull, nothing left to overlap
                 gnorm_h, mb_host = jax.device_get((gnorm, pending_stats))
+        seq_pairs = [
+            (g, _split_seq_stats(s)) for g, s in zip(grids, mb_host)
+        ]
+        if any(arrs for _, arrs in seq_pairs):
+            self._collect_seq_stats(
+                seq_pairs, int(np.asarray(input_["attention_mask"]).shape[0])
+            )
         _fold_weighted_stats(agg, mb_host, weights, total_w)
         agg["grad_norm"] = float(gnorm_h)
         agg["lr"] = float(self._lr_schedule(step_before))
@@ -1189,6 +1257,23 @@ class JaxTrainEngine(TrainEngine):
         agg["train_batch_secs"] = time.monotonic() - t0
         self._count_opt_step()
         return agg
+
+    def _collect_seq_stats(
+        self, pairs: list[tuple[Grid, dict[str, np.ndarray]]], n_input: int
+    ) -> None:
+        """Map per-slot ``seq__*`` loss stats back to INPUT sequence order
+        through each grid's source_index (bucket-padding slots drop).
+        Host-side bookkeeping only — the arrays already arrived in the one
+        step-boundary pull."""
+        out: dict[str, np.ndarray] = {}
+        for g, arrs in pairs:
+            src = g.source_index if g.source_index is not None else g.seq_index
+            for k, arr in arrs.items():
+                dest = out.setdefault(k, np.zeros(n_input, np.float64))
+                for local, s in enumerate(src):
+                    if local < len(arr) and 0 <= s < n_input:
+                        dest[s] = arr[local]
+        self.last_seq_stats = out or None
 
     # -- RPC-friendly dispatch (single-controller mode) -------------------
     # Closures don't cross the RPC boundary; the controller ships loss /

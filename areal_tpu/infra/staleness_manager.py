@@ -20,6 +20,37 @@ from typing import Protocol
 from areal_tpu.api.io_struct import RolloutStat
 from areal_tpu.observability import catalog
 
+# ---------------------------------------------------------------------------
+# Version-lag bucket taxonomy (docs/observability.md "Learning-health
+# observatory"). ONE definition shared by the loss-side bucket stats
+# (trainer/ppo.py), the metric catalog's ``lag_bucket`` label values, the
+# autopilot's learning-health guard signal, and the dashboard panel — the
+# four must agree on what "the high-lag bucket" means or the guard steers
+# on a bucket nobody computes.
+#
+# lag = consuming policy version - per-token policy version. Buckets:
+#   "0"  : lag <= 0 (on-policy; unknown/untagged tokens clamp here)
+#   "1"  : lag == 1 (one weight commit behind — the η=1 steady state)
+#   "2"  : 2 <= lag <= 3
+#   "4+" : lag >= 4 (the deep-off-policy tail the staleness bound exists
+#          to keep useful; the guard watches this bucket)
+# ---------------------------------------------------------------------------
+LAG_BUCKET_EDGES = (0, 1, 2, 4)
+LAG_BUCKET_LABELS = ("0", "1", "2", "4+")
+HIGH_LAG_BUCKET = "4+"
+
+
+def lag_bucket_index(lag: int) -> int:
+    """Bucket index of one lag value (host-side twin of the in-jit
+    bucketing in trainer/ppo.py — keep both in sync with the edges)."""
+    if lag >= 4:
+        return 3
+    if lag >= 2:
+        return 2
+    if lag >= 1:
+        return 1
+    return 0
+
 
 class VersionProvider(Protocol):
     def get_version(self) -> int: ...

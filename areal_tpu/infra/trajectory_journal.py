@@ -74,6 +74,11 @@ class JournalEntry:
     # resolved during scan(): the policy version whose training step popped
     # this entry (None = never consumed before the crash)
     consumed_version: int | None = None
+    # trajectory-lineage provenance (lineage_id/task_id/replica/reward from
+    # observability/lineage.py) as journaled at append time — replay
+    # re-registers the record from it, and postmortems can rebuild lineage
+    # from disk when the ring died with the process
+    lineage: dict[str, Any] | None = None
 
 
 @dataclasses.dataclass
@@ -267,17 +272,18 @@ class TrajectoryJournal:
         head_version: int,
         tail_version: int,
         n_real_tokens: int,
+        lineage: dict[str, Any] | None = None,
     ) -> None:
         import numpy as np
 
-        payload = pickle.dumps(
-            {
-                "tail_version": int(tail_version),
-                "n_real_tokens": int(n_real_tokens),
-                "traj": {k: np.asarray(v) for k, v in traj.items()},
-            },
-            protocol=pickle.HIGHEST_PROTOCOL,
-        )
+        record = {
+            "tail_version": int(tail_version),
+            "n_real_tokens": int(n_real_tokens),
+            "traj": {k: np.asarray(v) for k, v in traj.items()},
+        }
+        if lineage is not None:
+            record["lineage"] = dict(lineage)
+        payload = pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL)
         self._append_frame(b"T", int(head_version), task_id, payload)
         self.appended += 1
         self._metrics.journal_appended.inc()
@@ -342,6 +348,7 @@ class TrajectoryJournal:
                         tail_version=rec["tail_version"],
                         n_real_tokens=rec["n_real_tokens"],
                         traj=rec["traj"],
+                        lineage=rec.get("lineage"),
                     )
                     if e.task_id not in entries:
                         order.append(e.task_id)
@@ -352,10 +359,10 @@ class TrajectoryJournal:
 
     def pending_for_replay(
         self, restored_version: int, max_staleness: int
-    ) -> tuple[list[JournalEntry], int, int]:
+    ) -> tuple[list[JournalEntry], list[JournalEntry], int]:
         """Partition the journal against a restored trainer clock.
 
-        Returns ``(replayable, n_dropped_stale, n_skipped_consumed)``:
+        Returns ``(replayable, dropped_stale, n_skipped_consumed)``:
 
         - *replayable*: never consumed, or consumed by a training step the
           recover checkpoint does NOT cover (``consumed_version >=
@@ -363,12 +370,14 @@ class TrajectoryJournal:
           re-run), and still inside the staleness bound.
         - *dropped_stale*: would otherwise replay but ``restored_version -
           head_version > max_staleness`` — decoupled PPO's bound says the
-          restored policy may not train on them.
+          restored policy may not train on them. Returned as ENTRIES (not
+          a count) so the caller can leave a per-trajectory audit trail
+          (``kind=journal_drop_stale`` flight events).
         - *skipped_consumed*: consumed by a step the checkpoint covers;
           replaying would train on them twice.
         """
         replayable: list[JournalEntry] = []
-        n_stale = 0
+        dropped_stale: list[JournalEntry] = []
         n_consumed = 0
         for e in self.scan():
             if (
@@ -378,10 +387,10 @@ class TrajectoryJournal:
                 n_consumed += 1
                 continue
             if restored_version - e.head_version > max_staleness:
-                n_stale += 1
+                dropped_stale.append(e)
                 continue
             replayable.append(e)
-        return replayable, n_stale, n_consumed
+        return replayable, dropped_stale, n_consumed
 
     def gc(self, covered_version: int) -> int:
         """Drop sealed segments that recovery can never need again: every

@@ -177,32 +177,69 @@ class WorkflowExecutor:
         re-generating (docs/fault_tolerance.md)."""
         self.journal = journal
 
-    def _journal_append(self, traj: TensorDict, task_id: str, ntok: int) -> None:
+    def _version_stats(
+        self, traj: TensorDict
+    ) -> tuple[int, int, int, int, bool]:
+        """Per-token version tags of one trajectory, summarized in ONE
+        scan: ``(head, tail, lag, span, tagged)`` where head/tail are the
+        min/max tagged version (current engine version when untagged),
+        lag = current version - head, span = tail - head (>0 means the
+        sequence decoded across a zero-pause weight commit), and tagged
+        says whether any token carried a version at all (untagged
+        trajectories must not feed the staleness lag/span observations).
+        The single definition behind journaling, staleness accounting,
+        lineage, and trajectory dumps."""
+        versions = np.asarray(traj.get("versions", np.empty(0)))
+        vmask = versions >= 0
+        cur = int(self.engine.get_version())
+        tagged = bool(versions.size and vmask.any())
+        if tagged:
+            head = int(versions[vmask].min())
+            tail = int(versions[vmask].max())
+        else:
+            head = tail = cur
+        return head, tail, max(0, cur - head), tail - head, tagged
+
+    def _journal_append(
+        self,
+        traj: TensorDict,
+        task_id: str,
+        ntok: int,
+        head_v: int,
+        tail_v: int,
+        lineage_meta: dict | None = None,
+    ) -> None:
         if self.journal is None:
             return
         try:
-            versions = np.asarray(traj.get("versions", np.empty(0)))
-            vmask = versions >= 0
-            if versions.size and vmask.any():
-                head_v = int(versions[vmask].min())
-                tail_v = int(versions[vmask].max())
-            else:
-                head_v = tail_v = int(self.engine.get_version())
             self.journal.append_trajectory(
-                traj, task_id, head_v, tail_v, ntok
+                traj, task_id, head_v, tail_v, ntok, lineage=lineage_meta
             )
+            if lineage_meta is not None:
+                from areal_tpu.observability import lineage as lineage_mod
+
+                lineage_mod.get_lineage().mark_journaled(
+                    int(lineage_meta.get("lineage_id", -1))
+                )
         except Exception:  # noqa: BLE001 — durability is best-effort; a
             # full disk must degrade to the pre-journal behavior, not kill
             # the rollout pipeline
             logger.exception("trajectory journal append failed")
 
-    def _journal_consumed(self, task_ids: list[str]) -> None:
-        if self.journal is None or not task_ids:
+    def _mark_consumed(self, task_ids: list[str]) -> None:
+        """A training batch popped these trajectories: stamp the lineage
+        ring with the consuming version and journal the consumption
+        markers (replay skips what a checkpointed step already trained)."""
+        if not task_ids:
+            return
+        version = int(self.engine.get_version())
+        from areal_tpu.observability import lineage as lineage_mod
+
+        lineage_mod.get_lineage().mark_consumed(task_ids, version)
+        if self.journal is None:
             return
         try:
-            self.journal.mark_consumed(
-                task_ids, int(self.engine.get_version())
-            )
+            self.journal.mark_consumed(task_ids, version)
         except Exception:  # noqa: BLE001 — see _journal_append
             logger.exception("trajectory journal consume-mark failed")
 
@@ -217,12 +254,34 @@ class WorkflowExecutor:
         if max_staleness is None:
             max_staleness = self.staleness.max_staleness
         version = int(self.engine.get_version())
-        replayable, n_stale, n_consumed = self.journal.pending_for_replay(
+        replayable, dropped_stale, n_consumed = self.journal.pending_for_replay(
             version, max_staleness
         )
+        n_stale = len(dropped_stale)
+        from areal_tpu.observability import lineage as lineage_mod
+        from areal_tpu.observability import timeline as tl_mod
+
+        ring = lineage_mod.get_lineage()
         for e in replayable:
             self.staleness.observe_version_lag(version - e.head_version)
             self.staleness.observe_version_span(e.tail_version - e.head_version)
+            # fresh lineage record for this life (the old ring died with
+            # the old process); provenance comes back from the journal
+            # frame payload, and the stamped lineage_id is rewritten so
+            # the train-step attribution lands on the new record
+            lin = e.lineage or {}
+            lid = ring.register(
+                task_id=e.task_id,
+                replica=str(lin.get("replica", "")),
+                head_version=e.head_version,
+                tail_version=e.tail_version,
+                n_tokens=e.n_real_tokens,
+                reward=float(lin.get("reward", 0.0)),
+                journaled=True,
+            )
+            if "lineage_id" in e.traj or lin:
+                B = int(np.asarray(e.traj["attention_mask"]).shape[0])
+                e.traj["lineage_id"] = np.full(B, lid, np.int64)
             with self._cv:
                 self._results.append((e.task_id, e.traj, e.n_real_tokens))
                 self._cv.notify_all()
@@ -233,12 +292,66 @@ class WorkflowExecutor:
             self._preempt_obs.journal_replayed.inc(len(replayable))
         if n_stale:
             self._preempt_obs.journal_dropped_stale.inc(n_stale)
+            # per-trajectory audit trail: the counter says HOW MANY were
+            # discarded, the flight ring says WHICH work (and how far past
+            # the bound) — postmortems can cost a preemption in lost
+            # rollout, not just count it
+            flight = tl_mod.get_flight_recorder()
+            for e in dropped_stale:
+                flight.record(
+                    "journal_drop_stale",
+                    severity="warn",
+                    task_id=e.task_id,
+                    lag=version - e.head_version,
+                    bound=int(max_staleness),
+                    n_tokens=e.n_real_tokens,
+                )
         logger.info(
             f"journal replay: {len(replayable)} trajectories re-injected, "
             f"{n_stale} dropped over-stale (bound {max_staleness}), "
             f"{n_consumed} already consumed by checkpointed steps"
         )
         return len(replayable), n_stale
+
+    def _register_lineage(
+        self,
+        traj: TensorDict,
+        task_id: str,
+        head_v: int,
+        tail_v: int,
+        ntok: int,
+    ) -> dict:
+        """Register an accepted trajectory on the lineage ring
+        (observability/lineage.py) and stamp its id as a per-sequence
+        ``lineage_id`` batch key — the ride-along that survives batching,
+        minibatch splits, and grid packing so the train step can attribute
+        its loss stats back to this trace id."""
+        from areal_tpu.observability import lineage as lineage_mod
+
+        B = int(np.asarray(traj["attention_mask"]).shape[0])
+        rewards = np.ravel(
+            np.asarray(traj.get("rewards", np.zeros(B)), np.float32)
+        )
+        reward = float(rewards.mean()) if rewards.size else 0.0
+        replica = (
+            ",".join(list(getattr(self.engine, "addresses", []) or [])[:4])
+            or "inproc"
+        )
+        lid = lineage_mod.get_lineage().register(
+            task_id=task_id,
+            replica=replica,
+            head_version=head_v,
+            tail_version=tail_v,
+            n_tokens=ntok,
+            reward=reward,
+        )
+        traj["lineage_id"] = np.full(B, lid, np.int64)
+        return {
+            "lineage_id": lid,
+            "task_id": task_id,
+            "replica": replica,
+            "reward": reward,
+        }
 
     def _check_interrupt(self) -> None:
         if self._interrupt is not None and self._interrupt.is_set():
@@ -349,24 +462,22 @@ class WorkflowExecutor:
         counter_cm = (
             tracker.scope("eval-rollout") if is_eval else _nullcontext()
         )
+        # one versions scan per accepted train trajectory: staleness
+        # accounting, lineage, and the journal header all read this tuple
+        vstats = (
+            self._version_stats(traj) if accepted and not is_eval else None
+        )
         if accepted:
             if not is_eval:
                 self.staleness.on_accept()
-                if "versions" in traj:
-                    versions = np.asarray(traj["versions"])
-                    vmask = versions >= 0
-                    if vmask.any():
-                        vmin = int(versions[vmask].min())
-                        self.staleness.observe_version_lag(
-                            int(self.engine.get_version()) - vmin
-                        )
-                        # per-token tags: a sequence decoded across a
-                        # zero-pause commit carries both versions; the span
-                        # feeds the mixed-version accounting decoupled PPO
-                        # corrects per token
-                        self.staleness.observe_version_span(
-                            int(versions[vmask].max()) - vmin
-                        )
+                if vstats[4]:  # tagged: the one scan already decided
+                    _head, _tail, lag, span, _tagged = vstats
+                    self.staleness.observe_version_lag(lag)
+                    # per-token tags: a sequence decoded across a
+                    # zero-pause commit carries both versions; the span
+                    # feeds the mixed-version accounting decoupled PPO
+                    # corrects per token
+                    self.staleness.observe_version_span(span)
             with counter_cm:
                 tracker.scalar(rollout_accepted=1.0)
             if self.config.dump_trajectories:
@@ -389,10 +500,20 @@ class WorkflowExecutor:
             int(np.asarray(traj["attention_mask"]).sum()) if accepted else 0
         )
         if accepted and not is_eval:
+            head_v, tail_v, _lag, _span, _tagged = vstats
+            # lineage BEFORE journal: the journal frame's payload carries
+            # the same provenance metadata, so a postmortem can rebuild
+            # the record from disk even if the ring was lost with the
+            # process
+            lineage_meta = self._register_lineage(
+                traj, task_id, head_v, tail_v, ntok
+            )
             # durable BEFORE visible: once a trajectory can be popped into
             # a batch it must already be journaled, or a crash between pop
             # and the next dump silently loses it
-            self._journal_append(traj, task_id, ntok)
+            self._journal_append(
+                traj, task_id, ntok, head_v, tail_v, lineage_meta
+            )
         with self._cv:
             if rec is not None:
                 rec.result = traj if accepted else None
@@ -598,13 +719,7 @@ class WorkflowExecutor:
         attn = np.asarray(traj["attention_mask"])
         loss_mask = np.asarray(traj.get("loss_mask", np.ones_like(attn)))
         rewards = np.asarray(traj.get("rewards", np.zeros(len(input_ids))))
-        if "versions" in traj:
-            versions = np.asarray(traj["versions"])
-            vmask = versions >= 0
-            head_v = int(versions[vmask].min()) if vmask.any() else -1
-            tail_v = int(versions[vmask].max()) if vmask.any() else -1
-        else:
-            head_v = tail_v = int(self.engine.get_version())
+        head_v, tail_v, _lag, _span, _tagged = self._version_stats(traj)
         version_dir = os.path.join(self._dump_dir(), str(tail_v))
         os.makedirs(version_dir, exist_ok=True)
         path = os.path.join(version_dir, f"{task_id}.jsonl")
@@ -682,7 +797,7 @@ class WorkflowExecutor:
             for tid, _, _ in out:
                 self._done_tasks.pop(tid, None)
         if not is_eval:
-            self._journal_consumed([tid for tid, _, _ in out])
+            self._mark_consumed([tid for tid, _, _ in out])
         return concat_padded_tensor_dicts([t for _, t, _ in out])
 
     def wait_for_task(self, task_id: str, timeout: float | None = None):
@@ -755,13 +870,13 @@ class WorkflowExecutor:
                         self._results = self._results[n_take:]
                         for tid, _, _ in out:
                             self._done_tasks.pop(tid, None)
-                        self._journal_consumed([tid for tid, _, _ in out])
+                        self._mark_consumed([tid for tid, _, _ in out])
                         return concat_padded_tensor_dicts([t for _, t, _ in out])
                 elif len(self._results) >= bs:
                     out, self._results = self._results[:bs], self._results[bs:]
                     for tid, _, _ in out:
                         self._done_tasks.pop(tid, None)
-                    self._journal_consumed([tid for tid, _, _ in out])
+                    self._mark_consumed([tid for tid, _, _ in out])
                     return concat_padded_tensor_dicts([t for _, t, _ in out])
                 # event-driven: _on_result notifies _cv on every completion
                 # (which is also when staleness capacity frees up). The

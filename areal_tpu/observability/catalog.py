@@ -498,6 +498,94 @@ def train_obs_metrics(reg: Registry | None = None) -> SimpleNamespace:
     )
 
 
+def learning_health_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """Learning-health observatory (docs/observability.md): decoupled-PPO
+    loss diagnostics conditioned on per-token version lag, computed in-jit
+    by ``grpo_loss_fn`` and exported once per ``ppo_update``. The
+    ``lag_bucket`` label values are the staleness_manager taxonomy
+    (``0 | 1 | 2 | 4+``); gauges carry the last step's view for dashboards
+    while the ``*_total`` counters give the autopilot's signal plane a
+    windowable (bucket-delta) view, per the PR 13 convention."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        clip_ratio=r.gauge(
+            "areal_train_lag_clip_ratio",
+            "Fraction of the bucket's valid tokens whose PPO ratio was "
+            "clipped in the last update (1.0 = the bucket contributes no "
+            "gradient), by version-lag bucket.",
+            label_names=("lag_bucket",),
+        ),
+        behave_kl=r.gauge(
+            "areal_train_lag_behave_kl",
+            "Mean behave approx-KL (|log pi_prox - log pi_behave|) of the "
+            "bucket's uncapped tokens in the last update — how far the "
+            "policy moved since the tokens were generated, by lag bucket.",
+            label_names=("lag_bucket",),
+        ),
+        approx_kl=r.gauge(
+            "areal_train_lag_approx_kl",
+            "Mean approx-KL (log pi_theta - log pi_prox) of the bucket's "
+            "valid tokens in the last update, by lag bucket.",
+            label_names=("lag_bucket",),
+        ),
+        imp_weight=r.gauge(
+            "areal_train_lag_behave_imp_weight",
+            "Mean behave importance weight of the bucket's uncapped "
+            "tokens in the last update, by lag bucket.",
+            label_names=("lag_bucket",),
+        ),
+        cap_hit=r.gauge(
+            "areal_train_lag_cap_hit_share",
+            "Fraction of the bucket's valid tokens whose behave "
+            "importance weight hit behav_imp_weight_cap (dead weight: "
+            "masked out of the loss), by lag bucket.",
+            label_names=("lag_bucket",),
+        ),
+        token_share=r.gauge(
+            "areal_train_lag_token_share",
+            "The bucket's share of the last update's valid tokens, by lag "
+            "bucket (shares sum to 1 when version tags are present).",
+            label_names=("lag_bucket",),
+        ),
+        tokens_total=r.counter(
+            "areal_train_lag_tokens_total",
+            "Valid loss tokens trained, by version-lag bucket (the "
+            "windowable denominator for the autopilot's learning-health "
+            "guard).",
+            label_names=("lag_bucket",),
+        ),
+        clipped_total=r.counter(
+            "areal_train_lag_clipped_total",
+            "Clipped loss tokens trained, by version-lag bucket.",
+            label_names=("lag_bucket",),
+        ),
+        capped_total=r.counter(
+            "areal_train_lag_capped_total",
+            "Loss tokens masked out at behav_imp_weight_cap, by version-lag "
+            "bucket (the cap-hit tail as a windowable counter).",
+            label_names=("lag_bucket",),
+        ),
+        behave_kl_sum=r.counter(
+            "areal_train_lag_behave_kl_sum_total",
+            "Sum of behave approx-KL over trained tokens, by lag bucket "
+            "(divide a window's delta by the tokens_total delta for the "
+            "windowed mean the guard acts on).",
+            label_names=("lag_bucket",),
+        ),
+        lineage_records=r.counter(
+            "areal_lineage_records_total",
+            "Trajectory lineage records registered (one per accepted "
+            "train trajectory; observability/lineage.py ring).",
+        ),
+        lineage_joined=r.counter(
+            "areal_lineage_joined_total",
+            "Lineage records joined to training-step loss stats (the "
+            "generate->journal->consume->update chain closed for that "
+            "trace id).",
+        ),
+    )
+
+
 def robustness_metrics(reg: Registry | None = None) -> SimpleNamespace:
     """Fault-tolerance layer (robustness/): retry/circuit/supervision/chaos."""
     r = reg or get_registry()
@@ -691,6 +779,15 @@ def autopilot_metrics(reg: Registry | None = None) -> SimpleNamespace:
             "fallback).",
             label_names=("controller",),
         ),
+        guard_vetoes=r.counter(
+            "areal_autopilot_guard_veto_total",
+            "Setpoint changes vetoed by a learning-health guard (the "
+            "staleness controller declining to raise the bound while the "
+            "high-lag bucket's tokens are clipped dead weight), by "
+            "controller. Audited as kind=autopilot_guard_veto flight "
+            "events.",
+            label_names=("controller",),
+        ),
         apply_failures=r.counter(
             "areal_autopilot_apply_failures_total",
             "Actuations that failed to apply (replica knob POST errored, "
@@ -731,6 +828,7 @@ ALL_FACTORIES = (
     rpc_metrics,
     trainer_metrics,
     train_obs_metrics,
+    learning_health_metrics,
     robustness_metrics,
     preemption_metrics,
     router_metrics,

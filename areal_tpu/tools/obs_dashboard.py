@@ -132,6 +132,9 @@ def _min_per_target(snap: FleetSnapshot, name: str) -> float | None:
     return min(per.values())
 
 
+# learning-health lag-bucket taxonomy (infra/staleness_manager.py)
+from areal_tpu.infra.staleness_manager import LAG_BUCKET_LABELS as _LAG_BUCKETS
+
 # trainer observatory phase taxonomy (observability/step_timeline.py)
 _TRAIN_PHASES = (
     "rollout_wait",
@@ -369,6 +372,42 @@ def render_frame(
         cs = _merged_value(snap, "areal_xla_compile_seconds_sum")
         if cs is not None:
             lines.append(f"{'xla compile time (s)':<24} {cs:>12.1f}")
+    # learning-health observatory (docs/observability.md): decoupled-PPO
+    # loss diagnostics by version-lag bucket — clip fraction, behave |KL|,
+    # cap-hit tail mass, token share — plus the lineage join counters.
+    # Per-bucket gauges are per-trainer facts (mean across targets, like
+    # bubble/MFU), never fleet-summed.
+    share_by = _labeled_values(snap, "areal_train_lag_token_share", "lag_bucket")
+    if share_by:
+        clip_by = _labeled_values(snap, "areal_train_lag_clip_ratio", "lag_bucket")
+        kl_by = _labeled_values(snap, "areal_train_lag_behave_kl", "lag_bucket")
+        cap_by = _labeled_values(
+            snap, "areal_train_lag_cap_hit_share", "lag_bucket"
+        )
+
+        def _bucket_mean(d: dict[str, list[float]], label: str) -> float:
+            vs = d.get(label)
+            return sum(vs) / len(vs) if vs else 0.0
+
+        lines.append("-" * 64)
+        lines.append("learning health by lag bucket (clip/|KL|/cap-hit/tok)")
+        for label in _LAG_BUCKETS:
+            if label not in share_by:
+                continue
+            lines.append(
+                f"{'  lag ' + label:<10}"
+                f" clip {_bucket_mean(clip_by, label):>6.1%}"
+                f"  |KL| {_bucket_mean(kl_by, label):>8.4f}"
+                f"  cap {_bucket_mean(cap_by, label):>6.1%}"
+                f"  tok {_bucket_mean(share_by, label):>6.1%}"
+            )
+        regd = _merged_value(snap, "areal_lineage_records_total")
+        joined = _merged_value(snap, "areal_lineage_joined_total")
+        if regd is not None:
+            lines.append(
+                f"{'lineage joined/records':<24} "
+                f"{_fmt(joined or 0):>6} / {_fmt(regd)}"
+            )
     # straggler view: per-target token counters expose a lagging server
     # that the fleet-merged sums hide
     per = snap.per_target("areal_decode_generated_tokens_total")
@@ -542,6 +581,28 @@ areal_xla_compiles_total 12
 areal_xla_compile_seconds_bucket{le="+Inf"} 12
 areal_xla_compile_seconds_sum 30.0
 areal_xla_compile_seconds_count 12
+# HELP areal_train_lag_token_share Bucket share of last update's tokens.
+# TYPE areal_train_lag_token_share gauge
+areal_train_lag_token_share{lag_bucket="0"} 0.5
+areal_train_lag_token_share{lag_bucket="4+"} 0.25
+# HELP areal_train_lag_clip_ratio Clip fraction by version-lag bucket.
+# TYPE areal_train_lag_clip_ratio gauge
+areal_train_lag_clip_ratio{lag_bucket="0"} 0.05
+areal_train_lag_clip_ratio{lag_bucket="4+"} 0.85
+# HELP areal_train_lag_behave_kl Mean behave |KL| by version-lag bucket.
+# TYPE areal_train_lag_behave_kl gauge
+areal_train_lag_behave_kl{lag_bucket="0"} 0.01
+areal_train_lag_behave_kl{lag_bucket="4+"} 0.62
+# HELP areal_train_lag_cap_hit_share Cap-hit tail mass by lag bucket.
+# TYPE areal_train_lag_cap_hit_share gauge
+areal_train_lag_cap_hit_share{lag_bucket="0"} 0.0
+areal_train_lag_cap_hit_share{lag_bucket="4+"} 0.2
+# HELP areal_lineage_records_total Trajectory lineage records registered.
+# TYPE areal_lineage_records_total counter
+areal_lineage_records_total 9
+# HELP areal_lineage_joined_total Lineage records joined to step stats.
+# TYPE areal_lineage_joined_total counter
+areal_lineage_joined_total 6
 """
 
 
@@ -738,6 +799,17 @@ def self_test() -> int:
                 "xla compile time (s)" in frame and "60.0" in frame,
                 "frame missing compile-time row (30.0s per target sums "
                 "to 60.0)",
+            ),
+            (
+                "learning health by lag bucket" in frame
+                and "lag 4+" in frame
+                and "0.6200" in frame,
+                "frame missing learning-health panel (per-target mean "
+                "behave |KL| 0.62 in the 4+ bucket)",
+            ),
+            (
+                "lineage joined/records" in frame and "12 / 18" in frame,
+                "frame missing lineage join row (counters sum: 2x6 / 2x9)",
             ),
             ("DOWN  127.0.0.1:1" in frame, "frame missing down-target row"),
         ]

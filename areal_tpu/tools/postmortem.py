@@ -27,6 +27,7 @@ import tempfile
 import urllib.request
 from pathlib import Path
 
+from areal_tpu.observability.lineage import lineage_to_trace_events
 from areal_tpu.observability.timeline import (
     flight_to_trace_events,
     timelines_to_trace_events,
@@ -58,7 +59,14 @@ def snapshot_to_events(snap: dict) -> list[dict]:
     ``_dup_flight_ring`` (set by :func:`dedup_shared_rings`) suppresses the
     flight events while keeping the timelines: colocated replicas share one
     process-global ring, and merging it once per scraped port would show
-    every admission-reject/eviction/commit twice."""
+    every admission-reject/eviction/commit twice.
+
+    Trajectory-lineage dumps (observability/lineage.py; recognized by
+    their ``lineage_records`` key) convert to per-trajectory spans whose
+    ``args.task_id`` joins the serving-side request timelines — the merged
+    trace then reads generate -> journal -> consume -> update per trace id."""
+    if "lineage_records" in snap:
+        return lineage_to_trace_events(snap)
     events = [] if snap.get("_dup_flight_ring") else flight_to_trace_events(snap)
     events.extend(timelines_to_trace_events(snap.get("timelines", [])))
     return events
@@ -174,7 +182,8 @@ def main(argv=None) -> int:
         "--files",
         nargs="*",
         default=[],
-        help="flight dump files (wedge/SIGTERM dumps) to include",
+        help="flight dump files (wedge/SIGTERM dumps) and trajectory "
+        "lineage dumps (lineage_*.json) to include",
     )
     p.add_argument("-o", "--output", default="incident_trace.json")
     p.add_argument(

@@ -32,6 +32,15 @@ identity with >= 90% measured named-phase coverage, a non-zero measured
 rollout_wait bubble, a populated HBM ledger (analytic CPU fallback), and
 live XLA compile counters.
 
+``--learning-obs-self-test`` runs a short CPU RL loop with FORCED
+staleness (eta > 0: the rollout pipeline pre-generates several versions
+ahead of the trainer) and asserts the learning-health observatory
+(docs/observability.md "Learning-health observatory"): the high-lag
+bucket shows strictly higher measured behave-|KL| than lag-0, the behave
+importance-weight cap leaves a non-zero cap-hit tail, and the trajectory
+lineage ring joins journal frames to training-step loss stats by trace
+id (generate -> journal -> consume -> update for one task id).
+
 ``--routing-self-test`` drives a 3-replica in-process fleet under seeded
 chaos with an 80%-shared-prefix multi-turn workload through BOTH routing
 policies (docs/serving.md "Cache-aware routing"), and asserts the routing
@@ -44,7 +53,7 @@ as cold after the rejoin).
 Usage: python -m areal_tpu.tools.validate_installation [--tpu]
     [--chaos-self-test] [--weight-sync-self-test] [--prefix-cache-self-test]
     [--overload-self-test] [--timeline-self-test] [--train-obs-self-test]
-    [--preemption-self-test] [--routing-self-test]
+    [--learning-obs-self-test] [--preemption-self-test] [--routing-self-test]
 """
 
 from __future__ import annotations
@@ -144,6 +153,15 @@ def main(argv=None) -> int:
         "interactive headroom, the interactive shed rate must drop in the "
         "second measured window, and every setpoint change must be "
         "auditable in the flight ring (docs/autopilot.md) — all on CPU",
+    )
+    p.add_argument(
+        "--learning-obs-self-test",
+        action="store_true",
+        help="short CPU RL run with forced staleness (eta>0) asserting "
+        "the learning-health observatory: high-lag behave-|KL| strictly "
+        "above lag-0, non-zero behave-cap tail mass, and lineage records "
+        "joining journal frames to step loss stats by trace id — all "
+        "measured, deterministic under seeded chaos",
     )
     p.add_argument(
         "--preemption-self-test",
@@ -306,6 +324,9 @@ def main(argv=None) -> int:
 
     if args.train_obs_self_test:
         _check("train_obs", train_obs_self_test, results)
+
+    if args.learning_obs_self_test:
+        _check("learning_obs", learning_obs_self_test, results)
 
     if args.preemption_self_test:
         _check("preemption", preemption_self_test, results)
@@ -842,6 +863,244 @@ def train_obs_self_test(
     finally:
         trainer.close()
         server.stop()
+
+
+def learning_obs_self_test(n_steps: int = 6, eta: int = 4) -> str:
+    """Short CPU RL run with FORCED staleness asserting the learning-health
+    observatory (docs/observability.md "Learning-health observatory") with
+    MEASURED numbers:
+
+    - eta=4 lets the rollout pipeline pre-generate ~(eta+1)*bs trajectories
+      at version 0; FIFO consumption then trains them at lags 0..eta, so
+      several lag buckets fill without any mocking;
+    - the highest populated lag bucket must show strictly higher windowed
+      behave-|KL| than lag-0 (the decoupled-loss drift the staleness bound
+      is supposed to keep corrigible), and a tight behave importance-weight
+      cap must leave a non-zero cap-hit tail;
+    - the trajectory lineage ring must join journal frames to train-step
+      loss stats by trace id: generate -> journal -> consume -> update for
+      the same task id, with per-trajectory clip fraction attributed.
+    """
+    import os
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from areal_tpu.api.config import (
+        ChaosConfig,
+        DatasetConfig,
+        InferenceEngineConfig,
+        MeshConfig,
+        MicroBatchSpec,
+        OptimizerConfig,
+        PPOActorConfig,
+        PPOConfig,
+        RecoverConfig,
+        SaverConfig,
+        ServerConfig,
+        StatsLoggerConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec, GenerationHyperparameters
+    from areal_tpu.autopilot.signals import labeled_total
+    from areal_tpu.engine.train_engine import JaxTrainEngine
+    from areal_tpu.infra.staleness_manager import LAG_BUCKET_LABELS
+    from areal_tpu.inference.client import RemoteJaxEngine
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.inference.server import ServerThread
+    from areal_tpu.observability import lineage as lineage_mod
+    from areal_tpu.observability.metrics import (
+        get_registry,
+        parse_prometheus_text,
+    )
+    from areal_tpu.robustness import FaultInjector
+    from areal_tpu.trainer.rl_trainer import PPOTrainer
+    from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+    root = tempfile.mkdtemp(prefix="areal_learning_obs_selftest_")
+    tiny = tiny_model_config()
+    actor_cfg = PPOActorConfig(
+        init_from_scratch=True,
+        dtype="float32",
+        param_dtype="float32",
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        # the lr IS the experiment: the policy must measurably move per
+        # version so lag maps to drift (behave KL)
+        optimizer=OptimizerConfig(lr=2e-2, lr_scheduler_type="constant"),
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=100_000),
+        bucket_step=64,
+        group_size=1,
+        ppo_n_minibatches=1,
+        adv_norm=None,
+        kl_ctl=0.0,
+        use_decoupled_loss=True,
+        prox_logp_mode="recompute",
+        # tight cap: a few drifted tokens must hit it (the tail-mass assert)
+        behav_imp_weight_cap=1.01,
+    )
+    engine = JaxTrainEngine(actor_cfg, model_config=tiny)
+    engine.initialize(FinetuneSpec(1, 16, 2))
+    scfg = ServerConfig(
+        max_batch_size=8,
+        max_seq_len=128,
+        decode_steps_per_call=4,
+        seed=0,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+    )
+    dec = DecodeEngine(
+        scfg, params=jax.tree.map(np.asarray, engine.params), model_cfg=tiny
+    )
+    dec.initialize()
+    server = ServerThread(scfg, dec)
+    server.start()
+    rollout = RemoteJaxEngine(
+        InferenceEngineConfig(
+            # wide open concurrency + eta>0: the whole staleness budget
+            # ((eta + v + 1) * bs accepted) pre-generates at version 0 and
+            # drains FIFO over the next eta steps — lag 0..eta, measured
+            max_concurrent_rollouts=16,
+            consumer_batch_size=2,
+            max_head_offpolicyness=eta,
+            request_timeout=120,
+        ),
+        addresses=[server.address],
+    )
+    rollout.initialize()
+    # seeded chaos: deterministic small stalls on the client POSTs — the
+    # asserts below must hold under perturbed timing, not a quiet lab
+    rollout.install_fault_injector(
+        FaultInjector(
+            ChaosConfig(enabled=True, seed=11, stall_prob=0.2, stall_s=0.01)
+        )
+    )
+    cfg = PPOConfig(
+        experiment_name="learning-obs",
+        trial_name="t0",
+        total_train_epochs=50,
+        total_train_steps=n_steps,
+        weight_update_mode="mem",
+        # SAMPLED generation: greedy slots run at temp->0, whose sampling
+        # distribution is deterministic and reports ~0 logprobs — no
+        # behavior policy to be off of. RL rollouts sample; so does this.
+        gconfig=GenerationHyperparameters(
+            n_samples=1, max_new_tokens=4, greedy=False
+        ),
+        train_dataset=DatasetConfig(batch_size=2, shuffle=True),
+        actor=actor_cfg,
+        saver=SaverConfig(fileroot=root),
+        checkpointer=SaverConfig(fileroot=root),
+        recover=RecoverConfig(mode="disabled", fileroot=root),
+        stats_logger=StatsLoggerConfig(fileroot=root),
+    )
+    cfg.evaluator.fileroot = root
+    cfg.cluster.fileroot = root
+    # the journal is part of the lineage chain under test
+    cfg.rollout.journal.enabled = True
+    cfg.rollout.journal.dir = os.path.join(root, "journal")
+    cfg.rollout.journal.fsync = False
+    rng = np.random.default_rng(0)
+    dataset = [
+        {"prompt_ids": rng.integers(2, 100, 3).tolist()} for _ in range(16)
+    ]
+    wf = RLVRWorkflow(
+        lambda *a, **k: 1.0,
+        GenerationHyperparameters(max_new_tokens=4, greedy=False),
+    )
+
+    def lag_counters() -> dict[str, dict[str, float]]:
+        samples = parse_prometheus_text(get_registry().render_prometheus())
+        out: dict[str, dict[str, float]] = {}
+        for label in LAG_BUCKET_LABELS:
+            out[label] = {
+                "tokens": labeled_total(
+                    samples, "areal_train_lag_tokens_total", lag_bucket=label
+                )
+                or 0.0,
+                "kl": labeled_total(
+                    samples,
+                    "areal_train_lag_behave_kl_sum_total",
+                    lag_bucket=label,
+                )
+                or 0.0,
+                "capped": labeled_total(
+                    samples, "areal_train_lag_capped_total", lag_bucket=label
+                )
+                or 0.0,
+            }
+        return out
+
+    c0 = lag_counters()
+    trainer = PPOTrainer(cfg, dataset, rollout=rollout, actor_engine=engine)
+    try:
+        trainer.train(workflow=wf)
+        journal = trainer.journal
+        if journal is None:
+            raise AssertionError("trajectory journal was not attached")
+        journal_tasks = {e.task_id for e in journal.scan()}
+    finally:
+        trainer.close()
+        server.stop()
+    c1 = lag_counters()
+    delta = {
+        label: {k: c1[label][k] - c0[label][k] for k in c0[label]}
+        for label in LAG_BUCKET_LABELS
+    }
+    if delta["0"]["tokens"] <= 0:
+        raise AssertionError(f"no lag-0 tokens trained: {delta}")
+    high_label = next(
+        (l for l in ("4+", "2", "1") if delta[l]["tokens"] > 0), None
+    )
+    if high_label is None:
+        raise AssertionError(
+            f"forced staleness produced no off-policy bucket: {delta} — "
+            "every trained token was lag 0"
+        )
+    kl0 = delta["0"]["kl"] / delta["0"]["tokens"]
+    klh = delta[high_label]["kl"] / delta[high_label]["tokens"]
+    if not klh > kl0:
+        raise AssertionError(
+            f"no KL separation: lag-0 behave-|KL| {kl0:.5f} vs lag-"
+            f"{high_label} {klh:.5f} — staleness is not being measured as "
+            "drift"
+        )
+    capped = sum(d["capped"] for d in delta.values())
+    if capped <= 0:
+        raise AssertionError(
+            f"behave cap {actor_cfg.behav_imp_weight_cap} left zero cap-hit "
+            "tail mass — the dead-weight tail is not observed"
+        )
+    # lineage join: generate -> journal -> consume -> update by trace id
+    ring = lineage_mod.get_lineage()
+    joined = [
+        r
+        for r in ring.recent()
+        if r.trained_version is not None and r.clip_fraction is not None
+    ]
+    if not joined:
+        raise AssertionError("no lineage record joined to train-step stats")
+    chained = [
+        r
+        for r in joined
+        if r.journaled
+        and r.consumed_version is not None
+        and r.task_id in journal_tasks
+    ]
+    if not chained:
+        raise AssertionError(
+            "no lineage record closes the full chain (journaled + consumed "
+            f"+ trained): {len(joined)} joined, journal has "
+            f"{len(journal_tasks)} tasks"
+        )
+    lags = sorted(
+        {r.lag_at_consume for r in chained if r.lag_at_consume is not None}
+    )
+    return (
+        f"{len(joined)} trajectories joined generate->journal->consume->"
+        f"update ({len(chained)} full-chain, consume lags {lags}); "
+        f"behave-|KL| lag-0 {kl0:.4f} < lag-{high_label} {klh:.4f}; "
+        f"cap-hit tail {capped:.0f} tokens "
+        f"(cap {actor_cfg.behav_imp_weight_cap})"
+    )
 
 
 def preemption_self_test(kill_after_version: int = 1) -> str:

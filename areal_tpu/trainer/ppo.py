@@ -26,6 +26,8 @@ import numpy as np
 
 from areal_tpu.api.config import MicroBatchSpec, PPOActorConfig, PPOCriticConfig
 from areal_tpu.api.engine_api import TrainEngine
+from areal_tpu.infra.staleness_manager import LAG_BUCKET_LABELS
+from areal_tpu.observability import catalog as obs_catalog
 from areal_tpu.ops import functional as F
 from areal_tpu.utils import logging as alog, stats_tracker
 from areal_tpu.utils.data import (
@@ -36,6 +38,161 @@ from areal_tpu.utils.data import (
 )
 
 logger = alog.getLogger("ppo")
+
+
+def _lag_bucket_stats(
+    version_lag: jax.Array, lmf: jax.Array, denom: jax.Array, stat: dict
+) -> dict:
+    """Staleness-conditioned loss diagnostics (jit-side; docs/observability
+    .md "Learning-health observatory"): per-lag-bucket clip fraction,
+    approx-KL, behave importance-weight mean + cap-hit tail mass, and token
+    share, as masked reductions over the packed grid. All outputs are
+    scalars, so they ride the engine's ONE step-fence device pull with the
+    rest of the stats — zero new host syncs (the PR 11 PRF contract).
+
+    Identity contract (tested): for any of clip_ratio / approx_kl, the
+    token-share-weighted sum over buckets recomposes the batch-wide scalar
+    exactly; behave stats recompose through ``behave_share``. Buckets
+    partition the valid-token mask — unknown lags (< 0) clamp into "0".
+
+    Every output here is normalized by the SAME ``denom`` — the
+    microbatch's total valid tokens, which is also the engine's fold
+    weight — so `_fold_weighted_stats` recombines them EXACTLY across a
+    ``max_tokens_per_mb`` split (a weighted mean of ``sum_b/denom``
+    quantities with weights == denom is the full-batch quantity). The
+    documented per-bucket RATIOS (clip fraction of the bucket's tokens
+    etc.) are quotients of these and are derived host-side AFTER the fold
+    by `_finalize_lag_stats`; normalizing by bucket counts in-jit instead
+    would make the fold weight (total tokens) disagree with the ratio's
+    own denominator (bucket tokens) and bias every bucket stat whenever
+    microbatches have different bucket mixes."""
+    lag = jnp.clip(version_lag, 0, None)
+    # keep in sync with staleness_manager.lag_bucket_index (edges 0/1/2/4+)
+    bucket = jnp.where(
+        lag >= 4, 3, jnp.where(lag >= 2, 2, jnp.where(lag >= 1, 1, 0))
+    )
+    clip_f = stat["clip_mask"].astype(jnp.float32)
+    behave = "behave_mask" in stat
+    if behave:
+        behave_f = stat["behave_mask"].astype(jnp.float32)
+    out: dict[str, jax.Array] = {}
+    for i, label in enumerate(LAG_BUCKET_LABELS):
+        bm = lmf * (bucket == i)
+        out[f"lag_{label}/token_share"] = bm.sum() / denom
+        out[f"lag_{label}/clip_frac"] = (clip_f * bm).sum() / denom
+        out[f"lag_{label}/kl_frac"] = (stat["approx_kl"] * bm).sum() / denom
+        if behave:
+            bb = behave_f * bm  # uncapped tokens in this bucket
+            out[f"lag_{label}/behave_frac"] = bb.sum() / denom
+            out[f"lag_{label}/imp_weight_frac"] = (
+                stat["behave_imp_weight"] * bb
+            ).sum() / denom
+            out[f"lag_{label}/behave_kl_frac"] = (
+                stat["behave_approx_kl"] * bb
+            ).sum() / denom
+            # magnitude twin of the signed mean above: the signed one
+            # recomposes the batch scalar; the abs one is the drift signal
+            # the metrics/guard export (sign cancellation must not hide a
+            # diverged bucket)
+            out[f"lag_{label}/behave_abs_kl_frac"] = (
+                jnp.abs(stat["behave_approx_kl"]) * bb
+            ).sum() / denom
+            out[f"lag_{label}/cap_frac"] = (bm - bb).sum() / denom
+    return out
+
+
+def _finalize_lag_stats(stats: dict[str, float]) -> dict[str, float]:
+    """Host-side twin of `_lag_bucket_stats`: turn the fold-safe
+    denom-normalized ``*_frac`` device stats into the documented
+    per-bucket ratios (clip_ratio / approx_kl / behave_* / cap_hit_share).
+    Runs AFTER the engine fold, so the ratios are exact even when a
+    train_batch split into uneven microbatches — each quotient's numerator
+    and denominator folded exactly. The batch-wide behave scalars
+    (``behave_approx_kl``/``behave_imp_weight``) are behave-token-
+    normalized in-jit while the engine folds by VALID tokens, so they
+    carry the same split bias — rederived here from the bucket pieces
+    (which partition the behave mask) so the identity closes on both
+    sides. Internal ``*_frac`` keys are consumed and dropped; no-op for
+    stats without the lag families."""
+    if "lag_0/token_share" not in stats:
+        return stats
+    out = dict(stats)
+    behave_total = sum(
+        out.get(f"lag_{label}/behave_frac", 0.0)
+        for label in LAG_BUCKET_LABELS
+    )
+    if behave_total > 0:
+        out["behave_approx_kl"] = (
+            sum(
+                out.get(f"lag_{label}/behave_kl_frac", 0.0)
+                for label in LAG_BUCKET_LABELS
+            )
+            / behave_total
+        )
+        out["behave_imp_weight"] = (
+            sum(
+                out.get(f"lag_{label}/imp_weight_frac", 0.0)
+                for label in LAG_BUCKET_LABELS
+            )
+            / behave_total
+        )
+    for label in LAG_BUCKET_LABELS:
+        share = out[f"lag_{label}/token_share"]
+        d = share if share > 0 else 1.0
+        out[f"lag_{label}/clip_ratio"] = (
+            out.pop(f"lag_{label}/clip_frac", 0.0) / d
+        )
+        out[f"lag_{label}/approx_kl"] = (
+            out.pop(f"lag_{label}/kl_frac", 0.0) / d
+        )
+        if f"lag_{label}/behave_frac" in out:
+            bfrac = out.pop(f"lag_{label}/behave_frac")
+            bd = bfrac if bfrac > 0 else 1.0
+            out[f"lag_{label}/behave_imp_weight"] = (
+                out.pop(f"lag_{label}/imp_weight_frac", 0.0) / bd
+            )
+            out[f"lag_{label}/behave_approx_kl"] = (
+                out.pop(f"lag_{label}/behave_kl_frac", 0.0) / bd
+            )
+            out[f"lag_{label}/behave_abs_kl"] = (
+                out.pop(f"lag_{label}/behave_abs_kl_frac", 0.0) / bd
+            )
+            out[f"lag_{label}/behave_share"] = bfrac / (
+                behave_total if behave_total > 0 else 1.0
+            )
+            out[f"lag_{label}/cap_hit_share"] = (
+                out.pop(f"lag_{label}/cap_frac", 0.0) / d
+            )
+    return out
+
+
+def _per_sequence_stats(b: dict, lmf: jax.Array, stat: dict) -> dict:
+    """Per-trajectory loss attribution through the packed-batch segment map
+    (jit-side). ``seq_slot`` maps each grid cell to its grid-local sequence
+    slot (-1 = padding); ``seq_slots`` is a host-shipped dummy whose SHAPE
+    carries the static slot count, so the segment reduction needs no
+    dynamic ``num_segments``. The array-valued outputs are pulled in the
+    same step-fence device_get as the scalars; the engine maps them back to
+    source sequences (``last_seq_stats``) for the trajectory lineage ring."""
+    nseq = b["seq_slots"].shape[0]
+    slot = b["seq_slot"].reshape(-1).astype(jnp.int32)
+    slot = jnp.where(slot < 0, nseq, slot)  # padding -> trash slot, sliced off
+
+    def seg(x: jax.Array) -> jax.Array:
+        return jax.ops.segment_sum(
+            x.reshape(-1), slot, num_segments=nseq + 1
+        )[:nseq]
+
+    out = {
+        "seq__tokens": seg(lmf),
+        "seq__clipped": seg(stat["clip_mask"].astype(jnp.float32)),
+    }
+    if "behave_mask" in stat:
+        bf = stat["behave_mask"].astype(jnp.float32)
+        out["seq__behave_tokens"] = seg(bf)
+        # abs: per-trajectory drift magnitude (see _lag_bucket_stats note)
+        out["seq__behave_kl_sum"] = seg(jnp.abs(stat["behave_approx_kl"]) * bf)
+    return out
 
 
 def grpo_loss_fn(outputs: dict, b: dict, cfg: PPOActorConfig):
@@ -127,7 +284,163 @@ def grpo_loss_fn(outputs: dict, b: dict, cfg: PPOActorConfig):
         )
     if "sapo_soft_gate" in stat:
         stats["sapo_soft_gate"] = tok_mean(stat["sapo_soft_gate"])
+    # learning-health observatory: staleness-conditioned stats + the
+    # per-trajectory attribution the lineage ring joins on — both emitted
+    # only when the batch carries the host-prepared keys (presence is
+    # static at trace time, so absent keys compile to nothing)
+    if "version_lag" in b:
+        stats.update(_lag_bucket_stats(b["version_lag"], lmf, denom, stat))
+    if "seq_slot" in b and "seq_slots" in b:
+        stats.update(_per_sequence_stats(b, lmf, stat))
     return loss, stats
+
+
+def _export_learning_health(
+    all_stats: list[dict[str, float]], weights: list[float] | None = None
+) -> None:
+    """Fold one update's minibatch stats into the catalogued
+    ``areal_train_lag_*{lag_bucket}`` metrics: gauges carry this step's
+    token-weighted view (dashboard), counters accumulate token-weighted
+    sums (the autopilot's windowable signal). Minibatches are weighted by
+    their HOST loss weight (valid-token count — the same weight the
+    engine folds stats by): the engine's folded ``n_valid_tokens`` is a
+    weight-weighted MEAN of per-microbatch counts, which under-scales as
+    a total whenever a batch splits into uneven microbatches. With the
+    host weights, single-minibatch updates recompose the batch scalars
+    exactly (the identity the tests pin down) and the counters track the
+    true trained-token totals."""
+    keep = [
+        (s, w)
+        for s, w in zip(
+            all_stats,
+            weights
+            if weights is not None
+            else [s.get("n_valid_tokens", 0.0) for s in all_stats],
+        )
+        if "lag_0/token_share" in s
+    ]
+    if not keep:
+        return
+    stats = [s for s, _ in keep]
+    m = obs_catalog.learning_health_metrics()
+    total_tokens = sum(w for _, w in keep) or 1.0
+    for label in LAG_BUCKET_LABELS:
+        tok = [
+            w * s.get(f"lag_{label}/token_share", 0.0) for s, w in keep
+        ]
+        ntok = sum(tok)
+        d = max(ntok, 1.0)
+        clip = (
+            sum(
+                t * s.get(f"lag_{label}/clip_ratio", 0.0)
+                for t, s in zip(tok, stats)
+            )
+            / d
+        )
+        akl = (
+            sum(
+                t * s.get(f"lag_{label}/approx_kl", 0.0)
+                for t, s in zip(tok, stats)
+            )
+            / d
+        )
+        m.token_share.labels(lag_bucket=label).set(ntok / total_tokens)
+        m.clip_ratio.labels(lag_bucket=label).set(clip)
+        m.approx_kl.labels(lag_bucket=label).set(akl)
+        m.tokens_total.labels(lag_bucket=label).inc(ntok)
+        m.clipped_total.labels(lag_bucket=label).inc(clip * ntok)
+        if any(f"lag_{label}/behave_approx_kl" in s for s in stats):
+            cap = (
+                sum(
+                    t * s.get(f"lag_{label}/cap_hit_share", 0.0)
+                    for t, s in zip(tok, stats)
+                )
+                / d
+            )
+            btok = [
+                t * (1.0 - s.get(f"lag_{label}/cap_hit_share", 0.0))
+                for t, s in zip(tok, stats)
+            ]
+            nb = max(sum(btok), 1.0)
+            bkl = (
+                sum(
+                    bt * s.get(f"lag_{label}/behave_abs_kl", 0.0)
+                    for bt, s in zip(btok, stats)
+                )
+                / nb
+            )
+            biw = (
+                sum(
+                    bt * s.get(f"lag_{label}/behave_imp_weight", 0.0)
+                    for bt, s in zip(btok, stats)
+                )
+                / nb
+            )
+            m.cap_hit.labels(lag_bucket=label).set(cap)
+            m.behave_kl.labels(lag_bucket=label).set(bkl)
+            m.imp_weight.labels(lag_bucket=label).set(biw)
+            m.capped_total.labels(lag_bucket=label).inc(cap * ntok)
+            m.behave_kl_sum.labels(lag_bucket=label).inc(bkl * sum(btok))
+
+
+def _accumulate_lineage(
+    acc: dict[int, dict[str, float]],
+    lineage_ids: np.ndarray,
+    seq_stats: dict[str, np.ndarray],
+) -> None:
+    """Fold one minibatch's per-sequence loss attribution (the engine's
+    ``last_seq_stats``, mapped back from the packed grids) onto lineage
+    ids. A GRPO group's sequences share one lineage id, so this is also
+    the group -> trajectory aggregation."""
+    toks = seq_stats.get("seq__tokens")
+    if toks is None:
+        return
+    clipped = seq_stats.get("seq__clipped")
+    btok = seq_stats.get("seq__behave_tokens")
+    bkl = seq_stats.get("seq__behave_kl_sum")
+    for i, lid in enumerate(np.ravel(np.asarray(lineage_ids))):
+        lid = int(lid)
+        if lid < 0 or i >= len(toks):
+            continue
+        a = acc.setdefault(
+            lid,
+            {
+                "tokens": 0.0,
+                "clipped": 0.0,
+                "behave_tokens": 0.0,
+                "behave_kl_sum": 0.0,
+            },
+        )
+        a["tokens"] += float(toks[i])
+        if clipped is not None:
+            a["clipped"] += float(clipped[i])
+        if btok is not None:
+            a["behave_tokens"] += float(btok[i])
+        if bkl is not None:
+            a["behave_kl_sum"] += float(bkl[i])
+
+
+def _commit_lineage(acc: dict[int, dict[str, float]], version: int) -> None:
+    """Join the update's per-trajectory loss stats onto the lineage ring
+    (observability/lineage.py) — the train-step end of the
+    generate -> journal -> consume -> update chain."""
+    if not acc:
+        return
+    from areal_tpu.observability import lineage as lineage_mod
+
+    ring = lineage_mod.get_lineage()
+    for lid, a in acc.items():
+        ring.record_train(
+            lid,
+            version=version,
+            tokens=a["tokens"],
+            clip_fraction=a["clipped"] / max(a["tokens"], 1.0),
+            behave_kl=(
+                a["behave_kl_sum"] / max(a["behave_tokens"], 1.0)
+                if a["behave_tokens"]
+                else None
+            ),
+        )
 
 
 class PPOActor:
@@ -286,6 +599,17 @@ class PPOActor:
             data["prox_logprobs"] = prox * loss_mask
         elif cfg.use_decoupled_loss and cfg.prox_logp_mode == "loglinear":
             data["prox_alpha"] = self._prox_alpha(data, loss_mask)
+        if "versions" in data:
+            # per-token version lag (label-aligned, like every loss key):
+            # lag = consuming policy version - token's tagged version; -1
+            # marks untagged positions (prompt tokens — masked out of the
+            # loss anyway, and clamped into bucket "0" by the jit-side
+            # bucketing). Host-side like prox_alpha: the consuming version
+            # is host knowledge, so no per-version recompiles.
+            v_theta = int(self.engine.get_version())
+            versions_lbl = _roll_back(np.asarray(data["versions"], np.int64))
+            lag = np.where(versions_lbl >= 0, v_theta - versions_lbl, -1)
+            data["version_lag"] = np.clip(lag, -1, 2**31 - 1).astype(np.int32)
         data.pop("logprobs", None)
         return data
 
@@ -332,17 +656,30 @@ class PPOActor:
             data, MicroBatchSpec(n_mbs=cfg.ppo_n_minibatches)
         )
         all_stats = []
+        mb_weights = []
+        consuming_version = int(self.engine.get_version())
+        lineage_acc: dict[int, dict[str, float]] = {}
         for mb in mb_list.mbs:
-            train_stat = self.engine.train_batch(
-                mb,
-                loss_fn=self._loss_fn,
-                loss_weight_fn=lambda x: float(
-                    (np.asarray(x["loss_mask"]) > 0).sum()
-                ),
+            train_stat = _finalize_lag_stats(
+                self.engine.train_batch(
+                    mb,
+                    loss_fn=self._loss_fn,
+                    loss_weight_fn=lambda x: float(
+                        (np.asarray(x["loss_mask"]) > 0).sum()
+                    ),
+                )
             )
+            mb_weights.append(float((np.asarray(mb["loss_mask"]) > 0).sum()))
+            seq_stats = getattr(self.engine, "last_seq_stats", None)
+            if seq_stats and "lineage_id" in mb:
+                _accumulate_lineage(
+                    lineage_acc, np.asarray(mb["lineage_id"]), seq_stats
+                )
             with stats_tracker.scope("ppo_actor"):
                 stats_tracker.get().scalar(**train_stat)
             all_stats.append(train_stat)
+        _export_learning_health(all_stats, mb_weights)
+        _commit_lineage(lineage_acc, consuming_version)
         return all_stats
 
 
@@ -384,7 +721,16 @@ class PPOCritic:
         # label-aligned targets: value at position t predicts return from t
         data["old_values"] = np.asarray(data.pop("values"), np.float32)
         data["target_values"] = np.asarray(data.pop("returns"), np.float32)
-        for key in ("rewards", "tot_rewards", "kl_rewards", "versions"):
+        # version_lag/lineage_id are actor-loss diagnostics — dead weight
+        # (and a pointless grid transfer for version_lag) in the critic
+        for key in (
+            "rewards",
+            "tot_rewards",
+            "kl_rewards",
+            "versions",
+            "version_lag",
+            "lineage_id",
+        ):
             data.pop(key, None)
         mb_list = split_padded_tensor_dict_into_mb_list(
             data, MicroBatchSpec(n_mbs=self.config.ppo_n_minibatches)

@@ -337,6 +337,7 @@ class PPOTrainer:
                 logger.exception("emergency recover dump failed")
         if self.journal is not None:
             self.journal.seal_active()
+        self._dump_lineage("preempt")
         self.preemption.note_drained(time.monotonic() - t0)
         self.preempted = True
         logger.warning(
@@ -347,6 +348,21 @@ class PPOTrainer:
     def _on_profile_signal(self, signum, frame) -> None:
         # flag-only (arealint SIG family): the step loop does the work
         self._profile_requested.set()
+
+    def _dump_lineage(self, reason: str) -> None:
+        """Persist the trajectory-lineage ring next to the flight-recorder
+        dumps (docs/observability.md "Learning-health observatory"):
+        tools/postmortem.py merges both into one incident trace, joining
+        generate -> journal -> consume -> update by trace id."""
+        from areal_tpu.observability import lineage as lineage_mod
+
+        ring = lineage_mod.get_lineage()
+        if not ring.recent(1):
+            return  # nothing recorded (e.g. SFT-style runs): no dump file
+        try:
+            ring.dump(lineage_mod.default_dump_path(reason), reason)
+        except OSError:
+            logger.exception("trajectory lineage dump failed")
 
     # -- step loop --------------------------------------------------------
     # arealint: hot-path — the RL step loop: every statement here runs once
@@ -616,6 +632,7 @@ class PPOTrainer:
             logger.exception("async checkpoint write failed during close")
         if self.journal is not None:
             self.journal.close()
+        self._dump_lineage("close")
         if self.autopilot is not None:
             self.autopilot.stop()
         if self.preemption is not None:

@@ -30,6 +30,7 @@ _NON_TOKEN_KEYS = (
     "task_ids",
     "begin_of_trajectory",
     "seq_no_eos_mask",
+    "lineage_id",
     "pixel_values",
     "pixel_counts",
     "pixel_pos_ids",

@@ -36,6 +36,10 @@ class Grid:
     row_of_seq: list[int]
     col_of_seq: list[int]
     seq_lens: list[int]
+    # grid-local sequence order -> index in the ENGINE's input batch (set
+    # by JaxTrainEngine._make_grids; ``seq_index`` only points into the
+    # dict pack_grid was handed, which may be a re-packed sub-batch)
+    source_index: list[int] | None = None
 
     @property
     def segment_ids(self) -> np.ndarray:

@@ -592,7 +592,11 @@ def phase_train():
 
     rng = np.random.default_rng(0)
     trajs = []
-    for _ in range(6):
+    # synthetic per-trajectory version lags spanning every learning-health
+    # bucket (0/1/2/4+): detail.train then reports clip/behave-KL by lag
+    # bucket from the same measured steps
+    lag_cycle = (0, 1, 3, 5, 0, 2)
+    for i in range(6):
         n = int(rng.integers(1500, 2048))
         trajs.append(
             {
@@ -602,21 +606,40 @@ def phase_train():
                 ),
                 "old_logprobs": rng.normal(-1.5, 0.1, n).astype(np.float32),
                 "advantages": rng.normal(0, 1, n).astype(np.float32),
+                "version_lag": np.full(n, lag_cycle[i], np.int32),
             }
+        )
+        # decoupled-loss inputs: prox drifts from behave with the lag, so
+        # the bucketed behave-KL/cap stats measure a realistic gradient
+        trajs[-1]["prox_logprobs"] = (
+            trajs[-1]["old_logprobs"]
+            + rng.normal(0, 0.02 * (1 + lag_cycle[i]), n).astype(np.float32)
         )
     batch = pad_sequences_to_tensors(trajs)
     n_tokens = int(np.asarray(batch["attention_mask"]).sum())
+
+    from areal_tpu.trainer.ppo import _finalize_lag_stats, _lag_bucket_stats
 
     def grpo_loss(outputs, b):
         lm = (b["label_valid"] & (b["loss_mask"] > 0)).astype(jnp.float32)
         loss, stats = F.ppo_actor_loss_fn(
             logprobs=outputs["logprobs"],
-            proximal_logprobs=b["old_logprobs"],
+            proximal_logprobs=b["prox_logprobs"],
             old_logprobs=b["old_logprobs"],
             advantages=b["advantages"],
             loss_mask=lm,
+            behave_imp_weight_cap=5.0,
         )
-        return loss, {}
+        out = {
+            "clip_ratio": stats["clip_mask"].astype(jnp.float32).sum()
+            / jnp.maximum(lm.sum(), 1.0)
+        }
+        out.update(
+            _lag_bucket_stats(
+                b["version_lag"], lm, jnp.maximum(lm.sum(), 1.0), stats
+            )
+        )
+        return loss, out
 
     def weight_fn(d):
         return float((np.asarray(d["loss_mask"]) > 0).sum())
@@ -632,9 +655,14 @@ def phase_train():
     rec = step_timeline.StepTimelineRecorder()
     n_steps = 3
     t0 = time.monotonic()
+    step_stats = []
     for i in range(n_steps):
         tl = rec.start(i)
-        eng.train_batch(batch, grpo_loss, weight_fn)
+        # finalize like PPOActor.ppo_update: the engine returns fold-safe
+        # *_frac keys; the documented ratios are derived after the fold
+        step_stats.append(
+            _finalize_lag_stats(eng.train_batch(batch, grpo_loss, weight_fn))
+        )
         rec.complete(tl)
     dt = time.monotonic() - t0
     import jax
@@ -657,12 +685,34 @@ def phase_train():
         / max(1, len(recent)),
         4,
     )
+    # learning-health scoreboard rows: mean clip/behave-|KL|/cap-hit by lag
+    # bucket over the measured steps (docs/observability.md taxonomy)
+    from areal_tpu.infra.staleness_manager import LAG_BUCKET_LABELS
+
+    by_lag_bucket = {}
+    for label in LAG_BUCKET_LABELS:
+        if not any(f"lag_{label}/token_share" in s for s in step_stats):
+            continue
+        by_lag_bucket[label] = {
+            k: round(
+                sum(s.get(f"lag_{label}/{k}", 0.0) for s in step_stats)
+                / len(step_stats),
+                5,
+            )
+            for k in (
+                "clip_ratio",
+                "behave_abs_kl",
+                "cap_hit_share",
+                "token_share",
+            )
+        }
     _emit_phase(
         {
             "phase": "train",
             "tok_s": n_tokens * n_steps / dt,
             "mfu": mfu,
             "bubble_fraction": bubble,
+            "by_lag_bucket": by_lag_bucket,
         }
     )
     try:
@@ -1215,6 +1265,10 @@ def main():
                 "mfu": t.get("mfu"),
                 "tok_s_per_chip": round(train_tok_s / train_chips, 1),
                 "bubble_fraction": t.get("bubble_fraction"),
+                # learning-health rows (clip_ratio / behave_abs_kl /
+                # cap_hit_share / token_share per lag bucket); cached
+                # pre-observatory payloads fold None, never a missing key
+                "by_lag_bucket": t.get("by_lag_bucket"),
             }
         a = resolve("async_sync", spawn_in_window("async_sync") if live else None)
         if a is not None:

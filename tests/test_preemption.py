@@ -92,11 +92,12 @@ def test_journal_replay_policy(tmp_path):
     j.close()
 
     j2 = TrajectoryJournal(str(tmp_path), fsync=False)
-    replayable, n_stale, n_consumed = j2.pending_for_replay(
+    replayable, dropped_stale, n_consumed = j2.pending_for_replay(
         restored_version=5, max_staleness=2
     )
     assert {e.task_id for e in replayable} == {"lost_step", "pending"}
-    assert n_stale == 1  # too_stale: 5 - 0 > 2
+    # too_stale: 5 - 0 > 2 — returned as an entry for the flight audit
+    assert [e.task_id for e in dropped_stale] == ["too_stale"]
     assert n_consumed == 1  # old_consumed: durable inside the checkpoint
 
 
@@ -245,9 +246,9 @@ def _executor(tmp_path, version=0, journal=True):
 
 def test_executor_journal_append_consume_replay(tmp_path):
     ex = _executor(tmp_path, version=3)
-    ex._journal_append(_traj(3), "keep", 16)
-    ex._journal_append(_traj(3), "eaten", 16)
-    ex._journal_consumed(["eaten"])  # consumed at version 3
+    ex._journal_append(_traj(3), "keep", 16, 3, 3)
+    ex._journal_append(_traj(3), "eaten", 16, 3, 3)
+    ex._mark_consumed(["eaten"])  # consumed at version 3
     ex.journal.close()
 
     # relaunch at restored version 3: "eaten" was consumed by the step
