@@ -296,6 +296,11 @@ class ProjectContext:
         # for sources outside the package (fixtures, repo scripts)
         self._package_graph = None
         self._file_graphs: dict[str, object] = {}
+        # lazy wire contract (wirecontract.py): the package's HTTP route
+        # tables + handler schemas, shared by every WIRE check; files
+        # outside the package get a self-contained single-file contract
+        self._package_wire = None
+        self._file_wire: dict[str, object] = {}
         self._build_config_registry()
         self._build_metric_catalog()
         self._build_mesh_axes()
@@ -430,6 +435,36 @@ class ProjectContext:
             self._file_graphs[sf.relpath] = g
         return g
 
+    def wire_for(self, sf: "SourceFile"):
+        """The wire contract covering ``sf``: the shared package contract
+        for package files (built once from every server module), a
+        single-file contract otherwise (fixtures are self-contained
+        client+server pairs). Mirrors :meth:`graph_for`'s caching."""
+        from areal_tpu.analysis import wirecontract
+
+        try:
+            sf.path.resolve().relative_to(self.package_root.resolve())
+            in_package = True
+        except ValueError:
+            in_package = False
+        if in_package:
+            if self._package_wire is None:
+                # reuse the call graph's parsed modules when a dataflow
+                # rule already built it (the default full run)
+                g = self._package_graph
+                self._package_wire = wirecontract.build_package_contract(
+                    self.package_root,
+                    modules=g.modules.values() if g is not None else None,
+                )
+            return self._package_wire
+        c = self._file_wire.get(sf.relpath)
+        if c is None:
+            c = wirecontract.build_contract(
+                [(sf.relpath, sf.text, sf.tree)]
+            )
+            self._file_wire[sf.relpath] = c
+        return c
+
 
 # ---------------------------------------------------------------------------
 # Engine
@@ -488,7 +523,8 @@ class Analyzer:
         self.context = ProjectContext(package_root or default_package_root())
         self.checkers = all_checkers()
         if rules:
-            wanted = {r.strip() for r in rules if r.strip()}
+            # case-insensitive selection: `--rules wire,lck` == `WIRE,LCK`
+            wanted = {r.strip().upper() for r in rules if r.strip()}
             known = {c.FAMILY for c in self.checkers} | {
                 r for c in self.checkers for r in c.RULES
             }

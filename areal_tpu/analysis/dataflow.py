@@ -986,7 +986,12 @@ def device_attrs_of_class(mod: ModuleInfo, cls: str) -> set[str]:
 # ---------------------------------------------------------------------------
 
 
-def build_package_graph(package_root: Path) -> PackageGraph:
+def iter_package_sources(
+    package_root: Path,
+) -> list[tuple[str, str, ast.Module]]:
+    """(relpath, text, tree) for every parseable package module — the ONE
+    enumeration both the call graph and the wire contract build from, so
+    a filter change cannot silently apply to one and not the other."""
     sources: list[tuple[str, str, ast.Module]] = []
     repo_root = package_root.parent
     for path in sorted(package_root.rglob("*.py")):
@@ -1002,7 +1007,11 @@ def build_package_graph(package_root: Path) -> PackageGraph:
         except ValueError:
             rel = path.as_posix()
         sources.append((rel, text, tree))
-    return PackageGraph.build(sources)
+    return sources
+
+
+def build_package_graph(package_root: Path) -> PackageGraph:
+    return PackageGraph.build(iter_package_sources(package_root))
 
 
 def single_file_graph(relpath: str, text: str, tree: ast.Module) -> PackageGraph:

@@ -13,12 +13,14 @@ def all_checkers() -> list:
     from areal_tpu.analysis.rules.don import DonationChecker
     from areal_tpu.analysis.rules.exc import SilentExceptionChecker
     from areal_tpu.analysis.rules.jaxpurity import JaxPurityChecker
+    from areal_tpu.analysis.rules.lck import LockOrderChecker
     from areal_tpu.analysis.rules.obs import MetricCatalogChecker
     from areal_tpu.analysis.rules.prf import HotPathSyncChecker
     from areal_tpu.analysis.rules.rcp import RecompileRiskChecker
     from areal_tpu.analysis.rules.shd import ShardingSpecChecker
     from areal_tpu.analysis.rules.sig import SignalSafetyChecker
     from areal_tpu.analysis.rules.thr import SharedStateChecker
+    from areal_tpu.analysis.rules.wire import WireContractChecker
 
     return [
         AsyncSafetyChecker(),
@@ -32,4 +34,6 @@ def all_checkers() -> list:
         DonationChecker(),
         ShardingSpecChecker(),
         RecompileRiskChecker(),
+        WireContractChecker(),
+        LockOrderChecker(),
     ]

@@ -1,0 +1,491 @@
+"""LCK — lock & fence ordering in the threaded runtime.
+
+Every long-lived component owns threads (decode loop, snapshot poller,
+autopilot, fleet probe, supervisor) and a small set of
+``threading.Lock``/``Condition``/``Event`` objects coordinating them.
+The failure modes are classic and none of them raise: an A->B / B->A
+acquisition-order cycle deadlocks only under the right interleaving, a
+``Condition.wait`` outside a while-predicate loop drops wakeups on
+spurious signals, a blocking call under a shared lock stalls every
+other path that needs it (the decode loop included), and a state event
+flipped outside its owning lock tears the check-then-act it guards.
+
+  LCK001  inconsistent pairwise lock order: lock B acquired while A is
+          held in one place and A while B is held in another (cycle in
+          the class's acquisition-order graph, self-calls followed)
+  LCK002  ``Condition.wait`` outside a ``while``-predicate loop —
+          spurious wakeups and stolen predicates are real; ``if`` is
+          not a retry
+  LCK003  blocking call (HTTP transport, ``queue.get()`` without
+          timeout, ``Event.wait()`` without timeout, ``urlopen``) while
+          holding a lock that other methods of the class also take —
+          every one of them stalls for the full wait
+  LCK004  ``Event.set()``/``.clear()`` outside the lock that guards it
+          at its other call sites (the hold/drain/stage state machines
+          establish an owning lock; a bare flip tears their transitions)
+
+Lock identity is constructor-resolved (``self._x = threading.Lock()``;
+``Condition``/``RLock``/``Event`` tracked by kind) plus module-level
+lock assignments; unknown receivers never fire.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator
+
+from areal_tpu.analysis import wirecontract as _wc
+from areal_tpu.analysis.core import (
+    Finding,
+    ProjectContext,
+    SourceFile,
+    dotted_name,
+    make_key,
+)
+
+_CTOR_KINDS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "lock",
+    "Lock": "lock",
+    "RLock": "lock",
+    "threading.Condition": "condition",
+    "Condition": "condition",
+    "threading.Event": "event",
+    "Event": "event",
+}
+
+_QUEUEISH = ("queue", "_q", "backlog", "inbox")
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+@dataclasses.dataclass
+class _MethodFacts:
+    """Per-method lock facts gathered in one pass."""
+
+    name: str
+    node: ast.AST
+    # (acquired lock, locks already held, site node)
+    acquisitions: list[tuple[str, frozenset, ast.AST]] = dataclasses.field(
+        default_factory=list
+    )
+    # self-method calls: (callee name, locks held, site node)
+    self_calls: list[tuple[str, frozenset, ast.AST]] = dataclasses.field(
+        default_factory=list
+    )
+    # blocking sites: (description, locks held, site node)
+    blocking: list[tuple[str, frozenset, ast.AST]] = dataclasses.field(
+        default_factory=list
+    )
+    # event transitions: (event attr, op, locks held, site node)
+    event_ops: list[tuple[str, str, frozenset, ast.AST]] = dataclasses.field(
+        default_factory=list
+    )
+    # condition waits: (cond attr, inside-while?, site node)
+    cond_waits: list[tuple[str, bool, ast.AST]] = dataclasses.field(
+        default_factory=list
+    )
+
+
+class LockOrderChecker:
+    FAMILY = "LCK"
+    RULES = {
+        "LCK001": "inconsistent pairwise lock acquisition order",
+        "LCK002": "Condition.wait outside a while-predicate loop",
+        "LCK003": "blocking call while holding a shared lock",
+        "LCK004": "event/state transition outside its owning lock",
+    }
+
+    def check(self, sf: SourceFile, ctx: ProjectContext) -> Iterator[Finding]:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(sf, node)
+        yield from self._check_module_level(sf)
+
+    # -- lock discovery ----------------------------------------------------
+    @staticmethod
+    def _attr_kinds(cls: ast.ClassDef) -> dict[str, str]:
+        """self.<attr> -> "lock" | "condition" | "event" (ctor-resolved;
+        attrs with mixed assignments keep the first kind seen)."""
+        kinds: dict[str, str] = {}
+        for node in ast.walk(cls):
+            if not (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+            ):
+                continue
+            kind = _CTOR_KINDS.get(dotted_name(node.value.func) or "")
+            if kind is None:
+                continue
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr:
+                    kinds.setdefault(attr, kind)
+        return kinds
+
+    # -- per-method fact gathering ------------------------------------------
+    def _gather(
+        self, sf: SourceFile, meth: ast.FunctionDef, kinds: dict[str, str]
+    ) -> _MethodFacts:
+        facts = _MethodFacts(name=meth.name, node=meth)
+        lockish = {
+            a for a, k in kinds.items() if k in ("lock", "condition")
+        }
+        cond_attrs = {a for a, k in kinds.items() if k == "condition"}
+        event_attrs = {a for a, k in kinds.items() if k == "event"}
+
+        def walk(node: ast.AST, held: frozenset, in_while: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue  # nested defs run on their own schedule
+                child_held = held
+                child_in_while = in_while or isinstance(node, ast.While)
+                if isinstance(child, ast.withitem):
+                    attr = _self_attr(child.context_expr)
+                    if attr in lockish:
+                        # in the single-statement `with self._a, self._b:`
+                        # form the i-th item is acquired with the earlier
+                        # items already held — record them, or the a->b
+                        # edge is lost and LCK001 misses the idiomatic
+                        # two-lock inversion
+                        item_held = held
+                        if isinstance(node, (ast.With, ast.AsyncWith)):
+                            for prev in node.items:
+                                if prev is child:
+                                    break
+                                pa = _self_attr(prev.context_expr)
+                                if pa in lockish:
+                                    item_held = item_held | {pa}
+                        facts.acquisitions.append(
+                            (attr, item_held, child.context_expr)
+                        )
+                if isinstance(node, (ast.With, ast.AsyncWith)) and child in node.body:
+                    for item in node.items:
+                        attr = _self_attr(item.context_expr)
+                        if attr in lockish:
+                            child_held = child_held | {attr}
+                if isinstance(child, ast.Call):
+                    self._gather_call(
+                        child,
+                        child_held,
+                        child_in_while,
+                        facts,
+                        lockish,
+                        cond_attrs,
+                        event_attrs,
+                    )
+                walk(child, child_held, child_in_while)
+
+        walk(meth, frozenset(), False)
+        return facts
+
+    def _gather_call(
+        self,
+        call: ast.Call,
+        held: frozenset,
+        in_while: bool,
+        facts: _MethodFacts,
+        lockish: set[str],
+        cond_attrs: set[str],
+        event_attrs: set[str],
+    ) -> None:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            recv_attr = _self_attr(f.value)
+            # self.method(...) call edges
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                facts.self_calls.append((f.attr, held, call))
+            # condition waits
+            if f.attr == "wait" and recv_attr in cond_attrs:
+                facts.cond_waits.append((recv_attr, in_while, call))
+                return
+            # event transitions
+            if f.attr in ("set", "clear") and recv_attr in event_attrs:
+                facts.event_ops.append((recv_attr, f.attr, held, call))
+                return
+            # blocking: Event.wait() with no timeout
+            if (
+                f.attr == "wait"
+                and recv_attr in event_attrs
+                and not call.args
+                and not any(k.arg == "timeout" for k in call.keywords)
+            ):
+                facts.blocking.append(
+                    (f"`self.{recv_attr}.wait()` without timeout", held, call)
+                )
+                return
+            # blocking: queue.get() with no timeout
+            if (
+                f.attr == "get"
+                and not call.args
+                and not any(k.arg == "timeout" for k in call.keywords)
+            ):
+                base = f.value
+                base_name = (
+                    base.attr
+                    if isinstance(base, ast.Attribute)
+                    else (base.id if isinstance(base, ast.Name) else "")
+                )
+                if any(h in base_name.lower() for h in _QUEUEISH):
+                    facts.blocking.append(
+                        (f"`{base_name}.get()` without timeout", held, call)
+                    )
+                    return
+        # blocking: HTTP transport shapes (urlopen / _post_json* with a
+        # literal "/"-path arg — a bare `.get("key")` dict read is not one)
+        name = None
+        if isinstance(f, ast.Attribute):
+            name = f.attr
+        elif isinstance(f, ast.Name):
+            name = f.id
+        if name == "urlopen" or (
+            _wc.is_transport_call(call) and _wc.call_path(call) is not None
+        ):
+            facts.blocking.append((f"HTTP call `{name}(...)`", held, call))
+
+    # -- class analysis ------------------------------------------------------
+    def _check_class(
+        self, sf: SourceFile, cls: ast.ClassDef
+    ) -> Iterator[Finding]:
+        kinds = self._attr_kinds(cls)
+        if not kinds:
+            return
+        methods = [
+            n for n in cls.body if isinstance(n, ast.FunctionDef)
+        ]
+        facts = {
+            m.name: self._gather(sf, m, kinds) for m in methods
+        }
+
+        # locks shared across methods (with-acquired in >= 2 methods)
+        acquire_methods: dict[str, set[str]] = {}
+        for name, fa in facts.items():
+            for lock, _, _ in fa.acquisitions:
+                acquire_methods.setdefault(lock, set()).add(name)
+        shared_locks = {
+            lk for lk, ms in acquire_methods.items() if len(ms) >= 2
+        }
+
+        # transitive closures over self-calls: locks a method may acquire
+        # and blocking sites it may reach
+        def closure(fa: _MethodFacts, seen: frozenset):
+            acquires = {lk for lk, _, _ in fa.acquisitions}
+            blocks = list(fa.blocking)
+            for callee, _, _ in fa.self_calls:
+                if callee in seen or callee not in facts:
+                    continue
+                sub_a, sub_b = closure(facts[callee], seen | {callee})
+                acquires |= sub_a
+                blocks.extend(sub_b)
+            return acquires, blocks
+
+        closures = {
+            name: closure(fa, frozenset({name})) for name, fa in facts.items()
+        }
+
+        # -- LCK001: acquisition-order graph + pairwise cycles ------------
+        edges: dict[tuple[str, str], tuple[ast.AST, str]] = {}
+        for name, fa in facts.items():
+            for lock, held, site in fa.acquisitions:
+                for h in held:
+                    if h != lock:
+                        edges.setdefault((h, lock), (site, name))
+            for callee, held, site in fa.self_calls:
+                if not held or callee not in facts:
+                    continue
+                callee_acquires = closures[callee][0]
+                for h in held:
+                    for lk in callee_acquires:
+                        if lk != h:
+                            edges.setdefault((h, lk), (site, name))
+        reported_pairs: set[frozenset] = set()
+        for (a, b), (site, name) in sorted(
+            edges.items(), key=lambda kv: kv[1][0].lineno
+        ):
+            if (b, a) not in edges:
+                continue
+            pair = frozenset((a, b))
+            if pair in reported_pairs:
+                continue
+            reported_pairs.add(pair)
+            other_site, other_name = edges[(b, a)]
+            yield Finding(
+                rule="LCK001",
+                path=sf.relpath,
+                line=site.lineno,
+                message=(
+                    f"inconsistent lock order on `{cls.name}`: "
+                    f"`{a}` -> `{b}` here (in `{name}`) but "
+                    f"`{b}` -> `{a}` at line {other_site.lineno} "
+                    f"(in `{other_name}`) — two threads taking opposite "
+                    "orders deadlock; pick one order and hoist"
+                ),
+                key=make_key(
+                    "LCK001",
+                    sf.relpath,
+                    cls.name,
+                    "<->".join(sorted((a, b))),
+                ),
+            )
+
+        # -- LCK002: Condition.wait outside while ---------------------------
+        for name, fa in facts.items():
+            for attr, in_while, site in fa.cond_waits:
+                if in_while:
+                    continue
+                yield Finding(
+                    rule="LCK002",
+                    path=sf.relpath,
+                    line=site.lineno,
+                    message=(
+                        f"`self.{attr}.wait()` in `{cls.name}.{name}` is "
+                        "not inside a `while`-predicate loop: spurious "
+                        "wakeups and stolen predicates make a bare wait "
+                        "(or `if`-guarded wait) return with the condition "
+                        "still false"
+                    ),
+                    key=make_key(
+                        "LCK002", sf.relpath, cls.name, f"{name}:{attr}"
+                    ),
+                )
+
+        # -- LCK003: blocking while holding a shared lock -------------------
+        seen_blk: set[str] = set()
+        for name, fa in facts.items():
+            sites = list(fa.blocking)
+            # one-hop: self-calls made while holding a lock, into methods
+            # whose closure blocks
+            for callee, held, site in fa.self_calls:
+                if not held or callee not in facts:
+                    continue
+                for what, _, _ in closures[callee][1]:
+                    sites.append(
+                        (f"{what} via `self.{callee}()`", held, site)
+                    )
+            for what, held, site in sites:
+                locks = sorted(h for h in held if h in shared_locks)
+                if not locks:
+                    continue
+                token = f"{name}:{locks[0]}:{site.lineno}"
+                if token in seen_blk:
+                    continue
+                seen_blk.add(token)
+                yield Finding(
+                    rule="LCK003",
+                    path=sf.relpath,
+                    line=site.lineno,
+                    message=(
+                        f"{what} in `{cls.name}.{name}` while holding "
+                        f"`{locks[0]}`, which other methods of the class "
+                        "also take — every one of them stalls for the "
+                        "full wait; move the call outside the lock"
+                    ),
+                    key=make_key(
+                        "LCK003",
+                        sf.relpath,
+                        cls.name,
+                        f"{name}:{locks[0]}",
+                    ),
+                )
+
+        # -- LCK004: event transitions outside their owning lock ------------
+        by_event: dict[str, list[tuple[str, str, frozenset, ast.AST]]] = {}
+        for name, fa in facts.items():
+            for attr, op, held, site in fa.event_ops:
+                by_event.setdefault(attr, []).append((name, op, held, site))
+        for attr, ops in by_event.items():
+            # candidate owning locks: held at >= 2 transition sites AND at
+            # a strict majority — a convention, not a coincidence
+            lock_counts: dict[str, int] = {}
+            for _, _, held, _ in ops:
+                for h in held:
+                    lock_counts[h] = lock_counts.get(h, 0) + 1
+            for lock, n in sorted(lock_counts.items()):
+                if n < 2 or n <= len(ops) - n:
+                    continue
+                for name, op, held, site in ops:
+                    if lock in held:
+                        continue
+                    yield Finding(
+                        rule="LCK004",
+                        path=sf.relpath,
+                        line=site.lineno,
+                        message=(
+                            f"`self.{attr}.{op}()` in `{cls.name}.{name}` "
+                            f"outside `{lock}`, which guards this event's "
+                            f"other {n} transition(s) — an unguarded flip "
+                            "tears the state machine's check-then-act"
+                        ),
+                        key=make_key(
+                            "LCK004",
+                            sf.relpath,
+                            cls.name,
+                            f"{attr}:{name}",
+                        ),
+                    )
+
+    # -- module-level functions with module-level locks ---------------------
+    def _check_module_level(self, sf: SourceFile) -> Iterator[Finding]:
+        """Minimal module-scope coverage: Condition.wait-outside-while on
+        module-level Condition objects (class analysis covers the rest)."""
+        kinds: dict[str, str] = {}
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                kind = _CTOR_KINDS.get(dotted_name(node.value.func) or "")
+                if kind is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        kinds.setdefault(t.id, kind)
+        conds = {n for n, k in kinds.items() if k == "condition"}
+        if not conds:
+            return
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "wait"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in conds
+            ):
+                continue
+            cur = sf.parents.get(id(node))
+            in_while = False
+            while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)
+            ):
+                if isinstance(cur, ast.While):
+                    in_while = True
+                    break
+                cur = sf.parents.get(id(cur))
+            if in_while:
+                continue
+            yield Finding(
+                rule="LCK002",
+                path=sf.relpath,
+                line=node.lineno,
+                message=(
+                    f"`{node.func.value.id}.wait()` is not inside a "
+                    "`while`-predicate loop: spurious wakeups return "
+                    "with the condition still false"
+                ),
+                key=make_key(
+                    "LCK002",
+                    sf.relpath,
+                    sf.scope_of(node),
+                    node.func.value.id,
+                ),
+            )

@@ -1,0 +1,393 @@
+"""WIRE — wire-contract drift across the HTTP-coupled control plane.
+
+The fleet's processes talk over a small HTTP protocol whose two sides
+live in different files (often different processes owned by different
+subsystems). Nothing type-checks the contract: a client posting a body
+key no handler reads, a dashboard consuming a /statusz key no server
+emits, or an error body returned with a success status all fail
+*silently* — the review-hardening lists of PRs 4, 6, 8, 12 and 13 are
+full of exactly these. The WIRE family checks both sides against the
+extracted contract (analysis/wirecontract.py):
+
+  WIRE001  client call to a path no server registers (dead endpoint or
+           typo'd route)
+  WIRE002  body-key drift: a client sends a JSON key no handler of the
+           path reads, or omits a key every handler requires
+           (subscript-accessed with no default)
+  WIRE003  response-key drift: a consumer reads a key of a parsed
+           response document that no handler of the path emits
+           (``# arealint: wire-doc=<path>`` marks cross-function
+           consumers like ReplicaSnapshot.from_statusz)
+  WIRE004  status-code drift: an error-shaped response body returned
+           with a success status (bare ``raise_for_status`` checks
+           swallow it), or a client comparing against a status code no
+           handler in the package ever returns
+  WIRE005  ``x-areal-*`` header literal outside ``api/wire.py`` — the
+           producer/consumer constants module WIRE005 exists to enforce
+
+Like the dataflow families, unknown is SILENT: an unresolvable path,
+a non-literal body, or an open handler schema (body/response escapes
+into unresolvable code) never fires.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from areal_tpu.analysis import wirecontract as wc
+from areal_tpu.analysis.core import (
+    Finding,
+    ProjectContext,
+    SourceFile,
+    make_key,
+)
+
+_HEADER_LITERAL_RE = re.compile(r"^x-areal-[a-z0-9-]+$", re.IGNORECASE)
+
+# body keys that ride every areal JSON post via shared plumbing (none
+# today; kept as the one extension point for envelope keys)
+_ENVELOPE_KEYS: frozenset[str] = frozenset()
+
+
+class WireContractChecker:
+    FAMILY = "WIRE"
+    RULES = {
+        "WIRE001": "client call to a path no server registers",
+        "WIRE002": "request body key drift between client and handler",
+        "WIRE003": "response key consumed that no handler emits",
+        "WIRE004": "status-code drift (swallowed error / dead status check)",
+        "WIRE005": "x-areal-* header literal outside api/wire.py",
+    }
+
+    def check(self, sf: SourceFile, ctx: ProjectContext) -> Iterator[Finding]:
+        contract = ctx.wire_for(sf)
+        mod = contract.modules.get(sf.relpath) or wc.ModuleInfo(
+            sf.relpath, sf.text, sf.tree
+        )
+        yield from self._check_header_literals(sf, ctx)
+        yield from self._check_server_side(sf, mod)
+        if contract.has_routes:
+            yield from self._check_client_side(sf, mod, contract)
+            yield from self._check_marked_docs(sf, mod, contract)
+
+    # -- WIRE005: header literals ------------------------------------------
+    def _check_header_literals(
+        self, sf: SourceFile, ctx: ProjectContext
+    ) -> Iterator[Finding]:
+        if sf.relpath.endswith("api/wire.py"):
+            return
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Constant) and isinstance(node.value, str)
+            ):
+                continue
+            if not _HEADER_LITERAL_RE.match(node.value):
+                continue
+            yield Finding(
+                rule="WIRE005",
+                path=sf.relpath,
+                line=node.lineno,
+                message=(
+                    f"header literal `{node.value}` bypasses the shared "
+                    "constants module; import it from areal_tpu.api.wire "
+                    "so producer and consumer cannot drift"
+                ),
+                key=make_key(
+                    "WIRE005", sf.relpath, sf.scope_of(node), node.value.lower()
+                ),
+            )
+
+    # -- WIRE004a: server-side swallowed errors ----------------------------
+    def _check_server_side(
+        self, sf: SourceFile, mod: wc.ModuleInfo
+    ) -> Iterator[Finding]:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = wc.transport_callee_name(node) or ""
+            if tail != "json_response":
+                continue
+            arg = node.args[0] if node.args else None
+            lit = wc._dict_literal_keys(arg) if arg is not None else None
+            if lit is None:
+                continue
+            keys, _ = lit
+            error_shaped = "error" in keys or self._status_error_value(arg)
+            if not error_shaped:
+                continue
+            status = 200
+            for kw in node.keywords:
+                if kw.arg == "status":
+                    if isinstance(kw.value, ast.Constant) and isinstance(
+                        kw.value.value, int
+                    ):
+                        status = kw.value.value
+                    else:
+                        status = -1  # dynamic: assume intentional
+            if 200 <= status < 400:
+                yield Finding(
+                    rule="WIRE004",
+                    path=sf.relpath,
+                    line=node.lineno,
+                    message=(
+                        "error-shaped response body returned with a success "
+                        "status: callers checking only "
+                        "`raise_for_status()` treat this failure as success "
+                        "— add `status=4xx/5xx`"
+                    ),
+                    key=make_key(
+                        "WIRE004",
+                        sf.relpath,
+                        sf.scope_of(node),
+                        "error_body_200",
+                    ),
+                )
+
+    @staticmethod
+    def _status_error_value(arg: ast.expr) -> bool:
+        """dict literal carrying "status": "error"."""
+        exprs = (
+            [arg.body, arg.orelse] if isinstance(arg, ast.IfExp) else [arg]
+        )
+        for e in exprs:
+            if not isinstance(e, ast.Dict):
+                continue
+            for k, v in zip(e.keys, e.values):
+                if (
+                    isinstance(k, ast.Constant)
+                    and k.value == "status"
+                    and isinstance(v, ast.Constant)
+                    and v.value == "error"
+                ):
+                    return True
+        return False
+
+    # -- client side: WIRE001/002/003 + WIRE004b ---------------------------
+    def _check_client_side(
+        self, sf: SourceFile, mod: wc.ModuleInfo, contract: wc.WireContract
+    ) -> Iterator[Finding]:
+        all_statuses = contract.all_statuses()
+        for fi in mod.funcs.values():
+            if isinstance(fi.node, ast.Lambda):
+                continue
+            calls = list(wc.iter_client_calls(fi.node))
+            for call in calls:
+                handlers = contract.for_path(call.path)
+                if not handlers:
+                    yield Finding(
+                        rule="WIRE001",
+                        path=sf.relpath,
+                        line=call.node.lineno,
+                        message=(
+                            f"client call to `{call.path}` but no server "
+                            "in the package registers that route — dead "
+                            "endpoint or typo'd path"
+                        ),
+                        key=make_key(
+                            "WIRE001", sf.relpath, fi.qualname, call.path
+                        ),
+                    )
+                    continue
+                if call.body_keys is not None and not call.body_splat:
+                    read, open_ = contract.body_reads(call.path)
+                    if not open_:
+                        for k in sorted(call.body_keys - read - _ENVELOPE_KEYS):
+                            yield Finding(
+                                rule="WIRE002",
+                                path=sf.relpath,
+                                line=call.node.lineno,
+                                message=(
+                                    f"body key `{k}` sent to `{call.path}` "
+                                    "but no handler of that path reads it "
+                                    "— silently dropped on the server"
+                                ),
+                                key=make_key(
+                                    "WIRE002",
+                                    sf.relpath,
+                                    fi.qualname,
+                                    f"{call.path}:{k}",
+                                ),
+                            )
+                    required = contract.body_required(call.path)
+                    for k in sorted(required - call.body_keys):
+                        yield Finding(
+                            rule="WIRE002",
+                            path=sf.relpath,
+                            line=call.node.lineno,
+                            message=(
+                                f"`{call.path}` handlers require body key "
+                                f"`{k}` (subscript access, no default) but "
+                                "this call omits it — the request 500s"
+                            ),
+                            key=make_key(
+                                "WIRE002",
+                                sf.relpath,
+                                fi.qualname,
+                                f"{call.path}:missing:{k}",
+                            ),
+                        )
+                if call.resp_var is not None:
+                    yield from self._check_doc_reads(
+                        sf,
+                        fi.qualname,
+                        fi.node,
+                        call.resp_var,
+                        call.path,
+                        contract,
+                        start=call.node.lineno,
+                    )
+            # WIRE004b: status-literal comparisons against codes nothing
+            # returns (only meaningful in functions that do wire traffic;
+            # silent when any handler's status= is dynamic — the package
+            # may then return any code)
+            if calls and all_statuses is not None:
+                yield from self._check_status_compares(
+                    sf, fi.qualname, fi.node, all_statuses
+                )
+
+    def _check_status_compares(
+        self, sf: SourceFile, qual: str, fn: ast.AST, statuses: set[int]
+    ) -> Iterator[Finding]:
+        for node in wc._own_nodes(fn):
+            if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+                continue
+            if not isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+                continue
+            sides = [node.left, node.comparators[0]]
+            code = None
+            is_status = False
+            for s in sides:
+                if isinstance(s, ast.Constant) and isinstance(s.value, int):
+                    code = s.value
+                elif isinstance(s, ast.Attribute) and s.attr in (
+                    "status",
+                    "status_code",
+                ):
+                    is_status = True
+            if not is_status or code is None or code < 400:
+                continue
+            if code in statuses:
+                continue
+            yield Finding(
+                rule="WIRE004",
+                path=sf.relpath,
+                line=node.lineno,
+                message=(
+                    f"status comparison against {code}, but no handler in "
+                    "the package returns it — dead error-handling branch "
+                    "(contract drift)"
+                ),
+                key=make_key(
+                    "WIRE004", sf.relpath, qual, f"status:{code}"
+                ),
+            )
+
+    # -- WIRE003 helpers ---------------------------------------------------
+    def _check_doc_reads(
+        self,
+        sf: SourceFile,
+        qual: str,
+        fn: ast.AST,
+        var: str,
+        path: str,
+        contract: wc.WireContract,
+        start: int = 0,
+    ) -> Iterator[Finding]:
+        emits, open_ = contract.resp_emits(path)
+        if open_ or not contract.for_path(path):
+            return
+        # only reads AFTER the binding and BEFORE the var's next rebind
+        # belong to this response — a local dict reusing the name earlier
+        # (or a later rebinding) is not the response document
+        end = None
+        for n in wc._own_nodes(fn):
+            if (
+                isinstance(n, ast.Assign)
+                and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and n.targets[0].id == var
+                and n.lineno > start
+            ):
+                end = n.lineno if end is None else min(end, n.lineno)
+        seen: set[str] = set()
+        for node in wc._own_nodes(fn):
+            ln = getattr(node, "lineno", None)
+            if ln is None or ln <= start or (end is not None and ln >= end):
+                continue
+            key = None
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == var
+                and node.args
+            ):
+                key = wc._const_key(node.args[0])
+            elif (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == var
+                and isinstance(node.ctx, ast.Load)
+            ):
+                key = wc._const_key(node.slice)
+            if key is None or key in emits or key in seen:
+                continue
+            seen.add(key)
+            yield Finding(
+                rule="WIRE003",
+                path=sf.relpath,
+                line=node.lineno,
+                message=(
+                    f"reads key `{key}` of the `{path}` response, but no "
+                    "handler of that path emits it — the consumer sees "
+                    "an always-absent field"
+                ),
+                key=make_key(
+                    "WIRE003", sf.relpath, qual, f"{path}:{key}"
+                ),
+            )
+
+    def _check_marked_docs(
+        self, sf: SourceFile, mod: wc.ModuleInfo, contract: wc.WireContract
+    ) -> Iterator[Finding]:
+        """``# arealint: wire-doc=<path>`` on (or directly above) a def:
+        its first non-self/cls parameter is a parsed response document of
+        that path."""
+        for fi in mod.funcs.values():
+            node = fi.node
+            if isinstance(node, ast.Lambda):
+                continue
+            first = node.lineno
+            if node.decorator_list:
+                first = min(
+                    first, min(d.lineno for d in node.decorator_list)
+                )
+            # decorator line .. def line (comments may sit between
+            # decorators and the def), plus the contiguous comment
+            # block directly above
+            lines = list(range(first, node.lineno + 1))
+            ln = first - 1
+            while ln in mod.comments:
+                lines.append(ln)
+                ln -= 1
+            path = param = None
+            for line in lines:
+                m = wc.WIRE_DOC_RE.search(mod.comments.get(line, ""))
+                if m:
+                    path, param = m.group(1), m.group(2)
+                    break
+            if path is None:
+                continue
+            params = [
+                a.arg for a in node.args.args if a.arg not in ("self", "cls")
+            ]
+            if param is None:
+                param = params[0] if params else None
+            if param is None:
+                continue
+            yield from self._check_doc_reads(
+                sf, fi.qualname, node, param, path, contract
+            )

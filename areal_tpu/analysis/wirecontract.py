@@ -1,0 +1,976 @@
+"""Wire-contract extraction for arealint's WIRE family.
+
+The control plane is a set of HTTP-coupled processes: aiohttp servers
+(inference server, rpc worker, proxy gateway, rollout proxy) and the
+clients that call them (inference client transport, /statusz scrapers,
+autopilot knob pushes, tools). The contract between them — which paths
+exist, which JSON body keys a handler reads, which response keys it
+emits, which status codes it returns — lives only in convention, and the
+repo's review history shows it drifting (legacy-body downgrades,
+``_hold_ack`` vs ``_pause_ack`` mixups, swallowed status codes).
+
+This module extracts both sides of that contract statically:
+
+- **Route tables**: every ``web.get/post(...)`` / ``app.router.add_*``
+  registration, with the handler resolved to its function (including the
+  gateway's ``for path in FORWARDED_PATHS`` idiom).
+- **Handler schemas**: per handler, the JSON body keys read
+  (``d.get(...)`` / ``d["..."]`` — subscript-only keys are *required*),
+  the response keys emitted by ``web.json_response`` dict literals
+  (including the ``out = {...}; out["k"] = v`` build-up idiom), and the
+  status codes returned (``status=`` kwargs + ``web.HTTPXxx`` raises).
+  One-hop resolution follows the body dict into same-module helpers
+  (``_req_from_json(d)``) and the response out of them.
+- **Client call sites**: calls through recognizably transport-shaped
+  callables (``_post_json*``, ``_get_json``, ``urlopen`` over an
+  ``http://.../path`` f-string, ...) with a resolvable literal path,
+  plus the dict-literal body they send and the variable their parsed
+  response lands in.
+
+Everything is *approximate by design*, tuned like the dataflow engine:
+a body that escapes into unresolvable code marks the schema **open**
+(reads/emits anything), a path that cannot be resolved to a literal is
+simply not recorded — precision errors become missed findings, never
+false alarms.
+
+Consumers outside the calling function can opt in with a marker comment
+on the def line (or the line above)::
+
+    # arealint: wire-doc=/statusz
+    def from_statusz(cls, addr, doc, ...):
+
+which declares the first non-self/cls parameter a parsed response
+document of that path, so its key reads check against the emitting
+handlers fleet-wide.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Iterable, Iterator
+
+from areal_tpu.analysis.dataflow import (
+    ModuleInfo,
+    dotted_name,
+    iter_package_sources,
+)
+
+# terminal callable names that look like an HTTP transport (the repo's
+# client layer: _post_json/_post_json_failover/_post_all/_get_json/
+# _send_json_once/urlopen/http_json/...)
+TRANSPORT_RE = re.compile(
+    r"(?:^|_)(?:a?post|get|put|send|fetch|scrape|urlopen|http_json)(?:$|_)",
+    re.IGNORECASE,
+)
+
+# tokens that by themselves mark a callable as HTTP-shaped; names matching
+# only the generic verbs above (get/put/send/fetch) also name filesystem
+# and name-resolve helpers (get_subtree("/rollout/servers")) and need an
+# http URL argument as corroboration
+_STRONG_TRANSPORT_RE = re.compile(
+    r"(?:^|_)(?:a?post|urlopen|http|json|scrape)(?:$|_)", re.IGNORECASE
+)
+
+_HTTP_VERBS = {"get", "post", "put", "delete", "patch", "head"}
+
+# aiohttp's raise-able response classes -> status code
+_HTTP_EXC_STATUS = {
+    "HTTPBadRequest": 400,
+    "HTTPUnauthorized": 401,
+    "HTTPForbidden": 403,
+    "HTTPNotFound": 404,
+    "HTTPConflict": 409,
+    "HTTPGone": 410,
+    "HTTPRequestTimeout": 408,
+    "HTTPTooManyRequests": 429,
+    "HTTPInternalServerError": 500,
+    "HTTPNotImplemented": 501,
+    "HTTPServiceUnavailable": 503,
+}
+
+WIRE_DOC_RE = re.compile(r"arealint:\s*wire-doc=(\S+)(?:\s+(\w+))?")
+
+# functions a raw request body may flow into without "escaping" the
+# handler (still ends up as the parsed-json value we track)
+_JSON_PARSERS = {"loads"}
+
+
+@dataclasses.dataclass
+class HandlerSchema:
+    """One (path, handler) registration with its extracted contract."""
+
+    path: str
+    method: str  # "GET" / "POST" / ...
+    relpath: str
+    line: int
+    qualname: str
+    body_keys: set[str] = dataclasses.field(default_factory=set)
+    body_required: set[str] = dataclasses.field(default_factory=set)
+    body_open: bool = False
+    resp_keys: set[str] = dataclasses.field(default_factory=set)
+    resp_open: bool = False
+    statuses: set[int] = dataclasses.field(default_factory=set)
+    # a handler passing a non-literal ``status=`` may return ANY code
+    statuses_open: bool = False
+
+
+@dataclasses.dataclass
+class WireContract:
+    """The union contract over every server module analyzed."""
+
+    handlers: dict[str, list[HandlerSchema]] = dataclasses.field(
+        default_factory=dict
+    )
+    # relpath -> the ModuleInfo the contract was built from, retained so
+    # per-file checkers reuse it instead of re-walking the AST
+    modules: dict[str, ModuleInfo] = dataclasses.field(default_factory=dict)
+
+    @property
+    def has_routes(self) -> bool:
+        return bool(self.handlers)
+
+    def paths(self) -> set[str]:
+        return set(self.handlers)
+
+    def for_path(self, path: str) -> list[HandlerSchema]:
+        return self.handlers.get(path, [])
+
+    def body_reads(self, path: str) -> tuple[set[str], bool]:
+        """(union of keys any handler reads, any-handler-open)."""
+        keys: set[str] = set()
+        open_ = False
+        for h in self.for_path(path):
+            keys |= h.body_keys
+            open_ = open_ or h.body_open
+        return keys, open_
+
+    def body_required(self, path: str) -> set[str]:
+        """Keys EVERY handler of the path requires (subscript access with
+        no defaulted read anywhere) — the safe definition across servers
+        that share a path."""
+        hs = [h for h in self.for_path(path) if not h.body_open]
+        if not hs or len(hs) != len(self.for_path(path)):
+            return set()
+        req = set(hs[0].body_required)
+        for h in hs[1:]:
+            req &= h.body_required
+        return req
+
+    def resp_emits(self, path: str) -> tuple[set[str], bool]:
+        keys: set[str] = set()
+        open_ = False
+        for h in self.for_path(path):
+            keys |= h.resp_keys
+            open_ = open_ or h.resp_open
+        return keys, open_
+
+    def all_statuses(self) -> set[int] | None:
+        """Every status code any handler returns, or None when some
+        handler's ``status=`` is dynamic — the package may then return
+        any code and dead-status checks must stay silent."""
+        out = {200}
+        for hs in self.handlers.values():
+            for h in hs:
+                if h.statuses_open:
+                    return None
+                out |= h.statuses
+        return out
+
+
+# ---------------------------------------------------------------------------
+# registration discovery
+# ---------------------------------------------------------------------------
+
+
+def _module_const(mod: ModuleInfo, name: str) -> ast.expr | None:
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return node.value
+    return None
+
+
+def _paths_of(mod: ModuleInfo, expr: ast.expr, at: ast.AST) -> list[str]:
+    """Resolve a route-path expression to literal path(s): a string
+    constant, a module-level string constant, or a loop variable over a
+    module-level tuple/list of strings (the gateway FORWARDED_PATHS
+    idiom). Unresolvable -> [] (silent)."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [expr.value]
+    if not isinstance(expr, ast.Name):
+        return []
+    # loop variable over a module constant?
+    cur = mod.parents.get(id(at))
+    while cur is not None:
+        if (
+            isinstance(cur, (ast.For, ast.AsyncFor))
+            and isinstance(cur.target, ast.Name)
+            and cur.target.id == expr.id
+            and isinstance(cur.iter, ast.Name)
+        ):
+            seq = _module_const(mod, cur.iter.id)
+            if isinstance(seq, (ast.Tuple, ast.List)):
+                return [
+                    e.value
+                    for e in seq.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                ]
+            return []
+        cur = mod.parents.get(id(cur))
+    const = _module_const(mod, expr.id)
+    if isinstance(const, ast.Constant) and isinstance(const.value, str):
+        return [const.value]
+    return []
+
+
+def _handler_node(mod: ModuleInfo, expr: ast.expr, at: ast.AST):
+    """Resolve a route-handler expression to its FunctionDef (qualname,
+    node) — ``self.h_x`` methods and lexically-visible bare names."""
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        cls = mod.enclosing_class(at)
+        if cls:
+            qual = mod.method_qual(cls, expr.attr)
+            if qual:
+                return qual, mod.funcs[qual].node
+    if isinstance(expr, ast.Name):
+        qual = mod._resolve_local(expr.id, at)
+        if qual:
+            return qual, mod.funcs[qual].node
+    return None
+
+
+def iter_registrations(
+    mod: ModuleInfo,
+) -> Iterator[tuple[str, str, str, ast.AST]]:
+    """(path, METHOD, handler qualname, handler node) for every resolvable
+    route registration in the module."""
+    for call in ast.walk(mod.tree):
+        if not isinstance(call, ast.Call) or len(call.args) < 2:
+            continue
+        verb: str | None = None
+        f = call.func
+        d = dotted_name(f)
+        if d is not None and "." in d:
+            head, _, tail = d.rpartition(".")
+            if head.endswith("web") and tail in _HTTP_VERBS:
+                verb = tail
+        if verb is None and isinstance(f, ast.Attribute):
+            if f.attr.startswith("add_") and f.attr[4:] in _HTTP_VERBS:
+                verb = f.attr[4:]
+        if verb is None:
+            continue
+        resolved = _handler_node(mod, call.args[1], call)
+        if resolved is None:
+            continue
+        qual, node = resolved
+        for path in _paths_of(mod, call.args[0], call):
+            yield path, verb.upper(), qual, node
+
+
+def is_registration(call: ast.Call) -> bool:
+    """True for route-registration calls (they carry '/'-leading string
+    args but are the server table, not client traffic)."""
+    f = call.func
+    d = dotted_name(f)
+    if d is not None and "." in d:
+        head, _, tail = d.rpartition(".")
+        if head.endswith("web") and tail in _HTTP_VERBS | {"route"}:
+            return True
+    if isinstance(f, ast.Attribute) and f.attr.startswith("add_"):
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# handler schema extraction
+# ---------------------------------------------------------------------------
+
+
+def _own_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's own statements, not descending into nested defs
+    (except lambdas, whose bodies execute in this frame)."""
+    stack = list(getattr(fn, "body", []))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _is_json_source(node: ast.AST, request_names: set[str]) -> bool:
+    """``await request.json()`` or ``json.loads(...)`` — the expressions
+    that produce the parsed request body inside a handler."""
+    if isinstance(node, ast.Await):
+        node = node.value
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "json" and isinstance(f.value, ast.Name):
+            return f.value.id in request_names
+        if f.attr in _JSON_PARSERS:
+            return True
+    return False
+
+
+def _const_key(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class _BodyReads:
+    def __init__(self) -> None:
+        self.keys: set[str] = set()
+        self.subscript: set[str] = set()
+        self.defaulted: set[str] = set()
+        self.open = False
+
+    @property
+    def required(self) -> set[str]:
+        return self.subscript - self.defaulted
+
+
+def _scan_body_reads(
+    mod: ModuleInfo,
+    fn: ast.AST,
+    var_names: set[str],
+    source_pred,
+    reads: _BodyReads,
+    depth: int = 0,
+) -> None:
+    """Accumulate key reads of the body value bound to ``var_names`` (or
+    produced inline by ``source_pred``) within ``fn``. Follows the value
+    one hop into same-module callables; any other escape opens the
+    schema."""
+
+    def is_body(expr: ast.expr) -> bool:
+        if isinstance(expr, ast.Name) and expr.id in var_names:
+            return True
+        return source_pred(expr)
+
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            # d.get("k", default) / d.pop("k", default)
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("get", "pop")
+                and is_body(f.value)
+            ):
+                k = _const_key(node.args[0]) if node.args else None
+                if k is not None:
+                    reads.keys.add(k)
+                    reads.defaulted.add(k)
+                continue
+            # d.items()/keys()/values() -> wholesale use
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("items", "keys", "values", "update", "copy")
+                and is_body(f.value)
+            ):
+                reads.open = True
+                continue
+            # body passed onward as an argument
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if not is_body(arg):
+                    continue
+                if dotted_name(f) in ("isinstance", "len", "bool", "repr", "str"):
+                    continue
+                if dotted_name(f) == "dict":
+                    reads.open = True
+                    continue
+                absorbed = False
+                if depth < 2:
+                    target = None
+                    if isinstance(f, ast.Name):
+                        q = mod._resolve_local(f.id, node)
+                        target = mod.funcs[q].node if q else None
+                    elif (
+                        isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "self"
+                    ):
+                        cls = mod.enclosing_class(node)
+                        q = mod.method_qual(cls, f.attr) if cls else None
+                        target = mod.funcs[q].node if q else None
+                    if target is not None:
+                        # map the argument onto the callee's parameter
+                        idx = None
+                        for i, a in enumerate(node.args):
+                            if a is arg:
+                                idx = i
+                                break
+                        params = [
+                            a.arg
+                            for a in target.args.args
+                            if a.arg not in ("self", "cls")
+                        ]
+                        pname = None
+                        if idx is not None and idx < len(params):
+                            pname = params[idx]
+                        else:
+                            for kw in node.keywords:
+                                if kw.value is arg and kw.arg:
+                                    pname = kw.arg
+                        if pname is not None:
+                            _scan_body_reads(
+                                mod,
+                                target,
+                                {pname},
+                                lambda e: False,
+                                reads,
+                                depth + 1,
+                            )
+                            absorbed = True
+                if not absorbed:
+                    reads.open = True
+        elif isinstance(node, ast.Subscript) and is_body(node.value):
+            k = _const_key(node.slice)
+            if k is not None:
+                if isinstance(node.ctx, ast.Load):
+                    reads.keys.add(k)
+                    reads.subscript.add(k)
+            else:
+                reads.open = True  # dynamic key: anything may be read
+        elif isinstance(node, (ast.For, ast.AsyncFor)) and is_body(node.iter):
+            reads.open = True
+        elif isinstance(node, ast.Return) and node.value is not None:
+            if is_body(node.value):
+                reads.open = True
+        elif isinstance(node, ast.keyword) and node.arg is None:
+            # **body splat into a call
+            if is_body(node.value):
+                reads.open = True
+        elif isinstance(node, ast.Starred) and is_body(node.value):
+            reads.open = True
+
+
+def _dict_literal_keys(expr: ast.expr) -> tuple[set[str], bool] | None:
+    """(keys, has_splat) for a dict literal (or an IfExp of two literals);
+    None when the expression is not a literal dict."""
+    if isinstance(expr, ast.IfExp):
+        a = _dict_literal_keys(expr.body)
+        b = _dict_literal_keys(expr.orelse)
+        if a is None or b is None:
+            return None
+        return a[0] | b[0], a[1] or b[1]
+    if not isinstance(expr, ast.Dict):
+        return None
+    keys: set[str] = set()
+    splat = False
+    for k in expr.keys:
+        if k is None:
+            splat = True
+            continue
+        ck = _const_key(k)
+        if ck is None:
+            splat = True
+        else:
+            keys.add(ck)
+    return keys, splat
+
+
+def _scan_responses(
+    mod: ModuleInfo, fn: ast.AST, schema: HandlerSchema, depth: int = 0
+) -> None:
+    """Collect response keys and status codes emitted by a handler,
+    following one hop into locally-resolvable helper returns."""
+    # name -> (keys, open) built up from literal assignments + key stores
+    # (source order matters: `out = {...}` must precede `out["k"] = v`)
+    built: dict[str, tuple[set[str], bool]] = {}
+    saw_response = False
+    assigns = sorted(
+        (n for n in _own_nodes(fn) if isinstance(n, ast.Assign)),
+        key=lambda n: n.lineno,
+    )
+    for node in assigns:
+        if len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                lit = _dict_literal_keys(node.value)
+                if lit is not None:
+                    # UNION across rebinds, not last-literal-wins: the var
+                    # may be returned between two bindings, so it "may
+                    # emit" any of them — narrowing here would turn a real
+                    # emit into a false WIRE003 on the consumer
+                    keys, op = built.get(t.id, (set(), False))
+                    built[t.id] = (keys | lit[0], op or lit[1])
+                elif t.id in built:
+                    # rebound to something unresolvable: keep the keys,
+                    # mark the shape open
+                    built[t.id] = (built[t.id][0], True)
+            elif (
+                isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Name)
+                and t.value.id in built
+            ):
+                k = _const_key(t.slice)
+                keys, op = built[t.value.id]
+                if k is None:
+                    built[t.value.id] = (keys, True)
+                else:
+                    keys.add(k)
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            tail = d.rpartition(".")[2] if d else (
+                node.func.attr if isinstance(node.func, ast.Attribute) else ""
+            )
+            if tail == "json_response":
+                saw_response = True
+                status = 200
+                for kw in node.keywords:
+                    if kw.arg == "status":
+                        if isinstance(kw.value, ast.Constant) and isinstance(
+                            kw.value.value, int
+                        ):
+                            status = kw.value.value
+                        else:
+                            status = -1  # dynamic: may return any code
+                if status > 0:
+                    schema.statuses.add(status)
+                else:
+                    schema.statuses_open = True
+                arg = node.args[0] if node.args else None
+                lit = _dict_literal_keys(arg) if arg is not None else None
+                if lit is not None:
+                    schema.resp_keys |= lit[0]
+                    if lit[1]:
+                        schema.resp_open = True
+                elif isinstance(arg, ast.Name) and arg.id in built:
+                    keys, op = built[arg.id]
+                    schema.resp_keys |= keys
+                    if op:
+                        schema.resp_open = True
+                else:
+                    schema.resp_open = True
+            elif tail in ("Response", "StreamResponse", "FileResponse"):
+                saw_response = True
+                schema.resp_open = True
+            elif d is not None:
+                exc = d.rpartition(".")[2]
+                if exc in _HTTP_EXC_STATUS:
+                    schema.statuses.add(_HTTP_EXC_STATUS[exc])
+        elif isinstance(node, ast.Return) and node.value is not None:
+            # return await helper(...) -> absorb the helper's responses
+            v = node.value
+            if isinstance(v, ast.Await):
+                v = v.value
+            if isinstance(v, ast.Call) and depth < 2:
+                target = None
+                if isinstance(v.func, ast.Name):
+                    q = mod._resolve_local(v.func.id, node)
+                    target = mod.funcs[q].node if q else None
+                elif (
+                    isinstance(v.func, ast.Attribute)
+                    and isinstance(v.func.value, ast.Name)
+                    and v.func.value.id == "self"
+                ):
+                    cls = mod.enclosing_class(node)
+                    q = mod.method_qual(cls, v.func.attr) if cls else None
+                    target = mod.funcs[q].node if q else None
+                if target is not None:
+                    saw_response = True
+                    _scan_responses(mod, target, schema, depth + 1)
+    if not saw_response and depth == 0:
+        schema.resp_open = True
+
+
+def analyze_handler(
+    mod: ModuleInfo, path: str, method: str, qual: str, node: ast.AST
+) -> HandlerSchema:
+    schema = HandlerSchema(
+        path=path,
+        method=method,
+        relpath=mod.relpath,
+        line=getattr(node, "lineno", 1),
+        qualname=qual,
+    )
+    # request parameter: first non-self arg
+    req_names = set()
+    args = [a.arg for a in node.args.args if a.arg not in ("self", "cls")]
+    if args:
+        req_names.add(args[0])
+
+    # body variables: names assigned a json source; raw-read vars feed
+    # json.loads chains (handled by the source predicate)
+    body_vars: set[str] = set()
+    for n in _own_nodes(node):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1:
+            t = n.targets[0]
+            if isinstance(t, ast.Name) and _is_json_source(
+                n.value, req_names
+            ):
+                body_vars.add(t.id)
+
+    reads = _BodyReads()
+    _scan_body_reads(
+        mod,
+        node,
+        body_vars,
+        lambda e: _is_json_source(e, req_names),
+        reads,
+    )
+    # a raw body forwarded wholesale (gateway passthrough): request.read()
+    # result used by anything but a json parser
+    raw_vars: set[str] = set()
+    for n in _own_nodes(node):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1:
+            t = n.targets[0]
+            v = n.value
+            if isinstance(v, ast.Await):
+                v = v.value
+            if (
+                isinstance(t, ast.Name)
+                and isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "read"
+                and isinstance(v.func.value, ast.Name)
+                and v.func.value.id in req_names
+            ):
+                raw_vars.add(t.id)
+    if raw_vars:
+        for n in _own_nodes(node):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            fname = (
+                f.attr
+                if isinstance(f, ast.Attribute)
+                else (f.id if isinstance(f, ast.Name) else "")
+            )
+            if fname in _JSON_PARSERS or fname in ("len", "strip"):
+                continue
+            for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                base = arg
+                # raw.strip() etc. still the raw body
+                while isinstance(base, ast.Call) and isinstance(
+                    base.func, ast.Attribute
+                ):
+                    base = base.func.value
+                if isinstance(base, ast.Name) and base.id in raw_vars:
+                    reads.open = True
+
+    schema.body_keys = reads.keys
+    schema.body_required = reads.required
+    schema.body_open = reads.open
+    _scan_responses(mod, node, schema)
+    return schema
+
+
+# ---------------------------------------------------------------------------
+# client-side call extraction
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ClientCall:
+    """One outbound HTTP call with a resolvable literal path."""
+
+    node: ast.Call
+    path: str
+    body_keys: set[str] | None  # None = unknown / non-dict body
+    body_splat: bool
+    resp_var: str | None  # name the parsed response is bound to
+
+
+def _path_from_fstring(js: ast.JoinedStr) -> str | None:
+    """Extract "/path" from f"http://{addr}/path..." — the constant
+    fragment that follows the host FormattedValue."""
+    vals = list(js.values)
+    if not vals:
+        return None
+    head = vals[0]
+    # f"/path?{q}" — the path IS the leading constant
+    if isinstance(head, ast.Constant) and isinstance(head.value, str):
+        if head.value.startswith("/"):
+            return head.value.split("?")[0]
+        if not head.value.startswith("http"):
+            return None
+    else:
+        # f"{backend}/path" — host expression first, then the path
+        for v in vals[1:]:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                if v.value.startswith("/"):
+                    return v.value.split("?")[0]
+        return None
+    for v in vals[1:]:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            if v.value.startswith("/"):
+                return v.value.split("?")[0]
+    return None
+
+
+def transport_callee_name(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def is_transport_call(call: ast.Call) -> bool:
+    if is_registration(call):
+        return False
+    name = transport_callee_name(call)
+    if name is None:
+        return False
+    if name.lower() in ("get", "put", "pop", "post"):
+        # dict-like method names double as HTTP verbs: only a first-arg
+        # literal path / http url makes them a transport
+        # (``os.environ.get("KEY", "/tmp/default")`` is not a request)
+        if not call.args:
+            return False
+        a0 = call.args[0]
+        if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+            return a0.value.startswith("/")
+        if isinstance(a0, ast.JoinedStr):
+            return _path_from_fstring(a0) is not None
+        return False
+    if TRANSPORT_RE.search(name):
+        if _STRONG_TRANSPORT_RE.search(name):
+            return True
+        # weak verb (get/put/send/fetch): only an absolute http(s) URL
+        # argument marks it as a transport
+        return any(
+            (
+                isinstance(a, ast.Constant)
+                and isinstance(a.value, str)
+                and a.value.startswith(("http://", "https://"))
+            )
+            or (
+                isinstance(a, ast.JoinedStr)
+                and a.values
+                and isinstance(a.values[0], ast.Constant)
+                and str(a.values[0].value).startswith("http")
+            )
+            for a in list(call.args) + [kw.value for kw in call.keywords]
+        )
+    # pool.submit(self._post_json_one, addr, "/path", payload) /
+    # loop.run_in_executor(None, self._post_bytes, ...): the transport
+    # callable rides as an argument
+    if name in ("submit", "run_in_executor", "map"):
+        for arg in call.args:
+            d = dotted_name(arg)
+            tail = d.rpartition(".")[2] if d else None
+            if tail and TRANSPORT_RE.search(tail):
+                return True
+    return False
+
+
+def call_path(call: ast.Call) -> str | None:
+    """The literal request path of a transport call, or None."""
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value.startswith("/"):
+                return arg.value.split("?")[0]
+        if isinstance(arg, ast.JoinedStr):
+            p = _path_from_fstring(arg)
+            if p is not None:
+                return p
+    return None
+
+
+def iter_client_calls(fn: ast.AST) -> Iterator[ClientCall]:
+    """Client calls in one function: transport-shaped callables with a
+    literal path, the dict-literal body they carry, and the variable
+    their parsed response binds to."""
+    # name -> dict-literal bindings in source order; a CALL resolves its
+    # body var to the latest binding AT OR BEFORE its own line (a global
+    # last-binding-wins map mis-attributed an earlier call's body to a
+    # later rebind — false WIRE002 on contract-faithful clients)
+    dict_bindings: dict[str, list[tuple[int, set[str], bool]]] = {}
+    assigns: list[ast.Assign] = [
+        n for n in _own_nodes(fn) if isinstance(n, ast.Assign)
+    ]
+    for n in sorted(assigns, key=lambda a: a.lineno):
+        if len(n.targets) == 1 and isinstance(n.targets[0], ast.Name):
+            lit = _dict_literal_keys(n.value)
+            if lit is not None:
+                dict_bindings.setdefault(n.targets[0].id, []).append(
+                    (n.lineno, lit[0], lit[1])
+                )
+
+    def dict_var_at(name: str, lineno: int) -> tuple[set[str], bool] | None:
+        best = None
+        for ln, keys, splat in dict_bindings.get(name, ()):
+            if ln <= lineno:
+                best = (keys, splat)
+        return best
+
+    # urlopen context vars: with urlopen(f"http://../p") as r -> r : path.
+    # A var reused for DIFFERENT paths is dropped: reads of it cannot be
+    # attributed to one path without false WIRE003s.
+    resp_objs: dict[str, str | None] = {}
+    for n in _own_nodes(fn):
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                cexpr = item.context_expr
+                if (
+                    isinstance(cexpr, ast.Call)
+                    and is_transport_call(cexpr)
+                    and item.optional_vars is not None
+                    and isinstance(item.optional_vars, ast.Name)
+                ):
+                    p = call_path(cexpr)
+                    if p is not None:
+                        var = item.optional_vars.id
+                        if resp_objs.get(var, p) != p:
+                            resp_objs[var] = None
+                        else:
+                            resp_objs[var] = p
+
+    # one parent map per function, shared by every resp_binding lookup
+    parent_map: dict[int, ast.AST] = {}
+    for n in ast.walk(fn):
+        for c in ast.iter_child_nodes(n):
+            parent_map[id(c)] = n
+
+    def resp_binding(call: ast.Call) -> str | None:
+        """The name this call's (awaited) result is assigned to —
+        last element for tuple targets ((addr, data) unpack)."""
+        cur: ast.AST | None = parent_map.get(id(call))
+        while isinstance(cur, (ast.Await,)):
+            cur = parent_map.get(id(cur))
+        if isinstance(cur, ast.Assign) and len(cur.targets) == 1:
+            t = cur.targets[0]
+            if isinstance(t, ast.Name):
+                return t.id
+            if isinstance(t, (ast.Tuple, ast.List)) and t.elts:
+                last = t.elts[-1]
+                if isinstance(last, ast.Name):
+                    return last.id
+        return None
+
+    out: list[ClientCall] = []
+    for n in _own_nodes(fn):
+        if not isinstance(n, ast.Call) or not is_transport_call(n):
+            continue
+        path = call_path(n)
+        if path is None:
+            continue
+        body_keys: set[str] | None = None
+        splat = False
+        for arg in list(n.args) + [
+            kw.value
+            for kw in n.keywords
+            if kw.arg in (None, "json", "payload", "data", "body")
+        ]:
+            lit = _dict_literal_keys(arg)
+            if lit is None and isinstance(arg, ast.Name):
+                lit = dict_var_at(arg.id, n.lineno)
+            if lit is not None:
+                body_keys, splat = set(lit[0]), lit[1]
+                break
+        out.append(
+            ClientCall(
+                node=n,
+                path=path,
+                body_keys=body_keys,
+                body_splat=splat,
+                resp_var=resp_binding(n),
+            )
+        )
+
+    # parsed-response bindings over a tracked response object:
+    #   with urlopen(f".../p") as r: d = json.loads(r.read() or b"{}")
+    #   async with sess.post(f".../p") as r: d = await r.json()
+    for n in _own_nodes(fn):
+        if not (
+            isinstance(n, ast.Assign)
+            and len(n.targets) == 1
+            and isinstance(n.targets[0], ast.Name)
+        ):
+            continue
+        for call in ast.walk(n.value):
+            if not (isinstance(call, ast.Call) and isinstance(call.func, ast.Attribute)):
+                continue
+            if call.func.attr in _JSON_PARSERS:
+                for sub in ast.walk(call):
+                    if (
+                        isinstance(sub, ast.Attribute)
+                        and sub.attr == "read"
+                        and isinstance(sub.value, ast.Name)
+                        and resp_objs.get(sub.value.id) is not None
+                    ):
+                        out.append(
+                            ClientCall(
+                                node=call,
+                                path=resp_objs[sub.value.id],
+                                body_keys=None,
+                                body_splat=False,
+                                resp_var=n.targets[0].id,
+                            )
+                        )
+            elif (
+                call.func.attr == "json"
+                and isinstance(call.func.value, ast.Name)
+                and resp_objs.get(call.func.value.id) is not None
+            ):
+                out.append(
+                    ClientCall(
+                        node=call,
+                        path=resp_objs[call.func.value.id],
+                        body_keys=None,
+                        body_splat=False,
+                        resp_var=n.targets[0].id,
+                    )
+                )
+
+    # a response var bound by calls to DIFFERENT paths is untrackable:
+    # its reads would be checked against every path (false WIRE003);
+    # applies to BOTH binding mechanisms (assign and context-manager)
+    var_paths: dict[str, set[str]] = {}
+    for c in out:
+        if c.resp_var is not None:
+            var_paths.setdefault(c.resp_var, set()).add(c.path)
+    for c in out:
+        if c.resp_var is not None and len(var_paths[c.resp_var]) > 1:
+            c.resp_var = None
+    yield from out
+
+
+# ---------------------------------------------------------------------------
+# contract construction
+# ---------------------------------------------------------------------------
+
+
+def build_contract_from_modules(
+    mods: Iterable[ModuleInfo],
+) -> WireContract:
+    contract = WireContract()
+    for mod in mods:
+        contract.modules[mod.relpath] = mod
+        for path, method, qual, node in iter_registrations(mod):
+            schema = analyze_handler(mod, path, method, qual, node)
+            contract.handlers.setdefault(path, []).append(schema)
+    return contract
+
+
+def build_contract(
+    sources: Iterable[tuple[str, str, ast.Module]],
+) -> WireContract:
+    return build_contract_from_modules(
+        ModuleInfo(relpath, text, tree) for relpath, text, tree in sources
+    )
+
+
+def build_package_contract(
+    package_root: Path,
+    modules: Iterable[ModuleInfo] | None = None,
+) -> WireContract:
+    """Package-wide contract; pass the call graph's already-parsed
+    ``modules`` to skip the second read+parse of every package file."""
+    if modules is not None:
+        return build_contract_from_modules(modules)
+    return build_contract(iter_package_sources(package_root))

@@ -1,0 +1,37 @@
+"""Wire-protocol constants shared by every HTTP producer and consumer.
+
+The fleet is HTTP-coupled (trainer -> weight-sync client -> N inference
+servers -> proxy/gateway), and the ``x-areal-*`` headers are the part of
+that contract that rides OUTSIDE request bodies — a producer and a
+consumer that spell one differently fail silently (the header is simply
+absent on the other side; deadlines stop propagating, priorities stop
+splitting, traces stop correlating). This module is the single source of
+truth for those names; arealint's WIRE005 rule flags any ``x-areal-*``
+string literal outside this file so the two sides can never drift.
+
+Header names are case-insensitive on the wire (aiohttp and urllib both
+normalize); the canonical spellings below match what each subsystem
+historically sent, so packet captures stay greppable.
+"""
+
+from __future__ import annotations
+
+# cross-process trace correlation (observability/tracecontext.py):
+# "task=<task_id>;session=<session_id>"
+TRACE_HEADER = "x-areal-trace"
+
+# request-lifecycle deadline, absolute unix-epoch seconds
+# (docs/request_lifecycle.md): gateway -> proxy -> client -> /generate
+DEADLINE_HEADER = "x-areal-deadline"
+
+# load-shedding priority class ("interactive" | "rollout"):
+# classified at the gateway, rides to the engine so TTFT splits by class
+PRIORITY_HEADER = "x-areal-priority"
+
+# control-plane auth for POST /autopilot/knobs (docs/autopilot.md)
+AUTOPILOT_TOKEN_HEADER = "x-areal-autopilot-token"
+
+# weight-broadcast relay tree (inference/server.py h_update_bucket):
+# comma-separated downstream addresses + the per-hop timeout
+RELAY_HEADER = "X-Areal-Relay"
+RELAY_TIMEOUT_HEADER = "X-Areal-Relay-Timeout"

@@ -38,6 +38,7 @@ import json as _json
 import threading
 import time
 
+from areal_tpu.api import wire
 from areal_tpu.autopilot import signals as sig_mod
 from areal_tpu.autopilot.controllers import (
     Action,
@@ -65,7 +66,7 @@ def _default_post(addr: str, path: str, payload: dict, token: str, timeout: floa
 
     headers = {"Content-Type": "application/json"}
     if token:
-        headers["x-areal-autopilot-token"] = token
+        headers[wire.AUTOPILOT_TOKEN_HEADER] = token
     req = urllib.request.Request(
         f"http://{addr}{path}",
         data=_json.dumps(payload).encode(),

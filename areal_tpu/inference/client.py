@@ -30,6 +30,7 @@ import numpy as np
 
 from areal_tpu.api.config import InferenceEngineConfig
 from areal_tpu.api.engine_api import InferenceEngine
+from areal_tpu.api import wire
 from areal_tpu.api.io_struct import (
     TIMING_FIELDS,
     ModelRequest,
@@ -538,12 +539,12 @@ class RemoteJaxEngine(InferenceEngine):
                 }
                 headers = {}
                 if deadline is not None:
-                    headers["x-areal-deadline"] = f"{deadline:.6f}"
+                    headers[wire.DEADLINE_HEADER] = f"{deadline:.6f}"
                 prio = req.metadata.get("priority")
                 if prio:
                     # priority class rides to the engine so server-side
                     # TTFT histograms split by class (timeline metrics)
-                    headers["x-areal-priority"] = str(prio)
+                    headers[wire.PRIORITY_HEADER] = str(prio)
                 addr, data = await self._post_json_failover(
                     addr, "/generate", payload, extra_headers=headers or None
                 )
@@ -1412,8 +1413,8 @@ class RemoteJaxEngine(InferenceEngine):
 
             if relay:
                 hdr = {
-                    "X-Areal-Relay": ",".join(targets[1:]),
-                    "X-Areal-Relay-Timeout": str(self.config.request_timeout),
+                    wire.RELAY_HEADER: ",".join(targets[1:]),
+                    wire.RELAY_TIMEOUT_HEADER: str(self.config.request_timeout),
                 }
 
                 def send(body: bytes) -> None:

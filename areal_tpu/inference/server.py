@@ -19,7 +19,7 @@ import numpy as np
 from aiohttp import web
 
 from areal_tpu.api.config import ServerConfig
-from areal_tpu.api import io_struct
+from areal_tpu.api import io_struct, wire
 from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
 from areal_tpu.inference.decode_engine import DecodeEngine
 from areal_tpu.observability import catalog, tracecontext
@@ -358,7 +358,7 @@ class InferenceServer:
         # classes; docs/request_lifecycle.md) into request metadata so the
         # engine's timeline histograms split TTFT by class
         prio = request.headers.get(
-            "x-areal-priority", req.metadata.get("priority", "")
+            wire.PRIORITY_HEADER, req.metadata.get("priority", "")
         )
         if prio:
             req.metadata["priority"] = str(prio).lower()
@@ -366,7 +366,7 @@ class InferenceServer:
         # seconds) end-to-end; a JSON "deadline" field is the fallback for
         # hand-rolled callers. Header wins: the outermost hop (gateway)
         # owns the budget.
-        hdr_deadline = request.headers.get("x-areal-deadline")
+        hdr_deadline = request.headers.get(wire.DEADLINE_HEADER)
         if hdr_deadline:
             try:
                 req.deadline = float(hdr_deadline)
@@ -504,7 +504,7 @@ class InferenceServer:
         token leaves the endpoint open like the other ops endpoints."""
         self._metrics.requests.labels(endpoint="autopilot_knobs").inc()
         token = getattr(self.config, "autopilot_token", "") or ""
-        if token and request.headers.get("x-areal-autopilot-token") != token:
+        if token and request.headers.get(wire.AUTOPILOT_TOKEN_HEADER) != token:
             return web.json_response(
                 {"status": "error", "error": "bad autopilot token"},
                 status=403,
@@ -632,13 +632,13 @@ class InferenceServer:
         (the commit barrier stays correct)."""
         body = await request.read()
         self._metrics.update_bucket_bytes.inc(len(body))
-        relay = [a for a in request.headers.get("X-Areal-Relay", "").split(",") if a]
+        relay = [a for a in request.headers.get(wire.RELAY_HEADER, "").split(",") if a]
         forwards = []
         if relay:
             # per-hop timeout rides with the request so the operator's
             # client-side request_timeout governs the whole tree
             timeout = float(
-                request.headers.get("X-Areal-Relay-Timeout", "300")
+                request.headers.get(wire.RELAY_TIMEOUT_HEADER, "300")
             )
             forwards = [
                 asyncio.get_running_loop().run_in_executor(
@@ -775,10 +775,10 @@ def _relay_bucket(
     head, tail = group[0], group[1:]
     headers = {
         "Content-Type": "application/octet-stream",
-        "X-Areal-Relay-Timeout": str(timeout),
+        wire.RELAY_TIMEOUT_HEADER: str(timeout),
     }
     if tail:
-        headers["X-Areal-Relay"] = ",".join(tail)
+        headers[wire.RELAY_HEADER] = ",".join(tail)
     req = urllib.request.Request(
         f"http://{head}{path_qs}", data=body, headers=headers, method="POST"
     )

@@ -19,9 +19,18 @@ from __future__ import annotations
 
 from typing import Mapping, MutableMapping
 
+from areal_tpu.api.wire import TRACE_HEADER  # canonical header name
 from areal_tpu.utils import perf_tracer
 
-TRACE_HEADER = "x-areal-trace"
+__all__ = [
+    "TRACE_HEADER",
+    "format_trace_header",
+    "parse_trace_header",
+    "current_trace_header",
+    "apply_trace_header",
+    "inject",
+    "extract",
+]
 
 
 def format_trace_header(

@@ -29,6 +29,7 @@ import time
 import aiohttp
 from aiohttp import web
 
+from areal_tpu.api import wire
 from areal_tpu.observability import catalog
 from areal_tpu.openai.proxy.common import bearer_token as _bearer
 from areal_tpu.utils import logging as alog
@@ -38,7 +39,11 @@ logger = alog.getLogger("proxy_gateway")
 PRIORITIES = ("interactive", "rollout")
 # lifecycle + trace headers forwarded verbatim to the owning proxy backend
 # (x-areal-trace keeps gateway-entered requests correlatable in postmortems)
-PASSTHROUGH_HEADERS = ("x-areal-deadline", "x-areal-priority", "x-areal-trace")
+PASSTHROUGH_HEADERS = (
+    wire.DEADLINE_HEADER,
+    wire.PRIORITY_HEADER,
+    wire.TRACE_HEADER,
+)
 
 FORWARDED_PATHS = (
     "/v1/chat/completions",
@@ -104,7 +109,7 @@ class GatewayState:
         return self.interactive_headroom
 
     def classify(self, request: web.Request) -> str:
-        p = request.headers.get("x-areal-priority", "interactive").lower()
+        p = request.headers.get(wire.PRIORITY_HEADER, "interactive").lower()
         return p if p in PRIORITIES else "interactive"
 
     def admit(self, priority: str) -> bool:

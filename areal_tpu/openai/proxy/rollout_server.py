@@ -32,6 +32,7 @@ from typing import Any
 import numpy as np
 from aiohttp import web
 
+from areal_tpu.api import wire
 from areal_tpu.openai.client import ArealOpenAI
 from areal_tpu.openai.types import Interaction
 from areal_tpu.utils import logging as alog, name_resolve
@@ -195,7 +196,7 @@ def create_proxy_app(state: ProxyState) -> web.Application:
         """x-areal-deadline header (absolute unix epoch seconds) — the
         request-lifecycle budget forwarded by the gateway; see
         docs/request_lifecycle.md."""
-        raw = request.headers.get("x-areal-deadline")
+        raw = request.headers.get(wire.DEADLINE_HEADER)
         if not raw:
             return None
         try:
@@ -208,7 +209,7 @@ def create_proxy_app(state: ProxyState) -> web.Application:
         rides request metadata -> ModelRequest -> engine, so the serving
         fleet's timeline histograms split TTFT by class — on EVERY proxy
         path, not just chat.completions."""
-        prio = request.headers.get("x-areal-priority")
+        prio = request.headers.get(wire.PRIORITY_HEADER)
         if not prio:
             return
         try:

@@ -66,6 +66,8 @@ class ReplicaSnapshot:
     autopilot_knobs: dict = dataclasses.field(default_factory=dict)
 
     @classmethod
+    # arealint: wire-doc=/statusz doc — every top-level key read here is
+    # checked against what the inference server's /statusz actually emits
     def from_statusz(
         cls, addr: str, doc: dict, now: float | None = None
     ) -> "ReplicaSnapshot":

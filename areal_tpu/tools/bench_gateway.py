@@ -42,6 +42,7 @@ from typing import Any
 # the self-contained local fleet serves the toy char tokenizer — the bench
 # measures serving latency, not tokenization; real deployments pass
 # --gateway at a fleet whose proxies run the production tokenizer
+from areal_tpu.api import wire
 from areal_tpu.infra.rpc.echo_engine import CharTokenizer  # noqa: F401
 from areal_tpu.utils import logging as alog
 
@@ -211,8 +212,8 @@ async def _one_client(
         key = sess["api_key"]
         headers = {
             "Authorization": f"Bearer {key}",
-            "x-areal-priority": priority,
-            "x-areal-deadline": f"{time.time() + (budget_end - time.monotonic()):.6f}",
+            wire.PRIORITY_HEADER: priority,
+            wire.DEADLINE_HEADER: f"{time.time() + (budget_end - time.monotonic()):.6f}",
         }
         messages = [{"role": "user", "content": prompt}]
         was_shed = False

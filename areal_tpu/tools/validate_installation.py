@@ -284,6 +284,35 @@ def main(argv=None) -> int:
 
     _check("perf_lint", perf_lint, results)
 
+    def wire_lint():
+        """The distributed-control-plane families (WIRE wire-contract
+        drift between the HTTP-coupled processes, LCK lock/fence
+        ordering in the threaded engine) over the package — the static
+        half of what the scale-out e2e tests exercise at runtime
+        (docs/static_analysis.md)."""
+        from areal_tpu.analysis import (
+            default_baseline_path,
+            default_package_root,
+            run_analysis,
+        )
+
+        res = run_analysis(
+            [default_package_root()],
+            rules=["WIRE", "LCK"],
+            baseline_path=default_baseline_path(),
+        )
+        if not res.ok:
+            raise RuntimeError(
+                "; ".join(f.render() for f in res.findings[:5])
+                + (f" (+{len(res.findings) - 5} more)" if len(res.findings) > 5 else "")
+            )
+        return (
+            f"WIRE/LCK clean over {res.files_checked} files "
+            f"({len(res.suppressed)} reasoned suppressions)"
+        )
+
+    _check("wire_lint", wire_lint, results)
+
     def native_kernels():
         from areal_tpu.native import datapack_lib
         from areal_tpu.utils.datapack import ffd_allocate
@@ -485,7 +514,9 @@ def overload_self_test(
         }
         headers = {}
         if deadline_s is not None:
-            headers["x-areal-deadline"] = f"{time.time() + deadline_s:.6f}"
+            from areal_tpu.api import wire
+
+            headers[wire.DEADLINE_HEADER] = f"{time.time() + deadline_s:.6f}"
         t0 = time.monotonic()
         async with aiohttp.ClientSession() as s:
             for _ in range(200):  # bounded retry: no hung client

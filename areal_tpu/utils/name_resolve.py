@@ -255,6 +255,8 @@ class Etcd3NameResolveRepo(NameResolveRepo):
     a new lease, re-puts, then revokes the old lease (2 RPCs at discovery
     scale beats tracking gateway keepalive streams)."""
 
+    # arealint: disable-file=WIRE001 the /v3/* routes are etcd's own gRPC-gateway API served by an EXTERNAL etcd process — no in-package server registers them by design
+
     def __init__(
         self,
         addr: str | None = None,
@@ -266,6 +268,11 @@ class Etcd3NameResolveRepo(NameResolveRepo):
         self._timeout = timeout
         self._lock = threading.RLock()
         self._leases: dict[str, int] = {}  # name -> lease id we attached
+        # same-NAME mutations must serialize (a lost race between two
+        # replace-adds revokes the lease the key just got bound to —
+        # revoking a lease deletes its attached keys); one Lock per
+        # distinct name ever touched, bounded at discovery scale
+        self._name_locks: dict[str, threading.Lock] = {}
         self._auth_token: str | None = None
         self._user = user or os.environ.get("AREAL_ETCD_USER")
         self._password = password or os.environ.get("AREAL_ETCD_PASSWORD")
@@ -347,44 +354,72 @@ class Etcd3NameResolveRepo(NameResolveRepo):
         except (urllib.error.URLError, OSError, KeyError):
             pass  # expired or already gone
 
+    def _name_lock(self, name: str) -> threading.Lock:
+        with self._lock:
+            lk = self._name_locks.get(name)
+            if lk is None:
+                lk = self._name_locks[name] = threading.Lock()
+            return lk
+
     # -- contract ---------------------------------------------------------
     def add(self, name, value, replace=False, keepalive_ttl=None):
+        # Every etcd RPC (grant/put/txn/revoke) runs OUTSIDE self._lock
+        # (arealint LCK003): the repo lock guards only the maps. Holding
+        # it across the round-trips serialized every concurrent discovery
+        # op — worker registrations, keepalive re-adds, deletes — behind
+        # one slow etcd call (up to 4 x timeout per add). etcd's txn is
+        # what provides cross-host atomicity; the local lock never did.
+        # Same-NAME mutations DO serialize (on the per-name lock): two
+        # interleaved replace-adds of one name could otherwise end with
+        # the key bound to lease A while B's cleanup revokes A — and a
+        # lease revoke deletes the keys attached to it.
         name = name.strip("/")
+        with self._name_lock(name):
+            self._add_locked(name, value, replace, keepalive_ttl)
+
+    def _add_locked(self, name, value, replace, keepalive_ttl):
+        body: dict = {"key": self._b64(name), "value": self._b64(str(value))}
+        lease_id: int | None = None
+        if keepalive_ttl:
+            lease_id = self._grant(keepalive_ttl)
+            body["lease"] = lease_id
         with self._lock:
-            body: dict = {"key": self._b64(name), "value": self._b64(str(value))}
             old_lease = self._leases.pop(name, None)
-            if keepalive_ttl:
-                lease_id = self._grant(keepalive_ttl)
-                body["lease"] = lease_id
+            if lease_id is not None:
                 self._leases[name] = lease_id
-            if replace:
-                self._post("/v3/kv/put", body)
-            else:
-                # ATOMIC create-if-absent via a txn (create_revision == 0):
-                # a client-side check-then-put would race across hosts —
-                # the exact multi-host deployment this backend exists for
-                resp = self._post(
-                    "/v3/kv/txn",
-                    {
-                        "compare": [
-                            {
-                                "key": body["key"],
-                                "target": "CREATE",
-                                "result": "EQUAL",
-                                "create_revision": "0",
-                            }
-                        ],
-                        "success": [{"request_put": body}],
-                    },
-                )
-                if not resp.get("succeeded"):
-                    if keepalive_ttl:
-                        self._revoke(self._leases.pop(name))
-                    if old_lease is not None:
+        if replace:
+            self._post("/v3/kv/put", body)
+        else:
+            # ATOMIC create-if-absent via a txn (create_revision == 0):
+            # a client-side check-then-put would race across hosts —
+            # the exact multi-host deployment this backend exists for
+            resp = self._post(
+                "/v3/kv/txn",
+                {
+                    "compare": [
+                        {
+                            "key": body["key"],
+                            "target": "CREATE",
+                            "result": "EQUAL",
+                            "create_revision": "0",
+                        }
+                    ],
+                    "success": [{"request_put": body}],
+                },
+            )
+            if not resp.get("succeeded"):
+                with self._lock:
+                    # pop, not del: clear_subtree takes only the repo lock
+                    # and may have raced the entry away mid-add
+                    if lease_id is not None:
+                        self._leases.pop(name, None)
+                    if old_lease is not None and name not in self._leases:
                         self._leases[name] = old_lease
-                    raise NameEntryExistsError(name)
-            if old_lease is not None:
-                self._revoke(old_lease)
+                if lease_id is not None:
+                    self._revoke(lease_id)
+                raise NameEntryExistsError(name)
+        if old_lease is not None:
+            self._revoke(old_lease)
 
     def get(self, name):
         name = name.strip("/")
@@ -407,11 +442,12 @@ class Etcd3NameResolveRepo(NameResolveRepo):
 
     def delete(self, name):
         name = name.strip("/")
-        resp = self._post("/v3/kv/deleterange", {"key": self._b64(name)})
-        with self._lock:
-            lease = self._leases.pop(name, None)
-        if lease is not None:
-            self._revoke(lease)
+        with self._name_lock(name):  # serialize vs a racing same-name add
+            resp = self._post("/v3/kv/deleterange", {"key": self._b64(name)})
+            with self._lock:
+                lease = self._leases.pop(name, None)
+            if lease is not None:
+                self._revoke(lease)
         if int(resp.get("deleted", 0)) == 0:
             raise NameEntryNotFoundError(name)
 

@@ -13,10 +13,12 @@ wire protocols are e2e-tested SDK-free in tests/test_scale_out.py and
 tests/test_openai_layer.py.
 """
 
+from areal_tpu.api import wire
+
 # Every adapter here IS the RL system's own bulk traffic, so each stamps
 # this on its client: the gateway's load shedder
 # (docs/request_lifecycle.md) classifies by the header and sheds
 # rollout-class requests before interactive ones — without the stamp a
 # rollout flood would count as interactive and the headroom guarantee
 # would be inert.
-ROLLOUT_PRIORITY_HEADERS = {"x-areal-priority": "rollout"}
+ROLLOUT_PRIORITY_HEADERS = {wire.PRIORITY_HEADER: "rollout"}

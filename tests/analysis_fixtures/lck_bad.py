@@ -1,0 +1,50 @@
+"""LCK bad fixture: every lock/fence-ordering defect the family flags.
+
+``step`` takes _a then _b while ``publish`` takes _b then _a (LCK001);
+``wait_ready`` waits on the condition under an ``if`` (LCK002); ``push``
+does an HTTP round-trip while holding the shared _a (LCK003); ``rogue``
+flips the state event outside the lock that guards its other transitions
+(LCK004)."""
+
+import threading
+import urllib.request
+
+
+class Engine:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._cv = threading.Condition()
+        self._flag = threading.Event()
+        self._ready = False
+
+    def step(self):
+        with self._a:
+            with self._b:  # order: _a -> _b
+                pass
+
+    def publish(self):
+        with self._b:
+            with self._a:  # order: _b -> _a  => LCK001 cycle
+                pass
+
+    def wait_ready(self):
+        with self._cv:
+            if not self._ready:  # LCK002: `if` is not a retry loop
+                self._cv.wait()
+
+    def push(self, addr):
+        with self._a:
+            # LCK003: blocking HTTP while holding the shared _a
+            urllib.request.urlopen(f"http://{addr}/knobs")
+
+    def begin(self):
+        with self._a:
+            self._flag.set()  # guarded transition 1
+
+    def finish(self):
+        with self._a:
+            self._flag.clear()  # guarded transition 2
+
+    def rogue(self):
+        self._flag.set()  # LCK004: outside the owning lock

@@ -1,0 +1,47 @@
+"""LCK good fixture: the same shapes, ordered and fenced correctly —
+one global lock order, condition waits in while loops, HTTP outside the
+critical section, every event transition under its owning lock."""
+
+import threading
+import urllib.request
+
+
+class Engine:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._cv = threading.Condition()
+        self._flag = threading.Event()
+        self._ready = False
+
+    def step(self):
+        with self._a:
+            with self._b:  # the one global order: _a -> _b
+                pass
+
+    def publish(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def wait_ready(self):
+        with self._cv:
+            while not self._ready:  # predicate re-checked on every wakeup
+                self._cv.wait()
+
+    def push(self, addr):
+        with self._a:
+            payload = self._render()
+        # blocking I/O happens with no lock held
+        urllib.request.urlopen(f"http://{addr}/knobs", data=payload)
+
+    def begin(self):
+        with self._a:
+            self._flag.set()
+
+    def finish(self):
+        with self._a:
+            self._flag.clear()
+
+    def _render(self):
+        return b"{}"

@@ -1,0 +1,68 @@
+"""WIRE bad fixture: one file holding both sides of a drifted HTTP
+contract. The server registers /submit, /info and /broken; the client
+posts to a typo'd path (001), sends an unread body key and omits a
+required one (002), consumes a response key nothing emits (003), checks
+a status code nothing returns + the server ships an error body as 200
+(004), and spells an x-areal header as a literal (005)."""
+
+from aiohttp import web
+
+
+class Server:
+    def build(self) -> web.Application:
+        app = web.Application()
+        app.add_routes(
+            [
+                web.post("/submit", self.h_submit),
+                web.get("/info", self.h_info),
+                web.post("/broken", self.h_broken),
+            ]
+        )
+        return app
+
+    async def h_submit(self, request: web.Request) -> web.Response:
+        d = await request.json()
+        job = d["job_id"]  # required: subscript, no defaulted read
+        prio = d.get("priority", "normal")
+        return web.json_response({"status": "ok", "accepted": bool(job), "prio": prio})
+
+    async def h_info(self, request: web.Request) -> web.Response:
+        return web.json_response({"version": 3, "uptime": 1.0})
+
+    async def h_broken(self, request: web.Request) -> web.Response:
+        # WIRE004: error-shaped body with the default 200 status — a
+        # caller's raise_for_status() reads this failure as success
+        return web.json_response({"status": "error", "error": "boom"})
+
+
+class Client:
+    async def _post_json(self, addr: str, path: str, payload: dict) -> dict:
+        return {}
+
+    async def submit_typo(self, addr: str) -> None:
+        # WIRE001: nothing registers /submitt
+        await self._post_json(addr, "/submitt", {"job_id": 1})
+
+    async def submit_drifted(self, addr: str) -> None:
+        # WIRE002: `prio` is not read by any handler of /submit
+        await self._post_json(addr, "/submit", {"job_id": 1, "prio": "high"})
+
+    async def submit_incomplete(self, addr: str) -> None:
+        # WIRE002: /submit requires `job_id`; this body omits it
+        await self._post_json(addr, "/submit", {"priority": "low"})
+
+    async def read_phantom(self, addr: str) -> bool:
+        d = await self._post_json(addr, "/submit", {"job_id": 2})
+        # WIRE003: /submit never emits `queued`
+        return bool(d.get("queued"))
+
+    async def dead_status_branch(self, sess, addr: str) -> dict:
+        d = await self._post_json(addr, "/info", {})
+        r = await sess.get(f"http://{addr}/info")
+        if r.status == 418:  # WIRE004: no handler returns 418
+            return {}
+        return d
+
+    def stamp(self, headers: dict, deadline: float) -> None:
+        # WIRE005: header literal outside api/wire.py
+        headers["x-areal-deadline"] = f"{deadline:.6f}"

@@ -1,0 +1,60 @@
+"""WIRE good fixture: the same client/server shapes as wire_bad, with a
+consistent contract — every posted key is read, required keys are always
+sent, consumed response keys are emitted, status checks match what
+handlers return, and headers come from the shared constants module."""
+
+from aiohttp import web
+
+from areal_tpu.api.wire import DEADLINE_HEADER
+
+
+class Server:
+    def build(self) -> web.Application:
+        app = web.Application()
+        app.add_routes(
+            [
+                web.post("/submit", self.h_submit),
+                web.get("/info", self.h_info),
+            ]
+        )
+        return app
+
+    async def h_submit(self, request: web.Request) -> web.Response:
+        d = await request.json()
+        job = d["job_id"]
+        prio = d.get("priority", "normal")
+        if not job:
+            return web.json_response(
+                {"status": "error", "error": "bad job_id"}, status=400
+            )
+        return web.json_response(
+            {"status": "ok", "accepted": True, "prio": prio}
+        )
+
+    async def h_info(self, request: web.Request) -> web.Response:
+        return web.json_response({"version": 3, "uptime": 1.0})
+
+    # arealint: wire-doc=/info doc
+    def parse_info(self, doc: dict) -> int:
+        return int(doc.get("version", 0))
+
+
+class Client:
+    async def _post_json(self, addr: str, path: str, payload: dict) -> dict:
+        return {}
+
+    async def submit(self, addr: str) -> bool:
+        d = await self._post_json(
+            addr, "/submit", {"job_id": 1, "priority": "high"}
+        )
+        return bool(d.get("accepted"))
+
+    async def poll(self, sess, addr: str) -> dict:
+        d = await self._post_json(addr, "/info", {})
+        r = await sess.get(f"http://{addr}/info")
+        if r.status == 400:  # h_submit returns 400: a live branch
+            return {}
+        return d
+
+    def stamp(self, headers: dict, deadline: float) -> None:
+        headers[DEADLINE_HEADER] = f"{deadline:.6f}"

@@ -239,6 +239,217 @@ def test_rcp_good_fixture():
     assert rules_in(FIXTURES / "rcp_good.py", ["RCP"]) == []
 
 
+def test_wire_bad_fixture():
+    """The bad fixture is a self-contained client+server pair drifted in
+    every WIRE way: each rule in the family fires at least once."""
+    rules = rules_in(FIXTURES / "wire_bad.py", ["WIRE"])
+    assert {"WIRE001", "WIRE002", "WIRE003", "WIRE004", "WIRE005"} == set(rules)
+    # WIRE002 fires twice: unread key sent AND required key omitted
+    assert rules.count("WIRE002") == 2
+
+
+def test_wire_good_fixture():
+    # same server, a contract-faithful client, headers via api/wire.py
+    assert rules_in(FIXTURES / "wire_good.py", ["WIRE"]) == []
+
+
+def test_lck_bad_fixture():
+    rules = rules_in(FIXTURES / "lck_bad.py", ["LCK"])
+    assert {"LCK001", "LCK002", "LCK003", "LCK004"} == set(rules)
+
+
+def test_lck_good_fixture():
+    # consistent order, while-predicate wait, RPC outside the lock, and
+    # every event flip under its owning lock stay silent
+    assert rules_in(FIXTURES / "lck_good.py", ["LCK"]) == []
+
+
+def test_wire_response_var_rebinding_unions_not_narrows(tmp_path):
+    """A handler that returns a response var, rebinds it, and returns it
+    again emits the UNION of both literals — a consumer reading a key
+    from the first binding must not fire a false WIRE003."""
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "from aiohttp import web\n"
+        "class S:\n"
+        "    def build(self):\n"
+        "        app = web.Application()\n"
+        "        app.add_routes([web.post('/q', self.h)])\n"
+        "        return app\n"
+        "    async def h(self, request):\n"
+        "        out = {'cached': True}\n"
+        "        if request.query.get('hit'):\n"
+        "            return web.json_response(out)\n"
+        "        out = {'status': 'ok'}\n"
+        "        return web.json_response(out)\n"
+        "class C:\n"
+        "    async def _post_json(self, addr, path, payload):\n"
+        "        return {}\n"
+        "    async def go(self, addr):\n"
+        "        d = await self._post_json(addr, '/q', {})\n"
+        "        return d.get('cached'), d.get('status')\n"
+    )
+    assert rules_in(src, ["WIRE"]) == []
+
+
+def test_wire_body_var_resolves_to_binding_before_call(tmp_path):
+    """A body variable rebound AFTER a call must not retroactively change
+    what that call sent (was a false WIRE002: last-binding-wins)."""
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "from aiohttp import web\n"
+        "class S:\n"
+        "    def build(self):\n"
+        "        app = web.Application()\n"
+        "        app.add_routes([web.post('/p', self.hp),\n"
+        "                        web.post('/q', self.hq)])\n"
+        "        return app\n"
+        "    async def hp(self, request):\n"
+        "        d = await request.json()\n"
+        "        return web.json_response({'r': d.get('a')})\n"
+        "    async def hq(self, request):\n"
+        "        d = await request.json()\n"
+        "        return web.json_response({'r': d.get('b')})\n"
+        "class C:\n"
+        "    async def _post_json(self, addr, path, payload):\n"
+        "        return {}\n"
+        "    async def go(self, addr):\n"
+        "        payload = {'a': 1}\n"
+        "        await self._post_json(addr, '/p', payload)\n"
+        "        payload = {'b': 2}\n"
+        "        await self._post_json(addr, '/q', payload)\n"
+    )
+    assert rules_in(src, ["WIRE"]) == []
+
+
+def test_wire_weak_verb_with_slash_literal_is_not_transport(tmp_path):
+    """get/fetch-named helpers taking slash-shaped strings (name-resolve
+    keys, file paths) are NOT wire traffic — only an http URL argument
+    corroborates a weak verb (was a false WIRE001)."""
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "from aiohttp import web\n"
+        "class S:\n"
+        "    def build(self):\n"
+        "        app = web.Application()\n"
+        "        app.add_routes([web.get('/info', self.h)])\n"
+        "        return app\n"
+        "    async def h(self, request):\n"
+        "        return web.json_response({'v': 1})\n"
+        "class C:\n"
+        "    def get_subtree(self, root):\n"
+        "        return []\n"
+        "    def fetch_file(self, p):\n"
+        "        return b''\n"
+        "    def go(self):\n"
+        "        self.get_subtree('/rollout/servers')\n"
+        "        self.fetch_file('/data/cache')\n"
+    )
+    assert rules_in(src, ["WIRE"]) == []
+
+
+def test_wire_dynamic_status_silences_dead_status_check(tmp_path):
+    """A handler whose status= is computed may return any code: a client
+    branching on one must not fire WIRE004 (was a false dead-branch)."""
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "from aiohttp import web\n"
+        "class S:\n"
+        "    def build(self):\n"
+        "        app = web.Application()\n"
+        "        app.add_routes([web.get('/busy', self.h)])\n"
+        "        return app\n"
+        "    async def h(self, request):\n"
+        "        code = 503 if request.query.get('busy') else 200\n"
+        "        return web.json_response({'ok': True}, status=code)\n"
+        "class C:\n"
+        "    async def _get_json(self, addr, path):\n"
+        "        return {}\n"
+        "    async def go(self, sess, addr):\n"
+        "        d = await self._get_json(addr, '/busy')\n"
+        "        r = await sess.get(f'http://{addr}/busy')\n"
+        "        if r.status == 503:\n"
+        "            return None\n"
+        "        return d\n"
+    )
+    assert rules_in(src, ["WIRE"]) == []
+
+
+def test_lck001_catches_single_statement_two_lock_with(tmp_path):
+    """`with self._a, self._b:` vs nested b->a is the idiomatic shape of
+    the two-lock inversion — the order edge must be recorded."""
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import threading\n"
+        "class E:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a, self._b:\n"
+        "            pass\n"
+        "    def two(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    )
+    assert rules_in(src, ["LCK"]) == ["LCK001"]
+
+
+def test_wire_doc_reads_scoped_to_binding_window(tmp_path):
+    """Reads of a name BEFORE the response binds to it (a local dict
+    reusing the name) or AFTER a rebind are not response reads — and a
+    var bound from two different paths is dropped entirely (both were
+    false WIRE003 classes)."""
+    server = (
+        "from aiohttp import web\n"
+        "class S:\n"
+        "    def build(self):\n"
+        "        app = web.Application()\n"
+        "        app.add_routes([web.post('/a', self.ha),\n"
+        "                        web.post('/b', self.hb)])\n"
+        "        return app\n"
+        "    async def ha(self, request):\n"
+        "        return web.json_response({'k1': 1})\n"
+        "    async def hb(self, request):\n"
+        "        return web.json_response({'k2': 2})\n"
+    )
+    src = tmp_path / "mod.py"
+    src.write_text(
+        server
+        + "class C:\n"
+        "    async def _post_json(self, addr, path, payload):\n"
+        "        return {}\n"
+        "    async def pre_binding_read(self, addr):\n"
+        "        d = {'cfg': 1}\n"
+        "        x = d['cfg']\n"
+        "        d = await self._post_json(addr, '/a', {})\n"
+        "        return x, d.get('k1')\n"
+        "    async def rebound_var(self, addr):\n"
+        "        d = await self._post_json(addr, '/a', {})\n"
+        "        x = d['k1']\n"
+        "        d = await self._post_json(addr, '/b', {})\n"
+        "        return x, d['k2']\n"
+    )
+    assert rules_in(src, ["WIRE"]) == []
+
+
+def test_wire_routeless_client_file_is_silent(tmp_path):
+    """Unknown is silent: a file outside the package with client calls
+    but NO route table of its own (a standalone script talking to an
+    external service) must not fire WIRE001 — there is no contract to
+    check against. Only files carrying both sides get route checks."""
+    src = tmp_path / "loner.py"
+    src.write_text(
+        "class C:\n"
+        "    async def _post_json(self, addr, path, payload):\n"
+        "        return {}\n"
+        "    async def go(self, addr):\n"
+        "        await self._post_json(addr, '/anything-at-all', {'k': 1})\n"
+    )
+    assert rules_in(src, ["WIRE"]) == []
+
+
 def test_new_family_suppression_roundtrip(tmp_path):
     """Inline suppression + baseline matching both work for the dataflow
     families (they key on scope/token exactly like the one-hop rules)."""

@@ -280,6 +280,85 @@ def test_round_payload_carries_gateway_alongside_decode(cache_dir, monkeypatch, 
     assert out["detail"]["sources"]["train"] == "live"
 
 
+def test_cached_r06_shaped_round_keeps_both_scoreboards(
+    cache_dir, monkeypatch, capsys
+):
+    """ISSUE 15 housekeeping: an r06-shaped round (the full modern payload
+    — train with MFU/bubble/learning-health buckets, gateway with routing
+    + autopilot scoreboards) seeded in the cache must fold back with
+    detail.gateway AND detail.train non-null when every live phase
+    wedges, so the first real TPU round since r02 cannot silently regress
+    the scoreboards by dropping a fold key."""
+    _seed(
+        cache_dir,
+        "decode",
+        {"phase": "decode", "tok_s": 6700.0},
+    )
+    _seed(
+        cache_dir,
+        "train",
+        {
+            "phase": "train",
+            "tok_s": 5800.0,
+            "mfu": 0.41,
+            "bubble_fraction": 0.02,
+            "by_lag_bucket": {
+                "0": {"clip_ratio": 0.05, "behave_abs_kl": 0.01,
+                      "cap_hit_share": 0.0, "token_share": 0.6},
+                "1-2": {"clip_ratio": 0.09, "behave_abs_kl": 0.03,
+                        "cap_hit_share": 0.1, "token_share": 0.4},
+            },
+        },
+        n_chips=2,
+    )
+    _seed(
+        cache_dir,
+        "gateway",
+        {
+            "phase": "gateway",
+            "goodput_tok_s": 250.0,
+            "route_policy": "cache_aware",
+            "router_hit_rate": 0.5,
+            "autopilot": {
+                "setpoints": {"max_queue_depth": 16.0},
+                "decisions": 3,
+                "decisions_by_reason": {"queue_wait_high": 3},
+            },
+            "classes": {
+                "interactive": {"ttft_p99_s": 0.4, "goodput_tok_s": 50.0},
+                "rollout": {"ttft_p99_s": 1.1, "goodput_tok_s": 200.0},
+            },
+        },
+    )
+    monkeypatch.setattr(
+        bench,
+        "_spawn_phase",
+        lambda name, deadline=None: {"phase": name, "error": "wedged"},
+    )
+    bench.main()
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    # both scoreboards survive the cached fold, with the modern keys
+    gw = out["detail"]["gateway"]
+    assert gw is not None and gw["goodput_tok_s"] == 250.0
+    assert gw["route_policy"] == "cache_aware"
+    assert gw["autopilot"]["decisions"] == 3
+    assert set(gw["classes"]) == {"interactive", "rollout"}
+    tr = out["detail"]["train"]
+    assert tr is not None and tr["mfu"] == 0.41
+    assert tr["tok_s_per_chip"] == 2900.0
+    assert tr["bubble_fraction"] == 0.02
+    assert set(tr["by_lag_bucket"]) == {"0", "1-2"}
+    assert out["detail"]["sources"]["gateway"].startswith("cached@")
+    assert out["detail"]["sources"]["train"].startswith("cached@")
+    # the headline (harmonic decode+train per-chip) rides the same cached
+    # payloads — non-zero, not 0.0, with the raw decode number in detail
+    assert out["value"] > 0
+    assert out["detail"]["gen_tok_s"] == 6700.0
+
+
 def test_cached_train_payload_still_yields_train_detail(cache_dir, monkeypatch, capsys):
     """A pre-observatory cached train payload (tok/s only) must still fold
     to a non-null detail.train — tok/s/chip computable, mfu/bubble None

@@ -174,3 +174,150 @@ def test_lock_timeout_and_stale_steal(tmp_path):
     f.release()  # stealer finished before the original holder releases
     with _pytest.raises(RuntimeError):
         e.release()
+
+
+# ---------------------------------------------------------------------------
+# etcd lock-scope regressions (arealint LCK003 burn-down): etcd RPCs must
+# run OUTSIDE the repo's _lock — the lock guards only the lease map.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def etcd_repo():
+    from fake_etcd import start_fake_etcd
+
+    server, addr = start_fake_etcd()
+    try:
+        yield Etcd3NameResolveRepo(addr=addr), server.RequestHandlerClass.store
+    finally:
+        server.shutdown()
+
+
+def test_etcd_add_does_not_hold_lock_across_rpcs(etcd_repo):
+    """A slow etcd round-trip inside add() must not serialize every other
+    repo operation behind it (the LCK003 stall: up to 4 x timeout per add
+    with the lock held). Pin: while one thread's add() is blocked inside
+    the lease-grant RPC, the repo lock is free."""
+    import threading
+
+    repo, _ = etcd_repo
+    in_grant = threading.Event()
+    release_grant = threading.Event()
+    orig_grant = repo._grant
+
+    def slow_grant(ttl):
+        in_grant.set()
+        assert release_grant.wait(5.0)
+        return orig_grant(ttl)
+
+    repo._grant = slow_grant
+    t = threading.Thread(
+        target=repo.add, args=("slow/name", "v"), kwargs={"keepalive_ttl": 30}
+    )
+    t.start()
+    try:
+        assert in_grant.wait(5.0)
+        # the add is mid-RPC: the map lock must be FREE (pre-fix this
+        # blocked until the grant returned)
+        acquired = repo._lock.acquire(timeout=1.0)
+        assert acquired, "repo lock held across the etcd grant RPC"
+        repo._lock.release()
+        # ...and an unrelated add on another name completes while the
+        # slow one is still in flight
+        repo.add("fast/name", "v2")
+        assert repo.get("fast/name") == "v2"
+    finally:
+        release_grant.set()
+        t.join(timeout=5.0)
+    assert repo.get("slow/name") == "v"
+    assert repo._leases.get("slow/name") is not None
+
+
+def test_etcd_txn_conflict_restores_lease_bookkeeping(etcd_repo):
+    """create-if-absent conflict: the freshly granted lease is revoked,
+    the previous lease binding is restored in the map, and the name still
+    resolves to the original value — with every RPC outside the lock."""
+    repo, store = etcd_repo
+    repo.add("exp/k", "v1", keepalive_ttl=30)
+    lease1 = repo._leases["exp/k"]
+    assert lease1 in store.leases
+    with pytest.raises(NameEntryExistsError):
+        repo.add("exp/k", "v2", keepalive_ttl=30)
+    # bookkeeping restored: the map still tracks the ORIGINAL lease and
+    # the conflicting add's lease is gone server-side
+    assert repo._leases["exp/k"] == lease1
+    assert set(store.leases) == {lease1}
+    assert repo.get("exp/k") == "v1"
+    # the original lease stays functional: delete revokes it cleanly
+    repo.delete("exp/k")
+    assert lease1 not in store.leases
+
+
+def test_etcd_same_name_adds_serialize_cross_name_stay_concurrent(etcd_repo):
+    """Same-NAME mutations serialize on the per-name lock (two interleaved
+    replace-adds could otherwise bind the key to lease A while B's cleanup
+    revokes A — and revoking a lease deletes its keys); a DIFFERENT name
+    still proceeds while the slow one is mid-RPC (the LCK003 fix)."""
+    import threading
+
+    repo, store = etcd_repo
+    repo.add("ser/k", "v0", replace=True, keepalive_ttl=30)
+    in_grant = threading.Event()
+    release_grant = threading.Event()
+    orig_grant = repo._grant
+    slow_once = [True]
+
+    def slow_grant(ttl):
+        if slow_once[0]:
+            slow_once[0] = False
+            in_grant.set()
+            assert release_grant.wait(5.0)
+        return orig_grant(ttl)
+
+    repo._grant = slow_grant
+    t = threading.Thread(
+        target=repo.add,
+        args=("ser/k", "vA"),
+        kwargs={"replace": True, "keepalive_ttl": 30},
+    )
+    t.start()
+    second_done = threading.Event()
+    try:
+        assert in_grant.wait(5.0)
+        # the same name blocks behind the in-flight add...
+        t2 = threading.Thread(
+            target=lambda: (
+                repo.add("ser/k", "vB", replace=True, keepalive_ttl=30),
+                second_done.set(),
+            )
+        )
+        t2.start()
+        assert not second_done.wait(0.3), "same-name add did not serialize"
+        # ...while another name completes immediately
+        repo.add("ser/other", "w", replace=True, keepalive_ttl=30)
+        assert repo.get("ser/other") == "w"
+    finally:
+        release_grant.set()
+        t.join(timeout=5.0)
+    assert second_done.wait(5.0)
+    # serialized outcome: key resolves, map and server agree on ONE live
+    # lease for the name (pre-fix interleavings left the key deleted or
+    # bound to a revoked lease)
+    assert repo.get("ser/k") == "vB"
+    assert repo._leases["ser/k"] in store.leases
+    ours = {repo._leases["ser/k"], repo._leases["ser/other"]}
+    assert set(store.leases) == ours
+
+
+def test_etcd_keepalive_readd_revokes_old_lease_once(etcd_repo):
+    """replace=True keepalive refresh: the new lease replaces the old in
+    the map and the old lease is revoked server-side AFTER the put — the
+    restructured (lock-narrow) path must keep exactly one live lease."""
+    repo, store = etcd_repo
+    repo.add("exp/ka", "v1", keepalive_ttl=30)
+    lease1 = repo._leases["exp/ka"]
+    repo.add("exp/ka", "v2", replace=True, keepalive_ttl=30)
+    lease2 = repo._leases["exp/ka"]
+    assert lease2 != lease1
+    assert set(store.leases) == {lease2}, "old lease must be revoked"
+    assert repo.get("exp/ka") == "v2"
