@@ -40,6 +40,7 @@ speaking the reference's small protocol (/generate, /pause_generation, ...).
 
 from __future__ import annotations
 
+import contextlib
 import queue
 import threading
 import time
@@ -58,6 +59,8 @@ from areal_tpu.api.io_struct import ModelRequest, ModelResponse, StopReason
 from areal_tpu.models import qwen
 from areal_tpu.models.hf import load_params_from_hf
 from areal_tpu.observability import catalog as obs_catalog
+from areal_tpu.observability import hw_accounting as hw
+from areal_tpu.observability import kernel_probe
 from areal_tpu.observability import timeline as tl_mod
 from areal_tpu.parallel import mesh as mesh_lib
 from areal_tpu.utils.jax_compat import set_mesh
@@ -318,6 +321,12 @@ class DecodeEngine:
         self._autopilot_lock = threading.Lock()
         self._autopilot_knobs: dict[str, float] = {}
         self._autopilot_applied_at: float | None = None
+        # kernel observatory (observability/kernel_probe.py): per-pass phase
+        # timeline + compiled-cost registry. Built in initialize() (peak
+        # resolution may calibrate the host backend); None until then, and
+        # _ktl holds the current pass's open timeline on the decode thread
+        self.kprobe: kernel_probe.KernelProbe | None = None
+        self._ktl: kernel_probe.DecodeStepTimeline | None = None
 
     # -- lifecycle --------------------------------------------------------
     def initialize(self) -> None:
@@ -449,6 +458,13 @@ class DecodeEngine:
         from areal_tpu.utils.compile_cache import enable_persistent_cache
 
         enable_persistent_cache()
+        # kernel observatory: init-time construction (an unknown chip kind
+        # triggers a one-time host peak calibration — device work + host
+        # pulls that must never run on the decode hot path)
+        self.kprobe = kernel_probe.KernelProbe(
+            model_cfg=self.model_cfg,
+            n_chips=int(getattr(self.mesh, "size", 1) or 1),
+        )
         self.initialized = True
         logger.info(
             f"decode engine ready: {S} slots × {T} ctx, "
@@ -1776,7 +1792,12 @@ class DecodeEngine:
                 # ks/vs: [n_layers, A, bucket, KH, hd] -> page scatter
                 return paged_kv.scatter_prefill(cache, ks, vs, flat_pages, psz)
 
-            self._fn_cache[key] = jax.jit(prefill, donate_argnames=("cache",))
+            self._fn_cache[key] = kernel_probe.ProbedFn(
+                jax.jit(prefill, donate_argnames=("cache",)),
+                self.kprobe,
+                key,
+                analytic=self._analytic_prefill_cost(n_prompts * bucket),
+            )
         return self._fn_cache[key]
 
     def _prefill_paged_fn(self, n_prompts: int, bucket: int, wp: int):
@@ -1805,7 +1826,12 @@ class DecodeEngine:
                 )
                 return paged_kv.scatter_prefill(cache, ks, vs, flat_pages, psz)
 
-            self._fn_cache[key] = jax.jit(prefill, donate_argnames=("cache",))
+            self._fn_cache[key] = kernel_probe.ProbedFn(
+                jax.jit(prefill, donate_argnames=("cache",)),
+                self.kprobe,
+                key,
+                analytic=self._analytic_prefill_cost(n_prompts * bucket),
+            )
         return self._fn_cache[key]
 
     def _image_embeds_for(self, group: list[tuple[_Task, int]], ids_np, bucket: int):
@@ -1998,10 +2024,34 @@ class DecodeEngine:
                 )
                 return cache, out_state, rng, packed
 
-            self._fn_cache[key] = jax.jit(
-                chunk, donate_argnames=("cache", "state")
+            self._fn_cache[key] = kernel_probe.ProbedFn(
+                jax.jit(chunk, donate_argnames=("cache", "state")),
+                self.kprobe,
+                key,
+                analytic=self._analytic_chunk_cost(n_steps),
             )
         return self._fn_cache[key]
+
+    def _analytic_chunk_cost(self, n_steps: int) -> tuple[float, float] | None:
+        """Analytic FLOPs/bytes of one decode chunk — the cost_analysis
+        fallback (hw_accounting) for backends that report nothing (CPU).
+        Mean context is taken as half the max window; the roofline wants
+        the right order of magnitude, not token-exact attention FLOPs."""
+        if self.model_cfg is None:
+            return None
+        c = hw.decode_step_costs(
+            self.model_cfg,
+            n_steps,
+            self.config.max_batch_size,
+            self.config.max_seq_len / 2.0,
+        )
+        return (c["flops"], c["bytes"])
+
+    def _analytic_prefill_cost(self, n_tokens: int) -> tuple[float, float] | None:
+        if self.model_cfg is None:
+            return None
+        c = hw.prefill_costs(self.model_cfg, n_tokens)
+        return (c["flops"], c["bytes"])
 
     def _update_fn(self, n: int):
         """Jitted slot-state scatter: one packed fp32 [n, 11+_MAX_STOP] upload
@@ -2310,24 +2360,26 @@ class DecodeEngine:
         # only the suffix; the rest take the plain full-prefill path
         cold: list[tuple[_Task, int]] = []
         warm: list[tuple[_Task, int, list[int], list[int]]] = []
-        for task, slot in primaries:
-            m = self._radix_match(task)
-            if m is None:
-                cold.append((task, slot))
-            else:
-                warm.append((task, slot, m[0], m[1]))
+        with self._kphase("radix_match"):
+            for task, slot in primaries:
+                m = self._radix_match(task)
+                if m is None:
+                    cold.append((task, slot))
+                else:
+                    warm.append((task, slot, m[0], m[1]))
 
         # group by length bucket, prefill in batches of _PREFILL_SIZES
         by_bucket: dict[int, list[tuple[_Task, int]]] = {}
         for task, slot in cold:
             bucket = min(T, round_up_to_bucket(len(task.req.input_ids), 256))
             by_bucket.setdefault(bucket, []).append((task, slot))
-        for bucket, group in sorted(by_bucket.items()):
-            i = 0
-            while i < len(group):
-                A = next(a for a in _PREFILL_SIZES if a <= len(group) - i)
-                rows.extend(self._prefill_group(group[i : i + A], bucket))
-                i += A
+        with self._kphase("prefill"):
+            for bucket, group in sorted(by_bucket.items()):
+                i = 0
+                while i < len(group):
+                    A = next(a for a in _PREFILL_SIZES if a <= len(group) - i)
+                    rows.extend(self._prefill_group(group[i : i + A], bucket))
+                    i += A
         # warm admissions group by SUFFIX bucket (the only tokens prefilled)
         warm_by_bucket: dict[int, list[tuple[_Task, int, list[int], list[int]]]] = {}
         psz = self.config.page_size
@@ -2337,14 +2389,15 @@ class DecodeEngine:
             warm_by_bucket.setdefault(bucket, []).append(
                 (task, slot, mpages, mvers)
             )
-        for bucket, group in sorted(warm_by_bucket.items()):
-            i = 0
-            while i < len(group):
-                A = next(a for a in _PREFILL_SIZES if a <= len(group) - i)
-                rows.extend(
-                    self._prefill_group_prefixed(group[i : i + A], bucket)
-                )
-                i += A
+        with self._kphase("prefill"):
+            for bucket, group in sorted(warm_by_bucket.items()):
+                i = 0
+                while i < len(group):
+                    A = next(a for a in _PREFILL_SIZES if a <= len(group) - i)
+                    rows.extend(
+                        self._prefill_group_prefixed(group[i : i + A], bucket)
+                    )
+                    i += A
         if dup_pairs:
             rows.extend(self._admit_duplicates(dup_pairs))
         return rows
@@ -2979,6 +3032,10 @@ class DecodeEngine:
         return {
             "packed": packed,
             "n_steps": n_steps,
+            # fn-cache key of the chunk program: the kernel probe attributes
+            # this chunk's registered FLOP/byte cost to the pass that DRAINS
+            # it (steady state drains exactly one chunk per pass)
+            "key": ("chunk", n_steps, wp, capped, greedy_any, freq_any),
             "version": self._version,
             "was_active": active.copy(),
             # task identity per slot at dispatch: a slot can turn over
@@ -2988,76 +3045,117 @@ class DecodeEngine:
             "tasks": list(self._slot_task),
         }
 
-    def _drain(self, pending: dict | None) -> None:
+    def _drain(self, pending: dict | None) -> int:
         """Download one chunk's packed emissions (a single transfer) and
         credit tokens / finish tasks. Slots admitted after the chunk was
-        dispatched are excluded via the was_active snapshot."""
+        dispatched are excluded via the was_active snapshot. Returns the
+        credited token count (the kernel probe's per-step tok/s input)."""
         if pending is None:
-            return
-        packed = np.asarray(pending["packed"])  # the one device->host pull
-        n_steps = pending["n_steps"]
-        version = pending["version"]
-        was_active = pending["was_active"]
-        toks = packed[:n_steps]
-        logps = packed[n_steps : 2 * n_steps].view(np.float32)
-        emit_count = packed[2 * n_steps]
-        active = packed[2 * n_steps + 1].astype(bool)
-        pos = packed[2 * n_steps + 2]
-        st = self._state
-        now = time.monotonic()
-        for slot, task in enumerate(pending["tasks"]):
-            if task is None or not was_active[slot]:
-                continue
-            if task is not self._slot_task[slot]:
-                continue  # slot turned over since dispatch; nothing to credit
-            c = int(emit_count[slot])
-            if c:
-                if task.first_token_time is None:
-                    task.first_token_time = now
+            return 0
+        with self._kphase("device_wait"):
+            # the one device->host pull: blocks until the chunk's compute
+            # finishes, so its span IS the visible device time of the pass
+            packed = np.asarray(pending["packed"])
+        credited = 0
+        with self._kphase("bookkeeping"):
+            n_steps = pending["n_steps"]
+            version = pending["version"]
+            was_active = pending["was_active"]
+            toks = packed[:n_steps]
+            logps = packed[n_steps : 2 * n_steps].view(np.float32)
+            emit_count = packed[2 * n_steps]
+            active = packed[2 * n_steps + 1].astype(bool)
+            pos = packed[2 * n_steps + 2]
+            st = self._state
+            now = time.monotonic()
+            for slot, task in enumerate(pending["tasks"]):
+                if task is None or not was_active[slot]:
+                    continue
+                if task is not self._slot_task[slot]:
+                    continue  # slot turned over since dispatch; nothing to credit
+                c = int(emit_count[slot])
+                if c:
+                    credited += c
+                    if task.first_token_time is None:
+                        task.first_token_time = now
+                        if task.timeline is not None:
+                            task.timeline.mark(tl_mod.FIRST_TOKEN)
                     if task.timeline is not None:
-                        task.timeline.mark(tl_mod.FIRST_TOKEN)
-                if task.timeline is not None:
-                    # per-chunk decode cadence; the timeline's event cap
-                    # bounds long generations (durations stay exact)
-                    task.timeline.mark(
-                        tl_mod.DECODE_CHUNK, n_tokens=c, version=version
-                    )
-                self._slot_progress[slot] = now  # watchdog: progress seen
-                # .tolist() converts in C — a genexpr of int()/float() costs
-                # ~S*n_steps Python calls per chunk on the serving hot loop
-                task.out_tokens.extend(toks[:c, slot].tolist())
-                task.out_logprobs.extend(logps[:c, slot].tolist())
-                task.out_versions.extend([version] * c)
-                self.stats["generated_tokens"] += c
-                self._obs.generated_tokens.inc(c)
-            st["pos"][slot] = int(pos[slot])
-            st["ids"][slot] = int(toks[c - 1, slot]) if c else st["ids"][slot]
-            st["remaining"][slot] -= c
-            st["active"][slot] = bool(active[slot])
-            if not active[slot]:
-                last = task.out_tokens[-1] if task.out_tokens else -1
-                g = task.req.gconfig
-                if (
-                    not g.ignore_eos
-                    and last in g.stop_token_ids
-                    and len(task.out_tokens) >= g.min_new_tokens
-                ):
-                    reason = StopReason.STOP.value
-                else:
-                    reason = StopReason.LENGTH.value
-                self._finish(task, reason)
-        self.stats["chunks"] += 1
-        self._obs.chunks.inc()
+                        # per-chunk decode cadence; the timeline's event cap
+                        # bounds long generations (durations stay exact)
+                        task.timeline.mark(
+                            tl_mod.DECODE_CHUNK, n_tokens=c, version=version
+                        )
+                    self._slot_progress[slot] = now  # watchdog: progress seen
+                    # .tolist() converts in C — a genexpr of int()/float() costs
+                    # ~S*n_steps Python calls per chunk on the serving hot loop
+                    task.out_tokens.extend(toks[:c, slot].tolist())
+                    task.out_logprobs.extend(logps[:c, slot].tolist())
+                    task.out_versions.extend([version] * c)
+                    self.stats["generated_tokens"] += c
+                    self._obs.generated_tokens.inc(c)
+                st["pos"][slot] = int(pos[slot])
+                st["ids"][slot] = int(toks[c - 1, slot]) if c else st["ids"][slot]
+                st["remaining"][slot] -= c
+                st["active"][slot] = bool(active[slot])
+                if not active[slot]:
+                    last = task.out_tokens[-1] if task.out_tokens else -1
+                    g = task.req.gconfig
+                    if (
+                        not g.ignore_eos
+                        and last in g.stop_token_ids
+                        and len(task.out_tokens) >= g.min_new_tokens
+                    ):
+                        reason = StopReason.STOP.value
+                    else:
+                        reason = StopReason.LENGTH.value
+                    self._finish(task, reason)
+            self.stats["chunks"] += 1
+            self._obs.chunks.inc()
+        return credited
+
+    def _kphase(self, name: str):
+        """Phase span on the current pass's kernel-probe timeline
+        (observability/kernel_probe.py); a no-op null context outside a
+        recorded pass (shutdown drain, direct calls from tests). Two
+        monotonic-clock reads per span — never a device sync."""
+        tl = self._ktl
+        if tl is None:
+            return contextlib.nullcontext()
+        return tl.phase(name)
+
+    def _abandon_kstep(self) -> None:
+        """Discard the current pass's timeline (idle poll, pause, hold
+        fence, torn-down cache): abandoned passes never reach the phase
+        histograms, so every recorded step is a real chunk-work step."""
+        if self._ktl is not None and self.kprobe is not None:
+            self.kprobe.abandon_step(self._ktl)
+        self._ktl = None
+
+    def kernel_stats(self) -> dict:
+        """Kernel-observatory summary for /statusz ``kernels`` and bench
+        ``detail.kernels`` (None-safe before initialize())."""
+        if self.kprobe is None:
+            return {}
+        return self.kprobe.stats()
 
     def _loop(self) -> None:
         pending: dict | None = None
         while not self._shutdown.is_set():
             # arealint: disable-next=THR001 monotonic float heartbeat: torn reads are impossible for a GIL-protected float rebind and the wedge detector only compares against a multi-second threshold
             self._last_loop_ts = time.monotonic()
+            # kernel observatory: one timeline per pass; idle/paused/held
+            # passes abandon it, so recorded steps are always real chunk
+            # work and the phase-sum identity holds on every record
+            step_tl = (
+                self.kprobe.begin_step() if self.kprobe is not None else None
+            )
+            self._ktl = step_tl
             self._apply_weight_update()
             self._service_radix_flush()
             self._service_radix_cap()
             if self._paused.is_set():
+                self._abandon_kstep()
                 self._drain(pending)
                 pending = None
                 self._abort_all()
@@ -3095,7 +3193,12 @@ class DecodeEngine:
                     )
                     self._held.clear()
                     self._hold_ack.clear()
+                    self._abandon_kstep()
                     continue
+                # a hold-fence pass is abandoned even when it drains the
+                # in-flight chunk: its wall time is fence stall, not a
+                # decode step, and recording it would skew the phase means
+                self._abandon_kstep()
                 drained_chunk = pending is not None
                 self._drain(pending)
                 pending = None
@@ -3135,6 +3238,7 @@ class DecodeEngine:
                 continue
             if self.cache is None:
                 # memory released and not yet resumed: nothing to run on
+                self._abandon_kstep()
                 self._wakeup.wait(timeout=0.05)
                 self._wakeup.clear()
                 continue
@@ -3144,20 +3248,34 @@ class DecodeEngine:
             # overload-safety half of interruptible generation. When a reap
             # fires, the in-flight chunk is drained first (tokens credited)
             # and None comes back; the fast path returns pending untouched.
-            pending = self._reap_lifecycle(pending)
-            # admissions enqueue prefills + ONE packed state scatter; the
-            # in-flight chunk (if any) ordered before them touches only
-            # previously-active slots, so there is no dataflow hazard
-            rows = self._admit_pending()
-            self._apply_slot_updates(rows)
+            with self._kphase("admission"):
+                pending = self._reap_lifecycle(pending)
+                # admissions enqueue prefills + ONE packed state scatter; the
+                # in-flight chunk (if any) ordered before them touches only
+                # previously-active slots, so there is no dataflow hazard
+                rows = self._admit_pending()
+                self._apply_slot_updates(rows)
             # speculatively dispatch the next chunk, then pay the previous
             # chunk's download while this one computes
-            dispatched = self._dispatch_chunk()
-            self._drain(pending)
+            with self._kphase("dispatch"):
+                dispatched = self._dispatch_chunk()
+            drained_key = pending["key"] if pending is not None else None
+            n_drained = self._drain(pending)
             pending = dispatched
+            if step_tl is not None:
+                # a pass that drained, dispatched, or admitted is a real
+                # step; a bare poll (no slots, empty queue) is not
+                if drained_key is not None or dispatched is not None or rows:
+                    self._ktl = None
+                    self.kprobe.complete_step(
+                        step_tl, tokens=n_drained, cost_key=drained_key
+                    )
+                else:
+                    self._abandon_kstep()
             if pending is None:
                 if not any(t is not None for t in self._slot_task):
                     self._wakeup.wait(timeout=0.05)
                     self._wakeup.clear()
+        self._ktl = None
         self._drain(pending)
         self._abort_all()

@@ -249,6 +249,12 @@ class InferenceServer:
             # same key as /debug/flight's stats section — over THERE
             # "timelines" is the list of timeline records
             out["timeline_stats"] = tl.stats()
+        ks = getattr(self.engine, "kernel_stats", None)
+        if ks is not None:
+            # kernel observatory (docs/perf.md "Kernel observatory"):
+            # per-pass phase means, dominant phase, roofline fraction, and
+            # the compiled-program cost registry with source provenance
+            out["kernels"] = ks()
         hb = getattr(self.engine, "hbm_ledger", None)
         if hb is not None:
             try:

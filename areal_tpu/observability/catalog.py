@@ -815,10 +815,37 @@ def aggregator_metrics(reg: Registry | None = None) -> SimpleNamespace:
     )
 
 
+def kernel_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """Kernel observatory (observability/kernel_probe.py): per-decode-step
+    phase attribution + roofline join (docs/perf.md "Kernel observatory")."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        phase_seconds=r.histogram(
+            "areal_decode_phase_seconds",
+            "Per-decode-step host wall seconds by loop phase (admission, "
+            "radix_match, prefill, dispatch, device_wait, bookkeeping, "
+            "other); named phases + other sum exactly to the step wall.",
+            label_names=("phase",),
+            buckets=FAST_BUCKETS,
+        ),
+        step_flops=r.gauge(
+            "areal_decode_step_flops",
+            "Model FLOPs of the last drained decode chunk, from the "
+            "compiled executable's cost_analysis or the analytic fallback.",
+        ),
+        roofline_fraction=r.gauge(
+            "areal_decode_roofline_fraction",
+            "Achieved over attainable FLOP/s of the last completed decode "
+            "step: attainable = min(peak FLOPs, intensity x peak HBM bw).",
+        ),
+    )
+
+
 ALL_FACTORIES = (
     staleness_metrics,
     executor_metrics,
     engine_metrics,
+    kernel_metrics,
     prefix_cache_metrics,
     lifecycle_metrics,
     timeline_metrics,

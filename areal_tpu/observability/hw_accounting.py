@@ -27,6 +27,7 @@ Formulas (documented in docs/observability.md "Trainer observatory"):
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import numpy as np
@@ -43,6 +44,19 @@ CHIP_SPECS: tuple[tuple[str, float, float], ...] = (
     ("v4", 275e12, 32e9),
     ("v3", 123e12, 32e9),
     ("v2", 46e12, 16e9),
+)
+
+# peak HBM bandwidth (bytes/s) per chip, same key scheme + match order as
+# CHIP_SPECS; the roofline's memory ceiling (kernel_probe)
+CHIP_MEMBW: tuple[tuple[str, float], ...] = (
+    ("v6e", 1640e9),
+    ("v6 lite", 1640e9),
+    ("v5p", 2765e9),
+    ("v5e", 819e9),
+    ("v5 lite", 819e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
 )
 
 
@@ -76,6 +90,23 @@ def chip_hbm_bytes(
     for sub, _flops, hbm in CHIP_SPECS:
         if sub in kind:
             return hbm
+    return None
+
+
+def chip_peak_membw(
+    device: Any | None = None, override_gbps: float | None = None
+) -> float | None:
+    """Peak HBM bandwidth (bytes/s) of one chip; the roofline memory
+    ceiling. Unknown kinds return None — the roofline then degrades to a
+    compute-only ceiling rather than inventing a bandwidth."""
+    if override_gbps is not None and override_gbps > 0:
+        return float(override_gbps) * 1e9
+    kind = _device_kind(device)
+    if kind is None:
+        return None
+    for sub, bw in CHIP_MEMBW:
+        if sub in kind:
+            return bw
     return None
 
 
@@ -134,6 +165,133 @@ def train_step_flops(
     m = transformer_param_counts(mcfg)["matmul"]
     per_tok = (6 + (2 if remat else 0) + 2 * max(0, n_extra_forwards)) * m
     return float(per_tok) * float(n_tokens)
+
+
+# ---------------------------------------------------------------------------
+# decode-side analytic costs (kernel_probe fallback when the backend's
+# cost_analysis returns nothing, e.g. CPU) + host peak calibration
+# ---------------------------------------------------------------------------
+
+_DTYPE_BYTES = {
+    "float32": 4,
+    "bfloat16": 2,
+    "float16": 2,
+    "float8_e4m3fn": 1,
+    "int8": 1,
+}
+
+
+def _param_dtype_bytes(mcfg) -> int:
+    return _DTYPE_BYTES.get(str(getattr(mcfg, "dtype", "bfloat16")), 2)
+
+
+def decode_step_costs(
+    mcfg,
+    n_steps: int,
+    n_slots: int,
+    ctx_len: float,
+    kv_bytes_per_elem: int | None = None,
+) -> dict[str, float]:
+    """Analytic FLOPs + HBM bytes of one fused decode chunk (``n_steps``
+    sampling steps over ``n_slots`` batch slots at mean context
+    ``ctx_len``). Per token: 2·M matmul FLOPs + 4·L·ctx·q_dim attention
+    (QKᵀ + PV, 2 FLOPs each); bytes = the full matmul weight read once per
+    *step* (batch slots share it) + each token's KV history read."""
+    pc = transformer_param_counts(mcfg)
+    L = mcfg.num_layers
+    q_dim = mcfg.num_heads * mcfg.head_dim_
+    kv_dim = mcfg.num_kv_heads * mcfg.head_dim_
+    kvb = kv_bytes_per_elem or _param_dtype_bytes(mcfg)
+    tokens = float(n_steps) * float(n_slots)
+    attn_flops = 4.0 * L * float(ctx_len) * q_dim
+    flops = tokens * (2.0 * pc["matmul"] + attn_flops)
+    kv_read = float(ctx_len) * kv_dim * 2.0 * kvb * L
+    nbytes = (
+        float(n_steps) * pc["matmul"] * _param_dtype_bytes(mcfg)
+        + tokens * kv_read
+    )
+    return {"flops": flops, "bytes": nbytes, "tokens": tokens}
+
+
+def prefill_costs(mcfg, n_tokens: float) -> dict[str, float]:
+    """Analytic FLOPs + bytes of prefilling ``n_tokens`` prompt tokens:
+    2·M per token + causal attention 2·L·T²·q_dim; bytes = one weight
+    read + the KV write."""
+    pc = transformer_param_counts(mcfg)
+    L = mcfg.num_layers
+    q_dim = mcfg.num_heads * mcfg.head_dim_
+    kv_dim = mcfg.num_kv_heads * mcfg.head_dim_
+    T = float(n_tokens)
+    flops = 2.0 * pc["matmul"] * T + 2.0 * L * T * T * q_dim
+    b = _param_dtype_bytes(mcfg)
+    nbytes = pc["matmul"] * b + T * kv_dim * 2.0 * b * L
+    return {"flops": flops, "bytes": nbytes, "tokens": T}
+
+
+def decode_device_attribution(mcfg, ctx_len: float = 512.0) -> dict[str, float]:
+    """FLOP-share split of the fused decode chunk's device window into the
+    phases the host cannot time without a sync: page gather (KV reads —
+    bandwidth work, reported as its byte share of a step), attention+MLP
+    forward, and sampling (logits softmax/top-k — vocab-sized). Shares sum
+    to 1.0; they attribute the measured ``dispatch``+``device_wait``
+    window analytically (docs/perf.md)."""
+    pc = transformer_param_counts(mcfg)
+    L = mcfg.num_layers
+    q_dim = mcfg.num_heads * mcfg.head_dim_
+    attn = 4.0 * L * float(ctx_len) * q_dim
+    forward = 2.0 * pc["matmul"] + attn
+    sampling = 6.0 * mcfg.vocab_size  # softmax + transform + select, ~O(V)
+    costs = decode_step_costs(mcfg, 1, 1, ctx_len)
+    gather_bytes = costs["bytes"] - pc["matmul"] * _param_dtype_bytes(mcfg)
+    total = forward + sampling
+    return {
+        "attention_mlp_forward": forward / total,
+        "sampling": sampling / total,
+        "page_gather_byte_share": (
+            gather_bytes / costs["bytes"] if costs["bytes"] else 0.0
+        ),
+    }
+
+
+# one-time measured host peaks per backend (CPU has no CHIP_SPECS row);
+# process-lifetime cache so repeated engine constructions don't re-pay it
+_CALIBRATED: dict[str, tuple[float, float]] = {}
+
+
+def calibrate_host_peaks(force: bool = False) -> tuple[float, float]:
+    """Measure the current backend's achievable peak FLOPs/s (small f32
+    matmul) and memory bandwidth (large array copy, read+write), best of
+    three after a warm-up. Init-time only — this does real device work
+    and host pulls, and must never be called from the decode hot path.
+    Timing uses host scalar pulls, not ``block_until_ready`` (which does
+    not synchronize on the axon backend — docs/perf.md)."""
+    import jax
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    if not force and backend in _CALIBRATED:
+        return _CALIBRATED[backend]
+    n = 384
+    a = jnp.ones((n, n), jnp.float32)
+    mm = jax.jit(lambda x, y: x @ y)
+    _ = np.asarray(mm(a, a))  # compile + warm
+    best_f = 0.0
+    for _i in range(3):
+        t0 = time.monotonic()
+        _ = np.asarray(mm(a, a)).ravel()[0]
+        dt = max(1e-9, time.monotonic() - t0)
+        best_f = max(best_f, 2.0 * n * n * n / dt)
+    big = jnp.ones((4 * 1024 * 1024,), jnp.float32)  # 16 MiB
+    cp = jax.jit(lambda x: x + 1.0)
+    _ = np.asarray(cp(big))
+    best_b = 0.0
+    for _i in range(3):
+        t0 = time.monotonic()
+        _ = np.asarray(cp(big)).ravel()[0]
+        dt = max(1e-9, time.monotonic() - t0)
+        best_b = max(best_b, 2.0 * big.nbytes / dt)
+    _CALIBRATED[backend] = (best_f, best_b)
+    return _CALIBRATED[backend]
 
 
 # ---------------------------------------------------------------------------

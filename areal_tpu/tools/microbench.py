@@ -1,0 +1,1222 @@
+"""Standing microbench registry + noise-aware regression gate.
+
+The kernel observatory's second half (docs/perf.md "Kernel observatory"):
+where observability/kernel_probe.py attributes *production* decode steps,
+this module pins each hot-path kernel in isolation so a regression shows
+up as one number moving, not as a 3%% end-to-end drift nobody can bisect.
+
+Registered benches (fast set — the committed CPU baseline under
+benchmarks/):
+
+    paged_decode_step    one forward_decode_paged step over all slots
+    suffix_prefill       radix-suffix prefill over a cached prefix
+    int8_kv_dequant      KV quantize->dequantize round trip
+    tree_verify_forward  ancestor-masked forest forward (no_grad)
+    radix_match          host-side radix prefix walk (no device work)
+    weight_stage_encode  weight-bucket wire encoding (server push path)
+
+Heavy benches (``--heavy`` / named via ``--benches``; engine- or
+trainer-level, minutes not seconds — these subsume the retired root
+prof_* scripts, see docs/perf.md "Reproduction"):
+
+    decode_engine_steady  live DecodeEngine steady-state tok/s + the
+                          probe's achieved roofline   (was prof_decode /
+                          prof_r3 phase_decode; BENCH_QUANT=int8 covers
+                          prof_r4 phase_int8)
+    train_step            fwd+bwd+CE optimizer-shaped step (prof_r3
+                          phase_train)
+    tree_train            grad through the ancestor-mask forward
+                          (prof_r5 phase_tree)
+    weight_update         paused LoRA-delta fold + one full mem-path
+                          push on a live engine (prof_r4 phase_wu)
+
+Every bench emits ``{wall_s, tok_s, flops, bytes, roofline_frac,
+noise_frac}`` measured with warm-up + median-of-N (the PR 12 lesson:
+first-call compile and cache replay must never land in the measured
+window; timing syncs by pulling a host scalar because
+``block_until_ready`` does not synchronize on the axon backend).
+
+``--compare BASELINE.json`` applies a noise-aware relative threshold per
+bench — regression iff ``cur > base * (1 + max(threshold, 2*noise)) +
+floor`` — and exits nonzero iff any bench regresses; new/missing entries
+are warnings, not failures, so adding a bench never breaks CI.
+
+Modes (ported from the retired scripts):
+
+    --ladder      unattended measurement ladder (was prof_ladder.py):
+                  SIGALRM-raising children, TPU probe between steps,
+                  done-file resume under .bench_cache/ladder_done.json
+    --learn-gate  on-chip RL learning gate through the full stack
+                  (was prof_learn.py); excluded from --compare
+
+Dims default tiny (CPU-runnable, the committed baseline);
+``MICROBENCH_FULL=1`` switches to bench.py's MODEL_KW (Qwen2.5-1.5B).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+DEFAULT_ITERS = 7
+DEFAULT_WARMUP = 2
+# relative slack below which a move is never a regression. Measured on
+# this image: identical back-to-back suites differ up to ~45% on ms-scale
+# kernels — the variance is CROSS-PROCESS (container CPU contention slows
+# a whole run), so neither median-of-N nor min-of-N inside one process can
+# average it away. The gate therefore targets kernel-scale regressions
+# (a 2x is always flagged: 2.0 > 1.6 + floor) and stays silent on drift
+# smaller than the machine's own run-to-run wobble; the per-bench measured
+# noise_frac widens the margin further for intrinsically jumpy benches.
+DEFAULT_THRESHOLD = 0.6
+# absolute floor: sub-millisecond medians can move tens of µs on one
+# scheduler hiccup regardless of the kernel under test
+NOISE_FLOOR_S = 5e-5
+
+REGISTRY: dict[str, dict[str, Any]] = {}
+
+
+def register(name: str, *, heavy: bool = False) -> Callable:
+    """Class-of-one decorator: the registered fn is a SETUP fn returning
+    ``{"run": closure, "tokens"?, "flops"?, "bytes"?}`` (the harness times
+    ``run``), or ``{"entry": {...}}`` for benches that self-measure (the
+    engine-level heavies, where one "iteration" is a multi-second run)."""
+
+    def deco(fn: Callable) -> Callable:
+        REGISTRY[name] = {"fn": fn, "heavy": heavy, "doc": (fn.__doc__ or "").strip().splitlines()[0] if fn.__doc__ else ""}
+        return fn
+
+    return deco
+
+
+def _sync(x: Any) -> Any:
+    """Force completion by pulling one host scalar (NOT block_until_ready,
+    which does not synchronize on the axon backend — docs/perf.md)."""
+    import jax
+
+    return np.asarray(jax.tree.leaves(x)[0]).ravel()[0]
+
+
+def model_cfg():
+    """Tiny CPU-runnable dims by default; MICROBENCH_FULL=1 uses bench.py's
+    MODEL_KW (Qwen2.5-1.5B) so the TPU ladder measures the real model."""
+    from areal_tpu.models import qwen
+
+    if os.environ.get("MICROBENCH_FULL"):
+        from bench import MODEL_KW  # bench.py owns the 1.5B dims
+
+        return qwen.ModelConfig(**MODEL_KW)
+    return qwen.ModelConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        dtype="float32",
+        tie_word_embeddings=True,
+        attention_bias=True,
+        rope_theta=10000.0,
+    )
+
+
+_CTX: dict[str, Any] = {}
+
+
+def _ctx() -> dict[str, Any]:
+    """Shared per-process setup (params init + jit are the expensive part;
+    every bench reuses one tree)."""
+    if _CTX:
+        return _CTX
+    import jax
+
+    from areal_tpu.models import qwen
+
+    cfg = model_cfg()
+    params = jax.jit(lambda k: qwen.init_params(k, cfg))(jax.random.PRNGKey(0))
+    _sync(params)
+    full = bool(os.environ.get("MICROBENCH_FULL"))
+    _CTX.update(
+        cfg=cfg,
+        params=params,
+        full=full,
+        page_size=128 if full else 16,
+        n_slots=32 if full else 8,
+    )
+    return _CTX
+
+
+# ---------------------------------------------------------------------------
+# fast benches (the committed CPU baseline)
+# ---------------------------------------------------------------------------
+
+
+@register("paged_decode_step")
+def bench_paged_decode_step() -> dict:
+    """One forward_decode_paged step for all slots over a warm paged KV."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.inference.paged_kv import init_paged_cache
+    from areal_tpu.models import qwen
+    from areal_tpu.observability import hw_accounting as hw
+
+    c = _ctx()
+    cfg, psz, S = c["cfg"], c["page_size"], 4 * c["n_slots"]
+    ctx_len = 7 * psz  # seven warm pages per slot
+    wp = ctx_len // psz + 1
+    n_pages = S * wp + 1
+    cache = init_paged_cache(cfg, n_pages, psz)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, S), jnp.int32)
+    pos = jnp.full((S,), ctx_len, jnp.int32)
+    table = jnp.asarray(
+        1 + np.arange(S * wp, dtype=np.int32).reshape(S, wp)
+    )
+    use_kernel = jax.default_backend() == "tpu"
+    step = jax.jit(
+        lambda i, p, kv, t: qwen.forward_decode_paged(
+            c["params"], cfg, i, p, kv, t, page_size=psz, use_kernel=use_kernel
+        )[0]
+    )
+    costs = hw.decode_step_costs(cfg, 1, S, float(ctx_len))
+    return {
+        "run": lambda: _sync(step(ids, pos, cache, table)),
+        "tokens": S,
+        "flops": costs["flops"],
+        "bytes": costs["bytes"],
+    }
+
+
+@register("suffix_prefill")
+def bench_suffix_prefill() -> dict:
+    """Radix-suffix prefill: queries attend over one cached prefix page."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.inference.paged_kv import init_paged_cache
+    from areal_tpu.models import qwen
+    from areal_tpu.observability import hw_accounting as hw
+
+    c = _ctx()
+    cfg, psz = c["cfg"], c["page_size"]
+    A, B = 4, 2 * psz  # suffix bucket: two pages of new tokens per row
+    wp = 4
+    cache = init_paged_cache(cfg, A * wp + 1, psz)
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (A, B)), jnp.int32)
+    offs = np.full((A,), psz, np.int32)  # one page already cached
+    positions = jnp.asarray(offs[:, None] + np.arange(B, dtype=np.int32))
+    seg = jnp.ones((A, B), jnp.int32)
+    table = jnp.asarray(1 + np.arange(A * wp, dtype=np.int32).reshape(A, wp))
+    fn = jax.jit(
+        lambda i, p, s, kv, t, o: qwen.forward_prefill_paged(
+            c["params"], cfg, i, p, s, kv, t, o
+        )[1]
+    )
+    offs_d = jnp.asarray(offs)
+    costs = hw.prefill_costs(cfg, float(A * B))
+    return {
+        "run": lambda: _sync(fn(ids, positions, seg, cache, table, offs_d)),
+        "tokens": A * B,
+        "flops": costs["flops"],
+        "bytes": costs["bytes"],
+    }
+
+
+@register("int8_kv_dequant")
+def bench_int8_kv_dequant() -> dict:
+    """KV int8 quantize -> dequantize round trip (the serving KV-cache
+    compression path; decode reads pay the dequant side every step)."""
+    import jax
+
+    from areal_tpu.inference.paged_kv import dequantize_kv, quantize_kv
+
+    c = _ctx()
+    cfg = c["cfg"]
+    n_tok = 16384 if c["full"] else 8192
+    x = jax.numpy.asarray(
+        np.random.default_rng(2).normal(
+            0, 1, (cfg.num_layers, cfg.num_kv_heads, n_tok, cfg.head_dim_)
+        ).astype(np.float32)
+    )
+    rt = jax.jit(lambda t: dequantize_kv(*quantize_kv(t), t.dtype))
+    nelem = float(x.size)
+    return {
+        "run": lambda: _sync(rt(x)),
+        "tokens": None,
+        # abs/max/scale/rint/clip on the way down, one fma on the way up
+        "flops": 8.0 * nelem,
+        # f32 read + int8 write + int8 read + f32 write (+ scales, small)
+        "bytes": 10.0 * nelem,
+    }
+
+
+@register("tree_verify_forward")
+def bench_tree_verify_forward() -> dict:
+    """Ancestor-masked forest forward (no_grad): the tree-verify step of
+    speculative/tree decoding — shared prefixes scored once."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.models import qwen
+    from areal_tpu.models.tree import build_tree
+    from areal_tpu.observability import hw_accounting as hw
+
+    c = _ctx()
+    cfg = c["cfg"]
+    rng = np.random.default_rng(3)
+    base = 48 if c["full"] else 16
+    seqs = [list(rng.integers(1, cfg.vocab_size, base + int(rng.integers(0, 8)))) for _ in range(8)]
+    for i in range(4, 8):  # force shared prefixes: real GRPO-group shape
+        seqs[i] = seqs[i - 4][: base // 2] + seqs[i]
+    pack = build_tree(seqs)
+    N = pack.n_nodes
+    ids = jnp.asarray(pack.tokens, jnp.int32)[None]
+    pos = jnp.asarray(pack.depth, jnp.int32)[None]
+    seg = jnp.ones((1, N), jnp.int32)
+    mask = jnp.asarray(pack.ancestor_mask())[None, None]
+    fn = jax.jit(
+        lambda i, s, p, m: qwen.forward(
+            c["params"], cfg, i, s, p, attn_mask=m, no_grad=True
+        )
+    )
+    costs = hw.prefill_costs(cfg, float(N))
+    return {
+        "run": lambda: _sync(fn(ids, seg, pos, mask)),
+        "tokens": N,
+        "flops": costs["flops"],
+        "bytes": costs["bytes"],
+    }
+
+
+@register("radix_match")
+def bench_radix_match() -> dict:
+    """Host-side radix prefix walk: the admission-time lookup kernel_probe
+    times as the radix_match phase. Pure host — no device work."""
+    from areal_tpu.inference.paged_kv import PagePool, RadixPrefixCache
+
+    c = _ctx()
+    psz = c["page_size"]
+    depth = 8  # pages per published prompt
+    n_pub, n_probe = 64, 32
+    pool = PagePool(n_pub * depth + 64)
+    cache = RadixPrefixCache(pool, psz, max_pages=n_pub * depth)
+    rng = np.random.default_rng(4)
+    shared = rng.integers(1, 200, 4 * psz)
+    pubs = []
+    for _ in range(n_pub):
+        tail = rng.integers(1, 200, (depth - 4) * psz)
+        pubs.append(np.concatenate([shared, tail]))
+    for ids in pubs:
+        pages = pool.alloc(depth)
+        assert pages is not None
+        cache.insert(ids, pages, [0] * depth)
+    probes = [pubs[i % n_pub][: (depth - 1) * psz] for i in range(n_probe)]
+
+    def run() -> int:
+        hits = 0
+        for p in probes:
+            pages, _v = cache.match(p)
+            hits += len(pages)
+        return hits
+
+    return {
+        "run": run,
+        "tokens": sum(len(p) for p in probes),
+        "flops": None,
+        "bytes": None,
+    }
+
+
+@register("weight_stage_encode")
+def bench_weight_stage_encode() -> dict:
+    """Weight-bucket wire encoding: the per-bucket host cost of a staged
+    mem-mode weight push (server.encode_weight_bucket)."""
+    from areal_tpu.inference.server import encode_weight_bucket
+
+    c = _ctx()
+    mb = 64 if c["full"] else 4
+    arr = np.random.default_rng(5).normal(0, 1, (mb * 256 * 1024,)).astype(np.float32)
+    entries = [("layers/wq", arr), ("layers/wo", arr[: arr.size // 2])]
+    nbytes = float(sum(a.nbytes for _n, a in entries))
+    return {
+        "run": lambda: len(encode_weight_bucket(entries)),
+        "tokens": None,
+        "flops": None,
+        "bytes": 2.0 * nbytes,  # one read + one write of the payload
+    }
+
+
+# ---------------------------------------------------------------------------
+# heavy benches (engine/trainer level; subsume the retired prof_* scripts)
+# ---------------------------------------------------------------------------
+
+
+def _make_engine():
+    import jax
+
+    from areal_tpu.api.config import MeshConfig, ServerConfig
+    from areal_tpu.inference.decode_engine import DecodeEngine
+
+    c = _ctx()
+    full = c["full"]
+    scfg = ServerConfig(
+        max_batch_size=128 if full else 8,
+        max_seq_len=512 if full else 128,
+        decode_steps_per_call=32 if full else 8,
+        quantization=os.environ.get("BENCH_QUANT", "none"),
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+    )
+    # the engine's weight-update paths DONATE the served buffers (the LoRA
+    # fold frees the fold base) — hand it a private host copy so the shared
+    # _ctx() tree survives for later benches in the same process
+    host = jax.tree.map(np.asarray, c["params"])
+    eng = DecodeEngine(scfg, params=host, model_cfg=c["cfg"])
+    eng.initialize()
+    return eng, scfg, host
+
+
+@register("decode_engine_steady", heavy=True)
+def bench_decode_engine_steady() -> dict:
+    """Live DecodeEngine steady state: continuous-batched tok/s plus the
+    kernel probe's achieved roofline over the same window (was
+    prof_decode / prof_r3 phase_decode; BENCH_QUANT=int8 gives the
+    prof_r4 phase_int8 comparison)."""
+    import threading
+
+    from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+
+    c = _ctx()
+    eng, scfg, _host = _make_engine()
+    eng.start()
+    try:
+        rng = np.random.default_rng(0)
+        n_req = 128 if c["full"] else 32
+        new_tokens = 128 if c["full"] else 32
+        plen = scfg.max_seq_len // 4
+        done = threading.Event()
+        results: list = []
+        lock = threading.Lock()
+
+        def cb(resp):
+            with lock:
+                results.append(resp)
+                if len(results) == n_req:
+                    done.set()
+
+        warm = ModelRequest(
+            input_ids=rng.integers(1, c["cfg"].vocab_size, plen).tolist(),
+            gconfig=GenerationHyperparameters(max_new_tokens=8, greedy=True),
+        )
+        eng.generate_sync(warm, timeout=600.0)
+        t0 = time.monotonic()
+        for _ in range(n_req):
+            eng.submit(
+                ModelRequest(
+                    input_ids=rng.integers(1, c["cfg"].vocab_size, plen).tolist(),
+                    gconfig=GenerationHyperparameters(
+                        max_new_tokens=new_tokens, temperature=1.0
+                    ),
+                ),
+                cb,
+            )
+        done.wait(timeout=1200.0)
+        dt = max(1e-9, time.monotonic() - t0)
+        with lock:
+            gen = sum(len(r.output_tokens) for r in results)
+        ks = eng.kernel_stats()
+        return {
+            "entry": {
+                "wall_s": dt,
+                "tok_s": gen / dt,
+                "flops": ks.get("flops_total"),
+                "bytes": None,
+                "roofline_frac": ks.get("roofline_fraction"),
+                "noise_frac": 0.0,
+                "dominant_phase": ks.get("dominant_phase"),
+                "requests_done": len(results),
+            }
+        }
+    finally:
+        eng.stop()
+
+
+@register("train_step", heavy=True)
+def bench_train_step() -> dict:
+    """Fwd+bwd cross-entropy step — the optimizer-shaped FLOPs path (was
+    prof_r3 phase_train)."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.models import qwen
+    from areal_tpu.observability import hw_accounting as hw
+
+    c = _ctx()
+    cfg = c["cfg"]
+    B, T = (8, 512) if c["full"] else (4, 64)
+    rng = np.random.default_rng(6)
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (B, T)), jnp.int32)
+    labels = jnp.asarray(rng.integers(1, cfg.vocab_size, (B, T)), jnp.int32)
+    seg = jnp.ones((B, T), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+
+    def loss_fn(p, i, l):
+        logits = qwen.forward(p, cfg, i, seg, pos)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.take_along_axis(logp, l[..., None], -1).mean()
+
+    grad = jax.jit(jax.grad(loss_fn))
+    return {
+        "run": lambda: _sync(grad(c["params"], ids, labels)),
+        "tokens": B * T,
+        "flops": hw.train_step_flops(cfg, float(B * T)),
+        "bytes": None,
+        "warmup": 1,
+    }
+
+
+@register("tree_train", heavy=True)
+def bench_tree_train() -> dict:
+    """Grad through the ancestor-mask forest forward — the tree-training
+    FLOP-reduction path (was prof_r5 phase_tree)."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.models import qwen
+    from areal_tpu.models.tree import build_tree
+    from areal_tpu.observability import hw_accounting as hw
+
+    c = _ctx()
+    cfg = c["cfg"]
+    rng = np.random.default_rng(7)
+    base = 64 if c["full"] else 20
+    seqs = [list(rng.integers(1, cfg.vocab_size, base)) for _ in range(8)]
+    for i in range(4, 8):
+        seqs[i] = seqs[i - 4][: base // 2] + seqs[i]
+    pack = build_tree(seqs)
+    N = pack.n_nodes
+    ids = jnp.asarray(pack.tokens, jnp.int32)[None]
+    pos = jnp.asarray(pack.depth, jnp.int32)[None]
+    seg = jnp.ones((1, N), jnp.int32)
+    mask = jnp.asarray(pack.ancestor_mask())[None, None]
+    labels = jnp.asarray(np.roll(pack.tokens, -1), jnp.int32)[None]
+
+    def loss_fn(p):
+        logits = qwen.forward(p, cfg, ids, seg, pos, attn_mask=mask)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.take_along_axis(logp, labels[..., None], -1).mean()
+
+    grad = jax.jit(jax.grad(loss_fn))
+    return {
+        "run": lambda: _sync(grad(c["params"])),
+        "tokens": N,
+        "flops": hw.train_step_flops(cfg, float(N)),
+        "bytes": None,
+        "warmup": 1,
+    }
+
+
+@register("weight_update", heavy=True)
+def bench_weight_update() -> dict:
+    """Paused weight-update latency on a live engine: the LoRA-delta fold
+    (measured, LoRA FIRST — any full update invalidates the engine's
+    delta-fold base by design) plus one full mem-path push reported as
+    ``full_update_s`` (was prof_r4 phase_wu)."""
+    import jax
+
+    c = _ctx()
+    eng, _scfg, host = _make_engine()
+    eng.start()
+    try:
+        rng = np.random.default_rng(8)
+        lora = {}
+        for t in ("wq", "wk", "wv", "wo"):
+            L, d_in, d_out = c["params"]["layers"][t].shape
+            lora[f"layers/{t}_lora_a"] = rng.normal(0, 0.01, (L, d_in, 32)).astype(np.float32)
+            # b == 0: repeated folds leave the served weights unchanged
+            lora[f"layers/{t}_lora_b"] = np.zeros((L, 32, d_out), np.float32)
+        version = [1]
+
+        def fold():
+            version[0] += 1
+            eng.pause_generation()
+            eng.update_weights_lora(lora, scale=0.5, version=version[0])
+            eng.continue_generation()
+            _sync(eng.params["layers"]["wq"])
+
+        fold()  # warm the fold-fn compile outside the measured window
+        wall, noise, _s = _measure(fold, iters=3, warmup=0)
+        # one full mem-path push, measured once (it invalidates the LoRA
+        # base, so it must come LAST)
+        t0 = time.monotonic()
+        eng.pause_generation()
+        eng.update_weights_from_params(host, version=version[0] + 1)
+        eng.continue_generation()
+        _sync(eng.params["layers"]["wq"])
+        full_s = time.monotonic() - t0
+        return {
+            "entry": {
+                "wall_s": wall,
+                "tok_s": None,
+                "flops": None,
+                "bytes": float(sum(a.nbytes for a in lora.values())),
+                "roofline_frac": None,
+                "noise_frac": noise,
+                "full_update_s": full_s,
+            }
+        }
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+
+def _measure(fn: Callable, *, iters: int, warmup: int) -> tuple[float, float, list[float]]:
+    """Warm-up + median-of-N; noise_frac = MAD/median of the measured
+    samples (robust against single outliers — a max-based spread on
+    sub-ms benches reads one scheduler hiccup as 50-80%% "noise" and
+    would widen the compare margin past a genuine 2x regression)."""
+    for _ in range(warmup):
+        fn()
+    samples = []
+    for _ in range(max(1, iters)):
+        t0 = time.monotonic()
+        fn()
+        samples.append(time.monotonic() - t0)
+    med = statistics.median(samples)
+    mad = statistics.median([abs(s - med) for s in samples])
+    noise = mad / med if med > 0 else 0.0
+    return med, noise, samples
+
+
+def _peaks() -> dict[str, Any]:
+    import jax
+
+    from areal_tpu.observability import hw_accounting as hw
+
+    dev = jax.devices()[0]
+    pf = hw.chip_peak_flops(dev)
+    pb = hw.chip_peak_membw(dev)
+    if pf is not None:
+        return {"flops": pf, "membw": pb, "source": "spec"}
+    cf, cb = hw.calibrate_host_peaks()
+    return {"flops": cf, "membw": cb, "source": "calibrated"}
+
+
+def run_bench(name: str, *, iters: int, warmup: int, peaks: dict) -> dict:
+    from areal_tpu.observability import kernel_probe
+
+    spec = REGISTRY[name]
+    b = spec["fn"]()
+    if "entry" in b:
+        return b["entry"]
+    wall, noise, _samples = _measure(
+        b["run"], iters=iters, warmup=b.get("warmup", warmup)
+    )
+    tokens = b.get("tokens")
+    flops = b.get("flops")
+    nbytes = b.get("bytes")
+    return {
+        "wall_s": wall,
+        "tok_s": (tokens / wall) if tokens else None,
+        "flops": flops,
+        "bytes": nbytes,
+        "roofline_frac": kernel_probe.roofline_fraction(
+            flops or 0.0, nbytes or 0.0, wall, peaks["flops"], peaks["membw"]
+        ),
+        "noise_frac": noise,
+    }
+
+
+def run_suite(
+    names: list[str], *, iters: int = DEFAULT_ITERS, warmup: int = DEFAULT_WARMUP
+) -> dict:
+    import jax
+
+    peaks = _peaks()
+    out = {
+        "schema": 1,
+        "backend": jax.default_backend(),
+        "full": bool(os.environ.get("MICROBENCH_FULL")),
+        "peaks": peaks,
+        "benches": {},
+    }
+    for name in names:
+        t0 = time.monotonic()
+        entry = run_bench(name, iters=iters, warmup=warmup, peaks=peaks)
+        out["benches"][name] = entry
+        rf = entry.get("roofline_frac")
+        print(
+            f"[microbench] {name}: wall={entry['wall_s']:.6f}s"
+            + (f" tok/s={entry['tok_s']:.1f}" if entry.get("tok_s") else "")
+            + (f" roofline={rf:.4f}" if rf is not None else "")
+            + f" (setup+run {time.monotonic()-t0:.1f}s)",
+            flush=True,
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# compare gate
+# ---------------------------------------------------------------------------
+
+
+def compare(
+    current: dict, baseline: dict, threshold: float = DEFAULT_THRESHOLD
+) -> dict:
+    """Noise-aware regression check of ``current`` against ``baseline``.
+
+    Per shared bench: regression iff
+    ``cur.wall_s > base.wall_s * (1 + max(threshold, 2*noise)) + floor``
+    where noise is the larger of the two runs' measured noise_frac.
+    Entries only in current are "new", only in baseline "missing" — both
+    are warnings (a renamed bench must not hard-fail the gate; the
+    baseline refresh is the reviewed fix)."""
+    cur = current.get("benches", {})
+    base = baseline.get("benches", {})
+    out: dict[str, list] = {"regressions": [], "ok": [], "new": [], "missing": []}
+    for name, c in cur.items():
+        b = base.get(name)
+        if b is None:
+            out["new"].append(name)
+            continue
+        noise = max(
+            float(c.get("noise_frac") or 0.0), float(b.get("noise_frac") or 0.0)
+        )
+        margin = max(threshold, 2.0 * noise)
+        limit = float(b["wall_s"]) * (1.0 + margin) + NOISE_FLOOR_S
+        if float(c["wall_s"]) > limit:
+            out["regressions"].append(
+                {
+                    "bench": name,
+                    "wall_s": float(c["wall_s"]),
+                    "baseline_s": float(b["wall_s"]),
+                    "limit_s": limit,
+                    "margin": margin,
+                }
+            )
+        else:
+            out["ok"].append(name)
+    out["missing"] = sorted(set(base) - set(cur))
+    return out
+
+
+def _print_compare(result: dict) -> None:
+    for r in result["regressions"]:
+        print(
+            f"[microbench] REGRESSION {r['bench']}: {r['wall_s']:.6f}s vs"
+            f" baseline {r['baseline_s']:.6f}s (limit {r['limit_s']:.6f}s,"
+            f" margin {r['margin']:.0%})",
+            flush=True,
+        )
+    for n in result["new"]:
+        print(f"[microbench] WARN new bench not in baseline: {n}", flush=True)
+    for n in result["missing"]:
+        print(f"[microbench] WARN baseline bench not run: {n}", flush=True)
+    print(
+        f"[microbench] compare: {len(result['ok'])} ok,"
+        f" {len(result['regressions'])} regression(s),"
+        f" {len(result['new'])} new, {len(result['missing'])} missing",
+        flush=True,
+    )
+
+
+# ---------------------------------------------------------------------------
+# --learn-gate: on-chip RL learning gate (was prof_learn.py)
+# ---------------------------------------------------------------------------
+
+LEARN_TARGET = 7
+LEARN_GROUP = 4
+
+
+def _learn_reward(prompt, completions, prompt_ids, completion_ids, **kw):
+    return 1.0 if LEARN_TARGET in completion_ids else 0.0
+
+
+def learn_gate() -> int:
+    """Full-stack learning smoke on the REAL backend: a tiny from-scratch
+    policy must learn to emit LEARN_TARGET through DecodeEngine-over-HTTP,
+    staleness-gated async rollout, GRPO advantages, and mem-mode weight
+    updates. Prints ``LEARN_RESULT {json}``; exit 0 iff it learned.
+    (No pretrained weights exist in the zero-egress image, so this is the
+    hardware-validated stand-in for a benchmark reward curve.)"""
+    import tempfile
+
+    import jax
+
+    from areal_tpu.api.config import (
+        DatasetConfig,
+        EvaluatorConfig,
+        InferenceEngineConfig,
+        MeshConfig,
+        MicroBatchSpec,
+        NormConfig,
+        OptimizerConfig,
+        PPOActorConfig,
+        PPOConfig,
+        RecoverConfig,
+        SaverConfig,
+        ServerConfig,
+        StatsLoggerConfig,
+    )
+    from areal_tpu.api.io_struct import (
+        FinetuneSpec,
+        GenerationHyperparameters,
+        ModelRequest,
+    )
+    from areal_tpu.engine.train_engine import JaxTrainEngine
+    from areal_tpu.inference.client import RemoteJaxEngine
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.inference.server import ServerThread
+    from areal_tpu.models import qwen
+    from areal_tpu.trainer.rl_trainer import PPOTrainer
+    from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+    platform = jax.default_backend()
+    print(f"[learn] backend={platform}", flush=True)
+    model_cfg_ = qwen.ModelConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        dtype="float32",
+        tie_word_embeddings=True,
+        attention_bias=True,
+        rope_theta=10000.0,
+    )
+    root = tempfile.mkdtemp(prefix="learn_gate_")
+    actor_cfg = PPOActorConfig(
+        init_from_scratch=True,
+        dtype="float32",
+        param_dtype="float32",
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        optimizer=OptimizerConfig(lr=2e-2, lr_scheduler_type="constant"),
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=100_000),
+        bucket_step=64,
+        group_size=LEARN_GROUP,
+        ppo_n_minibatches=1,
+        adv_norm=NormConfig(
+            mean_level="group", std_level="group", group_size=LEARN_GROUP
+        ),
+        kl_ctl=0.0,
+        use_decoupled_loss=True,
+        prox_logp_mode="recompute",
+        eps_clip=0.4,
+        temperature=1.0,
+    )
+    engine = JaxTrainEngine(actor_cfg, model_config=model_cfg_)
+    engine.initialize(FinetuneSpec(1, 32, 8))
+    scfg = ServerConfig(
+        max_batch_size=8,
+        max_seq_len=64,
+        decode_steps_per_call=4,
+        seed=0,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+    )
+    dec = DecodeEngine(
+        scfg, params=jax.tree.map(np.asarray, engine.params), model_cfg=model_cfg_
+    )
+    dec.initialize()
+    server = ServerThread(scfg, dec)
+    server.start()
+    rollout = RemoteJaxEngine(
+        InferenceEngineConfig(
+            max_concurrent_rollouts=8,
+            consumer_batch_size=4,
+            max_head_offpolicyness=2,
+            request_timeout=300,
+        ),
+        addresses=[server.address],
+    )
+    rollout.initialize()
+    cfg = PPOConfig(
+        experiment_name="learn_onchip",
+        trial_name="t0",
+        total_train_epochs=12,
+        weight_update_mode="mem",
+        gconfig=GenerationHyperparameters(
+            n_samples=LEARN_GROUP, max_new_tokens=4, temperature=1.0
+        ),
+        train_dataset=DatasetConfig(batch_size=4, shuffle=True),
+        actor=actor_cfg,
+        saver=SaverConfig(fileroot=root),
+        checkpointer=SaverConfig(fileroot=root),
+        evaluator=EvaluatorConfig(fileroot=root),
+        recover=RecoverConfig(mode="disabled", fileroot=root),
+        stats_logger=StatsLoggerConfig(fileroot=root),
+    )
+    cfg.cluster.fileroot = root
+    rng = np.random.default_rng(0)
+    dataset = [{"prompt_ids": rng.integers(20, 200, 4).tolist()} for _ in range(32)]
+    trainer = PPOTrainer(cfg, dataset, rollout=rollout, actor_engine=engine)
+
+    def hit_rate(n=16):
+        import asyncio
+
+        async def probe_fn():
+            reqs = [
+                ModelRequest(
+                    input_ids=row["prompt_ids"],
+                    gconfig=GenerationHyperparameters(
+                        n_samples=1, max_new_tokens=4, greedy=True
+                    ),
+                )
+                for row in dataset[:n]
+            ]
+            resps = await asyncio.gather(*[rollout.agenerate(r) for r in reqs])
+            return float(np.mean([LEARN_TARGET in r.output_tokens for r in resps]))
+
+        return asyncio.run(probe_fn())
+
+    t0 = time.monotonic()
+    before = hit_rate()
+    trainer.train(workflow=RLVRWorkflow(_learn_reward, cfg.gconfig))
+    after = hit_rate()
+    dt = time.monotonic() - t0
+    ok = after > max(0.5, before + 0.3)
+    print(
+        "LEARN_RESULT "
+        + json.dumps(
+            {
+                "backend": platform,
+                "before": before,
+                "after": after,
+                "learned": ok,
+                "secs": round(dt, 1),
+                "versions": engine.get_version(),
+            }
+        ),
+        flush=True,
+    )
+    server.stop()
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
+# --ladder: unattended on-chip measurement ladder (was prof_ladder.py)
+# ---------------------------------------------------------------------------
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_MB = (
+    "import os; os.environ.setdefault('MICROBENCH_FULL', '1')\n"
+    "from areal_tpu.tools import microbench\n"
+)
+
+# (name, child budget seconds, code). Ordering: the round's must-have (a
+# full valid bench) FIRST; on-chip kernel parity SECOND; component
+# microbenches after. The mb_* steps replace the retired prof_r3/r4/r5
+# scripts with registry entries (docs/perf.md "Reproduction").
+LADDER_STEPS = [
+    ("bench_full", 1600, "import bench; bench.main()"),
+    (
+        "tests_tpu",
+        1500,
+        "import pytest\n"
+        "rc = pytest.main(['tests_tpu', '-x', '-q', '--no-header'])\n"
+        "raise SystemExit(int(rc))",
+    ),
+    (
+        "bench_decode_int8",
+        700,
+        "import os; os.environ['BENCH_QUANT'] = 'int8'\n"
+        "import bench; raise SystemExit(bench._run_phase_child('decode'))",
+    ),
+    (
+        "bench_longctx_int8kv",
+        500,
+        "import os\n"
+        "os.environ['BENCH_QUANT'] = 'int8'\n"
+        "os.environ['BENCH_KV_QUANT'] = 'int8'\n"
+        "import bench; raise SystemExit(bench._run_phase_child('longctx'))",
+    ),
+    (
+        "mb_fast",
+        900,
+        _MB + "raise SystemExit(microbench.main(['--out', '/tmp/mb_fast_tpu.json']))",
+    ),
+    (
+        "mb_decode_steady",
+        1500,
+        _MB
+        + "raise SystemExit(microbench.main(['--benches', 'decode_engine_steady',"
+        " '--out', '/tmp/mb_decode_steady.json']))",
+    ),
+    (
+        "mb_weight_update",
+        900,
+        _MB
+        + "raise SystemExit(microbench.main(['--benches', 'weight_update',"
+        " '--out', '/tmp/mb_weight_update.json']))",
+    ),
+    (
+        "mb_train_step",
+        2400,
+        _MB
+        + "raise SystemExit(microbench.main(['--benches', 'train_step',"
+        " '--out', '/tmp/mb_train_step.json']))",
+    ),
+    (
+        "mb_tree_train",
+        1500,
+        _MB
+        + "raise SystemExit(microbench.main(['--benches', 'tree_train',"
+        " '--out', '/tmp/mb_tree_train.json']))",
+    ),
+    (
+        "rl_learn_onchip",
+        1200,
+        "from areal_tpu.tools import microbench\n"
+        "raise SystemExit(microbench.main(['--learn-gate']))",
+    ),
+]
+
+# the alarm handler must RAISE (not default-terminate): only a normal
+# interpreter exit runs the PJRT client teardown that releases the remote
+# pool lease — an abrupt signal death wedges it like a SIGKILL does
+_ALARM_PREAMBLE = (
+    "import signal, sys, os\n"
+    "def _die(s, f):\n"
+    "    raise SystemExit('ladder alarm: budget exceeded')\n"
+    "signal.signal(signal.SIGALRM, _die)\n"
+)
+
+# persistent compile cache shared with bench.py phase children (replays
+# from prior green runs keep cold starts inside the step budgets); the
+# helper gates on backend==tpu so a CPU fallback can't poison the cache
+_CACHE_LINE = (
+    "from areal_tpu.utils.compile_cache import enable_persistent_cache\n"
+    "enable_persistent_cache()\n"
+)
+
+PROBE_CODE = (
+    _ALARM_PREAMBLE
+    + "signal.alarm(110)\n"
+    "import jax, jax.numpy as jnp, numpy as np\n"
+    "x = jnp.ones((128, 128), jnp.bfloat16)\n"
+    "v = np.asarray((x @ x))[0, 0]\n"
+    "print('PROBE_OK', jax.default_backend(), flush=True)\n"
+)
+
+_DONE_PATH = os.path.join(REPO, ".bench_cache", "ladder_done.json")
+
+
+def _ladder_log(msg: str) -> None:
+    print(f"[ladder {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def _ladder_probe() -> bool:
+    import subprocess
+
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", PROBE_CODE],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=180,
+        )
+        ok = "PROBE_OK tpu" in p.stdout
+    except subprocess.TimeoutExpired:
+        # child wedged in C past its in-child alarm — report blocked so the
+        # ladder stops cleanly instead of queueing more hangs
+        ok = False
+    _ladder_log(f"probe: {'OK' if ok else 'blocked'}")
+    return ok
+
+
+def _ladder_run_step(name: str, budget: int, code: str) -> bool:
+    import signal
+    import subprocess
+
+    # _CACHE_LINE initializes a TPU client, which CLAIMS the pool lease —
+    # bench_full is a phase-SPAWNING parent whose children must make their
+    # own claims, so the parent must not hold the lease against them
+    cache = "" if name == "bench_full" else _CACHE_LINE
+    child = (
+        _ALARM_PREAMBLE
+        + f"signal.alarm({budget})\n"
+        + "sys.path.insert(0, %r)\n" % REPO
+        + cache
+    ) + code
+    _ladder_log(f"step {name} (budget {budget}s)")
+    t0 = time.monotonic()
+    out_path = f"/tmp/ladder_{name}.log"
+    with open(out_path, "w") as f:
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-c", child],
+            cwd=REPO,
+            stdout=f,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        try:
+            rc = proc.wait(timeout=budget + 180)
+        except subprocess.TimeoutExpired:
+            _ladder_log(f"step {name}: HARD TIMEOUT, SIGKILL (lease at risk)")
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait()
+            return False
+    dt = time.monotonic() - t0
+    _ladder_log(f"step {name}: rc={rc} in {dt:.0f}s -> {out_path}")
+    return rc == 0
+
+
+def _ladder_load_done() -> dict:
+    try:
+        with open(_DONE_PATH) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _ladder_mark_done(name: str) -> None:
+    done = _ladder_load_done()
+    done[name] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    os.makedirs(os.path.dirname(_DONE_PATH), exist_ok=True)
+    with open(_DONE_PATH, "w") as f:
+        json.dump(done, f, indent=1)
+
+
+def ladder_main(start: int = 0, force: bool = False) -> int:
+    """Run LADDER_STEPS unattended: every child exits CLEANLY on overrun
+    (SIGALRM raises), a TPU probe runs between steps, and completed steps
+    are recorded under .bench_cache/ so reruns skip them."""
+    done = {} if force else _ladder_load_done()
+    for i, (name, budget, code) in enumerate(LADDER_STEPS[start:], start):
+        if name in done:
+            _ladder_log(f"step {name}: already completed {done[name]}, skipping")
+            continue
+        if not _ladder_probe():
+            _ladder_log(f"tunnel blocked before step {i} ({name}); stopping ladder")
+            return 1
+        ok = _ladder_run_step(name, budget, code)
+        if name == "bench_full":
+            # bench.main() exits 0 even when every phase died (the driver
+            # contract: always print one JSON line) — success for
+            # done-marking means the harvested payload carries a real LIVE
+            # pipeline number, not a cache fallback or 0.0
+            payload = None
+            try:
+                lines = open(f"/tmp/ladder_{name}.log").read().splitlines()
+                for ln in reversed(lines):
+                    if not (ln.startswith("{") and '"metric"' in ln):
+                        continue
+                    try:
+                        payload = json.loads(ln)  # a truncated line must not
+                    except json.JSONDecodeError:  # poison the snapshot
+                        continue
+                    with open(os.path.join(REPO, "BENCH_mid.json"), "w") as f:
+                        json.dump(payload, f)
+                        f.write("\n")
+                    _ladder_log(f"BENCH_mid.json written: {ln[:120]}")
+                    break
+            except OSError as e:
+                _ladder_log(f"snapshot harvest failed: {e}")
+            srcs = (payload or {}).get("detail", {}).get("sources", {})
+            ok = (
+                payload is not None
+                and payload.get("value", 0) > 0
+                and srcs.get("decode", "live") == "live"
+                and srcs.get("train", "live") == "live"
+            )
+        if ok:
+            _ladder_mark_done(name)
+        if not ok and not _ladder_probe():
+            _ladder_log(f"tunnel died during {name}; stopping ladder")
+            return 1
+    _ladder_log("ladder complete")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def fast_names() -> list[str]:
+    return [n for n, s in REGISTRY.items() if not s["heavy"]]
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="microbench", description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    ap.add_argument("--list", action="store_true", help="list registered benches")
+    ap.add_argument(
+        "--benches", help="comma-separated bench names (default: all fast benches)"
+    )
+    ap.add_argument(
+        "--heavy", action="store_true", help="include the heavy engine-level benches"
+    )
+    ap.add_argument("--iters", type=int, default=DEFAULT_ITERS)
+    ap.add_argument("--warmup", type=int, default=DEFAULT_WARMUP)
+    ap.add_argument("--out", help="write results JSON here")
+    ap.add_argument("--compare", help="baseline JSON; exit 1 on regression")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD)
+    ap.add_argument(
+        "--learn-gate", action="store_true", help="run the on-chip RL learning gate"
+    )
+    ap.add_argument(
+        "--ladder", action="store_true", help="run the unattended measurement ladder"
+    )
+    ap.add_argument("--from", dest="ladder_from", type=int, default=0, metavar="N")
+    ap.add_argument("--force", action="store_true", help="ladder: ignore done-file")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for n, s in REGISTRY.items():
+            kind = "heavy" if s["heavy"] else "fast"
+            print(f"{n:22s} [{kind}] {s['doc']}")
+        return 0
+    if args.learn_gate:
+        return learn_gate()
+    if args.ladder:
+        return ladder_main(args.ladder_from, args.force)
+
+    if args.benches:
+        names = [n.strip() for n in args.benches.split(",") if n.strip()]
+        unknown = [n for n in names if n not in REGISTRY]
+        if unknown:
+            print(f"[microbench] unknown bench(es): {unknown}", file=sys.stderr)
+            return 2
+    else:
+        names = [
+            n for n, s in REGISTRY.items() if args.heavy or not s["heavy"]
+        ]
+
+    result = run_suite(names, iters=args.iters, warmup=args.warmup)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+        print(f"[microbench] wrote {args.out}", flush=True)
+
+    if args.compare:
+        with open(args.compare) as f:
+            baseline = json.load(f)
+        cmp_res = compare(result, baseline, threshold=args.threshold)
+        _print_compare(cmp_res)
+        return 1 if cmp_res["regressions"] else 0
+    print(json.dumps({"benches": result["benches"]}, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

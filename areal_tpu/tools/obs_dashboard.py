@@ -135,6 +135,18 @@ def _min_per_target(snap: FleetSnapshot, name: str) -> float | None:
 # learning-health lag-bucket taxonomy (infra/staleness_manager.py)
 from areal_tpu.infra.staleness_manager import LAG_BUCKET_LABELS as _LAG_BUCKETS
 
+# decode-step phase taxonomy (observability/kernel_probe.py) + the
+# identity remainder bucket
+_DECODE_PHASES = (
+    "admission",
+    "radix_match",
+    "prefill",
+    "dispatch",
+    "device_wait",
+    "bookkeeping",
+    "other",
+)
+
 # trainer observatory phase taxonomy (observability/step_timeline.py)
 _TRAIN_PHASES = (
     "rollout_wait",
@@ -335,6 +347,30 @@ def render_frame(
             f"{'journal replay/stale':<24} "
             f"{_fmt(replayed or 0):>6} / {_fmt(dropped or 0)}"
         )
+    # kernel observatory (docs/perf.md "Kernel observatory"): decode-step
+    # phase means with the dominant phase highlighted, plus the fleet's
+    # achieved-roofline fraction (mean across targets — a per-engine
+    # fact like MFU, never fleet-summed)
+    dphase_rows = []
+    for ph in _DECODE_PHASES:
+        s = _merged_value_labeled(
+            snap, "areal_decode_phase_seconds_sum", phase=ph
+        )
+        c = _merged_value_labeled(
+            snap, "areal_decode_phase_seconds_count", phase=ph
+        )
+        if s is not None and c:
+            dphase_rows.append((ph, s / c))
+    if dphase_rows:
+        lines.append("-" * 64)
+        lines.append("decode step phases (mean s)")
+        dominant = max(dphase_rows, key=lambda kv: kv[1])[0]
+        for ph, v in dphase_rows:
+            label = "  " + ph + (" (dominant)" if ph == dominant else "")
+            lines.append(f"{label:<24} {v:>12.6f}")
+    roofline = _mean_per_target(snap, "areal_decode_roofline_fraction")
+    if roofline is not None:
+        lines.append(f"{'decode roofline frac':<24} {roofline:>11.1%}")
     # trainer observatory (docs/observability.md "Trainer observatory"):
     # step-phase means with the async bubble highlighted, utilization,
     # worst-replica HBM headroom, and the recompile-storm counters
@@ -553,6 +589,17 @@ areal_journal_replayed_total 7
 # HELP areal_journal_dropped_stale_total Journaled trajectories dropped over-stale.
 # TYPE areal_journal_dropped_stale_total counter
 areal_journal_dropped_stale_total 1
+# HELP areal_decode_phase_seconds Wall-clock seconds per decode-step phase.
+# TYPE areal_decode_phase_seconds histogram
+areal_decode_phase_seconds_bucket{phase="dispatch",le="+Inf"} 10
+areal_decode_phase_seconds_sum{phase="dispatch"} 0.5
+areal_decode_phase_seconds_count{phase="dispatch"} 10
+areal_decode_phase_seconds_bucket{phase="device_wait",le="+Inf"} 10
+areal_decode_phase_seconds_sum{phase="device_wait"} 0.2
+areal_decode_phase_seconds_count{phase="device_wait"} 10
+# HELP areal_decode_roofline_fraction Achieved fraction of the roofline ceiling.
+# TYPE areal_decode_roofline_fraction gauge
+areal_decode_roofline_fraction 0.42
 # HELP areal_train_phase_seconds Wall-clock seconds per training-step phase.
 # TYPE areal_train_phase_seconds histogram
 areal_train_phase_seconds_bucket{phase="rollout_wait",le="+Inf"} 4
@@ -659,6 +706,20 @@ def self_test() -> int:
                 "target merges to the same 80% ratio)",
             ),
             ("update pause (mean s)" in frame, "frame missing pause row"),
+            (
+                "decode step phases (mean s)" in frame,
+                "frame missing decode phase panel",
+            ),
+            (
+                "dispatch (dominant)" in frame,
+                "dispatch (0.05 mean) should be highlighted as the "
+                "dominant decode phase over device_wait (0.02)",
+            ),
+            (
+                "decode roofline frac" in frame and "42.0%" in frame,
+                "frame missing fleet roofline row (0.42 per target means "
+                "to 42.0%)",
+            ),
             (
                 "ttft p50/p99 (s)" in frame,
                 "frame missing timeline ttft quantile row",

@@ -303,6 +303,29 @@ def phase_decode():
     if not complete:
         log(f"[decode] PARTIAL: {n_done}/{n_req} finished in {dt:.0f}s")
     tok_s = gen_tokens / dt
+    # kernel observatory payload (docs/perf.md "Kernel observatory"): the
+    # engine probe's steady-state achieved roofline + per-phase host means
+    # over the measured window, plus a cheap microbench subset (host-side
+    # benches + the small dequant jit — the heavy device benches have
+    # their own ladder steps and must not eat this phase's deadline)
+    kernels = None
+    try:
+        ks = eng.kernel_stats()
+        from areal_tpu.tools import microbench as _mb
+
+        peaks = _mb._peaks()
+        sub = {
+            name: _mb.run_bench(name, iters=3, warmup=1, peaks=peaks)
+            for name in ("radix_match", "weight_stage_encode", "int8_kv_dequant")
+        }
+        kernels = {
+            "roofline_frac": ks.get("roofline_fraction"),
+            "dominant_phase": ks.get("dominant_phase"),
+            "phase_means_s": ks.get("phase_means_s"),
+            "microbench": sub,
+        }
+    except Exception as e:  # noqa: BLE001 — observability must not kill the bench
+        log(f"[decode] kernels payload failed: {type(e).__name__}: {e}")
     # emit the throughput result NOW: if the weight-update segment below
     # stalls into the phase deadline, the parent keeps this line
     _emit_phase(
@@ -311,6 +334,7 @@ def phase_decode():
             "tok_s": tok_s,
             "partial": not complete,
             "requests_done": n_done,
+            "kernels": kernels,
         }
     )
 
@@ -426,6 +450,7 @@ def phase_decode():
             "requests_done": n_done,
             "quantization": quant,
             "weight_update_secs": wu.get("wu_colocated_secs"),
+            "kernels": kernels,
             **wu,
         }
     )
@@ -1144,6 +1169,7 @@ def main():
     errors = {}
     sources = {}
     gen_tok_s = train_tok_s = weight_update_secs = longctx = async_sync = None
+    kernels = None
     gateway = None
     train_detail = None
     wu_detail = {}
@@ -1246,6 +1272,10 @@ def main():
             }
             if d.get("partial"):
                 errors["decode_partial"] = f"only {d.get('requests_done')} reqs"
+        # kernel observatory scoreboard (steady-state roofline + microbench
+        # subset); cached pre-observatory payloads fold None, never a
+        # missing key
+        kernels = (d or {}).get("kernels")
         lc = resolve("longctx", spawn_in_window("longctx") if live else None)
         if lc is not None:
             longctx = {
@@ -1308,6 +1338,7 @@ def main():
         "async_vs_sync": async_sync,
         "gateway": gateway,
         "train": train_detail,
+        "kernels": kernels,
         # the chip count the pipeline number is normalized by: each phase's
         # rate divides by ITS OWN measurement's chip count (a live 1-chip
         # decode must not be divided by a cached 4-chip train's grant)

@@ -526,3 +526,63 @@ def test_main_prefers_live_over_cache(cache_dir, monkeypatch, capsys):
     out = json.loads(line)
     assert out["detail"]["sources"]["decode"] == "live"
     assert out["value"] == pytest.approx(3000.0, abs=0.5)
+
+
+def test_main_folds_decode_kernels_observatory(cache_dir, monkeypatch, capsys):
+    """The kernel observatory rides the round payload: the decode phase's
+    roofline fraction, dominant phase, per-phase means, and microbench
+    sub-suite land in detail["kernels"]."""
+
+    def fake_spawn(name, deadline=None):
+        if name == "probe":
+            return {"phase": "probe", "platform": "tpu", "n_devices": 1}
+        if name == "decode":
+            return {
+                "phase": "decode",
+                "tok_s": 6700.0,
+                "kernels": {
+                    "roofline_frac": 0.31,
+                    "dominant_phase": "device_wait",
+                    "phase_means_s": {"device_wait": 0.004, "dispatch": 0.001},
+                    "microbench": {
+                        "radix_match": {"wall_s": 3.2e-4, "roofline_frac": None}
+                    },
+                },
+            }
+        return {"phase": name, "error": "skipped"}
+
+    monkeypatch.setattr(bench, "_spawn_phase", fake_spawn)
+    bench.main()
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    ks = out["detail"]["kernels"]
+    assert ks["roofline_frac"] == 0.31
+    assert ks["dominant_phase"] == "device_wait"
+    assert ks["phase_means_s"]["device_wait"] == 0.004
+    assert ks["microbench"]["radix_match"]["wall_s"] == 3.2e-4
+
+
+def test_cached_pre_observatory_decode_payload_folds_kernels_none(
+    cache_dir, monkeypatch, capsys
+):
+    """A cached decode payload measured BEFORE the kernel observatory landed
+    has no kernels section: detail["kernels"] folds as None (key always
+    present), and the decode scoreboard itself never nulls out."""
+    _seed(cache_dir, "decode", {"phase": "decode", "tok_s": 6696.5})
+
+    def fake_spawn(name, deadline=None):
+        if name == "probe":
+            return {"phase": "probe", "platform": "tpu", "n_devices": 1}
+        return {"phase": name, "error": "wedged"}
+
+    monkeypatch.setattr(bench, "_spawn_phase", fake_spawn)
+    bench.main()
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    assert out["detail"]["sources"]["decode"].startswith("cached@")
+    assert "kernels" in out["detail"]
+    assert out["detail"]["kernels"] is None

@@ -6,19 +6,21 @@ measurements with no human in the loop:
 
     nohup python watch_tpu.py >> /tmp/tpu_watch_r05.log 2>&1 &
 
-Every PERIOD seconds it runs prof_ladder.probe() (a subprocess that exits
-cleanly via SIGALRM, never SIGKILL-while-claiming unless already wedged);
-the moment a probe succeeds it runs the full prof_ladder measurement
-ladder, then keeps watching so a later window can resume any steps the
-first one didn't finish (ladder steps are individually resumable via
---from, and bench phases persist results to .bench_cache/).
+Every PERIOD seconds it runs the microbench ladder probe (a subprocess
+that exits cleanly via SIGALRM, never SIGKILL-while-claiming unless
+already wedged); the moment a probe succeeds it runs the full measurement
+ladder (``python -m areal_tpu.tools.microbench --ladder``, the retired
+prof_ladder.py's successor — docs/perf.md "Reproduction"), then keeps
+watching so a later window can resume any steps the first one didn't
+finish (ladder steps are individually resumable via --from, and bench
+phases persist results to .bench_cache/).
 """
 
 import subprocess
 import sys
 import time
 
-import prof_ladder
+from areal_tpu.tools import microbench
 
 PERIOD_S = 390  # ~6.5 min: recovery latency bound without probe-spam
 MAX_LADDER_RUNS = 4
@@ -31,10 +33,11 @@ def log(msg):
 def main():
     runs = 0
     while runs < MAX_LADDER_RUNS:
-        if prof_ladder.probe():
+        if microbench._ladder_probe():
             log("lease is live — running measurement ladder")
             rc = subprocess.call(
-                [sys.executable, "-u", "prof_ladder.py"], cwd=prof_ladder.REPO
+                [sys.executable, "-u", "-m", "areal_tpu.tools.microbench", "--ladder"],
+                cwd=microbench.REPO,
             )
             runs += 1
             log(f"ladder run #{runs} rc={rc}")
