@@ -13,9 +13,12 @@ def all_checkers() -> list:
     from areal_tpu.analysis.rules.don import DonationChecker
     from areal_tpu.analysis.rules.exc import SilentExceptionChecker
     from areal_tpu.analysis.rules.jaxpurity import JaxPurityChecker
+    from areal_tpu.analysis.rules.krn import PallasKernelChecker
     from areal_tpu.analysis.rules.lck import LockOrderChecker
+    from areal_tpu.analysis.rules.msh import MeshCollectiveChecker
     from areal_tpu.analysis.rules.obs import MetricCatalogChecker
     from areal_tpu.analysis.rules.prf import HotPathSyncChecker
+    from areal_tpu.analysis.rules.pvt import PrivateApiChecker
     from areal_tpu.analysis.rules.rcp import RecompileRiskChecker
     from areal_tpu.analysis.rules.shd import ShardingSpecChecker
     from areal_tpu.analysis.rules.sig import SignalSafetyChecker
@@ -36,4 +39,7 @@ def all_checkers() -> list:
         RecompileRiskChecker(),
         WireContractChecker(),
         LockOrderChecker(),
+        PallasKernelChecker(),
+        PrivateApiChecker(),
+        MeshCollectiveChecker(),
     ]

@@ -1,0 +1,436 @@
+"""KRN — Pallas kernel safety (the ROADMAP kernel arc's defect class).
+
+Pallas failures are late and opaque: a BlockSpec index map with the wrong
+arity for the launch grid is a TypeError deep inside tracing; a kernel
+body whose positional refs drifted from the operand list reads the wrong
+buffer silently (interpret mode often still "works"); a write through an
+input ref aliases HBM the caller still owns; a ragged tail (grid dim from
+a cdiv of a non-multiple size) without masking reads garbage rows; and a
+kernel that never exposes ``interpret=`` cannot run in CI at all (the
+paged-attention fork was red for 15 PRs precisely because its only
+coverage needed a TPU). These rules check the launch-site geometry the
+compiler only checks at trace time — and only on a TPU for some of it.
+
+  KRN001  BlockSpec index-map arity differs from grid rank (+ prefetch):
+          index maps are called with one argument per grid dimension plus
+          one per scalar-prefetch operand (PrefetchScalarGridSpec)
+  KRN002  kernel body positional-parameter count differs from the
+          operand plan (prefetch + inputs + outputs + scratch)
+  KRN003  kernel body writes through an input ref (scalar-prefetch or
+          in_specs position) — inputs alias caller memory
+  KRN004  grid dimension is a cdiv of a runtime size but the kernel body
+          has no ``pl.when`` masking — the ragged tail reads/writes out
+          of the logical bounds (warning: the size may be known-aligned)
+  KRN005  ``pallas_call`` whose enclosing function does not expose an
+          ``interpret`` parameter — the kernel cannot run on CPU, so it
+          is invisible to tier-1 and to tools/kernelcheck.py parity runs
+
+Everything is resolved statically and conservatively: names are followed
+one assignment deep within the enclosing function (names bound more than
+once are treated as unknown), ``functools.partial`` unwraps to local
+defs, and any count that cannot be resolved to a literal silences the
+rules that need it. Unknown stays silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.analysis.core import (
+    Finding,
+    ProjectContext,
+    SourceFile,
+    SEVERITY_WARNING,
+    dotted_name,
+    make_key,
+)
+
+
+def _last(d: str | None) -> str:
+    return (d or "").split(".")[-1]
+
+
+def _single_assign_env(*bodies: list[ast.stmt]) -> dict[str, ast.expr]:
+    """name -> value for names assigned exactly once across ``bodies``
+    (simple ``x = expr`` only; re-bound names are unknown)."""
+    counts: dict[str, int] = {}
+    values: dict[str, ast.expr] = {}
+    for body in bodies:
+        for stmt in body:
+            for node in ast.walk(ast.Module(body=[stmt], type_ignores=[])):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 and (
+                    isinstance(node.targets[0], ast.Name)
+                ):
+                    name = node.targets[0].id
+                    counts[name] = counts.get(name, 0) + 1
+                    values[name] = node.value
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) and (
+                    isinstance(node.target, ast.Name)
+                ):
+                    counts[node.target.id] = counts.get(node.target.id, 0) + 2
+    return {n: v for n, v in values.items() if counts.get(n) == 1}
+
+
+def _resolve(node: ast.expr | None, env: dict, depth: int = 3) -> ast.expr | None:
+    while depth > 0 and isinstance(node, ast.Name) and node.id in env:
+        node = env[node.id]
+        depth -= 1
+    return node
+
+
+def _const_int(node: ast.expr | None, env: dict) -> int | None:
+    node = _resolve(node, env)
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    return None
+
+
+def _seq_len(node: ast.expr | None, env: dict) -> int | None:
+    node = _resolve(node, env)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        if any(isinstance(e, ast.Starred) for e in node.elts):
+            return None
+        return len(node.elts)
+    return None
+
+
+def _positional_params(fn: ast.AST) -> list[str] | None:
+    """Positional parameter names (None when *args makes the list open)."""
+    args = getattr(fn, "args", None)
+    if args is None or args.vararg is not None:
+        return None
+    return [a.arg for a in (*args.posonlyargs, *args.args)]
+
+
+class _Site:
+    """One pallas_call launch with whatever geometry resolved statically."""
+
+    def __init__(self, call: ast.Call, sf: SourceFile, env: dict, defs: dict):
+        self.call = call
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        spec_src = kw
+        gs = _resolve(kw.get("grid_spec"), env)
+        if isinstance(gs, ast.Call) and _last(dotted_name(gs.func)) in (
+            "PrefetchScalarGridSpec",
+            "GridSpec",
+        ):
+            spec_src = {k.arg: k.value for k in gs.keywords if k.arg}
+            self.num_prefetch = _const_int(
+                spec_src.get("num_scalar_prefetch"), env
+            ) or 0
+        else:
+            self.num_prefetch = 0
+        self.grid = _resolve(spec_src.get("grid"), env)
+        self.grid_len = _seq_len(spec_src.get("grid"), env)
+        if self.grid_len is None and isinstance(self.grid, ast.Constant):
+            self.grid_len = 1 if isinstance(self.grid.value, int) else None
+        self.in_specs = _resolve(spec_src.get("in_specs"), env)
+        self.n_in = _seq_len(spec_src.get("in_specs"), env)
+        self.out_specs = _resolve(spec_src.get("out_specs"), env)
+        self.n_out = _seq_len(spec_src.get("out_specs"), env)
+        if self.n_out is None and self.out_specs is not None:
+            self.n_out = 1  # single spec = single output
+        if self.n_out is None:
+            self.n_out = _seq_len(kw.get("out_shape"), env)
+            if self.n_out is None and isinstance(
+                _resolve(kw.get("out_shape"), env), ast.Call
+            ):
+                self.n_out = 1
+        self.n_scratch = _seq_len(spec_src.get("scratch_shapes"), env)
+        if "scratch_shapes" not in spec_src:
+            self.n_scratch = 0
+        self.interpret_kw = "interpret" in kw
+        # kernel: first positional arg, through functools.partial if needed
+        self.kernel_def: ast.AST | None = None
+        self.kernel_name = "<kernel>"
+        self.partial_kw_names: set[str] = set()
+        self.partial_pos = 0
+        target = _resolve(call.args[0], env) if call.args else None
+        if isinstance(target, ast.Call) and _last(dotted_name(target.func)) == (
+            "partial"
+        ):
+            self.partial_kw_names = {k.arg for k in target.keywords if k.arg}
+            self.partial_pos = len(target.args) - 1
+            target = _resolve(target.args[0], env) if target.args else None
+        if isinstance(target, ast.Lambda):
+            self.kernel_def = target
+            self.kernel_name = "<lambda>"
+        elif isinstance(target, ast.Name) and target.id in defs:
+            self.kernel_def = defs[target.id]
+            self.kernel_name = target.id
+
+    def specs(self) -> Iterator[ast.expr]:
+        for group in (self.in_specs, self.out_specs):
+            if isinstance(group, (ast.Tuple, ast.List)):
+                yield from group.elts
+            elif group is not None:
+                yield group
+
+
+class PallasKernelChecker:
+    FAMILY = "KRN"
+    RULES = {
+        "KRN001": "BlockSpec index-map arity differs from grid rank",
+        "KRN002": "kernel parameter count differs from operand plan",
+        "KRN003": "kernel writes through an input ref",
+        "KRN004": "cdiv-derived grid dimension without pl.when masking",
+        "KRN005": "pallas_call not reachable with interpret= (not CPU-testable)",
+    }
+
+    def check(self, sf: SourceFile, ctx: ProjectContext) -> Iterator[Finding]:
+        # local defs by name, vetoed when the name is also re-assigned
+        defs: dict[str, ast.AST] = {}
+        assigned: set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for el in t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]:
+                        if isinstance(el, ast.Name):
+                            assigned.add(el.id)
+        for name in assigned:
+            defs.pop(name, None)
+
+        module_env = _single_assign_env(sf.tree.body)
+        for call in ast.walk(sf.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if _last(dotted_name(call.func)) != "pallas_call":
+                continue
+            env = dict(module_env)
+            encl = self._enclosing_functions(sf, call)
+            for fn in encl:
+                env.update(_single_assign_env(fn.body))
+            site = _Site(call, sf, env, defs)
+            yield from self._check_index_maps(sf, site, env, defs)
+            yield from self._check_kernel_arity(sf, site)
+            yield from self._check_input_writes(sf, site)
+            yield from self._check_ragged_tail(sf, site, env)
+            yield from self._check_interpret(sf, site, encl)
+
+    def _enclosing_functions(self, sf: SourceFile, node: ast.AST) -> list[ast.AST]:
+        out = []
+        cur = sf.parents.get(id(node))
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(cur)
+            cur = sf.parents.get(id(cur))
+        return out
+
+    # -- KRN001 -------------------------------------------------------------
+    def _check_index_maps(
+        self, sf: SourceFile, site: _Site, env: dict, defs: dict
+    ) -> Iterator[Finding]:
+        if site.grid_len is None:
+            return
+        expected = site.grid_len + site.num_prefetch
+        for spec in site.specs():
+            spec = _resolve(spec, env)
+            if not (
+                isinstance(spec, ast.Call)
+                and _last(dotted_name(spec.func)) == "BlockSpec"
+            ):
+                continue
+            imap = None
+            if len(spec.args) >= 2:
+                imap = spec.args[1]
+            for k in spec.keywords:
+                if k.arg == "index_map":
+                    imap = k.value
+            imap = _resolve(imap, env)
+            fn: ast.AST | None = None
+            if isinstance(imap, ast.Lambda):
+                fn = imap
+            elif isinstance(imap, ast.Name) and imap.id in defs:
+                fn = defs[imap.id]
+            if fn is None:
+                continue
+            params = _positional_params(fn)
+            if params is None or len(params) == expected:
+                continue
+            yield Finding(
+                rule="KRN001",
+                path=sf.relpath,
+                line=spec.lineno,
+                message=(
+                    f"BlockSpec index map takes {len(params)} argument(s) "
+                    f"but the launch calls it with {expected} "
+                    f"({site.grid_len} grid dim(s)"
+                    + (
+                        f" + {site.num_prefetch} scalar-prefetch ref(s)"
+                        if site.num_prefetch
+                        else ""
+                    )
+                    + ")"
+                ),
+                key=make_key(
+                    "KRN001",
+                    sf.relpath,
+                    sf.scope_of(spec),
+                    f"{site.kernel_name}:{len(params)}v{expected}",
+                ),
+            )
+
+    # -- KRN002 -------------------------------------------------------------
+    def _check_kernel_arity(self, sf: SourceFile, site: _Site) -> Iterator[Finding]:
+        if site.kernel_def is None:
+            return
+        if None in (site.n_in, site.n_out, site.n_scratch):
+            return
+        params = _positional_params(site.kernel_def)
+        if params is None:
+            return
+        # partial keyword bindings only consume a ref slot when they bind a
+        # POSITIONAL parameter; binding a keyword-only config (scale=,
+        # blk_q=) leaves the positional ref zip untouched
+        free = [
+            p
+            for p in params[site.partial_pos :]
+            if p not in site.partial_kw_names
+        ]
+        have = len(free)
+        want = site.num_prefetch + site.n_in + site.n_out + site.n_scratch
+        if have == want:
+            return
+        yield Finding(
+            rule="KRN002",
+            path=sf.relpath,
+            line=site.call.lineno,
+            message=(
+                f"kernel `{site.kernel_name}` takes {have} ref parameter(s) "
+                f"but the launch supplies {want} "
+                f"({site.num_prefetch} prefetch + {site.n_in} in + "
+                f"{site.n_out} out + {site.n_scratch} scratch); refs zip "
+                "positionally — drift reads the wrong buffer silently"
+            ),
+            key=make_key(
+                "KRN002",
+                sf.relpath,
+                sf.scope_of(site.call),
+                f"{site.kernel_name}:{have}v{want}",
+            ),
+        )
+
+    # -- KRN003 -------------------------------------------------------------
+    def _check_input_writes(self, sf: SourceFile, site: _Site) -> Iterator[Finding]:
+        if site.kernel_def is None or site.n_in is None:
+            return
+        params = _positional_params(site.kernel_def)
+        if params is None:
+            return
+        # refs bound by a keyword partial are config scalars, not refs; the
+        # input range is the first prefetch+n_in UNBOUND positional params
+        # after any positionally-bound partial args
+        free = [
+            p
+            for p in params[site.partial_pos :]
+            if p not in site.partial_kw_names
+        ]
+        inputs = set(free[: site.num_prefetch + site.n_in])
+        body = getattr(site.kernel_def, "body", site.kernel_def)
+        nodes = []
+        for stmt in body if isinstance(body, list) else [body]:
+            nodes.extend(ast.walk(stmt))
+        for node in nodes:
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in inputs
+                ):
+                    yield Finding(
+                        rule="KRN003",
+                        path=sf.relpath,
+                        line=t.lineno,
+                        message=(
+                            f"kernel `{site.kernel_name}` writes through "
+                            f"input ref `{t.value.id}`; input refs alias "
+                            "caller memory — route results through an "
+                            "output or scratch ref"
+                        ),
+                        key=make_key(
+                            "KRN003",
+                            sf.relpath,
+                            sf.scope_of(site.call),
+                            f"{site.kernel_name}:{t.value.id}",
+                        ),
+                    )
+
+    # -- KRN004 -------------------------------------------------------------
+    def _check_ragged_tail(
+        self, sf: SourceFile, site: _Site, env: dict
+    ) -> Iterator[Finding]:
+        if site.kernel_def is None or not isinstance(
+            site.grid, (ast.Tuple, ast.List)
+        ):
+            return
+        ragged = None
+        for dim in site.grid.elts:
+            dim = _resolve(dim, env)
+            if isinstance(dim, ast.Call) and _last(dotted_name(dim.func)) == "cdiv":
+                ragged = dim
+                break
+        if ragged is None:
+            return
+        body = getattr(site.kernel_def, "body", [])
+        for node in (n for stmt in body for n in ast.walk(stmt)):
+            if isinstance(node, ast.Call) and _last(dotted_name(node.func)) == (
+                "when"
+            ):
+                return
+            if isinstance(node, ast.Compare):
+                return  # any predicate in the body counts as masking intent
+        yield Finding(
+            rule="KRN004",
+            path=sf.relpath,
+            line=ragged.lineno,
+            severity=SEVERITY_WARNING,
+            message=(
+                f"grid dimension is a cdiv but kernel "
+                f"`{site.kernel_name}` has no pl.when/predicate masking: "
+                "the last program instance covers a ragged tail of "
+                "out-of-bounds rows"
+            ),
+            key=make_key(
+                "KRN004",
+                sf.relpath,
+                sf.scope_of(site.call),
+                site.kernel_name,
+            ),
+        )
+
+    # -- KRN005 -------------------------------------------------------------
+    def _check_interpret(
+        self, sf: SourceFile, site: _Site, encl: list[ast.AST]
+    ) -> Iterator[Finding]:
+        for fn in encl:
+            args = fn.args
+            names = {
+                a.arg
+                for a in (*args.posonlyargs, *args.args, *args.kwonlyargs)
+            }
+            if "interpret" in names:
+                return
+        yield Finding(
+            rule="KRN005",
+            path=sf.relpath,
+            line=site.call.lineno,
+            message=(
+                "no enclosing function exposes an `interpret` parameter: "
+                "this pallas_call can only ever run on a TPU, so tier-1 "
+                "and tools/kernelcheck.py parity runs cannot cover it"
+            ),
+            key=make_key(
+                "KRN005",
+                sf.relpath,
+                sf.scope_of(site.call),
+                site.kernel_name,
+            ),
+        )

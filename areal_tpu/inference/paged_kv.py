@@ -32,6 +32,25 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from areal_tpu.utils.private_api import pin_signature
+
+# the library paged_attention launch wrapper is a PRIVATE pallas op called
+# positionally below (q, pages, lengths, page table); audited against jax
+# 0.4.37, verified at first use, re-checked against the installed jax by
+# arealint PVT002
+_EXPECTED_PAGED_ATTENTION_PARAMS = (
+    "q",
+    "k_pages",
+    "v_pages",
+    "lengths",
+    "page_indices",
+    "mask_value",
+    "attn_logits_soft_cap",
+    "pages_per_compute_block",
+    "megacore_mode",
+    "inline_seq_dim",
+)
+
 
 class PagePool:
     """Host-side refcounted page allocator.
@@ -470,6 +489,7 @@ def paged_attention_tpu(
         )
     from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention
 
+    pin_signature(paged_attention, _EXPECTED_PAGED_ATTENTION_PARAMS)
     # the library kernel applies NO 1/sqrt(hd) to the logits — callers
     # pre-scale q (verified against a dense reference in interpret mode;
     # the XLA path above scales internally)

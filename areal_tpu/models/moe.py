@@ -26,14 +26,35 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from areal_tpu.utils.jax_compat import get_abstract_mesh, shard_map
+from areal_tpu.utils.jax_compat import (
+    get_abstract_mesh,
+    shard_map,
+    with_sharding_constraint,
+)
+from areal_tpu.utils.private_api import pin_signature
+
+# megablox gmm is a PRIVATE pallas op called positionally below; audited
+# against jax 0.4.37, verified at first use, re-checked against the
+# installed jax by arealint PVT002
+_EXPECTED_GMM_PARAMS = (
+    "lhs",
+    "rhs",
+    "group_sizes",
+    "preferred_element_type",
+    "tiling",
+    "group_offset",
+    "existing_out",
+    "transpose_rhs",
+    "interpret",
+)
 
 
 def _shard(x, spec):
-    try:
-        return jax.lax.with_sharding_constraint(x, spec)
-    except (ValueError, RuntimeError):
-        return x
+    # jax_compat's constraint drops manual axes (old shard_map manualizes
+    # every mesh axis) and no-ops outside a mesh — a raw
+    # jax.lax.with_sharding_constraint here dies at lowering inside the
+    # EP shard_map region on jax 0.4.x (arealint MSH003)
+    return with_sharding_constraint(x, spec)
 
 
 def moe_ffn(h: jax.Array, layer: dict, cfg) -> tuple[jax.Array, jax.Array]:
@@ -124,6 +145,8 @@ def moe_ffn_dropless(h: jax.Array, layer: dict, cfg) -> tuple[jax.Array, jax.Arr
     that want both should use the capacity path)."""
     from jax.experimental.pallas.ops.tpu.megablox import gmm
     from areal_tpu.models.qwen import BATCH_AXES
+
+    pin_signature(gmm, _EXPECTED_GMM_PARAMS)
 
     G, L, D = h.shape
     E, K = cfg.num_experts, cfg.num_experts_per_tok

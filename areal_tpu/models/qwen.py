@@ -667,11 +667,13 @@ def _decoder_layer(cfg: ModelConfig, x, layer, mask, positions, impl=None):
 
 
 def _shard(x: jax.Array, spec: P) -> jax.Array:
-    """Sharding constraint that is a no-op outside a mesh context."""
-    try:
-        return jax.lax.with_sharding_constraint(x, spec)
-    except (ValueError, RuntimeError):
-        return x
+    """Sharding constraint that is a no-op outside a mesh context and
+    drops manual axes inside shard_map regions (the PP path wraps the
+    layer stack in shard_map over ``pipe``; on jax 0.4.x that manualizes
+    every mesh axis, and a raw constraint naming one dies at lowering)."""
+    from areal_tpu.utils.jax_compat import with_sharding_constraint
+
+    return with_sharding_constraint(x, spec)
 
 
 def forward(

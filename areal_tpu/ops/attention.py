@@ -23,6 +23,24 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from areal_tpu.utils.private_api import pin_signature
+
+# flash_attention is a PRIVATE pallas op we call with keyword args whose
+# names (and the positional q/k/v order) a jax bump can silently change;
+# verified at first use, re-checked against the installed jax by arealint
+# PVT002. Audited against jax 0.4.37.
+_EXPECTED_FLASH_ATTENTION_PARAMS = (
+    "q",
+    "k",
+    "v",
+    "ab",
+    "segment_ids",
+    "causal",
+    "sm_scale",
+    "block_sizes",
+    "debug",
+)
+
 
 def sdpa_xla(q, k, v, mask, head_dim: int):
     """Plain XLA attention. q,k,v: [G, L, H, hd]; mask [G, 1, L, L] bool."""
@@ -52,6 +70,7 @@ def flash_train(q, k, v, segment_ids):
         flash_attention,
     )
 
+    pin_signature(flash_attention, _EXPECTED_FLASH_ATTENTION_PARAMS)
     qt, kt, vt = (jnp.transpose(x, (0, 2, 1, 3)) for x in (q, k, v))
     seg = SegmentIds(q=segment_ids, kv=segment_ids)
     out = flash_attention(
@@ -148,9 +167,19 @@ except Exception:  # noqa: BLE001
     _HAS_PALLAS = False
 
 
-def flash_fwd_pallas(q, k, v, segment_ids, blk_q: int = 128, blk_k: int = 128):
+def flash_fwd_pallas(
+    q,
+    k,
+    v,
+    segment_ids,
+    blk_q: int = 128,
+    blk_k: int = 128,
+    interpret: bool = False,
+):
     """Forward-only packed flash attention. q,k,v: [G, L, H, d] (kv heads
-    pre-replicated); segment_ids [G, L]. Causal by column index."""
+    pre-replicated); segment_ids [G, L]. Causal by column index.
+    ``interpret=True`` runs the kernel through the Pallas interpreter so
+    CPU tier-1 and tools/kernelcheck.py can cover it (arealint KRN005)."""
     assert _HAS_PALLAS
     G, L, H, d = q.shape
     assert L % blk_q == 0 and L % blk_k == 0, (L, blk_q, blk_k)
@@ -181,6 +210,7 @@ def flash_fwd_pallas(q, k, v, segment_ids, blk_q: int = 128, blk_k: int = 128):
             pltpu.VMEM((blk_q, 128), jnp.float32),
             pltpu.VMEM((blk_q, d), jnp.float32),
         ],
+        interpret=interpret,
     )(seg_q_in, seg_k_in, qt, kt, vt)
     return jnp.transpose(out, (0, 2, 1, 3))
 

@@ -45,15 +45,18 @@ from jax.experimental.pallas.ops.tpu.paged_attention.paged_attention_kernel impo
 
 # This fork passes positional args into a PRIVATE jax kernel whose signature
 # a jax upgrade can silently reorder/extend — fail loudly at import instead
-# of via subtly wrong kernel arguments (tested against jax 0.9.0; interpret
-# tests only help if they run on the upgraded jax).
+# of via subtly wrong kernel arguments. Audited against jax 0.4.37 (the
+# ``step_ref`` scalar-prefetch form: 4 library-prefetched scalars, one
+# shared DMA semaphore); interpret tests only help if they run on the
+# upgraded jax, so keep the pin in lockstep with pyproject's audited range.
 import inspect as _inspect
 
+_AUDITED_JAX = "0.4.37"
 _EXPECTED_KERNEL_PARAMS = (
     "lengths_ref",
     "page_indices_ref",
     "buffer_index_ref",
-    "init_flag_ref",
+    "step_ref",
     "q_ref",
     "k_pages_hbm_ref",
     "k_scales_pages_hbm_ref",
@@ -66,8 +69,7 @@ _EXPECTED_KERNEL_PARAMS = (
     "k_scales_vmem_buffer",
     "v_vmem_buffer",
     "v_scales_vmem_buffer",
-    "k_sems",
-    "v_sems",
+    "sem",
     "batch_size",
     "pages_per_compute_block",
     "pages_per_sequence",
@@ -85,7 +87,8 @@ _got = tuple(
 if _got != _EXPECTED_KERNEL_PARAMS:
     raise ImportError(
         "jax's private paged_flash_attention_kernel_inline_seq_dim signature "
-        f"changed (got {_got}); re-audit areal_tpu/ops/paged_attention_q8.py "
+        f"changed (got {_got}); this fork was audited against jax "
+        f"{_AUDITED_JAX} — re-audit areal_tpu/ops/paged_attention_q8.py "
         "against the new kernel before serving with int8 KV"
     )
 
@@ -126,7 +129,7 @@ def _stacked_kernel(
     lengths_ref,
     page_indices_ref,
     buffer_index_ref,
-    init_flag_ref,
+    step_ref,
     layer_ref,
     q_ref,
     k_hbm,
@@ -140,8 +143,7 @@ def _stacked_kernel(
     k_scales_vmem,
     v_vmem,
     v_scales_vmem,
-    k_sems,
-    v_sems,
+    sem,
     *,
     batch_size: int,
     pages_per_compute_block: int,
@@ -154,7 +156,7 @@ def _stacked_kernel(
         lengths_ref,
         page_indices_ref,
         buffer_index_ref,
-        init_flag_ref,
+        step_ref,
         q_ref,
         k_hbm.at[li],
         None if k_scales_hbm is None else k_scales_hbm.at[li],
@@ -167,8 +169,7 @@ def _stacked_kernel(
         k_scales_vmem,
         v_vmem,
         v_scales_vmem,
-        k_sems,
-        v_sems,
+        sem,
         batch_size=batch_size,
         pages_per_compute_block=pages_per_compute_block,
         pages_per_sequence=pages_per_sequence,
@@ -249,15 +250,14 @@ def paged_attention_stacked(
         kv_vmem(k_scales.dtype, 1) if quant else None,
         kv_vmem(v_pages.dtype, head_dim),
         kv_vmem(v_scales.dtype, 1) if quant else None,
-        pltpu.SemaphoreType.DMA((2,)),
-        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA,  # one semaphore shared by k and v copies
     )
 
     operands = [
         lengths,
         page_indices.reshape(-1),
         jnp.zeros((1,), jnp.int32),  # buffer index
-        jnp.ones((1,), jnp.int32),  # init flag
+        jnp.zeros((1,), jnp.int32),  # step
         jnp.asarray(layer, jnp.int32).reshape(1),  # layer index (prefetched)
         q.astype(q_dtype_for_kernel_launch),
         k_pages,
@@ -289,7 +289,7 @@ def paged_attention_stacked(
             if not quant
             else scratch_shapes,
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pltpu.TPUCompilerParams(
             dimension_semantics=dimension_semantics
         ),
         out_shape=[
@@ -306,7 +306,7 @@ def _stacked_kernel_noscale(
     lengths_ref,
     page_indices_ref,
     buffer_index_ref,
-    init_flag_ref,
+    step_ref,
     layer_ref,
     q_ref,
     k_hbm,
@@ -316,15 +316,14 @@ def _stacked_kernel_noscale(
     l_ref,
     k_vmem,
     v_vmem,
-    k_sems,
-    v_sems,
+    sem,
     **kw,
 ):
     _stacked_kernel(
         lengths_ref,
         page_indices_ref,
         buffer_index_ref,
-        init_flag_ref,
+        step_ref,
         layer_ref,
         q_ref,
         k_hbm,
@@ -338,7 +337,6 @@ def _stacked_kernel_noscale(
         None,
         v_vmem,
         None,
-        k_sems,
-        v_sems,
+        sem,
         **kw,
     )

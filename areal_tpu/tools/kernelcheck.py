@@ -1,0 +1,318 @@
+"""kernelcheck — standing interpret-vs-XLA parity harness over ops/ kernels.
+
+Every Pallas kernel in ``areal_tpu/ops/`` registers a *case grid* here:
+closures that run the kernel in interpret mode (CPU) and an independent
+pure-XLA reference over a spread of shapes/dtypes/quantization variants.
+``python -m areal_tpu.tools.kernelcheck`` runs the whole grid and exits
+nonzero on any divergence — so the next kernel PR (ROADMAP item 2) lands
+onto a standing differential harness instead of ad-hoc parity tests, and
+a jax bump that changes kernel semantics (not just signatures — PVT
+covers those) fails loudly in CI.
+
+Registering a kernel:
+
+    @register_kernel("my_kernel")
+    def _cases():
+        yield {
+            "case": "f32-basic",        # unique within the kernel
+            "kernel": lambda: ...,      # interpret-mode launch -> array
+            "reference": lambda: ...,   # pure-XLA ground truth -> array
+            "tol": 2e-2,                # max |kernel - reference| allowed
+        }
+
+The harness materializes both sides, compares max-abs-diff against the
+case tolerance, and reports per-case PASS/FAIL. Closures build their own
+inputs deterministically (seeded numpy) so runs are reproducible.
+
+CLI:
+  --list            enumerate registered kernels and their case counts
+  --kernel NAME     run one kernel's grid only
+  --json            machine-readable report on stdout
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Callable, Dict, Iterator
+
+import numpy as np
+
+REGISTRY: Dict[str, Callable[[], "Iterator[dict]"]] = {}
+
+
+def register_kernel(name: str) -> Callable:
+    def deco(fn: Callable) -> Callable:
+        REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# paged attention (ops/paged_attention_q8.py): int8 narrow scales + stacked
+# ---------------------------------------------------------------------------
+
+
+def _paged_inputs(S=4, KH=2, G=6, hd=128, psz=16, wp=4, layers=1, seed=0):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    H = KH * G
+    N = S * wp + 1
+    q = jnp.asarray(rng.normal(0, 1, (S, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(0, 1, (layers, KH, N, psz, hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1, (layers, KH, N, psz, hd)), jnp.float32)
+    pt = jnp.asarray(1 + np.arange(S * wp).reshape(S, wp), jnp.int32)
+    lengths = jnp.asarray(rng.integers(1, wp * psz + 1, S), jnp.int32)
+    return q, k, v, lengths, pt
+
+
+@register_kernel("paged_attention_q8")
+def _cases_paged_q8() -> Iterator[dict]:
+    from areal_tpu.inference import paged_kv
+    from areal_tpu.ops.paged_attention_q8 import paged_attention_q8
+
+    for S, KH, G, label in ((4, 2, 6, "int8-S4-gqa6"), (2, 1, 8, "int8-S2-mha8")):
+        q, k, v, lengths, pt = _paged_inputs(S=S, KH=KH, G=G, seed=S)
+        kq, ks = paged_kv.quantize_kv(k[0])
+        vq, vs = paged_kv.quantize_kv(v[0])
+        yield {
+            "case": label,
+            # the fork takes RAW q (applies 1/sqrt(hd) internally)
+            "kernel": lambda q=q, kq=kq, ks=ks, vq=vq, vs=vs, le=lengths, pt=pt: (
+                paged_attention_q8(
+                    q, kq, ks, vq, vs, le, pt,
+                    pages_per_compute_block=2,
+                    interpret=True,
+                )
+            ),
+            "reference": lambda q=q, kq=kq, ks=ks, vq=vq, vs=vs, le=lengths, pt=pt: (
+                paged_kv.paged_attention_xla(q, kq, vq, le, pt, ks, vs)
+            ),
+            "tol": 3e-2,
+        }
+
+
+@register_kernel("paged_attention_stacked")
+def _cases_paged_stacked() -> Iterator[dict]:
+    import jax.numpy as jnp
+
+    from areal_tpu.inference import paged_kv
+    from areal_tpu.ops.paged_attention_q8 import paged_attention_stacked
+
+    L = 3
+    q, k, v, lengths, pt = _paged_inputs(layers=L, seed=7)
+
+    # bf16 stacked cache (no scales), first and last layer indices
+    kb, vb = k.astype(jnp.bfloat16), v.astype(jnp.bfloat16)
+    for layer in (0, L - 1):
+        yield {
+            "case": f"stacked-bf16-layer{layer}",
+            "kernel": lambda layer=layer: paged_attention_stacked(
+                q, kb, vb, jnp.int32(layer), lengths, pt,
+                pages_per_compute_block=2,
+                interpret=True,
+            ),
+            "reference": lambda layer=layer: paged_kv.paged_attention_xla(
+                q, kb[layer], vb[layer], lengths, pt
+            ),
+            "tol": 3e-2,
+        }
+
+    # int8 stacked cache with narrow scales
+    kq = jnp.stack([paged_kv.quantize_kv(k[i])[0] for i in range(L)])
+    ks = jnp.stack([paged_kv.quantize_kv(k[i])[1] for i in range(L)])
+    vq = jnp.stack([paged_kv.quantize_kv(v[i])[0] for i in range(L)])
+    vs = jnp.stack([paged_kv.quantize_kv(v[i])[1] for i in range(L)])
+    for layer in (1, L - 1):
+        yield {
+            "case": f"stacked-int8-layer{layer}",
+            "kernel": lambda layer=layer: paged_attention_stacked(
+                q, kq, vq, jnp.int32(layer), lengths, pt,
+                pages_per_compute_block=2,
+                k_scales=ks, v_scales=vs,
+                interpret=True,
+            ),
+            "reference": lambda layer=layer: paged_kv.paged_attention_xla(
+                q, kq[layer], vq[layer], lengths, pt, ks[layer], vs[layer]
+            ),
+            "tol": 3e-2,
+        }
+
+
+# ---------------------------------------------------------------------------
+# forward-only flash attention (ops/attention.py)
+# ---------------------------------------------------------------------------
+
+
+@register_kernel("flash_fwd")
+def _cases_flash_fwd() -> Iterator[dict]:
+    import jax.numpy as jnp
+
+    from areal_tpu.ops import attention
+
+    rng = np.random.default_rng(11)
+    G, L, H, d = 1, 128, 2, 128
+    q = jnp.asarray(rng.normal(0, 1, (G, L, H, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(0, 1, (G, L, H, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1, (G, L, H, d)), jnp.float32)
+    grids = {
+        "f32-one-segment": np.ones((G, L), np.int32),
+        "f32-packed-two-segments": np.concatenate(
+            [np.ones((G, L // 2), np.int32), 2 * np.ones((G, L // 2), np.int32)],
+            axis=1,
+        ),
+    }
+    for label, seg_np in grids.items():
+        seg = jnp.asarray(seg_np)
+        # same semantics as the kernel: causal AND same segment AND seg != 0
+        qi = np.arange(L)[:, None]
+        ki = np.arange(L)[None, :]
+        mask = (
+            (qi >= ki)
+            & (seg_np[:, :, None] == seg_np[:, None, :])
+            & (seg_np[:, :, None] != 0)
+        )[:, None]  # [G, 1, L, L]
+        yield {
+            "case": label,
+            "kernel": lambda seg=seg: attention.flash_fwd_pallas(
+                q, k, v, seg, interpret=True
+            ),
+            "reference": lambda mask=mask: attention.sdpa_xla(
+                q, k, v, jnp.asarray(mask), d
+            ),
+            "tol": 2e-4,
+        }
+
+
+# ---------------------------------------------------------------------------
+# block-sparse tree attention (ops/tree_attention.py)
+# ---------------------------------------------------------------------------
+
+
+@register_kernel("tree_attention")
+def _cases_tree_attention() -> Iterator[dict]:
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.ops import tree_attention as ta
+
+    rng = np.random.default_rng(13)
+    N, H, d = 128, 2, 128
+    q = jnp.asarray(rng.normal(0, 1, (N, H, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(0, 1, (N, H, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1, (N, H, d)), jnp.float32)
+
+    # a chain tree (parent = i-1) makes the ancestor mask exactly causal;
+    # a branching tree exercises the sparse-block path
+    chain = np.arange(-1, N - 1)
+    branchy = np.where(np.arange(N) % 4 == 0, np.maximum(np.arange(N) - 4, -1),
+                       np.arange(N) - 1).astype(np.int64)
+    for label, parent in (("chain-causal", chain), ("branching", branchy)):
+        words_np, block_any_np = ta.pack_ancestor_bits(parent)
+        words = jnp.asarray(words_np)
+        block_any = jnp.asarray(block_any_np)
+        # dense reference from the same ancestor bits
+        bits = np.unpackbits(
+            words_np.view(np.uint8), bitorder="little", axis=1
+        )[:, :N].astype(bool)  # [N, N] ancestor mask
+        mask = jnp.asarray(bits)[None]  # [1, N, N], broadcast over heads
+
+        def ref(mask=mask):
+            logits = jnp.einsum("qhd,khd->hqk", q, k) * d**-0.5
+            probs = jax.nn.softmax(jnp.where(mask, logits, -1e30), axis=-1)
+            return jnp.einsum("hqk,khd->qhd", probs, v)
+
+        yield {
+            "case": label,
+            "kernel": lambda w=words, b=block_any: ta.tree_attention(
+                q, k, v, w, b, interpret=True
+            ),
+            "reference": ref,
+            "tol": 2e-4,
+        }
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+
+def run_kernel(name: str) -> list[dict]:
+    """Run one kernel's full case grid; never raises on divergence — every
+    case reports {kernel, case, max_abs_diff, tol, ok, error?}."""
+    results: list[dict] = []
+    for case in REGISTRY[name]():
+        rec: dict[str, Any] = {"kernel": name, "case": case["case"], "tol": case["tol"]}
+        try:
+            got = np.asarray(case["kernel"](), np.float32)
+            want = np.asarray(case["reference"](), np.float32)
+            if got.shape != want.shape:
+                rec.update(ok=False, error=f"shape {got.shape} vs {want.shape}")
+            else:
+                diff = float(np.max(np.abs(got - want)))
+                rec.update(max_abs_diff=diff, ok=diff <= case["tol"])
+        except Exception as e:  # noqa: BLE001 — a crash IS a parity failure
+            rec.update(ok=False, error=f"{type(e).__name__}: {e}")
+        results.append(rec)
+    return results
+
+
+def run_all(only: str | None = None) -> list[dict]:
+    names = [only] if only else sorted(REGISTRY)
+    out: list[dict] = []
+    for name in names:
+        out.extend(run_kernel(name))
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kernelcheck",
+        description="interpret-vs-XLA parity for every registered ops/ kernel",
+    )
+    ap.add_argument("--list", action="store_true", help="enumerate kernels")
+    ap.add_argument("--kernel", help="run one kernel's grid only")
+    ap.add_argument("--json", action="store_true", help="JSON report")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in sorted(REGISTRY):
+            n = sum(1 for _ in REGISTRY[name]())
+            print(f"{name}: {n} case(s)")
+        return 0
+    if args.kernel and args.kernel not in REGISTRY:
+        print(f"unknown kernel {args.kernel!r}; known: {sorted(REGISTRY)}",
+              file=sys.stderr)
+        return 2
+
+    results = run_all(args.kernel)
+    if args.json:
+        print(json.dumps({"results": results}, indent=1))
+    else:
+        for r in results:
+            if r["ok"]:
+                print(
+                    f"PASS {r['kernel']}:{r['case']} "
+                    f"max_abs_diff={r.get('max_abs_diff', 0):.2e} tol={r['tol']:.0e}"
+                )
+            else:
+                detail = r.get("error") or (
+                    f"max_abs_diff={r['max_abs_diff']:.2e} > tol={r['tol']:.0e}"
+                )
+                print(f"FAIL {r['kernel']}:{r['case']} {detail}")
+    failed = [r for r in results if not r["ok"]]
+    if failed:
+        print(f"kernelcheck: {len(failed)}/{len(results)} case(s) DIVERGED",
+              file=sys.stderr)
+        return 1
+    # in --json mode stdout is the document; keep it parseable
+    print(f"kernelcheck: {len(results)} case(s) ok",
+          file=sys.stderr if args.json else sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

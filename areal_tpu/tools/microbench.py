@@ -9,6 +9,7 @@ Registered benches (fast set — the committed CPU baseline under
 benchmarks/):
 
     paged_decode_step    one forward_decode_paged step over all slots
+    paged_attention_interpret  interpret-mode stacked paged kernel alone
     suffix_prefill       radix-suffix prefill over a cached prefix
     int8_kv_dequant      KV quantize->dequantize round trip
     tree_verify_forward  ancestor-masked forest forward (no_grad)
@@ -192,6 +193,46 @@ def bench_paged_decode_step() -> dict:
         "tokens": S,
         "flops": costs["flops"],
         "bytes": costs["bytes"],
+    }
+
+
+@register("paged_attention_interpret")
+def bench_paged_attention_interpret() -> dict:
+    """Revived interpret-mode stacked paged-attention kernel in isolation
+    (ISSUE 17 burn-down): the same Pallas body the TPU runs, executed via
+    the interpreter so the CPU baseline pins the kernel's own cost — a
+    signature or index-map regression shows up here before any TPU job."""
+    import jax
+    import jax.numpy as jnp
+
+    c = _ctx()
+    from areal_tpu.ops.paged_attention_q8 import paged_attention_stacked
+
+    S, KH, G, hd, psz, wp, L = 4, 2, 6, 128, c["page_size"], 4, 2
+    H = KH * G
+    N = S * wp + 1
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(0, 1, (S, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(0, 1, (L, KH, N, psz, hd)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(0, 1, (L, KH, N, psz, hd)), jnp.bfloat16)
+    pt = jnp.asarray(1 + np.arange(S * wp, dtype=np.int32).reshape(S, wp))
+    ctx = wp * psz  # every slot fully warm
+    lengths = jnp.full((S,), ctx, jnp.int32)
+    fn = jax.jit(
+        lambda q, k, v, le, t: paged_attention_stacked(
+            q, k, v, jnp.int32(0), le, t,
+            pages_per_compute_block=2,
+            interpret=True,
+        )
+    )
+    # QK^T + AV over the warm context, one query row per slot
+    flops = 4.0 * S * H * hd * ctx
+    bytes_ = 2.0 * KH * S * ctx * hd * k.dtype.itemsize + q.nbytes * 2
+    return {
+        "run": lambda: _sync(fn(q, k, v, lengths, pt)),
+        "tokens": S,
+        "flops": flops,
+        "bytes": bytes_,
     }
 
 

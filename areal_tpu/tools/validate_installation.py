@@ -330,6 +330,36 @@ def main(argv=None) -> int:
 
     _check("wire_lint", wire_lint, results)
 
+    def kernel_lint():
+        """The kernel-arc families (KRN Pallas launch-site safety, PVT
+        private-jax signature pins re-verified against the INSTALLED jax,
+        MSH collective/mesh consistency) over the package — run on the
+        deployment's actual jax, this is the install-time check that a
+        jax bump has not drifted any pinned private kernel signature
+        (docs/static_analysis.md)."""
+        from areal_tpu.analysis import (
+            default_baseline_path,
+            default_package_root,
+            run_analysis,
+        )
+
+        res = run_analysis(
+            [default_package_root()],
+            rules=["KRN", "PVT", "MSH"],
+            baseline_path=default_baseline_path(),
+        )
+        if not res.ok:
+            raise RuntimeError(
+                "; ".join(f.render() for f in res.findings[:5])
+                + (f" (+{len(res.findings) - 5} more)" if len(res.findings) > 5 else "")
+            )
+        return (
+            f"KRN/PVT/MSH clean over {res.files_checked} files "
+            f"({len(res.suppressed)} reasoned suppressions)"
+        )
+
+    _check("kernel_lint", kernel_lint, results)
+
     def native_kernels():
         from areal_tpu.native import datapack_lib
         from areal_tpu.utils.datapack import ffd_allocate

@@ -14,11 +14,34 @@ both:
 - ``get_abstract_mesh()``: newer ambient-mesh query; the old equivalent is
   the resource env's physical mesh (empty mesh when no context is active,
   which callers already treat as "no mesh").
+- ``with_sharding_constraint(x, spec)``: manual-axes-aware constraint.
+  Old ``shard_map`` makes EVERY mesh axis manual inside the mapped body,
+  and ``jax.lax.with_sharding_constraint`` there rejects any spec naming
+  a manual axis at lowering time ("Axis ... is also found in
+  manual_axes") — the pp_engine failure class. Newer jax only
+  manualizes the mapped axes, so GSPMD constraints keep working inside
+  a partially-manual region. This shim recovers that behavior on 0.4.x
+  by dropping manual axes from the spec (inside a full-manual region the
+  array is already a local slice, so the constraint is meaningless for
+  those axes) and becoming a no-op when nothing survives. All model/
+  engine code must route constraints through this shim, not
+  ``jax.lax.with_sharding_constraint`` directly (arealint MSH003).
+- ``jax_threefry_partitionable``: flipped on at import (the newer-jax
+  default) so seeded init is identical on every mesh topology.
 """
 
 from __future__ import annotations
 
 import jax
+from jax.sharding import PartitionSpec as _P
+
+# Newer jax defaults the partitionable threefry lowering ON, which makes
+# jax.random generation invariant to the output sharding. 0.4.x defaults
+# it OFF, so ``jit(init_params, out_shardings=...)`` yields *mesh-dependent*
+# initial params — the pp-vs-plain engine parity failure class. Align 0.4.x
+# with the new default so the same seed gives the same params on any mesh.
+if not jax.config.jax_threefry_partitionable:
+    jax.config.update("jax_threefry_partitionable", True)
 
 if hasattr(jax, "set_mesh"):
     set_mesh = jax.set_mesh
@@ -63,6 +86,49 @@ else:
         """jax<=0.4 fallback: psum of 1 constant-folds to a python int
         inside shard_map/pmap bodies (usable as a static loop bound)."""
         return jax.lax.psum(1, axis_name)
+
+
+def manual_axis_names() -> frozenset[str]:
+    """Mesh axes that are MANUAL at the current trace point (bound by an
+    enclosing shard_map/pmap). Empty outside any manual region."""
+    if hasattr(jax.sharding, "get_abstract_mesh"):
+        # newer jax: the abstract mesh knows each axis's type
+        mesh = jax.sharding.get_abstract_mesh()
+        manual = getattr(mesh, "manual_axes", None)
+        if manual is not None:
+            return frozenset(manual)
+    try:
+        # 0.4.x: shard_map extends the axis env with every manual axis
+        from jax._src.core import get_axis_env  # noqa: PVT — pinned below
+
+        return frozenset(get_axis_env().axis_sizes)
+    except (ImportError, AttributeError):  # pragma: no cover — layout drift
+        return frozenset()
+
+
+def with_sharding_constraint(x, spec):
+    """``jax.lax.with_sharding_constraint`` that survives manual regions:
+    axes currently bound manual (old shard_map manualizes ALL mesh axes)
+    are dropped from ``spec``; a fully-dropped spec is a no-op. Outside
+    any mesh context the constraint is also a no-op (same contract as
+    qwen's historical ``_shard`` helper)."""
+    manual = manual_axis_names()
+    if manual:
+        def keep(entry):
+            if entry is None:
+                return None
+            if isinstance(entry, (tuple, list)):
+                kept = tuple(a for a in entry if a not in manual)
+                return kept if kept else None
+            return None if entry in manual else entry
+        spec = _P(*(keep(e) for e in spec))
+        if all(e is None for e in spec):
+            return x
+    try:
+        # arealint: disable-next=MSH003 this IS the shim every other raw call must route through
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x  # no ambient mesh (single-process tests, CPU smoke)
 
 
 def get_abstract_mesh():

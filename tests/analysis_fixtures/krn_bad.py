@@ -1,0 +1,29 @@
+"""KRN bad fixture: one pallas_call launch wearing every kernel-safety
+defect — index-map arity drift (KRN001), kernel/operand arity drift
+(KRN002), a write through an input ref (KRN003), a cdiv grid with no
+masking (KRN004), and no interpret= exposure anywhere (KRN005)."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, o_ref):  # KRN002: launch supplies 3 refs (1 in + 1 out
+    x_ref[...] = o_ref[...] * 2.0  # + 1 scratch)  # KRN003: writes input
+    o_ref[...] = x_ref[...]
+
+
+def launch(x):  # KRN005: no `interpret` parameter on any enclosing fn
+    grid = (pl.cdiv(x.shape[0], 128), 4)  # KRN004: ragged tail, no pl.when
+    return pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            # KRN001: 1 index-map argument, 2 grid dimensions
+            pl.BlockSpec((128, 128), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((128, 128), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        scratch_shapes=[pltpu.VMEM((128, 128), jnp.float32)],
+    )(x)

@@ -1,0 +1,27 @@
+"""KRN good fixture: the same launch shape with every rule satisfied —
+index maps match the grid rank, the kernel's refs match the operand plan,
+outputs go through the output ref, the grid is exact (no cdiv), and the
+wrapper exposes interpret= for CPU parity runs."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, o_ref, acc_ref):
+    acc_ref[...] = x_ref[...] * 2.0
+    o_ref[...] = acc_ref[...]
+
+
+def launch(x, interpret: bool = False):
+    grid = (x.shape[0] // 128, 4)
+    return pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((128, 128), lambda i, j: (i, 0))],
+        out_specs=pl.BlockSpec((128, 128), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        scratch_shapes=[pltpu.VMEM((128, 128), jnp.float32)],
+        interpret=interpret,
+    )(x)

@@ -1,0 +1,31 @@
+"""PVT bad fixture: an unguarded private import (PVT001), a pin that has
+drifted from the installed jax (PVT002), and a pin whose target module
+does not exist in the installed jax at all (PVT003). The analyzer
+resolves the pins against the REAL installed jax — a mutated pin must be
+a reported finding, never a crash."""
+
+import inspect
+
+# PVT001: private import, no pin, no try/except ImportError gate
+from jax._src.core import Trace
+
+# PVT002: pinned, but the tuple is stale relative to the installed jax
+from jax.experimental.pallas.ops.tpu.paged_attention.paged_attention_kernel import (
+    paged_flash_attention_kernel_inline_seq_dim,
+)
+
+_EXPECTED_KERNEL_PARAMS = ("lengths_ref", "a_param_jax_renamed", "q_ref")
+_got = tuple(
+    inspect.signature(paged_flash_attention_kernel_inline_seq_dim).parameters
+)
+if _got != _EXPECTED_KERNEL_PARAMS:
+    KERNEL_DRIFTED = True
+
+# PVT003: pinned, but the module vanished from the installed jax
+from jax._src.definitely_not_a_module import vanished_kernel
+
+_EXPECTED_VANISHED_PARAMS = ("x_ref", "o_ref")
+if tuple(inspect.signature(vanished_kernel).parameters) != (
+    _EXPECTED_VANISHED_PARAMS
+):
+    VANISHED_DRIFTED = True

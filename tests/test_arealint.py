@@ -264,6 +264,50 @@ def test_lck_good_fixture():
     assert rules_in(FIXTURES / "lck_good.py", ["LCK"]) == []
 
 
+def test_krn_bad_fixture():
+    """One pallas_call launch wearing every kernel-safety defect."""
+    rules = rules_in(FIXTURES / "krn_bad.py", ["KRN"])
+    assert {"KRN001", "KRN002", "KRN003", "KRN004", "KRN005"} == set(rules)
+
+
+def test_krn_good_fixture():
+    # matched index-map arity, operand plan, no input writes, exact grid,
+    # interpret= exposed
+    assert rules_in(FIXTURES / "krn_good.py", ["KRN"]) == []
+
+
+def test_pvt_bad_fixture():
+    """Unguarded private import, drifted pin, and vanished pin target —
+    all REPORTED findings, none a crash (the analyzer resolves the pins
+    against the really-installed jax)."""
+    res = run_analysis(
+        [FIXTURES / "pvt_bad.py"], rules=["PVT"], baseline_path=None
+    )
+    assert {"PVT001", "PVT002", "PVT003"} == {f.rule for f in res.findings}
+    drift = next(f for f in res.findings if f.rule == "PVT002")
+    # the finding carries the parameter diff, naming a really-removed pin
+    # entry and a really-present installed parameter
+    assert "a_param_jax_renamed" in drift.message
+    assert "step_ref" in drift.message
+
+
+def test_pvt_good_fixture():
+    # gated import, inline inspect.signature pin matching the installed
+    # jax, and the pin_signature helper idiom all stay silent
+    assert rules_in(FIXTURES / "pvt_good.py", ["PVT"]) == []
+
+
+def test_msh_bad_fixture():
+    rules = rules_in(FIXTURES / "msh_bad.py", ["MSH"])
+    assert {"MSH001", "MSH002", "MSH003"} == set(rules)
+
+
+def test_msh_good_fixture():
+    # declared axes, pmap-bound local axis, matching out_specs, and the
+    # jax_compat-routed constraint stay silent
+    assert rules_in(FIXTURES / "msh_good.py", ["MSH"]) == []
+
+
 def test_wire_response_var_rebinding_unions_not_narrows(tmp_path):
     """A handler that returns a response var, rebinds it, and returns it
     again emits the UNION of both literals — a consumer reading a key

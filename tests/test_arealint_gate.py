@@ -62,6 +62,7 @@ def test_every_rule_family_is_loaded():
     assert {
         "ASY", "JAX", "THR", "CFG", "OBS", "EXC", "SIG",
         "PRF", "DON", "SHD", "RCP", "WIRE", "LCK",
+        "KRN", "PVT", "MSH",
     } <= families
 
 
@@ -115,6 +116,62 @@ def test_wire_lck_baseline_entries_would_need_reasons(package_result):
     assert not wire_lck, (
         "WIRE/LCK must stay fixed-or-inline-suppressed, not baselined: "
         f"{wire_lck}"
+    )
+
+
+def test_krn_pvt_msh_enforced_repo_wide():
+    """ISSUE 17: the Pallas-kernel and SPMD-collective families are
+    tier-1-clean — the scoped run that guards the kernel arc (ROADMAP
+    items 2-3). PVT here re-verifies every pinned private-API signature
+    against the INSTALLED jax, so this test is also the early-warning
+    trip-wire for the next jax bump."""
+    res = run_analysis(
+        [default_package_root()],
+        rules=["KRN", "PVT", "MSH"],
+        baseline_path=default_baseline_path(),
+    )
+    assert res.files_checked > 100
+    assert not res.findings, "KRN/PVT/MSH findings:\n" + "\n".join(
+        f.render() for f in res.findings
+    )
+
+
+def test_krn_pvt_msh_suppressions_carry_written_reasons():
+    """No blanket burn-down: every inline KRN/PVT/MSH suppression in the
+    package must say WHY (e.g. jax_compat's raw constraint IS the shim
+    the MSH003 rule tells everyone else to route through)."""
+    res = run_analysis(
+        [default_package_root()],
+        rules=["KRN", "PVT", "MSH"],
+        baseline_path=default_baseline_path(),
+    )
+    from areal_tpu.analysis.core import SourceFile
+
+    bare = []
+    for f in res.suppressed:
+        sf = SourceFile.load(
+            default_package_root() / ".." / f.path,
+            default_package_root().parent,
+        )
+        sup = sf.suppressions.get(f.line) or sf.file_suppression
+        if sup is None or not sup.reason.strip():
+            bare.append(f.key)
+    assert not bare, f"reason-less KRN/PVT/MSH suppressions: {bare}"
+
+
+def test_krn_pvt_msh_never_baselined(package_result):
+    """The kernel-arc families stay fixed-or-inline-suppressed: a
+    baselined KRN/PVT/MSH entry would let signature drift or a manual-axes
+    regression ride silently through the next jax bump."""
+    doc = load_baseline(default_baseline_path())
+    entries = [
+        e["key"]
+        for e in doc["findings"]
+        if e["rule"].startswith(("KRN", "PVT", "MSH"))
+    ]
+    assert not entries, (
+        "KRN/PVT/MSH must never be baselined, only fixed or "
+        f"inline-suppressed with a reason: {entries}"
     )
 
 

@@ -353,12 +353,13 @@ def test_compare_matrix():
 
 def test_fast_benches_registered():
     """The committed CPU baseline's bench set is a stable contract: the
-    six hot-path benches from docs/perf.md must stay registered as the
+    seven hot-path benches from docs/perf.md must stay registered as the
     fast (non-heavy) set."""
     from areal_tpu.tools import microbench as mb
 
     assert set(mb.fast_names()) == {
         "paged_decode_step",
+        "paged_attention_interpret",
         "suffix_prefill",
         "int8_kv_dequant",
         "tree_verify_forward",
