@@ -187,6 +187,41 @@ class OpenAIProxyConfig:
     engine_max_tokens: int = 0  # 0 = the serving engine's own limit
     capacity: int = 128  # concurrent sessions per proxy worker
     admin_api_key: str = ""  # empty = generate one at start_proxy time
+    # horizontal gateway sharding (docs/serving.md "Gateway tier")
+    tier: "GatewayTierConfig" = field(default_factory=lambda: GatewayTierConfig())
+
+
+@dataclass
+class GatewayTierConfig:
+    """Horizontally-sharded gateway tier (docs/serving.md "Gateway tier").
+
+    N ``GatewayState`` shards behind a consistent-hash ring
+    (routing/hash_ring.py): clients map session keys to shards
+    deterministically, so session routes and the shadow prefix index stay
+    shard-LOCAL with no shared state on the request path. Membership +
+    drain states publish through the name_resolve layer (etcd in
+    production); when discovery is unreachable the tier keeps serving on
+    its last-known view (counted on
+    ``areal_gateway_shard_membership_stale_total``, never a crash)."""
+
+    enabled: bool = False
+    n_shards: int = 1
+    # vnode replicas per shard on the ring: more = smoother K/N remap
+    vnodes: int = 64
+    # name_resolve subtree the tier publishes shard records under
+    # (rooted per experiment/trial by the tier harness)
+    namespace: str = "gateway_tier/default"
+    # membership record TTL (keepalive-refreshed; a dead shard's record
+    # expires and the ring drops it) and the reader's poll cadence
+    membership_ttl_s: float = 5.0
+    membership_poll_s: float = 1.0
+    # degraded-mode floor: shard addresses assumed live when discovery has
+    # never answered (static membership — the tier must serve without etcd)
+    static_shards: list[str] = field(default_factory=list)
+    # affinity repair: a shard receiving an unknown session key probes the
+    # backend proxies to adopt the route (the proxy still owns the session;
+    # only the dead shard's route map was lost). Off = pre-tier 410.
+    route_adopt: bool = True
 
 
 @dataclass
@@ -217,6 +252,11 @@ class RequestLifecycleConfig:
     min_free_pages: int = 0
     # Retry-After seconds returned with 429 rejections
     retry_after_s: float = 1.0
+    # bounded multiplicative jitter on the emitted hint AND the client's
+    # backpressure wait: each is scattered into [x, x*(1+jitter)] so a
+    # fleet of honoring clients never retries on the same tick (thundering
+    # herd). 0 = exact hints (tests that assert byte-stable timing).
+    retry_after_jitter: float = 0.5
     # client-side: total wall-clock seconds a request keeps honoring 429
     # Retry-After hints before giving up. Backpressure waits do NOT burn
     # the bounded failure-retry attempts (a saturated-but-healthy fleet
@@ -272,6 +312,13 @@ class ChaosConfig:
     # preempted at most once per injector so a chaos run kills a bounded
     # set of workers instead of the whole fleet.
     preempt_prob: float = 0.0
+    # gateway-shard kill (docs/serving.md "Gateway tier"): hard-stop one
+    # registered gateway shard (each at most once per injector, seeded
+    # choice) so the tier's re-hash + affinity-repair path is exercised,
+    # not simulated. Targets register via
+    # FaultInjector.set_gateway_kill_targets; the triggering request
+    # proceeds untouched (a shard kill is a process fault).
+    gateway_kill_prob: float = 0.0
     # only inject on paths starting with this prefix ("" = every path);
     # lets a test target /generate while leaving weight updates clean
     path_prefix: str = ""

@@ -35,3 +35,12 @@ AUTOPILOT_TOKEN_HEADER = "x-areal-autopilot-token"
 # comma-separated downstream addresses + the per-hop timeout
 RELAY_HEADER = "X-Areal-Relay"
 RELAY_TIMEOUT_HEADER = "X-Areal-Relay-Timeout"
+
+# gateway tier (docs/serving.md "Gateway tier"): every shard stamps its
+# shard id on responses so clients/benches can attribute traffic; clients
+# send the shard id THEIR ring computed so a receiving shard can count
+# ring-view divergence (areal_gateway_shard_misroute_total) — the request
+# is still served locally (placement disagreement is never an error)
+GATEWAY_SHARD_HEADER = "x-areal-gateway-shard"
+# expected-owner echo from the client's ring (misroute detection)
+GATEWAY_EXPECT_SHARD_HEADER = "x-areal-expect-shard"

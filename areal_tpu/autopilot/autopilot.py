@@ -45,6 +45,7 @@ from areal_tpu.autopilot.controllers import (
     AdmissionController,
     CacheController,
     FleetController,
+    GatewayTierController,
     StalenessController,
 )
 from areal_tpu.observability import catalog
@@ -94,6 +95,7 @@ class Autopilot:
         *,
         staleness_manager=None,
         gateway=None,
+        gateway_tier=None,
         metrics_source=None,
         poller=None,
         fetch_statusz=None,
@@ -106,6 +108,7 @@ class Autopilot:
         self._addresses_fn = addresses_fn
         self._staleness_manager = staleness_manager
         self._gateway = gateway
+        self._gateway_tier = gateway_tier
         if metrics_source is not None:
             self._source = metrics_source
         elif getattr(cfg, "metrics_addr", ""):
@@ -160,6 +163,13 @@ class Autopilot:
                 FleetController(
                     cfg.fleet, initial_replicas=len(addresses_fn() or [])
                 )
+            )
+        if cfg.fleet.enabled and gateway_tier is not None:
+            # the tier scales with the SAME asymmetric policy the replica
+            # fleet uses (undrain cooldown-exempt, drain behind sustain +
+            # cooldown) — one scaling discipline across the control plane
+            self.controllers.append(
+                GatewayTierController(cfg.fleet, gateway_tier)
             )
         self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
@@ -322,6 +332,25 @@ class Autopilot:
             # the end-of-tick convergence sweep does the actual push —
             # several same-round actions must not each fan a POST wave
             self._actuated_knobs.add(action.knob)
+        elif action.knob == "target_gateway_shards":
+            # tier scaling actuates the shards' PR 8 drain surface through
+            # the tier harness (in-process; the shard's own POST /drain
+            # returns immediately — nothing to quiesce at the gateway, its
+            # routes keep serving until their sessions end)
+            if self._gateway_tier is None:
+                return False
+            try:
+                if action.new < action.old:
+                    self._gateway_tier.drain_shard(action.target)
+                else:
+                    self._gateway_tier.undrain_shard(action.target)
+            except Exception:  # noqa: BLE001 — re-decided next round
+                logger.warning(
+                    f"autopilot tier scale on {action.target} failed",
+                    exc_info=True,
+                )
+                self._obs.apply_failures.inc()
+                return False
         elif action.knob == "target_replicas":
             path = "/drain" if action.new < action.old else "/undrain"
             if path == "/drain":

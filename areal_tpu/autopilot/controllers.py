@@ -22,6 +22,7 @@ takes ``signals.now`` so tests drive time explicitly — no fleet needed).
 from __future__ import annotations
 
 import dataclasses
+import types
 
 from areal_tpu.autopilot.signals import Signals
 
@@ -522,3 +523,67 @@ class FleetController(_Base):
                 )
             ]
         return []
+
+
+class GatewayTierController(FleetController):
+    """The fleet autoscaler's asymmetric policy applied to the GATEWAY
+    tier (docs/serving.md "Gateway tier").
+
+    Same state machine as :class:`FleetController` — sustained idleness
+    drains the least-loaded shard, sustained shedding undrains one,
+    undrain is cooldown-exempt — but the signals come from the tier
+    itself (``tier.shard_stats()``: per-shard inflight/max_inflight and
+    the shed counters) instead of replica /statusz snapshots, and the
+    knob is ``target_gateway_shards`` so the facade actuates the shards'
+    drain surface rather than the replicas'. ``sig.now`` still drives
+    the clock, so tests steer time the same way."""
+
+    name = "gateway_tier"
+
+    def __init__(self, cfg, tier):
+        super().__init__(
+            cfg, initial_replicas=len(tier.shard_stats() or ())
+        )
+        self.tier = tier
+        self._last_shed_total: int | None = None
+
+    def decide(self, sig: Signals) -> list[Action]:
+        stats = self.tier.shard_stats()
+        shed_total = sum(s.get("shed", 0) for s in stats)
+        # shed DELTA is the tier's backlog signal: a gateway has no queue,
+        # so "requests we turned away since the last round" is what
+        # sustained overload looks like from here
+        shed_delta = (
+            0
+            if self._last_shed_total is None
+            else max(0, shed_total - self._last_shed_total)
+        )
+        self._last_shed_total = shed_total
+        replicas = [
+            types.SimpleNamespace(
+                addr=s["addr"],
+                draining=bool(s["draining"]),
+                drain_terminal=False,
+                load_fraction=(
+                    s["inflight"] / s["max_inflight"]
+                    if s.get("max_inflight", 0) > 0
+                    else 0.0
+                ),
+            )
+            for s in stats
+        ]
+        live = [r for r in replicas if not r.draining]
+        shim = types.SimpleNamespace(
+            now=sig.now,
+            replicas=replicas,
+            mean_load_fraction=(
+                sum(r.load_fraction for r in live) / len(live)
+                if live
+                else None
+            ),
+            mean_queue_depth=float(shed_delta) if stats else None,
+        )
+        actions = super().decide(shim)
+        for a in actions:
+            a.knob = "target_gateway_shards"
+        return actions

@@ -783,6 +783,20 @@ class RemoteJaxEngine(InferenceEngine):
                             )
                         except ValueError:
                             retry_after = 1.0
+                        # client-side half of the thundering-herd fix:
+                        # even against a pre-jitter server (or a proxy
+                        # that rounded the hint), scatter the wait into
+                        # [x, x*(1+jitter)] so the herd never re-arrives
+                        # on one tick
+                        bp_jitter = (
+                            getattr(lc, "retry_after_jitter", 0.0) or 0.0
+                            if lc is not None and lc.enabled
+                            else 0.0
+                        )
+                        if bp_jitter > 0 and retry_after > 0:
+                            retry_after *= (
+                                1.0 + random.random() * bp_jitter
+                            )
                         try:
                             body_429 = await r.json()
                         except Exception:  # noqa: BLE001 — a bare 429 is

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import random as _random
 import threading
 import time
 
@@ -346,6 +347,12 @@ class InferenceServer:
             if not admit:
                 lc = getattr(self.engine.config, "lifecycle", None)
                 retry_after = getattr(lc, "retry_after_s", 1.0) or 1.0
+                # bounded multiplicative jitter scatters honoring clients
+                # across [x, x*(1+jitter)] — a fleet shedding in unison
+                # must not re-arrive in unison (thundering herd)
+                jitter = getattr(lc, "retry_after_jitter", 0.0) or 0.0
+                if jitter > 0:
+                    retry_after *= 1.0 + _random.random() * jitter
                 self._lc_obs.admission_rejected.labels(reason=reason).inc()
                 self._flight.record(
                     "admission_reject",

@@ -52,6 +52,7 @@ class RolloutController:
         self._gateway_thread = None
         self._gateway_loop = None
         self.gateway_url: str | None = None
+        self._shard_directory = None  # ShardDirectory when the tier is on
         import threading as _threading
 
         # fault-tolerance: worker fleet membership + eviction state, guarded
@@ -214,9 +215,17 @@ class RolloutController:
         port = port or find_free_port()
         backends = [f"http://{w.address}" for w in self.proxy_workers]
         lc = getattr(self._engine_init_config, "lifecycle", None)
+        ocfg = getattr(self._engine_init_config, "openai", None)
+        tier_cfg = getattr(ocfg, "tier", None)
+        tier_on = tier_cfg is not None and tier_cfg.enabled
+        from areal_tpu.utils.network import gethostip
+
+        shard_addr = f"{gethostip()}:{port}"
         state = GatewayState(
             backends,
             admin_api_key=self._admin_key,
+            shard_id=f"gw-{shard_addr}" if tier_on else "",
+            route_adopt=bool(tier_on and tier_cfg.route_adopt),
             max_inflight=(
                 lc.gateway_max_inflight if lc is not None and lc.enabled else 0
             ),
@@ -226,6 +235,9 @@ class RolloutController:
                 else 0
             ),
             retry_after_s=(lc.retry_after_s if lc is not None else 1.0),
+            retry_after_jitter=(
+                lc.retry_after_jitter if lc is not None else 0.5
+            ),
         )
         started = threading.Event()
         # loop is created and published BEFORE the thread starts, so the
@@ -249,14 +261,25 @@ class RolloutController:
             self._gateway_thread = None
             self._gateway_loop = None
             raise RuntimeError(f"gateway failed to bind port {port}")
-        from areal_tpu.utils.network import gethostip
-
         # externally reachable URL — off-host agents are the whole point
-        self.gateway_url = f"http://{gethostip()}:{port}"
+        self.gateway_url = f"http://{shard_addr}"
+        if tier_on:
+            # gateway tier (docs/serving.md "Gateway tier"): publish this
+            # process's shard into the shared membership namespace (etcd
+            # via the default name_resolve repo in production) so sibling
+            # controller processes and tier clients form one hash ring
+            from areal_tpu.openai.proxy.tier import ShardDirectory
+
+            self._shard_directory = ShardDirectory(tier_cfg)
+            self._shard_directory.publish(f"gw-{shard_addr}", shard_addr)
+            self._shard_directory.start()
         logger.info(f"gateway up at {self.gateway_url} over {backends}")
         return self.gateway_url
 
     def stop_gateway(self) -> None:
+        if self._shard_directory is not None:
+            self._shard_directory.stop()  # unpublishes our shard record
+            self._shard_directory = None
         if self._gateway_thread is not None:
             if self._gateway_loop is not None:
                 self._gateway_loop.call_soon_threadsafe(self._gateway_loop.stop)

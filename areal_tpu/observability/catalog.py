@@ -841,6 +841,51 @@ def kernel_metrics(reg: Registry | None = None) -> SimpleNamespace:
     )
 
 
+def gateway_tier_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """Horizontally-sharded gateway tier (docs/serving.md "Gateway tier"):
+    ring membership health, degraded-mode discovery, and the affinity
+    -repair path that resumes sessions on surviving shards."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        shard_count=r.gauge(
+            "areal_gateway_shard_count",
+            "Live (non-draining) gateway shards in the current membership "
+            "view — the ring's fan-out.",
+        ),
+        membership_stale=r.counter(
+            "areal_gateway_shard_membership_stale_total",
+            "Membership refreshes that failed (etcd/name_resolve "
+            "unreachable) and kept serving on the last-known view — the "
+            "tier's degraded mode is counted, never a crash.",
+        ),
+        route_recoveries=r.counter(
+            "areal_gateway_shard_route_recoveries_total",
+            "Sessions adopted by a surviving shard after a re-hash: the "
+            "shard had no route for the presented session key and "
+            "recovered it by probing the backend proxies (affinity "
+            "repair after a shard death).",
+        ),
+        misroutes=r.counter(
+            "areal_gateway_shard_misroute_total",
+            "Requests that arrived at a shard other than the one the "
+            "client's ring expected (x-areal-expect-shard mismatch) — "
+            "served locally anyway; counts ring-view divergence.",
+        ),
+        sessions=r.gauge(
+            "areal_gateway_shard_sessions",
+            "Active session routes held by each gateway shard (shard"
+            "-local route map size — tier balance at a glance).",
+            label_names=("shard",),
+        ),
+        drains=r.counter(
+            "areal_gateway_shard_drain_total",
+            "Gateway-shard drain/undrain transitions (autopilot tier "
+            "scaling + supervised eviction), by direction.",
+            label_names=("direction",),
+        ),
+    )
+
+
 ALL_FACTORIES = (
     staleness_metrics,
     executor_metrics,
@@ -861,6 +906,7 @@ ALL_FACTORIES = (
     router_metrics,
     autopilot_metrics,
     aggregator_metrics,
+    gateway_tier_metrics,
 )
 
 

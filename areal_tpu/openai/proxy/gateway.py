@@ -24,6 +24,7 @@ never starve interactive decode; interactive sheds only at the full cap.
 from __future__ import annotations
 
 import dataclasses
+import random
 import time
 
 import aiohttp
@@ -32,6 +33,7 @@ from aiohttp import web
 from areal_tpu.api import wire
 from areal_tpu.observability import catalog
 from areal_tpu.openai.proxy.common import bearer_token as _bearer
+from areal_tpu.routing.hash_ring import stable_hash
 from areal_tpu.utils import logging as alog
 
 logger = alog.getLogger("proxy_gateway")
@@ -53,6 +55,7 @@ FORWARDED_PATHS = (
     "/rl/end_session",
 )
 ROUTE_TIMEOUT_S = 3600.0  # matches the proxy's session timeout
+SWEEP_BASE_S = 60.0  # stale-route sweep cadence, jittered per shard
 
 
 @dataclasses.dataclass
@@ -70,6 +73,9 @@ class GatewayState:
         max_inflight: int = 0,
         interactive_headroom: int = 0,
         retry_after_s: float = 1.0,
+        retry_after_jitter: float = 0.0,
+        shard_id: str = "",
+        route_adopt: bool = False,
     ):
         assert backends, "gateway needs at least one backend proxy"
         self.backends = list(backends)
@@ -86,15 +92,98 @@ class GatewayState:
         # floor to a positive hint (same defense as the engine server's
         # 429): "Retry-After: 0" turns honoring clients into hot-spinners
         self.retry_after_s = retry_after_s if retry_after_s > 0 else 1.0
+        # bounded multiplicative jitter on the emitted hint so honoring
+        # clients don't all retry on the same tick; seeded per shard so a
+        # chaos replay sees the same scatter
+        self.retry_after_jitter = max(0.0, retry_after_jitter)
+        self.shard_id = shard_id or "gw0"
+        self._jitter_rng = random.Random(stable_hash(f"ra#{self.shard_id}"))
+        # tier membership state (docs/serving.md "Gateway tier"): a
+        # draining shard refuses NEW sessions (429 reason="draining") but
+        # keeps serving its existing routes until they end
+        self.draining = False
+        # affinity repair: adopt unknown session keys by probing backends
+        # (re-hashed sessions after a shard death resume here)
+        self.route_adopt = route_adopt
+        # stale-route sweeps stagger per shard: N shards scanning their
+        # route maps in lockstep is a synchronized latency spike
+        self._sweep_interval_s = SWEEP_BASE_S * (
+            0.75 + 0.5 * (stable_hash(f"sweep#{self.shard_id}") % 997) / 997.0
+        )
         self.inflight: dict[str, int] = {p: 0 for p in PRIORITIES}
         self.shed: dict[str, int] = {p: 0 for p in PRIORITIES}
         self._lc_obs = catalog.lifecycle_metrics()
+        self._tier_obs = catalog.gateway_tier_metrics()
         # session placement rides the shared routing policy (areal_tpu/
         # routing/): least-loaded with rotation among ties, every decision
         # audited (areal_router_decisions_total + flight recorder) like
         # the inference client's replica choices
         self._rr = 0
         self._router_obs = catalog.router_metrics()
+
+    def retry_after_hint(self) -> float:
+        """The Retry-After value for one 429: the configured floor
+        scattered into [x, x*(1+jitter)] (thundering-herd fix)."""
+        j = self.retry_after_jitter
+        if j <= 0:
+            return self.retry_after_s
+        return self.retry_after_s * (1.0 + self._jitter_rng.random() * j)
+
+    # -- tier drain surface (PR 8 semantics on the shard) -------------------
+    def begin_drain(self) -> bool:
+        """Refuse new sessions; existing routes keep serving until they
+        end (finish-or-park at the tier level: nothing dies responseless).
+        Returns whether this call changed state."""
+        if self.draining:
+            return False
+        self.draining = True
+        self._tier_obs.drains.labels(direction="drain").inc()
+        from areal_tpu.observability import timeline as tl_mod
+
+        tl_mod.get_flight_recorder().record(
+            "gateway_shard_drain", shard=self.shard_id, sessions=len(self.routes)
+        )
+        return True
+
+    def end_drain(self) -> bool:
+        if not self.draining:
+            return False
+        self.draining = False
+        self._tier_obs.drains.labels(direction="undrain").inc()
+        from areal_tpu.observability import timeline as tl_mod
+
+        tl_mod.get_flight_recorder().record(
+            "gateway_shard_undrain", shard=self.shard_id
+        )
+        return True
+
+    def note_expected_shard(self, expect: str | None) -> None:
+        """Count ring-view divergence: the client computed a different
+        owner. Served locally anyway — placement disagreement costs a
+        cold route, never a failure."""
+        if expect and expect != self.shard_id:
+            self._tier_obs.misroutes.inc()
+
+    def _export_sessions(self) -> None:
+        self._tier_obs.sessions.labels(shard=self.shard_id).set(
+            len(self.routes)
+        )
+
+    def adopt_route(self, api_key: str, backend: str) -> None:
+        """Affinity repair: this shard now owns a session it never
+        started (the starting shard died; the backend proxy still holds
+        the session — only the gateway-side route map was lost)."""
+        self.routes[api_key] = SessionRoute(
+            backend=backend, session_id="adopted"
+        )
+        self.load[backend] = self.load.get(backend, 0) + 1
+        self._tier_obs.route_recoveries.inc()
+        self._export_sessions()
+        from areal_tpu.observability import timeline as tl_mod
+
+        tl_mod.get_flight_recorder().record(
+            "gateway_route_recovered", shard=self.shard_id, backend=backend
+        )
 
     def set_interactive_headroom(self, n: int) -> int:
         """Goodput-autopilot hook (docs/autopilot.md): resize the slots
@@ -170,6 +259,7 @@ class GatewayState:
         route = self.routes.pop(api_key, None)
         if route is not None:
             self.load[route.backend] = max(0, self.load.get(route.backend, 1) - 1)
+            self._export_sessions()
 
     def sweep_stale_routes(self) -> None:
         """Crashed agents never send another request, so forward()-side
@@ -177,7 +267,7 @@ class GatewayState:
         the proxy's last-access semantics — an active long episode must
         never lose its route mid-rollout)."""
         now = time.time()
-        if now - self._last_sweep < 60:
+        if now - self._last_sweep < self._sweep_interval_s:
             return
         self._last_sweep = now
         for key in [
@@ -205,21 +295,63 @@ def create_gateway_app(state: GatewayState) -> web.Application:
     app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)
 
+    def _shed_response(reason: str, priority: str) -> web.Response:
+        return web.json_response(
+            {
+                "status": "rejected",
+                "reason": reason,
+                "priority": priority,
+                "inflight": dict(state.inflight),
+                "max_inflight": state.max_inflight,
+            },
+            status=429,
+            headers={
+                "Retry-After": f"{state.retry_after_hint():g}",
+                wire.GATEWAY_SHARD_HEADER: state.shard_id,
+            },
+        )
+
     async def health(_):
         return web.json_response(
             {
                 "status": "ok",
+                "shard_id": state.shard_id,
+                "draining": state.draining,
                 "backends": state.backends,
                 "sessions": len(state.routes),
                 "inflight": dict(state.inflight),
                 "shed": dict(state.shed),
                 "max_inflight": state.max_inflight,
-            }
+            },
+            headers={wire.GATEWAY_SHARD_HEADER: state.shard_id},
+        )
+
+    async def drain(_):
+        # the PR 8 surface on the shard: new sessions refuse with 429
+        # reason="draining" (clients re-hash via the ring), existing
+        # routes keep serving — the autopilot scales the tier with the
+        # same asymmetric policy it uses for replicas
+        state.begin_drain()
+        return web.json_response(
+            {"status": "ok", "draining": True, "sessions": len(state.routes)},
+            headers={wire.GATEWAY_SHARD_HEADER: state.shard_id},
+        )
+
+    async def undrain(_):
+        state.end_drain()
+        return web.json_response(
+            {"status": "ok", "draining": False},
+            headers={wire.GATEWAY_SHARD_HEADER: state.shard_id},
         )
 
     async def start_session(request: web.Request):
         if _bearer(request) != state.admin_api_key:
             raise web.HTTPForbidden(text="admin API key required")
+        state.note_expected_shard(
+            request.headers.get(wire.GATEWAY_EXPECT_SHARD_HEADER)
+        )
+        if state.draining:
+            return _shed_response("draining", "interactive")
         state.sweep_stale_routes()
         body = await request.json()
         backend = state.pick_backend()
@@ -237,43 +369,67 @@ def create_gateway_app(state: GatewayState) -> web.Application:
             backend=backend, session_id=payload["session_id"]
         )
         state.load[backend] = state.load.get(backend, 0) + 1
+        state._export_sessions()
         # the agent must keep talking THROUGH the gateway — backends are
         # internal addresses and bypassing them breaks route bookkeeping
         payload["base_url"] = f"http://{request.headers.get('Host', request.host)}"
-        return web.json_response(payload)
+        return web.json_response(
+            payload, headers={wire.GATEWAY_SHARD_HEADER: state.shard_id}
+        )
 
     async def forward(request: web.Request):
         key = _bearer(request)
         route = state.routes.get(key)
-        if route is None:
+        state.note_expected_shard(
+            request.headers.get(wire.GATEWAY_EXPECT_SHARD_HEADER)
+        )
+        if route is None and not state.route_adopt:
             raise web.HTTPGone(text="unknown session key")
-        route.last_activity = time.time()
+        if route is not None:
+            route.last_activity = time.time()
         # load shedding (docs/request_lifecycle.md): classify and gate
         # BEFORE reading the body — a shed request must stay cheap
         priority = state.classify(request)
         if not state.admit(priority):
             state.on_shed(priority)
-            return web.json_response(
-                {
-                    "status": "rejected",
-                    "reason": "gateway_overload",
-                    "priority": priority,
-                    "inflight": dict(state.inflight),
-                    "max_inflight": state.max_inflight,
-                },
-                status=429,
-                headers={"Retry-After": f"{state.retry_after_s:g}"},
-            )
+            return _shed_response("gateway_overload", priority)
         state.on_admitted(priority)
         t0 = time.monotonic()
         try:
-            return await _forward_admitted(request, key, route)
+            if route is None:
+                return await _recover_and_forward(request, key)
+            return await _proxy_to(request, key, route.backend)
         finally:
             state.on_done(priority, time.monotonic() - t0)
 
-    async def _forward_admitted(
-        request: web.Request, key: str, route: SessionRoute
+    async def _recover_and_forward(request: web.Request, key: str):
+        """Affinity repair (docs/serving.md "Gateway tier"): this shard
+        has no route for the session key — the shard that started it
+        died and the client re-hashed here. The backend proxy still owns
+        the session, so forwarding the request to each backend finds the
+        owner (everyone else answers 410 from their session check without
+        doing any work); the first non-410 adopts the route and the
+        session resumes on this shard."""
+        for backend in sorted(
+            state.backends, key=lambda b: state.load.get(b, 0)
+        ):
+            resp = await _proxy_to(
+                request, key, backend, adopt_probe=True
+            )
+            if resp is None:  # 410 from this backend: not the owner
+                continue
+            return resp
+        raise web.HTTPGone(text="unknown session key")
+
+    async def _proxy_to(
+        request: web.Request,
+        key: str,
+        backend: str,
+        adopt_probe: bool = False,
     ):
+        """Forward the request to ``backend``. With ``adopt_probe`` the
+        410 outcome returns None (caller tries the next backend) and any
+        other outcome first adopts the route."""
         http = await _client(request.app)
         body = await request.read()
         fwd_headers = {
@@ -286,17 +442,26 @@ def create_gateway_app(state: GatewayState) -> web.Application:
             if h in request.headers:
                 fwd_headers[h] = request.headers[h]
         async with http.post(
-            f"{route.backend}{request.path}",
+            f"{backend}{request.path}",
             data=body,
             headers=fwd_headers,
         ) as r:
+            if adopt_probe:
+                if r.status == 410:
+                    await r.read()  # drain so the connection is reusable
+                    return None
+                state.adopt_route(key, backend)
             ct = r.headers.get("Content-Type", "")
             if ct.startswith("text/event-stream"):
                 # SSE passthrough: relay chunks as they arrive so streaming
                 # agents see deltas live instead of one buffered blob
                 out = web.StreamResponse(
                     status=r.status,
-                    headers={"Content-Type": ct, "Cache-Control": "no-cache"},
+                    headers={
+                        "Content-Type": ct,
+                        "Cache-Control": "no-cache",
+                        wire.GATEWAY_SHARD_HEADER: state.shard_id,
+                    },
                 )
                 await out.prepare(request)
                 async for chunk in r.content.iter_any():
@@ -313,11 +478,16 @@ def create_gateway_app(state: GatewayState) -> web.Application:
             ):
                 state.drop_route(key)
             return web.Response(
-                text=text, status=r.status, content_type="application/json"
+                text=text,
+                status=r.status,
+                content_type="application/json",
+                headers={wire.GATEWAY_SHARD_HEADER: state.shard_id},
             )
 
     app.router.add_get("/health", health)
     app.router.add_post("/rl/start_session", start_session)
+    app.router.add_post("/drain", drain)
+    app.router.add_post("/undrain", undrain)
     for path in FORWARDED_PATHS:
         app.router.add_post(path, forward)
     return app

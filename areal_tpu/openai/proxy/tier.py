@@ -1,0 +1,469 @@
+"""Gateway tier: N gateway shards behind a consistent-hash ring.
+
+The PR 6/8 gateway is one aiohttp process — both the throughput ceiling
+and the last single point of failure in a control plane whose replica
+fleet already survives evictions, drains, and preemption. This module
+converts it into a *tier* (docs/serving.md "Gateway tier"):
+
+- :class:`ShardDirectory` — membership through the name_resolve layer
+  (etcd in production, memory/NFS elsewhere): each shard keepalive
+  -publishes a JSON record ``{shard_id, addr, state}`` under the tier
+  namespace; readers poll the subtree on a daemon thread and rebuild a
+  :class:`~areal_tpu.routing.hash_ring.HashRing` over the live shards.
+  Discovery failing is a DEGRADED mode, never an outage: the last-known
+  view keeps serving (counted on
+  ``areal_gateway_shard_membership_stale_total``) and the static floor
+  covers the never-connected case.
+- :class:`GatewayTier` — the in-process harness (bench, self-test,
+  chaos tests): N ``GatewayState`` shards over ONE backend proxy set,
+  with kill (hard process-death semantics: the runner stops, the
+  membership record simply expires), respawn, and the PR 8 drain/undrain
+  surface per shard.
+- :class:`TierClient` — the client half: session key -> shard via the
+  ring, failures reported into the PR 3 circuit machinery
+  (:class:`~areal_tpu.robustness.retry.FleetHealth`), and re-hash past
+  open circuits so a killed shard's sessions land on its ring successor.
+  The receiving shard adopts the session by probing the backend proxies
+  (affinity repair — the proxy still owns the session; only the dead
+  shard's route map was lost).
+
+Session state never crosses shards on the request path: the ring IS the
+coordination. Two clients with the same membership view agree on
+placement without talking to anyone.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import threading
+
+from aiohttp import web
+
+from areal_tpu.api.config import GatewayTierConfig
+from areal_tpu.observability import catalog
+from areal_tpu.openai.proxy.gateway import GatewayState, create_gateway_app
+from areal_tpu.robustness.retry import FleetHealth
+from areal_tpu.routing.hash_ring import HashRing
+from areal_tpu.utils import logging as alog
+from areal_tpu.utils import name_resolve
+
+logger = alog.getLogger("gateway_tier")
+
+UP = "up"
+DRAINING = "draining"
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardRecord:
+    shard_id: str
+    addr: str  # host:port
+    state: str = UP  # up | draining
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, raw: str) -> "ShardRecord":
+        d = json.loads(raw)
+        return cls(
+            shard_id=str(d["shard_id"]),
+            addr=str(d["addr"]),
+            state=str(d.get("state", UP)),
+        )
+
+
+class ShardDirectory:
+    """Tier membership over name_resolve with graceful degradation.
+
+    Writers (shards / the tier harness) publish keepalive-refreshed
+    records; readers poll :meth:`refresh` (or run :meth:`start`'s daemon
+    thread) and consume :meth:`ring`/:meth:`view`. A failed refresh
+    keeps the previous view — stale membership mis-places a few sessions
+    (repaired by route adoption), whereas refusing to serve would turn a
+    discovery blip into an outage.
+    """
+
+    def __init__(
+        self,
+        cfg: GatewayTierConfig,
+        repo: name_resolve.NameResolveRepo | None = None,
+    ):
+        self.cfg = cfg
+        self._repo = repo  # None = the process-wide DEFAULT_REPO
+        self._lock = threading.Lock()
+        self._view: dict[str, ShardRecord] = {
+            f"static{i}": ShardRecord(shard_id=f"static{i}", addr=a)
+            for i, a in enumerate(cfg.static_shards)
+        }
+        self._ring = self._build_ring(self._view)
+        self._keepalives: dict[str, name_resolve.KeepaliveThread] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._ever_refreshed = False
+        self.stale_reads = 0
+        self._obs = catalog.gateway_tier_metrics()
+
+    @property
+    def repo(self) -> name_resolve.NameResolveRepo:
+        return self._repo if self._repo is not None else name_resolve.DEFAULT_REPO
+
+    def _key(self, shard_id: str) -> str:
+        return f"{self.cfg.namespace}/{shard_id}"
+
+    # -- writer side --------------------------------------------------------
+    def publish(self, shard_id: str, addr: str, state: str = UP) -> None:
+        """Register a shard with a keepalive-refreshed TTL record: a
+        shard that dies without unpublishing simply expires."""
+        rec = ShardRecord(shard_id=shard_id, addr=addr, state=state)
+        old = self._keepalives.pop(shard_id, None)
+        if old is not None:
+            old.stop(delete_entry=False)
+        self._keepalives[shard_id] = self.repo.keepalive(
+            self._key(shard_id), rec.to_json(), ttl=self.cfg.membership_ttl_s
+        )
+
+    def unpublish(self, shard_id: str) -> None:
+        ka = self._keepalives.pop(shard_id, None)
+        if ka is not None:
+            ka.stop(delete_entry=True)
+
+    def abandon(self, shard_id: str) -> None:
+        """Stop refreshing WITHOUT deleting: the record outlives us by at
+        most the TTL — exactly what a killed process looks like."""
+        ka = self._keepalives.pop(shard_id, None)
+        if ka is not None:
+            ka.stop(delete_entry=False)
+
+    # -- reader side --------------------------------------------------------
+    @staticmethod
+    def _build_ring(view: dict[str, ShardRecord]) -> HashRing:
+        return HashRing(
+            (r.addr for r in view.values() if r.state == UP),
+        )
+
+    def refresh(self) -> bool:
+        """One membership read. Returns True on a fresh view; False keeps
+        the last-known (degraded) view and counts it."""
+        try:
+            raw = self.repo.get_subtree(self.cfg.namespace)
+            view: dict[str, ShardRecord] = {}
+            for item in raw:
+                try:
+                    rec = ShardRecord.from_json(item)
+                except (ValueError, KeyError, TypeError):
+                    continue  # foreign junk under the namespace
+                view[rec.shard_id] = rec
+        except Exception:  # noqa: BLE001 — degraded mode IS the feature
+            with self._lock:
+                self.stale_reads += 1
+            self._obs.membership_stale.inc()
+            return False
+        ring = self._build_ring(view)
+        with self._lock:
+            self._view = view
+            self._ring = ring
+            self._ever_refreshed = True
+        self._obs.shard_count.set(len(ring))
+        return True
+
+    def view(self) -> dict[str, ShardRecord]:
+        with self._lock:
+            return dict(self._view)
+
+    def ring(self) -> HashRing:
+        # the ring reference swaps atomically on refresh; readers on the
+        # event loop never take the lock (no shared state on the request
+        # path — arealint ASY keeps handlers block-free)
+        return self._ring
+
+    def shard_for_addr(self, addr: str) -> ShardRecord | None:
+        for rec in self.view().values():
+            if rec.addr == addr:
+                return rec
+        return None
+
+    # -- poll loop ----------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="gateway-tier-directory"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        for sid in list(self._keepalives):
+            self.unpublish(sid)
+
+    def _loop(self) -> None:
+        interval = max(0.05, self.cfg.membership_poll_s)
+        while not self._stop.is_set():
+            try:
+                self.refresh()
+            except Exception:  # noqa: BLE001 — the poll loop must outlive bugs
+                logger.exception("tier membership refresh failed")
+            self._stop.wait(interval)
+
+
+@dataclasses.dataclass
+class _Shard:
+    shard_id: str
+    state: GatewayState
+    runner: web.AppRunner | None
+    addr: str
+    alive: bool = True
+
+
+class GatewayTier:
+    """N in-process gateway shards over one backend proxy set.
+
+    The bench harness, the ``--gateway-tier-self-test``, and the chaos
+    tests drive this; production deployments run one shard per process
+    with the same :class:`ShardDirectory` publishing. Kill semantics are
+    process-death-faithful: :meth:`kill_shard` stops the listener and
+    abandons (not deletes) the membership record, so survivors only
+    learn through TTL expiry — the hard path, not the polite one.
+    """
+
+    def __init__(
+        self,
+        backends: list[str],
+        admin_api_key: str,
+        cfg: GatewayTierConfig | None = None,
+        *,
+        max_inflight: int = 0,
+        interactive_headroom: int = 0,
+        retry_after_s: float = 1.0,
+        retry_after_jitter: float = 0.5,
+        repo: name_resolve.NameResolveRepo | None = None,
+        host: str = "127.0.0.1",
+    ):
+        self.cfg = cfg or GatewayTierConfig(enabled=True, n_shards=1)
+        self.backends = list(backends)
+        self.admin_api_key = admin_api_key
+        self._gw_kw = dict(
+            max_inflight=max_inflight,
+            interactive_headroom=interactive_headroom,
+            retry_after_s=retry_after_s,
+            retry_after_jitter=retry_after_jitter,
+        )
+        self._host = host
+        self.directory = ShardDirectory(self.cfg, repo=repo)
+        self.shards: dict[str, _Shard] = {}
+        self._next_idx = 0
+        self._loop: asyncio.AbstractEventLoop | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+    async def astart(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        for _ in range(max(1, self.cfg.n_shards)):
+            await self._spawn_shard()
+        # publishing happens via the directory's repo (blocking for the
+        # etcd backend) — pushed off the event loop
+        await self._loop.run_in_executor(None, self.directory.refresh)
+        self.directory.start()
+
+    async def astop(self) -> None:
+        self.directory.stop()
+        for shard in list(self.shards.values()):
+            if shard.alive and shard.runner is not None:
+                await shard.runner.cleanup()
+                shard.alive = False
+
+    async def _spawn_shard(self) -> _Shard:
+        shard_id = f"gw{self._next_idx}"
+        self._next_idx += 1
+        state = GatewayState(
+            self.backends,
+            self.admin_api_key,
+            shard_id=shard_id,
+            route_adopt=self.cfg.route_adopt,
+            **self._gw_kw,
+        )
+        from areal_tpu.utils.network import find_free_port
+
+        # short shutdown grace: kill_shard models process death, not a
+        # polite drain — in-flight handlers get a beat, then the listener
+        # is gone (aiohttp's 60s default would make "kill" a soft pause)
+        runner = web.AppRunner(
+            create_gateway_app(state), shutdown_timeout=1.0
+        )
+        await runner.setup()
+        port = find_free_port()
+        await web.TCPSite(runner, self._host, port).start()
+        addr = f"{self._host}:{port}"
+        shard = _Shard(shard_id=shard_id, state=state, runner=runner, addr=addr)
+        self.shards[shard_id] = shard
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, self.directory.publish, shard_id, addr, UP
+        )
+        return shard
+
+    # -- chaos / supervision hooks ------------------------------------------
+    async def _kill(self, shard_id: str) -> bool:
+        shard = self.shards.get(shard_id)
+        if shard is None or not shard.alive:
+            return False
+        shard.alive = False
+        # abandon, don't unpublish: a killed process never says goodbye;
+        # the record expires after membership_ttl_s
+        self.directory.abandon(shard_id)
+        if shard.runner is not None:
+            await shard.runner.cleanup()
+        logger.warning(f"gateway shard {shard_id} @ {shard.addr} killed")
+        return True
+
+    def kill_shard(self, shard_id: str) -> bool:
+        """Hard-stop one shard; thread-safe (chaos fires from injector
+        threads, the supervisor from its probe loop)."""
+        if self._loop is None:
+            return False
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is self._loop:
+            asyncio.ensure_future(self._kill(shard_id))
+            return True
+        fut = asyncio.run_coroutine_threadsafe(self._kill(shard_id), self._loop)
+        return bool(fut.result(timeout=10))
+
+    def kill_callables(self) -> dict[str, "object"]:
+        """shard_id -> zero-arg kill closure (FaultInjector targets)."""
+        return {
+            sid: (lambda s=sid: self.kill_shard(s)) for sid in self.shards
+        }
+
+    def respawn_shard(self, shard_id: str) -> str:
+        """Replace a dead shard with a fresh one (new port, new id);
+        returns the replacement's address. Thread-safe."""
+        assert self._loop is not None, "tier not started"
+        fut = asyncio.run_coroutine_threadsafe(self._spawn_shard(), self._loop)
+        shard = fut.result(timeout=10)
+        self.shards.pop(shard_id, None)
+        return shard.addr
+
+    # -- drain surface (autopilot tier scaling) -----------------------------
+    def drain_shard(self, addr: str) -> bool:
+        shard = self._by_addr(addr)
+        if shard is None:
+            return False
+        changed = shard.state.begin_drain()
+        self.directory.publish(shard.shard_id, shard.addr, DRAINING)
+        return changed
+
+    def undrain_shard(self, addr: str) -> bool:
+        shard = self._by_addr(addr)
+        if shard is None:
+            return False
+        changed = shard.state.end_drain()
+        self.directory.publish(shard.shard_id, shard.addr, UP)
+        return changed
+
+    def _by_addr(self, addr: str) -> _Shard | None:
+        for shard in self.shards.values():
+            if shard.addr == addr and shard.alive:
+                return shard
+        return None
+
+    # -- introspection ------------------------------------------------------
+    def addresses(self, include_draining: bool = True) -> list[str]:
+        return [
+            s.addr
+            for s in self.shards.values()
+            if s.alive and (include_draining or not s.state.draining)
+        ]
+
+    def shard_stats(self) -> list[dict]:
+        """Per-shard load view for the tier's FleetController shim."""
+        out = []
+        for s in self.shards.values():
+            if not s.alive:
+                continue
+            out.append(
+                {
+                    "addr": s.addr,
+                    "shard_id": s.shard_id,
+                    "draining": s.state.draining,
+                    "inflight": sum(s.state.inflight.values()),
+                    "max_inflight": s.state.max_inflight,
+                    "sessions": len(s.state.routes),
+                    "shed": sum(s.state.shed.values()),
+                }
+            )
+        return out
+
+    def client(self, ft=None) -> "TierClient":
+        return TierClient(self.directory, ft=ft)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardPick:
+    addr: str
+    shard_id: str
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.addr}"
+
+
+class TierClient:
+    """Session-key -> shard placement with circuit-aware re-hash.
+
+    Pure in-memory decisions (ring lookup + breaker check) — safe to
+    call from the event loop. Failures feed the PR 3 circuit machinery;
+    an open circuit walks the ring to the shard's successor, which is
+    where the dead shard's keyspace lands after membership expiry too,
+    so the pre-expiry failover and the post-expiry steady state agree.
+    """
+
+    def __init__(self, directory: ShardDirectory, ft=None):
+        self.directory = directory
+        self._health = FleetHealth((), ft=ft)
+
+    def pick(
+        self, session_key: str, exclude: tuple[str, ...] = ()
+    ) -> ShardPick | None:
+        """Place ``session_key`` on the ring, skipping open circuits and
+        the caller's hard ``exclude`` set (shards that refused a
+        connection THIS request — the breaker needs several strikes to
+        open, the in-flight request cannot wait for them)."""
+        ring = self.directory.ring()
+        avoid = set(exclude)
+        open_addrs = {
+            a
+            for a in self._health.addresses()
+            if a in ring and self._health.state(a) == "open"
+        }
+        addr = ring.pick(session_key, exclude=avoid | open_addrs)
+        if addr is None:
+            # every known shard's circuit is open: fall back to the raw
+            # ring owner (half-open probes are how circuits close again)
+            # — but never past the caller's hard exclusions
+            addr = ring.pick(session_key, exclude=avoid)
+        if addr is None:
+            return None
+        rec = self.directory.shard_for_addr(addr)
+        return ShardPick(
+            addr=addr, shard_id=rec.shard_id if rec is not None else ""
+        )
+
+    def note_failure(self, addr: str) -> None:
+        self._health.track(addr)
+        self._health.on_failure(addr)
+
+    def note_success(self, addr: str) -> None:
+        self._health.track(addr)
+        self._health.on_success(addr)
+
+    def evict(self, addr: str) -> None:
+        self._health.track(addr)
+        self._health.evict(addr)

@@ -26,7 +26,12 @@ from areal_tpu.robustness.retry import (
     RetryBudget,
     RetryPolicy,
 )
-from areal_tpu.robustness.supervisor import ReplicaSupervisor, default_probe
+from areal_tpu.robustness.supervisor import (
+    GatewayShardSupervisor,
+    ReplicaSupervisor,
+    default_probe,
+    default_shard_probe,
+)
 
 __all__ = [
     "CLOSED",
@@ -39,10 +44,12 @@ __all__ = [
     "FaultInjected",
     "FaultInjector",
     "FleetHealth",
+    "GatewayShardSupervisor",
     "KINDS",
     "PreemptionHandler",
     "ReplicaSupervisor",
     "RetryBudget",
     "RetryPolicy",
     "default_probe",
+    "default_shard_probe",
 ]

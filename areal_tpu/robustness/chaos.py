@@ -12,7 +12,10 @@ Install on a client with ``RemoteJaxEngine.install_fault_injector`` (the
 client calls :meth:`aperturb`/:meth:`perturb` before each HTTP call), or
 wrap any callable with :meth:`wrap`. Replica kills are driven by the test
 harness directly (stop the server), since a real kill exercises the whole
-eviction path rather than simulating it.
+eviction path rather than simulating it. Gateway-SHARD kills are chaos
+kinds proper (``gateway_kill_prob`` + :meth:`set_gateway_kill_targets`):
+the registered kill closure stops a real listener, and the tier's
+re-hash/affinity-repair machinery is what's under test.
 
 Injected faults are counted per-kind in ``areal_chaos_injected_total`` so a
 chaos run can assert the harness actually fired.
@@ -33,7 +36,7 @@ from areal_tpu.utils import logging as alog
 
 logger = alog.getLogger("robustness.chaos")
 
-KINDS = ("drop", "delay", "error", "hang", "stall", "preempt")
+KINDS = ("drop", "delay", "error", "hang", "stall", "preempt", "gw_kill")
 
 
 class FaultInjected(ConnectionError):
@@ -61,6 +64,12 @@ class FaultInjector:
         # bounded set of workers, never the whole fleet in one run
         self._preempt_targets: list[int] = []
         self._preempted: set[int] = set()
+        # gateway-shard kill targets (ChaosConfig.gateway_kill_prob):
+        # name -> zero-arg kill closure (GatewayTier.kill_callables), each
+        # fired at most once per injector — chaos kills a bounded set of
+        # shards, never the whole tier in one run
+        self._gw_kill_targets: dict[str, object] = {}
+        self._gw_killed: set[str] = set()
 
     def set_preempt_targets(self, pids: list[int]) -> None:
         """Register the live worker pids eligible for chaos preemption
@@ -68,6 +77,14 @@ class FaultInjector:
         grace-window drain end to end)."""
         with self._lock:
             self._preempt_targets = [int(p) for p in pids]
+
+    def set_gateway_kill_targets(self, targets: dict) -> None:
+        """Register gateway shards eligible for chaos kill: a mapping of
+        shard name -> zero-arg kill callable (docs/serving.md "Gateway
+        tier" — drives the tier's re-hash + affinity-repair path with a
+        REAL listener death, not a simulation)."""
+        with self._lock:
+            self._gw_kill_targets = dict(targets)
 
     # -- decision ----------------------------------------------------------
     def decide(self, addr: str, path: str) -> str | None:
@@ -101,6 +118,9 @@ class FaultInjector:
         edge += cfg.preempt_prob
         if u < edge:
             return "preempt"
+        edge += cfg.gateway_kill_prob
+        if u < edge:
+            return "gw_kill"
         return None
 
     def _record(self, kind: str, addr: str, path: str) -> None:
@@ -129,6 +149,30 @@ class FaultInjector:
         logger.warning(f"chaos: SIGTERM delivered to live worker pid {pid}")
         return True
 
+    def _do_gateway_kill(self) -> bool:
+        """Kill the next not-yet-killed registered gateway shard (seeded
+        choice). Like preempt, the triggering request proceeds untouched —
+        a shard kill is a process-lifecycle fault; the "gw_kill" count
+        only reflects kills that actually landed."""
+        with self._lock:
+            pool = sorted(
+                n for n in self._gw_kill_targets if n not in self._gw_killed
+            )
+            if not pool:
+                return False
+            name = pool[self._rng.randrange(len(pool))]
+            self._gw_killed.add(name)
+            kill = self._gw_kill_targets[name]
+        try:
+            killed = kill()
+        except Exception as e:  # noqa: BLE001 — a failed kill is a no-op
+            logger.warning(f"chaos gateway kill of {name} failed: {e!r}")
+            return False
+        if killed is False:
+            return False
+        logger.warning(f"chaos: gateway shard {name} killed")
+        return True
+
     # -- application -------------------------------------------------------
     async def aperturb(self, addr: str, path: str) -> None:
         """Async boundary hook: sleep for delay/hang, raise for drop/error."""
@@ -137,6 +181,10 @@ class FaultInjector:
             return
         if kind == "preempt":
             if self._do_preempt():
+                self._record(kind, addr, path)
+            return
+        if kind == "gw_kill":
+            if self._do_gateway_kill():
                 self._record(kind, addr, path)
             return
         self._record(kind, addr, path)
@@ -160,6 +208,10 @@ class FaultInjector:
             return
         if kind == "preempt":
             if self._do_preempt():
+                self._record(kind, addr, path)
+            return
+        if kind == "gw_kill":
+            if self._do_gateway_kill():
                 self._record(kind, addr, path)
             return
         self._record(kind, addr, path)

@@ -185,3 +185,145 @@ class ReplicaSupervisor:
             "respawn_counts": respawns,
             "checked_at": time.time(),
         }
+
+
+def default_shard_probe(addr: str, timeout: float) -> bool:
+    """True when the gateway shard answers /health. A DRAINING shard is
+    alive by definition (it refuses new sessions but serves its routes) —
+    only an unreachable/erroring shard counts as down."""
+    from areal_tpu.utils.network import http_json
+
+    try:
+        d = http_json(f"http://{addr}/health", timeout=timeout)
+    except Exception as e:  # noqa: BLE001 — probe failures are the signal
+        logger.debug(f"shard probe {addr} failed: {e!r}")
+        return False
+    return d.get("status") == "ok"
+
+
+class GatewayShardSupervisor:
+    """Probe -> evict -> respawn over the gateway tier's shards.
+
+    The replica fleet's supervision pattern (above) applied to the tier
+    (docs/serving.md "Gateway tier"): each live shard's /health is probed
+    every ``probe_interval_s``; ``probe_failures_to_evict`` consecutive
+    failures evicts it (its membership record expires on its own — a dead
+    shard can't keepalive) and, respawn budget permitting, a replacement
+    shard spawns on a fresh port and publishes itself. Clients meanwhile
+    re-hash the dead shard's sessions to its ring successor through their
+    circuit breakers, and the successor adopts the routes (affinity
+    repair) — supervision restores CAPACITY; availability never waited
+    on it.
+
+    ``tier`` is duck-typed (GatewayTier or compatible): ``addresses()``,
+    ``kill_shard(shard_id)``-style ids come from ``shard_stats()``, and
+    ``respawn_shard(shard_id) -> new_addr``.
+    """
+
+    def __init__(
+        self,
+        tier,
+        ft: FaultToleranceConfig,
+        probe: Callable[[str, float], bool] | None = None,
+    ):
+        self.tier = tier
+        self.ft = ft
+        self.probe = probe or default_shard_probe
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._fail_counts: dict[str, int] = {}
+        self._respawns = 0
+        self._metrics = catalog.robustness_metrics()
+        self._tier_obs = catalog.gateway_tier_metrics()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        assert self._thread is None, "shard supervisor already running"
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="gateway-shard-supervisor"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._wake.set()
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def kick(self) -> None:
+        self._wake.set()
+
+    def _loop(self) -> None:
+        interval = max(0.1, self.ft.probe_interval_s)
+        while not self._stop.is_set():
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — supervision must survive bugs
+                logger.exception("shard supervision round failed")
+            self._wake.wait(interval)
+            self._wake.clear()
+
+    def probe_once(self) -> dict[str, str]:
+        """One probe round over the tier; returns {shard_id: state}."""
+        states: dict[str, str] = {}
+        for stat in self.tier.shard_stats():
+            sid, addr = stat["shard_id"], stat["addr"]
+            if self.probe(addr, self.ft.probe_timeout_s):
+                with self._lock:
+                    self._fail_counts[sid] = 0
+                states[sid] = "up"
+                continue
+            with self._lock:
+                self._fail_counts[sid] = self._fail_counts.get(sid, 0) + 1
+                n = self._fail_counts[sid]
+            states[sid] = "down"
+            if n >= max(1, self.ft.probe_failures_to_evict):
+                self._handle_dead(sid, addr)
+                states[sid] = "evicted"
+        return states
+
+    def _handle_dead(self, shard_id: str, addr: str) -> None:
+        from areal_tpu.observability import timeline as tl_mod
+
+        # eviction = confirm the death to the tier (stops the dead shard
+        # from counting toward capacity); membership expiry is the TTL's
+        # job and already underway
+        self.tier.kill_shard(shard_id)
+        tl_mod.get_flight_recorder().record(
+            "gateway_shard_evict", severity="error", shard=shard_id, address=addr
+        )
+        with self._lock:
+            if self._respawns >= self.ft.max_respawns:
+                logger.error(
+                    f"gateway shard {shard_id} dead and tier respawn budget "
+                    f"exhausted ({self._respawns}/{self.ft.max_respawns})"
+                )
+                return
+            self._respawns += 1
+        try:
+            new_addr = self.tier.respawn_shard(shard_id)
+        except Exception:  # noqa: BLE001 — best-effort; retry next round
+            logger.exception(f"respawn of gateway shard {shard_id} failed")
+            return
+        self._metrics.replica_respawns.inc()
+        tl_mod.get_flight_recorder().record(
+            "gateway_shard_respawn", shard=shard_id, address=new_addr
+        )
+        with self._lock:
+            self._fail_counts.pop(shard_id, None)
+        logger.info(
+            f"gateway shard {shard_id} respawned @ {new_addr} and published"
+        )
+
+    def statusz(self) -> dict:
+        with self._lock:
+            return {
+                "probe_interval_s": self.ft.probe_interval_s,
+                "fail_counts": dict(self._fail_counts),
+                "respawns": self._respawns,
+                "checked_at": time.time(),
+            }

@@ -9,6 +9,7 @@ go stale. Placement-only by construction: a routing misprediction can
 cost latency, never change output.
 """
 
+from areal_tpu.routing.hash_ring import HashRing, stable_hash
 from areal_tpu.routing.policy import (
     Candidate,
     RouteDecision,
@@ -22,6 +23,7 @@ from areal_tpu.routing.snapshot import ReplicaSnapshot, SnapshotPoller
 __all__ = [
     "AffinityMap",
     "Candidate",
+    "HashRing",
     "ReplicaSnapshot",
     "RouteDecision",
     "Router",
@@ -29,4 +31,5 @@ __all__ = [
     "SnapshotPoller",
     "pick",
     "pick_least_loaded",
+    "stable_hash",
 ]

@@ -1,0 +1,125 @@
+"""Consistent-hash ring: session key -> gateway shard, bounded remap.
+
+The gateway tier (docs/serving.md "Gateway tier") shards session state —
+routes, per-backend load counters, the PR 12 shadow prefix index — across
+N `GatewayState` processes with NO shared state on the request path. The
+only cross-shard agreement needed is *placement*: every client and every
+shard must map a given session key to the same shard, and a membership
+change (shard killed, drained, or added) must move as few sessions as
+possible so surviving shards keep their local route maps and prefix
+indexes warm.
+
+Classic consistent hashing delivers both: each shard owns ``vnodes``
+points on a 2^64 ring (SHA-1 of ``"{shard}#{i}"`` — stable across
+processes and Python hash seeds, unlike ``hash()``), and a key maps to
+the first point clockwise from SHA-1 of the key. Removing a shard moves
+ONLY the keys it owned (its arcs fall to their clockwise successors);
+adding one steals ~K/N of the keyspace. Placement is deterministic:
+two ring instances built from the same membership agree exactly, which
+is what lets clients pick shards without asking anybody.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Iterable
+
+DEFAULT_VNODES = 64
+
+
+def stable_hash(key: str) -> int:
+    """64-bit ring position, stable across processes (SHA-1 prefix)."""
+    return int.from_bytes(hashlib.sha1(key.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    """Deterministic consistent-hash ring over string node names."""
+
+    def __init__(self, nodes: Iterable[str] = (), vnodes: int = DEFAULT_VNODES):
+        self.vnodes = max(1, int(vnodes))
+        self._nodes: set[str] = set()
+        self._points: list[int] = []  # sorted vnode positions
+        self._owners: dict[int, str] = {}  # position -> node
+        for n in nodes:
+            self.add(n)
+
+    # -- membership ---------------------------------------------------------
+    def add(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for i in range(self.vnodes):
+            pos = stable_hash(f"{node}#{i}")
+            # ties between distinct nodes at one position are resolved by
+            # name so every ring built from this membership agrees
+            cur = self._owners.get(pos)
+            if cur is not None:
+                if node < cur:
+                    self._owners[pos] = node
+                continue
+            self._owners[pos] = node
+            bisect.insort(self._points, pos)
+
+    def remove(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        for i in range(self.vnodes):
+            pos = stable_hash(f"{node}#{i}")
+            if self._owners.get(pos) == node:
+                # hand a tied position back to the smallest remaining
+                # claimant (same rule add() applies) instead of dropping it
+                claimants = sorted(
+                    n for n in self._nodes if self._claims(n, pos)
+                )
+                if claimants:
+                    self._owners[pos] = claimants[0]
+                else:
+                    del self._owners[pos]
+                    idx = bisect.bisect_left(self._points, pos)
+                    if idx < len(self._points) and self._points[idx] == pos:
+                        self._points.pop(idx)
+
+    def _claims(self, node: str, pos: int) -> bool:
+        return any(
+            stable_hash(f"{node}#{i}") == pos for i in range(self.vnodes)
+        )
+
+    def set_nodes(self, nodes: Iterable[str]) -> None:
+        """Reconcile membership to exactly ``nodes`` (discovery refresh)."""
+        target = set(nodes)
+        for n in list(self._nodes - target):
+            self.remove(n)
+        for n in sorted(target - self._nodes):
+            self.add(n)
+
+    def nodes(self) -> tuple[str, ...]:
+        return tuple(sorted(self._nodes))
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def __contains__(self, node: str) -> bool:
+        return node in self._nodes
+
+    # -- placement ----------------------------------------------------------
+    def pick(self, key: str, exclude: Iterable[str] = ()) -> str | None:
+        """The shard owning ``key``: first vnode clockwise from the key's
+        position. ``exclude`` walks further clockwise past shards the
+        caller knows are dead/draining — the natural failover order, so a
+        killed shard's sessions land on its ring successor (bounded remap)
+        instead of re-scattering fleet-wide. None on an empty ring."""
+        if not self._points:
+            return None
+        skip = set(exclude)
+        if skip >= self._nodes:
+            return None
+        pos = stable_hash(key)
+        start = bisect.bisect_right(self._points, pos) % len(self._points)
+        for off in range(len(self._points)):
+            p = self._points[(start + off) % len(self._points)]
+            node = self._owners[p]
+            if node not in skip:
+                return node
+        return None

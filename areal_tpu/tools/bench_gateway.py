@@ -27,6 +27,12 @@ Usage:
     # 80%-shared-prefix multi-turn-style workload, one report:
     python -m areal_tpu.tools.bench_gateway --ab --replicas 3 \
         --workload shared_prefix --duration 15 -o ab.json
+    # gateway tier (ROADMAP item 8): 3 consistent-hash shards, one
+    # hard-killed 2s into the measured window:
+    python -m areal_tpu.tools.bench_gateway --local --gateways 3 \
+        --kill-shard-at 2 -o tier.json
+    # the tier acceptance A/B (1 vs 3 shards + kill twin, one report):
+    python -m areal_tpu.tools.bench_gateway --tier-ab -o tier_ab.json
 """
 
 from __future__ import annotations
@@ -171,6 +177,46 @@ class _ClassStats:
         }
 
 
+class _TierResolver:
+    """Session-key -> gateway-shard placement for the tier bench.
+
+    Wraps :class:`~areal_tpu.openai.proxy.tier.TierClient` (the ring +
+    circuit machinery every tier client threads through) and keeps the
+    per-shard goodput scoreboard: each client attributes its
+    within-deadline tokens to the shard that served them (the
+    ``x-areal-gateway-shard`` response header), so the artifact shows
+    load re-hashing onto survivors after a kill."""
+
+    def __init__(self, tier):
+        self.tier = tier
+        self._client = tier.client()
+        self.shard_tokens: dict[str, int] = {}
+        self.failovers = 0
+
+    def pick(self, session_key: str, exclude: tuple[str, ...] = ()):
+        return self._client.pick(session_key, exclude)
+
+    def note_failure(self, addr: str) -> None:
+        self.failovers += 1
+        self._client.note_failure(addr)
+
+    def note_success(self, addr: str) -> None:
+        self._client.note_success(addr)
+
+    def note_tokens(self, shard_id: str, n: int) -> None:
+        if shard_id:
+            self.shard_tokens[shard_id] = self.shard_tokens.get(shard_id, 0) + n
+
+    def report(self, duration_s: float) -> dict[str, Any]:
+        return {
+            "per_shard_goodput_tok_s": {
+                sid: (tok / duration_s if duration_s > 0 else 0.0)
+                for sid, tok in sorted(self.shard_tokens.items())
+            },
+            "failovers": self.failovers,
+        }
+
+
 async def _one_client(
     http,
     gateway_url: str,
@@ -182,6 +228,8 @@ async def _one_client(
     stats: _ClassStats,
     turns: int = 1,
     greedy: bool = False,
+    resolver: _TierResolver | None = None,
+    client_id: int = 0,
 ) -> None:
     """One open-loop client: session -> ``turns`` sequential prioritized
     chat completions -> end session, honoring 429 Retry-After inside the
@@ -190,25 +238,70 @@ async def _one_client(
     t's prompt extends turn t-1's — the conversation-history locality
     that prefix-aware routing exploits (and round-robin re-prefills on a
     cold replica ~(N-1)/N of the time).
+    With a ``resolver`` (the tier bench) the session hashes to ONE gateway
+    shard for its whole lifetime; a connection-refused shard (killed
+    mid-run) is reported into the circuit machinery and the request
+    re-hashes to the ring successor, where route adoption resumes the
+    session — the request must never end responseless.
     The session ends on EVERY exit path: an abandoned session burns one of
     the proxy's capacity units forever, and a bench that leaks capacity
     under sustained overload corrupts its own scoreboard (start_session
     eventually 429s and every later client counts as an error)."""
+    import aiohttp
+
     stats.sent += 1
     t0 = time.monotonic()
     budget_end = t0 + deadline_s
     key = None
+    session_key = f"bench-{priority}-{client_id}"
+    pick = resolver.pick(session_key) if resolver is not None else None
+    shard_tokens: dict[str, int] = {}
+
+    async def post(path: str, body: dict, headers: dict):
+        """POST returning (status, headers, json-or-None). Without a
+        resolver this is a single attempt against ``gateway_url`` — the
+        pre-tier behavior, byte for byte. With one, a refused connection
+        re-picks past the dead shard and retries (bounded)."""
+        nonlocal pick
+        tried: list[str] = []
+        for _ in range(4):
+            if pick is not None:
+                base = pick.url
+                headers = dict(headers)
+                headers[wire.GATEWAY_EXPECT_SHARD_HEADER] = pick.shard_id
+            else:
+                base = gateway_url
+            try:
+                async with http.post(
+                    f"{base}{path}", json=body, headers=headers
+                ) as r:
+                    payload = (
+                        await r.json(content_type=None)
+                        if r.status == 200
+                        else None
+                    )
+                    if pick is not None:
+                        resolver.note_success(pick.addr)
+                    return r.status, r.headers, payload
+            except (aiohttp.ClientConnectionError, OSError):
+                if pick is None:
+                    raise
+                resolver.note_failure(pick.addr)
+                tried.append(pick.addr)
+                pick = resolver.pick(session_key, tuple(tried))
+                if pick is None:
+                    break
+        raise ConnectionError("no reachable gateway shard")
+
     try:
-        admin = {"Authorization": f"Bearer {admin_key}"}
-        async with http.post(
-            f"{gateway_url}/rl/start_session",
-            json={"task_id": f"bench-{priority}"},
-            headers=admin,
-        ) as r:
-            if r.status != 200:
-                stats.errors += 1
-                return
-            sess = await r.json(content_type=None)
+        status, _hd, sess = await post(
+            "/rl/start_session",
+            {"task_id": f"bench-{priority}"},
+            {"Authorization": f"Bearer {admin_key}"},
+        )
+        if status != 200:
+            stats.errors += 1
+            return
         key = sess["api_key"]
         headers = {
             "Authorization": f"Bearer {key}",
@@ -231,43 +324,44 @@ async def _one_client(
                 # masquerade as a goodput difference between arms
                 body["temperature"] = 0
             comp = None
+            served_by = ""
             while True:
-                async with http.post(
-                    f"{gateway_url}/v1/chat/completions",
-                    json=body,
-                    headers=headers,
-                ) as r:
-                    if r.status == 429:
-                        stats.shed_429 += 1
-                        if not was_shed:
-                            was_shed = True
-                            stats.shed_requests += 1
-                        # floor: a foreign gateway's "Retry-After: 0" must
-                        # not hot-spin the bench into amplifying the
-                        # overload; the RFC 7231 HTTP-date form falls back
-                        # to the default rather than misclassifying the
-                        # shed as an error
-                        try:
-                            ra = float(
-                                r.headers.get("Retry-After", "0.5") or 0.5
-                            )
-                        except ValueError:
-                            ra = 0.5
-                        ra = max(0.05, ra)
-                        if time.monotonic() + ra >= budget_end:
-                            return  # budget exhausted while shed
-                        await asyncio.sleep(ra)
-                        continue
-                    if r.status != 200:
-                        stats.errors += 1
-                        return
-                    comp = await r.json(content_type=None)
-                    break
+                status, hd, comp = await post(
+                    "/v1/chat/completions", body, headers
+                )
+                if status == 429:
+                    stats.shed_429 += 1
+                    if not was_shed:
+                        was_shed = True
+                        stats.shed_requests += 1
+                    # floor: a foreign gateway's "Retry-After: 0" must
+                    # not hot-spin the bench into amplifying the
+                    # overload; the RFC 7231 HTTP-date form falls back
+                    # to the default rather than misclassifying the
+                    # shed as an error
+                    try:
+                        ra = float(hd.get("Retry-After", "0.5") or 0.5)
+                    except ValueError:
+                        ra = 0.5
+                    ra = max(0.05, ra)
+                    if time.monotonic() + ra >= budget_end:
+                        return  # budget exhausted while shed
+                    await asyncio.sleep(ra)
+                    continue
+                if status != 200:
+                    stats.errors += 1
+                    return
+                served_by = hd.get(wire.GATEWAY_SHARD_HEADER, "")
+                break
             timing = comp.get("areal_timing") or {}
             usage = comp.get("usage") or {}
             n_tok = int(usage.get("completion_tokens") or 0)
             session_tokens += n_tok
             stats.tokens += n_tok
+            if resolver is not None and served_by:
+                shard_tokens[served_by] = (
+                    shard_tokens.get(served_by, 0) + n_tok
+                )
             if n_tok > 0 and timing.get("ttft_s"):
                 # EVERY turn's TTFT enters the distribution — turns 2+
                 # are exactly where prefix routing shows up (warm
@@ -297,6 +391,11 @@ async def _one_client(
             stats.deadline_reaped += 1
         elif e2e <= deadline_s:
             stats.tokens_within_deadline += session_tokens
+            if resolver is not None:
+                # per-shard goodput uses the same within-deadline rule as
+                # the class totals, attributed to the serving shard
+                for sid, tok in shard_tokens.items():
+                    resolver.note_tokens(sid, tok)
     except Exception as e:  # noqa: BLE001 — one client's failure is a data
         # point (errors count), not a bench abort
         logger.debug(f"bench client failed: {e!r}")
@@ -304,12 +403,11 @@ async def _one_client(
     finally:
         if key is not None:
             try:
-                async with http.post(
-                    f"{gateway_url}/rl/end_session",
-                    json={},
-                    headers={"Authorization": f"Bearer {key}"},
-                ):
-                    pass
+                await post(
+                    "/rl/end_session",
+                    {},
+                    {"Authorization": f"Bearer {key}"},
+                )
             except Exception as e:  # noqa: BLE001 — best-effort release
                 logger.debug(f"end_session failed: {e!r}")
 
@@ -330,6 +428,7 @@ async def drive_gateway(
     rounds: int = 1,
     load_profile: str | list | None = None,
     greedy: bool = False,
+    resolver: _TierResolver | None = None,
 ) -> dict[str, Any]:
     """Open-loop drive: each class's clients start on a fixed arrival
     schedule spread over ``duration_s``. ``*_prompts`` override the default
@@ -340,7 +439,9 @@ async def drive_gateway(
     out scheduling transients). ``load_profile`` (a LOAD_PROFILES name or
     explicit (time_fraction, relative_rate) segments) makes the arrival
     rate time-varying — the overload-study / autopilot-acceptance shape;
-    None keeps the legacy even spread. Returns the report dict."""
+    None keeps the legacy even spread. A ``resolver`` (gateway tier mode)
+    hashes each session to a shard and survives shard death; without one
+    every request hits ``gateway_url``. Returns the report dict."""
     import aiohttp
 
     stats = {p: _ClassStats() for p in PRIORITIES}
@@ -375,6 +476,8 @@ async def drive_gateway(
                             stats[priority],
                             turns=turns,
                             greedy=greedy,
+                            resolver=resolver,
+                            client_id=rnd * n + i,
                         )
                     )
                 )
@@ -458,8 +561,11 @@ class LocalFleet:
         routing_kw: dict | None = None,
         model: str = "tiny",
         autopilot_cfg: Any = None,
+        n_gateways: int = 1,
     ):
         self.n_replicas = n_replicas
+        self.n_gateways = n_gateways
+        self.tier = None
         self.max_batch_size = max_batch_size
         self.chaos_stall_prob = chaos_stall_prob
         self.chaos_stall_s = chaos_stall_s
@@ -599,19 +705,46 @@ class LocalFleet:
         pport = find_free_port()
         await web.TCPSite(self._proxy_runner, "127.0.0.1", pport).start()
         self.proxy_url = f"http://127.0.0.1:{pport}"
-        gw_state = GatewayState(
-            [self.proxy_url],
-            admin_api_key=self.admin_key,
-            max_inflight=self.gateway_max_inflight,
-            interactive_headroom=self.gateway_interactive_headroom,
-            retry_after_s=0.2,
-        )
-        self._gateway_runner = web.AppRunner(create_gateway_app(gw_state))
-        await self._gateway_runner.setup()
-        gport = find_free_port()
-        await web.TCPSite(self._gateway_runner, "127.0.0.1", gport).start()
-        self.gateway_url = f"http://127.0.0.1:{gport}"
-        self.gw_state = gw_state
+        if self.n_gateways > 1:
+            # the horizontally-sharded tier: N gateway shards over this
+            # one proxy, membership in a PRIVATE memory repo (concurrent
+            # benches must not cross-pollinate the process-wide default)
+            from areal_tpu.api.config import GatewayTierConfig
+            from areal_tpu.openai.proxy.tier import GatewayTier
+            from areal_tpu.utils import name_resolve
+
+            self.tier = GatewayTier(
+                [self.proxy_url],
+                self.admin_key,
+                cfg=GatewayTierConfig(
+                    enabled=True,
+                    n_shards=self.n_gateways,
+                    membership_ttl_s=2.0,
+                    membership_poll_s=0.25,
+                ),
+                max_inflight=self.gateway_max_inflight,
+                interactive_headroom=self.gateway_interactive_headroom,
+                retry_after_s=0.2,
+                repo=name_resolve.MemoryNameResolveRepo(),
+            )
+            await self.tier.astart()
+            # the plain-URL consumers (greedy probes) pin shard 0
+            self.gateway_url = f"http://{self.tier.addresses()[0]}"
+            self.gw_state = next(iter(self.tier.shards.values())).state
+        else:
+            gw_state = GatewayState(
+                [self.proxy_url],
+                admin_api_key=self.admin_key,
+                max_inflight=self.gateway_max_inflight,
+                interactive_headroom=self.gateway_interactive_headroom,
+                retry_after_s=0.2,
+            )
+            self._gateway_runner = web.AppRunner(create_gateway_app(gw_state))
+            await self._gateway_runner.setup()
+            gport = find_free_port()
+            await web.TCPSite(self._gateway_runner, "127.0.0.1", gport).start()
+            self.gateway_url = f"http://127.0.0.1:{gport}"
+            self.gw_state = gw_state
         if self.autopilot_cfg is not None and self.autopilot_cfg.enabled:
             # the goodput autopilot over this fleet: knob pushes over HTTP
             # like production, the gateway headroom via the in-process
@@ -621,7 +754,8 @@ class LocalFleet:
             self.autopilot = Autopilot(
                 self.autopilot_cfg,
                 lambda: [s.address for s in self.servers],
-                gateway=gw_state,
+                gateway=self.gw_state,
+                gateway_tier=self.tier,
             )
             self.autopilot.seed_setpoints(
                 max_queue_depth=self.max_queue_depth,
@@ -635,6 +769,8 @@ class LocalFleet:
 
         if self.autopilot is not None:
             self.autopilot.stop()
+        if self.tier is not None:
+            await self.tier.astop()
         if self._gateway_runner is not None:
             await self._gateway_runner.cleanup()
         if self._proxy_runner is not None:
@@ -841,11 +977,14 @@ async def run_local_bench(
     warmup_s: float = 0.0,
     load_profile: str | list | None = None,
     greedy: bool = False,
+    kill_shard_at: float | None = None,
+    post_probe_prompts: list[str] | None = None,
     **fleet_kw: Any,
 ) -> dict[str, Any]:
     fleet = LocalFleet(n_replicas=n_replicas, **fleet_kw)
     try:
         gateway_url, admin_key = await fleet.astart()
+        resolver = _TierResolver(fleet.tier) if fleet.tier is not None else None
         probe_texts = None
         if probe_prompts:
             probe_texts = await _greedy_probes(
@@ -882,6 +1021,7 @@ async def run_local_bench(
                 rollout_prompts=warm_rp,
                 turns=turns,
                 greedy=greedy,
+                resolver=resolver,
             )
         ip, rp = _workload_prompts(
             workload,
@@ -893,6 +1033,21 @@ async def run_local_bench(
             generations=max(1, rounds),
         )
         fleet.mark_baseline()
+        if resolver is not None:
+            # the measured window's scoreboard starts clean (warm-up
+            # traffic attributed tokens too)
+            resolver.shard_tokens = {}
+            resolver.failovers = 0
+        killed_shard = None
+        kill_handle = None
+        if kill_shard_at is not None and fleet.tier is not None:
+            # the deterministic chaos point: hard-kill one shard T seconds
+            # into the measured window (highest shard id — stable across
+            # runs, so the kill and no-kill twins differ ONLY in the kill)
+            killed_shard = sorted(fleet.tier.shards)[-1]
+            kill_handle = asyncio.get_running_loop().call_later(
+                max(0.0, kill_shard_at), fleet.tier.kill_shard, killed_shard
+            )
         fleet.start_activity_sampler()
         report = await drive_gateway(
             gateway_url,
@@ -910,7 +1065,10 @@ async def run_local_bench(
             rounds=rounds,
             load_profile=load_profile,
             greedy=greedy,
+            resolver=resolver,
         )
+        if kill_handle is not None:
+            kill_handle.cancel()  # no-op if it already fired
         active_mean = fleet.stop_activity_sampler()
         report["workload"] = workload
         report["turns"] = turns
@@ -928,8 +1086,27 @@ async def run_local_bench(
         report["autopilot"] = (
             fleet.autopilot.status() if fleet.autopilot is not None else None
         )
+        report["gateway_shards"] = fleet.n_gateways
+        if resolver is not None:
+            tier_report = resolver.report(report["duration_s"])
+            tier_report["killed_shard"] = killed_shard
+            tier_report["shard_stats"] = fleet.tier.shard_stats()
+            report["gateway_tier"] = tier_report
         if probe_texts is not None:
             report["probe_texts"] = probe_texts
+        if post_probe_prompts:
+            # POST-drive identity evidence: in a kill run these greedy
+            # completions ride a tier that already lost a shard — output
+            # must still match the no-kill twin byte for byte (membership
+            # moves placement, never sampling). Served from a live shard.
+            url = (
+                f"http://{fleet.tier.addresses()[0]}"
+                if fleet.tier is not None
+                else gateway_url
+            )
+            report["post_probe_texts"] = await _greedy_probes(
+                url, admin_key, post_probe_prompts
+            )
         return report
     finally:
         await fleet.astop()
@@ -1030,6 +1207,101 @@ async def run_ab(
         "workload": workload,
         "shared_frac": shared_frac,
         "prompt_chars": prompt_chars,
+        "arms": arms,
+        "comparison": comparison,
+    }
+
+
+async def run_tier_ab(
+    n_replicas: int = 2,
+    n_interactive: int = 90,
+    n_rollout: int = 90,
+    duration_s: float = 3.0,
+    deadline_s: float = 20.0,
+    shard_inflight: int = 2,
+    kill_at_frac: float = 0.4,
+    **fleet_kw: Any,
+) -> dict[str, Any]:
+    """The gateway-tier scoreboard (ISSUE 18 acceptance): the SAME fleet
+    shape behind 1 gateway shard, 3 shards, and 3 shards with one killed
+    mid-run.
+
+    The workload is gateway-ADMISSION-bound by construction: each shard
+    admits only ``shard_inflight`` concurrent completions (the per-process
+    ceiling the tier exists to multiply), and per-request service time is
+    dominated by a deterministic chaos stall on every engine call (wait,
+    not compute — in-process shards share one CPU budget, so only
+    latency-bound work can scale with admission slots, exactly like a
+    production fleet whose gateway ceiling is connection/IO concurrency,
+    not cycles). Demand is several times what ``shard_inflight`` slots
+    can clear inside ``deadline_s``: the single-shard arm sheds clients
+    out of their entire deadline budget while three shards clear the same
+    demand in time. Scored on within-deadline goodput, the metric the
+    whole gateway exists to protect; sub-linear scaling means the tier
+    added contention on the request path (exactly what the shared-nothing
+    design forbids).
+
+    The kill twin asserts the robustness headline: zero responseless
+    requests (every client completes, sheds, or reaps — never errors) and
+    post-kill greedy outputs byte-identical to the no-kill twin's
+    (membership moves placement, never sampling)."""
+    probe_prompts = make_shared_prefix_prompts(
+        2, shared_frac=0.5, total_chars=120, seed=53
+    )
+    common = dict(
+        n_replicas=n_replicas,
+        n_interactive=n_interactive,
+        n_rollout=n_rollout,
+        duration_s=duration_s,
+        interactive_tokens=8,
+        rollout_tokens=16,
+        interactive_deadline_s=deadline_s,
+        rollout_deadline_s=deadline_s,
+        greedy=True,
+        post_probe_prompts=probe_prompts,
+        # every engine call stalls 0.4s: service time is wait-dominated
+        # and identical across arms (same seed, same schedule), so the
+        # admission ceiling is the only thing the arms disagree on
+        chaos_stall_prob=1.0,
+        chaos_stall_s=0.4,
+        gateway_max_inflight=shard_inflight,
+        **fleet_kw,
+    )
+    arms: dict[str, dict[str, Any]] = {}
+    arms["shards_1"] = await run_local_bench(n_gateways=1, **common)
+    arms["shards_3"] = await run_local_bench(n_gateways=3, **common)
+    arms["shards_3_kill"] = await run_local_bench(
+        n_gateways=3, kill_shard_at=duration_s * kill_at_frac, **common
+    )
+    g1 = arms["shards_1"]["totals"]["goodput_tok_s"]
+    g3 = arms["shards_3"]["totals"]["goodput_tok_s"]
+    kill = arms["shards_3_kill"]
+    kill_errors = sum(
+        kill["classes"][p]["errors"] for p in PRIORITIES
+    )
+    survivors = {
+        sid: tok
+        for sid, tok in kill["gateway_tier"]["per_shard_goodput_tok_s"].items()
+        if sid != kill["gateway_tier"]["killed_shard"]
+    }
+    comparison = {
+        "goodput_tok_s": {"shards_1": g1, "shards_3": g3},
+        "scaling_x": (g3 / g1) if g1 > 0 else None,
+        "near_linear": g1 > 0 and g3 / g1 >= 2.2,
+        "killed_shard": kill["gateway_tier"]["killed_shard"],
+        "kill_failovers": kill["gateway_tier"]["failovers"],
+        "kill_errors": kill_errors,
+        "kill_zero_responseless": kill_errors == 0,
+        # the dead shard's keyspace must land on survivors, not vanish
+        "survivors_absorbed": any(v > 0 for v in survivors.values()),
+        "kill_greedy_identical": (
+            kill.get("post_probe_texts")
+            == arms["shards_3"].get("post_probe_texts")
+        ),
+    }
+    return {
+        "bench": "gateway_tier_ab",
+        "shard_inflight": shard_inflight,
         "arms": arms,
         "comparison": comparison,
     }
@@ -1288,6 +1560,29 @@ def main(argv=None) -> int:
         "byte-identity)",
     )
     p.add_argument(
+        "--gateways",
+        type=int,
+        default=1,
+        help="gateway shards for the local fleet (N>1 runs the "
+        "consistent-hash tier; 1 keeps the pre-tier single gateway)",
+    )
+    p.add_argument(
+        "--kill-shard-at",
+        type=float,
+        default=None,
+        metavar="T",
+        help="with --gateways N>1: hard-kill one shard T seconds into "
+        "the measured window (the chaos point — clients must re-hash to "
+        "survivors with zero responseless requests)",
+    )
+    p.add_argument(
+        "--tier-ab",
+        action="store_true",
+        help="gateway-tier acceptance A/B: 1 vs 3 shards on the same "
+        "fleet plus a mid-run-kill twin, one comparison report (scaling, "
+        "zero responseless, greedy byte-identity)",
+    )
+    p.add_argument(
         "--load-profile",
         choices=("uniform", *sorted(LOAD_PROFILES)),
         default="uniform",
@@ -1328,7 +1623,13 @@ def main(argv=None) -> int:
     if args.shared_frac is None:
         args.shared_frac = 0.1 if args.ab else 0.8
 
-    if args.autopilot_ab:
+    if args.tier_ab:
+        report = asyncio.run(
+            run_tier_ab(
+                duration_s=args.duration if args.duration != 15.0 else 6.0,
+            )
+        )
+    elif args.autopilot_ab:
         report = asyncio.run(
             run_autopilot_ab(
                 load_profile=(
@@ -1378,6 +1679,8 @@ def main(argv=None) -> int:
                 gateway_max_inflight=args.max_inflight,
                 gateway_interactive_headroom=args.headroom,
                 route_policy=args.route_policy,
+                n_gateways=args.gateways,
+                kill_shard_at=args.kill_shard_at,
             )
         )
     else:
@@ -1399,7 +1702,15 @@ def main(argv=None) -> int:
         atomic_io.atomic_write_text(args.output, text)
         print(f"wrote {args.output}")
     # non-null scoreboard or the run proved nothing
-    if args.autopilot_ab:
+    if args.tier_ab:
+        cmp_ = report["comparison"]
+        ok = (
+            cmp_["near_linear"]
+            and cmp_["kill_zero_responseless"]
+            and cmp_["survivors_absorbed"]
+            and cmp_["kill_greedy_identical"]
+        )
+    elif args.autopilot_ab:
         cmp_ = report["comparison"]
         ok = (
             cmp_["autopilot_wins"]

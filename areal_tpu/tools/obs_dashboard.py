@@ -269,6 +269,37 @@ def render_frame(
             nonneg = [v for v in vs if v >= 0]
             age = f"{min(nonneg):.0f}s ago" if nonneg else "never"
             lines.append(f"{'  ' + ctrl + ' acted':<24} {age:>12}")
+    # gateway tier (docs/serving.md "Gateway tier"): ring fan-out plus the
+    # shard-death story — degraded membership refreshes, affinity repairs
+    # on survivors, misroutes, and per-shard session balance. The shard
+    # count and per-shard session gauges are per-membership-view FACTS
+    # (co-located shards share one registry, so every scrape of the tier
+    # process reports the whole tier): take the max, never the merge-sum.
+    shard_counts = [
+        v
+        for t in snap.targets
+        if t.up
+        for n, _labels, v in t.samples
+        if n == "areal_gateway_shard_count"
+    ]
+    if shard_counts:
+        lines.append("-" * 64)
+        lines.append(f"{'gateway shards':<24} {_fmt(max(shard_counts)):>12}")
+        for metric, label in (
+            ("areal_gateway_shard_membership_stale_total", "  stale membership"),
+            ("areal_gateway_shard_route_recoveries_total", "  route recoveries"),
+            ("areal_gateway_shard_misroute_total", "  misroutes"),
+            ("areal_gateway_shard_drain_total", "  drain transitions"),
+        ):
+            v = _merged_value(snap, metric)
+            if v is not None:
+                lines.append(f"{label:<24} {_fmt(v):>12}")
+        for shard, vs in sorted(
+            _labeled_values(
+                snap, "areal_gateway_shard_sessions", "shard"
+            ).items()
+        ):
+            lines.append(f"{'  sessions ' + shard:<24} {_fmt(max(vs)):>12}")
     # overload view (docs/request_lifecycle.md): everything turned away with
     # a 429 — gateway load shedding + engine admission rejections — as a
     # fleet total, and as a rate once two frames exist
@@ -524,6 +555,22 @@ areal_router_actual_hit_total 5
 # HELP areal_admission_rejected_total Requests rejected at engine admission.
 # TYPE areal_admission_rejected_total counter
 areal_admission_rejected_total{reason="queue_depth"} 4
+# HELP areal_gateway_shard_count Live gateway shards in the membership view.
+# TYPE areal_gateway_shard_count gauge
+areal_gateway_shard_count 3
+# HELP areal_gateway_shard_membership_stale_total Failed membership refreshes served on the last-known view.
+# TYPE areal_gateway_shard_membership_stale_total counter
+areal_gateway_shard_membership_stale_total 2
+# HELP areal_gateway_shard_route_recoveries_total Sessions adopted by a surviving shard.
+# TYPE areal_gateway_shard_route_recoveries_total counter
+areal_gateway_shard_route_recoveries_total 4
+# HELP areal_gateway_shard_misroute_total Requests landing on an unexpected shard.
+# TYPE areal_gateway_shard_misroute_total counter
+areal_gateway_shard_misroute_total 1
+# HELP areal_gateway_shard_sessions Active session routes per gateway shard.
+# TYPE areal_gateway_shard_sessions gauge
+areal_gateway_shard_sessions{shard="gw0"} 5
+areal_gateway_shard_sessions{shard="gw1"} 3
 # HELP areal_autopilot_decisions_total Autopilot setpoint changes applied.
 # TYPE areal_autopilot_decisions_total counter
 areal_autopilot_decisions_total{controller="admission",reason="queue_wait_high"} 3
@@ -805,6 +852,30 @@ def self_test() -> int:
             (
                 "shed/rejected (429)" in frame and "20" in frame,
                 "frame missing shed/rejected row",
+            ),
+            (
+                "gateway shards" in frame and "3" in frame,
+                "frame missing gateway-tier panel (shard count is a "
+                "membership FACT: 3 per scrape must stay 3, never the 6 "
+                "a fleet merge-sum would claim)",
+            ),
+            (
+                "route recoveries" in frame
+                and _merged_value(
+                    snap, "areal_gateway_shard_route_recoveries_total"
+                )
+                == 8,
+                "frame missing affinity-repair row (counters are "
+                "additive: 2x4 = 8)",
+            ),
+            (
+                "stale membership" in frame,
+                "frame missing degraded-discovery row",
+            ),
+            (
+                "sessions gw0" in frame and "sessions gw1" in frame,
+                "frame missing per-shard session balance rows (gauge "
+                "children keyed by shard, max across scrapes)",
             ),
             (
                 "preemptions" in frame

@@ -50,6 +50,16 @@ ring with a reason, and an evict -> respawn cycle yields zero routes to
 the evicted replica while it is down (with its shadow prefix index read
 as cold after the rejoin).
 
+``--gateway-tier-self-test`` stands up the horizontally-sharded gateway
+tier (docs/serving.md "Gateway tier"): 3 gateway shards over a small
+in-process fleet, driven by the ring-hashing tier client while seeded
+chaos kills one shard mid-run. Asserts the tier's whole fault story:
+every session completes or terminates with a real terminal status (zero
+responseless requests), the clients re-hash their sessions onto the
+surviving shards (failovers observed, the keyspace the victim owned is
+served by survivors), and the membership view converges to the two
+survivors.
+
 ``--microbench-self-test`` exercises the kernel observatory (docs/perf.md
 "Kernel observatory") on CPU: the fast microbench registry runs end to
 end with non-null analytic rooflines, the compare gate stays silent on a
@@ -62,7 +72,7 @@ Usage: python -m areal_tpu.tools.validate_installation [--tpu]
     [--chaos-self-test] [--weight-sync-self-test] [--prefix-cache-self-test]
     [--overload-self-test] [--timeline-self-test] [--train-obs-self-test]
     [--learning-obs-self-test] [--preemption-self-test] [--routing-self-test]
-    [--microbench-self-test]
+    [--microbench-self-test] [--gateway-tier-self-test]
 """
 
 from __future__ import annotations
@@ -179,6 +189,15 @@ def main(argv=None) -> int:
         "rooflines), assert the compare gate flags a seeded 2x regression "
         "per bench and stays silent on self-compare, and assert the live "
         "engine's decode phase breakdown obeys the exact-sum identity",
+    )
+    p.add_argument(
+        "--gateway-tier-self-test",
+        action="store_true",
+        help="3 gateway shards over a small fleet under seeded chaos: one "
+        "shard is killed mid-run and every session must complete or "
+        "terminate with a real terminal (zero responseless requests) "
+        "while the survivors absorb the re-hashed load "
+        "(docs/serving.md 'Gateway tier')",
     )
     p.add_argument(
         "--preemption-self-test",
@@ -415,6 +434,9 @@ def main(argv=None) -> int:
 
     if args.microbench_self_test:
         _check("microbench", microbench_self_test, results)
+
+    if args.gateway_tier_self_test:
+        _check("gateway_tier", gateway_tier_self_test, results)
 
     width = max(len(n) for n, _, _ in results)
     ok = True
@@ -1849,6 +1871,130 @@ def microbench_self_test() -> str:
         f"{len(recs)} steps, steady roofline "
         f"{ks['roofline_fraction']:.4f}"
     )
+
+
+def gateway_tier_self_test(
+    n_replicas: int = 2,
+    n_shards: int = 3,
+    n_interactive: int = 9,
+    n_rollout: int = 15,
+    duration_s: float = 2.0,
+    seed: int = 31,
+) -> str:
+    """Horizontally-sharded gateway tier end to end (docs/serving.md
+    "Gateway tier"): 3 shards over a 2-replica fleet, sessions placed by
+    the consistent-hash tier client, with seeded chaos arming a
+    mid-run shard kill.
+
+    Asserts: (1) the kill actually fired and the membership view
+    converged to the survivors; (2) zero responseless requests — every
+    session completed or ended on a real terminal status, and with no
+    backpressure in this fleet that means completed == sent; (3) the
+    survivors absorbed the re-hashed load: clients observed failovers,
+    and the keyspace the victim owned was served by surviving shards."""
+    import asyncio
+    import time
+
+    from areal_tpu.api.config import ChaosConfig
+    from areal_tpu.robustness import FaultInjector
+    from areal_tpu.tools.bench_gateway import (
+        LocalFleet,
+        _TierResolver,
+        drive_gateway,
+    )
+
+    async def run() -> str:
+        fleet = LocalFleet(
+            n_replicas=n_replicas,
+            n_gateways=n_shards,
+            chaos_stall_prob=0.0,
+            seed=seed,
+        )
+        await fleet.astart()
+        try:
+            assert fleet.tier is not None
+            assert len(fleet.tier.addresses()) == n_shards
+            resolver = _TierResolver(fleet.tier)
+            # seeded chaos, restricted to ONE victim shard: the injector
+            # fires each registered target at most once, so "kill one
+            # shard mid-run" is a harness invariant, not a probability
+            victim = sorted(fleet.tier.shards)[-1]
+            injector = FaultInjector(
+                ChaosConfig(
+                    enabled=True,
+                    seed=seed,
+                    gateway_kill_prob=0.35,
+                    path_prefix="/generate",
+                )
+            )
+            injector.set_gateway_kill_targets(
+                {victim: fleet.tier.kill_callables()[victim]}
+            )
+            fleet.client.install_fault_injector(injector)
+            report = await drive_gateway(
+                fleet.gateway_url,
+                fleet.admin_key,
+                n_interactive=n_interactive,
+                n_rollout=n_rollout,
+                duration_s=duration_s,
+                interactive_deadline_s=30.0,
+                rollout_deadline_s=30.0,
+                interactive_tokens=8,
+                rollout_tokens=16,
+                turns=2,
+                greedy=True,
+                resolver=resolver,
+            )
+            tot = report["totals"]
+            kills = injector.stats().get("gw_kill", 0)
+            assert kills == 1, f"chaos never killed the shard ({kills=})"
+            # zero responseless requests: every session reached a real
+            # terminal (here: completion — this fleet has no admission
+            # limit and generous deadlines, so shed/reaped would itself
+            # be a tier failure)
+            assert tot["errors"] == 0, f"responseless requests: {tot}"
+            assert tot["completed"] == tot["sent"], (
+                f"sessions lost mid-failover: {tot}"
+            )
+            # the survivors absorbed the re-hashed load: clients hit the
+            # dead shard, failed over, and the victim's keyspace was
+            # served by surviving shards
+            assert resolver.failovers > 0, (
+                "no client ever failed over — kill happened outside the "
+                "measured run?"
+            )
+            survivors = {
+                sid: tok
+                for sid, tok in resolver.shard_tokens.items()
+                if sid != victim
+            }
+            assert sum(survivors.values()) > 0, (
+                f"survivors served nothing: {resolver.shard_tokens}"
+            )
+            # membership converges: the victim's record expires from the
+            # name_resolve view (abandoned keepalive -> TTL), leaving
+            # exactly the survivors serving
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if len(fleet.tier.directory.view()) == n_shards - 1:
+                    break
+                await asyncio.sleep(0.2)
+            view = fleet.tier.directory.view()
+            assert len(view) == n_shards - 1, (
+                f"membership never converged: {sorted(view)}"
+            )
+            assert victim not in view, f"dead shard still in view: {victim}"
+            return (
+                f"{tot['completed']}/{tot['sent']} sessions completed over "
+                f"{n_shards} shards with shard {victim} killed mid-run: "
+                f"0 responseless, {resolver.failovers} failovers, "
+                f"survivor tokens {sorted(survivors.items())}, membership "
+                f"converged to {len(view)} shards"
+            )
+        finally:
+            await fleet.astop()
+
+    return asyncio.run(run())
 
 
 if __name__ == "__main__":

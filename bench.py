@@ -998,6 +998,11 @@ def phase_gateway():
             prompt_chars=280,
             interactive_tokens=8,
             rollout_tokens=32,
+            # the gateway tier is live in the standing scoreboard: 2
+            # consistent-hash shards (sessions split by key, per-shard
+            # goodput recorded) — the sharded control plane is the
+            # measured configuration, not a special mode
+            n_gateways=2,
         )
     )
     classes = {}
@@ -1014,11 +1019,23 @@ def phase_gateway():
         }
     hit_rate = report.get("router_hit_rate")
     ap = report.get("autopilot")
+    tier = report.get("gateway_tier") or {}
     _emit_phase(
         {
             "phase": "gateway",
             "duration_s": report["duration_s"],
             "goodput_tok_s": round(report["totals"]["goodput_tok_s"], 1),
+            # the sharded gateway tier's scoreboard (ROADMAP item 8):
+            # shard count + per-shard within-deadline goodput
+            "gateway_shards": report.get("gateway_shards"),
+            "shard_goodput_tok_s": (
+                {
+                    sid: round(v, 1)
+                    for sid, v in tier["per_shard_goodput_tok_s"].items()
+                }
+                if tier.get("per_shard_goodput_tok_s")
+                else None
+            ),
             "route_policy": report.get("route_policy"),
             "router_hit_rate": (
                 round(hit_rate, 4) if hit_rate is not None else None
@@ -1317,6 +1334,10 @@ def main():
             # scoreboard itself is never null)
             gateway = {
                 "goodput_tok_s": gw.get("goodput_tok_s"),
+                # the sharded tier's numbers (cached pre-tier payloads
+                # fold None, never a missing key)
+                "shards": gw.get("gateway_shards"),
+                "shard_goodput_tok_s": gw.get("shard_goodput_tok_s"),
                 "route_policy": gw.get("route_policy"),
                 "router_hit_rate": gw.get("router_hit_rate"),
                 # the control plane's setpoints + decision count (cached

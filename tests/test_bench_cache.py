@@ -143,6 +143,8 @@ def test_main_folds_gateway_scoreboard(cache_dir, monkeypatch, capsys):
             return {
                 "phase": "gateway",
                 "goodput_tok_s": 123.4,
+                "gateway_shards": 2,
+                "shard_goodput_tok_s": {"gw0": 70.0, "gw1": 53.4},
                 "route_policy": "cache_aware",
                 "router_hit_rate": 0.61,
                 "classes": {
@@ -160,6 +162,8 @@ def test_main_folds_gateway_scoreboard(cache_dir, monkeypatch, capsys):
     out = json.loads(line)
     gw = out["detail"]["gateway"]
     assert gw["goodput_tok_s"] == 123.4
+    assert gw["shards"] == 2
+    assert gw["shard_goodput_tok_s"] == {"gw0": 70.0, "gw1": 53.4}
     assert gw["route_policy"] == "cache_aware"
     assert gw["router_hit_rate"] == 0.61
     assert gw["classes"]["rollout"]["ttft_p99_s"] == 1.5
@@ -169,15 +173,17 @@ def test_main_folds_gateway_scoreboard(cache_dir, monkeypatch, capsys):
 def test_cached_pre_router_gateway_payload_folds_with_none(
     cache_dir, monkeypatch, capsys
 ):
-    """A cached gateway payload measured BEFORE the routing brain landed
-    has no route_policy/router_hit_rate — those fields fold as None, the
+    """A cached gateway payload measured BEFORE the routing brain (PR 7)
+    or the gateway tier (PR 18) landed has no route_policy /
+    router_hit_rate / gateway_shards — those fields fold as None, the
     scoreboard itself (goodput + classes) never nulls out."""
 
     def fake_spawn(name, deadline=None):
         if name == "probe":
             return {"phase": "probe", "platform": "tpu", "n_devices": 1}
         if name == "gateway":
-            # pre-router payload shape (PR 7): no router fields at all
+            # pre-router, pre-tier payload shape (PR 7): no router and no
+            # shard fields at all
             return {
                 "phase": "gateway",
                 "goodput_tok_s": 99.0,
@@ -198,6 +204,8 @@ def test_cached_pre_router_gateway_payload_folds_with_none(
     assert gw["goodput_tok_s"] == 99.0
     assert gw["route_policy"] is None
     assert gw["router_hit_rate"] is None
+    assert gw["shards"] is None
+    assert gw["shard_goodput_tok_s"] is None
     assert gw["classes"]["interactive"]["ttft_p99_s"] == 0.4
 
 

@@ -1,0 +1,648 @@
+"""Gateway tier behavior (openai/proxy/tier.py + friends).
+
+The tier converts the last control-plane singleton into a fleet; these
+tests pin each leg of that story in isolation: membership with graceful
+degradation (etcd down = stale view, counted, never a crash), the drain
+surface the autopilot scales through, affinity repair (a surviving shard
+adopts a dead shard's sessions from the backend proxy), probe→evict→
+respawn supervision, circuit-aware client re-hash, and the chaos kind
+that kills real shard listeners deterministically.
+"""
+
+import asyncio
+import threading
+import time
+import types
+
+from areal_tpu.api.config import (
+    ChaosConfig,
+    FaultToleranceConfig,
+    GatewayTierConfig,
+)
+from areal_tpu.observability import catalog
+from areal_tpu.openai.proxy.tier import (
+    DRAINING,
+    UP,
+    GatewayTier,
+    ShardDirectory,
+    ShardRecord,
+)
+from areal_tpu.robustness import FaultInjector
+from areal_tpu.utils import name_resolve
+
+
+class _FlakyRepo(name_resolve.MemoryNameResolveRepo):
+    """A memory repo whose reads can be switched off — the etcd-outage
+    stand-in for the degraded-discovery contract."""
+
+    def __init__(self):
+        super().__init__()
+        self.down = False
+
+    def get_subtree(self, name_root):
+        if self.down:
+            raise ConnectionError("etcd unreachable")
+        return super().get_subtree(name_root)
+
+
+def _tier_cfg(**kw):
+    base = dict(
+        enabled=True,
+        n_shards=2,
+        membership_ttl_s=1.0,
+        membership_poll_s=0.1,
+    )
+    base.update(kw)
+    return GatewayTierConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# membership: degraded discovery, TTL expiry, static floor
+# ---------------------------------------------------------------------------
+
+
+def test_directory_degraded_mode_keeps_serving_counted_and_recovers():
+    """The acceptance criterion verbatim: etcd-unreachable keeps serving
+    on the last-known membership (counted on the catalogued metric) and
+    recovers when etcd returns."""
+    repo = _FlakyRepo()
+    d = ShardDirectory(_tier_cfg(), repo=repo)
+    stale_metric = catalog.gateway_tier_metrics().membership_stale
+    stale0 = stale_metric.get()
+    try:
+        d.publish("gw0", "127.0.0.1:1001")
+        d.publish("gw1", "127.0.0.1:1002")
+        assert d.refresh() is True
+        assert set(d.view()) == {"gw0", "gw1"}
+
+        repo.down = True
+        for _ in range(3):
+            assert d.refresh() is False
+        # stale view KEEPS SERVING: the ring still places every key
+        assert set(d.view()) == {"gw0", "gw1"}
+        assert d.ring().pick("session-x") in {"127.0.0.1:1001", "127.0.0.1:1002"}
+        assert d.stale_reads == 3
+        assert stale_metric.get() - stale0 == 3
+
+        repo.down = False
+        assert d.refresh() is True
+        assert set(d.view()) == {"gw0", "gw1"}
+    finally:
+        d.stop()
+
+
+def test_directory_abandoned_record_expires_after_ttl():
+    """kill semantics: an abandoned keepalive (process death) leaves the
+    record to expire on its own — survivors learn through the TTL, not a
+    goodbye message."""
+    d = ShardDirectory(
+        _tier_cfg(membership_ttl_s=0.3), repo=name_resolve.MemoryNameResolveRepo()
+    )
+    try:
+        d.publish("gw0", "127.0.0.1:1001")
+        d.publish("gw1", "127.0.0.1:1002")
+        assert d.refresh() and len(d.view()) == 2
+        d.abandon("gw1")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            d.refresh()
+            if set(d.view()) == {"gw0"}:
+                break
+            time.sleep(0.05)
+        assert set(d.view()) == {"gw0"}
+        assert d.ring().pick("any") == "127.0.0.1:1001"
+    finally:
+        d.stop()
+
+
+def test_directory_static_floor_without_discovery():
+    """static_shards is the never-connected fallback: a client that has
+    never reached etcd still places sessions."""
+    cfg = _tier_cfg(static_shards=["10.0.0.1:9000", "10.0.0.2:9000"])
+    d = ShardDirectory(cfg, repo=_FlakyRepo())
+    assert d.ring().pick("k") in {"10.0.0.1:9000", "10.0.0.2:9000"}
+    assert len(d.view()) == 2
+
+
+def test_directory_ignores_foreign_junk_under_namespace():
+    repo = name_resolve.MemoryNameResolveRepo()
+    d = ShardDirectory(_tier_cfg(), repo=repo)
+    try:
+        d.publish("gw0", "127.0.0.1:1001")
+        repo.add(f"{d.cfg.namespace}/junk", "not json {", replace=True)
+        assert d.refresh() is True
+        assert set(d.view()) == {"gw0"}
+    finally:
+        d.stop()
+
+
+# ---------------------------------------------------------------------------
+# tier harness: drain surface + membership record state
+# ---------------------------------------------------------------------------
+
+
+def test_tier_drain_undrain_surface():
+    async def go():
+        tier = GatewayTier(
+            ["http://127.0.0.1:1"],
+            "adm",
+            cfg=_tier_cfg(n_shards=2),
+            repo=name_resolve.MemoryNameResolveRepo(),
+        )
+        await tier.astart()
+        try:
+            a, b = tier.addresses()
+            assert len(tier.addresses(include_draining=False)) == 2
+            assert tier.drain_shard(b)
+            assert tier.addresses(include_draining=False) == [a]
+            assert b in tier.addresses()  # still listed, still serving
+            # the DRAINING state reaches the membership record, so client
+            # rings built from the view stop placing NEW sessions there
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, tier.directory.refresh)
+            rec = tier.directory.shard_for_addr(b)
+            assert rec is not None and rec.state == DRAINING
+            assert b not in tier.directory.ring()
+            assert tier.undrain_shard(b)
+            await loop.run_in_executor(None, tier.directory.refresh)
+            rec = tier.directory.shard_for_addr(b)
+            assert rec is not None and rec.state == UP
+            assert b in tier.directory.ring()
+        finally:
+            await tier.astop()
+
+    asyncio.run(go())
+
+
+def test_tier_kill_shard_stops_listener_and_abandons_record():
+    async def go():
+        import aiohttp
+
+        tier = GatewayTier(
+            ["http://127.0.0.1:1"],
+            "adm",
+            cfg=_tier_cfg(n_shards=2, membership_ttl_s=0.3),
+            repo=name_resolve.MemoryNameResolveRepo(),
+        )
+        await tier.astart()
+        try:
+            victim = sorted(tier.shards)[0]
+            victim_addr = tier.shards[victim].addr
+            assert tier.kill_shard(victim)
+            await asyncio.sleep(0)  # let the kill future run
+            assert victim_addr not in tier.addresses()
+            # the listener is really gone — a connect must fail
+            await asyncio.sleep(0.1)
+            async with aiohttp.ClientSession() as http:
+                try:
+                    await http.get(
+                        f"http://{victim_addr}/health",
+                        timeout=aiohttp.ClientTimeout(total=1),
+                    )
+                    raise AssertionError("killed shard still accepting")
+                except aiohttp.ClientConnectionError:
+                    pass
+            # membership learns through TTL expiry, not a goodbye
+            loop = asyncio.get_running_loop()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                await loop.run_in_executor(None, tier.directory.refresh)
+                if victim not in tier.directory.view():
+                    break
+                await asyncio.sleep(0.05)
+            assert victim not in tier.directory.view()
+            # killing twice is a no-op, not an error
+            assert tier.kill_shard(victim) is True  # scheduled, resolves False
+        finally:
+            await tier.astop()
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# affinity repair: a shard with no route adopts from the owning backend
+# ---------------------------------------------------------------------------
+
+
+def test_route_adoption_probes_backends_and_repairs_affinity():
+    async def go():
+        import aiohttp
+        from aiohttp import web
+        from aiohttp.test_utils import TestServer
+
+        owner_hits = []
+
+        async def not_owner(request):
+            return web.json_response({"reason": "unknown session"}, status=410)
+
+        async def owner(request):
+            owner_hits.append(request.path)
+            return web.json_response({"choices": [{"ok": True}]})
+
+        apps = []
+        for handler in (not_owner, owner):
+            app = web.Application()
+            app.router.add_post("/v1/chat/completions", handler)
+            apps.append(app)
+        srv_not, srv_own = TestServer(apps[0]), TestServer(apps[1])
+        await srv_not.start_server()
+        await srv_own.start_server()
+        backends = [
+            f"http://127.0.0.1:{srv_not.port}",
+            f"http://127.0.0.1:{srv_own.port}",
+        ]
+
+        recoveries = catalog.gateway_tier_metrics().route_recoveries
+        rec0 = recoveries.get()
+        tier = GatewayTier(
+            backends,
+            "adm",
+            cfg=_tier_cfg(n_shards=1, route_adopt=True),
+            repo=name_resolve.MemoryNameResolveRepo(),
+        )
+        await tier.astart()
+        try:
+            shard = next(iter(tier.shards.values()))
+            assert "key-1" not in shard.state.routes
+            async with aiohttp.ClientSession() as http:
+                r = await http.post(
+                    f"http://{tier.addresses()[0]}/v1/chat/completions",
+                    json={},
+                    headers={"Authorization": "Bearer key-1"},
+                )
+                assert r.status == 200
+            # the shard probed past the non-owner's 410, found the owner,
+            # and ADOPTED the route: affinity repaired
+            assert owner_hits == ["/v1/chat/completions"]
+            assert shard.state.routes["key-1"].backend == backends[1]
+            assert recoveries.get() - rec0 == 1
+            # second request rides the adopted route — no more probing
+            async with aiohttp.ClientSession() as http:
+                r = await http.post(
+                    f"http://{tier.addresses()[0]}/v1/chat/completions",
+                    json={},
+                    headers={"Authorization": "Bearer key-1"},
+                )
+                assert r.status == 200
+            assert len(owner_hits) == 2
+            assert recoveries.get() - rec0 == 1
+        finally:
+            await tier.astop()
+            await srv_not.close()
+            await srv_own.close()
+
+    asyncio.run(go())
+
+
+def test_route_miss_without_adopt_is_410():
+    async def go():
+        import aiohttp
+
+        tier = GatewayTier(
+            ["http://127.0.0.1:1"],
+            "adm",
+            cfg=_tier_cfg(n_shards=1, route_adopt=False),
+            repo=name_resolve.MemoryNameResolveRepo(),
+        )
+        await tier.astart()
+        try:
+            async with aiohttp.ClientSession() as http:
+                r = await http.post(
+                    f"http://{tier.addresses()[0]}/v1/chat/completions",
+                    json={},
+                    headers={"Authorization": "Bearer ghost"},
+                )
+                assert r.status == 410
+        finally:
+            await tier.astop()
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# supervision: probe -> evict -> respawn
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_evicts_dead_shard_and_respawns():
+    from areal_tpu.robustness import GatewayShardSupervisor
+
+    async def go():
+        tier = GatewayTier(
+            ["http://127.0.0.1:1"],
+            "adm",
+            cfg=_tier_cfg(n_shards=2),
+            repo=name_resolve.MemoryNameResolveRepo(),
+        )
+        await tier.astart()
+        try:
+            dead = set()
+
+            def probe(addr, timeout):
+                return addr not in dead
+
+            sup = GatewayShardSupervisor(
+                tier,
+                FaultToleranceConfig(
+                    probe_interval_s=0.1,
+                    probe_failures_to_evict=2,
+                    max_respawns=2,
+                ),
+                probe=probe,
+            )
+            victim = sorted(tier.shards)[0]
+            victim_addr = tier.shards[victim].addr
+            loop = asyncio.get_running_loop()
+            # healthy round: nothing happens
+            states = await loop.run_in_executor(None, sup.probe_once)
+            assert set(states.values()) == {"up"}
+            dead.add(victim_addr)
+            states = await loop.run_in_executor(None, sup.probe_once)
+            assert states[victim] == "down"  # 1 failure: not evicted yet
+            states = await loop.run_in_executor(None, sup.probe_once)
+            assert states[victim] == "evicted"
+            # the victim is gone and a REPLACEMENT shard listens on a
+            # fresh port — capacity restored
+            assert victim not in tier.shards
+            addrs = tier.addresses()
+            assert len(addrs) == 2 and victim_addr not in addrs
+            assert sup.statusz()["respawns"] == 1
+        finally:
+            await tier.astop()
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# client placement: circuit-aware re-hash + hard exclusions
+# ---------------------------------------------------------------------------
+
+
+def test_tier_client_rehashes_past_open_circuit_and_back():
+    cfg = _tier_cfg(static_shards=["10.0.0.1:9", "10.0.0.2:9", "10.0.0.3:9"])
+    d = ShardDirectory(cfg, repo=_FlakyRepo())
+    clock = [100.0]
+    from areal_tpu.openai.proxy.tier import TierClient
+
+    client = TierClient(d)
+    client._health._clock = lambda: clock[0]  # steer breaker recovery
+    key = "session-rehash"
+    owner = client.pick(key).addr
+    # failures trip the owner's breaker: placement walks to the ring
+    # successor — the same shard membership expiry would choose
+    for _ in range(FaultToleranceConfig().circuit_failure_threshold):
+        client.note_failure(owner)
+    moved = client.pick(key)
+    assert moved.addr != owner
+    assert moved.addr == d.ring().pick(key, exclude=(owner,))
+    # hard exclusion wins even when every circuit is open (the fall-back
+    # to the raw ring owner must never resurrect THIS request's refusals)
+    for a in cfg.static_shards:
+        for _ in range(FaultToleranceConfig().circuit_failure_threshold):
+            client.note_failure(a)
+    p = client.pick(key, exclude=(owner,))
+    assert p is not None and p.addr != owner
+    assert client.pick(key, exclude=tuple(cfg.static_shards)) is None
+
+
+# ---------------------------------------------------------------------------
+# autopilot: the tier controller scales through the drain surface
+# ---------------------------------------------------------------------------
+
+
+class _FakeTier:
+    def __init__(self, stats):
+        self.stats = stats
+        self.drained: list[str] = []
+        self.undrained: list[str] = []
+
+    def shard_stats(self):
+        return self.stats
+
+    def drain_shard(self, addr):
+        self.drained.append(addr)
+        return True
+
+    def undrain_shard(self, addr):
+        self.undrained.append(addr)
+        return True
+
+
+def _shard_stat(addr, inflight=0, shed=0, draining=False, max_inflight=4):
+    return {
+        "addr": addr,
+        "shard_id": addr,
+        "draining": draining,
+        "inflight": inflight,
+        "max_inflight": max_inflight,
+        "sessions": 0,
+        "shed": shed,
+    }
+
+
+def test_tier_controller_drains_idle_shard_with_tier_knob():
+    from areal_tpu.api.config import FleetControllerConfig
+    from areal_tpu.autopilot.controllers import GatewayTierController
+
+    tier = _FakeTier(
+        [_shard_stat("gw:1"), _shard_stat("gw:2"), _shard_stat("gw:3")]
+    )
+    ctrl = GatewayTierController(
+        FleetControllerConfig(sustain_rounds=2, cooldown_s=0.0), tier
+    )
+    assert ctrl.decide(types.SimpleNamespace(now=100.0)) == []
+    acts = ctrl.decide(types.SimpleNamespace(now=101.0))
+    assert len(acts) == 1
+    a = acts[0]
+    assert a.knob == "target_gateway_shards"
+    assert a.reason == "sustained_idle"
+    assert a.target in {"gw:1", "gw:2", "gw:3"}
+    assert (a.old, a.new) == (3, 2)
+
+
+def test_tier_controller_undrains_on_shed_delta():
+    from areal_tpu.api.config import FleetControllerConfig
+    from areal_tpu.autopilot.controllers import GatewayTierController
+
+    stats = [
+        _shard_stat("gw:1", inflight=4, shed=0),
+        _shard_stat("gw:2", draining=True),
+    ]
+    tier = _FakeTier(stats)
+    ctrl = GatewayTierController(
+        FleetControllerConfig(
+            sustain_rounds=9, undrain_sustain_rounds=2, cooldown_s=0.0
+        ),
+        tier,
+    )
+    assert ctrl.decide(types.SimpleNamespace(now=100.0)) == []
+    # shed counters JUMP between rounds: the delta is the backlog signal
+    stats[0]["shed"] = 40
+    assert ctrl.decide(types.SimpleNamespace(now=101.0)) == []
+    stats[0]["shed"] = 80
+    acts = ctrl.decide(types.SimpleNamespace(now=102.0))
+    assert len(acts) == 1
+    assert acts[0].knob == "target_gateway_shards"
+    assert acts[0].reason == "sustained_backlog"
+    assert acts[0].target == "gw:2"
+
+
+def test_autopilot_applies_tier_knob_through_drain_surface():
+    from areal_tpu.autopilot import signals as sig_mod
+    from areal_tpu.autopilot.autopilot import Autopilot
+    from areal_tpu.autopilot.controllers import Action
+
+    from areal_tpu.api.config import AutopilotConfig
+
+    sig = sig_mod.Signals(now=100.0)
+    tier = _FakeTier([_shard_stat("gw:1"), _shard_stat("gw:2")])
+    ap = Autopilot(
+        AutopilotConfig(enabled=True),
+        lambda: [],
+        gateway_tier=tier,
+    )
+    down = Action(
+        controller="gateway_tier",
+        knob="target_gateway_shards",
+        old=2,
+        new=1,
+        reason="sustained_idle",
+        target="gw:2",
+    )
+    up = Action(
+        controller="gateway_tier",
+        knob="target_gateway_shards",
+        old=1,
+        new=2,
+        reason="sustained_backlog",
+        target="gw:2",
+    )
+    assert ap._apply(down, sig) is True
+    assert tier.drained == ["gw:2"]
+    assert ap._apply(up, sig) is True
+    assert tier.undrained == ["gw:2"]
+
+
+# ---------------------------------------------------------------------------
+# chaos: the gw_kill kind fires real kill closures, each at most once
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_gateway_kill_each_target_at_most_once():
+    killed: list[str] = []
+    inj = FaultInjector(
+        ChaosConfig(enabled=True, seed=3, gateway_kill_prob=1.0)
+    )
+    inj.set_gateway_kill_targets(
+        {
+            "gw0": lambda: killed.append("gw0") or True,
+            "gw1": lambda: killed.append("gw1") or True,
+        }
+    )
+    for _ in range(6):
+        inj.perturb("addr", "/generate")  # never raises for gw_kill
+    assert sorted(killed) == ["gw0", "gw1"]
+    assert inj.stats()["gw_kill"] == 2
+
+
+def test_chaos_gateway_kill_failed_kill_not_counted():
+    inj = FaultInjector(
+        ChaosConfig(enabled=True, seed=3, gateway_kill_prob=1.0)
+    )
+    inj.set_gateway_kill_targets({"gw0": lambda: False})
+    inj.perturb("addr", "/generate")
+    assert inj.stats()["gw_kill"] == 0
+
+
+def test_chaos_gateway_kill_deterministic_order():
+    def order(seed):
+        seen = []
+        inj = FaultInjector(
+            ChaosConfig(enabled=True, seed=seed, gateway_kill_prob=1.0)
+        )
+        inj.set_gateway_kill_targets(
+            {n: (lambda n=n: seen.append(n) or True) for n in ("a", "b", "c")}
+        )
+        for _ in range(3):
+            inj.perturb("addr", "/x")
+        return seen
+
+    assert order(11) == order(11)
+
+
+# ---------------------------------------------------------------------------
+# threads hygiene: the directory poll loop starts and stops cleanly
+# ---------------------------------------------------------------------------
+
+
+def test_directory_poll_thread_lifecycle():
+    d = ShardDirectory(
+        _tier_cfg(membership_poll_s=0.05),
+        repo=name_resolve.MemoryNameResolveRepo(),
+    )
+    d.publish("gw0", "127.0.0.1:1001")
+    d.start()
+    try:
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline and "gw0" not in d.view():
+            time.sleep(0.02)
+        assert "gw0" in d.view()
+    finally:
+        d.stop()
+    assert not any(
+        t.name == "gateway-tier-directory" and t.is_alive()
+        for t in threading.enumerate()
+    )
+
+
+def test_shard_record_json_roundtrip():
+    rec = ShardRecord(shard_id="gw7", addr="10.1.2.3:8443", state=DRAINING)
+    assert ShardRecord.from_json(rec.to_json()) == rec
+    # missing state defaults to UP (older publishers)
+    assert ShardRecord.from_json('{"shard_id": "a", "addr": "b"}').state == UP
+
+
+def test_controller_start_gateway_publishes_shard_record():
+    """start_gateway with openai.tier.enabled publishes a keepalive shard
+    record into the membership namespace (so sibling controller processes
+    form one ring) and stop_gateway unpublishes it."""
+    from areal_tpu.infra.controller.rollout_controller import RolloutController
+
+    ns = "gateway_tier/test_controller_wire"
+    name_resolve.clear_subtree(ns)
+    ctl = RolloutController(scheduler=None)
+    ctl.proxy_workers = [types.SimpleNamespace(address="127.0.0.1:9")]
+    tcfg = GatewayTierConfig(enabled=True, namespace=ns)
+    ctl._engine_init_config = types.SimpleNamespace(
+        lifecycle=None, openai=types.SimpleNamespace(tier=tcfg)
+    )
+    url = ctl.start_gateway()
+    try:
+        recs = [ShardRecord.from_json(v) for v in name_resolve.get_subtree(ns)]
+        assert len(recs) == 1
+        assert f"http://{recs[0].addr}" == url
+        assert recs[0].shard_id == f"gw-{recs[0].addr}"
+        assert recs[0].state == UP
+        # the controller's own directory sees itself once polled
+        assert ctl._shard_directory is not None
+        assert ctl._shard_directory.refresh()
+        assert set(ctl._shard_directory.view()) == {recs[0].shard_id}
+    finally:
+        ctl.stop_gateway()
+    assert name_resolve.get_subtree(ns) == []
+    assert ctl._shard_directory is None
+
+
+def test_controller_start_gateway_tier_off_stays_plain():
+    """config=None (the scale-out tests' path) and tier.enabled=False both
+    skip the directory entirely — no membership record, no poll thread."""
+    from areal_tpu.infra.controller.rollout_controller import RolloutController
+
+    ctl = RolloutController(scheduler=None)
+    ctl.proxy_workers = [types.SimpleNamespace(address="127.0.0.1:9")]
+    url = ctl.start_gateway()
+    try:
+        assert url.startswith("http://")
+        assert ctl._shard_directory is None
+    finally:
+        ctl.stop_gateway()

@@ -107,6 +107,7 @@ def test_gsm8k_real_checkpoint_reward_moves(tmp_path):
     assert max(rewards) > 0.0, rewards
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_gsm8k_sft_main_smoke(tmp_path, monkeypatch):
     """The SFT example entry (examples/math/gsm8k_sft.py: tokenize rows ->
     SFTTrainer loop) runs a short synthetic leg from scratch and the LM

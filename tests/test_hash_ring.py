@@ -1,0 +1,237 @@
+"""Hash-ring placement properties (routing/hash_ring.py) + the tier-off
+byte-identity guarantee.
+
+The gateway tier's whole coordination story is the ring: clients and
+shards never talk about placement, they just agree on it. These tests
+pin the properties that agreement rests on — determinism, bounded remap
+on membership change, sane degenerate cases — and that a 1-shard tier
+forwards requests byte-identically to the pre-tier single gateway
+(enabling the tier must be a no-op until you actually add shards).
+"""
+
+import asyncio
+
+from areal_tpu.routing.hash_ring import DEFAULT_VNODES, HashRing, stable_hash
+
+KEYS = [f"session-{i}" for i in range(4000)]
+NODES = ["10.0.0.1:9000", "10.0.0.2:9000", "10.0.0.3:9000"]
+
+
+def _placement(ring: HashRing, keys=KEYS) -> dict:
+    return {k: ring.pick(k) for k in keys}
+
+
+# ---------------------------------------------------------------------------
+# determinism
+# ---------------------------------------------------------------------------
+
+
+def test_placement_deterministic_across_instances_and_insert_order():
+    """Two rings built from the same membership agree exactly — including
+    when the nodes were added in a different order (clients discover
+    membership in whatever order etcd returns it)."""
+    a = HashRing(NODES)
+    b = HashRing(reversed(NODES))
+    assert _placement(a) == _placement(b)
+    # and across processes: stable_hash is SHA-1, not hash() — pin one
+    # value so a PYTHONHASHSEED change or interpreter bump can't silently
+    # re-place every session in the fleet
+    assert stable_hash("session-0") == 0xDE04968C601828DE
+
+
+def test_placement_spreads_over_all_nodes():
+    counts = {n: 0 for n in NODES}
+    for owner in _placement(HashRing(NODES)).values():
+        counts[owner] += 1
+    # with 64 vnodes the split is rough but every shard must own a real
+    # slice of the keyspace (a zero here means the ring is broken)
+    for n, c in counts.items():
+        assert c > len(KEYS) * 0.1, (n, counts)
+
+
+# ---------------------------------------------------------------------------
+# bounded remap on membership change
+# ---------------------------------------------------------------------------
+
+
+def test_single_leave_moves_only_the_leavers_keys():
+    ring = HashRing(NODES)
+    before = _placement(ring)
+    victim = NODES[1]
+    ring.remove(victim)
+    after = _placement(ring)
+    for k in KEYS:
+        if before[k] == victim:
+            assert after[k] != victim
+        else:
+            # keys the victim did not own MUST NOT move: survivors keep
+            # their route maps and shadow prefix indexes warm
+            assert after[k] == before[k], k
+    moved = sum(1 for k in KEYS if before[k] != after[k])
+    assert moved <= len(KEYS) / len(NODES) * 1.5  # ~K/N, vnode variance
+
+
+def test_single_join_steals_at_most_k_over_n():
+    ring = HashRing(NODES)
+    before = _placement(ring)
+    newcomer = "10.0.0.4:9000"
+    ring.add(newcomer)
+    after = _placement(ring)
+    moved = [k for k in KEYS if before[k] != after[k]]
+    # every moved key must land on the NEW node — a join never shuffles
+    # keys between incumbents
+    assert all(after[k] == newcomer for k in moved)
+    assert 0 < len(moved) <= len(KEYS) / len(NODES)
+
+
+def test_leave_then_rejoin_restores_exact_placement():
+    ring = HashRing(NODES)
+    before = _placement(ring)
+    ring.remove(NODES[0])
+    ring.add(NODES[0])
+    assert _placement(ring) == before
+
+
+def test_set_nodes_reconciles_to_fresh_ring():
+    ring = HashRing(NODES)
+    target = [NODES[0], "10.0.0.9:9000"]
+    ring.set_nodes(target)
+    assert ring.nodes() == tuple(sorted(target))
+    assert _placement(ring) == _placement(HashRing(target))
+
+
+# ---------------------------------------------------------------------------
+# degenerate cases
+# ---------------------------------------------------------------------------
+
+
+def test_empty_ring_picks_none():
+    ring = HashRing()
+    assert len(ring) == 0
+    assert ring.pick("anything") is None
+    ring.remove("not-there")  # no-op, never raises
+    assert ring.pick("anything", exclude=("ghost",)) is None
+
+
+def test_one_shard_owns_everything():
+    ring = HashRing(["only:1"])
+    assert all(owner == "only:1" for owner in _placement(ring).values())
+    # excluding the only shard leaves nowhere to go
+    assert ring.pick("k", exclude=("only:1",)) is None
+
+
+def test_exclude_walks_to_ring_successor():
+    """pick(key, exclude={owner}) is the failover order: a killed shard's
+    keys land deterministically on live shards, and never on the dead one."""
+    ring = HashRing(NODES)
+    for k in KEYS[:500]:
+        owner = ring.pick(k)
+        fallback = ring.pick(k, exclude=(owner,))
+        assert fallback is not None and fallback != owner
+        # failover agrees with what membership expiry will decide: the
+        # ring without the dead shard places the key on the same survivor
+        survivors = HashRing([n for n in NODES if n != owner])
+        assert survivors.pick(k) == fallback
+    assert ring.pick("k", exclude=tuple(NODES)) is None
+
+
+def test_duplicate_add_is_idempotent():
+    ring = HashRing(NODES)
+    before = _placement(ring)
+    ring.add(NODES[0])
+    assert len(ring) == len(NODES)
+    assert _placement(ring) == before
+
+
+def test_vnode_count_honored():
+    ring = HashRing(["a"], vnodes=7)
+    assert ring.vnodes == 7
+    assert HashRing(["a"]).vnodes == DEFAULT_VNODES
+
+
+# ---------------------------------------------------------------------------
+# tier disabled == pre-PR behavior (byte-identity through one shard)
+# ---------------------------------------------------------------------------
+
+
+def test_one_shard_tier_forwards_byte_identical_to_plain_gateway():
+    """A 1-shard tier is the pre-tier gateway: the same greedy completion
+    through a plain ``GatewayState`` and through the tier's single shard
+    must produce byte-identical response bodies (the tier adds shard
+    headers, never touches the payload)."""
+
+    async def go():
+        from aiohttp import ClientSession, web
+        from aiohttp.test_utils import TestServer
+
+        from areal_tpu.api.config import GatewayTierConfig
+        from areal_tpu.openai.proxy.gateway import (
+            GatewayState,
+            SessionRoute,
+            create_gateway_app,
+        )
+        from areal_tpu.openai.proxy.tier import GatewayTier
+        from areal_tpu.utils import name_resolve
+
+        async def backend_handler(request):
+            body = await request.json()
+            # deterministic "greedy decode": echo a pure function of the
+            # prompt, so identical forwarding => identical bytes
+            prompt = body.get("messages", [{}])[-1].get("content", "")
+            return web.json_response(
+                {"choices": [{"message": {"content": prompt.upper()}}]}
+            )
+
+        backend = web.Application()
+        backend.router.add_post("/v1/chat/completions", backend_handler)
+        backend_srv = TestServer(backend)
+        await backend_srv.start_server()
+        backend_url = f"http://127.0.0.1:{backend_srv.port}"
+
+        plain = GatewayState([backend_url], admin_api_key="adm")
+        plain.routes["key-1"] = SessionRoute(backend=backend_url, session_id="s1")
+        plain_srv = TestServer(create_gateway_app(plain))
+        await plain_srv.start_server()
+
+        tier = GatewayTier(
+            [backend_url],
+            "adm",
+            cfg=GatewayTierConfig(enabled=True, n_shards=1),
+            repo=name_resolve.MemoryNameResolveRepo(),
+        )
+        await tier.astart()
+        try:
+            shard = next(iter(tier.shards.values()))
+            shard.state.routes["key-1"] = SessionRoute(
+                backend=backend_url, session_id="s1"
+            )
+            req = {
+                "messages": [{"role": "user", "content": "greedy prompt"}],
+                "temperature": 0.0,
+            }
+            hdrs = {"Authorization": "Bearer key-1"}
+            async with ClientSession() as http:
+                r1 = await http.post(
+                    f"http://127.0.0.1:{plain_srv.port}/v1/chat/completions",
+                    json=req,
+                    headers=hdrs,
+                )
+                b1 = await r1.read()
+                r2 = await http.post(
+                    f"http://{tier.addresses()[0]}/v1/chat/completions",
+                    json=req,
+                    headers=hdrs,
+                )
+                b2 = await r2.read()
+            assert r1.status == r2.status == 200
+            assert b1 == b2, (b1, b2)
+            # the only visible delta is the shard header the tier stamps
+            from areal_tpu.api import wire
+
+            assert wire.GATEWAY_SHARD_HEADER in r2.headers
+        finally:
+            await tier.astop()
+            await plain_srv.close()
+            await backend_srv.close()
+
+    asyncio.run(go())

@@ -121,6 +121,7 @@ def test_moe_forward_ep_sharded():
     assert np.isfinite(float(aux))
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_moe_train_step():
     """One GRPO-style train step on the MoE model through the engine,
     including the router aux loss."""

@@ -109,6 +109,7 @@ def test_pack_forest_budget_and_coverage():
     assert nodes < total * 0.75
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_tree_outputs_match_per_sequence_forward():
     """The engine's tree outputs (logprobs+entropy, label-aligned [B, T])
     must equal a flat per-sequence forward — the loss zoo then guarantees

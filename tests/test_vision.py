@@ -91,6 +91,7 @@ def _vlm_engine(**kw):
     return eng
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_vlm_train_batch():
     eng = _vlm_engine()
     rng = np.random.default_rng(0)

@@ -354,6 +354,7 @@ def test_mixed_version_tags_through_workflow_executor(fleet, fleet_client):
     )
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest tests ride -m slow (PR 4)
 def test_replica_evicted_mid_stage_excluded_from_commit(fleet, fleet_client):
     """Supervision interplay: a replica that dies mid-stage is dropped from
     THIS update's commit (PR 3's pinned-snapshot rule over the unpaused
