@@ -23,6 +23,7 @@ never starve interactive decode; interactive sheds only at the full cap.
 
 from __future__ import annotations
 
+import asyncio
 import dataclasses
 import random
 import time
@@ -326,18 +327,24 @@ def create_gateway_app(state: GatewayState) -> web.Application:
             headers={wire.GATEWAY_SHARD_HEADER: state.shard_id},
         )
 
-    async def drain(_):
+    async def drain(request: web.Request):
         # the PR 8 surface on the shard: new sessions refuse with 429
         # reason="draining" (clients re-hash via the ring), existing
         # routes keep serving — the autopilot scales the tier with the
-        # same asymmetric policy it uses for replicas
+        # same asymmetric policy it uses for replicas. Admin-gated like
+        # start_session: the gateway is externally reachable, and an
+        # unauthenticated drain would let any client park the tier.
+        if _bearer(request) != state.admin_api_key:
+            raise web.HTTPForbidden(text="admin API key required")
         state.begin_drain()
         return web.json_response(
             {"status": "ok", "draining": True, "sessions": len(state.routes)},
             headers={wire.GATEWAY_SHARD_HEADER: state.shard_id},
         )
 
-    async def undrain(_):
+    async def undrain(request: web.Request):
+        if _bearer(request) != state.admin_api_key:
+            raise web.HTTPForbidden(text="admin API key required")
         state.end_drain()
         return web.json_response(
             {"status": "ok", "draining": False},
@@ -408,17 +415,30 @@ def create_gateway_app(state: GatewayState) -> web.Application:
         died and the client re-hashed here. The backend proxy still owns
         the session, so forwarding the request to each backend finds the
         owner (everyone else answers 410 from their session check without
-        doing any work); the first non-410 adopts the route and the
-        session resumes on this shard."""
+        doing any work); the first success adopts the route and the
+        session resumes on this shard. An error short of success is NOT
+        proof of ownership (a transient 500/429 can come from a backend
+        that never saw the session), so probing continues past it — and
+        past unreachable backends, which matters exactly when part of the
+        fleet is unhealthy; the best error is returned only after every
+        backend has been tried."""
+        last_err = None
         for backend in sorted(
             state.backends, key=lambda b: state.load.get(b, 0)
         ):
-            resp = await _proxy_to(
-                request, key, backend, adopt_probe=True
-            )
+            try:
+                resp = await _proxy_to(
+                    request, key, backend, adopt_probe=True
+                )
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                continue  # backend down: the owner may be a later one
             if resp is None:  # 410 from this backend: not the owner
                 continue
-            return resp
+            if resp.status < 400:
+                return resp
+            last_err = resp
+        if last_err is not None:
+            return last_err
         raise web.HTTPGone(text="unknown session key")
 
     async def _proxy_to(
@@ -428,8 +448,10 @@ def create_gateway_app(state: GatewayState) -> web.Application:
         adopt_probe: bool = False,
     ):
         """Forward the request to ``backend``. With ``adopt_probe`` the
-        410 outcome returns None (caller tries the next backend) and any
-        other outcome first adopts the route."""
+        410 outcome returns None (caller tries the next backend) and only
+        a SUCCESS adopts the route — an errored backend has not proven it
+        owns the session, and pinning the route to it would hand every
+        follow-up request the same error."""
         http = await _client(request.app)
         body = await request.read()
         fwd_headers = {
@@ -450,9 +472,15 @@ def create_gateway_app(state: GatewayState) -> web.Application:
                 if r.status == 410:
                     await r.read()  # drain so the connection is reusable
                     return None
-                state.adopt_route(key, backend)
+                if r.status < 400:
+                    state.adopt_route(key, backend)
             ct = r.headers.get("Content-Type", "")
-            if ct.startswith("text/event-stream"):
+            # an adopt-probe error must come back as a buffered response
+            # (the caller may keep probing) — never a prepared stream,
+            # which is already on the wire and can't be superseded
+            if ct.startswith("text/event-stream") and not (
+                adopt_probe and r.status >= 400
+            ):
                 # SSE passthrough: relay chunks as they arrive so streaming
                 # agents see deltas live instead of one buffered blob
                 out = web.StreamResponse(

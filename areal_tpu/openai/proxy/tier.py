@@ -93,10 +93,11 @@ class ShardDirectory:
         self.cfg = cfg
         self._repo = repo  # None = the process-wide DEFAULT_REPO
         self._lock = threading.Lock()
-        self._view: dict[str, ShardRecord] = {
+        self._static_floor: dict[str, ShardRecord] = {
             f"static{i}": ShardRecord(shard_id=f"static{i}", addr=a)
             for i, a in enumerate(cfg.static_shards)
         }
+        self._view: dict[str, ShardRecord] = dict(self._static_floor)
         self._ring = self._build_ring(self._view)
         self._keepalives: dict[str, name_resolve.KeepaliveThread] = {}
         self._stop = threading.Event()
@@ -137,10 +138,10 @@ class ShardDirectory:
             ka.stop(delete_entry=False)
 
     # -- reader side --------------------------------------------------------
-    @staticmethod
-    def _build_ring(view: dict[str, ShardRecord]) -> HashRing:
+    def _build_ring(self, view: dict[str, ShardRecord]) -> HashRing:
         return HashRing(
             (r.addr for r in view.values() if r.state == UP),
+            vnodes=self.cfg.vnodes,
         )
 
     def refresh(self) -> bool:
@@ -160,6 +161,14 @@ class ShardDirectory:
                 self.stale_reads += 1
             self._obs.membership_stale.inc()
             return False
+        if not any(r.state == UP for r in view.values()):
+            # discovery answered but shows no live shard (reader started
+            # before any shard published, or a namespace mismatch): keep
+            # the static floor underneath rather than replacing it with
+            # an empty ring that fails every pick while static shards
+            # are serving fine. Live records override floor entries the
+            # moment at least one shard is actually observed UP.
+            view = {**self._static_floor, **view}
         ring = self._build_ring(view)
         with self._lock:
             self._view = view

@@ -124,6 +124,35 @@ def test_directory_static_floor_without_discovery():
     assert len(d.view()) == 2
 
 
+def test_directory_empty_view_keeps_static_floor():
+    """A discovery read that answers but shows no live shard (reader
+    started before any publish, or a namespace mismatch) must not wipe
+    the static floor: the statically configured shards ARE serving, and
+    an empty ring would fail every pick. Live records take over once at
+    least one shard is actually observed UP."""
+    repo = name_resolve.MemoryNameResolveRepo()
+    cfg = _tier_cfg(static_shards=["10.0.0.1:9000", "10.0.0.2:9000"])
+    d = ShardDirectory(cfg, repo=repo)
+    try:
+        # namespace is reachable but EMPTY: the floor survives the refresh
+        assert d.refresh() is True
+        assert d.ring().pick("k") in {"10.0.0.1:9000", "10.0.0.2:9000"}
+        assert len(d.view()) == 2
+        # first live record observed: the floor yields to real membership
+        d.publish("gw0", "127.0.0.1:1001")
+        assert d.refresh() is True
+        assert set(d.view()) == {"gw0"}
+        assert d.ring().pick("k") == "127.0.0.1:1001"
+    finally:
+        d.stop()
+
+
+def test_directory_ring_honors_vnodes_config():
+    cfg = _tier_cfg(vnodes=8, static_shards=["10.0.0.1:9000"])
+    d = ShardDirectory(cfg, repo=name_resolve.MemoryNameResolveRepo())
+    assert d.ring().vnodes == 8
+
+
 def test_directory_ignores_foreign_junk_under_namespace():
     repo = name_resolve.MemoryNameResolveRepo()
     d = ShardDirectory(_tier_cfg(), repo=repo)
@@ -290,6 +319,152 @@ def test_route_adoption_probes_backends_and_repairs_affinity():
             await tier.astop()
             await srv_not.close()
             await srv_own.close()
+
+    asyncio.run(go())
+
+
+def test_route_adoption_skips_errors_and_dead_backends_finds_owner():
+    """An errored or unreachable backend has NOT proven it owns the
+    session: the probe must continue past a transient 500 and past a
+    dead listener and adopt only the backend that actually answers —
+    affinity repair has to work exactly when part of the fleet is
+    unhealthy."""
+
+    async def go():
+        import aiohttp
+        from aiohttp import web
+        from aiohttp.test_utils import TestServer
+
+        async def flaky(request):
+            return web.json_response({"error": "transient"}, status=500)
+
+        async def owner(request):
+            return web.json_response({"choices": [{"ok": True}]})
+
+        flaky_app, owner_app = web.Application(), web.Application()
+        flaky_app.router.add_post("/v1/chat/completions", flaky)
+        owner_app.router.add_post("/v1/chat/completions", owner)
+        srv_flaky, srv_owner = TestServer(flaky_app), TestServer(owner_app)
+        await srv_flaky.start_server()
+        await srv_owner.start_server()
+        # probe order is ascending load (all 0: list order) — the dead
+        # listener and the 500 both come before the true owner
+        backends = [
+            "http://127.0.0.1:1",  # nothing listens here
+            f"http://127.0.0.1:{srv_flaky.port}",
+            f"http://127.0.0.1:{srv_owner.port}",
+        ]
+        tier = GatewayTier(
+            backends,
+            "adm",
+            cfg=_tier_cfg(n_shards=1, route_adopt=True),
+            repo=name_resolve.MemoryNameResolveRepo(),
+        )
+        await tier.astart()
+        try:
+            shard = next(iter(tier.shards.values()))
+            async with aiohttp.ClientSession() as http:
+                r = await http.post(
+                    f"http://{tier.addresses()[0]}/v1/chat/completions",
+                    json={},
+                    headers={"Authorization": "Bearer key-err"},
+                )
+                assert r.status == 200
+            # pinned to the OWNER, not the 500-backend probed first
+            assert shard.state.routes["key-err"].backend == backends[2]
+        finally:
+            await tier.astop()
+            await srv_flaky.close()
+            await srv_owner.close()
+
+    asyncio.run(go())
+
+
+def test_route_adoption_error_without_owner_returns_error_unadopted():
+    """When no backend claims the session, the probe returns the error a
+    backend DID produce (better signal than a blanket 410) — but never
+    adopts a route to it: a later request must re-probe, not inherit a
+    pin to a backend that merely errored."""
+
+    async def go():
+        import aiohttp
+        from aiohttp import web
+        from aiohttp.test_utils import TestServer
+
+        async def not_owner(request):
+            return web.json_response({"reason": "unknown session"}, status=410)
+
+        async def flaky(request):
+            return web.json_response({"error": "transient"}, status=500)
+
+        not_app, flaky_app = web.Application(), web.Application()
+        not_app.router.add_post("/v1/chat/completions", not_owner)
+        flaky_app.router.add_post("/v1/chat/completions", flaky)
+        srv_not, srv_flaky = TestServer(not_app), TestServer(flaky_app)
+        await srv_not.start_server()
+        await srv_flaky.start_server()
+        backends = [
+            f"http://127.0.0.1:{srv_not.port}",
+            f"http://127.0.0.1:{srv_flaky.port}",
+        ]
+        tier = GatewayTier(
+            backends,
+            "adm",
+            cfg=_tier_cfg(n_shards=1, route_adopt=True),
+            repo=name_resolve.MemoryNameResolveRepo(),
+        )
+        await tier.astart()
+        try:
+            shard = next(iter(tier.shards.values()))
+            async with aiohttp.ClientSession() as http:
+                r = await http.post(
+                    f"http://{tier.addresses()[0]}/v1/chat/completions",
+                    json={},
+                    headers={"Authorization": "Bearer key-ghost"},
+                )
+                assert r.status == 500
+            assert "key-ghost" not in shard.state.routes
+        finally:
+            await tier.astop()
+            await srv_not.close()
+            await srv_flaky.close()
+
+    asyncio.run(go())
+
+
+def test_shard_drain_endpoints_require_admin_key():
+    """/drain and /undrain are control-plane mutations on an externally
+    reachable listener: they carry the same admin gate as
+    /rl/start_session — an unauthenticated client must not be able to
+    park the tier."""
+
+    async def go():
+        import aiohttp
+
+        tier = GatewayTier(
+            ["http://127.0.0.1:1"],
+            "adm",
+            cfg=_tier_cfg(n_shards=1),
+            repo=name_resolve.MemoryNameResolveRepo(),
+        )
+        await tier.astart()
+        try:
+            addr = tier.addresses()[0]
+            shard = next(iter(tier.shards.values()))
+            async with aiohttp.ClientSession() as http:
+                for hdrs in ({}, {"Authorization": "Bearer wrong"}):
+                    r = await http.post(f"http://{addr}/drain", headers=hdrs)
+                    assert r.status == 403
+                    assert not shard.state.draining
+                admin = {"Authorization": "Bearer adm"}
+                r = await http.post(f"http://{addr}/drain", headers=admin)
+                assert r.status == 200 and shard.state.draining
+                r = await http.post(f"http://{addr}/undrain")
+                assert r.status == 403 and shard.state.draining
+                r = await http.post(f"http://{addr}/undrain", headers=admin)
+                assert r.status == 200 and not shard.state.draining
+        finally:
+            await tier.astop()
 
     asyncio.run(go())
 
