@@ -644,6 +644,59 @@ class AutopilotConfig:
 
 
 @dataclass
+class SpeculativeConfig:
+    """Speculative decoding on the paged engine (docs/serving.md
+    "Speculative decoding"): a host-side drafter proposes up to
+    `spec_depth` continuation tokens per slot, one batched verify forward
+    (models/qwen.py forward_verify_paged) scores the whole draft over the
+    paged KV pool, and the engine accepts the longest prefix whose tokens
+    match what the target sampler would have emitted — greedy outputs are
+    byte-identical to the sequential path by construction. Rejected
+    draft KV never lands in real pages (it routes to the trash page) and
+    surplus speculation pages roll back through the refcounted PagePool,
+    so radix-published pages never contain unverified tokens."""
+
+    enabled: bool = False
+    # "ngram" = prompt-lookup chain drafting (match the slot's recent
+    #           tokens against its own context + the radix prefix tree;
+    #           zero model cost), "tree" = the same sources widened to a
+    #           token tree packed via models/tree.py TreePack with
+    #           ancestor-masked verify
+    drafter: str = "ngram"
+    # max draft tokens per chain per round; the verify forward scores
+    # spec_depth+1 positions (root = the pending token) per slot
+    spec_depth: int = 4
+    # tree drafter only: how many candidate chains are merged into the
+    # token tree (distinct n-gram match sites / radix continuations)
+    tree_width: int = 2
+    # longest n-gram the prompt-lookup matcher tries (it backs off to
+    # shorter suffixes down to 1 token)
+    max_ngram: int = 4
+    # also consult the radix prefix tree for continuations of the slot's
+    # cached prefix (strong on shared-prefix / multi-turn traffic)
+    use_radix: bool = True
+
+    def __post_init__(self):
+        if self.drafter not in ("ngram", "tree"):
+            raise ValueError(
+                f"speculative.drafter must be 'ngram' or 'tree', "
+                f"got {self.drafter!r}"
+            )
+        if self.spec_depth < 1:
+            raise ValueError("speculative.spec_depth must be >= 1")
+        if self.tree_width < 1:
+            raise ValueError("speculative.tree_width must be >= 1")
+        if self.max_ngram < 1:
+            raise ValueError("speculative.max_ngram must be >= 1")
+
+    def max_nodes(self) -> int:
+        """Static verify-forward width B (tree nodes incl. the root /
+        pending token) — one compiled verify variant per (B, window)."""
+        width = self.tree_width if self.drafter == "tree" else 1
+        return 1 + self.spec_depth * width
+
+
+@dataclass
 class InferenceEngineConfig:
     """Client-side rollout controls incl. staleness knobs (reference
     cli_args.py:1591-1612)."""
@@ -719,6 +772,10 @@ class InferenceEngineConfig:
     # the staleness bound, admission gates, cache cap, and fleet size.
     # Off by default — static configs behave exactly as before.
     autopilot: AutopilotConfig = field(default_factory=AutopilotConfig)
+    # client-side view of server speculative decoding (the authoritative
+    # knob lives on ServerConfig.speculative; launchers that build both
+    # sides from one config forward this one)
+    speculative: SpeculativeConfig = field(default_factory=SpeculativeConfig)
 
 
 @dataclass
@@ -780,6 +837,9 @@ class ServerConfig:
     # cross-request radix prefix cache (enable_prefix_caching must also be
     # True; that legacy flag additionally gates GRPO in-batch aliasing)
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
+    # speculative decoding (docs/serving.md): off by default — the engine
+    # is byte-identical to the sequential decode path when disabled
+    speculative: SpeculativeConfig = field(default_factory=SpeculativeConfig)
     # keep aborted requests' KV parked in their slots across weight updates so
     # the client's abort->resubmit loop resumes with zero re-prefill. The
     # retained KV was computed under the previous policy — the same staleness

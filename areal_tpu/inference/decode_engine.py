@@ -286,12 +286,24 @@ class DecodeEngine:
             "deadline_exceeded": 0,
             "cancelled": 0,
             "watchdog_fired": 0,
+            # speculative decoding (docs/serving.md): per-round draft/accept
+            # accounting; acceptance rate = accepted / drafted
+            "spec_rounds": 0,
+            "spec_draft_tokens": 0,
+            "spec_accepted_tokens": 0,
+            "spec_rollback_pages": 0,
         }
         # registry counters mirror the hot stats (thread-sharded: the
         # decode thread increments contention-free; scrapes sum shards)
         self._obs = obs_catalog.engine_metrics()
         self._obs_pc = obs_catalog.prefix_cache_metrics()
         self._obs_lc = obs_catalog.lifecycle_metrics()
+        self._obs_spec = obs_catalog.speculative_metrics()
+        # speculative decoding: non-None only while enabled (the loop's
+        # per-pass mode switch); the drafter is built in initialize() /
+        # set_speculative() so it can see the radix tree
+        self._spec_cfg = None
+        self._drafter = None
         self._radix = None  # cross-request prefix cache; built in initialize
         self._radix_flush_req: tuple[threading.Event, list[int]] | None = None
         # request lifecycle (docs/request_lifecycle.md): rids queued for
@@ -465,6 +477,14 @@ class DecodeEngine:
             model_cfg=self.model_cfg,
             n_chips=int(getattr(self.mesh, "size", 1) or 1),
         )
+        # speculative decoding (getattr: configs serialized before the knob
+        # existed deserialize without it)
+        spec = getattr(cfg, "speculative", None)
+        if spec is not None and spec.enabled:
+            from areal_tpu.inference import speculative as spec_mod
+
+            self._spec_cfg = spec
+            self._drafter = spec_mod.build_drafter(spec, radix=self._radix)
         self.initialized = True
         logger.info(
             f"decode engine ready: {S} slots × {T} ctx, "
@@ -2053,6 +2073,169 @@ class DecodeEngine:
         c = hw.prefill_costs(self.model_cfg, n_tokens)
         return (c["flops"], c["bytes"])
 
+    def _spec_fn(self, B: int, wp: int, capped: bool, greedy_any: bool = True):
+        """One speculative verify+accept round in a single jitted call.
+
+        Row 0 per slot is the pending token, rows 1..B-1 the draft tree
+        nodes. ``forward_verify_paged`` scores all B nodes at once; an
+        unrolled accept walk then re-runs the TARGET sampler position by
+        position and follows the tree edge whose draft token equals the
+        sampled target — so every emitted token is exactly what the
+        sequential path would have produced (greedy byte-identity; sampled
+        slots draw from the true per-position conditional, the token-match
+        form of speculative rejection sampling). KV is scattered
+        row-granularly: only visited (accepted-path) rows land in real
+        pages, everything else routes to trash page 0, so rejected drafts
+        never exist in committed KV and radix publication stays safe.
+
+        ``packed`` has the exact _chunk_fn layout with n_steps = B, so the
+        normal ``_drain`` bookkeeping credits the round unchanged."""
+        key = ("spec", B, wp, capped, greedy_any)
+        if key not in self._fn_cache:
+            from areal_tpu.inference import paged_kv
+
+            mcfg = self.model_cfg
+            T = self.config.max_seq_len
+            psz = self.config.page_size
+            K = B - 1
+
+            def spec(params, cache, page_table, state, rng, drafts):
+                d_tokens = drafts["tokens"]  # [S, K]
+                d_parent = drafts["parent_row"]  # [S, K] row of parent
+                d_depth = drafts["depth"]  # [S, K]
+                d_mask = drafts["mask"]  # [S, B, B]
+                d_count = drafts["n_draft"]  # [S]
+                S = state["ids"].shape[0]
+                pos0 = state["pos"]
+                ids_nodes = jnp.concatenate(
+                    [state["ids"][:, None], d_tokens], axis=1
+                )  # [S, B]
+                depth_full = jnp.concatenate(
+                    [jnp.zeros((S, 1), jnp.int32), d_depth], axis=1
+                )
+                # clamp keeps gather/scatter indices in range for inactive
+                # slots with stale pos; their page-table rows are zeroed so
+                # everything lands in trash anyway
+                positions = jnp.minimum(pos0[:, None] + depth_full, T - 1)
+                hidden, ks, vs = qwen.forward_verify_paged(
+                    params,
+                    mcfg,
+                    ids_nodes,
+                    positions,
+                    d_mask,
+                    cache,
+                    page_table,
+                    pos0,
+                )
+                logits = qwen.compute_logits(params, mcfg, hidden)  # [S,B,V]
+                row_valid = (
+                    jnp.arange(1, B, dtype=jnp.int32)[None, :]
+                    <= d_count[:, None]
+                )  # [S, K]
+                cur = jnp.zeros((S,), jnp.int32)  # row the walk is at
+                cont = state["active"]  # still emitting THIS round
+                alive = state["active"]  # slot lives past the round
+                pos_c = pos0
+                rem_c = state["remaining"]
+                ids_c = state["ids"]
+                # rows whose KV becomes committed context = rows the walk
+                # visits (root + accepted path); matches the sequential
+                # path's write set exactly
+                row_ok = jnp.zeros((S, B), bool).at[:, 0].set(True)
+                toks_rows, logp_rows, emit_rows = [], [], []
+                for j in range(B):
+                    lg = jnp.take_along_axis(
+                        logits, cur[:, None, None], axis=1
+                    )[:, 0]  # [S, V]
+                    rng, sub = jax.random.split(rng)
+                    t_j, logp_j = _sample_step(
+                        lg, sub, state, capped, greedy_any
+                    )
+                    emit_rows.append(cont)
+                    toks_rows.append(t_j)
+                    logp_rows.append(logp_j)
+                    # exact _chunk_fn stop/budget semantics per emitted step
+                    hit_stop = jnp.any(
+                        t_j[:, None] == state["stop_ids"], axis=-1
+                    ) & (rem_c - 1 <= state["min_rem"])
+                    new_pos = pos_c + cont.astype(jnp.int32)
+                    rem_c = rem_c - cont.astype(jnp.int32)
+                    step_alive = (
+                        cont & ~hit_stop & (rem_c > 0) & (new_pos < T - 1)
+                    )
+                    alive = jnp.where(cont, step_alive, alive)
+                    ids_c = jnp.where(cont, t_j, ids_c)
+                    pos_c = new_pos
+                    if j < K:
+                        # follow the tree edge matching the target token
+                        match = (
+                            (d_parent == cur[:, None])
+                            & (d_tokens == t_j[:, None])
+                            & row_valid
+                        )  # [S, K] over rows 1..K
+                        has = match.any(axis=1)
+                        child = jnp.argmax(match, axis=1).astype(jnp.int32) + 1
+                        cont = step_alive & has
+                        cur = jnp.where(cont, child, cur)
+                        row_ok = row_ok | (
+                            (jnp.arange(B)[None, :] == child[:, None])
+                            & cont[:, None]
+                        )
+                out_state = dict(state)
+                out_state.update(
+                    ids=ids_c, pos=pos_c, active=alive, remaining=rem_c
+                )
+                # selective KV commit: visited rows -> their real page rows,
+                # everything else -> trash page 0
+                page_idx = jnp.clip(positions // psz, 0, wp - 1)
+                pages = jnp.take_along_axis(page_table, page_idx, axis=1)
+                pages = jnp.where(row_ok, pages, 0)
+                rows = positions % psz
+                L = ks.shape[0]
+                KH, hd = ks.shape[3], ks.shape[4]
+                cache = paged_kv.scatter_token_rows(
+                    cache,
+                    ks.reshape(L, S * B, KH, hd),
+                    vs.reshape(L, S * B, KH, hd),
+                    pages.reshape(-1),
+                    rows.reshape(-1),
+                )
+                packed = jnp.concatenate(
+                    [
+                        jnp.stack(toks_rows).astype(jnp.int32),  # [B, S]
+                        jax.lax.bitcast_convert_type(
+                            jnp.stack(logp_rows).astype(jnp.float32),
+                            jnp.int32,
+                        ),  # [B, S]
+                        jnp.stack(emit_rows).sum(0, dtype=jnp.int32)[None],
+                        alive.astype(jnp.int32)[None],
+                        pos_c.astype(jnp.int32)[None],
+                    ],
+                    axis=0,
+                )
+                return cache, out_state, rng, packed
+
+            self._fn_cache[key] = kernel_probe.ProbedFn(
+                jax.jit(spec, donate_argnames=("cache", "state")),
+                self.kprobe,
+                key,
+                analytic=self._analytic_spec_cost(B),
+            )
+        return self._fn_cache[key]
+
+    def _analytic_spec_cost(self, B: int) -> tuple[float, float] | None:
+        """Verify forward ~ one decode step with B tokens per slot: B x the
+        activation FLOPs, ~1x the weight HBM read (the speculative win)."""
+        if self.model_cfg is None:
+            return None
+        c = hw.decode_step_costs(
+            self.model_cfg,
+            1,
+            self.config.max_batch_size * B,
+            self.config.max_seq_len / 2.0,
+        )
+        return (c["flops"], c["bytes"])
+
     def _update_fn(self, n: int):
         """Jitted slot-state scatter: one packed fp32 [n, 11+_MAX_STOP] upload
         (columns: slot, ids, pos, active, remaining, top_k, greedy, temp,
@@ -2867,23 +3050,27 @@ class DecodeEngine:
             ]
             self._apply_slot_updates(rows)
 
-    def _ensure_pages(self) -> None:
+    def _ensure_pages(self, ahead: int | None = None) -> None:
         """Allocation-ahead: every active slot gets pages covering
-        ``pos + 2*n_steps`` writes (host pos can be one in-flight chunk
-        stale). On pool exhaustion, evict parked KV first, then preempt the
-        active slots with the most remaining budget (they abort with their
-        partial tokens; the client's retry loop re-submits them — the same
-        backpressure role SGLang's RETRACT_DECODE preemption plays)."""
+        ``pos + ahead`` writes — by default ``2*n_steps`` (host pos can be
+        one in-flight chunk stale); speculative rounds pass their exact
+        synchronous coverage instead. On pool exhaustion, evict parked KV
+        first, then preempt the active slots with the most remaining budget
+        (they abort with their partial tokens; the client's retry loop
+        re-submits them — the same backpressure role SGLang's
+        RETRACT_DECODE preemption plays)."""
         st = self._state
         psz = self.config.page_size
         n_steps = self.config.decode_steps_per_call
+        if ahead is None:
+            ahead = 2 * n_steps
         deact_rows: list[np.ndarray] = []
         clamp_rows: list[tuple[int, int]] = []  # (slot, remaining cap)
         for slot in np.nonzero(st["active"])[0]:
             if not st["active"][slot]:  # preempted by an earlier iteration
                 continue
             need = min(
-                self._maxp, -(-(int(st["pos"][slot]) + 2 * n_steps + 1) // psz)
+                self._maxp, -(-(int(st["pos"][slot]) + ahead + 1) // psz)
             )
             pages = self._slot_pages[slot]
             while len(pages) < need:
@@ -3044,6 +3231,164 @@ class DecodeEngine:
             # task, and the new one must not be touched
             "tasks": list(self._slot_task),
         }
+
+    def set_speculative(self, enabled: bool) -> None:
+        """Runtime toggle for speculative decoding (bench A/B without an
+        engine rebuild); applies from the next loop pass. Safe from any
+        thread: the loop reads ``_spec_cfg`` once per pass and a spec pass
+        always drains the pipelined chunk before its own round."""
+        from areal_tpu.api.config import SpeculativeConfig
+
+        spec = getattr(self.config, "speculative", None)
+        if spec is None:
+            spec = SpeculativeConfig()
+            self.config.speculative = spec
+        spec.enabled = bool(enabled)
+        if enabled:
+            from areal_tpu.inference import speculative as spec_mod
+
+            self._drafter = spec_mod.build_drafter(spec, radix=self._radix)
+            self._spec_cfg = spec
+        else:
+            self._spec_cfg = None
+            self._drafter = None
+        self._wakeup.set()
+
+    def _spec_round(self) -> tuple[int, tuple | None]:
+        """One SYNCHRONOUS speculative round: host drafter proposes, one
+        jitted verify+accept call scores and commits, the packed result
+        drains through the normal bookkeeping, then over-allocated pages
+        roll back through the pool. Synchronous because the accept decision
+        gates the next round's drafts — the pipelined-chunk overlap trick
+        cannot apply; the round itself must beat ``accepted+1`` sequential
+        steps to win. Returns (credited tokens, the round's cost key)."""
+        cfg = self.config
+        spec = self._spec_cfg
+        st = self._state
+        if not st["active"].any():
+            return 0, None
+        psz = cfg.page_size
+        T = cfg.max_seq_len
+        B = spec.max_nodes()
+        K = B - 1
+        # exact coverage for this round's writes (rows pos..pos+K) plus the
+        # next pending row; host pos is authoritative here (no in-flight
+        # chunk), unlike the pipelined path's 2-chunk slack
+        self._ensure_pages(ahead=B)
+        active = st["active"]
+        if not active.any():
+            return 0, None
+        with self._kphase("draft"):
+            from areal_tpu.inference import speculative as spec_mod
+
+            contexts: dict[int, list[int]] = {}
+            for slot in np.nonzero(active)[0]:
+                task = self._slot_task[slot]
+                if task is None:
+                    continue
+                # context ends with the pending token (st["ids"][slot]):
+                # drafts propose what FOLLOWS it
+                contexts[int(slot)] = task.req.input_ids + task.out_tokens
+            bundle = spec_mod.draft_batch(self._drafter, contexts, len(st["active"]), K)
+            for slot in contexts:
+                task = self._slot_task[slot]
+                nd = int(bundle.n_draft[slot])
+                if nd and task is not None and task.timeline is not None:
+                    task.timeline.mark(
+                        tl_mod.DRAFT, n_draft=nd, source=bundle.sources[slot]
+                    )
+        max_pos = int(st["pos"][active].max())
+        window = min(
+            T, round_up_to_bucket(max_pos + 1 + B, cfg.attn_window_step)
+        )
+        wp = min(self._maxp, -(-window // psz))
+        capped = bool(((st["top_k"] > 0) | (st["top_p"] < 1.0))[active].any())
+        greedy_any = bool(st["greedy"][active].any())
+        key = ("spec", B, wp, capped, greedy_any)
+        fn = self._spec_fn(B, wp, capped, greedy_any)
+        with self._kphase("dispatch"):
+            with set_mesh(self.mesh):
+                pt = jnp.asarray(self._pt_host[:, :wp])
+                drafts = {
+                    "tokens": jnp.asarray(bundle.tokens),
+                    "parent_row": jnp.asarray(bundle.parent_row),
+                    "depth": jnp.asarray(bundle.depth),
+                    "mask": jnp.asarray(bundle.mask),
+                    "n_draft": jnp.asarray(bundle.n_draft),
+                }
+                self.cache, self._dev_state, self._rng, packed = fn(
+                    self.params, self.cache, pt, self._dev_state, self._rng,
+                    drafts,
+                )
+        with self._kphase("verify"):
+            # arealint: disable-next=PRF002 designed synchronous round: the spec path has no pipelined successor to overlap with, so this blocking pull IS the verify forward's device time (the spec twin of device_wait) and is what the "verify" kphase measures
+            packed_np = np.asarray(packed)
+        pending = {
+            "packed": packed_np,
+            "n_steps": B,
+            "key": key,
+            "version": self._version,
+            "was_active": active.copy(),
+            "tasks": list(self._slot_task),
+        }
+        # acceptance accounting BEFORE _drain (it mutates slot ownership)
+        emit_count = packed_np[2 * B]
+        n_draft_total = int(bundle.n_draft.sum())
+        n_accepted = 0
+        source_tokens: dict[str, int] = {}
+        for slot, task in enumerate(pending["tasks"]):
+            if task is None or not active[slot]:
+                continue
+            if task is not self._slot_task[slot]:
+                continue
+            nd = int(bundle.n_draft[slot])
+            acc = max(0, int(emit_count[slot]) - 1)
+            if nd:
+                n_accepted += acc
+                src = bundle.sources[slot]
+                source_tokens[src] = source_tokens.get(src, 0) + nd
+                self._obs_spec.accepted_length.observe(acc)
+                if task.timeline is not None:
+                    task.timeline.mark(tl_mod.VERIFY, n_accepted=acc)
+        self.stats["spec_rounds"] += 1
+        self.stats["spec_draft_tokens"] += n_draft_total
+        self.stats["spec_accepted_tokens"] += n_accepted
+        self._obs_spec.rounds.inc()
+        self._obs_spec.accepted_tokens.inc(n_accepted)
+        for src, n in source_tokens.items():
+            self._obs_spec.draft_tokens.labels(source=src).inc(n)
+        credited = self._drain(pending)
+        rolled = self._rollback_spec_pages()
+        if rolled:
+            self.stats["spec_rollback_pages"] += rolled
+            self._obs_spec.rollback_pages.inc(rolled)
+        return credited, key
+
+    def _rollback_spec_pages(self) -> int:
+        """Free speculation-allocated pages beyond each live slot's
+        COMMITTED coverage (rows 0..pos hold written KV plus the pending
+        token's row). Rejected drafts never wrote into these pages (the
+        verify scatter routes non-accepted rows to trash), so this is the
+        allocator-level rollback: after every round a slot owns exactly the
+        pages its accepted tokens justify, and the pool audit
+        (free + held + radix == total) holds mid-generation."""
+        st = self._state
+        psz = self.config.page_size
+        freed = 0
+        for slot in np.nonzero(st["active"])[0]:
+            if self._slot_task[slot] is None:
+                continue
+            need = -(-(int(st["pos"][slot]) + 1) // psz)
+            pages = self._slot_pages[slot]
+            if len(pages) <= need:
+                continue
+            tail = pages[need:]
+            self.pool.free(tail)
+            self._slot_pages[slot] = pages[:need]
+            del self._slot_page_versions[slot][need:]
+            self._pt_host[slot, need : need + len(tail)] = 0
+            freed += len(tail)
+        return freed
 
     def _drain(self, pending: dict | None) -> int:
         """Download one chunk's packed emissions (a single transfer) and
@@ -3255,6 +3600,40 @@ class DecodeEngine:
                 # previously-active slots, so there is no dataflow hazard
                 rows = self._admit_pending()
                 self._apply_slot_updates(rows)
+            spec_on = self._spec_cfg is not None and self._drafter is not None
+            if spec_on and self._freq_enabled:
+                st = self._state
+                # the in-round count updates the freq penalty needs are
+                # incompatible with parallel verify scoring — fall back to
+                # the sequential chunk path while any active slot uses it
+                spec_on = not bool((st["freq_pen"] != 0.0)[st["active"]].any())
+            if spec_on:
+                # SYNCHRONOUS speculative pass: drain the pipelined chunk
+                # first (covers the spec-off -> spec-on transition), then
+                # draft + verify + accept in one round. A weight commit
+                # always applies at the top of the pass, so draft and
+                # verify run under ONE version — a commit landing "between
+                # draft and verify" is impossible by construction, and
+                # drafts are version-free host proposals anyway.
+                drained_key = pending["key"] if pending is not None else None
+                n_pipe = self._drain(pending)
+                pending = None
+                n_spec, spec_key = self._spec_round()
+                if step_tl is not None:
+                    if drained_key is not None or spec_key is not None or rows:
+                        self._ktl = None
+                        self.kprobe.complete_step(
+                            step_tl,
+                            tokens=n_pipe + n_spec,
+                            cost_key=spec_key or drained_key,
+                        )
+                    else:
+                        self._abandon_kstep()
+                if spec_key is None:
+                    if not any(t is not None for t in self._slot_task):
+                        self._wakeup.wait(timeout=0.05)
+                        self._wakeup.clear()
+                continue
             # speculatively dispatch the next chunk, then pay the previous
             # chunk's download while this one computes
             with self._kphase("dispatch"):

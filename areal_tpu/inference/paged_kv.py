@@ -197,6 +197,37 @@ class RadixPrefixCache:
             node = child
         return pages, versions
 
+    def lookup_extension(self, ids, k: int) -> list[int]:
+        """Draft continuation tokens for ``ids`` from the tree (speculative
+        decoding's radix prompt-lookup source): walk the cached full pages
+        of ``ids``, then follow children whose keys continue the
+        partial-page tail and return up to ``k`` cached tokens beyond
+        ``len(ids)``. Read-only — no pool refs, no LRU touch; the result
+        is a draft PROPOSAL the verify forward scores before anything is
+        emitted, so a stale or mid-eviction answer only lowers acceptance,
+        never correctness."""
+        psz = self.page_size
+        node = self.root
+        for i in range(len(ids) // psz):
+            child = node.children.get(tuple(ids[i * psz : (i + 1) * psz]))
+            if child is None:
+                return []
+            node = child
+        tail = tuple(ids[(len(ids) // psz) * psz :])
+        out: list[int] = []
+        while len(out) < k:
+            step = None
+            for key, child in node.children.items():
+                if key[: len(tail)] == tail:
+                    step = (key, child)
+                    break
+            if step is None:
+                break
+            key, node = step
+            out.extend(key[len(tail) :])
+            tail = ()
+        return out[:k]
+
     def insert(self, ids, pages, versions) -> int:
         """Publish full prompt pages: one node per page of ``ids``
         (``len(pages)`` pages; ids beyond ``len(pages) * page_size`` are
@@ -395,6 +426,38 @@ def scatter_prefill(cache: dict, ks: jax.Array, vs: jax.Array, flat_pages: jax.A
             cache[f"{name}_scale"] = cache[f"{name}_scale"].at[:, :, flat_pages].set(s)
         else:
             cache[name] = cache[name].at[:, :, flat_pages].set(
+                r.astype(cache[name].dtype)
+            )
+    return cache
+
+
+def scatter_token_rows(
+    cache: dict,
+    ks: jax.Array,
+    vs: jax.Array,
+    flat_pages: jax.Array,
+    flat_rows: jax.Array,
+) -> dict:
+    """Row-granular KV write: token n lands at cache[.., flat_pages[n],
+    flat_rows[n]]. scatter_prefill writes whole pages; speculative verify
+    needs per-row routing because only the ACCEPTED tree path may land in
+    real pages — rejected/off-path rows are steered to trash page 0 by the
+    caller (duplicate trash writes are benign, exactly like prefill
+    padding).
+
+    ks/vs: [n_layers, N, KH, hd] — one flattened row per verify-tree node.
+    """
+    quant = "k_scale" in cache
+    for name, new in (("k", ks), ("v", vs)):
+        r = jnp.transpose(new, (0, 2, 1, 3))  # [L, KH, N, hd]
+        if quant:
+            q, s = quantize_kv(r)
+            cache[name] = cache[name].at[:, :, flat_pages, flat_rows].set(q)
+            cache[f"{name}_scale"] = (
+                cache[f"{name}_scale"].at[:, :, flat_pages, flat_rows].set(s)
+            )
+        else:
+            cache[name] = cache[name].at[:, :, flat_pages, flat_rows].set(
                 r.astype(cache[name].dtype)
             )
     return cache

@@ -1,0 +1,161 @@
+"""Host-side draft proposal for speculative decoding (docs/serving.md
+"Speculative decoding").
+
+The engine's verify forward (qwen.forward_verify_paged) scores a token
+TREE per slot in one pass; this module builds those trees on the host with
+zero model cost. Two drafters ship:
+
+- ``NgramDrafter`` — prompt-lookup chain drafting (the Leviathan-style
+  draft model replaced by the sequence's own statistics): the longest
+  n-gram suffix of the slot's context (prompt + generated tokens; the
+  pending token is always context[-1]) is matched against earlier
+  occurrences in the same context, and the tokens that followed the match
+  are proposed as the continuation. Optionally the radix prefix tree
+  (paged_kv.RadixPrefixCache.lookup_extension) is consulted — on
+  shared-prefix / multi-turn traffic another request may have already
+  decoded this exact continuation.
+- ``TreeDrafter`` — the same sources widened to a token tree: up to
+  ``tree_width`` candidate chains from DISTINCT match sites are merged
+  via models/tree.py build_tree (one node per unique prefix+token), so
+  the verify forward scores several futures at once under an
+  ancestor mask (TreePack.ancestor_mask(); the packed-bitmask Pallas
+  kernel of ops/tree_attention.py is the TPU upgrade path).
+
+Drafts are PROPOSALS: a wrong draft costs acceptance, never correctness —
+the verify/accept walk in the engine only ever emits tokens the target
+sampler itself produced.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from areal_tpu.models.tree import build_tree
+
+
+@dataclasses.dataclass
+class DraftBundle:
+    """Fixed-shape per-round draft arrays for the verify jit.
+
+    Row 0 of the verify tree is always the slot's pending token; draft
+    node j occupies row j+1. ``parent_row`` holds ROW indices (0 = the
+    pending-token root), topological (parent row < child row)."""
+
+    tokens: np.ndarray  # [S, K] int32 draft node tokens
+    parent_row: np.ndarray  # [S, K] int32 parent row in [0, K]
+    depth: np.ndarray  # [S, K] int32 node depth (root = 0, drafts >= 1)
+    mask: np.ndarray  # [S, K+1, K+1] bool ancestor-or-self (incl. root)
+    n_draft: np.ndarray  # [S] int32 valid draft nodes (0 = none)
+    sources: list[str]  # per-slot draft provenance ("ngram"|"radix"|"none")
+
+
+def empty_bundle(S: int, K: int) -> DraftBundle:
+    B = K + 1
+    mask = np.zeros((S, B, B), bool)
+    mask[:, np.arange(B), np.arange(B)] = True
+    mask[:, :, 0] = True  # every node sees the root / pending token
+    return DraftBundle(
+        tokens=np.zeros((S, K), np.int32),
+        parent_row=np.zeros((S, K), np.int32),
+        depth=np.ones((S, K), np.int32),
+        mask=mask,
+        n_draft=np.zeros(S, np.int32),
+        sources=["none"] * S,
+    )
+
+
+def _ngram_continuations(
+    ctx: list[int], max_ngram: int, depth: int, max_sites: int
+) -> list[list[int]]:
+    """Continuations that followed earlier occurrences of the context's
+    suffix n-gram, longest-n first, rightmost (most recent) site first.
+    Sites are deduped by end offset so a shorter n never re-proposes the
+    continuation a longer match at the same spot already did."""
+    n_ctx = len(ctx)
+    out: list[list[int]] = []
+    seen_ends: set[int] = set()
+    for n in range(min(max_ngram, n_ctx - 1), 0, -1):
+        pattern = ctx[-n:]
+        for i in range(n_ctx - n - 1, -1, -1):
+            if ctx[i : i + n] != pattern:
+                continue
+            end = i + n
+            if end in seen_ends:
+                continue
+            cont = ctx[end : end + depth]
+            if not cont:
+                continue
+            seen_ends.add(end)
+            out.append(cont)
+            if len(out) >= max_sites:
+                return out
+        if out:
+            # a longer n-gram matched; shorter suffixes only add weaker
+            # evidence from sites the longer match already covers
+            break
+    return out
+
+
+class NgramDrafter:
+    """Prompt-lookup chain drafting: one chain per slot per round."""
+
+    def __init__(self, spec_cfg, radix=None):
+        self.cfg = spec_cfg
+        self.radix = radix if spec_cfg.use_radix else None
+
+    def _width(self) -> int:
+        return 1
+
+    def propose(self, ctx: list[int]) -> tuple[list[list[int]], str]:
+        """(candidate chains, provenance label) for one slot's context.
+        ``ctx`` ends with the pending token; chains continue it."""
+        chains = _ngram_continuations(
+            ctx, self.cfg.max_ngram, self.cfg.spec_depth, self._width()
+        )
+        source = "ngram" if chains else "none"
+        if self.radix is not None and len(chains) < self._width():
+            ext = self.radix.lookup_extension(ctx, self.cfg.spec_depth)
+            if ext and ext not in chains:
+                chains.append(ext)
+                if source == "none":
+                    source = "radix"
+        return chains, source
+
+
+class TreeDrafter(NgramDrafter):
+    """Widens prompt-lookup to ``tree_width`` chains merged into a trie."""
+
+    def _width(self) -> int:
+        return self.cfg.tree_width
+
+
+def build_drafter(spec_cfg, radix=None):
+    cls = TreeDrafter if spec_cfg.drafter == "tree" else NgramDrafter
+    return cls(spec_cfg, radix=radix)
+
+
+def draft_batch(
+    drafter, contexts: dict[int, list[int]], S: int, K: int
+) -> DraftBundle:
+    """One round's DraftBundle: propose per active slot, merge each slot's
+    chains into a trie, and pack rows 1..K (row 0 = pending token).
+    build_tree's insertion order guarantees parent-before-child, so
+    truncating to K nodes never orphans a packed row."""
+    bundle = empty_bundle(S, K)
+    for slot, ctx in contexts.items():
+        chains, source = drafter.propose(ctx)
+        bundle.sources[slot] = source
+        if not chains:
+            continue
+        pack = build_tree([c[:K] for c in chains])
+        n = min(pack.n_nodes, K)
+        bundle.tokens[slot, :n] = pack.tokens[:n]
+        # pack parent -1 (root) -> row 0; node p -> row p+1
+        bundle.parent_row[slot, :n] = pack.parent[:n] + 1
+        bundle.depth[slot, :n] = pack.depth[:n] + 1
+        am = pack.ancestor_mask()[:n, :n]
+        bundle.mask[slot, 1 : n + 1, 1 : n + 1] = am
+        bundle.n_draft[slot] = n
+    return bundle

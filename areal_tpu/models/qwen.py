@@ -1037,6 +1037,97 @@ def forward_prefill_paged(
     return hidden, ks, vs
 
 
+def forward_verify_paged(
+    params: dict,
+    cfg: ModelConfig,
+    input_ids: jax.Array,  # [S, B] pending token (root) + draft tree nodes
+    positions: jax.Array,  # [S, B] ABSOLUTE rope positions (root pos + depth)
+    tree_mask: jax.Array,  # [S, B, B] bool: node row attends node col
+    cache: dict,  # k/v [n_layers, KH, n_pages, psz, hd] (+ scales under int8)
+    page_table: jax.Array,  # [S, wp] int32 pages holding the cached context
+    prefix_lens: jax.Array,  # [S] int32 tokens already in pages (= root pos)
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Speculative-verify forward: score every slot's draft token tree in
+    ONE pass over the paged KV pool — the step that used to produce one
+    token per slot produces logits for B tree nodes per slot.
+
+    Structurally ``forward_prefill_paged`` with two twists: the in-flight
+    suffix mask is the draft tree's ancestor-or-self mask (a chain draft
+    degenerates to plain causal), and ``prefix_lens`` is the slot's live
+    decode position rather than a page-aligned radix prefix. Returns
+    (hidden [S, B, D], ks, vs [L, S, B, KH, hd]) — KV is NOT written here;
+    the caller routes only accepted-path rows into real pages
+    (paged_kv.scatter_token_rows) so rejected drafts never land.
+    """
+    x = _embed_lookup(params["embed"], input_ids, cfg.jax_dtype, batch_sharded=False)
+    H, KH, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    G = H // KH
+    S, B = input_ids.shape
+    wp = page_table.shape[1]
+    psz = cache["k"].shape[3]
+    W = wp * psz
+    kv_quant = "k_scale" in cache
+    # every node attends the whole committed context; tree structure only
+    # constrains attention among the in-flight nodes themselves
+    pre_valid = jnp.broadcast_to(
+        (jnp.arange(W)[None, :] < prefix_lens[:, None])[:, None, None, :],
+        (S, 1, B, W),
+    )
+    suf_mask = tree_mask[:, None]  # [S, 1, B, B]
+
+    def gather(name, li):
+        lay = jax.lax.dynamic_index_in_dim(cache[name], li, 0, keepdims=False)
+        # [KH, S, wp, psz, d] -> [S, W, KH, d]
+        g = jnp.transpose(lay[:, page_table], (1, 2, 3, 0, 4))
+        return g.reshape(S, W, KH, g.shape[-1])
+
+    def body(x, scanned):
+        layer, li = scanned
+        h = _rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+        q = _proj(cfg, layer, "wq", h)
+        k = _proj(cfg, layer, "wk", h)
+        v = _proj(cfg, layer, "wv", h)
+        if cfg.attention_bias:
+            q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+        q = q.reshape(S, B, H, hd)
+        k = k.reshape(S, B, KH, hd)
+        v = v.reshape(S, B, KH, hd)
+        if cfg.qk_norm:
+            q = _rms_norm(q, layer["q_norm"], cfg.rms_norm_eps)
+            k = _rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        k_cache, v_cache = k, v
+        kp = gather("k", li)  # [S, W, KH, hd]
+        vp = gather("v", li)
+        if kv_quant:
+            from areal_tpu.inference.paged_kv import dequantize_kv
+
+            kp = dequantize_kv(kp, gather("k_scale", li), q.dtype)
+            vp = dequantize_kv(vp, gather("v_scale", li), q.dtype)
+        if KH != H:
+            kp = jnp.repeat(kp, G, axis=2)
+            vp = jnp.repeat(vp, G, axis=2)
+            k_r = jnp.repeat(k, G, axis=2)
+            v_r = jnp.repeat(v, G, axis=2)
+        else:
+            k_r, v_r = k, v
+        k_full = jnp.concatenate([kp, k_r], axis=1)  # [S, W + B, H, hd]
+        v_full = jnp.concatenate([vp, v_r], axis=1)
+        mask = jnp.concatenate([pre_valid, suf_mask], axis=-1)  # [S,1,B,W+B]
+        attn = _sdpa(q, k_full, v_full, mask, hd).reshape(S, B, H * hd)
+        x = x + _proj(cfg, layer, "wo", attn)
+        h = _rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + _ffn(cfg, h, layer)
+        return x, (k_cache, v_cache)
+
+    x, (ks, vs) = jax.lax.scan(
+        body, x, (params["layers"], jnp.arange(cfg.num_layers))
+    )
+    hidden = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return hidden, ks, vs
+
+
 def forward_decode_paged(
     params: dict,
     cfg: ModelConfig,

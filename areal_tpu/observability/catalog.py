@@ -823,8 +823,9 @@ def kernel_metrics(reg: Registry | None = None) -> SimpleNamespace:
         phase_seconds=r.histogram(
             "areal_decode_phase_seconds",
             "Per-decode-step host wall seconds by loop phase (admission, "
-            "radix_match, prefill, dispatch, device_wait, bookkeeping, "
-            "other); named phases + other sum exactly to the step wall.",
+            "radix_match, prefill, draft, dispatch, device_wait, verify, "
+            "bookkeeping, other); named phases + other sum exactly to the "
+            "step wall.",
             label_names=("phase",),
             buckets=FAST_BUCKETS,
         ),
@@ -837,6 +838,45 @@ def kernel_metrics(reg: Registry | None = None) -> SimpleNamespace:
             "areal_decode_roofline_fraction",
             "Achieved over attainable FLOP/s of the last completed decode "
             "step: attainable = min(peak FLOPs, intensity x peak HBM bw).",
+        ),
+    )
+
+
+def speculative_metrics(reg: Registry | None = None) -> SimpleNamespace:
+    """Speculative decoding (docs/serving.md "Speculative decoding"):
+    draft/verify/accept accounting. Acceptance rate =
+    accepted_tokens / draft_tokens; each verify round also emits one base
+    token that is never at risk, so round throughput is
+    (accepted_length + 1) tokens per forward."""
+    r = reg or get_registry()
+    return SimpleNamespace(
+        rounds=r.counter(
+            "areal_spec_rounds_total",
+            "Speculative draft+verify rounds executed by the decode loop.",
+        ),
+        draft_tokens=r.counter(
+            "areal_spec_draft_tokens_total",
+            "Draft tree tokens proposed to the verify forward, by drafter "
+            "source (prompt n-gram lookup vs radix prefix tree).",
+            label_names=("source",),
+        ),
+        accepted_tokens=r.counter(
+            "areal_spec_accepted_tokens_total",
+            "Draft tokens accepted by the target sampler (tokens emitted "
+            "beyond each round's base token).",
+        ),
+        accepted_length=r.histogram(
+            "areal_spec_accepted_length",
+            "Accepted draft length per slot-round (0 = all drafts "
+            "rejected; the base token still emits).",
+            buckets=LAG_BUCKETS,
+        ),
+        rollback_pages=r.counter(
+            "areal_spec_rollback_pages_total",
+            "KV pages rolled back through the refcounted pool after "
+            "partial acceptance (speculative over-allocation freed; "
+            "rejected-draft KV itself never lands — it routes to the "
+            "trash page).",
         ),
     )
 
@@ -907,6 +947,7 @@ ALL_FACTORIES = (
     autopilot_metrics,
     aggregator_metrics,
     gateway_tier_metrics,
+    speculative_metrics,
 )
 
 

@@ -64,8 +64,10 @@ DECODE_PHASES = (
     "admission",
     "radix_match",
     "prefill",
+    "draft",
     "dispatch",
     "device_wait",
+    "verify",
     "bookkeeping",
 )
 

@@ -62,6 +62,8 @@ PREFILL_START = "prefill_start"
 PREFILL_END = "prefill_end"
 FIRST_TOKEN = "first_token"
 DECODE_CHUNK = "decode_chunk"
+DRAFT = "draft"  # speculative round: drafter proposed tokens for this slot
+VERIFY = "verify"  # speculative round: verify forward scored + accepted
 PARK = "park"
 RESUME = "resume"
 FENCE_STALL = "fence_stall"
@@ -458,7 +460,15 @@ def timelines_to_trace_events(
                     }
                 )
         for e in events:
-            if e["stage"] in (RADIX_MATCH, PARK, RESUME, FENCE_STALL, TERMINAL):
+            if e["stage"] in (
+                RADIX_MATCH,
+                DRAFT,
+                VERIFY,
+                PARK,
+                RESUME,
+                FENCE_STALL,
+                TERMINAL,
+            ):
                 out.append(
                     {
                         "name": e["stage"],

@@ -13,6 +13,7 @@ benchmarks/):
     suffix_prefill       radix-suffix prefill over a cached prefix
     int8_kv_dequant      KV quantize->dequantize round trip
     tree_verify_forward  ancestor-masked forest forward (no_grad)
+    spec_decode_step     oracle-draft speculative verify + accept walk
     radix_match          host-side radix prefix walk (no device work)
     weight_stage_encode  weight-bucket wire encoding (server push path)
 
@@ -333,6 +334,73 @@ def bench_tree_verify_forward() -> dict:
     return {
         "run": lambda: _sync(fn(ids, seg, pos, mask)),
         "tokens": N,
+        "flops": costs["flops"],
+        "bytes": costs["bytes"],
+    }
+
+
+@register("spec_decode_step")
+def bench_spec_decode_step() -> dict:
+    """Speculative verify step at full acceptance: forward_verify_paged
+    over K+1 chain rows per slot plus the greedy accept walk. Setup
+    iterates the verify fn to the model's own self-consistent greedy
+    chain (an oracle draft), so every row lands and one timed call emits
+    (K+1) x slots tokens — divide this bench's tok/s by
+    paged_decode_step's for the raw speculation multiplier."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.inference.paged_kv import init_paged_cache
+    from areal_tpu.models import qwen
+    from areal_tpu.observability import hw_accounting as hw
+
+    c = _ctx()
+    cfg, psz, S = c["cfg"], c["page_size"], 4 * c["n_slots"]
+    K = 4  # SpeculativeConfig.spec_depth default
+    B = K + 1
+    ctx_len = 7 * psz  # seven warm pages per slot (= paged_decode_step)
+    wp = (ctx_len + B) // psz + 1
+    n_pages = S * wp + 1
+    cache = init_paged_cache(cfg, n_pages, psz)
+    rng = np.random.default_rng(0)
+    pending = jnp.asarray(rng.integers(1, cfg.vocab_size, S), jnp.int32)
+    table = jnp.asarray(1 + np.arange(S * wp, dtype=np.int32).reshape(S, wp))
+    prefix_lens = jnp.full((S,), ctx_len, jnp.int32)
+    positions = jnp.broadcast_to(
+        ctx_len + jnp.arange(B, dtype=jnp.int32)[None], (S, B)
+    )
+    # chain tree: row j attends rows 0..j (lower-triangular ancestor mask)
+    mask = jnp.broadcast_to(
+        jnp.asarray(np.tril(np.ones((B, B), bool)))[None], (S, B, B)
+    )
+
+    def verify(drafts):
+        ids_nodes = jnp.concatenate([pending[:, None], drafts], 1)
+        hidden, _ks, _vs = qwen.forward_verify_paged(
+            c["params"], cfg, ids_nodes, positions, mask, cache, table,
+            prefix_lens,
+        )
+        logits = qwen.compute_logits(c["params"], cfg, hidden)
+        targets = jnp.argmax(logits, -1).astype(jnp.int32)  # [S, B]
+        hit = (targets[:, :-1] == drafts).astype(jnp.int32)
+        accepted = jnp.cumprod(
+            jnp.concatenate([jnp.ones((S, 1), jnp.int32), hit], 1), axis=1
+        )
+        return targets, accepted.sum(1)  # emitted tokens per slot
+
+    fn = jax.jit(verify)
+    # oracle: each pass fixes one more chain position (target at depth d
+    # depends only on draft rows < d), so K+1 passes reach a fixed point
+    drafts = jnp.asarray(rng.integers(1, cfg.vocab_size, (S, K)), jnp.int32)
+    for _ in range(K + 1):
+        targets, _em = fn(drafts)
+        drafts = targets[:, :K]
+    _targets, emitted = fn(drafts)
+    assert int(np.asarray(emitted).min()) == B, "oracle draft did not converge"
+    costs = hw.decode_step_costs(cfg, 1, S * B, float(ctx_len))
+    return {
+        "run": lambda: _sync(fn(drafts)),
+        "tokens": S * B,
         "flops": costs["flops"],
         "bytes": costs["bytes"],
     }

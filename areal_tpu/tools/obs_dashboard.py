@@ -141,8 +141,10 @@ _DECODE_PHASES = (
     "admission",
     "radix_match",
     "prefill",
+    "draft",
     "dispatch",
     "device_wait",
+    "verify",
     "bookkeeping",
     "other",
 )
@@ -402,6 +404,38 @@ def render_frame(
     roofline = _mean_per_target(snap, "areal_decode_roofline_fraction")
     if roofline is not None:
         lines.append(f"{'decode roofline frac':<24} {roofline:>11.1%}")
+    # speculative decoding (docs/serving.md "Speculative decoding"):
+    # acceptance economics — drafted vs accepted tokens, the per-round
+    # accepted-length mean, and allocator-level rollback churn
+    spec_rounds = _merged_value(snap, "areal_spec_rounds_total")
+    if spec_rounds is not None:
+        lines.append("-" * 64)
+        lines.append(f"{'spec rounds':<24} {_fmt(spec_rounds):>12}")
+        drafted = _merged_value(snap, "areal_spec_draft_tokens_total")
+        accepted = _merged_value(snap, "areal_spec_accepted_tokens_total")
+        if drafted is not None:
+            lines.append(f"{'spec drafted tokens':<24} {_fmt(drafted):>12}")
+            for src, vs in sorted(
+                _labeled_values(
+                    snap, "areal_spec_draft_tokens_total", "source"
+                ).items()
+            ):
+                lines.append(f"{'  draft ' + src:<24} {_fmt(sum(vs)):>12}")
+        if accepted is not None:
+            lines.append(f"{'spec accepted tokens':<24} {_fmt(accepted):>12}")
+        if drafted and accepted is not None:
+            lines.append(
+                f"{'spec acceptance rate':<24} {accepted / drafted:>11.1%}"
+            )
+        al_sum = _merged_value(snap, "areal_spec_accepted_length_sum")
+        al_cnt = _merged_value(snap, "areal_spec_accepted_length_count")
+        if al_sum is not None and al_cnt:
+            lines.append(
+                f"{'spec accepted len mean':<24} {al_sum / al_cnt:>12.2f}"
+            )
+        rb = _merged_value(snap, "areal_spec_rollback_pages_total")
+        if rb is not None:
+            lines.append(f"{'spec rollback pages':<24} {_fmt(rb):>12}")
     # trainer observatory (docs/observability.md "Trainer observatory"):
     # step-phase means with the async bubble highlighted, utilization,
     # worst-replica HBM headroom, and the recompile-storm counters
@@ -647,6 +681,24 @@ areal_decode_phase_seconds_count{phase="device_wait"} 10
 # HELP areal_decode_roofline_fraction Achieved fraction of the roofline ceiling.
 # TYPE areal_decode_roofline_fraction gauge
 areal_decode_roofline_fraction 0.42
+# HELP areal_spec_rounds_total Speculative draft/verify rounds executed.
+# TYPE areal_spec_rounds_total counter
+areal_spec_rounds_total 50
+# HELP areal_spec_draft_tokens_total Draft tokens proposed, by source.
+# TYPE areal_spec_draft_tokens_total counter
+areal_spec_draft_tokens_total{source="ngram"} 150
+areal_spec_draft_tokens_total{source="radix"} 50
+# HELP areal_spec_accepted_tokens_total Draft tokens accepted by the verifier.
+# TYPE areal_spec_accepted_tokens_total counter
+areal_spec_accepted_tokens_total 120
+# HELP areal_spec_accepted_length Accepted draft-prefix length per slot-round.
+# TYPE areal_spec_accepted_length histogram
+areal_spec_accepted_length_bucket{le="+Inf"} 60
+areal_spec_accepted_length_sum 120
+areal_spec_accepted_length_count 60
+# HELP areal_spec_rollback_pages_total KV pages rolled back after rejection.
+# TYPE areal_spec_rollback_pages_total counter
+areal_spec_rollback_pages_total 9
 # HELP areal_train_phase_seconds Wall-clock seconds per training-step phase.
 # TYPE areal_train_phase_seconds histogram
 areal_train_phase_seconds_bucket{phase="rollout_wait",le="+Inf"} 4
@@ -931,6 +983,31 @@ def self_test() -> int:
                 "xla compile time (s)" in frame and "60.0" in frame,
                 "frame missing compile-time row (30.0s per target sums "
                 "to 60.0)",
+            ),
+            (
+                "spec rounds" in frame
+                and _merged_value(snap, "areal_spec_rounds_total") == 100,
+                "frame missing speculation panel (50 rounds per target "
+                "sums to 100)",
+            ),
+            (
+                "draft ngram" in frame and "draft radix" in frame,
+                "frame missing per-source draft-token rows",
+            ),
+            (
+                "spec acceptance rate" in frame and "60.0%" in frame,
+                "frame missing acceptance-rate row (120 accepted / 200 "
+                "drafted = 60.0%, ratio survives the fleet merge)",
+            ),
+            (
+                "spec accepted len mean" in frame and "2.00" in frame,
+                "frame missing accepted-length row (120/60 = 2.00)",
+            ),
+            (
+                "spec rollback pages" in frame
+                and _merged_value(snap, "areal_spec_rollback_pages_total")
+                == 18,
+                "frame missing rollback-pages row (counters sum: 2x9)",
             ),
             (
                 "learning health by lag bucket" in frame

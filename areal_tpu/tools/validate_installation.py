@@ -68,11 +68,18 @@ tiny engine's per-step phase breakdown obeys the exact-sum identity
 (named phases + other_s == step wall) with a non-null steady-state
 roofline fraction via the calibrated CPU peak fallback.
 
+``--spec-decode-self-test`` runs a spec-enabled tiny engine over an
+acceptance-friendly repetitive workload (docs/serving.md "Speculative
+decoding") and asserts acceptance rate > 0, zero leaked KV pages after
+settling, draft/verify stage coverage in the request timelines, and the
+kernel probe's exact-sum identity over the widened phase taxonomy.
+
 Usage: python -m areal_tpu.tools.validate_installation [--tpu]
     [--chaos-self-test] [--weight-sync-self-test] [--prefix-cache-self-test]
     [--overload-self-test] [--timeline-self-test] [--train-obs-self-test]
     [--learning-obs-self-test] [--preemption-self-test] [--routing-self-test]
-    [--microbench-self-test] [--gateway-tier-self-test]
+    [--microbench-self-test] [--spec-decode-self-test]
+    [--gateway-tier-self-test]
 """
 
 from __future__ import annotations
@@ -189,6 +196,15 @@ def main(argv=None) -> int:
         "rooflines), assert the compare gate flags a seeded 2x regression "
         "per bench and stays silent on self-compare, and assert the live "
         "engine's decode phase breakdown obeys the exact-sum identity",
+    )
+    p.add_argument(
+        "--spec-decode-self-test",
+        action="store_true",
+        help="run a spec-enabled tiny engine over a repetitive workload "
+        "and assert the speculative-decoding contract: acceptance rate "
+        "> 0, zero leaked KV pages after settling, draft/verify stages "
+        "in the request timelines, and the kernel probe's exact-sum "
+        "identity over the widened phase taxonomy",
     )
     p.add_argument(
         "--gateway-tier-self-test",
@@ -434,6 +450,9 @@ def main(argv=None) -> int:
 
     if args.microbench_self_test:
         _check("microbench", microbench_self_test, results)
+
+    if args.spec_decode_self_test:
+        _check("spec_decode", spec_decode_self_test, results)
 
     if args.gateway_tier_self_test:
         _check("gateway_tier", gateway_tier_self_test, results)
@@ -1870,6 +1889,114 @@ def microbench_self_test() -> str:
         f"{len(flagged)}/{len(names)}, identity residual {worst:.1e}s over "
         f"{len(recs)} steps, steady roofline "
         f"{ks['roofline_fraction']:.4f}"
+    )
+
+
+def spec_decode_self_test() -> str:
+    """Speculative decoding end to end (docs/serving.md "Speculative
+    decoding"): a spec-enabled tiny engine over an acceptance-friendly
+    repetitive workload.
+
+    Asserts: (1) speculation genuinely ran — rounds > 0 and acceptance
+    rate > 0 (prompt-lookup drafts of a periodic prompt must land);
+    (2) zero leaked KV pages after settling (free + radix-held == pool
+    total: rejected tails were rolled back through the refcounted pool);
+    (3) request timelines carry the draft/verify stages and the kernel
+    probe's per-step exact-sum identity holds with the two new phases
+    in the taxonomy."""
+    import threading
+    import time
+
+    import jax
+
+    from areal_tpu.api.config import MeshConfig, ServerConfig, SpeculativeConfig
+    from areal_tpu.api.io_struct import GenerationHyperparameters, ModelRequest
+    from areal_tpu.inference.decode_engine import DecodeEngine
+    from areal_tpu.models import qwen
+    from areal_tpu.observability.kernel_probe import DECODE_PHASES
+
+    tiny = tiny_model_config()
+    params = qwen.init_params(jax.random.PRNGKey(0), tiny)
+    cfg = ServerConfig(
+        max_batch_size=2,
+        max_seq_len=256,
+        decode_steps_per_call=4,
+        page_size=16,
+        seed=0,
+        mesh=MeshConfig(data=-1, fsdp=1, seq=1, model=1),
+        speculative=SpeculativeConfig(enabled=True, drafter="tree"),
+    )
+    eng = DecodeEngine(cfg, params=params, model_cfg=tiny)
+    eng.initialize()
+    eng.start()
+    try:
+        done = threading.Event()
+        got: list = []
+        lock = threading.Lock()
+
+        def cb(resp):
+            with lock:
+                got.append(resp)
+                if len(got) == 3:
+                    done.set()
+
+        for i in range(3):
+            eng.submit(
+                ModelRequest(
+                    # periodic prompts: prompt-lookup drafting proposes the
+                    # continuation the model itself settles into
+                    input_ids=[7 + i, 3, 9] * 8,
+                    gconfig=GenerationHyperparameters(
+                        max_new_tokens=32, greedy=True
+                    ),
+                ),
+                cb,
+            )
+        assert done.wait(timeout=300.0), f"only {len(got)}/3 finished"
+        rounds = eng.stats["spec_rounds"]
+        drafted = eng.stats["spec_draft_tokens"]
+        accepted = eng.stats["spec_accepted_tokens"]
+        assert rounds > 0, "speculation never ran"
+        assert drafted > 0 and accepted > 0, (
+            f"acceptance rate must be > 0 on a repetitive prompt "
+            f"(drafted {drafted}, accepted {accepted})"
+        )
+        # settle, then the allocator audit: every page free or radix-held
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            snap = eng.admission_snapshot()
+            if snap["queue_depth"] == 0 and snap["active_slots"] == 0:
+                break
+            time.sleep(0.05)
+        held = eng.prefix_cache_stats()["pages_held"] if eng._radix is not None else 0
+        leaked = eng.pool.used - held
+        assert leaked == 0, f"{leaked} leaked KV pages after settling"
+        # timeline stage coverage: the spec rounds marked draft + verify
+        staged = set()
+        for rec in eng.timeline.recent():
+            staged |= {ev["stage"] for ev in rec["events"]}
+        for want in ("draft", "verify"):
+            assert want in staged, (
+                f"timeline missing the {want} stage (saw {sorted(staged)})"
+            )
+        # kernel-probe exact-sum identity over the widened phase taxonomy
+        recs = eng.kprobe.recent()
+        assert recs, "no decode steps recorded by the kernel probe"
+        worst = 0.0
+        spec_phase_s = 0.0
+        for rec in recs:
+            bd = rec["breakdown"]
+            named = sum(bd[f"{p}_s"] for p in DECODE_PHASES)
+            worst = max(worst, abs(named + bd["other_s"] - bd["total_s"]))
+            spec_phase_s += bd["draft_s"] + bd["verify_s"]
+        assert worst < 1e-9, f"phase-sum identity violated by {worst:.3e}s"
+        assert spec_phase_s > 0, "draft/verify phases recorded no time"
+    finally:
+        eng.stop()
+    return (
+        f"acceptance {accepted}/{drafted} "
+        f"({accepted / drafted:.0%}) over {rounds} rounds, 0 leaked pages, "
+        f"draft+verify staged, identity residual {worst:.1e}s"
     )
 
 

@@ -338,6 +338,64 @@ def phase_decode():
         }
     )
 
+    # speculative decoding A/B (docs/serving.md "Speculative decoding"):
+    # the same acceptance-friendly periodic workload with the drafter on
+    # then off — the honest engine-level multiplier on THIS model/host
+    # (the spec_decode_step microbench pins the jit-level ceiling), plus
+    # the measured acceptance rate the multiplier stands on
+    spec = None
+    try:
+        spec_rng = np.random.default_rng(7)
+        pattern = spec_rng.integers(0, 1000, 16).tolist()
+
+        def _spec_run(n=16):
+            done_s = threading.Event()
+            got: list = []
+
+            def cb_s(r):
+                with lock:
+                    got.append(r)
+                    if len(got) == n:
+                        done_s.set()
+
+            t0 = time.monotonic()
+            for i in range(n):
+                eng.submit(
+                    ModelRequest(
+                        # 16-periodic prompts: prompt-lookup drafting hits
+                        input_ids=(pattern * 6)[i : i + 64],
+                        gconfig=GenerationHyperparameters(
+                            max_new_tokens=64, greedy=True
+                        ),
+                    ),
+                    cb_s,
+                )
+            done_s.wait(timeout=120.0)
+            dt = max(1e-9, time.monotonic() - t0)
+            with lock:
+                return sum(len(r.output_tokens) for r in got) / dt
+
+        eng.set_speculative(True)
+        d0 = eng.stats["spec_draft_tokens"]
+        a0 = eng.stats["spec_accepted_tokens"]
+        tok_on = _spec_run()
+        drafted = eng.stats["spec_draft_tokens"] - d0
+        accepted = eng.stats["spec_accepted_tokens"] - a0
+        eng.set_speculative(False)
+        tok_off = _spec_run()
+        spec = {
+            "tok_s_on": round(tok_on, 1),
+            "tok_s_off": round(tok_off, 1),
+            "speedup": round(tok_on / tok_off, 2) if tok_off else None,
+            "acceptance_rate": round(accepted / drafted, 3) if drafted else None,
+        }
+        log(
+            f"[decode] spec A/B: on {tok_on:.0f} / off {tok_off:.0f} tok/s, "
+            f"acceptance {spec['acceptance_rate']}"
+        )
+    except Exception as e:  # noqa: BLE001 — A/B segment must not kill the bench
+        log(f"[decode] spec segment failed: {type(e).__name__}: {e}")
+
     # weight-update latency. The reference bar is the <3 s transfer story
     # (blog/AReaL_v0_2.md:79-83). Three sub-measurements, cheapest-wire
     # first — the r04 first run showed the full 3.1 GB host stream takes
@@ -451,6 +509,7 @@ def phase_decode():
             "quantization": quant,
             "weight_update_secs": wu.get("wu_colocated_secs"),
             "kernels": kernels,
+            "spec": spec,
             **wu,
         }
     )
@@ -1189,6 +1248,7 @@ def main():
     kernels = None
     gateway = None
     train_detail = None
+    decode_detail = None
     wu_detail = {}
     n_chips = 1
     gen_chips = train_chips = 1
@@ -1289,6 +1349,10 @@ def main():
             }
             if d.get("partial"):
                 errors["decode_partial"] = f"only {d.get('requests_done')} reqs"
+            # speculative A/B scoreboard (acceptance rate + tok/s on vs
+            # off); cached pre-speculation payloads fold None, never a
+            # missing key
+            decode_detail = {"spec": d.get("spec")}
         # kernel observatory scoreboard (steady-state roofline + microbench
         # subset); cached pre-observatory payloads fold None, never a
         # missing key
@@ -1359,6 +1423,7 @@ def main():
         "async_vs_sync": async_sync,
         "gateway": gateway,
         "train": train_detail,
+        "decode": decode_detail,
         "kernels": kernels,
         # the chip count the pipeline number is normalized by: each phase's
         # rate divides by ITS OWN measurement's chip count (a live 1-chip

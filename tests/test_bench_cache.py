@@ -446,7 +446,9 @@ def test_deadlined_phase_with_cache_folds_cached_payload(
     ][-1]
     out = json.loads(line)
     assert out["detail"]["gen_tok_s"] == 6696.5
-    assert "decode" not in out["detail"]  # cached data, no deadlined stamp
+    # cached data, no deadlined stamp (the decode scoreboard folds the
+    # pre-speculation payload's missing spec section as None)
+    assert out["detail"]["decode"] == {"spec": None}
     assert out["detail"]["sources"]["decode"].startswith("cached@")
     # train deadlined with no cache: stamped
     assert out["detail"]["train"] == {"deadlined": True}
@@ -594,3 +596,59 @@ def test_cached_pre_observatory_decode_payload_folds_kernels_none(
     assert out["detail"]["sources"]["decode"].startswith("cached@")
     assert "kernels" in out["detail"]
     assert out["detail"]["kernels"] is None
+
+
+def test_main_folds_decode_spec_scoreboard(cache_dir, monkeypatch, capsys):
+    """The speculative A/B segment rides the round payload: acceptance
+    rate and spec-on/spec-off tok/s land in detail["decode"]["spec"]."""
+
+    def fake_spawn(name, deadline=None):
+        if name == "probe":
+            return {"phase": "probe", "platform": "tpu", "n_devices": 1}
+        if name == "decode":
+            return {
+                "phase": "decode",
+                "tok_s": 6700.0,
+                "spec": {
+                    "tok_s_on": 14100.0,
+                    "tok_s_off": 6700.0,
+                    "speedup": 2.1,
+                    "acceptance_rate": 0.74,
+                },
+            }
+        return {"phase": name, "error": "skipped"}
+
+    monkeypatch.setattr(bench, "_spawn_phase", fake_spawn)
+    bench.main()
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    spec = out["detail"]["decode"]["spec"]
+    assert spec["speedup"] == 2.1
+    assert spec["acceptance_rate"] == 0.74
+    assert spec["tok_s_on"] == 14100.0
+
+
+def test_cached_pre_spec_decode_payload_folds_spec_none(
+    cache_dir, monkeypatch, capsys
+):
+    """A cached decode payload measured BEFORE speculative decoding landed
+    has no spec section: detail["decode"]["spec"] folds as None (key always
+    present), and the decode scoreboard itself never nulls out."""
+    _seed(cache_dir, "decode", {"phase": "decode", "tok_s": 6696.5})
+
+    def fake_spawn(name, deadline=None):
+        if name == "probe":
+            return {"phase": "probe", "platform": "tpu", "n_devices": 1}
+        return {"phase": name, "error": "wedged"}
+
+    monkeypatch.setattr(bench, "_spawn_phase", fake_spawn)
+    bench.main()
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    assert out["detail"]["sources"]["decode"].startswith("cached@")
+    assert out["detail"]["decode"] == {"spec": None}
+    assert out["detail"]["gen_tok_s"] == 6696.5
