@@ -868,10 +868,12 @@ class ServerConfig:
     # logprobs ARE the quantized server's). Reference reaches this through
     # SGLang/vLLM quantized deployments.
     quantization: str = "none"
-    # KV-cache quantization: "none" | "int8" (per-token-vector scales,
-    # matching the TPU paged-attention kernel's QuantizedTensor support).
-    # KV reads dominate decode HBM traffic at long context; int8 halves
-    # them AND doubles the page pool a kv_hbm_gb budget buys.
+    # KV-cache quantization: "none" | "int8" | "fp8" (per-token-vector
+    # scales, matching the TPU paged-attention kernel's QuantizedTensor
+    # support; "fp8" stores float8_e4m3fn pages with the same scale
+    # semantics, inference/paged_kv.py). KV reads dominate decode HBM
+    # traffic at long context; both 1-byte dtypes halve them AND double
+    # the page pool a kv_hbm_gb budget buys.
     kv_quantization: str = "none"
     # safety net for the zero-pause hold fence: a hold whose
     # /continue_generation got lost (client crash, partitioned network)

@@ -543,9 +543,15 @@ class DecodeEngine:
         mcfg = self.model_cfg
         S, T, psz = cfg.max_batch_size, cfg.max_seq_len, cfg.page_size
         self._maxp = -(-T // psz)  # pages per sequence (ceil)
-        kv_quant = cfg.kv_quantization == "int8"
-        if cfg.kv_quantization not in (None, "", "none", "int8"):
+        if cfg.kv_quantization not in (None, "", "none", "int8", "fp8"):
             raise ValueError(f"unknown kv_quantization {cfg.kv_quantization!r}")
+        # "int8" -> int8 pages, "fp8" -> float8_e4m3fn pages; both carry
+        # narrow f32 scales and share one dequant formula (paged_kv)
+        kv_quant = (
+            cfg.kv_quantization
+            if cfg.kv_quantization in ("int8", "fp8")
+            else False
+        )
         if cfg.kv_hbm_gb is not None:
             n_pages = paged_kv.n_pages_for_budget(
                 int(cfg.kv_hbm_gb * (1 << 30)),
@@ -572,6 +578,11 @@ class DecodeEngine:
             jax.devices()[0].platform == "tpu"
             and int(np.prod(list(self.mesh.shape.values()))) == 1
         )
+        # suffix-prefill / tree-verify Pallas kernel
+        # (ops/paged_suffix_attention.py): same single-device condition,
+        # overridable at runtime for kernel-vs-XLA A/B (bench decode phase;
+        # off-TPU the kernel runs in interpret mode)
+        self._suffix_kernel_override: bool | None = None
         with set_mesh(self.mesh):
             self.cache = jax.jit(
                 lambda: paged_kv.init_paged_cache(mcfg, n_pages, psz, quant=kv_quant),
@@ -1826,7 +1837,8 @@ class DecodeEngine:
         row's cached prefix pages (``wp`` page-table columns) plus the
         causal suffix; suffix KV scatters into fresh pages. The prefix
         pages are read-only (aliased, possibly shared across requests)."""
-        key = ("prefill_sfx", n_prompts, bucket, wp)
+        use_kernel = self._suffix_kernel()
+        key = ("prefill_sfx", n_prompts, bucket, wp, use_kernel)
         if key not in self._fn_cache:
             mcfg = self.model_cfg
             psz = self.config.page_size
@@ -1842,7 +1854,8 @@ class DecodeEngine:
                     jnp.arange(bucket, dtype=jnp.int32)[None] < plens[:, None]
                 ).astype(jnp.int32)
                 _, ks, vs = qwen.forward_prefill_paged(
-                    params, mcfg, ids, positions, seg, cache, ppt, offs
+                    params, mcfg, ids, positions, seg, cache, ppt, offs,
+                    use_kernel=use_kernel,
                 )
                 return paged_kv.scatter_prefill(cache, ks, vs, flat_pages, psz)
 
@@ -2090,7 +2103,8 @@ class DecodeEngine:
 
         ``packed`` has the exact _chunk_fn layout with n_steps = B, so the
         normal ``_drain`` bookkeeping credits the round unchanged."""
-        key = ("spec", B, wp, capped, greedy_any)
+        use_kernel = self._suffix_kernel()
+        key = ("spec", B, wp, capped, greedy_any, use_kernel)
         if key not in self._fn_cache:
             from areal_tpu.inference import paged_kv
 
@@ -2126,6 +2140,7 @@ class DecodeEngine:
                     cache,
                     page_table,
                     pos0,
+                    use_kernel=use_kernel,
                 )
                 logits = qwen.compute_logits(params, mcfg, hidden)  # [S,B,V]
                 row_valid = (
@@ -3231,6 +3246,19 @@ class DecodeEngine:
             # task, and the new one must not be touched
             "tasks": list(self._slot_task),
         }
+
+    def _suffix_kernel(self) -> bool:
+        """Whether suffix-prefill / tree-verify runs the Pallas kernel."""
+        if self._suffix_kernel_override is not None:
+            return self._suffix_kernel_override
+        return self._use_kernel
+
+    def set_suffix_kernel(self, on: bool | None) -> None:
+        """Force the paged suffix-attention kernel on/off (None restores
+        the platform default). Used by bench's kernel-vs-XLA A/B; takes
+        effect on the next compiled prefill/verify fn (the fn-cache key
+        carries the flag, so both variants can coexist warm)."""
+        self._suffix_kernel_override = on
 
     def set_speculative(self, enabled: bool) -> None:
         """Runtime toggle for speculative decoding (bench A/B without an

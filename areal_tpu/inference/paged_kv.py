@@ -336,21 +336,40 @@ class RadixPrefixCache:
         return freed
 
 
-# int8 KV quantization convention — matches the library paged-attention
+# KV quantization convention — matches the library paged-attention
 # kernel's quantization_utils (scales = max|x| over head_dim, q = rint(
 # x * 127.5 / scale)), so quantized pages feed the TPU kernel directly as
-# QuantizedTensor(weight, scales)
+# QuantizedTensor(weight, scales). fp8 (float8_e4m3fn) pages keep the SAME
+# stored-value semantics (q = x * 127.5 / scale, no rounding clip — the
+# values sit well inside e4m3's ±448 range), so ONE dequant formula
+# ``q.astype(f32) * scale / 127.5`` serves both dtypes through every
+# kernel (the library body's from_int8 is dtype-generic on q).
 _MAX_INT8 = 127.5
+_QUANT_DTYPES = {"int8": jnp.int8, "fp8": jnp.float8_e4m3fn}
 
 
-def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """[..., hd] float -> (int8 [..., hd], f32 scale [..., 1])."""
+def quant_dtype(quant) -> "jnp.dtype | None":
+    """Normalize a quant flag (bool | "int8" | "fp8") to a page dtype.
+    ``True`` keeps the historical int8 meaning."""
+    if not quant:
+        return None
+    if quant is True:
+        return jnp.int8
+    if quant in _QUANT_DTYPES:
+        return _QUANT_DTYPES[quant]
+    raise ValueError(f"unknown kv quant mode {quant!r}")
+
+
+def quantize_kv(x: jax.Array, dtype=jnp.int8) -> tuple[jax.Array, jax.Array]:
+    """[..., hd] float -> (int8/fp8 [..., hd], f32 scale [..., 1])."""
     x32 = x.astype(jnp.float32)
     scale = jnp.maximum(jnp.max(jnp.abs(x32), axis=-1, keepdims=True), 1e-12)
-    # clip: rint(127.5) would be 128, which wraps in int8 (a latent bug in
-    # the library's own to_int8)
-    q = jnp.clip(jnp.rint(x32 * (_MAX_INT8 / scale)), -127, 127)
-    return q.astype(jnp.int8), scale
+    q = x32 * (_MAX_INT8 / scale)
+    if dtype == jnp.int8:
+        # clip: rint(127.5) would be 128, which wraps in int8 (a latent bug
+        # in the library's own to_int8)
+        q = jnp.clip(jnp.rint(q), -127, 127)
+    return q.astype(dtype), scale
 
 
 def dequantize_kv(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
@@ -359,29 +378,32 @@ def dequantize_kv(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
 
 def n_pages_for_budget(
     budget_bytes: int, n_layers: int, num_kv_heads: int, page_size: int,
-    head_dim: int, itemsize: int, quant: bool = False,
+    head_dim: int, itemsize: int, quant=False,
 ) -> int:
-    """Pages fitting a KV HBM budget (k+v across all layers per page)."""
+    """Pages fitting a KV HBM budget (k+v across all layers per page).
+    ``quant`` (bool | "int8" | "fp8"): both quantized dtypes are 1 byte
+    per element plus a 4-byte f32 scale per token vector."""
     vec_bytes = head_dim * (1 if quant else itemsize) + (4 if quant else 0)
     page_bytes = 2 * n_layers * num_kv_heads * page_size * vec_bytes
     return max(2, budget_bytes // page_bytes)
 
 
 def init_paged_cache(
-    cfg, n_pages: int, page_size: int, dtype=None, quant: bool = False
+    cfg, n_pages: int, page_size: int, dtype=None, quant=False
 ) -> dict:
     """k/v page pools: [n_layers, KH, n_pages, page_size, hd]. With
-    ``quant`` the pages are int8 plus per-token-vector f32 scales
-    ([..., psz, 1]) — halved KV HBM traffic, the decode bottleneck at long
-    context."""
+    ``quant`` (True/"int8" or "fp8") the pages are int8 or float8_e4m3fn
+    plus per-token-vector f32 scales ([..., psz, 1]) — halved KV HBM
+    traffic, the decode bottleneck at long context."""
     dtype = dtype or cfg.jax_dtype
     shape = (cfg.num_layers, cfg.num_kv_heads, n_pages, page_size, cfg.head_dim_)
-    if not quant:
+    qdtype = quant_dtype(quant)
+    if qdtype is None:
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
     sshape = shape[:-1] + (1,)
     return {
-        "k": jnp.zeros(shape, jnp.int8),
-        "v": jnp.zeros(shape, jnp.int8),
+        "k": jnp.zeros(shape, qdtype),
+        "v": jnp.zeros(shape, qdtype),
         "k_scale": jnp.ones(sshape, jnp.float32),
         "v_scale": jnp.ones(sshape, jnp.float32),
     }
@@ -421,7 +443,7 @@ def scatter_prefill(cache: dict, ks: jax.Array, vs: jax.Array, flat_pages: jax.A
             L, KH, A * npg, page_size, hd
         )
         if quant:
-            q, s = quantize_kv(r)
+            q, s = quantize_kv(r, dtype=cache[name].dtype)
             cache[name] = cache[name].at[:, :, flat_pages].set(q)
             cache[f"{name}_scale"] = cache[f"{name}_scale"].at[:, :, flat_pages].set(s)
         else:
@@ -451,7 +473,7 @@ def scatter_token_rows(
     for name, new in (("k", ks), ("v", vs)):
         r = jnp.transpose(new, (0, 2, 1, 3))  # [L, KH, N, hd]
         if quant:
-            q, s = quantize_kv(r)
+            q, s = quantize_kv(r, dtype=cache[name].dtype)
             cache[name] = cache[name].at[:, :, flat_pages, flat_rows].set(q)
             cache[f"{name}_scale"] = (
                 cache[f"{name}_scale"].at[:, :, flat_pages, flat_rows].set(s)

@@ -947,20 +947,25 @@ def forward_prefill_paged(
     input_ids: jax.Array,  # [A, B] suffix tokens (page-aligned start)
     positions: jax.Array,  # [A, B] ABSOLUTE rope positions (prefix_len + i)
     seg: jax.Array,  # [A, B] 1=valid 0=pad
-    cache: dict,  # k/v [n_layers, KH, n_pages, psz, hd] (+ scales under int8)
+    cache: dict,  # k/v [n_layers, KH, n_pages, psz, hd] (+ scales under quant)
     page_table: jax.Array,  # [A, wp] int32 pages holding the cached prefix
     prefix_lens: jax.Array,  # [A] int32 tokens cached (page-aligned; 0 = none)
+    use_kernel: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Suffix-only prefill over a radix-cached prefix: like
     ``forward_prefill`` but each row's queries additionally attend over its
-    cached prefix pages (gathered from the paged cache), so only the
-    NON-cached suffix pays prefill FLOPs. Returns (hidden, ks, vs) for the
-    suffix positions only — the caller scatters them into fresh pages; the
-    prefix pages are read, never written (aliased, possibly shared).
+    cached prefix pages, so only the NON-cached suffix pays prefill FLOPs.
+    Returns (hidden, ks, vs) for the suffix positions only — the caller
+    scatters them into fresh pages; the prefix pages are read, never
+    written (aliased, possibly shared).
 
-    XLA-only path (gather + grouped einsum, the same numerics as
-    ``paged_attention_xla``): prefill is compute-bound, so the gathered
-    prefix costs one extra HBM read per layer, not a kernel.
+    ``use_kernel=False`` (the default and the reference): gather + grouped
+    einsum, the same numerics as ``paged_attention_xla`` — one extra HBM
+    read+write of the gathered prefix per layer. ``use_kernel=True`` runs
+    the Pallas suffix-prefill kernel (ops/paged_suffix_attention.py,
+    chain-mask launch): the prefix streams page-by-page through VMEM and
+    never materializes; padded rows output zeros instead of the dense
+    path's discarded garbage (their KV lands in trash page 0 either way).
     """
     x = _embed_lookup(params["embed"], input_ids, cfg.jax_dtype, batch_sharded=False)
     suf_mask = _attention_mask(seg)  # [A, 1, B, B] causal-within-suffix
@@ -1001,6 +1006,31 @@ def forward_prefill_paged(
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         k_cache, v_cache = k, v
+        if use_kernel:
+            # Pallas chain-mask launch: the prefix streams through VMEM
+            # (double-buffered page DMA + online softmax), quantized pages
+            # dequantize in-kernel with narrow scales
+            from areal_tpu.ops.paged_suffix_attention import (
+                paged_suffix_attention,
+            )
+
+            attn = paged_suffix_attention(
+                q,
+                k,
+                v,
+                cache["k"],
+                cache["v"],
+                li,
+                prefix_lens,
+                page_table,
+                suf_mask[:, 0],  # [A, B, B] causal & row/col validity
+                k_scales=cache.get("k_scale"),
+                v_scales=cache.get("v_scale"),
+            ).reshape(A, B, H * hd)
+            x = x + _proj(cfg, layer, "wo", attn.astype(x.dtype))
+            h = _rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
+            x = x + _ffn(cfg, h, layer)
+            return x, (k_cache, v_cache)
         kp = gather("k", li)  # [A, W, KH, hd]
         vp = gather("v", li)
         if kv_quant:
@@ -1043,9 +1073,10 @@ def forward_verify_paged(
     input_ids: jax.Array,  # [S, B] pending token (root) + draft tree nodes
     positions: jax.Array,  # [S, B] ABSOLUTE rope positions (root pos + depth)
     tree_mask: jax.Array,  # [S, B, B] bool: node row attends node col
-    cache: dict,  # k/v [n_layers, KH, n_pages, psz, hd] (+ scales under int8)
+    cache: dict,  # k/v [n_layers, KH, n_pages, psz, hd] (+ scales under quant)
     page_table: jax.Array,  # [S, wp] int32 pages holding the cached context
     prefix_lens: jax.Array,  # [S] int32 tokens already in pages (= root pos)
+    use_kernel: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Speculative-verify forward: score every slot's draft token tree in
     ONE pass over the paged KV pool — the step that used to produce one
@@ -1058,6 +1089,13 @@ def forward_verify_paged(
     (hidden [S, B, D], ks, vs [L, S, B, KH, hd]) — KV is NOT written here;
     the caller routes only accepted-path rows into real pages
     (paged_kv.scatter_token_rows) so rejected drafts never land.
+
+    ``use_kernel=True`` runs the Pallas tree-verify launch
+    (ops/paged_suffix_attention.py, the same kernel body as suffix-prefill
+    with the ancestor tree mask as the suffix-mask operand) — the drafter
+    sets every node's self bit (inference/speculative.py), so the kernel's
+    diagonal row-validity rule admits every row to the committed prefix,
+    matching this function's broadcast ``pre_valid`` exactly.
     """
     x = _embed_lookup(params["embed"], input_ids, cfg.jax_dtype, batch_sharded=False)
     H, KH, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
@@ -1098,6 +1136,28 @@ def forward_verify_paged(
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         k_cache, v_cache = k, v
+        if use_kernel:
+            from areal_tpu.ops.paged_suffix_attention import (
+                paged_suffix_attention,
+            )
+
+            attn = paged_suffix_attention(
+                q,
+                k,
+                v,
+                cache["k"],
+                cache["v"],
+                li,
+                prefix_lens,
+                page_table,
+                tree_mask,  # [S, B, B] ancestor-or-self
+                k_scales=cache.get("k_scale"),
+                v_scales=cache.get("v_scale"),
+            ).reshape(S, B, H * hd)
+            x = x + _proj(cfg, layer, "wo", attn.astype(x.dtype))
+            h = _rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
+            x = x + _ffn(cfg, h, layer)
+            return x, (k_cache, v_cache)
         kp = gather("k", li)  # [S, W, KH, hd]
         vp = gather("v", li)
         if kv_quant:
@@ -1184,8 +1244,8 @@ def forward_decode_paged(
         # slice dim (KH) stay behind them -> value layout [S, KH, hd].
         c = dict(c)
         if kv_quant:
-            kq, ksc = paged_kv.quantize_kv(k)
-            vq, vsc = paged_kv.quantize_kv(v)
+            kq, ksc = paged_kv.quantize_kv(k, dtype=cache["k"].dtype)
+            vq, vsc = paged_kv.quantize_kv(v, dtype=cache["v"].dtype)
             writes = (("k", kq), ("k_scale", ksc), ("v", vq), ("v_scale", vsc))
         else:
             writes = (("k", k), ("v", v))

@@ -1,0 +1,452 @@
+"""Paged suffix-attention kernel family: suffix-prefill + tree-verify.
+
+The decode path (q_len=1) rides the stacked paged-attention fork
+(ops/paged_attention_q8.py), but the two *batched-suffix* paths —
+radix-warm suffix prefill (``qwen.forward_prefill_paged``) and
+spec-decode tree verify (``qwen.forward_verify_paged``) — gathered every
+prefix page into a dense [A, W, KH, hd] array and ran batched matmuls:
+a full HBM read + write of the windowed prefix per layer on exactly the
+paths every spec round and every radix-hit admission pays.
+
+This module is a repo-native Pallas kernel (not another fork of a private
+jax kernel) computing a block of suffix queries against page-table-indexed
+prefix KV plus the causal/tree-masked in-flight suffix:
+
+  - grid over (slot, kv_head); all of a slot's suffix rows x group heads
+    form one [B*G, hd] query block per cell
+  - per-slot ``page_indices``/``prefix_lens`` arrive via scalar prefetch;
+    prefix pages are DMA-ed HBM->VMEM in double-buffered blocks of
+    ``pages_per_compute_block`` pages, so the gathered prefix never
+    materializes in HBM
+  - flash-style online softmax across prefix blocks, then one masked
+    suffix block — the mask operand is the ONLY thing distinguishing the
+    two launch variants: a causal chain mask gives suffix-prefill, an
+    ancestor tree mask gives tree-verify (subsuming ops/tree_attention.py
+    semantics on the paged pool)
+  - int8 / float8_e4m3fn pages dequantize IN VMEM with trailing-1
+    per-vector scales end to end (the paged_attention_q8 discipline:
+    4/head_dim the scale traffic); both dtypes share one dequant formula
+    ``x.astype(f32) * scale / 127.5`` because fp8 pages store
+    ``x * 127.5 / scale`` (inference/paged_kv.py quantize_kv)
+
+Row-validity convention: a suffix row attends the prefix iff its mask
+DIAGONAL bit is set (mask[s, r, r]). ``qwen._attention_mask`` is
+row-gated (padded rows attend nothing, diag included) and the drafter
+sets every node's self bit (inference/speculative.py), so one rule serves
+both variants. Rows with nothing valid anywhere output exact zeros —
+``paged_suffix_attention_xla`` below is the bit-matching reference (the
+model's dense ``_sdpa`` instead emits a garbage uniform average on such
+rows; callers discard them either way, but the parity harness needs a
+reference with identical semantics).
+
+``interpret=None`` auto-selects interpret mode off-TPU so CPU tests and
+microbenches exercise the real kernel body; the TPU-compiled win is
+measured on hardware via the standing kernel-probe roofline phases
+(docs/perf.md for the honesty note).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# shared with inference/paged_kv.py quantize_kv: scale = max|x| over
+# head_dim, stored value = x * 127.5 / scale (rint+clip for int8, raw cast
+# for float8_e4m3fn) -> one in-VMEM dequant formula for both page dtypes
+_MAX_INT8 = 127.5
+_NEG_INF = -1e30
+
+
+def _interp(interpret):
+    if interpret is None:
+        return jax.devices()[0].platform != "tpu"
+    return interpret
+
+
+def _suffix_kernel(
+    plens_ref,  # SMEM [S] int32 — prefix tokens per slot
+    pidx_ref,  # SMEM [S * wp] int32 — flat page table
+    layer_ref,  # SMEM [1] int32 — which layer's pages to read
+    q_ref,  # [BG, hd] f32 — this cell's query rows (pre-scaled)
+    ks_ref,  # [B, hd] f32 — in-flight suffix K for this kv head
+    vs_ref,  # [B, hd] f32
+    mask_ref,  # [BG, B] int32 — suffix validity (chain or tree)
+    k_hbm,  # ANY [L, KH, N, psz, hd] — paged prefix K
+    k_scales_hbm,  # ANY [L, KH, N, psz, 1] f32 (quant launch only)
+    v_hbm,
+    v_scales_hbm,
+    o_ref,  # [BG, hd] f32
+    k_vmem,  # VMEM [2, ppcb, psz, hd] — double-buffered page landing
+    k_scales_vmem,  # VMEM [2, ppcb, psz, 1] (quant launch only)
+    v_vmem,
+    v_scales_vmem,
+    sem,  # one DMA semaphore shared by all page copies
+    *,
+    wp: int,
+    ppcb: int,
+    page_size: int,
+    num_groups: int,
+    b_suffix: int,
+    head_dim: int,
+):
+    s = pl.program_id(0)
+    h = pl.program_id(1)
+    li = layer_ref[0]
+    plen = plens_ref[s]
+    quant = k_scales_hbm is not None
+    bg = b_suffix * num_groups
+    bs = ppcb * page_size  # tokens per prefix block
+    nb = (plen + bs - 1) // bs  # prefix blocks this slot actually needs
+
+    def _block_copies(blk, slot):
+        """Async-copy descriptors for prefix block ``blk`` -> buffer
+        ``slot`` — built identically at start() and wait() time (the
+        semaphore counts bytes; copies complete in issue order)."""
+        copies = []
+        for j in range(ppcb):  # static unroll
+            page = pidx_ref[s * wp + blk * ppcb + j]
+            copies.append(
+                pltpu.make_async_copy(
+                    k_hbm.at[li, h, page], k_vmem.at[slot, j], sem
+                )
+            )
+            copies.append(
+                pltpu.make_async_copy(
+                    v_hbm.at[li, h, page], v_vmem.at[slot, j], sem
+                )
+            )
+            if quant:
+                copies.append(
+                    pltpu.make_async_copy(
+                        k_scales_hbm.at[li, h, page],
+                        k_scales_vmem.at[slot, j],
+                        sem,
+                    )
+                )
+                copies.append(
+                    pltpu.make_async_copy(
+                        v_scales_hbm.at[li, h, page],
+                        v_scales_vmem.at[slot, j],
+                        sem,
+                    )
+                )
+        return copies
+
+    q = q_ref[...].astype(jnp.float32)  # [BG, hd]
+    mask_s = mask_ref[...] > 0  # [BG, B]
+    # row attends the prefix iff its SELF bit is set: row r = i*G + g maps
+    # to suffix row i, so select column i of the mask per row
+    self_col = (
+        jax.lax.broadcasted_iota(jnp.int32, (bg, b_suffix), 0) // num_groups
+    )
+    col_id = jax.lax.broadcasted_iota(jnp.int32, (bg, b_suffix), 1)
+    row_valid = jnp.sum(
+        jnp.where((col_id == self_col) & mask_s, 1, 0), axis=1, keepdims=True
+    ) > 0  # [BG, 1]
+
+    @pl.when(nb > 0)
+    def _prologue():
+        for c in _block_copies(0, 0):
+            c.start()
+
+    def _prefix_block(i, carry):
+        m_prev, l_prev, acc = carry
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < nb)
+        def _next():  # overlap block i's compute with block i+1's DMA
+            for c in _block_copies(i + 1, jax.lax.rem(i + 1, 2)):
+                c.start()
+
+        for c in _block_copies(i, slot):
+            c.wait()
+        k_blk = k_vmem[slot].astype(jnp.float32)  # [ppcb, psz, hd]
+        v_blk = v_vmem[slot].astype(jnp.float32)
+        if quant:
+            k_blk = k_blk * (
+                k_scales_vmem[slot].astype(jnp.float32) / _MAX_INT8
+            )
+            v_blk = v_blk * (
+                v_scales_vmem[slot].astype(jnp.float32) / _MAX_INT8
+            )
+        k2 = k_blk.reshape(bs, head_dim)
+        v2 = v_blk.reshape(bs, head_dim)
+        logits = jax.lax.dot_general(
+            q, k2, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BG, bs]
+        col = jax.lax.broadcasted_iota(jnp.int32, (bg, bs), 1) + i * bs
+        valid = (col < plen) & row_valid
+        logits = jnp.where(valid, logits, _NEG_INF)
+        m_blk = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.where(valid, jnp.exp(logits - m_new), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jax.lax.dot_general(
+            p, v2, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc
+
+    init = (
+        jnp.full((bg, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((bg, 1), jnp.float32),
+        jnp.zeros((bg, head_dim), jnp.float32),
+    )
+    m, l, acc = jax.lax.fori_loop(0, nb, _prefix_block, init)
+
+    # the in-flight suffix: one block, gated entirely by the mask operand
+    ks = ks_ref[...].astype(jnp.float32)  # [B, hd]
+    vs = vs_ref[...].astype(jnp.float32)
+    logits = jax.lax.dot_general(
+        q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [BG, B]
+    logits = jnp.where(mask_s, logits, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+    p = jnp.where(mask_s, jnp.exp(logits - m_new), 0.0)
+    corr = jnp.exp(m - m_new)
+    l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc = acc * corr + jax.lax.dot_general(
+        p, vs, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    # all-masked rows have l == 0 and acc == 0 -> exact zero output
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _suffix_kernel_noscale(
+    plens_ref,
+    pidx_ref,
+    layer_ref,
+    q_ref,
+    ks_ref,
+    vs_ref,
+    mask_ref,
+    k_hbm,
+    v_hbm,
+    o_ref,
+    k_vmem,
+    v_vmem,
+    sem,
+    **kw,
+):
+    _suffix_kernel(
+        plens_ref,
+        pidx_ref,
+        layer_ref,
+        q_ref,
+        ks_ref,
+        vs_ref,
+        mask_ref,
+        k_hbm,
+        None,
+        v_hbm,
+        None,
+        o_ref,
+        k_vmem,
+        None,
+        v_vmem,
+        None,
+        sem,
+        **kw,
+    )
+
+
+def paged_suffix_attention(
+    q: jax.Array,  # [S, B, H, hd] — RAW (this wrapper applies 1/sqrt(hd))
+    k_suffix: jax.Array,  # [S, B, KH, hd] — in-flight suffix KV (unquantized)
+    v_suffix: jax.Array,
+    k_pages: jax.Array,  # [L, KH, N, psz, hd] (bf16/f32, int8, or fp8)
+    v_pages: jax.Array,
+    layer: jax.Array,  # scalar int32 — which layer's pages to read
+    prefix_lens: jax.Array,  # [S] int32 — tokens committed in pages
+    page_indices: jax.Array,  # [S, wp] int32 — window's pages per slot
+    suffix_mask: jax.Array,  # [S, B, B] bool — row attends col (chain/tree)
+    *,
+    k_scales: jax.Array | None = None,  # f32 [L, KH, N, psz, 1] (quant pages)
+    v_scales: jax.Array | None = None,
+    pages_per_compute_block: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Suffix queries over paged prefix + masked in-flight suffix
+    -> [S, B, H, hd]. One kernel body, two launch variants: a causal chain
+    ``suffix_mask`` is suffix-prefill, an ancestor tree mask is
+    spec-decode verify. Reads layer ``layer`` of the FULL stacked cache
+    (sliced inside the kernel — the paged_attention_q8 r04 discipline:
+    a host-side layer slice would make XLA materialize every layer's
+    pages per scan step). Scales, when given, stay NARROW ([..., 1])."""
+    S, B, H, hd = q.shape
+    L, KH, N, psz, hd_k = k_pages.shape
+    wp = page_indices.shape[1]
+    orig_dtype = q.dtype
+    if k_pages.shape != v_pages.shape:
+        raise ValueError(f"k/v page shapes differ: {k_pages.shape} {v_pages.shape}")
+    if hd_k != hd:
+        raise ValueError(f"head_dim mismatch {hd} vs {hd_k}")
+    if H % KH:
+        raise ValueError(f"H={H} not divisible by KH={KH}")
+    if k_suffix.shape != (S, B, KH, hd):
+        raise ValueError(f"k_suffix shape {k_suffix.shape} != {(S, B, KH, hd)}")
+    if suffix_mask.shape != (S, B, B):
+        raise ValueError(f"suffix_mask shape {suffix_mask.shape} != {(S, B, B)}")
+    quant = k_scales is not None
+    if quant != (v_scales is not None):
+        raise ValueError("k_scales and v_scales must be given together")
+    if quant and k_scales.shape != (*k_pages.shape[:-1], 1):
+        raise ValueError(f"narrow scales expected, got {k_scales.shape}")
+    ppcb = pages_per_compute_block
+    if ppcb is None:
+        ppcb = next(d for d in range(min(wp, 8), 0, -1) if wp % d == 0)
+    if wp % ppcb:
+        raise ValueError(f"wp={wp} not divisible by ppcb={ppcb}")
+
+    G = H // KH
+    BG = B * G
+    # row order i*G + g: suffix row-major, group heads minor — the mask
+    # expansion below must (and does) match
+    qt = (
+        (q.astype(jnp.float32) * hd**-0.5)
+        .reshape(S, B, KH, G, hd)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(S, KH, BG, hd)
+    )
+    ks = jnp.transpose(k_suffix, (0, 2, 1, 3)).astype(jnp.float32)  # [S,KH,B,hd]
+    vs = jnp.transpose(v_suffix, (0, 2, 1, 3)).astype(jnp.float32)
+    mask = jnp.broadcast_to(
+        suffix_mask[:, :, None, :], (S, B, G, B)
+    ).reshape(S, BG, B).astype(jnp.int32)
+
+    kernel = functools.partial(
+        _suffix_kernel if quant else _suffix_kernel_noscale,
+        wp=wp,
+        ppcb=ppcb,
+        page_size=psz,
+        num_groups=G,
+        b_suffix=B,
+        head_dim=hd,
+    )
+    in_specs = [
+        pl.BlockSpec((None, None, BG, hd), lambda s, h, *_: (s, h, 0, 0)),
+        pl.BlockSpec((None, None, B, hd), lambda s, h, *_: (s, h, 0, 0)),
+        pl.BlockSpec((None, None, B, hd), lambda s, h, *_: (s, h, 0, 0)),
+        pl.BlockSpec((None, BG, B), lambda s, h, *_: (s, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),  # k_pages
+    ]
+    if quant:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))  # k_scales
+    in_specs.append(pl.BlockSpec(memory_space=pl.ANY))  # v_pages
+    if quant:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))  # v_scales
+
+    def kv_vmem(dtype, trailing):
+        return pltpu.VMEM((2, ppcb, psz, trailing), dtype)
+
+    scratch_shapes = [kv_vmem(k_pages.dtype, hd)]
+    if quant:
+        scratch_shapes.append(kv_vmem(k_scales.dtype, 1))
+    scratch_shapes.append(kv_vmem(v_pages.dtype, hd))
+    if quant:
+        scratch_shapes.append(kv_vmem(v_scales.dtype, 1))
+    scratch_shapes.append(pltpu.SemaphoreType.DMA)
+
+    operands = [
+        prefix_lens.astype(jnp.int32),
+        page_indices.reshape(-1).astype(jnp.int32),
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        qt,
+        ks,
+        vs,
+        mask,
+        k_pages,
+    ]
+    if quant:
+        operands.append(k_scales)
+    operands.append(v_pages)
+    if quant:
+        operands.append(v_scales)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (None, None, BG, hd), lambda s, h, *_: (s, h, 0, 0)
+            ),
+            grid=(S, KH),
+            scratch_shapes=tuple(scratch_shapes),
+        ),
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, KH, BG, hd), jnp.float32),
+        interpret=_interp(interpret),
+    )(*operands)
+    return (
+        out.reshape(S, KH, B, G, hd)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(S, B, H, hd)
+        .astype(orig_dtype)
+    )
+
+
+def paged_suffix_attention_xla(
+    q: jax.Array,  # [S, B, H, hd] — RAW
+    k_suffix: jax.Array,  # [S, B, KH, hd]
+    v_suffix: jax.Array,
+    k_pages: jax.Array,  # [L, KH, N, psz, hd]
+    v_pages: jax.Array,
+    layer: jax.Array,
+    prefix_lens: jax.Array,  # [S]
+    page_indices: jax.Array,  # [S, wp]
+    suffix_mask: jax.Array,  # [S, B, B] bool
+    *,
+    k_scales: jax.Array | None = None,
+    v_scales: jax.Array | None = None,
+) -> jax.Array:
+    """Pure-XLA reference with the kernel's EXACT semantics (gather +
+    grouped einsum, f32, zero output on all-masked rows, prefix gated by
+    the mask diagonal) — kernelcheck's ground truth and the fallback the
+    model paths keep behind ``use_kernel=False``."""
+    S, B, H, hd = q.shape
+    KH, psz = k_pages.shape[1], k_pages.shape[3]
+    G = H // KH
+    wp = page_indices.shape[1]
+    W = wp * psz
+
+    def gather(pages):
+        lay = jax.lax.dynamic_index_in_dim(pages, layer, 0, keepdims=False)
+        g = jnp.transpose(lay[:, page_indices], (1, 2, 3, 0, 4))
+        return g.reshape(S, W, KH, g.shape[-1])
+
+    kp = gather(k_pages).astype(jnp.float32)
+    vp = gather(v_pages).astype(jnp.float32)
+    if k_scales is not None:
+        kp = kp * (gather(k_scales).astype(jnp.float32) / _MAX_INT8)
+        vp = vp * (gather(v_scales).astype(jnp.float32) / _MAX_INT8)
+    k_full = jnp.concatenate(
+        [kp, k_suffix.astype(jnp.float32)], axis=1
+    )  # [S, W+B, KH, hd]
+    v_full = jnp.concatenate([vp, v_suffix.astype(jnp.float32)], axis=1)
+
+    row_valid = suffix_mask[
+        :, jnp.arange(B), jnp.arange(B)
+    ]  # [S, B] — the diagonal
+    pre_valid = (
+        row_valid[:, :, None]
+        & (jnp.arange(W)[None, :] < prefix_lens[:, None])[:, None, :]
+    )  # [S, B, W]
+    mask = jnp.concatenate([pre_valid, suffix_mask], axis=-1)  # [S, B, W+B]
+
+    qg = q.astype(jnp.float32).reshape(S, B, KH, G, hd)
+    logits = (
+        jnp.einsum("sbkgd,stkd->skgbt", qg, k_full) * hd**-0.5
+    )  # [S, KH, G, B, W+B]
+    m = jnp.where(mask[:, None, None], logits, _NEG_INF)
+    mx = jnp.max(m, axis=-1, keepdims=True)
+    p = jnp.where(mask[:, None, None], jnp.exp(m - mx), 0.0)
+    denom = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    o = jnp.einsum("skgbt,stkd->sbkgd", p / denom, v_full)
+    return o.reshape(S, B, H, hd).astype(q.dtype)

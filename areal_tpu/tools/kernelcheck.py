@@ -143,6 +143,122 @@ def _cases_paged_stacked() -> Iterator[dict]:
 
 
 # ---------------------------------------------------------------------------
+# paged suffix attention (ops/paged_suffix_attention.py): suffix-prefill
+# (chain mask) + tree-verify (ancestor mask) over bf16/int8/fp8 pages
+# ---------------------------------------------------------------------------
+
+
+def _suffix_case(
+    S=3, B=6, KH=2, G=2, hd=16, psz=4, wp=4, L=2, layer=1,
+    mask="chain", pages="f32", lens="ragged", ppcb=None, seed=0,
+):
+    """Build one paged_suffix_attention parity case. Returns (params,
+    kernel_fn, reference_fn); the params dict is what --case repro wants."""
+    import jax.numpy as jnp
+
+    from areal_tpu.inference import paged_kv
+    from areal_tpu.ops import paged_suffix_attention as psa
+
+    params = dict(S=S, B=B, KH=KH, G=G, hd=hd, psz=psz, wp=wp, L=L,
+                  layer=layer, mask=mask, pages=pages, lens=lens,
+                  ppcb=ppcb, seed=seed)
+    rng = np.random.default_rng(seed)
+    H = KH * G
+    N = S * wp + 1
+    q = jnp.asarray(rng.normal(0, 1, (S, B, H, hd)), jnp.float32)
+    ksf = jnp.asarray(rng.normal(0, 1, (S, B, KH, hd)), jnp.float32)
+    vsf = jnp.asarray(rng.normal(0, 1, (S, B, KH, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(0, 1, (L, KH, N, psz, hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1, (L, KH, N, psz, hd)), jnp.float32)
+    pt = jnp.asarray(1 + np.arange(S * wp).reshape(S, wp), jnp.int32)
+    W = wp * psz
+    if lens == "ragged":
+        # 0, full, and page-boundary-straddling lengths (NOT multiples of
+        # psz or of the ppcb*psz block) in one batch
+        pool = [0, W] + [int(x) for x in rng.integers(1, W, max(S, 2))]
+        plens = jnp.asarray(pool[:S], jnp.int32)
+    else:  # "aligned": page-multiple lengths (radix prefixes)
+        plens = jnp.asarray(
+            psz * rng.integers(0, wp + 1, S), jnp.int32
+        )
+    if mask == "chain":
+        seg = np.ones((S, B), np.int32)
+        seg[:, B - 1] = 0  # one padded suffix row
+        m = (
+            np.tril(np.ones((B, B), bool))[None]
+            & (seg[:, :, None] != 0)
+            & (seg[:, None, :] != 0)
+        )
+    else:  # "tree": random parent-before-child ancestor-or-self mask
+        m = np.zeros((S, B, B), bool)
+        m[:, np.arange(B), np.arange(B)] = True
+        m[:, :, 0] = True
+        for s in range(S):
+            for r in range(1, B):
+                p = int(rng.integers(0, r))
+                m[s, r] |= m[s, p]
+    m = jnp.asarray(m)
+
+    scales = {}
+    if pages in ("int8", "fp8"):
+        dt = jnp.int8 if pages == "int8" else jnp.float8_e4m3fn
+        kq, ks = paged_kv.quantize_kv(k, dtype=dt)
+        vq, vs = paged_kv.quantize_kv(v, dtype=dt)
+        k, v = kq, vq
+        scales = dict(k_scales=ks, v_scales=vs)
+    elif pages == "bf16":
+        k, v = k.astype(jnp.bfloat16), v.astype(jnp.bfloat16)
+
+    li = jnp.int32(layer)
+
+    def kernel():
+        return psa.paged_suffix_attention(
+            q, ksf, vsf, k, v, li, plens, pt, m,
+            pages_per_compute_block=ppcb, interpret=True, **scales,
+        )
+
+    def reference():
+        return psa.paged_suffix_attention_xla(
+            q, ksf, vsf, k, v, li, plens, pt, m, **scales,
+        )
+
+    return params, kernel, reference
+
+
+@register_kernel("paged_suffix_attention")
+def _cases_paged_suffix() -> Iterator[dict]:
+    grid = [
+        # (label, overrides, tol) — GQA ratios x ragged/aligned lengths x
+        # bf16/int8/fp8 pages x chain/tree masks, page-straddling blocks
+        ("chain-f32-gqa2-ragged", dict(), 2e-4),
+        ("chain-bf16-mha1-aligned",
+         dict(KH=4, G=1, pages="bf16", lens="aligned", seed=1), 2e-2),
+        ("chain-f32-gqa4-straddle-ppcb2",
+         dict(KH=1, G=4, wp=6, ppcb=2, seed=2), 2e-4),
+        ("tree-f32-gqa2-ragged", dict(mask="tree", seed=3), 2e-4),
+        ("tree-bf16-gqa2-layer0",
+         dict(mask="tree", pages="bf16", layer=0, seed=4), 2e-2),
+        ("chain-int8-gqa2-ragged", dict(pages="int8", seed=5), 2e-4),
+        ("tree-int8-mha1-straddle",
+         dict(mask="tree", pages="int8", KH=4, G=1, wp=6, ppcb=3, seed=6),
+         2e-4),
+        ("chain-fp8-gqa2-ragged", dict(pages="fp8", seed=7), 2e-4),
+        ("tree-fp8-gqa4-aligned",
+         dict(mask="tree", pages="fp8", KH=1, G=4, lens="aligned", seed=8),
+         2e-4),
+    ]
+    for label, overrides, tol in grid:
+        params, kernel, reference = _suffix_case(**overrides)
+        yield {
+            "case": label,
+            "params": params,
+            "kernel": kernel,
+            "reference": reference,
+            "tol": tol,
+        }
+
+
+# ---------------------------------------------------------------------------
 # forward-only flash attention (ops/attention.py)
 # ---------------------------------------------------------------------------
 
@@ -240,31 +356,42 @@ def _cases_tree_attention() -> Iterator[dict]:
 # ---------------------------------------------------------------------------
 
 
-def run_kernel(name: str) -> list[dict]:
-    """Run one kernel's full case grid; never raises on divergence — every
-    case reports {kernel, case, max_abs_diff, tol, ok, error?}."""
+def run_kernel(name: str, case: "int | str | None" = None) -> list[dict]:
+    """Run one kernel's case grid; never raises on divergence — every
+    case reports {kernel, index, case, max_abs_diff, tol, ok, error?,
+    params?}. ``case`` filters to a single grid point by index or label
+    (repro of one failing case without re-running the grid)."""
     results: list[dict] = []
-    for case in REGISTRY[name]():
-        rec: dict[str, Any] = {"kernel": name, "case": case["case"], "tol": case["tol"]}
+    for idx, spec in enumerate(REGISTRY[name]()):
+        if case is not None and case != idx and case != spec["case"]:
+            continue
+        rec: dict[str, Any] = {
+            "kernel": name, "index": idx, "case": spec["case"],
+            "tol": spec["tol"],
+        }
+        if "params" in spec:
+            rec["params"] = spec["params"]
         try:
-            got = np.asarray(case["kernel"](), np.float32)
-            want = np.asarray(case["reference"](), np.float32)
+            got = np.asarray(spec["kernel"](), np.float32)
+            want = np.asarray(spec["reference"](), np.float32)
             if got.shape != want.shape:
                 rec.update(ok=False, error=f"shape {got.shape} vs {want.shape}")
             else:
                 diff = float(np.max(np.abs(got - want)))
-                rec.update(max_abs_diff=diff, ok=diff <= case["tol"])
+                rec.update(max_abs_diff=diff, ok=diff <= spec["tol"])
         except Exception as e:  # noqa: BLE001 — a crash IS a parity failure
             rec.update(ok=False, error=f"{type(e).__name__}: {e}")
         results.append(rec)
     return results
 
 
-def run_all(only: str | None = None) -> list[dict]:
+def run_all(
+    only: str | None = None, case: "int | str | None" = None
+) -> list[dict]:
     names = [only] if only else sorted(REGISTRY)
     out: list[dict] = []
     for name in names:
-        out.extend(run_kernel(name))
+        out.extend(run_kernel(name, case=case))
     return out
 
 
@@ -275,6 +402,11 @@ def main(argv: list[str] | None = None) -> int:
     )
     ap.add_argument("--list", action="store_true", help="enumerate kernels")
     ap.add_argument("--kernel", help="run one kernel's grid only")
+    ap.add_argument(
+        "--case",
+        help="run a single grid point (index or label; requires --kernel) — "
+        "re-run one failing case in isolation",
+    )
     ap.add_argument("--json", action="store_true", help="JSON report")
     args = ap.parse_args(argv)
 
@@ -287,8 +419,24 @@ def main(argv: list[str] | None = None) -> int:
         print(f"unknown kernel {args.kernel!r}; known: {sorted(REGISTRY)}",
               file=sys.stderr)
         return 2
+    case: int | str | None = None
+    if args.case is not None:
+        if not args.kernel:
+            print("--case requires --kernel", file=sys.stderr)
+            return 2
+        case = int(args.case) if args.case.isdigit() else args.case
+        known = list(REGISTRY[args.kernel]())
+        if not any(
+            case == i or case == c["case"] for i, c in enumerate(known)
+        ):
+            print(
+                f"unknown case {args.case!r} for {args.kernel}; known: "
+                f"{[c['case'] for c in known]}",
+                file=sys.stderr,
+            )
+            return 2
 
-    results = run_all(args.kernel)
+    results = run_all(args.kernel, case=case)
     if args.json:
         print(json.dumps({"results": results}, indent=1))
     else:
@@ -303,6 +451,14 @@ def main(argv: list[str] | None = None) -> int:
                     f"max_abs_diff={r['max_abs_diff']:.2e} > tol={r['tol']:.0e}"
                 )
                 print(f"FAIL {r['kernel']}:{r['case']} {detail}")
+                # full repro line: the case-params dict plus the --case
+                # incantation that re-runs just this grid point
+                if "params" in r:
+                    print(f"  params={r['params']}")
+                print(
+                    f"  repro: python -m areal_tpu.tools.kernelcheck "
+                    f"--kernel {r['kernel']} --case {r['index']}"
+                )
     failed = [r for r in results if not r["ok"]]
     if failed:
         print(f"kernelcheck: {len(failed)}/{len(results)} case(s) DIVERGED",

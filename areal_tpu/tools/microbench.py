@@ -237,9 +237,7 @@ def bench_paged_attention_interpret() -> dict:
     }
 
 
-@register("suffix_prefill")
-def bench_suffix_prefill() -> dict:
-    """Radix-suffix prefill: queries attend over one cached prefix page."""
+def _suffix_prefill_bench(use_kernel: bool) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -260,7 +258,7 @@ def bench_suffix_prefill() -> dict:
     table = jnp.asarray(1 + np.arange(A * wp, dtype=np.int32).reshape(A, wp))
     fn = jax.jit(
         lambda i, p, s, kv, t, o: qwen.forward_prefill_paged(
-            c["params"], cfg, i, p, s, kv, t, o
+            c["params"], cfg, i, p, s, kv, t, o, use_kernel=use_kernel
         )[1]
     )
     offs_d = jnp.asarray(offs)
@@ -271,6 +269,21 @@ def bench_suffix_prefill() -> dict:
         "flops": costs["flops"],
         "bytes": costs["bytes"],
     }
+
+
+@register("suffix_prefill")
+def bench_suffix_prefill() -> dict:
+    """Radix-suffix prefill: queries attend over one cached prefix page."""
+    return _suffix_prefill_bench(False)
+
+
+@register("suffix_prefill_kernel")
+def bench_suffix_prefill_kernel() -> dict:
+    """Radix-suffix prefill through the Pallas suffix-prefill kernel
+    (ops/paged_suffix_attention.py, chain-mask launch). On CPU this runs
+    the kernel body in interpret mode — the honest bar is parity-not-perf;
+    the HBM-bound win is measured on TPU (docs/perf.md)."""
+    return _suffix_prefill_bench(True)
 
 
 @register("int8_kv_dequant")
@@ -339,14 +352,7 @@ def bench_tree_verify_forward() -> dict:
     }
 
 
-@register("spec_decode_step")
-def bench_spec_decode_step() -> dict:
-    """Speculative verify step at full acceptance: forward_verify_paged
-    over K+1 chain rows per slot plus the greedy accept walk. Setup
-    iterates the verify fn to the model's own self-consistent greedy
-    chain (an oracle draft), so every row lands and one timed call emits
-    (K+1) x slots tokens — divide this bench's tok/s by
-    paged_decode_step's for the raw speculation multiplier."""
+def _spec_decode_step_bench(use_kernel: bool) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -378,7 +384,7 @@ def bench_spec_decode_step() -> dict:
         ids_nodes = jnp.concatenate([pending[:, None], drafts], 1)
         hidden, _ks, _vs = qwen.forward_verify_paged(
             c["params"], cfg, ids_nodes, positions, mask, cache, table,
-            prefix_lens,
+            prefix_lens, use_kernel=use_kernel,
         )
         logits = qwen.compute_logits(c["params"], cfg, hidden)
         targets = jnp.argmax(logits, -1).astype(jnp.int32)  # [S, B]
@@ -404,6 +410,26 @@ def bench_spec_decode_step() -> dict:
         "flops": costs["flops"],
         "bytes": costs["bytes"],
     }
+
+
+@register("spec_decode_step")
+def bench_spec_decode_step() -> dict:
+    """Speculative verify step at full acceptance: forward_verify_paged
+    over K+1 chain rows per slot plus the greedy accept walk. Setup
+    iterates the verify fn to the model's own self-consistent greedy
+    chain (an oracle draft), so every row lands and one timed call emits
+    (K+1) x slots tokens — divide this bench's tok/s by
+    paged_decode_step's for the raw speculation multiplier."""
+    return _spec_decode_step_bench(False)
+
+
+@register("spec_decode_step_kernel")
+def bench_spec_decode_step_kernel() -> dict:
+    """Oracle-draft speculative verify through the Pallas tree-verify
+    launch (ops/paged_suffix_attention.py, ancestor-mask operand). On CPU
+    the kernel runs in interpret mode — parity is the bar here, the
+    HBM-bound win is a TPU measurement (docs/perf.md)."""
+    return _spec_decode_step_bench(True)
 
 
 @register("radix_match")

@@ -74,12 +74,17 @@ decoding") and asserts acceptance rate > 0, zero leaked KV pages after
 settling, draft/verify stage coverage in the request timelines, and the
 kernel probe's exact-sum identity over the widened phase taxonomy.
 
+``--kernelcheck`` runs every registered ops/ Pallas kernel's full
+differential case grid in interpret mode against its XLA reference
+(docs/perf.md "Paged suffix-attention kernel family") — the numerics
+companion to the always-on static ``kernel_lint`` check.
+
 Usage: python -m areal_tpu.tools.validate_installation [--tpu]
     [--chaos-self-test] [--weight-sync-self-test] [--prefix-cache-self-test]
     [--overload-self-test] [--timeline-self-test] [--train-obs-self-test]
     [--learning-obs-self-test] [--preemption-self-test] [--routing-self-test]
     [--microbench-self-test] [--spec-decode-self-test]
-    [--gateway-tier-self-test]
+    [--gateway-tier-self-test] [--kernelcheck]
 """
 
 from __future__ import annotations
@@ -196,6 +201,16 @@ def main(argv=None) -> int:
         "rooflines), assert the compare gate flags a seeded 2x regression "
         "per bench and stays silent on self-compare, and assert the live "
         "engine's decode phase breakdown obeys the exact-sum identity",
+    )
+    p.add_argument(
+        "--kernelcheck",
+        action="store_true",
+        help="run every registered ops/ Pallas kernel's full kernelcheck "
+        "case grid (interpret mode vs XLA reference — for "
+        "paged_suffix_attention: GQA ratios x ragged lengths x "
+        "bf16/int8/fp8 x chain/tree masks) and fail on any divergence; "
+        "the numerics companion to the static kernel_lint check "
+        "(docs/perf.md 'Paged suffix-attention kernel family')",
     )
     p.add_argument(
         "--spec-decode-self-test",
@@ -405,6 +420,27 @@ def main(argv=None) -> int:
         return "C++ datapack" if lib is not None else "python fallback (no g++?)"
 
     _check("native", native_kernels, results)
+
+    if args.kernelcheck:
+
+        def kernelcheck():
+            from areal_tpu.tools.kernelcheck import run_all
+
+            recs = run_all()
+            bad = [r for r in recs if not r["ok"]]
+            if bad:
+                raise RuntimeError(
+                    "; ".join(
+                        f"{r['kernel']}[{r['case']}]: "
+                        + r.get("error", f"diff {r.get('max_abs_diff')}")
+                        for r in bad[:5]
+                    )
+                    + (f" (+{len(bad) - 5} more)" if len(bad) > 5 else "")
+                )
+            kernels = sorted({r["kernel"] for r in recs})
+            return f"{len(recs)} cases green over {len(kernels)} kernels"
+
+        _check("kernelcheck", kernelcheck, results)
 
     if args.chaos_self_test:
         _check("chaos", chaos_self_test, results)

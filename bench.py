@@ -396,6 +396,74 @@ def phase_decode():
     except Exception as e:  # noqa: BLE001 — A/B segment must not kill the bench
         log(f"[decode] spec segment failed: {type(e).__name__}: {e}")
 
+    # suffix-prefill kernel A/B (docs/perf.md "Paged suffix-attention
+    # kernel family"): radix-warm shared-prefix admissions route through
+    # forward_prefill_paged — time the same workload with the Pallas
+    # kernel on then off (XLA gather path); on CPU/interpret this is a
+    # parity bar, on TPU it is the HBM-read win the kernel exists for
+    prefill_kernel = None
+    try:
+        pk_rng = np.random.default_rng(11)
+        shared = pk_rng.integers(0, 1000, 96).tolist()
+
+        def _pk_run(n=16):
+            done_k = threading.Event()
+            got_k: list = []
+
+            def cb_k(r):
+                with lock:
+                    got_k.append(r)
+                    if len(got_k) == n:
+                        done_k.set()
+
+            t0 = time.monotonic()
+            for _ in range(n):
+                # shared 96-token prefix + distinct 16-token tail: every
+                # admission after the radix warm below is a prefix hit, so
+                # only the tail runs suffix prefill
+                eng.submit(
+                    ModelRequest(
+                        input_ids=shared + pk_rng.integers(0, 1000, 16).tolist(),
+                        gconfig=GenerationHyperparameters(
+                            max_new_tokens=32, greedy=True
+                        ),
+                    ),
+                    cb_k,
+                )
+            done_k.wait(timeout=120.0)
+            dt = max(1e-9, time.monotonic() - t0)
+            with lock:
+                return sum(len(r.output_tokens) for r in got_k) / dt
+
+        # publish the shared prefix into the radix before either timed run
+        eng.generate_sync(
+            ModelRequest(
+                input_ids=shared,
+                gconfig=GenerationHyperparameters(max_new_tokens=8, greedy=True),
+            ),
+            timeout=120.0,
+        )
+        eng.set_suffix_kernel(True)
+        tok_kon = _pk_run()
+        eng.set_suffix_kernel(False)
+        tok_koff = _pk_run()
+        prefill_kernel = {
+            "tok_s_on": round(tok_kon, 1),
+            "tok_s_off": round(tok_koff, 1),
+            "speedup": round(tok_kon / tok_koff, 2) if tok_koff else None,
+        }
+        log(
+            f"[decode] prefill-kernel A/B: on {tok_kon:.0f} / off "
+            f"{tok_koff:.0f} tok/s"
+        )
+    except Exception as e:  # noqa: BLE001 — A/B segment must not kill the bench
+        log(f"[decode] prefill-kernel segment failed: {type(e).__name__}: {e}")
+    finally:
+        try:
+            eng.set_suffix_kernel(None)  # restore platform default
+        except Exception:  # noqa: BLE001
+            pass
+
     # weight-update latency. The reference bar is the <3 s transfer story
     # (blog/AReaL_v0_2.md:79-83). Three sub-measurements, cheapest-wire
     # first — the r04 first run showed the full 3.1 GB host stream takes
@@ -510,6 +578,7 @@ def phase_decode():
             "weight_update_secs": wu.get("wu_colocated_secs"),
             "kernels": kernels,
             "spec": spec,
+            "prefill_kernel": prefill_kernel,
             **wu,
         }
     )
@@ -1350,9 +1419,12 @@ def main():
             if d.get("partial"):
                 errors["decode_partial"] = f"only {d.get('requests_done')} reqs"
             # speculative A/B scoreboard (acceptance rate + tok/s on vs
-            # off); cached pre-speculation payloads fold None, never a
-            # missing key
-            decode_detail = {"spec": d.get("spec")}
+            # off) and the suffix-prefill kernel A/B; cached pre-feature
+            # payloads fold None, never a missing key
+            decode_detail = {
+                "spec": d.get("spec"),
+                "prefill_kernel": d.get("prefill_kernel"),
+            }
         # kernel observatory scoreboard (steady-state roofline + microbench
         # subset); cached pre-observatory payloads fold None, never a
         # missing key

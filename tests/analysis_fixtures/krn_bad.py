@@ -27,3 +27,26 @@ def launch(x):  # KRN005: no `interpret` parameter on any enclosing fn
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
         scratch_shapes=[pltpu.VMEM((128, 128), jnp.float32)],
     )(x)
+
+
+def _sfx_kernel(plens_ref, q_ref, o_ref, acc_ref):
+    # KRN002 (scalar-prefetch drift): the launch below supplies 5 refs
+    # (2 prefetch + 1 in + 1 out + 1 scratch); this body takes 4, so the
+    # second prefetch ref lands in q_ref and every later operand shifts
+    # one slot left — silently
+    acc_ref[...] = q_ref[...] * 2.0
+    o_ref[...] = acc_ref[...]
+
+
+def launch_prefetch(plens, pidx, q):  # KRN005: interpret not plumbed through
+    return pl.pallas_call(
+        _sfx_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(4, 2),
+            in_specs=[pl.BlockSpec((128, 128), lambda i, j, *_: (i, 0))],
+            out_specs=pl.BlockSpec((128, 128), lambda i, j, *_: (i, 0)),
+            scratch_shapes=[pltpu.VMEM((128, 128), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+    )(plens, pidx, q)

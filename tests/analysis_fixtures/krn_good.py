@@ -25,3 +25,26 @@ def launch(x, interpret: bool = False):
         scratch_shapes=[pltpu.VMEM((128, 128), jnp.float32)],
         interpret=interpret,
     )(x)
+
+
+def _sfx_kernel(plens_ref, pidx_ref, q_ref, o_ref, acc_ref):
+    # 5 positional refs: 2 prefetch + 1 in + 1 out + 1 scratch, matching
+    # the PrefetchScalarGridSpec operand plan exactly
+    acc_ref[...] = q_ref[...] * 2.0
+    o_ref[...] = acc_ref[...]
+
+
+def launch_prefetch(plens, pidx, q, interpret: bool = False):
+    return pl.pallas_call(
+        _sfx_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(4, 2),
+            # index maps take grid rank + prefetch refs (2 + 2)
+            in_specs=[pl.BlockSpec((128, 128), lambda i, j, s, p: (i, 0))],
+            out_specs=pl.BlockSpec((128, 128), lambda i, j, s, p: (i, 0)),
+            scratch_shapes=[pltpu.VMEM((128, 128), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(plens, pidx, q)

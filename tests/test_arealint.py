@@ -265,14 +265,21 @@ def test_lck_good_fixture():
 
 
 def test_krn_bad_fixture():
-    """One pallas_call launch wearing every kernel-safety defect."""
+    """Two launches wearing every kernel-safety defect: a plain
+    pallas_call (all five rules) and a PrefetchScalarGridSpec launch with
+    scalar-prefetch operand drift + no interpret plumb-through — the
+    defect shape of the suffix-attention kernel family."""
     rules = rules_in(FIXTURES / "krn_bad.py", ["KRN"])
     assert {"KRN001", "KRN002", "KRN003", "KRN004", "KRN005"} == set(rules)
+    # the prefetch launch fires its own KRN002 (2 prefetch + 1 in + 1 out
+    # + 1 scratch = 5 supplied, 4 taken) and its own KRN005
+    assert rules.count("KRN002") == 2
+    assert rules.count("KRN005") == 2
 
 
 def test_krn_good_fixture():
-    # matched index-map arity, operand plan, no input writes, exact grid,
-    # interpret= exposed
+    # matched index-map arity, operand plan (incl. scalar-prefetch refs),
+    # no input writes, exact grid, interpret= exposed on both launches
     assert rules_in(FIXTURES / "krn_good.py", ["KRN"]) == []
 
 

@@ -447,8 +447,8 @@ def test_deadlined_phase_with_cache_folds_cached_payload(
     out = json.loads(line)
     assert out["detail"]["gen_tok_s"] == 6696.5
     # cached data, no deadlined stamp (the decode scoreboard folds the
-    # pre-speculation payload's missing spec section as None)
-    assert out["detail"]["decode"] == {"spec": None}
+    # pre-feature payload's missing spec/prefill_kernel sections as None)
+    assert out["detail"]["decode"] == {"spec": None, "prefill_kernel": None}
     assert out["detail"]["sources"]["decode"].startswith("cached@")
     # train deadlined with no cache: stamped
     assert out["detail"]["train"] == {"deadlined": True}
@@ -650,5 +650,73 @@ def test_cached_pre_spec_decode_payload_folds_spec_none(
     ][-1]
     out = json.loads(line)
     assert out["detail"]["sources"]["decode"].startswith("cached@")
-    assert out["detail"]["decode"] == {"spec": None}
+    assert out["detail"]["decode"] == {"spec": None, "prefill_kernel": None}
     assert out["detail"]["gen_tok_s"] == 6696.5
+
+
+def test_main_folds_decode_prefill_kernel_scoreboard(
+    cache_dir, monkeypatch, capsys
+):
+    """The suffix-prefill kernel A/B segment rides the round payload:
+    kernel-on/kernel-off tok/s and the speedup ratio land in
+    detail["decode"]["prefill_kernel"] next to the spec scoreboard."""
+
+    def fake_spawn(name, deadline=None):
+        if name == "probe":
+            return {"phase": "probe", "platform": "tpu", "n_devices": 1}
+        if name == "decode":
+            return {
+                "phase": "decode",
+                "tok_s": 6700.0,
+                "prefill_kernel": {
+                    "tok_s_on": 7900.0,
+                    "tok_s_off": 6700.0,
+                    "speedup": 1.18,
+                },
+            }
+        return {"phase": name, "error": "skipped"}
+
+    monkeypatch.setattr(bench, "_spawn_phase", fake_spawn)
+    bench.main()
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    pk = out["detail"]["decode"]["prefill_kernel"]
+    assert pk["speedup"] == 1.18
+    assert pk["tok_s_on"] == 7900.0
+    assert pk["tok_s_off"] == 6700.0
+    # payload carried no spec section: folds None, never a missing key
+    assert out["detail"]["decode"]["spec"] is None
+
+
+def test_cached_pre_kernel_decode_payload_folds_prefill_kernel_none(
+    cache_dir, monkeypatch, capsys
+):
+    """A cached decode payload measured BEFORE the suffix-prefill kernel
+    A/B landed has no prefill_kernel section: it folds as None (key always
+    present) while the spec scoreboard it DOES carry survives intact."""
+    _seed(
+        cache_dir,
+        "decode",
+        {
+            "phase": "decode",
+            "tok_s": 6696.5,
+            "spec": {"tok_s_on": 14100.0, "tok_s_off": 6700.0},
+        },
+    )
+
+    def fake_spawn(name, deadline=None):
+        if name == "probe":
+            return {"phase": "probe", "platform": "tpu", "n_devices": 1}
+        return {"phase": name, "error": "wedged"}
+
+    monkeypatch.setattr(bench, "_spawn_phase", fake_spawn)
+    bench.main()
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    assert out["detail"]["sources"]["decode"].startswith("cached@")
+    assert out["detail"]["decode"]["prefill_kernel"] is None
+    assert out["detail"]["decode"]["spec"]["tok_s_on"] == 14100.0

@@ -355,17 +355,20 @@ def test_compare_matrix():
 
 def test_fast_benches_registered():
     """The committed CPU baseline's bench set is a stable contract: the
-    eight hot-path benches from docs/perf.md must stay registered as the
-    fast (non-heavy) set."""
+    hot-path benches from docs/perf.md must stay registered as the fast
+    (non-heavy) set — including the suffix-attention kernel-path twins
+    of suffix_prefill/spec_decode_step."""
     from areal_tpu.tools import microbench as mb
 
     assert set(mb.fast_names()) == {
         "paged_decode_step",
         "paged_attention_interpret",
         "suffix_prefill",
+        "suffix_prefill_kernel",
         "int8_kv_dequant",
         "tree_verify_forward",
         "spec_decode_step",
+        "spec_decode_step_kernel",
         "radix_match",
         "weight_stage_encode",
     }

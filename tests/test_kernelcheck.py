@@ -16,6 +16,7 @@ def test_registered_kernels_enumerate():
         "paged_attention_stacked",
         "flash_fwd",
         "tree_attention",
+        "paged_suffix_attention",
     } <= set(kernelcheck.REGISTRY)
     for name, cases_fn in kernelcheck.REGISTRY.items():
         labels = [c["case"] for c in cases_fn()]
@@ -82,3 +83,56 @@ def test_crashing_kernel_is_a_failure_not_a_crash(monkeypatch):
 
 def test_unknown_kernel_is_a_usage_error():
     assert kernelcheck.main(["--kernel", "nope"]) == 2
+
+
+def test_suffix_attention_grid_covers_masks_dtypes_gqa():
+    """The suffix-attention family's case grid spans both launch variants
+    (chain mask = suffix prefill, tree mask = spec verify), the full
+    quantization ladder, and GQA ratios — the coverage the single-kernel-
+    body design claim stands on."""
+    cases = list(kernelcheck.REGISTRY["paged_suffix_attention"]())
+    labels = [c["case"] for c in cases]
+    assert any(label.startswith("chain") for label in labels)
+    assert any(label.startswith("tree") for label in labels)
+    for dtype in ("bf16", "int8", "fp8"):
+        assert any(dtype in label for label in labels), dtype
+    # every case carries its params dict (the FAIL-repro payload)
+    assert all("params" in c for c in cases)
+    gqa = {c["params"]["G"] for c in cases}
+    assert len(gqa) >= 2, f"one GQA ratio only: {gqa}"
+    # ragged (non-page-aligned) prefix lengths are present
+    assert any("ragged" in label or "straddle" in label for label in labels)
+
+
+def test_case_filter_selects_one_grid_point():
+    """run_kernel(case=...) filters by index or label; the CLI rejects
+    --case without --kernel and unknown case labels (usage errors, not
+    silent empty runs)."""
+    cases = list(kernelcheck.REGISTRY["flash_fwd"]())
+    by_idx = kernelcheck.run_kernel("flash_fwd", case=0)
+    assert len(by_idx) == 1 and by_idx[0]["index"] == 0
+    by_label = kernelcheck.run_kernel("flash_fwd", case=cases[-1]["case"])
+    assert len(by_label) == 1
+    assert by_label[0]["case"] == cases[-1]["case"]
+    assert kernelcheck.main(["--case", "0"]) == 2  # no --kernel
+    assert kernelcheck.main(["--kernel", "flash_fwd", "--case", "nope"]) == 2
+
+
+def test_failing_case_prints_params_and_repro(monkeypatch, capsys):
+    """A parity failure prints the full case-params dict plus the --case
+    incantation that re-runs just that grid point."""
+
+    def bad_cases():
+        yield {
+            "case": "diverges",
+            "params": {"S": 3, "dtype": "int8", "mask": "tree"},
+            "kernel": lambda: np.ones((2, 2), np.float32),
+            "reference": lambda: np.zeros((2, 2), np.float32),
+            "tol": 1e-3,
+        }
+
+    monkeypatch.setitem(kernelcheck.REGISTRY, "bad_kernel", bad_cases)
+    assert kernelcheck.main(["--kernel", "bad_kernel"]) == 1
+    out = capsys.readouterr().out
+    assert "params={'S': 3, 'dtype': 'int8', 'mask': 'tree'}" in out
+    assert "--kernel bad_kernel --case 0" in out

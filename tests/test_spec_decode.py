@@ -355,6 +355,69 @@ def test_spec_rejected_drafts_roll_back_pages(tiny_params):
         eng.stop()
 
 
+def test_spec_twin_int8_kv_greedy_identity(tiny_params):
+    """Spec-on vs spec-off under kv_quantization="int8": the verify walk
+    reads the SAME quantized pages the plain decode path reads, so greedy
+    outputs stay byte-identical — int8 shifts numerics relative to the
+    full-precision baseline fixture, so the spec-off half is re-served on
+    its own int8 engine rather than reusing the bf16 baseline."""
+    off = _engine(tiny_params, kv_quantization="int8")
+    try:
+        reqs = [
+            ModelRequest(rid=f"r{i}", input_ids=list(p), gconfig=_greedy())
+            for i, p in enumerate(_PROMPTS)
+        ]
+        base = {rid: r.output_tokens for rid, r in _run_all(off, reqs).items()}
+        _settle(off)
+        assert _leaked(off) == 0
+    finally:
+        off.stop()
+    on = _engine(
+        tiny_params,
+        spec=SpeculativeConfig(enabled=True, drafter="tree"),
+        kv_quantization="int8",
+    )
+    try:
+        reqs = [
+            ModelRequest(rid=f"r{i}", input_ids=list(p), gconfig=_greedy())
+            for i, p in enumerate(_PROMPTS)
+        ]
+        outs = {rid: r.output_tokens for rid, r in _run_all(on, reqs).items()}
+        _settle(on)
+        assert _leaked(on) == 0
+        assert on.stats["spec_rounds"] > 0, "speculation never ran"
+        assert on.stats["spec_accepted_tokens"] > 0
+    finally:
+        on.stop()
+    assert outs == base, "spec-on diverged from spec-off under int8 KV"
+
+
+def test_spec_rollback_with_quantized_pages_no_leak(tiny_params):
+    """Rejected-tail rollback over int8 KV pages: the value and scale
+    planes live in the same refcounted pages, so the audit is unchanged —
+    rollback activity observable, nothing stranded after settling."""
+    eng = _engine(
+        tiny_params,
+        spec=SpeculativeConfig(enabled=True, drafter="tree"),
+        kv_quantization="int8",
+    )
+    try:
+        reqs = [
+            ModelRequest(rid=f"r{i}", input_ids=list(p), gconfig=_greedy())
+            for i, p in enumerate(_PROMPTS)
+        ]
+        _run_all(eng, reqs)
+        assert eng.stats["spec_rollback_pages"] > 0, (
+            "the adversarial prompts should force rejected tails"
+        )
+        _settle(eng)
+        assert _leaked(eng) == 0
+        held = eng.prefix_cache_stats()["pages_held"]
+        assert eng.pool.used == held  # free + held == total
+    finally:
+        eng.stop()
+
+
 def test_spec_deadline_reaps_mid_speculation(tiny_params):
     """The lifecycle deadline reaper fires while the slot is speculating:
     partial output with consistent version tags, pages fully returned."""
